@@ -1,0 +1,110 @@
+"""Headline benchmark: GPT-2 training throughput + MFU on the local TPU.
+
+Prints ONE JSON line:
+  {"metric": "gpt2_train_mfu", "value": <MFU %>, "unit": "%",
+   "vs_baseline": <MFU / 45%>, ...extras}
+
+Baseline (BASELINE.json): Ray-Train-style GPT-2 at >=45% MFU. vs_baseline > 1
+means we beat the 45% target on this chip.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.gpt2 import (
+    GPT2Config,
+    gpt2_flops_per_token,
+    gpt2_init,
+    gpt2_loss,
+    gpt2_shardings,
+)
+from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+from ray_tpu.train.train_step import make_init_fn, make_train_step
+
+# bf16 peak TFLOP/s per chip by device kind substring.
+PEAK_TFLOPS = {
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v4": 275.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+    "cpu": 0.5,  # nominal, so the script still runs off-TPU
+}
+
+
+def peak_flops_per_chip() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, tf in PEAK_TFLOPS.items():
+        if key in kind:
+            return tf * 1e12
+    return 197.0e12
+
+
+def main() -> None:
+    on_tpu = jax.default_backend() not in ("cpu",)
+    n_dev = jax.device_count()
+    if on_tpu:
+        cfg = GPT2Config()  # GPT-2 small, seq 1024
+        batch, steps, warmup = 16 * n_dev, 20, 3
+    else:
+        cfg = GPT2Config.tiny()
+        batch, steps, warmup = 8, 5, 1
+
+    mesh = build_mesh(MeshConfig(fsdp=-1))
+    shardings = gpt2_shardings(cfg, mesh)
+    init_fn = make_init_fn(lambda r: gpt2_init(r, cfg), shardings, mesh)
+    state = init_fn(jax.random.key(0))
+    step_fn = make_train_step(lambda p, b: gpt2_loss(p, b, cfg), shardings, mesh)
+
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, cfg.seq_len + 1), 0, cfg.vocab_size, jnp.int32
+    )
+    batch_data = {"tokens": tokens}
+
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch_data)
+    # float() forces a device->host transfer of the whole dispatch chain;
+    # block_until_ready alone is not reliable on experimental backends.
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch_data)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * cfg.seq_len
+    tok_s = tokens_per_step * steps / dt
+    flops_tok = gpt2_flops_per_token(cfg)
+    achieved = tok_s * flops_tok
+    mfu = achieved / (peak_flops_per_chip() * n_dev) * 100.0
+
+    print(
+        f"gpt2 {cfg.n_params/1e6:.0f}M params, batch={batch}, seq={cfg.seq_len}, "
+        f"{steps} steps in {dt:.2f}s, loss={final_loss:.3f}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_train_mfu",
+                "value": round(mfu, 2),
+                "unit": "%",
+                "vs_baseline": round(mfu / 45.0, 3),
+                "tokens_per_sec_per_chip": round(tok_s / n_dev, 1),
+                "device": jax.devices()[0].device_kind,
+                "n_devices": n_dev,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+Capability parity target: the Ray framework (reference analyzed in SURVEY.md) —
+tasks, actors, immutable distributed objects, placement groups, and an ML
+library stack (train/tune/data/serve/rllib) — rebuilt TPU-first:
+
+* The **tensor plane is XLA**: collectives ride ICI via ``psum``/``ppermute``/
+  ``all_to_all`` inside jitted step functions over a `jax.sharding.Mesh`,
+  instead of NCCL/Gloo between worker processes (reference:
+  ``python/ray/util/collective/collective_group/nccl_collective_group.py``).
+* The **control plane** mirrors Ray's GCS + raylet + core-worker split
+  (reference: ``src/ray/gcs``, ``src/ray/raylet``, ``src/ray/core_worker``)
+  with a head metadata service, per-node daemon with a worker pool, and an
+  in-process core runtime per driver/worker.
+* The **resource model is topology-aware**: TPU slices, hosts and chips are
+  first-class, and placement groups understand ICI contiguity.
+
+Public API (mirrors reference ``python/ray/__init__.py``):
+    ray_tpu.init / shutdown
+    @ray_tpu.remote        -> RemoteFunction / ActorClass
+    ray_tpu.get / put / wait
+    ray_tpu.get_actor, ray_tpu.kill, ray_tpu.cancel
+"""
+
+from ray_tpu.version import __version__
+
+from ray_tpu.api import (
+    ObjectRef,
+    cancel,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+
+__all__ = [
+    "__version__",
+    "ObjectRef",
+    "cancel",
+    "get",
+    "get_actor",
+    "init",
+    "is_initialized",
+    "kill",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+]

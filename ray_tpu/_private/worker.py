@@ -1,0 +1,55 @@
+"""Global worker/driver state (reference: ``python/ray/_private/worker.py:405``).
+
+Holds the process-wide backend connection. ``init`` wires either the local
+in-process backend or (M3) a cluster backend that talks to the control plane.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+_lock = threading.Lock()
+_backend = None
+_init_kwargs: dict[str, Any] = {}
+
+
+def init(address: str | None = None, **kwargs):
+    global _backend, _init_kwargs
+    with _lock:
+        if _backend is not None:
+            return _backend
+        if address is None or address == "local":
+            from ray_tpu.core.local_backend import LocalBackend
+
+            _backend = LocalBackend(num_cpus=kwargs.get("num_cpus"))
+        else:
+            try:
+                from ray_tpu.cluster.client import connect
+            except ImportError as e:
+                raise NotImplementedError(
+                    f"cluster backend not available in this build "
+                    f"(address={address!r}): {e}"
+                ) from e
+            _backend = connect(address, **kwargs)
+        _init_kwargs = kwargs
+        return _backend
+
+
+def backend():
+    if _backend is None:
+        # Auto-init, matching the reference's implicit ray.init() on first use.
+        init()
+    return _backend
+
+
+def is_initialized() -> bool:
+    return _backend is not None
+
+
+def shutdown():
+    global _backend
+    with _lock:
+        if _backend is not None:
+            _backend.shutdown()
+            _backend = None

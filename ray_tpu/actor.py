@@ -1,0 +1,73 @@
+"""Actor classes and handles (reference: ``python/ray/actor.py:377,657,1020``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu._private import worker as _worker
+from ray_tpu._private.options import validate_actor_options
+
+
+class ActorMethod:
+    """Bound method proxy: ``handle.method.remote(args)``."""
+
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        refs = _worker.backend().submit_actor_task(
+            self._handle._actor_id,
+            self._method_name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str, class_name: str = "Actor"):
+        self._actor_id = actor_id
+        self._class_name = class_name
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self) -> str:
+        return f"ActorHandle({self._class_name}, {self._actor_id[:12]}…)"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name))
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: dict[str, Any] | None = None):
+        self._cls = cls
+        self._options = validate_actor_options(options or {})
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__} cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote()."
+        )
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        actor_id = _worker.backend().create_actor(
+            self._cls, args, kwargs, **self._options
+        )
+        return ActorHandle(actor_id, self._cls.__name__)
+
+    def options(self, **new_options) -> "ActorClass":
+        merged = {**self._options, **validate_actor_options(new_options)}
+        return ActorClass(self._cls, merged)
+
+    @property
+    def cls(self) -> type:
+        return self._cls

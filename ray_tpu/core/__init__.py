@@ -1,0 +1,8 @@
+"""Core runtime: IDs, object refs, backends (local in-process / cluster).
+
+Mirrors the reference's core split (SURVEY.md §2.1): the ``Backend`` protocol
+is the equivalent of the CoreWorker surface (submit/execute, Put/Get/Wait,
+actor lifecycle — ``src/ray/core_worker/core_worker.h:249``); the local
+backend is the single-process implementation, the cluster backend (M3) spans
+a control plane + node daemons.
+"""

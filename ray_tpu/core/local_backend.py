@@ -1,0 +1,339 @@
+"""In-process backend: tasks on a thread pool, actors on dedicated threads.
+
+This is the ``ray.init(local_mode=...)`` analog but with real asynchrony —
+tasks run concurrently and ObjectRefs are genuine futures. It implements the
+same ``Backend`` surface the cluster backend (multi-process, M3) implements,
+so the public API code is backend-agnostic — preserving the reference's
+invariant that libraries sit only on tasks/actors/objects (SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Sequence
+
+from ray_tpu.core import ids
+from ray_tpu.core.object_ref import (
+    ActorError,
+    GetTimeoutError,
+    ObjectRef,
+    TaskError,
+)
+
+
+class _Entry:
+    """Object-table slot: either a concrete value or a pending event."""
+
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error: BaseException | None = None
+
+    def set(self, value):
+        self.value = value
+        self.event.set()
+
+    def set_error(self, err: BaseException):
+        self.error = err
+        self.event.set()
+
+
+class _ActorState:
+    def __init__(self, instance, max_concurrency: int, name: str | None):
+        self.instance = instance
+        self.name = name
+        self.dead = False
+        self.death_cause: str | None = None
+        self.queue: queue.Queue = queue.Queue()
+        self.max_concurrency = max_concurrency
+        self.threads: list[threading.Thread] = []
+        self.lock = threading.Lock()
+
+
+_POISON = object()
+
+
+class LocalBackend:
+    """Single-process task/actor/object runtime."""
+
+    def __init__(self, num_cpus: int | None = None):
+        import os
+
+        self._ncpu = num_cpus or os.cpu_count() or 8
+        # Oversized pool: tasks may block waiting on upstream deps.
+        self._pool = cf.ThreadPoolExecutor(max_workers=max(64, self._ncpu * 8))
+        self._objects: dict[str, _Entry] = {}
+        self._objects_lock = threading.Lock()
+        self._actors: dict[str, _ActorState] = {}
+        self._named_actors: dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._shutdown = False
+
+    # -- object plane -----------------------------------------------------
+
+    def _entry(self, oid: str) -> _Entry:
+        with self._objects_lock:
+            e = self._objects.get(oid)
+            if e is None:
+                e = self._objects[oid] = _Entry()
+            return e
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ids.new_object_id()
+        self._entry(oid).set(value)
+        return ObjectRef(oid)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: float | None = None):
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for r in refs:
+            e = self._entry(r.id)
+            remaining = (
+                None if deadline is None else max(0.0, deadline - time.monotonic())
+            )
+            if not e.event.wait(remaining):
+                raise GetTimeoutError(f"ray_tpu.get timed out on {r}")
+            if e.error is not None:
+                raise e.error
+            out.append(e.value)
+        return out
+
+    def wait(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int,
+        timeout: float | None,
+        fetch_local: bool = True,
+    ):
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: list[ObjectRef] = []
+        pending = list(refs)
+        while len(ready) < num_returns:
+            progressed = False
+            for r in list(pending):
+                if self._entry(r.id).event.is_set():
+                    ready.append(r)
+                    pending.remove(r)
+                    progressed = True
+                    if len(ready) >= num_returns:
+                        break
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            if not progressed:
+                time.sleep(0.001)
+        return ready, pending
+
+    # -- task plane -------------------------------------------------------
+
+    def _resolve_args(self, args, kwargs):
+        args = [self.get([a])[0] if isinstance(a, ObjectRef) else a for a in args]
+        kwargs = {
+            k: self.get([v])[0] if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()
+        }
+        return args, kwargs
+
+    def _store_returns(self, oids: list[str], result, num_returns: int):
+        if num_returns == 1:
+            self._entry(oids[0]).set(result)
+        else:
+            vals = list(result)
+            if len(vals) != num_returns:
+                raise ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{len(vals)} values"
+                )
+            for oid, v in zip(oids, vals):
+                self._entry(oid).set(v)
+
+    def _store_error(self, oids: list[str], err: BaseException):
+        for oid in oids:
+            self._entry(oid).set_error(err)
+
+    def submit_task(
+        self,
+        func: Callable,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        max_retries: int = 0,
+        retry_exceptions: bool | tuple = False,
+        name: str = "",
+        **_options,
+    ) -> list[ObjectRef]:
+        task_id = ids.new_task_id()
+        oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
+        fname = name or getattr(func, "__name__", "task")
+
+        def run():
+            attempts = 0
+            while True:
+                try:
+                    a, kw = self._resolve_args(args, kwargs)
+                    result = func(*a, **kw)
+                    self._store_returns(oids, result, num_returns)
+                    return
+                except BaseException as e:  # noqa: BLE001 — stored, not dropped
+                    retriable = retry_exceptions is True or (
+                        isinstance(retry_exceptions, tuple)
+                        and isinstance(e, retry_exceptions)
+                    )
+                    if retriable and attempts < max_retries:
+                        attempts += 1
+                        continue
+                    if isinstance(e, (TaskError, ActorError)):
+                        self._store_error(oids, e)
+                    else:
+                        self._store_error(
+                            oids,
+                            TaskError(fname, traceback.format_exc(), repr(e)),
+                        )
+                    return
+
+        self._pool.submit(run)
+        return [ObjectRef(o) for o in oids]
+
+    # -- actor plane ------------------------------------------------------
+
+    def create_actor(
+        self,
+        cls: type,
+        args: tuple,
+        kwargs: dict,
+        *,
+        name: str | None = None,
+        max_concurrency: int = 1,
+        **_options,
+    ) -> str:
+        actor_id = ids.new_actor_id()
+        with self._lock:
+            if name is not None:
+                if name in self._named_actors:
+                    raise ValueError(f"actor name {name!r} already taken")
+                self._named_actors[name] = actor_id
+        state = _ActorState(None, max_concurrency, name)
+        self._actors[actor_id] = state
+
+        def ctor():
+            try:
+                a, kw = self._resolve_args(args, kwargs)
+                state.instance = cls(*a, **kw)
+            except BaseException:  # noqa: BLE001
+                state.dead = True
+                state.death_cause = traceback.format_exc()
+                return
+
+        def worker_loop():
+            ctor_done.wait()
+            while True:
+                item = state.queue.get()
+                if item is _POISON:
+                    return
+                oids, method_name, m_args, m_kwargs, num_returns = item
+                if state.dead:
+                    self._store_error(
+                        oids,
+                        ActorError(
+                            f"actor {actor_id} is dead: {state.death_cause}"
+                        ),
+                    )
+                    continue
+                try:
+                    a, kw = self._resolve_args(m_args, m_kwargs)
+                    method = getattr(state.instance, method_name)
+                    result = method(*a, **kw)
+                    self._store_returns(oids, result, num_returns)
+                except BaseException as e:  # noqa: BLE001
+                    self._store_error(
+                        oids,
+                        TaskError(
+                            f"{cls.__name__}.{method_name}",
+                            traceback.format_exc(),
+                            repr(e),
+                        ),
+                    )
+
+        ctor_done = threading.Event()
+
+        def ctor_then_signal():
+            ctor()
+            ctor_done.set()
+
+        threading.Thread(target=ctor_then_signal, daemon=True).start()
+        for _ in range(max_concurrency):
+            t = threading.Thread(target=worker_loop, daemon=True)
+            t.start()
+            state.threads.append(t)
+        return actor_id
+
+    def submit_actor_task(
+        self,
+        actor_id: str,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        **_options,
+    ) -> list[ObjectRef]:
+        state = self._actors.get(actor_id)
+        task_id = ids.new_task_id()
+        oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
+        if state is None or state.dead:
+            cause = state.death_cause if state else "no such actor"
+            err = ActorError(f"actor {actor_id} is dead: {cause}")
+            self._store_error(oids, err)
+        else:
+            state.queue.put((oids, method_name, args, kwargs, num_returns))
+        return [ObjectRef(o) for o in oids]
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        state = self._actors.get(actor_id)
+        if state is None:
+            return
+        state.dead = True
+        state.death_cause = "killed via ray_tpu.kill"
+        for _ in state.threads:
+            state.queue.put(_POISON)
+        with self._lock:
+            if state.name and self._named_actors.get(state.name) == actor_id:
+                del self._named_actors[state.name]
+
+    def get_named_actor(self, name: str) -> str:
+        with self._lock:
+            aid = self._named_actors.get(name)
+        if aid is None:
+            raise ValueError(f"no actor named {name!r}")
+        return aid
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        # Local mode: best-effort no-op (threads are not interruptible).
+        pass
+
+    # -- lifecycle --------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        for aid in list(self._actors):
+            self.kill_actor(aid)
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    # -- introspection ----------------------------------------------------
+
+    def cluster_resources(self) -> dict:
+        return {"CPU": float(self._ncpu)}
+
+    def nodes(self) -> list[dict]:
+        return [{"NodeID": "local", "Alive": True, "Resources": self.cluster_resources()}]

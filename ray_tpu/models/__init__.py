@@ -1,0 +1,10 @@
+"""Model zoo: pure-JAX pytree models designed for pjit sharding.
+
+Flagship: GPT-2 (the BASELINE.json north-star workload). Models are plain
+functions over parameter pytrees — no framework Module state — so the same
+code runs under any mesh and any rules table.
+"""
+
+from ray_tpu.models.gpt2 import GPT2Config, gpt2_forward, gpt2_init, gpt2_loss
+
+__all__ = ["GPT2Config", "gpt2_forward", "gpt2_init", "gpt2_loss"]

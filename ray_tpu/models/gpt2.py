@@ -1,0 +1,212 @@
+"""GPT-2 in pure JAX, sharding-annotated, scan-over-layers, remat-able.
+
+This is the flagship training workload (BASELINE.json: GPT-2 Train benchmark,
+target >=45% MFU on a v4 slice). Design choices for TPU:
+
+* Parameters are a plain pytree of arrays plus a parallel pytree of *logical
+  axis names* (``gpt2_param_axes``); physical shardings come from
+  ``ray_tpu.parallel.sharding`` rules — Megatron TP on mlp/heads/vocab dims,
+  ZeRO-3 (fsdp) on the embed dim, pp over the stacked layer dim.
+* Transformer blocks are **stacked** ([n_layer, ...] leaves) and iterated
+  with `lax.scan` => O(1) compile time in depth, and the block body is
+  `jax.checkpoint`-ed so activations are rematerialized in backward
+  (HBM-for-FLOPs trade, SURVEY.md §"HBM bandwidth").
+* Compute in bf16 (MXU-native), params + optimizer state in fp32, softmax
+  and loss in fp32.
+
+Reference parity note: the reference trains GPT-2 through torch DDP wrapped
+in Ray Train (``release/air_tests/air_benchmarks``); here the model is owned
+by the framework and compiled as one pjit program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import causal_attention
+from ray_tpu.parallel.sharding import logical_sharding, with_logical_constraint
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304  # 50257 padded to a multiple of 128 for the MXU
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    seq_len: int = 1024
+    dtype: Any = jnp.bfloat16  # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    use_flash: bool | None = None  # None = auto by seq_len/backend
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @property
+    def n_params(self) -> int:
+        """Parameter count (tied embeddings)."""
+        d, l, v, s = self.d_model, self.n_layer, self.vocab_size, self.seq_len
+        per_layer = 12 * d * d + 13 * d  # qkv+proj+mlp weights & biases + 2 LN
+        return v * d + s * d + l * per_layer + 2 * d
+
+    @classmethod
+    def small(cls) -> "GPT2Config":
+        return cls()  # 124M
+
+    @classmethod
+    def medium(cls) -> "GPT2Config":
+        return cls(n_layer=24, n_head=16, d_model=1024)
+
+    @classmethod
+    def tiny(cls) -> "GPT2Config":
+        """CPU-test sized."""
+        return cls(vocab_size=256, n_layer=2, n_head=4, d_model=64, seq_len=64)
+
+
+def gpt2_param_axes(cfg: GPT2Config) -> Params:
+    """Logical axis names for every param leaf (same tree structure)."""
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": {
+            # leading dim is the stacked layer dim
+            "ln1_scale": ("layers", None),
+            "ln1_bias": ("layers", None),
+            "attn_qkv_w": ("layers", "embed", "qkv"),
+            "attn_qkv_b": ("layers", "qkv"),
+            "attn_out_w": ("layers", "qkv", "embed"),
+            "attn_out_b": ("layers", None),
+            "ln2_scale": ("layers", None),
+            "ln2_bias": ("layers", None),
+            "mlp_in_w": ("layers", "embed", "mlp"),
+            "mlp_in_b": ("layers", "mlp"),
+            "mlp_out_w": ("layers", "mlp", "embed"),
+            "mlp_out_b": ("layers", None),
+        },
+        "lnf_scale": (None,),
+        "lnf_bias": (None,),
+    }
+
+
+def gpt2_shardings(cfg: GPT2Config, mesh, rules=None) -> Params:
+    return jax.tree.map(
+        lambda axes: logical_sharding(mesh, axes, rules),
+        gpt2_param_axes(cfg),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def gpt2_init(rng: jax.Array, cfg: GPT2Config) -> Params:
+    """GPT-2 init: normal(0.02), residual projections scaled by 1/sqrt(2L)."""
+    d, l, v, s = cfg.d_model, cfg.n_layer, cfg.vocab_size, cfg.seq_len
+    pd = cfg.param_dtype
+    k = iter(jax.random.split(rng, 8))
+    std = 0.02
+    resid_std = std / math.sqrt(2 * l)
+
+    def norm(key, shape, stddev):
+        return (jax.random.normal(key, shape, jnp.float32) * stddev).astype(pd)
+
+    return {
+        "wte": norm(next(k), (v, d), std),
+        "wpe": norm(next(k), (s, d), std),
+        "blocks": {
+            "ln1_scale": jnp.ones((l, d), pd),
+            "ln1_bias": jnp.zeros((l, d), pd),
+            "attn_qkv_w": norm(next(k), (l, d, 3 * d), std),
+            "attn_qkv_b": jnp.zeros((l, 3 * d), pd),
+            "attn_out_w": norm(next(k), (l, d, d), resid_std),
+            "attn_out_b": jnp.zeros((l, d), pd),
+            "ln2_scale": jnp.ones((l, d), pd),
+            "ln2_bias": jnp.zeros((l, d), pd),
+            "mlp_in_w": norm(next(k), (l, d, 4 * d), std),
+            "mlp_in_b": jnp.zeros((l, 4 * d), pd),
+            "mlp_out_w": norm(next(k), (l, 4 * d, d), resid_std),
+            "mlp_out_b": jnp.zeros((l, d), pd),
+        },
+        "lnf_scale": jnp.ones((d,), pd),
+        "lnf_bias": jnp.zeros((d,), pd),
+    }
+
+
+def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + 1e-5)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _block(x: jax.Array, p: Params, cfg: GPT2Config) -> jax.Array:
+    """One transformer block. x: [B, T, D] in cfg.dtype."""
+    b, t, d = x.shape
+    h, hd = cfg.n_head, cfg.head_dim
+    dt = cfg.dtype
+
+    y = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    qkv = y @ p["attn_qkv_w"].astype(dt) + p["attn_qkv_b"].astype(dt)
+    q, k_, v_ = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, h, hd)
+    k_ = k_.reshape(b, t, h, hd)
+    v_ = v_.reshape(b, t, h, hd)
+    attn = causal_attention(q, k_, v_, use_flash=cfg.use_flash)
+    attn = attn.reshape(b, t, d)
+    x = x + attn @ p["attn_out_w"].astype(dt) + p["attn_out_b"].astype(dt)
+    x = with_logical_constraint(x, ("batch", "seq", None))
+
+    y = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    y = y @ p["mlp_in_w"].astype(dt) + p["mlp_in_b"].astype(dt)
+    y = with_logical_constraint(y, ("batch", "seq", "mlp"))
+    y = jax.nn.gelu(y, approximate=True)
+    x = x + y @ p["mlp_out_w"].astype(dt) + p["mlp_out_b"].astype(dt)
+    x = with_logical_constraint(x, ("batch", "seq", None))
+    return x
+
+
+def gpt2_forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, V] fp32."""
+    _, t = tokens.shape
+    dt = cfg.dtype
+    x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[:t]
+    x = with_logical_constraint(x, ("batch", "seq", None))
+
+    block_fn = lambda carry, p: (_block(carry, p, cfg), None)
+    if cfg.remat:
+        block_fn = jax.checkpoint(block_fn, prevent_cse=False)
+    x, _ = jax.lax.scan(block_fn, x, params["blocks"])
+
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    # Tied LM head; fp32 logits for a stable loss.
+    logits = jnp.einsum(
+        "btd,vd->btv", x, params["wte"].astype(dt), preferred_element_type=jnp.float32
+    )
+    return logits
+
+
+def gpt2_loss(params: Params, batch: dict[str, jax.Array], cfg: GPT2Config) -> jax.Array:
+    """Next-token cross-entropy. batch: {'tokens': [B, T+1] or [B, T] int32}.
+
+    If only [B, T] is given, inputs are tokens[:, :-1], targets tokens[:, 1:].
+    """
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = gpt2_forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def gpt2_flops_per_token(cfg: GPT2Config, seq_len: int | None = None) -> float:
+    """Training FLOPs/token: 6*N for matmuls + attention score/value FLOPs.
+
+    Standard estimate (PaLM appendix B): 6*n_params + 12*L*D*T (causal)."""
+    t = seq_len or cfg.seq_len
+    return 6 * cfg.n_params + 12 * cfg.n_layer * cfg.d_model * t // 2

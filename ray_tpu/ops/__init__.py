@@ -1,0 +1,10 @@
+"""Compute ops: attention (XLA + Pallas flash), fused layers, collectives.
+
+The MXU-facing layer: everything here is written for large, static-shaped,
+bf16 matmuls that XLA can tile onto the systolic array, with Pallas kernels
+for the ops XLA does not fuse well (flash attention with causal masking).
+"""
+
+from ray_tpu.ops.attention import causal_attention
+
+__all__ = ["causal_attention"]

@@ -1,0 +1,70 @@
+"""Attention ops.
+
+``causal_attention`` dispatches between:
+  * a pure-XLA implementation (always correct; XLA fuses the softmax chain
+    and maps the two einsums onto the MXU) — also the CPU-test path;
+  * a Pallas flash-attention TPU kernel (``ray_tpu.ops.flash_attention``)
+    for long sequences where materializing the [T, T] score matrix would be
+    HBM-bound.
+
+The reference has no attention ops at all (it defers to torch); this module
+exists because on TPU the framework owns the compute path (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Sequence length at/above which the flash kernel pays for itself; below it
+# the XLA path is both faster to compile and fast enough.
+_FLASH_MIN_SEQ = 1024
+
+
+def xla_causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, softmax_scale: float | None = None
+) -> jax.Array:
+    """Causal multi-head attention, pure XLA.
+
+    Args are [batch, seq, heads, head_dim]. Computes in the input dtype
+    (bf16 on TPU) with fp32 softmax accumulation.
+    """
+    *_, t, _h, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+    # [B, H, T, T] scores in fp32 for a stable softmax.
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    softmax_scale: float | None = None,
+    use_flash: bool | None = None,
+) -> jax.Array:
+    """[B, T, H, D] causal attention with automatic kernel selection."""
+    t = q.shape[1]
+    explicit = use_flash is True
+    if use_flash is None:
+        use_flash = (
+            t >= _FLASH_MIN_SEQ
+            and jax.default_backend() not in ("cpu",)
+        )
+    if use_flash:
+        try:
+            from ray_tpu.ops.flash_attention import flash_causal_attention
+
+            return flash_causal_attention(q, k, v, softmax_scale=softmax_scale)
+        except (ImportError, NotImplementedError):
+            if explicit:
+                # The caller asked for flash by name; do not silently degrade.
+                raise
+    return xla_causal_attention(q, k, v, softmax_scale=softmax_scale)

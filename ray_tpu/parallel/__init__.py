@@ -1,0 +1,36 @@
+"""Parallelism layer: device meshes, sharding rules, collective groups.
+
+TPU-native replacement for the reference's ``ray.util.collective`` (group
+management over NCCL/Gloo, ``python/ray/util/collective/collective.py``) and
+for the parallelism strategies the reference lacks entirely (TP/PP/SP/EP —
+see SURVEY.md §2.4): here they are named mesh axes over which XLA compiles
+ICI collectives.
+"""
+
+from ray_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    MeshConfig,
+    auto_mesh_config,
+    build_mesh,
+    local_device_count,
+)
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    logical_sharding,
+    logical_spec,
+    shard_pytree,
+    with_logical_constraint,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "MeshConfig",
+    "auto_mesh_config",
+    "build_mesh",
+    "local_device_count",
+    "DEFAULT_RULES",
+    "logical_sharding",
+    "logical_spec",
+    "shard_pytree",
+    "with_logical_constraint",
+]

@@ -1,0 +1,20 @@
+"""Train library: pjit train steps, sessions, worker groups, checkpoints.
+
+TPU-native equivalent of the reference's Ray Train
+(``python/ray/train/data_parallel_trainer.py:244``,
+``python/ray/train/_internal/backend_executor.py:42``): the inner loop is a
+single pjit-compiled step over a device mesh (XLA inserts the gradient
+collectives on ICI); the framework's job is placement, session plumbing,
+checkpoints and failure handling.
+"""
+
+from ray_tpu.train.train_step import TrainState, make_train_step, make_init_fn
+from ray_tpu.train.optim import adamw_init, adamw_update
+
+__all__ = [
+    "TrainState",
+    "make_train_step",
+    "make_init_fn",
+    "adamw_init",
+    "adamw_update",
+]

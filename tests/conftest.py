@@ -1,0 +1,27 @@
+"""Test harness: force an 8-virtual-device CPU platform.
+
+Mirrors the reference's trick of simulating multi-node clusters on one host
+(``python/ray/cluster_utils.py:99``): here we simulate an 8-chip TPU slice
+with 8 XLA CPU devices so every sharding/collective path is exercised
+without TPU hardware (SURVEY.md §4.3).
+"""
+
+import os
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+# The environment may force a TPU backend via a site hook that overrides
+# JAX_PLATFORMS by config; undo it before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 cpu devices, got {len(devs)}"
+    return devs[:8]

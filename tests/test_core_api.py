@@ -1,0 +1,150 @@
+"""Core task/actor/object API tests (modeled on the reference's
+``python/ray/tests/test_basic.py`` behaviors, run against the in-process
+backend)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.object_ref import GetTimeoutError, TaskError
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.init()
+    yield
+    ray_tpu.shutdown()
+
+
+def test_put_get():
+    ref = ray_tpu.put({"a": 1})
+    assert ray_tpu.get(ref) == {"a": 1}
+    assert ray_tpu.get([ref, ref]) == [{"a": 1}, {"a": 1}]
+
+
+def test_task_roundtrip():
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+    # ObjectRef args are resolved before execution (dependency ordering).
+    r1 = add.remote(1, 2)
+    r2 = add.remote(r1, 10)
+    assert ray_tpu.get(r2) == 13
+
+
+def test_num_returns():
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    a, b = two.remote()
+    assert ray_tpu.get([a, b]) == [1, 2]
+
+
+def test_task_error_propagates():
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("bad")
+
+    with pytest.raises(TaskError, match="bad"):
+        ray_tpu.get(boom.remote())
+
+
+def test_task_retries():
+    state = {"n": 0}
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise RuntimeError("transient")
+        return state["n"]
+
+    assert ray_tpu.get(flaky.remote()) == 3
+
+
+def test_wait():
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast = slow.remote(0.01)
+    sluggish = slow.remote(5.0)
+    ready, pending = ray_tpu.wait([fast, sluggish], num_returns=1, timeout=2.0)
+    assert ready == [fast] and pending == [sluggish]
+
+
+def test_get_timeout():
+    @ray_tpu.remote
+    def hang():
+        time.sleep(60)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(hang.remote(), timeout=0.1)
+
+
+def test_actor_state_and_order():
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    refs = [c.incr.remote() for _ in range(5)]
+    assert ray_tpu.get(refs) == [11, 12, 13, 14, 15]  # sequential ordering
+    assert ray_tpu.get(c.value.remote()) == 15
+
+
+def test_named_actor():
+    @ray_tpu.remote
+    class KV:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+
+        def get(self, k):
+            return self.d.get(k)
+
+    kv = KV.options(name="kv-store").remote()
+    ray_tpu.get(kv.set.remote("x", 42))
+    handle = ray_tpu.get_actor("kv-store")
+    assert ray_tpu.get(handle.get.remote("x")) == 42
+    ray_tpu.kill(kv)
+
+
+def test_actor_handle_passing():
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.v = 7
+
+        def value(self):
+            return self.v
+
+    @ray_tpu.remote
+    def reads(handle):
+        return ray_tpu.get(handle.value.remote())
+
+    h = Holder.remote()
+    assert ray_tpu.get(reads.remote(h)) == 7
+
+
+def test_invalid_options():
+    with pytest.raises(ValueError):
+
+        @ray_tpu.remote(bogus_option=1)
+        def f():
+            pass

@@ -1,0 +1,39 @@
+import jax
+import pytest
+
+from ray_tpu.parallel.mesh import AXIS_ORDER, MeshConfig, build_mesh
+from ray_tpu.parallel.sharding import DEFAULT_RULES, logical_spec
+from jax.sharding import PartitionSpec as P
+
+
+def test_axis_sizes_wildcard():
+    cfg = MeshConfig(dp=2, fsdp=-1, tp=2)
+    sizes = cfg.axis_sizes(8)
+    assert sizes == {"pp": 1, "dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
+
+
+def test_axis_sizes_errors():
+    with pytest.raises(ValueError):
+        MeshConfig(dp=3, fsdp=-1).axis_sizes(8)  # not divisible
+    with pytest.raises(ValueError):
+        MeshConfig(dp=2, fsdp=2).axis_sizes(8)  # product mismatch
+    with pytest.raises(ValueError):
+        MeshConfig(dp=-1, fsdp=-1).axis_sizes(8)  # two wildcards
+
+
+def test_build_mesh(devices8):
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    assert mesh.axis_names == AXIS_ORDER
+    assert mesh.devices.size == 8
+
+
+def test_logical_spec_basic():
+    assert logical_spec(("batch", "seq", "embed")) == P(("dp", "fsdp"), "sp", None)
+    # 'embed' falls back to replicated because fsdp was taken by batch.
+    assert logical_spec(("embed", "mlp")) == P("fsdp", "tp")
+    assert logical_spec((None, "vocab")) == P(None, "tp")
+
+
+def test_logical_spec_no_double_use():
+    # vocab and mlp both map to tp; second one must be replicated.
+    assert logical_spec(("vocab", "mlp")) == P("tp", None)
