@@ -1,0 +1,4 @@
+"""Native (C++) components: shm object store, scheduling policy.
+
+Built on demand by ``ray_tpu._native.build`` (reference analog: the bazel
+targets under ``src/ray/``)."""

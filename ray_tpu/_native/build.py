@@ -1,0 +1,54 @@
+"""Build the native C++ components on first import (cached by mtime).
+
+The reference builds its native core via bazel (``src/ray/BUILD``); here the
+native surface is small enough that a direct g++ invocation with an mtime
+cache is simpler and hermetic (no generated build files in-tree).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_LIB_DIR = os.path.join(os.path.dirname(__file__), "lib")
+_LOCK = threading.Lock()
+
+_LIBS = {
+    # lib name -> source files
+    "shm_store": ["shm_store.cc"],
+    "scheduler": ["scheduler.cc"],
+}
+
+
+def lib_path(name: str) -> str:
+    return os.path.join(_LIB_DIR, f"lib{name}.so")
+
+
+def ensure_built(name: str) -> str:
+    """Compile lib<name>.so if missing or stale; return its path."""
+    sources = [os.path.join(_SRC_DIR, s) for s in _LIBS[name]]
+    out = lib_path(name)
+    with _LOCK:
+        if os.path.exists(out):
+            src_mtime = max(os.path.getmtime(s) for s in sources)
+            if os.path.getmtime(out) >= src_mtime:
+                return out
+        os.makedirs(_LIB_DIR, exist_ok=True)
+        tmp = out + f".tmp.{os.getpid()}"
+        cmd = [
+            "g++",
+            "-O2",
+            "-g",
+            "-fPIC",
+            "-shared",
+            "-std=c++17",
+            "-pthread",
+            "-o",
+            tmp,
+            *sources,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)  # atomic w.r.t. concurrent builders
+    return out

@@ -1,0 +1,206 @@
+"""ctypes binding for the C++ shared-memory object store.
+
+Python-side counterpart of the reference's plasma client
+(``src/ray/object_manager/plasma/client.h``): create/seal/get/release/delete
+against the node-local segment, with zero-copy reads — ``get`` returns
+memoryviews sliced straight out of the mmap'd segment, which numpy /
+pickle-5 consume without copying.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import mmap
+import os
+
+from ray_tpu._native.build import ensure_built
+
+ID_SIZE = 20
+
+
+class StoreFullError(Exception):
+    """Segment cannot fit the object even after evicting everything evictable."""
+
+
+class ObjectExistsError(Exception):
+    pass
+
+
+def _load():
+    lib = ctypes.CDLL(ensure_built("shm_store"))
+    lib.ts_create.restype = ctypes.c_void_p
+    lib.ts_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.ts_attach.restype = ctypes.c_void_p
+    lib.ts_attach.argtypes = [ctypes.c_char_p]
+    lib.ts_detach.argtypes = [ctypes.c_void_p]
+    lib.ts_unlink.argtypes = [ctypes.c_char_p]
+    lib.ts_alloc.restype = ctypes.c_int64
+    lib.ts_alloc.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+    ]
+    for fn in ("ts_seal", "ts_release", "ts_contains", "ts_delete", "ts_abort"):
+        f = getattr(lib, fn)
+        f.restype = ctypes.c_int
+        f.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ts_get.restype = ctypes.c_int
+    lib.ts_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.ts_stats.argtypes = [ctypes.c_void_p] + [
+        ctypes.POINTER(ctypes.c_uint64)
+    ] * 4
+    lib.ts_list.restype = ctypes.c_uint64
+    lib.ts_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    return lib
+
+
+_lib = None
+
+
+def _get_lib():
+    global _lib
+    if _lib is None:
+        _lib = _load()
+    return _lib
+
+
+def store_key(object_id: str) -> bytes:
+    """Map a framework object-id string to the store's fixed 20-byte key."""
+    return hashlib.sha1(object_id.encode()).digest()
+
+
+class ShmStore:
+    """One node-local segment. ``create=True`` initializes it (node daemon);
+    workers/drivers attach to the existing segment."""
+
+    def __init__(self, path: str, capacity: int = 0, *, create: bool = False):
+        lib = _get_lib()
+        self.path = path
+        if create:
+            self._h = lib.ts_create(path.encode(), capacity, 0)
+            if not self._h:
+                raise OSError(f"failed to create shm store at {path}")
+        else:
+            self._h = lib.ts_attach(path.encode())
+            if not self._h:
+                raise OSError(f"failed to attach shm store at {path}")
+        # Python-side view of the same segment for zero-copy buffers.
+        self._fd = os.open(path, os.O_RDWR)
+        self._mm = mmap.mmap(self._fd, 0)
+        self._owner = create
+
+    # -- object lifecycle -------------------------------------------------
+
+    def create(self, object_id: str, data_size: int, meta: bytes = b"") -> memoryview:
+        """Allocate an unsealed object; returns a writable view of its data
+        region. Write, then ``seal``."""
+        key = store_key(object_id)
+        off = _get_lib().ts_alloc(self._h, key, data_size, len(meta))
+        if off == -2:
+            raise ObjectExistsError(object_id)
+        if off < 0:
+            raise StoreFullError(
+                f"cannot allocate {data_size + len(meta)} bytes (code {off})"
+            )
+        if meta:
+            self._mm[off + data_size : off + data_size + len(meta)] = meta
+        return memoryview(self._mm)[off : off + data_size]
+
+    def put(self, object_id: str, data, meta: bytes = b"") -> None:
+        """create + write + seal in one call. ``data`` is bytes-like or a
+        list of bytes-like chunks (written back to back)."""
+        chunks = data if isinstance(data, (list, tuple)) else [data]
+        total = sum(len(c) for c in chunks)
+        buf = self.create(object_id, total, meta)
+        pos = 0
+        for c in chunks:
+            n = len(c)
+            buf[pos : pos + n] = bytes(c) if not isinstance(c, (bytes, bytearray, memoryview)) else c
+            pos += n
+        self.seal(object_id)
+
+    def seal(self, object_id: str) -> None:
+        rc = _get_lib().ts_seal(self._h, store_key(object_id))
+        if rc != 0:
+            raise KeyError(f"seal({object_id}) failed: {rc}")
+
+    def get(self, object_id: str) -> tuple[memoryview, bytes] | None:
+        """Zero-copy read: (data view, metadata bytes), or None if absent.
+        Caller must ``release`` when done with the view."""
+        off = ctypes.c_uint64()
+        dsz = ctypes.c_uint64()
+        msz = ctypes.c_uint64()
+        rc = _get_lib().ts_get(
+            self._h, store_key(object_id), ctypes.byref(off), ctypes.byref(dsz),
+            ctypes.byref(msz),
+        )
+        if rc != 0:
+            return None
+        o, d, m = off.value, dsz.value, msz.value
+        data = memoryview(self._mm)[o : o + d]
+        meta = bytes(self._mm[o + d : o + d + m])
+        return data, meta
+
+    def release(self, object_id: str) -> None:
+        _get_lib().ts_release(self._h, store_key(object_id))
+
+    def contains(self, object_id: str) -> bool:
+        return bool(_get_lib().ts_contains(self._h, store_key(object_id)))
+
+    def delete(self, object_id: str) -> bool:
+        return _get_lib().ts_delete(self._h, store_key(object_id)) == 0
+
+    def abort(self, object_id: str) -> bool:
+        return _get_lib().ts_abort(self._h, store_key(object_id)) == 0
+
+    # -- introspection ----------------------------------------------------
+
+    def stats(self) -> dict:
+        vals = [ctypes.c_uint64() for _ in range(4)]
+        _get_lib().ts_stats(self._h, *[ctypes.byref(v) for v in vals])
+        return {
+            "capacity": vals[0].value,
+            "used": vals[1].value,
+            "num_objects": vals[2].value,
+            "num_evictions": vals[3].value,
+        }
+
+    def list_keys(self, max_ids: int = 1 << 16) -> list[bytes]:
+        buf = ctypes.create_string_buffer(max_ids * ID_SIZE)
+        n = _get_lib().ts_list(self._h, buf, max_ids)
+        return [buf.raw[i * ID_SIZE : (i + 1) * ID_SIZE] for i in range(n)]
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self, unlink: bool = False) -> None:
+        if self._h:
+            _get_lib().ts_detach(self._h)
+            self._h = None
+            try:
+                self._mm.close()
+            except BufferError:
+                # Zero-copy views into the segment are still alive somewhere;
+                # the mapping stays until they are collected (plasma keeps
+                # client mappings for the process lifetime for the same
+                # reason). The OS reclaims it at process exit.
+                pass
+            os.close(self._fd)
+        if unlink and self._owner:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

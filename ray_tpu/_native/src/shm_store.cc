@@ -1,0 +1,460 @@
+// shm_store — per-node shared-memory immutable object store.
+//
+// TPU-native analog of the reference's plasma store
+// (reference: src/ray/object_manager/plasma/store.h:55,
+//  object_lifecycle_manager.h:101, eviction_policy.h:105).
+//
+// Design: ONE mmap'd file (under /dev/shm) shared by every process on the
+// node (node daemon + workers + driver). All metadata — object table,
+// allocator free state, LRU clock — lives INSIDE the segment, guarded by a
+// process-shared robust pthread mutex. Clients attach by mmapping the same
+// file, so Create/Seal/Get/Release are plain library calls (no store daemon
+// round-trip, no fd passing — the fd-passing dance in plasma's fling.cc
+// exists because plasma allocates per-object maps; a single fixed segment
+// makes offsets process-portable).
+//
+// Object lifecycle: ALLOC (unsealed, writable by creator) -> SEAL (immutable,
+// readable by all) -> refcounted Get/Release -> DELETE or LRU-evict when
+// refcount hits zero and space is needed (mirrors plasma eviction_policy).
+//
+// Allocation: block-header first-fit arena with lazy coalescing of adjacent
+// free blocks during the allocation scan (plasma uses dlmalloc; first-fit is
+// adequate at the object counts a node sees and is robust in shared memory).
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <new>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5452415953544f52ULL;  // "TRAYSTOR"
+constexpr uint32_t kIdSize = 20;
+constexpr uint64_t kAlign = 64;  // cache-line align objects; helps DMA/H2D
+
+enum SlotState : uint32_t {
+  SLOT_EMPTY = 0,
+  SLOT_USED = 1,
+  SLOT_TOMBSTONE = 2,
+};
+
+struct Slot {
+  uint8_t id[kIdSize];
+  uint32_t state;     // SlotState
+  uint32_t sealed;    // 0 = created/unsealed, 1 = sealed
+  int64_t refcount;   // cross-process pins from ts_get
+  uint64_t offset;    // data offset from segment base
+  uint64_t data_size;
+  uint64_t meta_size;
+  uint64_t lru_tick;  // last-touch clock for eviction
+};
+
+// Arena block header, placed immediately before each block's payload.
+struct Block {
+  uint64_t size;  // payload bytes (excluding header)
+  uint32_t free;  // 1 = free
+  uint32_t magic; // 0xB10CB10C guard
+};
+constexpr uint32_t kBlockMagic = 0xB10CB10C;
+
+struct Header {
+  uint64_t magic;
+  uint64_t segment_size;
+  uint64_t num_slots;
+  uint64_t slots_offset;
+  uint64_t arena_offset;
+  uint64_t arena_size;
+  uint64_t lru_clock;
+  uint64_t num_objects;
+  uint64_t bytes_in_use;   // payload bytes of live objects
+  uint64_t num_evictions;
+  pthread_mutex_t mutex;
+};
+
+struct Handle {
+  uint8_t* base;
+  uint64_t size;
+  int fd;
+  Header* hdr() const { return reinterpret_cast<Header*>(base); }
+  Slot* slots() const {
+    return reinterpret_cast<Slot*>(base + hdr()->slots_offset);
+  }
+};
+
+uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+uint64_t id_hash(const uint8_t* id) {
+  // FNV-1a over the 20-byte id.
+  uint64_t h = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < kIdSize; i++) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+class Guard {
+ public:
+  explicit Guard(Header* h) : h_(h) {
+    int rc = pthread_mutex_lock(&h_->mutex);
+    if (rc == EOWNERDEAD) {
+      // A process died holding the lock; state is still consistent for our
+      // coarse-grained critical sections (each op completes its writes
+      // before unlocking the only partially-written thing is an unsealed
+      // object, which its dead creator can never seal => abortable).
+      pthread_mutex_consistent(&h_->mutex);
+    }
+  }
+  ~Guard() { pthread_mutex_unlock(&h_->mutex); }
+
+ private:
+  Header* h_;
+};
+
+Slot* find_slot(Handle* h, const uint8_t* id) {
+  Header* hdr = h->hdr();
+  Slot* slots = h->slots();
+  uint64_t n = hdr->num_slots;
+  uint64_t i = id_hash(id) % n;
+  for (uint64_t probe = 0; probe < n; probe++, i = (i + 1) % n) {
+    Slot& s = slots[i];
+    if (s.state == SLOT_EMPTY) return nullptr;
+    if (s.state == SLOT_USED && memcmp(s.id, id, kIdSize) == 0) return &s;
+  }
+  return nullptr;
+}
+
+Slot* insert_slot(Handle* h, const uint8_t* id) {
+  Header* hdr = h->hdr();
+  Slot* slots = h->slots();
+  uint64_t n = hdr->num_slots;
+  uint64_t i = id_hash(id) % n;
+  Slot* tomb = nullptr;
+  for (uint64_t probe = 0; probe < n; probe++, i = (i + 1) % n) {
+    Slot& s = slots[i];
+    if (s.state == SLOT_EMPTY) {
+      Slot* t = tomb ? tomb : &s;
+      memcpy(t->id, id, kIdSize);
+      t->state = SLOT_USED;
+      return t;
+    }
+    if (s.state == SLOT_TOMBSTONE && !tomb) tomb = &s;
+    if (s.state == SLOT_USED && memcmp(s.id, id, kIdSize) == 0) return nullptr;
+  }
+  if (tomb) {
+    memcpy(tomb->id, id, kIdSize);
+    tomb->state = SLOT_USED;
+    return tomb;
+  }
+  return nullptr;  // table full
+}
+
+Block* block_at(Handle* h, uint64_t off) {
+  return reinterpret_cast<Block*>(h->base + off);
+}
+
+// First-fit scan with lazy coalescing. Returns payload offset or 0.
+uint64_t arena_alloc(Handle* h, uint64_t want) {
+  Header* hdr = h->hdr();
+  want = align_up(want, kAlign);
+  uint64_t off = hdr->arena_offset;
+  uint64_t end = hdr->arena_offset + hdr->arena_size;
+  while (off < end) {
+    Block* b = block_at(h, off);
+    if (b->magic != kBlockMagic) return 0;  // corruption; bail
+    if (b->free) {
+      // Coalesce following free blocks.
+      uint64_t next = off + sizeof(Block) + b->size;
+      while (next < end) {
+        Block* nb = block_at(h, next);
+        if (nb->magic != kBlockMagic || !nb->free) break;
+        b->size += sizeof(Block) + nb->size;
+        nb->magic = 0;
+        next = off + sizeof(Block) + b->size;
+      }
+      if (b->size >= want) {
+        // Split if the tail is big enough to hold a header + one line.
+        if (b->size >= want + sizeof(Block) + kAlign) {
+          uint64_t tail_off = off + sizeof(Block) + want;
+          Block* tail = block_at(h, tail_off);
+          tail->size = b->size - want - sizeof(Block);
+          tail->free = 1;
+          tail->magic = kBlockMagic;
+          b->size = want;
+        }
+        b->free = 0;
+        return off + sizeof(Block);
+      }
+    }
+    off += sizeof(Block) + b->size;
+  }
+  return 0;
+}
+
+void arena_free(Handle* h, uint64_t payload_off) {
+  Block* b = block_at(h, payload_off - sizeof(Block));
+  if (b->magic != kBlockMagic) return;
+  b->free = 1;
+}
+
+void delete_slot(Handle* h, Slot* s) {
+  Header* hdr = h->hdr();
+  arena_free(h, s->offset);
+  hdr->bytes_in_use -= align_up(s->data_size + s->meta_size, kAlign);
+  hdr->num_objects--;
+  s->state = SLOT_TOMBSTONE;
+  s->sealed = 0;
+  s->refcount = 0;
+}
+
+// Evict the single least-recently-used sealed, unpinned object.
+// Returns true if a victim was evicted (caller retries allocation).
+bool evict_one(Handle* h) {
+  Header* hdr = h->hdr();
+  Slot* victim = nullptr;
+  Slot* slots = h->slots();
+  for (uint64_t i = 0; i < hdr->num_slots; i++) {
+    Slot& s = slots[i];
+    if (s.state == SLOT_USED && s.sealed && s.refcount == 0) {
+      if (!victim || s.lru_tick < victim->lru_tick) victim = &s;
+    }
+  }
+  if (!victim) return false;
+  delete_slot(h, victim);
+  hdr->num_evictions++;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ts_create(const char* path, uint64_t size, uint64_t num_slots) {
+  if (size < (1u << 20)) size = 1u << 20;
+  if (num_slots == 0) {
+    // Size the table so it stays well under the segment: one slot per 4KB
+    // of capacity, clamped to [1024, 65536].
+    num_slots = size / 4096;
+    if (num_slots > (1 << 16)) num_slots = 1 << 16;
+    if (num_slots < 1024) num_slots = 1024;
+  }
+  // The slot table + header must leave a usable arena.
+  {
+    uint64_t meta_bytes = align_up(sizeof(Header), kAlign) +
+                          align_up(num_slots * sizeof(Slot), kAlign);
+    if (meta_bytes + (1u << 16) > size) return nullptr;
+  }
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)size) != 0) {
+    close(fd);
+    unlink(path);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    unlink(path);
+    return nullptr;
+  }
+  Handle* h = new Handle{reinterpret_cast<uint8_t*>(base), size, fd};
+  Header* hdr = h->hdr();
+  memset(hdr, 0, sizeof(Header));
+  hdr->segment_size = size;
+  hdr->num_slots = num_slots;
+  hdr->slots_offset = align_up(sizeof(Header), kAlign);
+  uint64_t slots_bytes = align_up(num_slots * sizeof(Slot), kAlign);
+  hdr->arena_offset = hdr->slots_offset + slots_bytes;
+  hdr->arena_size = size - hdr->arena_offset;
+  memset(h->base + hdr->slots_offset, 0, slots_bytes);
+  // One giant free block spanning the arena.
+  Block* b = block_at(h, hdr->arena_offset);
+  b->size = hdr->arena_size - sizeof(Block);
+  b->free = 1;
+  b->magic = kBlockMagic;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  __sync_synchronize();
+  hdr->magic = kMagic;  // publish last
+  return h;
+}
+
+void* ts_attach(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < (off_t)sizeof(Header)) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Handle* h =
+      new Handle{reinterpret_cast<uint8_t*>(base), (uint64_t)st.st_size, fd};
+  // Wait (bounded) for the creator to publish the magic.
+  for (int i = 0; i < 1000 && h->hdr()->magic != kMagic; i++) usleep(1000);
+  if (h->hdr()->magic != kMagic) {
+    munmap(base, (size_t)st.st_size);
+    close(fd);
+    delete h;
+    return nullptr;
+  }
+  return h;
+}
+
+void ts_detach(void* hp) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  munmap(h->base, h->size);
+  close(h->fd);
+  delete h;
+}
+
+int ts_unlink(const char* path) { return unlink(path); }
+
+// Allocate space for an object. Returns payload offset (>0), or:
+//   -1 out of memory (even after eviction)   -2 already exists
+//   -3 table full                            -4 too large for segment
+int64_t ts_alloc(void* hp, const uint8_t* id, uint64_t data_size,
+                 uint64_t meta_size) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Header* hdr = h->hdr();
+  uint64_t want = data_size + meta_size;
+  if (want == 0) want = 1;
+  if (align_up(want, kAlign) + sizeof(Block) > hdr->arena_size) return -4;
+  Guard g(hdr);
+  if (find_slot(h, id)) return -2;
+  uint64_t off = arena_alloc(h, want);
+  while (!off) {
+    if (!evict_one(h)) return -1;
+    off = arena_alloc(h, want);
+  }
+  Slot* s = insert_slot(h, id);
+  if (!s) {
+    arena_free(h, off);
+    return -3;
+  }
+  s->sealed = 0;
+  s->refcount = 1;  // creator holds a pin until seal/abort
+  s->offset = off;
+  s->data_size = data_size;
+  s->meta_size = meta_size;
+  s->lru_tick = ++hdr->lru_clock;
+  hdr->num_objects++;
+  hdr->bytes_in_use += align_up(want, kAlign);
+  return (int64_t)off;
+}
+
+int ts_seal(void* hp, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Guard g(h->hdr());
+  Slot* s = find_slot(h, id);
+  if (!s) return -1;
+  if (s->sealed) return -2;
+  s->sealed = 1;
+  s->refcount -= 1;  // drop creator pin
+  s->lru_tick = ++h->hdr()->lru_clock;
+  return 0;
+}
+
+// Look up a sealed object, pinning it. 0 on success.
+int ts_get(void* hp, const uint8_t* id, uint64_t* offset, uint64_t* data_size,
+           uint64_t* meta_size) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Guard g(h->hdr());
+  Slot* s = find_slot(h, id);
+  if (!s || !s->sealed) return -1;
+  s->refcount++;
+  s->lru_tick = ++h->hdr()->lru_clock;
+  *offset = s->offset;
+  *data_size = s->data_size;
+  *meta_size = s->meta_size;
+  return 0;
+}
+
+int ts_release(void* hp, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Guard g(h->hdr());
+  Slot* s = find_slot(h, id);
+  if (!s) return -1;
+  if (s->refcount > 0) s->refcount--;
+  return 0;
+}
+
+int ts_contains(void* hp, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Guard g(h->hdr());
+  Slot* s = find_slot(h, id);
+  return (s && s->sealed) ? 1 : 0;
+}
+
+// Delete a sealed object (refcount must be 0) or abort an unsealed one.
+int ts_delete(void* hp, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Guard g(h->hdr());
+  Slot* s = find_slot(h, id);
+  if (!s) return -1;
+  if (s->sealed && s->refcount > 0) return -2;  // pinned
+  delete_slot(h, s);
+  return 0;
+}
+
+// Abort an in-progress (unsealed) creation by the creator.
+int ts_abort(void* hp, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Guard g(h->hdr());
+  Slot* s = find_slot(h, id);
+  if (!s || s->sealed) return -1;
+  delete_slot(h, s);
+  return 0;
+}
+
+void ts_stats(void* hp, uint64_t* capacity, uint64_t* used,
+              uint64_t* num_objects, uint64_t* num_evictions) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Guard g(h->hdr());
+  Header* hdr = h->hdr();
+  *capacity = hdr->arena_size;
+  *used = hdr->bytes_in_use;
+  *num_objects = hdr->num_objects;
+  *num_evictions = hdr->num_evictions;
+}
+
+// Copy up to max_ids sealed object ids into out (max_ids * 20 bytes).
+uint64_t ts_list(void* hp, uint8_t* out, uint64_t max_ids) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Guard g(h->hdr());
+  Header* hdr = h->hdr();
+  Slot* slots = h->slots();
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < hdr->num_slots && n < max_ids; i++) {
+    if (slots[i].state == SLOT_USED && slots[i].sealed) {
+      memcpy(out + n * kIdSize, slots[i].id, kIdSize);
+      n++;
+    }
+  }
+  return n;
+}
+
+uint8_t* ts_base_ptr(void* hp) {
+  return reinterpret_cast<Handle*>(hp)->base;
+}
+
+}  // extern "C"

@@ -11,13 +11,19 @@ from typing import Any
 
 _lock = threading.Lock()
 _backend = None
+_address: str | None = None
 _init_kwargs: dict[str, Any] = {}
 
 
 def init(address: str | None = None, **kwargs):
-    global _backend, _init_kwargs
+    global _backend, _address, _init_kwargs
     with _lock:
         if _backend is not None:
+            if address is not None and address != _address:
+                raise RuntimeError(
+                    f"ray_tpu is already initialized (address={_address!r}); "
+                    f"call shutdown() before init(address={address!r})"
+                )
             return _backend
         if address is None or address == "local":
             from ray_tpu.core.local_backend import LocalBackend
@@ -32,6 +38,7 @@ def init(address: str | None = None, **kwargs):
                     f"(address={address!r}): {e}"
                 ) from e
             _backend = connect(address, **kwargs)
+        _address = address
         _init_kwargs = kwargs
         return _backend
 

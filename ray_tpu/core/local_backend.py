@@ -2,17 +2,27 @@
 
 This is the ``ray.init(local_mode=...)`` analog but with real asynchrony —
 tasks run concurrently and ObjectRefs are genuine futures. It implements the
-same ``Backend`` surface the cluster backend (multi-process, M3) implements,
+same ``Backend`` surface the cluster backend (multi-process) implements,
 so the public API code is backend-agnostic — preserving the reference's
 invariant that libraries sit only on tasks/actors/objects (SURVEY.md §1).
+
+Semantics mirrored from the reference:
+* Object table entries are reference-counted against live ``ObjectRef``
+  handles plus in-flight task-argument pins, and freed when the count drops
+  to zero (``src/ray/core_worker/reference_count.h:61``).
+* Actor-task dependencies are resolved on the *caller* side before the call
+  is enqueued to the actor, preserving per-caller submission order — the
+  ``DependencyResolver`` + sequence-number design of
+  ``direct_actor_task_submitter.h``. The actor's execution thread never
+  blocks on an unresolved argument.
 """
 
 from __future__ import annotations
 
-import concurrent.futures as cf
 import queue
 import threading
 import traceback
+import weakref
 from typing import Any, Callable, Sequence
 
 from ray_tpu.core import ids
@@ -20,8 +30,42 @@ from ray_tpu.core.object_ref import (
     ActorError,
     GetTimeoutError,
     ObjectRef,
+    ObjectLostError,
     TaskError,
 )
+
+
+class _DaemonPool:
+    """Thread pool with daemon threads: in-flight tasks never block
+    interpreter exit (cf. the raylet worker pool being killable)."""
+
+    def __init__(self, max_workers: int):
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._max = max_workers
+        self._count = 0
+        self._idle = 0
+        self._lock = threading.Lock()
+
+    def submit(self, fn: Callable, *args) -> None:
+        self._q.put((fn, args))
+        with self._lock:
+            if self._idle == 0 and self._count < self._max:
+                self._count += 1
+                threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._idle += 1
+            try:
+                fn, args = self._q.get()
+            finally:
+                with self._lock:
+                    self._idle -= 1
+            try:
+                fn(*args)
+            except BaseException:  # noqa: BLE001 — pool must survive anything
+                traceback.print_exc()
 
 
 class _Entry:
@@ -53,6 +97,10 @@ class _ActorState:
         self.max_concurrency = max_concurrency
         self.threads: list[threading.Thread] = []
         self.lock = threading.Lock()
+        # Per-caller-thread submission chains: tail event of the last deferred
+        # dispatch, so a caller's calls enqueue in submission order even when
+        # argument resolution happens off-thread.
+        self.caller_chains: dict[int, threading.Event] = {}
 
 
 _POISON = object()
@@ -66,13 +114,42 @@ class LocalBackend:
 
         self._ncpu = num_cpus or os.cpu_count() or 8
         # Oversized pool: tasks may block waiting on upstream deps.
-        self._pool = cf.ThreadPoolExecutor(max_workers=max(64, self._ncpu * 8))
+        self._pool = _DaemonPool(max_workers=max(64, self._ncpu * 8))
         self._objects: dict[str, _Entry] = {}
+        self._refcounts: dict[str, int] = {}
         self._objects_lock = threading.Lock()
         self._actors: dict[str, _ActorState] = {}
         self._named_actors: dict[str, str] = {}
         self._lock = threading.Lock()
         self._shutdown = False
+
+    # -- ref counting ------------------------------------------------------
+
+    def make_ref(self, oid: str) -> ObjectRef:
+        """Mint an ObjectRef whose lifetime pins the object-table entry."""
+        with self._objects_lock:
+            self._refcounts[oid] = self._refcounts.get(oid, 0) + 1
+        ref = ObjectRef(oid)
+        weakref.finalize(ref, self._decref, oid)
+        return ref
+
+    def _incref(self, oid: str):
+        with self._objects_lock:
+            self._refcounts[oid] = self._refcounts.get(oid, 0) + 1
+
+    def _decref(self, oid: str):
+        with self._objects_lock:
+            n = self._refcounts.get(oid, 0) - 1
+            if n <= 0:
+                self._refcounts.pop(oid, None)
+                e = self._objects.get(oid)
+                # Free only resolved entries; a pending task result with no
+                # handles left is freed when the task completes (see
+                # _store_returns).
+                if e is not None and e.event.is_set():
+                    del self._objects[oid]
+            else:
+                self._refcounts[oid] = n
 
     # -- object plane -----------------------------------------------------
 
@@ -85,8 +162,9 @@ class LocalBackend:
 
     def put(self, value: Any) -> ObjectRef:
         oid = ids.new_object_id()
+        ref = self.make_ref(oid)
         self._entry(oid).set(value)
-        return ObjectRef(oid)
+        return ref
 
     def get(self, refs: Sequence[ObjectRef], timeout: float | None = None):
         import time
@@ -94,7 +172,15 @@ class LocalBackend:
         deadline = None if timeout is None else time.monotonic() + timeout
         out = []
         for r in refs:
-            e = self._entry(r.id)
+            with self._objects_lock:
+                e = self._objects.get(r.id)
+            if e is None:
+                if self._refcounts.get(r.id):
+                    e = self._entry(r.id)
+                else:
+                    raise ObjectLostError(
+                        f"object {r.id[:16]}… was freed (all references dropped)"
+                    )
             remaining = (
                 None if deadline is None else max(0.0, deadline - time.monotonic())
             )
@@ -136,6 +222,19 @@ class LocalBackend:
 
     # -- task plane -------------------------------------------------------
 
+    def _pin_ref_args(self, args, kwargs) -> list[str]:
+        """Pin ObjectRef arguments for the duration of a task (the lineage-
+        pinning analog of TaskManager, ``task_manager.h:87``)."""
+        pins = [a.id for a in args if isinstance(a, ObjectRef)]
+        pins += [v.id for v in kwargs.values() if isinstance(v, ObjectRef)]
+        for oid in pins:
+            self._incref(oid)
+        return pins
+
+    def _unpin(self, pins: list[str]):
+        for oid in pins:
+            self._decref(oid)
+
     def _resolve_args(self, args, kwargs):
         args = [self.get([a])[0] if isinstance(a, ObjectRef) else a for a in args]
         kwargs = {
@@ -156,10 +255,18 @@ class LocalBackend:
                 )
             for oid, v in zip(oids, vals):
                 self._entry(oid).set(v)
+        self._gc_unreferenced(oids)
 
     def _store_error(self, oids: list[str], err: BaseException):
         for oid in oids:
             self._entry(oid).set_error(err)
+        self._gc_unreferenced(oids)
+
+    def _gc_unreferenced(self, oids: list[str]):
+        with self._objects_lock:
+            for oid in oids:
+                if not self._refcounts.get(oid):
+                    self._objects.pop(oid, None)
 
     def submit_task(
         self,
@@ -175,35 +282,40 @@ class LocalBackend:
     ) -> list[ObjectRef]:
         task_id = ids.new_task_id()
         oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
+        refs = [self.make_ref(o) for o in oids]
         fname = name or getattr(func, "__name__", "task")
+        pins = self._pin_ref_args(args, kwargs)
 
         def run():
             attempts = 0
-            while True:
-                try:
-                    a, kw = self._resolve_args(args, kwargs)
-                    result = func(*a, **kw)
-                    self._store_returns(oids, result, num_returns)
-                    return
-                except BaseException as e:  # noqa: BLE001 — stored, not dropped
-                    retriable = retry_exceptions is True or (
-                        isinstance(retry_exceptions, tuple)
-                        and isinstance(e, retry_exceptions)
-                    )
-                    if retriable and attempts < max_retries:
-                        attempts += 1
-                        continue
-                    if isinstance(e, (TaskError, ActorError)):
-                        self._store_error(oids, e)
-                    else:
-                        self._store_error(
-                            oids,
-                            TaskError(fname, traceback.format_exc(), repr(e)),
+            try:
+                while True:
+                    try:
+                        a, kw = self._resolve_args(args, kwargs)
+                        result = func(*a, **kw)
+                        self._store_returns(oids, result, num_returns)
+                        return
+                    except BaseException as e:  # noqa: BLE001 — stored, not dropped
+                        retriable = retry_exceptions is True or (
+                            isinstance(retry_exceptions, tuple)
+                            and isinstance(e, retry_exceptions)
                         )
-                    return
+                        if retriable and attempts < max_retries:
+                            attempts += 1
+                            continue
+                        if isinstance(e, (TaskError, ActorError)):
+                            self._store_error(oids, e)
+                        else:
+                            self._store_error(
+                                oids,
+                                TaskError(fname, traceback.format_exc(), repr(e)),
+                            )
+                        return
+            finally:
+                self._unpin(pins)
 
         self._pool.submit(run)
-        return [ObjectRef(o) for o in oids]
+        return refs
 
     # -- actor plane ------------------------------------------------------
 
@@ -225,6 +337,9 @@ class LocalBackend:
                 self._named_actors[name] = actor_id
         state = _ActorState(None, max_concurrency, name)
         self._actors[actor_id] = state
+        pins = self._pin_ref_args(args, kwargs)
+
+        ctor_done = threading.Event()
 
         def ctor():
             try:
@@ -233,7 +348,9 @@ class LocalBackend:
             except BaseException:  # noqa: BLE001
                 state.dead = True
                 state.death_cause = traceback.format_exc()
-                return
+            finally:
+                self._unpin(pins)
+                ctor_done.set()
 
         def worker_loop():
             ctor_done.wait()
@@ -241,37 +358,34 @@ class LocalBackend:
                 item = state.queue.get()
                 if item is _POISON:
                     return
-                oids, method_name, m_args, m_kwargs, num_returns = item
-                if state.dead:
-                    self._store_error(
-                        oids,
-                        ActorError(
-                            f"actor {actor_id} is dead: {state.death_cause}"
-                        ),
-                    )
-                    continue
+                oids, method_name, m_args, m_kwargs, num_returns, pins = item
                 try:
-                    a, kw = self._resolve_args(m_args, m_kwargs)
-                    method = getattr(state.instance, method_name)
-                    result = method(*a, **kw)
-                    self._store_returns(oids, result, num_returns)
-                except BaseException as e:  # noqa: BLE001
-                    self._store_error(
-                        oids,
-                        TaskError(
-                            f"{cls.__name__}.{method_name}",
-                            traceback.format_exc(),
-                            repr(e),
-                        ),
-                    )
+                    if state.dead:
+                        self._store_error(
+                            oids,
+                            ActorError(
+                                f"actor {actor_id} is dead: {state.death_cause}"
+                            ),
+                        )
+                        continue
+                    try:
+                        a, kw = self._resolve_args(m_args, m_kwargs)
+                        method = getattr(state.instance, method_name)
+                        result = method(*a, **kw)
+                        self._store_returns(oids, result, num_returns)
+                    except BaseException as e:  # noqa: BLE001
+                        self._store_error(
+                            oids,
+                            TaskError(
+                                f"{cls.__name__}.{method_name}",
+                                traceback.format_exc(),
+                                repr(e),
+                            ),
+                        )
+                finally:
+                    self._unpin(pins)
 
-        ctor_done = threading.Event()
-
-        def ctor_then_signal():
-            ctor()
-            ctor_done.set()
-
-        threading.Thread(target=ctor_then_signal, daemon=True).start()
+        threading.Thread(target=ctor, daemon=True).start()
         for _ in range(max_concurrency):
             t = threading.Thread(target=worker_loop, daemon=True)
             t.start()
@@ -291,22 +405,84 @@ class LocalBackend:
         state = self._actors.get(actor_id)
         task_id = ids.new_task_id()
         oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
-        if state is None or state.dead:
-            cause = state.death_cause if state else "no such actor"
-            err = ActorError(f"actor {actor_id} is dead: {cause}")
-            self._store_error(oids, err)
-        else:
-            state.queue.put((oids, method_name, args, kwargs, num_returns))
-        return [ObjectRef(o) for o in oids]
+        refs = [self.make_ref(o) for o in oids]
+        if state is None:
+            self._store_error(oids, ActorError(f"no such actor: {actor_id}"))
+            return refs
+
+        pins = self._pin_ref_args(args, kwargs)
+        item = (oids, method_name, args, kwargs, num_returns, pins)
+        caller = threading.get_ident()
+
+        # Unresolved ObjectRef args are resolved OFF the actor's execution
+        # thread (caller-side dependency resolution), then the call is
+        # enqueued — chained per caller thread to preserve submission order.
+        has_deps = any(
+            isinstance(a, ObjectRef) and not self._entry(a.id).event.is_set()
+            for a in list(args) + list(kwargs.values())
+        )
+        with state.lock:
+            if state.dead:
+                self._unpin(pins)
+                self._store_error(
+                    oids, ActorError(f"actor {actor_id} is dead: {state.death_cause}")
+                )
+                return refs
+            prev = state.caller_chains.get(caller)
+            if not has_deps and (prev is None or prev.is_set()):
+                state.queue.put(item)
+                return refs
+            done = threading.Event()
+            state.caller_chains[caller] = done
+
+        def resolve_then_enqueue():
+            try:
+                if prev is not None:
+                    prev.wait()
+                for a in list(args) + list(kwargs.values()):
+                    if isinstance(a, ObjectRef):
+                        self._entry(a.id).event.wait()
+                with state.lock:
+                    if state.dead:
+                        self._unpin(pins)
+                        self._store_error(
+                            oids,
+                            ActorError(
+                                f"actor {actor_id} is dead: {state.death_cause}"
+                            ),
+                        )
+                    else:
+                        state.queue.put(item)
+            finally:
+                done.set()
+
+        self._pool.submit(resolve_then_enqueue)
+        return refs
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
         state = self._actors.get(actor_id)
         if state is None:
             return
-        state.dead = True
-        state.death_cause = "killed via ray_tpu.kill"
-        for _ in state.threads:
-            state.queue.put(_POISON)
+        with state.lock:
+            state.dead = True
+            state.death_cause = "killed via ray_tpu.kill"
+            # Fail everything still queued, then poison the worker threads.
+            drained = []
+            try:
+                while True:
+                    drained.append(state.queue.get_nowait())
+            except queue.Empty:
+                pass
+            for item in drained:
+                if item is _POISON:
+                    continue
+                oids, *_rest, pins = item
+                self._unpin(pins)
+                self._store_error(
+                    oids, ActorError(f"actor {actor_id} is dead: killed")
+                )
+            for _ in state.threads:
+                state.queue.put(_POISON)
         with self._lock:
             if state.name and self._named_actors.get(state.name) == actor_id:
                 del self._named_actors[state.name]
@@ -328,7 +504,6 @@ class LocalBackend:
         self._shutdown = True
         for aid in list(self._actors):
             self.kill_actor(aid)
-        self._pool.shutdown(wait=False, cancel_futures=True)
 
     # -- introspection ----------------------------------------------------
 

@@ -12,7 +12,7 @@ from typing import Any
 
 
 class ObjectRef:
-    __slots__ = ("id", "_owner")
+    __slots__ = ("id", "_owner", "__weakref__")
 
     def __init__(self, object_id: str, owner: str = ""):
         self.id = object_id

@@ -1,0 +1,75 @@
+"""Object serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Mirrors the reference's split (``python/ray/_private/serialization.py``):
+the pickle stream carries structure, while large contiguous buffers (numpy
+arrays, jax host arrays, arrow buffers) travel out-of-band so that reads
+from the shm store are zero-copy — the deserialized numpy array's memory IS
+the store segment, exactly like plasma's numpy/Arrow views (SURVEY.md §3.3).
+
+Wire format of one serialized object:
+    meta  = msgpack: {"n": num_buffers, "sizes": [..], "inline": bool}
+    data  = pickled bytes || buffer0 || buffer1 || ...  (8-byte aligned)
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+import cloudpickle
+import msgpack
+
+ALIGN = 64
+
+
+def _aligned(n: int) -> int:
+    return (n + ALIGN - 1) & ~(ALIGN - 1)
+
+
+def serialize(value: Any) -> tuple[bytes, list[bytes | memoryview]]:
+    """Returns (meta, chunks). Concatenating chunks gives the data payload."""
+    buffers: list[pickle.PickleBuffer] = []
+    payload = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    raw = [b.raw() for b in buffers]
+    sizes = [len(payload)] + [len(r) for r in raw]
+    chunks: list[bytes | memoryview] = []
+    offset = 0
+    for part in [payload, *raw]:
+        pad = _aligned(offset) - offset
+        if pad:
+            chunks.append(b"\x00" * pad)
+            offset += pad
+        chunks.append(part)
+        offset += len(part)
+    meta = msgpack.packb({"sizes": sizes})
+    return meta, chunks
+
+
+def total_size(chunks: list[bytes | memoryview]) -> int:
+    return sum(len(c) for c in chunks)
+
+
+def deserialize(meta: bytes, data) -> Any:
+    """``data``: bytes-like covering the full payload (zero-copy memoryview
+    straight from the shm segment, or bytes off the wire)."""
+    info = msgpack.unpackb(meta)
+    sizes = info["sizes"]
+    view = memoryview(data)
+    parts = []
+    offset = 0
+    for size in sizes:
+        offset = _aligned(offset) if offset else 0
+        # first part starts at 0; subsequent start aligned
+        parts.append(view[offset : offset + size])
+        offset += size
+    payload, bufs = parts[0], parts[1:]
+    return pickle.loads(payload, buffers=bufs)
+
+
+def dumps(value: Any) -> bytes:
+    """One-shot in-band serialization (control-plane messages)."""
+    return cloudpickle.dumps(value)
+
+
+def loads(blob: bytes) -> Any:
+    return pickle.loads(blob)

@@ -70,7 +70,7 @@ def make_train_step(
     if batch_spec is None:
         batch_spec = P(("dp", "fsdp"))
     batch_shardings = jax.tree.map(
-        lambda _: NamedSharding(mesh, batch_spec),
+        lambda s: NamedSharding(mesh, s),
         batch_spec,
         is_leaf=lambda x: isinstance(x, P),
     )

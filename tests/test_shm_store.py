@@ -1,0 +1,174 @@
+"""Native shm object store tests (plasma-equivalent; SURVEY.md §2.1).
+
+Covers the behaviors the reference tests in
+``src/ray/object_manager/plasma/test/``: lifecycle, refcount pinning,
+LRU eviction, cross-process visibility, zero-copy reads.
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._native.shm_store import (
+    ObjectExistsError,
+    ShmStore,
+    StoreFullError,
+)
+from ray_tpu.core import serialization
+
+
+@pytest.fixture
+def store(tmp_path):
+    path = str(tmp_path / "segment")
+    s = ShmStore(path, capacity=8 << 20, create=True)
+    yield s
+    s.close(unlink=True)
+
+
+def test_put_get_roundtrip(store):
+    store.put("obj1", b"hello world", meta=b"M")
+    out = store.get("obj1")
+    assert out is not None
+    data, meta = out
+    assert bytes(data) == b"hello world"
+    assert meta == b"M"
+    store.release("obj1")
+
+
+def test_get_missing_returns_none(store):
+    assert store.get("nope") is None
+    assert not store.contains("nope")
+
+
+def test_unsealed_not_visible(store):
+    buf = store.create("obj2", 4)
+    assert store.get("obj2") is None
+    assert not store.contains("obj2")
+    buf[:] = b"abcd"
+    store.seal("obj2")
+    assert store.contains("obj2")
+    data, _ = store.get("obj2")
+    assert bytes(data) == b"abcd"
+    store.release("obj2")
+
+
+def test_duplicate_create_raises(store):
+    store.put("dup", b"x")
+    with pytest.raises(ObjectExistsError):
+        store.create("dup", 1)
+
+
+def test_delete_and_abort(store):
+    store.put("d1", b"x")
+    assert store.delete("d1")
+    assert store.get("d1") is None
+    store.create("a1", 4)
+    assert store.abort("a1")
+    # after abort the id is reusable
+    store.put("a1", b"yy")
+    assert bytes(store.get("a1")[0]) == b"yy"
+    store.release("a1")
+
+
+def test_pinned_objects_not_deletable(store):
+    store.put("p1", b"x" * 100)
+    data, _ = store.get("p1")  # pin
+    assert not store.delete("p1")
+    store.release("p1")
+    assert store.delete("p1")
+
+
+def test_lru_eviction_under_pressure(store):
+    # Fill with 1MB objects in an 8MB segment; old unpinned ones get evicted.
+    blob = b"z" * (1 << 20)
+    for i in range(20):
+        store.put(f"evict{i}", blob)
+    stats = store.stats()
+    assert stats["num_evictions"] > 0
+    # Most recent object must still be present.
+    assert store.contains("evict19")
+    # Oldest must be gone.
+    assert not store.contains("evict0")
+
+
+def test_pinned_survive_eviction(store):
+    blob = b"z" * (1 << 20)
+    store.put("keep", blob)
+    assert store.get("keep") is not None  # pin it
+    for i in range(20):
+        store.put(f"fill{i}", blob)
+    assert store.contains("keep")
+    store.release("keep")
+
+
+def test_object_larger_than_segment(store):
+    with pytest.raises(StoreFullError):
+        store.put("huge", b"x" * (64 << 20))
+
+
+def _child_reader(path, q):
+    s = ShmStore(path)
+    out = s.get("shared")
+    q.put(bytes(out[0]) if out else None)
+    s.release("shared")
+    s.close()
+
+
+def _child_writer(path):
+    s = ShmStore(path)
+    s.put("from_child", b"child wrote this")
+    s.close()
+
+
+def test_cross_process_visibility(tmp_path):
+    path = str(tmp_path / "seg2")
+    s = ShmStore(path, capacity=4 << 20, create=True)
+    s.put("shared", b"visible across processes")
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_reader, args=(path, q))
+    p.start()
+    assert q.get(timeout=30) == b"visible across processes"
+    p.join()
+
+    p2 = ctx.Process(target=_child_writer, args=(path,))
+    p2.start()
+    p2.join()
+    out = s.get("from_child")
+    assert out is not None and bytes(out[0]) == b"child wrote this"
+    s.release("from_child")
+    s.close(unlink=True)
+
+
+def test_zero_copy_numpy_via_serialization(store):
+    arr = np.arange(100_000, dtype=np.float32)
+    meta, chunks = serialization.serialize(arr)
+    store.put("np1", chunks, meta=meta)
+    data, meta2 = store.get("np1")
+    out = serialization.deserialize(meta2, data)
+    np.testing.assert_array_equal(out, arr)
+    # Zero-copy: the array's buffer must live inside the segment mmap,
+    # not a heap copy.
+    assert not out.flags.owndata
+    store.release("np1")
+
+
+def test_serialization_roundtrip_structures():
+    value = {"a": [1, 2, 3], "b": np.ones((4, 5)), "c": ("x", bytearray(b"yz"))}
+    meta, chunks = serialization.serialize(value)
+    blob = b"".join(bytes(c) for c in chunks)
+    out = serialization.deserialize(meta, blob)
+    assert out["a"] == [1, 2, 3]
+    np.testing.assert_array_equal(out["b"], np.ones((4, 5)))
+    assert out["c"] == ("x", bytearray(b"yz"))
+
+
+def test_stats(store):
+    store.put("s1", b"x" * 1000)
+    st = store.stats()
+    assert st["num_objects"] == 1
+    assert st["used"] >= 1000
+    assert st["capacity"] > 0
+    assert len(store.list_keys()) == 1
