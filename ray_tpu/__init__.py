@@ -26,12 +26,15 @@ from ray_tpu.version import __version__
 
 from ray_tpu.api import (
     ObjectRef,
+    available_resources,
     cancel,
+    cluster_resources,
     get,
     get_actor,
     init,
     is_initialized,
     kill,
+    nodes,
     put,
     remote,
     shutdown,
@@ -41,12 +44,15 @@ from ray_tpu.api import (
 __all__ = [
     "__version__",
     "ObjectRef",
+    "available_resources",
     "cancel",
+    "cluster_resources",
     "get",
     "get_actor",
     "init",
     "is_initialized",
     "kill",
+    "nodes",
     "put",
     "remote",
     "shutdown",

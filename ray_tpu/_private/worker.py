@@ -28,7 +28,10 @@ def init(address: str | None = None, **kwargs):
         if address is None or address == "local":
             from ray_tpu.core.local_backend import LocalBackend
 
-            _backend = LocalBackend(num_cpus=kwargs.get("num_cpus"))
+            _backend = LocalBackend(
+                num_cpus=kwargs.get("num_cpus"),
+                resources=kwargs.get("resources"),
+            )
         else:
             try:
                 from ray_tpu.cluster.client import connect

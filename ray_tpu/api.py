@@ -94,5 +94,9 @@ def cluster_resources() -> dict:
     return _worker.backend().cluster_resources()
 
 
+def available_resources() -> dict:
+    return _worker.backend().available_resources()
+
+
 def nodes() -> list[dict]:
     return _worker.backend().nodes()

@@ -33,6 +33,7 @@ from ray_tpu.core.object_ref import (
     ObjectLostError,
     TaskError,
 )
+from ray_tpu.core.resources import ResourcePool, default_node_resources, demand_of
 
 
 class _DaemonPool:
@@ -101,6 +102,90 @@ class _ActorState:
         # dispatch, so a caller's calls enqueue in submission order even when
         # argument resolution happens off-thread.
         self.caller_chains: dict[int, threading.Event] = {}
+        # Set once the ctor acquires lifetime resources; called on kill.
+        self.release_resources: Callable[[], None] | None = None
+
+
+class _PlacementGroupState:
+    """A gang reservation: per-bundle sub-pools carved out of the node pool.
+
+    Single-node analog of the GCS 2-phase commit
+    (``gcs_placement_group_scheduler.h:265``): prepare = blocking acquire of
+    the union demand from the node pool; commit = expose per-bundle pools.
+    """
+
+    def __init__(self, pg_id, bundles, strategy, name):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = "PENDING"  # PENDING | CREATED | INFEASIBLE | REMOVED
+        self.union: dict[str, float] = {}
+        self.bundle_pools: list[ResourcePool] = []
+        self.ready_event = threading.Event()
+        self.lock = threading.Lock()
+        # Signaled whenever capacity returns to any bundle, so acquirers
+        # waiting for "any bundle" wake without busy-polling.
+        self.release_cv = threading.Condition()
+
+    def table_entry(self) -> dict:
+        return {
+            "placement_group_id": self.id,
+            "name": self.name,
+            "bundles": self.bundles,
+            "strategy": self.strategy,
+            "state": self.state,
+        }
+
+
+class _Lease:
+    """Resources a running task/actor holds, releasable and re-acquirable.
+
+    The release/reacquire pair is what lets a blocked ``get`` give its CPUs
+    back — the analog of the raylet releasing a worker's CPUs while it is
+    blocked in ``ray.get`` (reference: worker-blocked handling in
+    ``node_manager.cc``). If the task's placement group was removed while it
+    ran, the release is redirected to the node pool (the bundle pool is
+    orphaned; its capacity was already returned).
+    """
+
+    __slots__ = ("backend", "pool", "demand", "pg", "held")
+
+    def __init__(self, backend, pool, demand, pg=None):
+        self.backend = backend
+        self.pool = pool
+        self.demand = demand
+        self.pg = pg
+        self.held = bool(demand)
+
+    def release(self):
+        if not self.held:
+            return
+        self.held = False
+        if self.pg is not None:
+            with self.pg.lock:
+                if self.pg.state == "REMOVED":
+                    self.backend._node_pool.release(self.demand)
+                    return
+                self.pool.release(self.demand)
+            with self.pg.release_cv:
+                self.pg.release_cv.notify_all()
+        else:
+            self.pool.release(self.demand)
+
+    def reacquire(self):
+        if self.held or not self.demand:
+            return
+        while True:
+            if self.pg is not None and self.pg.state == "REMOVED":
+                # Bundle pool is orphaned (its free capacity went back to the
+                # node pool at removal, including what we released) — so the
+                # node pool is now the right source and sink.
+                self.pg = None
+                self.pool = self.backend._node_pool
+            if self.pool.acquire(self.demand, timeout=0.05):
+                self.held = True
+                return
 
 
 _POISON = object()
@@ -109,7 +194,7 @@ _POISON = object()
 class LocalBackend:
     """Single-process task/actor/object runtime."""
 
-    def __init__(self, num_cpus: int | None = None):
+    def __init__(self, num_cpus: int | None = None, resources: dict | None = None):
         import os
 
         self._ncpu = num_cpus or os.cpu_count() or 8
@@ -122,6 +207,15 @@ class LocalBackend:
         self._named_actors: dict[str, str] = {}
         self._lock = threading.Lock()
         self._shutdown = False
+        node_res = default_node_resources(self._ncpu)
+        node_res.update(resources or {})
+        self._node_pool = ResourcePool(node_res)
+        self._pgs: dict[str, _PlacementGroupState] = {}
+        self._current_pg = threading.local()
+        # The resource lease held by the task running on this thread, so a
+        # blocking get() can give the CPUs back (raylet parity: workers
+        # blocked in ray.get release their CPUs).
+        self._current_lease = threading.local()
 
     # -- ref counting ------------------------------------------------------
 
@@ -170,25 +264,36 @@ class LocalBackend:
         import time
 
         deadline = None if timeout is None else time.monotonic() + timeout
+        lease: _Lease | None = getattr(self._current_lease, "lease", None)
+        released = False
         out = []
-        for r in refs:
-            with self._objects_lock:
-                e = self._objects.get(r.id)
-            if e is None:
-                if self._refcounts.get(r.id):
-                    e = self._entry(r.id)
-                else:
-                    raise ObjectLostError(
-                        f"object {r.id[:16]}… was freed (all references dropped)"
-                    )
-            remaining = (
-                None if deadline is None else max(0.0, deadline - time.monotonic())
-            )
-            if not e.event.wait(remaining):
-                raise GetTimeoutError(f"ray_tpu.get timed out on {r}")
-            if e.error is not None:
-                raise e.error
-            out.append(e.value)
+        try:
+            for r in refs:
+                with self._objects_lock:
+                    e = self._objects.get(r.id)
+                if e is None:
+                    if self._refcounts.get(r.id):
+                        e = self._entry(r.id)
+                    else:
+                        raise ObjectLostError(
+                            f"object {r.id[:16]}… was freed (all references dropped)"
+                        )
+                if not e.event.is_set() and lease is not None and not released:
+                    # About to block inside a task: give the CPUs back so
+                    # nested tasks can run (deadlock avoidance).
+                    lease.release()
+                    released = True
+                remaining = (
+                    None if deadline is None else max(0.0, deadline - time.monotonic())
+                )
+                if not e.event.wait(remaining):
+                    raise GetTimeoutError(f"ray_tpu.get timed out on {r}")
+                if e.error is not None:
+                    raise e.error
+                out.append(e.value)
+        finally:
+            if released:
+                lease.reacquire()
         return out
 
     def wait(
@@ -219,6 +324,182 @@ class LocalBackend:
             if not progressed:
                 time.sleep(0.001)
         return ready, pending
+
+    # -- resources + placement groups -------------------------------------
+
+    def _plan_resources(self, options: dict, *, is_actor: bool) -> dict:
+        """Resolve options into {demand, pg, bundle_index}; raise on demands
+        this node can never satisfy (surfaced at submit time, unlike the
+        reference which leaves the task pending forever)."""
+        from ray_tpu.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy,
+            validate_strategy,
+        )
+
+        demand = demand_of(options, is_actor=is_actor)
+        strategy = options.get("scheduling_strategy")
+        validate_strategy(strategy)
+        pg_handle = options.get("placement_group")
+        bundle_index = options.get("placement_group_bundle_index", -1)
+        capture = False
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg_handle = strategy.placement_group
+            bundle_index = strategy.placement_group_bundle_index
+            capture = strategy.placement_group_capture_child_tasks
+        if pg_handle is None and strategy in (None, "DEFAULT"):
+            # Child-task capture: inherit the caller's PG if it asked for it.
+            inherited = getattr(self._current_pg, "info", None)
+            if inherited is not None:
+                pg_handle = inherited["id"]
+                bundle_index = -1
+                capture = True
+        pg_state = None
+        if pg_handle is not None:
+            pg_id = getattr(pg_handle, "id", pg_handle)
+            pg_state = self._pgs.get(pg_id)
+            if pg_state is None:
+                raise ValueError(f"no such placement group: {pg_id}")
+            if pg_state.state == "INFEASIBLE":
+                raise ValueError(f"placement group {pg_id} is infeasible")
+            if bundle_index >= len(pg_state.bundles) or bundle_index < -1:
+                raise ValueError(
+                    f"bundle index {bundle_index} out of range for placement "
+                    f"group {pg_id} with {len(pg_state.bundles)} bundles"
+                )
+            for b in (
+                pg_state.bundles
+                if bundle_index < 0
+                else [pg_state.bundles[bundle_index]]
+            ):
+                if all(b.get(k, 0.0) >= v for k, v in demand.items()):
+                    break
+            else:
+                raise ValueError(
+                    f"demand {demand} does not fit any bundle of placement "
+                    f"group {pg_id} (bundles: {pg_state.bundles})"
+                )
+        elif demand and not self._node_pool.feasible(demand):
+            raise ValueError(
+                f"demand {demand} is infeasible on this node "
+                f"(total: {self._node_pool.total})"
+            )
+        return {
+            "demand": demand,
+            "pg": pg_state,
+            "bundle_index": bundle_index,
+            "capture": capture,
+        }
+
+    def _acquire_planned(self, plan: dict) -> _Lease:
+        """Blocking-acquire the planned resources; returns the held lease."""
+        demand, pg = plan["demand"], plan["pg"]
+        if pg is None:
+            self._node_pool.acquire(demand)
+            return _Lease(self, self._node_pool, demand)
+        pg.ready_event.wait()
+        idx = plan["bundle_index"]
+        while True:
+            if pg.state == "REMOVED":
+                raise ValueError(f"placement group {pg.id} was removed")
+            candidates = (
+                list(range(len(pg.bundle_pools))) if idx < 0 else [idx]
+            )
+            for i in candidates:
+                pool = pg.bundle_pools[i]
+                if pool.try_acquire(demand):
+                    return _Lease(self, pool, demand, pg)
+            if not demand:
+                return _Lease(self, self._node_pool, {})
+            with pg.release_cv:
+                pg.release_cv.wait(0.05)
+
+    def create_placement_group(
+        self, bundles: list, strategy: str, name: str = "", lifetime=None
+    ) -> str:
+        pg_id = ids.new_placement_group_id()
+        pg = _PlacementGroupState(pg_id, bundles, strategy, name)
+        union: dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                union[k] = union.get(k, 0.0) + v
+        pg.union = union
+        # Single-node backend: STRICT_SPREAD needs len(bundles) distinct
+        # nodes, so >1 bundle is infeasible here by definition.
+        if (strategy == "STRICT_SPREAD" and len(bundles) > 1) or (
+            not self._node_pool.feasible(union)
+        ):
+            pg.state = "INFEASIBLE"
+            self._pgs[pg_id] = pg
+            return pg_id
+        self._pgs[pg_id] = pg
+
+        def reserve():
+            # Poll-acquire so a concurrent removal cancels the reservation
+            # instead of leaving this thread blocked forever.
+            while not self._node_pool.acquire(union, timeout=0.05):
+                if pg.state == "REMOVED":
+                    return
+            with pg.lock:
+                if pg.state == "REMOVED":
+                    self._node_pool.release(union)
+                    return
+                pg.bundle_pools = [ResourcePool(b) for b in bundles]
+                pg.state = "CREATED"
+                pg.ready_event.set()
+
+        self._pool.submit(reserve)
+        return pg_id
+
+    def remove_placement_group(self, pg_id: str) -> None:
+        pg = self._pgs.get(pg_id)
+        if pg is None:
+            return
+        with pg.lock:
+            prev = pg.state
+            pg.state = "REMOVED"
+            if prev == "CREATED":
+                # Return only capacity not currently held by running
+                # tasks/actors; their leases release straight to the node
+                # pool once they finish (see _Lease.release).
+                freed: dict[str, float] = {}
+                for pool in pg.bundle_pools:
+                    for k, v in pool.available().items():
+                        freed[k] = freed.get(k, 0.0) + v
+                self._node_pool.release(freed)
+        # Wake anything blocked on readiness; they observe REMOVED and fail.
+        pg.ready_event.set()
+        with pg.release_cv:
+            pg.release_cv.notify_all()
+
+    def placement_group_ready(self, pg_id: str) -> ObjectRef:
+        oid = ids.new_object_id()
+        ref = self.make_ref(oid)
+        pg = self._pgs.get(pg_id)
+        entry = self._entry(oid)
+        if pg is None or pg.state in ("INFEASIBLE", "REMOVED"):
+            entry.set_error(
+                ValueError(f"placement group {pg_id} cannot become ready")
+            )
+            return ref
+
+        def waiter():
+            pg.ready_event.wait()
+            if pg.state == "REMOVED":
+                entry.set_error(ValueError(f"placement group {pg_id} was removed"))
+            else:
+                entry.set(pg_id)
+
+        self._pool.submit(waiter)
+        return ref
+
+    def placement_group_table(self, pg_id: str | None = None):
+        if pg_id is not None:
+            pg = self._pgs.get(pg_id)
+            return pg.table_entry() if pg else None
+        return {pid: pg.table_entry() for pid, pg in self._pgs.items()}
+
+    def current_placement_group(self):
+        return getattr(self._current_pg, "info", None)
 
     # -- task plane -------------------------------------------------------
 
@@ -284,6 +565,11 @@ class LocalBackend:
         oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
         refs = [self.make_ref(o) for o in oids]
         fname = name or getattr(func, "__name__", "task")
+        try:
+            plan = self._plan_resources(_options, is_actor=False)
+        except (ValueError, TypeError) as e:
+            self._store_error(oids, e)
+            return refs
         pins = self._pin_ref_args(args, kwargs)
 
         def run():
@@ -292,7 +578,22 @@ class LocalBackend:
                 while True:
                     try:
                         a, kw = self._resolve_args(args, kwargs)
-                        result = func(*a, **kw)
+                        lease = self._acquire_planned(plan)
+                        self._current_lease.lease = lease
+                        if plan["capture"]:
+                            self._current_pg.info = {
+                                "id": plan["pg"].id,
+                                "bundles": plan["pg"].bundles,
+                                "strategy": plan["pg"].strategy,
+                                "name": plan["pg"].name,
+                            }
+                        try:
+                            result = func(*a, **kw)
+                        finally:
+                            self._current_lease.lease = None
+                            lease.release()
+                            if plan["capture"]:
+                                self._current_pg.info = None
                         self._store_returns(oids, result, num_returns)
                         return
                     except BaseException as e:  # noqa: BLE001 — stored, not dropped
@@ -330,6 +631,7 @@ class LocalBackend:
         **_options,
     ) -> str:
         actor_id = ids.new_actor_id()
+        plan = self._plan_resources(_options, is_actor=True)  # raises if infeasible
         with self._lock:
             if name is not None:
                 if name in self._named_actors:
@@ -342,12 +644,18 @@ class LocalBackend:
         ctor_done = threading.Event()
 
         def ctor():
+            lease = None
             try:
                 a, kw = self._resolve_args(args, kwargs)
+                # Resources are held for the actor's whole lifetime.
+                lease = self._acquire_planned(plan)
                 state.instance = cls(*a, **kw)
+                state.release_resources = lease.release
             except BaseException:  # noqa: BLE001
                 state.dead = True
                 state.death_cause = traceback.format_exc()
+                if lease is not None:
+                    lease.release()
             finally:
                 self._unpin(pins)
                 ctor_done.set()
@@ -483,6 +791,9 @@ class LocalBackend:
                 )
             for _ in state.threads:
                 state.queue.put(_POISON)
+            if state.release_resources is not None:
+                state.release_resources()
+                state.release_resources = None
         with self._lock:
             if state.name and self._named_actors.get(state.name) == actor_id:
                 del self._named_actors[state.name]
@@ -508,7 +819,10 @@ class LocalBackend:
     # -- introspection ----------------------------------------------------
 
     def cluster_resources(self) -> dict:
-        return {"CPU": float(self._ncpu)}
+        return self._node_pool.total
+
+    def available_resources(self) -> dict:
+        return self._node_pool.available()
 
     def nodes(self) -> list[dict]:
         return [{"NodeID": "local", "Alive": True, "Resources": self.cluster_resources()}]

@@ -1,0 +1,28 @@
+"""Utility layer over the core task/actor/object API.
+
+Reference parity: ``python/ray/util/`` — placement groups, scheduling
+strategies, ActorPool, queue, collective groups. Everything here uses only
+public ``ray_tpu`` APIs (the SURVEY.md §1 layering invariant).
+"""
+
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    get_current_placement_group,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "PlacementGroup",
+    "placement_group",
+    "placement_group_table",
+    "remove_placement_group",
+    "get_current_placement_group",
+    "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+]

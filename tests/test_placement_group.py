@@ -1,0 +1,188 @@
+"""Placement group + resource accounting tests (modeled on the reference's
+``python/ray/tests/test_placement_group.py`` behaviors)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, resources={"TPU": 8.0})
+    yield
+    ray_tpu.shutdown()
+
+
+def test_cluster_and_available_resources():
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4.0
+    assert total["TPU"] == 8.0
+    assert ray_tpu.available_resources()["CPU"] == 4.0
+
+
+def test_task_resource_acquisition_blocks():
+    """Two 2-CPU tasks saturate a 4-CPU node; a third must wait."""
+    running = threading.Semaphore(0)
+    release = threading.Event()
+
+    @ray_tpu.remote(num_cpus=2)
+    def hold():
+        running.release()
+        release.wait(5)
+        return "done"
+
+    r1, r2, r3 = hold.remote(), hold.remote(), hold.remote()
+    running.acquire(timeout=5)
+    running.acquire(timeout=5)
+    # Third task cannot have started: no CPU left.
+    assert not running.acquire(timeout=0.3)
+    assert ray_tpu.available_resources()["CPU"] == 0.0
+    release.set()
+    assert ray_tpu.get([r1, r2, r3]) == ["done"] * 3
+    # All released after completion.
+    deadline = time.monotonic() + 5
+    while ray_tpu.available_resources()["CPU"] != 4.0:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+
+
+def test_infeasible_task_raises_at_get():
+    @ray_tpu.remote(num_cpus=64)
+    def big():
+        return 1
+
+    with pytest.raises(ValueError, match="infeasible"):
+        ray_tpu.get(big.remote())
+
+
+def test_placement_group_create_ready_remove():
+    pg = placement_group([{"CPU": 1, "TPU": 4}, {"TPU": 4}], strategy="PACK")
+    assert ray_tpu.get(pg.ready(), timeout=5) == pg.id
+    table = placement_group_table(pg)
+    assert table["state"] == "CREATED"
+    assert table["strategy"] == "PACK"
+    # Bundles carved out of the node pool.
+    assert ray_tpu.available_resources().get("TPU", 0.0) == 0.0
+
+    @ray_tpu.remote(num_tpus=4, num_cpus=0)
+    def in_pg():
+        return "ok"
+
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=1
+    )
+    assert ray_tpu.get(in_pg.options(scheduling_strategy=strategy).remote()) == "ok"
+
+    remove_placement_group(pg)
+    assert placement_group_table(pg)["state"] == "REMOVED"
+    deadline = time.monotonic() + 5
+    while ray_tpu.available_resources().get("TPU", 0.0) != 8.0:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+
+
+def test_placement_group_infeasible():
+    pg = placement_group([{"CPU": 128}])
+    assert placement_group_table(pg)["state"] == "INFEASIBLE"
+    assert not pg.wait(timeout_seconds=0.2)
+
+
+def test_strict_spread_infeasible_on_one_node():
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert placement_group_table(pg)["state"] == "INFEASIBLE"
+
+
+def test_demand_must_fit_a_bundle():
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(5)
+
+    @ray_tpu.remote(num_cpus=2)
+    def too_big():
+        return 1
+
+    strategy = PlacementGroupSchedulingStrategy(placement_group=pg)
+    with pytest.raises(ValueError, match="does not fit"):
+        ray_tpu.get(too_big.options(scheduling_strategy=strategy).remote())
+    remove_placement_group(pg)
+
+
+def test_nested_tasks_release_cpus_while_blocked():
+    """A parent blocked in get() must give its CPUs back (raylet parity)."""
+
+    @ray_tpu.remote(num_cpus=4)
+    def parent():
+        @ray_tpu.remote(num_cpus=4)
+        def child():
+            return 41
+
+        return ray_tpu.get(child.remote()) + 1
+
+    assert ray_tpu.get(parent.remote(), timeout=10) == 42
+
+
+def test_remove_pending_pg_unblocks_waiters():
+    # Saturate TPUs with an actor so the second PG can't reserve.
+    @ray_tpu.remote(num_tpus=8)
+    class Hog:
+        def ping(self):
+            return 1
+
+    hog = Hog.remote()
+    ray_tpu.get(hog.ping.remote())
+    pg = placement_group([{"TPU": 8}])
+    ready_ref = pg.ready()
+    assert placement_group_table(pg)["state"] == "PENDING"
+    remove_placement_group(pg)
+    with pytest.raises(ValueError, match="removed"):
+        ray_tpu.get(ready_ref, timeout=5)
+    ray_tpu.kill(hog)
+    deadline = time.monotonic() + 5
+    while ray_tpu.available_resources().get("TPU", 0.0) != 8.0:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+
+
+def test_failed_actor_ctor_releases_resources():
+    @ray_tpu.remote(num_cpus=4)
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("ctor boom")
+
+        def ping(self):
+            return 1
+
+    a = Bad.remote()
+    from ray_tpu.core.object_ref import ActorError, TaskError
+
+    with pytest.raises((ActorError, TaskError)):
+        ray_tpu.get(a.ping.remote(), timeout=5)
+    deadline = time.monotonic() + 5
+    while ray_tpu.available_resources()["CPU"] != 4.0:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+
+
+def test_actor_holds_resources_until_kill():
+    @ray_tpu.remote(num_tpus=8)
+    class Chip:
+        def ping(self):
+            return "pong"
+
+    a = Chip.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    assert ray_tpu.available_resources().get("TPU", 0.0) == 0.0
+    ray_tpu.kill(a)
+    deadline = time.monotonic() + 5
+    while ray_tpu.available_resources().get("TPU", 0.0) != 8.0:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
