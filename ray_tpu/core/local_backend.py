@@ -660,8 +660,15 @@ class LocalBackend:
                 self._unpin(pins)
                 ctor_done.set()
 
-        def worker_loop():
-            ctor_done.wait()
+        def worker_loop(run_ctor: bool = False):
+            # The ctor runs on worker thread 0 so that thread-local state a
+            # constructor sets (e.g. a collective-group context) is visible
+            # to subsequent method calls on a max_concurrency=1 actor —
+            # matching the reference, where ctor and methods share a process.
+            if run_ctor:
+                ctor()
+            else:
+                ctor_done.wait()
             while True:
                 item = state.queue.get()
                 if item is _POISON:
@@ -693,9 +700,8 @@ class LocalBackend:
                 finally:
                     self._unpin(pins)
 
-        threading.Thread(target=ctor, daemon=True).start()
-        for _ in range(max_concurrency):
-            t = threading.Thread(target=worker_loop, daemon=True)
+        for i in range(max_concurrency):
+            t = threading.Thread(target=worker_loop, args=(i == 0,), daemon=True)
             t.start()
             state.threads.append(t)
         return actor_id

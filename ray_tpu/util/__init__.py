@@ -16,8 +16,12 @@ from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Queue
 
 __all__ = [
+    "ActorPool",
+    "Queue",
     "PlacementGroup",
     "placement_group",
     "placement_group_table",

@@ -1,0 +1,102 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Reference parity: ``python/ray/util/actor_pool.py`` — same surface
+(map / map_unordered / submit / get_next / get_next_unordered / has_next /
+has_free / push / pop_idle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: list = []
+
+    def map(self, fn: Callable, values: Iterable):
+        """Apply fn(actor, value) over values, yielding results in order."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def submit(self, fn: Callable, value):
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending_submits)
+
+    def get_next(self, timeout: float | None = None):
+        """Next result in submission order."""
+        if not self.has_next():
+            raise StopIteration("no more results to get")
+        future = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        try:
+            value = ray_tpu.get(future, timeout=timeout)
+        finally:
+            _, actor = self._future_to_actor.pop(future)
+            self._return_actor(actor)
+        return value
+
+    def get_next_unordered(self, timeout: float | None = None):
+        """Earliest-finishing result, any order."""
+        if not self.has_next():
+            raise StopIteration("no more results to get")
+        ready, _ = ray_tpu.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("timed out waiting for a result")
+        future = ready[0]
+        try:
+            value = ray_tpu.get(future)
+        finally:
+            i, actor = self._future_to_actor.pop(future)
+            del self._index_to_future[i]
+            # Keep ordered-get consistent: skip the consumed index.
+            if i == self._next_return_index:
+                self._next_return_index += 1
+            self._return_actor(actor)
+        return value
+
+    def _return_actor(self, actor):
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending_submits
+
+    def push(self, actor):
+        """Add a new idle actor to the pool."""
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def pop_idle(self):
+        """Remove and return an idle actor, or None if none are idle."""
+        return self._idle.pop() if self.has_free() else None

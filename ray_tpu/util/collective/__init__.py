@@ -1,0 +1,49 @@
+"""Collective communication over groups of actors.
+
+Reference parity: ``python/ray/util/collective/collective.py`` — the
+declarative group-management API (init/create/destroy groups, ranked ops:
+allreduce/barrier/reduce/broadcast/allgather/reducescatter/send/recv).
+
+TPU-native split (SURVEY.md §5.8): the reference backs these ops with NCCL
+(cupy) or Gloo (pygloo). Here the **tensor plane is XLA** — dense-array
+collectives inside jitted step functions ride ICI via ``jax.lax`` ops (see
+``ray_tpu.util.collective.xla``), and the group-management/rendezvous layer
+(this module) runs over the control plane: a coordinator actor is the
+rendezvous store (the analog of the named actor holding the NCCL unique id,
+``nccl_collective_group.py``), and host-memory collectives between actors
+move numpy arrays through the object plane.
+"""
+
+from ray_tpu.util.collective.collective import (
+    ReduceOp,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.util.collective import xla
+
+__all__ = [
+    "ReduceOp",
+    "init_collective_group",
+    "destroy_collective_group",
+    "get_rank",
+    "get_collective_group_size",
+    "allreduce",
+    "allgather",
+    "barrier",
+    "broadcast",
+    "reduce",
+    "reducescatter",
+    "send",
+    "recv",
+    "xla",
+]

@@ -1,0 +1,283 @@
+"""Group management + host-memory collective ops.
+
+The coordinator actor is the rendezvous + exchange store; ranks push
+contributions and poll for completeness. All ranks of a group must issue
+collective calls in the same order (standard collective semantics — same
+contract as the reference's NCCL/Gloo groups).
+
+Reference: ``python/ray/util/collective/collective.py:120,151,258-594``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda arrs: np.sum(arrs, axis=0),
+    ReduceOp.PRODUCT: lambda arrs: np.prod(arrs, axis=0),
+    ReduceOp.MIN: lambda arrs: np.min(arrs, axis=0),
+    ReduceOp.MAX: lambda arrs: np.max(arrs, axis=0),
+}
+
+
+class _Coordinator:
+    """Rendezvous + exchange slots for one collective group.
+
+    A slot is complete when ``expected`` ranks contributed; it is deleted
+    after ``num_fetchers`` distinct ranks fetched it.
+    """
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.slots: dict = {}  # key -> {"payloads": {rank: x}, "expected": n,
+        #                               "num_fetchers": n, "fetched": set()}
+
+    def contribute(self, key, rank, payload, expected, num_fetchers):
+        slot = self.slots.setdefault(
+            key,
+            {"payloads": {}, "expected": expected, "num_fetchers": num_fetchers,
+             "fetched": set()},
+        )
+        slot["payloads"][rank] = payload
+        return len(slot["payloads"])
+
+    def try_fetch(self, key, rank):
+        """(ready, payloads-by-rank). GC the slot once everyone fetched."""
+        slot = self.slots.get(key)
+        if slot is None or len(slot["payloads"]) < slot["expected"]:
+            return False, None
+        payloads = slot["payloads"]
+        slot["fetched"].add(rank)
+        if len(slot["fetched"]) >= slot["num_fetchers"]:
+            del self.slots[key]
+        return True, payloads
+
+    def ready(self, key):
+        slot = self.slots.get(key)
+        return slot is not None and len(slot["payloads"]) >= slot["expected"]
+
+
+class _GroupContext:
+    def __init__(self, name, coordinator, world_size, rank):
+        self.name = name
+        self.coordinator = coordinator
+        self.world_size = world_size
+        self.rank = rank
+        self.seq = 0
+        # Point-to-point ops sequence independently per (src, dst) pair —
+        # only pairwise ordering matters for send/recv matching.
+        self.pair_seq: dict = {}
+
+    def next_key(self, op: str) -> str:
+        self.seq += 1
+        return f"{op}:{self.seq}"
+
+    def next_pair_key(self, src: int, dst: int) -> str:
+        n = self.pair_seq.get((src, dst), 0) + 1
+        self.pair_seq[(src, dst)] = n
+        return f"sendrecv:{src}->{dst}:{n}"
+
+    def exchange(
+        self,
+        op: str,
+        payload,
+        *,
+        contribute: bool = True,
+        expected: int | None = None,
+        num_fetchers: int | None = None,
+        fetch: bool = True,
+        poll_interval: float = 0.002,
+        timeout: float = 120.0,
+    ) -> Optional[dict]:
+        key = self.next_key(op)
+        expected = self.world_size if expected is None else expected
+        num_fetchers = self.world_size if num_fetchers is None else num_fetchers
+        c = self.coordinator
+        if contribute:
+            ray_tpu.get(
+                c.contribute.remote(key, self.rank, payload, expected, num_fetchers)
+            )
+        deadline = time.monotonic() + timeout
+        if not fetch:
+            # Still wait for slot completeness so the op is a sync point.
+            while not ray_tpu.get(c.ready.remote(key)):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"collective {key} timed out")
+                time.sleep(poll_interval)
+            return None
+        while True:
+            ok, payloads = ray_tpu.get(c.try_fetch.remote(key, self.rank))
+            if ok:
+                return payloads
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"collective {key} timed out")
+            time.sleep(poll_interval)
+
+
+# Group contexts are per-execution-thread: each actor worker thread (one per
+# max_concurrency=1 actor) holds its own rank state, mirroring the
+# per-process module state of the reference.
+_local = threading.local()
+
+
+def _groups() -> dict:
+    if not hasattr(_local, "groups"):
+        _local.groups = {}
+    return _local.groups
+
+
+def _ctx(group_name: str) -> _GroupContext:
+    try:
+        return _groups()[group_name]
+    except KeyError:
+        raise ValueError(
+            f"collective group {group_name!r} is not initialized in this "
+            f"worker; call init_collective_group first"
+        ) from None
+
+
+def _coordinator_name(group_name: str) -> str:
+    return f"ray_tpu.collective.{group_name}"
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Declare this worker as ``rank`` of a ``world_size`` group.
+
+    backend="host": numpy collectives through the coordinator/object plane.
+    (In-mesh XLA collectives don't need a group: use ``collective.xla``.)
+    """
+    if backend not in ("host",):
+        raise ValueError(f"unknown collective backend {backend!r}")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    coordinator_cls = ray_tpu.remote(_Coordinator)
+    name = _coordinator_name(group_name)
+    try:
+        coordinator = coordinator_cls.options(name=name, num_cpus=0).remote(world_size)
+        # Force ctor completion so a racing get_actor sees a live actor.
+        ray_tpu.get(coordinator.ready.remote("__init__"))
+    except ValueError:
+        coordinator = ray_tpu.get_actor(name)
+    _groups()[group_name] = _GroupContext(group_name, coordinator, world_size, rank)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    ctx = _groups().pop(group_name, None)
+    if ctx is not None and ctx.rank == 0:
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(_coordinator_name(group_name)))
+        except ValueError:
+            pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _ctx(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _ctx(group_name).world_size
+
+
+def _as_np(tensor) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
+    ctx = _ctx(group_name)
+    payloads = ctx.exchange("allreduce", _as_np(tensor))
+    return _REDUCERS[op]([payloads[r] for r in sorted(payloads)])
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    ctx = _ctx(group_name)
+    payloads = ctx.exchange("allgather", _as_np(tensor))
+    return [payloads[r] for r in sorted(payloads)]
+
+
+def barrier(group_name: str = "default") -> None:
+    _ctx(group_name).exchange("barrier", None)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = ReduceOp.SUM):
+    ctx = _ctx(group_name)
+    payloads = ctx.exchange(
+        "reduce",
+        _as_np(tensor),
+        num_fetchers=1,
+        fetch=ctx.rank == dst_rank,
+    )
+    if ctx.rank == dst_rank:
+        return _REDUCERS[op]([payloads[r] for r in sorted(payloads)])
+    return tensor
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    ctx = _ctx(group_name)
+    payloads = ctx.exchange(
+        "broadcast",
+        _as_np(tensor) if ctx.rank == src_rank else None,
+        contribute=ctx.rank == src_rank,
+        expected=1,
+    )
+    return payloads[src_rank]
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
+    """Reduce across ranks, then return this rank's 1/world_size chunk
+    (along axis 0, which must divide evenly)."""
+    ctx = _ctx(group_name)
+    arr = _as_np(tensor)
+    if arr.shape[0] % ctx.world_size != 0:
+        raise ValueError(
+            f"reducescatter axis-0 dim {arr.shape[0]} not divisible by "
+            f"world_size {ctx.world_size}"
+        )
+    payloads = ctx.exchange("reducescatter", arr)
+    reduced = _REDUCERS[op]([payloads[r] for r in sorted(payloads)])
+    chunk = arr.shape[0] // ctx.world_size
+    return reduced[ctx.rank * chunk : (ctx.rank + 1) * chunk]
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    ctx = _ctx(group_name)
+    if dst_rank == ctx.rank:
+        raise ValueError("cannot send to self")
+    key = ctx.next_pair_key(ctx.rank, dst_rank)
+    ray_tpu.get(
+        ctx.coordinator.contribute.remote(key, ctx.rank, _as_np(tensor), 1, 1)
+    )
+
+
+def recv(src_rank: int, group_name: str = "default", timeout: float = 120.0):
+    ctx = _ctx(group_name)
+    if src_rank == ctx.rank:
+        raise ValueError("cannot recv from self")
+    key = ctx.next_pair_key(src_rank, ctx.rank)
+    deadline = time.monotonic() + timeout
+    while True:
+        ok, payloads = ray_tpu.get(ctx.coordinator.try_fetch.remote(key, ctx.rank))
+        if ok:
+            return payloads[src_rank]
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"recv from rank {src_rank} timed out")
+        time.sleep(0.002)

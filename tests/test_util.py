@@ -1,0 +1,194 @@
+"""Tests for util layer: ActorPool, Queue, collective groups.
+
+Modeled on reference tests ``python/ray/tests/test_actor_pool.py``,
+``test_queue.py``, and ``python/ray/util/collective/tests/``.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=16)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_map_ordered():
+    pool = ActorPool([_Doubler.remote() for _ in range(3)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * i for i in range(8)]
+
+
+def test_actor_pool_map_unordered():
+    pool = ActorPool([_Doubler.remote() for _ in range(3)])
+    out = list(pool.map_unordered(lambda a, v: a.double.remote(v), range(8)))
+    assert sorted(out) == [2 * i for i in range(8)]
+
+
+def test_actor_pool_submit_get_next():
+    pool = ActorPool([_Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 1)
+    pool.submit(lambda a, v: a.double.remote(v), 2)
+    assert pool.get_next() == 2
+    assert pool.get_next() == 4
+    assert not pool.has_next()
+
+
+def test_actor_pool_push_pop():
+    pool = ActorPool([_Doubler.remote()])
+    a = pool.pop_idle()
+    assert a is not None
+    assert pool.pop_idle() is None
+    pool.push(a)
+    assert pool.has_free()
+
+
+def test_queue_fifo_and_batch():
+    q = Queue()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5
+    assert [q.get() for _ in range(5)] == list(range(5))
+    assert q.empty()
+    q.put_nowait_batch([1, 2, 3])
+    assert q.get_nowait_batch(3) == [1, 2, 3]
+    q.shutdown()
+
+
+def test_queue_maxsize_and_exceptions():
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait(3)
+    with pytest.raises(Full):
+        q.put(3, timeout=0.2)
+    assert q.get() == 1
+    q.put(3)
+    assert [q.get(), q.get()] == [2, 3]
+    with pytest.raises(Empty):
+        q.get_nowait()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+def test_queue_handle_shared_between_actors():
+    q = Queue()
+
+    @ray_tpu.remote
+    class Producer:
+        def run(self, q, n):
+            for i in range(n):
+                q.put(i)
+            return "done"
+
+    p = Producer.remote()
+    assert ray_tpu.get(p.run.remote(q, 4)) == "done"
+    assert [q.get(timeout=5) for _ in range(4)] == [0, 1, 2, 3]
+    q.shutdown()
+
+
+# -- collective groups ----------------------------------------------------
+
+
+@ray_tpu.remote
+class _Rank:
+    def __init__(self, rank, world, group):
+        from ray_tpu.util import collective as col
+
+        self.rank = rank
+        col.init_collective_group(world, rank, group_name=group)
+
+    def do_allreduce(self, group):
+        from ray_tpu.util import collective as col
+
+        out = col.allreduce(np.full((4,), self.rank + 1.0), group_name=group)
+        return out
+
+    def do_allgather(self, group):
+        from ray_tpu.util import collective as col
+
+        return col.allgather(np.array([self.rank]), group_name=group)
+
+    def do_broadcast(self, group):
+        from ray_tpu.util import collective as col
+
+        return col.broadcast(np.array([42.0 + self.rank]), src_rank=1,
+                             group_name=group)
+
+    def do_reducescatter(self, group):
+        from ray_tpu.util import collective as col
+
+        return col.reducescatter(np.arange(8.0), group_name=group)
+
+    def do_sendrecv(self, group):
+        from ray_tpu.util import collective as col
+
+        if self.rank == 0:
+            col.send(np.array([7.0]), dst_rank=1, group_name=group)
+            return None
+        return col.recv(src_rank=0, group_name=group)
+
+    def do_barrier(self, group):
+        from ray_tpu.util import collective as col
+
+        col.barrier(group_name=group)
+        return self.rank
+
+
+def _make_group(name, world=2):
+    return [_Rank.remote(r, world, name) for r in range(world)]
+
+
+def test_collective_allreduce_allgather():
+    ranks = _make_group("g1")
+    outs = ray_tpu.get([r.do_allreduce.remote("g1") for r in ranks])
+    for out in outs:
+        np.testing.assert_allclose(out, np.full((4,), 3.0))
+    gathers = ray_tpu.get([r.do_allgather.remote("g1") for r in ranks])
+    for g in gathers:
+        assert [int(x[0]) for x in g] == [0, 1]
+
+
+def test_collective_broadcast_reducescatter_sendrecv_barrier():
+    ranks = _make_group("g2")
+    outs = ray_tpu.get([r.do_broadcast.remote("g2") for r in ranks])
+    for out in outs:
+        np.testing.assert_allclose(out, np.array([43.0]))
+    rs = ray_tpu.get([r.do_reducescatter.remote("g2") for r in ranks])
+    np.testing.assert_allclose(rs[0], 2 * np.arange(4.0))
+    np.testing.assert_allclose(rs[1], 2 * np.arange(4.0, 8.0))
+    sr = ray_tpu.get([r.do_sendrecv.remote("g2") for r in ranks])
+    assert sr[0] is None
+    np.testing.assert_allclose(sr[1], np.array([7.0]))
+    assert sorted(ray_tpu.get([r.do_barrier.remote("g2") for r in ranks])) == [0, 1]
+
+
+def test_xla_device_group(devices8):
+    from ray_tpu.util.collective.xla import DeviceGroup
+
+    g = DeviceGroup(devices8)
+    x = np.arange(16.0).reshape(8, 2)
+    out = np.asarray(g.allreduce(x))
+    np.testing.assert_allclose(out, x.sum(axis=0))
+    gathered = np.asarray(g.allgather(x))
+    np.testing.assert_allclose(gathered, x)
+    rs = np.asarray(g.reducescatter(np.ones((8, 8))))
+    assert rs.shape == (8, 1)
+    np.testing.assert_allclose(rs, np.full((8, 1), 8.0))
+    g.barrier()
