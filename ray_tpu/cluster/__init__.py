@@ -1,0 +1,26 @@
+"""Multiprocess cluster runtime: head (control plane) + node agents + workers.
+
+This package is the native-runtime analog of the reference's process
+topology (SURVEY.md §3.1):
+
+  * ``head``        — GCS-equivalent control plane (``src/ray/gcs``): node /
+                      actor / object directories, KV, placement groups,
+                      cluster scheduler.
+  * ``node_agent``  — raylet-equivalent per-node daemon (``src/ray/raylet``):
+                      worker pool, local resource accounting, local shm
+                      object store (C++), object serving to peers.
+  * ``workerproc``  — worker process (core-worker equivalent,
+                      ``src/ray/core_worker``): executes tasks, hosts actors,
+                      stores results in the node store.
+  * ``client``      — the driver/worker in-process runtime implementing the
+                      same Backend surface as ``core.local_backend``.
+
+Processes talk over length-prefixed pickled RPC on TCP (the reference uses
+gRPC; the wire is an implementation detail, the protocol shape — leases,
+directories, pull-based transfer — is what's mirrored). Simulated multi-node
+on one host works exactly like the reference's ``cluster_utils.Cluster``:
+every node agent fakes its own node id, resources, and object store segment
+(SURVEY.md §4.3).
+"""
+
+from ray_tpu.cluster.cluster_utils import Cluster

@@ -1,0 +1,555 @@
+"""ClusterBackend: the in-process runtime for drivers and workers.
+
+Implements the same Backend surface as ``core.local_backend.LocalBackend``
+over the cluster's control plane (head) and data plane (shm stores + node
+agents) — task submission with cluster scheduling, direct actor calls
+(caller → actor worker RPC, no agent hop: the direct actor transport of
+``direct_actor_task_submitter.h``), object put/get with pull-based
+transfer, and lineage-based re-execution: if the node that was computing a
+task dies, the owner resubmits the task spec elsewhere
+(``object_recovery_manager.h:41``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+from ray_tpu._native.shm_store import ShmStore, StoreFullError
+from ray_tpu.cluster.rpc import ConnectionLost, RpcClient
+from ray_tpu.core import ids
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.object_ref import (
+    ActorError,
+    GetTimeoutError,
+    ObjectRef,
+    ObjectLostError,
+    TaskError,
+)
+from ray_tpu.core.resources import demand_of
+
+DEFAULT_MAX_RETRIES = 3
+
+
+class ClusterBackend:
+    def __init__(self, head_address: str, *, node_id: str | None = None,
+                 store_path: str | None = None):
+        self.head = RpcClient(head_address)
+        self.head_address = head_address
+        if node_id is None:
+            nodes = [n for n in self.head.call("nodes") if n["Alive"]]
+            if not nodes:
+                raise RuntimeError("cluster has no alive nodes")
+            node_id, store_path = nodes[0]["NodeID"], nodes[0]["StorePath"]
+        self.node_id = node_id
+        self.store = ShmStore(store_path)
+        self._node_clients: dict[str, RpcClient] = {}
+        self._worker_clients: dict[str, RpcClient] = {}
+        self._actor_cache: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        # Owner-side lineage: oid -> task spec, for re-execution on loss.
+        self._lineage: dict[str, dict] = {}
+        # Pending actor-task results: oid -> actor_id (for fail-fast when
+        # the actor dies with calls in flight).
+        self._actor_tasks: dict[str, str] = {}
+        self._pins: dict[str, Any] = {}  # zero-copy views we hold alive
+        # Set by the worker process: (on_block, on_unblock) callbacks that
+        # tell the node agent to release/reacquire this task's resources
+        # while we block in get() (nested-task deadlock avoidance).
+        self._block_hooks: tuple | None = None
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _node_client(self, address: str) -> RpcClient:
+        with self._lock:
+            c = self._node_clients.get(address)
+            if c is None:
+                c = self._node_clients[address] = RpcClient(address)
+            return c
+
+    def _worker_client(self, address: str) -> RpcClient:
+        with self._lock:
+            c = self._worker_clients.get(address)
+            if c is None:
+                c = self._worker_clients[address] = RpcClient(address)
+            return c
+
+    def make_ref(self, oid: str) -> ObjectRef:
+        return ObjectRef(oid, owner=self.node_id)
+
+    # -- object plane ------------------------------------------------------
+
+    def put_with_id(self, oid: str, value: Any, is_error: bool = False) -> None:
+        flag = b"E" if is_error else b"V"
+        meta, chunks = ser.serialize(value)
+        try:
+            self.store.put(oid, chunks, flag + meta)
+        except StoreFullError:
+            raise
+        self.head.call(
+            "add_location", oid, self.node_id, is_error=is_error,
+            size=ser.total_size(chunks),
+        )
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ids.new_object_id()
+        self.put_with_id(oid, value)
+        return self.make_ref(oid)
+
+    def _read_local(self, oid: str):
+        """Returns (value,) or None if the object isn't in the local store.
+        (The 1-tuple disambiguates a stored None from a miss.)"""
+        got = self.store.get(oid)
+        if got is None:
+            return None
+        data, meta = got
+        try:
+            value = self._decode(meta, data)
+        except BaseException:
+            self.store.release(oid)
+            raise
+        self._scope_pin(oid, value, ser.num_buffers(meta[1:]))
+        return (value,)
+
+    def _scope_pin(self, oid: str, value: Any, nbufs: int) -> None:
+        """Hold the store refcount (zero-copy pin) only while deserialized
+        views into the segment can still be alive.
+
+        * no out-of-band buffers: nothing points into the segment — release
+          immediately;
+        * numpy arrays found in the value: release when they are all
+          collected (plasma parity: buffer lifetime pins the object);
+        * buffers but no trackable arrays: keep the pin for the backend's
+          lifetime (rare; conservative).
+        """
+        if nbufs == 0:
+            self.store.release(oid)
+            return
+        import weakref
+
+        import numpy as np
+
+        arrays: list = []
+
+        def walk(v, depth=0):
+            if depth > 4:
+                return
+            if isinstance(v, np.ndarray):
+                arrays.append(v)
+            elif isinstance(v, dict):
+                for x in v.values():
+                    walk(x, depth + 1)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    walk(x, depth + 1)
+
+        walk(value)
+        if not arrays:
+            self._pins[oid] = True
+            return
+        remaining = {"n": len(arrays)}
+        store = self.store
+
+        def on_dead():
+            remaining["n"] -= 1
+            if remaining["n"] == 0:
+                try:
+                    store.release(oid)
+                except Exception:
+                    pass
+
+        for a in arrays:
+            weakref.finalize(a, on_dead)
+
+    @staticmethod
+    def _decode(meta: bytes, data):
+        flag, ser_meta = meta[:1], meta[1:]
+        value = ser.deserialize(ser_meta, data)
+        if flag == b"E":
+            raise value
+        return value
+
+    def _fetch_remote(self, oid: str, locations: list) -> Any:
+        last_err: Exception | None = None
+        for node_id, address, _store_path in locations:
+            if node_id == self.node_id:
+                boxed = self._read_local(oid)
+                if boxed is not None:
+                    return boxed[0]
+                continue
+            try:
+                got = self._node_client(address).call("fetch_object", oid)
+            except (ConnectionLost, OSError) as e:
+                last_err = e
+                continue
+            if got is None:
+                continue
+            meta, data = got
+            return self._decode(meta, data)
+        raise ObjectLostError(
+            f"object {oid[:16]}… not retrievable from {len(locations)} "
+            f"location(s): {last_err}"
+        )
+
+    def _maybe_recover(self, oid: str) -> bool:
+        """Lineage reconstruction: resubmit the creating task if its node
+        died before the object appeared. Returns True if resubmitted."""
+        spec = self._lineage.get(oid)
+        if spec is None or spec.get("retries_left", 0) <= 0:
+            return False
+        assigned = spec.get("assigned_node")
+        nodes = {n["NodeID"]: n for n in self.head.call("nodes")}
+        if assigned is not None and nodes.get(assigned, {}).get("Alive"):
+            return False  # still computing
+        spec["retries_left"] -= 1
+        # Soft affinity on recovery: the pinned node is gone, so let the
+        # scheduler place the retry anywhere feasible.
+        spec["sinfo"]["node_affinity"] = None
+        try:
+            self._submit_spec(spec)
+        except (ValueError, TimeoutError):
+            return False
+        return True
+
+    def _check_actor_alive(self, oid: str) -> None:
+        """A pending actor-task result can never appear if the actor died —
+        fail fast (RayActorError parity) instead of waiting forever."""
+        actor_id = self._actor_tasks.get(oid)
+        if actor_id is None:
+            return
+        info = self._actor_info(actor_id, refresh=True)
+        if info["state"] == "DEAD":
+            self._actor_tasks.pop(oid, None)
+            raise ActorError(
+                f"actor {actor_id} died before this call completed: "
+                f"{info.get('death_cause')}"
+            )
+
+    def get(self, refs: Sequence[ObjectRef], timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        hooks = self._block_hooks
+        blocked = False
+        out = []
+        try:
+            for r in refs:
+                while True:
+                    # Local fast path (stored errors re-raise from _decode).
+                    boxed = self._read_local(r.id)
+                    if boxed is not None:
+                        out.append(boxed[0])
+                        break
+                    if hooks is not None and not blocked:
+                        hooks[0]()  # give our CPUs back while we block
+                        blocked = True
+                    loc = self.head.call("wait_location", r.id, 1.0, timeout=15.0)
+                    if loc is None:
+                        self._maybe_recover(r.id)
+                        self._check_actor_alive(r.id)
+                        if deadline is not None and time.monotonic() > deadline:
+                            raise GetTimeoutError(f"ray_tpu.get timed out on {r}")
+                        continue
+                    out.append(self._fetch_remote(r.id, loc["nodes"]))
+                    break
+                self._actor_tasks.pop(r.id, None)  # resolved; stop tracking
+        finally:
+            if blocked:
+                hooks[1]()
+        return out
+
+    def wait(self, refs, num_returns, timeout, fetch_local=True):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: list[ObjectRef] = []
+        pending = list(refs)
+        while len(ready) < num_returns:
+            for r in list(pending):
+                if self.store.contains(r.id):
+                    ready.append(r)
+                    pending.remove(r)
+                    continue
+                loc = self.head.call("locations", r.id)
+                if loc and loc["nodes"]:
+                    ready.append(r)
+                    pending.remove(r)
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready, pending
+
+    # -- task plane --------------------------------------------------------
+
+    def _strategy_info(self, options: dict) -> dict:
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+            PlacementGroupSchedulingStrategy,
+        )
+
+        strategy = options.get("scheduling_strategy")
+        info: dict[str, Any] = {
+            "strategy": strategy if isinstance(strategy, str) else None,
+            "pg_id": None,
+            "bundle_index": -1,
+            "node_affinity": None,
+        }
+        pg = options.get("placement_group")
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg = strategy.placement_group
+            info["bundle_index"] = strategy.placement_group_bundle_index
+        elif isinstance(strategy, NodeAffinitySchedulingStrategy):
+            info["node_affinity"] = strategy.node_id
+        if pg is not None:
+            info["pg_id"] = getattr(pg, "id", pg)
+            if "placement_group_bundle_index" in options:
+                info["bundle_index"] = options["placement_group_bundle_index"]
+        return info
+
+    def _choose_node(self, demand, sinfo):
+        if sinfo["pg_id"] is not None:
+            return self.head.call(
+                "pg_node_for_bundle", sinfo["pg_id"], sinfo["bundle_index"],
+                60.0, timeout=75.0,
+            )
+        return self.head.call(
+            "schedule", demand, caller_node=self.node_id,
+            strategy=sinfo["strategy"], node_affinity=sinfo["node_affinity"],
+        )
+
+    def _submit_spec(self, spec: dict):
+        placed = self._choose_node(spec["demand"], spec["sinfo"])
+        if placed is None:
+            raise ValueError(
+                f"demand {spec['demand']} is infeasible on this cluster"
+            )
+        node_id, address = placed
+        spec["assigned_node"] = node_id
+        self._node_client(address).call("submit_task", spec)
+
+    def submit_task(
+        self,
+        func: Callable,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        max_retries: int = DEFAULT_MAX_RETRIES,
+        retry_exceptions: bool | tuple = False,
+        name: str = "",
+        **options,
+    ) -> list[ObjectRef]:
+        task_id = ids.new_task_id()
+        oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
+        refs = [self.make_ref(o) for o in oids]
+        spec = {
+            "task_id": task_id,
+            "oids": oids,
+            "num_returns": num_returns,
+            "fname": name or getattr(func, "__name__", "task"),
+            "func": ser.dumps(func),
+            "args": ser.dumps((args, kwargs)),
+            "demand": demand_of(options, is_actor=False),
+            "sinfo": self._strategy_info(options),
+            "pg_id": None,
+            "bundle_index": -1,
+            "retries_left": max_retries,
+        }
+        spec["pg_id"] = spec["sinfo"]["pg_id"]
+        spec["bundle_index"] = spec["sinfo"]["bundle_index"]
+        for oid in oids:
+            self._lineage[oid] = spec
+        try:
+            self._submit_spec(spec)
+        except (ValueError, TimeoutError) as e:
+            for oid in oids:
+                self._lineage.pop(oid, None)
+                self.put_with_id(oid, TaskError(spec["fname"], str(e), repr(e)),
+                                 is_error=True)
+        return refs
+
+    # -- actor plane -------------------------------------------------------
+
+    def create_actor(
+        self,
+        cls: type,
+        args: tuple,
+        kwargs: dict,
+        *,
+        name: str | None = None,
+        max_concurrency: int = 1,
+        **options,
+    ) -> str:
+        actor_id = ids.new_actor_id()
+        spec = {
+            "actor_create": True,
+            "actor_id": actor_id,
+            "oids": [],
+            "class_name": cls.__name__,
+            "name": name,
+            "fname": f"{cls.__name__}.__init__",
+            "func": ser.dumps(cls),
+            "args": ser.dumps((args, kwargs)),
+            "demand": demand_of(options, is_actor=True),
+            "sinfo": self._strategy_info(options),
+            "retries_left": 0,
+        }
+        spec["pg_id"] = spec["sinfo"]["pg_id"]
+        spec["bundle_index"] = spec["sinfo"]["bundle_index"]
+        self._submit_spec(spec)  # raises if infeasible
+        return actor_id
+
+    def _actor_info(self, actor_id: str, refresh: bool = False) -> dict:
+        with self._lock:
+            info = self._actor_cache.get(actor_id)
+        if info is None or refresh or info["state"] == "DEAD":
+            info = self.head.call("get_actor", actor_id, 30.0, timeout=45.0)
+            if info is None:
+                raise ValueError(f"no such actor: {actor_id}")
+            with self._lock:
+                self._actor_cache[actor_id] = info
+        return info
+
+    def submit_actor_task(
+        self,
+        actor_id: str,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        **_options,
+    ) -> list[ObjectRef]:
+        task_id = ids.new_task_id()
+        oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
+        refs = [self.make_ref(o) for o in oids]
+        spec = {
+            "actor_id": actor_id,
+            "method": method_name,
+            "oids": oids,
+            "num_returns": num_returns,
+            "args": ser.dumps((args, kwargs)),
+        }
+        try:
+            info = self._actor_info(actor_id)
+            if info["state"] == "DEAD":
+                raise ActorError(
+                    f"actor {actor_id} is dead: {info['death_cause']}"
+                )
+            self._worker_client(info["address"]).call("push_actor_task", spec)
+            for oid in oids:
+                self._actor_tasks[oid] = actor_id
+        except ActorError as e:
+            for oid in oids:
+                self.put_with_id(oid, e, is_error=True)
+        except (ConnectionLost, OSError):
+            info = self._actor_info(actor_id, refresh=True)
+            err = ActorError(
+                f"actor {actor_id} is dead: "
+                f"{info.get('death_cause') or 'connection lost'}"
+            )
+            for oid in oids:
+                self.put_with_id(oid, err, is_error=True)
+        return refs
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        info = self._actor_info(actor_id, refresh=True)
+        if info["state"] == "DEAD":
+            return
+        nodes = {n["NodeID"]: n for n in self.head.call("nodes")}
+        node = nodes.get(info["node_id"])
+        if node is None or not node["Alive"]:
+            return
+        try:
+            self._node_client(node["Address"]).call("kill_actor", actor_id)
+        except (ConnectionLost, OSError):
+            pass
+
+    def get_named_actor(self, name: str) -> str:
+        info = self.head.call("get_named_actor", name)
+        if info is None or info["state"] == "DEAD":
+            raise ValueError(f"no actor named {name!r}")
+        with self._lock:
+            self._actor_cache[info["actor_id"]] = info
+        return info["actor_id"]
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        pass  # best-effort no-op, matching the local backend
+
+    # -- placement groups --------------------------------------------------
+
+    def create_placement_group(self, bundles, strategy, name="", lifetime=None):
+        return self.head.call(
+            "create_placement_group", bundles, strategy, name, lifetime
+        )
+
+    def remove_placement_group(self, pg_id: str) -> None:
+        self.head.call("remove_placement_group", pg_id)
+
+    def placement_group_table(self, pg_id=None):
+        table = self.head.call("placement_group_table", pg_id)
+        if table is None:
+            return None
+        if pg_id is not None:
+            return {**table, "state": table["state"]}
+        return table
+
+    def placement_group_ready(self, pg_id: str) -> ObjectRef:
+        oid = ids.new_object_id()
+        ref = self.make_ref(oid)
+
+        def waiter():
+            deadline = time.monotonic() + 300.0
+            while time.monotonic() < deadline:
+                table = self.head.call("placement_group_table", pg_id)
+                if table is None:
+                    break
+                if table["state"] == "CREATED":
+                    self.put_with_id(oid, pg_id)
+                    return
+                if table["state"] in ("INFEASIBLE", "REMOVED", "DEAD"):
+                    break
+                time.sleep(0.02)
+            self.put_with_id(
+                oid,
+                ValueError(f"placement group {pg_id} cannot become ready"),
+                is_error=True,
+            )
+
+        threading.Thread(target=waiter, daemon=True).start()
+        return ref
+
+    def current_placement_group(self):
+        return None  # capture is a local-backend feature for now
+
+    # -- introspection / lifecycle ----------------------------------------
+
+    def cluster_resources(self) -> dict:
+        return self.head.call("cluster_resources")
+
+    def available_resources(self) -> dict:
+        return self.head.call("available_resources")
+
+    def nodes(self) -> list[dict]:
+        return self.head.call("nodes")
+
+    def shutdown(self) -> None:
+        """Disconnect this client (the cluster keeps running; use
+        Cluster.shutdown / shutdown_cluster to tear it down)."""
+        with self._lock:
+            clients = (
+                list(self._node_clients.values())
+                + list(self._worker_clients.values())
+            )
+            self._node_clients.clear()
+            self._worker_clients.clear()
+        for c in clients:
+            c.close()
+        self._pins.clear()
+        self.store.close()
+        self.head.close()
+
+
+def connect(address: str, **kwargs) -> ClusterBackend:
+    """Backend factory for ``ray_tpu.init(address="host:port")``."""
+    address = address.removeprefix("ray://").removeprefix("tcp://")
+    return ClusterBackend(address)

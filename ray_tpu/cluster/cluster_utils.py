@@ -1,0 +1,92 @@
+"""Cluster: N simulated nodes on one host, for tests and local multi-node.
+
+Reference parity: ``python/ray/cluster_utils.py:99`` — ``Cluster`` /
+``add_node`` start real node agents (with their own node ids, resource
+views, and shm store segments) as in-process servers + worker subprocesses,
+which is exactly how the reference tests distributed behavior without
+machines (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ray_tpu.cluster.head import HeadServer
+from ray_tpu.cluster.node_agent import NodeAgent
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True, head_node_args: dict | None = None):
+        self.head: HeadServer | None = None
+        self.nodes: list[NodeAgent] = []
+        self.session = f"c{os.getpid()}_{os.urandom(3).hex()}"
+        if initialize_head:
+            self.head = HeadServer()
+            if head_node_args is not None:
+                self.add_node(**head_node_args)
+
+    @property
+    def address(self) -> str:
+        assert self.head is not None
+        return self.head.address
+
+    def add_node(self, *, num_cpus: float | None = None,
+                 resources: dict | None = None,
+                 store_capacity: int | None = None) -> NodeAgent:
+        assert self.head is not None, "head not initialized"
+        kwargs = {}
+        if store_capacity is not None:
+            kwargs["store_capacity"] = store_capacity
+        node = NodeAgent(
+            self.head.address,
+            num_cpus=num_cpus,
+            resources=resources,
+            session=self.session,
+            **kwargs,
+        )
+        self.nodes.append(node)
+        return node
+
+    def remove_node(self, node: NodeAgent, graceful: bool = True):
+        if graceful and self.head is not None:
+            try:
+                self.head._mark_dead(node.node_id, "removed")
+            except Exception:
+                pass
+        node.stop()
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def kill_node(self, node: NodeAgent):
+        """Ungraceful: stop heartbeats + kill workers; the head discovers
+        the death via heartbeat timeout (chaos-test path)."""
+        node._shutdown.set()
+        for w in list(node._workers.values()):
+            if w.proc.poll() is None:
+                w.proc.kill()
+        node._server.stop()
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def wait_for_nodes(self, timeout: float = 10.0) -> None:
+        assert self.head is not None
+        deadline = time.monotonic() + timeout
+        want = len(self.nodes)
+        while time.monotonic() < deadline:
+            alive = [n for n in self.head.rpc_nodes() if n["Alive"]]
+            if len(alive) >= want:
+                return
+            time.sleep(0.02)
+        raise TimeoutError(f"cluster did not reach {want} nodes")
+
+    def shutdown(self):
+        for node in list(self.nodes):
+            try:
+                node.stop()
+            except Exception:
+                pass
+        self.nodes.clear()
+        if self.head is not None:
+            self.head.stop()
+            self.head = None

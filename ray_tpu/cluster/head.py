@@ -1,0 +1,569 @@
+"""Head server: the cluster control plane (GCS equivalent).
+
+Mirrors the managers booted by the reference GCS
+(``src/ray/gcs/gcs_server/gcs_server.cc:119-166``): node manager +
+heartbeats, internal KV, actor directory, placement groups with 2-phase
+commit across node agents (``gcs_placement_group_scheduler.h:265,423``),
+plus the cluster-wide scheduler view. The object directory lives here too
+(the reference resolves locations from owners; a central directory is the
+simpler equivalent at this scale — the protocol shape toward clients is the
+same: "where is object X / tell me when it exists").
+
+Scheduling policy: hybrid — prefer the caller's node until it cannot fit
+the demand, then best-fit over the cluster view
+(``hybrid_scheduling_policy.cc:26``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from ray_tpu.cluster.rpc import RpcClient, RpcServer
+from ray_tpu.core import ids
+
+DEAD_AFTER_S = 5.0  # heartbeat timeout (reference: num_heartbeats_timeout)
+
+
+class NodeInfo:
+    def __init__(self, node_id, address, resources, store_path):
+        self.node_id = node_id
+        self.address = address
+        self.resources = dict(resources)  # total
+        self.available = dict(resources)  # latest reported view
+        self.store_path = store_path
+        self.last_heartbeat = time.monotonic()
+        self.alive = True
+        self.client = RpcClient(address)
+
+
+class HeadServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.RLock()
+        self._nodes: dict[str, NodeInfo] = {}
+        self._kv: dict[str, Any] = {}
+        # object directory: oid -> {"nodes": set, "error": bool}
+        self._objects: dict[str, dict] = {}
+        self._objects_cv = threading.Condition(self._lock)
+        # actor directory: actor_id -> info dict
+        self._actors: dict[str, dict] = {}
+        self._named_actors: dict[str, str] = {}
+        self._actors_cv = threading.Condition(self._lock)
+        self._pgs: dict[str, dict] = {}
+        self._rr_counter = 0
+        self._server = RpcServer(self, host, port)
+        self.address = self._server.address
+        self._stop = threading.Event()
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._monitor.start()
+
+    # -- nodes ------------------------------------------------------------
+
+    def rpc_register_node(self, node_id, address, resources, store_path):
+        with self._lock:
+            self._nodes[node_id] = NodeInfo(node_id, address, resources, store_path)
+        return {"head_time": time.time()}
+
+    def rpc_heartbeat(self, node_id, available):
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                return {"ok": False}  # node was declared dead; it must exit
+            node.last_heartbeat = time.monotonic()
+            node.available = dict(available)
+            return {"ok": True}
+
+    def rpc_drain_node(self, node_id):
+        """Graceful removal (cluster_utils.remove_node)."""
+        self._mark_dead(node_id, "drained")
+        return True
+
+    def rpc_nodes(self):
+        with self._lock:
+            return [
+                {
+                    "NodeID": n.node_id,
+                    "Alive": n.alive,
+                    "Address": n.address,
+                    "Resources": dict(n.resources),
+                    "Available": dict(n.available),
+                    "StorePath": n.store_path,
+                }
+                for n in self._nodes.values()
+            ]
+
+    def rpc_cluster_resources(self):
+        with self._lock:
+            total: dict[str, float] = {}
+            for n in self._nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.resources.items():
+                    total[k] = total.get(k, 0.0) + v
+            return total
+
+    def rpc_available_resources(self):
+        with self._lock:
+            total: dict[str, float] = {}
+            for n in self._nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.available.items():
+                    total[k] = total.get(k, 0.0) + v
+            return total
+
+    def _monitor_loop(self):
+        while not self._stop.wait(0.25):
+            now = time.monotonic()
+            dead = []
+            with self._lock:
+                for n in self._nodes.values():
+                    if n.alive and now - n.last_heartbeat > DEAD_AFTER_S:
+                        dead.append(n.node_id)
+            for node_id in dead:
+                self._mark_dead(node_id, "heartbeat timeout")
+
+    def _mark_dead(self, node_id: str, cause: str):
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+            node.alive = False
+            # Fail actors living on the node (GcsActorManager::OnNodeDead).
+            for info in self._actors.values():
+                if info["node_id"] == node_id and info["state"] != "DEAD":
+                    info["state"] = "DEAD"
+                    info["death_cause"] = f"node {node_id} died: {cause}"
+            # Drop its object locations; lineage re-execution is the
+            # client's job (object_recovery_manager.h:41 analog).
+            for entry in self._objects.values():
+                entry["nodes"].discard(node_id)
+            # Placement groups with bundles there become DEAD (rescheduling
+            # PGs is round-2 work; Train-level elasticity handles restarts).
+            for pg in self._pgs.values():
+                if pg["state"] == "CREATED" and any(
+                    nid == node_id for nid, _ in pg["placement"]
+                ):
+                    pg["state"] = "DEAD"
+            self._actors_cv.notify_all()
+            self._objects_cv.notify_all()
+
+    # -- KV ---------------------------------------------------------------
+
+    def rpc_kv_put(self, key, value, overwrite=True):
+        with self._lock:
+            if not overwrite and key in self._kv:
+                return False
+            self._kv[key] = value
+            return True
+
+    def rpc_kv_get(self, key):
+        with self._lock:
+            return self._kv.get(key)
+
+    def rpc_kv_del(self, key):
+        with self._lock:
+            return self._kv.pop(key, None) is not None
+
+    def rpc_kv_keys(self, prefix=""):
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+    # -- object directory -------------------------------------------------
+
+    def rpc_add_location(self, oid, node_id, is_error=False, size=0):
+        with self._lock:
+            entry = self._objects.setdefault(
+                oid, {"nodes": set(), "error": False, "size": 0}
+            )
+            entry["nodes"].add(node_id)
+            entry["error"] = entry["error"] or is_error
+            entry["size"] = max(entry["size"], size)
+            self._objects_cv.notify_all()
+        return True
+
+    def rpc_remove_location(self, oid, node_id):
+        with self._lock:
+            entry = self._objects.get(oid)
+            if entry:
+                entry["nodes"].discard(node_id)
+                if not entry["nodes"]:
+                    del self._objects[oid]
+        return True
+
+    def rpc_wait_location(self, oid, timeout=None):
+        """Block until the object exists somewhere; returns
+        {"nodes": [...], "error": bool} or None on timeout. The long-poll
+        analog of GetObjectStatus."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                entry = self._objects.get(oid)
+                if entry and entry["nodes"]:
+                    node_ids = [
+                        nid
+                        for nid in entry["nodes"]
+                        if self._nodes.get(nid) and self._nodes[nid].alive
+                    ]
+                    if node_ids:
+                        return {
+                            "nodes": [
+                                (nid, self._nodes[nid].address,
+                                 self._nodes[nid].store_path)
+                                for nid in node_ids
+                            ],
+                            "error": entry["error"],
+                        }
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._objects_cv.wait(remaining if remaining is None else min(remaining, 1.0))
+
+    def rpc_locations(self, oid):
+        with self._lock:
+            entry = self._objects.get(oid)
+            if not entry:
+                return None
+            return {
+                "nodes": [
+                    (nid, self._nodes[nid].address, self._nodes[nid].store_path)
+                    for nid in entry["nodes"]
+                    if self._nodes.get(nid) and self._nodes[nid].alive
+                ],
+                "error": entry["error"],
+            }
+
+    # -- actor directory --------------------------------------------------
+
+    def rpc_register_actor(
+        self, actor_id, node_id, worker_address, class_name, name=None
+    ):
+        with self._lock:
+            if name:
+                existing = self._named_actors.get(name)
+                if existing is not None and self._actors[existing]["state"] != "DEAD":
+                    raise ValueError(f"actor name {name!r} already taken")
+                self._named_actors[name] = actor_id
+            self._actors[actor_id] = {
+                "actor_id": actor_id,
+                "node_id": node_id,
+                "address": worker_address,
+                "class_name": class_name,
+                "name": name,
+                "state": "ALIVE",
+                "death_cause": None,
+            }
+            self._actors_cv.notify_all()
+        return True
+
+    def rpc_get_actor(self, actor_id, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                info = self._actors.get(actor_id)
+                if info is not None:
+                    return dict(info)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._actors_cv.wait(min(remaining, 1.0))
+
+    def rpc_get_named_actor(self, name):
+        with self._lock:
+            actor_id = self._named_actors.get(name)
+            if actor_id is None:
+                return None
+            return dict(self._actors[actor_id])
+
+    def rpc_mark_actor_dead(self, actor_id, cause):
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is not None and info["state"] != "DEAD":
+                info["state"] = "DEAD"
+                info["death_cause"] = cause
+                name = info.get("name")
+                if name and self._named_actors.get(name) == actor_id:
+                    del self._named_actors[name]
+        return True
+
+    def rpc_list_actors(self):
+        with self._lock:
+            return [dict(v) for v in self._actors.values()]
+
+    # -- scheduling -------------------------------------------------------
+
+    def rpc_schedule(self, demand, caller_node=None, strategy=None,
+                     node_affinity=None):
+        """Pick a node for a task/actor; returns (node_id, address) or None
+        if no alive node can ever fit the demand."""
+        with self._lock:
+            alive = [n for n in self._nodes.values() if n.alive]
+            if node_affinity is not None:
+                node = self._nodes.get(node_affinity)
+                if node is not None and node.alive:
+                    return node.node_id, node.address
+                return None
+            feasible = [
+                n
+                for n in alive
+                if all(n.resources.get(k, 0.0) >= v for k, v in demand.items())
+            ]
+            if not feasible:
+                return None
+
+            def headroom(n: NodeInfo) -> float:
+                return min(
+                    (n.available.get(k, 0.0) - v for k, v in demand.items()),
+                    default=1.0,
+                )
+
+            if strategy == "SPREAD":
+                self._rr_counter += 1
+                return self._pick(feasible[self._rr_counter % len(feasible)])
+            # Hybrid: prefer caller's node while it has headroom.
+            if caller_node is not None:
+                local = self._nodes.get(caller_node)
+                if local is not None and local.alive and local in feasible:
+                    if headroom(local) >= 0:
+                        return self._pick(local)
+            best = max(feasible, key=headroom)
+            return self._pick(best)
+
+    def _pick(self, node: NodeInfo):
+        # Optimistically debit the view so bursts spread before the next
+        # heartbeat refreshes truth (the raylet remains authoritative).
+        return node.node_id, node.address
+
+    # -- placement groups (2-phase commit) --------------------------------
+
+    def rpc_create_placement_group(self, bundles, strategy, name="", lifetime=None):
+        pg_id = ids.new_placement_group_id()
+        with self._lock:
+            self._pgs[pg_id] = {
+                "placement_group_id": pg_id,
+                "bundles": bundles,
+                "strategy": strategy,
+                "name": name,
+                "state": "PENDING",
+                "placement": [],  # [(node_id, bundle_index)]
+            }
+        threading.Thread(
+            target=self._reserve_pg, args=(pg_id,), daemon=True
+        ).start()
+        return pg_id
+
+    def _pg_assign(self, bundles, strategy) -> Optional[list]:
+        """Choose a node per bundle against total capacities."""
+        with self._lock:
+            alive = [n for n in self._nodes.values() if n.alive]
+        if not alive:
+            return None
+        # Track what this PG adds per node to respect totals.
+        planned: dict[str, dict[str, float]] = {n.node_id: {} for n in alive}
+
+        def fits(n: NodeInfo, b: dict) -> bool:
+            add = planned[n.node_id]
+            return all(
+                n.resources.get(k, 0.0) >= add.get(k, 0.0) + v
+                for k, v in b.items()
+            )
+
+        def commit(n: NodeInfo, b: dict):
+            add = planned[n.node_id]
+            for k, v in b.items():
+                add[k] = add.get(k, 0.0) + v
+
+        assignment: list[tuple[str, int]] = []
+        if strategy in ("PACK", "STRICT_PACK"):
+            order = sorted(alive, key=lambda n: -sum(n.resources.values()))
+            for i, b in enumerate(bundles):
+                for n in (order if strategy == "PACK" else order[:1]):
+                    if fits(n, b):
+                        commit(n, b)
+                        assignment.append((n.node_id, i))
+                        break
+                else:
+                    return None
+            if strategy == "STRICT_PACK" and len({a[0] for a in assignment}) > 1:
+                return None
+        elif strategy in ("SPREAD", "STRICT_SPREAD"):
+            used: set[str] = set()
+            for i, b in enumerate(bundles):
+                ranked = sorted(
+                    alive,
+                    key=lambda n: (n.node_id in used, -sum(n.resources.values())),
+                )
+                placed = False
+                for n in ranked:
+                    if strategy == "STRICT_SPREAD" and n.node_id in used:
+                        continue
+                    if fits(n, b):
+                        commit(n, b)
+                        used.add(n.node_id)
+                        assignment.append((n.node_id, i))
+                        placed = True
+                        break
+                if not placed:
+                    return None
+        else:
+            return None
+        return assignment
+
+    def _reserve_pg(self, pg_id: str):
+        with self._lock:
+            pg = self._pgs[pg_id]
+            bundles, strategy = pg["bundles"], pg["strategy"]
+        assignment = self._pg_assign(bundles, strategy)
+        if assignment is None:
+            with self._lock:
+                pg["state"] = "INFEASIBLE"
+            return
+        # Phase 1: prepare every bundle on its node (blocking until the
+        # node can reserve it); phase 2: commit. Rollback on any failure.
+        prepared: list[tuple[str, int]] = []
+        ok = True
+        for node_id, bundle_index in assignment:
+            with self._lock:
+                node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                ok = False
+                break
+            try:
+                node.client.call(
+                    "prepare_bundle", pg_id, bundle_index,
+                    bundles[bundle_index], timeout=120.0,
+                )
+                prepared.append((node_id, bundle_index))
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for node_id, bundle_index in prepared:
+                with self._lock:
+                    node = self._nodes.get(node_id)
+                if node is not None:
+                    try:
+                        node.client.call("return_bundle", pg_id, bundle_index)
+                    except Exception:
+                        pass
+            with self._lock:
+                pg["state"] = "INFEASIBLE"
+            return
+        for node_id, bundle_index in assignment:
+            with self._lock:
+                node = self._nodes.get(node_id)
+            try:
+                node.client.call("commit_bundle", pg_id, bundle_index)
+            except Exception:
+                pass
+        rollback = False
+        with self._lock:
+            if pg["state"] == "REMOVED":
+                # Removed while we were reserving: give everything back
+                # instead of resurrecting the group.
+                rollback = True
+            else:
+                pg["placement"] = assignment
+                pg["state"] = "CREATED"
+        if rollback:
+            for node_id, bundle_index in assignment:
+                with self._lock:
+                    node = self._nodes.get(node_id)
+                if node is not None and node.alive:
+                    try:
+                        node.client.call("return_bundle", pg_id, bundle_index)
+                    except Exception:
+                        pass
+
+    def rpc_remove_placement_group(self, pg_id):
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None:
+                return False
+            prev, pg["state"] = pg["state"], "REMOVED"
+            placement = list(pg["placement"])
+        if prev == "CREATED":
+            for node_id, bundle_index in placement:
+                with self._lock:
+                    node = self._nodes.get(node_id)
+                if node is not None and node.alive:
+                    try:
+                        node.client.call("return_bundle", pg_id, bundle_index)
+                    except Exception:
+                        pass
+        return True
+
+    def rpc_placement_group_table(self, pg_id=None):
+        with self._lock:
+            if pg_id is not None:
+                pg = self._pgs.get(pg_id)
+                return dict(pg, placement=list(pg["placement"])) if pg else None
+            return {k: dict(v, placement=list(v["placement"]))
+                    for k, v in self._pgs.items()}
+
+    def rpc_pg_node_for_bundle(self, pg_id, bundle_index, timeout=30.0):
+        """Blocking: node that holds the given bundle (or any, if -1)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+                if pg is None:
+                    raise ValueError(f"no such placement group {pg_id}")
+                if pg["state"] == "INFEASIBLE":
+                    raise ValueError(f"placement group {pg_id} is infeasible")
+                if pg["state"] == "REMOVED":
+                    raise ValueError(f"placement group {pg_id} was removed")
+                if pg["state"] == "CREATED":
+                    for node_id, bi in pg["placement"]:
+                        if bundle_index < 0 or bi == bundle_index:
+                            node = self._nodes.get(node_id)
+                            if node and node.alive:
+                                return node_id, node.address
+                    raise ValueError(
+                        f"bundle {bundle_index} of {pg_id} has no live node"
+                    )
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"placement group {pg_id} not ready")
+            time.sleep(0.02)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def rpc_ping(self):
+        return "pong"
+
+    def rpc_shutdown_cluster(self):
+        with self._lock:
+            nodes = [n for n in self._nodes.values() if n.alive]
+        for n in nodes:
+            try:
+                n.client.call("shutdown_node", timeout=5.0)
+            except Exception:
+                pass
+        return True
+
+    def stop(self):
+        self._stop.set()
+        self._server.stop()
+
+
+def main():
+    import argparse
+    import signal
+    import sys
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+    head = HeadServer(args.host, args.port)
+    print(f"HEAD_ADDRESS={head.address}", flush=True)
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+    head.stop()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

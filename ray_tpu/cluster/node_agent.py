@@ -1,0 +1,489 @@
+"""Node agent: per-node daemon (raylet equivalent).
+
+Mirrors ``src/ray/raylet/node_manager.h``: owns the node's resources and
+worker processes. Implements:
+
+  * worker pool — forked Python worker processes, cached when idle
+    (``worker_pool.h:80``); a dead worker's in-flight task is failed by
+    storing an error object (the owner then retries);
+  * local task dispatch — FIFO queue + blocking resource acquisition, the
+    LocalTaskManager analog;
+  * placement-group bundle 2PC participant — prepare/commit/return
+    (``node_manager.proto:375`` PrepareBundleResources/CommitBundleResources);
+  * local object store — creates this node's C++ shm segment and serves
+    object bytes to peer nodes (``ObjectManager::Push`` analog, pull-based);
+  * heartbeats to the head with the live resource view
+    (``gcs_heartbeat_manager.h``).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+from ray_tpu._native.shm_store import ShmStore
+from ray_tpu.cluster.rpc import RpcClient, RpcServer
+from ray_tpu.core import ids
+from ray_tpu.core.resources import ResourcePool
+
+DEFAULT_STORE_CAPACITY = 512 << 20
+
+
+class _Worker:
+    def __init__(self, worker_id, proc, address=None):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.address = address
+        self.client: Optional[RpcClient] = None
+        self.ready = threading.Event()
+        self.current_task = None  # (task_spec, release_fn) while executing
+        self.is_actor = False
+        self.actor_id = None
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        head_address: str,
+        *,
+        num_cpus: float | None = None,
+        resources: dict | None = None,
+        store_capacity: int = DEFAULT_STORE_CAPACITY,
+        host: str = "127.0.0.1",
+        session: str | None = None,
+    ):
+        self.node_id = ids.new_node_id()
+        self.head_address = head_address
+        self.head = RpcClient(head_address)
+        node_res = {"CPU": float(num_cpus if num_cpus is not None else os.cpu_count() or 8)}
+        node_res.update(resources or {})
+        self.pool = ResourcePool(node_res)
+        self.total_resources = dict(node_res)
+        session = session or f"s{os.getpid()}"
+        self.store_path = f"/dev/shm/ray_tpu_{session}_{self.node_id[-8:]}"
+        self.store = ShmStore(self.store_path, store_capacity, create=True)
+
+        self._lock = threading.RLock()
+        self._workers: dict[str, _Worker] = {}
+        self._idle: list[_Worker] = []
+        self._max_workers = max(4, int(node_res.get("CPU", 4)) * 4)
+        self._bundles: dict[tuple, ResourcePool] = {}
+        self._bundle_state: dict[tuple, str] = {}  # PREPARED | COMMITTED
+        self._task_queue: list[dict] = []
+        self._queue_cv = threading.Condition(self._lock)
+        self._shutdown = threading.Event()
+
+        self._server = RpcServer(self, host)
+        self.address = self._server.address
+        self.head.call(
+            "register_node", self.node_id, self.address,
+            self.total_resources, self.store_path,
+        )
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+        threading.Thread(target=self._dispatch_loop, daemon=True).start()
+        threading.Thread(target=self._reap_loop, daemon=True).start()
+
+    # -- worker pool ------------------------------------------------------
+
+    def _spawn_worker(self) -> _Worker:
+        worker_id = "w-" + os.urandom(6).hex()
+        env = dict(os.environ)
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu.cluster.workerproc",
+                "--head", self.head_address,
+                "--agent", self.address,
+                "--node-id", self.node_id,
+                "--store", self.store_path,
+                "--worker-id", worker_id,
+            ],
+            env=env,
+            stdout=sys.stdout.fileno() if hasattr(sys.stdout, "fileno") else None,
+            stderr=sys.stderr.fileno() if hasattr(sys.stderr, "fileno") else None,
+        )
+        w = _Worker(worker_id, proc)
+        with self._lock:
+            self._workers[worker_id] = w
+        return w
+
+    def rpc_register_worker(self, worker_id, address):
+        with self._lock:
+            w = self._workers.get(worker_id)
+            if w is None:
+                return False
+            w.address = address
+            w.client = RpcClient(address)
+            w.ready.set()
+        return True
+
+    def _checkout_worker(self, timeout: float = 60.0) -> _Worker:
+        """Idle worker or a fresh one (lease grant, ``PopWorker`` analog)."""
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+            n_live = len([w for w in self._workers.values()
+                          if w.proc.poll() is None])
+            can_spawn = n_live < self._max_workers
+        if can_spawn:
+            w = self._spawn_worker()
+        else:
+            # Wait for an idle worker.
+            deadline = time.monotonic() + timeout
+            while True:
+                with self._lock:
+                    if self._idle:
+                        w = self._idle.pop()
+                        break
+                if time.monotonic() > deadline:
+                    raise TimeoutError("no worker became available")
+                time.sleep(0.005)
+        if not w.ready.wait(timeout):
+            raise TimeoutError(f"worker {w.worker_id} failed to start")
+        return w
+
+    def _return_worker(self, w: _Worker):
+        with self._lock:
+            if w.proc.poll() is None and not w.is_actor:
+                w.current_task = None
+                self._idle.append(w)
+
+    # -- task dispatch ----------------------------------------------------
+
+    def rpc_submit_task(self, spec: dict):
+        """Enqueue a task; the dispatcher leases a worker when resources
+        allow. Returns immediately (results flow through the store)."""
+        with self._queue_cv:
+            self._task_queue.append(spec)
+            self._queue_cv.notify()
+        return True
+
+    def _dispatch_loop(self):
+        while not self._shutdown.is_set():
+            with self._queue_cv:
+                while not self._task_queue and not self._shutdown.is_set():
+                    self._queue_cv.wait(0.5)
+                if self._shutdown.is_set():
+                    return
+                spec = self._task_queue.pop(0)
+            threading.Thread(
+                target=self._dispatch_one, args=(spec,), daemon=True
+            ).start()
+
+    def _bundle_pool(self, spec) -> Optional[ResourcePool]:
+        pg_id, idx = spec.get("pg_id"), spec.get("bundle_index", -1)
+        if pg_id is None:
+            return None
+        with self._lock:
+            if idx >= 0:
+                return self._bundles.get((pg_id, idx))
+            for (p, _i), pool in self._bundles.items():
+                if p == pg_id and pool.feasible(spec.get("demand", {})):
+                    return pool
+        return None
+
+    def _dispatch_one(self, spec: dict):
+        demand = spec.get("demand", {})
+        pool = self.pool
+        if spec.get("pg_id") is not None:
+            deadline = time.monotonic() + 60.0
+            while True:
+                bp = self._bundle_pool(spec)
+                if bp is not None and bp.try_acquire(demand):
+                    pool = bp
+                    acquired = True
+                    break
+                if time.monotonic() > deadline:
+                    self._fail_task(spec, "placement group bundle unavailable")
+                    return
+                time.sleep(0.01)
+        else:
+            acquired = pool.acquire(demand, timeout=300.0)
+        if not acquired:
+            self._fail_task(spec, f"resources {demand} unavailable")
+            return
+        try:
+            w = self._checkout_worker()
+        except TimeoutError as e:
+            pool.release(demand)
+            self._fail_task(spec, str(e))
+            return
+        w.current_task = {
+            "spec": spec, "pool": pool, "demand": demand, "released": False,
+        }
+        try:
+            if spec.get("actor_create"):
+                w.is_actor = True
+                w.actor_id = spec["actor_id"]
+                w.client.call("create_actor", spec)
+                try:
+                    self.head.call(
+                        "register_actor", spec["actor_id"], self.node_id,
+                        w.address, spec.get("class_name", "Actor"),
+                        spec.get("name"),
+                    )
+                except ValueError as e:
+                    # Name conflict: the actor loses the race but the worker
+                    # is healthy. Register it unnamed + dead so callers fail
+                    # fast, and recycle the worker.
+                    self._release_current(w)
+                    w.is_actor = False
+                    w.actor_id = None
+                    try:
+                        self.head.call(
+                            "register_actor", spec["actor_id"], self.node_id,
+                            w.address, spec.get("class_name", "Actor"), None,
+                        )
+                        self.head.call(
+                            "mark_actor_dead", spec["actor_id"], str(e)
+                        )
+                    except Exception:
+                        pass
+                    # The worker already constructed actor state; retire it.
+                    w.proc.kill()
+            else:
+                w.client.call("push_task", spec)
+        except Exception as e:  # worker died between checkout and push
+            self._release_current(w)
+            self._on_worker_failure(w, f"dispatch failed: {e}")
+
+    @staticmethod
+    def _release_current(w: _Worker):
+        current = w.current_task
+        if current is not None and not current["released"]:
+            current["released"] = True
+            current["pool"].release(current["demand"])
+
+    def rpc_task_done(self, worker_id):
+        """Worker finished its current task; release + return to pool."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+        if w is None:
+            return False
+        self._release_current(w)
+        self._return_worker(w)
+        return True
+
+    def rpc_task_blocked(self, worker_id):
+        """The worker's task is blocked in get(): free its resources so
+        other (possibly nested) tasks can run (raylet parity for workers
+        blocked in ray.get)."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+        if w is not None:
+            self._release_current(w)
+        return True
+
+    def rpc_task_unblocked(self, worker_id):
+        with self._lock:
+            w = self._workers.get(worker_id)
+        if w is None or w.current_task is None:
+            return False
+        current = w.current_task
+        if current["released"]:
+            current["pool"].acquire(current["demand"], timeout=300.0)
+            current["released"] = False
+        return True
+
+    def _fail_task(self, spec: dict, reason: str):
+        from ray_tpu.core.object_ref import TaskError
+        from ray_tpu.core import serialization as ser
+
+        err = TaskError(spec.get("fname", "task"), reason, reason)
+        meta, chunks = ser.serialize(err)
+        for oid in spec["oids"]:
+            try:
+                self.store.put(oid, chunks, b"E" + meta)
+            except Exception:
+                continue
+            self.head.call("add_location", oid, self.node_id, is_error=True)
+
+    def _on_worker_failure(self, w: _Worker, cause: str):
+        with self._lock:
+            self._workers.pop(w.worker_id, None)
+            if w in self._idle:
+                self._idle.remove(w)
+            current = w.current_task
+            w.current_task = None
+        if w.proc.poll() is None:
+            w.proc.kill()
+        if w.is_actor and w.actor_id:
+            try:
+                self.head.call("mark_actor_dead", w.actor_id, cause)
+            except Exception:
+                pass
+        if current is not None:
+            if not current["released"]:
+                current["released"] = True
+                current["pool"].release(current["demand"])
+            spec = current["spec"]
+            if not spec.get("actor_create"):
+                self._fail_task(spec, f"worker died: {cause}")
+
+    def _reap_loop(self):
+        """Detect dead worker processes (WorkerPool's disconnect handling)."""
+        while not self._shutdown.wait(0.2):
+            with self._lock:
+                dead = [
+                    w for w in self._workers.values() if w.proc.poll() is not None
+                ]
+            for w in dead:
+                self._on_worker_failure(
+                    w, f"exit code {w.proc.returncode}"
+                )
+
+    # -- actors -----------------------------------------------------------
+
+    def rpc_kill_actor(self, actor_id):
+        with self._lock:
+            target = next(
+                (w for w in self._workers.values() if w.actor_id == actor_id),
+                None,
+            )
+        if target is None:
+            return False
+        try:
+            self.head.call("mark_actor_dead", actor_id,
+                           "killed via ray_tpu.kill")
+        except Exception:
+            pass
+        target.is_actor = False  # already marked dead; avoid double-marking
+        target.actor_id = None
+        target.proc.kill()
+        return True
+
+    def rpc_actor_ctor_failed(self, actor_id, cause):
+        try:
+            self.head.call("mark_actor_dead", actor_id, cause)
+        except Exception:
+            pass
+        return True
+
+    # -- placement group bundles (2PC participant) ------------------------
+
+    def rpc_prepare_bundle(self, pg_id, bundle_index, bundle):
+        if not self.pool.feasible(bundle):
+            raise ValueError(f"bundle {bundle} infeasible on node {self.node_id}")
+        if not self.pool.acquire(bundle, timeout=60.0):
+            raise TimeoutError(f"bundle {bundle} not reservable on {self.node_id}")
+        with self._lock:
+            self._bundles[(pg_id, bundle_index)] = ResourcePool(bundle)
+            self._bundle_state[(pg_id, bundle_index)] = "PREPARED"
+        return True
+
+    def rpc_commit_bundle(self, pg_id, bundle_index):
+        with self._lock:
+            self._bundle_state[(pg_id, bundle_index)] = "COMMITTED"
+        return True
+
+    def rpc_return_bundle(self, pg_id, bundle_index):
+        with self._lock:
+            pool = self._bundles.pop((pg_id, bundle_index), None)
+            self._bundle_state.pop((pg_id, bundle_index), None)
+        if pool is not None:
+            # Give back what is currently free; in-flight tasks' releases
+            # drain into their (now orphaned) bundle pool — accounted as
+            # still-used until the task ends, then lost with the pool, so
+            # over-release cannot happen.
+            self.pool.release(pool.available())
+        return True
+
+    # -- object serving ---------------------------------------------------
+
+    def rpc_fetch_object(self, oid):
+        """Serve an object's (meta, data) to a peer (push analog)."""
+        got = self.store.get(oid)
+        if got is None:
+            return None
+        data, meta = got
+        try:
+            return meta, bytes(data)
+        finally:
+            self.store.release(oid)
+
+    def rpc_delete_object(self, oid):
+        self.store.delete(oid)
+        try:
+            self.head.call("remove_location", oid, self.node_id)
+        except Exception:
+            pass
+        return True
+
+    def rpc_store_stats(self):
+        return self.store.stats()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def _heartbeat_loop(self):
+        while not self._shutdown.wait(0.25):
+            try:
+                resp = self.head.call(
+                    "heartbeat", self.node_id, self.pool.available(),
+                    timeout=5.0,
+                )
+                if not resp.get("ok"):
+                    # Head declared us dead: actually exit (kill workers,
+                    # stop serving) instead of running on as a zombie node.
+                    self.stop()
+                    return
+            except Exception:
+                continue
+
+    def rpc_ping(self):
+        return "pong"
+
+    def rpc_shutdown_node(self):
+        threading.Thread(target=self.stop, daemon=True).start()
+        return True
+
+    def stop(self):
+        with self._lock:
+            if getattr(self, "_stopped", False):
+                return
+            self._stopped = True
+        self._shutdown.set()
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            if w.proc.poll() is None:
+                w.proc.kill()
+        for w in workers:
+            try:
+                w.proc.wait(timeout=5)
+            except Exception:
+                pass
+        self._server.stop()
+        self.store.close(unlink=True)
+
+
+def main():
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head", required=True)
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--store-capacity", type=int, default=DEFAULT_STORE_CAPACITY)
+    parser.add_argument("--session", default=None)
+    args = parser.parse_args()
+    import json
+
+    agent = NodeAgent(
+        args.head,
+        num_cpus=args.num_cpus,
+        resources=json.loads(args.resources),
+        store_capacity=args.store_capacity,
+        session=args.session,
+    )
+    print(f"NODE_ADDRESS={agent.address}", flush=True)
+    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+    agent.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""Minimal threaded RPC: length-prefixed pickle over TCP.
+
+Plays the role of the reference's gRPC scaffolding (``src/ray/rpc/``):
+request/response with per-connection FIFO ordering (the property the direct
+actor transport relies on for in-order actor calls,
+``direct_actor_task_submitter.h``). Handlers run on a thread per connection;
+blocking handlers (long-poll style) are therefore fine.
+
+Wire format: 4-byte big-endian length || pickled {"m": method, "a": args,
+"k": kwargs} — responses {"ok": bool, "v": value} or {"ok": False,
+"e": exception}.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import traceback
+from typing import Any, Callable
+
+_LEN = struct.Struct(">I")
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    blob = pickle.dumps(obj, protocol=5)
+    sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionLost("peer closed connection")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, length))
+
+
+class RpcServer:
+    """Serves ``rpc_<method>`` methods of a handler object."""
+
+    def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(512)
+        self.address = f"{host}:{self._sock.getsockname()[1]}"
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            while True:
+                req = _recv_msg(conn)
+                try:
+                    fn = getattr(self._handler, "rpc_" + req["m"])
+                    value = fn(*req.get("a", ()), **req.get("k", {}))
+                    _send_msg(conn, {"ok": True, "v": value})
+                except ConnectionLost:
+                    raise
+                except BaseException as e:  # noqa: BLE001 — shipped to caller
+                    _send_msg(
+                        conn,
+                        {"ok": False, "e": e, "tb": traceback.format_exc()},
+                    )
+        except (ConnectionLost, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RpcClient:
+    """Thread-safe client; one pooled connection per calling thread (so
+    concurrent calls don't interleave frames, and per-thread call order is
+    preserved end-to-end)."""
+
+    def __init__(self, address: str, timeout: float = 60.0):
+        self.address = address
+        self._timeout = timeout
+        self._local = threading.local()
+        self._closed = False
+
+    def _conn(self) -> socket.socket:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            host, port = self.address.rsplit(":", 1)
+            conn = socket.create_connection((host, int(port)), timeout=self._timeout)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._local.conn = conn
+        return conn
+
+    def call(self, method: str, *args, timeout: float | None = None, **kwargs):
+        if self._closed:
+            raise ConnectionLost(f"client to {self.address} is closed")
+        conn = self._conn()
+        if timeout is not None:
+            conn.settimeout(timeout)
+        try:
+            _send_msg(conn, {"m": method, "a": args, "k": kwargs})
+            resp = _recv_msg(conn)
+        except (OSError, EOFError, ConnectionLost) as e:
+            self._drop_conn()
+            raise ConnectionLost(f"rpc {method} to {self.address}: {e}") from e
+        finally:
+            if timeout is not None:
+                try:
+                    conn.settimeout(self._timeout)
+                except OSError:
+                    pass
+        if resp["ok"]:
+            return resp["v"]
+        raise resp["e"]
+
+    def _drop_conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._local.conn = None
+
+    def close(self):
+        self._closed = True
+        self._drop_conn()
+
+    def __getattr__(self, name: str) -> Callable:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda *a, **k: self.call(name, *a, **k)

@@ -1,0 +1,194 @@
+"""Worker process: executes tasks and hosts actors (CoreWorker equivalent).
+
+The execution side of ``src/ray/core_worker``: receives pushed tasks over
+RPC (``core_worker.proto:382`` PushTask), deserializes with cloudpickle,
+resolves ObjectRef args through the object plane, runs the function, and
+stores returns in the node's shm store + registers locations with the head
+(the task-execution callback path, ``_raylet.pyx:956``).
+
+A worker executes ONE task at a time on its executor thread; actor workers
+are dedicated: the actor constructor is the first queued item and method
+calls execute in arrival order (sequence-numbered actor queue analog).
+Nested ``ray_tpu.*`` calls inside user code work because the worker installs
+a full ClusterBackend as the process-wide backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import queue
+import threading
+import traceback
+
+from ray_tpu.cluster.rpc import RpcClient, RpcServer
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.object_ref import ActorError, ObjectRef, TaskError
+
+
+class WorkerHandler:
+    def __init__(self, head_address, agent_address, node_id, store_path, worker_id):
+        from ray_tpu.cluster.client import ClusterBackend
+
+        self.worker_id = worker_id
+        self.agent = RpcClient(agent_address)
+        self.backend = ClusterBackend(
+            head_address, node_id=node_id, store_path=store_path,
+        )
+        from ray_tpu._private import worker as worker_mod
+
+        worker_mod._backend = self.backend  # nested API calls inside tasks
+        self._hooks = (
+            lambda: self.agent.call("task_blocked", self.worker_id),
+            lambda: self.agent.call("task_unblocked", self.worker_id),
+        )
+        self._q: queue.Queue = queue.Queue()
+        self._actor_instance = None
+        self._actor_dead_cause: str | None = None
+        self._actor_id: str | None = None
+        threading.Thread(target=self._exec_loop, daemon=True).start()
+
+    # -- rpc surface (called by agent and by remote callers) ---------------
+
+    def rpc_push_task(self, spec: dict):
+        self._q.put(("task", spec))
+        return True
+
+    def rpc_create_actor(self, spec: dict):
+        self._actor_id = spec["actor_id"]
+        self._q.put(("actor_ctor", spec))
+        return True
+
+    def rpc_push_actor_task(self, spec: dict):
+        self._q.put(("actor_task", spec))
+        return True
+
+    def rpc_ping(self):
+        return "pong"
+
+    # -- execution ---------------------------------------------------------
+
+    def _exec_loop(self):
+        while True:
+            kind, spec = self._q.get()
+            try:
+                if kind == "task":
+                    self._run_task(spec)
+                    self.agent.call("task_done", self.worker_id)
+                elif kind == "actor_ctor":
+                    self._run_actor_ctor(spec)
+                elif kind == "actor_task":
+                    self._run_actor_task(spec)
+            except Exception:
+                traceback.print_exc()
+
+    def _resolve(self, args, kwargs):
+        args = [
+            self.backend.get([a])[0] if isinstance(a, ObjectRef) else a
+            for a in args
+        ]
+        kwargs = {
+            k: self.backend.get([v])[0] if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()
+        }
+        return args, kwargs
+
+    def _store_result(self, spec, result):
+        oids, num_returns = spec["oids"], spec.get("num_returns", 1)
+        if num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != num_returns:
+                raise ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{len(values)}"
+                )
+        for oid, v in zip(oids, values):
+            self.backend.put_with_id(oid, v)
+
+    def _store_error(self, spec, err: BaseException):
+        for oid in spec["oids"]:
+            self.backend.put_with_id(oid, err, is_error=True)
+
+    def _run_task(self, spec):
+        # Only plain tasks hold a per-task lease worth releasing while
+        # blocked; actor lifetime resources stay held (reference semantics).
+        self.backend._block_hooks = self._hooks
+        try:
+            func = ser.loads(spec["func"])
+            args, kwargs = ser.loads(spec["args"])
+            args, kwargs = self._resolve(args, kwargs)
+            result = func(*args, **kwargs)
+            self._store_result(spec, result)
+        except BaseException as e:  # noqa: BLE001 — stored, not dropped
+            if isinstance(e, (TaskError, ActorError)):
+                self._store_error(spec, e)
+            else:
+                self._store_error(
+                    spec,
+                    TaskError(
+                        spec.get("fname", "task"), traceback.format_exc(), repr(e)
+                    ),
+                )
+        finally:
+            self.backend._block_hooks = None
+
+    def _run_actor_ctor(self, spec):
+        try:
+            cls = ser.loads(spec["func"])
+            args, kwargs = ser.loads(spec["args"])
+            args, kwargs = self._resolve(args, kwargs)
+            self._actor_instance = cls(*args, **kwargs)
+        except BaseException:  # noqa: BLE001
+            self._actor_dead_cause = traceback.format_exc()
+            try:
+                self.agent.call(
+                    "actor_ctor_failed", self._actor_id, self._actor_dead_cause
+                )
+            except Exception:
+                pass
+
+    def _run_actor_task(self, spec):
+        try:
+            if self._actor_instance is None:
+                raise ActorError(
+                    f"actor is dead: {self._actor_dead_cause or 'not constructed'}"
+                )
+            args, kwargs = ser.loads(spec["args"])
+            args, kwargs = self._resolve(args, kwargs)
+            method = getattr(self._actor_instance, spec["method"])
+            result = method(*args, **kwargs)
+            self._store_result(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, (TaskError, ActorError)):
+                self._store_error(spec, e)
+            else:
+                self._store_error(
+                    spec,
+                    TaskError(
+                        spec.get("method", "actor_task"),
+                        traceback.format_exc(),
+                        repr(e),
+                    ),
+                )
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head", required=True)
+    parser.add_argument("--agent", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--store", required=True)
+    parser.add_argument("--worker-id", required=True)
+    args = parser.parse_args()
+
+    handler = WorkerHandler(
+        args.head, args.agent, args.node_id, args.store, args.worker_id
+    )
+    server = RpcServer(handler)
+    handler.agent.call("register_worker", args.worker_id, server.address)
+    threading.Event().wait()  # serve forever; the agent kills us
+
+
+if __name__ == "__main__":
+    main()

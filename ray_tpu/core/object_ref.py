@@ -48,6 +48,12 @@ class TaskError(Exception):
             f"task {function_name} failed:\n{remote_traceback}"
         )
 
+    def __reduce__(self):
+        return (
+            TaskError,
+            (self.function_name, self.remote_traceback, self.cause_repr),
+        )
+
 
 class ActorError(Exception):
     """The actor died before/while executing this call (cf. RayActorError)."""

@@ -66,6 +66,11 @@ def deserialize(meta: bytes, data) -> Any:
     return pickle.loads(payload, buffers=bufs)
 
 
+def num_buffers(meta: bytes) -> int:
+    """Out-of-band buffer count recorded in a serialized object's meta."""
+    return len(msgpack.unpackb(meta)["sizes"]) - 1
+
+
 def dumps(value: Any) -> bytes:
     """One-shot in-band serialization (control-plane messages)."""
     return cloudpickle.dumps(value)

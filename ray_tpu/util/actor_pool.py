@@ -48,16 +48,21 @@ class ActorPool:
         return bool(self._future_to_actor) or bool(self._pending_submits)
 
     def get_next(self, timeout: float | None = None):
-        """Next result in submission order."""
+        """Next result in submission order. A timeout leaves the pool
+        untouched so the call can be retried."""
+        from ray_tpu.core.object_ref import GetTimeoutError
+
         if not self.has_next():
             raise StopIteration("no more results to get")
-        future = self._index_to_future.pop(self._next_return_index)
-        self._next_return_index += 1
+        future = self._index_to_future[self._next_return_index]
         try:
             value = ray_tpu.get(future, timeout=timeout)
-        finally:
-            _, actor = self._future_to_actor.pop(future)
-            self._return_actor(actor)
+        except GetTimeoutError:
+            raise  # task still running; state unchanged, retryable
+        except Exception:
+            self._consume(future)  # task finished (with an error)
+            raise
+        self._consume(future)
         return value
 
     def get_next_unordered(self, timeout: float | None = None):
@@ -73,13 +78,17 @@ class ActorPool:
         try:
             value = ray_tpu.get(future)
         finally:
-            i, actor = self._future_to_actor.pop(future)
-            del self._index_to_future[i]
-            # Keep ordered-get consistent: skip the consumed index.
-            if i == self._next_return_index:
-                self._next_return_index += 1
-            self._return_actor(actor)
+            self._consume(future)
         return value
+
+    def _consume(self, future):
+        i, actor = self._future_to_actor.pop(future)
+        self._index_to_future.pop(i, None)
+        # Ordered gets resume past everything consumed out of order
+        # (reference behavior: mixing ordered/unordered skips indices).
+        if i >= self._next_return_index:
+            self._next_return_index = i + 1
+        self._return_actor(actor)
 
     def _return_actor(self, actor):
         self._idle.append(actor)

@@ -220,12 +220,9 @@ def barrier(group_name: str = "default") -> None:
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: str = ReduceOp.SUM):
     ctx = _ctx(group_name)
-    payloads = ctx.exchange(
-        "reduce",
-        _as_np(tensor),
-        num_fetchers=1,
-        fetch=ctx.rank == dst_rank,
-    )
+    # Every rank fetches (slot GC needs world_size fetches; a single-fetch
+    # slot could vanish before non-dst ranks observe completeness).
+    payloads = ctx.exchange("reduce", _as_np(tensor))
     if ctx.rank == dst_rank:
         return _REDUCERS[op]([payloads[r] for r in sorted(payloads)])
     return tensor

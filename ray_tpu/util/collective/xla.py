@@ -84,21 +84,29 @@ class DeviceGroup:
         self.devices = list(devices if devices is not None else jax.devices())
         self.mesh = Mesh(self.devices, (self.AXIS,))
         self.world_size = len(self.devices)
+        self._compiled: dict[str, callable] = {}
 
     def _sharded(self, x, spec: P):
         return jax.device_put(x, NamedSharding(self.mesh, spec))
 
-    def _run(self, fn, x, in_spec: P, out_spec: P):
-        shard_fn = shard_map(
-            fn, mesh=self.mesh, in_specs=(in_spec,), out_specs=out_spec,
-            check_vma=False,
-        )
-        return jax.jit(shard_fn)(self._sharded(x, in_spec))
+    def _run(self, name: str, fn, x, in_spec: P, out_spec: P):
+        # Cache the jitted collective per op: jax.jit caches by function
+        # identity, so a fresh closure per call would recompile every time.
+        compiled = self._compiled.get(name)
+        if compiled is None:
+            compiled = self._compiled[name] = jax.jit(
+                shard_map(
+                    fn, mesh=self.mesh, in_specs=(in_spec,),
+                    out_specs=out_spec, check_vma=False,
+                )
+            )
+        return compiled(self._sharded(x, in_spec))
 
     def allreduce(self, x):
         """x: (world, ...) stacked per-rank contributions; returns the
         elementwise sum over ranks, replicated."""
         return self._run(
+            "allreduce",
             lambda s: jax.lax.psum(s[0], axis_name=self.AXIS),
             x, P(self.AXIS), P(),
         )
@@ -107,6 +115,7 @@ class DeviceGroup:
         """x: (world, ...) stacked per-rank contributions; returns the full
         stack on every rank (i.e. x, replicated)."""
         return self._run(
+            "allgather",
             lambda s: jax.lax.all_gather(s, self.AXIS, axis=0, tiled=True),
             x, P(self.AXIS), P(),
         )
@@ -115,6 +124,7 @@ class DeviceGroup:
         """x: (world, k*world, ...) stacked per-rank contributions; returns
         (world, k, ...) where row r is rank r's chunk of the reduced sum."""
         return self._run(
+            "reducescatter",
             lambda s: jax.lax.psum_scatter(
                 s[0], self.AXIS, scatter_dimension=0, tiled=True
             )[None],

@@ -66,7 +66,6 @@ class Queue:
         )
 
     def __reduce__(self):
-        q = object.__new__(Queue)
         return (_rebuild_queue, (self.maxsize, self.actor))
 
     def qsize(self) -> int:
@@ -93,11 +92,20 @@ class Queue:
             if not ray_tpu.get(self.actor.put_nowait.remote(item)):
                 raise Full
             return
-        self._poll(
-            lambda: (ray_tpu.get(self.actor.put_nowait.remote(item)), None),
-            timeout,
-            Full(),
-        )
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            # Ship the payload only when the queue looks acceptable; while
+            # full, poll the cheap qsize probe instead of re-serializing the
+            # item every tick.
+            if ray_tpu.get(self.actor.put_nowait.remote(item)):
+                return
+            while (
+                self.maxsize > 0
+                and ray_tpu.get(self.actor.qsize.remote()) >= self.maxsize
+            ):
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise Full
+                time.sleep(0.005)
 
     def put_nowait(self, item):
         self.put(item, block=False)

@@ -1,0 +1,267 @@
+"""Multiprocess cluster backend tests.
+
+Modeled on the reference's multi-node tests over ``cluster_utils.Cluster``
+(``python/ray/tests/test_multinode_failures.py``, ``test_scheduling*.py``,
+``test_chaos.py`` — SURVEY.md §4.3-4.4): several node agents with their own
+stores + worker subprocesses on one host.
+"""
+
+import os
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.cluster import Cluster
+from ray_tpu.core.object_ref import ActorError, TaskError
+
+# Worker processes import this module by name when unpickling test
+# functions; force by-value pickling instead so they don't need it on
+# their sys.path.
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes()
+    backend = ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_cluster_resources(cluster):
+    assert ray_tpu.cluster_resources()["CPU"] == 4.0
+    assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == 2
+
+
+def test_task_runs_in_separate_process(cluster):
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    pid = ray_tpu.get(whoami.remote(), timeout=30)
+    assert pid != os.getpid()
+
+
+def test_parallel_tasks_use_multiple_processes(cluster):
+    @ray_tpu.remote
+    def slow_pid():
+        time.sleep(0.4)
+        return os.getpid()
+
+    pids = ray_tpu.get([slow_pid.remote() for _ in range(4)], timeout=60)
+    assert len(set(pids)) >= 2  # true process parallelism
+
+
+def test_put_get_and_ref_args(cluster):
+    import numpy as np
+
+    ref = ray_tpu.put(np.arange(1000))
+
+    @ray_tpu.remote
+    def total(x):
+        return int(x.sum())
+
+    assert ray_tpu.get(total.remote(ref), timeout=30) == 499500
+    r1 = total.remote(ref)
+    # chained: ObjectRef arg produced by another task
+
+    @ray_tpu.remote
+    def plus_one(x):
+        return x + 1
+
+    assert ray_tpu.get(plus_one.remote(r1), timeout=30) == 499501
+
+
+def test_task_error_propagates(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("cluster boom")
+
+    with pytest.raises(TaskError, match="cluster boom"):
+        ray_tpu.get(boom.remote(), timeout=30)
+
+
+def test_actor_roundtrip_and_named(cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def pid(self):
+            return os.getpid()
+
+    c = Counter.options(name="the_counter").remote(10)
+    assert ray_tpu.get([c.inc.remote() for _ in range(3)], timeout=30) == [11, 12, 13]
+    assert ray_tpu.get(c.pid.remote(), timeout=30) != os.getpid()
+
+    handle = ray_tpu.get_actor("the_counter")
+    assert ray_tpu.get(handle.inc.remote(5), timeout=30) == 18
+
+    ray_tpu.kill(c)
+    time.sleep(0.5)
+    with pytest.raises((ActorError, TaskError)):
+        ray_tpu.get(handle.inc.remote(), timeout=30)
+
+
+def test_actor_ctor_failure(cluster):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("no dice")
+
+        def ping(self):
+            return 1
+
+    a = Bad.remote()
+    with pytest.raises((ActorError, TaskError), match="no dice|dead"):
+        ray_tpu.get(a.ping.remote(), timeout=30)
+
+
+def test_cross_node_object_transfer(cluster):
+    """Produce an object pinned to node 2, consume pinned to node 1."""
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    import numpy as np
+
+    n1, n2 = cluster.nodes[0], cluster.nodes[1]
+
+    @ray_tpu.remote
+    def produce():
+        return np.full((1000,), 7.0)
+
+    @ray_tpu.remote
+    def consume(x):
+        return float(x.sum())
+
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(n2.node_id)
+    ).remote()
+    out = consume.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(n1.node_id)
+    ).remote(ref)
+    assert ray_tpu.get(out, timeout=30) == 7000.0
+
+
+def test_nested_tasks_no_deadlock(cluster):
+    @ray_tpu.remote(num_cpus=2)
+    def parent():
+        @ray_tpu.remote(num_cpus=2)
+        def child():
+            return 20
+
+        return ray_tpu.get(child.remote(), timeout=60) + 1
+
+    assert ray_tpu.get(parent.remote(), timeout=90) == 21
+
+
+def test_strict_spread_placement_group(cluster):
+    from ray_tpu.util import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+        placement_group_table,
+        remove_placement_group,
+    )
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert ray_tpu.get(pg.ready(), timeout=30) == pg.id
+    table = placement_group_table(pg)
+    assert table["state"] == "CREATED"
+    nodes_used = {node_id for node_id, _ in table["placement"]}
+    assert len(nodes_used) == 2  # bundles on distinct nodes
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    node_ids = ray_tpu.get(
+        [
+            where.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=i
+                )
+            ).remote()
+            for i in range(2)
+        ],
+        timeout=60,
+    )
+    assert len(set(node_ids)) == 2
+    remove_placement_group(pg)
+
+
+def test_none_result_roundtrip(cluster):
+    @ray_tpu.remote
+    def nothing():
+        return None
+
+    assert ray_tpu.get(nothing.remote(), timeout=30) is None
+
+
+def test_actor_death_fails_inflight_calls(cluster):
+    @ray_tpu.remote
+    class Suicidal:
+        def ping(self):
+            return "pong"
+
+        def die(self):
+            os._exit(7)
+
+    a = Suicidal.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    ref = a.die.remote()  # never completes; worker dies mid-call
+    with pytest.raises((ActorError, TaskError)):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_worker_crash_surfaces_error(cluster):
+    @ray_tpu.remote
+    def die():
+        os._exit(13)
+
+    with pytest.raises(TaskError, match="worker died"):
+        ray_tpu.get(die.remote(), timeout=60)
+
+
+def test_node_death_lineage_retry():
+    """Kill the node computing a task; owner resubmits it elsewhere
+    (chaos-test analog of test_chaos.py:66)."""
+    ray_tpu.shutdown()
+    c = Cluster()
+    n1 = c.add_node(num_cpus=1)
+    n2 = c.add_node(num_cpus=1)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    try:
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        @ray_tpu.remote
+        def slow_value():
+            time.sleep(3.0)
+            return os.environ.get("RAY_TPU_NODE_ID")
+
+        ref = slow_value.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(n2.node_id)
+        ).remote()
+        time.sleep(0.8)  # let it start on n2
+        c.kill_node(n2)
+        # Head declares n2 dead after the heartbeat timeout; the owner then
+        # resubmits via lineage, landing on n1.
+        result = ray_tpu.get(ref, timeout=60)
+        assert result == n1.node_id
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
