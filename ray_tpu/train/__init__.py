@@ -10,6 +10,22 @@ checkpoints and failure handling.
 
 from ray_tpu.train.train_step import TrainState, make_train_step, make_init_fn
 from ray_tpu.train.optim import adamw_init, adamw_update
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.checkpoint import Checkpoint, load_sharded, save_sharded
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
+from ray_tpu.train import session
+
+# Session API at package level too (reference exposes ray.air.session).
+report = session.report
+get_checkpoint = session.get_checkpoint
+get_world_rank = session.get_world_rank
+get_world_size = session.get_world_size
+get_dataset_shard = session.get_dataset_shard
 
 __all__ = [
     "TrainState",
@@ -17,4 +33,20 @@ __all__ = [
     "make_init_fn",
     "adamw_init",
     "adamw_update",
+    "ScalingConfig",
+    "RunConfig",
+    "FailureConfig",
+    "CheckpointConfig",
+    "Checkpoint",
+    "save_sharded",
+    "load_sharded",
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "Result",
+    "session",
+    "report",
+    "get_checkpoint",
+    "get_world_rank",
+    "get_world_size",
+    "get_dataset_shard",
 ]

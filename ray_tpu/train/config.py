@@ -1,0 +1,67 @@
+"""Train/AIR config dataclasses.
+
+Reference parity: ``python/ray/air/config.py`` — ``ScalingConfig:79``,
+``FailureConfig:454``, ``CheckpointConfig:513``, ``RunConfig:642``.
+
+TPU extension (SURVEY.md §7): ScalingConfig speaks topology — a worker is a
+*host* owning its slice-local chips; ``use_tpu``/``topology`` replace
+``use_gpu``; ``resources_per_worker`` defaults to the host's chip count.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    use_gpu: bool = False  # accepted for API parity; ignored on TPU builds
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None  # e.g. "v4-64": 8 hosts x 8 chips
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = float(self.chips_per_host())
+        return res
+
+    def chips_per_host(self) -> int:
+        if self.topology:
+            # "v4-64" => 64 chips total over num_workers hosts.
+            total = int(self.topology.rsplit("-", 1)[1])
+            return max(1, total // max(1, self.num_workers))
+        return 4
+
+    def as_placement_group_bundles(self) -> list[Dict[str, float]]:
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0  # 0 = no retries, -1 = infinite
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None  # None = keep all
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"  # or "min"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None  # local dir (cloud sync is round-2)
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1

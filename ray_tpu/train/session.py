@@ -1,0 +1,95 @@
+"""Worker-facing session API: report / get_checkpoint / ranks / shards.
+
+Reference parity: ``python/ray/air/session.py:41,94,220,345`` and the
+per-worker ``_TrainSession`` (``python/ray/train/_internal/session.py:61``)
+— results flow worker -> trainer through a queue; the trainer consumes them
+in ``TrainingIterator`` order.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_local = threading.local()
+
+
+class _Session:
+    def __init__(self, world_rank, world_size, local_rank, node_rank,
+                 results_queue, checkpoint, dataset_shards, trial_info=None):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.node_rank = node_rank
+        self.results_queue = results_queue
+        self.checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.trial_info = trial_info
+        self.iteration = 0
+
+    def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
+        self.iteration += 1
+        payload = {
+            "type": "report",
+            "rank": self.world_rank,
+            "iteration": self.iteration,
+            "metrics": dict(metrics),
+            "checkpoint": checkpoint,
+        }
+        self.results_queue.put(payload)
+
+
+def init_session(**kwargs) -> None:
+    _local.session = _Session(**kwargs)
+
+
+def shutdown_session() -> None:
+    _local.session = None
+
+
+def _session() -> _Session:
+    s = getattr(_local, "session", None)
+    if s is None:
+        raise RuntimeError(
+            "No train session active: this API must be called inside "
+            "train_loop_per_worker."
+        )
+    return s
+
+
+def report(metrics: dict, checkpoint: Optional[Checkpoint] = None) -> None:
+    _session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _session().checkpoint
+
+
+def get_world_rank() -> int:
+    return _session().world_rank
+
+
+def get_world_size() -> int:
+    return _session().world_size
+
+
+def get_local_rank() -> int:
+    return _session().local_rank
+
+
+def get_node_rank() -> int:
+    return _session().node_rank
+
+
+def get_dataset_shard(name: str = "train"):
+    return _session().dataset_shards.get(name)
+
+
+def get_trial_info():
+    return _session().trial_info
+
+
+def in_session() -> bool:
+    return getattr(_local, "session", None) is not None

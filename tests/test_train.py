@@ -1,0 +1,204 @@
+"""Train library tests (modeled on the reference's
+``python/ray/train/tests/test_data_parallel_trainer.py`` and
+``test_backend_executor`` behaviors: multi-worker groups on CPU, reporting,
+checkpoints, elastic restart)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import Checkpoint, session
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_basic_report_and_result():
+    def loop(config):
+        for i in range(3):
+            session.report({"step": i, "loss": 1.0 / (i + 1)})
+
+    trainer = train.DataParallelTrainer(
+        loop, scaling_config=train.ScalingConfig(num_workers=2)
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_history) == 3  # rank-0 reports only
+
+
+def test_world_rank_and_size():
+    def loop(config):
+        session.report(
+            {"rank": session.get_world_rank(), "ws": session.get_world_size()}
+        )
+
+    result = train.DataParallelTrainer(
+        loop, scaling_config=train.ScalingConfig(num_workers=3)
+    ).fit()
+    assert result.metrics == {"rank": 0, "ws": 3}
+
+
+def test_dataset_sharding():
+    data = np.arange(12)
+
+    def loop(config):
+        shard = session.get_dataset_shard("train")
+        session.report({"total": int(np.sum(shard)), "n": len(shard)})
+
+    result = train.DataParallelTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=2),
+        datasets={"train": data},
+    ).fit()
+    assert result.metrics["n"] == 6  # 12 items over 2 workers
+
+
+def test_checkpoint_reported_and_best_kept():
+    def loop(config):
+        for i in range(4):
+            session.report(
+                {"score": i},
+                checkpoint=Checkpoint.from_dict({"model": i}),
+            )
+
+    result = train.DataParallelTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(
+            checkpoint_config=train.CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="score"
+            )
+        ),
+    ).fit()
+    assert result.checkpoint is not None
+    assert result.checkpoint.to_dict()["model"] == 3  # best score
+
+
+def test_checkpoint_roundtrip_forms(tmp_path):
+    ckpt = Checkpoint.from_dict({"weights": np.ones(4), "step": 7})
+    d = ckpt.to_directory(str(tmp_path / "ck"))
+    restored = Checkpoint.from_directory(d).to_dict()
+    assert restored["step"] == 7
+    np.testing.assert_allclose(restored["weights"], np.ones(4))
+    ref = ckpt.to_object_ref()
+    again = Checkpoint.from_object_ref(ref).to_dict()
+    assert again["step"] == 7
+
+
+def test_elastic_restart_resumes_from_checkpoint():
+    """First attempt dies mid-run; retry resumes from the checkpoint."""
+
+    def loop(config):
+        start = 0
+        ckpt = session.get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.to_dict()["step"] + 1
+        for i in range(start, 4):
+            session.report(
+                {"step": i}, checkpoint=Checkpoint.from_dict({"step": i})
+            )
+            if i == 1 and ckpt is None and session.get_world_rank() == 0:
+                raise RuntimeError("simulated worker failure")
+
+    result = train.DataParallelTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(
+            failure_config=train.FailureConfig(max_failures=2)
+        ),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+    # resumed at 2 (ckpt step 1 + 1), so 0,1 then 2,3 -> 4 reports
+    steps = [m["step"] for m in result.metrics_history]
+    assert steps == [0, 1, 2, 3]
+
+
+def test_failure_exhausts_retries():
+    def loop(config):
+        raise RuntimeError("always fails")
+
+    result = train.DataParallelTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=1),
+        run_config=train.RunConfig(
+            failure_config=train.FailureConfig(max_failures=1)
+        ),
+    ).fit()
+    assert result.error is not None
+
+
+def test_jax_trainer_mnist_style_mesh(devices8):
+    """End-to-end: jitted data-parallel train step inside a train loop on
+    the 8-device CPU mesh (the SURVEY.md §7 minimum end-to-end slice)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    def loop(config):
+        mesh = build_mesh(MeshConfig(dp=8))
+        w_shard = NamedSharding(mesh, P())
+        x_shard = NamedSharding(mesh, P(("dp",)))
+
+        def loss_fn(w, batch):
+            x, y = batch
+            pred = x @ w
+            return jnp.mean((pred - y) ** 2)
+
+        @jax.jit
+        def step(w, batch):
+            l, g = jax.value_and_grad(loss_fn)(w, batch)
+            return w - 0.1 * g, l
+
+        rng = np.random.default_rng(0)
+        w = jax.device_put(jnp.zeros((4, 1)), w_shard)
+        true_w = np.array([[1.0], [-2.0], [3.0], [0.5]])
+        for i in range(30):
+            x = rng.normal(size=(64, 4)).astype(np.float32)
+            y = (x @ true_w).astype(np.float32)
+            batch = (
+                jax.device_put(x, x_shard),
+                jax.device_put(y, x_shard),
+            )
+            w, l = step(w, batch)
+        session.report({"final_loss": float(l)})
+
+    result = train.JaxTrainer(
+        loop, scaling_config=train.ScalingConfig(num_workers=1)
+    ).fit()
+    assert result.error is None
+    assert result.metrics["final_loss"] < 1e-2
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path, devices8):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(dp=8))
+    sharding = NamedSharding(mesh, P("dp"))
+    state = {
+        "w": jax.device_put(jnp.arange(16.0).reshape(8, 2), sharding),
+        "step": jnp.asarray(5),
+    }
+    path = str(tmp_path / "sharded")
+    train.save_sharded(state, path)
+    restored = train.load_sharded(
+        path, {"w": sharding, "step": NamedSharding(mesh, P())}
+    )
+    np.testing.assert_allclose(
+        np.asarray(restored["w"]), np.arange(16.0).reshape(8, 2)
+    )
+    assert restored["w"].sharding == sharding
+    assert int(restored["step"]) == 5
