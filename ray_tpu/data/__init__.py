@@ -1,0 +1,39 @@
+"""Data library: distributed datasets over object-store blocks.
+
+Reference parity: ``python/ray/data`` (SURVEY.md §2.3) — lazy plans with
+stage fusion, all-to-all shuffles, equal splits for Train ingest, actor-pool
+compute, preprocessors — built purely on tasks/actors/objects, with a
+TPU-native device-feeding path (``iter_device_batches``).
+"""
+
+from ray_tpu.data.dataset import (
+    ActorPoolStrategy,
+    Dataset,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)
+from ray_tpu.data import preprocessors
+
+__all__ = [
+    "ActorPoolStrategy",
+    "Dataset",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_json",
+    "read_parquet",
+    "read_text",
+    "preprocessors",
+]

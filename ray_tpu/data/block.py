@@ -1,0 +1,111 @@
+"""Blocks: the unit of distributed data (reference: ``python/ray/data/block.py``).
+
+Two physical layouts, mirroring the reference's simple vs Arrow blocks:
+  * list block — ``list`` of rows (arbitrary Python objects / dicts);
+  * columnar block — ``dict[str, np.ndarray]`` (the Arrow-table analog;
+    zero-copy friendly through the shm object store's pickle-5 buffers).
+
+``BlockAccessor``-style helpers are plain functions here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Union
+
+import numpy as np
+
+Block = Union[List[Any], dict]
+
+
+def is_columnar(block: Block) -> bool:
+    return isinstance(block, dict)
+
+
+def num_rows(block: Block) -> int:
+    if is_columnar(block):
+        if not block:
+            return 0
+        return len(next(iter(block.values())))
+    return len(block)
+
+
+def slice_block(block: Block, start: int, end: int) -> Block:
+    if is_columnar(block):
+        return {k: v[start:end] for k, v in block.items()}
+    return block[start:end]
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if num_rows(b) > 0]
+    if not blocks:
+        return []
+    if is_columnar(blocks[0]):
+        keys = blocks[0].keys()
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    out: list = []
+    for b in blocks:
+        out.extend(b)
+    return out
+
+
+def rows_of(block: Block) -> Iterable[Any]:
+    if is_columnar(block):
+        keys = list(block.keys())
+        for i in range(num_rows(block)):
+            yield {k: block[k][i] for k in keys}
+    else:
+        yield from block
+
+
+def from_rows(rows: List[Any], like: Block) -> Block:
+    """Rebuild a block from rows, keeping the input layout when possible."""
+    if is_columnar(like) and rows and isinstance(rows[0], dict):
+        keys = rows[0].keys()
+        return {k: np.asarray([r[k] for r in rows]) for k in keys}
+    return list(rows)
+
+
+def to_batch(block: Block, batch_format: str):
+    """Materialize a block in the requested batch format
+    (``iter_batches(batch_format=...)`` parity: numpy / pandas / default)."""
+    if batch_format in ("default", "native"):
+        return block
+    if batch_format == "numpy":
+        if is_columnar(block):
+            return block
+        if block and isinstance(block[0], dict):
+            keys = block[0].keys()
+            return {k: np.asarray([r[k] for r in block]) for k in keys}
+        return np.asarray(block)
+    if batch_format == "pandas":
+        import pandas as pd
+
+        if is_columnar(block):
+            return pd.DataFrame({k: list(v) for k, v in block.items()})
+        if block and isinstance(block[0], dict):
+            return pd.DataFrame(block)
+        return pd.DataFrame({"value": block})
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def from_batch(batch) -> Block:
+    """Normalize a user-returned batch back into a block."""
+    import pandas as pd
+
+    if isinstance(batch, pd.DataFrame):
+        return {k: batch[k].to_numpy() for k in batch.columns}
+    if isinstance(batch, dict):
+        return {k: np.asarray(v) for k, v in batch.items()}
+    if isinstance(batch, np.ndarray):
+        return list(batch)
+    if isinstance(batch, list):
+        return batch
+    raise TypeError(f"cannot convert batch of type {type(batch)} to a block")
+
+
+def schema_of(block: Block):
+    if is_columnar(block):
+        return {k: v.dtype for k, v in block.items()}
+    if block and isinstance(block[0], dict):
+        return {k: type(v).__name__ for k, v in block[0].items()}
+    return type(block[0]).__name__ if block else None

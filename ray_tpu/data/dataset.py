@@ -1,0 +1,703 @@
+"""Dataset: lazy distributed data over object-store blocks.
+
+Reference parity (``python/ray/data/dataset.py:139``):
+  * blocks live in the object store as ObjectRefs; transformations are
+    ``@remote`` tasks over blocks (``_internal/remote_fn.py`` invariant);
+  * the plan is LAZY with stage fusion — consecutive one-to-one stages run
+    as a single task per block (``_internal/plan.py:288``);
+  * all-to-all ops (shuffle / sort / repartition) follow the two-phase
+    map+reduce shape of the push-based shuffle
+    (``_internal/push_based_shuffle.py``);
+  * ``split(equal=True)`` yields row-balanced per-worker shards
+    (``_internal/equalize.py``) for Train ingestion;
+  * compute strategies: task pool (default) or an actor pool
+    (``_internal/compute.py:58,173``).
+
+TPU addition: ``iter_device_batches`` — double-buffered host->HBM feeding
+of jax arrays with a target sharding.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Iterable, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+_py_range = range  # the builtin; the public range() API below shadows it
+
+
+def _remote_apply(fns, blk):
+    """One task: run the fused chain of block fns."""
+    for fn in fns:
+        blk = fn(blk)
+    return blk
+
+
+class _Stage:
+    """One-to-one stage: fuseable block -> block function."""
+
+    def __init__(self, name: str, fn: Callable):
+        self.name = name
+        self.fn = fn
+
+
+class DatasetStats:
+    def __init__(self):
+        self.stages: List[tuple] = []  # (name, seconds, n_blocks)
+
+    def record(self, name, seconds, n_blocks):
+        self.stages.append((name, seconds, n_blocks))
+
+    def summary(self) -> str:
+        lines = [
+            f"stage {i}: {name} — {sec*1000:.1f} ms over {nb} blocks"
+            for i, (name, sec, nb) in enumerate(self.stages)
+        ]
+        return "\n".join(lines) or "(no stages executed)"
+
+
+class Dataset:
+    def __init__(self, blocks: List, stages: Optional[List[_Stage]] = None,
+                 stats: Optional[DatasetStats] = None):
+        self._blocks = blocks  # list[ObjectRef]
+        self._stages: List[_Stage] = list(stages or [])
+        self._stats = stats or DatasetStats()
+        self._computed: Optional[List] = None if self._stages else blocks
+
+    # -- plan execution (lazy, with stage fusion) -------------------------
+
+    def _execute(self) -> List:
+        if self._computed is not None:
+            return self._computed
+        fns = [s.fn for s in self._stages]
+        name = "+".join(s.name for s in self._stages)
+        start = time.perf_counter()
+        apply_task = ray_tpu.remote(_remote_apply)
+        out = [apply_task.remote(fns, b) for b in self._blocks]
+        ray_tpu.wait(out, num_returns=len(out), timeout=None)
+        self._stats.record(name, time.perf_counter() - start, len(out))
+        self._computed = out
+        self._blocks, self._stages = out, []
+        return out
+
+    def _with_stage(self, name: str, fn: Callable) -> "Dataset":
+        return Dataset(self._blocks, self._stages + [_Stage(name, fn)],
+                       self._stats)
+
+    def materialize(self) -> "Dataset":
+        self._execute()
+        return self
+
+    def stats(self) -> str:
+        return self._stats.summary()
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._blocks)
+
+    # -- one-to-one transformations ---------------------------------------
+
+    def map(self, fn: Callable) -> "Dataset":
+        def do(blk):
+            return B.from_rows([fn(r) for r in B.rows_of(blk)], blk)
+
+        return self._with_stage("map", do)
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        def do(blk):
+            rows: list = []
+            for r in B.rows_of(blk):
+                rows.extend(fn(r))
+            return B.from_rows(rows, blk)
+
+        return self._with_stage("flat_map", do)
+
+    def filter(self, fn: Callable) -> "Dataset":
+        def do(blk):
+            return B.from_rows([r for r in B.rows_of(blk) if fn(r)], blk)
+
+        return self._with_stage("filter", do)
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        compute: Optional["ActorPoolStrategy"] = None,
+        **_kw,
+    ) -> "Dataset":
+        def do(blk):
+            n = B.num_rows(blk)
+            size = n if batch_size is None else batch_size
+            outs = []
+            for s in _py_range(0, max(n, 1), max(size, 1)):
+                batch = B.to_batch(B.slice_block(blk, s, min(s + size, n)),
+                                   batch_format)
+                outs.append(B.from_batch(fn(batch)))
+                if n == 0:
+                    break
+            return B.concat_blocks(outs) if outs else blk
+
+        if compute is not None:
+            return self._map_with_actor_pool(do, compute)
+        return self._with_stage("map_batches", do)
+
+    def _map_with_actor_pool(self, do: Callable, compute) -> "Dataset":
+        """ActorPoolStrategy: blocks stream through a pool of worker actors
+        (``_internal/compute.py:173``)."""
+        from ray_tpu.util.actor_pool import ActorPool
+
+        blocks = self._execute()
+
+        class _BlockWorker:
+            def apply(self, fns, blk):
+                return _remote_apply(fns, blk)
+
+        worker_cls = ray_tpu.remote(_BlockWorker)
+        n = min(compute.max_size, max(compute.min_size, len(blocks)))
+        pool = ActorPool([worker_cls.remote() for _ in _py_range(n)])
+        start = time.perf_counter()
+        out_vals = list(
+            pool.map(lambda a, blk: a.apply.remote([do], blk), blocks)
+        )
+        out = [ray_tpu.put(v) for v in out_vals]
+        self._stats.record("map_batches(actors)",
+                           time.perf_counter() - start, len(out))
+        for w in list(pool._idle):
+            ray_tpu.kill(w)
+        return Dataset(out, [], self._stats)
+
+    def limit(self, n: int) -> "Dataset":
+        blocks = self._execute()
+        out, used = [], 0
+        for ref in blocks:
+            if used >= n:
+                break
+            blk = ray_tpu.get(ref)
+            take = min(n - used, B.num_rows(blk))
+            out.append(ray_tpu.put(B.slice_block(blk, 0, take)))
+            used += take
+        return Dataset(out, [], self._stats)
+
+    # -- all-to-all operations --------------------------------------------
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        blocks = self._execute()
+
+        def split_one(blk, n_out):
+            n = B.num_rows(blk)
+            cuts = [round(i * n / n_out) for i in _py_range(n_out + 1)]
+            return [B.slice_block(blk, cuts[i], cuts[i + 1]) for i in _py_range(n_out)]
+
+        split_task = ray_tpu.remote(split_one).options(num_returns=num_blocks)
+        concat_task = ray_tpu.remote(lambda *parts: B.concat_blocks(list(parts)))
+        start = time.perf_counter()
+        if num_blocks == 1:
+            parts_per_block = [[ref] for ref in blocks]
+        else:
+            parts_per_block = [split_task.remote(ref, num_blocks) for ref in blocks]
+        out = []
+        for j in _py_range(num_blocks):
+            parts = [
+                (p[j] if isinstance(p, list) else p) for p in parts_per_block
+            ]
+            out.append(concat_task.remote(*parts))
+        ray_tpu.wait(out, num_returns=len(out), timeout=None)
+        self._stats.record("repartition", time.perf_counter() - start, num_blocks)
+        return Dataset(out, [], self._stats)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Two-phase all-to-all shuffle (push-based shuffle shape)."""
+        blocks = self._execute()
+        n_out = len(blocks)
+
+        def shuffle_map(blk, i, n, seed_):
+            rng = np.random.default_rng(None if seed_ is None else seed_ + i)
+            rows = list(B.rows_of(blk))
+            perm = rng.permutation(len(rows))
+            parts: list = [[] for _ in _py_range(n)]
+            for j, pi in enumerate(perm):
+                parts[j % n].append(rows[pi])
+            return [B.from_rows(p, blk) for p in parts]
+
+        def shuffle_reduce(seed_, j, *parts):
+            blk = B.concat_blocks(list(parts))
+            rows = list(B.rows_of(blk))
+            rng = np.random.default_rng(None if seed_ is None else seed_ * 7919 + j)
+            rng.shuffle(rows)
+            return B.from_rows(rows, blk)
+
+        map_task = ray_tpu.remote(shuffle_map).options(num_returns=n_out)
+        reduce_task = ray_tpu.remote(shuffle_reduce)
+        start = time.perf_counter()
+        parts = [map_task.remote(ref, i, n_out, seed) for i, ref in enumerate(blocks)]
+        if n_out == 1:
+            parts = [[p] for p in parts]
+        out = [
+            reduce_task.remote(seed, j, *[p[j] for p in parts])
+            for j in _py_range(n_out)
+        ]
+        ray_tpu.wait(out, num_returns=len(out), timeout=None)
+        self._stats.record("random_shuffle", time.perf_counter() - start, n_out)
+        return Dataset(out, [], self._stats)
+
+    def sort(self, key: Optional[Any] = None, descending: bool = False) -> "Dataset":
+        """Sample-partition-sort (range-partitioned distributed sort)."""
+        blocks = self._execute()
+        n_out = len(blocks)
+        keyfn = self._make_keyfn(key)
+
+        sample_task = ray_tpu.remote(
+            lambda blk: [keyfn(r) for r in list(B.rows_of(blk))[:: max(1, B.num_rows(blk) // 20)]]
+        )
+        samples = sorted(
+            x for s in ray_tpu.get([sample_task.remote(b) for b in blocks])
+            for x in s
+        )
+        if not samples:
+            return self
+        bounds = [
+            samples[int(len(samples) * (i + 1) / n_out)]
+            for i in _py_range(n_out - 1)
+            if int(len(samples) * (i + 1) / n_out) < len(samples)
+        ]
+
+        def part_map(blk, bounds_):
+            parts: list = [[] for _ in _py_range(len(bounds_) + 1)]
+            for r in B.rows_of(blk):
+                k = keyfn(r)
+                import bisect
+
+                parts[bisect.bisect_right(bounds_, k)].append(r)
+            return [B.from_rows(p, blk) for p in parts]
+
+        def part_reduce(*parts):
+            blk = B.concat_blocks(list(parts))
+            rows = sorted(B.rows_of(blk), key=keyfn, reverse=descending)
+            return B.from_rows(rows, blk)
+
+        n_parts = len(bounds) + 1
+        map_task = ray_tpu.remote(part_map).options(num_returns=n_parts)
+        reduce_task = ray_tpu.remote(part_reduce)
+        start = time.perf_counter()
+        parts = [map_task.remote(b, bounds) for b in blocks]
+        if n_parts == 1:
+            parts = [[p] for p in parts]
+        out = [reduce_task.remote(*[p[j] for p in parts]) for j in _py_range(n_parts)]
+        if descending:
+            out = out[::-1]
+        ray_tpu.wait(out, num_returns=len(out), timeout=None)
+        self._stats.record("sort", time.perf_counter() - start, len(out))
+        return Dataset(out, [], self._stats)
+
+    @staticmethod
+    def _make_keyfn(key):
+        if key is None:
+            return lambda r: r
+        if isinstance(key, str):
+            return lambda r: r[key]
+        return key
+
+    def groupby(self, key) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # -- combining --------------------------------------------------------
+
+    def union(self, other: "Dataset") -> "Dataset":
+        return Dataset(self._execute() + other._execute(), [], self._stats)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        a, b = self._execute(), other._execute()
+        zip_task = ray_tpu.remote(
+            lambda x, y: [(r1, r2) for r1, r2 in zip(B.rows_of(x), B.rows_of(y))]
+        )
+        if len(a) != len(b):
+            a_rows = self.take_all()
+            b_rows = other.take_all()
+            return from_items(list(zip(a_rows, b_rows)))
+        return Dataset([zip_task.remote(x, y) for x, y in zip(a, b)], [],
+                       self._stats)
+
+    def split(self, n: int, *, equal: bool = False,
+              locality_hints=None) -> List["Dataset"]:
+        """N sub-datasets; ``equal=True`` balances rows exactly
+        (Train per-worker shards, ``_internal/equalize.py``)."""
+        blocks = self._execute()
+        if not equal:
+            return [
+                Dataset(blocks[i::n], [], self._stats) for i in _py_range(n)
+            ]
+        counts = ray_tpu.get(
+            [ray_tpu.remote(B.num_rows).remote(b) for b in blocks]
+        )
+        total = sum(counts)
+        per = total // n
+        slice_task = ray_tpu.remote(B.slice_block)
+        shards: List[List] = [[] for _ in _py_range(n)]
+        shard_idx, filled = 0, 0
+        for ref, cnt in zip(blocks, counts):
+            offset = 0
+            while offset < cnt and shard_idx < n:
+                room = per - filled
+                take = min(room, cnt - offset)
+                if take > 0:
+                    shards[shard_idx].append(
+                        slice_task.remote(ref, offset, offset + take)
+                    )
+                offset += take
+                filled += take
+                if filled >= per:
+                    shard_idx += 1
+                    filled = 0
+        return [Dataset(s, [], self._stats) for s in shards]
+
+    # -- consumption ------------------------------------------------------
+
+    def count(self) -> int:
+        counts = ray_tpu.get(
+            [ray_tpu.remote(B.num_rows).remote(b) for b in self._execute()]
+        )
+        return sum(counts)
+
+    def take(self, n: int = 20) -> list:
+        out: list = []
+        for ref in self._execute():
+            for r in B.rows_of(ray_tpu.get(ref)):
+                out.append(r)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> list:
+        return self.take(float("inf"))  # type: ignore[arg-type]
+
+    def show(self, n: int = 20) -> None:
+        for r in self.take(n):
+            print(r)
+
+    def schema(self):
+        for ref in self._execute():
+            blk = ray_tpu.get(ref)
+            if B.num_rows(blk):
+                return B.schema_of(blk)
+        return None
+
+    def iter_rows(self) -> Iterable:
+        for ref in self._execute():
+            yield from B.rows_of(ray_tpu.get(ref))
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        batch_format: str = "numpy",
+        prefetch_blocks: int = 1,
+        drop_last: bool = False,
+    ) -> Iterable:
+        """Batches with background block prefetch (the pipelined-ingest
+        analog of ``DatasetPipeline`` windows)."""
+        refs = self._execute()
+        fetched: "dict[int, Any]" = {}
+        cv = threading.Condition()
+
+        def prefetcher():
+            for i, ref in enumerate(refs):
+                blk = ray_tpu.get(ref)
+                with cv:
+                    fetched[i] = blk
+                    cv.notify_all()
+                    while len(fetched) > prefetch_blocks + 1:
+                        cv.wait(0.1)
+
+        threading.Thread(target=prefetcher, daemon=True).start()
+        carry: Optional[B.Block] = None
+        for i in _py_range(len(refs)):
+            with cv:
+                while i not in fetched:
+                    cv.wait(0.1)
+                blk = fetched.pop(i)
+                cv.notify_all()
+            if carry is not None and B.num_rows(carry):
+                blk = B.concat_blocks([carry, blk])
+                carry = None
+            n = B.num_rows(blk)
+            pos = 0
+            while n - pos >= batch_size:
+                yield B.to_batch(B.slice_block(blk, pos, pos + batch_size),
+                                 batch_format)
+                pos += batch_size
+            if pos < n:
+                carry = B.slice_block(blk, pos, n)
+        if carry is not None and B.num_rows(carry) and not drop_last:
+            yield B.to_batch(carry, batch_format)
+
+    def iter_device_batches(self, *, batch_size: int, sharding=None,
+                            dtype=None, drop_last: bool = True) -> Iterable:
+        """Double-buffered host->device feeding: batch i+1 is transferred
+        while batch i is being consumed (TPU ingest path)."""
+        import jax
+
+        def to_device(batch):
+            def put(x):
+                x = np.asarray(x)
+                if dtype is not None:
+                    x = x.astype(dtype)
+                return (jax.device_put(x, sharding) if sharding is not None
+                        else jax.device_put(x))
+
+            if isinstance(batch, dict):
+                return {k: put(v) for k, v in batch.items()}
+            return put(batch)
+
+        it = self.iter_batches(batch_size=batch_size, batch_format="numpy",
+                               drop_last=drop_last)
+        prev = None
+        for batch in it:
+            nxt = to_device(batch)  # async transfer starts immediately
+            if prev is not None:
+                yield prev
+            prev = nxt
+        if prev is not None:
+            yield prev
+
+    # -- writes -----------------------------------------------------------
+
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pandas as pd
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            df = B.to_batch(ray_tpu.get(ref), "pandas")
+            df.to_parquet(f"{path}/part-{i:05d}.parquet")
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            df = B.to_batch(ray_tpu.get(ref), "pandas")
+            df.to_csv(f"{path}/part-{i:05d}.csv", index=False)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        dfs = [B.to_batch(ray_tpu.get(r), "pandas") for r in self._execute()]
+        return pd.concat(dfs, ignore_index=True) if dfs else pd.DataFrame()
+
+    def __repr__(self) -> str:
+        return f"Dataset(num_blocks={self.num_blocks}, stages={len(self._stages)})"
+
+
+class GroupedData:
+    """Hash-aggregation over a key (``Dataset.groupby`` parity)."""
+
+    def __init__(self, ds: Dataset, key):
+        self.ds = ds
+        self.keyfn = Dataset._make_keyfn(key)
+        self.key = key
+
+    def _aggregate(self, init, acc, merge, final) -> Dataset:
+        keyfn = self.keyfn
+
+        def partial(blk):
+            groups: dict = {}
+            for r in B.rows_of(blk):
+                k = keyfn(r)
+                groups[k] = acc(groups.get(k, init()), r)
+            return groups
+
+        def combine(*partials):
+            total: dict = {}
+            for p in partials:
+                for k, v in p.items():
+                    total[k] = merge(total[k], v) if k in total else v
+            rows = [
+                {"key": k, "value": final(v)} for k, v in sorted(total.items())
+            ]
+            return rows
+
+        blocks = self.ds._execute()
+        partial_task = ray_tpu.remote(partial)
+        combine_task = ray_tpu.remote(combine)
+        out = combine_task.remote(*[partial_task.remote(b) for b in blocks])
+        return Dataset([out], [], self.ds._stats)
+
+    def count(self) -> Dataset:
+        return self._aggregate(
+            lambda: 0, lambda s, r: s + 1, lambda a, b: a + b, lambda s: s
+        )
+
+    def sum(self, on: Optional[str] = None) -> Dataset:
+        val = (lambda r: r[on]) if on else (lambda r: r)
+        return self._aggregate(
+            lambda: 0, lambda s, r: s + val(r), lambda a, b: a + b, lambda s: s
+        )
+
+    def min(self, on: Optional[str] = None) -> Dataset:
+        val = (lambda r: r[on]) if on else (lambda r: r)
+        return self._aggregate(
+            lambda: None,
+            lambda s, r: val(r) if s is None else min(s, val(r)),
+            lambda a, b: min(a, b),
+            lambda s: s,
+        )
+
+    def max(self, on: Optional[str] = None) -> Dataset:
+        val = (lambda r: r[on]) if on else (lambda r: r)
+        return self._aggregate(
+            lambda: None,
+            lambda s, r: val(r) if s is None else max(s, val(r)),
+            lambda a, b: max(a, b),
+            lambda s: s,
+        )
+
+    def mean(self, on: Optional[str] = None) -> Dataset:
+        val = (lambda r: r[on]) if on else (lambda r: r)
+        return self._aggregate(
+            lambda: (0.0, 0),
+            lambda s, r: (s[0] + val(r), s[1] + 1),
+            lambda a, b: (a[0] + b[0], a[1] + b[1]),
+            lambda s: s[0] / s[1],
+        )
+
+
+class ActorPoolStrategy:
+    """Compute strategy: run map stages on a pool of long-lived actors
+    (``_internal/compute.py:173``)."""
+
+    def __init__(self, min_size: int = 1, max_size: int = 4):
+        self.min_size = min_size
+        self.max_size = max_size
+
+
+# -- read API (``python/ray/data/read_api.py``) ----------------------------
+
+
+def _to_blocks(items: list, parallelism: int) -> List:
+    n = max(1, min(parallelism, len(items) or 1))
+    cuts = [round(i * len(items) / n) for i in _py_range(n + 1)]
+    return [
+        ray_tpu.put(items[cuts[i] : cuts[i + 1]]) for i in _py_range(n)
+    ]
+
+
+def from_items(items: list, *, parallelism: int = 8) -> Dataset:
+    return Dataset(_to_blocks(list(items), parallelism))
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    import builtins
+
+    return from_items(list(builtins.range(n)), parallelism=parallelism)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = 8) -> Dataset:
+    import builtins
+
+    items = [np.full(shape, i) for i in builtins.range(n)]
+    return from_items(items, parallelism=parallelism)
+
+
+def from_numpy(arr: np.ndarray, *, parallelism: int = 8) -> Dataset:
+    n = max(1, min(parallelism, len(arr)))
+    cuts = [round(i * len(arr) / n) for i in _py_range(n + 1)]
+    return Dataset(
+        [ray_tpu.put({"data": arr[cuts[i]:cuts[i + 1]]}) for i in _py_range(n)]
+    )
+
+
+def from_pandas(df, *, parallelism: int = 8) -> Dataset:
+    n = max(1, min(parallelism, len(df)))
+    cuts = [round(i * len(df) / n) for i in _py_range(n + 1)]
+    return Dataset(
+        [
+            ray_tpu.put(
+                {k: df[k].to_numpy()[cuts[i]:cuts[i + 1]] for k in df.columns}
+            )
+            for i in _py_range(n)
+        ]
+    )
+
+
+def _expand_paths(paths) -> list:
+    import glob
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "*"))))
+        else:
+            out.extend(sorted(glob.glob(p)) or [p])
+    return out
+
+
+def read_parquet(paths, *, parallelism: int = 8) -> Dataset:
+    files = _expand_paths(paths)
+
+    def load(path):
+        import pandas as pd
+
+        df = pd.read_parquet(path)
+        return {k: df[k].to_numpy() for k in df.columns}
+
+    load_task = ray_tpu.remote(load)
+    return Dataset([load_task.remote(p) for p in files])
+
+
+def read_csv(paths, *, parallelism: int = 8) -> Dataset:
+    files = _expand_paths(paths)
+
+    def load(path):
+        import pandas as pd
+
+        df = pd.read_csv(path)
+        return {k: df[k].to_numpy() for k in df.columns}
+
+    load_task = ray_tpu.remote(load)
+    return Dataset([load_task.remote(p) for p in files])
+
+
+def read_json(paths, *, parallelism: int = 8) -> Dataset:
+    files = _expand_paths(paths)
+
+    def load(path):
+        import json
+
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    load_task = ray_tpu.remote(load)
+    return Dataset([load_task.remote(p) for p in files])
+
+
+def read_text(paths, *, parallelism: int = 8) -> Dataset:
+    files = _expand_paths(paths)
+
+    def load(path):
+        with open(path) as f:
+            return [line.rstrip("\n") for line in f]
+
+    load_task = ray_tpu.remote(load)
+    return Dataset([load_task.remote(p) for p in files])
+
+
+def read_binary_files(paths, *, parallelism: int = 8) -> Dataset:
+    files = _expand_paths(paths)
+
+    def load(path):
+        with open(path, "rb") as f:
+            return [f.read()]
+
+    load_task = ray_tpu.remote(load)
+    return Dataset([load_task.remote(p) for p in files])

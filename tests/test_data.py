@@ -1,0 +1,238 @@
+"""Data library tests (modeled on reference block/plan/shuffle behaviors in
+``python/ray/data/tests/``)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rtd
+from ray_tpu.data.preprocessors import (
+    BatchMapper,
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MinMaxScaler,
+    StandardScaler,
+)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_count_take():
+    ds = rtd.range(100, parallelism=4)
+    assert ds.num_blocks == 4
+    assert ds.count() == 100
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+
+
+def test_map_filter_flatmap_fused():
+    ds = (
+        rtd.range(20, parallelism=4)
+        .map(lambda x: x * 2)
+        .filter(lambda x: x % 4 == 0)
+        .flat_map(lambda x: [x, x + 1])
+    )
+    out = ds.take_all()
+    expected = []
+    for x in range(20):
+        y = 2 * x
+        if y % 4 == 0:
+            expected.extend([y, y + 1])
+    assert out == expected
+    # one fused stage executed
+    assert "map+filter+flat_map" in ds.stats()
+
+
+def test_map_batches_numpy_and_pandas():
+    ds = rtd.from_numpy(np.arange(16.0))
+    doubled = ds.map_batches(lambda b: {"data": b["data"] * 2}).take_all()
+    assert [r["data"] for r in doubled] == [2.0 * i for i in range(16)]
+
+    import pandas as pd
+
+    df = pd.DataFrame({"a": [1, 2, 3], "b": [4.0, 5.0, 6.0]})
+    ds2 = rtd.from_pandas(df)
+    out = ds2.map_batches(
+        lambda pdf: pdf.assign(c=pdf.a + pdf.b), batch_format="pandas"
+    ).to_pandas()
+    assert list(out["c"]) == [5.0, 7.0, 9.0]
+
+
+def test_map_batches_with_actor_pool():
+    ds = rtd.range(32, parallelism=4)
+    out = ds.map_batches(
+        lambda b: (np.asarray(b) + 1),
+        compute=rtd.ActorPoolStrategy(min_size=1, max_size=2),
+    )
+    assert sorted(out.take_all()) == list(range(1, 33))
+
+
+def test_repartition():
+    ds = rtd.range(30, parallelism=3).repartition(5)
+    assert ds.num_blocks == 5
+    assert sorted(ds.take_all()) == list(range(30))
+    counts = [len(b) for b in [ray_tpu.get(r) for r in ds._execute()]]
+    assert all(c == 6 for c in counts)
+
+
+def test_random_shuffle():
+    ds = rtd.range(50, parallelism=5)
+    shuffled = ds.random_shuffle(seed=42).take_all()
+    assert sorted(shuffled) == list(range(50))
+    assert shuffled != list(range(50))
+    again = rtd.range(50, parallelism=5).random_shuffle(seed=42).take_all()
+    assert shuffled == again  # deterministic for a fixed seed
+
+
+def test_sort():
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(100).tolist()
+    ds = rtd.from_items(vals, parallelism=4).sort()
+    assert ds.take_all() == sorted(vals)
+    desc = rtd.from_items(vals, parallelism=4).sort(descending=True)
+    assert desc.take_all() == sorted(vals, reverse=True)
+
+
+def test_sort_by_key_column():
+    items = [{"k": i % 5, "v": i} for i in range(25)]
+    ds = rtd.from_items(items, parallelism=3).sort(key="k")
+    ks = [r["k"] for r in ds.take_all()]
+    assert ks == sorted(ks)
+
+
+def test_groupby_aggregates():
+    items = [{"k": i % 3, "v": float(i)} for i in range(12)]
+    ds = rtd.from_items(items, parallelism=4)
+    counts = {r["key"]: r["value"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 4, 1: 4, 2: 4}
+    sums = {
+        r["key"]: r["value"]
+        for r in ds.groupby("k").sum(on="v").take_all()
+    }
+    assert sums == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+    means = {
+        r["key"]: r["value"]
+        for r in ds.groupby("k").mean(on="v").take_all()
+    }
+    assert means[0] == pytest.approx(4.5)
+
+
+def test_split_equal():
+    ds = rtd.range(10, parallelism=3)
+    shards = ds.split(2, equal=True)
+    counts = [s.count() for s in shards]
+    assert counts == [5, 5]
+    all_vals = sorted(v for s in shards for v in s.take_all())
+    assert all_vals == list(range(10))
+
+
+def test_union_zip_limit():
+    a = rtd.range(5)
+    b = rtd.range(5).map(lambda x: x + 10)
+    assert a.union(b).count() == 10
+    z = a.zip(b).take_all()
+    assert z[0] == (0, 10)
+    assert rtd.range(100).limit(7).count() == 7
+
+
+def test_iter_batches_and_schema():
+    ds = rtd.from_numpy(np.arange(32.0))
+    batches = list(ds.iter_batches(batch_size=10, batch_format="numpy"))
+    sizes = [len(b["data"]) for b in batches]
+    assert sum(sizes) == 32
+    assert max(sizes) <= 10
+    assert "data" in ds.schema()
+
+
+def test_iter_device_batches(devices8):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(jax.devices()[:8], ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    ds = rtd.from_numpy(np.arange(64.0))
+    batches = list(
+        ds.iter_device_batches(batch_size=16, sharding=sharding)
+    )
+    assert len(batches) == 4
+    assert batches[0]["data"].sharding.is_equivalent_to(sharding, 1)
+    total = sum(float(jax.numpy.sum(b["data"])) for b in batches)
+    assert total == float(np.arange(64.0).sum())
+
+
+def test_read_write_roundtrip(tmp_path):
+    import pandas as pd
+
+    df = pd.DataFrame({"x": np.arange(20), "y": np.arange(20) * 1.5})
+    ds = rtd.from_pandas(df, parallelism=2)
+    ds.write_parquet(str(tmp_path / "pq"))
+    back = rtd.read_parquet(str(tmp_path / "pq"))
+    assert back.count() == 20
+    assert back.to_pandas()["y"].sum() == df["y"].sum()
+
+    ds.write_csv(str(tmp_path / "csv"))
+    back_csv = rtd.read_csv(str(tmp_path / "csv"))
+    assert back_csv.count() == 20
+
+
+def test_read_text_json(tmp_path):
+    p = tmp_path / "t.txt"
+    p.write_text("alpha\nbeta\ngamma\n")
+    assert rtd.read_text(str(p)).take_all() == ["alpha", "beta", "gamma"]
+    j = tmp_path / "d.jsonl"
+    j.write_text('{"a": 1}\n{"a": 2}\n')
+    assert [r["a"] for r in rtd.read_json(str(j)).take_all()] == [1, 2]
+
+
+def test_preprocessors():
+    import pandas as pd
+
+    df = pd.DataFrame(
+        {"a": [1.0, 2.0, 3.0, 4.0], "b": [10.0, 20.0, 30.0, 40.0],
+         "label": ["x", "y", "x", "z"]}
+    )
+    ds = rtd.from_pandas(df, parallelism=2)
+
+    scaler = StandardScaler(columns=["a"])
+    out = scaler.fit_transform(ds).to_pandas()
+    assert out["a"].mean() == pytest.approx(0.0, abs=1e-9)
+
+    mm = MinMaxScaler(columns=["b"]).fit(ds)
+    outb = mm.transform(ds).to_pandas()
+    assert outb["b"].min() == 0.0 and outb["b"].max() == 1.0
+
+    le = LabelEncoder("label").fit(ds)
+    outl = le.transform(ds).to_pandas()
+    assert set(outl["label"]) == {0, 1, 2}
+
+    chain = Chain(
+        BatchMapper(lambda b: {**b, "a2": np.asarray(b["a"]) * 2}),
+        Concatenator(exclude=["label"], output_column_name="features"),
+    )
+    feat = chain.fit_transform(ds).take(1)[0]["features"]
+    assert feat.shape == (3,)  # a, a2, b
+
+
+def test_train_integration_get_dataset_shard():
+    from ray_tpu import train
+    from ray_tpu.train import session
+
+    ds = rtd.range(16, parallelism=4)
+
+    def loop(config):
+        shard = session.get_dataset_shard("train")
+        vals = shard.take_all()
+        session.report({"n": len(vals), "sum": sum(vals)})
+
+    result = train.DataParallelTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=2),
+        datasets={"train": ds},
+    ).fit()
+    assert result.metrics["n"] == 8
