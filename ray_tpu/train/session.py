@@ -37,6 +37,7 @@ class _Session:
             "iteration": self.iteration,
             "metrics": dict(metrics),
             "checkpoint": checkpoint,
+            "trial_info": self.trial_info,
         }
         self.results_queue.put(payload)
 

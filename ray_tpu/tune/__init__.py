@@ -1,0 +1,71 @@
+"""Tune: distributed hyperparameter search over trial actors.
+
+Reference parity: ``python/ray/tune`` (SURVEY.md §2.3) — search spaces,
+variant generation, trial runner over actors with per-trial resources,
+ASHA / median-stopping / PBT schedulers, per-trial checkpoints + retries.
+"""
+
+from ray_tpu.train import session as _session
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search_space import (
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trial_runner import Trial, TrialRunner
+from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner, run
+
+
+def report(metrics: dict | None = None, *, checkpoint: Checkpoint | None = None,
+           **kwargs) -> None:
+    """``tune.report``: accepts a dict or keyword metrics."""
+    payload = dict(metrics or {})
+    payload.update(kwargs)
+    _session.report(payload, checkpoint=checkpoint)
+
+
+def get_checkpoint() -> Checkpoint | None:
+    return _session.get_checkpoint()
+
+
+def get_trial_id() -> str | None:
+    info = _session.get_trial_info()
+    return info["trial_id"] if info else None
+
+
+__all__ = [
+    "Tuner",
+    "TuneConfig",
+    "ResultGrid",
+    "TrialResult",
+    "Trial",
+    "TrialRunner",
+    "run",
+    "report",
+    "get_checkpoint",
+    "get_trial_id",
+    "uniform",
+    "quniform",
+    "loguniform",
+    "randint",
+    "choice",
+    "grid_search",
+    "sample_from",
+    "TrialScheduler",
+    "FIFOScheduler",
+    "ASHAScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "Checkpoint",
+]

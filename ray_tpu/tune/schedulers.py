@@ -1,0 +1,182 @@
+"""Trial schedulers: early stopping + population-based training.
+
+Reference parity: ``python/ray/tune/schedulers/`` — FIFO,
+AsyncHyperBand/ASHA (``async_hyperband.py``), median stopping rule
+(``median_stopping_rule.py``), and PBT (``pbt.py``) exploit/explore.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_trial_result(self, runner, trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, runner, trial, result: Optional[dict]) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Async successive halving: at each rung, stop trials below the top
+    1/reduction_factor quantile of peers that reached the rung."""
+
+    def __init__(
+        self,
+        metric: str = "score",
+        mode: str = "max",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+        time_attr: str = "training_iteration",
+    ):
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be max|min")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        # rung value t -> list of recorded metric values at that rung
+        self.rungs: Dict[int, List[float]] = {}
+        t = grace_period
+        while t < max_t:
+            self.rungs[t] = []
+            t *= reduction_factor
+
+    def on_trial_result(self, runner, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        if self.mode == "min":
+            value = -value
+        if t >= self.max_t:
+            return STOP
+        decision = CONTINUE
+        for rung_t in sorted(self.rungs, reverse=True):
+            if t >= rung_t:
+                recorded = self.rungs[rung_t]
+                recorded.append(value)
+                k = max(1, len(recorded) // self.rf)
+                cutoff = sorted(recorded, reverse=True)[k - 1]
+                if value < cutoff:
+                    decision = STOP
+                break
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running mean is below the median of completed
+    means at the same step."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        self.histories: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, runner, trial, result: dict) -> str:
+        value = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if value is None or t < self.grace:
+            return CONTINUE
+        if self.mode == "min":
+            value = -value
+        hist = self.histories.setdefault(trial.trial_id, [])
+        hist.append(value)
+        means = [
+            sum(h) / len(h)
+            for tid, h in self.histories.items()
+            if tid != trial.trial_id and h
+        ]
+        if len(means) < self.min_samples:
+            return CONTINUE
+        means.sort()
+        median = means[len(means) // 2]
+        mine = sum(hist) / len(hist)
+        return STOP if mine < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: every ``perturbation_interval`` results, bottom-quantile trials
+    exploit (copy checkpoint + config of) a top-quantile trial, then
+    explore (perturb hyperparameters) and restart from that checkpoint.
+
+    The runner performs the actual restart (see TrialRunner._pbt_exploit).
+    """
+
+    def __init__(
+        self,
+        metric: str = "score",
+        mode: str = "max",
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Optional[dict] = None,
+        quantile_fraction: float = 0.25,
+        time_attr: str = "training_iteration",
+        seed: Optional[int] = None,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.time_attr = time_attr
+        self.last_perturb: Dict[str, int] = {}
+        self.latest: Dict[str, float] = {}
+        self.rng = random.Random(seed)
+
+    def _score(self, result) -> Optional[float]:
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        return -v if self.mode == "min" else v
+
+    def on_trial_result(self, runner, trial, result: dict) -> str:
+        score = self._score(result)
+        if score is not None:
+            self.latest[trial.trial_id] = score
+        t = result.get(self.time_attr, 0)
+        if t - self.last_perturb.get(trial.trial_id, 0) < self.interval:
+            return CONTINUE
+        self.last_perturb[trial.trial_id] = t
+        ranked = sorted(self.latest.items(), key=lambda kv: kv[1])
+        n = len(ranked)
+        if n < 2:
+            return CONTINUE
+        k = max(1, int(n * self.quantile))
+        bottom = {tid for tid, _ in ranked[:k]}
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id in bottom:
+            donor_id = self.rng.choice(top)
+            if donor_id != trial.trial_id:
+                runner._pbt_exploit(trial, donor_id, self)
+        return CONTINUE
+
+    def perturb_config(self, config: dict) -> dict:
+        out = dict(config)
+        for key, mutation in self.mutations.items():
+            if callable(mutation):
+                out[key] = mutation()
+            elif isinstance(mutation, list):
+                out[key] = self.rng.choice(mutation)
+            elif isinstance(mutation, tuple) and len(mutation) == 2:
+                lo, hi = mutation
+                factor = self.rng.choice([0.8, 1.2])
+                out[key] = min(hi, max(lo, out.get(key, lo) * factor))
+        return out

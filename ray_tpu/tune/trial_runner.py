@@ -1,0 +1,225 @@
+"""Trial + TrialRunner: the Tune execution event loop.
+
+Reference parity: ``python/ray/tune/execution/trial_runner.py:319,961`` —
+the step loop asks the variant generator for configs, starts trials as
+actors (``RayTrialExecutor``), consumes reported results, applies
+scheduler decisions (ASHA stop / PBT exploit), retries failed trials from
+their last checkpoint, and tracks per-trial checkpoints.
+
+Function trainables run the user function inside the trial actor and
+report through the shared queue (``trainable/function_trainable.py:126``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.object_ref import ActorError, TaskError
+from ray_tpu.train import session as session_mod
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.util.queue import Queue
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+class Trial:
+    _counter = 0
+
+    def __init__(self, config: dict, resources: Optional[dict] = None):
+        Trial._counter += 1
+        self.trial_id = f"trial_{Trial._counter:05d}_{os.urandom(2).hex()}"
+        self.config = dict(config)
+        self.resources = resources or {"CPU": 1}
+        self.status = PENDING
+        self.last_result: Optional[dict] = None
+        self.metrics_history: List[dict] = []
+        self.checkpoint: Optional[Checkpoint] = None
+        self.error: Optional[BaseException] = None
+        self.num_failures = 0
+        self.generation = 0  # bumped on restart; stale reports are dropped
+        self.actor = None
+        self.run_ref = None
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status})"
+
+
+class _TrialActor:
+    """Actor hosting one trial's function trainable."""
+
+    def run(self, train_fn, config, session_kwargs):
+        session_mod.init_session(**session_kwargs)
+        try:
+            train_fn(config)
+        finally:
+            q = session_kwargs["results_queue"]
+            q.put({
+                "type": "finished",
+                "trial_info": session_kwargs.get("trial_info"),
+            })
+            session_mod.shutdown_session()
+        return True
+
+
+class TrialRunner:
+    def __init__(
+        self,
+        trainable: Callable,
+        trials: List[Trial],
+        *,
+        scheduler=None,
+        max_concurrent: int = 8,
+        max_failures: int = 0,
+    ):
+        self.trainable = trainable
+        self.trials = trials
+        self.by_id: Dict[str, Trial] = {t.trial_id: t for t in trials}
+        self.scheduler = scheduler or FIFOScheduler()
+        self.max_concurrent = max_concurrent
+        self.max_failures = max_failures
+        self.queue = Queue()
+        self._actor_cls = ray_tpu.remote(_TrialActor)
+
+    # -- lifecycle of one trial -------------------------------------------
+
+    def _start_trial(self, trial: Trial):
+        trial.generation += 1
+        session_kwargs = {
+            "world_rank": 0,
+            "world_size": 1,
+            "local_rank": 0,
+            "node_rank": 0,
+            "results_queue": self.queue,
+            "checkpoint": trial.checkpoint,
+            "dataset_shards": {},
+            "trial_info": {
+                "trial_id": trial.trial_id,
+                "generation": trial.generation,
+                "config": trial.config,
+            },
+        }
+        opts = {"num_cpus": trial.resources.get("CPU", 1)}
+        if trial.resources.get("TPU"):
+            opts["num_tpus"] = trial.resources["TPU"]
+        trial.actor = self._actor_cls.options(**opts).remote()
+        trial.run_ref = trial.actor.run.remote(
+            self.trainable, trial.config, session_kwargs
+        )
+        trial.status = RUNNING
+
+    def _stop_actor(self, trial: Trial):
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+        trial.actor = None
+        trial.run_ref = None
+
+    def _pbt_exploit(self, trial: Trial, donor_id: str, scheduler) -> None:
+        """Exploit+explore: adopt a perturbed copy of the donor's config and
+        restart from the donor's checkpoint (``pbt.py`` _exploit)."""
+        donor = self.by_id.get(donor_id)
+        if donor is None or donor.checkpoint is None:
+            return
+        self._stop_actor(trial)
+        trial.config = scheduler.perturb_config(donor.config)
+        trial.checkpoint = donor.checkpoint
+        self._start_trial(trial)
+
+    # -- event loop --------------------------------------------------------
+
+    def run(self) -> List[Trial]:
+        pending = [t for t in self.trials if t.status == PENDING]
+        try:
+            while True:
+                running = [t for t in self.trials if t.status == RUNNING]
+                while pending and len(running) < self.max_concurrent:
+                    t = pending.pop(0)
+                    self._start_trial(t)
+                    running.append(t)
+                if not running and not pending:
+                    break
+                self._drain_queue()
+                self._poll_completions(pending)
+        finally:
+            for t in self.trials:
+                self._stop_actor(t)
+            self.queue.shutdown()
+        return self.trials
+
+    def _drain_queue(self):
+        try:
+            msg = self.queue.get(timeout=0.2)
+        except Exception:
+            return
+        while True:
+            self._handle_message(msg)
+            try:
+                msg = self.queue.get(block=False)
+            except Exception:
+                return
+
+    def _handle_message(self, msg: dict):
+        info = msg.get("trial_info") or {}
+        trial = self.by_id.get(info.get("trial_id", ""))
+        if trial is None or msg["type"] != "report":
+            return
+        if info.get("generation") != trial.generation or trial.status != RUNNING:
+            return  # stale report from a superseded attempt
+        result = dict(msg["metrics"])
+        result.setdefault("training_iteration", msg["iteration"])
+        trial.last_result = result
+        trial.metrics_history.append(result)
+        if msg["checkpoint"] is not None:
+            trial.checkpoint = msg["checkpoint"]
+        decision = self.scheduler.on_trial_result(self, trial, result)
+        if decision == STOP:
+            self._stop_actor(trial)
+            trial.status = TERMINATED
+            self.scheduler.on_trial_complete(self, trial, result)
+
+    def _drain_all_nowait(self):
+        while True:
+            try:
+                msg = self.queue.get(block=False)
+            except Exception:
+                return
+            self._handle_message(msg)
+
+    def _poll_completions(self, pending: List[Trial]):
+        for trial in self.trials:
+            if trial.status != RUNNING or trial.run_ref is None:
+                continue
+            ready, _ = ray_tpu.wait([trial.run_ref], num_returns=1, timeout=0)
+            if not ready:
+                continue
+            # All of this attempt's reports were enqueued before run()
+            # returned — apply them before completing the trial.
+            self._drain_all_nowait()
+            if trial.status != RUNNING:
+                continue  # a drained report stopped it
+            try:
+                ray_tpu.get(trial.run_ref)
+            except (ActorError, TaskError) as e:
+                trial.num_failures += 1
+                if trial.num_failures <= self.max_failures:
+                    # Retry from the last checkpoint.
+                    self._stop_actor(trial)
+                    self._start_trial(trial)
+                    continue
+                trial.status = ERROR
+                trial.error = e
+                self._stop_actor(trial)
+                self.scheduler.on_trial_complete(self, trial, None)
+                continue
+            trial.status = TERMINATED
+            self._stop_actor(trial)
+            self.scheduler.on_trial_complete(self, trial, trial.last_result)

@@ -1,0 +1,148 @@
+"""Tuner / tune.run: the user-facing experiment API.
+
+Reference parity: ``python/ray/tune/tuner.py:44,239`` (Tuner.fit ->
+ResultGrid), ``tune/tune.py:131`` (tune.run), with trainers runnable as
+trainables (``Trainer.fit`` wraps itself into a 1-trial experiment,
+``train/base_trainer.py:339-363`` — here the composition goes the other
+way: a Tuner can run a DataParallelTrainer factory per trial).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.search_space import generate_variants
+from ray_tpu.tune.trial_runner import ERROR, TERMINATED, Trial, TrialRunner
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 8
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: dict
+    metrics: Optional[dict]
+    checkpoint: Optional[Checkpoint]
+    error: Optional[BaseException]
+    metrics_history: List[dict] = field(default_factory=list)
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult],
+                 default_metric: Optional[str], default_mode: str):
+        self._results = results
+        self._metric = default_metric
+        self._mode = default_mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[BaseException]:
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (none set in TuneConfig)")
+        candidates = [
+            r for r in self._results
+            if r.metrics is not None and metric in r.metrics
+        ]
+        if not candidates:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda r: r.metrics[metric]
+        return max(candidates, key=key) if mode == "max" else min(candidates, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = {"trial_id": r.trial_id, **{f"config/{k}": v for k, v in r.config.items()}}
+            row.update(r.metrics or {})
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resources_per_trial: Optional[dict] = None,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources_per_trial = resources_per_trial
+
+    def fit(self) -> ResultGrid:
+        variants = generate_variants(
+            self.param_space,
+            num_samples=self.tune_config.num_samples,
+            seed=self.tune_config.seed,
+        )
+        trials = [Trial(cfg, self.resources_per_trial) for cfg in variants]
+        runner = TrialRunner(
+            self.trainable,
+            trials,
+            scheduler=self.tune_config.scheduler,
+            max_concurrent=self.tune_config.max_concurrent_trials,
+            max_failures=self.run_config.failure_config.max_failures,
+        )
+        runner.run()
+        results = [
+            TrialResult(
+                t.trial_id, t.config, t.last_result, t.checkpoint, t.error,
+                t.metrics_history,
+            )
+            for t in trials
+        ]
+        return ResultGrid(results, self.tune_config.metric, self.tune_config.mode)
+
+
+def run(
+    trainable: Callable,
+    *,
+    config: Optional[dict] = None,
+    num_samples: int = 1,
+    scheduler: Optional[TrialScheduler] = None,
+    metric: Optional[str] = None,
+    mode: str = "max",
+    max_concurrent_trials: int = 8,
+    **_kw,
+) -> ResultGrid:
+    """Legacy ``tune.run`` entry point (``tune/tune.py:131``)."""
+    return Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(
+            metric=metric, mode=mode, num_samples=num_samples,
+            scheduler=scheduler, max_concurrent_trials=max_concurrent_trials,
+        ),
+    ).fit()

@@ -1,0 +1,228 @@
+"""Tune tests (modeled on reference searcher/scheduler/trial-runner tests
+in ``python/ray/tune/tests/``)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import (
+    ASHAScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TuneConfig,
+    Tuner,
+)
+from ray_tpu.tune.search_space import generate_variants
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=16)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_generate_variants_grid_and_samples():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "bs": tune.grid_search([8, 16]),
+        "wd": tune.uniform(0.0, 1.0),
+        "depth": tune.randint(1, 5),
+        "act": tune.choice(["relu", "gelu"]),
+    }
+    variants = generate_variants(space, num_samples=3, seed=0)
+    assert len(variants) == 12  # 2x2 grid x 3 samples
+    assert {(v["lr"], v["bs"]) for v in variants} == {
+        (0.1, 8), (0.1, 16), (0.01, 8), (0.01, 16)
+    }
+    assert all(0.0 <= v["wd"] <= 1.0 for v in variants)
+    assert all(v["depth"] in (1, 2, 3, 4) for v in variants)
+    # deterministic under the same seed
+    again = generate_variants(space, num_samples=3, seed=0)
+    assert [v["wd"] for v in again] == [v["wd"] for v in variants]
+
+
+def test_loguniform_bounds():
+    vals = [tune.loguniform(1e-4, 1e-1).sample(np.random.default_rng(i))
+            for i in range(50)]
+    assert all(1e-4 <= v <= 1e-1 for v in vals)
+
+
+def test_tuner_fit_and_best_result():
+    def objective(config):
+        score = -((config["x"] - 3.0) ** 2)
+        tune.report(score=score)
+
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0.0, 1.0, 3.0, 5.0])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(grid) == 4
+    best = grid.get_best_result()
+    assert best.config["x"] == 3.0
+    assert best.metrics["score"] == 0.0
+    df = grid.get_dataframe()
+    assert "config/x" in df.columns and len(df) == 4
+
+
+def test_trial_error_is_captured():
+    def objective(config):
+        if config["x"] == 1:
+            raise RuntimeError("bad trial")
+        tune.report(score=config["x"])
+
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=TuneConfig(metric="score"),
+    ).fit()
+    assert len(grid.errors) == 1
+    assert grid.get_best_result().config["x"] == 2
+
+
+def test_asha_stops_bad_trials_early():
+    iterations_run = {}
+
+    def objective(config):
+        for i in range(32):
+            tune.report(score=config["target"] * (i + 1))
+
+    # Descending order: good trials populate the rungs first, so the bad
+    # ones are stopped at their first rung (async halving semantics — a
+    # trial with no peers at a rung can never be stopped).
+    grid = Tuner(
+        objective,
+        param_space={"target": tune.grid_search([10.0, 1.0, 0.1, 0.0])},
+        tune_config=TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=ASHAScheduler(
+                metric="score", mode="max", max_t=32, grace_period=2,
+                reduction_factor=2,
+            ),
+            max_concurrent_trials=1,  # deterministic rung order
+        ),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.config["target"] == 10.0
+    # the worst trial must have been stopped before 32 iterations
+    worst = min(grid, key=lambda r: r.config["target"])
+    assert len(worst.metrics_history) < 32
+
+
+def test_median_stopping_rule_runs():
+    def objective(config):
+        for i in range(8):
+            tune.report(score=config["q"])
+
+    grid = Tuner(
+        objective,
+        param_space={"q": tune.grid_search([1.0, 1.0, 1.0, 0.0])},
+        tune_config=TuneConfig(
+            metric="score",
+            scheduler=MedianStoppingRule(metric="score", grace_period=1,
+                                         min_samples_required=2),
+            max_concurrent_trials=2,
+        ),
+    ).fit()
+    assert grid.get_best_result().metrics["score"] == 1.0
+
+
+def test_trial_retry_from_checkpoint():
+    def objective(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt.to_dict()["i"] + 1 if ckpt else 0
+        for i in range(start, 5):
+            tune.report({"i": i}, checkpoint=tune.Checkpoint.from_dict({"i": i}))
+            if i == 2 and ckpt is None:
+                raise RuntimeError("mid-trial crash")
+
+    from ray_tpu.train.config import FailureConfig, RunConfig
+
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0])},
+        tune_config=TuneConfig(metric="i"),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    r = grid[0]
+    assert r.error is None
+    assert r.metrics["i"] == 4
+    # history: 0,1,2 then resumed 3,4
+    assert [m["i"] for m in r.metrics_history] == [0, 1, 2, 3, 4]
+
+
+def test_pbt_exploits_and_perturbs():
+    """Low-lr trials should adopt (a perturbation of) the best lr."""
+
+    def objective(config):
+        # score grows with lr; PBT should migrate the population upward.
+        lr = config["lr"]
+        ckpt = tune.get_checkpoint()
+        total = ckpt.to_dict()["total"] if ckpt else 0.0
+        for i in range(16):
+            total += lr
+            tune.report(
+                {"score": total, "lr": lr},
+                checkpoint=tune.Checkpoint.from_dict({"total": total}),
+            )
+
+    pbt = PopulationBasedTraining(
+        metric="score",
+        mode="max",
+        perturbation_interval=4,
+        hyperparam_mutations={"lr": (0.001, 1.0)},
+        quantile_fraction=0.5,
+        seed=0,
+    )
+    grid = Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.001, 0.002, 0.5, 0.6])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=pbt,
+                               max_concurrent_trials=4),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] > 0.5
+    # at least one low-lr trial was exploited into a higher-lr config
+    final_lrs = sorted(r.config["lr"] for r in grid)
+    assert final_lrs[0] > 0.001 or final_lrs[1] > 0.002
+
+
+def test_tune_run_legacy_entry():
+    def objective(config):
+        tune.report(score=config["x"] ** 2)
+
+    grid = tune.run(
+        objective,
+        config={"x": tune.grid_search([1, 2, 3])},
+        metric="score",
+        mode="min",
+    )
+    assert grid.get_best_result().config["x"] == 1
+
+
+def test_tuner_over_trainer():
+    """Tune × Train composition: each trial runs a DataParallelTrainer."""
+    from ray_tpu import train
+
+    def trial_fn(config):
+        def loop(loop_config):
+            train.session.report({"loss": loop_config["lr"] * 10})
+
+        result = train.DataParallelTrainer(
+            loop,
+            train_loop_config={"lr": config["lr"]},
+            scaling_config=train.ScalingConfig(num_workers=1),
+        ).fit()
+        tune.report(loss=result.metrics["loss"])
+
+    grid = Tuner(
+        trial_fn,
+        param_space={"lr": tune.grid_search([0.1, 0.01])},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               max_concurrent_trials=2),
+    ).fit()
+    assert grid.get_best_result().config["lr"] == 0.01
