@@ -45,6 +45,11 @@ class GPT2Config:
     param_dtype: Any = jnp.float32
     remat: bool = True
     use_flash: bool | None = None  # None = auto by seq_len/backend
+    # Attention parallelism: "auto" (GSPMD-partitioned dense/flash),
+    # "ring" (sp-axis ring attention, ppermute KV), or "ulysses"
+    # (sp-axis all_to_all head scatter). ring/ulysses need ``mesh``.
+    attention_impl: str = "auto"
+    mesh: Any = dataclasses.field(default=None, compare=False)
 
     @property
     def head_dim(self) -> int:
@@ -157,7 +162,16 @@ def _block(x: jax.Array, p: Params, cfg: GPT2Config) -> jax.Array:
     q = q.reshape(b, t, h, hd)
     k_ = k_.reshape(b, t, h, hd)
     v_ = v_.reshape(b, t, h, hd)
-    attn = causal_attention(q, k_, v_, use_flash=cfg.use_flash)
+    if cfg.attention_impl == "ring" and cfg.mesh is not None:
+        from ray_tpu.ops.ring_attention import ring_causal_attention
+
+        attn = ring_causal_attention(q, k_, v_, cfg.mesh, axis="sp")
+    elif cfg.attention_impl == "ulysses" and cfg.mesh is not None:
+        from ray_tpu.ops.ulysses import ulysses_attention
+
+        attn = ulysses_attention(q, k_, v_, cfg.mesh, axis="sp")
+    else:
+        attn = causal_attention(q, k_, v_, use_flash=cfg.use_flash)
     attn = attn.reshape(b, t, d)
     x = x + attn @ p["attn_out_w"].astype(dt) + p["attn_out_b"].astype(dt)
     x = with_logical_constraint(x, ("batch", "seq", None))

@@ -5,6 +5,24 @@ bf16 matmuls that XLA can tile onto the systolic array, with Pallas kernels
 for the ops XLA does not fuse well (flash attention with causal masking).
 """
 
-from ray_tpu.ops.attention import causal_attention
+from ray_tpu.ops.attention import causal_attention, xla_causal_attention
+from ray_tpu.ops.flash_attention import flash_causal_attention
+from ray_tpu.ops.ring_attention import (
+    ring_causal_attention,
+    ring_causal_attention_local,
+)
+from ray_tpu.ops.ulysses import ulysses_attention, ulysses_attention_local
+from ray_tpu.ops.moe import init_moe_params, moe_ffn, moe_ffn_ep
 
-__all__ = ["causal_attention"]
+__all__ = [
+    "causal_attention",
+    "xla_causal_attention",
+    "flash_causal_attention",
+    "ring_causal_attention",
+    "ring_causal_attention_local",
+    "ulysses_attention",
+    "ulysses_attention_local",
+    "init_moe_params",
+    "moe_ffn",
+    "moe_ffn_ep",
+]

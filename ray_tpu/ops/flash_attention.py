@@ -1,0 +1,223 @@
+"""Pallas TPU flash attention (causal) with a blockwise backward.
+
+Forward: one Pallas kernel per (batch*head, q-block) grid cell streams K/V
+blocks through VMEM with online-softmax accumulation — the [T, T] score
+matrix never exists in HBM (the reason XLA attention OOMs at long T).
+
+Backward: custom VJP that recomputes attention blockwise with `lax.scan`
+over key blocks (pure XLA, fp32 accumulators). It keeps the same O(T)
+memory property; the recompute trades FLOPs for HBM exactly like
+`jax.checkpoint` (SURVEY.md "HBM bandwidth" note).
+
+On CPU (tests) the kernel runs in Pallas interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, block_q: int, block_k: int, n_kb: int,
+                softmax_scale: float, causal: bool):
+    """Grid (bh, q_block, k_block), k innermost: pallas double-buffers the
+    K/V block DMAs while the previous block's matmuls run. Running
+    (max, denom, acc) live in VMEM scratch that persists across the k
+    sweep; outputs are finalized on the last k block."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal skip: a k block entirely in the future contributes nothing.
+    needed = (k_start <= q_start + block_q - 1) if causal else True
+
+    @pl.when(needed)
+    def _accumulate():
+        q = q_ref[:].astype(jnp.float32) * softmax_scale
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[:] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[:] = m_scr[:] + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, *, block_q: int, block_k: int, softmax_scale: float,
+               causal: bool, interpret: bool):
+    """q,k,v: [B, T, H, D] -> (out [B,T,H,D], lse [B,H,T])."""
+    b, t, h, d = q.shape
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    n_kb = t // block_k
+    grid = (b * h, t // block_q, n_kb)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, block_q=block_q, block_k=block_k, n_kb=n_kb,
+            softmax_scale=softmax_scale, causal=causal,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+            # lse rides a trailing singleton lane dim to satisfy the TPU
+            # block-tiling rule (last dim == array dim).
+            pl.BlockSpec((None, block_q, 1), lambda bh, qi, kb: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qr, kr, vr)
+    out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, t)
+    return out, lse
+
+
+def _blockwise_bwd(q, k, v, out, lse, g, *, block_q: int,
+                   softmax_scale: float, causal: bool):
+    """Gradients via blockwise recompute (XLA scan over q blocks).
+
+    Memory: O(T * block_q) scores at a time instead of O(T^2).
+    """
+    b, t, h, d = q.shape
+    f32 = jnp.float32
+    qf = q.astype(f32)
+    kf = k.astype(f32)
+    vf = v.astype(f32)
+    gf = g.astype(f32)
+    of = out.astype(f32)
+    # delta = rowsum(dO * O) — the softmax-jacobian diagonal term.
+    delta = jnp.einsum("bthd,bthd->bht", gf, of)
+
+    n_q = t // block_q
+    k_pos = jnp.arange(t)
+
+    def per_qblock(carry, qi):
+        dk_acc, dv_acc = carry
+        qs = qi * block_q
+        q_blk = jax.lax.dynamic_slice_in_dim(qf, qs, block_q, 1)
+        g_blk = jax.lax.dynamic_slice_in_dim(gf, qs, block_q, 1)
+        lse_blk = jax.lax.dynamic_slice_in_dim(lse, qs, block_q, 2)
+        delta_blk = jax.lax.dynamic_slice_in_dim(delta, qs, block_q, 2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q_blk, kf) * softmax_scale
+        if causal:
+            q_pos = qs + jnp.arange(block_q)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jnp.exp(s - lse_blk[..., None])  # [B,H,bq,T]
+        dv = jnp.einsum("bhqk,bqhd->bkhd", p, g_blk)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", g_blk, vf)
+        ds = p * (dp - delta_blk[..., None]) * softmax_scale
+        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+        dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q_blk)
+        return (dk_acc + dk, dv_acc + dv), dq_blk
+
+    (dk, dv), dq_blocks = jax.lax.scan(
+        per_qblock,
+        (jnp.zeros_like(kf), jnp.zeros_like(vf)),
+        jnp.arange(n_q),
+    )
+    # [n_q, B, bq, H, D] -> [B, T, H, D]
+    dq = dq_blocks.transpose(1, 0, 2, 3, 4).reshape(b, t, h, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash_attention(q, k, v, block_q, block_k, softmax_scale, causal,
+                     interpret):
+    out, _ = _flash_fwd(
+        q, k, v, block_q=block_q, block_k=block_k,
+        softmax_scale=softmax_scale, causal=causal, interpret=interpret,
+    )
+    return out
+
+
+def _vjp_fwd(q, k, v, block_q, block_k, softmax_scale, causal, interpret):
+    out, lse = _flash_fwd(
+        q, k, v, block_q=block_q, block_k=block_k,
+        softmax_scale=softmax_scale, causal=causal, interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _vjp_bwd(block_q, block_k, softmax_scale, causal, interpret, res, g):
+    q, k, v, out, lse = res
+    return _blockwise_bwd(
+        q, k, v, out, lse, g, block_q=block_q,
+        softmax_scale=softmax_scale, causal=causal,
+    )
+
+
+_flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def flash_causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *,
+    softmax_scale: float | None = None,
+    block_q: int = 256, block_k: int = 256,
+) -> jax.Array:
+    """[B, T, H, D] causal flash attention (differentiable)."""
+    b, t, h, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise NotImplementedError(
+            f"seq len {t} must be divisible by block sizes ({block_q},{block_k})"
+        )
+    interpret = jax.default_backend() == "cpu"
+    return _flash_attention(
+        q, k, v, block_q, block_k, scale, True, interpret
+    )

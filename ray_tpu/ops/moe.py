@@ -1,0 +1,154 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+SURVEY.md §2.4: expert-axis sharding + all_to_all dispatch — absent from
+the reference, first-class here. Design (switch-style top-1 / top-2):
+
+  * router: [tokens, E] logits -> top-k experts per token + combine weights;
+  * capacity: each expert takes at most C = capacity_factor * tokens/E
+    tokens per device shard; overflow tokens are dropped (standard switch
+    behavior) — keeps shapes static for XLA;
+  * dispatch: one-hot combine matrices turn gather/scatter into einsums
+    (MXU-friendly; no dynamic shapes);
+  * expert parallelism: experts shard over mesh axis ``ep``; the dispatch
+    einsum's tokens flow through ``all_to_all`` so each device computes
+    only its local experts' FFNs.
+
+The dense path (``moe_ffn``) works on any mesh; ``moe_ffn_ep`` adds the
+all_to_all when an ``ep`` axis exists.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def init_moe_params(rng, d_model: int, d_ff: int, n_experts: int,
+                    dtype=jnp.float32) -> dict:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    std = 0.02
+    return {
+        "router": (jax.random.normal(k1, (d_model, n_experts)) * std).astype(dtype),
+        "w_in": (jax.random.normal(k2, (n_experts, d_model, d_ff)) * std).astype(dtype),
+        "w_out": (jax.random.normal(k3, (n_experts, d_ff, d_model)) * std).astype(dtype),
+    }
+
+
+def moe_param_axes() -> dict:
+    """Logical axes: experts shard over ep; ffn dim over tp."""
+    return {
+        "router": ("embed", None),
+        "w_in": ("experts", "embed", "mlp"),
+        "w_out": ("experts", "mlp", "embed"),
+    }
+
+
+def _route(x2d, router_w, n_experts, top_k, capacity):
+    """Returns (dispatch [T, E, C] one-hot, combine [T, E, C] weights,
+    aux_loss). Shapes static; overflow dropped."""
+    logits = x2d.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+    t = x2d.shape[0]
+
+    gates, experts = jax.lax.top_k(probs, top_k)  # [T, k]
+    # Load-balancing auxiliary loss (Switch Transformer eq. 4).
+    density = jnp.mean(probs, axis=0)
+    top1_mask = jax.nn.one_hot(experts[:, 0], n_experts)
+    density_proxy = jnp.mean(top1_mask, axis=0)
+    aux_loss = n_experts * jnp.sum(density * density_proxy)
+
+    dispatch = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    combine = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    # Position of each token within its expert's capacity buffer: running
+    # per-expert counts across the k routing slots keep positions unique.
+    counts = jnp.zeros((n_experts,), jnp.float32)
+    for j in range(top_k):
+        onehot = jax.nn.one_hot(experts[:, j], n_experts)  # [T, E]
+        prior = jnp.cumsum(onehot, axis=0) - onehot + counts[None, :]
+        pos = jnp.sum(prior * onehot, axis=1).astype(jnp.int32)  # [T]
+        counts = counts + jnp.sum(onehot, axis=0)
+        keep = pos < capacity
+        pos_oh = jax.nn.one_hot(pos, capacity)  # [T, C]
+        sel = (onehot * keep[:, None])[:, :, None] * pos_oh[:, None, :]
+        dispatch = dispatch + sel
+        combine = combine + sel * gates[:, j][:, None, None]
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(params: dict, x: jax.Array, *, top_k: int = 1,
+            capacity_factor: float = 1.25,
+            activation=jax.nn.gelu) -> tuple[jax.Array, jax.Array]:
+    """Dense-mesh MoE FFN. x: [B, T, D] -> ([B, T, D], aux_loss).
+
+    All experts computed on every device (XLA partitions the expert einsum
+    by the param shardings); for explicit expert parallelism use
+    ``moe_ffn_ep``.
+    """
+    b, t, d = x.shape
+    e = params["router"].shape[1]
+    x2d = x.reshape(b * t, d)
+    capacity = max(1, int(capacity_factor * (b * t) / e))
+    dispatch, combine, aux = _route(x2d, params["router"], e, top_k, capacity)
+    # [E, C, D] expert inputs via einsum dispatch.
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x2d.astype(jnp.float32))
+    h = jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"].astype(jnp.float32))
+    h = activation(h)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(jnp.float32))
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out.reshape(b, t, d).astype(x.dtype), aux
+
+
+def moe_ffn_ep(params: dict, x: jax.Array, mesh: Mesh, *,
+               axis: str = "ep", top_k: int = 1,
+               capacity_factor: float = 1.25,
+               activation=jax.nn.gelu,
+               batch_axes=("dp", "fsdp")) -> tuple[jax.Array, jax.Array]:
+    """Expert-parallel MoE: tokens all_to_all to their experts' devices.
+
+    x: [B, T, D] with B sharded over batch_axes; experts sharded over
+    ``axis``. Per-device: route locally, all_to_all token buffers so each
+    device holds only its E/ep experts' inputs, compute FFN, route back.
+    """
+    ep = mesh.shape[axis]
+    e = params["router"].shape[1]
+    if e % ep:
+        raise ValueError(f"n_experts {e} must divide by ep={ep}")
+
+    def local(px, p_router, p_win, p_wout):
+        b, t, d = px.shape
+        x2d = px.reshape(b * t, d)
+        capacity = max(1, int(capacity_factor * (b * t) / e))
+        dispatch, combine, aux = _route(x2d, p_router, e, top_k, capacity)
+        # [E, C, D] on this device -> exchange so device i holds expert
+        # rows for its local experts from ALL devices' tokens:
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, x2d.astype(jnp.float32))
+        # [E, C, D] -> [E/ep, ep*C, D]: split experts, concat capacity.
+        expert_in = jax.lax.all_to_all(
+            expert_in, axis, split_axis=0, concat_axis=1, tiled=True
+        )
+        h = activation(jnp.einsum(
+            "ecd,edf->ecf", expert_in, p_win.astype(jnp.float32)
+        ))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, p_wout.astype(jnp.float32))
+        # Route back: [E/ep, ep*C, D] -> [E, C, D].
+        expert_out = jax.lax.all_to_all(
+            expert_out, axis, split_axis=1, concat_axis=0, tiled=True
+        )
+        out = jnp.einsum("tec,ecd->td", combine, expert_out)
+        aux = jax.lax.pmean(aux, axis)
+        return out.reshape(b, t, d).astype(px.dtype), aux
+
+    xspec = P(batch_axes, None, None)
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(xspec, P(), P(axis, None, None), P(axis, None, None)),
+        out_specs=(xspec, P()),
+        check_vma=False,
+    )
+    return fn(x, params["router"], params["w_in"], params["w_out"])

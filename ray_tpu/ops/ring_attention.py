@@ -1,0 +1,100 @@
+"""Ring attention: causal attention over a sequence-sharded axis.
+
+Long-context scaling (SURVEY.md §5.7 — absent from the reference, required
+here): Q/K/V are sharded over mesh axis ``sp`` ([B, T/sp, H, D] per
+device). Each device computes blockwise attention of its Q shard against
+the K/V shard it currently holds, then rotates K/V around the ring with
+``ppermute`` — sp steps visit every KV block while only ever holding
+O(T/sp) keys, and the permute overlaps with the next block's compute (XLA
+schedules the collective-permute concurrently with the matmuls).
+
+Causal masking across shards: a KV shard strictly *ahead* of the Q shard
+contributes nothing (skipped by masking the whole block), the diagonal
+shard uses the triangular mask, and shards behind contribute fully.
+Online-softmax merging keeps fp32 running (max, denom, acc) — the same
+math as flash attention, at ring granularity.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_chunk_idx, kv_chunk_idx, chunk, scale):
+    """Scores of local q against one kv chunk with cross-chunk causality.
+    q,k,v: [B, C, H, D]; returns (scores_max m, exp-sum l, weighted acc)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    q_pos = q_chunk_idx * chunk + jnp.arange(chunk)
+    k_pos = kv_chunk_idx * chunk + jnp.arange(chunk)
+    mask = q_pos[:, None] >= k_pos[None, :]
+    s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B,H,Q,1]
+    # Guard fully-masked rows (kv chunk entirely in the future).
+    m_safe = jnp.maximum(m, _NEG_INF / 2)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m_safe, l, acc
+
+
+def ring_causal_attention_local(q, k, v, *, axis_size: int, axis: str = "sp",
+                                softmax_scale: float | None = None):
+    """The per-device body: call INSIDE shard_map over ``axis``.
+
+    q/k/v per-device: [B, C, H, D] where C = T / sp. The ring loop is
+    unrolled (sp is small and static) so the whole op stays reverse-mode
+    differentiable and XLA can overlap each ppermute with the next
+    block's compute.
+    """
+    b, c, h, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+    sp = axis_size
+    my_idx = jax.lax.axis_index(axis)
+    perm = [(i, (i - 1) % sp) for i in range(sp)]  # kv travels backward
+
+    qf = q.astype(jnp.float32)
+    m = jnp.full((b, h, c, 1), _NEG_INF / 2, jnp.float32)
+    l = jnp.zeros((b, h, c, 1), jnp.float32)
+    acc = jnp.zeros((b, c, h, d), jnp.float32)
+    k_cur, v_cur = k, v
+    for i in range(sp):
+        kv_idx = (my_idx + i) % sp
+        bm, bl, bacc = _block_attend(qf, k_cur, v_cur, my_idx, kv_idx, c, scale)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(bm - m_new)
+        l = l * alpha + bl * beta
+        acc = acc * jnp.swapaxes(alpha, 1, 2) + bacc * jnp.swapaxes(beta, 1, 2)
+        m = m_new
+        if i != sp - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+    out = acc / jnp.maximum(jnp.swapaxes(l, 1, 2), 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_causal_attention(q, k, v, mesh: Mesh, *, axis: str = "sp",
+                          softmax_scale: float | None = None,
+                          batch_axes=("dp", "fsdp")):
+    """Full-array entry: q/k/v [B, T, H, D] with T sharded over ``axis``."""
+    spec = P(batch_axes, axis, None, None)
+    fn = shard_map(
+        functools.partial(
+            ring_causal_attention_local, axis=axis,
+            axis_size=mesh.shape[axis], softmax_scale=softmax_scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -21,8 +21,11 @@ from ray_tpu.parallel.sharding import (
     shard_pytree,
     with_logical_constraint,
 )
+from ray_tpu.parallel.pipeline import pipeline_apply, pipeline_apply_local
 
 __all__ = [
+    "pipeline_apply",
+    "pipeline_apply_local",
     "AXIS_ORDER",
     "MeshConfig",
     "auto_mesh_config",

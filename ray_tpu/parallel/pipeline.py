@@ -1,0 +1,82 @@
+"""Pipeline parallelism: GPipe microbatch schedule over mesh axis ``pp``.
+
+SURVEY.md §2.4 (absent from the reference, first-class here): layer stacks
+shard over ``pp``; microbatches stream through the stages with
+``ppermute`` forwarding activations stage->stage each tick. Total ticks =
+n_micro + pp - 1 (the pipeline bubble); all devices run the same program
+(SPMD), with stage identity = ``axis_index``.
+
+Requirements: every stage maps activations [mb, ...] -> [mb, ...] of the
+same shape (the transformer-block case), and stage parameters are a pytree
+whose leaves have a leading ``pp``-sharded stage dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply_local(stage_params, x_micro, *, stage_fn: Callable,
+                         axis: str = "pp", axis_size: int):
+    """Per-device body (inside shard_map over ``axis``).
+
+    stage_params: this stage's params (leading stage dim of size 1, squeezed
+    here). x_micro: [n_micro, mb, ...] (replicated). Returns this device's
+    per-tick outputs [n_ticks, mb, ...]; the caller extracts the last
+    stage's valid ticks.
+    """
+    pp = axis_size
+    s = jax.lax.axis_index(axis)
+    params = jax.tree.map(lambda p: p[0], stage_params)
+    n_micro = x_micro.shape[0]
+    n_ticks = n_micro + pp - 1
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def tick(carry, t):
+        arriving = carry
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        x0 = jax.lax.dynamic_index_in_dim(x_micro, mb_idx, 0, keepdims=False)
+        inp = jnp.where(s == 0, x0, arriving)
+        out = stage_fn(params, inp)
+        sent = jax.lax.ppermute(out, axis, perm)
+        return sent, out
+
+    _, ys = jax.lax.scan(tick, jnp.zeros_like(x_micro[0]), jnp.arange(n_ticks))
+    return ys[None]  # restore a device-stacked leading dim for out_specs
+
+
+def pipeline_apply(stage_params, x, mesh: Mesh, *, stage_fn: Callable,
+                   n_micro: int, axis: str = "pp"):
+    """Run x [batch, ...] through the pp-sharded stage stack.
+
+    stage_params: pytree with leading dim == mesh.shape[axis] (one slice
+    per stage), sharded P(axis, ...). Returns [batch, ...] outputs.
+    """
+    pp = mesh.shape[axis]
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} must divide into {n_micro} microbatches")
+    x_micro = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+
+    param_specs = jax.tree.map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stage_params
+    )
+    fn = shard_map(
+        functools.partial(
+            pipeline_apply_local, stage_fn=stage_fn, axis=axis, axis_size=pp
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    ys = fn(stage_params, x_micro)  # [pp, n_ticks, mb, ...]
+    # Valid outputs: last stage (pp-1), ticks pp-1 .. pp-1+n_micro-1.
+    outs = ys[pp - 1, pp - 1 : pp - 1 + n_micro]
+    return outs.reshape(b, *x.shape[1:])

@@ -1,0 +1,171 @@
+"""Parallelism kernels vs dense references on the 8-device CPU mesh.
+
+This is the §5.7 coverage the reference lacks: ring attention, Ulysses,
+MoE expert parallelism, pipeline parallelism — each checked numerically
+against a single-device dense implementation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops.attention import xla_causal_attention
+from ray_tpu.ops.flash_attention import flash_causal_attention
+from ray_tpu.ops.moe import init_moe_params, moe_ffn, moe_ffn_ep
+from ray_tpu.ops.ring_attention import ring_causal_attention
+from ray_tpu.ops.ulysses import ulysses_attention
+from ray_tpu.parallel.pipeline import pipeline_apply
+
+
+def _qkv(rng_seed=0, b=2, t=64, h=4, d=16, dtype=jnp.float32):
+    rng = jax.random.key(rng_seed)
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, t, h, d), dtype)
+    k = jax.random.normal(kk, (b, t, h, d), dtype)
+    v = jax.random.normal(kv, (b, t, h, d), dtype)
+    return q, k, v
+
+
+@pytest.fixture(scope="module")
+def sp_mesh(devices8):
+    return Mesh(np.array(devices8).reshape(2, 4), ("dp", "sp"))
+
+
+def test_flash_attention_matches_xla():
+    q, k, v = _qkv(t=128)
+    ref = xla_causal_attention(q, k, v)
+    out = flash_causal_attention(q, k, v, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_gradients_match():
+    q, k, v = _qkv(t=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_causal_attention(q, k, v, block_q=16, block_k=16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_causal_attention(q, k, v) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_matches_dense(sp_mesh):
+    q, k, v = _qkv(t=64)
+    ref = xla_causal_attention(q, k, v)
+    out = ring_causal_attention(q, k, v, sp_mesh, axis="sp",
+                                batch_axes=("dp",))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_differentiable(sp_mesh):
+    q, k, v = _qkv(t=32)
+
+    @jax.jit
+    def loss(q, k, v):
+        out = ring_causal_attention(q, k, v, sp_mesh, axis="sp",
+                                    batch_axes=("dp",))
+        return jnp.sum(out ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_causal_attention(q, k, v) ** 2)
+
+    g = jax.grad(loss)(q, k, v)
+    g_ref = jax.grad(loss_ref)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_matches_dense(sp_mesh):
+    q, k, v = _qkv(t=64, h=8)  # heads divisible by sp=4
+    ref = xla_causal_attention(q, k, v)
+    out = ulysses_attention(q, k, v, sp_mesh, axis="sp", batch_axes=("dp",))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_moe_dense_routes_and_balances():
+    rng = jax.random.key(0)
+    params = init_moe_params(rng, d_model=16, d_ff=32, n_experts=4)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 16))
+    out, aux = moe_ffn(params, x, top_k=2, capacity_factor=2.0)
+    assert out.shape == x.shape
+    assert np.isfinite(float(aux))
+    # capacity large enough + top2 -> every token routed: output nonzero
+    assert float(jnp.mean(jnp.abs(out))) > 1e-4
+
+
+def test_moe_expert_parallel_matches_dense(devices8):
+    mesh = Mesh(np.array(devices8).reshape(2, 4), ("dp", "ep"))
+    rng = jax.random.key(0)
+    params = init_moe_params(rng, d_model=16, d_ff=32, n_experts=8)
+    x = jax.random.normal(jax.random.key(1), (4, 8, 16))
+    dense_out, dense_aux = moe_ffn(params, x, top_k=1, capacity_factor=4.0)
+
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None, None)))
+    ep_out, ep_aux = moe_ffn_ep(params, xs, mesh, axis="ep", top_k=1,
+                                capacity_factor=4.0, batch_axes=("dp",))
+    # Same routing math on the same tokens => identical outputs.
+    np.testing.assert_allclose(np.asarray(ep_out), np.asarray(dense_out),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_matches_sequential(devices8):
+    mesh = Mesh(np.array(devices8[:4]), ("pp",))
+    pp = 4
+    rng = jax.random.key(0)
+    d = 16
+    ws = jax.random.normal(rng, (pp, d, d)) * 0.3
+    stage_params = {"w": ws}
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"])
+
+    x = jax.random.normal(jax.random.key(1), (8, d))
+    # sequential reference
+    ref = x
+    for i in range(pp):
+        ref = stage_fn({"w": ws[i]}, ref)
+
+    ws_sharded = jax.device_put(ws, NamedSharding(mesh, P("pp", None, None)))
+    out = pipeline_apply({"w": ws_sharded}, x, mesh, stage_fn=stage_fn,
+                         n_micro=4, axis="pp")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_differentiable(devices8):
+    mesh = Mesh(np.array(devices8[:2]), ("pp",))
+    d = 8
+    ws = jax.random.normal(jax.random.key(0), (2, d, d)) * 0.3
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"])
+
+    x = jax.random.normal(jax.random.key(1), (4, d))
+
+    def loss_pp(ws):
+        out = pipeline_apply(
+            {"w": ws}, x, mesh, stage_fn=stage_fn, n_micro=2, axis="pp"
+        )
+        return jnp.sum(out ** 2)
+
+    def loss_ref(ws):
+        y = x
+        for i in range(2):
+            y = stage_fn({"w": ws[i]}, y)
+        return jnp.sum(y ** 2)
+
+    g = jax.grad(loss_pp)(ws)
+    g_ref = jax.grad(loss_ref)(ws)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-4)
