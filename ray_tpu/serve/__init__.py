@@ -1,0 +1,169 @@
+"""Serve: scalable model serving on actors.
+
+Reference parity: ``python/ray/serve`` (SURVEY.md §2.3, §3.5) —
+``@serve.deployment`` -> ``serve.run`` -> controller-reconciled replica
+actors, handles with power-of-two routing + backpressure, an HTTP proxy,
+and ``@serve.batch`` dynamic batching. On TPU the replica's callable
+typically wraps a jitted inference function; replicas-per-chip is the
+scaling unit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve._private import (
+    CONTROLLER_NAME,
+    DeploymentHandle,
+    HTTPProxy,
+    batch,
+    get_or_create_controller,
+)
+
+
+@dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    max_concurrent_queries: int = 100
+    route_prefix: Optional[str] = None
+    version: Optional[str] = None
+    user_config: Optional[dict] = None
+    ray_actor_options: Optional[dict] = None
+    init_args: tuple = ()
+    init_kwargs: dict = field(default_factory=dict)
+
+    def options(self, **kwargs) -> "Deployment":
+        known = {f for f in self.__dataclass_fields__}  # noqa: C416
+        bad = set(kwargs) - known
+        if bad:
+            raise ValueError(f"unknown deployment options: {bad}")
+        merged = {**self.__dict__, **kwargs}
+        return Deployment(**merged)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+
+@dataclass
+class Application:
+    deployment: Deployment
+    init_args: tuple
+    init_kwargs: dict
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_concurrent_queries: int = 100,
+               route_prefix: Optional[str] = None,
+               version: Optional[str] = None,
+               user_config: Optional[dict] = None,
+               ray_actor_options: Optional[dict] = None):
+    """``@serve.deployment`` decorator (``python/ray/serve/api.py``)."""
+
+    def wrap(target):
+        return Deployment(
+            func_or_class=target,
+            name=name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            route_prefix=route_prefix,
+            version=version,
+            user_config=user_config,
+            ray_actor_options=ray_actor_options,
+        )
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+def run(target: "Application | Deployment", *, name: Optional[str] = None,
+        route_prefix: Optional[str] = None) -> DeploymentHandle:
+    """Deploy (or redeploy) and return a handle
+    (``serve/api.py:455``)."""
+    if isinstance(target, Deployment):
+        target = target.bind()
+    dep = target.deployment
+    controller = get_or_create_controller()
+    ray_tpu.get(
+        controller.deploy.remote(
+            name or dep.name,
+            dep.func_or_class,
+            target.init_args,
+            target.init_kwargs,
+            dep.num_replicas,
+            dep.max_concurrent_queries,
+            route_prefix if route_prefix is not None else dep.route_prefix,
+            dep.version,
+            dep.ray_actor_options,
+        ),
+        timeout=120,
+    )
+    return DeploymentHandle(name or dep.name)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def get_app_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def delete(name: str) -> None:
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
+def status() -> Dict[str, dict]:
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.status.remote(), timeout=30)
+
+
+_proxy_handle = None
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the HTTP ingress; returns the bound port."""
+    global _proxy_handle
+    proxy_cls = ray_tpu.remote(HTTPProxy)
+    _proxy_handle = proxy_cls.options(num_cpus=0, max_concurrency=16).remote(
+        host, port
+    )
+    return ray_tpu.get(_proxy_handle.get_port.remote(), timeout=60)
+
+
+def shutdown() -> None:
+    global _proxy_handle
+    if _proxy_handle is not None:
+        try:
+            ray_tpu.get(_proxy_handle.stop.remote(), timeout=10)
+            ray_tpu.kill(_proxy_handle)
+        except Exception:
+            pass
+        _proxy_handle = None
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.shutdown_all.remote(), timeout=60)
+        ray_tpu.kill(controller)
+    except ValueError:
+        pass
+
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "DeploymentHandle",
+    "run",
+    "get_deployment_handle",
+    "get_app_handle",
+    "delete",
+    "status",
+    "start_http_proxy",
+    "shutdown",
+    "batch",
+]

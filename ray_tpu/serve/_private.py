@@ -1,0 +1,453 @@
+"""Serve internals: controller, replicas, router, proxy, batching.
+
+Reference parity (SURVEY.md §3.5):
+  * control plane — detached ``ServeController`` actor reconciling
+    deployment goal states into replica actors with rolling updates
+    (``serve/controller.py:61``, ``_private/deployment_state.py:958``);
+  * data plane — ``Router`` with power-of-two-choices replica selection
+    bounded by ``max_concurrent_queries`` (``_private/router.py:221,261``),
+    replicas executing ``handle_request`` (``_private/replica.py:174``);
+  * config fanout — handles refresh their replica view from the
+    controller on a version change (the long-poll analog,
+    ``_private/long_poll.py``);
+  * HTTP ingress — a proxy actor running a threaded HTTP server that
+    routes by prefix (``_private/http_proxy.py:312``);
+  * ``@serve.batch`` dynamic batching (``serve/batching.py``).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+CONTROLLER_NAME = "ray_tpu.serve.controller"
+
+
+# -- replica ---------------------------------------------------------------
+
+
+class Replica:
+    """Actor wrapping one copy of the user's deployment callable."""
+
+    def __init__(self, cls_or_fn, init_args, init_kwargs):
+        if isinstance(cls_or_fn, type):
+            self.callable = cls_or_fn(*init_args, **init_kwargs)
+        else:
+            self.callable = cls_or_fn
+        self.num_ongoing = 0
+        self._lock = threading.Lock()
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self.num_ongoing += 1
+        try:
+            target = (
+                self.callable if method == "__call__"
+                else getattr(self.callable, method)
+            )
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self.num_ongoing -= 1
+
+    def get_num_ongoing(self) -> int:
+        return self.num_ongoing
+
+    def reconfigure(self, user_config):
+        if hasattr(self.callable, "reconfigure"):
+            self.callable.reconfigure(user_config)
+        return True
+
+    def check_health(self) -> str:
+        return "ok"
+
+
+# -- controller ------------------------------------------------------------
+
+
+class ServeController:
+    """Detached actor: goal-state reconciliation for all deployments."""
+
+    def __init__(self):
+        # name -> {"deployment": info dict, "replicas": [handles],
+        #          "version": int}
+        self.apps: Dict[str, dict] = {}
+        self.config_version = 0
+
+    def deploy(self, name: str, cls_or_fn, init_args, init_kwargs,
+               num_replicas: int, max_concurrent_queries: int,
+               route_prefix: Optional[str], version: Optional[str],
+               ray_actor_options: Optional[dict]):
+        """Create/update a deployment; rolling replace on version change."""
+        existing = self.apps.get(name)
+        replica_cls = ray_tpu.remote(Replica)
+        opts = dict(ray_actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        opts["max_concurrency"] = max(2, max_concurrent_queries)
+
+        new_replicas = []
+        for _ in range(num_replicas):
+            new_replicas.append(
+                replica_cls.options(**opts).remote(
+                    cls_or_fn, init_args, init_kwargs
+                )
+            )
+        # Verify the first replica constructed (fail fast on bad ctor).
+        ray_tpu.get(new_replicas[0].check_health.remote(), timeout=60)
+
+        old = existing["replicas"] if existing else []
+        self.apps[name] = {
+            "name": name,
+            "route_prefix": route_prefix,
+            "num_replicas": num_replicas,
+            "max_concurrent_queries": max_concurrent_queries,
+            "version": version or "1",
+            "replicas": new_replicas,
+        }
+        self.config_version += 1
+        # Rolling replace: retire old replicas after the new set is live.
+        for r in old:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        return self.config_version
+
+    def delete_deployment(self, name: str):
+        app = self.apps.pop(name, None)
+        if app:
+            for r in app["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+            self.config_version += 1
+        return True
+
+    def get_routing_table(self):
+        """(version, {name: {replicas, max_concurrent_queries,
+        route_prefix}}) for handles + proxies."""
+        table = {
+            name: {
+                "replicas": app["replicas"],
+                "max_concurrent_queries": app["max_concurrent_queries"],
+                "route_prefix": app["route_prefix"],
+            }
+            for name, app in self.apps.items()
+        }
+        return self.config_version, table
+
+    def status(self):
+        return {
+            name: {
+                "num_replicas": app["num_replicas"],
+                "version": app["version"],
+                "route_prefix": app["route_prefix"],
+            }
+            for name, app in self.apps.items()
+        }
+
+    def shutdown_all(self):
+        for name in list(self.apps):
+            self.delete_deployment(name)
+        return True
+
+
+def get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    controller_cls = ray_tpu.remote(ServeController)
+    try:
+        handle = controller_cls.options(
+            name=CONTROLLER_NAME, num_cpus=0, max_concurrency=8
+        ).remote()
+        ray_tpu.get(handle.status.remote(), timeout=30)
+        return handle
+    except ValueError:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+# -- router / handle --------------------------------------------------------
+
+
+class Router:
+    """Power-of-two-choices replica selection with per-replica in-flight
+    caps (client-side view of max_concurrent_queries)."""
+
+    def __init__(self, controller, deployment_name: str,
+                 refresh_interval: float = 0.5):
+        self.controller = controller
+        self.name = deployment_name
+        self.refresh_interval = refresh_interval
+        self._version = -1
+        self._replicas: List = []
+        self._max_q = 100
+        self._inflight: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+        self._refresh(force=True)
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self.refresh_interval:
+            return
+        self._last_refresh = now
+        version, table = ray_tpu.get(
+            self.controller.get_routing_table.remote(), timeout=30
+        )
+        entry = table.get(self.name)
+        if entry is None:
+            raise ValueError(f"no deployment named {self.name!r}")
+        if version != self._version:
+            with self._lock:
+                self._version = version
+                self._replicas = list(entry["replicas"])
+                self._max_q = entry["max_concurrent_queries"]
+                self._inflight = {i: 0 for i in range(len(self._replicas))}
+
+    def assign(self):
+        """Pick a replica index (blocks while all are saturated)."""
+        deadline = time.monotonic() + 60.0
+        while True:
+            self._refresh()
+            with self._lock:
+                n = len(self._replicas)
+                if n:
+                    if n == 1:
+                        cands = [0]
+                    else:
+                        cands = random.sample(range(n), 2)
+                    best = min(cands, key=lambda i: self._inflight.get(i, 0))
+                    if self._inflight.get(best, 0) < self._max_q:
+                        self._inflight[best] = self._inflight.get(best, 0) + 1
+                        return best, self._replicas[best]
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no replica of {self.name!r} available (backpressure)"
+                )
+            time.sleep(0.002)
+
+    def complete(self, idx: int):
+        with self._lock:
+            if idx in self._inflight and self._inflight[idx] > 0:
+                self._inflight[idx] -= 1
+
+
+# Per-process router cache, shared by handles and proxies.
+_routers: Dict[str, Router] = {}
+_routers_lock = threading.Lock()
+
+
+def _router_for(name: str) -> Router:
+    with _routers_lock:
+        router = _routers.get(name)
+        if router is None:
+            router = _routers[name] = Router(get_or_create_controller(), name)
+        return router
+
+
+def routed_call(deployment_name: str, method: str, args: tuple, kwargs: dict):
+    """Route one request with retry-on-replica-death: a request that lands
+    on a replica retired by a rolling update refreshes the routing table
+    and retries elsewhere (the handle-side retry of the reference router)."""
+    from ray_tpu.core.object_ref import ActorError
+
+    router = _router_for(deployment_name)
+    last_err = None
+    for _ in range(4):
+        idx, replica = router.assign()
+        try:
+            return ray_tpu.get(
+                replica.handle_request.remote(method, args, kwargs),
+                timeout=120.0,
+            )
+        except ActorError as e:
+            last_err = e
+            router._refresh(force=True)
+            continue
+        finally:
+            router.complete(idx)
+    raise last_err
+
+
+class DeploymentHandle:
+    """Python-level handle: ``handle.remote(...)`` / ``handle.method.remote``
+    (reference ``serve/handle.py``). Requests go through a routing proxy
+    task so callers get a plain ObjectRef while routing keeps retry
+    semantics."""
+
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.method_name = method_name
+
+    def remote(self, *args, **kwargs):
+        call = ray_tpu.remote(routed_call).options(num_cpus=0)
+        return call.remote(self.deployment_name, self.method_name, args, kwargs)
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment_name, name)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self.method_name))
+
+
+# -- HTTP proxy -------------------------------------------------------------
+
+
+class HTTPProxy:
+    """Actor hosting a threaded HTTP server; routes by path prefix."""
+
+    def __init__(self, host: str, port: int):
+        import http.server
+        import json as _json
+
+        controller = get_or_create_controller()
+
+        def resolve(path: str):
+            _, table = ray_tpu.get(
+                controller.get_routing_table.remote(), timeout=30
+            )
+            best_name, best_prefix = None, ""
+            for name, entry in table.items():
+                prefix = entry.get("route_prefix")
+                if prefix and path.startswith(prefix) and len(prefix) > len(best_prefix):
+                    best_name, best_prefix = name, prefix
+            return best_name
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _serve(self):
+                try:
+                    name = resolve(self.path)
+                    if name is None:
+                        self._reply(404, {"error": f"no route for {self.path}"})
+                        return
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    payload = _json.loads(body) if body else None
+                    result = routed_call(name, "__call__", (payload,), {})
+                    self._reply(200, result)
+                except Exception as e:  # noqa: BLE001 — HTTP boundary
+                    self._reply(500, {"error": repr(e)})
+
+            def _reply(self, code: int, payload):
+                blob = _json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            do_GET = _serve
+            do_POST = _serve
+
+            def log_message(self, *a):
+                pass
+
+        self.server = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def get_port(self) -> int:
+        return self.port
+
+    def stop(self):
+        self.server.shutdown()
+        return True
+
+
+# -- dynamic batching -------------------------------------------------------
+
+
+class _BatchQueue:
+    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self.items: list = []  # (arg, event, result_box)
+        self.cv = threading.Condition()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def submit(self, arg):
+        event = threading.Event()
+        box: list = [None, None]  # [value, error]
+        with self.cv:
+            self.items.append((arg, event, box))
+            self.cv.notify()
+        event.wait()
+        if box[1] is not None:
+            raise box[1]
+        return box[0]
+
+    def _loop(self):
+        while True:
+            with self.cv:
+                while not self.items:
+                    self.cv.wait()
+                deadline = time.monotonic() + self.timeout
+                while (len(self.items) < self.max_batch_size
+                       and time.monotonic() < deadline):
+                    self.cv.wait(max(0.0, deadline - time.monotonic()))
+                batch = self.items[: self.max_batch_size]
+                del self.items[: self.max_batch_size]
+            args = [b[0] for b in batch]
+            try:
+                results = self.fn(args)
+                if len(results) != len(args):
+                    raise ValueError(
+                        f"batched fn returned {len(results)} results for "
+                        f"{len(args)} inputs"
+                    )
+                for (_, event, box), r in zip(batch, results):
+                    box[0] = r
+                    event.set()
+            except BaseException as e:  # noqa: BLE001 — fan the error out
+                for _, event, box in batch:
+                    box[1] = e
+                    event.set()
+
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """``@serve.batch``: calls taking one item each are transparently
+    batched into one call of the wrapped list->list function."""
+
+    def wrap(fn):
+        queue_holder: dict = {}
+        lock = threading.Lock()
+
+        def single(*args):
+            # Methods: args = (self, item); functions: (item,).
+            if len(args) == 2:
+                self_obj, item = args
+                key = id(self_obj)
+                bound = lambda items: fn(self_obj, items)
+            elif len(args) == 1:
+                item = args[0]
+                key = 0
+                bound = fn
+            else:
+                raise TypeError("@serve.batch functions take exactly one item")
+            with lock:
+                q = queue_holder.get(key)
+                if q is None:
+                    q = queue_holder[key] = _BatchQueue(
+                        bound, max_batch_size, batch_wait_timeout_s
+                    )
+            return q.submit(item)
+
+        single.__name__ = getattr(fn, "__name__", "batched")
+        return single
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

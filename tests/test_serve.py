@@ -1,0 +1,178 @@
+"""Serve tests (modeled on the reference's ``serve/tests/`` behaviors:
+controller+replicas per test, handles, batching, HTTP)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=16)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _clean_between_tests():
+    yield
+    serve.shutdown()
+
+
+def test_basic_deployment_and_handle():
+    @serve.deployment(num_replicas=2)
+    class Adder:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, x):
+            return x + self.offset
+
+        def describe(self):
+            return f"offset={self.offset}"
+
+    handle = serve.run(Adder.bind(10))
+    assert ray_tpu.get(handle.remote(5), timeout=30) == 15
+    assert ray_tpu.get(handle.describe.remote(), timeout=30) == "offset=10"
+    assert serve.status()["Adder"]["num_replicas"] == 2
+
+
+def test_function_deployment():
+    @serve.deployment
+    def square(x):
+        return x * x
+
+    handle = serve.run(square.bind())
+    assert ray_tpu.get(handle.remote(7), timeout=30) == 49
+
+
+def test_requests_spread_across_replicas():
+    @serve.deployment(num_replicas=3)
+    class WhoAmI:
+        def __init__(self):
+            import os
+            import threading as th
+
+            self.ident = f"{os.getpid()}-{id(self)}"
+
+        def __call__(self, _):
+            time.sleep(0.05)
+            return self.ident
+
+    handle = serve.run(WhoAmI.bind())
+    refs = [handle.remote(None) for _ in range(12)]
+    idents = set(ray_tpu.get(refs, timeout=60))
+    assert len(idents) >= 2  # power-of-two choices spreads load
+
+
+def test_redeploy_rolls_replicas():
+    @serve.deployment
+    class V:
+        def __init__(self, version):
+            self.v = version
+
+        def __call__(self, _):
+            return self.v
+
+    handle = serve.run(V.bind("v1"))
+    assert ray_tpu.get(handle.remote(None), timeout=30) == "v1"
+    serve.run(V.options(version="2").bind("v2"))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if ray_tpu.get(handle.remote(None), timeout=30) == "v2":
+            break
+        time.sleep(0.1)
+    assert ray_tpu.get(handle.remote(None), timeout=30) == "v2"
+
+
+def test_get_handle_by_name_and_delete():
+    @serve.deployment(name="named_dep")
+    def hello(_):
+        return "hi"
+
+    serve.run(hello.bind())
+    handle = serve.get_deployment_handle("named_dep")
+    assert ray_tpu.get(handle.remote(None), timeout=30) == "hi"
+    serve.delete("named_dep")
+    assert "named_dep" not in serve.status()
+
+
+def test_dynamic_batching():
+    batch_sizes = []
+
+    @serve.deployment(max_concurrent_queries=32)
+    class BatchModel:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+        def handle_batch(self, items):
+            batch_sizes.append(len(items))
+            return [i * 2 for i in items]
+
+        def __call__(self, x):
+            return self.handle_batch(x)
+
+    handle = serve.run(BatchModel.bind())
+    refs = [handle.remote(i) for i in range(16)]
+    out = ray_tpu.get(refs, timeout=60)
+    assert sorted(out) == [2 * i for i in range(16)]
+
+
+def test_http_proxy_routes_by_prefix():
+    @serve.deployment(route_prefix="/double")
+    def double(payload):
+        return {"result": payload["x"] * 2}
+
+    @serve.deployment(route_prefix="/negate")
+    def negate(payload):
+        return {"result": -payload["x"]}
+
+    serve.run(double.bind())
+    serve.run(negate.bind())
+    port = serve.start_http_proxy()
+
+    def post(path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    assert post("/double", {"x": 21})["result"] == 42
+    assert post("/negate", {"x": 5})["result"] == -5
+    # unknown route -> 404
+    try:
+        post("/nope", {})
+        raised = False
+    except urllib.error.HTTPError as e:
+        raised = e.code == 404
+    assert raised
+
+
+def test_jitted_inference_deployment(devices8):
+    """TPU-shaped use: replica wraps a jitted forward fn."""
+    import jax
+    import jax.numpy as jnp
+
+    @serve.deployment
+    class JaxModel:
+        def __init__(self):
+            w = jnp.eye(4) * 3.0
+            self.fwd = jax.jit(lambda x: x @ w)
+
+        def __call__(self, x):
+            return np.asarray(self.fwd(jnp.asarray(x, jnp.float32))).tolist()
+
+    handle = serve.run(JaxModel.bind())
+    out = ray_tpu.get(handle.remote([[1.0, 0, 0, 0]]), timeout=60)
+    assert out[0][0] == 3.0
