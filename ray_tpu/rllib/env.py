@@ -1,0 +1,94 @@
+"""Vectorized pure-jax environments (Anakin-style: envs live ON device).
+
+The reference's RolloutWorker actors step Python gym envs
+(``rllib/evaluation/rollout_worker.py:153``); the TPU-native fast path
+keeps the whole env batch in device memory and vmaps the dynamics, so the
+rollout is part of the jitted learner program (no host<->device bounce per
+step). CartPole here follows the classic gym dynamics/termination.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CartPoleState(NamedTuple):
+    x: jax.Array
+    x_dot: jax.Array
+    theta: jax.Array
+    theta_dot: jax.Array
+    t: jax.Array  # steps since reset
+
+
+class CartPole:
+    """Classic control CartPole-v1 dynamics, vectorizable with vmap."""
+
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    TOTAL_MASS = MASSCART + MASSPOLE
+    LENGTH = 0.5
+    POLEMASS_LENGTH = MASSPOLE * LENGTH
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    X_LIMIT = 2.4
+    THETA_LIMIT = 12 * 2 * jnp.pi / 360
+    MAX_STEPS = 500
+
+    observation_size = 4
+    num_actions = 2
+
+    def reset(self, rng: jax.Array) -> CartPoleState:
+        vals = jax.random.uniform(rng, (4,), minval=-0.05, maxval=0.05)
+        return CartPoleState(vals[0], vals[1], vals[2], vals[3],
+                             jnp.zeros((), jnp.int32))
+
+    def obs(self, s: CartPoleState) -> jax.Array:
+        return jnp.stack([s.x, s.x_dot, s.theta, s.theta_dot])
+
+    def step(self, s: CartPoleState, action: jax.Array,
+             rng: jax.Array) -> tuple[CartPoleState, jax.Array, jax.Array, jax.Array]:
+        """-> (next_state, obs, reward, done); auto-resets on done."""
+        force = jnp.where(action == 1, self.FORCE_MAG, -self.FORCE_MAG)
+        cos, sin = jnp.cos(s.theta), jnp.sin(s.theta)
+        temp = (force + self.POLEMASS_LENGTH * s.theta_dot**2 * sin) / self.TOTAL_MASS
+        theta_acc = (self.GRAVITY * sin - cos * temp) / (
+            self.LENGTH * (4.0 / 3.0 - self.MASSPOLE * cos**2 / self.TOTAL_MASS)
+        )
+        x_acc = temp - self.POLEMASS_LENGTH * theta_acc * cos / self.TOTAL_MASS
+        nxt = CartPoleState(
+            s.x + self.TAU * s.x_dot,
+            s.x_dot + self.TAU * x_acc,
+            s.theta + self.TAU * s.theta_dot,
+            s.theta_dot + self.TAU * theta_acc,
+            s.t + 1,
+        )
+        done = (
+            (jnp.abs(nxt.x) > self.X_LIMIT)
+            | (jnp.abs(nxt.theta) > self.THETA_LIMIT)
+            | (nxt.t >= self.MAX_STEPS)
+        )
+        reward = jnp.ones(())
+        fresh = self.reset(rng)
+        nxt = jax.tree.map(
+            lambda a, b: jnp.where(done, a, b), fresh, nxt
+        )
+        return nxt, self.obs(nxt), reward, done
+
+
+def make_vec_env(env: CartPole, n_envs: int):
+    """(reset_fn, step_fn) vmapped over the env batch."""
+
+    def reset(rng):
+        return jax.vmap(env.reset)(jax.random.split(rng, n_envs))
+
+    def step(states, actions, rng):
+        return jax.vmap(env.step)(states, actions, jax.random.split(rng, n_envs))
+
+    def obs(states):
+        return jax.vmap(env.obs)(states)
+
+    return reset, step, obs

@@ -1,0 +1,49 @@
+"""SampleBatch: the dict-of-arrays experience container.
+
+Reference parity: ``rllib/policy/sample_batch.py`` — named columns,
+concat, row count, minibatch slicing, shuffling.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+DONES = "dones"
+LOGPS = "action_logp"
+VALUES = "vf_preds"
+ADVANTAGES = "advantages"
+RETURNS = "value_targets"
+
+
+class SampleBatch(dict):
+    @property
+    def count(self) -> int:
+        if not self:
+            return 0
+        return len(next(iter(self.values())))
+
+    @staticmethod
+    def concat_samples(batches: List["SampleBatch"]) -> "SampleBatch":
+        keys = batches[0].keys()
+        return SampleBatch(
+            {k: np.concatenate([np.asarray(b[k]) for b in batches]) for k in keys}
+        )
+
+    def shuffle(self, rng: np.random.Generator) -> "SampleBatch":
+        perm = rng.permutation(self.count)
+        return SampleBatch({k: np.asarray(v)[perm] for k, v in self.items()})
+
+    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
+        n = self.count
+        for start in range(0, n - size + 1, size):
+            yield SampleBatch(
+                {k: np.asarray(v)[start : start + size] for k, v in self.items()}
+            )
+
+    def slice(self, start: int, end: int) -> "SampleBatch":
+        return SampleBatch({k: np.asarray(v)[start:end] for k, v in self.items()})
