@@ -1,0 +1,171 @@
+"""Lazy DAG authoring: ``.bind()`` graphs executed over tasks/actors.
+
+Reference parity: ``python/ray/dag`` — ``DAGNode`` (``dag_node.py:23``),
+Function/ClassMethod nodes, ``InputNode`` placeholder, ``MultiOutputNode``;
+used by Serve's deployment graphs and the Workflow layer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.actor import ActorClass
+from ray_tpu.remote_function import RemoteFunction
+
+
+class DAGNode:
+    def __init__(self, bound_args: tuple, bound_kwargs: dict):
+        self._bound_args = bound_args
+        self._bound_kwargs = bound_kwargs
+
+    def _deps(self) -> List["DAGNode"]:
+        out = []
+        for a in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                out.append(a)
+        return out
+
+    def execute(self, *input_args, **input_kwargs):
+        """Execute the whole graph; returns the root's result (resolved)."""
+        refs = _execute_graph(self, input_args, input_kwargs)
+        value = refs[self]
+        if isinstance(value, list):
+            return ray_tpu.get(value)
+        return ray_tpu.get(value) if isinstance(value, ray_tpu.ObjectRef) else value
+
+    # structural identity for workflow checkpoint keys
+    def _structure_name(self) -> str:
+        return type(self).__name__
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn: RemoteFunction, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._fn = remote_fn
+
+    def _structure_name(self) -> str:
+        return getattr(self._fn.func, "__name__", "fn")
+
+    def _submit(self, args, kwargs):
+        return self._fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """Actor construction node: methods on it create ClassMethodNodes."""
+
+    def __init__(self, actor_cls: ActorClass, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+
+    def _structure_name(self) -> str:
+        return self._actor_cls.cls.__name__
+
+    def _submit(self, args, kwargs):
+        return self._actor_cls.remote(*args, **kwargs)  # ActorHandle
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        class _MethodBinder:
+            def __init__(self, node, method):
+                self.node = node
+                self.method = method
+
+            def bind(self, *args, **kwargs):
+                return ClassMethodNode(self.node, self.method, args, kwargs)
+
+        return _MethodBinder(self, name)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method: str, args, kwargs):
+        super().__init__((class_node, *args), kwargs)
+        self._method = method
+
+    def _structure_name(self) -> str:
+        return f"{self._bound_args[0]._structure_name()}.{self._method}"
+
+    def _submit(self, args, kwargs):
+        handle, *rest = args
+        return getattr(handle, self._method).remote(*rest, **kwargs)
+
+
+class InputNode(DAGNode):
+    """Placeholder for execute()-time input; usable as a context manager
+    (``with InputNode() as inp:``)."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _structure_name(self) -> str:
+        return "input"
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+
+    def _structure_name(self) -> str:
+        return "multi_output"
+
+
+def _execute_graph(root: DAGNode, input_args, input_kwargs) -> Dict[DAGNode, Any]:
+    """Bottom-up execution with memoization (shared nodes run once)."""
+    results: Dict[DAGNode, Any] = {}
+
+    def resolve(node: DAGNode):
+        if node in results:
+            return results[node]
+        if isinstance(node, InputNode):
+            value = input_args[0] if input_args else input_kwargs
+            results[node] = value
+            return value
+        args = [
+            resolve(a) if isinstance(a, DAGNode) else a
+            for a in node._bound_args
+        ]
+        kwargs = {
+            k: resolve(v) if isinstance(v, DAGNode) else v
+            for k, v in node._bound_kwargs.items()
+        }
+        if isinstance(node, MultiOutputNode):
+            results[node] = list(args)
+            return results[node]
+        value = node._submit(args, kwargs)
+        results[node] = value
+        return value
+
+    resolve(root)
+    return results
+
+
+def _fn_bind(self: RemoteFunction, *args, **kwargs) -> FunctionNode:
+    return FunctionNode(self, args, kwargs)
+
+
+def _cls_bind(self: ActorClass, *args, **kwargs) -> ClassNode:
+    return ClassNode(self, args, kwargs)
+
+
+# Install .bind on the decorator outputs (reference: @ray.remote objects
+# expose .bind for DAG authoring).
+RemoteFunction.bind = _fn_bind
+ActorClass.bind = _cls_bind
+
+__all__ = [
+    "DAGNode",
+    "FunctionNode",
+    "ClassNode",
+    "ClassMethodNode",
+    "InputNode",
+    "MultiOutputNode",
+]

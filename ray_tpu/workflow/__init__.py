@@ -1,0 +1,166 @@
+"""Workflow: durable DAG execution with checkpointed task outputs.
+
+Reference parity: ``python/ray/workflow`` — every task's output is
+persisted to storage (``workflow_storage.py:229,315``); re-running (or
+``resume``-ing) a workflow id skips completed tasks and recomputes only
+what's missing (``workflow_executor.py``). Storage is a local/NFS
+directory; task identity is the node's deterministic structural id.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.dag import DAGNode, InputNode, MultiOutputNode
+
+_DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu/workflows")
+
+
+def _node_ids(root: DAGNode) -> Dict[DAGNode, str]:
+    """Deterministic structural ids: name + dep ids + literal args hash,
+    disambiguated by visit order for identical structures."""
+    ids: Dict[DAGNode, str] = {}
+    counter: Dict[str, int] = {}
+
+    def visit(node: DAGNode) -> str:
+        if node in ids:
+            return ids[node]
+        dep_ids = []
+        literals = []
+        values = list(node._bound_args) + [
+            v for _, v in sorted(node._bound_kwargs.items())
+        ]
+        for v in values:
+            if isinstance(v, DAGNode):
+                dep_ids.append(visit(v))
+            else:
+                try:
+                    literals.append(pickle.dumps(v))
+                except Exception:
+                    literals.append(repr(v).encode())
+        basis = node._structure_name().encode() + b"|".join(
+            d.encode() for d in dep_ids
+        ) + b"#" + b"|".join(literals)
+        digest = hashlib.sha1(basis).hexdigest()[:12]
+        key = f"{node._structure_name()}_{digest}"
+        n = counter.get(key, 0)
+        counter[key] = n + 1
+        if n:
+            key = f"{key}_{n}"
+        ids[node] = key
+        return key
+
+    visit(root)
+    return ids
+
+
+class _Storage:
+    def __init__(self, base: str, workflow_id: str):
+        self.dir = os.path.join(base, workflow_id)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _path(self, task_id: str) -> str:
+        return os.path.join(self.dir, task_id + ".pkl")
+
+    def has(self, task_id: str) -> bool:
+        return os.path.exists(self._path(task_id))
+
+    def load(self, task_id: str):
+        with open(self._path(task_id), "rb") as f:
+            return pickle.load(f)
+
+    def save(self, task_id: str, value) -> None:
+        tmp = self._path(task_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, self._path(task_id))  # atomic commit
+
+    def mark_status(self, status: str) -> None:
+        with open(os.path.join(self.dir, "STATUS"), "w") as f:
+            f.write(status)
+
+    def status(self) -> Optional[str]:
+        try:
+            with open(os.path.join(self.dir, "STATUS")) as f:
+                return f.read().strip()
+        except FileNotFoundError:
+            return None
+
+
+def run(
+    dag: DAGNode,
+    *args,
+    workflow_id: str = "default",
+    storage: Optional[str] = None,
+    **kwargs,
+) -> Any:
+    """Execute the DAG durably; completed node outputs are checkpointed
+    and skipped on re-run/resume."""
+    store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    store.mark_status("RUNNING")
+    ids = _node_ids(dag)
+    results: Dict[DAGNode, Any] = {}
+
+    def resolve(node: DAGNode):
+        if node in results:
+            return results[node]
+        if isinstance(node, InputNode):
+            value = args[0] if args else kwargs
+            results[node] = value
+            return value
+        task_id = ids[node]
+        if store.has(task_id):
+            value = store.load(task_id)
+            results[node] = value
+            return value
+        rargs = [
+            resolve(a) if isinstance(a, DAGNode) else a
+            for a in node._bound_args
+        ]
+        rkwargs = {
+            k: resolve(v) if isinstance(v, DAGNode) else v
+            for k, v in node._bound_kwargs.items()
+        }
+        if isinstance(node, MultiOutputNode):
+            results[node] = list(rargs)
+            return results[node]
+        ref = node._submit(rargs, rkwargs)
+        value = ray_tpu.get(ref) if isinstance(ref, ray_tpu.ObjectRef) else ref
+        store.save(task_id, value)
+        results[node] = value
+        return value
+
+    try:
+        out = resolve(dag)
+    except BaseException:
+        store.mark_status("FAILED")
+        raise
+    store.mark_status("SUCCESSFUL")
+    return out
+
+
+def resume(workflow_id: str, dag: DAGNode, *args,
+           storage: Optional[str] = None, **kwargs) -> Any:
+    """Re-drive a workflow: completed tasks load from storage, the rest
+    execute (``workflow.resume`` parity — the DAG is re-supplied because
+    we persist outputs, not code)."""
+    return run(dag, *args, workflow_id=workflow_id, storage=storage, **kwargs)
+
+
+def get_status(workflow_id: str, storage: Optional[str] = None) -> Optional[str]:
+    store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    return store.status()
+
+
+def delete(workflow_id: str, storage: Optional[str] = None) -> None:
+    import shutil
+
+    path = os.path.join(storage or _DEFAULT_STORAGE, workflow_id)
+    shutil.rmtree(path, ignore_errors=True)
+
+
+__all__ = ["run", "resume", "get_status", "delete"]

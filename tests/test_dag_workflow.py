@@ -1,0 +1,146 @@
+"""DAG + Workflow tests (reference behaviors: ``python/ray/dag/tests``,
+``python/ray/workflow/tests`` — bind graphs, shared nodes run once,
+durable resume skips completed tasks)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu import workflow
+from ray_tpu.core.object_ref import TaskError
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_function_dag_execute():
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    dag = mul.bind(add.bind(1, 2), add.bind(3, 4))
+    assert dag.execute() == 21
+
+
+def test_input_node_and_multi_output():
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([double.bind(inp), square.bind(inp)])
+    assert dag.execute(5) == [10, 25]
+
+
+def test_shared_node_executes_once(tmp_path):
+    marker = tmp_path / "count"
+
+    @ray_tpu.remote
+    def expensive():
+        n = int(marker.read_text()) if marker.exists() else 0
+        marker.write_text(str(n + 1))
+        return 7
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    shared = expensive.bind()
+    dag = add.bind(shared, shared)
+    assert dag.execute() == 14
+    assert marker.read_text() == "1"
+
+
+def test_actor_dag():
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    counter = Counter.bind(100)
+    dag = counter.add.bind(5)
+    assert dag.execute() == 105
+
+
+def test_workflow_run_and_skip_completed(tmp_path):
+    calls = tmp_path / "calls"
+    calls.write_text("0")
+
+    @ray_tpu.remote
+    def tracked(x):
+        calls.write_text(str(int(calls.read_text()) + 1))
+        return x + 1
+
+    @ray_tpu.remote
+    def total(a, b):
+        return a + b
+
+    dag = total.bind(tracked.bind(1), tracked.bind(10))
+    out = workflow.run(dag, workflow_id="wf1", storage=str(tmp_path / "st"))
+    assert out == 13
+    assert calls.read_text() == "2"
+    assert workflow.get_status("wf1", storage=str(tmp_path / "st")) == "SUCCESSFUL"
+
+    # Re-run: everything checkpointed, no task re-executes.
+    out2 = workflow.run(dag, workflow_id="wf1", storage=str(tmp_path / "st"))
+    assert out2 == 13
+    assert calls.read_text() == "2"
+
+
+def test_workflow_resume_after_failure(tmp_path):
+    state = tmp_path / "mode"
+    state.write_text("fail")
+    ran = tmp_path / "ran"
+    ran.write_text("0")
+
+    @ray_tpu.remote
+    def step_a():
+        ran.write_text(str(int(ran.read_text()) + 1))
+        return 5
+
+    @ray_tpu.remote
+    def flaky(x):
+        if state.read_text() == "fail":
+            raise RuntimeError("transient failure")
+        return x * 2
+
+    dag = flaky.bind(step_a.bind())
+    with pytest.raises(TaskError, match="transient"):
+        workflow.run(dag, workflow_id="wf2", storage=str(tmp_path / "st"))
+    assert workflow.get_status("wf2", storage=str(tmp_path / "st")) == "FAILED"
+    assert ran.read_text() == "1"  # step_a completed + checkpointed
+
+    state.write_text("ok")
+    out = workflow.resume("wf2", dag, storage=str(tmp_path / "st"))
+    assert out == 10
+    assert ran.read_text() == "1"  # step_a NOT re-executed
+    assert workflow.get_status("wf2", storage=str(tmp_path / "st")) == "SUCCESSFUL"
+
+
+def test_workflow_delete(tmp_path):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    workflow.run(one.bind(), workflow_id="wf3", storage=str(tmp_path / "st"))
+    workflow.delete("wf3", storage=str(tmp_path / "st"))
+    assert workflow.get_status("wf3", storage=str(tmp_path / "st")) is None
