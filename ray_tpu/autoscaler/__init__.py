@@ -1,0 +1,166 @@
+"""Autoscaler: demand-driven node provisioning.
+
+Reference parity: ``python/ray/autoscaler`` (SURVEY.md §2.2) —
+``StandardAutoscaler.update`` reconciles resource demand against running
+nodes (``_private/autoscaler.py:167``), a ``ResourceDemandScheduler``
+bin-packs pending demands over node types
+(``_private/resource_demand_scheduler.py:103``), and ``NodeProvider``
+plugins do the actual provisioning (local/fake providers for tests,
+``fake_multi_node/node_provider.py``). The TPU deployment target is pods:
+a node type maps to a TPU host shape (e.g. ``{"CPU": 8, "TPU": 4}``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.cluster.rpc import RpcClient
+
+
+class NodeProvider:
+    """Plugin interface (``autoscaler/node_provider.py``)."""
+
+    def create_node(self, node_type: str, node_config: dict) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Provisions simulated nodes in a ``cluster_utils.Cluster``
+    (FakeMultiNodeProvider parity: scaling without a cloud)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self._agents: Dict[str, object] = {}
+
+    def create_node(self, node_type: str, node_config: dict) -> str:
+        agent = self.cluster.add_node(
+            num_cpus=node_config.get("num_cpus"),
+            resources=node_config.get("resources"),
+        )
+        self._agents[agent.node_id] = agent
+        return agent.node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        agent = self._agents.pop(node_id, None)
+        if agent is not None:
+            self.cluster.remove_node(agent)
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [
+            nid for nid, agent in self._agents.items()
+            if not agent._shutdown.is_set()
+        ]
+
+
+class StandardAutoscaler:
+    """One reconcile step per ``update()``; ``start()`` loops it."""
+
+    def __init__(
+        self,
+        head_address: str,
+        provider: NodeProvider,
+        *,
+        node_types: Dict[str, dict],
+        max_workers: int = 8,
+        idle_timeout_s: float = 60.0,
+        launch_cooldown_s: float = 2.0,
+    ):
+        self.head = RpcClient(head_address)
+        self.provider = provider
+        self.node_types = node_types
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.launch_cooldown_s = launch_cooldown_s
+        self._idle_since: Dict[str, float] = {}
+        self._last_launch = 0.0
+        self._stop = threading.Event()
+        self.launched: List[str] = []
+
+    # -- demand -> nodes (ResourceDemandScheduler.get_nodes_to_launch) ----
+
+    def _nodes_to_launch(self, demands: List[dict], n_current: int) -> List[str]:
+        budget = self.max_workers - n_current
+        if budget <= 0 or not demands:
+            return []
+        # First-fit-decreasing bin-pack of demands onto new node headrooms.
+        launches: List[str] = []
+        headrooms: List[dict] = []
+        for demand in sorted(demands, key=lambda d: -sum(d.values())):
+            placed = False
+            for room in headrooms:
+                if all(room.get(k, 0.0) >= v for k, v in demand.items()):
+                    for k, v in demand.items():
+                        room[k] = room.get(k, 0.0) - v
+                    placed = True
+                    break
+            if placed:
+                continue
+            if len(launches) >= budget:
+                continue
+            for type_name, config in self.node_types.items():
+                total = {"CPU": float(config.get("num_cpus", 0) or 0)}
+                total.update(config.get("resources") or {})
+                if all(total.get(k, 0.0) >= v for k, v in demand.items()):
+                    launches.append(type_name)
+                    room = dict(total)
+                    for k, v in demand.items():
+                        room[k] = room.get(k, 0.0) - v
+                    headrooms.append(room)
+                    break
+        return launches
+
+    def update(self) -> dict:
+        """One reconcile round: scale up for pending demand, scale down
+        idle provider nodes past the timeout."""
+        demands = self.head.call("pending_demands", 10.0)
+        nodes = self.head.call("nodes")
+        alive = [n for n in nodes if n["Alive"]]
+        report = {"launched": [], "terminated": []}
+
+        now = time.monotonic()
+        if demands and now - self._last_launch >= self.launch_cooldown_s:
+            mine = set(self.provider.non_terminated_nodes())
+            for type_name in self._nodes_to_launch(demands, len(mine)):
+                config = self.node_types[type_name]
+                node_id = self.provider.create_node(type_name, config)
+                self.launched.append(node_id)
+                report["launched"].append(node_id)
+                self._last_launch = now
+
+        # Scale down: provider-owned nodes fully idle past the timeout.
+        by_id = {n["NodeID"]: n for n in alive}
+        for node_id in list(self.provider.non_terminated_nodes()):
+            info = by_id.get(node_id)
+            if info is None:
+                continue
+            idle = info["Available"] == info["Resources"]
+            if not idle:
+                self._idle_since.pop(node_id, None)
+                continue
+            since = self._idle_since.setdefault(node_id, now)
+            if now - since >= self.idle_timeout_s:
+                self.provider.terminate_node(node_id)
+                self._idle_since.pop(node_id, None)
+                report["terminated"].append(node_id)
+        return report
+
+    def start(self, interval_s: float = 1.0) -> None:
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.update()
+                except Exception:
+                    continue
+
+        threading.Thread(target=loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -199,8 +199,10 @@ class ClusterBackend:
         if spec is None or spec.get("retries_left", 0) <= 0:
             return False
         assigned = spec.get("assigned_node")
+        if assigned is None:
+            return False  # not yet placed; the pending-retry thread owns it
         nodes = {n["NodeID"]: n for n in self.head.call("nodes")}
-        if assigned is not None and nodes.get(assigned, {}).get("Alive"):
+        if nodes.get(assigned, {}).get("Alive"):
             return False  # still computing
         spec["retries_left"] -= 1
         # Soft affinity on recovery: the pinned node is gone, so let the
@@ -305,7 +307,7 @@ class ClusterBackend:
                 info["bundle_index"] = options["placement_group_bundle_index"]
         return info
 
-    def _choose_node(self, demand, sinfo):
+    def _choose_node(self, demand, sinfo, task_id=None):
         if sinfo["pg_id"] is not None:
             return self.head.call(
                 "pg_node_for_bundle", sinfo["pg_id"], sinfo["bundle_index"],
@@ -314,17 +316,49 @@ class ClusterBackend:
         return self.head.call(
             "schedule", demand, caller_node=self.node_id,
             strategy=sinfo["strategy"], node_affinity=sinfo["node_affinity"],
+            task_id=task_id,
         )
 
-    def _submit_spec(self, spec: dict):
-        placed = self._choose_node(spec["demand"], spec["sinfo"])
+    def _submit_spec(self, spec: dict, *, allow_pending: bool = False):
+        placed = self._choose_node(spec["demand"], spec["sinfo"],
+                                   task_id=spec.get("task_id"))
         if placed is None:
-            raise ValueError(
-                f"demand {spec['demand']} is infeasible on this cluster"
-            )
+            if not allow_pending:
+                raise ValueError(
+                    f"demand {spec['demand']} is infeasible on this cluster"
+                )
+            # Keep the task pending while the autoscaler adds capacity
+            # (reference: infeasible tasks wait; the demand is already
+            # recorded head-side by the failed schedule call).
+            threading.Thread(
+                target=self._retry_submit, args=(spec,), daemon=True
+            ).start()
+            return
         node_id, address = placed
         spec["assigned_node"] = node_id
         self._node_client(address).call("submit_task", spec)
+
+    def _retry_submit(self, spec: dict, timeout: float = 120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            time.sleep(0.25)
+            placed = self._choose_node(spec["demand"], spec["sinfo"],
+                                   task_id=spec.get("task_id"))
+            if placed is not None:
+                node_id, address = placed
+                spec["assigned_node"] = node_id
+                try:
+                    self._node_client(address).call("submit_task", spec)
+                except (ConnectionLost, OSError):
+                    continue
+                return
+        err = TaskError(
+            spec.get("fname", "task"),
+            f"demand {spec['demand']} unsatisfiable for {timeout}s",
+            "infeasible",
+        )
+        for oid in spec["oids"]:
+            self.put_with_id(oid, err, is_error=True)
 
     def submit_task(
         self,
@@ -359,7 +393,7 @@ class ClusterBackend:
         for oid in oids:
             self._lineage[oid] = spec
         try:
-            self._submit_spec(spec)
+            self._submit_spec(spec, allow_pending=True)
         except (ValueError, TimeoutError) as e:
             for oid in oids:
                 self._lineage.pop(oid, None)

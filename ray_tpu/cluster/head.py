@@ -52,6 +52,9 @@ class HeadServer:
         self._actors_cv = threading.Condition(self._lock)
         self._pgs: dict[str, dict] = {}
         self._rr_counter = 0
+        # Unsatisfiable demand log: the autoscaler's input signal
+        # (load_metrics.py / resource_demand_scheduler.py analog).
+        self._demand_misses: list[dict] = []
         self._server = RpcServer(self, host, port)
         self.address = self._server.address
         self._stop = threading.Event()
@@ -296,7 +299,7 @@ class HeadServer:
     # -- scheduling -------------------------------------------------------
 
     def rpc_schedule(self, demand, caller_node=None, strategy=None,
-                     node_affinity=None):
+                     node_affinity=None, task_id=None):
         """Pick a node for a task/actor; returns (node_id, address) or None
         if no alive node can ever fit the demand."""
         with self._lock:
@@ -312,6 +315,18 @@ class HeadServer:
                 if all(n.resources.get(k, 0.0) >= v for k, v in demand.items())
             ]
             if not feasible:
+                # One live entry per pending task: retries refresh the
+                # timestamp instead of inflating apparent demand.
+                if task_id is not None:
+                    self._demand_misses = [
+                        m for m in self._demand_misses
+                        if m.get("task_id") != task_id
+                    ]
+                self._demand_misses.append(
+                    {"demand": dict(demand), "ts": time.monotonic(),
+                     "task_id": task_id}
+                )
+                del self._demand_misses[:-1000]
                 return None
 
             def headroom(n: NodeInfo) -> float:
@@ -336,6 +351,15 @@ class HeadServer:
         # Optimistically debit the view so bursts spread before the next
         # heartbeat refreshes truth (the raylet remains authoritative).
         return node.node_id, node.address
+
+    def rpc_pending_demands(self, window_s: float = 30.0):
+        """Recent demands no alive node could fit (autoscaler input)."""
+        cutoff = time.monotonic() - window_s
+        with self._lock:
+            self._demand_misses = [
+                m for m in self._demand_misses if m["ts"] >= cutoff
+            ]
+            return [dict(m["demand"]) for m in self._demand_misses]
 
     # -- placement groups (2-phase commit) --------------------------------
 

@@ -216,6 +216,13 @@ class LocalBackend:
         # blocking get() can give the CPUs back (raylet parity: workers
         # blocked in ray.get release their CPUs).
         self._current_lease = threading.local()
+        # State-API records (bounded): task lifecycle events for
+        # list_tasks/summary/timeline (profiling.h + GetTasksInfo analog).
+        self._task_records: "collections.OrderedDict[str, dict]" = (
+            __import__("collections").OrderedDict()
+        )
+        self._task_records_cap = 10_000
+        self._actor_records: dict[str, dict] = {}
 
     # -- ref counting ------------------------------------------------------
 
@@ -501,6 +508,66 @@ class LocalBackend:
     def current_placement_group(self):
         return getattr(self._current_pg, "info", None)
 
+    # -- state records ----------------------------------------------------
+
+    def _record_task(self, task_id: str, name: str, kind: str = "NORMAL_TASK"):
+        import time as _time
+
+        with self._lock:
+            if len(self._task_records) >= self._task_records_cap:
+                self._task_records.popitem(last=False)
+            self._task_records[task_id] = {
+                "task_id": task_id,
+                "name": name,
+                "type": kind,
+                "state": "PENDING",
+                "submitted_at": _time.time(),
+                "start_time": None,
+                "end_time": None,
+                "error": None,
+            }
+
+    def _record_task_state(self, task_id: str, state: str, error: str | None = None):
+        import time as _time
+
+        rec = self._task_records.get(task_id)
+        if rec is None:
+            return
+        rec["state"] = state
+        if state == "RUNNING":
+            rec["start_time"] = _time.time()
+        elif state in ("FINISHED", "FAILED"):
+            rec["end_time"] = _time.time()
+            rec["error"] = error
+
+    def list_tasks(self, limit: int = 1000) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in list(self._task_records.values())[-limit:]]
+
+    def list_actors(self) -> list[dict]:
+        out = []
+        for actor_id, state in self._actors.items():
+            rec = self._actor_records.get(actor_id, {})
+            out.append({
+                "actor_id": actor_id,
+                "class_name": rec.get("class_name", "?"),
+                "name": state.name,
+                "state": "DEAD" if state.dead else "ALIVE",
+                "death_cause": state.death_cause,
+            })
+        return out
+
+    def list_objects(self, limit: int = 1000) -> list[dict]:
+        with self._objects_lock:
+            out = []
+            for oid, entry in list(self._objects.items())[:limit]:
+                out.append({
+                    "object_id": oid,
+                    "status": "READY" if entry.event.is_set() else "PENDING",
+                    "refcount": self._refcounts.get(oid, 0),
+                })
+            return out
+
     # -- task plane -------------------------------------------------------
 
     def _pin_ref_args(self, args, kwargs) -> list[str]:
@@ -565,6 +632,7 @@ class LocalBackend:
         oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
         refs = [self.make_ref(o) for o in oids]
         fname = name or getattr(func, "__name__", "task")
+        self._record_task(task_id, fname)
         try:
             plan = self._plan_resources(_options, is_actor=False)
         except (ValueError, TypeError) as e:
@@ -587,6 +655,7 @@ class LocalBackend:
                                 "strategy": plan["pg"].strategy,
                                 "name": plan["pg"].name,
                             }
+                        self._record_task_state(task_id, "RUNNING")
                         try:
                             result = func(*a, **kw)
                         finally:
@@ -595,8 +664,10 @@ class LocalBackend:
                             if plan["capture"]:
                                 self._current_pg.info = None
                         self._store_returns(oids, result, num_returns)
+                        self._record_task_state(task_id, "FINISHED")
                         return
                     except BaseException as e:  # noqa: BLE001 — stored, not dropped
+                        self._record_task_state(task_id, "FAILED", repr(e))
                         retriable = retry_exceptions is True or (
                             isinstance(retry_exceptions, tuple)
                             and isinstance(e, retry_exceptions)
@@ -639,6 +710,7 @@ class LocalBackend:
                 self._named_actors[name] = actor_id
         state = _ActorState(None, max_concurrency, name)
         self._actors[actor_id] = state
+        self._actor_records[actor_id] = {"class_name": cls.__name__}
         pins = self._pin_ref_args(args, kwargs)
 
         ctor_done = threading.Event()
@@ -686,8 +758,14 @@ class LocalBackend:
                     try:
                         a, kw = self._resolve_args(m_args, m_kwargs)
                         method = getattr(state.instance, method_name)
+                        self._record_task_state(
+                            ids.task_of_object(oids[0])[0], "RUNNING"
+                        )
                         result = method(*a, **kw)
                         self._store_returns(oids, result, num_returns)
+                        self._record_task_state(
+                            ids.task_of_object(oids[0])[0], "FINISHED"
+                        )
                     except BaseException as e:  # noqa: BLE001
                         self._store_error(
                             oids,
@@ -720,6 +798,7 @@ class LocalBackend:
         task_id = ids.new_task_id()
         oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
         refs = [self.make_ref(o) for o in oids]
+        self._record_task(task_id, method_name, kind="ACTOR_TASK")
         if state is None:
             self._store_error(oids, ActorError(f"no such actor: {actor_id}"))
             return refs

@@ -1,0 +1,168 @@
+"""Job submission: run driver entrypoints under a supervisor actor.
+
+Reference parity: ``python/ray/job_submission`` + ``dashboard/modules/job``
+— submit a shell entrypoint, poll status, fetch logs; the driver runs as a
+subprocess supervised by a ``JobSupervisor`` actor (``job_manager.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import ray_tpu
+
+_MANAGER_NAME = "ray_tpu.job_manager"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+class _JobManager:
+    """Named actor: registry + supervisor threads for submitted jobs."""
+
+    def __init__(self):
+        self.jobs: Dict[str, dict] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def submit(self, entrypoint: str, job_id: Optional[str],
+               runtime_env: Optional[dict], metadata: Optional[dict]) -> str:
+        job_id = job_id or f"raytpu_job_{uuid.uuid4().hex[:10]}"
+        with self._lock:
+            if job_id in self.jobs:
+                raise ValueError(f"job {job_id} already exists")
+            self.jobs[job_id] = {
+                "job_id": job_id,
+                "entrypoint": entrypoint,
+                "status": JobStatus.PENDING,
+                "logs": "",
+                "metadata": metadata or {},
+                "start_time": time.time(),
+                "end_time": None,
+            }
+        threading.Thread(
+            target=self._supervise, args=(job_id, entrypoint, runtime_env),
+            daemon=True,
+        ).start()
+        return job_id
+
+    def _supervise(self, job_id: str, entrypoint: str,
+                   runtime_env: Optional[dict]):
+        env = dict(os.environ)
+        for k, v in ((runtime_env or {}).get("env_vars") or {}).items():
+            env[k] = str(v)
+        try:
+            proc = subprocess.Popen(
+                entrypoint, shell=True, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                cwd=(runtime_env or {}).get("working_dir") or None,
+            )
+        except OSError as e:
+            with self._lock:
+                self.jobs[job_id]["status"] = JobStatus.FAILED
+                self.jobs[job_id]["logs"] = f"failed to start: {e}"
+                self.jobs[job_id]["end_time"] = time.time()
+            return
+        with self._lock:
+            self.jobs[job_id]["status"] = JobStatus.RUNNING
+            self._procs[job_id] = proc
+        out, _ = proc.communicate()
+        with self._lock:
+            job = self.jobs[job_id]
+            job["logs"] = out or ""
+            job["end_time"] = time.time()
+            if job["status"] != JobStatus.STOPPED:
+                job["status"] = (
+                    JobStatus.SUCCEEDED if proc.returncode == 0
+                    else JobStatus.FAILED
+                )
+            self._procs.pop(job_id, None)
+
+    def status(self, job_id: str) -> str:
+        return self.jobs[job_id]["status"]
+
+    def logs(self, job_id: str) -> str:
+        return self.jobs[job_id]["logs"]
+
+    def info(self, job_id: str) -> dict:
+        return dict(self.jobs[job_id])
+
+    def list_jobs(self) -> List[dict]:
+        return [dict(j) for j in self.jobs.values()]
+
+    def stop(self, job_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(job_id)
+            if proc is None:
+                return False
+            self.jobs[job_id]["status"] = JobStatus.STOPPED
+        proc.terminate()
+        return True
+
+
+def _manager():
+    try:
+        return ray_tpu.get_actor(_MANAGER_NAME)
+    except ValueError:
+        pass
+    cls = ray_tpu.remote(_JobManager)
+    try:
+        handle = cls.options(
+            name=_MANAGER_NAME, num_cpus=0, max_concurrency=4
+        ).remote()
+        ray_tpu.get(handle.list_jobs.remote(), timeout=30)
+        return handle
+    except ValueError:
+        return ray_tpu.get_actor(_MANAGER_NAME)
+
+
+class JobSubmissionClient:
+    """Mirrors the reference client surface (``job_submission/__init__``)."""
+
+    def __init__(self, address: Optional[str] = None):
+        if address and not ray_tpu.is_initialized():
+            ray_tpu.init(address)
+        self._mgr = _manager()
+
+    def submit_job(self, *, entrypoint: str, job_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[dict] = None) -> str:
+        return ray_tpu.get(
+            self._mgr.submit.remote(entrypoint, job_id, runtime_env, metadata),
+            timeout=60,
+        )
+
+    def get_job_status(self, job_id: str) -> str:
+        return ray_tpu.get(self._mgr.status.remote(job_id), timeout=30)
+
+    def get_job_logs(self, job_id: str) -> str:
+        return ray_tpu.get(self._mgr.logs.remote(job_id), timeout=30)
+
+    def get_job_info(self, job_id: str) -> dict:
+        return ray_tpu.get(self._mgr.info.remote(job_id), timeout=30)
+
+    def list_jobs(self) -> List[dict]:
+        return ray_tpu.get(self._mgr.list_jobs.remote(), timeout=30)
+
+    def stop_job(self, job_id: str) -> bool:
+        return ray_tpu.get(self._mgr.stop.remote(job_id), timeout=30)
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                          JobStatus.STOPPED):
+                return status
+            time.sleep(0.2)
+        raise TimeoutError(f"job {job_id} did not finish in {timeout}s")

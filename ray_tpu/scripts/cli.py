@@ -1,0 +1,147 @@
+"""CLI: ``python -m ray_tpu.scripts.cli <command>``.
+
+Reference parity: ``python/ray/scripts/scripts.py`` (``ray start/stop/
+status/list/summary/timeline/memory``) + the state CLI
+(``experimental/state/state_cli.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _connect(args):
+    import ray_tpu
+
+    ray_tpu.init(args.address)
+    return ray_tpu
+
+
+def cmd_start(args):
+    """Start a head or worker node daemon (blocks until SIGTERM)."""
+    if args.head:
+        from ray_tpu.cluster.head import main as head_main
+
+        sys.argv = ["head", "--port", str(args.port)]
+        head_main()
+    else:
+        if not args.address:
+            print("--address required for worker nodes", file=sys.stderr)
+            sys.exit(2)
+        from ray_tpu.cluster.node_agent import main as node_main
+
+        sys.argv = ["node", "--head", args.address]
+        if args.num_cpus is not None:
+            sys.argv += ["--num-cpus", str(args.num_cpus)]
+        node_main()
+
+
+def cmd_status(args):
+    ray_tpu = _connect(args)
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    nodes = ray_tpu.nodes()
+    print(f"nodes: {sum(1 for n in nodes if n['Alive'])} alive / {len(nodes)}")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
+
+
+def cmd_list(args):
+    from ray_tpu import state
+
+    _connect(args)
+    kind = args.kind
+    rows = {
+        "tasks": state.list_tasks,
+        "actors": state.list_actors,
+        "objects": state.list_objects,
+    }[kind]()
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_summary(args):
+    from ray_tpu import state
+
+    _connect(args)
+    print(json.dumps(
+        {"tasks": state.summarize_tasks(), "actors": state.summarize_actors()},
+        indent=2,
+    ))
+
+
+def cmd_timeline(args):
+    from ray_tpu import state
+
+    _connect(args)
+    out = state.timeline(args.output)
+    print(f"wrote chrome trace to {out}")
+
+
+def cmd_memory(args):
+    ray_tpu = _connect(args)
+    backend = ray_tpu._private.worker.backend() if hasattr(ray_tpu, "_private") else None
+    from ray_tpu._private import worker as worker_mod
+
+    backend = worker_mod.backend()
+    if hasattr(backend, "store"):
+        print(json.dumps(backend.store.stats(), indent=2))
+    else:
+        objs = backend.list_objects() if hasattr(backend, "list_objects") else []
+        print(json.dumps({"num_objects": len(objs)}, indent=2))
+
+
+def cmd_submit(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    _connect(args)
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=" ".join(args.entrypoint))
+    print(f"submitted {job_id}")
+    if args.wait:
+        status = client.wait_until_finished(job_id)
+        print(f"{job_id}: {status}")
+        print(client.get_job_logs(job_id))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray-tpu")
+    parser.add_argument("--address", default=None,
+                        help="cluster head host:port (default: local)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start a head/worker daemon")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--port", type=int, default=6380)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("status", help="cluster resource status")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list tasks/actors/objects")
+    p.add_argument("kind", choices=["tasks", "actors", "objects"])
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary", help="task/actor state summary")
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("timeline", help="dump chrome trace")
+    p.add_argument("--output", "-o", default="/tmp/ray_tpu_timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("memory", help="object store stats")
+    p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("submit", help="submit a job entrypoint")
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_submit)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

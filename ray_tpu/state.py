@@ -1,0 +1,88 @@
+"""State observability API: list tasks/actors/objects, summaries, timeline.
+
+Reference parity: ``python/ray/experimental/state/api.py:729,952,1269``
+(``ray list tasks/actors/objects``, ``ray summary``) and the Chrome-trace
+timeline dump of ``ray timeline`` (``_private/state.py:414-431``).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import List, Optional
+
+from ray_tpu._private import worker as _worker
+
+
+def list_tasks(limit: int = 1000) -> List[dict]:
+    backend = _worker.backend()
+    if hasattr(backend, "list_tasks"):
+        return backend.list_tasks(limit)
+    return []
+
+
+def list_actors() -> List[dict]:
+    backend = _worker.backend()
+    if hasattr(backend, "list_actors"):
+        return backend.list_actors()
+    return []
+
+
+def list_objects(limit: int = 1000) -> List[dict]:
+    backend = _worker.backend()
+    if hasattr(backend, "list_objects"):
+        return backend.list_objects(limit)
+    return []
+
+
+def summarize_tasks() -> dict:
+    """Counts by (name, state) — `ray summary tasks` analog."""
+    by_name: dict = {}
+    for rec in list_tasks(limit=100_000):
+        entry = by_name.setdefault(
+            rec["name"], {"type": rec["type"], "states": Counter()}
+        )
+        entry["states"][rec["state"]] += 1
+    return {
+        name: {"type": e["type"], "states": dict(e["states"])}
+        for name, e in by_name.items()
+    }
+
+
+def summarize_actors() -> dict:
+    states = Counter()
+    by_class: dict = {}
+    for rec in list_actors():
+        states[rec["state"]] += 1
+        by_class.setdefault(rec["class_name"], Counter())[rec["state"]] += 1
+    return {
+        "total": dict(states),
+        "by_class": {k: dict(v) for k, v in by_class.items()},
+    }
+
+
+def timeline(filename: Optional[str] = None) -> "list | str":
+    """Chrome trace (``chrome://tracing`` / Perfetto) of task execution.
+
+    Returns the event list, or writes JSON to ``filename`` if given.
+    """
+    events = []
+    for rec in list_tasks(limit=100_000):
+        if rec["start_time"] is None:
+            continue
+        end = rec["end_time"] or rec["start_time"]
+        events.append({
+            "name": rec["name"],
+            "cat": rec["type"],
+            "ph": "X",
+            "ts": rec["start_time"] * 1e6,
+            "dur": max(1.0, (end - rec["start_time"]) * 1e6),
+            "pid": "ray_tpu",
+            "tid": rec["task_id"][:8],
+            "args": {"state": rec["state"]},
+        })
+    if filename is not None:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+        return filename
+    return events

@@ -1,0 +1,186 @@
+"""User-defined metrics: Counter / Gauge / Histogram + Prometheus text.
+
+Reference parity: ``python/ray/util/metrics.py`` (the user API) and the
+Prometheus exposition of ``_private/prometheus_exporter.py``; the OpenCensus
+agent pipeline collapses to an in-process registry with a text endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: "List[Metric]" = []
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+]
+
+
+class Metric:
+    metric_type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name.replace("_", "").replace(":", "").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry.append(self)
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        missing = set(self.tag_keys) - set(merged)
+        if missing:
+            raise ValueError(f"metric {self.name} missing tags {missing}")
+        return tuple(merged.get(k, "") for k in self.tag_keys)
+
+    def _fmt_tags(self, key: Tuple) -> str:
+        if not self.tag_keys:
+            return ""
+        inner = ",".join(
+            f'{k}="{v}"' for k, v in zip(self.tag_keys, key)
+        )
+        return "{" + inner + "}"
+
+    def expose(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    metric_type = "counter"
+
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = self._key(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.description}",
+               f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, v in self._values.items():
+                out.append(f"{self.name}{self._fmt_tags(key)} {v}")
+        return out
+
+
+class Gauge(Metric):
+    metric_type = "gauge"
+
+    def __init__(self, name, description="", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+    def inc(self, value: float = 1.0, tags=None):
+        key = self._key(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags=None):
+        self.inc(-value, tags)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.description}",
+               f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, v in self._values.items():
+                out.append(f"{self.name}{self._fmt_tags(key)} {v}")
+        return out
+
+
+class Histogram(Metric):
+    metric_type = "histogram"
+
+    def __init__(self, name, description="", boundaries=None, tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = list(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1)
+            )
+            import bisect
+
+            counts[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.description}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key, counts in self._counts.items():
+                base_tags = list(zip(self.tag_keys, key))
+                cumulative = 0
+                for bound, c in zip(self.boundaries, counts):
+                    cumulative += c
+                    tags = base_tags + [("le", str(bound))]
+                    inner = ",".join(f'{k}="{v}"' for k, v in tags)
+                    out.append(f"{self.name}_bucket{{{inner}}} {cumulative}")
+                cumulative += counts[-1]
+                inner = ",".join(
+                    f'{k}="{v}"' for k, v in base_tags + [("le", "+Inf")]
+                )
+                out.append(f"{self.name}_bucket{{{inner}}} {cumulative}")
+                out.append(
+                    f"{self.name}_sum{self._fmt_tags(key)} {self._sums[key]}"
+                )
+                out.append(
+                    f"{self.name}_count{self._fmt_tags(key)} {self._totals[key]}"
+                )
+        return out
+
+
+def prometheus_text() -> str:
+    """Full registry in Prometheus exposition format (the /metrics body)."""
+    lines: List[str] = []
+    with _registry_lock:
+        metrics = list(_registry)
+    for m in metrics:
+        lines.extend(m.expose())
+    return "\n".join(lines) + "\n"
+
+
+def start_metrics_server(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Serve /metrics for Prometheus scraping; returns the bound port."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server.server_address[1]

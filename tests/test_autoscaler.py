@@ -1,0 +1,124 @@
+"""Autoscaler tests: demand-driven scale-up on a live simulated cluster and
+pure-unit reconciler behavior (reference: ``test_autoscaler.py``,
+``test_autoscaler_fake_multinode.py``)."""
+
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import LocalNodeProvider, NodeProvider, StandardAutoscaler
+from ray_tpu.cluster import Cluster
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+class MockProvider(NodeProvider):
+    def __init__(self):
+        self.nodes = {}
+        self.counter = 0
+
+    def create_node(self, node_type, node_config):
+        self.counter += 1
+        node_id = f"mock-{self.counter}"
+        self.nodes[node_id] = node_type
+        return node_id
+
+    def terminate_node(self, node_id):
+        self.nodes.pop(node_id, None)
+
+    def non_terminated_nodes(self):
+        return list(self.nodes)
+
+
+def test_nodes_to_launch_bin_packing():
+    autoscaler = StandardAutoscaler.__new__(StandardAutoscaler)
+    autoscaler.max_workers = 8
+    autoscaler.node_types = {
+        "small": {"num_cpus": 2},
+        "tpu_host": {"num_cpus": 8, "resources": {"TPU": 4}},
+    }
+    # The TPU demand forces a tpu_host; the 1-CPU demands then pack into
+    # its remaining headroom -> a single launch covers everything.
+    launches = autoscaler._nodes_to_launch(
+        [{"CPU": 1}, {"CPU": 1}, {"TPU": 4}], n_current=0
+    )
+    assert launches == ["tpu_host"]
+    # CPU demands exceeding the tpu host's headroom need a second node.
+    launches = autoscaler._nodes_to_launch(
+        [{"TPU": 4}] + [{"CPU": 2}] * 5, n_current=0
+    )
+    assert sorted(launches) == ["small", "tpu_host"]
+    # Budget cap respected.
+    autoscaler.max_workers = 1
+    launches = autoscaler._nodes_to_launch(
+        [{"CPU": 2}, {"TPU": 4}], n_current=1
+    )
+    assert launches == []
+
+
+def test_scale_up_makes_pending_task_runnable():
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    autoscaler = StandardAutoscaler(
+        cluster.address,
+        LocalNodeProvider(cluster),
+        node_types={"big": {"num_cpus": 4}},
+        max_workers=2,
+        idle_timeout_s=9999,
+    )
+    try:
+        @ray_tpu.remote(num_cpus=4)
+        def needs_big_node():
+            return "ran"
+
+        ref = needs_big_node.remote()  # no node can fit -> pending demand
+        time.sleep(0.5)
+        report = autoscaler.update()
+        assert len(report["launched"]) == 1
+        assert ray_tpu.get(ref, timeout=60) == "ran"
+    finally:
+        autoscaler.stop()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_scale_down_idle_nodes():
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    provider = LocalNodeProvider(cluster)
+    autoscaler = StandardAutoscaler(
+        cluster.address,
+        provider,
+        node_types={"big": {"num_cpus": 2}},
+        max_workers=2,
+        idle_timeout_s=0.5,
+        launch_cooldown_s=0.0,
+    )
+    try:
+        node_id = provider.create_node("big", {"num_cpus": 2})
+        cluster.wait_for_nodes()
+        assert provider.non_terminated_nodes() == [node_id]
+        autoscaler.update()  # first observation starts the idle clock
+        time.sleep(0.8)  # exceed idle timeout
+        report = autoscaler.update()
+        assert node_id in report["terminated"]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+            if len(alive) == 1:
+                break
+            time.sleep(0.1)
+        assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == 1
+    finally:
+        autoscaler.stop()
+        ray_tpu.shutdown()
+        cluster.shutdown()

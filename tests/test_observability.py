@@ -1,0 +1,136 @@
+"""State API, timeline, metrics, CLI, job submission tests
+(reference behaviors: ``experimental/state``, ``util/metrics``,
+``job_submission``, ``ray timeline``)."""
+
+import json
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.util import metrics
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_list_and_summarize_tasks():
+    @ray_tpu.remote
+    def fine():
+        return 1
+
+    @ray_tpu.remote
+    def broken():
+        raise ValueError("x")
+
+    ray_tpu.get([fine.remote() for _ in range(3)])
+    try:
+        ray_tpu.get(broken.remote())
+    except Exception:
+        pass
+    tasks = state.list_tasks()
+    names = [t["name"] for t in tasks]
+    assert names.count("fine") == 3
+    summary = state.summarize_tasks()
+    assert summary["fine"]["states"].get("FINISHED") == 3
+    assert summary["broken"]["states"].get("FAILED") == 1
+
+
+def test_list_actors_and_summary():
+    @ray_tpu.remote
+    class Probe:
+        def ping(self):
+            return "pong"
+
+    a = Probe.remote()
+    ray_tpu.get(a.ping.remote())
+    actors = state.list_actors()
+    assert any(r["class_name"] == "Probe" and r["state"] == "ALIVE"
+               for r in actors)
+    tasks = state.list_tasks()
+    assert any(t["type"] == "ACTOR_TASK" and t["name"] == "ping"
+               for t in tasks)
+    ray_tpu.kill(a)
+    time.sleep(0.2)
+    assert any(r["class_name"] == "Probe" and r["state"] == "DEAD"
+               for r in state.list_actors())
+    assert state.summarize_actors()["by_class"]["Probe"]
+
+
+def test_timeline_chrome_trace(tmp_path):
+    @ray_tpu.remote
+    def traced():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([traced.remote() for _ in range(2)])
+    out = tmp_path / "trace.json"
+    state.timeline(str(out))
+    events = json.loads(out.read_text())
+    mine = [e for e in events if e["name"] == "traced"]
+    assert len(mine) == 2
+    assert all(e["ph"] == "X" and e["dur"] >= 1 for e in mine)
+
+
+def test_metrics_counter_gauge_histogram():
+    c = metrics.Counter("test_requests_total", "reqs", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    g = metrics.Gauge("test_inflight", "inflight")
+    g.set(5)
+    g.dec()
+    h = metrics.Histogram("test_latency_seconds", "lat",
+                          boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(3.0)
+    text = metrics.prometheus_text()
+    assert 'test_requests_total{route="/a"} 3.0' in text
+    assert "test_inflight 4.0" in text
+    assert 'test_latency_seconds_bucket{le="0.1"} 1' in text
+    assert 'test_latency_seconds_bucket{le="+Inf"} 3' in text
+    assert "test_latency_seconds_count 3" in text
+
+
+def test_metrics_http_endpoint():
+    port = metrics.start_metrics_server()
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ) as resp:
+        body = resp.read().decode()
+    assert "# TYPE" in body
+
+
+def test_job_submission_lifecycle():
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"",
+    )
+    assert client.wait_until_finished(job_id, timeout=60) == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(job_id)
+    assert any(j["job_id"] == job_id for j in client.list_jobs())
+
+    bad = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(bad, timeout=60) == JobStatus.FAILED
+
+
+def test_cli_status_and_summary(capsys):
+    from ray_tpu.scripts.cli import main
+
+    main(["status"])
+    out = capsys.readouterr().out
+    assert "alive" in out and "CPU" in out
+    main(["summary"])
+    out = capsys.readouterr().out
+    assert "tasks" in out
