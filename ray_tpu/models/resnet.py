@@ -1,0 +1,160 @@
+"""ResNet in pure JAX — the vision training workload.
+
+Reference parity: the reference's ResNet-50 MLPerf-style benchmark
+(``release/air_tests/air_benchmarks/mlperf-train/resnet50_ray_air.py``)
+trains torch ResNet-50 under Ray Train; here the model is owned by the
+framework and compiled as one pjit program.
+
+TPU design notes: convs map onto the MXU via ``lax.conv_general_dilated``
+in NHWC (TPU-native layout); normalization is GroupNorm — stateless, so
+the train step stays a pure function of (params, batch) with no
+running-stat side channel, and it parallelizes over any mesh without
+cross-replica batch statistics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    groups: int = 32
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def resnet18(cls, **kw):
+        return cls(stage_sizes=(2, 2, 2, 2), **kw)
+
+    @classmethod
+    def resnet50(cls, **kw):
+        return cls(stage_sizes=(3, 4, 6, 3), **kw)
+
+    @classmethod
+    def tiny(cls):
+        """CPU-test sized."""
+        return cls(stage_sizes=(1, 1), num_classes=10, width=8, groups=4)
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    std = (2.0 / fan_in) ** 0.5
+    return (jax.random.normal(key, (kh, kw, cin, cout)) * std).astype(dtype)
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _group_norm(x, scale, bias, groups):
+    b, h, w, c = x.shape
+    g = min(groups, c)
+    x32 = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mu = x32.mean(axis=(1, 2, 4), keepdims=True)
+    var = x32.var(axis=(1, 2, 4), keepdims=True)
+    x32 = (x32 - mu) * jax.lax.rsqrt(var + 1e-5)
+    x32 = x32.reshape(b, h, w, c)
+    return (x32 * scale + bias).astype(x.dtype)
+
+
+def resnet_init(rng: jax.Array, cfg: ResNetConfig) -> Params:
+    pd = cfg.param_dtype
+    keys = iter(jax.random.split(rng, 1024))
+    width = cfg.width
+
+    def norm_params(c):
+        return {"scale": jnp.ones((c,), pd), "bias": jnp.zeros((c,), pd)}
+
+    params: dict = {
+        "stem": {
+            "conv": _conv_init(next(keys), 7, 7, 3, width, pd),
+            "norm": norm_params(width),
+        },
+        "stages": [],
+    }
+    cin = width
+    for i, n_blocks in enumerate(cfg.stage_sizes):
+        cmid = width * (2**i)
+        cout = cmid * 4
+        stage = []
+        for j in range(n_blocks):
+            block = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, cmid, pd),
+                "norm1": norm_params(cmid),
+                "conv2": _conv_init(next(keys), 3, 3, cmid, cmid, pd),
+                "norm2": norm_params(cmid),
+                "conv3": _conv_init(next(keys), 1, 1, cmid, cout, pd),
+                "norm3": norm_params(cout),
+            }
+            if j == 0:
+                block["proj"] = _conv_init(next(keys), 1, 1, cin, cout, pd)
+                block["proj_norm"] = norm_params(cout)
+            stage.append(block)
+            cin = cout
+        params["stages"].append(stage)
+    params["head"] = {
+        "w": (jax.random.normal(next(keys), (cin, cfg.num_classes)) * 0.01).astype(pd),
+        "b": jnp.zeros((cfg.num_classes,), pd),
+    }
+    return params
+
+
+def resnet_forward(params: Params, images: jax.Array, cfg: ResNetConfig) -> jax.Array:
+    """images [B, H, W, 3] -> logits [B, num_classes] (fp32)."""
+    x = images.astype(cfg.dtype)
+    stem = params["stem"]
+    x = _conv(x, stem["conv"], stride=2)
+    x = _group_norm(x, stem["norm"]["scale"], stem["norm"]["bias"], cfg.groups)
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for i, stage in enumerate(params["stages"]):
+        for j, block in enumerate(stage):
+            stride = 2 if (i > 0 and j == 0) else 1
+            residual = x
+            y = _conv(x, block["conv1"])
+            y = _group_norm(y, block["norm1"]["scale"], block["norm1"]["bias"],
+                            cfg.groups)
+            y = jax.nn.relu(y)
+            y = _conv(y, block["conv2"], stride=stride)
+            y = _group_norm(y, block["norm2"]["scale"], block["norm2"]["bias"],
+                            cfg.groups)
+            y = jax.nn.relu(y)
+            y = _conv(y, block["conv3"])
+            y = _group_norm(y, block["norm3"]["scale"], block["norm3"]["bias"],
+                            cfg.groups)
+            if "proj" in block:
+                residual = _conv(x, block["proj"], stride=stride)
+                residual = _group_norm(
+                    residual, block["proj_norm"]["scale"],
+                    block["proj_norm"]["bias"], cfg.groups,
+                )
+            x = jax.nn.relu(y + residual)
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    head = params["head"]
+    return x @ head["w"].astype(jnp.float32) + head["b"].astype(jnp.float32)
+
+
+def resnet_loss(params: Params, batch: dict, cfg: ResNetConfig) -> jax.Array:
+    """Cross-entropy. batch: {'images': [B,H,W,3], 'labels': [B] int32}."""
+    logits = resnet_forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(
+        jnp.take_along_axis(logp, batch["labels"][:, None], axis=1)
+    )
