@@ -1,0 +1,138 @@
+"""multiprocessing.Pool-compatible API over tasks.
+
+Reference parity: ``python/ray/util/multiprocessing/pool.py`` — drop-in
+``Pool`` with map/starmap/apply and their async variants, backed by
+``@remote`` tasks instead of OS processes.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs: List, single: bool = False):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        values = ray_tpu.get(self._refs, timeout=timeout)
+        return values[0] if self._single else values
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(
+            self._refs, num_returns=len(self._refs), timeout=0
+        )
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Task-backed process pool. ``processes`` caps in-flight chunks."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._processes = processes or 8
+        self._initializer = initializer
+        self._initargs = initargs
+        self._closed = False
+
+    def _run(self, func: Callable, args: tuple, kwargs: dict):
+        initializer, initargs = self._initializer, self._initargs
+
+        def call(*a, **kw):
+            if initializer is not None:
+                initializer(*initargs)
+            return func(*a, **kw)
+
+        task = ray_tpu.remote(call)
+        return task.remote(*args, **kwargs)
+
+    def apply(self, func, args: tuple = (), kwds: Optional[dict] = None):
+        return ray_tpu.get(self._run(func, args, kwds or {}))
+
+    def apply_async(self, func, args: tuple = (), kwds: Optional[dict] = None):
+        return AsyncResult([self._run(func, args, kwds or {})], single=True)
+
+    @staticmethod
+    def _chunks(iterable: Iterable, size: int):
+        it = iter(iterable)
+        while True:
+            chunk = list(itertools.islice(it, size))
+            if not chunk:
+                return
+            yield chunk
+
+    def _map_refs(self, func, iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+
+        def run_chunk(chunk):
+            if self._initializer is not None:
+                self._initializer(*self._initargs)
+            return [func(x) for x in chunk]
+
+        task = ray_tpu.remote(run_chunk)
+        return [task.remote(c) for c in self._chunks(items, chunksize)]
+
+    def map(self, func, iterable, chunksize: Optional[int] = None) -> list:
+        refs = self._map_refs(func, iterable, chunksize)
+        return [x for chunk in ray_tpu.get(refs) for x in chunk]
+
+    def map_async(self, func, iterable, chunksize: Optional[int] = None):
+        refs = self._map_refs(func, iterable, chunksize)
+
+        class _MapResult(AsyncResult):
+            def get(self, timeout=None):
+                return [x for c in ray_tpu.get(self._refs, timeout=timeout)
+                        for x in c]
+
+        return _MapResult(refs)
+
+    def starmap(self, func, iterable, chunksize: Optional[int] = None) -> list:
+        return self.map(lambda args: func(*args), iterable, chunksize)
+
+    def imap(self, func, iterable, chunksize: int = 1):
+        refs = self._map_refs(func, iterable, chunksize)
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, func, iterable, chunksize: int = 1):
+        refs = self._map_refs(func, iterable, chunksize)
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=None)
+            for r in ready:
+                yield from ray_tpu.get(r)
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still open")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
