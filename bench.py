@@ -6,30 +6,34 @@ Prints ONE JSON line:
 
 Baseline (BASELINE.json): Ray-Train-style GPT-2 at >=45% MFU. vs_baseline > 1
 means we beat the 45% target on this chip.
+
+Hardened (round 2): TPU availability is probed in a subprocess with a bounded
+timeout, the measurement itself runs in a subprocess (retried once), and on
+TPU failure the script degrades to a CPU measurement with an ``"error"``
+field instead of crashing — the JSON line is ALWAYS emitted.
+
+Platform handling: the TPU attempt inherits the environment untouched (the
+TPU may be exposed through a site-customized JAX platform plugin, so forcing
+``JAX_PLATFORMS=tpu`` would hide it); the CPU fallback clears the plugin's
+env triggers and forces the cpu platform.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
-from ray_tpu.models.gpt2 import (
-    GPT2Config,
-    gpt2_flops_per_token,
-    gpt2_init,
-    gpt2_loss,
-    gpt2_shardings,
-)
-from ray_tpu.parallel.mesh import MeshConfig, build_mesh
-from ray_tpu.train.train_step import make_init_fn, make_train_step
+TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT", "1200"))
+TPU_RETRY_TIMEOUT_S = int(os.environ.get("BENCH_TPU_RETRY_TIMEOUT", "900"))
+CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
 
 # bf16 peak TFLOP/s per chip by device kind substring.
 PEAK_TFLOPS = {
     "v5 lite": 197.0,
+    "v5litepod": 197.0,
     "v5e": 197.0,
     "v4": 275.0,
     "v5p": 459.0,
@@ -39,19 +43,42 @@ PEAK_TFLOPS = {
 }
 
 
-def peak_flops_per_chip() -> float:
-    kind = jax.devices()[0].device_kind.lower()
+def _peak_flops_per_chip(device_kind: str) -> float:
+    kind = device_kind.lower()
     for key, tf in PEAK_TFLOPS.items():
         if key in kind:
             return tf * 1e12
     return 197.0e12
 
 
-def main() -> None:
+# --------------------------------------------------------------------------
+# Worker: the actual measurement, runs in a subprocess.
+# --------------------------------------------------------------------------
+
+
+def _worker(platform: str) -> None:
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt2 import (
+        GPT2Config,
+        gpt2_flops_per_token,
+        gpt2_init,
+        gpt2_loss,
+        gpt2_shardings,
+    )
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+    from ray_tpu.train.train_step import make_init_fn, make_train_step
+
     on_tpu = jax.default_backend() not in ("cpu",)
     n_dev = jax.device_count()
     if on_tpu:
-        cfg = GPT2Config()  # GPT-2 small, seq 1024
+        cfg = GPT2Config()  # GPT-2 small, seq 1024; remat on (v5e HBM fit)
         batch, steps, warmup = 16 * n_dev, 20, 3
     else:
         cfg = GPT2Config.tiny()
@@ -84,10 +111,11 @@ def main() -> None:
     tok_s = tokens_per_step * steps / dt
     flops_tok = gpt2_flops_per_token(cfg)
     achieved = tok_s * flops_tok
-    mfu = achieved / (peak_flops_per_chip() * n_dev) * 100.0
+    device_kind = jax.devices()[0].device_kind
+    mfu = achieved / (_peak_flops_per_chip(device_kind) * n_dev) * 100.0
 
     print(
-        f"gpt2 {cfg.n_params/1e6:.0f}M params, batch={batch}, seq={cfg.seq_len}, "
+        f"gpt2 {cfg.n_params / 1e6:.0f}M params, batch={batch}, seq={cfg.seq_len}, "
         f"{steps} steps in {dt:.2f}s, loss={final_loss:.3f}",
         file=sys.stderr,
     )
@@ -99,12 +127,100 @@ def main() -> None:
                 "unit": "%",
                 "vs_baseline": round(mfu / 45.0, 3),
                 "tokens_per_sec_per_chip": round(tok_s / n_dev, 1),
-                "device": jax.devices()[0].device_kind,
+                "device": device_kind,
                 "n_devices": n_dev,
             }
-        )
+        ),
+        flush=True,
     )
 
 
+# --------------------------------------------------------------------------
+# Orchestrator: probe + bounded subprocess runs + honest fallback.
+# --------------------------------------------------------------------------
+
+
+def _subproc_env(platform: str) -> dict:
+    env = dict(os.environ)
+    if platform == "cpu":
+        # Neutralize any site-customized TPU platform plugin and force cpu.
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+    return env
+
+
+def _run_subprocess(argv, platform: str, timeout: float):
+    """Run argv; return (ok, json_or_None, err)."""
+    try:
+        proc = subprocess.run(
+            argv, env=_subproc_env(platform), capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False, None, f"timeout after {timeout:.0f}s"
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+        return False, None, f"rc={proc.returncode}: {' | '.join(tail)[:500]}"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict) and "metric" in obj:
+                return True, obj, ""
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return False, None, "no JSON line in worker output"
+
+
+def main() -> None:
+    errors = []
+    result = None
+
+    # TPU attempt (default env so a site-customized platform plugin is
+    # honored), bounded + retried once. No separate probe: the chip may be
+    # exclusively claimed, and a probe-then-run would claim it twice.
+    for attempt, tmo in enumerate((TPU_TIMEOUT_S, TPU_RETRY_TIMEOUT_S)):
+        ok, result, err = _run_subprocess(
+            [sys.executable, __file__, "--worker", "default"],
+            "default", tmo,
+        )
+        if ok and result.get("device", "").lower() == "cpu":
+            # No TPU attached: the default backend ran the CPU measurement.
+            # That outcome is deterministic — keep this result as the CPU
+            # number instead of retrying/re-measuring.
+            errors.append("no TPU attached (default backend is cpu)")
+            break
+        if ok:
+            break
+        errors.append(f"tpu run attempt {attempt + 1}: {err}")
+        result = None
+
+    if result is None:
+        # Degrade to a CPU measurement so a number is always recorded.
+        for attempt in range(2):
+            ok3, result, err = _run_subprocess(
+                [sys.executable, __file__, "--worker", "cpu"], "cpu",
+                CPU_TIMEOUT_S,
+            )
+            if ok3:
+                break
+            errors.append(f"cpu run attempt {attempt + 1}: {err}")
+            result = None
+
+    if result is None:
+        result = {
+            "metric": "gpt2_train_mfu",
+            "value": 0.0,
+            "unit": "%",
+            "vs_baseline": 0.0,
+        }
+    if errors:
+        result["error"] = "; ".join(errors)[:1000]
+    print(json.dumps(result), flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        _worker(sys.argv[2] if len(sys.argv) > 2 else "default")
+    else:
+        main()

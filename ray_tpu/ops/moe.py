@@ -56,6 +56,11 @@ def _route(x2d, router_w, n_experts, top_k, capacity):
     t = x2d.shape[0]
 
     gates, experts = jax.lax.top_k(probs, top_k)  # [T, k]
+    if top_k > 1:
+        # GShard-style top-k gating: renormalize over the selected experts
+        # so the combined output isn't attenuated by dropped probability
+        # mass (sum of selected gates == 1).
+        gates = gates / (jnp.sum(gates, axis=-1, keepdims=True) + 1e-9)
     # Load-balancing auxiliary loss (Switch Transformer eq. 4).
     density = jnp.mean(probs, axis=0)
     top1_mask = jax.nn.one_hot(experts[:, 0], n_experts)

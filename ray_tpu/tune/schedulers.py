@@ -48,11 +48,13 @@ class ASHAScheduler(TrialScheduler):
         self.grace = grace_period
         self.rf = reduction_factor
         self.time_attr = time_attr
-        # rung value t -> list of recorded metric values at that rung
-        self.rungs: Dict[int, List[float]] = {}
+        # rung value t -> {trial_id: recorded metric value at that rung}.
+        # Each trial is recorded at most once per rung so rung populations
+        # are peers-that-reached-the-rung, not per-iteration duplicates.
+        self.rungs: Dict[int, Dict[str, float]] = {}
         t = grace_period
         while t < max_t:
-            self.rungs[t] = []
+            self.rungs[t] = {}
             t *= reduction_factor
 
     def on_trial_result(self, runner, trial, result: dict) -> str:
@@ -64,17 +66,21 @@ class ASHAScheduler(TrialScheduler):
             value = -value
         if t >= self.max_t:
             return STOP
-        decision = CONTINUE
         for rung_t in sorted(self.rungs, reverse=True):
-            if t >= rung_t:
+            if t >= rung_t and trial.trial_id not in self.rungs[rung_t]:
                 recorded = self.rungs[rung_t]
-                recorded.append(value)
-                k = max(1, len(recorded) // self.rf)
-                cutoff = sorted(recorded, reverse=True)[k - 1]
-                if value < cutoff:
-                    decision = STOP
+                # Cutoff from peers already at the rung, BEFORE recording
+                # this trial (mirrors the async-successive-halving rule).
+                cutoff = None
+                if recorded:
+                    vals = sorted(recorded.values(), reverse=True)
+                    k = max(1, len(vals) // self.rf)
+                    cutoff = vals[k - 1]
+                recorded[trial.trial_id] = value
+                if cutoff is not None and value < cutoff:
+                    return STOP
                 break
-        return decision
+        return CONTINUE
 
 
 class MedianStoppingRule(TrialScheduler):
