@@ -280,6 +280,20 @@ class ClusterBackend:
             time.sleep(0.005)
         return ready, pending
 
+    # -- internal KV -------------------------------------------------------
+
+    def kv_put(self, key: str, value, overwrite: bool = True) -> bool:
+        return self.head.call("kv_put", key, value, overwrite)
+
+    def kv_get(self, key: str):
+        return self.head.call("kv_get", key)
+
+    def kv_del(self, key: str) -> bool:
+        return self.head.call("kv_del", key)
+
+    def kv_keys(self, prefix: str = "") -> list[str]:
+        return self.head.call("kv_keys", prefix)
+
     # -- task plane --------------------------------------------------------
 
     def _strategy_info(self, options: dict) -> dict:

@@ -223,6 +223,30 @@ class LocalBackend:
         )
         self._task_records_cap = 10_000
         self._actor_records: dict[str, dict] = {}
+        # Internal KV (GCS InternalKVGcsService analog, in-process flavor).
+        self._kv: dict[str, Any] = {}
+        self.node_id = "local"
+
+    # -- internal KV -------------------------------------------------------
+
+    def kv_put(self, key: str, value, overwrite: bool = True) -> bool:
+        with self._lock:
+            if not overwrite and key in self._kv:
+                return False
+            self._kv[key] = value
+            return True
+
+    def kv_get(self, key: str):
+        with self._lock:
+            return self._kv.get(key)
+
+    def kv_del(self, key: str) -> bool:
+        with self._lock:
+            return self._kv.pop(key, None) is not None
+
+    def kv_keys(self, prefix: str = "") -> list[str]:
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
 
     # -- ref counting ------------------------------------------------------
 

@@ -1,0 +1,15 @@
+"""Experimental APIs (reference: ``python/ray/experimental/``)."""
+
+from ray_tpu.experimental.internal_kv import (
+    _internal_kv_del,
+    _internal_kv_get,
+    _internal_kv_list,
+    _internal_kv_put,
+)
+
+__all__ = [
+    "_internal_kv_put",
+    "_internal_kv_get",
+    "_internal_kv_del",
+    "_internal_kv_list",
+]

@@ -1,0 +1,49 @@
+"""Cluster-wide internal KV store.
+
+Reference parity: ``ray.experimental.internal_kv`` backed by the GCS KV
+table (``src/ray/gcs/gcs_server/gcs_kv_manager.h``). Here the head server
+holds the table in cluster mode; the local backend holds it in-process.
+This is the rendezvous substrate for collective-group bootstrap (the
+NCCL-uid-via-named-actor pattern of the reference becomes
+coordinator-address-via-KV, see ``ray_tpu.parallel.distributed``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private import worker as _worker
+
+
+def _internal_kv_put(key: str, value, overwrite: bool = True) -> bool:
+    """Store key -> value; returns True if written."""
+    return _worker.backend().kv_put(key, value, overwrite)
+
+
+def _internal_kv_get(key: str):
+    return _worker.backend().kv_get(key)
+
+
+def _internal_kv_del(key: str) -> bool:
+    return _worker.backend().kv_del(key)
+
+
+def _internal_kv_list(prefix: str = "") -> list[str]:
+    return _worker.backend().kv_keys(prefix)
+
+
+def kv_put(key: str, value, overwrite: bool = True) -> bool:
+    return _internal_kv_put(key, value, overwrite)
+
+
+def kv_get(key: str, default=None):
+    v = _internal_kv_get(key)
+    return default if v is None else v
+
+
+def kv_del(key: str) -> bool:
+    return _internal_kv_del(key)
+
+
+def kv_keys(prefix: str = "") -> list[str]:
+    return _internal_kv_list(prefix)

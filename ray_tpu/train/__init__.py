@@ -13,6 +13,7 @@ from ray_tpu.train.optim import adamw_init, adamw_update
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
+    JaxConfig,
     RunConfig,
     ScalingConfig,
 )
@@ -37,6 +38,7 @@ __all__ = [
     "RunConfig",
     "FailureConfig",
     "CheckpointConfig",
+    "JaxConfig",
     "Checkpoint",
     "save_sharded",
     "load_sharded",

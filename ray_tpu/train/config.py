@@ -43,6 +43,25 @@ class ScalingConfig:
 
 
 @dataclass
+class JaxConfig:
+    """Per-worker JAX runtime setup (the ``TorchConfig`` analog,
+    ``python/ray/train/torch/config.py:29``): whether/how workers join one
+    ``jax.distributed`` process group so all hosts' chips form a single
+    global mesh.
+
+    ``platform``/``num_cpu_devices`` force the CPU simulation path (N
+    virtual devices per worker process, Gloo cross-process collectives) —
+    the test harness for multi-host behavior. On a real TPU pod leave both
+    None: the TPU runtime discovers slice topology itself.
+    """
+
+    distributed: bool = True
+    platform: Optional[str] = None  # e.g. "cpu" for the simulation path
+    num_cpu_devices: Optional[int] = None  # virtual devices per worker
+    init_timeout: float = 120.0
+
+
+@dataclass
 class FailureConfig:
     max_failures: int = 0  # 0 = no retries, -1 = infinite
 

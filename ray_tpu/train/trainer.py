@@ -21,11 +21,13 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.core import ids
 from ray_tpu.core.object_ref import ActorError, TaskError
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
+    JaxConfig,
     RunConfig,
     ScalingConfig,
 )
@@ -51,6 +53,33 @@ class _TrainWorker:
 
     def __init__(self, rank: int):
         self.rank = rank
+
+    def node_id(self) -> str:
+        """Which cluster node this worker landed on (for rank layout)."""
+        import ray_tpu._private.worker as worker_mod
+
+        return getattr(worker_mod.backend(), "node_id", "local")
+
+    def setup_jax(
+        self, group: str, rank: int, world_size: int,
+        local_rank: int, local_world_size: int, jax_config,
+    ) -> bool:
+        """Join the group's jax.distributed runtime (Backend.on_start
+        analog, ``train/torch/config.py:129-181``). Blocks until all
+        ranks connect, so the trainer must call it on all workers
+        concurrently."""
+        import os
+
+        from ray_tpu.parallel import distributed as dist
+
+        os.environ["RAY_TPU_LOCAL_RANK"] = str(local_rank)
+        dist.initialize(
+            group, rank, world_size,
+            platform=jax_config.platform,
+            num_cpu_devices=jax_config.num_cpu_devices,
+            timeout=jax_config.init_timeout,
+        )
+        return True
 
     def run(self, train_fn, config, session_kwargs):
         session_mod.init_session(**session_kwargs)
@@ -173,12 +202,14 @@ class DataParallelTrainer:
                 name: _shard_dataset(ds, n) for name, ds in self.datasets.items()
             }
             start_ckpt = ckpt_mgr.latest or self.resume_checkpoint
+            node_ranks, local_ranks = self._compute_ranks(group)
+            self._on_group_start(group, node_ranks, local_ranks)
             session_kwargs = [
                 {
                     "world_rank": i,
                     "world_size": n,
-                    "local_rank": 0,
-                    "node_rank": i,
+                    "local_rank": local_ranks[i],
+                    "node_rank": node_ranks[i],
                     "results_queue": queue,
                     "checkpoint": start_ckpt,
                     "dataset_shards": {
@@ -194,6 +225,28 @@ class DataParallelTrainer:
         finally:
             queue.shutdown()
             group.shutdown()
+
+    def _compute_ranks(self, group: WorkerGroup) -> tuple[list, list]:
+        """node_rank + local_rank per worker, from actual actor placement
+        (``backend_executor.py:339-404`` init_session rank layout)."""
+        node_ids = ray_tpu.get(
+            [w.node_id.remote() for w in group.workers], timeout=60
+        )
+        node_order: list[str] = []
+        counts: dict[str, int] = {}
+        node_ranks, local_ranks = [], []
+        for nid in node_ids:
+            if nid not in counts:
+                counts[nid] = 0
+                node_order.append(nid)
+            node_ranks.append(node_order.index(nid))
+            local_ranks.append(counts[nid])
+            counts[nid] += 1
+        return node_ranks, local_ranks
+
+    def _on_group_start(self, group, node_ranks, local_ranks) -> None:
+        """Framework-backend hook run before the training loops start
+        (``Backend.on_start`` analog). Default: nothing."""
 
     def _consume_results(
         self, queue, run_refs, n, ckpt_mgr, metrics_history
@@ -258,9 +311,49 @@ class JaxTrainer(DataParallelTrainer):
     """DataParallelTrainer whose workers drive jax on their local devices.
 
     The torch/TF/horovod backends of the reference
-    (``train/torch/config.py:113``) become: each worker (host) builds its
-    mesh via ``ray_tpu.parallel.build_mesh`` inside the loop; gradient
-    communication happens inside the jitted step (XLA collectives). For
-    true multi-host meshes the workers call ``jax.distributed.initialize``
-    with a rendezvous address from the session (round-2: cluster KV).
+    (``train/torch/config.py:113``) become: before the loops start, every
+    worker joins ONE ``jax.distributed`` process group — rank 0 publishes
+    the coordinator address through the cluster KV
+    (``ray_tpu.parallel.distributed``), all ranks call
+    ``jax.distributed.initialize``, and ``jax.devices()`` then spans every
+    worker host. Gradient communication happens inside the jitted step
+    (XLA collectives on ICI/DCN); the framework only does placement,
+    sessions, checkpoints, and failure handling.
     """
+
+    def __init__(self, *args, jax_config: Optional[JaxConfig] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.jax_config = jax_config or JaxConfig()
+
+    def _on_group_start(self, group, node_ranks, local_ranks) -> None:
+        if not self.jax_config.distributed:
+            return
+        # The in-process local backend runs worker "actors" as threads of
+        # ONE process — jax.distributed (one runtime per OS process) can't
+        # span them. Multi-host setup needs the cluster backend, where each
+        # worker is its own process; on the local backend each worker just
+        # uses the process-wide JAX runtime as-is.
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu.core.local_backend import LocalBackend
+
+        if isinstance(worker_mod.backend(), LocalBackend):
+            return
+        from ray_tpu.parallel import distributed as dist
+
+        group_name = f"train-{ids.new_task_id()[:12]}"
+        local_world = {}
+        for nr in node_ranks:
+            local_world[nr] = local_world.get(nr, 0) + 1
+        # All setup calls must be in flight together: initialize() blocks
+        # until every rank has connected to the coordinator.
+        refs = [
+            w.setup_jax.remote(
+                group_name, i, self.scaling.num_workers,
+                local_ranks[i], local_world[node_ranks[i]], self.jax_config,
+            )
+            for i, w in enumerate(group.workers)
+        ]
+        try:
+            ray_tpu.get(refs, timeout=self.jax_config.init_timeout + 60)
+        finally:
+            dist.clear_group(group_name)
