@@ -42,10 +42,25 @@ def _load():
         ctypes.c_uint64,
         ctypes.c_uint64,
     ]
-    for fn in ("ts_seal", "ts_release", "ts_contains", "ts_delete", "ts_abort"):
+    for fn in ("ts_seal", "ts_release", "ts_contains", "ts_delete", "ts_abort",
+               "ts_evict"):
         f = getattr(lib, fn)
         f.restype = ctypes.c_int
         f.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ts_pin.restype = ctypes.c_int
+    lib.ts_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.ts_release_dead.restype = ctypes.c_int64
+    lib.ts_release_dead.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ts_info.restype = ctypes.c_int
+    lib.ts_info.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
     lib.ts_get.restype = ctypes.c_int
     lib.ts_get.argtypes = [
         ctypes.c_void_p,
@@ -160,6 +175,44 @@ class ShmStore:
 
     def abort(self, object_id: str) -> bool:
         return _get_lib().ts_abort(self._h, store_key(object_id)) == 0
+
+    def release_dead(self, pid: int) -> int:
+        """Reclaim all pins held by a dead process + abort its unsealed
+        creations; returns slots touched (crash-leak cleanup)."""
+        return _get_lib().ts_release_dead(self._h, pid)
+
+    def pin(self, object_id: str, pinned: bool = True) -> bool:
+        """Primary-copy pin: pinned objects are never LRU-evicted (only
+        spilled). Set on put by owners; cleared when the cluster
+        ref-counter frees the object."""
+        return _get_lib().ts_pin(self._h, store_key(object_id), int(pinned)) == 0
+
+    def evict(self, object_id: str) -> bool:
+        """Remove a sealed object regardless of pin (its bytes are safe
+        elsewhere, e.g. spilled). Fails if actively read (refcount > 0)."""
+        return _get_lib().ts_evict(self._h, store_key(object_id)) == 0
+
+    def info(self, object_id: str) -> dict | None:
+        """Sealed-object metadata (spill-candidate selection)."""
+        dsz = ctypes.c_uint64()
+        msz = ctypes.c_uint64()
+        ref = ctypes.c_int64()
+        pin = ctypes.c_uint32()
+        lru = ctypes.c_uint64()
+        rc = _get_lib().ts_info(
+            self._h, store_key(object_id), ctypes.byref(dsz),
+            ctypes.byref(msz), ctypes.byref(ref), ctypes.byref(pin),
+            ctypes.byref(lru),
+        )
+        if rc != 0:
+            return None
+        return {
+            "data_size": dsz.value,
+            "meta_size": msz.value,
+            "refcount": ref.value,
+            "pinned": bool(pin.value),
+            "lru_tick": lru.value,
+        }
 
     # -- introspection ----------------------------------------------------
 

@@ -45,15 +45,36 @@ enum SlotState : uint32_t {
   SLOT_TOMBSTONE = 2,
 };
 
+// Read pins are tracked PER PROCESS so the node agent can reclaim the
+// pins of a crashed worker (plasma handles the same problem via client
+// disconnect cleanup in the store daemon). Up to kPinSlots distinct
+// processes are tracked exactly; further pinners fall into an overflow
+// count that a crash cannot reclaim (rare: >6 concurrent readers of one
+// object on one node).
+constexpr int kPinSlots = 6;
+struct PinEntry {
+  int32_t pid;
+  int32_t count;
+};
+
 struct Slot {
   uint8_t id[kIdSize];
   uint32_t state;     // SlotState
   uint32_t sealed;    // 0 = created/unsealed, 1 = sealed
-  int64_t refcount;   // cross-process pins from ts_get
+  int64_t refcount;   // total cross-process pins (sum of entries+overflow)
+  PinEntry pins[kPinSlots];
+  int64_t overflow_pins;
+  uint32_t creator_pid;  // for aborting creations of crashed processes
   uint64_t offset;    // data offset from segment base
   uint64_t data_size;
   uint64_t meta_size;
   uint64_t lru_tick;  // last-touch clock for eviction
+  // Primary-copy pin: set while the cluster ref-counter still references
+  // the object. Pinned objects are never LRU-evicted (data would be LOST);
+  // they may be SPILLED to disk (data preserved) via ts_evict after the
+  // node agent wrote them out (local_object_manager.h:110 analog).
+  uint32_t pinned;
+  uint32_t _pad;
 };
 
 // Arena block header, placed immediately before each block's payload.
@@ -204,6 +225,35 @@ void arena_free(Handle* h, uint64_t payload_off) {
   b->free = 1;
 }
 
+void pin_add(Slot* s, int32_t pid) {
+  s->refcount++;
+  for (int i = 0; i < kPinSlots; i++) {
+    if (s->pins[i].count > 0 && s->pins[i].pid == pid) {
+      s->pins[i].count++;
+      return;
+    }
+  }
+  for (int i = 0; i < kPinSlots; i++) {
+    if (s->pins[i].count == 0) {
+      s->pins[i].pid = pid;
+      s->pins[i].count = 1;
+      return;
+    }
+  }
+  s->overflow_pins++;
+}
+
+void pin_sub(Slot* s, int32_t pid) {
+  if (s->refcount > 0) s->refcount--;
+  for (int i = 0; i < kPinSlots; i++) {
+    if (s->pins[i].count > 0 && s->pins[i].pid == pid) {
+      s->pins[i].count--;
+      return;
+    }
+  }
+  if (s->overflow_pins > 0) s->overflow_pins--;
+}
+
 void delete_slot(Handle* h, Slot* s) {
   Header* hdr = h->hdr();
   arena_free(h, s->offset);
@@ -212,17 +262,23 @@ void delete_slot(Handle* h, Slot* s) {
   s->state = SLOT_TOMBSTONE;
   s->sealed = 0;
   s->refcount = 0;
+  memset(s->pins, 0, sizeof(s->pins));
+  s->overflow_pins = 0;
+  s->creator_pid = 0;
+  s->pinned = 0;
 }
 
-// Evict the single least-recently-used sealed, unpinned object.
-// Returns true if a victim was evicted (caller retries allocation).
+// Evict the single least-recently-used sealed, unreferenced, UNPINNED
+// object. Returns true if a victim was evicted (caller retries allocation).
+// Pinned (primary) copies are spill-only — losing them would drop the only
+// copy of a still-referenced object.
 bool evict_one(Handle* h) {
   Header* hdr = h->hdr();
   Slot* victim = nullptr;
   Slot* slots = h->slots();
   for (uint64_t i = 0; i < hdr->num_slots; i++) {
     Slot& s = slots[i];
-    if (s.state == SLOT_USED && s.sealed && s.refcount == 0) {
+    if (s.state == SLOT_USED && s.sealed && s.refcount == 0 && !s.pinned) {
       if (!victim || s.lru_tick < victim->lru_tick) victim = &s;
     }
   }
@@ -352,7 +408,12 @@ int64_t ts_alloc(void* hp, const uint8_t* id, uint64_t data_size,
     return -3;
   }
   s->sealed = 0;
-  s->refcount = 1;  // creator holds a pin until seal/abort
+  s->refcount = 0;
+  memset(s->pins, 0, sizeof(s->pins));
+  s->overflow_pins = 0;
+  pin_add(s, (int32_t)getpid());  // creator holds a pin until seal/abort
+  s->creator_pid = (uint32_t)getpid();
+  s->pinned = 0;
   s->offset = off;
   s->data_size = data_size;
   s->meta_size = meta_size;
@@ -369,7 +430,7 @@ int ts_seal(void* hp, const uint8_t* id) {
   if (!s) return -1;
   if (s->sealed) return -2;
   s->sealed = 1;
-  s->refcount -= 1;  // drop creator pin
+  pin_sub(s, (int32_t)getpid());  // drop creator pin
   s->lru_tick = ++h->hdr()->lru_clock;
   return 0;
 }
@@ -381,7 +442,7 @@ int ts_get(void* hp, const uint8_t* id, uint64_t* offset, uint64_t* data_size,
   Guard g(h->hdr());
   Slot* s = find_slot(h, id);
   if (!s || !s->sealed) return -1;
-  s->refcount++;
+  pin_add(s, (int32_t)getpid());
   s->lru_tick = ++h->hdr()->lru_clock;
   *offset = s->offset;
   *data_size = s->data_size;
@@ -394,8 +455,39 @@ int ts_release(void* hp, const uint8_t* id) {
   Guard g(h->hdr());
   Slot* s = find_slot(h, id);
   if (!s) return -1;
-  if (s->refcount > 0) s->refcount--;
+  pin_sub(s, (int32_t)getpid());
   return 0;
+}
+
+// Reclaim every pin held by a (dead) process and abort its unsealed
+// creations. Returns the number of slots touched. The node agent calls
+// this when it reaps a worker so crashed readers can't leak refcounts
+// (plasma client-disconnect cleanup analog).
+int64_t ts_release_dead(void* hp, int32_t pid) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Guard g(h->hdr());
+  Header* hdr = h->hdr();
+  Slot* slots = h->slots();
+  int64_t touched = 0;
+  for (uint64_t i = 0; i < hdr->num_slots; i++) {
+    Slot& s = slots[i];
+    if (s.state != SLOT_USED) continue;
+    bool hit = false;
+    for (int p = 0; p < kPinSlots; p++) {
+      if (s.pins[p].count > 0 && s.pins[p].pid == pid) {
+        s.refcount -= s.pins[p].count;
+        if (s.refcount < 0) s.refcount = 0;
+        s.pins[p].count = 0;
+        hit = true;
+      }
+    }
+    if (!s.sealed && s.creator_pid == (uint32_t)pid) {
+      delete_slot(h, &s);
+      hit = true;
+    }
+    if (hit) touched++;
+  }
+  return touched;
 }
 
 int ts_contains(void* hp, const uint8_t* id) {
@@ -422,6 +514,45 @@ int ts_abort(void* hp, const uint8_t* id) {
   Guard g(h->hdr());
   Slot* s = find_slot(h, id);
   if (!s || s->sealed) return -1;
+  delete_slot(h, s);
+  return 0;
+}
+
+// Set/clear the primary-copy pin (cluster ref-counter protection).
+int ts_pin(void* hp, const uint8_t* id, int pinned) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Guard g(h->hdr());
+  Slot* s = find_slot(h, id);
+  if (!s) return -1;
+  s->pinned = pinned ? 1 : 0;
+  return 0;
+}
+
+// Per-object metadata for spill-candidate selection.
+int ts_info(void* hp, const uint8_t* id, uint64_t* data_size,
+            uint64_t* meta_size, int64_t* refcount, uint32_t* pinned,
+            uint64_t* lru_tick) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Guard g(h->hdr());
+  Slot* s = find_slot(h, id);
+  if (!s || !s->sealed) return -1;
+  *data_size = s->data_size;
+  *meta_size = s->meta_size;
+  *refcount = s->refcount;
+  *pinned = s->pinned;
+  *lru_tick = s->lru_tick;
+  return 0;
+}
+
+// Remove a sealed object regardless of its pin (the caller has preserved
+// the data elsewhere, e.g. spilled it to disk). Still refuses if actively
+// read (refcount > 0).
+int ts_evict(void* hp, const uint8_t* id) {
+  Handle* h = reinterpret_cast<Handle*>(hp);
+  Guard g(h->hdr());
+  Slot* s = find_slot(h, id);
+  if (!s || !s->sealed) return -1;
+  if (s->refcount > 0) return -2;
   delete_slot(h, s);
   return 0;
 }

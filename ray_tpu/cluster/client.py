@@ -34,15 +34,24 @@ DEFAULT_MAX_RETRIES = 3
 
 class ClusterBackend:
     def __init__(self, head_address: str, *, node_id: str | None = None,
-                 store_path: str | None = None):
+                 store_path: str | None = None, agent_address: str | None = None,
+                 process_kind: str = "d"):
+        import os
+
         self.head = RpcClient(head_address)
         self.head_address = head_address
+        self._agent_address = agent_address
         if node_id is None:
             nodes = [n for n in self.head.call("nodes") if n["Alive"]]
             if not nodes:
                 raise RuntimeError("cluster has no alive nodes")
             node_id, store_path = nodes[0]["NodeID"], nodes[0]["StorePath"]
+            self._agent_address = nodes[0]["Address"]
         self.node_id = node_id
+        # "d" = driver (survives node death), "w" = worker (dies with node).
+        self.client_id = (
+            f"{process_kind}:{node_id}:{os.getpid()}:{os.urandom(3).hex()}"
+        )
         self.store = ShmStore(store_path)
         self._node_clients: dict[str, RpcClient] = {}
         self._worker_clients: dict[str, RpcClient] = {}
@@ -58,6 +67,21 @@ class ClusterBackend:
         # tell the node agent to release/reacquire this task's resources
         # while we block in get() (nested-task deadlock avoidance).
         self._block_hooks: tuple | None = None
+        # Process-local ref counts feeding the head's distributed table
+        # (reference_count.h analog): transitions 0->1 / 1->0 are batched
+        # to the head by a flusher thread; ObjectRef finalizers only touch
+        # dicts (no RPC on the GC path).
+        self._ref_lock = threading.Lock()
+        self._local_refs: dict[str, int] = {}
+        self._dirty_add: set[str] = set()
+        self._dirty_remove: set[str] = set()
+        self._ref_cv = threading.Condition(self._ref_lock)
+        # Serializes flush I/O: flush_refs() must not return while another
+        # thread's ref_update RPC is still in flight (borrower-handoff
+        # ordering depends on add-before-task-end).
+        self._flush_io_lock = threading.Lock()
+        self._closed = False
+        threading.Thread(target=self._ref_flush_loop, daemon=True).start()
 
     # -- plumbing ----------------------------------------------------------
 
@@ -75,21 +99,114 @@ class ClusterBackend:
                 c = self._worker_clients[address] = RpcClient(address)
             return c
 
-    def make_ref(self, oid: str) -> ObjectRef:
-        return ObjectRef(oid, owner=self.node_id)
+    def _agent_client(self) -> RpcClient:
+        """RPC client to THIS node's agent (spill requests, etc.)."""
+        if self._agent_address is None:
+            for n in self.head.call("nodes"):
+                if n["NodeID"] == self.node_id:
+                    self._agent_address = n["Address"]
+                    break
+            else:
+                raise RuntimeError(f"node {self.node_id} not in directory")
+        return self._node_client(self._agent_address)
+
+    # -- ref counting ------------------------------------------------------
+
+    def make_ref(self, oid: str, owner: str | None = None) -> ObjectRef:
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0)
+            self._local_refs[oid] = n + 1
+            if n == 0:
+                if oid in self._dirty_remove:
+                    self._dirty_remove.discard(oid)
+                else:
+                    self._dirty_add.add(oid)
+                self._ref_cv.notify_all()
+        ref = ObjectRef(oid, owner if owner is not None else self.node_id)
+        import weakref
+
+        weakref.finalize(ref, self._deref, oid)
+        return ref
+
+    def on_ref_deserialized(self, oid: str, owner: str) -> ObjectRef:
+        """Unpickle hook: this process becomes a holder (borrower)."""
+        return self.make_ref(oid, owner)
+
+    def _deref(self, oid: str) -> None:
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n > 0:
+                self._local_refs[oid] = n
+                return
+            self._local_refs.pop(oid, None)
+            if oid in self._dirty_add:
+                self._dirty_add.discard(oid)  # head never saw the hold
+            else:
+                self._dirty_remove.add(oid)
+            self._ref_cv.notify_all()
+        self._lineage.pop(oid, None)  # owner dropped it: no recovery needed
+
+    def _ref_flush_loop(self) -> None:
+        while True:
+            with self._ref_cv:
+                while (
+                    not self._dirty_add and not self._dirty_remove
+                    and not self._closed
+                ):
+                    self._ref_cv.wait(0.5)
+                if self._closed:
+                    return
+            time.sleep(0.02)  # coalesce bursts into one RPC
+            self.flush_refs()
+
+    def flush_refs(self) -> None:
+        """Push pending holder add/removes to the head. Workers call this
+        synchronously before reporting task end so borrower registration
+        can never lose the race against the borrow release. The io lock
+        makes that guarantee hold even when the background flusher already
+        popped the dirty sets: we wait for its RPC to finish."""
+        with self._flush_io_lock:
+            with self._ref_lock:
+                if not self._dirty_add and not self._dirty_remove:
+                    return
+                add, self._dirty_add = list(self._dirty_add), set()
+                remove, self._dirty_remove = list(self._dirty_remove), set()
+            try:
+                self.head.call("ref_update", self.client_id, add, remove)
+            except (ConnectionLost, OSError):
+                pass  # head gone: shutdown path
 
     # -- object plane ------------------------------------------------------
 
     def put_with_id(self, oid: str, value: Any, is_error: bool = False) -> None:
         flag = b"E" if is_error else b"V"
-        meta, chunks = ser.serialize(value)
-        try:
-            self.store.put(oid, chunks, flag + meta)
-        except StoreFullError:
-            raise
+        contained: list[str] = []
+        meta, chunks = ser.serialize(value, found_refs=contained)
+        size = ser.total_size(chunks)
+        for attempt in range(4):
+            try:
+                self.store.put(oid, chunks, flag + meta)
+                break
+            except StoreFullError:
+                # Ask this node's agent to spill cold objects to disk and
+                # retry (create-request backpressure + spill orchestration,
+                # local_object_manager.h:110 analog).
+                try:
+                    freed = self._agent_client().call(
+                        "spill", size + (64 << 10), timeout=60.0
+                    )
+                except (ConnectionLost, OSError):
+                    freed = 0
+                if freed <= 0 and attempt >= 1:
+                    raise
+        else:
+            raise StoreFullError(f"object {oid[:16]}… ({size} bytes)")
+        # Primary copy: protect from LRU eviction until the cluster
+        # ref-counter frees it (spilling is still allowed — data survives).
+        self.store.pin(oid)
         self.head.call(
             "add_location", oid, self.node_id, is_error=is_error,
-            size=ser.total_size(chunks),
+            size=size, contained=contained,
         )
 
     def put(self, value: Any) -> ObjectRef:
@@ -177,7 +294,8 @@ class ClusterBackend:
                 boxed = self._read_local(oid)
                 if boxed is not None:
                     return boxed[0]
-                continue
+                # Not in the local segment but the directory says it's on
+                # this node: it was spilled — the agent restores/serves it.
             try:
                 got = self._node_client(address).call("fetch_object", oid)
             except (ConnectionLost, OSError) as e:
@@ -257,6 +375,13 @@ class ClusterBackend:
         finally:
             if blocked:
                 hooks[1]()
+        # Values may have carried nested ObjectRefs: make sure the head
+        # knows about our new holds before our caller can release the
+        # containers they arrived in.
+        with self._ref_lock:
+            dirty = bool(self._dirty_add)
+        if dirty:
+            self.flush_refs()
         return out
 
     def wait(self, refs, num_returns, timeout, fetch_local=True):
@@ -350,7 +475,23 @@ class ClusterBackend:
             return
         node_id, address = placed
         spec["assigned_node"] = node_id
-        self._node_client(address).call("submit_task", spec)
+        self._register_borrows(spec, node_id)
+        try:
+            self._node_client(address).call("submit_task", spec)
+        except (ConnectionLost, OSError):
+            self._end_borrows(spec)  # nothing will ever end them otherwise
+            raise
+
+    def _register_borrows(self, spec: dict, node_id: str) -> None:
+        """Task args borrow their objects until the task ends — registered
+        BEFORE dispatch so the caller may drop its handles immediately.
+        Actor-method borrows carry the actor id so the head can end them
+        when the actor dies with calls still queued."""
+        if spec.get("borrowed"):
+            self.head.call(
+                "ref_task_begin", spec["task_id"], node_id, spec["borrowed"],
+                spec.get("actor_id") if spec.get("method") else None,
+            )
 
     def _retry_submit(self, spec: dict, timeout: float = 120.0):
         deadline = time.monotonic() + timeout
@@ -361,9 +502,11 @@ class ClusterBackend:
             if placed is not None:
                 node_id, address = placed
                 spec["assigned_node"] = node_id
+                self._register_borrows(spec, node_id)
                 try:
                     self._node_client(address).call("submit_task", spec)
                 except (ConnectionLost, OSError):
+                    self._end_borrows(spec)
                     continue
                 return
         err = TaskError(
@@ -389,13 +532,18 @@ class ClusterBackend:
         task_id = ids.new_task_id()
         oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
         refs = [self.make_ref(o) for o in oids]
+        borrowed: list[str] = []
+        args_blob = ser.dumps((args, kwargs), found_refs=borrowed)
+        # Refs captured in the function's closure are borrows too.
+        func_blob = ser.dumps(func, found_refs=borrowed)
         spec = {
             "task_id": task_id,
             "oids": oids,
             "num_returns": num_returns,
             "fname": name or getattr(func, "__name__", "task"),
-            "func": ser.dumps(func),
-            "args": ser.dumps((args, kwargs)),
+            "func": func_blob,
+            "args": args_blob,
+            "borrowed": borrowed,
             "demand": demand_of(options, is_actor=False),
             "sinfo": self._strategy_info(options),
             "pg_id": None,
@@ -428,15 +576,20 @@ class ClusterBackend:
         **options,
     ) -> str:
         actor_id = ids.new_actor_id()
+        borrowed: list[str] = []
+        args_blob = ser.dumps((args, kwargs), found_refs=borrowed)
+        cls_blob = ser.dumps(cls, found_refs=borrowed)
         spec = {
             "actor_create": True,
             "actor_id": actor_id,
+            "task_id": ids.new_task_id(),
             "oids": [],
             "class_name": cls.__name__,
             "name": name,
             "fname": f"{cls.__name__}.__init__",
-            "func": ser.dumps(cls),
-            "args": ser.dumps((args, kwargs)),
+            "func": cls_blob,
+            "args": args_blob,
+            "borrowed": borrowed,
             "demand": demand_of(options, is_actor=True),
             "sinfo": self._strategy_info(options),
             "retries_left": 0,
@@ -470,12 +623,16 @@ class ClusterBackend:
         task_id = ids.new_task_id()
         oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
         refs = [self.make_ref(o) for o in oids]
+        borrowed: list[str] = []
+        args_blob = ser.dumps((args, kwargs), found_refs=borrowed)
         spec = {
+            "task_id": task_id,
             "actor_id": actor_id,
             "method": method_name,
             "oids": oids,
             "num_returns": num_returns,
-            "args": ser.dumps((args, kwargs)),
+            "args": args_blob,
+            "borrowed": borrowed,
         }
         try:
             info = self._actor_info(actor_id)
@@ -483,13 +640,16 @@ class ClusterBackend:
                 raise ActorError(
                     f"actor {actor_id} is dead: {info['death_cause']}"
                 )
+            self._register_borrows(spec, info["node_id"])
             self._worker_client(info["address"]).call("push_actor_task", spec)
             for oid in oids:
                 self._actor_tasks[oid] = actor_id
         except ActorError as e:
+            self._end_borrows(spec)
             for oid in oids:
                 self.put_with_id(oid, e, is_error=True)
         except (ConnectionLost, OSError):
+            self._end_borrows(spec)
             info = self._actor_info(actor_id, refresh=True)
             err = ActorError(
                 f"actor {actor_id} is dead: "
@@ -498,6 +658,13 @@ class ClusterBackend:
             for oid in oids:
                 self.put_with_id(oid, err, is_error=True)
         return refs
+
+    def _end_borrows(self, spec: dict) -> None:
+        if spec.get("borrowed"):
+            try:
+                self.head.call("ref_task_end", spec["task_id"])
+            except (ConnectionLost, OSError):
+                pass
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
         info = self._actor_info(actor_id, refresh=True)
@@ -583,6 +750,22 @@ class ClusterBackend:
     def shutdown(self) -> None:
         """Disconnect this client (the cluster keeps running; use
         Cluster.shutdown / shutdown_cluster to tear it down)."""
+        # Release every hold this process still has so the cluster can
+        # free the objects (clean-exit ref release).
+        with self._ref_lock:
+            self._closed = True
+            release = set(self._local_refs) | self._dirty_remove
+            self._local_refs.clear()
+            self._dirty_add.clear()
+            self._dirty_remove.clear()
+            self._ref_cv.notify_all()
+        if release:
+            try:
+                self.head.call(
+                    "ref_update", self.client_id, [], sorted(release)
+                )
+            except (ConnectionLost, OSError):
+                pass
         with self._lock:
             clients = (
                 list(self._node_clients.values())

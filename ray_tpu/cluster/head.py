@@ -52,6 +52,21 @@ class HeadServer:
         self._actors_cv = threading.Condition(self._lock)
         self._pgs: dict[str, dict] = {}
         self._rr_counter = 0
+        # Distributed ref-counting (reference_count.h:61 analog, centralized):
+        # oid -> set of holders. A holder is a client process id ("c:...")
+        # or a containing object ("obj:<oid>" — the container keeps nested
+        # refs alive). An oid ABSENT from the table is conservatively kept
+        # (never freed); an entry with no holders and no in-flight borrows
+        # is freed cluster-wide.
+        self._refs: dict[str, set] = {}
+        # oid -> count of in-flight task-arg borrows (submitted-but-running
+        # tasks whose args reference the object).
+        self._inflight: dict[str, int] = {}
+        self._inflight_by_task: dict[str, tuple] = {}  # task_id -> (node, oids)
+        self._contained: dict[str, list] = {}  # container oid -> inner oids
+        self._freed: dict[str, bool] = {}  # tombstones (bounded)
+        self._free_queue: list[tuple] = []  # (address, oid) delete fanout
+        self._free_cv = threading.Condition(self._lock)
         # Unsatisfiable demand log: the autoscaler's input signal
         # (load_metrics.py / resource_demand_scheduler.py analog).
         self._demand_misses: list[dict] = []
@@ -60,6 +75,7 @@ class HeadServer:
         self._stop = threading.Event()
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
         self._monitor.start()
+        threading.Thread(target=self._free_loop, daemon=True).start()
 
     # -- nodes ------------------------------------------------------------
 
@@ -142,6 +158,21 @@ class HeadServer:
             # client's job (object_recovery_manager.h:41 analog).
             for entry in self._objects.values():
                 entry["nodes"].discard(node_id)
+            # Ref-counting cleanup: worker processes on the node died with
+            # it — drop their holds and their tasks' in-flight borrows.
+            # (Driver clients use the "d:" prefix and survive node death;
+            # their objects are recovered via lineage.)
+            prefix = f"w:{node_id}:"
+            for oid, holders in list(self._refs.items()):
+                dead = {h for h in holders if h.startswith(prefix)}
+                if dead:
+                    holders.difference_update(dead)
+                    self._maybe_free(oid)
+            for task_id, (nid, _oids, _a) in list(
+                self._inflight_by_task.items()
+            ):
+                if nid == node_id:
+                    self._end_task_borrows(task_id)
             # Placement groups with bundles there become DEAD (rescheduling
             # PGs is round-2 work; Train-level elasticity handles restarts).
             for pg in self._pgs.values():
@@ -173,18 +204,146 @@ class HeadServer:
         with self._lock:
             return [k for k in self._kv if k.startswith(prefix)]
 
+    # -- distributed ref-counting -----------------------------------------
+
+    def rpc_ref_update(self, client_id, add, remove):
+        """Batched holder registration/release from one client process."""
+        with self._lock:
+            for oid in add:
+                if oid in self._freed:
+                    continue  # already freed: don't create ghost holders
+                self._refs.setdefault(oid, set()).add(client_id)
+            for oid in remove:
+                holders = self._refs.get(oid)
+                if holders is not None:
+                    holders.discard(client_id)
+                self._maybe_free(oid)
+        return True
+
+    def rpc_ref_task_begin(self, task_id, node_id, oids, actor_id=None):
+        """Args of a submitted task borrow their objects until the task
+        ends (borrower registration at submission, so the caller may drop
+        its handles while the task is in flight)."""
+        with self._lock:
+            self._end_task_borrows(task_id)  # resubmission replaces
+            self._inflight_by_task[task_id] = (node_id, list(oids), actor_id)
+            for oid in oids:
+                self._inflight[oid] = self._inflight.get(oid, 0) + 1
+        return True
+
+    def rpc_ref_task_end(self, task_id):
+        with self._lock:
+            self._end_task_borrows(task_id)
+        return True
+
+    def _end_task_borrows(self, task_id):
+        entry = self._inflight_by_task.pop(task_id, None)
+        if entry is None:
+            return
+        _node, oids, _actor = entry
+        for oid in oids:
+            n = self._inflight.get(oid, 0) - 1
+            if n <= 0:
+                self._inflight.pop(oid, None)
+            else:
+                self._inflight[oid] = n
+            self._maybe_free(oid)
+
+    def _maybe_free(self, oid):
+        """Free the object cluster-wide when nothing can reach it anymore.
+        Caller holds self._lock. Untracked oids are conservatively kept."""
+        if oid not in self._freed:
+            holders = self._refs.get(oid)
+            if holders is None or holders:
+                return
+            if self._inflight.get(oid, 0) > 0:
+                return
+        self._refs.pop(oid, None)
+        self._freed[oid] = True
+        if len(self._freed) > 200_000:
+            for k in list(self._freed)[:100_000]:
+                del self._freed[k]
+        entry = self._objects.pop(oid, None)
+        if entry is not None:
+            for nid in entry["nodes"]:
+                node = self._nodes.get(nid)
+                if node is not None and node.alive:
+                    self._free_queue.append((node, oid))
+            self._free_cv.notify_all()
+        # Cascade: the container no longer holds its nested refs.
+        for inner in self._contained.pop(oid, []):
+            holders = self._refs.get(inner)
+            if holders is not None:
+                holders.discard("obj:" + oid)
+            self._maybe_free(inner)
+
+    def _free_loop(self):
+        """Fan out store deletes outside the lock (free-on-zero broadcast)."""
+        while not self._stop.is_set():
+            with self._free_cv:
+                while not self._free_queue and not self._stop.is_set():
+                    self._free_cv.wait(0.5)
+                batch, self._free_queue = self._free_queue[:], []
+            for node, oid in batch:
+                try:
+                    node.client.call("free_object", oid, timeout=5.0)
+                except Exception:
+                    pass
+
+    def rpc_ref_client_dead(self, client_id):
+        """A client process died: drop every hold it registered."""
+        with self._lock:
+            for oid, holders in list(self._refs.items()):
+                if client_id in holders:
+                    holders.discard(client_id)
+                    self._maybe_free(oid)
+        return True
+
+    def rpc_ref_counts(self):
+        """Introspection: live tracked refs (tests / debugging)."""
+        with self._lock:
+            return {
+                "tracked": len(self._refs),
+                "inflight_tasks": len(self._inflight_by_task),
+                "holders": {
+                    oid: sorted(h) for oid, h in self._refs.items() if h
+                },
+            }
+
     # -- object directory -------------------------------------------------
 
-    def rpc_add_location(self, oid, node_id, is_error=False, size=0):
+    def rpc_add_location(self, oid, node_id, is_error=False, size=0,
+                         contained=None):
         with self._lock:
+            if oid in self._freed:
+                # Freed while the task computing it was still running:
+                # delete the fresh copy straight away.
+                node = self._nodes.get(node_id)
+                if node is not None and node.alive:
+                    self._free_queue.append((node, oid))
+                    self._free_cv.notify_all()
+                return True
             entry = self._objects.setdefault(
                 oid, {"nodes": set(), "error": False, "size": 0}
             )
             entry["nodes"].add(node_id)
             entry["error"] = entry["error"] or is_error
             entry["size"] = max(entry["size"], size)
+            if contained:
+                # The container holds its nested refs until it is freed.
+                self._contained[oid] = list(contained)
+                for inner in contained:
+                    self._refs.setdefault(inner, set()).add("obj:" + oid)
             self._objects_cv.notify_all()
         return True
+
+    def rpc_objects_on_node(self, node_id):
+        """Oids the directory places on this node (spill-candidate input)."""
+        with self._lock:
+            return [
+                oid for oid, e in self._objects.items()
+                if node_id in e["nodes"]
+            ]
 
     def rpc_remove_location(self, oid, node_id):
         with self._lock:
@@ -290,6 +449,13 @@ class HeadServer:
                 name = info.get("name")
                 if name and self._named_actors.get(name) == actor_id:
                     del self._named_actors[name]
+            # Calls queued on the dead actor will never report task-end:
+            # release their arg borrows here.
+            for task_id, (_n, _o, aid) in list(
+                self._inflight_by_task.items()
+            ):
+                if aid == actor_id:
+                    self._end_task_borrows(task_id)
         return True
 
     def rpc_list_actors(self):
@@ -570,6 +736,8 @@ class HeadServer:
 
     def stop(self):
         self._stop.set()
+        with self._free_cv:
+            self._free_cv.notify_all()
         self._server.stop()
 
 

@@ -39,6 +39,7 @@ class _Worker:
         self.proc = proc
         self.address = address
         self.client: Optional[RpcClient] = None
+        self.client_id: Optional[str] = None  # ref-table holder id
         self.ready = threading.Event()
         self.current_task = None  # (task_spec, release_fn) while executing
         self.is_actor = False
@@ -66,6 +67,12 @@ class NodeAgent:
         session = session or f"s{os.getpid()}"
         self.store_path = f"/dev/shm/ray_tpu_{session}_{self.node_id[-8:]}"
         self.store = ShmStore(self.store_path, store_capacity, create=True)
+        # Spill directory (external_storage.py:72 analog): cold primary
+        # copies move here under memory pressure; restored on demand.
+        self.spill_dir = f"/tmp/ray_tpu_spill_{session}_{self.node_id[-8:]}"
+        os.makedirs(self.spill_dir, exist_ok=True)
+        self._spill_lock = threading.Lock()
+        self._deferred_deletes: set[str] = set()
 
         self._lock = threading.RLock()
         self._workers: dict[str, _Worker] = {}
@@ -111,12 +118,13 @@ class NodeAgent:
             self._workers[worker_id] = w
         return w
 
-    def rpc_register_worker(self, worker_id, address):
+    def rpc_register_worker(self, worker_id, address, client_id=None):
         with self._lock:
             w = self._workers.get(worker_id)
             if w is None:
                 return False
             w.address = address
+            w.client_id = client_id  # its holder id in the head's ref table
             w.client = RpcClient(address)
             w.ready.set()
         return True
@@ -289,10 +297,20 @@ class NodeAgent:
             current["released"] = False
         return True
 
+    def _end_borrows(self, spec: dict):
+        """Release the task's in-flight arg borrows on its behalf (the
+        worker that would normally report task end is gone)."""
+        if spec.get("borrowed") and spec.get("task_id"):
+            try:
+                self.head.call("ref_task_end", spec["task_id"])
+            except Exception:
+                pass
+
     def _fail_task(self, spec: dict, reason: str):
         from ray_tpu.core.object_ref import TaskError
         from ray_tpu.core import serialization as ser
 
+        self._end_borrows(spec)
         err = TaskError(spec.get("fname", "task"), reason, reason)
         meta, chunks = ser.serialize(err)
         for oid in spec["oids"]:
@@ -311,9 +329,24 @@ class NodeAgent:
             w.current_task = None
         if w.proc.poll() is None:
             w.proc.kill()
+            try:
+                w.proc.wait(timeout=5)
+            except Exception:
+                pass
+        # Reclaim shm pins the dead process can never release.
+        try:
+            self.store.release_dead(w.proc.pid)
+        except Exception:
+            pass
         if w.is_actor and w.actor_id:
             try:
                 self.head.call("mark_actor_dead", w.actor_id, cause)
+            except Exception:
+                pass
+        if w.client_id:
+            # The process's holder registrations die with it.
+            try:
+                self.head.call("ref_client_dead", w.client_id)
             except Exception:
                 pass
         if current is not None:
@@ -322,19 +355,27 @@ class NodeAgent:
                 current["pool"].release(current["demand"])
             spec = current["spec"]
             if not spec.get("actor_create"):
-                self._fail_task(spec, f"worker died: {cause}")
+                self._fail_task(spec, f"worker died: {cause}")  # ends borrows
+            else:
+                self._end_borrows(spec)
 
     def _reap_loop(self):
-        """Detect dead worker processes (WorkerPool's disconnect handling)."""
+        """Detect dead worker processes (WorkerPool's disconnect handling)
+        and retry deletes deferred while readers held the object."""
         while not self._shutdown.wait(0.2):
             with self._lock:
                 dead = [
                     w for w in self._workers.values() if w.proc.poll() is not None
                 ]
+                deferred = list(self._deferred_deletes)
             for w in dead:
                 self._on_worker_failure(
                     w, f"exit code {w.proc.returncode}"
                 )
+            for oid in deferred:
+                if self.store.delete(oid) or not self.store.contains(oid):
+                    with self._lock:
+                        self._deferred_deletes.discard(oid)
 
     # -- actors -----------------------------------------------------------
 
@@ -394,19 +435,104 @@ class NodeAgent:
 
     # -- object serving ---------------------------------------------------
 
+    def _spill_path(self, oid: str) -> str:
+        return os.path.join(self.spill_dir, oid)
+
     def rpc_fetch_object(self, oid):
-        """Serve an object's (meta, data) to a peer (push analog)."""
+        """Serve an object's (meta, data) to a peer (push analog). Falls
+        back to the spill file and best-effort restores it into the store
+        (RestoreSpilledObjects analog)."""
         got = self.store.get(oid)
-        if got is None:
-            return None
-        data, meta = got
+        if got is not None:
+            data, meta = got
+            try:
+                return meta, bytes(data)
+            finally:
+                self.store.release(oid)
+        path = self._spill_path(oid)
         try:
-            return meta, bytes(data)
-        finally:
-            self.store.release(oid)
+            with open(path, "rb") as f:
+                meta_len = int.from_bytes(f.read(8), "little")
+                meta = f.read(meta_len)
+                data = f.read()
+        except OSError:
+            return None
+        try:
+            # Restored copies are NOT pinned: they may be re-evicted (the
+            # spill file remains the durable copy until the object is freed).
+            self.store.put(oid, data, meta)
+        except Exception:
+            pass
+        return meta, data
+
+    def rpc_spill(self, bytes_needed: int):
+        """Move cold, unreferenced primary copies to disk until
+        ``bytes_needed`` arena bytes are freed. Returns bytes freed
+        (local_object_manager.h:110,122 / SpillObjects analog)."""
+        with self._spill_lock:
+            try:
+                oids = self.head.call("objects_on_node", self.node_id)
+            except Exception:
+                oids = []
+            cands = []
+            for oid in oids:
+                info = self.store.info(oid)
+                if info is not None and info["refcount"] == 0:
+                    cands.append(
+                        (info["lru_tick"], oid,
+                         info["data_size"] + info["meta_size"])
+                    )
+            cands.sort()  # coldest first
+            freed = 0
+            for _tick, oid, size in cands:
+                if freed >= bytes_needed:
+                    break
+                got = self.store.get(oid)  # pins while we copy out
+                if got is None:
+                    continue
+                data, meta = got
+                path = self._spill_path(oid)
+                tmp = path + ".tmp"
+                try:
+                    with open(tmp, "wb") as f:
+                        f.write(len(meta).to_bytes(8, "little"))
+                        f.write(meta)
+                        f.write(bytes(data))
+                    os.replace(tmp, path)
+                except OSError:
+                    self.store.release(oid)
+                    continue
+                self.store.release(oid)
+                if self.store.evict(oid):  # despite pin: bytes now on disk
+                    freed += size
+                else:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+            return freed
+
+    def rpc_free_object(self, oid):
+        """Head says nothing references this object anymore: drop the shm
+        copy and any spill file (free-on-zero broadcast target)."""
+        self.store.pin(oid, False)
+        if not self.store.delete(oid) and self.store.contains(oid):
+            # Actively read right now (zero-copy views alive); the reap
+            # loop retries until readers release.
+            with self._lock:
+                self._deferred_deletes.add(oid)
+        try:
+            os.unlink(self._spill_path(oid))
+        except OSError:
+            pass
+        return True
 
     def rpc_delete_object(self, oid):
         self.store.delete(oid)
+        try:
+            os.unlink(self._spill_path(oid))
+        except OSError:
+            pass
         try:
             self.head.call("remove_location", oid, self.node_id)
         except Exception:
@@ -414,7 +540,18 @@ class NodeAgent:
         return True
 
     def rpc_store_stats(self):
-        return self.store.stats()
+        stats = self.store.stats()
+        try:
+            spill_files = os.listdir(self.spill_dir)
+            stats["spilled_objects"] = len(spill_files)
+            stats["spilled_bytes"] = sum(
+                os.path.getsize(os.path.join(self.spill_dir, f))
+                for f in spill_files
+            )
+        except OSError:
+            stats["spilled_objects"] = 0
+            stats["spilled_bytes"] = 0
+        return stats
 
     # -- lifecycle --------------------------------------------------------
 

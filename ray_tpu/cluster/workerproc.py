@@ -33,6 +33,7 @@ class WorkerHandler:
         self.agent = RpcClient(agent_address)
         self.backend = ClusterBackend(
             head_address, node_id=node_id, store_path=store_path,
+            agent_address=agent_address, process_kind="w",
         )
         from ray_tpu._private import worker as worker_mod
 
@@ -110,6 +111,17 @@ class WorkerHandler:
         for oid in spec["oids"]:
             self.backend.put_with_id(oid, err, is_error=True)
 
+    def _end_borrows(self, spec):
+        """Release the task's arg borrows — AFTER flushing our own holder
+        registrations, so a ref this task deserialized and kept can never
+        be freed in the gap (borrower handoff ordering)."""
+        if spec.get("borrowed") and spec.get("task_id"):
+            self.backend.flush_refs()
+            try:
+                self.backend.head.call("ref_task_end", spec["task_id"])
+            except Exception:
+                pass
+
     def _run_task(self, spec):
         # Only plain tasks hold a per-task lease worth releasing while
         # blocked; actor lifetime resources stay held (reference semantics).
@@ -132,6 +144,7 @@ class WorkerHandler:
                 )
         finally:
             self.backend._block_hooks = None
+            self._end_borrows(spec)
 
     def _run_actor_ctor(self, spec):
         try:
@@ -147,6 +160,8 @@ class WorkerHandler:
                 )
             except Exception:
                 pass
+        finally:
+            self._end_borrows(spec)
 
     def _run_actor_task(self, spec):
         try:
@@ -171,6 +186,8 @@ class WorkerHandler:
                         repr(e),
                     ),
                 )
+        finally:
+            self._end_borrows(spec)
 
 
 def main():
@@ -186,7 +203,10 @@ def main():
         args.head, args.agent, args.node_id, args.store, args.worker_id
     )
     server = RpcServer(handler)
-    handler.agent.call("register_worker", args.worker_id, server.address)
+    handler.agent.call(
+        "register_worker", args.worker_id, server.address,
+        handler.backend.client_id,
+    )
     threading.Event().wait()  # serve forever; the agent kills us
 
 

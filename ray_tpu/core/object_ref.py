@@ -8,7 +8,40 @@ owner (the driver process).
 
 from __future__ import annotations
 
+import threading
 from typing import Any
+
+# Serialization-time ref capture (reference_count.h borrower registration
+# analog): while a collector list is installed, every ObjectRef pickled on
+# this thread records its id — so put() knows which refs a value contains
+# and submit() knows which refs travel as task args.
+_capture = threading.local()
+
+
+def capture_refs(collector: list):
+    """Context manager: collect ids of ObjectRefs serialized on this thread."""
+
+    class _Ctx:
+        def __enter__(self):
+            self.prev = getattr(_capture, "collector", None)
+            _capture.collector = collector
+            return collector
+
+        def __exit__(self, *exc):
+            _capture.collector = self.prev
+
+    return _Ctx()
+
+
+def _rehydrate_ref(object_id: str, owner: str):
+    """Unpickle hook: hand the ref to the process-wide backend so it can
+    register this process as a holder (distributed ref-counting)."""
+    from ray_tpu._private import worker as worker_mod
+
+    b = worker_mod._backend
+    if b is not None and hasattr(b, "on_ref_deserialized"):
+        return b.on_ref_deserialized(object_id, owner)
+    return ObjectRef(object_id, owner)
 
 
 class ObjectRef:
@@ -31,7 +64,10 @@ class ObjectRef:
         return hash(self.id)
 
     def __reduce__(self):
-        return (ObjectRef, (self.id, self._owner))
+        collector = getattr(_capture, "collector", None)
+        if collector is not None:
+            collector.append(self.id)
+        return (_rehydrate_ref, (self.id, self._owner))
 
 
 class TaskError(Exception):

@@ -26,10 +26,19 @@ def _aligned(n: int) -> int:
     return (n + ALIGN - 1) & ~(ALIGN - 1)
 
 
-def serialize(value: Any) -> tuple[bytes, list[bytes | memoryview]]:
-    """Returns (meta, chunks). Concatenating chunks gives the data payload."""
+def serialize(
+    value: Any, found_refs: list | None = None
+) -> tuple[bytes, list[bytes | memoryview]]:
+    """Returns (meta, chunks). Concatenating chunks gives the data payload.
+    ``found_refs``: optional list that receives the ids of any ObjectRefs
+    nested in ``value`` (feeds distributed ref-counting)."""
+    from ray_tpu.core.object_ref import capture_refs
+
     buffers: list[pickle.PickleBuffer] = []
-    payload = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    with capture_refs(found_refs if found_refs is not None else []):
+        payload = cloudpickle.dumps(
+            value, protocol=5, buffer_callback=buffers.append
+        )
     raw = [b.raw() for b in buffers]
     sizes = [len(payload)] + [len(r) for r in raw]
     chunks: list[bytes | memoryview] = []
@@ -71,9 +80,13 @@ def num_buffers(meta: bytes) -> int:
     return len(msgpack.unpackb(meta)["sizes"]) - 1
 
 
-def dumps(value: Any) -> bytes:
-    """One-shot in-band serialization (control-plane messages)."""
-    return cloudpickle.dumps(value)
+def dumps(value: Any, found_refs: list | None = None) -> bytes:
+    """One-shot in-band serialization (control-plane messages).
+    ``found_refs``: see :func:`serialize`."""
+    from ray_tpu.core.object_ref import capture_refs
+
+    with capture_refs(found_refs if found_refs is not None else []):
+        return cloudpickle.dumps(value)
 
 
 def loads(blob: bytes) -> Any:

@@ -56,6 +56,9 @@ class ASHAScheduler(TrialScheduler):
         while t < max_t:
             self.rungs[t] = {}
             t *= reduction_factor
+        # Highest rung each trial has been recorded at (a trial never
+        # late-records into a rung it already skipped past).
+        self._trial_top: Dict[str, int] = {}
 
     def on_trial_result(self, runner, trial, result: dict) -> str:
         t = result.get(self.time_attr, 0)
@@ -67,19 +70,23 @@ class ASHAScheduler(TrialScheduler):
         if t >= self.max_t:
             return STOP
         for rung_t in sorted(self.rungs, reverse=True):
-            if t >= rung_t and trial.trial_id not in self.rungs[rung_t]:
-                recorded = self.rungs[rung_t]
-                # Cutoff from peers already at the rung, BEFORE recording
-                # this trial (mirrors the async-successive-halving rule).
-                cutoff = None
-                if recorded:
-                    vals = sorted(recorded.values(), reverse=True)
-                    k = max(1, len(vals) // self.rf)
-                    cutoff = vals[k - 1]
-                recorded[trial.trial_id] = value
-                if cutoff is not None and value < cutoff:
-                    return STOP
-                break
+            if t < rung_t:
+                continue
+            if self._trial_top.get(trial.trial_id, -1) >= rung_t:
+                break  # already judged at (or above) this rung
+            recorded = self.rungs[rung_t]
+            # Cutoff from peers already at the rung, BEFORE recording
+            # this trial (mirrors the async-successive-halving rule).
+            cutoff = None
+            if recorded:
+                vals = sorted(recorded.values(), reverse=True)
+                k = max(1, len(vals) // self.rf)
+                cutoff = vals[k - 1]
+            recorded[trial.trial_id] = value
+            self._trial_top[trial.trial_id] = rung_t
+            if cutoff is not None and value < cutoff:
+                return STOP
+            break
         return CONTINUE
 
 
