@@ -71,7 +71,9 @@ class ClusterBackend:
         # (reference_count.h analog): transitions 0->1 / 1->0 are batched
         # to the head by a flusher thread; ObjectRef finalizers only touch
         # dicts (no RPC on the GC path).
-        self._ref_lock = threading.Lock()
+        # RLock: _deref runs from weakref finalizers, which GC may invoke
+        # on a thread that already holds this lock mid-allocation.
+        self._ref_lock = threading.RLock()
         self._local_refs: dict[str, int] = {}
         self._dirty_add: set[str] = set()
         self._dirty_remove: set[str] = set()
@@ -139,10 +141,13 @@ class ClusterBackend:
                 self._local_refs[oid] = n
                 return
             self._local_refs.pop(oid, None)
-            if oid in self._dirty_add:
-                self._dirty_add.discard(oid)  # head never saw the hold
-            else:
-                self._dirty_remove.add(oid)
+            # Always send the remove, even when the matching add was never
+            # flushed: the head treats a remove for an unknown oid as
+            # "held-and-released between flushes" and frees it — otherwise
+            # a pinned primary copy with no registered holder would be
+            # immortal.
+            self._dirty_add.discard(oid)
+            self._dirty_remove.add(oid)
             self._ref_cv.notify_all()
         self._lineage.pop(oid, None)  # owner dropped it: no recovery needed
 
@@ -174,7 +179,13 @@ class ClusterBackend:
             try:
                 self.head.call("ref_update", self.client_id, add, remove)
             except (ConnectionLost, OSError):
-                pass  # head gone: shutdown path
+                # Transient failure: requeue the batch — dropping it would
+                # leak holders (lost removes) or free held objects (lost
+                # adds).
+                with self._ref_lock:
+                    if not self._closed:
+                        self._dirty_add.update(add)
+                        self._dirty_remove.update(remove)
 
     # -- object plane ------------------------------------------------------
 
@@ -334,17 +345,45 @@ class ClusterBackend:
 
     def _check_actor_alive(self, oid: str) -> None:
         """A pending actor-task result can never appear if the actor died —
-        fail fast (RayActorError parity) instead of waiting forever."""
-        actor_id = self._actor_tasks.get(oid)
-        if actor_id is None:
+        fail fast (RayActorError parity). If the actor RESTARTED and this
+        call was lost with it, replay the call within the actor's
+        max_task_retries budget (direct_actor_task_submitter retry analog)."""
+        entry = self._actor_tasks.get(oid)
+        if entry is None:
             return
+        actor_id = entry["actor_id"]
         info = self._actor_info(actor_id, refresh=True)
         if info["state"] == "DEAD":
-            self._actor_tasks.pop(oid, None)
+            for o in entry.get("oids", [oid]):
+                self._actor_tasks.pop(o, None)
             raise ActorError(
                 f"actor {actor_id} died before this call completed: "
                 f"{info.get('death_cause')}"
             )
+        if info["state"] != "ALIVE":
+            return  # restarting: keep waiting
+        if info.get("num_restarts", 0) > entry["incarnation"]:
+            # The call was in flight across a restart: its execution (and
+            # queued successors) died with the old worker.
+            if entry["retries_left"] == 0:
+                for o in entry.get("oids", [oid]):
+                    self._actor_tasks.pop(o, None)
+                raise ActorError(
+                    f"actor {actor_id} restarted and the call was lost "
+                    f"(max_task_retries exhausted)"
+                )
+            if entry["retries_left"] > 0:
+                entry["retries_left"] -= 1
+            entry["incarnation"] = info["num_restarts"]
+            spec = entry["spec"]
+            self._register_borrows(spec, info["node_id"])
+            try:
+                self._worker_client(info["address"]).call(
+                    "push_actor_task", spec
+                )
+            except (ConnectionLost, OSError):
+                self._end_borrows(spec)  # next get() round retries again
+                entry["incarnation"] -= 1  # didn't actually replay
 
     def get(self, refs: Sequence[ObjectRef], timeout: float | None = None):
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -596,13 +635,39 @@ class ClusterBackend:
         }
         spec["pg_id"] = spec["sinfo"]["pg_id"]
         spec["bundle_index"] = spec["sinfo"]["bundle_index"]
+        # The head keeps the creation spec so it can reconstruct the actor
+        # on worker/node death (max_restarts budget; -1 = infinite).
+        self.head.call(
+            "create_actor_record", actor_id,
+            options.get("max_restarts", 0),
+            options.get("max_task_retries", 0),
+            spec,
+        )
         self._submit_spec(spec)  # raises if infeasible
         return actor_id
+
+    def _wait_actor_alive(self, actor_id: str, timeout: float = 60.0) -> dict:
+        """Block through a RESTARTING window until the actor is ALIVE (or
+        raise if it ends up DEAD / never recovers)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            info = self._actor_info(actor_id, refresh=True)
+            if info["state"] == "ALIVE":
+                return info
+            if info["state"] == "DEAD":
+                raise ActorError(
+                    f"actor {actor_id} is dead: {info['death_cause']}"
+                )
+            if time.monotonic() > deadline:
+                raise ActorError(
+                    f"actor {actor_id} stuck in {info['state']} for {timeout}s"
+                )
+            time.sleep(0.05)
 
     def _actor_info(self, actor_id: str, refresh: bool = False) -> dict:
         with self._lock:
             info = self._actor_cache.get(actor_id)
-        if info is None or refresh or info["state"] == "DEAD":
+        if info is None or refresh or info["state"] != "ALIVE":
             info = self.head.call("get_actor", actor_id, 30.0, timeout=45.0)
             if info is None:
                 raise ValueError(f"no such actor: {actor_id}")
@@ -636,27 +701,38 @@ class ClusterBackend:
         }
         try:
             info = self._actor_info(actor_id)
-            if info["state"] == "DEAD":
-                raise ActorError(
-                    f"actor {actor_id} is dead: {info['death_cause']}"
-                )
-            self._register_borrows(spec, info["node_id"])
-            self._worker_client(info["address"]).call("push_actor_task", spec)
+            if info["state"] != "ALIVE":
+                info = self._wait_actor_alive(actor_id)
+            pushed = False
+            for _attempt in range(3):
+                self._register_borrows(spec, info["node_id"])
+                try:
+                    self._worker_client(info["address"]).call(
+                        "push_actor_task", spec
+                    )
+                    pushed = True
+                    break
+                except (ConnectionLost, OSError):
+                    self._end_borrows(spec)
+                    # Worker died under us: wait out a restart and retry.
+                    info = self._wait_actor_alive(actor_id)
+            if not pushed:
+                raise ActorError(f"actor {actor_id}: push failed repeatedly")
+            # ONE shared entry for all return oids: a restart must replay
+            # the call once, not once per return value.
+            entry = {
+                "actor_id": actor_id,
+                "spec": spec,
+                "oids": oids,
+                "incarnation": info.get("num_restarts", 0),
+                "retries_left": info.get("max_task_retries", 0),
+            }
             for oid in oids:
-                self._actor_tasks[oid] = actor_id
+                self._actor_tasks[oid] = entry
         except ActorError as e:
             self._end_borrows(spec)
             for oid in oids:
                 self.put_with_id(oid, e, is_error=True)
-        except (ConnectionLost, OSError):
-            self._end_borrows(spec)
-            info = self._actor_info(actor_id, refresh=True)
-            err = ActorError(
-                f"actor {actor_id} is dead: "
-                f"{info.get('death_cause') or 'connection lost'}"
-            )
-            for oid in oids:
-                self.put_with_id(oid, err, is_error=True)
         return refs
 
     def _end_borrows(self, spec: dict) -> None:
@@ -670,12 +746,24 @@ class ClusterBackend:
         info = self._actor_info(actor_id, refresh=True)
         if info["state"] == "DEAD":
             return
+        if no_restart:
+            # Burn the restart budget so an in-flight reconstruction can't
+            # resurrect it either.
+            try:
+                self.head.call(
+                    "mark_actor_dead", actor_id, "killed via ray_tpu.kill",
+                    False,
+                )
+            except (ConnectionLost, OSError):
+                pass
         nodes = {n["NodeID"]: n for n in self.head.call("nodes")}
         node = nodes.get(info["node_id"])
         if node is None or not node["Alive"]:
             return
         try:
-            self._node_client(node["Address"]).call("kill_actor", actor_id)
+            self._node_client(node["Address"]).call(
+                "kill_actor", actor_id, no_restart
+            )
         except (ConnectionLost, OSError):
             pass
 

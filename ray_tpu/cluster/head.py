@@ -48,6 +48,7 @@ class HeadServer:
         self._objects_cv = threading.Condition(self._lock)
         # actor directory: actor_id -> info dict
         self._actors: dict[str, dict] = {}
+        self._actor_specs: dict[str, dict] = {}  # restart policy + spec
         self._named_actors: dict[str, str] = {}
         self._actors_cv = threading.Condition(self._lock)
         self._pgs: dict[str, dict] = {}
@@ -149,11 +150,14 @@ class HeadServer:
             if node is None or not node.alive:
                 return
             node.alive = False
-            # Fail actors living on the node (GcsActorManager::OnNodeDead).
-            for info in self._actors.values():
-                if info["node_id"] == node_id and info["state"] != "DEAD":
-                    info["state"] = "DEAD"
-                    info["death_cause"] = f"node {node_id} died: {cause}"
+            # Actors on the node die with it; restartable ones reconstruct
+            # elsewhere (GcsActorManager::OnNodeDead -> ReconstructActor).
+            for info in list(self._actors.values()):
+                if info["node_id"] == node_id and info["state"] == "ALIVE":
+                    self._on_actor_death(
+                        info["actor_id"], f"node {node_id} died: {cause}",
+                        True,
+                    )
             # Drop its object locations; lineage re-execution is the
             # client's job (object_recovery_manager.h:41 analog).
             for entry in self._objects.values():
@@ -214,9 +218,14 @@ class HeadServer:
                     continue  # already freed: don't create ghost holders
                 self._refs.setdefault(oid, set()).add(client_id)
             for oid in remove:
-                holders = self._refs.get(oid)
-                if holders is not None:
-                    holders.discard(client_id)
+                if oid in self._freed:
+                    continue
+                # A remove with no prior entry means the client held and
+                # released entirely between flushes — materialize an empty
+                # entry so the free condition can fire (otherwise the
+                # pinned primary copy would be untracked and immortal).
+                holders = self._refs.setdefault(oid, set())
+                holders.discard(client_id)
                 self._maybe_free(oid)
         return True
 
@@ -400,15 +409,45 @@ class HeadServer:
 
     # -- actor directory --------------------------------------------------
 
+    def rpc_create_actor_record(self, actor_id, max_restarts,
+                                max_task_retries, spec):
+        """Keep the creation spec so the head can reconstruct the actor on
+        worker/node death (GcsActorManager::ReconstructActor state,
+        gcs_actor_manager.cc:1051-1079). -1 = infinite restarts."""
+        with self._lock:
+            self._actor_specs[actor_id] = {
+                "spec": spec,
+                "restarts_left": max_restarts,
+                "max_task_retries": max_task_retries,
+            }
+            if max_restarts != 0:
+                # A restart replays the ctor, which needs its arg objects:
+                # hold them for the actor's whole lifetime (released when
+                # it is permanently DEAD).
+                for oid in spec.get("borrowed", []):
+                    self._refs.setdefault(oid, set()).add(
+                        "actor:" + actor_id
+                    )
+        return True
+
     def rpc_register_actor(
         self, actor_id, node_id, worker_address, class_name, name=None
     ):
         with self._lock:
+            prev = self._actors.get(actor_id)
+            if prev is not None and prev["state"] == "DEAD":
+                # Killed while its (re)start was in flight: refuse to
+                # resurrect; the agent retires the worker.
+                raise ValueError(
+                    f"actor {actor_id} was killed during (re)start"
+                )
             if name:
                 existing = self._named_actors.get(name)
-                if existing is not None and self._actors[existing]["state"] != "DEAD":
+                if existing is not None and existing != actor_id and \
+                        self._actors[existing]["state"] != "DEAD":
                     raise ValueError(f"actor name {name!r} already taken")
                 self._named_actors[name] = actor_id
+            rec = self._actor_specs.get(actor_id, {})
             self._actors[actor_id] = {
                 "actor_id": actor_id,
                 "node_id": node_id,
@@ -417,6 +456,10 @@ class HeadServer:
                 "name": name,
                 "state": "ALIVE",
                 "death_cause": None,
+                # Incarnation counter: callers detect restarts (and replay
+                # lost calls) by comparing this against their submit-time view.
+                "num_restarts": prev.get("num_restarts", 0) if prev else 0,
+                "max_task_retries": rec.get("max_task_retries", 0),
             }
             self._actors_cv.notify_all()
         return True
@@ -440,23 +483,114 @@ class HeadServer:
                 return None
             return dict(self._actors[actor_id])
 
-    def rpc_mark_actor_dead(self, actor_id, cause):
+    def rpc_mark_actor_dead(self, actor_id, cause, allow_restart=True):
+        with self._lock:
+            self._on_actor_death(actor_id, cause, allow_restart)
+        return True
+
+    def rpc_register_actor_failed(self, actor_id, cause):
+        """The agent could not bring the actor up (name conflict, killed
+        mid-start): record a dead entry so callers fail fast."""
+        with self._lock:
+            if actor_id not in self._actors:
+                self._actors[actor_id] = {
+                    "actor_id": actor_id,
+                    "node_id": None,
+                    "address": None,
+                    "class_name": "Actor",
+                    "name": None,
+                    "state": "DEAD",
+                    "death_cause": cause,
+                    "num_restarts": 0,
+                    "max_task_retries": 0,
+                }
+                self._actors_cv.notify_all()
+            else:
+                self._on_actor_death(actor_id, cause, False)
+        return True
+
+    def _on_actor_death(self, actor_id, cause, allow_restart):
+        """Restart (ReconstructActor) within the max_restarts budget, else
+        mark DEAD. Caller holds self._lock."""
+        info = self._actors.get(actor_id)
+        if info is None or info["state"] == "DEAD":
+            return
+        rec = self._actor_specs.get(actor_id)
+        if (
+            allow_restart
+            and rec is not None
+            and rec["restarts_left"] != 0
+            and info["state"] != "RESTARTING"
+        ):
+            if rec["restarts_left"] > 0:
+                rec["restarts_left"] -= 1
+            info["state"] = "RESTARTING"
+            info["death_cause"] = cause
+            info["num_restarts"] = info.get("num_restarts", 0) + 1
+            self._actors_cv.notify_all()
+            threading.Thread(
+                target=self._restart_actor, args=(actor_id,), daemon=True
+            ).start()
+            return
+        info["state"] = "DEAD"
+        info["death_cause"] = cause
+        name = info.get("name")
+        if name and self._named_actors.get(name) == actor_id:
+            del self._named_actors[name]
+        # Calls queued on the dead actor will never report task-end:
+        # release their arg borrows. (Kept alive through RESTARTING so
+        # replayed calls still find their args.)
+        for task_id, (_n, _o, aid) in list(self._inflight_by_task.items()):
+            if aid == actor_id:
+                self._end_task_borrows(task_id)
+        # Release the lifetime holds on the ctor's arg objects.
+        rec = self._actor_specs.pop(actor_id, None)
+        if rec is not None:
+            holder = "actor:" + actor_id
+            for oid in rec["spec"].get("borrowed", []):
+                holders = self._refs.get(oid)
+                if holders is not None:
+                    holders.discard(holder)
+                    self._maybe_free(oid)
+        self._actors_cv.notify_all()
+
+    def _restart_actor(self, actor_id):
+        """Re-run the creation spec on a live node; the agent re-registers
+        the actor (state -> ALIVE) once the ctor finishes."""
+        with self._lock:
+            rec = self._actor_specs.get(actor_id)
+        if rec is None:
+            return
+        spec = dict(rec["spec"])
+        # The original placement (PG bundle / affinity) may have died with
+        # the node: restart anywhere the resources fit.
+        spec["sinfo"] = {"strategy": None, "pg_id": None,
+                         "bundle_index": -1, "node_affinity": None}
+        spec["pg_id"], spec["bundle_index"] = None, -1
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline and not self._stop.is_set():
+            with self._lock:
+                info = self._actors.get(actor_id)
+                if info is None or info["state"] != "RESTARTING":
+                    return  # killed (or already re-registered) meanwhile
+            placed = self.rpc_schedule(spec["demand"])
+            if placed is not None:
+                node_id, _addr = placed
+                with self._lock:
+                    node = self._nodes.get(node_id)
+                if node is not None:
+                    try:
+                        node.client.call("submit_task", spec, timeout=30.0)
+                        return
+                    except Exception:
+                        pass
+            time.sleep(0.25)
         with self._lock:
             info = self._actors.get(actor_id)
-            if info is not None and info["state"] != "DEAD":
-                info["state"] = "DEAD"
-                info["death_cause"] = cause
-                name = info.get("name")
-                if name and self._named_actors.get(name) == actor_id:
-                    del self._named_actors[name]
-            # Calls queued on the dead actor will never report task-end:
-            # release their arg borrows here.
-            for task_id, (_n, _o, aid) in list(
-                self._inflight_by_task.items()
-            ):
-                if aid == actor_id:
-                    self._end_task_borrows(task_id)
-        return True
+            if info is not None and info["state"] == "RESTARTING":
+                self._on_actor_death(
+                    actor_id, "restart failed: no placement", False
+                )
 
     def rpc_list_actors(self):
         with self._lock:

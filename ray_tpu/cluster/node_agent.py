@@ -235,23 +235,18 @@ class NodeAgent:
                         spec.get("name"),
                     )
                 except ValueError as e:
-                    # Name conflict: the actor loses the race but the worker
-                    # is healthy. Register it unnamed + dead so callers fail
-                    # fast, and recycle the worker.
+                    # Registration refused (name conflict, or the actor was
+                    # killed while starting): record the death for callers
+                    # and retire the worker — it already constructed state.
                     self._release_current(w)
                     w.is_actor = False
                     w.actor_id = None
                     try:
                         self.head.call(
-                            "register_actor", spec["actor_id"], self.node_id,
-                            w.address, spec.get("class_name", "Actor"), None,
-                        )
-                        self.head.call(
-                            "mark_actor_dead", spec["actor_id"], str(e)
+                            "register_actor_failed", spec["actor_id"], str(e)
                         )
                     except Exception:
                         pass
-                    # The worker already constructed actor state; retire it.
                     w.proc.kill()
             else:
                 w.client.call("push_task", spec)
@@ -379,7 +374,7 @@ class NodeAgent:
 
     # -- actors -----------------------------------------------------------
 
-    def rpc_kill_actor(self, actor_id):
+    def rpc_kill_actor(self, actor_id, no_restart=True):
         with self._lock:
             target = next(
                 (w for w in self._workers.values() if w.actor_id == actor_id),
@@ -387,19 +382,24 @@ class NodeAgent:
             )
         if target is None:
             return False
-        try:
-            self.head.call("mark_actor_dead", actor_id,
-                           "killed via ray_tpu.kill")
-        except Exception:
-            pass
-        target.is_actor = False  # already marked dead; avoid double-marking
-        target.actor_id = None
+        if no_restart:
+            try:
+                self.head.call("mark_actor_dead", actor_id,
+                               "killed via ray_tpu.kill", False)
+            except Exception:
+                pass
+            target.is_actor = False  # already marked dead; don't re-mark
+            target.actor_id = None
+        # With no_restart=False, the reap loop observes the death and the
+        # head reconstructs within the max_restarts budget.
         target.proc.kill()
         return True
 
     def rpc_actor_ctor_failed(self, actor_id, cause):
+        # A raising constructor is deterministic — restarting would just
+        # raise again (reference restarts only on process failure).
         try:
-            self.head.call("mark_actor_dead", actor_id, cause)
+            self.head.call("mark_actor_dead", actor_id, cause, False)
         except Exception:
             pass
         return True
@@ -514,17 +514,20 @@ class NodeAgent:
 
     def rpc_free_object(self, oid):
         """Head says nothing references this object anymore: drop the shm
-        copy and any spill file (free-on-zero broadcast target)."""
-        self.store.pin(oid, False)
-        if not self.store.delete(oid) and self.store.contains(oid):
-            # Actively read right now (zero-copy views alive); the reap
-            # loop retries until readers release.
-            with self._lock:
-                self._deferred_deletes.add(oid)
-        try:
-            os.unlink(self._spill_path(oid))
-        except OSError:
-            pass
+        copy and any spill file (free-on-zero broadcast target). The spill
+        lock orders this against an in-progress spill pass, so a spill
+        can't recreate the file after we unlink it."""
+        with self._spill_lock:
+            self.store.pin(oid, False)
+            if not self.store.delete(oid) and self.store.contains(oid):
+                # Actively read right now (zero-copy views alive); the reap
+                # loop retries until readers release.
+                with self._lock:
+                    self._deferred_deletes.add(oid)
+            try:
+                os.unlink(self._spill_path(oid))
+            except OSError:
+                pass
         return True
 
     def rpc_delete_object(self, oid):

@@ -1,0 +1,178 @@
+"""Actor fault tolerance + chaos: restarts, call replay, node killing.
+
+Reference parity: ``src/ray/gcs/gcs_server/gcs_actor_manager.cc:1051-1079``
+(ReconstructActor within the max_restarts budget), caller-side call replay
+(max_task_retries), and the NodeKiller chaos pattern of
+``python/ray/tests/test_chaos.py:66,101``.
+"""
+
+import gc
+import random
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.core.object_ref import ActorError
+
+
+def wait_for(cond, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def _kill_actor_worker(cluster, actor_id):
+    """Simulate a worker crash: SIGKILL the process hosting the actor."""
+    for node in cluster.nodes:
+        with node._lock:
+            target = next(
+                (w for w in node._workers.values()
+                 if w.actor_id == actor_id),
+                None,
+            )
+        if target is not None:
+            target.proc.kill()
+            return True
+    return False
+
+
+@pytest.fixture()
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=4)
+    c.wait_for_nodes()
+    ray_tpu.init(c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def incr(self):
+        self.n += 1
+        return self.n
+
+    def slow_incr(self, delay):
+        time.sleep(delay)
+        self.n += 1
+        return self.n
+
+
+def test_actor_restarts_within_budget(cluster):
+    a = Counter.options(max_restarts=1).remote()
+    assert ray_tpu.get(a.incr.remote(), timeout=30) == 1
+    assert _kill_actor_worker(cluster, a._actor_id)
+    # The head reconstructs the actor (fresh state) and new calls work.
+    wait_for(
+        lambda: cluster.head.rpc_get_actor(a._actor_id)["state"] == "ALIVE"
+        and cluster.head.rpc_get_actor(a._actor_id)["num_restarts"] == 1,
+        msg="actor restarted",
+    )
+    assert ray_tpu.get(a.incr.remote(), timeout=30) == 1  # state reset
+    # Second crash exhausts the budget -> DEAD.
+    assert _kill_actor_worker(cluster, a._actor_id)
+    wait_for(
+        lambda: cluster.head.rpc_get_actor(a._actor_id)["state"] == "DEAD",
+        msg="actor dead after budget exhausted",
+    )
+    with pytest.raises(ActorError):
+        ray_tpu.get(a.incr.remote(), timeout=30)
+
+
+def test_actor_without_budget_stays_dead(cluster):
+    a = Counter.remote()  # max_restarts defaults to 0
+    assert ray_tpu.get(a.incr.remote(), timeout=30) == 1
+    assert _kill_actor_worker(cluster, a._actor_id)
+    wait_for(
+        lambda: cluster.head.rpc_get_actor(a._actor_id)["state"] == "DEAD",
+        msg="actor dead",
+    )
+    with pytest.raises(ActorError):
+        ray_tpu.get(a.incr.remote(), timeout=30)
+
+
+def test_lost_call_replayed_with_task_retries(cluster):
+    a = Counter.options(max_restarts=-1, max_task_retries=-1).remote()
+    assert ray_tpu.get(a.incr.remote(), timeout=30) == 1
+    # A slow call is in flight when the worker dies; the caller replays it
+    # on the restarted incarnation.
+    out = a.slow_incr.remote(1.0)
+    time.sleep(0.3)
+    assert _kill_actor_worker(cluster, a._actor_id)
+    assert ray_tpu.get(out, timeout=60) == 1  # replayed on fresh state
+
+
+def test_kill_no_restart_beats_budget(cluster):
+    a = Counter.options(max_restarts=-1).remote()
+    assert ray_tpu.get(a.incr.remote(), timeout=30) == 1
+    ray_tpu.kill(a)  # no_restart=True must override the infinite budget
+    wait_for(
+        lambda: cluster.head.rpc_get_actor(a._actor_id)["state"] == "DEAD",
+        msg="killed actor stays dead",
+    )
+    with pytest.raises(ActorError):
+        ray_tpu.get(a.incr.remote(), timeout=30)
+
+
+def test_chaos_node_killer():
+    """Kill a random non-driver node mid-workload: tasks re-execute via
+    lineage, actors reconstruct, everything completes."""
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=4)  # driver node: survives (holds driver's store)
+    victims = [c.add_node(num_cpus=4) for _ in range(2)]
+    c.wait_for_nodes()
+    ray_tpu.init(c.address)
+    try:
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        actors = [
+            Counter.options(
+                max_restarts=-1,
+                max_task_retries=-1,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    v.node_id
+                ),
+            ).remote()
+            for v in victims
+        ]
+        for a in actors:
+            assert ray_tpu.get(a.incr.remote(), timeout=30) >= 1
+
+        @ray_tpu.remote
+        def work(i):
+            time.sleep(0.05)
+            return i * i
+
+        pending = [
+            work.options(scheduling_strategy="SPREAD").remote(i)
+            for i in range(40)
+        ]
+        call_refs = [a.slow_incr.remote(0.1) for a in actors for _ in range(3)]
+
+        victim = random.choice(victims)
+        c.kill_node(victim)  # heartbeat timeout marks it dead (~5s)
+
+        results = ray_tpu.get(pending, timeout=120)
+        assert results == [i * i for i in range(40)]
+        for r in call_refs:
+            assert ray_tpu.get(r, timeout=120) >= 1
+        # Both actors are usable afterwards (restarted or untouched).
+        for a in actors:
+            assert ray_tpu.get(a.incr.remote(), timeout=60) >= 1
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+        gc.collect()
