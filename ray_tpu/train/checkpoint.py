@@ -108,43 +108,155 @@ class Checkpoint:
 
 
 # -- sharded jax pytree checkpoints ---------------------------------------
+#
+# Truly sharded (SURVEY.md §5.4): every process writes ONLY its
+# addressable shards — one .npy per unique shard index, exactly-once
+# across hosts (the process holding the lowest-id device of a replica
+# group writes it) — plus a global manifest mapping shard index -> file.
+# No leaf is ever gathered whole, so models larger than host RAM
+# checkpoint fine (the property rank-0-upload schemes lack). Restoring
+# assembles each device's target region straight from the shard files
+# (mmap'd), including RESHARDING onto a different mesh/layout.
+
+
+def _bounds(index, shape) -> tuple:
+    """Normalize an index (tuple of slices) to (starts, stops)."""
+    starts, stops = [], []
+    for sl, dim in zip(index, shape):
+        starts.append(0 if sl.start is None else int(sl.start))
+        stops.append(dim if sl.stop is None else int(sl.stop))
+    return tuple(starts), tuple(stops)
+
+
+def _shard_key(starts, stops) -> str:
+    if not starts:
+        return "full"
+    return "_".join(f"{a}-{b}" for a, b in zip(starts, stops))
+
+
+def _atomic_save(path: str, arr: np.ndarray) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    np.save(tmp, arr)
+    os.replace(tmp, path)
 
 
 def save_sharded(state: Any, path: str) -> None:
-    """Write a pytree of jax/np arrays: one .npy per leaf + manifest.
+    """Write a pytree of (possibly sharded) jax arrays under ``path``.
 
-    Each process writes only its addressable shards — on a multi-host mesh
-    every host calls this with the same path on shared storage (or its own
-    local dir), and ``load_sharded`` reassembles onto the target shardings.
-    Single-host arrays are fully addressable, so the leaf is written whole.
+    Multi-host: every process calls this with the same path on shared
+    storage; each writes only the shards it holds (exactly once per
+    unique shard across replicas), and process 0 writes the manifest.
+    Callers should barrier after (the train session does) before
+    treating the checkpoint as complete.
     """
     import jax
 
     os.makedirs(path, exist_ok=True)
     leaves, treedef = jax.tree_util.tree_flatten(state)
-    manifest = {"treedef": treedef, "n": len(leaves)}
+    manifest_leaves = []
     for i, leaf in enumerate(leaves):
-        arr = np.asarray(jax.device_get(leaf))
-        np.save(os.path.join(path, f"leaf_{i}.npy"), arr)
-    with open(os.path.join(path, _MANIFEST), "wb") as f:
-        pickle.dump(manifest, f)
+        if not isinstance(leaf, jax.Array):
+            # Small host-side values (python/np scalars): inline.
+            manifest_leaves.append({"inline": leaf})
+            continue
+        shape = tuple(leaf.shape)
+        # Global index map (every process knows the full layout).
+        idx_map = leaf.sharding.devices_indices_map(shape)
+        groups: dict = {}  # key -> (starts, stops, [devices])
+        for dev, index in idx_map.items():
+            starts, stops = _bounds(index, shape)
+            key = _shard_key(starts, stops)
+            groups.setdefault(key, (starts, stops, []))[2].append(dev)
+        local = {s.device: s for s in leaf.addressable_shards}
+        shards = []
+        for key, (starts, stops, devs) in sorted(groups.items()):
+            fname = f"leaf_{i}.{key}.npy"
+            shards.append((starts, stops, fname))
+            writer = min(devs, key=lambda d: d.id)
+            if writer in local:  # exactly-once across replicas/hosts
+                _atomic_save(
+                    os.path.join(path, fname),
+                    np.asarray(local[writer].data),
+                )
+        manifest_leaves.append(
+            {"shape": shape, "dtype": str(leaf.dtype), "shards": shards}
+        )
+    if getattr(jax, "process_index", lambda: 0)() == 0:
+        tmp = os.path.join(path, f"{_MANIFEST}.tmp.{os.getpid()}")
+        with open(tmp, "wb") as f:
+            pickle.dump(
+                {"treedef": treedef, "leaves": manifest_leaves}, f
+            )
+        os.replace(tmp, os.path.join(path, _MANIFEST))
+
+
+def _load_region(path: str, info: dict, starts, stops) -> np.ndarray:
+    """Assemble the region [starts, stops) of a saved leaf from its shard
+    files (mmap'd: only the bytes actually needed are read)."""
+    dtype = np.dtype(info["dtype"])
+    # Fast path: the region is exactly one saved shard.
+    for s_starts, s_stops, fname in info["shards"]:
+        if tuple(s_starts) == tuple(starts) and tuple(s_stops) == tuple(stops):
+            return np.load(os.path.join(path, fname))
+    out = np.empty([b - a for a, b in zip(starts, stops)], dtype)
+    for s_starts, s_stops, fname in info["shards"]:
+        lo = [max(a, c) for a, c in zip(starts, s_starts)]
+        hi = [min(b, d) for b, d in zip(stops, s_stops)]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        src = np.load(os.path.join(path, fname), mmap_mode="r")
+        src_sl = tuple(
+            slice(l - c, h - c) for l, h, c in zip(lo, hi, s_starts)
+        )
+        dst_sl = tuple(
+            slice(l - a, h - a) for l, h, a in zip(lo, hi, starts)
+        )
+        out[dst_sl] = src[src_sl]
+    return out
 
 
 def load_sharded(path: str, shardings: Any = None) -> Any:
-    """Restore a pytree saved by ``save_sharded``; if ``shardings`` (a
-    matching pytree of jax Shardings) is given, leaves are device_put
-    directly onto their target layout (no full host-side copy per device)."""
+    """Restore a pytree saved by ``save_sharded``.
+
+    With ``shardings`` (a matching pytree of jax Shardings), each device's
+    target region is assembled straight from the shard files — no full
+    host-side copy of any leaf, and the saved layout may differ from the
+    target layout (resharding on load). Without shardings, returns full
+    numpy arrays.
+    """
     import jax
 
     with open(os.path.join(path, _MANIFEST), "rb") as f:
         manifest = pickle.load(f)
-    leaves = [
-        np.load(os.path.join(path, f"leaf_{i}.npy"))
-        for i in range(manifest["n"])
-    ]
-    state = jax.tree_util.tree_unflatten(manifest["treedef"], leaves)
-    if shardings is not None:
-        state = jax.tree.map(
-            lambda x, s: jax.device_put(x, s), state, shardings
-        )
-    return state
+    infos = manifest["leaves"]
+    treedef = manifest["treedef"]
+
+    if shardings is None:
+        leaves = []
+        for info in infos:
+            if "inline" in info:
+                leaves.append(info["inline"])
+                continue
+            shape = info["shape"]
+            leaves.append(
+                _load_region(path, info, (0,) * len(shape), tuple(shape))
+            )
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    sh_leaves = treedef.flatten_up_to(shardings)
+    leaves = []
+    for info, sh in zip(infos, sh_leaves):
+        if "inline" in info:
+            value = info["inline"]
+            if sh is not None and hasattr(sh, "device_set"):
+                value = jax.device_put(value, sh)
+            leaves.append(value)
+            continue
+        shape = tuple(info["shape"])
+
+        def cb(index, _path=path, _info=info, _shape=shape):
+            starts, stops = _bounds(index, _shape)
+            return _load_region(_path, _info, starts, stops)
+
+        leaves.append(jax.make_array_from_callback(shape, sh, cb))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
