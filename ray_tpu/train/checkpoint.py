@@ -136,7 +136,8 @@ def _shard_key(starts, stops) -> str:
 
 def _atomic_save(path: str, arr: np.ndarray) -> None:
     tmp = f"{path}.tmp.{os.getpid()}"
-    np.save(tmp, arr)
+    with open(tmp, "wb") as f:
+        np.save(f, arr)
     os.replace(tmp, path)
 
 
