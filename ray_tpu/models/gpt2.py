@@ -43,7 +43,17 @@ class GPT2Config:
     seq_len: int = 1024
     dtype: Any = jnp.bfloat16  # activation/compute dtype
     param_dtype: Any = jnp.float32
-    remat: bool = True
+    # Rematerialization of the block body in backward (HBM-for-FLOPs):
+    #   True   — full remat (lowest memory, recomputes the whole forward);
+    #   "dots" — selective: save matmul outputs, recompute elementwise only
+    #            (jax.checkpoint_policies.dots_with_no_batch_dims_saveable);
+    #   False  — save everything (needs flash attention to fit at seq 1024).
+    remat: Any = True
+    # Iterate the stacked blocks with lax.scan (O(1) compile time in depth)
+    # or a Python loop (unrolled: XLA schedules across layer boundaries —
+    # measured ~25% faster fwd+bwd on v5e at 12 layers, at higher compile
+    # cost; use for the single-slice training hot path).
+    scan_layers: bool = True
     use_flash: bool | None = None  # None = auto by seq_len/backend
     # Attention parallelism: "auto" (GSPMD-partitioned dense/flash),
     # "ring" (sp-axis ring attention, ppermute KV), or "ulysses"
@@ -193,9 +203,20 @@ def gpt2_forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Arra
     x = with_logical_constraint(x, ("batch", "seq", None))
 
     block_fn = lambda carry, p: (_block(carry, p, cfg), None)
-    if cfg.remat:
+    if cfg.remat == "dots":
+        block_fn = jax.checkpoint(
+            block_fn, prevent_cse=False,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    elif cfg.remat:
         block_fn = jax.checkpoint(block_fn, prevent_cse=False)
-    x, _ = jax.lax.scan(block_fn, x, params["blocks"])
+    if cfg.scan_layers:
+        x, _ = jax.lax.scan(block_fn, x, params["blocks"])
+    else:
+        for i in range(cfg.n_layer):
+            x, _ = block_fn(
+                x, jax.tree.map(lambda a: a[i], params["blocks"])
+            )
 
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     # Tied LM head; fp32 logits for a stable loss.
@@ -213,9 +234,12 @@ def gpt2_loss(params: Params, batch: dict[str, jax.Array], cfg: GPT2Config) -> j
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     logits = gpt2_forward(params, inputs, cfg)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    # CE via logsumexp - picked logit: one reduction pass over [B,T,V]
+    # instead of materializing log_softmax (measured ~2x faster fwd on
+    # v5e at V=50k; the softmax only appears in the backward).
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - picked)
 
 
 def gpt2_flops_per_token(cfg: GPT2Config, seq_len: int | None = None) -> float:

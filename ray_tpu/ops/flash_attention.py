@@ -1,15 +1,35 @@
-"""Pallas TPU flash attention (causal) with a blockwise backward.
+"""Pallas TPU flash attention (causal) with Pallas forward AND backward.
 
 Forward: one Pallas kernel per (batch*head, q-block) grid cell streams K/V
 blocks through VMEM with online-softmax accumulation — the [T, T] score
 matrix never exists in HBM (the reason XLA attention OOMs at long T).
 
-Backward: custom VJP that recomputes attention blockwise with `lax.scan`
-over key blocks (pure XLA, fp32 accumulators). It keeps the same O(T)
-memory property; the recompute trades FLOPs for HBM exactly like
-`jax.checkpoint` (SURVEY.md "HBM bandwidth" note).
+Backward, short sequences (<= _DQ_PARTIALS_MAX_KB k-blocks): ONE Pallas
+kernel, grid (bh, k-block, q-block): recomputes P for each (q, k) tile
+from the saved logsumexp ONCE, accumulates dK/dV in VMEM scratch across
+the q sweep, and writes fp32 per-k-block dQ partial contributions that a
+single XLA reduction sums afterwards. The standard two-kernel flash
+backward recomputes P twice (once for dKV, once for dQ); at short
+sequence lengths the recompute (exp on the VPU) dominates, so trading
+the second recompute for a small dQ-partials HBM roundtrip is a measured
+win on v5e.
 
-On CPU (tests) the kernel runs in Pallas interpret mode.
+Backward, long sequences: the dQ-partials tensor ([bh, n_kb, t, d])
+would grow O(T^2 / block_k), so past the threshold the standard
+two-kernel split runs instead — dKV kernel plus a dQ kernel with in-VMEM
+accumulation — preserving the O(T) memory property that makes flash
+attention viable at long context. Nothing [T, T]-shaped ever reaches
+HBM on either path. All matmuls run in the
+input dtype (bf16 on TPU => full MXU rate) with fp32 accumulation;
+softmax statistics stay fp32. Causal tiles that need no masking skip
+the mask arithmetic entirely (VPU, not MXU, is the bottleneck at short
+sequence lengths — measured on v5e).
+
+On CPU (tests) the kernels run in Pallas interpret mode.
+
+Reference parity: the reference has no attention kernels at all (torch
+owns its compute path); this module exists because on TPU the framework
+owns the compute path (SURVEY.md §5.7).
 """
 
 from __future__ import annotations
@@ -22,6 +42,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+
+# Backward dQ strategy switch: up to this many k-blocks the fused dKV+dQ
+# kernel writes fp32 dQ partials ([bh, n_kb, t, d] HBM, P computed once);
+# beyond it the O(T)-memory two-kernel path is used (see _flash_bwd).
+_DQ_PARTIALS_MAX_KB = 4
+
+
+# -- forward ---------------------------------------------------------------
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
@@ -42,19 +70,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Causal skip: a k block entirely in the future contributes nothing.
+    # Causal block classes: fully-past blocks need no mask; the blocks
+    # straddling the diagonal do; fully-future blocks contribute nothing.
     needed = (k_start <= q_start + block_q - 1) if causal else True
+    on_diag = (k_start + block_k - 1 > q_start) if causal else False
 
-    @pl.when(needed)
-    def _accumulate():
-        q = q_ref[:].astype(jnp.float32) * softmax_scale
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
+    def _accumulate(masked: bool):
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [bq, bk]
-        if causal:
+        ) * softmax_scale  # [bq, bk] fp32
+        if masked:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(
@@ -67,9 +96,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_scr[:] = m_new
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    if causal:
+        @pl.when(needed & jnp.logical_not(on_diag))
+        def _full():
+            _accumulate(False)
+
+        @pl.when(needed & on_diag)
+        def _diag():
+            _accumulate(True)
+    else:
+        _accumulate(False)
 
     @pl.when(kb == n_kb - 1)
     def _finalize():
@@ -80,7 +120,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 def _flash_fwd(q, k, v, *, block_q: int, block_k: int, softmax_scale: float,
                causal: bool, interpret: bool):
-    """q,k,v: [B, T, H, D] -> (out [B,T,H,D], lse [B,H,T])."""
+    """q,k,v: [B, T, H, D] -> (out [B,T,H,D], lse [B*H,T,1])."""
     b, t, h, d = q.shape
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     kr = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
@@ -119,57 +159,258 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, softmax_scale: float,
         interpret=interpret,
     )(qr, kr, vr)
     out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-    lse = lse.reshape(b, h, t)
     return out, lse
 
 
-def _blockwise_bwd(q, k, v, out, lse, g, *, block_q: int,
-                   softmax_scale: float, causal: bool):
-    """Gradients via blockwise recompute (XLA scan over q blocks).
+# -- backward --------------------------------------------------------------
 
-    Memory: O(T * block_q) scores at a time instead of O(T^2).
+
+def _recompute_p_ds(q, k, v, g, lse, delta, q_start, k_start,
+                    block_q, block_k, softmax_scale, masked):
+    """Shared tile math for both backward kernels.
+
+    Returns (p, ds) both cast to the matmul dtype. lse/delta: [bq, 1] fp32.
     """
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * softmax_scale  # [bq, bk]
+    if masked:
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse)  # [bq, bk] fp32
+    dp = jax.lax.dot_general(
+        g, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bq, bk]
+    ds = p * (dp - delta) * softmax_scale
+    return p.astype(q.dtype), ds.astype(q.dtype)
+
+
+def _dkv_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
+                dk_ref, dv_ref, dqp_ref, dk_scr, dv_scr,
+                *, block_q: int, block_k: int, n_qb: int,
+                softmax_scale: float, causal: bool, with_dqp: bool):
+    """Grid (bh, k_block, q_block), q innermost: for one fixed K/V tile,
+    dK/dV accumulate in VMEM across the q sweep. With ``with_dqp`` each
+    cell also writes its fp32 dQ contribution (one per (k-block,
+    q-block)) for the XLA post-reduction, so P/dS are recomputed exactly
+    once per tile (fused path for short sequences)."""
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    needed = (q_start + block_q - 1 >= k_start) if causal else True
+    on_diag = (k_start + block_k - 1 > q_start) if causal else False
+
+    def _accumulate(masked: bool):
+        q = q_ref[:]
+        g = g_ref[:]
+        k = k_ref[:]
+        p, ds = _recompute_p_ds(
+            q, k, v_ref[:], g, lse_ref[:], delta_ref[:],
+            q_start, k_start, block_q, block_k, softmax_scale, masked)
+        # dV += P^T dO ; dK += dS^T Q   (contract over the q dim)
+        dv_scr[:] += jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if with_dqp:
+            dqp_ref[:] = jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    if causal:
+        @pl.when(jnp.logical_not(needed))
+        def _skip():
+            if with_dqp:
+                dqp_ref[:] = jnp.zeros_like(dqp_ref)
+
+        @pl.when(needed & jnp.logical_not(on_diag))
+        def _full():
+            _accumulate(False)
+
+        @pl.when(needed & on_diag)
+        def _diag():
+            _accumulate(True)
+    else:
+        _accumulate(False)
+
+    @pl.when(qi == n_qb - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
+               dq_ref, dq_scr,
+               *, block_q: int, block_k: int, n_kb: int,
+               softmax_scale: float, causal: bool):
+    """Grid (bh, q_block, k_block), k innermost: dQ accumulates in VMEM
+    across the k sweep for one fixed Q tile (O(T)-memory path for long
+    sequences; recomputes P a second time)."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    needed = (k_start <= q_start + block_q - 1) if causal else True
+    on_diag = (k_start + block_k - 1 > q_start) if causal else False
+
+    def _accumulate(masked: bool):
+        q = q_ref[:]
+        g = g_ref[:]
+        k = k_ref[:]
+        _, ds = _recompute_p_ds(
+            q, k, v_ref[:], g, lse_ref[:], delta_ref[:],
+            q_start, k_start, block_q, block_k, softmax_scale, masked)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(needed & jnp.logical_not(on_diag))
+        def _full():
+            _accumulate(False)
+
+        @pl.when(needed & on_diag)
+        def _diag():
+            _accumulate(True)
+    else:
+        _accumulate(False)
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, *, block_q: int, block_k: int,
+               softmax_scale: float, causal: bool, interpret: bool):
+    """q,k,v,out,g: [B,T,H,D]; lse: [B*H,T,1] fp32 -> (dq, dk, dv)."""
     b, t, h, d = q.shape
-    f32 = jnp.float32
-    qf = q.astype(f32)
-    kf = k.astype(f32)
-    vf = v.astype(f32)
-    gf = g.astype(f32)
-    of = out.astype(f32)
-    # delta = rowsum(dO * O) — the softmax-jacobian diagonal term.
-    delta = jnp.einsum("bthd,bthd->bht", gf, of)
+    bh = b * h
 
-    n_q = t // block_q
-    k_pos = jnp.arange(t)
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
 
-    def per_qblock(carry, qi):
-        dk_acc, dv_acc = carry
-        qs = qi * block_q
-        q_blk = jax.lax.dynamic_slice_in_dim(qf, qs, block_q, 1)
-        g_blk = jax.lax.dynamic_slice_in_dim(gf, qs, block_q, 1)
-        lse_blk = jax.lax.dynamic_slice_in_dim(lse, qs, block_q, 2)
-        delta_blk = jax.lax.dynamic_slice_in_dim(delta, qs, block_q, 2)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q_blk, kf) * softmax_scale
-        if causal:
-            q_pos = qs + jnp.arange(block_q)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None], s, _NEG_INF)
-        p = jnp.exp(s - lse_blk[..., None])  # [B,H,bq,T]
-        dv = jnp.einsum("bhqk,bqhd->bkhd", p, g_blk)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", g_blk, vf)
-        ds = p * (dp - delta_blk[..., None]) * softmax_scale
-        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
-        dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q_blk)
-        return (dk_acc + dk, dv_acc + dv), dq_blk
+    qr, kr, vr, gr = to_bh(q), to_bh(k), to_bh(v), to_bh(g)
+    # delta = rowsum(dO * O): the softmax-jacobian diagonal term, fp32.
+    delta = jnp.einsum(
+        "bthd,bthd->bht", g, out, preferred_element_type=jnp.float32
+    ).reshape(bh, t, 1)
 
-    (dk, dv), dq_blocks = jax.lax.scan(
-        per_qblock,
-        (jnp.zeros_like(kf), jnp.zeros_like(vf)),
-        jnp.arange(n_q),
+    n_qb = t // block_q
+    n_kb = t // block_k
+
+    q_spec = pl.BlockSpec((None, block_q, d), lambda bhi, a, b_: (bhi, b_, 0))
+    r_spec = pl.BlockSpec((None, block_q, 1), lambda bhi, a, b_: (bhi, b_, 0))
+    kfix_spec = pl.BlockSpec((None, block_k, d), lambda bhi, a, b_: (bhi, a, 0))
+
+    # dQ strategy: the fused path writes fp32 per-k-block dQ partials
+    # ([bh, n_kb, t, d] in HBM) so P/dS are computed once — fastest, but
+    # O(n_kb * T) memory. Past _DQ_PARTIALS_MAX_KB k-blocks that tensor
+    # outgrows the activations it sits next to, so long sequences take a
+    # second kernel with in-VMEM dQ accumulation (O(T) memory, P
+    # recomputed twice) instead.
+    with_dqp = n_kb <= _DQ_PARTIALS_MAX_KB
+
+    out_specs = [
+        pl.BlockSpec((None, block_k, d), lambda bhi, a, b_: (bhi, a, 0)),
+        pl.BlockSpec((None, block_k, d), lambda bhi, a, b_: (bhi, a, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+        jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+    ]
+    if with_dqp:
+        out_specs.append(pl.BlockSpec(
+            (None, None, block_q, d), lambda bhi, a, b_: (bhi, a, b_, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((bh, n_kb, t, d), jnp.float32))
+
+    dkv_body = functools.partial(
+        _dkv_kernel, block_q=block_q, block_k=block_k, n_qb=n_qb,
+        softmax_scale=softmax_scale, causal=causal, with_dqp=with_dqp,
     )
-    # [n_q, B, bq, H, D] -> [B, T, H, D]
-    dq = dq_blocks.transpose(1, 0, 2, 3, 4).reshape(b, t, h, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    if not with_dqp:
+        # Without the dQ-partials output the ref list is one shorter.
+        dkv_body = functools.partial(
+            lambda body, q, g, l, dl, k, v, dk, dv, dks, dvs:
+                body(q, g, l, dl, k, v, dk, dv, None, dks, dvs),
+            dkv_body,
+        )
+
+    dkv_out = pl.pallas_call(
+        dkv_body,
+        grid=(bh, n_kb, n_qb),
+        in_specs=[q_spec, q_spec, r_spec, r_spec, kfix_spec, kfix_spec],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qr, gr, lse, delta, kr, vr)
+
+    if with_dqp:
+        dk, dv, dq_part = dkv_out
+        dq = jnp.sum(dq_part, axis=1).astype(q.dtype)
+    else:
+        dk, dv = dkv_out
+        qfix_spec = pl.BlockSpec(
+            (None, block_q, d), lambda bhi, a, b_: (bhi, a, 0))
+        rfix_spec = pl.BlockSpec(
+            (None, block_q, 1), lambda bhi, a, b_: (bhi, a, 0))
+        k_spec = pl.BlockSpec(
+            (None, block_k, d), lambda bhi, a, b_: (bhi, b_, 0))
+        dq = pl.pallas_call(
+            functools.partial(
+                _dq_kernel, block_q=block_q, block_k=block_k, n_kb=n_kb,
+                softmax_scale=softmax_scale, causal=causal,
+            ),
+            grid=(bh, n_qb, n_kb),
+            in_specs=[qfix_spec, qfix_spec, rfix_spec, rfix_spec,
+                      k_spec, k_spec],
+            out_specs=pl.BlockSpec(
+                (None, block_q, d), lambda bhi, a, b_: (bhi, a, 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"),
+            ),
+            interpret=interpret,
+        )(qr, gr, lse, delta, kr, vr)
+
+    def from_bh(x):
+        return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    return from_bh(dq), from_bh(dk), from_bh(dv)
+
+
+# -- custom VJP wiring -----------------------------------------------------
 
 
 @functools.partial(
@@ -194,9 +435,9 @@ def _vjp_fwd(q, k, v, block_q, block_k, softmax_scale, causal, interpret):
 
 def _vjp_bwd(block_q, block_k, softmax_scale, causal, interpret, res, g):
     q, k, v, out, lse = res
-    return _blockwise_bwd(
-        q, k, v, out, lse, g, block_q=block_q,
-        softmax_scale=softmax_scale, causal=causal,
+    return _flash_bwd(
+        q, k, v, out, lse, g, block_q=block_q, block_k=block_k,
+        softmax_scale=softmax_scale, causal=causal, interpret=interpret,
     )
 
 
@@ -206,8 +447,11 @@ _flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 def flash_causal_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, *,
     softmax_scale: float | None = None,
-    block_q: int = 256, block_k: int = 256,
+    block_q: int = 1024, block_k: int = 1024,
 ) -> jax.Array:
+    # Default block sizes: 1024x1024 measured fastest on v5e at seq 1024
+    # (4 MB fp32 score tile in VMEM; fewer grid cells beats finer causal
+    # skipping — per-cell overhead dominates below ~512).
     """[B, T, H, D] causal flash attention (differentiable)."""
     b, t, h, d = q.shape
     scale = softmax_scale if softmax_scale is not None else d**-0.5

@@ -49,6 +49,34 @@ def test_loss_decreases_single_device():
     assert last < first * 0.7, (first, last)
 
 
+@pytest.mark.parametrize("remat,scan_layers", [
+    ("dots", False),   # the bench.py hot-path config
+    ("dots", True),
+    (False, False),
+])
+def test_config_paths_match_baseline(remat, scan_layers):
+    """remat policy x layer-loop variants must match the default
+    (remat=True, scan_layers=True) loss and gradients — covers the
+    unrolled-loop and dots-checkpoint branches the TPU benchmark runs."""
+    tokens = jax.random.randint(jax.random.key(1), (2, 33), 0, CFG.vocab_size)
+    batch = {"tokens": tokens.astype(jnp.int32)}
+    params = gpt2_init(jax.random.key(0), CFG)
+
+    def loss_for(cfg):
+        return jax.value_and_grad(lambda p: gpt2_loss(p, batch, cfg))(params)
+
+    base_loss, base_grads = loss_for(CFG)
+    cfg = GPT2Config.tiny()
+    cfg = type(cfg)(**{**cfg.__dict__, "remat": remat,
+                       "scan_layers": scan_layers})
+    loss, grads = loss_for(cfg)
+    np.testing.assert_allclose(float(loss), float(base_loss), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        grads, base_grads)
+
+
 def test_sharded_step_matches_single_device(devices8):
     """dp2 x fsdp2 x tp2 sharded training must match 1-device numerics."""
     tokens = jax.random.randint(jax.random.key(1), (8, 33), 0, CFG.vocab_size)

@@ -58,6 +58,29 @@ def test_flash_attention_gradients_match():
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_flash_attention_gradients_long_seq_path():
+    """n_kb > _DQ_PARTIALS_MAX_KB exercises the O(T)-memory two-kernel
+    backward (separate dQ kernel) instead of the fused dQ-partials path."""
+    from ray_tpu.ops import flash_attention as fa
+
+    q, k, v = _qkv(t=96)
+    n_kb = 96 // 16
+    assert n_kb > fa._DQ_PARTIALS_MAX_KB
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_causal_attention(q, k, v, block_q=16, block_k=16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_causal_attention(q, k, v) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_ring_attention_matches_dense(sp_mesh):
     q, k, v = _qkv(t=64)
     ref = xla_causal_attention(q, k, v)
