@@ -165,15 +165,26 @@ class ShmStore:
         return data, meta
 
     def release(self, object_id: str) -> None:
+        # Guard post-close calls: zero-copy buffer finalizers (weakref)
+        # can fire at interpreter exit, after shutdown() detached the
+        # store — ts_* on a NULL handle is a segfault.
+        if not self._h:
+            return
         _get_lib().ts_release(self._h, store_key(object_id))
 
     def contains(self, object_id: str) -> bool:
+        if not self._h:
+            return False
         return bool(_get_lib().ts_contains(self._h, store_key(object_id)))
 
     def delete(self, object_id: str) -> bool:
+        if not self._h:
+            return False
         return _get_lib().ts_delete(self._h, store_key(object_id)) == 0
 
     def abort(self, object_id: str) -> bool:
+        if not self._h:
+            return False
         return _get_lib().ts_abort(self._h, store_key(object_id)) == 0
 
     def release_dead(self, pid: int) -> int:

@@ -607,7 +607,7 @@ class HeadServer:
             if node_affinity is not None:
                 node = self._nodes.get(node_affinity)
                 if node is not None and node.alive:
-                    return node.node_id, node.address
+                    return self._pick(node, demand)
                 return None
             feasible = [
                 n
@@ -637,19 +637,23 @@ class HeadServer:
 
             if strategy == "SPREAD":
                 self._rr_counter += 1
-                return self._pick(feasible[self._rr_counter % len(feasible)])
+                return self._pick(
+                    feasible[self._rr_counter % len(feasible)], demand)
             # Hybrid: prefer caller's node while it has headroom.
             if caller_node is not None:
                 local = self._nodes.get(caller_node)
                 if local is not None and local.alive and local in feasible:
                     if headroom(local) >= 0:
-                        return self._pick(local)
+                        return self._pick(local, demand)
             best = max(feasible, key=headroom)
-            return self._pick(best)
+            return self._pick(best, demand)
 
-    def _pick(self, node: NodeInfo):
+    def _pick(self, node: NodeInfo, demand):
         # Optimistically debit the view so bursts spread before the next
-        # heartbeat refreshes truth (the raylet remains authoritative).
+        # heartbeat refreshes truth (the node agent's heartbeat remains
+        # authoritative and restores the real availability).
+        for k, v in demand.items():
+            node.available[k] = node.available.get(k, 0.0) - v
         return node.node_id, node.address
 
     def rpc_pending_demands(self, window_s: float = 30.0):

@@ -49,6 +49,33 @@ _NEG_INF = -1e30
 _DQ_PARTIALS_MAX_KB = 4
 
 
+def _block_classes(q_start, k_start, block_q: int, block_k: int,
+                   causal: bool):
+    """Causal tile classification shared by all kernels: (needed, on_diag).
+    Fully-future tiles contribute nothing; only diagonal-straddling tiles
+    pay for mask arithmetic."""
+    if not causal:
+        return True, False
+    needed = q_start + block_q - 1 >= k_start
+    on_diag = k_start + block_k - 1 > q_start
+    return needed, on_diag
+
+
+def _dispatch_causal(causal: bool, needed, on_diag, accumulate):
+    """Run ``accumulate(masked)`` under the right pl.when branch so
+    off-diagonal tiles skip the iota mask (VPU) entirely."""
+    if causal:
+        @pl.when(needed & jnp.logical_not(on_diag))
+        def _full():
+            accumulate(False)
+
+        @pl.when(needed & on_diag)
+        def _diag():
+            accumulate(True)
+    else:
+        accumulate(False)
+
+
 # -- forward ---------------------------------------------------------------
 
 
@@ -70,10 +97,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Causal block classes: fully-past blocks need no mask; the blocks
-    # straddling the diagonal do; fully-future blocks contribute nothing.
-    needed = (k_start <= q_start + block_q - 1) if causal else True
-    on_diag = (k_start + block_k - 1 > q_start) if causal else False
+    needed, on_diag = _block_classes(
+        q_start, k_start, block_q, block_k, causal)
 
     def _accumulate(masked: bool):
         q = q_ref[:]
@@ -84,11 +109,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             preferred_element_type=jnp.float32,
         ) * softmax_scale  # [bq, bk] fp32
         if masked:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -100,16 +121,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             preferred_element_type=jnp.float32,
         )
 
-    if causal:
-        @pl.when(needed & jnp.logical_not(on_diag))
-        def _full():
-            _accumulate(False)
-
-        @pl.when(needed & on_diag)
-        def _diag():
-            _accumulate(True)
-    else:
-        _accumulate(False)
+    _dispatch_causal(causal, needed, on_diag, _accumulate)
 
     @pl.when(kb == n_kb - 1)
     def _finalize():
@@ -165,6 +177,14 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, softmax_scale: float,
 # -- backward --------------------------------------------------------------
 
 
+def _apply_causal_mask(s, q_start, k_start, block_q: int, block_k: int):
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
 def _recompute_p_ds(q, k, v, g, lse, delta, q_start, k_start,
                     block_q, block_k, softmax_scale, masked):
     """Shared tile math for both backward kernels.
@@ -176,11 +196,7 @@ def _recompute_p_ds(q, k, v, g, lse, delta, q_start, k_start,
         preferred_element_type=jnp.float32,
     ) * softmax_scale  # [bq, bk]
     if masked:
-        q_pos = q_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
     p = jnp.exp(s - lse)  # [bq, bk] fp32
     dp = jax.lax.dot_general(
         g, v, (((1,), (1,)), ((), ())),
@@ -209,8 +225,8 @@ def _dkv_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    needed = (q_start + block_q - 1 >= k_start) if causal else True
-    on_diag = (k_start + block_k - 1 > q_start) if causal else False
+    needed, on_diag = _block_classes(
+        q_start, k_start, block_q, block_k, causal)
 
     def _accumulate(masked: bool):
         q = q_ref[:]
@@ -234,21 +250,13 @@ def _dkv_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
                 preferred_element_type=jnp.float32,
             )
 
-    if causal:
+    if causal and with_dqp:
+        # Skipped tiles still own their dQ-partials output block.
         @pl.when(jnp.logical_not(needed))
         def _skip():
-            if with_dqp:
-                dqp_ref[:] = jnp.zeros_like(dqp_ref)
+            dqp_ref[:] = jnp.zeros_like(dqp_ref)
 
-        @pl.when(needed & jnp.logical_not(on_diag))
-        def _full():
-            _accumulate(False)
-
-        @pl.when(needed & on_diag)
-        def _diag():
-            _accumulate(True)
-    else:
-        _accumulate(False)
+    _dispatch_causal(causal, needed, on_diag, _accumulate)
 
     @pl.when(qi == n_qb - 1)
     def _finalize():
@@ -272,8 +280,8 @@ def _dq_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    needed = (k_start <= q_start + block_q - 1) if causal else True
-    on_diag = (k_start + block_k - 1 > q_start) if causal else False
+    needed, on_diag = _block_classes(
+        q_start, k_start, block_q, block_k, causal)
 
     def _accumulate(masked: bool):
         q = q_ref[:]
@@ -287,16 +295,7 @@ def _dq_kernel(q_ref, g_ref, lse_ref, delta_ref, k_ref, v_ref,
             preferred_element_type=jnp.float32,
         )
 
-    if causal:
-        @pl.when(needed & jnp.logical_not(on_diag))
-        def _full():
-            _accumulate(False)
-
-        @pl.when(needed & on_diag)
-        def _diag():
-            _accumulate(True)
-    else:
-        _accumulate(False)
+    _dispatch_causal(causal, needed, on_diag, _accumulate)
 
     @pl.when(kb == n_kb - 1)
     def _finalize():
@@ -455,13 +454,24 @@ def flash_causal_attention(
     """[B, T, H, D] causal flash attention (differentiable)."""
     b, t, h, d = q.shape
     scale = softmax_scale if softmax_scale is not None else d**-0.5
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    if t % block_q or t % block_k:
-        raise NotImplementedError(
-            f"seq len {t} must be divisible by block sizes ({block_q},{block_k})"
-        )
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, t)
     interpret = jax.default_backend() == "cpu"
     return _flash_attention(
         q, k, v, block_q, block_k, scale, True, interpret
     )
+
+
+def _fit_block(requested: int, t: int) -> int:
+    """Largest divisor of t that is <= requested (so any T works, e.g.
+    T=1536 -> 512 with the 1024 default). Degenerate T whose largest
+    usable divisor is < 8 (primes etc.) can't tile the TPU lane layout —
+    raise so `causal_attention`'s auto path falls back to XLA attention."""
+    block = min(requested, t)
+    while block > 1 and t % block:
+        block -= 1
+    if block < 8:
+        raise NotImplementedError(
+            f"seq len {t} has no block divisor >= 8 (<= {requested})"
+        )
+    return block

@@ -201,6 +201,24 @@ def test_strict_spread_placement_group(cluster):
     remove_placement_group(pg)
 
 
+def test_burst_spreads_across_nodes_between_heartbeats(cluster):
+    """A burst of CPU:1 tasks submitted faster than the heartbeat period
+    must land on BOTH nodes: Head._pick optimistically debits the cached
+    resource view at schedule time, so the second pair of tasks sees the
+    first node as full before any heartbeat refreshes truth (reference:
+    decentralized view + lease pipelining, ``hybrid_scheduling_policy.cc``)."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def hold_node():
+        time.sleep(1.0)
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    # 4 tasks x CPU:1 over 2 nodes x 2 CPUs, submitted in one burst.
+    refs = [hold_node.remote() for _ in range(4)]
+    nodes_used = set(ray_tpu.get(refs, timeout=60))
+    assert len(nodes_used) == 2, nodes_used
+
+
 def test_none_result_roundtrip(cluster):
     @ray_tpu.remote
     def nothing():

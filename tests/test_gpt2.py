@@ -1,3 +1,5 @@
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -66,9 +68,8 @@ def test_config_paths_match_baseline(remat, scan_layers):
         return jax.value_and_grad(lambda p: gpt2_loss(p, batch, cfg))(params)
 
     base_loss, base_grads = loss_for(CFG)
-    cfg = GPT2Config.tiny()
-    cfg = type(cfg)(**{**cfg.__dict__, "remat": remat,
-                       "scan_layers": scan_layers})
+    cfg = dataclasses.replace(
+        GPT2Config.tiny(), remat=remat, scan_layers=scan_layers)
     loss, grads = loss_for(cfg)
     np.testing.assert_allclose(float(loss), float(base_loss), rtol=1e-5)
     jax.tree.map(

@@ -58,6 +58,20 @@ def test_flash_attention_gradients_match():
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_flash_attention_non_divisible_blocks():
+    """Requested block sizes that don't divide T shrink to the largest
+    divisor instead of erroring (T=192 with block 128 -> 96)."""
+    from ray_tpu.ops.flash_attention import _fit_block
+
+    assert _fit_block(128, 192) == 96
+    assert _fit_block(1024, 1536) == 768
+    q, k, v = _qkv(t=192)
+    ref = xla_causal_attention(q, k, v)
+    out = flash_causal_attention(q, k, v, block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_flash_attention_gradients_long_seq_path():
     """n_kb > _DQ_PARTIALS_MAX_KB exercises the O(T)-memory two-kernel
     backward (separate dQ kernel) instead of the fused dQ-partials path."""
