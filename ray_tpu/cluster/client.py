@@ -84,6 +84,14 @@ class ClusterBackend:
         self._flush_io_lock = threading.Lock()
         self._closed = False
         threading.Thread(target=self._ref_flush_loop, daemon=True).start()
+        if process_kind == "d":
+            # Drivers stream worker stdout/stderr from the head; only
+            # lines emitted after this driver connected are shown.
+            try:
+                self._log_start_seq, _ = self.head.call("drain_logs", 1 << 62)
+            except Exception:
+                self._log_start_seq = 0
+            threading.Thread(target=self._log_poll_loop, daemon=True).start()
 
     # -- plumbing ----------------------------------------------------------
 
@@ -825,6 +833,39 @@ class ClusterBackend:
         return None  # capture is a local-backend feature for now
 
     # -- introspection / lifecycle ----------------------------------------
+
+    # -- state API (experimental/state/api.py analog) ----------------------
+
+    def list_tasks(self, limit: int = 1000) -> list:
+        return self.head.call("list_tasks", limit, timeout=15.0)
+
+    def list_actors(self) -> list:
+        return self.head.call("list_actors")
+
+    def list_objects(self, limit: int = 1000) -> list:
+        return self.head.call("list_objects", limit)
+
+    def _log_poll_loop(self) -> None:
+        """Driver-side log streaming: poll the head's worker-log ring and
+        echo lines to this process's stdout with a (pid=, node=) prefix —
+        the reference's log_monitor -> driver behavior, pull-based."""
+        seq = self._log_start_seq
+        while not self._closed:
+            time.sleep(0.3)
+            try:
+                seq, entries = self.head.call("drain_logs", seq, timeout=5.0)
+            except Exception:
+                continue
+            for e in entries:
+                try:
+                    # sys.stdout may be swapped/closed under us (pytest
+                    # capture, daemonized drivers) — never kill the poller.
+                    print(
+                        f"(pid={e['pid']}, node={e['node_id'][-8:]}) "
+                        f"{e['line']}"
+                    )
+                except Exception:
+                    break
 
     def cluster_resources(self) -> dict:
         return self.head.call("cluster_resources")

@@ -16,6 +16,7 @@ the demand, then best-fit over the cluster view
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Any, Optional
@@ -71,6 +72,11 @@ class HeadServer:
         # Unsatisfiable demand log: the autoscaler's input signal
         # (load_metrics.py / resource_demand_scheduler.py analog).
         self._demand_misses: list[dict] = []
+        # Worker stdout/stderr ring buffer for driver log streaming
+        # (log_monitor.py -> GCS pubsub -> driver analog; drivers poll
+        # rpc_drain_logs with their last-seen seq).
+        self._logs: "collections.deque[dict]" = collections.deque(maxlen=20_000)
+        self._log_seq = 0
         self._server = RpcServer(self, host, port)
         self.address = self._server.address
         self._stop = threading.Event()
@@ -595,6 +601,72 @@ class HeadServer:
     def rpc_list_actors(self):
         with self._lock:
             return [dict(v) for v in self._actors.values()]
+
+    # -- state API aggregation + log streaming ----------------------------
+
+    def rpc_list_tasks(self, limit: int = 1000):
+        """Fan out to alive agents' task records and merge by recency
+        (state_aggregator.py querying raylet GetTasksInfo analog)."""
+        with self._lock:
+            agents = [
+                (n.node_id, n.client) for n in self._nodes.values() if n.alive
+            ]
+        records = []
+        for node_id, client in agents:
+            try:
+                for rec in client.call("list_task_records", limit, timeout=5.0):
+                    rec["node_id"] = node_id
+                    records.append(rec)
+            except Exception:
+                continue  # node died mid-query: best-effort like the reference
+        # Actor tasks (direct caller->worker) have no agent submit record;
+        # fall back to their start time for recency ordering.
+        records.sort(
+            key=lambda r: r.get("submitted_at") or r.get("start_time") or 0)
+        return records[-limit:]
+
+    def rpc_list_objects(self, limit: int = 1000):
+        """Object records from the directory + ref table (no agent RPC)."""
+        with self._lock:
+            out = []
+            for oid, entry in list(self._objects.items())[:limit]:
+                out.append({
+                    "object_id": oid,
+                    "size": entry.get("size", 0),
+                    "locations": sorted(entry["nodes"]),
+                    "is_error": entry.get("error", False),
+                    "ref_holders": len(self._refs.get(oid, ())),
+                })
+            return out
+
+    def rpc_worker_logs(self, node_id, pid, lines):
+        with self._lock:
+            for line in lines:
+                self._log_seq += 1
+                self._logs.append({
+                    "seq": self._log_seq,
+                    "node_id": node_id,
+                    "pid": pid,
+                    "line": line,
+                })
+        return True
+
+    def rpc_drain_logs(self, after_seq: int = 0, limit: int = 1000):
+        """Up to ``limit`` log entries newer than after_seq, oldest first;
+        returns (cursor, entries) where cursor is the last delivered seq —
+        pass it back to resume without loss when truncated. Seqs are
+        monotone in the ring, so the common nothing-new poll scans O(1)
+        from the right."""
+        with self._lock:
+            newer: list = []
+            for e in reversed(self._logs):
+                if e["seq"] <= after_seq:
+                    break
+                newer.append(e)
+            newer.reverse()
+            entries = newer[:limit]
+            cursor = entries[-1]["seq"] if entries else self._log_seq
+            return cursor, entries
 
     # -- scheduling -------------------------------------------------------
 

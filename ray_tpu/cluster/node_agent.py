@@ -18,6 +18,7 @@ worker processes. Implements:
 
 from __future__ import annotations
 
+import collections
 import os
 import subprocess
 import sys
@@ -83,6 +84,13 @@ class NodeAgent:
         self._task_queue: list[dict] = []
         self._queue_cv = threading.Condition(self._lock)
         self._shutdown = threading.Event()
+        # Task state records for the state API (GetTasksInfo analog):
+        # PENDING on enqueue, RUNNING on dispatch, final state from the
+        # worker's batched event reports.
+        self._task_records: "collections.OrderedDict[str, dict]" = (
+            collections.OrderedDict()
+        )
+        self._task_records_cap = 10_000
 
         self._server = RpcServer(self, host)
         self.address = self._server.address
@@ -165,10 +173,62 @@ class NodeAgent:
     def rpc_submit_task(self, spec: dict):
         """Enqueue a task; the dispatcher leases a worker when resources
         allow. Returns immediately (results flow through the store)."""
+        self._record_task(spec, "PENDING")
         with self._queue_cv:
             self._task_queue.append(spec)
             self._queue_cv.notify()
         return True
+
+    # -- task state records (state API) -----------------------------------
+
+    def _task_key(self, spec: dict) -> str:
+        return spec.get("task_id") or spec.get("oids", ["?"])[0]
+
+    def _record_task(self, spec: dict, state: str):
+        rec = {
+            "task_id": self._task_key(spec),
+            "name": spec.get("fname") or spec.get("method")
+            or spec.get("class_name", "task"),
+            "type": "ACTOR_CREATION_TASK" if spec.get("actor_create")
+            else "NORMAL_TASK",
+            "state": state,
+            "submitted_at": time.time(),
+            "start_time": None,
+            "end_time": None,
+            "error": None,
+        }
+        with self._lock:
+            old = self._task_records.get(rec["task_id"])
+            if old is not None:
+                old["state"] = state
+                return
+            if len(self._task_records) >= self._task_records_cap:
+                self._task_records.popitem(last=False)
+            self._task_records[rec["task_id"]] = rec
+
+    def rpc_worker_events(self, worker_id, pid, task_events, log_lines):
+        """Batched observability report from a worker: authoritative task
+        records (with timings/outcome) + captured stdout/stderr lines."""
+        with self._lock:
+            for rec in task_events:
+                old = self._task_records.get(rec["task_id"])
+                if old is not None and rec.get("submitted_at") is None:
+                    # The agent saw the submit; the worker only saw the run.
+                    rec["submitted_at"] = old.get("submitted_at")
+                if len(self._task_records) >= self._task_records_cap:
+                    self._task_records.popitem(last=False)
+                self._task_records[rec["task_id"]] = rec
+        if log_lines:
+            try:
+                self.head.call(
+                    "worker_logs", self.node_id, pid, log_lines)
+            except Exception:
+                pass  # head restarting/unreachable: logs are best-effort
+        return True
+
+    def rpc_list_task_records(self, limit: int = 1000):
+        with self._lock:
+            return [dict(r) for r in list(self._task_records.values())[-limit:]]
 
     def _dispatch_loop(self):
         while not self._shutdown.is_set():
@@ -220,6 +280,7 @@ class NodeAgent:
             pool.release(demand)
             self._fail_task(spec, str(e))
             return
+        self._record_task(spec, "RUNNING")
         w.current_task = {
             "spec": spec, "pool": pool, "demand": demand, "released": False,
         }
@@ -305,6 +366,7 @@ class NodeAgent:
         from ray_tpu.core.object_ref import TaskError
         from ray_tpu.core import serialization as ser
 
+        self._record_task(spec, "FAILED")
         self._end_borrows(spec)
         err = TaskError(spec.get("fname", "task"), reason, reason)
         meta, chunks = ser.serialize(err)

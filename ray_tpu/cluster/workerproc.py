@@ -16,13 +16,44 @@ a full ClusterBackend as the process-wide backend.
 from __future__ import annotations
 
 import argparse
+import io
+import os
 import queue
+import sys
 import threading
+import time
 import traceback
 
 from ray_tpu.cluster.rpc import RpcClient, RpcServer
 from ray_tpu.core import serialization as ser
 from ray_tpu.core.object_ref import ActorError, ObjectRef, TaskError
+
+
+class _TeeStream(io.TextIOBase):
+    """Write-through stdout/stderr wrapper that also line-buffers into a
+    shared list for the log forwarder (reference: per-worker log files
+    tailed by ``_private/log_monitor.py`` and pushed to the driver)."""
+
+    def __init__(self, inner, sink: list, lock: threading.Lock):
+        self._inner = inner
+        self._sink = sink
+        self._lock = lock
+        self._partial = ""
+
+    def write(self, s):
+        self._inner.write(s)
+        self._partial += s
+        if "\n" in self._partial:
+            *lines, self._partial = self._partial.split("\n")
+            with self._lock:
+                self._sink.extend(lines)
+        return len(s)
+
+    def flush(self):
+        self._inner.flush()
+
+    def isatty(self):
+        return False
 
 
 class WorkerHandler:
@@ -46,7 +77,57 @@ class WorkerHandler:
         self._actor_instance = None
         self._actor_dead_cause: str | None = None
         self._actor_id: str | None = None
+        # Observability buffers, shipped to the agent in batches by the
+        # event flusher (keeps the task hot path free of extra RPCs).
+        self._ev_lock = threading.Lock()
+        self._log_lines: list = []
+        self._task_events: list = []
+        sys.stdout = _TeeStream(sys.stdout, self._log_lines, self._ev_lock)
+        sys.stderr = _TeeStream(sys.stderr, self._log_lines, self._ev_lock)
+        threading.Thread(target=self._event_flush_loop, daemon=True).start()
         threading.Thread(target=self._exec_loop, daemon=True).start()
+
+    # -- observability -----------------------------------------------------
+
+    def _record(self, spec, kind: str):
+        rec = {
+            "task_id": spec.get("task_id") or spec.get("oids", ["?"])[0],
+            "name": spec.get("fname") or spec.get("method")
+            or spec.get("class_name", "task"),
+            "type": kind,
+            "state": "RUNNING",
+            "submitted_at": spec.get("submitted_at"),
+            "start_time": time.time(),
+            "end_time": None,
+            "error": None,
+        }
+        return rec
+
+    def _finish(self, rec, error: str | None):
+        rec["state"] = "FAILED" if error else "FINISHED"
+        rec["end_time"] = time.time()
+        rec["error"] = error
+        with self._ev_lock:
+            self._task_events.append(rec)
+
+    def _event_flush_loop(self):
+        pid = os.getpid()
+        while True:
+            time.sleep(0.25)
+            with self._ev_lock:
+                # Drain in place: the tee streams hold a reference to
+                # THESE list objects — rebinding would orphan them.
+                lines = self._log_lines[:]
+                del self._log_lines[:]
+                events = self._task_events[:]
+                del self._task_events[:]
+            if not lines and not events:
+                continue
+            try:
+                self.agent.call(
+                    "worker_events", self.worker_id, pid, events, lines)
+            except Exception:
+                pass
 
     # -- rpc surface (called by agent and by remote callers) ---------------
 
@@ -126,6 +207,8 @@ class WorkerHandler:
         # Only plain tasks hold a per-task lease worth releasing while
         # blocked; actor lifetime resources stay held (reference semantics).
         self.backend._block_hooks = self._hooks
+        rec = self._record(spec, "NORMAL_TASK")
+        err = None
         try:
             func = ser.loads(spec["func"])
             args, kwargs = ser.loads(spec["args"])
@@ -133,6 +216,7 @@ class WorkerHandler:
             result = func(*args, **kwargs)
             self._store_result(spec, result)
         except BaseException as e:  # noqa: BLE001 — stored, not dropped
+            err = repr(e)
             if isinstance(e, (TaskError, ActorError)):
                 self._store_error(spec, e)
             else:
@@ -145,14 +229,18 @@ class WorkerHandler:
         finally:
             self.backend._block_hooks = None
             self._end_borrows(spec)
+            self._finish(rec, err)
 
     def _run_actor_ctor(self, spec):
+        rec = self._record(spec, "ACTOR_CREATION_TASK")
+        err = None
         try:
             cls = ser.loads(spec["func"])
             args, kwargs = ser.loads(spec["args"])
             args, kwargs = self._resolve(args, kwargs)
             self._actor_instance = cls(*args, **kwargs)
-        except BaseException:  # noqa: BLE001
+        except BaseException as e:  # noqa: BLE001
+            err = repr(e)
             self._actor_dead_cause = traceback.format_exc()
             try:
                 self.agent.call(
@@ -162,8 +250,11 @@ class WorkerHandler:
                 pass
         finally:
             self._end_borrows(spec)
+            self._finish(rec, err)
 
     def _run_actor_task(self, spec):
+        rec = self._record(spec, "ACTOR_TASK")
+        err = None
         try:
             if self._actor_instance is None:
                 raise ActorError(
@@ -175,6 +266,7 @@ class WorkerHandler:
             result = method(*args, **kwargs)
             self._store_result(spec, result)
         except BaseException as e:  # noqa: BLE001
+            err = repr(e)
             if isinstance(e, (TaskError, ActorError)):
                 self._store_error(spec, e)
             else:
@@ -188,6 +280,7 @@ class WorkerHandler:
                 )
         finally:
             self._end_borrows(spec)
+            self._finish(rec, err)
 
 
 def main():

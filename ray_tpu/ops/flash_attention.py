@@ -464,7 +464,7 @@ def flash_causal_attention(
 
 def _fit_block(requested: int, t: int) -> int:
     """Largest divisor of t that is <= requested (so any T works, e.g.
-    T=1536 -> 512 with the 1024 default). Degenerate T whose largest
+    T=1536 -> 768 with the 1024 default). Degenerate T whose largest
     usable divisor is < 8 (primes etc.) can't tile the TPU lane layout —
     raise so `causal_attention`'s auto path falls back to XLA attention."""
     block = min(requested, t)
