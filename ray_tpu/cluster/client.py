@@ -640,6 +640,10 @@ class ClusterBackend:
             "demand": demand_of(options, is_actor=True),
             "sinfo": self._strategy_info(options),
             "retries_left": 0,
+            # >1 = threaded actor: methods run on a pool of this many
+            # executor threads (reference threaded-actor semantics; call
+            # ordering is relaxed).
+            "max_concurrency": int(max_concurrency),
         }
         spec["pg_id"] = spec["sinfo"]["pg_id"]
         spec["bundle_index"] = spec["sinfo"]["bundle_index"]

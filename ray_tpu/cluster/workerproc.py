@@ -77,6 +77,10 @@ class WorkerHandler:
         self._actor_instance = None
         self._actor_dead_cause: str | None = None
         self._actor_id: str | None = None
+        # Threaded actors (max_concurrency > 1): method calls may not run
+        # before the constructor finishes, and extra executor threads are
+        # only spawned after it (so the ctor itself is never raced).
+        self._actor_ready = threading.Event()
         # Observability buffers, shipped to the agent in batches by the
         # event flusher (keeps the task hot path free of extra RPCs).
         self._ev_lock = threading.Lock()
@@ -251,8 +255,12 @@ class WorkerHandler:
         finally:
             self._end_borrows(spec)
             self._finish(rec, err)
+            self._actor_ready.set()
+            for _ in range(int(spec.get("max_concurrency", 1)) - 1):
+                threading.Thread(target=self._exec_loop, daemon=True).start()
 
     def _run_actor_task(self, spec):
+        self._actor_ready.wait(timeout=300.0)
         rec = self._record(spec, "ACTOR_TASK")
         err = None
         try:

@@ -33,6 +33,10 @@ class Deployment:
     version: Optional[str] = None
     user_config: Optional[dict] = None
     ray_actor_options: Optional[dict] = None
+    # Queue-depth autoscaling (reference autoscaling_policy.py): keys
+    # min_replicas, max_replicas, target_ongoing_requests,
+    # downscale_delay_s. None = fixed num_replicas.
+    autoscaling_config: Optional[dict] = None
     init_args: tuple = ()
     init_kwargs: dict = field(default_factory=dict)
 
@@ -60,7 +64,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                route_prefix: Optional[str] = None,
                version: Optional[str] = None,
                user_config: Optional[dict] = None,
-               ray_actor_options: Optional[dict] = None):
+               ray_actor_options: Optional[dict] = None,
+               autoscaling_config: Optional[dict] = None):
     """``@serve.deployment`` decorator (``python/ray/serve/api.py``)."""
 
     def wrap(target):
@@ -73,6 +78,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             version=version,
             user_config=user_config,
             ray_actor_options=ray_actor_options,
+            autoscaling_config=autoscaling_config,
         )
 
     if _func_or_class is not None:
@@ -99,6 +105,7 @@ def run(target: "Application | Deployment", *, name: Optional[str] = None,
             route_prefix if route_prefix is not None else dep.route_prefix,
             dep.version,
             dep.ray_actor_options,
+            dep.autoscaling_config,
         ),
         timeout=120,
     )
@@ -138,6 +145,9 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> int:
 
 def shutdown() -> None:
     global _proxy_handle
+    from ray_tpu.serve import _private as _serve_private
+
+    _serve_private.reset_routers()
     if _proxy_handle is not None:
         try:
             ray_tpu.get(_proxy_handle.stop.remote(), timeout=10)

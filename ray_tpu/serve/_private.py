@@ -2,21 +2,26 @@
 
 Reference parity (SURVEY.md §3.5):
   * control plane — detached ``ServeController`` actor reconciling
-    deployment goal states into replica actors with rolling updates
-    (``serve/controller.py:61``, ``_private/deployment_state.py:958``);
+    deployment goal states into replica actors: rolling updates, dead
+    replicas replaced, queue-depth autoscaling between min/max replicas
+    (``serve/controller.py:61``, ``_private/deployment_state.py:958``,
+    ``_private/autoscaling_policy.py``);
+  * config fanout — routers/handles hold a blocking ``listen_for_change``
+    long-poll on the controller and are PUSHED new routing tables the
+    moment the version bumps — no polling sleeps on the request path
+    (``_private/long_poll.py:68,185``);
   * data plane — ``Router`` with power-of-two-choices replica selection
     bounded by ``max_concurrent_queries`` (``_private/router.py:221,261``),
     replicas executing ``handle_request`` (``_private/replica.py:174``);
-  * config fanout — handles refresh their replica view from the
-    controller on a version change (the long-poll analog,
-    ``_private/long_poll.py``);
-  * HTTP ingress — a proxy actor running a threaded HTTP server that
-    routes by prefix (``_private/http_proxy.py:312``);
+  * HTTP ingress — an asyncio server speaking an ASGI-style app interface,
+    routing by longest path prefix (``_private/http_proxy.py:218``);
   * ``@serve.batch`` dynamic batching (``serve/batching.py``).
 """
 
 from __future__ import annotations
 
+import asyncio
+import math
 import random
 import threading
 import time
@@ -25,6 +30,10 @@ from typing import Any, Dict, List, Optional
 import ray_tpu
 
 CONTROLLER_NAME = "ray_tpu.serve.controller"
+# One reconcile pass every interval: health checks, autoscale decisions,
+# replica replacement.
+RECONCILE_INTERVAL_S = 0.25
+LONG_POLL_TIMEOUT_S = 10.0
 
 
 # -- replica ---------------------------------------------------------------
@@ -70,88 +79,221 @@ class Replica:
 
 
 class ServeController:
-    """Detached actor: goal-state reconciliation for all deployments."""
+    """Detached actor: goal-state reconciliation for all deployments.
+
+    A background loop (``DeploymentState.update`` analog) continuously:
+      * health-checks replicas and REPLACES dead ones,
+      * applies queue-depth autoscaling between min/max replicas,
+      * pushes any change to long-polling routers via ``listen_for_change``.
+    """
 
     def __init__(self):
-        # name -> {"deployment": info dict, "replicas": [handles],
-        #          "version": int}
+        # name -> {"replicas": [handles], goal state, autoscaling state}
         self.apps: Dict[str, dict] = {}
         self.config_version = 0
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._stop = False
+        threading.Thread(target=self._reconcile_loop, daemon=True).start()
+
+    # -- goal-state writes --------------------------------------------------
 
     def deploy(self, name: str, cls_or_fn, init_args, init_kwargs,
                num_replicas: int, max_concurrent_queries: int,
                route_prefix: Optional[str], version: Optional[str],
-               ray_actor_options: Optional[dict]):
-        """Create/update a deployment; rolling replace on version change."""
-        existing = self.apps.get(name)
-        replica_cls = ray_tpu.remote(Replica)
-        opts = dict(ray_actor_options or {})
-        opts.setdefault("num_cpus", 0)
-        opts["max_concurrency"] = max(2, max_concurrent_queries)
-
-        new_replicas = []
-        for _ in range(num_replicas):
-            new_replicas.append(
-                replica_cls.options(**opts).remote(
-                    cls_or_fn, init_args, init_kwargs
-                )
-            )
-        # Verify the first replica constructed (fail fast on bad ctor).
-        ray_tpu.get(new_replicas[0].check_health.remote(), timeout=60)
-
-        old = existing["replicas"] if existing else []
-        self.apps[name] = {
+               ray_actor_options: Optional[dict],
+               autoscaling_config: Optional[dict] = None):
+        """Create/update a deployment; rolling replace on redeploy."""
+        auto = None
+        if autoscaling_config is not None:
+            auto = {
+                "min_replicas": 1,
+                "max_replicas": 8,
+                "target_ongoing_requests": 2.0,
+                "downscale_delay_s": 5.0,
+                **autoscaling_config,
+            }
+            num_replicas = max(num_replicas, auto["min_replicas"])
+        app = {
             "name": name,
             "route_prefix": route_prefix,
-            "num_replicas": num_replicas,
+            "num_replicas": num_replicas,  # current target
             "max_concurrent_queries": max_concurrent_queries,
             "version": version or "1",
-            "replicas": new_replicas,
+            "replicas": [],
+            # Creation recipe — the reconcile loop uses it to start
+            # replacement/scale-up replicas at any later time.
+            "factory": (cls_or_fn, init_args, init_kwargs,
+                        dict(ray_actor_options or {}), max_concurrent_queries),
+            "autoscaling": auto,
+            "last_high_demand_ts": time.monotonic(),
         }
-        self.config_version += 1
+        new_replicas = [self._start_replica(app) for _ in range(num_replicas)]
+        # Verify the first replica constructed (fail fast on bad ctor).
+        ray_tpu.get(new_replicas[0].check_health.remote(), timeout=60)
+        app["replicas"] = new_replicas
+
+        with self._lock:
+            existing = self.apps.get(name)
+            old = existing["replicas"] if existing else []
+            self.apps[name] = app
+            self._bump_locked()
         # Rolling replace: retire old replicas after the new set is live.
         for r in old:
-            try:
-                ray_tpu.kill(r)
-            except Exception:
-                pass
+            self._kill_replica(r)
         return self.config_version
 
+    def _start_replica(self, app: dict):
+        cls_or_fn, init_args, init_kwargs, opts, max_q = app["factory"]
+        replica_cls = ray_tpu.remote(Replica)
+        opts = dict(opts)
+        opts.setdefault("num_cpus", 0)
+        # +1 thread of headroom so controller health probes are never
+        # starved behind a fully saturated request queue.
+        opts["max_concurrency"] = max(2, max_q) + 1
+        return replica_cls.options(**opts).remote(
+            cls_or_fn, init_args, init_kwargs
+        )
+
+    @staticmethod
+    def _kill_replica(handle):
+        try:
+            ray_tpu.kill(handle)
+        except Exception:
+            pass
+
     def delete_deployment(self, name: str):
-        app = self.apps.pop(name, None)
+        with self._lock:
+            app = self.apps.pop(name, None)
+            if app:
+                self._bump_locked()
         if app:
             for r in app["replicas"]:
-                try:
-                    ray_tpu.kill(r)
-                except Exception:
-                    pass
-            self.config_version += 1
+                self._kill_replica(r)
         return True
+
+    def _bump_locked(self):
+        self.config_version += 1
+        self._cv.notify_all()
+
+    # -- reconcile loop ------------------------------------------------------
+
+    def _reconcile_loop(self):
+        while not self._stop:
+            time.sleep(RECONCILE_INTERVAL_S)
+            try:
+                self._reconcile_once()
+            except Exception:
+                pass  # next tick retries; the loop must never die
+
+    def _reconcile_once(self):
+        with self._lock:
+            apps = list(self.apps.values())
+        for app in apps:
+            # 1. Probe replicas: liveness + in-flight depth in one call.
+            #    All probes share one time budget so a single wedged
+            #    replica can't stall repair of the others for 10s each.
+            probes = [(r, r.get_num_ongoing.remote()) for r in app["replicas"]]
+            deadline = time.monotonic() + 10.0
+            alive, ongoing = [], []
+            for r, ref in probes:
+                try:
+                    tmo = max(0.5, deadline - time.monotonic())
+                    ongoing.append(float(ray_tpu.get(ref, timeout=tmo)))
+                    alive.append(r)
+                except Exception:
+                    self._kill_replica(r)  # dead or wedged: replace it
+            changed = len(alive) != len(app["replicas"])
+
+            # 2. Autoscale: desired = ceil(total in-flight / target),
+            #    clamped to [min, max]; downscale only after a sustained
+            #    quiet period (autoscaling_policy.py behavior). Replicas
+            #    can never carry more than max_concurrent_queries, so the
+            #    effective per-replica target is capped there — and a
+            #    fully saturated fleet scales up even though the queued
+            #    demand behind the router cap is invisible to replicas.
+            target = app["num_replicas"]
+            auto = app["autoscaling"]
+            if auto is not None:
+                max_q = app["max_concurrent_queries"]
+                eff_target = max(
+                    1e-9, min(auto["target_ongoing_requests"], max_q))
+                desired = math.ceil(sum(ongoing) / eff_target)
+                if alive and all(o >= max_q for o in ongoing):
+                    desired = max(desired, len(alive) + 1)
+                desired = max(auto["min_replicas"],
+                              min(auto["max_replicas"], desired))
+                now = time.monotonic()
+                if desired >= target:
+                    app["last_high_demand_ts"] = now
+                    target = desired
+                elif now - app["last_high_demand_ts"] \
+                        >= auto["downscale_delay_s"]:
+                    target = desired
+                app["num_replicas"] = target
+
+            # 3. Converge replica count toward the target.
+            started = []
+            while len(alive) + len(started) < target:
+                started.append(self._start_replica(app))
+                changed = True
+            while len(alive) > target:
+                self._kill_replica(alive.pop())
+                changed = True
+            alive.extend(started)
+
+            if changed:
+                published = False
+                with self._lock:
+                    if self.apps.get(app["name"]) is app:
+                        app["replicas"] = alive
+                        self._bump_locked()
+                        published = True
+                if not published:
+                    # Raced a redeploy/delete: this app dict is stale and
+                    # replicas started for it would leak forever.
+                    for r in started:
+                        self._kill_replica(r)
+
+    # -- config plane ---------------------------------------------------------
 
     def get_routing_table(self):
         """(version, {name: {replicas, max_concurrent_queries,
         route_prefix}}) for handles + proxies."""
-        table = {
-            name: {
-                "replicas": app["replicas"],
-                "max_concurrent_queries": app["max_concurrent_queries"],
-                "route_prefix": app["route_prefix"],
+        with self._lock:
+            table = {
+                name: {
+                    "replicas": list(app["replicas"]),
+                    "max_concurrent_queries": app["max_concurrent_queries"],
+                    "route_prefix": app["route_prefix"],
+                }
+                for name, app in self.apps.items()
             }
-            for name, app in self.apps.items()
-        }
-        return self.config_version, table
+            return self.config_version, table
+
+    def listen_for_change(self, cur_version: int,
+                          timeout: float = LONG_POLL_TIMEOUT_S):
+        """Long-poll: block until config_version > cur_version (or
+        timeout), then return the fresh routing table — config is PUSHED
+        to routers, never polled per-request (long_poll.py:68,185)."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self.config_version > cur_version, timeout)
+        return self.get_routing_table()
 
     def status(self):
-        return {
-            name: {
-                "num_replicas": app["num_replicas"],
-                "version": app["version"],
-                "route_prefix": app["route_prefix"],
+        with self._lock:
+            return {
+                name: {
+                    "num_replicas": app["num_replicas"],
+                    "version": app["version"],
+                    "route_prefix": app["route_prefix"],
+                }
+                for name, app in self.apps.items()
             }
-            for name, app in self.apps.items()
-        }
 
     def shutdown_all(self):
+        self._stop = True
         for name in list(self.apps):
             self.delete_deployment(name)
         return True
@@ -165,7 +307,7 @@ def get_or_create_controller():
     controller_cls = ray_tpu.remote(ServeController)
     try:
         handle = controller_cls.options(
-            name=CONTROLLER_NAME, num_cpus=0, max_concurrency=8
+            name=CONTROLLER_NAME, num_cpus=0, max_concurrency=64
         ).remote()
         ray_tpu.get(handle.status.remote(), timeout=30)
         return handle
@@ -176,67 +318,127 @@ def get_or_create_controller():
 # -- router / handle --------------------------------------------------------
 
 
+class _TableListener:
+    """Shared long-poll client: a daemon thread blocks in the controller's
+    ``listen_for_change`` and invokes ``apply_fn(version, table)`` on every
+    push (used by Router and the HTTP proxy; long_poll.py:68 analog)."""
+
+    def __init__(self, controller, apply_fn, current_version):
+        self.controller = controller
+        self._apply_fn = apply_fn
+        self._current_version = current_version
+        self.stopped = False
+        self._apply_fn(*ray_tpu.get(
+            controller.get_routing_table.remote(), timeout=30))
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def refresh(self):
+        """Synchronous out-of-band fetch (error-retry path)."""
+        try:
+            self._apply_fn(*ray_tpu.get(
+                self.controller.get_routing_table.remote(), timeout=30))
+        except Exception:
+            pass
+
+    def _loop(self):
+        while not self.stopped:
+            try:
+                version, table = ray_tpu.get(
+                    self.controller.listen_for_change.remote(
+                        self._current_version()),
+                    timeout=LONG_POLL_TIMEOUT_S + 30,
+                )
+                self._apply_fn(version, table)
+            except Exception:
+                if self.stopped:
+                    return
+                time.sleep(0.5)  # controller restarting; retry
+
+
 class Router:
     """Power-of-two-choices replica selection with per-replica in-flight
-    caps (client-side view of max_concurrent_queries)."""
+    caps (client-side view of max_concurrent_queries).
 
-    def __init__(self, controller, deployment_name: str,
-                 refresh_interval: float = 0.5):
+    Routing-table updates are PUSHED via a ``_TableListener`` long-poll —
+    ``assign`` never talks to the controller."""
+
+    def __init__(self, controller, deployment_name: str):
         self.controller = controller
         self.name = deployment_name
-        self.refresh_interval = refresh_interval
         self._version = -1
         self._replicas: List = []
         self._max_q = 100
-        self._inflight: Dict[int, int] = {}
+        # in-flight keyed by actor id so counts survive table swaps.
+        self._inflight: Dict[str, int] = {}
         self._lock = threading.Lock()
-        self._last_refresh = 0.0
-        self._refresh(force=True)
-
-    def _refresh(self, force: bool = False):
-        now = time.monotonic()
-        if not force and now - self._last_refresh < self.refresh_interval:
-            return
-        self._last_refresh = now
-        version, table = ray_tpu.get(
-            self.controller.get_routing_table.remote(), timeout=30
-        )
-        entry = table.get(self.name)
-        if entry is None:
+        self._known_name = False
+        self._listener = _TableListener(
+            controller, self._apply, lambda: self._version)
+        if not self._known_name:
+            self._listener.stopped = True
             raise ValueError(f"no deployment named {self.name!r}")
-        if version != self._version:
-            with self._lock:
-                self._version = version
-                self._replicas = list(entry["replicas"])
-                self._max_q = entry["max_concurrent_queries"]
-                self._inflight = {i: 0 for i in range(len(self._replicas))}
 
-    def assign(self):
-        """Pick a replica index (blocks while all are saturated)."""
+    @property
+    def _stopped(self):
+        return self._listener.stopped
+
+    @_stopped.setter
+    def _stopped(self, value):
+        self._listener.stopped = value
+
+    def _apply(self, version: int, table: dict):
+        entry = table.get(self.name)
+        self._known_name = entry is not None
+        with self._lock:
+            if version <= self._version:
+                return
+            self._version = version
+            if entry is None:
+                self._replicas = []
+                return
+            self._replicas = list(entry["replicas"])
+            self._max_q = entry["max_concurrent_queries"]
+            live = {r._actor_id for r in self._replicas}
+            self._inflight = {
+                aid: n for aid, n in self._inflight.items() if aid in live
+            }
+
+    def refresh(self):
+        self._listener.refresh()
+
+    def assign(self, exclude: Optional[set] = None):
+        """Pick a replica, skipping ``exclude``d actor ids (known-dead from
+        a failed attempt). Blocks while all candidates are saturated."""
         deadline = time.monotonic() + 60.0
         while True:
-            self._refresh()
             with self._lock:
-                n = len(self._replicas)
+                pool = self._replicas
+                if exclude:
+                    filtered = [r for r in pool
+                                if r._actor_id not in exclude]
+                    # All known-dead: fall back to the full set and let the
+                    # retry loop wait for the controller's replacement.
+                    pool = filtered or pool
+                n = len(pool)
                 if n:
-                    if n == 1:
-                        cands = [0]
-                    else:
-                        cands = random.sample(range(n), 2)
-                    best = min(cands, key=lambda i: self._inflight.get(i, 0))
-                    if self._inflight.get(best, 0) < self._max_q:
-                        self._inflight[best] = self._inflight.get(best, 0) + 1
-                        return best, self._replicas[best]
+                    cands = [pool[0]] if n == 1 else random.sample(pool, 2)
+                    best = min(
+                        cands,
+                        key=lambda r: self._inflight.get(r._actor_id, 0))
+                    aid = best._actor_id
+                    if self._inflight.get(aid, 0) < self._max_q:
+                        self._inflight[aid] = self._inflight.get(aid, 0) + 1
+                        return aid, best
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"no replica of {self.name!r} available (backpressure)"
                 )
             time.sleep(0.002)
 
-    def complete(self, idx: int):
+    def complete(self, aid: str):
         with self._lock:
-            if idx in self._inflight and self._inflight[idx] > 0:
-                self._inflight[idx] -= 1
+            if self._inflight.get(aid, 0) > 0:
+                self._inflight[aid] -= 1
 
 
 # Per-process router cache, shared by handles and proxies.
@@ -245,11 +447,27 @@ _routers_lock = threading.Lock()
 
 
 def _router_for(name: str) -> Router:
+    controller = get_or_create_controller()
     with _routers_lock:
         router = _routers.get(name)
+        # A cached router from before a serve restart points at a dead
+        # controller (worker processes outlive serve.shutdown() and never
+        # see reset_routers) — rebuild when the controller changed.
+        if router is not None and \
+                router.controller._actor_id != controller._actor_id:
+            router._stopped = True
+            router = None
         if router is None:
-            router = _routers[name] = Router(get_or_create_controller(), name)
+            router = _routers[name] = Router(controller, name)
         return router
+
+
+def reset_routers() -> None:
+    """Stop long-poll threads and drop cached routers (serve.shutdown)."""
+    with _routers_lock:
+        for r in _routers.values():
+            r._stopped = True
+        _routers.clear()
 
 
 def routed_call(deployment_name: str, method: str, args: tuple, kwargs: dict):
@@ -260,8 +478,9 @@ def routed_call(deployment_name: str, method: str, args: tuple, kwargs: dict):
 
     router = _router_for(deployment_name)
     last_err = None
-    for _ in range(4):
-        idx, replica = router.assign()
+    dead: set = set()
+    for attempt in range(4):
+        aid, replica = router.assign(exclude=dead)
         try:
             return ray_tpu.get(
                 replica.handle_request.remote(method, args, kwargs),
@@ -269,10 +488,14 @@ def routed_call(deployment_name: str, method: str, args: tuple, kwargs: dict):
             )
         except ActorError as e:
             last_err = e
-            router._refresh(force=True)
+            dead.add(aid)
+            # Back off so the controller's reconcile tick (0.25s) can
+            # replace the dead replica before we run out of attempts.
+            time.sleep(0.2 * (attempt + 1))
+            router.refresh()
             continue
         finally:
-            router.complete(idx)
+            router.complete(aid)
     raise last_err
 
 
@@ -302,66 +525,175 @@ class DeploymentHandle:
 # -- HTTP proxy -------------------------------------------------------------
 
 
+_REASONS = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}
+
+
+def make_asgi_app():
+    """The proxy's ASGI application: routes by longest matching
+    ``route_prefix`` from the (long-poll-pushed) routing table, decodes a
+    JSON body, and dispatches through the shared Router. The blocking
+    replica RPC runs in a thread pool so the event loop keeps accepting
+    connections (http_proxy.py:218 uvicorn/ASGI analog)."""
+    import asyncio
+    import json as _json
+    from concurrent.futures import ThreadPoolExecutor
+
+    controller = get_or_create_controller()
+    pool = ThreadPoolExecutor(max_workers=32)
+    state = {"version": -1, "routes": []}  # [(prefix, name)]
+    state_lock = threading.Lock()
+
+    def apply_table(version, table):
+        routes = sorted(
+            ((e["route_prefix"], name) for name, e in table.items()
+             if e.get("route_prefix")),
+            key=lambda p: -len(p[0]),
+        )
+        with state_lock:
+            if version > state["version"]:
+                state["version"] = version
+                state["routes"] = routes
+
+    _TableListener(controller, apply_table, lambda: state["version"])
+
+    def resolve(path: str):
+        with state_lock:
+            for prefix, name in state["routes"]:
+                if path.startswith(prefix):
+                    return name
+        return None
+
+    async def app(scope, receive, send):
+        assert scope["type"] == "http"
+        body = b""
+        while True:
+            event = await receive()
+            body += event.get("body", b"")
+            if not event.get("more_body"):
+                break
+
+        async def reply(status: int, payload):
+            blob = _json.dumps(payload).encode()
+            await send({
+                "type": "http.response.start",
+                "status": status,
+                "headers": [(b"content-type", b"application/json"),
+                            (b"content-length",
+                             str(len(blob)).encode())],
+            })
+            await send({"type": "http.response.body", "body": blob})
+
+        name = resolve(scope["path"])
+        if name is None:
+            await reply(404, {"error": f"no route for {scope['path']}"})
+            return
+        try:
+            payload = _json.loads(body) if body else None
+            loop = asyncio.get_running_loop()
+            result = await loop.run_in_executor(
+                pool, routed_call, name, "__call__", (payload,), {})
+            await reply(200, result)
+        except Exception as e:  # noqa: BLE001 — HTTP boundary
+            await reply(500, {"error": repr(e)})
+
+    return app
+
+
 class HTTPProxy:
-    """Actor hosting a threaded HTTP server; routes by path prefix."""
+    """Actor hosting an asyncio HTTP/1.1 server that drives the ASGI app
+    above — connections multiplex on one event loop; only replica RPCs
+    occupy pool threads."""
 
     def __init__(self, host: str, port: int):
-        import http.server
-        import json as _json
+        import asyncio
 
-        controller = get_or_create_controller()
+        self._app = make_asgi_app()
+        self._loop = asyncio.new_event_loop()
+        started = threading.Event()
+        holder: dict = {}
 
-        def resolve(path: str):
-            _, table = ray_tpu.get(
-                controller.get_routing_table.remote(), timeout=30
-            )
-            best_name, best_prefix = None, ""
-            for name, entry in table.items():
-                prefix = entry.get("route_prefix")
-                if prefix and path.startswith(prefix) and len(prefix) > len(best_prefix):
-                    best_name, best_prefix = name, prefix
-            return best_name
+        def run_loop():
+            asyncio.set_event_loop(self._loop)
 
-        class Handler(http.server.BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
+            async def boot():
+                server = await asyncio.start_server(
+                    self._handle_conn, host, port)
+                holder["port"] = server.sockets[0].getsockname()[1]
+                holder["server"] = server
+                started.set()
 
-            def _serve(self):
-                try:
-                    name = resolve(self.path)
-                    if name is None:
-                        self._reply(404, {"error": f"no route for {self.path}"})
-                        return
-                    length = int(self.headers.get("Content-Length") or 0)
-                    body = self.rfile.read(length) if length else b""
-                    payload = _json.loads(body) if body else None
-                    result = routed_call(name, "__call__", (payload,), {})
-                    self._reply(200, result)
-                except Exception as e:  # noqa: BLE001 — HTTP boundary
-                    self._reply(500, {"error": repr(e)})
+            self._loop.run_until_complete(boot())
+            self._loop.run_forever()
 
-            def _reply(self, code: int, payload):
-                blob = _json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(blob)))
-                self.end_headers()
-                self.wfile.write(blob)
+        threading.Thread(target=run_loop, daemon=True).start()
+        if not started.wait(30):
+            raise RuntimeError("HTTP proxy failed to start")
+        self.port = holder["port"]
+        self._server = holder["server"]
 
-            do_GET = _serve
-            do_POST = _serve
+    async def _handle_conn(self, reader, writer):
+        try:
+            while True:
+                request_line = await reader.readline()
+                if not request_line or request_line == b"\r\n":
+                    break
+                method, path, _ = request_line.decode().split(" ", 2)
+                headers = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"", b"\n"):
+                        break
+                    k, _, v = line.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                length = int(headers.get("content-length") or 0)
+                body = await reader.readexactly(length) if length else b""
 
-            def log_message(self, *a):
+                scope = {
+                    "type": "http",
+                    "method": method,
+                    "path": path.split("?")[0],
+                    "headers": [(k.encode(), v.encode())
+                                for k, v in headers.items()],
+                }
+                received = {"done": False}
+
+                async def receive():
+                    if received["done"]:
+                        return {"type": "http.disconnect"}
+                    received["done"] = True
+                    return {"type": "http.request", "body": body,
+                            "more_body": False}
+
+                async def send(event):
+                    if event["type"] == "http.response.start":
+                        status = event["status"]
+                        writer.write(
+                            f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}"
+                            "\r\n".encode())
+                        for k, v in event.get("headers", []):
+                            writer.write(k + b": " + v + b"\r\n")
+                        writer.write(b"\r\n")
+                    elif event["type"] == "http.response.body":
+                        writer.write(event.get("body", b""))
+                        await writer.drain()
+
+                await self._app(scope, receive, send)
+                if headers.get("connection", "").lower() == "close":
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError, ValueError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
                 pass
-
-        self.server = http.server.ThreadingHTTPServer((host, port), Handler)
-        self.port = self.server.server_address[1]
-        threading.Thread(target=self.server.serve_forever, daemon=True).start()
 
     def get_port(self) -> int:
         return self.port
 
     def stop(self):
-        self.server.shutdown()
+        self._loop.call_soon_threadsafe(self._server.close)
+        self._loop.call_soon_threadsafe(self._loop.stop)
         return True
 
 

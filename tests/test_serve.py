@@ -159,6 +159,130 @@ def test_http_proxy_routes_by_prefix():
     assert raised
 
 
+def test_dead_replica_replaced_by_controller():
+    """The controller's reconcile loop replaces a killed replica
+    (deployment_state.py:958 behavior)."""
+    from ray_tpu.serve import _private as sp
+
+    @serve.deployment(num_replicas=2)
+    class Sturdy:
+        def __call__(self, _):
+            return "ok"
+
+    serve.run(Sturdy.bind())
+    controller = sp.get_or_create_controller()
+    version, table = ray_tpu.get(controller.get_routing_table.remote(),
+                                 timeout=30)
+    replicas = table["Sturdy"]["replicas"]
+    assert len(replicas) == 2
+    dead_id = replicas[0]._actor_id
+    ray_tpu.kill(replicas[0])
+
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        _, table = ray_tpu.get(controller.get_routing_table.remote(),
+                               timeout=30)
+        ids = {r._actor_id for r in table["Sturdy"]["replicas"]}
+        if len(ids) == 2 and dead_id not in ids:
+            break
+        time.sleep(0.2)
+    ids = {r._actor_id for r in table["Sturdy"]["replicas"]}
+    assert len(ids) == 2 and dead_id not in ids
+    # And the deployment still serves.
+    handle = serve.get_deployment_handle("Sturdy")
+    assert ray_tpu.get(handle.remote(None), timeout=30) == "ok"
+
+
+def test_autoscaling_up_then_down():
+    """Queue-depth autoscaling: sustained load scales replicas up toward
+    max; idleness scales back to min after downscale_delay_s
+    (autoscaling_policy.py behavior)."""
+    from ray_tpu.serve import _private as sp
+
+    @serve.deployment(
+        max_concurrent_queries=4,
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_ongoing_requests": 1.0,
+            "downscale_delay_s": 1.0,
+        },
+    )
+    class Slow:
+        def __call__(self, _):
+            time.sleep(0.3)
+            return "done"
+
+    handle = serve.run(Slow.bind())
+    controller = sp.get_or_create_controller()
+    assert serve.status()["Slow"]["num_replicas"] == 1
+
+    # Offered load of ~8 concurrent requests against target 1/replica.
+    stop = time.monotonic() + 6.0
+    errors = []
+
+    def hammer():
+        while time.monotonic() < stop:
+            try:
+                ray_tpu.get(handle.remote(None), timeout=60)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    scaled_up = False
+    while time.monotonic() < stop:
+        if serve.status()["Slow"]["num_replicas"] >= 2:
+            scaled_up = True
+            break
+        time.sleep(0.2)
+    for t in threads:
+        t.join()
+    assert not errors, errors[:1]
+    assert scaled_up, "replicas never scaled up under load"
+
+    # Load gone: scale back down to min_replicas after the delay.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if serve.status()["Slow"]["num_replicas"] == 1:
+            break
+        time.sleep(0.2)
+    assert serve.status()["Slow"]["num_replicas"] == 1
+
+
+def test_config_pushed_without_requests():
+    """Routing-table updates reach routers via the controller long-poll —
+    with NO requests in flight to trigger a refresh (long_poll.py:68)."""
+    from ray_tpu.serve import _private as sp
+
+    @serve.deployment
+    class Versioned:
+        def __init__(self, v):
+            self.v = v
+
+        def __call__(self, _):
+            return self.v
+
+    handle = serve.run(Versioned.bind("v1"))
+    assert ray_tpu.get(handle.remote(None), timeout=30) == "v1"
+    router = sp._routers["Versioned"]
+    old_replicas = {r._actor_id for r in router._replicas}
+
+    serve.run(Versioned.options(version="2").bind("v2"))
+    # No requests from here on: the router's replica set must still swap.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if {r._actor_id for r in router._replicas} not in ({}, old_replicas) \
+                and router._replicas:
+            break
+        time.sleep(0.1)
+    new_replicas = {r._actor_id for r in router._replicas}
+    assert new_replicas and new_replicas != old_replicas
+    assert ray_tpu.get(handle.remote(None), timeout=30) == "v2"
+
+
 def test_jitted_inference_deployment(devices8):
     """TPU-shaped use: replica wraps a jitted forward fn."""
     import jax

@@ -1,0 +1,69 @@
+"""Serve over the multiprocess cluster backend: the controller and
+replicas are real worker processes, so the blocking ``listen_for_change``
+long-poll and concurrent replica queries require threaded actors
+(``max_concurrency`` > 1) in the worker runtime."""
+
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.cluster.cluster_utils import Cluster
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=8)
+    c.wait_for_nodes()
+    ray_tpu.init(c.address)
+    yield c
+    serve.shutdown()
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_serve_on_cluster_backend(cluster):
+    @serve.deployment(num_replicas=2, max_concurrent_queries=8)
+    class Echo:
+        def __init__(self):
+            import os
+            self.pid = os.getpid()
+
+        def __call__(self, x):
+            time.sleep(0.05)
+            return (self.pid, x)
+
+    handle = serve.run(Echo.bind())
+    # Concurrent requests through threaded replica actors.
+    refs = [handle.remote(i) for i in range(12)]
+    out = ray_tpu.get(refs, timeout=120)
+    assert sorted(x for _, x in out) == list(range(12))
+    pids = {p for p, _ in out}
+    assert len(pids) == 2  # both replica processes served
+
+    # Reconcile loop replaces a killed replica process.
+    from ray_tpu.serve import _private as sp
+
+    controller = sp.get_or_create_controller()
+    _, table = ray_tpu.get(controller.get_routing_table.remote(), timeout=30)
+    dead = table["Echo"]["replicas"][0]
+    dead_id = dead._actor_id
+    ray_tpu.kill(dead)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        _, table = ray_tpu.get(
+            controller.get_routing_table.remote(), timeout=30)
+        ids = {r._actor_id for r in table["Echo"]["replicas"]}
+        if len(ids) == 2 and dead_id not in ids:
+            break
+        time.sleep(0.3)
+    ids = {r._actor_id for r in table["Echo"]["replicas"]}
+    assert len(ids) == 2 and dead_id not in ids
+    assert ray_tpu.get(handle.remote(99), timeout=60)[1] == 99
