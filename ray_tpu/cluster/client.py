@@ -38,7 +38,9 @@ class ClusterBackend:
                  process_kind: str = "d"):
         import os
 
-        self.head = RpcClient(head_address)
+        # 15s reconnect window: a head restart (GCS FT) retries instead of
+        # failing in-flight location/ref/schedule calls.
+        self.head = RpcClient(head_address, reconnect_window=15.0)
         self.head_address = head_address
         self._agent_address = agent_address
         if node_id is None:

@@ -17,14 +17,46 @@ from ray_tpu.cluster.node_agent import NodeAgent
 
 
 class Cluster:
-    def __init__(self, initialize_head: bool = True, head_node_args: dict | None = None):
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: dict | None = None,
+                 persist_path: str | None = None):
         self.head: HeadServer | None = None
         self.nodes: list[NodeAgent] = []
         self.session = f"c{os.getpid()}_{os.urandom(3).hex()}"
+        self.persist_path = persist_path
         if initialize_head:
-            self.head = HeadServer()
+            self.head = HeadServer(persist_path=persist_path)
             if head_node_args is not None:
                 self.add_node(**head_node_args)
+
+    def kill_head(self) -> str:
+        """Crash the head ungracefully (no final snapshot/close): the GCS
+        fault-tolerance chaos path. Returns the address to restart on."""
+        assert self.head is not None
+        address = self.head.address
+        self.head._stop.set()
+        self.head._server.stop()
+        self.head = None
+        return address
+
+    def restart_head(self, address: str, timeout: float = 10.0) -> None:
+        """Start a fresh head on the SAME address, reloading state from
+        ``persist_path`` (gcs fault tolerance: agents keep heartbeating
+        through their reconnect window and resume against the new head).
+        The bind is retried briefly — sockets of the killed head can
+        linger for a moment."""
+        assert self.head is None and self.persist_path is not None
+        host, port = address.rsplit(":", 1)
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self.head = HeadServer(host, int(port),
+                                       persist_path=self.persist_path)
+                return
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
 
     @property
     def address(self) -> str:

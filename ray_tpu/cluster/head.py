@@ -27,6 +27,61 @@ from ray_tpu.core import ids
 DEAD_AFTER_S = 5.0  # heartbeat timeout (reference: num_heartbeats_timeout)
 
 
+class _PersistentStore:
+    """Write-through sqlite store behind the head tables (GCS fault
+    tolerance: ``store_client/redis_store_client.h:28`` role — here a
+    local file so the head can restart on the same address and reload,
+    ``gcs_init_data.h`` analog). Namespaced key -> pickled value."""
+
+    def __init__(self, path: str):
+        import sqlite3
+
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS t "
+            "(ns TEXT, k TEXT, v BLOB, PRIMARY KEY (ns, k))"
+        )
+        self._conn.commit()
+        self._mu = threading.Lock()
+
+    def put(self, ns: str, key: str, value) -> None:
+        import pickle
+
+        self.put_blob(ns, key, pickle.dumps(value, protocol=5))
+
+    def put_blob(self, ns: str, key: str, blob: bytes) -> None:
+        with self._mu:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO t (ns, k, v) VALUES (?, ?, ?)",
+                (ns, key, blob),
+            )
+            self._conn.commit()
+
+    def delete(self, ns: str, key: str) -> None:
+        with self._mu:
+            self._conn.execute(
+                "DELETE FROM t WHERE ns = ? AND k = ?", (ns, key))
+            self._conn.commit()
+
+    def load_ns(self, ns: str) -> dict:
+        import pickle
+
+        with self._mu:
+            rows = self._conn.execute(
+                "SELECT k, v FROM t WHERE ns = ?", (ns,)).fetchall()
+        return {k: pickle.loads(v) for k, v in rows}
+
+    def close(self) -> None:
+        with self._mu:
+            try:
+                self._conn.commit()
+                self._conn.close()
+            except Exception:
+                pass
+
+
 class NodeInfo:
     def __init__(self, node_id, address, resources, store_path):
         self.node_id = node_id
@@ -40,7 +95,9 @@ class NodeInfo:
 
 
 class HeadServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: str | None = None):
+        self._store = _PersistentStore(persist_path) if persist_path else None
         self._lock = threading.RLock()
         self._nodes: dict[str, NodeInfo] = {}
         self._kv: dict[str, Any] = {}
@@ -77,18 +134,95 @@ class HeadServer:
         # rpc_drain_logs with their last-seen seq).
         self._logs: "collections.deque[dict]" = collections.deque(maxlen=20_000)
         self._log_seq = 0
+        if self._store is not None:
+            self._load_persisted()
         self._server = RpcServer(self, host, port)
         self.address = self._server.address
         self._stop = threading.Event()
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
         self._monitor.start()
         threading.Thread(target=self._free_loop, daemon=True).start()
+        if self._store is not None:
+            threading.Thread(target=self._snapshot_loop, daemon=True).start()
+
+    # -- persistence ------------------------------------------------------
+
+    def _persist(self, ns: str, key: str, value) -> None:
+        if self._store is not None:
+            self._store.put(ns, key, value)
+
+    def _persist_del(self, ns: str, key: str) -> None:
+        if self._store is not None:
+            self._store.delete(ns, key)
+
+    def _load_persisted(self) -> None:
+        """Rebuild head tables after a restart (``gcs_init_data.h``).
+
+        Nodes come back provisionally alive — their agents kept running
+        and the next heartbeat (or the monitor's timeout) settles truth.
+        The ref table is deliberately NOT persisted: it is high-churn, and
+        an oid absent from it is conservatively kept (never freed), so a
+        restart degrades to no-GC for pre-restart objects instead of
+        premature frees.
+        """
+        for node_id, rec in self._store.load_ns("node").items():
+            info = NodeInfo(node_id, rec["address"], rec["resources"],
+                            rec["store_path"])
+            self._nodes[node_id] = info
+        self._kv.update(self._store.load_ns("kv"))
+        snap = self._store.load_ns("snap")
+        self._actors.update(snap.get("actors", {}))
+        for actor_id, rec in self._actors.items():
+            if rec.get("name") and rec.get("state") not in ("DEAD",):
+                self._named_actors[rec["name"]] = actor_id
+        self._actor_specs.update(snap.get("aspecs", {}))
+        self._pgs.update(snap.get("pgs", {}))
+        for oid, rec in snap.get("objects", {}).items():
+            self._objects[oid] = {
+                "nodes": set(rec["nodes"]),
+                "error": rec["error"],
+                "size": rec["size"],
+            }
+
+    def _snapshot_loop(self) -> None:
+        """Persist the high-churn tables (actors/specs/PGs/object
+        locations) every 200ms when they changed — content-compared so
+        idle clusters write nothing. Crash loss window <= one interval;
+        lost object locations heal through lineage re-execution."""
+        import pickle as _pickle
+
+        last: dict[str, bytes] = {}
+        while not self._stop.wait(0.2):
+            try:
+                with self._lock:
+                    snap = {
+                        "actors": {k: dict(v) for k, v in self._actors.items()},
+                        "aspecs": dict(self._actor_specs),
+                        "pgs": {k: dict(v) for k, v in self._pgs.items()},
+                        "objects": {
+                            oid: {"nodes": sorted(e["nodes"]),
+                                  "error": e["error"],
+                                  "size": e.get("size", 0)}
+                            for oid, e in self._objects.items()
+                        },
+                    }
+                for key, table in snap.items():
+                    blob = _pickle.dumps(table, protocol=5)
+                    if last.get(key) != blob:
+                        last[key] = blob
+                        self._store.put_blob("snap", key, blob)
+            except Exception:
+                continue  # next tick retries; persistence is best-effort
 
     # -- nodes ------------------------------------------------------------
 
     def rpc_register_node(self, node_id, address, resources, store_path):
         with self._lock:
             self._nodes[node_id] = NodeInfo(node_id, address, resources, store_path)
+        self._persist("node", node_id, {
+            "address": address, "resources": dict(resources),
+            "store_path": store_path,
+        })
         return {"head_time": time.time()}
 
     def rpc_heartbeat(self, node_id, available):
@@ -151,6 +285,7 @@ class HeadServer:
                 self._mark_dead(node_id, "heartbeat timeout")
 
     def _mark_dead(self, node_id: str, cause: str):
+        self._persist_del("node", node_id)
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
@@ -200,7 +335,8 @@ class HeadServer:
             if not overwrite and key in self._kv:
                 return False
             self._kv[key] = value
-            return True
+        self._persist("kv", key, value)
+        return True
 
     def rpc_kv_get(self, key):
         with self._lock:
@@ -208,7 +344,10 @@ class HeadServer:
 
     def rpc_kv_del(self, key):
         with self._lock:
-            return self._kv.pop(key, None) is not None
+            existed = self._kv.pop(key, None) is not None
+        if existed:
+            self._persist_del("kv", key)
+        return existed
 
     def rpc_kv_keys(self, prefix=""):
         with self._lock:
@@ -949,6 +1088,8 @@ class HeadServer:
         with self._free_cv:
             self._free_cv.notify_all()
         self._server.stop()
+        if self._store is not None:
+            self._store.close()
 
 
 def main():

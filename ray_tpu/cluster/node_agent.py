@@ -60,7 +60,9 @@ class NodeAgent:
     ):
         self.node_id = ids.new_node_id()
         self.head_address = head_address
-        self.head = RpcClient(head_address)
+        # Reconnect window so a restarting head (GCS FT) doesn't fail
+        # in-flight add_location/register calls from this agent.
+        self.head = RpcClient(head_address, reconnect_window=15.0)
         node_res = {"CPU": float(num_cpus if num_cpus is not None else os.cpu_count() or 8)}
         node_res.update(resources or {})
         self.pool = ResourcePool(node_res)

@@ -17,6 +17,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 import traceback
 from typing import Any, Callable
 
@@ -63,6 +64,8 @@ class RpcServer:
         self._sock.listen(512)
         self.address = f"{host}:{self._sock.getsockname()[1]}"
         self._stopped = threading.Event()
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
 
@@ -73,6 +76,13 @@ class RpcServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                if self._stopped.is_set():
+                    # Raced stop(): it already swept the set — this conn
+                    # must not outlive the server (head-restart correctness).
+                    conn.close()
+                    return
+                self._conns.add(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
@@ -95,6 +105,8 @@ class RpcServer:
         except (ConnectionLost, OSError):
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             conn.close()
 
     def stop(self):
@@ -103,16 +115,39 @@ class RpcServer:
             self._sock.close()
         except OSError:
             pass
+        # Drop established connections too: a stopped server must release
+        # the port fully (head restart binds the same address) and stop
+        # serving — peers reconnect to whoever binds it next.
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 class RpcClient:
     """Thread-safe client; one pooled connection per calling thread (so
     concurrent calls don't interleave frames, and per-thread call order is
-    preserved end-to-end)."""
+    preserved end-to-end).
 
-    def __init__(self, address: str, timeout: float = 60.0):
+    ``reconnect_window`` > 0 makes calls retry on connection loss for that
+    many seconds before failing — used for head clients so a head restart
+    (GCS fault tolerance) is invisible to agents/workers/drivers. Only
+    safe for idempotent calls (all head mutations are: tables are keyed by
+    caller-generated ids and writes are last-write-wins)."""
+
+    def __init__(self, address: str, timeout: float = 60.0,
+                 reconnect_window: float = 0.0):
         self.address = address
         self._timeout = timeout
+        self._reconnect_window = reconnect_window
         self._local = threading.local()
         self._closed = False
 
@@ -126,9 +161,30 @@ class RpcClient:
         return conn
 
     def call(self, method: str, *args, timeout: float | None = None, **kwargs):
+        deadline = (
+            time.monotonic() + self._reconnect_window
+            if self._reconnect_window > 0 else None
+        )
+        while True:
+            try:
+                return self._call_once(method, args, kwargs, timeout)
+            except ConnectionLost:
+                if (deadline is None or self._closed
+                        or time.monotonic() >= deadline):
+                    raise
+                time.sleep(0.3)
+
+    def _call_once(self, method: str, args, kwargs, timeout: float | None):
         if self._closed:
             raise ConnectionLost(f"client to {self.address} is closed")
-        conn = self._conn()
+        try:
+            # Connect inside the ConnectionLost mapping: a refused
+            # reconnect (server restarting) must feed the retry window,
+            # not escape it as a bare OSError.
+            conn = self._conn()
+        except OSError as e:
+            raise ConnectionLost(
+                f"connect to {self.address}: {e}") from e
         if timeout is not None:
             conn.settimeout(timeout)
         try:

@@ -129,8 +129,14 @@ class ServeController:
             "last_high_demand_ts": time.monotonic(),
         }
         new_replicas = [self._start_replica(app) for _ in range(num_replicas)]
-        # Verify the first replica constructed (fail fast on bad ctor).
-        ray_tpu.get(new_replicas[0].check_health.remote(), timeout=60)
+        # Verify the first replica constructed (fail fast on bad ctor) —
+        # and never leak the batch if it didn't.
+        try:
+            ray_tpu.get(new_replicas[0].check_health.remote(), timeout=60)
+        except Exception:
+            for r in new_replicas:
+                self._kill_replica(r)
+            raise
         app["replicas"] = new_replicas
 
         with self._lock:
@@ -196,13 +202,20 @@ class ServeController:
             probes = [(r, r.get_num_ongoing.remote()) for r in app["replicas"]]
             deadline = time.monotonic() + 10.0
             alive, ongoing = [], []
+            from ray_tpu.core.object_ref import ActorError
+
             for r, ref in probes:
                 try:
                     tmo = max(0.5, deadline - time.monotonic())
                     ongoing.append(float(ray_tpu.get(ref, timeout=tmo)))
                     alive.append(r)
+                except ActorError:
+                    self._kill_replica(r)  # actually dead: replace it
                 except Exception:
-                    self._kill_replica(r)  # dead or wedged: replace it
+                    # Slow/saturated, not dead (the probe merely queued
+                    # behind real requests): keep it, treat as fully busy.
+                    alive.append(r)
+                    ongoing.append(float(app["max_concurrent_queries"]))
             changed = len(alive) != len(app["replicas"])
 
             # 2. Autoscale: desired = ceil(total in-flight / target),
@@ -447,19 +460,26 @@ _routers_lock = threading.Lock()
 
 
 def _router_for(name: str) -> Router:
+    # Hot path: a cached router is returned with no controller RPC; stale
+    # routers (from before a serve restart in a long-lived worker) are
+    # evicted by _drop_router on routed_call's terminal failure.
+    with _routers_lock:
+        router = _routers.get(name)
+    if router is not None:
+        return router
     controller = get_or_create_controller()
     with _routers_lock:
         router = _routers.get(name)
-        # A cached router from before a serve restart points at a dead
-        # controller (worker processes outlive serve.shutdown() and never
-        # see reset_routers) — rebuild when the controller changed.
-        if router is not None and \
-                router.controller._actor_id != controller._actor_id:
-            router._stopped = True
-            router = None
         if router is None:
             router = _routers[name] = Router(controller, name)
         return router
+
+
+def _drop_router(name: str, router: Router) -> None:
+    with _routers_lock:
+        if _routers.get(name) is router:
+            router._stopped = True
+            del _routers[name]
 
 
 def reset_routers() -> None:
@@ -496,6 +516,10 @@ def routed_call(deployment_name: str, method: str, args: tuple, kwargs: dict):
             continue
         finally:
             router.complete(aid)
+    # Terminal failure: the router (and possibly its controller) may be
+    # stale from before a serve restart — evict so the next call rebuilds
+    # against the live controller.
+    _drop_router(deployment_name, router)
     raise last_err
 
 
@@ -554,7 +578,8 @@ def make_asgi_app():
                 state["version"] = version
                 state["routes"] = routes
 
-    _TableListener(controller, apply_table, lambda: state["version"])
+    listener = _TableListener(
+        controller, apply_table, lambda: state["version"])
 
     def resolve(path: str):
         with state_lock:
@@ -596,6 +621,7 @@ def make_asgi_app():
         except Exception as e:  # noqa: BLE001 — HTTP boundary
             await reply(500, {"error": repr(e)})
 
+    app.table_listener = listener  # so the proxy can stop it
     return app
 
 
@@ -692,6 +718,7 @@ class HTTPProxy:
         return self.port
 
     def stop(self):
+        self._app.table_listener.stopped = True
         self._loop.call_soon_threadsafe(self._server.close)
         self._loop.call_soon_threadsafe(self._loop.stop)
         return True

@@ -1,0 +1,125 @@
+"""GCS fault tolerance: head persistence + restart on the same address.
+
+Reference behavior: with a Redis-backed GCS the gcs_server process can be
+killed and restarted; raylets re-attach and in-flight work drains
+(``store_client/redis_store_client.h:28``, ``gcs_init_data.h``,
+``test_gcs_fault_tolerance.py``). Here the head persists its tables to
+sqlite (write-through for KV/nodes, 200ms snapshots for actors/PGs/object
+locations), agents/drivers retry head RPCs through a reconnect window, and
+the restarted head reloads state and keeps serving.
+"""
+
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.experimental import internal_kv
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture()
+def persistent_cluster(tmp_path):
+    ray_tpu.shutdown()
+    c = Cluster(persist_path=str(tmp_path / "head.db"))
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes()
+    ray_tpu.init(c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_head_restart_mid_workload(persistent_cluster):
+    c = persistent_cluster
+
+    # Durable state written before the crash.
+    internal_kv._internal_kv_put(b"ft-key", b"ft-value")
+
+    @ray_tpu.remote
+    class Keeper:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    keeper = Keeper.options(name="ft-keeper").remote()
+    assert ray_tpu.get(keeper.bump.remote(), timeout=30) == 1
+
+    @ray_tpu.remote
+    def slow_add(x):
+        time.sleep(2.0)
+        return x + 100
+
+    # In-flight work spanning the crash: results land while the head is
+    # down and must drain once it is back.
+    refs = [slow_add.remote(i) for i in range(4)]
+    time.sleep(0.6)  # let the snapshot loop persist pre-crash state
+
+    address = c.kill_head()
+    time.sleep(1.0)  # head stays dead while tasks are still executing
+    c.restart_head(address)
+
+    # 1. In-flight tasks drain to correct results through the restart.
+    assert ray_tpu.get(refs, timeout=60) == [100, 101, 102, 103]
+
+    # 2. KV survived.
+    assert internal_kv._internal_kv_get(
+        b"ft-key") == b"ft-value"
+
+    # 3. The named actor survived with its in-memory state: the worker
+    #    process kept running and the restarted head reloaded its record.
+    again = ray_tpu.get_actor("ft-keeper")
+    assert ray_tpu.get(again.bump.remote(), timeout=30) == 2
+
+    # 4. Fresh work schedules on the rebuilt node table.
+    @ray_tpu.remote
+    def ping():
+        return "pong"
+
+    assert ray_tpu.get(ping.remote(), timeout=30) == "pong"
+
+    # 5. Both nodes re-attached (heartbeats accepted by the new head).
+    alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+    assert len(alive) == 2
+
+
+def test_state_survives_graceful_restart(tmp_path):
+    """KV + actor records reload from the store across a stop/start."""
+    ray_tpu.shutdown()
+    c = Cluster(persist_path=str(tmp_path / "head.db"))
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes()
+    ray_tpu.init(c.address)
+    try:
+        internal_kv._internal_kv_put(b"k1", b"v1")
+
+        @ray_tpu.remote
+        class Holder:
+            def get(self):
+                return "held"
+
+        h0 = Holder.options(name="holder").remote()
+        # Await a call so registration completes before the crash (an
+        # actor whose creation is still in flight when the head dies is
+        # not resumed — only registered state reloads).
+        assert ray_tpu.get(h0.get.remote(), timeout=30) == "held"
+        time.sleep(0.6)  # snapshot interval
+
+        address = c.kill_head()
+        c.restart_head(address)
+
+        assert internal_kv._internal_kv_get(
+            b"k1") == b"v1"
+        h = ray_tpu.get_actor("holder")
+        assert ray_tpu.get(h.get.remote(), timeout=30) == "held"
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
