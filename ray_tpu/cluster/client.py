@@ -318,8 +318,10 @@ class ClusterBackend:
                 # Not in the local segment but the directory says it's on
                 # this node: it was spilled — the agent restores/serves it.
             try:
-                got = self._node_client(address).call("fetch_object", oid)
-            except (ConnectionLost, OSError) as e:
+                got = self._pull_object(address, oid)
+            except (ConnectionLost, OSError, ObjectLostError) as e:
+                # ObjectLostError: this replica vanished mid-pull (evicted
+                # + unspilled); the next location may still be intact.
                 last_err = e
                 continue
             if got is None:
@@ -330,6 +332,66 @@ class ClusterBackend:
             f"object {oid[:16]}… not retrievable from {len(locations)} "
             f"location(s): {last_err}"
         )
+
+    # Node-to-node transfer tuning (object_manager.h:117, push_manager.h:29
+    # analog — pull-based here): objects above _WHOLE_FETCH_MAX stream in
+    # _CHUNK_SIZE pieces with at most _PULL_CONCURRENCY chunks in flight,
+    # so no RPC frame exceeds ~1 MiB and peak memory is size + a few
+    # chunks (not 2x size as with a single pickled frame).
+    _CHUNK_SIZE = 1 << 20
+    _WHOLE_FETCH_MAX = 4 << 20
+    _PULL_CONCURRENCY = 4
+
+    def _pull_object(self, address: str, oid: str):
+        """(meta, data) from a peer node: one frame for small objects,
+        bounded chunked streaming for large ones."""
+        client = self._node_client(address)
+        info = client.call("fetch_object_info", oid)
+        if info is None:
+            return None
+        meta, size = info
+        if size <= self._WHOLE_FETCH_MAX:
+            return client.call("fetch_object", oid)
+
+        buf = bytearray(size)
+        offsets = list(range(0, size, self._CHUNK_SIZE))
+
+        def pull_chunk(off: int):
+            # Per-thread pooled connections => at most _PULL_CONCURRENCY
+            # frames in flight toward this node.
+            length = min(self._CHUNK_SIZE, size - off)
+            chunk = client.call("fetch_object_chunk", oid, off, length)
+            if chunk is None or len(chunk) != length:
+                raise ObjectLostError(
+                    f"chunk [{off}:{off + length}) of {oid[:16]}… missing"
+                )
+            buf[off:off + length] = chunk
+
+        futs = [self._pull_pool().submit(pull_chunk, o) for o in offsets]
+        err = None
+        for fut in futs:
+            try:
+                fut.result()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err = err or e
+        if err is not None:
+            raise err
+        return meta, buf
+
+    def _pull_pool(self):
+        """One long-lived chunk-pull executor per backend: its threads
+        keep their pooled TCP connections warm across pulls."""
+        pool = getattr(self, "_chunk_pool", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._lock:
+                pool = getattr(self, "_chunk_pool", None)
+                if pool is None:
+                    pool = self._chunk_pool = ThreadPoolExecutor(
+                        self._PULL_CONCURRENCY,
+                        thread_name_prefix="chunk-pull")
+        return pool
 
     def _maybe_recover(self, oid: str) -> bool:
         """Lineage reconstruction: resubmit the creating task if its node
@@ -795,8 +857,13 @@ class ClusterBackend:
     # -- placement groups --------------------------------------------------
 
     def create_placement_group(self, bundles, strategy, name="", lifetime=None):
+        # Client-generated id makes the call idempotent under the head
+        # client's reconnect-window retry (a replayed create after a head
+        # restart must not reserve a second PG's resources).
+        pg_id = ids.new_placement_group_id()
         return self.head.call(
-            "create_placement_group", bundles, strategy, name, lifetime
+            "create_placement_group", bundles, strategy, name, lifetime,
+            pg_id,
         )
 
     def remove_placement_group(self, pg_id: str) -> None:

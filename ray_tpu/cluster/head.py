@@ -209,8 +209,10 @@ class HeadServer:
                 for key, table in snap.items():
                     blob = _pickle.dumps(table, protocol=5)
                     if last.get(key) != blob:
-                        last[key] = blob
+                        # Record success only after the write lands, so a
+                        # transient sqlite failure is retried next tick.
                         self._store.put_blob("snap", key, blob)
+                        last[key] = blob
             except Exception:
                 continue  # next tick retries; persistence is best-effort
 
@@ -335,7 +337,10 @@ class HeadServer:
             if not overwrite and key in self._kv:
                 return False
             self._kv[key] = value
-        self._persist("kv", key, value)
+            # Persist under the lock: concurrent writers to one key must
+            # land on disk in the same order as in memory, or a restart
+            # resurrects the loser.
+            self._persist("kv", key, value)
         return True
 
     def rpc_kv_get(self, key):
@@ -345,8 +350,8 @@ class HeadServer:
     def rpc_kv_del(self, key):
         with self._lock:
             existed = self._kv.pop(key, None) is not None
-        if existed:
-            self._persist_del("kv", key)
+            if existed:
+                self._persist_del("kv", key)
         return existed
 
     def rpc_kv_keys(self, prefix=""):
@@ -878,9 +883,15 @@ class HeadServer:
 
     # -- placement groups (2-phase commit) --------------------------------
 
-    def rpc_create_placement_group(self, bundles, strategy, name="", lifetime=None):
-        pg_id = ids.new_placement_group_id()
+    def rpc_create_placement_group(self, bundles, strategy, name="",
+                                   lifetime=None, pg_id=None):
+        if pg_id is None:  # legacy caller: server-generated id
+            pg_id = ids.new_placement_group_id()
         with self._lock:
+            if pg_id in self._pgs:
+                # Idempotent replay (client retried through a head
+                # restart): the PG already exists, don't double-reserve.
+                return pg_id
             self._pgs[pg_id] = {
                 "placement_group_id": pg_id,
                 "bundles": bundles,

@@ -93,6 +93,8 @@ class NodeAgent:
             collections.OrderedDict()
         )
         self._task_records_cap = 10_000
+        # Object-serving counters (tests assert the chunked path is used).
+        self._fetch_stats = {"whole": 0, "info": 0, "chunks": 0}
 
         self._server = RpcServer(self, host)
         self.address = self._server.address
@@ -503,9 +505,13 @@ class NodeAgent:
         return os.path.join(self.spill_dir, oid)
 
     def rpc_fetch_object(self, oid):
-        """Serve an object's (meta, data) to a peer (push analog). Falls
-        back to the spill file and best-effort restores it into the store
-        (RestoreSpilledObjects analog)."""
+        """Serve an object's (meta, data) to a peer in ONE frame — the
+        small-object path. Large objects go through fetch_object_info +
+        fetch_object_chunk (ObjectManager chunked transfer,
+        ``object_manager.h:117``). Falls back to the spill file and
+        best-effort restores it into the store (RestoreSpilledObjects
+        analog)."""
+        self._fetch_stats["whole"] += 1
         got = self.store.get(oid)
         if got is not None:
             data, meta = got
@@ -513,6 +519,12 @@ class NodeAgent:
                 return meta, bytes(data)
             finally:
                 self.store.release(oid)
+        restored = self._restore_from_spill(oid)
+        if restored is None:
+            return None
+        return restored
+
+    def _restore_from_spill(self, oid):
         path = self._spill_path(oid)
         try:
             with open(path, "rb") as f:
@@ -528,6 +540,44 @@ class NodeAgent:
         except Exception:
             pass
         return meta, data
+
+    def rpc_fetch_object_info(self, oid):
+        """(meta, data_size) for a chunked pull, or None. Restores a
+        spilled object into the store so chunk reads hit shared memory."""
+        self._fetch_stats["info"] += 1
+        got = self.store.get(oid)
+        if got is not None:
+            data, meta = got
+            try:
+                return meta, len(data)
+            finally:
+                self.store.release(oid)
+        restored = self._restore_from_spill(oid)
+        if restored is None:
+            return None
+        meta, data = restored
+        return meta, len(data)
+
+    def rpc_fetch_object_chunk(self, oid, offset: int, length: int):
+        """One bounded chunk of the object's data ([offset, offset+length)).
+        Stateless: each chunk pins/releases independently, so eviction or
+        spilling mid-transfer is handled by the spill-file fallback."""
+        self._fetch_stats["chunks"] += 1
+        got = self.store.get(oid)
+        if got is not None:
+            data, _meta = got
+            try:
+                return bytes(data[offset:offset + length])
+            finally:
+                self.store.release(oid)
+        path = self._spill_path(oid)
+        try:
+            with open(path, "rb") as f:
+                meta_len = int.from_bytes(f.read(8), "little")
+                f.seek(8 + meta_len + offset)
+                return f.read(length)
+        except OSError:
+            return None
 
     def rpc_spill(self, bytes_needed: int):
         """Move cold, unreferenced primary copies to disk until

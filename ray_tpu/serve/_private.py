@@ -34,6 +34,10 @@ CONTROLLER_NAME = "ray_tpu.serve.controller"
 # replica replacement.
 RECONCILE_INTERVAL_S = 0.25
 LONG_POLL_TIMEOUT_S = 10.0
+# Consecutive failed health probes after which a replica is declared
+# wedged (deadlocked, not just saturated) and replaced. With the 10s
+# shared probe budget this is ~50s of continuous unresponsiveness.
+_WEDGED_PROBE_FAILURES = 5
 
 
 # -- replica ---------------------------------------------------------------
@@ -202,20 +206,31 @@ class ServeController:
             probes = [(r, r.get_num_ongoing.remote()) for r in app["replicas"]]
             deadline = time.monotonic() + 10.0
             alive, ongoing = [], []
+            fails = app.setdefault("probe_failures", {})
             from ray_tpu.core.object_ref import ActorError
 
             for r, ref in probes:
+                aid = r._actor_id
                 try:
                     tmo = max(0.5, deadline - time.monotonic())
                     ongoing.append(float(ray_tpu.get(ref, timeout=tmo)))
                     alive.append(r)
+                    fails.pop(aid, None)
                 except ActorError:
                     self._kill_replica(r)  # actually dead: replace it
+                    fails.pop(aid, None)
                 except Exception:
-                    # Slow/saturated, not dead (the probe merely queued
-                    # behind real requests): keep it, treat as fully busy.
-                    alive.append(r)
-                    ongoing.append(float(app["max_concurrent_queries"]))
+                    # Slow/saturated probes merely queued behind real
+                    # requests — keep the replica, treat as fully busy.
+                    # But N consecutive misses = wedged (deadlocked user
+                    # code): kill and replace.
+                    fails[aid] = fails.get(aid, 0) + 1
+                    if fails[aid] >= _WEDGED_PROBE_FAILURES:
+                        self._kill_replica(r)
+                        fails.pop(aid, None)
+                    else:
+                        alive.append(r)
+                        ongoing.append(float(app["max_concurrent_queries"]))
             changed = len(alive) != len(app["replicas"])
 
             # 2. Autoscale: desired = ceil(total in-flight / target),
