@@ -24,6 +24,13 @@ Public API (mirrors reference ``python/ray/__init__.py``):
 
 from ray_tpu.version import __version__
 
+from ray_tpu.core.object_ref import (
+    ActorError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+)
 from ray_tpu.api import (
     ObjectRef,
     available_resources,
@@ -43,7 +50,12 @@ from ray_tpu.api import (
 
 __all__ = [
     "__version__",
+    "ActorError",
+    "GetTimeoutError",
+    "ObjectLostError",
     "ObjectRef",
+    "TaskCancelledError",
+    "TaskError",
     "available_resources",
     "cancel",
     "cluster_resources",

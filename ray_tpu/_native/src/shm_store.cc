@@ -314,8 +314,11 @@ void* ts_create(const char* path, uint64_t size, uint64_t num_slots) {
     unlink(path);
     return nullptr;
   }
-  void* base =
-      mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  // MAP_POPULATE prefaults the tmpfs pages at creation (node start, off
+  // the hot path) so a first big put pays minor faults, not page zeroing
+  // — first-touch was costing ~5x on a cold 256 MiB put.
+  void* base = mmap(nullptr, size, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_POPULATE, fd, 0);
   if (base == MAP_FAILED) {
     close(fd);
     unlink(path);

@@ -64,6 +64,10 @@ class ClusterBackend:
         # Pending actor-task results: oid -> actor_id (for fail-fast when
         # the actor dies with calls in flight).
         self._actor_tasks: dict[str, str] = {}
+        # Packaged runtime envs, memoized by the user dict's canonical
+        # JSON (reference packages once per job; we package once per
+        # distinct env per driver — content re-hashed only on first use).
+        self._rtenv_cache: dict[str, dict] = {}
         self._pins: dict[str, Any] = {}  # zero-copy views we hold alive
         # Set by the worker process: (on_block, on_unblock) callbacks that
         # tell the node agent to release/reacquire this task's resources
@@ -204,7 +208,7 @@ class ClusterBackend:
         contained: list[str] = []
         meta, chunks = ser.serialize(value, found_refs=contained)
         size = ser.total_size(chunks)
-        for attempt in range(4):
+        for attempt in range(8):
             try:
                 self.store.put(oid, chunks, flag + meta)
                 break
@@ -218,8 +222,14 @@ class ClusterBackend:
                     )
                 except (ConnectionLost, OSError):
                     freed = 0
-                if freed <= 0 and attempt >= 1:
-                    raise
+                if freed <= 0:
+                    if attempt >= 6:
+                        raise
+                    # Nothing spillable, but a free may be IN FLIGHT: the
+                    # head already forgot a dropped object (so it's not a
+                    # spill candidate) while the fanout delete hasn't
+                    # reached this store yet. Wait it out, then retry.
+                    time.sleep(0.05 * (attempt + 1))
         else:
             raise StoreFullError(f"object {oid[:16]}… ({size} bytes)")
         # Primary copy: protect from LRU eviction until the cluster
@@ -299,6 +309,11 @@ class ClusterBackend:
 
         for a in arrays:
             weakref.finalize(a, on_dead)
+        # The recursive ``walk`` closure is a cycle (it closes over its own
+        # cell), which keeps THIS list — and so every array in it — alive
+        # until a gc pass. Drop the strong refs now so the finalizers fire
+        # on plain refcount death and the store pin releases promptly.
+        arrays.clear()
 
     @staticmethod
     def _decode(meta: bytes, data):
@@ -336,22 +351,24 @@ class ClusterBackend:
     # Node-to-node transfer tuning (object_manager.h:117, push_manager.h:29
     # analog — pull-based here): objects above _WHOLE_FETCH_MAX stream in
     # _CHUNK_SIZE pieces with at most _PULL_CONCURRENCY chunks in flight,
-    # so no RPC frame exceeds ~1 MiB and peak memory is size + a few
-    # chunks (not 2x size as with a single pickled frame).
-    _CHUNK_SIZE = 1 << 20
-    _WHOLE_FETCH_MAX = 4 << 20
-    _PULL_CONCURRENCY = 4
+    # so no RPC frame exceeds ~4 MiB and peak extra memory is a few
+    # chunks (not 2x size as with a single pickled frame). 4 MiB × 8
+    # in flight keeps a 64 MiB arg at 2 serial rounds instead of 16.
+    _CHUNK_SIZE = 4 << 20
+    _WHOLE_FETCH_MAX = 8 << 20
+    _PULL_CONCURRENCY = 8
 
     def _pull_object(self, address: str, oid: str):
-        """(meta, data) from a peer node: one frame for small objects,
-        bounded chunked streaming for large ones."""
+        """(meta, data) from a peer node: ONE round trip for small objects
+        (data inlined in the info reply), bounded chunked streaming for
+        large ones."""
         client = self._node_client(address)
-        info = client.call("fetch_object_info", oid)
+        info = client.call("fetch_object_info", oid, self._WHOLE_FETCH_MAX)
         if info is None:
             return None
-        meta, size = info
-        if size <= self._WHOLE_FETCH_MAX:
-            return client.call("fetch_object", oid)
+        meta, size, inline = info
+        if inline is not None:
+            return meta, inline
 
         buf = bytearray(size)
         offsets = list(range(0, size, self._CHUNK_SIZE))
@@ -557,6 +574,26 @@ class ClusterBackend:
                 info["bundle_index"] = options["placement_group_bundle_index"]
         return info
 
+    def _resolve_runtime_env(self, options: dict) -> dict | None:
+        """Package a task/actor runtime_env (upload content-addressed zips
+        to the head KV) and return the shippable resolved spec."""
+        env = options.get("runtime_env")
+        if not env:
+            return None
+        import json as _json
+
+        from ray_tpu._private import runtime_env as rtenv
+
+        memo_key = _json.dumps(env, sort_keys=True, default=str)
+        resolved = self._rtenv_cache.get(memo_key)
+        if resolved is None:
+            resolved = rtenv.package(
+                env,
+                lambda k, v, ow: self.head.call("kv_put", k, v, ow),
+            )
+            self._rtenv_cache[memo_key] = resolved
+        return resolved
+
     def _choose_node(self, demand, sinfo, task_id=None):
         if sinfo["pg_id"] is not None:
             return self.head.call(
@@ -605,9 +642,16 @@ class ClusterBackend:
             )
 
     def _retry_submit(self, spec: dict, timeout: float = 120.0):
+        from ray_tpu.core.object_ref import TaskCancelledError
+
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             time.sleep(0.25)
+            if spec.get("cancelled"):
+                err = TaskCancelledError(spec.get("fname", "task"))
+                for oid in spec["oids"]:
+                    self.put_with_id(oid, err, is_error=True)
+                return
             placed = self._choose_node(spec["demand"], spec["sinfo"],
                                    task_id=spec.get("task_id"))
             if placed is not None:
@@ -619,6 +663,15 @@ class ClusterBackend:
                 except (ConnectionLost, OSError):
                     self._end_borrows(spec)
                     continue
+                if spec.get("cancelled"):
+                    # cancel() saw assigned_node=None and sent no node RPC;
+                    # now that the task has a home, deliver it there (the
+                    # agent's cancelled-set covers every dispatch window).
+                    try:
+                        self._node_client(address).call(
+                            "cancel_task", spec["task_id"], False)
+                    except (ConnectionLost, OSError):
+                        pass
                 return
         err = TaskError(
             spec.get("fname", "task"),
@@ -660,6 +713,7 @@ class ClusterBackend:
             "pg_id": None,
             "bundle_index": -1,
             "retries_left": max_retries,
+            "runtime_env": self._resolve_runtime_env(options),
         }
         spec["pg_id"] = spec["sinfo"]["pg_id"]
         spec["bundle_index"] = spec["sinfo"]["bundle_index"]
@@ -704,6 +758,7 @@ class ClusterBackend:
             "demand": demand_of(options, is_actor=True),
             "sinfo": self._strategy_info(options),
             "retries_left": 0,
+            "runtime_env": self._resolve_runtime_env(options),
             # >1 = threaded actor: methods run on a pool of this many
             # executor threads (reference threaded-actor semantics; call
             # ordering is relaxed).
@@ -852,7 +907,53 @@ class ClusterBackend:
         return info["actor_id"]
 
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
-        pass  # best-effort no-op, matching the local backend
+        """Best-effort cancel (``ray.cancel`` parity): queued tasks are
+        dropped and their refs raise TaskCancelledError; running tasks are
+        force-killed (worker process) or cooperatively interrupted; actor
+        calls are cancelled in the actor's queue or interrupted in place
+        (the actor itself survives — force never kills an actor)."""
+        oid = ref.id
+        entry = self._actor_tasks.get(oid)
+        if entry is not None:
+            spec = entry["spec"]
+            entry["retries_left"] = 0  # a cancelled call must not replay
+            try:
+                info = self._actor_info(spec["actor_id"], refresh=True)
+                if info.get("address"):
+                    self._worker_client(info["address"]).call(
+                        "cancel_task", spec["task_id"], force
+                    )
+            except (ConnectionLost, OSError, ActorError, KeyError):
+                pass
+            return
+        spec = self._lineage.get(oid)
+        if spec is None:
+            return  # finished-and-dropped or not owned here: no-op
+        # Already-finished outputs have locations (or a local copy):
+        # cancel must stay a no-op AND must not burn the lineage budget
+        # that protects the computed value against later node loss.
+        try:
+            if self.store.contains(oid):
+                return
+            loc = self.head.call("locations", oid)
+            if loc and loc["nodes"]:
+                return
+        except (ConnectionLost, OSError):
+            pass
+        spec["retries_left"] = 0   # no lineage re-exec of a cancelled task
+        spec["cancelled"] = True   # the pending-retry thread checks this
+        assigned = spec.get("assigned_node")
+        if assigned is None:
+            return  # still unplaced: _retry_submit stores the error
+        try:
+            nodes = {n["NodeID"]: n for n in self.head.call("nodes")}
+            node = nodes.get(assigned)
+            if node is not None and node["Alive"]:
+                self._node_client(node["Address"]).call(
+                    "cancel_task", spec["task_id"], force
+                )
+        except (ConnectionLost, OSError):
+            pass
 
     # -- placement groups --------------------------------------------------
 
@@ -977,6 +1078,9 @@ class ClusterBackend:
             self._worker_clients.clear()
         for c in clients:
             c.close()
+        pool = getattr(self, "_chunk_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
         self._pins.clear()
         self.store.close()
         self.head.close()

@@ -101,6 +101,7 @@ class HeadServer:
         self._lock = threading.RLock()
         self._nodes: dict[str, NodeInfo] = {}
         self._kv: dict[str, Any] = {}
+        self._kv_lock = threading.Lock()  # see rpc_kv_put — KV I/O only
         # object directory: oid -> {"nodes": set, "error": bool}
         self._objects: dict[str, dict] = {}
         self._objects_cv = threading.Condition(self._lock)
@@ -332,30 +333,35 @@ class HeadServer:
 
     # -- KV ---------------------------------------------------------------
 
+    # The KV is a self-contained subsystem under its own lock: its
+    # persistence writes can be multi-MB blobs (runtime-env packages),
+    # and doing that disk I/O under the global head lock would stall
+    # scheduling/heartbeats/location RPCs for the duration.
+
     def rpc_kv_put(self, key, value, overwrite=True):
-        with self._lock:
+        with self._kv_lock:
             if not overwrite and key in self._kv:
                 return False
             self._kv[key] = value
-            # Persist under the lock: concurrent writers to one key must
-            # land on disk in the same order as in memory, or a restart
-            # resurrects the loser.
+            # Persist under the KV lock: concurrent writers to one key
+            # must land on disk in the same order as in memory, or a
+            # restart resurrects the loser.
             self._persist("kv", key, value)
         return True
 
     def rpc_kv_get(self, key):
-        with self._lock:
+        with self._kv_lock:
             return self._kv.get(key)
 
     def rpc_kv_del(self, key):
-        with self._lock:
+        with self._kv_lock:
             existed = self._kv.pop(key, None) is not None
             if existed:
                 self._persist_del("kv", key)
         return existed
 
     def rpc_kv_keys(self, prefix=""):
-        with self._lock:
+        with self._kv_lock:
             return [k for k in self._kv if k.startswith(prefix)]
 
     # -- distributed ref-counting -----------------------------------------

@@ -35,7 +35,7 @@ DEFAULT_STORE_CAPACITY = 512 << 20
 
 
 class _Worker:
-    def __init__(self, worker_id, proc, address=None):
+    def __init__(self, worker_id, proc, address=None, env_key=""):
         self.worker_id = worker_id
         self.proc = proc
         self.address = address
@@ -45,6 +45,9 @@ class _Worker:
         self.current_task = None  # (task_spec, release_fn) while executing
         self.is_actor = False
         self.actor_id = None
+        # Runtime-env hash this process was spawned under; the pool never
+        # leases a worker across env keys ("" = plain environment).
+        self.env_key = env_key
 
 
 class NodeAgent:
@@ -79,8 +82,13 @@ class NodeAgent:
 
         self._lock = threading.RLock()
         self._workers: dict[str, _Worker] = {}
-        self._idle: list[_Worker] = []
+        # Idle pools keyed by runtime-env hash (worker_pool.cc keys its
+        # pools by runtime-env hash the same way; "" = no runtime env).
+        self._idle: dict[str, list[_Worker]] = {}
         self._max_workers = max(4, int(node_res.get("CPU", 4)) * 4)
+        # Materialized runtime-env package cache (per node, content-hashed).
+        self._rtenv_cache_root = f"/tmp/ray_tpu_rtenv_{session}"
+        os.makedirs(self._rtenv_cache_root, exist_ok=True)
         self._bundles: dict[tuple, ResourcePool] = {}
         self._bundle_state: dict[tuple, str] = {}  # PREPARED | COMMITTED
         self._task_queue: list[dict] = []
@@ -93,6 +101,12 @@ class NodeAgent:
             collections.OrderedDict()
         )
         self._task_records_cap = 10_000
+        # Task ids cancelled before the dispatcher picked them up (covers
+        # the queue→checkout window where a task is in neither place).
+        # Ordered so the bound evicts oldest-first.
+        self._cancelled_tasks: "collections.OrderedDict[str, bool]" = (
+            collections.OrderedDict()
+        )
         # Object-serving counters (tests assert the chunked path is used).
         self._fetch_stats = {"whole": 0, "info": 0, "chunks": 0}
 
@@ -108,10 +122,37 @@ class NodeAgent:
 
     # -- worker pool ------------------------------------------------------
 
-    def _spawn_worker(self) -> _Worker:
+    def _spawn_worker(self, env_key: str = "",
+                      resolved_env: dict | None = None) -> _Worker:
         worker_id = "w-" + os.urandom(6).hex()
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id
+        cwd = None
+        if resolved_env is not None:
+            # Materialize packages (content-hash cached) and bake the env
+            # into the subprocess: env_vars directly, py_modules +
+            # working_dir via PYTHONPATH, working_dir as cwd — the
+            # interpreter picks all of it up at start, no worker-side code.
+            from ray_tpu._private import runtime_env as rtenv
+
+            recipe = rtenv.ensure_local(
+                resolved_env,
+                lambda k: self.head.call("kv_get", k),
+                self._rtenv_cache_root,
+            )
+            env.update(recipe["env_vars"])
+            # The framework itself may be importable only via the agent's
+            # cwd; a changed cwd must not break `-m ray_tpu...` startup.
+            import ray_tpu as _pkg
+
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(_pkg.__file__)))
+            prior = env.get("PYTHONPATH", "")
+            env["PYTHONPATH"] = os.pathsep.join(
+                recipe["py_paths"] + [pkg_root]
+                + ([prior] if prior else [])
+            )
+            cwd = recipe["cwd"]
         proc = subprocess.Popen(
             [
                 sys.executable, "-m", "ray_tpu.cluster.workerproc",
@@ -122,10 +163,11 @@ class NodeAgent:
                 "--worker-id", worker_id,
             ],
             env=env,
+            cwd=cwd,
             stdout=sys.stdout.fileno() if hasattr(sys.stdout, "fileno") else None,
             stderr=sys.stderr.fileno() if hasattr(sys.stderr, "fileno") else None,
         )
-        w = _Worker(worker_id, proc)
+        w = _Worker(worker_id, proc, env_key=env_key)
         with self._lock:
             self._workers[worker_id] = w
         return w
@@ -141,27 +183,65 @@ class NodeAgent:
             w.ready.set()
         return True
 
-    def _checkout_worker(self, timeout: float = 60.0) -> _Worker:
-        """Idle worker or a fresh one (lease grant, ``PopWorker`` analog)."""
+    def _checkout_worker(self, timeout: float = 60.0, env_key: str = "",
+                         resolved_env: dict | None = None) -> _Worker:
+        """Idle worker of the SAME runtime env, or a fresh one spawned
+        into it (lease grant, ``PopWorker`` analog)."""
         with self._lock:
-            if self._idle:
-                return self._idle.pop()
+            pool = self._idle.get(env_key)
+            if pool:
+                return pool.pop()
             n_live = len([w for w in self._workers.values()
                           if w.proc.poll() is None])
             can_spawn = n_live < self._max_workers
+            victim = None
+            if not can_spawn:
+                # At capacity with nothing idle in THIS env: retire an
+                # idle worker of another env to make room — otherwise a
+                # node whose slots filled with (now idle) plain workers
+                # could never serve a runtime_env task at all.
+                victim = next(
+                    (w for key, lst in self._idle.items()
+                     if key != env_key for w in lst),
+                    None,
+                )
+                if victim is not None:
+                    self._idle[victim.env_key].remove(victim)
+                    self._workers.pop(victim.worker_id, None)
+                    can_spawn = True
+        if victim is not None:
+            victim.proc.kill()
+            if victim.client_id:
+                try:
+                    self.head.call("ref_client_dead", victim.client_id)
+                except Exception:
+                    pass
+            try:
+                self.store.release_dead(victim.proc.pid)
+            except Exception:
+                pass
         if can_spawn:
-            w = self._spawn_worker()
+            w = self._spawn_worker(env_key, resolved_env)
         else:
-            # Wait for an idle worker.
+            # Every slot is BUSY: wait for one of this env's workers to
+            # come back (or for capacity to free via task turnover).
             deadline = time.monotonic() + timeout
             while True:
                 with self._lock:
-                    if self._idle:
-                        w = self._idle.pop()
+                    pool = self._idle.get(env_key)
+                    if pool:
+                        w = pool.pop()
+                        break
+                    n_live = len([w_ for w_ in self._workers.values()
+                                  if w_.proc.poll() is None])
+                    if n_live < self._max_workers:
+                        can_spawn = True
                         break
                 if time.monotonic() > deadline:
                     raise TimeoutError("no worker became available")
                 time.sleep(0.005)
+            if can_spawn:
+                w = self._spawn_worker(env_key, resolved_env)
         if not w.ready.wait(timeout):
             raise TimeoutError(f"worker {w.worker_id} failed to start")
         return w
@@ -170,7 +250,7 @@ class NodeAgent:
         with self._lock:
             if w.proc.poll() is None and not w.is_actor:
                 w.current_task = None
-                self._idle.append(w)
+                self._idle.setdefault(w.env_key, []).append(w)
 
     # -- task dispatch ----------------------------------------------------
 
@@ -258,7 +338,17 @@ class NodeAgent:
                     return pool
         return None
 
+    def _consume_cancel(self, task_id) -> bool:
+        with self._lock:
+            if task_id is not None and task_id in self._cancelled_tasks:
+                self._cancelled_tasks.pop(task_id, None)
+                return True
+        return False
+
     def _dispatch_one(self, spec: dict):
+        if self._consume_cancel(spec.get("task_id")):
+            self._cancel_spec(spec)
+            return
         demand = spec.get("demand", {})
         pool = self.pool
         if spec.get("pg_id") is not None:
@@ -278,16 +368,31 @@ class NodeAgent:
         if not acquired:
             self._fail_task(spec, f"resources {demand} unavailable")
             return
+        rtenv = spec.get("runtime_env")
         try:
-            w = self._checkout_worker()
-        except TimeoutError as e:
+            w = self._checkout_worker(
+                env_key=(rtenv or {}).get("env_key", ""),
+                resolved_env=rtenv,
+            )
+        except (TimeoutError, RuntimeError, OSError) as e:
+            # RuntimeError/OSError: runtime-env materialization failed
+            # (missing package, bad zip) — surfaced as the task's error,
+            # matching the reference's runtime-env setup failures.
             pool.release(demand)
-            self._fail_task(spec, str(e))
+            self._fail_task(spec, f"worker setup failed: {e}")
             return
         self._record_task(spec, "RUNNING")
         w.current_task = {
             "spec": spec, "pool": pool, "demand": demand, "released": False,
         }
+        # A cancel that raced the queue→checkout window parked its id in
+        # the cancelled set; honor it now that the task is attributable.
+        if not spec.get("actor_create") and self._consume_cancel(
+                spec.get("task_id")):
+            self._release_current(w)
+            self._return_worker(w)
+            self._cancel_spec(spec)
+            return
         try:
             if spec.get("actor_create"):
                 w.is_actor = True
@@ -368,11 +473,21 @@ class NodeAgent:
 
     def _fail_task(self, spec: dict, reason: str):
         from ray_tpu.core.object_ref import TaskError
+
+        err = TaskError(spec.get("fname", "task"), reason, reason)
+        self._store_task_error(spec, err, "FAILED")
+
+    def _cancel_spec(self, spec: dict):
+        from ray_tpu.core.object_ref import TaskCancelledError
+
+        err = TaskCancelledError(spec.get("fname", "task"))
+        self._store_task_error(spec, err, "CANCELLED")
+
+    def _store_task_error(self, spec: dict, err: Exception, state: str):
         from ray_tpu.core import serialization as ser
 
-        self._record_task(spec, "FAILED")
+        self._record_task(spec, state)
         self._end_borrows(spec)
-        err = TaskError(spec.get("fname", "task"), reason, reason)
         meta, chunks = ser.serialize(err)
         for oid in spec["oids"]:
             try:
@@ -381,11 +496,56 @@ class NodeAgent:
                 continue
             self.head.call("add_location", oid, self.node_id, is_error=True)
 
+    def rpc_cancel_task(self, task_id: str, force: bool = False):
+        """CancelTask analog (``core_worker.proto`` CancelTask → raylet).
+        Queued: dropped here, TaskCancelledError stored. Running:
+        force kills the worker process (its lease/pins are reclaimed by
+        the reap path); otherwise the cancel is forwarded to the worker
+        for cooperative delivery. Returns True if the task was found."""
+        with self._queue_cv:
+            self._cancelled_tasks[task_id] = True
+            while len(self._cancelled_tasks) > 10_000:
+                # Oldest-first eviction: never the id just inserted.
+                self._cancelled_tasks.popitem(last=False)
+            for i, spec in enumerate(self._task_queue):
+                if spec.get("task_id") == task_id:
+                    self._task_queue.pop(i)
+                    self._cancelled_tasks.pop(task_id, None)
+                    break
+            else:
+                spec = None
+        if spec is not None:
+            self._cancel_spec(spec)
+            return True
+        with self._lock:
+            target = next(
+                (w for w in self._workers.values()
+                 if w.current_task is not None
+                 and w.current_task["spec"].get("task_id") == task_id),
+                None,
+            )
+            if target is None:
+                return False
+            target.current_task["cancelled"] = True
+            self._cancelled_tasks.pop(task_id, None)
+            if force:
+                # Kill UNDER the lock: outside it, the task could finish
+                # and the worker be re-leased to an innocent task first.
+                target.proc.kill()  # reap loop stores TaskCancelledError
+                return True
+            client = target.client
+        try:
+            client.call("cancel_task", task_id, False)
+        except Exception:
+            return False
+        return True
+
     def _on_worker_failure(self, w: _Worker, cause: str):
         with self._lock:
             self._workers.pop(w.worker_id, None)
-            if w in self._idle:
-                self._idle.remove(w)
+            pool = self._idle.get(w.env_key)
+            if pool is not None and w in pool:
+                pool.remove(w)
             current = w.current_task
             w.current_task = None
         if w.proc.poll() is None:
@@ -415,10 +575,14 @@ class NodeAgent:
                 current["released"] = True
                 current["pool"].release(current["demand"])
             spec = current["spec"]
-            if not spec.get("actor_create"):
-                self._fail_task(spec, f"worker died: {cause}")  # ends borrows
-            else:
+            if spec.get("actor_create"):
                 self._end_borrows(spec)
+            elif current.get("cancelled"):
+                # Force-cancel killed this worker on purpose: the result is
+                # TaskCancelledError, not a retriable worker death.
+                self._cancel_spec(spec)
+            else:
+                self._fail_task(spec, f"worker died: {cause}")  # ends borrows
 
     def _reap_loop(self):
         """Detect dead worker processes (WorkerPool's disconnect handling)
@@ -541,22 +705,29 @@ class NodeAgent:
             pass
         return meta, data
 
-    def rpc_fetch_object_info(self, oid):
-        """(meta, data_size) for a chunked pull, or None. Restores a
-        spilled object into the store so chunk reads hit shared memory."""
+    def rpc_fetch_object_info(self, oid, inline_max: int = 0):
+        """(meta, data_size, data_or_None) for a pull, or None if absent.
+        Data rides inline when it fits in ``inline_max`` — the small-object
+        fast path stays ONE round trip; only large objects pay an extra
+        info RPC before chunking. Restores a spilled object into the store
+        so subsequent chunk reads hit shared memory."""
         self._fetch_stats["info"] += 1
         got = self.store.get(oid)
         if got is not None:
             data, meta = got
             try:
-                return meta, len(data)
+                if len(data) <= inline_max:
+                    return meta, len(data), bytes(data)
+                return meta, len(data), None
             finally:
                 self.store.release(oid)
         restored = self._restore_from_spill(oid)
         if restored is None:
             return None
         meta, data = restored
-        return meta, len(data)
+        if len(data) <= inline_max:
+            return meta, len(data), bytes(data)
+        return meta, len(data), None
 
     def rpc_fetch_object_chunk(self, oid, offset: int, length: int):
         """One bounded chunk of the object's data ([offset, offset+length)).

@@ -26,7 +26,13 @@ import traceback
 
 from ray_tpu.cluster.rpc import RpcClient, RpcServer
 from ray_tpu.core import serialization as ser
-from ray_tpu.core.object_ref import ActorError, ObjectRef, TaskError
+from ray_tpu.core.cancellation import CancelRegistry
+from ray_tpu.core.object_ref import (
+    ActorError,
+    ObjectRef,
+    TaskCancelledError,
+    TaskError,
+)
 
 
 class _TeeStream(io.TextIOBase):
@@ -86,6 +92,10 @@ class WorkerHandler:
         self._ev_lock = threading.Lock()
         self._log_lines: list = []
         self._task_events: list = []
+        # Cancellation registry: ids cancelled before they ran, and the
+        # executor-thread ident of each currently running task (so a
+        # cooperative cancel can target the right thread).
+        self._cancels = CancelRegistry(threading.Lock())
         sys.stdout = _TeeStream(sys.stdout, self._log_lines, self._ev_lock)
         sys.stderr = _TeeStream(sys.stderr, self._log_lines, self._ev_lock)
         threading.Thread(target=self._event_flush_loop, daemon=True).start()
@@ -151,15 +161,50 @@ class WorkerHandler:
     def rpc_ping(self):
         return "pong"
 
+    def rpc_cancel_task(self, task_id: str, force: bool = False):
+        """Cancel a task this worker holds. Queued: marked so the executor
+        skips it and stores TaskCancelledError. Running: the class is
+        injected into the executor thread (best-effort — delivery waits
+        out any C-level block). ``force`` is handled by the agent killing
+        the process; by the time it reaches us it degrades to cooperative.
+        """
+        running = self._cancels.cancel(task_id, TaskCancelledError)
+        return "running" if running else "queued"
+
     # -- execution ---------------------------------------------------------
+
+    def _begin_cancellable(self, spec) -> bool:
+        """Register this thread as the runner of ``spec``. Returns False if
+        the task was already cancelled (caller must not run it)."""
+        return self._cancels.begin(spec.get("task_id"), threading.get_ident())
+
+    def _end_cancellable(self, spec) -> None:
+        """Unregister; if a cancel raced with completion, clear the
+        injected-but-undelivered exception so it cannot land on the NEXT
+        task this thread runs."""
+        self._cancels.end(spec.get("task_id"), threading.get_ident())
+
+    def _store_cancelled(self, spec, rec) -> None:
+        name = spec.get("fname") or spec.get("method", "task")
+        self._store_error(spec, TaskCancelledError(name))
+        self._end_borrows(spec)
+        rec["state"] = "CANCELLED"
+        rec["end_time"] = time.time()
+        rec["error"] = "cancelled"
+        with self._ev_lock:
+            self._task_events.append(rec)
 
     def _exec_loop(self):
         while True:
             kind, spec = self._q.get()
             try:
                 if kind == "task":
-                    self._run_task(spec)
-                    self.agent.call("task_done", self.worker_id)
+                    # finally: a late-delivered cancel injection escaping
+                    # _run_task's handlers must not skip the lease release.
+                    try:
+                        self._run_task(spec)
+                    finally:
+                        self.agent.call("task_done", self.worker_id)
                 elif kind == "actor_ctor":
                     self._run_actor_ctor(spec)
                 elif kind == "actor_task":
@@ -208,10 +253,13 @@ class WorkerHandler:
                 pass
 
     def _run_task(self, spec):
+        rec = self._record(spec, "NORMAL_TASK")
+        if not self._begin_cancellable(spec):
+            self._store_cancelled(spec, rec)
+            return
         # Only plain tasks hold a per-task lease worth releasing while
         # blocked; actor lifetime resources stay held (reference semantics).
         self.backend._block_hooks = self._hooks
-        rec = self._record(spec, "NORMAL_TASK")
         err = None
         try:
             func = ser.loads(spec["func"])
@@ -231,9 +279,17 @@ class WorkerHandler:
                     ),
                 )
         finally:
-            self.backend._block_hooks = None
-            self._end_borrows(spec)
-            self._finish(rec, err)
+            # Nested so a cancel injection delivered INSIDE this finally
+            # (the tiny window before _end_cancellable clears it) cannot
+            # abort the remaining cleanup steps.
+            try:
+                self._end_cancellable(spec)
+            finally:
+                self.backend._block_hooks = None
+                try:
+                    self._end_borrows(spec)
+                finally:
+                    self._finish(rec, err)
 
     def _run_actor_ctor(self, spec):
         rec = self._record(spec, "ACTOR_CREATION_TASK")
@@ -262,6 +318,9 @@ class WorkerHandler:
     def _run_actor_task(self, spec):
         self._actor_ready.wait(timeout=300.0)
         rec = self._record(spec, "ACTOR_TASK")
+        if not self._begin_cancellable(spec):
+            self._store_cancelled(spec, rec)
+            return
         err = None
         try:
             if self._actor_instance is None:
@@ -287,8 +346,13 @@ class WorkerHandler:
                     ),
                 )
         finally:
-            self._end_borrows(spec)
-            self._finish(rec, err)
+            try:
+                self._end_cancellable(spec)
+            finally:
+                try:
+                    self._end_borrows(spec)
+                finally:
+                    self._finish(rec, err)
 
 
 def main():

@@ -26,11 +26,13 @@ import weakref
 from typing import Any, Callable, Sequence
 
 from ray_tpu.core import ids
+from ray_tpu.core.cancellation import CancelRegistry
 from ray_tpu.core.object_ref import (
     ActorError,
     GetTimeoutError,
     ObjectRef,
     ObjectLostError,
+    TaskCancelledError,
     TaskError,
 )
 from ray_tpu.core.resources import ResourcePool, default_node_resources, demand_of
@@ -225,6 +227,9 @@ class LocalBackend:
         self._actor_records: dict[str, dict] = {}
         # Internal KV (GCS InternalKVGcsService analog, in-process flavor).
         self._kv: dict[str, Any] = {}
+        # Cancellation: task ids cancelled pre-run + running-thread idents
+        # for cooperative mid-run interruption (cancellation.py).
+        self._cancels = CancelRegistry(threading.Lock())
         self.node_id = "local"
 
     # -- internal KV -------------------------------------------------------
@@ -667,6 +672,10 @@ class LocalBackend:
         def run():
             attempts = 0
             try:
+                if not self._cancels.begin(task_id, threading.get_ident()):
+                    self._record_task_state(task_id, "CANCELLED")
+                    self._store_error(oids, TaskCancelledError(fname))
+                    return
                 while True:
                     try:
                         a, kw = self._resolve_args(args, kwargs)
@@ -691,6 +700,10 @@ class LocalBackend:
                         self._record_task_state(task_id, "FINISHED")
                         return
                     except BaseException as e:  # noqa: BLE001 — stored, not dropped
+                        if isinstance(e, TaskCancelledError):
+                            self._record_task_state(task_id, "CANCELLED")
+                            self._store_error(oids, e)
+                            return
                         self._record_task_state(task_id, "FAILED", repr(e))
                         retriable = retry_exceptions is True or (
                             isinstance(retry_exceptions, tuple)
@@ -708,7 +721,10 @@ class LocalBackend:
                             )
                         return
             finally:
-                self._unpin(pins)
+                try:
+                    self._cancels.end(task_id, threading.get_ident())
+                finally:
+                    self._unpin(pins)
 
         self._pool.submit(run)
         return refs
@@ -770,43 +786,65 @@ class LocalBackend:
                 if item is _POISON:
                     return
                 oids, method_name, m_args, m_kwargs, num_returns, pins = item
+                call_tid = ids.task_of_object(oids[0])[0]
                 try:
-                    if state.dead:
-                        self._store_error(
-                            oids,
-                            ActorError(
-                                f"actor {actor_id} is dead: {state.death_cause}"
-                            ),
-                        )
-                        continue
-                    try:
-                        a, kw = self._resolve_args(m_args, m_kwargs)
-                        method = getattr(state.instance, method_name)
-                        self._record_task_state(
-                            ids.task_of_object(oids[0])[0], "RUNNING"
-                        )
-                        result = method(*a, **kw)
-                        self._store_returns(oids, result, num_returns)
-                        self._record_task_state(
-                            ids.task_of_object(oids[0])[0], "FINISHED"
-                        )
-                    except BaseException as e:  # noqa: BLE001
-                        self._store_error(
-                            oids,
-                            TaskError(
-                                f"{cls.__name__}.{method_name}",
-                                traceback.format_exc(),
-                                repr(e),
-                            ),
-                        )
-                finally:
-                    self._unpin(pins)
+                    self._run_actor_item(
+                        state, cls, actor_id, oids, method_name, m_args,
+                        m_kwargs, num_returns, pins, call_tid)
+                except BaseException:  # noqa: BLE001
+                    # A cancel injection delivered after the item's own
+                    # handlers (e.g. inside a finally) must not kill this
+                    # actor's executor thread.
+                    traceback.print_exc()
 
         for i in range(max_concurrency):
             t = threading.Thread(target=worker_loop, args=(i == 0,), daemon=True)
             t.start()
             state.threads.append(t)
         return actor_id
+
+    def _run_actor_item(self, state, cls, actor_id, oids, method_name,
+                        m_args, m_kwargs, num_returns, pins, call_tid):
+        """Execute one dequeued actor call (body of the actor's executor
+        loop, factored out so worker_loop can shield its thread from a
+        late-delivered cancel injection)."""
+        try:
+            if state.dead:
+                self._store_error(
+                    oids,
+                    ActorError(
+                        f"actor {actor_id} is dead: {state.death_cause}"
+                    ),
+                )
+                return
+            if not self._cancels.begin(call_tid, threading.get_ident()):
+                self._record_task_state(call_tid, "CANCELLED")
+                self._store_error(oids, TaskCancelledError(method_name))
+                return
+            try:
+                a, kw = self._resolve_args(m_args, m_kwargs)
+                method = getattr(state.instance, method_name)
+                self._record_task_state(call_tid, "RUNNING")
+                result = method(*a, **kw)
+                self._store_returns(oids, result, num_returns)
+                self._record_task_state(call_tid, "FINISHED")
+            except BaseException as e:  # noqa: BLE001
+                if isinstance(e, TaskCancelledError):
+                    self._record_task_state(call_tid, "CANCELLED")
+                    self._store_error(oids, e)
+                else:
+                    self._store_error(
+                        oids,
+                        TaskError(
+                            f"{cls.__name__}.{method_name}",
+                            traceback.format_exc(),
+                            repr(e),
+                        ),
+                    )
+            finally:
+                self._cancels.end(call_tid, threading.get_ident())
+        finally:
+            self._unpin(pins)
 
     def submit_actor_task(
         self,
@@ -915,8 +953,15 @@ class LocalBackend:
         return aid
 
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
-        # Local mode: best-effort no-op (threads are not interruptible).
-        pass
+        """Best-effort cancel. Not-yet-started work (pool backlog, actor
+        queue) is skipped at pickup; a running task gets TaskCancelledError
+        injected into its executor thread (cooperative — C-blocked code
+        finishes its call first; there is no separate process to kill in
+        local mode, so ``force`` adds nothing here)."""
+        task_id = ids.task_of_object(ref.id)[0]
+        if self._entry(ref.id).event.is_set():
+            return  # already finished: no-op
+        self._cancels.cancel(task_id, TaskCancelledError)
 
     # -- lifecycle --------------------------------------------------------
 

@@ -91,6 +91,30 @@ class TaskError(Exception):
         )
 
 
+class TaskCancelledError(TaskError):
+    """The task was cancelled via ray_tpu.cancel
+    (cf. ``ray.exceptions.TaskCancelledError``). Raised at every get() of
+    the cancelled task's outputs. Subclasses TaskError so every store/raise
+    path that forwards task failures forwards cancellations unchanged.
+    Zero-arg constructible: cooperative cancellation injects the CLASS into
+    the executing thread (PyThreadState_SetAsyncExc instantiates it bare).
+    """
+
+    def __init__(self, function_name: str = "task",
+                 remote_traceback: str = "",
+                 cause_repr: str = "cancelled"):
+        self.function_name = function_name
+        self.remote_traceback = remote_traceback
+        self.cause_repr = cause_repr
+        Exception.__init__(self, f"task {function_name} was cancelled")
+
+    def __reduce__(self):
+        return (
+            TaskCancelledError,
+            (self.function_name, self.remote_traceback, self.cause_repr),
+        )
+
+
 class ActorError(Exception):
     """The actor died before/while executing this call (cf. RayActorError)."""
 

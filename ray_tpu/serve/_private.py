@@ -37,6 +37,10 @@ LONG_POLL_TIMEOUT_S = 10.0
 # Consecutive failed health probes after which a replica is declared
 # wedged (deadlocked, not just saturated) and replaced. With the 10s
 # shared probe budget this is ~50s of continuous unresponsiveness.
+# Saturation alone cannot trip this: replicas run with +1 executor
+# thread of headroom reserved for probes (see _make_replica), so a miss
+# means the process can't even answer a trivial call for ~10s — user
+# code holding the GIL or a true deadlock, not just long requests.
 _WEDGED_PROBE_FAILURES = 5
 
 
@@ -207,8 +211,17 @@ class ServeController:
             deadline = time.monotonic() + 10.0
             alive, ongoing = [], []
             fails = app.setdefault("probe_failures", {})
+            # Prune entries for replicas that left by scale-down/redeploy
+            # (their miss counts would otherwise accumulate forever).
+            current = {r._actor_id for r in app["replicas"]}
+            for aid in [a for a in fails if a not in current]:
+                del fails[aid]
             from ray_tpu.core.object_ref import ActorError
 
+            # Every ref above is already in flight, so even a late get()
+            # with a small residual timeout has given its probe the FULL
+            # budget of wall-clock since issuance — a miss is ~10s of
+            # unresponsiveness no matter where the replica sits in the list.
             for r, ref in probes:
                 aid = r._actor_id
                 try:

@@ -1,0 +1,175 @@
+"""Task cancellation tests (``ray.cancel`` parity).
+
+Reference semantics (``src/ray/protobuf/core_worker.proto`` CancelTask,
+``python/ray/tests/test_cancel.py``): cancelling a queued task drops it and
+its refs raise TaskCancelledError; ``force=True`` on a running task kills
+the worker process; non-force interrupts cooperatively; actor calls can be
+cancelled without killing the actor.
+"""
+
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu import TaskCancelledError
+from ray_tpu.cluster import Cluster
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+# -- local backend ---------------------------------------------------------
+
+
+@pytest.fixture()
+def local():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def _busy(seconds: float):
+    # Pure-Python loop: cooperative injection needs bytecode execution.
+    deadline = time.monotonic() + seconds
+    x = 0
+    while time.monotonic() < deadline:
+        x += 1
+    return x
+
+
+def test_local_cancel_running(local):
+    @ray_tpu.remote
+    def spin():
+        return _busy(30.0)
+
+    ref = spin.remote()
+    time.sleep(0.3)  # let it start
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=10)
+
+
+def test_local_cancel_queued_actor_call(local):
+    @ray_tpu.remote
+    class A:
+        def slow(self):
+            return _busy(1.0)
+
+        def fast(self):
+            return "ok"
+
+    a = A.remote()
+    first = a.slow.remote()
+    queued = a.fast.remote()
+    ray_tpu.cancel(queued)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=10)
+    # The actor survives and keeps serving.
+    assert ray_tpu.get(a.fast.remote(), timeout=10) == "ok"
+    ray_tpu.get(first, timeout=10)
+
+
+def test_local_cancel_finished_is_noop(local):
+    @ray_tpu.remote
+    def f():
+        return 7
+
+    ref = f.remote()
+    assert ray_tpu.get(ref, timeout=10) == 7
+    ray_tpu.cancel(ref)
+    assert ray_tpu.get(ref, timeout=10) == 7
+
+
+# -- cluster backend -------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=1)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_cluster_cancel_pending_queue(cluster):
+    @ray_tpu.remote(num_cpus=1)
+    def hold():
+        time.sleep(3.0)
+        return "held"
+
+    @ray_tpu.remote(num_cpus=1)
+    def never():
+        return "ran"
+
+    blocker = hold.remote()
+    time.sleep(0.5)  # blocker occupies the only CPU
+    queued = never.remote()
+    time.sleep(0.3)
+    ray_tpu.cancel(queued)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=15)
+    assert ray_tpu.get(blocker, timeout=30) == "held"
+
+
+def test_cluster_force_cancel_running(cluster):
+    @ray_tpu.remote(num_cpus=1)
+    def sleep_forever():
+        time.sleep(600)
+
+    ref = sleep_forever.remote()
+    time.sleep(1.0)  # ensure it is running on a worker
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=20)
+
+    # The node replaces the killed worker: new tasks still run.
+    @ray_tpu.remote(num_cpus=1)
+    def ping():
+        return "pong"
+
+    assert ray_tpu.get(ping.remote(), timeout=30) == "pong"
+
+
+def test_cluster_cooperative_cancel_running(cluster):
+    @ray_tpu.remote(num_cpus=1)
+    def spin():
+        return _busy(60.0)
+
+    ref = spin.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=False)
+    t0 = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - t0 < 25  # interrupted, not run to completion
+
+
+def test_cluster_cancel_actor_call(cluster):
+    @ray_tpu.remote
+    class Worker:
+        def spin(self):
+            return _busy(60.0)
+
+        def ping(self):
+            return "pong"
+
+    w = Worker.remote()
+    assert ray_tpu.get(w.ping.remote(), timeout=30) == "pong"
+    running = w.spin.remote()
+    queued = w.ping.remote()
+    time.sleep(0.5)
+    ray_tpu.cancel(queued)      # still waiting behind spin
+    ray_tpu.cancel(running)     # interrupts the busy loop
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(running, timeout=30)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=30)
+    # The actor itself survives cancellation.
+    assert ray_tpu.get(w.ping.remote(), timeout=30) == "pong"
