@@ -1,0 +1,148 @@
+"""Runtime environments: per-task/actor env_vars, working_dir, py_modules.
+
+The reference ships these via its runtime-env agent with content-addressed
+package URIs cached per node (``python/ray/_private/runtime_env/packaging.py``,
+``dashboard/modules/runtime_env/runtime_env_agent.py:160``). Same protocol
+here, cluster-KV flavored:
+
+* The DRIVER packages each ``working_dir`` / ``py_modules`` entry into a
+  deterministic zip, content-hashes it, and uploads it to the head KV under
+  ``rtenv:pkg:<sha256>`` — once per content (put with overwrite=False).
+* The task/actor spec carries the resolved env: env_vars + package URIs +
+  the env's own hash (``env_key``).
+* Each NODE AGENT downloads + extracts packages into a per-hash cache dir
+  on first use, and keys its worker pool by ``env_key`` so processes with
+  different environments are never mixed (reference: worker pools keyed by
+  runtime-env hash in ``worker_pool.cc``).
+
+Scope note: runtime envs apply to the cluster backend; the in-process
+local backend cannot give each task its own interpreter environment.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import zipfile
+
+KV_PREFIX = "rtenv:pkg:"
+_ALLOWED_KEYS = {"env_vars", "working_dir", "py_modules"}
+
+
+def validate(env: dict) -> None:
+    if not isinstance(env, dict):
+        raise TypeError(f"runtime_env must be a dict, got {type(env)}")
+    unknown = set(env) - _ALLOWED_KEYS
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)}; "
+            f"supported: {sorted(_ALLOWED_KEYS)}"
+        )
+    ev = env.get("env_vars") or {}
+    if not all(isinstance(k, str) and isinstance(v, str)
+               for k, v in ev.items()):
+        raise TypeError("runtime_env['env_vars'] must be {str: str}")
+    wd = env.get("working_dir")
+    if wd is not None and not os.path.isdir(wd):
+        raise ValueError(f"runtime_env working_dir {wd!r} is not a directory")
+    for m in env.get("py_modules") or []:
+        if not os.path.exists(m):
+            raise ValueError(f"runtime_env py_module {m!r} does not exist")
+
+
+def _zip_path(root: str) -> bytes:
+    """Deterministic zip of a file or directory tree: sorted entries,
+    zeroed timestamps — equal content ⇒ equal bytes ⇒ equal URI."""
+    buf = io.BytesIO()
+    root = os.path.abspath(root)
+    base = os.path.basename(root.rstrip(os.sep))
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isfile(root):
+            entries = [(root, base)]
+        else:
+            entries = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames.sort()
+                for fn in sorted(filenames):
+                    full = os.path.join(dirpath, fn)
+                    rel = os.path.join(base, os.path.relpath(full, root))
+                    entries.append((full, rel))
+        for full, rel in entries:
+            zi = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+            zi.external_attr = (os.stat(full).st_mode & 0xFFFF) << 16
+            with open(full, "rb") as f:
+                zf.writestr(zi, f.read())
+    return buf.getvalue()
+
+
+def package(env: dict, kv_put) -> dict:
+    """Resolve a user runtime_env into a shippable spec, uploading package
+    zips to the cluster KV (content-addressed; no-op when already there).
+    ``kv_put(key, value, overwrite)`` is the head KV entry point."""
+    validate(env)
+    resolved: dict = {"env_vars": dict(env.get("env_vars") or {}),
+                      "packages": []}
+
+    def upload(path: str, kind: str) -> None:
+        blob = _zip_path(path)
+        digest = hashlib.sha256(blob).hexdigest()
+        kv_put(KV_PREFIX + digest, blob, False)
+        resolved["packages"].append({
+            "uri": digest,
+            "kind": kind,
+            "name": os.path.basename(os.path.abspath(path).rstrip(os.sep)),
+        })
+
+    if env.get("working_dir"):
+        upload(env["working_dir"], "working_dir")
+    for m in env.get("py_modules") or []:
+        upload(m, "py_module")
+    resolved["env_key"] = env_key(resolved)
+    return resolved
+
+
+def env_key(resolved: dict) -> str:
+    canon = json.dumps(
+        {"env_vars": resolved.get("env_vars", {}),
+         "packages": [(p["uri"], p["kind"]) for p in
+                      resolved.get("packages", [])]},
+        sort_keys=True,
+    )
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+def ensure_local(resolved: dict, kv_get, cache_root: str) -> dict:
+    """Materialize a resolved env on this node. Returns the worker-process
+    recipe: {"env_vars", "cwd", "py_paths"}. Package extraction is cached
+    by content hash — concurrent ensures of the same URI extract into a
+    tmp dir and rename (atomic; losers are no-ops)."""
+    env_vars = dict(resolved.get("env_vars", {}))
+    cwd = None
+    py_paths: list[str] = []
+    for pkg in resolved.get("packages", []):
+        dest = os.path.join(cache_root, pkg["uri"])
+        if not os.path.isdir(dest):
+            blob = kv_get(KV_PREFIX + pkg["uri"])
+            if blob is None:
+                raise RuntimeError(
+                    f"runtime_env package {pkg['uri'][:12]}… missing from KV"
+                )
+            tmp = dest + f".tmp.{os.getpid()}"
+            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                zf.extractall(tmp)
+            try:
+                os.replace(tmp, dest)
+            except OSError:
+                # Lost the race to a concurrent extraction of the same
+                # content — identical bytes, keep the winner.
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        if pkg["kind"] == "working_dir":
+            cwd = os.path.join(dest, pkg["name"])
+            py_paths.append(cwd)
+        else:  # py_module: importable from the cache dir holding it
+            py_paths.append(dest)
+    return {"env_vars": env_vars, "cwd": cwd, "py_paths": py_paths}

@@ -1,0 +1,150 @@
+"""Per-task/actor runtime environments on the cluster backend.
+
+Reference behavior (``python/ray/_private/runtime_env/``, agent at
+``dashboard/modules/runtime_env/runtime_env_agent.py:160``): env_vars /
+working_dir / py_modules apply per task or actor; packages are uploaded
+once (content-addressed URI), cached per node, and workers with different
+envs never share a process.
+"""
+
+import os
+import sys
+import textwrap
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=4)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _write_module(root, name, version):
+    mod = os.path.join(root, name)
+    os.makedirs(mod, exist_ok=True)
+    with open(os.path.join(mod, "__init__.py"), "w") as f:
+        f.write(f"VERSION = {version}\n")
+    return mod
+
+
+def test_env_vars_per_task(cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTENV_PROBE": "alpha"}})
+    def read_env():
+        return os.environ.get("RTENV_PROBE")
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("RTENV_PROBE")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "alpha"
+    # Plain tasks never land in the env worker.
+    assert ray_tpu.get(read_plain.remote(), timeout=60) is None
+
+
+def test_py_modules_two_versions_concurrently(cluster, tmp_path):
+    """Two actors with different py_modules import different versions of
+    the same module name, concurrently, on one node."""
+    d1 = _write_module(str(tmp_path / "v1"), "rtenv_mod", 1)
+    d2 = _write_module(str(tmp_path / "v2"), "rtenv_mod", 2)
+
+    @ray_tpu.remote
+    class Prober:
+        def version(self):
+            import rtenv_mod
+            return rtenv_mod.VERSION
+
+        def pid(self):
+            return os.getpid()
+
+    a1 = Prober.options(runtime_env={"py_modules": [d1]}).remote()
+    a2 = Prober.options(runtime_env={"py_modules": [d2]}).remote()
+    v1, v2 = ray_tpu.get(
+        [a1.version.remote(), a2.version.remote()], timeout=60)
+    assert (v1, v2) == (1, 2)
+    p1, p2 = ray_tpu.get([a1.pid.remote(), a2.pid.remote()], timeout=60)
+    assert p1 != p2
+
+
+def test_working_dir(cluster, tmp_path):
+    wd = tmp_path / "appdir"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload-42")
+    (wd / "helper.py").write_text(
+        textwrap.dedent(
+            """
+            def read():
+                with open("data.txt") as f:
+                    return f.read()
+            """
+        )
+    )
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(wd)})
+    def use_working_dir():
+        import helper  # importable: working_dir is on sys.path
+        return helper.read()
+
+    assert ray_tpu.get(use_working_dir.remote(), timeout=60) == "payload-42"
+
+
+def test_package_cache_reused(cluster, tmp_path):
+    """Same content ⇒ same URI ⇒ one KV package and one extraction."""
+    d = _write_module(str(tmp_path / "shared"), "rtenv_cached", 7)
+    env = {"py_modules": [d]}
+
+    @ray_tpu.remote
+    def probe():
+        import rtenv_cached
+        return rtenv_cached.VERSION, os.getpid()
+
+    r1 = ray_tpu.get(probe.options(runtime_env=env).remote(), timeout=60)
+    r2 = ray_tpu.get(probe.options(runtime_env=env).remote(), timeout=60)
+    assert r1[0] == r2[0] == 7
+    agent = cluster.nodes[0]
+    from ray_tpu._private.runtime_env import KV_PREFIX
+
+    from ray_tpu._private import worker as wm
+
+    keys = wm.backend().head.call("kv_keys", KV_PREFIX)
+    uris = os.listdir(agent._rtenv_cache_root)
+    uris = [u for u in uris if not u.endswith(".tmp")]
+    # One package for this module (other tests may have added more).
+    assert len(keys) >= 1
+    assert any(k[len(KV_PREFIX):] in set(uris) for k in keys)
+
+
+def test_env_worker_reuse_same_key(cluster):
+    """Tasks with the SAME runtime env reuse the env's worker process."""
+    env = {"env_vars": {"RTENV_REUSE": "yes"}}
+
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    first = ray_tpu.get(whoami.options(runtime_env=env).remote(), timeout=60)
+    time.sleep(0.2)  # let the worker return to its idle pool
+    second = ray_tpu.get(whoami.options(runtime_env=env).remote(), timeout=60)
+    assert first == second
+
+
+def test_bad_runtime_env_rejected(cluster):
+    @ray_tpu.remote(runtime_env={"working_dir": "/definitely/not/a/dir"})
+    def never():
+        return 1
+
+    with pytest.raises(ValueError):
+        never.remote()
