@@ -10,6 +10,7 @@ from ray_tpu.ops.flash_attention import flash_causal_attention
 from ray_tpu.ops.ring_attention import (
     ring_causal_attention,
     ring_causal_attention_local,
+    ring_flash_attention_local,
 )
 from ray_tpu.ops.ulysses import ulysses_attention, ulysses_attention_local
 from ray_tpu.ops.moe import init_moe_params, moe_ffn, moe_ffn_ep
@@ -20,6 +21,7 @@ __all__ = [
     "flash_causal_attention",
     "ring_causal_attention",
     "ring_causal_attention_local",
+    "ring_flash_attention_local",
     "ulysses_attention",
     "ulysses_attention_local",
     "init_moe_params",

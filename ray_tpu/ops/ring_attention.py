@@ -82,14 +82,149 @@ def ring_causal_attention_local(q, k, v, *, axis_size: int, axis: str = "sp",
     return out.astype(q.dtype)
 
 
+# -- Pallas-fused ring attention (SURVEY §7 hard-part 5) -------------------
+#
+# Each ring step runs the flash kernel on (local Q) x (current KV block):
+# per-block scores live only in VMEM tiles, never [B,H,C,C] in HBM. The
+# per-block results are BLOCK-normalized (out_i, lse_i); merging in log
+# space reconstructs the global softmax exactly:
+#     lse = logaddexp_i(lse_i);  out = sum_i exp(lse_i - lse) * out_i.
+# KV blocks strictly ahead of the Q shard are masked out of the merge with
+# lse_i = -inf (same FLOPs as the dense ring variant, which also computed
+# every block; skipping them is a load-balancing follow-up — cf. striped
+# attention).
+#
+# Backward is a second ring pass: _flash_bwd with the GLOBAL (out, lse)
+# yields this block's exact (dq, dk, dv) contributions (p = exp(s - lse)
+# is the true global probability of the tile). dQ accumulates locally;
+# dK/dV accumulators travel WITH their KV block and take one final
+# ppermute home.
+
+
+def _lse_to_weights(lse_bh, b, h, c):
+    """[B*H, C, 1] fp32 -> broadcastable [B, C, H, 1] weight exponent."""
+    return lse_bh.reshape(b, h, c, 1).transpose(0, 2, 1, 3)
+
+
+def _ring_flash_fwd(q, k, v, axis, axis_size, scale, interpret):
+    from ray_tpu.ops.flash_attention import _fit_block, _flash_fwd
+
+    b, c, h, d = q.shape
+    block_q = _fit_block(1024, c)
+    block_k = _fit_block(1024, c)
+    sp = axis_size
+    my_idx = jax.lax.axis_index(axis)
+    perm = [(i, (i - 1) % sp) for i in range(sp)]  # kv travels backward
+
+    kwargs = dict(block_q=block_q, block_k=block_k, softmax_scale=scale,
+                  interpret=interpret)
+    out_r, lse_r = _flash_fwd(q, k, v, causal=True, **kwargs)
+    out_r = out_r.astype(jnp.float32)
+    k_cur, v_cur = k, v
+    for i in range(1, sp):
+        k_cur = jax.lax.ppermute(k_cur, axis, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        kv_idx = (my_idx + i) % sp
+        o_i, lse_i = _flash_fwd(q, k_cur, v_cur, causal=False, **kwargs)
+        lse_i = jnp.where(kv_idx > my_idx, _NEG_INF, lse_i)
+        lse_new = jnp.logaddexp(lse_r, lse_i)
+        w_r = jnp.exp(_lse_to_weights(lse_r - lse_new, b, h, c))
+        w_i = jnp.exp(_lse_to_weights(lse_i - lse_new, b, h, c))
+        out_r = out_r * w_r + o_i.astype(jnp.float32) * w_i
+        lse_r = lse_new
+    return out_r.astype(q.dtype), lse_r
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash_attention(q, k, v, axis, axis_size, scale, interpret):
+    out, _ = _ring_flash_fwd(q, k, v, axis, axis_size, scale, interpret)
+    return out
+
+
+def _ring_vjp_fwd(q, k, v, axis, axis_size, scale, interpret):
+    out, lse = _ring_flash_fwd(q, k, v, axis, axis_size, scale, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_vjp_bwd(axis, axis_size, scale, interpret, res, g):
+    from ray_tpu.ops.flash_attention import _fit_block, _flash_bwd
+
+    q, k, v, out, lse = res
+    b, c, h, d = q.shape
+    block_q = _fit_block(1024, c)
+    block_k = _fit_block(1024, c)
+    sp = axis_size
+    my_idx = jax.lax.axis_index(axis)
+    perm = [(i, (i - 1) % sp) for i in range(sp)]
+
+    kwargs = dict(block_q=block_q, block_k=block_k, softmax_scale=scale,
+                  interpret=interpret)
+    dq = jnp.zeros(q.shape, jnp.float32)
+    k_cur, v_cur = k, v
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    for i in range(sp):
+        if i:
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+            dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
+            dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
+        if i:
+            # Blocks ahead of this Q shard contributed nothing forward.
+            # Masking through the lse (p = exp(s - huge) -> 0) zeroes
+            # their gradients WITHOUT the overflow risk of computing
+            # exp(s - lse) against an unrelated lse and multiplying by 0
+            # afterwards (0 * inf = nan).
+            kv_idx = (my_idx + i) % sp
+            ahead = kv_idx > my_idx
+            lse_use = jnp.where(ahead, jnp.full_like(lse, -_NEG_INF), lse)
+            keep = (~ahead).astype(jnp.float32)
+        else:
+            lse_use, keep = lse, 1.0
+        dq_i, dk_i, dv_i = _flash_bwd(
+            q, k_cur, v_cur, out, lse_use, g, causal=(i == 0), **kwargs)
+        dq_i = dq_i * keep
+        dk_i = dk_i * keep
+        dv_i = dv_i * keep
+        dq = dq + dq_i.astype(jnp.float32)
+        dk_acc = dk_acc + dk_i.astype(jnp.float32)
+        dv_acc = dv_acc + dv_i.astype(jnp.float32)
+    # One more hop returns each accumulator to its KV block's owner.
+    dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
+    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
+
+
+_ring_flash_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def ring_flash_attention_local(q, k, v, *, axis_size: int, axis: str = "sp",
+                               softmax_scale: float | None = None):
+    """Pallas-fused per-device ring attention body (call inside shard_map
+    over ``axis``); differentiable. Falls back implicitly to interpret
+    mode on CPU."""
+    d = q.shape[-1]
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+    interpret = jax.default_backend() == "cpu"
+    return _ring_flash_attention(
+        q, k, v, axis, axis_size, scale, interpret)
+
+
 def ring_causal_attention(q, k, v, mesh: Mesh, *, axis: str = "sp",
                           softmax_scale: float | None = None,
-                          batch_axes=("dp", "fsdp")):
-    """Full-array entry: q/k/v [B, T, H, D] with T sharded over ``axis``."""
+                          batch_axes=("dp", "fsdp"), impl: str = "fused"):
+    """Full-array entry: q/k/v [B, T, H, D] with T sharded over ``axis``.
+
+    ``impl="fused"`` (default) runs the flash kernel on every ring block;
+    ``impl="dense"`` keeps the einsum body (debug/fallback — materializes
+    [B,H,C,C] scores per block)."""
+    local = (ring_flash_attention_local if impl == "fused"
+             else ring_causal_attention_local)
     spec = P(batch_axes, axis, None, None)
     fn = shard_map(
         functools.partial(
-            ring_causal_attention_local, axis=axis,
+            local, axis=axis,
             axis_size=mesh.shape[axis], softmax_scale=softmax_scale,
         ),
         mesh=mesh,
