@@ -9,10 +9,21 @@ blocking handlers (long-poll style) are therefore fine.
 Wire format: 4-byte big-endian length || pickled {"m": method, "a": args,
 "k": kwargs} — responses {"ok": bool, "v": value} or {"ok": False,
 "e": exception}.
+
+Authentication: when a cluster token is configured (``RAY_TPU_CLUSTER_TOKEN``
+/ ``config.cluster_token``), every server sends a random challenge on
+accept and requires ``HMAC-SHA256(token, challenge)`` back before serving
+— unauthenticated peers never reach the pickle deserializer. The hello
+frame is sent either way so token/no-token peers fail fast instead of
+deadlocking. Without a token (the default for localhost dev clusters)
+behavior is unchanged.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import os
 import pickle
 import socket
 import struct
@@ -22,6 +33,16 @@ import traceback
 from typing import Any, Callable
 
 _LEN = struct.Struct(">I")
+
+
+def get_cluster_token() -> bytes:
+    from ray_tpu.core.config import config
+
+    return config.cluster_token.encode()
+
+
+class AuthError(Exception):
+    """The peer failed (or refused) the cluster-token handshake."""
 
 
 class RpcError(Exception):
@@ -56,8 +77,10 @@ def _recv_msg(sock: socket.socket) -> Any:
 class RpcServer:
     """Serves ``rpc_<method>`` methods of a handler object."""
 
-    def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0,
+                 token: bytes | None = None):
         self._handler = handler
+        self._token = get_cluster_token() if token is None else token
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -87,8 +110,34 @@ class RpcServer:
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
 
+    def _handshake_server(self, conn: socket.socket) -> bool:
+        """Raw-byte MUTUAL hello/challenge exchange — runs BEFORE any
+        pickle frame, so unauthenticated bytes never reach the
+        deserializer. The server also proves token knowledge over the
+        client's nonce, so a spoofed server (e.g. an attacker binding a
+        dead head's port) cannot downgrade reconnecting peers."""
+        challenge = os.urandom(32)
+        required = b"\x01" if self._token else b"\x00"
+        try:
+            conn.sendall(b"RTPA1" + required + challenge)
+            if not self._token:
+                return True
+            frame = _recv_exact(conn, 64)  # digest || client nonce
+            digest, client_nonce = frame[:32], frame[32:]
+            expect = hmac.new(
+                self._token, challenge, hashlib.sha256).digest()
+            ok = hmac.compare_digest(digest, expect)
+            proof = hmac.new(
+                self._token, client_nonce, hashlib.sha256).digest()
+            conn.sendall((b"\x01" if ok else b"\x00") + proof)
+            return ok
+        except (ConnectionLost, OSError):
+            return False
+
     def _serve_conn(self, conn: socket.socket):
         try:
+            if not self._handshake_server(conn):
+                return
             while True:
                 req = _recv_msg(conn)
                 try:
@@ -144,10 +193,12 @@ class RpcClient:
     caller-generated ids and writes are last-write-wins)."""
 
     def __init__(self, address: str, timeout: float = 60.0,
-                 reconnect_window: float = 0.0):
+                 reconnect_window: float = 0.0,
+                 token: bytes | None = None):
         self.address = address
         self._timeout = timeout
         self._reconnect_window = reconnect_window
+        self._token = get_cluster_token() if token is None else token
         self._local = threading.local()
         self._closed = False
 
@@ -157,8 +208,50 @@ class RpcClient:
             host, port = self.address.rsplit(":", 1)
             conn = socket.create_connection((host, int(port)), timeout=self._timeout)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                self._handshake_client(conn)
+            except BaseException:
+                conn.close()
+                raise
             self._local.conn = conn
         return conn
+
+    def _handshake_client(self, conn: socket.socket) -> None:
+        hello = _recv_exact(conn, 38)
+        if hello[:5] != b"RTPA1":
+            raise ConnectionLost(
+                f"{self.address}: not a ray_tpu RPC server")
+        required, challenge = hello[5:6], hello[6:]
+        if required == b"\x00":
+            if self._token:
+                # A token-configured client must never talk to an
+                # unauthenticated server: a spoofed listener on a dead
+                # peer's port would otherwise downgrade us into feeding
+                # its frames to pickle.
+                raise AuthError(
+                    f"{self.address} does not require the cluster token "
+                    f"this client is configured with (spoofed server?)"
+                )
+            return
+        if not self._token:
+            raise AuthError(
+                f"{self.address} requires a cluster token "
+                f"(set RAY_TPU_CLUSTER_TOKEN)"
+            )
+        client_nonce = os.urandom(32)
+        conn.sendall(
+            hmac.new(self._token, challenge, hashlib.sha256).digest()
+            + client_nonce)
+        reply = _recv_exact(conn, 33)  # verdict || server proof
+        if reply[:1] != b"\x01":
+            raise AuthError(f"{self.address} rejected the cluster token")
+        expect = hmac.new(
+            self._token, client_nonce, hashlib.sha256).digest()
+        if not hmac.compare_digest(reply[1:], expect):
+            raise AuthError(
+                f"{self.address} failed to prove the cluster token "
+                f"(spoofed server?)"
+            )
 
     def call(self, method: str, *args, timeout: float | None = None, **kwargs):
         deadline = (

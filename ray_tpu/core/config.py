@@ -1,0 +1,107 @@
+"""System configuration registry (``RAY_CONFIG`` analog).
+
+Reference: ``src/ray/common/ray_config_def.h`` + ``ray_config.h`` — a
+single typed registry of tunables, each overridable from the environment
+without code changes. Here every knob ``foo_bar`` reads its override from
+``RAY_TPU_FOO_BAR`` (parsed to the declared type) at first access;
+``config.foo_bar`` afterwards is cached process-wide.
+
+Usage:
+    from ray_tpu.core.config import config
+    interval = config.heartbeat_interval_s
+
+Tests / embedders can force values with ``config.override(name, value)``
+(and ``config.reset()`` to drop all overrides and re-read the env).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(raw: str) -> bool:
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+# name -> (type, default). The single source of truth for system knobs.
+_DEFS: dict[str, tuple[type, Any]] = {
+    # -- control plane -----------------------------------------------------
+    "heartbeat_interval_s": (float, 0.25),
+    "node_death_timeout_s": (float, 5.0),
+    "head_reconnect_window_s": (float, 15.0),
+    "head_snapshot_interval_s": (float, 0.2),
+    # -- worker pool -------------------------------------------------------
+    "workers_per_cpu": (int, 4),
+    "worker_start_timeout_s": (float, 60.0),
+    "worker_min_pool": (int, 4),
+    # -- object plane ------------------------------------------------------
+    "object_store_capacity_bytes": (int, 512 << 20),
+    "transfer_chunk_bytes": (int, 4 << 20),
+    "transfer_whole_fetch_max_bytes": (int, 8 << 20),
+    "transfer_pull_concurrency": (int, 8),
+    "spill_headroom_bytes": (int, 64 << 10),
+    # -- memory protection -------------------------------------------------
+    "memory_usage_threshold": (float, 0.95),
+    "memory_limit_bytes": (int, 0),  # 0 = no aggregate-RSS limit
+    "memory_monitor_interval_s": (float, 0.25),
+    # -- tasks -------------------------------------------------------------
+    "task_default_max_retries": (int, 3),
+    "pending_task_timeout_s": (float, 120.0),
+    # -- pubsub ------------------------------------------------------------
+    "pubsub_max_buffer": (int, 10_000),
+    "pubsub_subscriber_ttl_s": (float, 120.0),
+    # -- security ----------------------------------------------------------
+    "cluster_token": (str, ""),
+}
+
+
+class _Config:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache: dict[str, Any] = {}
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.get(name)
+
+    def get(self, name: str):
+        if name not in _DEFS:
+            raise AttributeError(f"unknown config {name!r}; known: "
+                                 f"{sorted(_DEFS)}")
+        with self._lock:
+            if name in self._cache:
+                return self._cache[name]
+            typ, default = _DEFS[name]
+            raw = os.environ.get(_ENV_PREFIX + name.upper())
+            if raw is None:
+                value = default
+            elif typ is bool:
+                value = _parse_bool(raw)
+            else:
+                value = typ(raw)
+            self._cache[name] = value
+            return value
+
+    def override(self, name: str, value) -> None:
+        if name not in _DEFS:
+            raise AttributeError(f"unknown config {name!r}")
+        with self._lock:
+            self._cache[name] = value
+
+    def reset(self, name: str | None = None) -> None:
+        with self._lock:
+            if name is None:
+                self._cache.clear()
+            else:
+                self._cache.pop(name, None)
+
+    def snapshot(self) -> dict:
+        return {name: self.get(name) for name in _DEFS}
+
+
+config = _Config()
