@@ -28,6 +28,7 @@ from ray_tpu.core.object_ref import (
     ActorError,
     GetTimeoutError,
     ObjectLostError,
+    OutOfMemoryError,
     TaskCancelledError,
     TaskError,
 )
@@ -54,6 +55,7 @@ __all__ = [
     "GetTimeoutError",
     "ObjectLostError",
     "ObjectRef",
+    "OutOfMemoryError",
     "TaskCancelledError",
     "TaskError",
     "available_resources",

@@ -27,9 +27,8 @@ from ray_tpu.core.object_ref import (
     ObjectLostError,
     TaskError,
 )
+from ray_tpu.core.config import config
 from ray_tpu.core.resources import demand_of
-
-DEFAULT_MAX_RETRIES = 3
 
 
 class ClusterBackend:
@@ -38,9 +37,12 @@ class ClusterBackend:
                  process_kind: str = "d"):
         import os
 
-        # 15s reconnect window: a head restart (GCS FT) retries instead of
+        # Reconnect window: a head restart (GCS FT) retries instead of
         # failing in-flight location/ref/schedule calls.
-        self.head = RpcClient(head_address, reconnect_window=15.0)
+        self.head = RpcClient(
+            head_address,
+            reconnect_window=config.head_reconnect_window_s,
+        )
         self.head_address = head_address
         self._agent_address = agent_address
         if node_id is None:
@@ -90,14 +92,22 @@ class ClusterBackend:
         self._flush_io_lock = threading.Lock()
         self._closed = False
         threading.Thread(target=self._ref_flush_loop, daemon=True).start()
+        self.process_kind = process_kind
         if process_kind == "d":
-            # Drivers stream worker stdout/stderr from the head; only
-            # lines emitted after this driver connected are shown.
+            # Drivers stream worker stdout/stderr from the head via the
+            # pubsub LOGS channel. Subscribe SYNCHRONOUSLY so lines
+            # emitted right after connect can't race the poll thread's
+            # startup and publish to zero subscribers.
             try:
-                self._log_start_seq, _ = self.head.call("drain_logs", 1 << 62)
+                self.head.call(
+                    "pubsub_subscribe", "logs:" + self.client_id, "LOGS")
+                subscribed = True
             except Exception:
-                self._log_start_seq = 0
-            threading.Thread(target=self._log_poll_loop, daemon=True).start()
+                subscribed = False  # the poll loop re-subscribes
+            threading.Thread(
+                target=self._log_poll_loop, args=(subscribed,),
+                daemon=True,
+            ).start()
 
     # -- plumbing ----------------------------------------------------------
 
@@ -218,7 +228,8 @@ class ClusterBackend:
                 # local_object_manager.h:110 analog).
                 try:
                     freed = self._agent_client().call(
-                        "spill", size + (64 << 10), timeout=60.0
+                        "spill", size + config.spill_headroom_bytes,
+                        timeout=60.0,
                     )
                 except (ConnectionLost, OSError):
                     freed = 0
@@ -349,21 +360,22 @@ class ClusterBackend:
         )
 
     # Node-to-node transfer tuning (object_manager.h:117, push_manager.h:29
-    # analog — pull-based here): objects above _WHOLE_FETCH_MAX stream in
-    # _CHUNK_SIZE pieces with at most _PULL_CONCURRENCY chunks in flight,
-    # so no RPC frame exceeds ~4 MiB and peak extra memory is a few
-    # chunks (not 2x size as with a single pickled frame). 4 MiB × 8
-    # in flight keeps a 64 MiB arg at 2 serial rounds instead of 16.
-    _CHUNK_SIZE = 4 << 20
-    _WHOLE_FETCH_MAX = 8 << 20
-    _PULL_CONCURRENCY = 8
+    # analog — pull-based here): objects above the whole-fetch max stream
+    # in bounded chunks with a capped number in flight, so no RPC frame
+    # exceeds ~chunk size and peak extra memory is a few chunks (not 2x
+    # size as with a single pickled frame). 4 MiB × 8 in flight keeps a
+    # 64 MiB arg at 2 serial rounds instead of 16. All three knobs read
+    # the config registry AT CALL TIME so env/override changes apply
+    # without re-importing (RAY_TPU_TRANSFER_*).
 
     def _pull_object(self, address: str, oid: str):
         """(meta, data) from a peer node: ONE round trip for small objects
         (data inlined in the info reply), bounded chunked streaming for
         large ones."""
+        chunk_size = config.transfer_chunk_bytes
         client = self._node_client(address)
-        info = client.call("fetch_object_info", oid, self._WHOLE_FETCH_MAX)
+        info = client.call(
+            "fetch_object_info", oid, config.transfer_whole_fetch_max_bytes)
         if info is None:
             return None
         meta, size, inline = info
@@ -371,12 +383,12 @@ class ClusterBackend:
             return meta, inline
 
         buf = bytearray(size)
-        offsets = list(range(0, size, self._CHUNK_SIZE))
+        offsets = list(range(0, size, chunk_size))
 
         def pull_chunk(off: int):
-            # Per-thread pooled connections => at most _PULL_CONCURRENCY
-            # frames in flight toward this node.
-            length = min(self._CHUNK_SIZE, size - off)
+            # Per-thread pooled connections cap the frames in flight
+            # toward this node at the pull-pool's thread count.
+            length = min(chunk_size, size - off)
             chunk = client.call("fetch_object_chunk", oid, off, length)
             if chunk is None or len(chunk) != length:
                 raise ObjectLostError(
@@ -406,7 +418,7 @@ class ClusterBackend:
                 pool = getattr(self, "_chunk_pool", None)
                 if pool is None:
                     pool = self._chunk_pool = ThreadPoolExecutor(
-                        self._PULL_CONCURRENCY,
+                        config.transfer_pull_concurrency,
                         thread_name_prefix="chunk-pull")
         return pool
 
@@ -641,9 +653,11 @@ class ClusterBackend:
                 spec.get("actor_id") if spec.get("method") else None,
             )
 
-    def _retry_submit(self, spec: dict, timeout: float = 120.0):
+    def _retry_submit(self, spec: dict, timeout: float | None = None):
         from ray_tpu.core.object_ref import TaskCancelledError
 
+        if timeout is None:
+            timeout = config.pending_task_timeout_s
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             time.sleep(0.25)
@@ -688,11 +702,13 @@ class ClusterBackend:
         kwargs: dict,
         *,
         num_returns: int = 1,
-        max_retries: int = DEFAULT_MAX_RETRIES,
+        max_retries: int | None = None,
         retry_exceptions: bool | tuple = False,
         name: str = "",
         **options,
     ) -> list[ObjectRef]:
+        if max_retries is None:
+            max_retries = config.task_default_max_retries
         task_id = ids.new_task_id()
         oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
         refs = [self.make_ref(o) for o in oids]
@@ -1019,27 +1035,41 @@ class ClusterBackend:
     def list_objects(self, limit: int = 1000) -> list:
         return self.head.call("list_objects", limit)
 
-    def _log_poll_loop(self) -> None:
-        """Driver-side log streaming: poll the head's worker-log ring and
-        echo lines to this process's stdout with a (pid=, node=) prefix —
-        the reference's log_monitor -> driver behavior, pull-based."""
-        seq = self._log_start_seq
+    def _log_poll_loop(self, subscribed: bool = False) -> None:
+        """Driver-side log streaming over the pubsub LOGS channel
+        (long-poll push, ``src/ray/pubsub`` analog — replaces the old
+        0.3s drain_logs polling; the drain RPC remains for CLI catch-up).
+        A None poll result means the head lost our subscription (restart):
+        re-subscribe and continue."""
+        sub_id = "logs:" + self.client_id
         while not self._closed:
-            time.sleep(0.3)
             try:
-                seq, entries = self.head.call("drain_logs", seq, timeout=5.0)
+                if not subscribed:
+                    self.head.call("pubsub_subscribe", sub_id, "LOGS")
+                    subscribed = True
+                got = self.head.call(
+                    "pubsub_poll", sub_id, 10.0, timeout=15.0)
             except Exception:
+                subscribed = False
+                time.sleep(0.5)
                 continue
-            for e in entries:
-                try:
-                    # sys.stdout may be swapped/closed under us (pytest
-                    # capture, daemonized drivers) — never kill the poller.
-                    print(
-                        f"(pid={e['pid']}, node={e['node_id'][-8:]}) "
-                        f"{e['line']}"
-                    )
-                except Exception:
-                    break
+            if got is None:
+                subscribed = False  # head restarted: state was in-memory
+                continue
+            msgs, _dropped = got
+            try:
+                for m in msgs:
+                    d = m["data"]
+                    for line in d["lines"]:
+                        print(
+                            f"(pid={d['pid']}, node={d['node_id'][-8:]}) "
+                            f"{line}"
+                        )
+            except Exception:
+                # sys.stdout may be swapped/closed under us (pytest
+                # capture) — drop this batch but NEVER kill the poller;
+                # stdout usually comes back.
+                continue
 
     def cluster_resources(self) -> dict:
         return self.head.call("cluster_resources")
@@ -1081,12 +1111,27 @@ class ClusterBackend:
         pool = getattr(self, "_chunk_pool", None)
         if pool is not None:
             pool.shutdown(wait=False)
+        if self.process_kind == "d":
+            # Only drivers subscribe; workers have nothing to clean up.
+            try:
+                self.head.call(
+                    "pubsub_unsubscribe", "logs:" + self.client_id)
+            except (ConnectionLost, OSError):
+                pass  # publisher TTL evicts the subscription anyway
         self._pins.clear()
         self.store.close()
         self.head.close()
 
 
-def connect(address: str, **kwargs) -> ClusterBackend:
-    """Backend factory for ``ray_tpu.init(address="host:port")``."""
-    address = address.removeprefix("ray://").removeprefix("tcp://")
-    return ClusterBackend(address)
+def connect(address: str, **kwargs):
+    """Backend factory for ``ray_tpu.init(address=...)``.
+
+    ``host:port`` — direct driver on a cluster machine (shared-memory
+    object plane). ``ray://host:port`` — remote client through a
+    ClientProxyServer (no shm needed; reference Ray Client semantics).
+    """
+    if address.startswith("ray://"):
+        from ray_tpu.util.client import ClientBackend
+
+        return ClientBackend(address.removeprefix("ray://"))
+    return ClusterBackend(address.removeprefix("tcp://"))

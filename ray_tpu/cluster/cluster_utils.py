@@ -65,9 +65,10 @@ class Cluster:
 
     def add_node(self, *, num_cpus: float | None = None,
                  resources: dict | None = None,
-                 store_capacity: int | None = None) -> NodeAgent:
+                 store_capacity: int | None = None,
+                 **agent_kwargs) -> NodeAgent:
         assert self.head is not None, "head not initialized"
-        kwargs = {}
+        kwargs = dict(agent_kwargs)
         if store_capacity is not None:
             kwargs["store_capacity"] = store_capacity
         node = NodeAgent(

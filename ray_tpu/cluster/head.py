@@ -23,8 +23,13 @@ from typing import Any, Optional
 
 from ray_tpu.cluster.rpc import RpcClient, RpcServer
 from ray_tpu.core import ids
+from ray_tpu.core.config import config
 
-DEAD_AFTER_S = 5.0  # heartbeat timeout (reference: num_heartbeats_timeout)
+# Heartbeat timeout (reference: num_heartbeats_timeout). The config knob
+# scales it: death is declared after node_death_timeout_s with a floor
+# that tolerates a few missed heartbeat intervals.
+DEAD_AFTER_S = max(config.node_death_timeout_s,
+                   10 * config.heartbeat_interval_s)
 
 
 class _PersistentStore:
@@ -102,6 +107,11 @@ class HeadServer:
         self._nodes: dict[str, NodeInfo] = {}
         self._kv: dict[str, Any] = {}
         self._kv_lock = threading.Lock()  # see rpc_kv_put — KV I/O only
+        # Generalized pub/sub plane (src/ray/pubsub analog): LOGS/ACTORS/
+        # NODES/ERRORS feeds with long-poll delivery (pubsub.py).
+        from ray_tpu.cluster.pubsub import Publisher
+
+        self.pubsub = Publisher()
         # object directory: oid -> {"nodes": set, "error": bool}
         self._objects: dict[str, dict] = {}
         self._objects_cv = threading.Condition(self._lock)
@@ -187,13 +197,14 @@ class HeadServer:
 
     def _snapshot_loop(self) -> None:
         """Persist the high-churn tables (actors/specs/PGs/object
-        locations) every 200ms when they changed — content-compared so
-        idle clusters write nothing. Crash loss window <= one interval;
-        lost object locations heal through lineage re-execution."""
+        locations) every snapshot interval when they changed —
+        content-compared so idle clusters write nothing. Crash loss
+        window <= one interval; lost object locations heal through
+        lineage re-execution."""
         import pickle as _pickle
 
         last: dict[str, bytes] = {}
-        while not self._stop.wait(0.2):
+        while not self._stop.wait(config.head_snapshot_interval_s):
             try:
                 with self._lock:
                     snap = {
@@ -225,6 +236,10 @@ class HeadServer:
         self._persist("node", node_id, {
             "address": address, "resources": dict(resources),
             "store_path": store_path,
+        })
+        self.pubsub.publish("NODES", node_id, {
+            "node_id": node_id, "state": "ALIVE", "address": address,
+            "resources": dict(resources),
         })
         return {"head_time": time.time()}
 
@@ -292,8 +307,11 @@ class HeadServer:
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
-                return
+                return  # already dead/unknown: no duplicate DEAD event
             node.alive = False
+            self.pubsub.publish("NODES", node_id, {
+                "node_id": node_id, "state": "DEAD", "cause": cause,
+            })
             # Actors on the node die with it; restartable ones reconstruct
             # elsewhere (GcsActorManager::OnNodeDead -> ReconstructActor).
             for info in list(self._actors.values()):
@@ -363,6 +381,28 @@ class HeadServer:
     def rpc_kv_keys(self, prefix=""):
         with self._kv_lock:
             return [k for k in self._kv if k.startswith(prefix)]
+
+    # -- pubsub -----------------------------------------------------------
+
+    def rpc_pubsub_subscribe(self, sub_id, channel, keys=None):
+        return self.pubsub.subscribe(sub_id, channel, keys)
+
+    def rpc_pubsub_unsubscribe(self, sub_id, channel=None):
+        return self.pubsub.unsubscribe(sub_id, channel)
+
+    def rpc_pubsub_poll(self, sub_id, timeout=10.0, max_msgs=1000):
+        # Long-poll: safe to block — the RPC server is thread-per-
+        # connection and subscribers poll from a dedicated thread (whose
+        # pooled connection is its own).
+        return self.pubsub.poll(sub_id, min(float(timeout), 30.0), max_msgs)
+
+    def rpc_publish(self, channel, key, message):
+        """External publishers (agents/workers) push through the head —
+        e.g. error reports (``rpc_report_error``-style feeds)."""
+        return self.pubsub.publish(channel, key, message)
+
+    def rpc_pubsub_stats(self):
+        return self.pubsub.stats()
 
     # -- distributed ref-counting -----------------------------------------
 
@@ -618,6 +658,8 @@ class HeadServer:
                 "max_task_retries": rec.get("max_task_retries", 0),
             }
             self._actors_cv.notify_all()
+            info = dict(self._actors[actor_id])
+        self.pubsub.publish("ACTORS", actor_id, info)
         return True
 
     def rpc_get_actor(self, actor_id, timeout=10.0):
@@ -684,12 +726,14 @@ class HeadServer:
             info["death_cause"] = cause
             info["num_restarts"] = info.get("num_restarts", 0) + 1
             self._actors_cv.notify_all()
+            self.pubsub.publish("ACTORS", actor_id, dict(info))
             threading.Thread(
                 target=self._restart_actor, args=(actor_id,), daemon=True
             ).start()
             return
         info["state"] = "DEAD"
         info["death_cause"] = cause
+        self.pubsub.publish("ACTORS", actor_id, dict(info))
         name = info.get("name")
         if name and self._named_actors.get(name) == actor_id:
             del self._named_actors[name]
@@ -799,6 +843,11 @@ class HeadServer:
                     "pid": pid,
                     "line": line,
                 })
+        # Push-path for live followers (drivers long-poll the LOGS
+        # channel); the ring above stays for cursor-based catch-up (CLI).
+        self.pubsub.publish(
+            "LOGS", node_id, {"node_id": node_id, "pid": pid, "lines": lines}
+        )
         return True
 
     def rpc_drain_logs(self, after_seq: int = 0, limit: int = 1000):

@@ -1,0 +1,149 @@
+"""Node memory monitor + worker killing policy (OOM protection).
+
+Reference: ``src/ray/common/memory_monitor.h:52`` (periodic usage check
+against a threshold, cgroup-aware) and
+``src/ray/raylet/worker_killing_policy.h:30`` (pick a victim worker when
+the node is about to OOM, preferring the newest task so the oldest —
+most-progressed — work survives; killed tasks fail with an OOM-specific
+error rather than taking down the whole node).
+
+Two trigger modes:
+* system threshold — used/total of the node (MemAvailable-based, cgroup
+  limit respected when present) exceeds ``usage_threshold`` (default
+  0.95, env ``RAY_TPU_MEMORY_USAGE_THRESHOLD``);
+* worker aggregate limit — the summed RSS of this agent's workers
+  exceeds ``limit_bytes`` (env ``RAY_TPU_MEMORY_LIMIT_BYTES``; unset by
+  default). This is also the deterministic hook tests use.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def system_memory() -> tuple[int, int]:
+    """(used_bytes, total_bytes), respecting a cgroup-v2 limit if one is
+    below the machine total (containers)."""
+    total = avail = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+    except OSError:
+        return 0, 1
+    used = total - avail
+    try:
+        with open("/sys/fs/cgroup/memory.max") as f:
+            raw = f.read().strip()
+        if raw != "max":
+            climit = int(raw)
+            if 0 < climit < total:
+                with open("/sys/fs/cgroup/memory.current") as f:
+                    cused = int(f.read().strip())
+                return cused, climit
+    except (OSError, ValueError):
+        pass
+    return used, max(total, 1)
+
+
+def process_rss(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+class MemoryMonitor:
+    """Watches memory and asks the agent to kill a worker on pressure.
+
+    The victim policy (``pick_victim``) prefers, in order:
+    1. the plain-task worker whose task started most recently (its lost
+       progress is smallest; retriable by the owner's policy),
+    2. the newest actor worker (its restart budget applies).
+    Idle workers hold no task and are never victims — their memory is the
+    pool's, reclaimed separately by idle cleanup.
+    """
+
+    def __init__(self, agent, *, usage_threshold: float | None = None,
+                 limit_bytes: int | None = None,
+                 interval_s: float | None = None):
+        from ray_tpu.core.config import config
+
+        if usage_threshold is None:
+            usage_threshold = config.memory_usage_threshold
+        if limit_bytes is None:
+            limit_bytes = config.memory_limit_bytes or None
+        self.agent = agent
+        self.usage_threshold = usage_threshold
+        self.limit_bytes = limit_bytes
+        self.interval_s = (config.memory_monitor_interval_s
+                           if interval_s is None else interval_s)
+        self.kills = 0  # observability: how many OOM kills this node did
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        while not self.agent._shutdown.wait(self.interval_s):
+            try:
+                self.check_once()
+            except Exception:
+                continue  # the monitor must never die
+
+    # -- one check ---------------------------------------------------------
+
+    def check_once(self) -> bool:
+        """Returns True if a worker was killed this check."""
+        reason = None
+        if self.limit_bytes is not None:
+            rss = self.workers_rss()
+            if rss > self.limit_bytes:
+                reason = (f"worker memory {rss >> 20} MiB exceeds the "
+                          f"node limit {self.limit_bytes >> 20} MiB")
+        if reason is None and self.usage_threshold < 1.0:
+            used, total = system_memory()
+            if used / total > self.usage_threshold:
+                reason = (f"node memory usage {used / total:.0%} above "
+                          f"threshold {self.usage_threshold:.0%}")
+        if reason is None:
+            return False
+        picked = self.pick_victim()
+        if picked is None:
+            return False
+        victim, expected_task = picked
+        if not self.agent.kill_worker_oom(victim, reason, expected_task):
+            return False  # victim's task ended meanwhile: re-evaluate next tick
+        self.kills += 1
+        # Give the kill time to actually release memory before the next
+        # check re-fires (the reap loop runs async).
+        time.sleep(0.2)
+        return True
+
+    def workers_rss(self) -> int:
+        with self.agent._lock:
+            pids = [w.proc.pid for w in self.agent._workers.values()
+                    if w.proc.poll() is None]
+        return sum(process_rss(p) for p in pids)
+
+    def pick_victim(self):
+        with self.agent._lock:
+            busy = [w for w in self.agent._workers.values()
+                    if w.proc.poll() is None and w.current_task is not None]
+            tasks = [w for w in busy if not w.is_actor]
+            pool = tasks or [w for w in busy if w.is_actor]
+            if not pool:
+                return None
+            # Newest task = least progress lost (retriable-lifo policy).
+            # Return (worker, its-observed-task) so the kill can abort if
+            # the worker moved on to different work in the meantime.
+            w = max(pool, key=lambda w: w.current_task.get("started_at", 0.0))
+            return w, w.current_task

@@ -29,9 +29,10 @@ from typing import Optional
 from ray_tpu._native.shm_store import ShmStore
 from ray_tpu.cluster.rpc import RpcClient, RpcServer
 from ray_tpu.core import ids
+from ray_tpu.core.config import config
 from ray_tpu.core.resources import ResourcePool
 
-DEFAULT_STORE_CAPACITY = 512 << 20
+DEFAULT_STORE_CAPACITY = config.object_store_capacity_bytes
 
 
 class _Worker:
@@ -60,12 +61,15 @@ class NodeAgent:
         store_capacity: int = DEFAULT_STORE_CAPACITY,
         host: str = "127.0.0.1",
         session: str | None = None,
+        memory_usage_threshold: float | None = None,
+        memory_limit_bytes: int | None = None,
     ):
         self.node_id = ids.new_node_id()
         self.head_address = head_address
         # Reconnect window so a restarting head (GCS FT) doesn't fail
         # in-flight add_location/register calls from this agent.
-        self.head = RpcClient(head_address, reconnect_window=15.0)
+        self.head = RpcClient(
+            head_address, reconnect_window=config.head_reconnect_window_s)
         node_res = {"CPU": float(num_cpus if num_cpus is not None else os.cpu_count() or 8)}
         node_res.update(resources or {})
         self.pool = ResourcePool(node_res)
@@ -85,7 +89,10 @@ class NodeAgent:
         # Idle pools keyed by runtime-env hash (worker_pool.cc keys its
         # pools by runtime-env hash the same way; "" = no runtime env).
         self._idle: dict[str, list[_Worker]] = {}
-        self._max_workers = max(4, int(node_res.get("CPU", 4)) * 4)
+        self._max_workers = max(
+            config.worker_min_pool,
+            int(node_res.get("CPU", 4)) * config.workers_per_cpu,
+        )
         # Materialized runtime-env package cache (per node, content-hashed).
         self._rtenv_cache_root = f"/tmp/ray_tpu_rtenv_{session}"
         os.makedirs(self._rtenv_cache_root, exist_ok=True)
@@ -119,6 +126,16 @@ class NodeAgent:
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         threading.Thread(target=self._dispatch_loop, daemon=True).start()
         threading.Thread(target=self._reap_loop, daemon=True).start()
+        # OOM protection (memory_monitor.h / worker_killing_policy.h
+        # analog): watch node memory, kill the newest task's worker under
+        # pressure; its refs raise OutOfMemoryError.
+        from ray_tpu.cluster.memory_monitor import MemoryMonitor
+
+        self.memory_monitor = MemoryMonitor(
+            self, usage_threshold=memory_usage_threshold,
+            limit_bytes=memory_limit_bytes,
+        )
+        self.memory_monitor.start()
 
     # -- worker pool ------------------------------------------------------
 
@@ -183,10 +200,13 @@ class NodeAgent:
             w.ready.set()
         return True
 
-    def _checkout_worker(self, timeout: float = 60.0, env_key: str = "",
+    def _checkout_worker(self, timeout: float | None = None,
+                         env_key: str = "",
                          resolved_env: dict | None = None) -> _Worker:
         """Idle worker of the SAME runtime env, or a fresh one spawned
         into it (lease grant, ``PopWorker`` analog)."""
+        if timeout is None:
+            timeout = config.worker_start_timeout_s
         with self._lock:
             pool = self._idle.get(env_key)
             if pool:
@@ -308,6 +328,19 @@ class NodeAgent:
                     "worker_logs", self.node_id, pid, log_lines)
             except Exception:
                 pass  # head restarting/unreachable: logs are best-effort
+        failed = [r for r in task_events if r.get("state") == "FAILED"]
+        if failed:
+            # Error feed (reference: error_info pubsub to the driver).
+            try:
+                self.head.call("publish", "ERRORS", self.node_id, {
+                    "node_id": self.node_id, "pid": pid,
+                    "errors": [
+                        {"task_id": r["task_id"], "name": r.get("name"),
+                         "error": r.get("error")} for r in failed
+                    ],
+                })
+            except Exception:
+                pass
         return True
 
     def rpc_list_task_records(self, limit: int = 1000):
@@ -384,6 +417,7 @@ class NodeAgent:
         self._record_task(spec, "RUNNING")
         w.current_task = {
             "spec": spec, "pool": pool, "demand": demand, "released": False,
+            "started_at": time.monotonic(),
         }
         # A cancel that raced the queue→checkout window parked its id in
         # the cancelled set; honor it now that the task is attributable.
@@ -540,6 +574,23 @@ class NodeAgent:
             return False
         return True
 
+    def kill_worker_oom(self, w: _Worker, reason: str,
+                        expected_task=None) -> bool:
+        """Memory-monitor kill: the task fails with OutOfMemoryError (not
+        a retriable worker death), actors go through their restart
+        budget. The reap loop finishes the cleanup. ``expected_task`` is
+        the current_task the monitor observed when it picked the victim —
+        if the worker has since finished it (and possibly taken an
+        unrelated task or gone idle), the kill is aborted."""
+        with self._lock:
+            current = w.current_task
+            if expected_task is not None and current is not expected_task:
+                return False
+            if current is not None:
+                current["oom_reason"] = reason
+            w.proc.kill()
+        return True
+
     def _on_worker_failure(self, w: _Worker, cause: str):
         with self._lock:
             self._workers.pop(w.worker_id, None)
@@ -581,6 +632,15 @@ class NodeAgent:
                 # Force-cancel killed this worker on purpose: the result is
                 # TaskCancelledError, not a retriable worker death.
                 self._cancel_spec(spec)
+            elif current.get("oom_reason"):
+                from ray_tpu.core.object_ref import OutOfMemoryError
+
+                self._store_task_error(
+                    spec,
+                    OutOfMemoryError(spec.get("fname", "task"),
+                                     current["oom_reason"]),
+                    "FAILED",
+                )
             else:
                 self._fail_task(spec, f"worker died: {cause}")  # ends borrows
 
@@ -844,7 +904,7 @@ class NodeAgent:
     # -- lifecycle --------------------------------------------------------
 
     def _heartbeat_loop(self):
-        while not self._shutdown.wait(0.25):
+        while not self._shutdown.wait(config.heartbeat_interval_s):
             try:
                 resp = self.head.call(
                     "heartbeat", self.node_id, self.pool.available(),

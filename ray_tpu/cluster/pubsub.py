@@ -1,0 +1,138 @@
+"""Generalized pub/sub plane (reference: ``src/ray/pubsub/README.md``).
+
+The reference's GCS publisher fans object/actor/node/log/error feeds out
+to subscribers over long-poll batches with per-subscriber bounded buffers
+(``pubsub/publisher.h``: one outstanding poll per subscriber, messages
+buffered between polls, slow subscribers lose oldest messages rather than
+stalling the publisher). Same protocol here, hosted in the head:
+
+* ``subscribe(sub_id, channel, keys)`` — keys=None means the whole
+  channel; a key list narrows delivery (per-entity subscription).
+* ``poll(sub_id, timeout)`` — long-poll: returns buffered messages
+  immediately or blocks until one arrives / timeout. Also reports how
+  many messages were dropped on overflow since the last poll.
+* ``publish(channel, key, message)`` — fan out to matching subscribers.
+
+Channels in use: ``LOGS`` (worker stdout/stderr), ``ACTORS`` (lifecycle
+state changes), ``NODES`` (membership), ``ERRORS`` (pushed task errors).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from ray_tpu.core.config import config
+
+CHANNELS = ("LOGS", "ACTORS", "NODES", "ERRORS")
+
+
+class _Subscriber:
+    __slots__ = ("queue", "dropped", "channels", "last_seen")
+
+    def __init__(self):
+        self.queue: collections.deque = collections.deque()
+        self.dropped = 0
+        # channel -> None (all keys) | set of keys
+        self.channels: dict[str, set | None] = {}
+        self.last_seen = time.monotonic()
+
+
+class Publisher:
+    def __init__(self, max_buffer: int | None = None,
+                 subscriber_ttl_s: float | None = None):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._subs: dict[str, _Subscriber] = {}
+        # Config read at construction (not import) so overrides apply.
+        self._max_buffer = (config.pubsub_max_buffer
+                            if max_buffer is None else max_buffer)
+        self._ttl = (config.pubsub_subscriber_ttl_s
+                     if subscriber_ttl_s is None else subscriber_ttl_s)
+
+    def subscribe(self, sub_id: str, channel: str,
+                  keys: list | None = None) -> bool:
+        if channel not in CHANNELS:
+            raise ValueError(f"unknown channel {channel!r}")
+        with self._lock:
+            sub = self._subs.get(sub_id)
+            if sub is None:
+                sub = self._subs[sub_id] = _Subscriber()
+            if keys is None:
+                sub.channels[channel] = None
+            else:
+                have = sub.channels.get(channel)
+                if have is None and channel in sub.channels:
+                    pass  # already subscribed to ALL keys: keep that
+                else:
+                    sub.channels[channel] = (have or set()) | set(keys)
+        return True
+
+    def unsubscribe(self, sub_id: str, channel: str | None = None) -> bool:
+        with self._lock:
+            sub = self._subs.get(sub_id)
+            if sub is None:
+                return False
+            if channel is None:
+                del self._subs[sub_id]
+            else:
+                sub.channels.pop(channel, None)
+                if not sub.channels:
+                    del self._subs[sub_id]
+        return True
+
+    def publish(self, channel: str, key: str, message) -> int:
+        """Returns the number of subscribers the message was queued to."""
+        delivered = 0
+        now = time.monotonic()
+        with self._cv:
+            dead = []
+            for sub_id, sub in self._subs.items():
+                keys = sub.channels.get(channel, "absent")
+                if keys == "absent" or (keys is not None and key not in keys):
+                    continue
+                if now - sub.last_seen > self._ttl:
+                    dead.append(sub_id)  # poller gone: stop buffering
+                    continue
+                sub.queue.append(
+                    {"channel": channel, "key": key, "data": message})
+                if len(sub.queue) > self._max_buffer:
+                    sub.queue.popleft()
+                    sub.dropped += 1
+                delivered += 1
+            for sub_id in dead:
+                del self._subs[sub_id]
+            if delivered:
+                self._cv.notify_all()
+        return delivered
+
+    def poll(self, sub_id: str, timeout: float = 10.0,
+             max_msgs: int = 1000):
+        """Long-poll: (messages, dropped_since_last_poll). An unknown
+        sub_id returns immediately (the caller should re-subscribe — the
+        head may have restarted, pubsub state is not persisted)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                sub = self._subs.get(sub_id)
+                if sub is None:
+                    return None  # not subscribed (anymore)
+                sub.last_seen = time.monotonic()
+                if sub.queue:
+                    out = []
+                    while sub.queue and len(out) < max_msgs:
+                        out.append(sub.queue.popleft())
+                    dropped, sub.dropped = sub.dropped, 0
+                    return out, dropped
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return [], 0
+                self._cv.wait(remaining)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "subscribers": len(self._subs),
+                "buffered": sum(len(s.queue) for s in self._subs.values()),
+            }

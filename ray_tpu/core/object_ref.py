@@ -115,6 +115,30 @@ class TaskCancelledError(TaskError):
         )
 
 
+class OutOfMemoryError(TaskError):
+    """The node's memory monitor killed this task's worker to protect the
+    node (cf. ``ray.exceptions.OutOfMemoryError``; policy in
+    ``worker_killing_policy.h``)."""
+
+    def __init__(self, function_name: str = "task",
+                 remote_traceback: str = "",
+                 cause_repr: str = "oom-killed"):
+        self.function_name = function_name
+        self.remote_traceback = remote_traceback
+        self.cause_repr = cause_repr
+        Exception.__init__(
+            self,
+            f"task {function_name} was killed by the memory monitor: "
+            f"{remote_traceback}"
+        )
+
+    def __reduce__(self):
+        return (
+            OutOfMemoryError,
+            (self.function_name, self.remote_traceback, self.cause_repr),
+        )
+
+
 class ActorError(Exception):
     """The actor died before/while executing this call (cf. RayActorError)."""
 

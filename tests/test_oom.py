@@ -1,0 +1,96 @@
+"""OOM protection: memory monitor + worker killing policy.
+
+Reference: ``src/ray/common/memory_monitor.h:52`` (threshold check,
+cgroup-aware) and ``src/ray/raylet/worker_killing_policy.h:30`` (victim
+selection — newest task first, so the most-progressed work survives;
+killed tasks surface ``OutOfMemoryError`` instead of OOMing the node).
+"""
+
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu import OutOfMemoryError
+from ray_tpu.cluster import Cluster
+from ray_tpu.cluster.memory_monitor import process_rss, system_memory
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+def test_system_memory_sane():
+    used, total = system_memory()
+    assert 0 < used < total
+
+
+def test_process_rss_self():
+    import os
+    assert process_rss(os.getpid()) > 1 << 20  # a Python process: >1 MiB
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    # 600 MiB aggregate-worker-RSS limit: one hog crosses it alone.
+    c.add_node(num_cpus=2, memory_limit_bytes=600 << 20,
+               memory_usage_threshold=1.0)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_memory_hog_killed_with_oom_error(cluster):
+    @ray_tpu.remote(num_cpus=1)
+    def hog():
+        import numpy as np
+        blobs = []
+        for _ in range(40):
+            # Touch pages so RSS actually grows.
+            blobs.append(np.ones(64 << 20, dtype=np.uint8))
+            time.sleep(0.05)
+        return len(blobs)
+
+    ref = hog.remote()
+    with pytest.raises(OutOfMemoryError) as ei:
+        ray_tpu.get(ref, timeout=60)
+    assert "memory" in str(ei.value)
+    assert cluster.nodes[0].memory_monitor.kills >= 1
+
+    # The node survived: new tasks still run.
+    @ray_tpu.remote(num_cpus=1)
+    def ping():
+        return "pong"
+
+    assert ray_tpu.get(ping.remote(), timeout=30) == "pong"
+
+
+def test_victim_is_newest_task(cluster):
+    """Two tasks: an old modest one and a new hog — the policy kills the
+    NEWEST (the hog), and the older task completes."""
+    @ray_tpu.remote(num_cpus=1)
+    def modest():
+        import numpy as np
+        keep = np.ones(32 << 20, dtype=np.uint8)
+        time.sleep(4.0)
+        return int(keep[0])
+
+    @ray_tpu.remote(num_cpus=1)
+    def hog():
+        import numpy as np
+        blobs = []
+        for _ in range(40):
+            blobs.append(np.ones(64 << 20, dtype=np.uint8))
+            time.sleep(0.05)
+        return len(blobs)
+
+    old = modest.remote()
+    time.sleep(1.0)  # ensure ordering: modest started first
+    new = hog.remote()
+    with pytest.raises(OutOfMemoryError):
+        ray_tpu.get(new, timeout=60)
+    assert ray_tpu.get(old, timeout=60) == 1

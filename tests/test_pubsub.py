@@ -1,0 +1,178 @@
+"""Pub/sub plane tests (reference: ``src/ray/pubsub/README.md:7-27``).
+
+Long-poll delivery, per-key subscriptions, slow-subscriber overflow
+bounds, and the feeds riding the plane: ACTORS lifecycle, NODES
+membership, LOGS (worker stdout reaches a subscriber), ERRORS.
+"""
+
+import sys
+import threading
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.cluster import Cluster
+from ray_tpu.cluster.pubsub import Publisher
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+# -- unit: Publisher -------------------------------------------------------
+
+
+def test_publish_then_poll():
+    p = Publisher()
+    p.subscribe("s1", "ACTORS")
+    assert p.publish("ACTORS", "a1", {"state": "ALIVE"}) == 1
+    msgs, dropped = p.poll("s1", timeout=1.0)
+    assert dropped == 0
+    assert [m["key"] for m in msgs] == ["a1"]
+
+
+def test_key_filtered_subscription():
+    p = Publisher()
+    p.subscribe("s1", "ACTORS", keys=["a1"])
+    p.publish("ACTORS", "a1", 1)
+    p.publish("ACTORS", "a2", 2)
+    msgs, _ = p.poll("s1", timeout=0.1)
+    assert [m["key"] for m in msgs] == ["a1"]
+
+
+def test_long_poll_blocks_until_publish():
+    p = Publisher()
+    p.subscribe("s1", "NODES")
+    got = {}
+
+    def poller():
+        got["r"] = p.poll("s1", timeout=5.0)
+
+    t = threading.Thread(target=poller)
+    t.start()
+    time.sleep(0.2)
+    p.publish("NODES", "n1", {"state": "DEAD"})
+    t.join(timeout=5)
+    msgs, _ = got["r"]
+    assert msgs and msgs[0]["data"]["state"] == "DEAD"
+
+
+def test_slow_subscriber_bounded():
+    p = Publisher(max_buffer=10)
+    p.subscribe("s1", "LOGS")
+    for i in range(25):
+        p.publish("LOGS", "n", i)
+    msgs, dropped = p.poll("s1", timeout=0.1)
+    assert len(msgs) == 10
+    assert dropped == 15
+    assert msgs[0]["data"] == 15  # oldest were dropped
+
+
+def test_unknown_subscriber_poll_returns_none():
+    p = Publisher()
+    assert p.poll("nobody", timeout=0.05) is None
+
+
+def test_unsubscribe():
+    p = Publisher()
+    p.subscribe("s1", "ACTORS")
+    p.unsubscribe("s1")
+    assert p.publish("ACTORS", "a", 1) == 0
+
+
+# -- integration: feeds over a live cluster --------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _drain(head, sub_id, want, timeout=20.0):
+    """Poll until ``want(msg)`` matches one message; returns it."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = head.call("pubsub_poll", sub_id, 1.0, timeout=10.0)
+        if got is None:
+            raise AssertionError("subscription lost")
+        msgs, _ = got
+        for m in msgs:
+            if want(m):
+                return m
+    raise AssertionError("expected message never arrived")
+
+
+def test_actor_lifecycle_feed(cluster):
+    head = worker_mod.backend().head
+    head.call("pubsub_subscribe", "t-actors", "ACTORS")
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    alive = _drain(head, "t-actors",
+                   lambda m: m["data"]["state"] == "ALIVE")
+    assert alive["data"]["class_name"] == "A"
+    ray_tpu.kill(a)
+    dead = _drain(head, "t-actors",
+                  lambda m: m["data"]["state"] == "DEAD"
+                  and m["key"] == alive["key"])
+    assert "kill" in dead["data"]["death_cause"]
+
+
+def test_worker_logs_feed(cluster):
+    head = worker_mod.backend().head
+    head.call("pubsub_subscribe", "t-logs", "LOGS")
+
+    @ray_tpu.remote
+    def shout():
+        print("pubsub-log-probe")
+        return 1
+
+    assert ray_tpu.get(shout.remote(), timeout=30) == 1
+    m = _drain(head, "t-logs",
+               lambda m: any("pubsub-log-probe" in ln
+                             for ln in m["data"]["lines"]))
+    assert m["data"]["pid"]
+
+
+def test_error_feed(cluster):
+    head = worker_mod.backend().head
+    head.call("pubsub_subscribe", "t-errs", "ERRORS")
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("pubsub-error-probe")
+
+    ref = boom.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(ref, timeout=30)
+    m = _drain(head, "t-errs",
+               lambda m: any("pubsub-error-probe" in (e["error"] or "")
+                             for e in m["data"]["errors"]))
+    assert m["data"]["node_id"]
+
+
+def test_node_membership_feed(cluster):
+    head = worker_mod.backend().head
+    head.call("pubsub_subscribe", "t-nodes", "NODES")
+    n = cluster.add_node(num_cpus=1)
+    alive = _drain(head, "t-nodes",
+                   lambda m: m["data"]["state"] == "ALIVE"
+                   and m["key"] == n.node_id)
+    assert alive["data"]["resources"]["CPU"] == 1.0
+    cluster.remove_node(n)
+    _drain(head, "t-nodes",
+           lambda m: m["data"]["state"] == "DEAD" and m["key"] == n.node_id,
+           timeout=30.0)

@@ -73,12 +73,12 @@ def test_large_object_crosses_nodes_chunked(cluster):
     np.testing.assert_array_equal(
         value, rng.integers(0, 255, SIZE, dtype=np.uint8))
 
-    from ray_tpu.cluster.client import ClusterBackend
+    from ray_tpu.core.config import config
 
     stats = remote_node._fetch_stats
     assert stats["info"] == 1, stats
     # Serialized payload = array + pickle framing, so one extra chunk.
-    n_chunks = SIZE // ClusterBackend._CHUNK_SIZE
+    n_chunks = SIZE // config.transfer_chunk_bytes
     assert n_chunks <= stats["chunks"] <= n_chunks + 2, stats
     assert stats["whole"] == 0, stats
     # Peak allocation during the pull stays ~1x payload plus the bounded
@@ -86,7 +86,7 @@ def test_large_object_crosses_nodes_chunked(cluster):
     # its RPC reply is decoded); the deserialized copy is avoided because
     # numpy views the assembled buffer. The window is an ABSOLUTE bound —
     # at 1 GiB the peak is still size + ~window, never 2x size.
-    window = (ClusterBackend._CHUNK_SIZE * ClusterBackend._PULL_CONCURRENCY
+    window = (config.transfer_chunk_bytes * config.transfer_pull_concurrency
               * 4)
     assert peak - base < SIZE + window, (base, peak, window)
 
