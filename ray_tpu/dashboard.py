@@ -1,0 +1,144 @@
+"""Dashboard: REST aggregation of cluster state over HTTP.
+
+Reference: ``dashboard/head.py`` — an HTTP head aggregating GCS state
+(nodes, actors, tasks, objects, jobs, logs) behind ``/api/...`` routes,
+plus a human landing page. The reference ships a React UI; here the API
+surface is the deliverable (everything a UI or ``curl`` needs), with a
+minimal self-contained HTML summary at ``/``.
+
+Runs as a thread attached to a driver-style connection to the head —
+read-only, so a plain threading HTTP server is plenty (the Serve data
+plane, which is latency-sensitive, uses asyncio instead).
+
+    from ray_tpu.dashboard import Dashboard
+    dash = Dashboard(head_address)          # serves on 127.0.0.1:8265
+    print(dash.url)
+
+CLI: ``python -m ray_tpu.scripts.cli dashboard --address <head>``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qs, urlparse
+
+DEFAULT_PORT = 8265  # the reference dashboard's default
+
+
+class Dashboard:
+    def __init__(self, head_address: str, host: str = "127.0.0.1",
+                 port: int = DEFAULT_PORT):
+        from ray_tpu.cluster.rpc import RpcClient
+
+        self._head_address = head_address
+        self.head = RpcClient(head_address)
+        dash = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                try:
+                    status, ctype, body = dash._route(self.path)
+                except Exception as e:  # surface handler bugs as 500s
+                    status, ctype, body = (
+                        500, "application/json",
+                        json.dumps({"error": repr(e)}).encode(),
+                    )
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        # Single-threaded on purpose: requests serialize through ONE
+        # handler thread, whose pooled RpcClient connection to the head is
+        # reused across requests — a polling UI would otherwise dial (and
+        # handshake) a fresh head connection per request. Read-only,
+        # low-traffic: serialization is fine.
+        self._server = HTTPServer((host, port), Handler)
+        self.url = f"http://{host}:{self._server.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- routing -----------------------------------------------------------
+
+    def _route(self, path: str):
+        parsed = urlparse(path)
+        route = parsed.path.rstrip("/") or "/"
+        qs = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+
+        def ok_json(payload):
+            return 200, "application/json", json.dumps(
+                payload, default=str).encode()
+
+        if route == "/":
+            return 200, "text/html", self._index_html().encode()
+        if route == "/api/cluster_status":
+            return ok_json(self._cluster_status())
+        if route == "/api/nodes":
+            return ok_json({"nodes": self.head.call("nodes")})
+        if route == "/api/actors":
+            return ok_json({"actors": self.head.call("list_actors")})
+        if route == "/api/tasks":
+            limit = int(qs.get("limit", 1000))
+            return ok_json({"tasks": self.head.call("list_tasks", limit)})
+        if route == "/api/objects":
+            limit = int(qs.get("limit", 1000))
+            return ok_json({"objects": self.head.call("list_objects", limit)})
+        if route == "/api/logs":
+            after = int(qs.get("after_seq", 0))
+            limit = int(qs.get("limit", 1000))
+            cursor, entries = self.head.call("drain_logs", after, limit)
+            return ok_json({"cursor": cursor, "entries": entries})
+        if route == "/api/placement_groups":
+            return ok_json(
+                {"placement_groups": self.head.call(
+                    "placement_group_table")})
+        if route == "/api/pubsub_stats":
+            return ok_json(self.head.call("pubsub_stats"))
+        return 404, "application/json", b'{"error": "no such route"}'
+
+    def _cluster_status(self):
+        nodes = self.head.call("nodes")
+        total = self.head.call("cluster_resources")
+        avail = self.head.call("available_resources")
+        return {
+            "head_address": self._head_address,
+            "time": time.time(),
+            "alive_nodes": sum(1 for n in nodes if n["Alive"]),
+            "dead_nodes": sum(1 for n in nodes if not n["Alive"]),
+            "resources_total": total,
+            "resources_available": avail,
+        }
+
+    def _index_html(self) -> str:
+        import html as _html
+
+        s = self._cluster_status()
+        # Escape everything interpolated: resource names / addresses are
+        # cluster-user-controlled strings.
+        rows = "".join(
+            f"<tr><td>{_html.escape(str(k))}<td><code>"
+            f"{_html.escape(json.dumps(v, default=str))}</code>"
+            for k, v in s.items()
+        )
+        api = ["/api/cluster_status", "/api/nodes", "/api/actors",
+               "/api/tasks", "/api/objects", "/api/logs",
+               "/api/placement_groups", "/api/pubsub_stats"]
+        links = "".join(f'<li><a href="{r}">{r}</a></li>' for r in api)
+        return (
+            "<!doctype html><title>ray_tpu dashboard</title>"
+            "<h1>ray_tpu cluster</h1>"
+            f"<table border=1 cellpadding=4>{rows}</table>"
+            f"<h2>API</h2><ul>{links}</ul>"
+        )

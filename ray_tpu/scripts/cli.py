@@ -105,6 +105,38 @@ def cmd_submit(args):
         print(client.get_job_logs(job_id))
 
 
+def cmd_dashboard(args):
+    from ray_tpu.dashboard import Dashboard
+
+    if not args.address:
+        raise SystemExit("dashboard requires --address <head host:port>")
+    dash = Dashboard(args.address, host=args.host, port=args.port)
+    print(f"dashboard at {dash.url} (head {args.address}); Ctrl-C to stop")
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        dash.shutdown()
+
+
+def cmd_client_server(args):
+    from ray_tpu.util.client import ClientProxyServer
+
+    if not args.address:
+        raise SystemExit(
+            "client-server requires --address <head host:port>")
+    srv = ClientProxyServer(args.address, host=args.host, port=args.port)
+    print(f"client proxy at ray://{srv.address} (head {args.address}); "
+          f"Ctrl-C to stop")
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.shutdown()
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray-tpu")
     parser.add_argument("--address", default=None,
@@ -138,6 +170,17 @@ def main(argv=None):
     p.add_argument("--wait", action="store_true")
     p.add_argument("entrypoint", nargs=argparse.REMAINDER)
     p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("dashboard", help="serve the REST dashboard")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8265)
+    p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser(
+        "client-server", help="serve a ray:// client proxy")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=10001)
+    p.set_defaults(fn=cmd_client_server)
 
     args = parser.parse_args(argv)
     args.fn(args)

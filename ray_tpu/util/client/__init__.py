@@ -1,0 +1,19 @@
+"""Ray Client analog: connect to a cluster from a process that is NOT on
+a cluster machine (``ray://host:port``).
+
+Reference: ``python/ray/util/client`` + ``util/client/server/proxier.py``
+— a server-side proxy hosts the real driver state; the remote client
+speaks a narrow RPC surface and never needs shared memory access.
+
+    # on a cluster machine (or via `cli client-server`):
+    from ray_tpu.util.client import ClientProxyServer
+    srv = ClientProxyServer(head_address)
+
+    # anywhere that can reach srv.address:
+    ray_tpu.init(address=f"ray://{srv.address}")
+"""
+
+from ray_tpu.util.client.server import ClientProxyServer
+from ray_tpu.util.client.backend import ClientBackend
+
+__all__ = ["ClientProxyServer", "ClientBackend"]

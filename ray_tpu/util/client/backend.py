@@ -1,0 +1,187 @@
+"""Client side of the Ray Client analog (``python/ray/util/client``).
+
+Implements the process-wide Backend surface entirely over RPC to a
+ClientProxyServer — no shared memory, no cluster membership. Selected by
+``ray_tpu.init(address="ray://host:port")``.
+
+Ref lifetime: every ObjectRef this backend mints carries a finalizer that
+batches a release RPC to the proxy (which holds the real refs); a
+heartbeat thread keeps the session alive, and nested refs deserialized
+out of fetched values are re-pinned server-side before use.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Any, Callable, Sequence
+
+from ray_tpu.cluster.rpc import ConnectionLost, RpcClient
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.object_ref import ObjectRef
+
+
+class ClientBackend:
+    def __init__(self, address: str):
+        self.address = address
+        self.rpc = RpcClient(address)
+        self.session_id = f"cs:{os.getpid()}:{os.urandom(4).hex()}"
+        hello = self.rpc.call("client_hello", self.session_id)
+        self._ttl = float(hello.get("ttl_s", 60.0))
+        self._closed = False
+        self._release_lock = threading.Lock()
+        self._pending_release: list[str] = []
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _call(self, method: str, *args, timeout: float | None = None):
+        return self.rpc.call(
+            method, self.session_id, *args, timeout=timeout)
+
+    def _heartbeat_loop(self):
+        interval = max(1.0, self._ttl / 4)
+        while not self._closed:
+            threading.Event().wait(interval)
+            if self._closed:
+                return
+            # Piggyback batched ref releases on the heartbeat.
+            with self._release_lock:
+                batch, self._pending_release = self._pending_release, []
+            try:
+                if batch:
+                    self._call("client_release", batch)
+                self._call("client_ping")
+            except (ConnectionLost, OSError):
+                with self._release_lock:
+                    self._pending_release.extend(batch)
+
+    def make_ref(self, oid: str, owner: str = "") -> ObjectRef:
+        ref = ObjectRef(oid, owner)
+        weakref.finalize(ref, self._queue_release, oid)
+        return ref
+
+    def _queue_release(self, oid: str):
+        if self._closed:
+            return
+        with self._release_lock:
+            self._pending_release.append(oid)
+
+    def on_ref_deserialized(self, oid: str, owner: str) -> ObjectRef:
+        """A fetched value contained a nested ref: pin it server-side so
+        it outlives the value it rode in on."""
+        try:
+            self._call("client_hold", oid)
+        except (ConnectionLost, OSError):
+            pass
+        return self.make_ref(oid, owner)
+
+    # -- object plane ------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = self._call("client_put", ser.dumps(value))
+        return self.make_ref(oid)
+
+    # An untimed get/wait must not ride one unbounded RPC: the transport's
+    # per-connection socket default (60s) would sever it under a long
+    # task. Block in bounded wait slices instead, then fetch.
+    _SLICE_S = 20.0
+
+    def _wait_oids(self, oids, num_returns, timeout, fetch_local):
+        if timeout is not None:
+            return self._call(
+                "client_wait", oids, num_returns, timeout, fetch_local,
+                timeout=timeout + 15.0)
+        while True:
+            ready, rest = self._call(
+                "client_wait", oids, num_returns, self._SLICE_S,
+                fetch_local, timeout=self._SLICE_S + 15.0)
+            if len(ready) >= num_returns:
+                return ready, rest
+
+    def get(self, refs: Sequence[ObjectRef], timeout: float | None = None):
+        oids = [r.id for r in refs]
+        uniq = list(dict.fromkeys(oids))
+        _ready, rest = self._wait_oids(uniq, len(uniq), timeout, True)
+        if rest:
+            from ray_tpu.core.object_ref import GetTimeoutError
+
+            raise GetTimeoutError(
+                f"{len(rest)}/{len(uniq)} objects not ready "
+                f"within {timeout}s"
+            )
+        # Everything exists server-side now: the fetch itself is quick.
+        blob = self._call("client_get", oids, 30.0, timeout=60.0)
+        return ser.loads(blob)
+
+    def wait(self, refs, num_returns, timeout, fetch_local=True):
+        by_id = {r.id: r for r in refs}
+        ready, rest = self._wait_oids(
+            [r.id for r in refs], num_returns, timeout, fetch_local)
+        return [by_id[o] for o in ready], [by_id[o] for o in rest]
+
+    # -- tasks / actors ----------------------------------------------------
+
+    def submit_task(self, func: Callable, args: tuple, kwargs: dict,
+                    **options) -> list[ObjectRef]:
+        blob = ser.dumps((func, args, kwargs, options))
+        oids = self._call("client_submit_task", blob)
+        return [self.make_ref(o) for o in oids]
+
+    def create_actor(self, cls: type, args: tuple, kwargs: dict,
+                     **options) -> str:
+        blob = ser.dumps((cls, args, kwargs, options))
+        return self._call("client_create_actor", blob)
+
+    def submit_actor_task(self, actor_id: str, method_name: str,
+                          args: tuple, kwargs: dict, *,
+                          num_returns: int = 1,
+                          **options) -> list[ObjectRef]:
+        options["num_returns"] = num_returns
+        blob = ser.dumps((args, kwargs, options))
+        oids = self._call(
+            "client_submit_actor_task", actor_id, method_name, blob)
+        return [self.make_ref(o) for o in oids]
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self._call("client_kill_actor", actor_id, no_restart)
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        self._call("client_cancel", ref.id, force)
+
+    def get_named_actor(self, name: str) -> str:
+        return self._call("client_get_named_actor", name)
+
+    # -- introspection / kv ------------------------------------------------
+
+    def cluster_resources(self) -> dict:
+        return self._call("client_cluster_resources")
+
+    def available_resources(self) -> dict:
+        return self._call("client_available_resources")
+
+    def nodes(self) -> list:
+        return self._call("client_nodes")
+
+    def kv_put(self, key, value, overwrite=True):
+        return self._call("client_kv", "put", key, value, overwrite)
+
+    def kv_get(self, key):
+        return self._call("client_kv", "get", key)
+
+    def kv_del(self, key):
+        return self._call("client_kv", "del", key)
+
+    def kv_keys(self, prefix=""):
+        return self._call("client_kv", "keys", prefix)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._closed = True
+        try:
+            self._call("client_bye")
+        except (ConnectionLost, OSError):
+            pass
+        self.rpc.close()

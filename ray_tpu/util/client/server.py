@@ -1,0 +1,160 @@
+"""Server side of the Ray Client analog (``util/client/server/proxier.py``).
+
+Hosts ONE driver-style ClusterBackend and proxies a narrow RPC surface to
+remote clients. Per-session bookkeeping pins every ObjectRef handed to a
+client until the client releases it (or its session expires), so the
+cluster's distributed ref-counting sees the proxy as the holder — remote
+clients never participate in shm or the ref protocol directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ray_tpu.cluster.rpc import RpcServer
+from ray_tpu.core import serialization as ser
+
+SESSION_TTL_S = 60.0
+
+
+class _Session:
+    __slots__ = ("refs", "last_seen")
+
+    def __init__(self):
+        self.refs: dict[str, object] = {}  # oid -> live ObjectRef
+        self.last_seen = time.monotonic()
+
+
+class ClientProxyServer:
+    def __init__(self, head_address: str, host: str = "127.0.0.1",
+                 port: int = 0, session_ttl_s: float = SESSION_TTL_S):
+        from ray_tpu.cluster.client import ClusterBackend
+
+        self.backend = ClusterBackend(head_address)
+        self._sessions: dict[str, _Session] = {}
+        self._lock = threading.Lock()
+        self._ttl = session_ttl_s
+        self._stop = threading.Event()
+        self._server = RpcServer(self, host, port)
+        self.address = self._server.address
+        threading.Thread(target=self._reap_loop, daemon=True).start()
+
+    def shutdown(self):
+        self._stop.set()
+        self._server.stop()
+        self.backend.shutdown()
+
+    # -- sessions ----------------------------------------------------------
+
+    def _session(self, sid: str) -> _Session:
+        with self._lock:
+            s = self._sessions.get(sid)
+            if s is None:
+                s = self._sessions[sid] = _Session()
+            s.last_seen = time.monotonic()
+            return s
+
+    def _reap_loop(self):
+        while not self._stop.wait(5.0):
+            cutoff = time.monotonic() - self._ttl
+            with self._lock:
+                dead = [sid for sid, s in self._sessions.items()
+                        if s.last_seen < cutoff]
+                for sid in dead:
+                    # Dropping the refs releases the proxy's holds; the
+                    # cluster ref-counter frees what nothing else holds.
+                    del self._sessions[sid]
+
+    def _track(self, sid: str, refs) -> list[str]:
+        s = self._session(sid)
+        oids = []
+        for r in refs:
+            s.refs[r.id] = r
+            oids.append(r.id)
+        return oids
+
+    # -- rpc surface -------------------------------------------------------
+
+    def rpc_client_hello(self, sid: str):
+        self._session(sid)
+        return {"server": "ray_tpu-client-proxy", "ttl_s": self._ttl}
+
+    def rpc_client_ping(self, sid: str):
+        self._session(sid)
+        return True
+
+    def rpc_client_bye(self, sid: str):
+        with self._lock:
+            self._sessions.pop(sid, None)
+        return True
+
+    def rpc_client_put(self, sid: str, blob: bytes) -> str:
+        value = ser.loads(blob)
+        ref = self.backend.put(value)
+        return self._track(sid, [ref])[0]
+
+    def rpc_client_get(self, sid: str, oids: list, timeout) -> bytes:
+        s = self._session(sid)
+        refs = [s.refs.get(o) or self.backend.make_ref(o) for o in oids]
+        values = self.backend.get(refs, timeout)
+        return ser.dumps(values)
+
+    def rpc_client_hold(self, sid: str, oid: str):
+        """A client deserialized a nested ref: pin it for the session."""
+        self._track(sid, [self.backend.make_ref(oid)])
+        return True
+
+    def rpc_client_release(self, sid: str, oids: list):
+        s = self._session(sid)
+        for o in oids:
+            s.refs.pop(o, None)
+        return True
+
+    def rpc_client_submit_task(self, sid: str, blob: bytes) -> list:
+        func, args, kwargs, options = ser.loads(blob)
+        refs = self.backend.submit_task(func, args, kwargs, **options)
+        return self._track(sid, refs)
+
+    def rpc_client_create_actor(self, sid: str, blob: bytes) -> str:
+        cls, args, kwargs, options = ser.loads(blob)
+        return self.backend.create_actor(cls, args, kwargs, **options)
+
+    def rpc_client_submit_actor_task(self, sid: str, actor_id: str,
+                                     method: str, blob: bytes) -> list:
+        args, kwargs, options = ser.loads(blob)
+        refs = self.backend.submit_actor_task(
+            actor_id, method, args, kwargs, **options)
+        return self._track(sid, refs)
+
+    def rpc_client_wait(self, sid: str, oids: list, num_returns: int,
+                        timeout, fetch_local: bool):
+        s = self._session(sid)
+        refs = [s.refs.get(o) or self.backend.make_ref(o) for o in oids]
+        ready, rest = self.backend.wait(
+            refs, num_returns, timeout, fetch_local)
+        return [r.id for r in ready], [r.id for r in rest]
+
+    def rpc_client_kill_actor(self, sid: str, actor_id: str,
+                              no_restart: bool):
+        return self.backend.kill_actor(actor_id, no_restart)
+
+    def rpc_client_cancel(self, sid: str, oid: str, force: bool):
+        s = self._session(sid)
+        ref = s.refs.get(oid) or self.backend.make_ref(oid)
+        return self.backend.cancel(ref, force)
+
+    def rpc_client_get_named_actor(self, sid: str, name: str) -> str:
+        return self.backend.get_named_actor(name)
+
+    def rpc_client_cluster_resources(self, sid: str):
+        return self.backend.cluster_resources()
+
+    def rpc_client_available_resources(self, sid: str):
+        return self.backend.available_resources()
+
+    def rpc_client_nodes(self, sid: str):
+        return self.backend.nodes()
+
+    def rpc_client_kv(self, sid: str, op: str, *args):
+        return getattr(self.backend, "kv_" + op)(*args)

@@ -1,0 +1,197 @@
+"""Dashboard REST aggregation + Ray Client (`ray://`) proxy.
+
+Reference: ``dashboard/head.py`` (HTTP aggregation of GCS state) and
+``python/ray/util/client`` + ``util/client/server/proxier.py`` (remote
+clients without cluster membership or shared memory).
+"""
+
+import json
+import sys
+import urllib.request
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.dashboard import Dashboard
+from ray_tpu.util.client import ClientProxyServer
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+# -- dashboard -------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def dashboard(cluster):
+    dash = Dashboard(cluster.address, port=0)
+    yield dash
+    dash.shutdown()
+
+
+def test_dashboard_cluster_status(cluster, dashboard):
+    s = _get_json(dashboard.url + "/api/cluster_status")
+    assert s["alive_nodes"] == 1
+    assert s["resources_total"]["CPU"] == 2.0
+
+
+def test_dashboard_nodes_actors_tasks(cluster, dashboard):
+    ray_tpu.shutdown()
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote
+    class Probe:
+        def ping(self):
+            return "pong"
+
+    p = Probe.remote()
+    assert ray_tpu.get(p.ping.remote(), timeout=30) == "pong"
+
+    nodes = _get_json(dashboard.url + "/api/nodes")["nodes"]
+    assert len(nodes) == 1 and nodes[0]["Alive"]
+    actors = _get_json(dashboard.url + "/api/actors")["actors"]
+    assert any(a["class_name"] == "Probe" for a in actors)
+    # Task records reach the agent in 0.25s worker-event batches.
+    import time
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        tasks = _get_json(dashboard.url + "/api/tasks")["tasks"]
+        if any(t["name"] == "ping" for t in tasks):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(f"ping task never appeared: {tasks}")
+    ray_tpu.shutdown()
+
+
+def test_dashboard_index_and_404(dashboard):
+    with urllib.request.urlopen(dashboard.url + "/", timeout=10) as r:
+        assert b"ray_tpu cluster" in r.read()
+    try:
+        urllib.request.urlopen(dashboard.url + "/api/nope", timeout=10)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+# -- ray:// client ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def proxy(cluster):
+    srv = ClientProxyServer(cluster.address)
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture()
+def client(cluster, proxy):
+    ray_tpu.shutdown()
+    ray_tpu.init(address=f"ray://{proxy.address}")
+    yield
+    ray_tpu.shutdown()
+
+
+def test_client_tasks_and_objects(client):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    ref = ray_tpu.put(40)
+    out = ray_tpu.get(add.remote(ref, 2), timeout=60)
+    assert out == 42
+    assert ray_tpu.cluster_resources()["CPU"] == 2.0
+
+
+def test_client_actor_roundtrip(client):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+    assert ray_tpu.get(c.inc.remote(5), timeout=60) == 6
+    ray_tpu.kill(c)
+
+
+def test_client_wait_and_cancel(client):
+    import time as _t
+
+    @ray_tpu.remote
+    def fast():
+        return "f"
+
+    @ray_tpu.remote
+    def slow():
+        _t.sleep(30)
+        return "s"
+
+    f, s = fast.remote(), slow.remote()
+    ready, rest = ray_tpu.wait([f, s], num_returns=1, timeout=30)
+    assert ready and ready[0].id == f.id
+    ray_tpu.cancel(s, force=True)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(s, timeout=30)
+
+
+def test_client_untimed_get_survives_slow_task(client, monkeypatch):
+    """An untimed ray.get over ray:// must outlive the transport's
+    per-call socket timeout — it blocks in bounded wait slices."""
+    import time as _t
+
+    from ray_tpu.util.client.backend import ClientBackend
+
+    monkeypatch.setattr(ClientBackend, "_SLICE_S", 0.5)
+
+    @ray_tpu.remote
+    def slowish():
+        _t.sleep(2.5)  # spans several 0.5s wait slices
+        return "done"
+
+    assert ray_tpu.get(slowish.remote()) == "done"  # no timeout arg
+
+
+def test_client_get_timeout_raises(client):
+    @ray_tpu.remote
+    def forever():
+        import time as _t
+        _t.sleep(60)
+
+    ref = forever.remote()
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(ref, timeout=1.0)
+    ray_tpu.cancel(ref, force=True)
+
+
+def test_client_nested_ref_in_value(client):
+    @ray_tpu.remote
+    def make_ref_pair():
+        return {"inner": ray_tpu.put("nested-payload")}
+
+    box = ray_tpu.get(make_ref_pair.remote(), timeout=60)
+    inner = box["inner"]
+    assert isinstance(inner, ray_tpu.ObjectRef)
+    assert ray_tpu.get(inner, timeout=60) == "nested-payload"
