@@ -20,11 +20,13 @@ from ray_tpu.data.dataset import (
     read_parquet,
     read_text,
 )
+from ray_tpu.data.dataset_pipeline import DatasetPipeline
 from ray_tpu.data import preprocessors
 
 __all__ = [
     "ActorPoolStrategy",
     "Dataset",
+    "DatasetPipeline",
     "from_items",
     "from_numpy",
     "from_pandas",

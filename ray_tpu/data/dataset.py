@@ -324,6 +324,19 @@ class Dataset:
         return Dataset([zip_task.remote(x, y) for x, y in zip(a, b)], [],
                        self._stats)
 
+    def window(self, *, blocks_per_window: int = 10) -> "DatasetPipeline":
+        """Windowed pipeline over this dataset's blocks: each window's
+        plan executes while the previous window is consumed
+        (``dataset_pipeline.py``; reference ``Dataset.window``)."""
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        return DatasetPipeline.from_dataset(self, blocks_per_window)
+
+    def repeat(self, times: int = 2) -> "DatasetPipeline":
+        """Multi-epoch pipeline (reference ``Dataset.repeat``)."""
+        return self.window(blocks_per_window=max(1, len(self._blocks))
+                           ).repeat(times)
+
     def split(self, n: int, *, equal: bool = False,
               locality_hints=None) -> List["Dataset"]:
         """N sub-datasets; ``equal=True`` balances rows exactly
@@ -642,54 +655,164 @@ def _expand_paths(paths) -> list:
     return out
 
 
+def _rg_splits(files: list, parallelism: int) -> list:
+    """Split parquet files into ~parallelism read tasks at ROW-GROUP
+    granularity (reference: ``_internal/datasource/parquet_datasource.py``
+    fragment splitting) — a single big file still parallelizes."""
+    import pyarrow.parquet as pq
+
+    shards: list = []  # (path, row_group_index)
+    for path in files:
+        n = pq.ParquetFile(path).metadata.num_row_groups
+        shards.extend((path, rg) for rg in _py_range(n))
+    per_task = max(1, len(shards) // max(1, parallelism))
+    tasks: list = []
+    i = 0
+    while i < len(shards):
+        group = [shards[i]]
+        i += 1
+        # Grow the group with CONTIGUOUS row groups of the same file so
+        # one task does one sequential read.
+        while (len(group) < per_task and i < len(shards)
+               and shards[i][0] == group[0][0]):
+            group.append(shards[i])
+            i += 1
+        tasks.append((group[0][0], [rg for _p, rg in group]))
+    return tasks
+
+
 def read_parquet(paths, *, parallelism: int = 8) -> Dataset:
     files = _expand_paths(paths)
 
-    def load(path):
-        import pandas as pd
+    def load(path, row_groups):
+        import pyarrow.parquet as pq
 
-        df = pd.read_parquet(path)
-        return {k: df[k].to_numpy() for k in df.columns}
+        t = pq.ParquetFile(path).read_row_groups(row_groups)
+        return {
+            name: t.column(name).to_numpy(zero_copy_only=False)
+            for name in t.column_names
+        }
 
     load_task = ray_tpu.remote(load)
-    return Dataset([load_task.remote(p) for p in files])
+    return Dataset([
+        load_task.remote(path, rgs)
+        for path, rgs in _rg_splits(files, parallelism)
+    ])
 
 
-def read_csv(paths, *, parallelism: int = 8) -> Dataset:
+def _byte_ranges(files: list, parallelism: int) -> list:
+    """(path, start, end) splits totaling ~parallelism tasks across the
+    byte span of all files; line-oriented readers snap to newline
+    boundaries at read time (start seeks past its first partial line,
+    end reads through the end of its last full line)."""
+    import os
+
+    sizes = [(p, os.path.getsize(p)) for p in files]
+    total = sum(s for _p, s in sizes) or 1
+    target = max(1, total // max(1, parallelism))
+    ranges: list = []
+    for path, size in sizes:
+        if size == 0:
+            continue
+        n = max(1, min(size, round(size / target)))
+        step = size / n
+        for i in _py_range(n):
+            start = int(i * step)
+            end = int((i + 1) * step) if i < n - 1 else size
+            ranges.append((path, start, end))
+    return ranges
+
+
+def _read_lines_range(path: str, start: int, end: int) -> list:
+    """Lines whose FIRST byte lies in [start, end) — each line is owned
+    by exactly one range, so concatenating ranges reproduces the file."""
+    lines = []
+    with open(path, "rb") as f:
+        if start > 0:
+            # Only skip ahead if ``start`` lands MID-line (the line is
+            # owned by the previous range). If the byte before start is a
+            # newline, start IS a line's first byte — it belongs to us.
+            f.seek(start - 1)
+            if f.read(1) != b"\n":
+                f.readline()
+        else:
+            f.seek(0)
+        while f.tell() < end:
+            line = f.readline()
+            if not line:
+                break
+            lines.append(line.rstrip(b"\n").decode())
+    return lines
+
+
+def read_csv(paths, *, parallelism: int = 8,
+             quoted_newlines: bool = False) -> Dataset:
+    """Byte-range splitting assumes one record per physical line. CSVs
+    with newlines INSIDE quoted fields would be mis-split — pass
+    ``quoted_newlines=True`` to fall back to one (sound) task per file
+    for such data."""
     files = _expand_paths(paths)
 
-    def load(path):
+    if quoted_newlines:
+        def load_file(path):
+            import pandas as pd
+
+            df = pd.read_csv(path)
+            return {k: df[k].to_numpy() for k in df.columns}
+
+        load_whole = ray_tpu.remote(load_file)
+        return Dataset([load_whole.remote(p) for p in files])
+
+    def load(path, start, end, header):
+        import io
+
         import pandas as pd
 
-        df = pd.read_csv(path)
+        body = _read_lines_range(path, start, end)
+        if start == 0 and body:
+            body = body[1:]  # drop the header line from the data
+        if not body:
+            return {name: np.empty(0, dtype=object) for name in header}
+        df = pd.read_csv(
+            io.StringIO("\n".join(body)), names=header, header=None)
         return {k: df[k].to_numpy() for k in df.columns}
 
+    def header_of(path):
+        with open(path) as f:
+            import csv as _csv
+
+            return next(_csv.reader([f.readline()]))
+
     load_task = ray_tpu.remote(load)
-    return Dataset([load_task.remote(p) for p in files])
+    refs = []
+    headers = {p: header_of(p) for p in files}
+    for path, start, end in _byte_ranges(files, parallelism):
+        refs.append(load_task.remote(path, start, end, headers[path]))
+    return Dataset(refs)
 
 
 def read_json(paths, *, parallelism: int = 8) -> Dataset:
     files = _expand_paths(paths)
 
-    def load(path):
+    def load(path, start, end):
         import json
 
-        with open(path) as f:
-            return [json.loads(line) for line in f if line.strip()]
+        return [json.loads(ln) for ln in _read_lines_range(path, start, end)
+                if ln.strip()]
 
     load_task = ray_tpu.remote(load)
-    return Dataset([load_task.remote(p) for p in files])
+    return Dataset([
+        load_task.remote(p, s, e) for p, s, e in _byte_ranges(files, parallelism)
+    ])
 
 
 def read_text(paths, *, parallelism: int = 8) -> Dataset:
     files = _expand_paths(paths)
 
-    def load(path):
-        with open(path) as f:
-            return [line.rstrip("\n") for line in f]
-
-    load_task = ray_tpu.remote(load)
-    return Dataset([load_task.remote(p) for p in files])
+    load_task = ray_tpu.remote(_read_lines_range)
+    return Dataset([
+        load_task.remote(p, s, e) for p, s, e in _byte_ranges(files, parallelism)
+    ])
 
 
 def read_binary_files(paths, *, parallelism: int = 8) -> Dataset:
