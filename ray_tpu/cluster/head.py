@@ -112,6 +112,9 @@ class HeadServer:
         from ray_tpu.cluster.pubsub import Publisher
 
         self.pubsub = Publisher()
+        # Tracing span store (bounded; util/tracing.py feeds it through
+        # the agents' worker-event batches).
+        self._spans: list = []
         # object directory: oid -> {"nodes": set, "error": bool}
         self._objects: dict[str, dict] = {}
         self._objects_cv = threading.Condition(self._lock)
@@ -403,6 +406,21 @@ class HeadServer:
 
     def rpc_pubsub_stats(self):
         return self.pubsub.stats()
+
+    # -- tracing span store (util/tracing.py; OTel-shaped) ----------------
+
+    def rpc_report_spans(self, spans):
+        with self._lock:
+            self._spans.extend(spans)
+            if len(self._spans) > 100_000:
+                del self._spans[: len(self._spans) - 100_000]
+        return True
+
+    def rpc_list_spans(self, trace_id=None, limit: int = 10_000):
+        with self._lock:
+            out = [s for s in self._spans
+                   if trace_id is None or s["trace_id"] == trace_id]
+            return out[-limit:]
 
     # -- distributed ref-counting -----------------------------------------
 
@@ -1018,34 +1036,44 @@ class HeadServer:
         return assignment
 
     def _reserve_pg(self, pg_id: str):
-        with self._lock:
-            pg = self._pgs[pg_id]
-            bundles, strategy = pg["bundles"], pg["strategy"]
-        assignment = self._pg_assign(bundles, strategy)
-        if assignment is None:
+        # Reservation retries while the PG is PENDING: a prepare that
+        # fails because another group currently holds the resources is
+        # TRANSIENT (reference PGs stay pending until placeable);
+        # INFEASIBLE is only declared when no assignment exists against
+        # node TOTALS — it can never fit.
+        while True:
             with self._lock:
-                pg["state"] = "INFEASIBLE"
-            return
-        # Phase 1: prepare every bundle on its node (blocking until the
-        # node can reserve it); phase 2: commit. Rollback on any failure.
-        prepared: list[tuple[str, int]] = []
-        ok = True
-        for node_id, bundle_index in assignment:
-            with self._lock:
-                node = self._nodes.get(node_id)
-            if node is None or not node.alive:
-                ok = False
+                pg = self._pgs.get(pg_id)
+                if pg is None or pg["state"] != "PENDING":
+                    return  # removed (or already settled) while retrying
+                bundles, strategy = pg["bundles"], pg["strategy"]
+            assignment = self._pg_assign(bundles, strategy)
+            if assignment is None:
+                with self._lock:
+                    pg["state"] = "INFEASIBLE"
+                return
+            # Phase 1: prepare every bundle on its node (blocking until
+            # the node can reserve it); phase 2: commit. Rollback and
+            # retry on any failure.
+            prepared: list[tuple[str, int]] = []
+            ok = True
+            for node_id, bundle_index in assignment:
+                with self._lock:
+                    node = self._nodes.get(node_id)
+                if node is None or not node.alive:
+                    ok = False
+                    break
+                try:
+                    node.client.call(
+                        "prepare_bundle", pg_id, bundle_index,
+                        bundles[bundle_index], timeout=120.0,
+                    )
+                    prepared.append((node_id, bundle_index))
+                except Exception:
+                    ok = False
+                    break
+            if ok:
                 break
-            try:
-                node.client.call(
-                    "prepare_bundle", pg_id, bundle_index,
-                    bundles[bundle_index], timeout=120.0,
-                )
-                prepared.append((node_id, bundle_index))
-            except Exception:
-                ok = False
-                break
-        if not ok:
             for node_id, bundle_index in prepared:
                 with self._lock:
                     node = self._nodes.get(node_id)
@@ -1054,9 +1082,7 @@ class HeadServer:
                         node.client.call("return_bundle", pg_id, bundle_index)
                     except Exception:
                         pass
-            with self._lock:
-                pg["state"] = "INFEASIBLE"
-            return
+            time.sleep(0.25)
         for node_id, bundle_index in assignment:
             with self._lock:
                 node = self._nodes.get(node_id)

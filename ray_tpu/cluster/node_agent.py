@@ -310,9 +310,11 @@ class NodeAgent:
                 self._task_records.popitem(last=False)
             self._task_records[rec["task_id"]] = rec
 
-    def rpc_worker_events(self, worker_id, pid, task_events, log_lines):
+    def rpc_worker_events(self, worker_id, pid, task_events, log_lines,
+                          spans=None):
         """Batched observability report from a worker: authoritative task
-        records (with timings/outcome) + captured stdout/stderr lines."""
+        records (with timings/outcome), captured stdout/stderr lines, and
+        finished tracing spans (forwarded to the head's span store)."""
         with self._lock:
             for rec in task_events:
                 old = self._task_records.get(rec["task_id"])
@@ -328,6 +330,11 @@ class NodeAgent:
                     "worker_logs", self.node_id, pid, log_lines)
             except Exception:
                 pass  # head restarting/unreachable: logs are best-effort
+        if spans:
+            try:
+                self.head.call("report_spans", spans)
+            except Exception:
+                pass
         failed = [r for r in task_events if r.get("state") == "FAILED"]
         if failed:
             # Error feed (reference: error_info pubsub to the driver).
@@ -715,12 +722,27 @@ class NodeAgent:
         with self._lock:
             pool = self._bundles.pop((pg_id, bundle_index), None)
             self._bundle_state.pop((pg_id, bundle_index), None)
+            # Reference semantics: removing a PG kills the work running
+            # in its bundles (gcs_placement_group_manager removal path).
+            # Without this, returning the reservation below would
+            # oversubscribe the node for as long as a straggler runs.
+            victims = [
+                w for w in self._workers.values()
+                if w.current_task is not None
+                and w.current_task["spec"].get("pg_id") == pg_id
+                and w.proc.poll() is None
+            ]
+        for w in victims:
+            w.proc.kill()  # reap loop stores the task error / actor death
         if pool is not None:
-            # Give back what is currently free; in-flight tasks' releases
-            # drain into their (now orphaned) bundle pool — accounted as
-            # still-used until the task ends, then lost with the pool, so
-            # over-release cannot happen.
-            self.pool.release(pool.available())
+            # Return the bundle's FULL reservation. Any just-killed (or
+            # killed-but-unreaped) worker's release drains into this now-
+            # orphaned pool object, not the node pool, so returning the
+            # total cannot double-free — while returning only
+            # pool.available() would permanently leak whatever a
+            # not-yet-reaped worker still held (observed: a finished tune
+            # trial starving the next trial's PG).
+            self.pool.release(pool.total)
         return True
 
     # -- object serving ---------------------------------------------------

@@ -36,7 +36,14 @@ class Trial:
         Trial._counter += 1
         self.trial_id = f"trial_{Trial._counter:05d}_{os.urandom(2).hex()}"
         self.config = dict(config)
+        # Flat dict ({"CPU": 1}) or a gang spec
+        # ({"bundles": [{...}, ...], "strategy": "PACK"}): gang trials
+        # reserve a placement group atomically, so two multi-bundle
+        # trials can never deadlock each other by each grabbing half
+        # (reference: tune/execution/placement_groups.py
+        # PlacementGroupFactory).
         self.resources = resources or {"CPU": 1}
+        self.pg = None  # PlacementGroup handle for gang trials
         self.status = PENDING
         self.last_result: Optional[dict] = None
         self.metrics_history: List[dict] = []
@@ -89,7 +96,55 @@ class TrialRunner:
 
     # -- lifecycle of one trial -------------------------------------------
 
-    def _start_trial(self, trial: Trial):
+    def _start_trial(self, trial: Trial) -> bool:
+        """Try to start a trial. Returns False when its gang placement
+        group is not reserved yet (the event loop retries on its next
+        tick — starting must never block the loop, or a finished trial's
+        PG removal could never be processed: deadlock)."""
+        bundles = trial.resources.get("bundles")
+        opts: dict
+        if bundles:
+            from ray_tpu.util.placement_group import (
+                placement_group,
+                placement_group_table,
+                remove_placement_group,
+            )
+            from ray_tpu.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy,
+            )
+
+            if trial.pg is None:
+                trial.pg = placement_group(
+                    bundles, trial.resources.get("strategy", "PACK"),
+                    name=trial.trial_id,
+                )
+            state = (placement_group_table(trial.pg) or {}).get("state")
+            if state == "INFEASIBLE":
+                remove_placement_group(trial.pg)
+                trial.pg = None
+                trial.status = ERROR
+                trial.error = ValueError(
+                    f"trial gang {bundles} is infeasible on this cluster"
+                )
+                return True  # handled (terminally)
+            if state != "CREATED":
+                return False  # PG pending: the event loop retries
+            # Demand exactly what bundle 0 provides (default 0, not 1: a
+            # CPU-less bundle, e.g. TPU-only, could never grant CPU).
+            opts = {
+                "num_cpus": bundles[0].get("CPU", 0),
+                "scheduling_strategy": PlacementGroupSchedulingStrategy(
+                    placement_group=trial.pg,
+                    placement_group_bundle_index=0,
+                    placement_group_capture_child_tasks=True,
+                ),
+            }
+            if bundles[0].get("TPU"):
+                opts["num_tpus"] = bundles[0]["TPU"]
+        else:
+            opts = {"num_cpus": trial.resources.get("CPU", 1)}
+            if trial.resources.get("TPU"):
+                opts["num_tpus"] = trial.resources["TPU"]
         trial.generation += 1
         session_kwargs = {
             "world_rank": 0,
@@ -105,14 +160,12 @@ class TrialRunner:
                 "config": trial.config,
             },
         }
-        opts = {"num_cpus": trial.resources.get("CPU", 1)}
-        if trial.resources.get("TPU"):
-            opts["num_tpus"] = trial.resources["TPU"]
         trial.actor = self._actor_cls.options(**opts).remote()
         trial.run_ref = trial.actor.run.remote(
             self.trainable, trial.config, session_kwargs
         )
         trial.status = RUNNING
+        return True
 
     def _stop_actor(self, trial: Trial):
         if trial.actor is not None:
@@ -122,6 +175,16 @@ class TrialRunner:
                 pass
         trial.actor = None
         trial.run_ref = None
+        if trial.pg is not None:
+            # Release the gang reservation so the next pending trial's
+            # placement group can commit.
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(trial.pg)
+            except Exception:
+                pass
+            trial.pg = None
 
     def _pbt_exploit(self, trial: Trial, donor_id: str, scheduler) -> None:
         """Exploit+explore: adopt a perturbed copy of the donor's config and
@@ -132,23 +195,34 @@ class TrialRunner:
         self._stop_actor(trial)
         trial.config = scheduler.perturb_config(donor.config)
         trial.checkpoint = donor.checkpoint
-        self._start_trial(trial)
+        trial.status = PENDING  # the event loop restarts it
 
     # -- event loop --------------------------------------------------------
 
     def run(self) -> List[Trial]:
-        pending = [t for t in self.trials if t.status == PENDING]
         try:
             while True:
                 running = [t for t in self.trials if t.status == RUNNING]
-                while pending and len(running) < self.max_concurrent:
-                    t = pending.pop(0)
-                    self._start_trial(t)
-                    running.append(t)
-                if not running and not pending:
+                pending = [t for t in self.trials if t.status == PENDING]
+                # A gang trial waiting on its PG occupies a concurrency
+                # slot too — otherwise every pending trial would create
+                # (and possibly commit) a PG up front, hoarding cluster
+                # resources far beyond max_concurrent.
+                slots = len(running)
+                for t in pending:
+                    if slots >= self.max_concurrent:
+                        break
+                    started = self._start_trial(t)
+                    if started and t.status == RUNNING:
+                        running.append(t)
+                        slots += 1
+                    elif not started:
+                        slots += 1  # PG pending: holds its slot
+                if not running and not any(
+                        t.status == PENDING for t in self.trials):
                     break
                 self._drain_queue()
-                self._poll_completions(pending)
+                self._poll_completions()
         finally:
             for t in self.trials:
                 self._stop_actor(t)
@@ -194,7 +268,7 @@ class TrialRunner:
                 return
             self._handle_message(msg)
 
-    def _poll_completions(self, pending: List[Trial]):
+    def _poll_completions(self):
         for trial in self.trials:
             if trial.status != RUNNING or trial.run_ref is None:
                 continue
@@ -211,9 +285,11 @@ class TrialRunner:
             except (ActorError, TaskError) as e:
                 trial.num_failures += 1
                 if trial.num_failures <= self.max_failures:
-                    # Retry from the last checkpoint.
+                    # Retry from the last checkpoint; back to PENDING so
+                    # the event loop restarts it (a gang trial may need
+                    # to wait for its new PG without blocking the loop).
                     self._stop_actor(trial)
-                    self._start_trial(trial)
+                    trial.status = PENDING
                     continue
                 trial.status = ERROR
                 trial.error = e
