@@ -26,10 +26,8 @@ def lib_path(name: str) -> str:
     return os.path.join(_LIB_DIR, f"lib{name}.so")
 
 
-def ensure_built(name: str) -> str:
-    """Compile lib<name>.so if missing or stale; return its path."""
-    sources = [os.path.join(_SRC_DIR, s) for s in _LIBS[name]]
-    out = lib_path(name)
+def _compile(out: str, sources: list, flags: list) -> str:
+    """mtime-cached g++ compile to ``out`` (atomic tmp+rename)."""
     with _LOCK:
         if os.path.exists(out):
             src_mtime = max(os.path.getmtime(s) for s in sources)
@@ -37,18 +35,31 @@ def ensure_built(name: str) -> str:
                 return out
         os.makedirs(_LIB_DIR, exist_ok=True)
         tmp = out + f".tmp.{os.getpid()}"
-        cmd = [
-            "g++",
-            "-O2",
-            "-g",
-            "-fPIC",
-            "-shared",
-            "-std=c++17",
-            "-pthread",
-            "-o",
-            tmp,
-            *sources,
-        ]
+        cmd = ["g++", *flags, "-std=c++17", "-pthread", "-o", tmp, *sources]
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, out)  # atomic w.r.t. concurrent builders
     return out
+
+
+def ensure_built(name: str) -> str:
+    """Compile lib<name>.so if missing or stale; return its path."""
+    sources = [os.path.join(_SRC_DIR, s) for s in _LIBS[name]]
+    return _compile(lib_path(name), sources,
+                    ["-O2", "-g", "-fPIC", "-shared"])
+
+
+def build_stress_binary(sanitize: str | None = None) -> str:
+    """Build the multithreaded store stress driver (store_stress.cc +
+    shm_store.cc in one binary), optionally under a sanitizer
+    ("address" / "thread" / "undefined") — SURVEY §5.2 race detection.
+    Cached by mtime per sanitizer flavor."""
+    tag = sanitize or "plain"
+    sources = [
+        os.path.join(_SRC_DIR, "store_stress.cc"),
+        os.path.join(_SRC_DIR, "shm_store.cc"),
+    ]
+    flags = ["-O1", "-g"]
+    if sanitize:
+        flags += [f"-fsanitize={sanitize}", "-fno-omit-frame-pointer"]
+    return _compile(
+        os.path.join(_LIB_DIR, f"store_stress_{tag}"), sources, flags)

@@ -1,0 +1,141 @@
+// Multi-threaded stress driver for the shm object store, built under
+// ASAN/TSAN by tests (SURVEY §5.2 — the reference runs its C++ core
+// under sanitizers in CI; this is the equivalent for our one native
+// component). Hammers the API surface — alloc/seal/get/release/pin/
+// evict/delete/stats — from many threads sharing one attached handle:
+// the production pattern is many processes mapping one segment and
+// contending on the process-shared mutex, which the robust-mutex Guard
+// serializes identically for threads.
+//
+// Exit code 0 = no crashes, no sanitizer reports (sanitizers abort), and
+// all invariants held.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <unistd.h>
+
+extern "C" {
+void* ts_create(const char* path, uint64_t size, uint64_t num_slots);
+void* ts_attach(const char* path);
+void ts_detach(void* hp);
+int ts_unlink(const char* path);
+int64_t ts_alloc(void* hp, const uint8_t* id, uint64_t data_size,
+                 uint64_t meta_size);
+int ts_seal(void* hp, const uint8_t* id);
+int ts_get(void* hp, const uint8_t* id, uint64_t* offset,
+           uint64_t* data_size, uint64_t* meta_size);
+int ts_release(void* hp, const uint8_t* id);
+int64_t ts_release_dead(void* hp, int32_t pid);
+int ts_contains(void* hp, const uint8_t* id);
+int ts_delete(void* hp, const uint8_t* id);
+int ts_abort(void* hp, const uint8_t* id);
+int ts_pin(void* hp, const uint8_t* id, int pinned);
+int ts_evict(void* hp, const uint8_t* id);
+void ts_stats(void* hp, uint64_t* capacity, uint64_t* used,
+              uint64_t* num_objects, uint64_t* num_evictions,
+              uint64_t* spilled_objects, uint64_t* spilled_bytes);
+uint8_t* ts_base_ptr(void* hp);
+}
+
+namespace {
+
+constexpr int kIdSize = 20;  // matches shm_store.py ID_SIZE
+std::atomic<int> failures{0};
+
+void fill_id(uint8_t* id, int thread, int slot) {
+  std::memset(id, 0, kIdSize);
+  std::snprintf(reinterpret_cast<char*>(id), kIdSize, "t%02d-o%05d", thread,
+                slot);
+}
+
+void worker(void* h, int tid, int iters) {
+  uint8_t id[kIdSize];
+  for (int i = 0; i < iters; i++) {
+    fill_id(id, tid, i % 64);
+    uint64_t size = 256 + static_cast<uint64_t>(i % 7) * 1024;
+    int64_t off = ts_alloc(h, id, size, 8);
+    if (off >= 0) {
+      // Touch the data region: sanitizers watch these writes.
+      std::memset(ts_base_ptr(h) + off, tid & 0xff, size + 8);
+      if (ts_seal(h, id) != 0) failures++;
+      uint64_t o, ds, ms;
+      if (ts_get(h, id, &o, &ds, &ms) == 0) {
+        if (ds != size || ms != 8) {
+          std::fprintf(stderr, "size mismatch ds=%lu ms=%lu want=%lu\n",
+                       static_cast<unsigned long>(ds),
+                       static_cast<unsigned long>(ms),
+                       static_cast<unsigned long>(size));
+          failures++;
+        }
+        volatile uint8_t sink = ts_base_ptr(h)[o];  // concurrent read
+        (void)sink;
+        ts_release(h, id);
+      }
+      switch (i % 5) {
+        case 0:
+          ts_pin(h, id, 1);
+          ts_pin(h, id, 0);
+          break;
+        case 1:
+          ts_evict(h, id);
+          break;
+        case 2:
+          ts_delete(h, id);
+          break;
+        default:
+          ts_contains(h, id);
+          break;
+      }
+    } else if (off == -2) {
+      // Another thread owns this id right now: contend on delete.
+      ts_delete(h, id);
+    }
+    if (i % 97 == 0) {
+      uint64_t cap, used, n, ev, so, sb;
+      ts_stats(h, &cap, &used, &n, &ev, &so, &sb);
+      if (used > cap) {
+        std::fprintf(stderr, "used %lu > capacity %lu\n",
+                     static_cast<unsigned long>(used),
+                     static_cast<unsigned long>(cap));
+        failures++;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const int n_threads = argc > 1 ? std::atoi(argv[1]) : 8;
+  const int iters = argc > 2 ? std::atoi(argv[2]) : 3000;
+  std::string path = "/dev/shm/ray_tpu_stress_" + std::to_string(::getpid());
+  void* h = ts_create(path.c_str(), 8ull << 20, 4096);
+  if (h == nullptr) {
+    std::fprintf(stderr, "create failed\n");
+    return 2;
+  }
+  std::vector<std::thread> threads;
+  for (int t = 0; t < n_threads; t++) {
+    threads.emplace_back(worker, h, t, iters);
+  }
+  for (auto& th : threads) th.join();
+  // Dead-process sweep: whatever pins this pid still holds are
+  // reclaimable exactly once, without corrupting the arena.
+  ts_release_dead(h, static_cast<int32_t>(::getpid()));
+  uint64_t cap, used, n, ev, so, sb;
+  ts_stats(h, &cap, &used, &n, &ev, &so, &sb);
+  std::fprintf(stderr, "done: %lu objects, %lu/%lu bytes, %lu evictions\n",
+               static_cast<unsigned long>(n), static_cast<unsigned long>(used),
+               static_cast<unsigned long>(cap),
+               static_cast<unsigned long>(ev));
+  ts_detach(h);
+  ts_unlink(path.c_str());
+  return failures.load() == 0 ? 0 : 1;
+}

@@ -733,6 +733,18 @@ class ClusterBackend:
         }
         spec["pg_id"] = spec["sinfo"]["pg_id"]
         spec["bundle_index"] = spec["sinfo"]["bundle_index"]
+        from ray_tpu.util import tracing
+
+        if tracing.is_enabled():
+            # Submission span; its context rides the spec so the worker
+            # parents the execution span under it (tracing_helper.py).
+            with tracing.span(
+                    f"submit:{spec['fname']}",
+                    {"task_id": task_id}) as s:
+                spec["trace_ctx"] = (
+                    {"trace_id": s["trace_id"], "span_id": s["span_id"]}
+                    if s else None
+                )
         for oid in oids:
             self._lineage[oid] = spec
         try:

@@ -125,6 +125,8 @@ class WorkerHandler:
             self._task_events.append(rec)
 
     def _event_flush_loop(self):
+        from ray_tpu.util import tracing
+
         pid = os.getpid()
         while True:
             time.sleep(0.25)
@@ -135,11 +137,13 @@ class WorkerHandler:
                 del self._log_lines[:]
                 events = self._task_events[:]
                 del self._task_events[:]
-            if not lines and not events:
+            spans = tracing.drain() if tracing.is_enabled() else []
+            if not lines and not events and not spans:
                 continue
             try:
                 self.agent.call(
-                    "worker_events", self.worker_id, pid, events, lines)
+                    "worker_events", self.worker_id, pid, events, lines,
+                    spans)
             except Exception:
                 pass
 
@@ -262,10 +266,21 @@ class WorkerHandler:
         self.backend._block_hooks = self._hooks
         err = None
         try:
+            from ray_tpu.util import tracing
+
             func = ser.loads(spec["func"])
             args, kwargs = ser.loads(spec["args"])
             args, kwargs = self._resolve(args, kwargs)
-            result = func(*args, **kwargs)
+            if spec.get("trace_ctx"):
+                tracing.enable()  # the driver traces: continue here
+                with tracing.span(
+                        f"run:{spec.get('fname', 'task')}",
+                        {"task_id": spec.get("task_id"),
+                         "worker_id": self.worker_id},
+                        parent=spec["trace_ctx"]):
+                    result = func(*args, **kwargs)
+            else:
+                result = func(*args, **kwargs)
             self._store_result(spec, result)
         except BaseException as e:  # noqa: BLE001 — stored, not dropped
             err = repr(e)

@@ -19,10 +19,17 @@ SESSION_TTL_S = 60.0
 
 
 class _Session:
-    __slots__ = ("refs", "last_seen")
+    __slots__ = ("refs", "actors", "last_seen")
 
     def __init__(self):
-        self.refs: dict[str, object] = {}  # oid -> live ObjectRef
+        # oid -> [ObjectRef, pin_count]: a COUNT, not a set — the client
+        # may hold several distinct refs to one oid (each with its own
+        # release finalizer), and the pin must survive until the LAST one
+        # is gone.
+        self.refs: dict[str, list] = {}
+        # Actors created by this session: killed when it ends (reference
+        # Ray Client tears down the session's driver state).
+        self.actors: set[str] = set()
         self.last_seen = time.monotonic()
 
 
@@ -61,16 +68,31 @@ class ClientProxyServer:
             with self._lock:
                 dead = [sid for sid, s in self._sessions.items()
                         if s.last_seen < cutoff]
-                for sid in dead:
-                    # Dropping the refs releases the proxy's holds; the
-                    # cluster ref-counter frees what nothing else holds.
-                    del self._sessions[sid]
+                sessions = [self._sessions.pop(sid) for sid in dead]
+            for s in sessions:
+                self._teardown(s)
+
+    def _teardown(self, s: _Session):
+        """End-of-session cleanup: dropping the refs releases the proxy's
+        holds (the cluster ref-counter frees what nothing else holds),
+        and the session's actors are killed — a crashed client must not
+        leak actor workers and their resources forever."""
+        for actor_id in s.actors:
+            try:
+                self.backend.kill_actor(actor_id)
+            except Exception:
+                pass
+        s.refs.clear()
 
     def _track(self, sid: str, refs) -> list[str]:
         s = self._session(sid)
         oids = []
         for r in refs:
-            s.refs[r.id] = r
+            entry = s.refs.get(r.id)
+            if entry is None:
+                s.refs[r.id] = [r, 1]
+            else:
+                entry[1] += 1
             oids.append(r.id)
         return oids
 
@@ -86,7 +108,9 @@ class ClientProxyServer:
 
     def rpc_client_bye(self, sid: str):
         with self._lock:
-            self._sessions.pop(sid, None)
+            s = self._sessions.pop(sid, None)
+        if s is not None:
+            self._teardown(s)
         return True
 
     def rpc_client_put(self, sid: str, blob: bytes) -> str:
@@ -94,10 +118,15 @@ class ClientProxyServer:
         ref = self.backend.put(value)
         return self._track(sid, [ref])[0]
 
+    def _refs_of(self, s: _Session, oids: list) -> list:
+        return [
+            (s.refs[o][0] if o in s.refs else self.backend.make_ref(o))
+            for o in oids
+        ]
+
     def rpc_client_get(self, sid: str, oids: list, timeout) -> bytes:
         s = self._session(sid)
-        refs = [s.refs.get(o) or self.backend.make_ref(o) for o in oids]
-        values = self.backend.get(refs, timeout)
+        values = self.backend.get(self._refs_of(s, oids), timeout)
         return ser.dumps(values)
 
     def rpc_client_hold(self, sid: str, oid: str):
@@ -108,7 +137,11 @@ class ClientProxyServer:
     def rpc_client_release(self, sid: str, oids: list):
         s = self._session(sid)
         for o in oids:
-            s.refs.pop(o, None)
+            entry = s.refs.get(o)
+            if entry is not None:
+                entry[1] -= 1
+                if entry[1] <= 0:
+                    del s.refs[o]
         return True
 
     def rpc_client_submit_task(self, sid: str, blob: bytes) -> list:
@@ -118,7 +151,9 @@ class ClientProxyServer:
 
     def rpc_client_create_actor(self, sid: str, blob: bytes) -> str:
         cls, args, kwargs, options = ser.loads(blob)
-        return self.backend.create_actor(cls, args, kwargs, **options)
+        actor_id = self.backend.create_actor(cls, args, kwargs, **options)
+        self._session(sid).actors.add(actor_id)
+        return actor_id
 
     def rpc_client_submit_actor_task(self, sid: str, actor_id: str,
                                      method: str, blob: bytes) -> list:
@@ -130,9 +165,8 @@ class ClientProxyServer:
     def rpc_client_wait(self, sid: str, oids: list, num_returns: int,
                         timeout, fetch_local: bool):
         s = self._session(sid)
-        refs = [s.refs.get(o) or self.backend.make_ref(o) for o in oids]
         ready, rest = self.backend.wait(
-            refs, num_returns, timeout, fetch_local)
+            self._refs_of(s, oids), num_returns, timeout, fetch_local)
         return [r.id for r in ready], [r.id for r in rest]
 
     def rpc_client_kill_actor(self, sid: str, actor_id: str,
@@ -141,7 +175,7 @@ class ClientProxyServer:
 
     def rpc_client_cancel(self, sid: str, oid: str, force: bool):
         s = self._session(sid)
-        ref = s.refs.get(oid) or self.backend.make_ref(oid)
+        ref = self._refs_of(s, [oid])[0]
         return self.backend.cancel(ref, force)
 
     def rpc_client_get_named_actor(self, sid: str, name: str) -> str:

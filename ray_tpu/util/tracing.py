@@ -1,0 +1,158 @@
+"""Distributed tracing: OTel-shaped spans across task/actor boundaries.
+
+Reference: ``python/ray/util/tracing/tracing_helper.py`` — when tracing is
+enabled, every task submission records a client-side span and injects its
+context into the task spec; the worker continues the trace around
+execution, so one trace follows a request through submit → schedule →
+run, across processes. The environment ships only the OpenTelemetry API
+(no SDK), so the span model here is self-contained but OTel-shaped:
+trace_id/span_id/parent_id hex ids, name, start/end ns, attributes,
+status — exportable as JSON lines or a Chrome trace.
+
+Usage:
+    from ray_tpu.util import tracing
+    tracing.enable()                  # or RAY_TPU_TRACING_ENABLED=1
+    with tracing.span("my-step", {"k": "v"}):
+        ref = f.remote()              # submit/execute spans attach under it
+    spans = tracing.collect()         # this process's finished spans
+    tracing.export_chrome_trace("/tmp/trace.json")
+
+Worker-side spans ride the existing worker-events batching to the node
+agent and head (``rpc_worker_events`` → LOGS-style aggregation), queryable
+via ``head.call("list_spans")``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_enabled = os.environ.get("RAY_TPU_TRACING_ENABLED", "").lower() in (
+    "1", "true", "yes", "on")
+_finished: List[dict] = []
+_MAX_SPANS = 100_000
+_current = threading.local()  # .span = active span dict
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def _record(span: dict) -> None:
+    with _lock:
+        _finished.append(span)
+        if len(_finished) > _MAX_SPANS:
+            del _finished[: len(_finished) - _MAX_SPANS]
+
+
+def current_span() -> Optional[dict]:
+    return getattr(_current, "span", None)
+
+
+def current_context() -> Optional[dict]:
+    """Injectable context of the active span (what task specs carry)."""
+    s = current_span()
+    if s is None:
+        return None
+    return {"trace_id": s["trace_id"], "span_id": s["span_id"]}
+
+
+@contextmanager
+def span(name: str, attributes: Optional[Dict[str, Any]] = None,
+         parent: Optional[dict] = None):
+    """Start a span; ``parent`` is an injected context from another
+    process (or None to nest under this thread's active span)."""
+    if not _enabled:
+        yield None
+        return
+    if parent is None:
+        parent = current_context()
+    s = {
+        "trace_id": (parent or {}).get("trace_id") or _new_id(16),
+        "span_id": _new_id(8),
+        "parent_id": (parent or {}).get("span_id"),
+        "name": name,
+        "start_ns": time.time_ns(),
+        "end_ns": None,
+        "attributes": dict(attributes or {}),
+        "status": "OK",
+        "pid": os.getpid(),
+    }
+    prev = getattr(_current, "span", None)
+    _current.span = s
+    try:
+        yield s
+    except BaseException as e:
+        s["status"] = f"ERROR: {type(e).__name__}"
+        raise
+    finally:
+        s["end_ns"] = time.time_ns()
+        _current.span = prev
+        _record(s)
+
+
+def collect(clear: bool = False) -> List[dict]:
+    with _lock:
+        out = list(_finished)
+        if clear:
+            del _finished[:]
+    return out
+
+
+def drain() -> List[dict]:
+    """Pop this process's finished spans (used by the worker's event
+    flusher to ship spans to the node agent in batches)."""
+    return collect(clear=True)
+
+
+def export_jsonl(path: str) -> int:
+    spans = collect()
+    with open(path, "w") as f:
+        for s in spans:
+            f.write(json.dumps(s) + "\n")
+    return len(spans)
+
+
+def chrome_events(spans: List[dict]) -> List[dict]:
+    """Chrome trace 'X' events (same target format as `ray timeline`)."""
+    return [
+        {
+            "name": s["name"],
+            "cat": "task",
+            "ph": "X",
+            "ts": s["start_ns"] / 1e3,
+            "dur": ((s["end_ns"] or s["start_ns"]) - s["start_ns"]) / 1e3,
+            "pid": s.get("pid", 0),
+            "tid": s["trace_id"][:8],
+            "args": {**s["attributes"], "status": s["status"],
+                     "span_id": s["span_id"],
+                     "parent_id": s.get("parent_id")},
+        }
+        for s in spans
+    ]
+
+
+def export_chrome_trace(path: str, spans: Optional[List[dict]] = None) -> int:
+    spans = collect() if spans is None else spans
+    with open(path, "w") as f:
+        json.dump(chrome_events(spans), f)
+    return len(spans)

@@ -1,0 +1,47 @@
+"""Sanitizer/race-detection runs for the C++ shm store (SURVEY §5.2).
+
+The reference CI builds its C++ core under ASAN/TSAN
+(``src/ray/common/test`` targets with ``--config=asan`` etc.); here the
+one native component gets the same treatment: a multithreaded stress
+driver (alloc/seal/get/release/pin/evict/delete contention on one
+segment) compiled and run under AddressSanitizer and ThreadSanitizer.
+A sanitizer report aborts the binary, failing the test.
+"""
+
+import subprocess
+
+import pytest
+
+from ray_tpu._native.build import build_stress_binary
+
+
+def _run(binary: str, env=None) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [binary, "6", "2000"], capture_output=True, text=True, timeout=300,
+        env=env,
+    )
+
+
+def test_stress_plain():
+    p = _run(build_stress_binary(None))
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "done:" in p.stderr
+
+
+def test_stress_asan():
+    p = _run(build_stress_binary("address"))
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "ERROR: AddressSanitizer" not in p.stderr
+
+
+def test_stress_tsan():
+    import os
+
+    env = dict(os.environ)
+    # The store's cross-process robust mutex lives in shared memory;
+    # TSAN tracks pthread mutexes fine, but suppress its history-size
+    # exhaustion on long runs.
+    env.setdefault("TSAN_OPTIONS", "halt_on_error=1 history_size=7")
+    p = _run(build_stress_binary("thread"), env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "WARNING: ThreadSanitizer" not in p.stderr
