@@ -23,6 +23,7 @@ _TASK_ONLY: dict[str, tuple] = {}
 
 _ACTOR_ONLY = {
     "max_concurrency": (int,),
+    "concurrency_groups": (dict, type(None)),
     "max_restarts": (int,),
     "max_task_retries": (int,),
     "lifetime": (str, type(None)),
@@ -50,4 +51,18 @@ def validate_task_options(options: dict[str, Any]) -> dict[str, Any]:
 
 
 def validate_actor_options(options: dict[str, Any]) -> dict[str, Any]:
-    return _validate(options, {**_COMMON, **_ACTOR_ONLY}, "actor")
+    out = _validate(options, {**_COMMON, **_ACTOR_ONLY}, "actor")
+    groups = out.get("concurrency_groups")
+    if groups:
+        for gname, n in groups.items():
+            if not isinstance(gname, str) or not gname:
+                raise ValueError(
+                    f"concurrency_groups keys must be non-empty strings, "
+                    f"got {gname!r}"
+                )
+            if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+                raise ValueError(
+                    f"concurrency_groups[{gname!r}] must be a positive int "
+                    f"thread count, got {n!r}"
+                )
+    return out

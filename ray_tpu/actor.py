@@ -11,23 +11,35 @@ from ray_tpu._private.options import validate_actor_options
 class ActorMethod:
     """Bound method proxy: ``handle.method.remote(args)``."""
 
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1,
+                 concurrency_group: str | None = None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
+        extra = {}
+        if self._concurrency_group is not None:
+            extra["concurrency_group"] = self._concurrency_group
         refs = _worker.backend().submit_actor_task(
             self._handle._actor_id,
             self._method_name,
             args,
             kwargs,
             num_returns=self._num_returns,
+            **extra,
         )
         return refs[0] if self._num_returns == 1 else refs
 
-    def options(self, num_returns: int = 1) -> "ActorMethod":
-        return ActorMethod(self._handle, self._method_name, num_returns)
+    def options(self, num_returns: int = 1,
+                concurrency_group: str | None = None) -> "ActorMethod":
+        """Per-call overrides (reference ``@ray.method`` options):
+        ``concurrency_group`` routes the call to one of the actor's
+        declared executor groups instead of the default queue."""
+        return ActorMethod(self._handle, self._method_name, num_returns,
+                           concurrency_group)
 
 
 class ActorHandle:

@@ -733,27 +733,32 @@ class ClusterBackend:
         }
         spec["pg_id"] = spec["sinfo"]["pg_id"]
         spec["bundle_index"] = spec["sinfo"]["bundle_index"]
+        from contextlib import nullcontext
+
         from ray_tpu.util import tracing
 
-        if tracing.is_enabled():
-            # Submission span; its context rides the spec so the worker
-            # parents the execution span under it (tracing_helper.py).
-            with tracing.span(
-                    f"submit:{spec['fname']}",
-                    {"task_id": task_id}) as s:
-                spec["trace_ctx"] = (
-                    {"trace_id": s["trace_id"], "span_id": s["span_id"]}
-                    if s else None
-                )
-        for oid in oids:
-            self._lineage[oid] = spec
-        try:
-            self._submit_spec(spec, allow_pending=True)
-        except (ValueError, TimeoutError) as e:
+        # Submission span wraps the ACTUAL submit (schedule RPC included)
+        # so its duration/status mean something; its context rides the
+        # spec so the worker parents the execution span under it
+        # (tracing_helper.py).
+        span_cm = (tracing.span(f"submit:{spec['fname']}",
+                                {"task_id": task_id})
+                   if tracing.is_enabled() else nullcontext())
+        with span_cm as s:
+            if s is not None:
+                spec["trace_ctx"] = {
+                    "trace_id": s["trace_id"], "span_id": s["span_id"],
+                }
             for oid in oids:
-                self._lineage.pop(oid, None)
-                self.put_with_id(oid, TaskError(spec["fname"], str(e), repr(e)),
-                                 is_error=True)
+                self._lineage[oid] = spec
+            try:
+                self._submit_spec(spec, allow_pending=True)
+            except (ValueError, TimeoutError) as e:
+                for oid in oids:
+                    self._lineage.pop(oid, None)
+                    self.put_with_id(
+                        oid, TaskError(spec["fname"], str(e), repr(e)),
+                        is_error=True)
         return refs
 
     # -- actor plane -------------------------------------------------------
@@ -791,6 +796,10 @@ class ClusterBackend:
             # executor threads (reference threaded-actor semantics; call
             # ordering is relaxed).
             "max_concurrency": int(max_concurrency),
+            # {group_name: n_threads}: named executor groups with their
+            # own queues (reference concurrency groups) — calls routed
+            # via ActorMethod.options(concurrency_group=...).
+            "concurrency_groups": options.get("concurrency_groups"),
         }
         spec["pg_id"] = spec["sinfo"]["pg_id"]
         spec["bundle_index"] = spec["sinfo"]["bundle_index"]
@@ -857,6 +866,7 @@ class ClusterBackend:
             "num_returns": num_returns,
             "args": args_blob,
             "borrowed": borrowed,
+            "concurrency_group": _options.get("concurrency_group"),
         }
         try:
             info = self._actor_info(actor_id)

@@ -1,0 +1,134 @@
+"""Typed GCS client: accessor objects over the head's RPC surface.
+
+Reference: ``src/ray/gcs/gcs_client/accessor.h`` + the Python
+``GcsClient`` — instead of stringly-typed ``head.call("...")`` scattered
+through call sites, a ``GcsClient`` exposes typed accessors per table
+(nodes, actors, objects, placement groups, internal KV, pubsub, spans).
+Library code and tools (dashboard, CLI, state API) can depend on this
+stable surface while the wire protocol underneath evolves.
+
+    gcs = GcsClient(head_address)
+    gcs.nodes.all()                  # [{"NodeID": ..., "Alive": ...}]
+    gcs.actors.get(actor_id)
+    gcs.kv.put("k", b"v"); gcs.kv.get("k")
+    gcs.placement_groups.table()
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ray_tpu.cluster.rpc import RpcClient
+
+
+class _Accessor:
+    def __init__(self, rpc: RpcClient):
+        self._rpc = rpc
+
+
+class NodeInfoAccessor(_Accessor):
+    def all(self) -> list[dict]:
+        return self._rpc.call("nodes")
+
+    def alive(self) -> list[dict]:
+        return [n for n in self.all() if n["Alive"]]
+
+    def resources_total(self) -> dict:
+        return self._rpc.call("cluster_resources")
+
+    def resources_available(self) -> dict:
+        return self._rpc.call("available_resources")
+
+    def drain(self, node_id: str) -> None:
+        self._rpc.call("drain_node", node_id)
+
+
+class ActorInfoAccessor(_Accessor):
+    def all(self) -> list[dict]:
+        return self._rpc.call("list_actors")
+
+    def get(self, actor_id: str, timeout: float = 10.0) -> Optional[dict]:
+        return self._rpc.call("get_actor", actor_id, timeout,
+                              timeout=timeout + 5.0)
+
+    def by_name(self, name: str) -> Optional[dict]:
+        return self._rpc.call("get_named_actor", name)
+
+    def kill(self, actor_id: str, reason: str = "gcs_client.kill") -> None:
+        self._rpc.call("mark_actor_dead", actor_id, reason, False)
+
+
+class ObjectInfoAccessor(_Accessor):
+    def all(self, limit: int = 1000) -> list[dict]:
+        return self._rpc.call("list_objects", limit)
+
+    def locations(self, object_id: str) -> Optional[dict]:
+        return self._rpc.call("locations", object_id)
+
+    def on_node(self, node_id: str) -> list[str]:
+        return self._rpc.call("objects_on_node", node_id)
+
+
+class PlacementGroupAccessor(_Accessor):
+    def table(self, pg_id: Optional[str] = None):
+        return self._rpc.call("placement_group_table", pg_id)
+
+    def remove(self, pg_id: str) -> None:
+        self._rpc.call("remove_placement_group", pg_id)
+
+
+class InternalKvAccessor(_Accessor):
+    def put(self, key: str, value: Any, overwrite: bool = True) -> bool:
+        return self._rpc.call("kv_put", key, value, overwrite)
+
+    def get(self, key: str) -> Any:
+        return self._rpc.call("kv_get", key)
+
+    def delete(self, key: str) -> bool:
+        return self._rpc.call("kv_del", key)
+
+    def keys(self, prefix: str = "") -> list[str]:
+        return self._rpc.call("kv_keys", prefix)
+
+
+class PubsubAccessor(_Accessor):
+    def subscribe(self, sub_id: str, channel: str, keys=None) -> bool:
+        return self._rpc.call("pubsub_subscribe", sub_id, channel, keys)
+
+    def poll(self, sub_id: str, timeout: float = 10.0, max_msgs: int = 1000):
+        return self._rpc.call("pubsub_poll", sub_id, timeout, max_msgs,
+                              timeout=timeout + 10.0)
+
+    def unsubscribe(self, sub_id: str, channel=None) -> bool:
+        return self._rpc.call("pubsub_unsubscribe", sub_id, channel)
+
+    def publish(self, channel: str, key: str, message) -> int:
+        return self._rpc.call("publish", channel, key, message)
+
+
+class TaskInfoAccessor(_Accessor):
+    def all(self, limit: int = 1000) -> list[dict]:
+        return self._rpc.call("list_tasks", limit)
+
+    def spans(self, trace_id: Optional[str] = None,
+              limit: int = 10_000) -> list[dict]:
+        return self._rpc.call("list_spans", trace_id, limit)
+
+
+class GcsClient:
+    def __init__(self, address: str, reconnect_window: float = 15.0):
+        self.address = address
+        self._rpc = RpcClient(address, reconnect_window=reconnect_window)
+        self.nodes = NodeInfoAccessor(self._rpc)
+        self.actors = ActorInfoAccessor(self._rpc)
+        self.objects = ObjectInfoAccessor(self._rpc)
+        self.placement_groups = PlacementGroupAccessor(self._rpc)
+        self.kv = InternalKvAccessor(self._rpc)
+        self.pubsub = PubsubAccessor(self._rpc)
+        self.tasks = TaskInfoAccessor(self._rpc)
+
+    def ping(self) -> bool:
+        return self._rpc.call("ping") == "pong"
+
+    def close(self) -> None:
+        self._rpc.close()

@@ -80,6 +80,10 @@ class WorkerHandler:
             lambda: self.agent.call("task_unblocked", self.worker_id),
         )
         self._q: queue.Queue = queue.Queue()
+        # Named concurrency groups: each gets its own queue + executor
+        # threads (reference actor concurrency groups — a long call in
+        # one group never blocks another group's methods).
+        self._group_queues: dict[str, queue.Queue] = {}
         self._actor_instance = None
         self._actor_dead_cause: str | None = None
         self._actor_id: str | None = None
@@ -155,11 +159,32 @@ class WorkerHandler:
 
     def rpc_create_actor(self, spec: dict):
         self._actor_id = spec["actor_id"]
+        # Group queues exist from the start so calls routed to a group
+        # can never race the constructor (their executor threads spawn
+        # after the ctor and gate on _actor_ready regardless).
+        for group in (spec.get("concurrency_groups") or {}):
+            self._group_queues[group] = queue.Queue()
         self._q.put(("actor_ctor", spec))
         return True
 
     def rpc_push_actor_task(self, spec: dict):
-        self._q.put(("actor_task", spec))
+        group = spec.get("concurrency_group")
+        q = self._group_queues.get(group) if group else None
+        if group and q is None:
+            rec = self._record(spec, "ACTOR_TASK")
+            self._store_error(
+                spec,
+                TaskError(
+                    spec.get("method", "actor_task"),
+                    f"actor has no concurrency group {group!r}",
+                    "no-such-group",
+                ),
+            )
+            self._end_borrows(spec)
+            # Visible to the state API like every other failure path.
+            self._finish(rec, f"no concurrency group {group!r}")
+            return False
+        (q or self._q).put(("actor_task", spec))
         return True
 
     def rpc_ping(self):
@@ -198,9 +223,10 @@ class WorkerHandler:
         with self._ev_lock:
             self._task_events.append(rec)
 
-    def _exec_loop(self):
+    def _exec_loop(self, q: queue.Queue | None = None):
+        q = q if q is not None else self._q
         while True:
-            kind, spec = self._q.get()
+            kind, spec = q.get()
             try:
                 if kind == "task":
                     # finally: a late-delivered cancel injection escaping
@@ -329,6 +355,12 @@ class WorkerHandler:
             self._actor_ready.set()
             for _ in range(int(spec.get("max_concurrency", 1)) - 1):
                 threading.Thread(target=self._exec_loop, daemon=True).start()
+            for group, n in (spec.get("concurrency_groups") or {}).items():
+                gq = self._group_queues[group]  # created at rpc_create_actor
+                for _ in range(max(1, int(n))):
+                    threading.Thread(
+                        target=self._exec_loop, args=(gq,), daemon=True
+                    ).start()
 
     def _run_actor_task(self, spec):
         self._actor_ready.wait(timeout=300.0)

@@ -106,6 +106,9 @@ class _ActorState:
         self.caller_chains: dict[int, threading.Event] = {}
         # Set once the ctor acquires lifetime resources; called on kill.
         self.release_resources: Callable[[], None] | None = None
+        # Declared concurrency group names (local mode shares one pool,
+        # but an unknown group must still error like the cluster does).
+        self.concurrency_groups: set[str] = set()
 
 
 class _PlacementGroupState:
@@ -742,6 +745,11 @@ class LocalBackend:
         **_options,
     ) -> str:
         actor_id = ids.new_actor_id()
+        # Local-mode approximation of concurrency groups: the group
+        # threads join one shared pool (total parallelism matches; the
+        # per-group queue ISOLATION is a cluster-backend property).
+        groups = _options.get("concurrency_groups") or {}
+        max_concurrency += sum(int(n) for n in groups.values())
         plan = self._plan_resources(_options, is_actor=True)  # raises if infeasible
         with self._lock:
             if name is not None:
@@ -749,6 +757,7 @@ class LocalBackend:
                     raise ValueError(f"actor name {name!r} already taken")
                 self._named_actors[name] = actor_id
         state = _ActorState(None, max_concurrency, name)
+        state.concurrency_groups = set(groups)
         self._actors[actor_id] = state
         self._actor_records[actor_id] = {"class_name": cls.__name__}
         pins = self._pin_ref_args(args, kwargs)
@@ -863,6 +872,18 @@ class LocalBackend:
         self._record_task(task_id, method_name, kind="ACTOR_TASK")
         if state is None:
             self._store_error(oids, ActorError(f"no such actor: {actor_id}"))
+            return refs
+        group = _options.get("concurrency_group")
+        if group and group not in state.concurrency_groups:
+            # Same contract as the cluster worker: unknown group = error
+            # (local mode shares one pool but must not mask the typo).
+            self._store_error(
+                oids,
+                TaskError(method_name,
+                          f"actor has no concurrency group {group!r}",
+                          "no-such-group"),
+            )
+            self._record_task_state(task_id, "FAILED", "no-such-group")
             return refs
 
         pins = self._pin_ref_args(args, kwargs)

@@ -6,5 +6,20 @@ code runs under any mesh and any rules table.
 """
 
 from ray_tpu.models.gpt2 import GPT2Config, gpt2_forward, gpt2_init, gpt2_loss
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    llama_forward,
+    llama_init,
+    llama_loss,
+)
 
-__all__ = ["GPT2Config", "gpt2_forward", "gpt2_init", "gpt2_loss"]
+__all__ = [
+    "GPT2Config",
+    "LlamaConfig",
+    "gpt2_forward",
+    "gpt2_init",
+    "gpt2_loss",
+    "llama_forward",
+    "llama_init",
+    "llama_loss",
+]

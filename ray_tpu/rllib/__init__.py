@@ -1,13 +1,18 @@
 """RLlib-equivalent: RL algorithms on the task/actor substrate.
 
-Reference parity (minimum viable, SURVEY.md §7 step 11): Algorithm/
-Trainable contract, builder-style config, PPO with a fully jitted learner
-(Anakin) plus RolloutWorker actors (Sebulba), pure-jax vectorized envs,
-SampleBatch. The reference's ~30 algorithms narrow to PPO first — the
-execution model (jit the whole train iteration; actors only for
-off-device sampling) is the part that generalizes.
+Reference parity (SURVEY.md §7 step 11): Algorithm/Trainable contract,
+builder-style configs, pure-jax vectorized envs, SampleBatch. Two
+algorithm families:
+* PPO — fully jitted on-policy learner (Anakin) plus RolloutWorker
+  actors (Sebulba);
+* DQN — off-policy double-Q with an ON-DEVICE replay buffer, the whole
+  act/store/sample/update iteration as one jitted program.
+The execution model (jit the whole train iteration; actors only for
+off-device sampling) is the part of the reference's ~30 algorithms that
+generalizes.
 """
 
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, make_vec_env
 from ray_tpu.rllib.ppo import PPO, PPOConfig, RolloutWorker, policy_apply
 from ray_tpu.rllib.sample_batch import SampleBatch
@@ -15,6 +20,8 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 __all__ = [
     "CartPole",
     "make_vec_env",
+    "DQN",
+    "DQNConfig",
     "PPO",
     "PPOConfig",
     "RolloutWorker",

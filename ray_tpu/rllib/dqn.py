@@ -1,0 +1,256 @@
+"""DQN: double deep Q-learning with an ON-DEVICE replay buffer.
+
+Second algorithm family next to PPO (``rllib/ppo.py``), same TPU-native
+Anakin design: the vectorized env, the epsilon-greedy actor, the replay
+buffer, and the learner all live in ONE jitted program — a training
+iteration is a single device computation with no host↔device bounce per
+step (the reference's DQN moves sample batches host-side through replay
+actors, ``rllib/algorithms/dqn/dqn.py``).
+
+Pieces: epsilon-greedy acting with linear decay, uniform replay sampling,
+double-DQN targets (online net argmax, target net value), periodic
+target-network sync, Adam. ``.train()`` follows the reference's
+Trainable contract: one iteration -> result dict.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.env import CartPole, make_vec_env
+from ray_tpu.rllib.ppo import mlp_apply, mlp_init
+
+
+class DQNConfig:
+    """Builder-style config (``DQNConfig().environment(...).training(...)``)."""
+
+    def __init__(self):
+        self.env = CartPole()
+        self.num_envs = 16
+        self.steps_per_iter = 256       # env steps (per env) per train()
+        self.buffer_size = 50_000
+        self.batch_size = 128
+        self.updates_per_iter = 64
+        self.gamma = 0.99
+        self.lr = 1e-3
+        self.hidden_sizes = (64, 64)
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 5_000
+        self.target_update_every = 500  # gradient steps between syncs
+        self.learning_starts = 500      # buffer fill before updates
+        self.seed = 0
+
+    def environment(self, env=None) -> "DQNConfig":
+        if env is not None:
+            self.env = env
+        return self
+
+    def rollouts(self, *, num_envs: Optional[int] = None) -> "DQNConfig":
+        if num_envs is not None:
+            self.num_envs = num_envs
+        return self
+
+    def training(self, **kwargs) -> "DQNConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown DQN option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "DQNConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+def _make_train_iter(cfg: DQNConfig):
+    env = cfg.env
+    obs_size, n_act = env.observation_size, env.num_actions
+    reset_fn, step_fn, obs_fn = make_vec_env(env, cfg.num_envs)
+
+    def buffer_add(buf, obs, actions, rewards, next_obs, dones):
+        n_new = obs.shape[0]
+        idx = (buf["ptr"] + jnp.arange(n_new)) % cfg.buffer_size
+        return {
+            "obs": buf["obs"].at[idx].set(obs),
+            "actions": buf["actions"].at[idx].set(actions),
+            "rewards": buf["rewards"].at[idx].set(rewards),
+            "next_obs": buf["next_obs"].at[idx].set(next_obs),
+            "dones": buf["dones"].at[idx].set(dones),
+            "ptr": (buf["ptr"] + n_new) % cfg.buffer_size,
+            "size": jnp.minimum(buf["size"] + n_new, cfg.buffer_size),
+        }
+
+    def epsilon_at(global_step):
+        frac = jnp.clip(global_step / cfg.epsilon_decay_steps, 0.0, 1.0)
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def td_loss(params, target_params, batch):
+        q = mlp_apply(params, batch["obs"])  # [B, A]
+        q_taken = jnp.take_along_axis(
+            q, batch["actions"][:, None], axis=1)[:, 0]
+        # Double DQN: online net picks, target net evaluates.
+        next_online = mlp_apply(params, batch["next_obs"])
+        next_act = jnp.argmax(next_online, axis=1)
+        next_target = mlp_apply(target_params, batch["next_obs"])
+        next_q = jnp.take_along_axis(
+            next_target, next_act[:, None], axis=1)[:, 0]
+        target = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) * \
+            jax.lax.stop_gradient(next_q)
+        err = q_taken - target
+        return jnp.mean(err * err)
+
+    def adam_step(params, opt, grads):
+        t = opt["t"] + 1
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, opt["mu"], grads)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                          opt["nu"], grads)
+        mhat = jax.tree.map(lambda m: m / (1 - b1 ** t), mu)
+        vhat = jax.tree.map(lambda v: v / (1 - b2 ** t), nu)
+        params = jax.tree.map(
+            lambda p, m, v: p - cfg.lr * m / (jnp.sqrt(v) + eps),
+            params, mhat, vhat,
+        )
+        return params, {"mu": mu, "nu": nu, "t": t}
+
+    @jax.jit
+    def reset(rng):
+        return reset_fn(rng)
+
+    @jax.jit
+    def train_iter(learner, states, rng):
+        def env_step(carry, _):
+            learner, states, rng = carry
+            rng, k_rand, k_expl, k_step = jax.random.split(rng, 4)
+            obs = obs_fn(states)
+            q = mlp_apply(learner["params"], obs)
+            greedy = jnp.argmax(q, axis=1)
+            randa = jax.random.randint(
+                k_rand, (cfg.num_envs,), 0, n_act)
+            eps = epsilon_at(learner["env_steps"])
+            explore = jax.random.uniform(k_expl, (cfg.num_envs,)) < eps
+            actions = jnp.where(explore, randa, greedy)
+            nstates, nobs, rewards, dones = step_fn(states, actions, k_step)
+            learner = dict(
+                learner,
+                buffer=buffer_add(learner["buffer"], obs, actions, rewards,
+                                  nobs, dones.astype(jnp.float32)),
+                env_steps=learner["env_steps"] + cfg.num_envs,
+                done_count=learner["done_count"] + jnp.sum(dones),
+            )
+            return (learner, nstates, rng), None
+
+        (learner, states, rng), _ = jax.lax.scan(
+            env_step, (learner, states, rng), None, length=cfg.steps_per_iter)
+
+        def update(carry, _):
+            learner, rng = carry
+            rng, k = jax.random.split(rng)
+            buf = learner["buffer"]
+            idx = jax.random.randint(
+                k, (cfg.batch_size,), 0,
+                jnp.maximum(buf["size"], 1))
+            batch = {
+                "obs": buf["obs"][idx],
+                "actions": buf["actions"][idx],
+                "rewards": buf["rewards"][idx],
+                "next_obs": buf["next_obs"][idx],
+                "dones": buf["dones"][idx],
+            }
+            loss, grads = jax.value_and_grad(td_loss)(
+                learner["params"], learner["target_params"], batch)
+            # Gate the whole update on learning_starts: before the buffer
+            # warms up, apply a zero update.
+            ready = (buf["size"] >= cfg.learning_starts).astype(jnp.float32)
+            grads = jax.tree.map(lambda g: g * ready, grads)
+            params, opt = adam_step(learner["params"], learner["opt"], grads)
+            sync = (opt["t"] % cfg.target_update_every) == 0
+            target = jax.tree.map(
+                lambda t_, p: jnp.where(sync, p, t_),
+                learner["target_params"], params,
+            )
+            learner = dict(learner, params=params, opt=opt,
+                           target_params=target)
+            return (learner, rng), loss * ready
+
+        (learner, rng), losses = jax.lax.scan(
+            update, (learner, rng), None, length=cfg.updates_per_iter)
+        metrics = {
+            "loss": jnp.mean(losses),
+            "epsilon": epsilon_at(learner["env_steps"]),
+            "buffer_size": learner["buffer"]["size"].astype(jnp.float32),
+        }
+        return learner, states, rng, metrics
+
+    return reset, train_iter
+
+
+class DQN:
+    """Algorithm: ``.train()`` one iteration -> result dict
+    (``rllib/algorithms/algorithm.py:142`` Trainable contract)."""
+
+    def __init__(self, config: DQNConfig):
+        self.config = config
+        rng = jax.random.key(config.seed)
+        k_param, k_env, self._rng = jax.random.split(rng, 3)
+        env = config.env
+        sizes = (env.observation_size, *config.hidden_sizes, env.num_actions)
+        params = mlp_init(k_param, sizes)
+        self._reset, self._train_iter = _make_train_iter(config)
+        n, obs_size = config.buffer_size, env.observation_size
+        self._learner = {
+            "params": params,
+            "target_params": jax.tree.map(jnp.copy, params),
+            "opt": {
+                "mu": jax.tree.map(jnp.zeros_like, params),
+                "nu": jax.tree.map(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32),
+            },
+            "buffer": {
+                "obs": jnp.zeros((n, obs_size), jnp.float32),
+                "actions": jnp.zeros((n,), jnp.int32),
+                "rewards": jnp.zeros((n,), jnp.float32),
+                "next_obs": jnp.zeros((n, obs_size), jnp.float32),
+                "dones": jnp.zeros((n,), jnp.float32),
+                "ptr": jnp.zeros((), jnp.int32),
+                "size": jnp.zeros((), jnp.int32),
+            },
+            "env_steps": jnp.zeros((), jnp.int32),
+            "done_count": jnp.zeros((), jnp.int32),
+        }
+        self._states = self._reset(k_env)
+        self._iteration = 0
+
+    @property
+    def params(self):
+        return self._learner["params"]
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        prev_steps = int(self._learner["env_steps"])
+        prev_dones = int(self._learner["done_count"])
+        self._learner, self._states, self._rng, metrics = self._train_iter(
+            self._learner, self._states, self._rng)
+        self._iteration += 1
+        steps = int(self._learner["env_steps"]) - prev_steps
+        dones = max(1, int(self._learner["done_count"]) - prev_dones)
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter": steps,
+            "episode_reward_mean": steps / dones,  # CartPole: reward == len
+            "time_this_iter_s": time.perf_counter() - start,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def compute_single_action(self, obs) -> int:
+        q = mlp_apply(self._learner["params"], jnp.asarray(obs)[None])
+        return int(jnp.argmax(q[0]))

@@ -75,25 +75,43 @@ class ClientProxyServer:
     def _teardown(self, s: _Session):
         """End-of-session cleanup: dropping the refs releases the proxy's
         holds (the cluster ref-counter frees what nothing else holds),
-        and the session's actors are killed — a crashed client must not
-        leak actor workers and their resources forever."""
-        for actor_id in s.actors:
+        and the session's UNNAMED actors are killed — a crashed client
+        must not leak actor workers forever. Named actors survive: they
+        are discoverable (and possibly in use) by other sessions, and a
+        client whose link blipped past the TTL can find them again."""
+        with self._lock:
+            actors = list(s.actors)
+            s.refs.clear()
+        for actor_id in actors:
+            try:
+                info = self.backend._actor_info(actor_id, refresh=True)
+            except Exception:
+                # Unknown state (head slow/unreachable) must fail SAFE:
+                # skipping the kill leaks at worst one worker; killing a
+                # named actor another session uses breaks them for real.
+                continue
+            if info.get("name"):
+                continue
             try:
                 self.backend.kill_actor(actor_id)
             except Exception:
                 pass
-        s.refs.clear()
+
+    # Ref pin bookkeeping runs under self._lock: per-connection server
+    # threads race (the client's heartbeat releases concurrently with its
+    # main thread's get/submit), and count updates are check-then-act.
 
     def _track(self, sid: str, refs) -> list[str]:
         s = self._session(sid)
         oids = []
-        for r in refs:
-            entry = s.refs.get(r.id)
-            if entry is None:
-                s.refs[r.id] = [r, 1]
-            else:
-                entry[1] += 1
-            oids.append(r.id)
+        with self._lock:
+            for r in refs:
+                entry = s.refs.get(r.id)
+                if entry is None:
+                    s.refs[r.id] = [r, 1]
+                else:
+                    entry[1] += 1
+                oids.append(r.id)
         return oids
 
     # -- rpc surface -------------------------------------------------------
@@ -119,9 +137,11 @@ class ClientProxyServer:
         return self._track(sid, [ref])[0]
 
     def _refs_of(self, s: _Session, oids: list) -> list:
+        with self._lock:
+            entries = [s.refs.get(o) for o in oids]
         return [
-            (s.refs[o][0] if o in s.refs else self.backend.make_ref(o))
-            for o in oids
+            (e[0] if e is not None else self.backend.make_ref(o))
+            for e, o in zip(entries, oids)
         ]
 
     def rpc_client_get(self, sid: str, oids: list, timeout) -> bytes:
@@ -136,12 +156,13 @@ class ClientProxyServer:
 
     def rpc_client_release(self, sid: str, oids: list):
         s = self._session(sid)
-        for o in oids:
-            entry = s.refs.get(o)
-            if entry is not None:
-                entry[1] -= 1
-                if entry[1] <= 0:
-                    del s.refs[o]
+        with self._lock:
+            for o in oids:
+                entry = s.refs.get(o)
+                if entry is not None:
+                    entry[1] -= 1
+                    if entry[1] <= 0:
+                        del s.refs[o]
         return True
 
     def rpc_client_submit_task(self, sid: str, blob: bytes) -> list:

@@ -1,0 +1,65 @@
+"""Typed GCS client accessors (``gcs_client/accessor.h`` analog)."""
+
+import sys
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.cluster.gcs_client import GcsClient
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_accessors_end_to_end(cluster):
+    gcs = GcsClient(cluster.address)
+    assert gcs.ping()
+    assert len(gcs.nodes.alive()) == 1
+    assert gcs.nodes.resources_total()["CPU"] == 2.0
+
+    @ray_tpu.remote
+    class Named:
+        def ping(self):
+            return "pong"
+
+    a = Named.options(name="gcs-probe").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    assert any(x["class_name"] == "Named" for x in gcs.actors.all())
+    info = gcs.actors.by_name("gcs-probe")
+    assert info and info["state"] == "ALIVE"
+    assert gcs.actors.get(info["actor_id"])["actor_id"] == info["actor_id"]
+
+    assert gcs.kv.put("gcs:k", b"v1")
+    assert gcs.kv.get("gcs:k") == b"v1"
+    assert "gcs:k" in gcs.kv.keys("gcs:")
+    assert gcs.kv.delete("gcs:k")
+
+    ref = ray_tpu.put("loc-probe")
+    loc = gcs.objects.locations(ref.id)
+    assert loc and loc["nodes"]
+
+    gcs.pubsub.subscribe("gcs-sub", "ACTORS")
+    ray_tpu.kill(a)
+    import time
+
+    deadline = time.monotonic() + 15
+    seen_dead = False
+    while time.monotonic() < deadline and not seen_dead:
+        msgs, _ = gcs.pubsub.poll("gcs-sub", timeout=1.0)
+        seen_dead = any(m["data"]["state"] == "DEAD" for m in msgs)
+    assert seen_dead
+    assert isinstance(gcs.tasks.all(), list)
+    gcs.close()

@@ -127,3 +127,28 @@ def test_ppo_as_tune_trainable():
         tune_config=tune.TuneConfig(metric="episode_reward_mean", mode="max"),
     ).fit()
     assert len(grid) == 2 and not grid.errors
+
+
+def test_dqn_learns_cartpole():
+    """DQN (double-Q, on-device replay) improves CartPole episode length
+    — per-algorithm learning test, like the reference's
+    ``rllib/algorithms/dqn/tests/test_dqn.py``."""
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (
+        DQNConfig()
+        .rollouts(num_envs=16)
+        .training(
+            steps_per_iter=128, updates_per_iter=128, learning_starts=512,
+            buffer_size=20000, epsilon_decay_steps=20000, lr=5e-4,
+            target_update_every=250,
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    rewards = [algo.train()["episode_reward_mean"] for _ in range(60)]
+    early = sum(rewards[:5]) / 5
+    late = sum(rewards[-10:]) / 10
+    assert late > early * 2.5, (early, late)
+    # Greedy policy sanity: acting API returns a valid action.
+    assert algo.compute_single_action([0.0, 0.0, 0.0, 0.0]) in (0, 1)
