@@ -32,6 +32,7 @@ from ray_tpu.core.object_ref import (
     TaskCancelledError,
     TaskError,
 )
+from ray_tpu import cross_language
 from ray_tpu.api import (
     ObjectRef,
     available_resources,
@@ -51,6 +52,7 @@ from ray_tpu.api import (
 
 __all__ = [
     "__version__",
+    "cross_language",
     "ActorError",
     "GetTimeoutError",
     "ObjectLostError",

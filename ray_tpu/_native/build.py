@@ -48,6 +48,20 @@ def ensure_built(name: str) -> str:
                     ["-O2", "-g", "-fPIC", "-shared"])
 
 
+def build_cpp_worker() -> str:
+    """Build the sample C++ worker/driver binary (the native worker API's
+    reference executable — ``cpp/`` worker parity). Also usable as a
+    template: user worker binaries compile their own functions against
+    raytpu.h + raytpu_runtime.cc the same way."""
+    sources = [
+        os.path.join(_SRC_DIR, "sample_worker.cc"),
+        os.path.join(_SRC_DIR, "raytpu_runtime.cc"),
+        os.path.join(_SRC_DIR, "shm_store.cc"),
+    ]
+    return _compile(
+        os.path.join(_LIB_DIR, "raytpu_sample_worker"), sources, ["-O2", "-g"])
+
+
 def build_stress_binary(sanitize: str | None = None) -> str:
     """Build the multithreaded store stress driver (store_stress.cc +
     shm_store.cc in one binary), optionally under a sanitizer

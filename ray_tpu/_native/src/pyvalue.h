@@ -1,0 +1,612 @@
+// Restricted-pickle + msgpack codec for the C++ worker API.
+//
+// The repo's wire plane is length-prefixed pickle (ray_tpu/cluster/rpc.py)
+// and stored objects are pickled payloads with a msgpack size header
+// (ray_tpu/core/serialization.py). A native worker therefore needs to
+// read and write *restricted* pickle: the closed type set
+// {None, bool, int, float, str, bytes, list, tuple, dict} — exactly the
+// restriction the reference places on cross-language values (its
+// cross_language.py limits args to msgpack-able types; here the envelope
+// is pickle, the restriction is the same).
+//
+// Decode handles the opcodes CPython's protocol-5 pickler emits for these
+// types (FRAME/MEMOIZE/BINGET included). Encode declares protocol 3 and
+// uses the plain binary opcodes. Anything outside the type set raises
+// CodecError — a C++ worker receiving a cloudpickled Python closure fails
+// loudly, it does not guess.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace raytpu {
+
+struct CodecError : std::runtime_error {
+  explicit CodecError(const std::string& m) : std::runtime_error(m) {}
+};
+
+// ------------------------------------------------------------- Value
+struct Value {
+  enum Kind { NONE, BOOL, INT, FLOAT, STR, BYTES, LIST, TUPLE, DICT } kind =
+      NONE;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;  // STR (utf-8) and BYTES share the field
+  std::vector<Value> items;                      // LIST / TUPLE
+  std::vector<std::pair<Value, Value>> pairs;    // DICT
+
+  Value() = default;
+  static Value None() { return Value(); }
+  static Value Bool(bool v) { Value x; x.kind = BOOL; x.b = v; return x; }
+  static Value Int(int64_t v) { Value x; x.kind = INT; x.i = v; return x; }
+  static Value Float(double v) { Value x; x.kind = FLOAT; x.f = v; return x; }
+  static Value Str(std::string v) {
+    Value x; x.kind = STR; x.s = std::move(v); return x;
+  }
+  static Value Bytes(std::string v) {
+    Value x; x.kind = BYTES; x.s = std::move(v); return x;
+  }
+  static Value List(std::vector<Value> v = {}) {
+    Value x; x.kind = LIST; x.items = std::move(v); return x;
+  }
+  static Value Tuple(std::vector<Value> v = {}) {
+    Value x; x.kind = TUPLE; x.items = std::move(v); return x;
+  }
+  static Value Dict() { Value x; x.kind = DICT; return x; }
+
+  bool is_none() const { return kind == NONE; }
+  bool truthy() const {
+    switch (kind) {
+      case NONE: return false;
+      case BOOL: return b;
+      case INT: return i != 0;
+      case FLOAT: return f != 0.0;
+      case STR: case BYTES: return !s.empty();
+      case LIST: case TUPLE: return !items.empty();
+      case DICT: return !pairs.empty();
+    }
+    return false;
+  }
+  int64_t as_int() const {
+    if (kind == INT) return i;
+    if (kind == BOOL) return b ? 1 : 0;
+    if (kind == FLOAT) return int64_t(f);
+    throw CodecError("not an int");
+  }
+  double as_float() const {
+    if (kind == FLOAT) return f;
+    if (kind == INT) return double(i);
+    throw CodecError("not a float");
+  }
+  const std::string& as_str() const {
+    if (kind != STR && kind != BYTES) throw CodecError("not a str/bytes");
+    return s;
+  }
+  const Value* get(const std::string& key) const {
+    if (kind != DICT) return nullptr;
+    for (const auto& kv : pairs)
+      if (kv.first.kind == STR && kv.first.s == key) return &kv.second;
+    return nullptr;
+  }
+  void set(const std::string& key, Value v) {
+    if (kind != DICT) throw CodecError("set() on non-dict");
+    for (auto& kv : pairs)
+      if (kv.first.kind == STR && kv.first.s == key) {
+        kv.second = std::move(v);
+        return;
+      }
+    pairs.emplace_back(Str(key), std::move(v));
+  }
+};
+
+// -------------------------------------------------------- pickle encode
+inline void pickle_encode_into(const Value& v, std::string& out) {
+  auto put_u32le = [&out](uint32_t n) {
+    char b[4] = {char(n), char(n >> 8), char(n >> 16), char(n >> 24)};
+    out.append(b, 4);
+  };
+  switch (v.kind) {
+    case Value::NONE:
+      out.push_back('N');
+      break;
+    case Value::BOOL:
+      out.push_back(v.b ? '\x88' : '\x89');
+      break;
+    case Value::INT:
+      if (v.i >= INT32_MIN && v.i <= INT32_MAX) {
+        out.push_back('J');
+        put_u32le(uint32_t(int32_t(v.i)));
+      } else {  // LONG1: n little-endian two's-complement bytes
+        out.push_back('\x8a');
+        uint64_t u = uint64_t(v.i);
+        char tmp[9];
+        int n = 0;
+        for (; n < 8; n++) tmp[n] = char(u >> (8 * n));
+        // trim redundant sign bytes, keep at least 1
+        int len = 8;
+        while (len > 1) {
+          uint8_t top = uint8_t(tmp[len - 1]);
+          uint8_t next = uint8_t(tmp[len - 2]);
+          if ((top == 0x00 && !(next & 0x80)) ||
+              (top == 0xff && (next & 0x80)))
+            len--;
+          else
+            break;
+        }
+        out.push_back(char(len));
+        out.append(tmp, len);
+      }
+      break;
+    case Value::FLOAT: {
+      out.push_back('G');
+      uint64_t bits;
+      std::memcpy(&bits, &v.f, 8);
+      for (int k = 7; k >= 0; k--) out.push_back(char(bits >> (8 * k)));
+      break;
+    }
+    case Value::STR:
+      out.push_back('X');
+      put_u32le(uint32_t(v.s.size()));
+      out.append(v.s);
+      break;
+    case Value::BYTES:
+      out.push_back('B');
+      put_u32le(uint32_t(v.s.size()));
+      out.append(v.s);
+      break;
+    case Value::LIST:
+      out.push_back(']');
+      if (!v.items.empty()) {
+        out.push_back('(');
+        for (const auto& it : v.items) pickle_encode_into(it, out);
+        out.push_back('e');
+      }
+      break;
+    case Value::TUPLE:
+      out.push_back('(');
+      for (const auto& it : v.items) pickle_encode_into(it, out);
+      out.push_back('t');
+      break;
+    case Value::DICT:
+      out.push_back('}');
+      if (!v.pairs.empty()) {
+        out.push_back('(');
+        for (const auto& kv : v.pairs) {
+          pickle_encode_into(kv.first, out);
+          pickle_encode_into(kv.second, out);
+        }
+        out.push_back('u');
+      }
+      break;
+  }
+}
+
+inline std::string pickle_dumps(const Value& v) {
+  std::string out;
+  out.push_back('\x80');
+  out.push_back('\x03');
+  pickle_encode_into(v, out);
+  out.push_back('.');
+  return out;
+}
+
+// -------------------------------------------------------- pickle decode
+class PickleReader {
+ public:
+  PickleReader(const uint8_t* data, size_t len) : p_(data), end_(data + len) {}
+
+  Value load() {
+    // Stack entries: MARK sentinel is a Value with kind LIST and marker_
+    // recorded separately via index stack.
+    std::vector<Value> stack;
+    std::vector<size_t> marks;
+    std::vector<Value> memo;
+    while (p_ < end_) {
+      uint8_t op = *p_++;
+      switch (op) {
+        case 0x80:  // PROTO
+          need(1);
+          p_++;
+          break;
+        case 0x95:  // FRAME
+          need(8);
+          p_ += 8;
+          break;
+        case 0x94:  // MEMOIZE
+          if (stack.empty()) throw CodecError("MEMOIZE on empty stack");
+          memo.push_back(stack.back());
+          break;
+        case 'q':  // BINPUT
+          need(1);
+          setmemo(memo, *p_++, stack);
+          break;
+        case 'r':  // LONG_BINPUT
+          setmemo(memo, u32le(), stack);
+          break;
+        case 'h': {  // BINGET
+          need(1);
+          size_t idx = *p_++;
+          if (idx >= memo.size()) throw CodecError("BINGET out of range");
+          stack.push_back(memo[idx]);
+          break;
+        }
+        case 'j': {  // LONG_BINGET
+          size_t idx = u32le();
+          if (idx >= memo.size()) throw CodecError("LONG_BINGET range");
+          stack.push_back(memo[idx]);
+          break;
+        }
+        case 'N':
+          stack.push_back(Value::None());
+          break;
+        case 0x88:
+          stack.push_back(Value::Bool(true));
+          break;
+        case 0x89:
+          stack.push_back(Value::Bool(false));
+          break;
+        case 'J':
+          stack.push_back(Value::Int(int32_t(u32le())));
+          break;
+        case 'K':
+          need(1);
+          stack.push_back(Value::Int(*p_++));
+          break;
+        case 'M': {
+          need(2);
+          uint16_t n = uint16_t(p_[0]) | (uint16_t(p_[1]) << 8);
+          p_ += 2;
+          stack.push_back(Value::Int(n));
+          break;
+        }
+        case 0x8a: {  // LONG1
+          need(1);
+          size_t n = *p_++;
+          need(n);
+          if (n > 8) throw CodecError("LONG1 too wide for int64");
+          uint64_t u = 0;
+          for (size_t k = 0; k < n; k++) u |= uint64_t(p_[k]) << (8 * k);
+          if (n && n < 8 && (p_[n - 1] & 0x80))  // sign-extend
+            u |= ~uint64_t(0) << (8 * n);
+          p_ += n;
+          stack.push_back(Value::Int(int64_t(u)));
+          break;
+        }
+        case 'G': {  // BINFLOAT, big-endian
+          need(8);
+          uint64_t bits = 0;
+          for (int k = 0; k < 8; k++) bits = (bits << 8) | p_[k];
+          p_ += 8;
+          double d;
+          std::memcpy(&d, &bits, 8);
+          stack.push_back(Value::Float(d));
+          break;
+        }
+        case 0x8c: {  // SHORT_BINUNICODE
+          need(1);
+          size_t n = *p_++;
+          stack.push_back(Value::Str(take(n)));
+          break;
+        }
+        case 'X':  // BINUNICODE
+          stack.push_back(Value::Str(take(u32le())));
+          break;
+        case 0x8d:  // BINUNICODE8
+          stack.push_back(Value::Str(take(size_t(u64le()))));
+          break;
+        case 'C': {  // SHORT_BINBYTES
+          need(1);
+          size_t n = *p_++;
+          stack.push_back(Value::Bytes(take(n)));
+          break;
+        }
+        case 'B':  // BINBYTES
+          stack.push_back(Value::Bytes(take(u32le())));
+          break;
+        case 0x8e:  // BINBYTES8
+          stack.push_back(Value::Bytes(take(size_t(u64le()))));
+          break;
+        case 0x96:  // BYTEARRAY8 — surfaces as BYTES
+          stack.push_back(Value::Bytes(take(size_t(u64le()))));
+          break;
+        case ']':
+          stack.push_back(Value::List());
+          break;
+        case '}':
+          stack.push_back(Value::Dict());
+          break;
+        case ')':
+          stack.push_back(Value::Tuple());
+          break;
+        case '(':
+          marks.push_back(stack.size());
+          break;
+        case 'a': {  // APPEND
+          if (stack.size() < 2) throw CodecError("APPEND underflow");
+          Value item = std::move(stack.back());
+          stack.pop_back();
+          listref(stack).items.push_back(std::move(item));
+          break;
+        }
+        case 'e': {  // APPENDS
+          size_t m = popmark(marks, stack);
+          Value& lst = stack[m - 1];
+          if (lst.kind != Value::LIST) throw CodecError("APPENDS non-list");
+          for (size_t k = m; k < stack.size(); k++)
+            lst.items.push_back(std::move(stack[k]));
+          stack.resize(m);
+          break;
+        }
+        case 's': {  // SETITEM
+          if (stack.size() < 3) throw CodecError("SETITEM underflow");
+          Value val = std::move(stack.back());
+          stack.pop_back();
+          Value key = std::move(stack.back());
+          stack.pop_back();
+          dictref(stack).pairs.emplace_back(std::move(key), std::move(val));
+          break;
+        }
+        case 'u': {  // SETITEMS
+          size_t m = popmark(marks, stack);
+          Value& d = stack[m - 1];
+          if (d.kind != Value::DICT) throw CodecError("SETITEMS non-dict");
+          if ((stack.size() - m) % 2) throw CodecError("odd SETITEMS");
+          for (size_t k = m; k < stack.size(); k += 2)
+            d.pairs.emplace_back(std::move(stack[k]), std::move(stack[k + 1]));
+          stack.resize(m);
+          break;
+        }
+        case 't': {  // TUPLE
+          size_t m = popmark(marks, stack);
+          Value tup = Value::Tuple();
+          for (size_t k = m; k < stack.size(); k++)
+            tup.items.push_back(std::move(stack[k]));
+          stack.resize(m);
+          stack.push_back(std::move(tup));
+          break;
+        }
+        case 0x85:  // TUPLE1
+          taken_tuple(stack, 1);
+          break;
+        case 0x86:  // TUPLE2
+          taken_tuple(stack, 2);
+          break;
+        case 0x87:  // TUPLE3
+          taken_tuple(stack, 3);
+          break;
+        // ---- tolerated object opcodes --------------------------------
+        // Error responses carry pickled exception INSTANCES ({"e": exc}).
+        // These flatten class/instance machinery to representational
+        // strings so the surrounding dict (and its "tb" string) survives.
+        case 'c': {  // GLOBAL: module\nname\n
+          std::string mod = line(), name = line();
+          stack.push_back(Value::Str("<" + mod + "." + name + ">"));
+          break;
+        }
+        case 0x93: {  // STACK_GLOBAL
+          if (stack.size() < 2) throw CodecError("STACK_GLOBAL underflow");
+          Value name = std::move(stack.back());
+          stack.pop_back();
+          Value mod = std::move(stack.back());
+          stack.pop_back();
+          stack.push_back(Value::Str(
+              "<" + (mod.kind == Value::STR ? mod.s : "?") + "." +
+              (name.kind == Value::STR ? name.s : "?") + ">"));
+          break;
+        }
+        case 'R':      // REDUCE: callable(args) -> opaque marker
+        case 0x81: {   // NEWOBJ: cls.__new__(args)
+          if (stack.size() < 2) throw CodecError("REDUCE/NEWOBJ underflow");
+          Value args = std::move(stack.back());
+          stack.pop_back();
+          Value callee = std::move(stack.back());
+          stack.pop_back();
+          std::string desc = callee.kind == Value::STR ? callee.s : "<obj>";
+          for (const auto& it : args.items)
+            if (it.kind == Value::STR)
+              desc += " " + it.s.substr(0, 200);
+          stack.push_back(Value::Str(desc));
+          break;
+        }
+        case 0x92: {  // NEWOBJ_EX: cls, args, kwargs
+          if (stack.size() < 3) throw CodecError("NEWOBJ_EX underflow");
+          stack.pop_back();
+          stack.pop_back();  // kwargs, args dropped
+          // leave cls marker as the object
+          break;
+        }
+        case 'b': {  // BUILD: apply state to obj — drop the state
+          if (stack.size() < 2) throw CodecError("BUILD underflow");
+          stack.pop_back();
+          break;
+        }
+        case 0x8f:  // EMPTY_SET — surfaces as list
+          stack.push_back(Value::List());
+          break;
+        case 0x90: {  // ADDITEMS (into set-as-list)
+          size_t m = popmark(marks, stack);
+          Value& lst = stack[m - 1];
+          if (lst.kind != Value::LIST) throw CodecError("ADDITEMS non-list");
+          for (size_t k = m; k < stack.size(); k++)
+            lst.items.push_back(std::move(stack[k]));
+          stack.resize(m);
+          break;
+        }
+        case 0x91: {  // FROZENSET — surfaces as tuple
+          size_t m = popmark(marks, stack);
+          Value tup = Value::Tuple();
+          for (size_t k = m; k < stack.size(); k++)
+            tup.items.push_back(std::move(stack[k]));
+          stack.resize(m);
+          stack.push_back(std::move(tup));
+          break;
+        }
+        case '.':  // STOP
+          if (stack.size() != 1) throw CodecError("STOP with deep stack");
+          return std::move(stack.back());
+        default:
+          throw CodecError("unsupported pickle opcode 0x" + hex(op) +
+                           " (value outside the cross-language type set?)");
+      }
+    }
+    throw CodecError("pickle stream ended without STOP");
+  }
+
+ private:
+  const uint8_t* p_;
+  const uint8_t* end_;
+
+  static std::string hex(uint8_t b) {
+    const char* d = "0123456789abcdef";
+    return std::string() + d[b >> 4] + d[b & 15];
+  }
+  void need(size_t n) const {
+    if (size_t(end_ - p_) < n) throw CodecError("truncated pickle");
+  }
+  uint32_t u32le() {
+    need(4);
+    uint32_t n = uint32_t(p_[0]) | (uint32_t(p_[1]) << 8) |
+                 (uint32_t(p_[2]) << 16) | (uint32_t(p_[3]) << 24);
+    p_ += 4;
+    return n;
+  }
+  uint64_t u64le() {
+    need(8);
+    uint64_t n = 0;
+    for (int k = 7; k >= 0; k--) n = (n << 8) | p_[k];
+    p_ += 8;
+    return n;
+  }
+  std::string take(size_t n) {
+    need(n);
+    std::string s(reinterpret_cast<const char*>(p_), n);
+    p_ += n;
+    return s;
+  }
+  std::string line() {  // newline-terminated field (GLOBAL operands)
+    std::string s;
+    while (p_ < end_ && *p_ != '\n') s.push_back(char(*p_++));
+    if (p_ >= end_) throw CodecError("unterminated GLOBAL");
+    p_++;
+    return s;
+  }
+  static void setmemo(std::vector<Value>& memo, size_t idx,
+                      std::vector<Value>& stack) {
+    if (stack.empty()) throw CodecError("PUT on empty stack");
+    if (memo.size() <= idx) memo.resize(idx + 1);
+    memo[idx] = stack.back();
+  }
+  static size_t popmark(std::vector<size_t>& marks,
+                        std::vector<Value>& stack) {
+    if (marks.empty()) throw CodecError("no MARK");
+    size_t m = marks.back();
+    marks.pop_back();
+    if (m > stack.size()) throw CodecError("MARK beyond stack");
+    return m;
+  }
+  static Value& listref(std::vector<Value>& stack) {
+    if (stack.empty() || stack.back().kind != Value::LIST)
+      throw CodecError("expected list on stack");
+    return stack.back();
+  }
+  static Value& dictref(std::vector<Value>& stack) {
+    if (stack.empty() || stack.back().kind != Value::DICT)
+      throw CodecError("expected dict on stack");
+    return stack.back();
+  }
+  static void taken_tuple(std::vector<Value>& stack, size_t n) {
+    if (stack.size() < n) throw CodecError("TUPLEn underflow");
+    Value tup = Value::Tuple();
+    for (size_t k = stack.size() - n; k < stack.size(); k++)
+      tup.items.push_back(std::move(stack[k]));
+    stack.resize(stack.size() - n);
+    stack.push_back(std::move(tup));
+  }
+};
+
+inline Value pickle_loads(const std::string& blob) {
+  PickleReader r(reinterpret_cast<const uint8_t*>(blob.data()), blob.size());
+  return r.load();
+}
+
+// ----------------------------------------------- object meta (msgpack)
+// Stored-object metadata is flag byte ('V' value / 'E' error) + msgpack
+// {"sizes": [payload_len, buf0_len, ...]} (core/serialization.py). The
+// C++ side writes single-part payloads and reads sizes back out.
+inline std::string meta_encode(char flag, uint64_t payload_len) {
+  std::string m;
+  m.push_back(flag);
+  m.push_back('\x81');                       // fixmap(1)
+  m.push_back('\xa5');                       // fixstr(5)
+  m.append("sizes");
+  m.push_back('\x91');                       // fixarray(1)
+  m.push_back('\xcf');                       // uint64
+  for (int k = 7; k >= 0; k--) m.push_back(char(payload_len >> (8 * k)));
+  return m;
+}
+
+// Returns sizes; flag comes back via *flag. Tolerant of any msgpack int
+// widths the Python packer chooses.
+inline std::vector<uint64_t> meta_decode(const std::string& meta,
+                                         char* flag) {
+  if (meta.empty()) throw CodecError("empty object meta");
+  *flag = meta[0];
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(meta.data()) + 1;
+  const uint8_t* end = reinterpret_cast<const uint8_t*>(meta.data()) +
+                       meta.size();
+  auto need = [&](size_t n) {
+    if (size_t(end - p) < n) throw CodecError("truncated meta");
+  };
+  auto read_uint = [&]() -> uint64_t {
+    need(1);
+    uint8_t t = *p++;
+    if (t <= 0x7f) return t;
+    uint64_t v = 0;
+    int n = 0;
+    if (t == 0xcc) n = 1;
+    else if (t == 0xcd) n = 2;
+    else if (t == 0xce) n = 4;
+    else if (t == 0xcf) n = 8;
+    else throw CodecError("unexpected msgpack int tag");
+    need(n);
+    for (int k = 0; k < n; k++) v = (v << 8) | *p++;
+    return v;
+  };
+  need(1);
+  uint8_t t = *p++;
+  uint32_t map_n = 0;
+  if ((t & 0xf0) == 0x80) map_n = t & 0x0f;
+  else if (t == 0xde) { need(2); map_n = (uint32_t(p[0]) << 8) | p[1]; p += 2; }
+  else throw CodecError("meta is not a msgpack map");
+  std::vector<uint64_t> sizes;
+  for (uint32_t m = 0; m < map_n; m++) {
+    need(1);
+    uint8_t kt = *p++;
+    uint32_t klen = 0;
+    if ((kt & 0xe0) == 0xa0) klen = kt & 0x1f;
+    else if (kt == 0xd9) { need(1); klen = *p++; }
+    else throw CodecError("non-str meta key");
+    need(klen);
+    std::string key(reinterpret_cast<const char*>(p), klen);
+    p += klen;
+    need(1);
+    uint8_t at = *p++;
+    uint32_t arr_n = 0;
+    if ((at & 0xf0) == 0x90) arr_n = at & 0x0f;
+    else if (at == 0xdc) { need(2); arr_n = (uint32_t(p[0]) << 8) | p[1]; p += 2; }
+    else throw CodecError("meta value is not an array");
+    for (uint32_t k = 0; k < arr_n; k++) {
+      uint64_t v = read_uint();
+      if (key == "sizes") sizes.push_back(v);
+    }
+  }
+  return sizes;
+}
+
+}  // namespace raytpu

@@ -1,0 +1,86 @@
+// ray_tpu C++ worker / driver API.
+//
+// Reference parity: the reference ships a native C++ worker API
+// (``cpp/include/ray/api.h`` — ``ray::Init``, ``ray::Task(f).Remote()``,
+// ``ray::Get``) running on its CoreWorker. This build's equivalent rides
+// the repo's own planes: the pickle RPC (cluster/rpc.py) for control and
+// the C++ shared-memory store (src/shm_store.cc) for data — a C++ task
+// result is written straight into the node's shm segment, zero extra
+// copies, and any Python peer reads it zero-copy.
+//
+// Two roles, one library:
+//  * WORKER: an executable that registers functions and calls
+//    raytpu::WorkerMain(argc, argv). The node agent spawns it like a
+//    Python worker when a task's lang is "cpp" (worker-lease parity);
+//    Python drivers invoke its functions by name via
+//    ray_tpu.cross_language.cpp_function("name").remote(...).
+//  * DRIVER: any C++ program: Driver d; d.Connect(head_addr);
+//    auto ref = d.Submit("add", {Value::Int(1), Value::Int(2)});
+//    Value out = d.Get(ref, 30.0);
+//
+// Cross-language values are the restricted set {None, bool, int, float,
+// str, bytes, list, tuple, dict} (pyvalue.h) — the same restriction the
+// reference places on cross-language calls (python/ray/cross_language.py).
+#pragma once
+
+#include <functional>
+#include <optional>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "pyvalue.h"
+
+namespace raytpu {
+
+using TaskFn = std::function<Value(const std::vector<Value>&)>;
+
+// Register a function under a cross-language name. Call before
+// WorkerMain(); typically from static initializers via RAYTPU_FUNC.
+void RegisterFunction(const std::string& name, TaskFn fn);
+
+#define RAYTPU_FUNC(name, fn)                                         \
+  static const bool _raytpu_reg_##fn = [] {                           \
+    ::raytpu::RegisterFunction(name, fn);                             \
+    return true;                                                      \
+  }()
+
+// Worker entrypoint: connects to the node agent + head given the standard
+// worker flags (--head --agent --node-id --store --worker-id), serves
+// push_task, executes registered functions, writes results into the shm
+// store. Blocks until the agent connection drops. Returns exit code.
+int WorkerMain(int argc, char** argv);
+
+// ------------------------------------------------------------- driver
+class DriverImpl;
+
+struct ObjectRef {
+  std::string id;
+};
+
+class Driver {
+ public:
+  Driver();
+  ~Driver();
+
+  // Connect to a running cluster. Discovers a host node (agent address +
+  // store path) from the head's node table; the driver must be co-located
+  // with that node to attach its shm segment (same-machine requirement,
+  // like a raylet-attached reference driver).
+  void Connect(const std::string& head_address);
+
+  ObjectRef Put(const Value& v);
+  // Blocks until the object is ready or timeout (seconds). Throws
+  // RpcError on task failure / timeout.
+  Value Get(const ObjectRef& ref, double timeout_s = 60.0);
+  // Submit a cross-language task executed by a C++ worker running
+  // `worker_bin` (empty = cluster-configured default binary).
+  ObjectRef Submit(const std::string& fname, std::vector<Value> args,
+                   const std::string& worker_bin = "", double num_cpus = 1.0);
+  void Shutdown();
+
+ private:
+  DriverImpl* impl_;
+};
+
+}  // namespace raytpu

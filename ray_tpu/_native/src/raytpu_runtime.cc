@@ -1,0 +1,523 @@
+// Implementation of the C++ worker / driver API (raytpu.h).
+//
+// Worker-lease parity with the Python worker (cluster/workerproc.py): the
+// node agent spawns this binary with the same flags, the worker registers
+// back with its RPC address, serves push_task/ping/cancel_task, executes
+// registered functions from a FIFO queue on one executor thread, writes
+// results directly into the node's C++ shm store (src/shm_store.cc), and
+// reports add_location to the head + task_done / worker_events to the
+// agent — indistinguishable from a Python worker to the rest of the
+// cluster.
+#include "raytpu.h"
+
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <random>
+#include <thread>
+
+#include "hashes.h"
+#include "rpc_channel.h"
+
+// ---- shm store C API (defined in shm_store.cc, linked in) --------------
+extern "C" {
+void* ts_attach(const char* path);
+void ts_detach(void* hp);
+int64_t ts_alloc(void* hp, const uint8_t* id, uint64_t data_size,
+                 uint64_t meta_size);
+int ts_seal(void* hp, const uint8_t* id);
+int ts_get(void* hp, const uint8_t* id, uint64_t* offset, uint64_t* data_size,
+           uint64_t* meta_size);
+int ts_release(void* hp, const uint8_t* id);
+int ts_contains(void* hp, const uint8_t* id);
+int ts_pin(void* hp, const uint8_t* id, int pinned);
+uint8_t* ts_base_ptr(void* hp);
+}
+
+namespace raytpu {
+
+namespace {
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string env_token() {
+  const char* t = std::getenv("RAY_TPU_CLUSTER_TOKEN");
+  return t ? std::string(t) : std::string();
+}
+
+std::string random_hex(size_t nbytes) {
+  static thread_local std::mt19937_64 rng{std::random_device{}()};
+  static const char* digits = "0123456789abcdef";
+  std::string out;
+  out.reserve(nbytes * 2);
+  for (size_t i = 0; i < nbytes; i++) {
+    uint8_t b = uint8_t(rng());
+    out.push_back(digits[b >> 4]);
+    out.push_back(digits[b & 15]);
+  }
+  return out;
+}
+
+// Store key convention: SHA1 of the object-id string
+// (ray_tpu/_native/shm_store.py:store_key).
+void store_key(const std::string& oid, uint8_t out[20]) {
+  sha1(oid.data(), oid.size(), out);
+}
+
+// Node-local store handle over the ts_* API.
+class Store {
+ public:
+  void attach(const std::string& path) {
+    h_ = ts_attach(path.c_str());
+    if (!h_) throw RpcError("cannot attach shm store at " + path);
+  }
+  ~Store() {
+    if (h_) ts_detach(h_);
+  }
+  bool attached() const { return h_ != nullptr; }
+
+  void put(const std::string& oid, const std::string& data,
+           const std::string& meta) {
+    uint8_t key[20];
+    store_key(oid, key);
+    int64_t off = ts_alloc(h_, key, data.size(), meta.size());
+    if (off == -2) return;  // already present (idempotent re-put)
+    if (off < 0)
+      throw RpcError("store full putting " + oid.substr(0, 16) + "… (code " +
+                     std::to_string(off) + ")");
+    uint8_t* base = ts_base_ptr(h_);
+    std::memcpy(base + off, data.data(), data.size());
+    std::memcpy(base + off + data.size(), meta.data(), meta.size());
+    if (ts_seal(h_, key) != 0) throw RpcError("seal failed for " + oid);
+  }
+
+  // (data, meta) copies, or nullopt. Copies are fine for the C++ paths —
+  // zero-copy reads are the Python side's numpy-view specialty.
+  std::optional<std::pair<std::string, std::string>> get(
+      const std::string& oid) {
+    uint8_t key[20];
+    store_key(oid, key);
+    uint64_t off = 0, dsz = 0, msz = 0;
+    if (ts_get(h_, key, &off, &dsz, &msz) != 0) return std::nullopt;
+    uint8_t* base = ts_base_ptr(h_);
+    std::string data(reinterpret_cast<char*>(base + off), dsz);
+    std::string meta(reinterpret_cast<char*>(base + off + dsz), msz);
+    ts_release(h_, key);
+    return std::make_pair(std::move(data), std::move(meta));
+  }
+
+  void pin(const std::string& oid) {
+    uint8_t key[20];
+    store_key(oid, key);
+    ts_pin(h_, key, 1);
+  }
+
+ private:
+  void* h_ = nullptr;
+};
+
+std::map<std::string, TaskFn>& registry() {
+  static std::map<std::string, TaskFn> r;
+  return r;
+}
+
+}  // namespace
+
+void RegisterFunction(const std::string& name, TaskFn fn) {
+  registry()[name] = std::move(fn);
+}
+
+// ----------------------------------------------------------------- worker
+
+namespace {
+
+struct WorkerCtx {
+  std::string head_addr, agent_addr, node_id, store_path, worker_id;
+  Store store;
+  std::unique_ptr<RpcChannel> head, agent;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<Value> queue;  // push_task specs
+  std::atomic<bool> stopped{false};
+  std::vector<Value> events;  // task records pending worker_events flush
+
+  // Serialize a Value result into the store + announce the location.
+  void store_result(const std::string& oid, const Value& v) {
+    std::string payload = pickle_dumps(v);
+    std::string meta = meta_encode('V', payload.size());
+    store.put(oid, payload, meta);
+    store.pin(oid);  // primary copy (put_with_id parity)
+    Value kw = Value::Dict();
+    kw.set("is_error", Value::Bool(false));
+    kw.set("size", Value::Int(int64_t(payload.size())));
+    head->call("add_location", {Value::Str(oid), Value::Str(node_id)},
+               std::move(kw));
+  }
+
+  // Store a TaskError instance Python can re-raise at get()
+  // (core/object_ref.py TaskError.__reduce__ shape).
+  void store_error(const std::string& oid, const std::string& fname,
+                   const std::string& message) {
+    std::string payload;
+    payload.push_back('\x80');
+    payload.push_back('\x03');
+    payload.push_back('c');
+    payload += "ray_tpu.core.object_ref\nTaskError\n";
+    Value args = Value::Tuple({Value::Str(fname), Value::Str(message),
+                               Value::Str("cpp-task-error")});
+    pickle_encode_into(args, payload);
+    payload.push_back('R');
+    payload.push_back('.');
+    std::string meta = meta_encode('E', payload.size());
+    store.put(oid, payload, meta);
+    store.pin(oid);
+    Value kw = Value::Dict();
+    kw.set("is_error", Value::Bool(true));
+    kw.set("size", Value::Int(int64_t(payload.size())));
+    head->call("add_location", {Value::Str(oid), Value::Str(node_id)},
+               std::move(kw));
+  }
+
+  void record_event(const std::string& task_id, const std::string& name,
+                    double start, double end, const std::string& error) {
+    Value rec = Value::Dict();
+    rec.set("task_id", Value::Str(task_id));
+    rec.set("name", Value::Str(name));
+    rec.set("type", Value::Str("NORMAL_TASK"));
+    rec.set("state", error.empty() ? Value::Str("FINISHED")
+                                   : Value::Str("FAILED"));
+    rec.set("submitted_at", Value::None());
+    rec.set("start_time", Value::Float(start));
+    rec.set("end_time", Value::Float(end));
+    rec.set("error", error.empty() ? Value::None() : Value::Str(error));
+    rec.set("lang", Value::Str("cpp"));
+    std::lock_guard<std::mutex> g(mu);
+    events.push_back(std::move(rec));
+  }
+
+  void flush_events() {
+    std::vector<Value> batch;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      batch.swap(events);
+    }
+    if (batch.empty()) return;
+    try {
+      agent->call("worker_events",
+                  {Value::Str(worker_id), Value::Int(int64_t(getpid())),
+                   Value::List(std::move(batch)), Value::List()});
+    } catch (const std::exception&) {
+      // observability is best-effort, like the Python worker's reporter
+    }
+  }
+
+  void run_one(const Value& spec) {
+    const Value* tid = spec.get("task_id");
+    const Value* fname = spec.get("fname");
+    const Value* oids = spec.get("oids");
+    std::string name = fname && fname->kind == Value::STR ? fname->s : "task";
+    std::string task_id =
+        tid && tid->kind == Value::STR ? tid->s : random_hex(16);
+    double start = now_s();
+    std::string error;
+    try {
+      if (!oids || oids->items.empty())
+        throw CodecError("cpp task spec has no oids");
+      const Value* blob = spec.get("cpp_args");
+      std::vector<Value> args;
+      if (blob && blob->kind == Value::BYTES) {
+        Value decoded = pickle_loads(blob->s);
+        args = std::move(decoded.items);
+      }
+      auto it = registry().find(name);
+      if (it == registry().end())
+        throw CodecError("no C++ function registered under '" + name +
+                         "' in this worker binary");
+      Value result = it->second(args);
+      if (spec.get("num_returns") && spec.get("num_returns")->as_int() > 1) {
+        // multi-return: the function returns a tuple/list, one oid each
+        const auto& outs = result.items;
+        if (int64_t(outs.size()) != spec.get("num_returns")->as_int())
+          throw CodecError("num_returns mismatch");
+        for (size_t k = 0; k < outs.size(); k++)
+          store_result(oids->items[k].as_str(), outs[k]);
+      } else {
+        store_result(oids->items[0].as_str(), result);
+      }
+    } catch (const std::exception& e) {
+      error = e.what();
+      if (oids)
+        for (const auto& o : oids->items) {
+          try {
+            store_error(o.as_str(), name, error);
+          } catch (const std::exception&) {
+          }
+        }
+    }
+    record_event(task_id, name, start, now_s(), error);
+    try {
+      agent->call("task_done", {Value::Str(worker_id)});
+    } catch (const std::exception&) {
+    }
+  }
+
+  void exec_loop() {
+    while (!stopped) {
+      Value spec;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [this] { return stopped || !queue.empty(); });
+        if (stopped) return;
+        spec = std::move(queue.front());
+        queue.pop_front();
+      }
+      run_one(spec);
+      flush_events();
+    }
+  }
+};
+
+}  // namespace
+
+int WorkerMain(int argc, char** argv) {
+  WorkerCtx ctx;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    std::string flag = argv[i], val = argv[i + 1];
+    if (flag == "--head") ctx.head_addr = val;
+    else if (flag == "--agent") ctx.agent_addr = val;
+    else if (flag == "--node-id") ctx.node_id = val;
+    else if (flag == "--store") ctx.store_path = val;
+    else if (flag == "--worker-id") ctx.worker_id = val;
+  }
+  if (ctx.head_addr.empty() || ctx.agent_addr.empty() ||
+      ctx.store_path.empty()) {
+    fprintf(stderr,
+            "usage: worker --head H:P --agent H:P --node-id N --store PATH "
+            "--worker-id W\n");
+    return 2;
+  }
+  std::string token = env_token();
+  try {
+    ctx.store.attach(ctx.store_path);
+    ctx.head = std::make_unique<RpcChannel>(ctx.head_addr, token);
+    ctx.agent = std::make_unique<RpcChannel>(ctx.agent_addr, token);
+
+    RpcServer server(
+        [&ctx](const std::string& m, const Value& args, const Value&) -> Value {
+          if (m == "ping") return Value::Str("pong");
+          if (m == "push_task") {
+            if (args.items.empty()) throw CodecError("push_task needs a spec");
+            {
+              std::lock_guard<std::mutex> g(ctx.mu);
+              ctx.queue.push_back(args.items[0]);
+            }
+            ctx.cv.notify_one();
+            return Value::Bool(true);
+          }
+          if (m == "cancel_task") return Value::Bool(false);  // not supported
+          if (m == "create_actor")
+            throw CodecError("C++ workers do not host actors");
+          if (m == "exit") {
+            ctx.stopped = true;
+            ctx.cv.notify_all();
+            return Value::Bool(true);
+          }
+          throw CodecError("unknown worker rpc: " + m);
+        },
+        token);
+
+    std::thread exec([&ctx] { ctx.exec_loop(); });
+    ctx.agent->call("register_worker",
+                    {Value::Str(ctx.worker_id), Value::Str(server.address()),
+                     Value::None()});
+
+    // Heartbeat the agent link; exit when the agent goes away (agent
+    // death must reap its workers, matching Python worker lifetime).
+    while (!ctx.stopped) {
+      std::this_thread::sleep_for(std::chrono::seconds(2));
+      try {
+        ctx.agent->call("ping", {});
+      } catch (const std::exception&) {
+        break;
+      }
+    }
+    ctx.stopped = true;
+    ctx.cv.notify_all();
+    exec.join();
+    server.stop();
+    return 0;
+  } catch (const std::exception& e) {
+    fprintf(stderr, "raytpu worker fatal: %s\n", e.what());
+    return 1;
+  }
+}
+
+// ----------------------------------------------------------------- driver
+
+class DriverImpl {
+ public:
+  std::string head_addr, token;
+  std::unique_ptr<RpcChannel> head;
+  std::unique_ptr<RpcChannel> agent;  // the co-located node's agent
+  std::string node_id, agent_addr, store_path;
+  Store store;
+  std::map<std::string, std::unique_ptr<RpcChannel>> peers;
+
+  void connect(const std::string& addr) {
+    head_addr = addr;
+    token = env_token();
+    head = std::make_unique<RpcChannel>(addr, token);
+    Value nodes = head->call("nodes", {});
+    for (const auto& n : nodes.items) {
+      const Value* alive = n.get("Alive");
+      if (alive && !alive->truthy()) continue;
+      node_id = n.get("NodeID") ? n.get("NodeID")->as_str() : "";
+      agent_addr = n.get("Address") ? n.get("Address")->as_str() : "";
+      store_path = n.get("StorePath") ? n.get("StorePath")->as_str() : "";
+      break;
+    }
+    if (agent_addr.empty())
+      throw RpcError("cluster has no alive nodes to attach to");
+    agent = std::make_unique<RpcChannel>(agent_addr, token);
+    store.attach(store_path);
+  }
+
+  RpcChannel* peer(const std::string& addr) {
+    if (addr == agent_addr) return agent.get();
+    auto it = peers.find(addr);
+    if (it != peers.end()) return it->second.get();
+    auto ch = std::make_unique<RpcChannel>(addr, token);
+    RpcChannel* raw = ch.get();
+    peers[addr] = std::move(ch);
+    return raw;
+  }
+
+  std::string put(const Value& v) {
+    std::string oid = random_hex(16) + "00000000";  // task_id + index 0
+    std::string payload = pickle_dumps(v);
+    store.put(oid, payload, meta_encode('V', payload.size()));
+    store.pin(oid);
+    Value kw = Value::Dict();
+    kw.set("is_error", Value::Bool(false));
+    kw.set("size", Value::Int(int64_t(payload.size())));
+    head->call("add_location", {Value::Str(oid), Value::Str(node_id)},
+               std::move(kw));
+    return oid;
+  }
+
+  Value get(const std::string& oid, double timeout_s) {
+    double deadline = now_s() + timeout_s;
+    while (true) {
+      // local store first (results land here when the task ran locally)
+      auto local = store.get(oid);
+      std::string data, meta;
+      if (local) {
+        data = std::move(local->first);
+        meta = std::move(local->second);
+      } else {
+        Value loc = head->call("locations", {Value::Str(oid)});
+        const Value* ns = loc.is_none() ? nullptr : loc.get("nodes");
+        if (ns && !ns->items.empty()) {
+          // (node_id, agent_address, store_path) triples
+          const Value& first = ns->items[0];
+          std::string addr = first.items.at(1).as_str();
+          Value got = peer(addr)->call("fetch_object", {Value::Str(oid)});
+          if (!got.is_none()) {
+            meta = got.items.at(0).as_str();
+            data = got.items.at(1).as_str();
+          }
+        }
+      }
+      if (!meta.empty()) {
+        char flag = 0;
+        std::vector<uint64_t> sizes = meta_decode(meta, &flag);
+        uint64_t payload_len = sizes.empty() ? data.size() : sizes[0];
+        std::string payload = data.substr(0, payload_len);
+        if (flag == 'E') {
+          std::string desc;
+          try {
+            Value err = pickle_loads(payload);
+            desc = err.kind == Value::STR ? err.s : "task failed";
+          } catch (const CodecError&) {
+            desc = "task failed (undecodable error object)";
+          }
+          throw RpcError("task error for " + oid.substr(0, 16) + "…: " + desc);
+        }
+        if (sizes.size() > 1)
+          throw RpcError("object " + oid.substr(0, 16) +
+                         "… has out-of-band buffers (numpy?) — not "
+                         "representable in the C++ type set");
+        return pickle_loads(payload);
+      }
+      if (now_s() > deadline)
+        throw RpcError("get(" + oid.substr(0, 16) + "…) timed out");
+      std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    }
+  }
+
+  std::string submit(const std::string& fname, std::vector<Value> args,
+                     const std::string& worker_bin, double num_cpus) {
+    std::string task_id = random_hex(16);
+    std::string oid = task_id + "00000000";
+    Value demand = Value::Dict();
+    demand.set("CPU", Value::Float(num_cpus));
+
+    Value kw = Value::Dict();
+    kw.set("caller_node", Value::None());
+    kw.set("strategy", Value::None());
+    kw.set("node_affinity", Value::None());
+    kw.set("task_id", Value::Str(task_id));
+    Value placed = head->call("schedule", {demand}, std::move(kw));
+    if (placed.is_none())
+      throw RpcError("demand infeasible: no node has " +
+                     std::to_string(num_cpus) + " CPU");
+    std::string addr = placed.items.at(1).as_str();
+
+    Value spec = Value::Dict();
+    spec.set("task_id", Value::Str(task_id));
+    spec.set("oids", Value::List({Value::Str(oid)}));
+    spec.set("fname", Value::Str(fname));
+    spec.set("lang", Value::Str("cpp"));
+    if (!worker_bin.empty())
+      spec.set("cpp_worker_bin", Value::Str(worker_bin));
+    spec.set("cpp_args",
+             Value::Bytes(pickle_dumps(Value::List(std::move(args)))));
+    spec.set("num_returns", Value::Int(1));
+    spec.set("demand", demand);
+    spec.set("assigned_node", placed.items.at(0));
+    peer(addr)->call("submit_task", {std::move(spec)});
+    return oid;
+  }
+};
+
+Driver::Driver() : impl_(new DriverImpl) {}
+Driver::~Driver() { delete impl_; }
+void Driver::Connect(const std::string& head_address) {
+  impl_->connect(head_address);
+}
+ObjectRef Driver::Put(const Value& v) { return {impl_->put(v)}; }
+Value Driver::Get(const ObjectRef& ref, double timeout_s) {
+  return impl_->get(ref.id, timeout_s);
+}
+ObjectRef Driver::Submit(const std::string& fname, std::vector<Value> args,
+                         const std::string& worker_bin, double num_cpus) {
+  return {impl_->submit(fname, std::move(args), worker_bin, num_cpus)};
+}
+void Driver::Shutdown() {
+  impl_->head.reset();
+  impl_->agent.reset();
+  impl_->peers.clear();
+}
+
+}  // namespace raytpu

@@ -1,0 +1,365 @@
+// C++ peer for the repo's RPC plane (ray_tpu/cluster/rpc.py).
+//
+// Wire: on accept the server sends "RTPA1" + required-flag + 32-byte
+// challenge; when a cluster token is configured the client answers
+// HMAC-SHA256(token, challenge) || 32-byte nonce and verifies the
+// server's proof over that nonce (mutual auth). After the handshake,
+// frames are 4-byte big-endian length || pickle({"m","a","k"}) with
+// responses {"ok": bool, "v": value} / {"ok": false, "e": exc, "tb": str}.
+//
+// The pickle here is the restricted codec (pyvalue.h); error responses
+// carry arbitrary pickled exception *objects*, so the reader used for
+// responses tolerates GLOBAL/REDUCE/NEWOBJ/BUILD by flattening them to
+// representational strings — enough to surface "tb" to the C++ caller.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "hashes.h"
+#include "pyvalue.h"
+
+namespace raytpu {
+
+struct RpcError : std::runtime_error {
+  explicit RpcError(const std::string& m) : std::runtime_error(m) {}
+};
+
+inline void send_all(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) throw RpcError("send failed");
+    p += k;
+    n -= size_t(k);
+  }
+}
+
+inline void recv_exact(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) throw RpcError("peer closed connection");
+    p += k;
+    n -= size_t(k);
+  }
+}
+
+inline void send_frame(int fd, const std::string& blob) {
+  uint8_t len[4] = {uint8_t(blob.size() >> 24), uint8_t(blob.size() >> 16),
+                    uint8_t(blob.size() >> 8), uint8_t(blob.size())};
+  std::string out(reinterpret_cast<char*>(len), 4);
+  out += blob;
+  send_all(fd, out.data(), out.size());
+}
+
+inline std::string recv_frame(int fd) {
+  uint8_t len[4];
+  recv_exact(fd, len, 4);
+  uint32_t n = (uint32_t(len[0]) << 24) | (uint32_t(len[1]) << 16) |
+               (uint32_t(len[2]) << 8) | uint32_t(len[3]);
+  std::string blob(n, '\0');
+  if (n) recv_exact(fd, blob.data(), n);
+  return blob;
+}
+
+inline void fill_random(uint8_t* out, size_t n) {
+  static thread_local std::mt19937_64 rng{std::random_device{}()};
+  for (size_t i = 0; i < n; i++) out[i] = uint8_t(rng());
+}
+
+// Client side of the hello/challenge exchange (rpc.py _handshake_server).
+inline void handshake_client(int fd, const std::string& token) {
+  char hello[38];
+  recv_exact(fd, hello, 38);
+  if (std::memcmp(hello, "RTPA1", 5) != 0)
+    throw RpcError("bad hello magic from peer");
+  bool required = hello[5] == '\x01';
+  if (!required) return;
+  if (token.empty())
+    throw RpcError("cluster requires a token but none is configured "
+                   "(set RAY_TPU_CLUSTER_TOKEN)");
+  uint8_t digest[32], nonce[32];
+  hmac_sha256(reinterpret_cast<const uint8_t*>(token.data()), token.size(),
+              reinterpret_cast<const uint8_t*>(hello + 6), 32, digest);
+  fill_random(nonce, 32);
+  uint8_t frame[64];
+  std::memcpy(frame, digest, 32);
+  std::memcpy(frame + 32, nonce, 32);
+  send_all(fd, frame, 64);
+  uint8_t verdict[33];
+  recv_exact(fd, verdict, 33);
+  if (verdict[0] != 1) throw RpcError("cluster token rejected");
+  uint8_t proof[32];
+  hmac_sha256(reinterpret_cast<const uint8_t*>(token.data()), token.size(),
+              nonce, 32, proof);
+  if (std::memcmp(verdict + 1, proof, 32) != 0)
+    throw RpcError("server failed mutual auth (spoofed head?)");
+}
+
+// Server side (accepting connections from the node agent / head probes).
+inline bool handshake_server(int fd, const std::string& token) {
+  uint8_t challenge[32];
+  fill_random(challenge, 32);
+  std::string hello = "RTPA1";
+  hello.push_back(token.empty() ? '\x00' : '\x01');
+  hello.append(reinterpret_cast<char*>(challenge), 32);
+  try {
+    send_all(fd, hello.data(), hello.size());
+    if (token.empty()) return true;
+    uint8_t frame[64];
+    recv_exact(fd, frame, 64);
+    uint8_t expect[32];
+    hmac_sha256(reinterpret_cast<const uint8_t*>(token.data()), token.size(),
+                challenge, 32, expect);
+    bool ok = std::memcmp(frame, expect, 32) == 0;
+    uint8_t proof[32];
+    hmac_sha256(reinterpret_cast<const uint8_t*>(token.data()), token.size(),
+                frame + 32, 32, proof);
+    uint8_t verdict[33];
+    verdict[0] = ok ? 1 : 0;
+    std::memcpy(verdict + 1, proof, 32);
+    send_all(fd, verdict, 33);
+    return ok;
+  } catch (const RpcError&) {
+    return false;
+  }
+}
+
+inline std::pair<std::string, int> split_address(const std::string& addr) {
+  auto pos = addr.rfind(':');
+  if (pos == std::string::npos) throw RpcError("bad address: " + addr);
+  return {addr.substr(0, pos), std::stoi(addr.substr(pos + 1))};
+}
+
+inline int tcp_connect(const std::string& addr) {
+  auto [host, port] = split_address(addr);
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) throw RpcError("socket() failed");
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, host.c_str(), &sa.sin_addr) != 1) {
+    ::close(fd);
+    throw RpcError("bad host: " + host);
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0) {
+    ::close(fd);
+    throw RpcError("connect to " + addr + " refused");
+  }
+  return fd;
+}
+
+// One connection; NOT thread-safe — callers hold their own channel or lock
+// (matches rpc.py's per-thread connection pooling).
+class RpcChannel {
+ public:
+  RpcChannel(std::string address, std::string token)
+      : address_(std::move(address)), token_(std::move(token)) {}
+  ~RpcChannel() { close(); }
+
+  Value call(const std::string& method, std::vector<Value> args,
+             Value kwargs = Value::Dict()) {
+    std::lock_guard<std::mutex> g(mu_);
+    ensure_connected();
+    Value req = Value::Dict();
+    req.set("m", Value::Str(method));
+    req.set("a", Value::Tuple(std::move(args)));
+    req.set("k", std::move(kwargs));
+    std::string resp;
+    try {
+      send_frame(fd_, pickle_dumps(req));
+      resp = recv_frame(fd_);
+    } catch (const RpcError&) {
+      close();  // transport failure: reconnect on the next call
+      throw;
+    }
+    try {
+      Value r = pickle_loads(resp);
+      const Value* ok = r.get("ok");
+      if (ok && ok->truthy()) {
+        const Value* v = r.get("v");
+        return v ? *v : Value::None();
+      }
+      const Value* tb = r.get("tb");
+      // Peer-raised: the connection stays usable (frame boundary intact).
+      throw RpcError("rpc " + method + " raised on peer:\n" +
+                     (tb && tb->kind == Value::STR ? tb->s : "<no traceback>"));
+    } catch (const CodecError& e) {
+      // Response held objects outside the restricted set (possible for
+      // exotic handler returns). The connection is still framed
+      // correctly, but the value is unusable from C++.
+      throw RpcError("rpc " + method + ": undecodable response (" +
+                     e.what() + ")");
+    }
+  }
+
+  void close() {
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+ private:
+  void ensure_connected() {
+    if (fd_ >= 0) return;
+    fd_ = tcp_connect(address_);
+    try {
+      handshake_client(fd_, token_);
+    } catch (...) {
+      close();
+      throw;
+    }
+  }
+
+  std::string address_;
+  std::string token_;
+  std::mutex mu_;
+  int fd_ = -1;
+};
+
+// Serves rpc_<method> handlers; thread per connection like rpc.py.
+class RpcServer {
+ public:
+  using Handler =
+      std::function<Value(const std::string&, const Value& /*args tuple*/,
+                          const Value& /*kwargs dict*/)>;
+
+  RpcServer(Handler handler, std::string token)
+      : handler_(std::move(handler)), token_(std::move(token)) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) throw RpcError("socket() failed");
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    sa.sin_port = 0;
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0)
+      throw RpcError("bind failed");
+    if (::listen(listen_fd_, 128) != 0) throw RpcError("listen failed");
+    socklen_t slen = sizeof(sa);
+    getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&sa), &slen);
+    address_ = "127.0.0.1:" + std::to_string(ntohs(sa.sin_port));
+    accept_thread_ = std::thread([this] { accept_loop(); });
+  }
+
+  ~RpcServer() { stop(); }
+
+  const std::string& address() const { return address_; }
+
+  void stop() {
+    bool was = stopped_.exchange(true);
+    if (!was && listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    if (listen_fd_ >= 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+  }
+
+ private:
+  void accept_loop() {
+    while (!stopped_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::thread([this, fd] { serve_conn(fd); }).detach();
+    }
+  }
+
+  void serve_conn(int fd) {
+    try {
+      if (!handshake_server(fd, token_)) {
+        ::close(fd);
+        return;
+      }
+      while (true) {
+        std::string blob = recv_frame(fd);
+        Value req = pickle_loads(blob);
+        const Value* m = req.get("m");
+        const Value* a = req.get("a");
+        const Value* k = req.get("k");
+        Value resp = Value::Dict();
+        try {
+          Value out = handler_(m ? m->as_str() : "",
+                               a ? *a : Value::Tuple(),
+                               k ? *k : Value::Dict());
+          resp.set("ok", Value::Bool(true));
+          resp.set("v", std::move(out));
+        } catch (const std::exception& e) {
+          // Python peers expect "e" to be an exception instance; a plain
+          // string would raise TypeError at the call site. Mirror rpc.py's
+          // shape with a RuntimeError the Python side can re-raise.
+          resp.set("ok", Value::Bool(false));
+          resp.set("tb", Value::Str(e.what()));
+          std::string exc;
+          exc.push_back('\x80');
+          exc.push_back('\x03');
+          // GLOBAL 'builtins RuntimeError' + msg tuple + REDUCE
+          exc.push_back('c');
+          exc += "builtins\nRuntimeError\n";
+          Value msg = Value::Tuple({Value::Str(e.what())});
+          pickle_encode_into(msg, exc);
+          exc.push_back('R');
+          exc.push_back('.');
+          // splice the pre-pickled exception into the response frame by
+          // sending a custom-built frame below.
+          send_custom_error(fd, resp, exc);
+          continue;
+        }
+        send_frame(fd, pickle_dumps(resp));
+      }
+    } catch (const std::exception&) {
+      // connection closed or protocol error — drop the connection
+    }
+    ::close(fd);
+  }
+
+  // {"ok": False, "e": <pre-pickled exc>, "tb": str} — build the pickle
+  // by hand so the exception bytes embed as an object, not as bytes.
+  void send_custom_error(int fd, const Value& resp, const std::string& exc) {
+    std::string out;
+    out.push_back('\x80');
+    out.push_back('\x03');
+    out.push_back('}');
+    out.push_back('(');
+    pickle_encode_into(Value::Str("ok"), out);
+    pickle_encode_into(Value::Bool(false), out);
+    pickle_encode_into(Value::Str("tb"), out);
+    const Value* tb = resp.get("tb");
+    pickle_encode_into(tb ? *tb : Value::Str(""), out);
+    pickle_encode_into(Value::Str("e"), out);
+    // splice the exception body (strip its PROTO header and STOP)
+    out.append(exc.substr(2, exc.size() - 3));
+    out.push_back('u');
+    out.push_back('.');
+    send_frame(fd, out);
+  }
+
+  Handler handler_;
+  std::string token_;
+  std::string address_;
+  int listen_fd_ = -1;
+  std::atomic<bool> stopped_{false};
+  std::thread accept_thread_;
+};
+
+}  // namespace raytpu

@@ -1,0 +1,75 @@
+// Example C++ worker binary (reference: ``cpp/src/ray/worker/`` default
+// worker + the api.h examples). Registers a handful of cross-language
+// functions and hands control to raytpu::WorkerMain. The node agent
+// spawns this binary for tasks submitted with lang="cpp"
+// (ray_tpu.cross_language.cpp_function / raytpu::Driver::Submit).
+//
+// Build: ray_tpu._native.build.build_cpp_worker() →
+//   g++ -O2 sample_worker.cc raytpu_runtime.cc shm_store.cc
+//
+// With --driver <head_addr> it instead runs as a C++ DRIVER: submits
+// tasks to the cluster (executed by C++ workers of this same binary) and
+// prints results — the C++-to-C++ path with no Python in the loop.
+#include <cinttypes>
+#include <cstdio>
+#include <string>
+
+#include "raytpu.h"
+
+using raytpu::Value;
+
+static Value Add(const std::vector<Value>& args) {
+  int64_t s = 0;
+  for (const auto& a : args) s += a.as_int();
+  return Value::Int(s);
+}
+RAYTPU_FUNC("add", Add);
+
+static Value Concat(const std::vector<Value>& args) {
+  std::string out;
+  for (const auto& a : args) out += a.as_str();
+  return Value::Str(out);
+}
+RAYTPU_FUNC("concat", Concat);
+
+static Value Fib(const std::vector<Value>& args) {
+  int64_t n = args.at(0).as_int();
+  int64_t a = 0, b = 1;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t t = a + b;
+    a = b;
+    b = t;
+  }
+  return Value::Int(a);
+}
+RAYTPU_FUNC("fib", Fib);
+
+// Echoes its (restricted-type) argument back — exercises the full codec
+// round trip for nested lists/dicts/bytes.
+static Value Echo(const std::vector<Value>& args) {
+  return args.empty() ? Value::None() : args[0];
+}
+RAYTPU_FUNC("echo", Echo);
+
+static Value Boom(const std::vector<Value>&) {
+  throw std::runtime_error("intentional C++ task failure");
+}
+RAYTPU_FUNC("boom", Boom);
+
+int main(int argc, char** argv) {
+  if (argc >= 3 && std::string(argv[1]) == "--driver") {
+    // C++ driver demo: C++ → scheduler → C++ worker → shm store → C++.
+    raytpu::Driver d;
+    d.Connect(argv[2]);
+    std::string bin = argc >= 4 ? argv[3] : "";
+    auto r1 = d.Submit("add", {Value::Int(40), Value::Int(2)}, bin);
+    auto r2 = d.Submit("fib", {Value::Int(20)}, bin);
+    auto put = d.Put(Value::Str("cpp-put"));
+    printf("add=%" PRId64 "\n", d.Get(r1).as_int());
+    printf("fib=%" PRId64 "\n", d.Get(r2).as_int());
+    printf("put=%s\n", d.Get(put).as_str().c_str());
+    d.Shutdown();
+    return 0;
+  }
+  return raytpu::WorkerMain(argc, argv);
+}

@@ -761,6 +761,51 @@ class ClusterBackend:
                         is_error=True)
         return refs
 
+    def submit_cpp_task(
+        self,
+        fname: str,
+        packed_args: bytes,
+        *,
+        worker_bin: str | None = None,
+        num_cpus: float = 1.0,
+        num_returns: int = 1,
+    ) -> list[ObjectRef]:
+        """Submit a task executed by a native C++ worker
+        (``cross_language.cpp_function``). The spec carries no Python
+        function blob — ``fname`` names a function registered in the
+        worker binary (RAYTPU_FUNC), args ride as a restricted-pickle
+        blob the native codec decodes (``_native/src/pyvalue.h``)."""
+        task_id = ids.new_task_id()
+        oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
+        refs = [self.make_ref(o) for o in oids]
+        spec = {
+            "task_id": task_id,
+            "oids": oids,
+            "num_returns": num_returns,
+            "fname": fname,
+            "lang": "cpp",
+            "cpp_args": packed_args,
+            "cpp_worker_bin": worker_bin,
+            "borrowed": [],
+            "demand": {"CPU": float(num_cpus)},
+            "sinfo": self._strategy_info({}),
+            "pg_id": None,
+            "bundle_index": -1,
+            "retries_left": config.task_default_max_retries,
+            "runtime_env": None,
+        }
+        for oid in oids:
+            self._lineage[oid] = spec  # cpp specs are self-contained: a
+            # node death re-submits them like any lineage re-execution
+        try:
+            self._submit_spec(spec, allow_pending=True)
+        except (ValueError, TimeoutError) as e:
+            for oid in oids:
+                self._lineage.pop(oid, None)
+                self.put_with_id(oid, TaskError(fname, str(e), repr(e)),
+                                 is_error=True)
+        return refs
+
     # -- actor plane -------------------------------------------------------
 
     def create_actor(

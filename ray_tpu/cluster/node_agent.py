@@ -170,9 +170,17 @@ class NodeAgent:
                 + ([prior] if prior else [])
             )
             cwd = recipe["cwd"]
+        # The pool is language-aware like the reference's (worker_pool.h:80
+        # keys processes by language + runtime env): a "cpp::<bin>" key
+        # spawns that native binary with the same worker flags the Python
+        # workerproc takes; everything after argv is shared.
+        if env_key.startswith("cpp::"):
+            argv = [env_key[len("cpp::"):]]
+        else:
+            argv = [sys.executable, "-m", "ray_tpu.cluster.workerproc"]
         proc = subprocess.Popen(
             [
-                sys.executable, "-m", "ray_tpu.cluster.workerproc",
+                *argv,
                 "--head", self.head_address,
                 "--agent", self.address,
                 "--node-id", self.node_id,
@@ -409,9 +417,22 @@ class NodeAgent:
             self._fail_task(spec, f"resources {demand} unavailable")
             return
         rtenv = spec.get("runtime_env")
+        env_key = (rtenv or {}).get("env_key", "")
+        if spec.get("lang") == "cpp":
+            bin_path = spec.get("cpp_worker_bin") or config.cpp_worker_bin
+            if not bin_path or not os.path.exists(bin_path):
+                pool.release(demand)
+                self._fail_task(
+                    spec,
+                    "no C++ worker binary for this cluster (set "
+                    "RAY_TPU_CPP_WORKER_BIN or pass worker_bin= to "
+                    f"cpp_function; got {bin_path!r})",
+                )
+                return
+            env_key = "cpp::" + bin_path
         try:
             w = self._checkout_worker(
-                env_key=(rtenv or {}).get("env_key", ""),
+                env_key=env_key,
                 resolved_env=rtenv,
             )
         except (TimeoutError, RuntimeError, OSError) as e:

@@ -56,6 +56,12 @@ _DEFS: dict[str, tuple[type, Any]] = {
     "pubsub_subscriber_ttl_s": (float, 120.0),
     # -- security ----------------------------------------------------------
     "cluster_token": (str, ""),
+    # -- cross-language ----------------------------------------------------
+    # Default C++ worker binary agents spawn for lang="cpp" tasks (the
+    # reference's equivalent is the per-language worker command the raylet
+    # worker pool is configured with, worker_pool.h:80). Empty = cpp tasks
+    # must carry an explicit binary path.
+    "cpp_worker_bin": (str, ""),
 }
 
 

@@ -1,0 +1,95 @@
+"""Cross-language task invocation (reference: ``python/ray/cross_language.py``).
+
+``cpp_function(name)`` returns a handle whose ``.remote(*args)`` submits a
+task executed by a native C++ worker (``_native/src/raytpu.h`` /
+``raytpu_runtime.cc``) — the node agent spawns the configured worker
+binary (``config.cpp_worker_bin`` / ``RAY_TPU_CPP_WORKER_BIN`` or the
+``worker_bin=`` override) and the result lands in the shm object store
+like any other object; ``ray_tpu.get`` reads it as a plain Python value.
+
+Values crossing the language boundary are restricted to
+{None, bool, int, float, str, bytes, list, tuple, dict} — the same
+restriction the reference places on cross-language calls (its args must
+be msgpack-able); anything else raises ``TypeError`` at submission.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+from ray_tpu._private import worker as _worker
+
+_ALLOWED_SCALARS = (type(None), bool, int, float, str, bytes)
+
+
+def _check_value(v, path="arg"):
+    if isinstance(v, _ALLOWED_SCALARS):
+        return
+    if isinstance(v, (list, tuple)):
+        for i, item in enumerate(v):
+            _check_value(item, f"{path}[{i}]")
+        return
+    if isinstance(v, dict):
+        for k, item in v.items():
+            if not isinstance(k, str):
+                raise TypeError(
+                    f"cross-language dict keys must be str, got "
+                    f"{type(k).__name__} at {path}"
+                )
+            _check_value(item, f"{path}[{k!r}]")
+        return
+    raise TypeError(
+        f"cross-language values are restricted to None/bool/int/float/"
+        f"str/bytes/list/tuple/dict; got {type(v).__name__} at {path}"
+    )
+
+
+def pack_args(args: tuple) -> bytes:
+    """Restricted-pickle the arg list for the native codec
+    (``pyvalue.h`` decodes protocol ≤3 streams of these types)."""
+    for i, a in enumerate(args):
+        _check_value(a, f"arg{i}")
+    return pickle.dumps(list(args), protocol=3)
+
+
+class CppFunction:
+    """Handle to a named function in a C++ worker binary."""
+
+    def __init__(self, name: str, worker_bin: str | None = None,
+                 num_cpus: float = 1.0, num_returns: int = 1):
+        self._name = name
+        self._worker_bin = worker_bin
+        self._num_cpus = num_cpus
+        self._num_returns = num_returns
+
+    def options(self, *, worker_bin: str | None = None,
+                num_cpus: float | None = None,
+                num_returns: int | None = None) -> "CppFunction":
+        return CppFunction(
+            self._name,
+            worker_bin if worker_bin is not None else self._worker_bin,
+            num_cpus if num_cpus is not None else self._num_cpus,
+            num_returns if num_returns is not None else self._num_returns,
+        )
+
+    def remote(self, *args):
+        backend = _worker.backend()
+        if not hasattr(backend, "submit_cpp_task"):
+            raise RuntimeError(
+                "cpp_function requires the cluster backend "
+                "(ray_tpu.init(address=...)); local mode has no native "
+                "worker pool"
+            )
+        refs = backend.submit_cpp_task(
+            self._name,
+            pack_args(args),
+            worker_bin=self._worker_bin,
+            num_cpus=self._num_cpus,
+            num_returns=self._num_returns,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+
+def cpp_function(name: str, worker_bin: str | None = None) -> CppFunction:
+    """Reference-parity entry point (``ray.cross_language.cpp_function``)."""
+    return CppFunction(name, worker_bin)
