@@ -1,0 +1,105 @@
+"""Usage stats (reference: ``python/ray/_private/usage/usage_lib.py``).
+
+Opt-out telemetry: a periodic report of cluster shape + which libraries
+were imported. Differences from the reference, deliberately: this
+environment is zero-egress, so reports are only ever written to a local
+JSONL file under the session temp dir (the reference POSTs to a usage
+endpoint); and collection is DISABLED by default here — recording starts
+only when ``RAY_TPU_USAGE_STATS_ENABLED=1`` (the reference ships
+enabled-by-default with an opt-out env, ``usage_lib.py`` usage_stats_enabledness).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, Set
+
+_lock = threading.Lock()
+_library_usages: Set[str] = set()
+_extra_tags: Dict[str, str] = {}
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "0") == "1"
+
+
+def record_library_usage(library: str) -> None:
+    """Called by library entry points (train/tune/data/serve/rllib)."""
+    with _lock:
+        _library_usages.add(library)
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    with _lock:
+        _extra_tags[str(key)] = str(value)
+
+
+def _report_path() -> str:
+    return os.path.join(
+        tempfile.gettempdir(), f"ray_tpu_usage_{os.getuid()}.jsonl")
+
+
+def generate_report() -> Dict[str, Any]:
+    """The reference's UsageStatsToReport shape, trimmed to what exists."""
+    from ray_tpu.version import __version__
+
+    report: Dict[str, Any] = {
+        "schema_version": "0.1",
+        "source": "ray_tpu",
+        "version": __version__,
+        "collect_timestamp_ms": int(time.time() * 1000),
+        "os": os.uname().sysname.lower(),
+        "python_version": ".".join(map(str, __import__("sys").version_info[:3])),
+    }
+    with _lock:
+        report["library_usages"] = sorted(_library_usages)
+        report["extra_usage_tags"] = dict(_extra_tags)
+    try:
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            report["total_num_nodes"] = len(ray_tpu.nodes())
+            report["cluster_resources"] = {
+                k: float(v) for k, v in ray_tpu.cluster_resources().items()
+            }
+    except Exception:
+        pass
+    return report
+
+
+def write_report() -> str | None:
+    """Append one report line locally (the zero-egress 'ping'). Returns
+    the path, or None when disabled."""
+    if not usage_stats_enabled():
+        return None
+    path = _report_path()
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(generate_report()) + "\n")
+        return path
+    except OSError:
+        return None
+
+
+_reporter_started = False
+
+
+def start_usage_reporter(interval_s: float = 3600.0) -> bool:
+    """Background periodic recording (reference: usage stats agent on the
+    head). No-op unless enabled."""
+    global _reporter_started
+    if not usage_stats_enabled() or _reporter_started:
+        return False
+    _reporter_started = True
+
+    def loop():
+        while True:
+            write_report()
+            time.sleep(interval_s)
+
+    threading.Thread(target=loop, daemon=True).start()
+    return True

@@ -6,6 +6,9 @@ compute, preprocessors — built purely on tasks/actors/objects, with a
 TPU-native device-feeding path (``iter_device_batches``).
 """
 
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu("data")
+
 from ray_tpu.data.dataset import (
     ActorPoolStrategy,
     Dataset,

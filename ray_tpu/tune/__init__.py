@@ -5,6 +5,9 @@ variant generation, trial runner over actors with per-trial resources,
 ASHA / median-stopping / PBT schedulers, per-trial checkpoints + retries.
 """
 
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu("tune")
+
 from ray_tpu.train import session as _session
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.tune.schedulers import (
