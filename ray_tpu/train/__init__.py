@@ -8,6 +8,9 @@ collectives on ICI); the framework's job is placement, session plumbing,
 checkpoints and failure handling.
 """
 
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu("train")
+
 from ray_tpu.train.train_step import TrainState, make_train_step, make_init_fn
 from ray_tpu.train.optim import adamw_init, adamw_update
 from ray_tpu.train.config import (
@@ -20,6 +23,8 @@ from ray_tpu.train.config import (
 from ray_tpu.train.checkpoint import Checkpoint, load_sharded, save_sharded
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
 from ray_tpu.train import session
+from ray_tpu.train import torch as torch_backend
+from ray_tpu.train.torch import TorchConfig, TorchTrainer
 
 # Session API at package level too (reference exposes ray.air.session).
 report = session.report
@@ -44,6 +49,9 @@ __all__ = [
     "load_sharded",
     "DataParallelTrainer",
     "JaxTrainer",
+    "TorchConfig",
+    "TorchTrainer",
+    "torch_backend",
     "Result",
     "session",
     "report",

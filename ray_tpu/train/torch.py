@@ -1,0 +1,123 @@
+"""TorchTrainer: torch-DDP data parallelism on the actor substrate.
+
+Reference parity: ``python/ray/train/torch/`` — ``TorchConfig``/
+``_TorchBackend`` pick a backend (gloo on CPU hosts), rank 0 fans out a
+master addr/port, every worker calls ``dist.init_process_group``
+(``torch/config.py:29,69,113,129-181``), and ``prepare_model`` /
+``prepare_data_loader`` wrap DDP + DistributedSampler
+(``torch/train_loop_utils.py``).
+
+TPU-native positioning: the flagship training path here is ``JaxTrainer``
+(XLA collectives inside the jitted step); TorchTrainer exists for the
+reference's torch workloads — CPU-side torch models data-parallel over
+the same WorkerGroup/session machinery, rendezvousing through the same
+cluster-KV channel the JAX runtime uses (``parallel/distributed.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.train import session
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+@dataclass
+class TorchConfig:
+    """``python/ray/train/torch/config.py:29`` analog. ``backend``:
+    process-group backend; gloo is the CPU default (nccl has no meaning
+    on TPU hosts — device collectives belong to XLA/JaxTrainer)."""
+
+    backend: str = "gloo"
+    init_timeout: float = 120.0
+
+
+class TorchTrainer(DataParallelTrainer):
+    """DataParallelTrainer whose workers join one torch.distributed
+    process group before the training loops start; inside the loop,
+    ``prepare_model`` makes gradient averaging automatic via DDP."""
+
+    def __init__(self, *args, torch_config: Optional[TorchConfig] = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.torch_config = torch_config or TorchConfig()
+
+    def _on_group_start(self, group, node_ranks, local_ranks) -> None:
+        # torch.distributed is one process group per OS process; the
+        # local backend's thread-actors share a process, so the
+        # distributed path needs the cluster backend (same constraint and
+        # guard as JaxTrainer._on_group_start).
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu.core import ids
+        from ray_tpu.core.local_backend import LocalBackend
+        from ray_tpu.parallel import distributed as rdz
+
+        if isinstance(worker_mod.backend(), LocalBackend):
+            return
+        if self.scaling.num_workers == 1:
+            return
+        group_name = f"torch-{ids.new_task_id()[:12]}"
+        refs = [
+            w.setup_torch.remote(
+                group_name, i, self.scaling.num_workers,
+                local_ranks[i], self.torch_config,
+            )
+            for i, w in enumerate(group.workers)
+        ]
+        try:
+            import ray_tpu
+
+            ray_tpu.get(refs, timeout=self.torch_config.init_timeout + 60)
+        finally:
+            rdz.clear_group(group_name)
+
+
+def get_device():
+    """Reference ``train.torch.get_device``: the device this worker's
+    model should live on. CPU-host torch here (accelerators are JAX's)."""
+    import torch
+
+    return torch.device("cpu")
+
+
+def prepare_model(model, *, wrap_ddp: bool = True):
+    """Wrap the model for distributed training when a process group is
+    active (``train/torch/train_loop_utils.py`` prepare_model): DDP makes
+    backward() all-reduce gradients so every rank steps identically."""
+    import torch.distributed as dist
+
+    if (wrap_ddp and dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader, *, add_dist_sampler: bool = True):
+    """Re-wrap a DataLoader with a DistributedSampler over this worker's
+    rank/world (``train_loop_utils.py`` prepare_data_loader): each rank
+    iterates a disjoint 1/world shard per epoch."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not (add_dist_sampler and dist.is_available()
+            and dist.is_initialized() and dist.get_world_size() > 1):
+        return loader
+    if isinstance(getattr(loader, "sampler", None), DistributedSampler):
+        return loader
+    sampler = DistributedSampler(
+        loader.dataset,
+        num_replicas=session.get_world_size(),
+        rank=session.get_world_rank(),
+    )
+    return DataLoader(
+        loader.dataset,
+        batch_size=loader.batch_size,
+        sampler=sampler,
+        num_workers=0,
+        collate_fn=loader.collate_fn,
+        drop_last=loader.drop_last,
+    )

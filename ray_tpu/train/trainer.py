@@ -81,6 +81,33 @@ class _TrainWorker:
         )
         return True
 
+    def setup_torch(self, group: str, rank: int, world_size: int,
+                    local_rank: int, torch_config) -> bool:
+        """Join the group's torch.distributed process group (TorchTrainer
+        backend hook; ``train/torch/config.py:129-181`` analog). Rank 0
+        publishes its master addr/port through the cluster KV — the same
+        rendezvous channel the JAX runtime uses."""
+        import datetime
+        import os
+
+        import torch.distributed as tdist
+
+        from ray_tpu.parallel import distributed as rdz
+
+        if rank == 0:
+            addr = rdz.publish_coordinator(group)
+        else:
+            addr = rdz.wait_coordinator(group, torch_config.init_timeout)
+        os.environ["RAY_TPU_LOCAL_RANK"] = str(local_rank)
+        tdist.init_process_group(
+            torch_config.backend,
+            init_method=f"tcp://{addr}",
+            rank=rank,
+            world_size=world_size,
+            timeout=datetime.timedelta(seconds=torch_config.init_timeout),
+        )
+        return True
+
     def run(self, train_fn, config, session_kwargs):
         session_mod.init_session(**session_kwargs)
         try:

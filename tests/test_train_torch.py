@@ -1,0 +1,91 @@
+"""TorchTrainer: real torch-DDP over cluster worker processes (reference
+``python/ray/train/torch/`` — gloo process group, DDP gradient averaging,
+DistributedSampler sharding)."""
+
+import sys
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+from ray_tpu.train import Result, ScalingConfig, TorchConfig, TorchTrainer
+from ray_tpu.train import session
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=4)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _loop(config):
+    import numpy as np
+    import torch
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, TensorDataset
+
+    from ray_tpu.train import torch as train_torch
+
+    assert dist.is_initialized() and dist.get_world_size() == 2
+
+    # y = 3x - 1 + noise; each rank must see a DISJOINT half per epoch.
+    g = np.random.default_rng(0)
+    x = g.normal(size=(256, 1)).astype(np.float32)
+    y = (3.0 * x - 1.0 + 0.01 * g.normal(size=x.shape)).astype(np.float32)
+    ds = TensorDataset(torch.from_numpy(x), torch.from_numpy(y))
+    loader = train_torch.prepare_data_loader(
+        DataLoader(ds, batch_size=32, shuffle=False))
+    n_seen = sum(xb.shape[0] for xb, _ in loader)
+
+    torch.manual_seed(session.get_world_rank())  # ranks start DIFFERENT
+    model = torch.nn.Linear(1, 1)
+    model = train_torch.prepare_model(model)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    loss_fn = torch.nn.MSELoss()
+
+    final = None
+    for epoch in range(20):
+        for xb, yb in loader:
+            opt.zero_grad()
+            loss = loss_fn(model(xb), yb)
+            loss.backward()  # DDP all-reduces grads here
+            opt.step()
+        final = float(loss)
+    w = model.module.weight.item()
+    b = model.module.bias.item()
+    # DDP weight sync proof: gather both ranks' weights and compare —
+    # identical synced updates mean bit-for-bit equality.
+    mine = torch.tensor([w, b])
+    gathered = [torch.zeros(2) for _ in range(dist.get_world_size())]
+    dist.all_gather(gathered, mine)
+    synced = bool(torch.equal(gathered[0], gathered[1]))
+    session.report({"loss": final, "w": w, "b": b, "synced": synced,
+                    "rank": session.get_world_rank(), "n_seen": n_seen})
+
+
+def test_torch_ddp_trains_and_syncs(cluster):
+    trainer = TorchTrainer(
+        _loop,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        torch_config=TorchConfig(backend="gloo"),
+    )
+    result: Result = trainer.fit()
+    assert result.error is None
+    # Rank-0 metrics win; the model must have learned y = 3x - 1.
+    m = result.metrics
+    assert abs(m["w"] - 3.0) < 0.1 and abs(m["b"] + 1.0) < 0.1, m
+    assert m["loss"] < 0.01
+    # DistributedSampler: each rank iterated half the 256 samples.
+    assert m["n_seen"] == 128
+    # DDP weight sync verified in-loop via all_gather across ranks.
+    assert m["synced"] is True
