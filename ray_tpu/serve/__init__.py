@@ -10,6 +10,9 @@ scaling unit.
 
 from __future__ import annotations
 
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu("serve")
+
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
@@ -86,30 +89,80 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
     return wrap
 
 
-def run(target: "Application | Deployment", *, name: Optional[str] = None,
-        route_prefix: Optional[str] = None) -> DeploymentHandle:
-    """Deploy (or redeploy) and return a handle
-    (``serve/api.py:455``)."""
-    if isinstance(target, Deployment):
-        target = target.bind()
+def _resolve_graph_arg(value, controller, used_names: dict):
+    """Deployment-graph composition (reference
+    ``serve/deployment_graph_build.py``): a bound deployment appearing in
+    another deployment's init args deploys first (post-order DFS) and is
+    replaced by a ``DeploymentHandle`` — the constructed replica holds
+    live handles to its upstream models."""
+    if isinstance(value, Deployment):
+        value = value.bind()
+    if isinstance(value, Application):
+        inner = _deploy_app(value, controller, route_prefix=None,
+                            used_names=used_names)
+        return DeploymentHandle(inner)
+    if isinstance(value, (list, tuple)):
+        resolved = [_resolve_graph_arg(v, controller, used_names)
+                    for v in value]
+        return type(value)(resolved)
+    if isinstance(value, dict):
+        return {k: _resolve_graph_arg(v, controller, used_names)
+                for k, v in value.items()}
+    return value
+
+
+def _deploy_app(target: "Application", controller,
+                name: Optional[str] = None,
+                route_prefix: Optional[str] = "__use_deployment__",
+                used_names: Optional[dict] = None) -> str:
+    used_names = used_names if used_names is not None else {}
     dep = target.deployment
-    controller = get_or_create_controller()
+    init_args = tuple(
+        _resolve_graph_arg(a, controller, used_names)
+        for a in target.init_args)
+    init_kwargs = {
+        k: _resolve_graph_arg(v, controller, used_names)
+        for k, v in target.init_kwargs.items()
+    }
+    prefix = dep.route_prefix if route_prefix == "__use_deployment__" \
+        else route_prefix
+    # Unique graph-node names (reference graph build does the same): two
+    # bindings of one deployment in a graph are distinct deployments —
+    # without this the second would silently redeploy over the first.
+    final = name or dep.name
+    count = used_names.get(final, 0)
+    used_names[final] = count + 1
+    if count:
+        final = f"{final}_{count + 1}"
     ray_tpu.get(
         controller.deploy.remote(
-            name or dep.name,
+            final,
             dep.func_or_class,
-            target.init_args,
-            target.init_kwargs,
+            init_args,
+            init_kwargs,
             dep.num_replicas,
             dep.max_concurrent_queries,
-            route_prefix if route_prefix is not None else dep.route_prefix,
+            prefix,
             dep.version,
             dep.ray_actor_options,
             dep.autoscaling_config,
         ),
         timeout=120,
     )
-    return DeploymentHandle(name or dep.name)
+    return final
+
+
+def run(target: "Application | Deployment", *, name: Optional[str] = None,
+        route_prefix: Optional[str] = None) -> DeploymentHandle:
+    """Deploy (or redeploy) an application — possibly a deployment GRAPH
+    whose init args contain other bound deployments — and return a handle
+    (``serve/api.py:455`` + graph build)."""
+    if isinstance(target, Deployment):
+        target = target.bind()
+    controller = get_or_create_controller()
+    prefix = route_prefix if route_prefix is not None else "__use_deployment__"
+    deployed = _deploy_app(target, controller, name=name, route_prefix=prefix)
+    return DeploymentHandle(deployed)
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:
@@ -131,6 +184,23 @@ def status() -> Dict[str, dict]:
 
 
 _proxy_handle = None
+
+
+@deployment(name="DAGDriver", route_prefix="/")
+class DAGDriver:
+    """HTTP ingress for a deployment graph (reference
+    ``serve/drivers.py`` DAGDriver): bind it over a composed application
+    — ``serve.run(DAGDriver.bind(graph))`` — and each request payload is
+    fed to the graph's root handle. ``http_adapter`` optionally reshapes
+    the decoded JSON body first."""
+
+    def __init__(self, graph: DeploymentHandle, http_adapter=None):
+        self._handle = graph
+        self._adapter = http_adapter
+
+    def __call__(self, request):
+        payload = self._adapter(request) if self._adapter else request
+        return ray_tpu.get(self._handle.remote(payload), timeout=120)
 
 
 def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> int:
@@ -168,6 +238,7 @@ __all__ = [
     "Deployment",
     "Application",
     "DeploymentHandle",
+    "DAGDriver",
     "run",
     "get_deployment_handle",
     "get_app_handle",

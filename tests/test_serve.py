@@ -300,3 +300,98 @@ def test_jitted_inference_deployment(devices8):
     handle = serve.run(JaxModel.bind())
     out = ray_tpu.get(handle.remote([[1.0, 0, 0, 0]]), timeout=60)
     assert out[0][0] == 3.0
+
+
+def test_deployment_graph_composition():
+    """Bound deployments inside another deployment's init args deploy
+    first and arrive as live handles (reference deployment graphs,
+    ``serve/deployment_graph_build.py``): a preprocess -> ensemble
+    two-stage pipeline with fan-out."""
+    @serve.deployment
+    class Preprocessor:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class ModelA:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class ModelB:
+        def __call__(self, x):
+            return x + 2
+
+    @serve.deployment
+    class Ensemble:
+        def __init__(self, pre, models):
+            self.pre = pre
+            self.models = models
+
+        def __call__(self, x):
+            y = ray_tpu.get(self.pre.remote(x), timeout=30)
+            outs = ray_tpu.get([m.remote(y) for m in self.models],
+                               timeout=30)
+            return sum(outs) / len(outs)
+
+    handle = serve.run(
+        Ensemble.bind(Preprocessor.bind(), [ModelA.bind(), ModelB.bind()]))
+    # 3 -> pre: 6 -> models: 7, 8 -> mean 7.5
+    assert ray_tpu.get(handle.remote(3), timeout=30) == 7.5
+    # All graph nodes are real deployments, visible in status.
+    st = serve.status()
+    assert {"Ensemble", "Preprocessor", "ModelA", "ModelB"} <= set(st)
+
+
+def test_dag_driver_http_ingress():
+    """serve.DAGDriver: HTTP ingress over a composed graph
+    (reference ``serve/drivers.py``)."""
+    @serve.deployment
+    class Scale:
+        def __call__(self, x):
+            return x * 10
+
+    @serve.deployment
+    class Shift:
+        def __init__(self, upstream):
+            self.upstream = upstream
+
+        def __call__(self, x):
+            return ray_tpu.get(self.upstream.remote(x), timeout=30) + 1
+
+    serve.run(serve.DAGDriver.bind(Shift.bind(Scale.bind())))
+    port = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps(4).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert json.loads(resp.read()) == 41
+
+
+def test_graph_duplicate_bindings_stay_distinct():
+    """Two bindings of one deployment in a graph must deploy as distinct
+    nodes (the reference uniquifies graph-node names)."""
+    @serve.deployment
+    class Model:
+        def __init__(self, k):
+            self.k = k
+
+        def __call__(self, x):
+            return x * self.k
+
+    @serve.deployment
+    class Combine:
+        def __init__(self, a, b):
+            self.a = a
+            self.b = b
+
+        def __call__(self, x):
+            ra, rb = ray_tpu.get(
+                [self.a.remote(x), self.b.remote(x)], timeout=30)
+            return [ra, rb]
+
+    handle = serve.run(Combine.bind(Model.bind(10), Model.bind(100)))
+    assert ray_tpu.get(handle.remote(3), timeout=30) == [30, 300]
