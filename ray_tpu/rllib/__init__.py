@@ -6,14 +6,20 @@ algorithm families:
 * PPO — fully jitted on-policy learner (Anakin) plus RolloutWorker
   actors (Sebulba);
 * DQN — off-policy double-Q with an ON-DEVICE replay buffer, the whole
-  act/store/sample/update iteration as one jitted program.
+  act/store/sample/update iteration as one jitted program;
+* IMPALA — the distributed actor-learner architecture: stale behavior
+  policies on rollout actors, V-trace correction on the learner.
 The execution model (jit the whole train iteration; actors only for
 off-device sampling) is the part of the reference's ~30 algorithms that
 generalizes.
 """
 
+from ray_tpu._private.usage import record_library_usage as _rlu
+_rlu("rllib")
+
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, make_vec_env
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.ppo import PPO, PPOConfig, RolloutWorker, policy_apply
 from ray_tpu.rllib.sample_batch import SampleBatch
 
@@ -22,6 +28,9 @@ __all__ = [
     "make_vec_env",
     "DQN",
     "DQNConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "vtrace",
     "PPO",
     "PPOConfig",
     "RolloutWorker",

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.rllib.env import CartPole, make_vec_env
+from ray_tpu.rllib.optim import adam_step as _adam
 from ray_tpu.rllib.ppo import mlp_apply, mlp_init
 
 
@@ -109,18 +110,7 @@ def _make_train_iter(cfg: DQNConfig):
         return jnp.mean(err * err)
 
     def adam_step(params, opt, grads):
-        t = opt["t"] + 1
-        b1, b2, eps = 0.9, 0.999, 1e-8
-        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, opt["mu"], grads)
-        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g,
-                          opt["nu"], grads)
-        mhat = jax.tree.map(lambda m: m / (1 - b1 ** t), mu)
-        vhat = jax.tree.map(lambda v: v / (1 - b2 ** t), nu)
-        params = jax.tree.map(
-            lambda p, m, v: p - cfg.lr * m / (jnp.sqrt(v) + eps),
-            params, mhat, vhat,
-        )
-        return params, {"mu": mu, "nu": nu, "t": t}
+        return _adam(params, opt, grads, lr=cfg.lr)
 
     @jax.jit
     def reset(rng):

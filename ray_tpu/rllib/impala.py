@@ -1,0 +1,274 @@
+"""IMPALA: distributed actor-learner RL with V-trace off-policy correction.
+
+Reference parity: ``rllib/algorithms/impala/`` — the architecture the
+reference's distributed RL story is built around: rollout-worker actors
+sample with a stale BEHAVIOR policy snapshot while the learner updates the
+TARGET policy; the decoupling is corrected by V-trace (clipped importance
+weights rho/c, Espeholt et al. 2018), so the learner never waits for
+on-policy data.
+
+TPU-native shape (Sebulba, like ``rllib/ppo.py``): workers are actors with
+their own jitted on-device env batch; the learner's V-trace update is one
+jitted program over time-major [T, B] trajectories. With
+``num_rollout_workers=0`` the same program runs Anakin-style (sample +
+update in-process; importance ratios are then ~1 and V-trace reduces to
+n-step TD, which is exactly the algorithm's on-policy limit).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import CartPole, make_vec_env
+from ray_tpu.rllib.optim import adam_step as _adam
+from ray_tpu.rllib.ppo import policy_apply, policy_init
+
+
+class IMPALAConfig:
+    """Builder-style config (``IMPALAConfig().training(...)``)."""
+
+    def __init__(self):
+        self.env = CartPole()
+        self.num_envs = 16
+        self.rollout_length = 64         # T per sample()
+        self.num_rollout_workers = 0
+        self.gamma = 0.99
+        self.lr = 5e-4
+        self.hidden_sizes = (64, 64)
+        self.entropy_coef = 0.01
+        self.vf_coef = 0.5
+        self.rho_clip = 1.0              # V-trace rho-bar
+        self.c_clip = 1.0                # V-trace c-bar
+        self.max_grad_norm = 40.0
+        self.seed = 0
+
+    def environment(self, env=None) -> "IMPALAConfig":
+        if env is not None:
+            self.env = env
+        return self
+
+    def rollouts(self, *, num_envs: Optional[int] = None,
+                 num_rollout_workers: Optional[int] = None,
+                 rollout_length: Optional[int] = None) -> "IMPALAConfig":
+        if num_envs is not None:
+            self.num_envs = num_envs
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if rollout_length is not None:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, **kwargs) -> "IMPALAConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown IMPALA option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "IMPALAConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+def vtrace(values, bootstrap_value, rewards, dones, logp_target,
+           logp_behavior, gamma, rho_clip, c_clip):
+    """V-trace targets + policy-gradient advantages over time-major [T, B].
+
+    Returns (vs [T,B], pg_adv [T,B]). ``values`` are the TARGET policy's
+    value estimates V(x_t); ``bootstrap_value`` is V(x_T)."""
+    rho = jnp.minimum(rho_clip, jnp.exp(logp_target - logp_behavior))
+    c = jnp.minimum(c_clip, jnp.exp(logp_target - logp_behavior))
+    discounts = gamma * (1.0 - dones)
+    values_next = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = rho * (rewards + discounts * values_next - values)
+
+    def backward(acc, xs):
+        delta_t, discount_t, c_t = xs
+        acc = delta_t + discount_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, c), reverse=True)
+    vs = vs_minus_v + values
+    vs_next = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = rho * (rewards + discounts * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+def _make_pieces(cfg: IMPALAConfig):
+    env = cfg.env
+    reset_fn, step_fn, obs_fn = make_vec_env(env, cfg.num_envs)
+
+    def sample_rollout(params, states, rng):
+        """Behavior-policy rollout -> time-major trajectory + bootstrap."""
+        def one_step(carry, _):
+            states, rng = carry
+            rng, k_act, k_step = jax.random.split(rng, 3)
+            obs = obs_fn(states)
+            logits, _ = policy_apply(params, obs)
+            actions = jax.random.categorical(k_act, logits)
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), actions[:, None], axis=1)[:, 0]
+            nstates, _, rewards, dones = step_fn(states, actions, k_step)
+            out = {"obs": obs, "actions": actions, "logp": logp,
+                   "rewards": rewards, "dones": dones.astype(jnp.float32)}
+            return (nstates, rng), out
+
+        (states, rng), traj = jax.lax.scan(
+            one_step, (states, rng), None, length=cfg.rollout_length)
+        return states, rng, traj, obs_fn(states)
+
+    def adam_step(params, opt, grads):
+        return _adam(params, opt, grads, lr=cfg.lr,
+                     max_grad_norm=cfg.max_grad_norm)
+
+    def loss_fn(params, batch):
+        t_, b_ = batch["actions"].shape
+        flat_obs = batch["obs"].reshape(t_ * b_, -1)
+        logits, values = policy_apply(params, flat_obs)
+        logits = logits.reshape(t_, b_, -1)
+        values = values.reshape(t_, b_)
+        _, bootstrap = policy_apply(params, batch["bootstrap_obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp_target = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        vs, pg_adv = vtrace(
+            values, bootstrap, batch["rewards"], batch["dones"],
+            logp_target, batch["logp"], cfg.gamma, cfg.rho_clip, cfg.c_clip)
+        pg_loss = -jnp.mean(logp_target * pg_adv)
+        vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = pg_loss + cfg.vf_coef * vf_loss - cfg.entropy_coef * entropy
+        return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    @jax.jit
+    def reset(rng):
+        return reset_fn(rng)
+
+    @jax.jit
+    def update(params, opt, batch):
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        params, opt = adam_step(params, opt, grads)
+        return params, opt, {"loss": loss, **aux}
+
+    return reset, jax.jit(sample_rollout), update
+
+
+class ImpalaRolloutWorker:
+    """Actor sampling with a (possibly stale) behavior-policy snapshot —
+    the 'actor' half of the actor-learner architecture."""
+
+    def __init__(self, cfg_dict: dict, seed: int):
+        cfg = IMPALAConfig()
+        cfg.__dict__.update(cfg_dict)
+        cfg.num_rollout_workers = 0
+        self.cfg = cfg
+        self._reset, self._sample, _ = _make_pieces(cfg)
+        self.rng = jax.random.key(seed)
+        self.states = self._reset(jax.random.key(seed + 1))
+
+    def sample(self, params) -> dict:
+        self.states, self.rng, traj, boot = self._sample(
+            params, self.states, self.rng)
+        out = {k: np.asarray(v) for k, v in traj.items()}
+        out["bootstrap_obs"] = np.asarray(boot)
+        return out
+
+
+class IMPALA:
+    """Algorithm: ``.train()`` one iteration -> result dict
+    (``rllib/algorithms/algorithm.py:142`` Trainable contract)."""
+
+    def __init__(self, config: IMPALAConfig):
+        self.config = config
+        rng = jax.random.key(config.seed)
+        k_param, k_env, self._rng = jax.random.split(rng, 3)
+        env = config.env
+        self.params = policy_init(
+            k_param, env.observation_size, env.num_actions,
+            config.hidden_sizes)
+        self.opt = {
+            "mu": jax.tree.map(jnp.zeros_like, self.params),
+            "nu": jax.tree.map(jnp.zeros_like, self.params),
+            "t": jnp.zeros((), jnp.int32),
+        }
+        self._reset, self._sample, self._update = _make_pieces(config)
+        self._iteration = 0
+        self._ep_steps = 0.0
+        self._ep_dones = 0.0
+        self._workers: List = []
+        if config.num_rollout_workers > 0:
+            # Distributed: sampling lives on the worker actors — the
+            # learner never builds a local env batch.
+            self._states = None
+            worker_cls = ray_tpu.remote(ImpalaRolloutWorker)
+            # The FULL config crosses (env included: it's a plain object
+            # the actor args pickler handles) — workers must sample the
+            # configured env, not a default.
+            self._workers = [
+                worker_cls.remote(dict(config.__dict__),
+                                  config.seed + 100 + i)
+                for i in range(config.num_rollout_workers)
+            ]
+        else:
+            self._states = self._reset(k_env)
+
+    def _gather(self) -> dict:
+        if self._workers:
+            # Learner-side barrier per iteration; staleness comes from the
+            # params snapshot each worker used (V-trace corrects it).
+            batches = ray_tpu.get(
+                [w.sample.remote(self.params) for w in self._workers],
+                timeout=300)
+            return {
+                k: (np.concatenate([b[k] for b in batches], axis=0)
+                    if k == "bootstrap_obs"
+                    else np.concatenate([b[k] for b in batches], axis=1))
+                for k in batches[0]
+            }
+        self._states, self._rng, traj, boot = self._sample(
+            self.params, self._states, self._rng)
+        out = {k: v for k, v in traj.items()}
+        out["bootstrap_obs"] = boot
+        return out
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        batch = self._gather()
+        self.params, self.opt, metrics = self._update(
+            self.params, self.opt, batch)
+        steps = int(np.asarray(batch["actions"]).size)
+        dones = float(np.asarray(batch["dones"]).sum())
+        self._ep_steps += steps
+        self._ep_dones += dones
+        self._iteration += 1
+        reward_mean = (self._ep_steps / max(1.0, self._ep_dones))
+        if dones > 0:  # fresher estimate once episodes complete
+            self._ep_steps, self._ep_dones = steps, dones
+            reward_mean = steps / dones
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter": steps,
+            "episode_reward_mean": reward_mean,
+            "time_this_iter_s": time.perf_counter() - start,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def compute_single_action(self, obs) -> int:
+        logits, _ = policy_apply(self.params, jnp.asarray(obs)[None])
+        return int(jnp.argmax(logits[0]))

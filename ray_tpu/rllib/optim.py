@@ -1,0 +1,34 @@
+"""Shared RL optimizer step (one copy for ppo/dqn/impala).
+
+Bias-corrected Adam with optional clip-by-global-norm, shaped for use
+inside jitted train iterations: ``opt`` is the plain pytree
+``{"mu", "nu", "t"}`` each algorithm carries in its learner state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def adam_step(params, opt, grads, *, lr: float,
+              max_grad_norm: Optional[float] = None,
+              b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    """One Adam update; returns (params, opt)."""
+    if max_grad_norm is not None:
+        gnorm = jnp.sqrt(sum(
+            jnp.sum(g * g) for g in jax.tree.leaves(grads)))
+        scale = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-8))
+        grads = jax.tree.map(lambda g: g * scale, grads)
+    t = opt["t"] + 1
+    mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, opt["mu"], grads)
+    nu = jax.tree.map(lambda n, g: b2 * n + (1 - b2) * g * g, opt["nu"], grads)
+    bc1 = 1 - b1 ** t.astype(jnp.float32)
+    bc2 = 1 - b2 ** t.astype(jnp.float32)
+    params = jax.tree.map(
+        lambda p, m, n: p - lr * (m / bc1) / (jnp.sqrt(n / bc2) + eps),
+        params, mu, nu,
+    )
+    return params, {"mu": mu, "nu": nu, "t": t}

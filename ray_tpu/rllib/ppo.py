@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 import ray_tpu
 from ray_tpu.rllib.env import CartPole, make_vec_env
+from ray_tpu.rllib.optim import adam_step as _adam
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
@@ -185,20 +186,8 @@ def _make_train_iter(cfg: PPOConfig):
                        "entropy": entropy}
 
     def adam_step(params, opt, grads):
-        b1, b2, eps = 0.9, 0.999, 1e-5
-        gnorm = jnp.sqrt(sum(jnp.sum(g**2) for g in jax.tree.leaves(grads)))
-        scale = jnp.minimum(1.0, cfg.grad_clip / (gnorm + 1e-8))
-        grads = jax.tree.map(lambda g: g * scale, grads)
-        t = opt["t"] + 1
-        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, opt["mu"], grads)
-        nu = jax.tree.map(lambda n_, g: b2 * n_ + (1 - b2) * g**2, opt["nu"], grads)
-        bc1 = 1 - b1**t.astype(jnp.float32)
-        bc2 = 1 - b2**t.astype(jnp.float32)
-        params = jax.tree.map(
-            lambda p, m, n_: p - cfg.lr * (m / bc1) / (jnp.sqrt(n_ / bc2) + eps),
-            params, mu, nu,
-        )
-        return params, {"mu": mu, "nu": nu, "t": t}
+        return _adam(params, opt, grads, lr=cfg.lr,
+                     max_grad_norm=cfg.grad_clip, eps=1e-5)
 
     def sgd_on_batch(params, opt, flat, rng):
         n = flat["obs"].shape[0]
@@ -322,9 +311,9 @@ class PPO:
         self._workers: List = []
         if config.num_rollout_workers > 0:
             worker_cls = ray_tpu.remote(RolloutWorker)
-            cfg_dict = {
-                k: v for k, v in config.__dict__.items() if k != "env"
-            }
+            # FULL config crosses (env included) — workers must sample
+            # the configured env, not a rebuilt default.
+            cfg_dict = dict(config.__dict__)
             self._workers = [
                 worker_cls.remote(cfg_dict, config.seed + 100 + i)
                 for i in range(config.num_rollout_workers)
