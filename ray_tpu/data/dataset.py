@@ -450,6 +450,37 @@ class Dataset:
         if carry is not None and B.num_rows(carry) and not drop_last:
             yield B.to_batch(carry, batch_format)
 
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        dtypes: Optional[dict] = None,
+        device: Optional[str] = None,
+        prefetch_blocks: int = 1,
+        drop_last: bool = False,
+    ) -> Iterable:
+        """Batches as torch tensors (reference ``iter_torch_batches``):
+        numpy batches converted zero-copy via ``torch.as_tensor``. A
+        columnar batch yields a dict of tensors; a plain array batch
+        yields one tensor. ``dtypes``: optional per-column torch dtypes."""
+        import torch
+
+        def convert(name, arr):
+            t = torch.as_tensor(arr)
+            if dtypes and name in dtypes:
+                t = t.to(dtypes[name])
+            if device:
+                t = t.to(device)
+            return t
+
+        for batch in self.iter_batches(
+                batch_size=batch_size, batch_format="numpy",
+                prefetch_blocks=prefetch_blocks, drop_last=drop_last):
+            if isinstance(batch, dict):
+                yield {k: convert(k, v) for k, v in batch.items()}
+            else:
+                yield convert(None, batch)
+
     def iter_device_batches(self, *, batch_size: int, sharding=None,
                             dtype=None, drop_last: bool = True) -> Iterable:
         """Double-buffered host->device feeding: batch i+1 is transferred

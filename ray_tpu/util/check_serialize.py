@@ -1,0 +1,92 @@
+"""Serializability debugging (reference: ``python/ray/util/check_serialize.py``
+``inspect_serializability``): when a task/actor argument fails to pickle,
+walk its closure/attributes and name the exact offending members instead
+of one opaque cloudpickle stack trace.
+
+    ok, failures = inspect_serializability(obj)
+    # failures: [FailureTuple(obj=<socket>, name="sock", parent=<A>)]
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Set, Tuple
+
+import cloudpickle
+
+
+@dataclass
+class FailureTuple:
+    obj: Any
+    name: str
+    parent: Any
+
+    def __repr__(self) -> str:
+        return f"FailTuple({self.name} [obj={self.obj!r}, parent={self.parent!r}])"
+
+
+def _serializable(obj: Any) -> bool:
+    try:
+        cloudpickle.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
+def _inspect(obj: Any, name: str, parent: Any, failures: list,
+             seen: Set[int], depth: int, max_depth: int) -> None:
+    if id(obj) in seen or depth > max_depth:
+        return
+    seen.add(id(obj))
+    if _serializable(obj):
+        return
+    children: list[Tuple[str, Any]] = []
+    # closures of functions
+    closure = getattr(obj, "__closure__", None)
+    if closure:
+        names = getattr(obj.__code__, "co_freevars", ())
+        children += [
+            (names[i] if i < len(names) else f"cell{i}", c.cell_contents)
+            for i, c in enumerate(closure)
+            if c.cell_contents is not obj
+        ]
+    # globals a function captures
+    if hasattr(obj, "__globals__") and hasattr(obj, "__code__"):
+        g = obj.__globals__
+        children += [
+            (n, g[n]) for n in obj.__code__.co_names if n in g
+        ]
+    # instance / class attributes
+    if hasattr(obj, "__dict__") and isinstance(getattr(obj, "__dict__"), dict):
+        children += list(vars(obj).items())
+    if isinstance(obj, dict):
+        children += [(str(k), v) for k, v in obj.items()]
+    elif isinstance(obj, (list, tuple, set)):
+        children += [(f"[{i}]", v) for i, v in enumerate(obj)]
+
+    found_deeper = False
+    for child_name, child in children:
+        if not _serializable(child):
+            found_deeper = True
+            _inspect(child, f"{name}.{child_name}", obj, failures, seen,
+                     depth + 1, max_depth)
+    if not found_deeper:
+        # This object is the leaf cause.
+        failures.append(FailureTuple(obj=obj, name=name, parent=parent))
+
+
+def inspect_serializability(
+    obj: Any, name: str | None = None, max_depth: int = 4,
+    print_failures: bool = True,
+) -> Tuple[bool, list]:
+    """Returns (serializable, failures). Mirrors the reference signature;
+    ``failures`` holds the deepest non-serializable members found."""
+    name = name or getattr(obj, "__name__", type(obj).__name__)
+    failures: list = []
+    _inspect(obj, name, None, failures, set(), 0, max_depth)
+    ok = not failures
+    if print_failures and failures:
+        print(f"{name} is not serializable. Offending members:")
+        for f in failures:
+            print(f"  {f}")
+    return ok, failures

@@ -1,0 +1,153 @@
+"""ParallelIterator: lazy sharded iteration over actors
+(reference: ``python/ray/util/iter.py``).
+
+    it = from_items([1, 2, 3, 4], num_shards=2)
+    it = it.for_each(lambda x: x * 2).filter(lambda x: x > 2).batch(2)
+    list(it.gather_sync())  # pulls round-robin from the shard actors
+
+Shards are actors holding their slice; transformations accumulate into a
+per-shard op pipeline applied actor-side (data stays put, functions move —
+the reference's core design), and ``gather_sync`` streams results back in
+shard round-robin order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+_DONE = "__parallel_iterator_exhausted__"
+
+
+class _ShardActor:
+    """One shard: items + the transformation pipeline, iterated lazily."""
+
+    def __init__(self, items: list):
+        self._items = items
+        self._it = None
+
+    def start(self, ops: list):
+        def gen():
+            for x in self._items:
+                out = [x]
+                for kind, fn in ops:
+                    if kind == "for_each":
+                        out = [fn(v) for v in out]
+                    elif kind == "filter":
+                        out = [v for v in out if fn(v)]
+                    elif kind == "flatten":
+                        out = [w for v in out for w in v]
+                yield from out
+
+        self._it = gen()
+        return True
+
+    def next_items(self, n: int):
+        assert self._it is not None, "start() not called"
+        out = []
+        for _ in range(n):
+            try:
+                out.append(next(self._it))
+            except StopIteration:
+                return out, True
+        return out, False
+
+
+class ParallelIterator:
+    def __init__(self, shards_items: List[list], ops: list | None = None,
+                 batch_size: int | None = None):
+        self._shards_items = shards_items
+        self._ops = ops or []
+        self._batch = batch_size
+
+    # -- transformations (lazy, applied actor-side) -----------------------
+
+    def for_each(self, fn: Callable) -> "ParallelIterator":
+        return ParallelIterator(
+            self._shards_items, self._ops + [("for_each", fn)], self._batch)
+
+    def filter(self, fn: Callable) -> "ParallelIterator":
+        return ParallelIterator(
+            self._shards_items, self._ops + [("filter", fn)], self._batch)
+
+    def flatten(self) -> "ParallelIterator":
+        return ParallelIterator(
+            self._shards_items, self._ops + [("flatten", None)], self._batch)
+
+    def batch(self, n: int) -> "ParallelIterator":
+        return ParallelIterator(self._shards_items, list(self._ops), n)
+
+    def num_shards(self) -> int:
+        return len(self._shards_items)
+
+    def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        if self._ops or other._ops:
+            raise ValueError("union() must precede transformations")
+        return ParallelIterator(
+            self._shards_items + other._shards_items, [], self._batch)
+
+    # -- consumption ------------------------------------------------------
+
+    def gather_sync(self) -> Iterable[Any]:
+        """Round-robin pull from shard actors until all are exhausted.
+        One ``next_items`` request stays in flight PER live shard, so
+        shard-side transformation work overlaps across actors while this
+        consumer yields in deterministic round-robin order."""
+        actor_cls = ray_tpu.remote(_ShardActor)
+        actors = [actor_cls.remote(items) for items in self._shards_items]
+        ray_tpu.get([a.start.remote(self._ops) for a in actors], timeout=60)
+        pull = self._batch or 32
+        inflight = [(a, a.next_items.remote(pull)) for a in actors]
+        try:
+            while inflight:
+                next_round = []
+                for a, ref in inflight:
+                    items, done = ray_tpu.get(ref, timeout=60)
+                    if not done:
+                        # re-arm BEFORE yielding: the shard computes its
+                        # next batch while the consumer processes this one
+                        next_round.append((a, a.next_items.remote(pull)))
+                    if self._batch:
+                        if items:
+                            yield items
+                    else:
+                        yield from items
+                inflight = next_round
+        finally:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+
+    def take(self, n: int) -> list:
+        out = []
+        for x in self.gather_sync():
+            out.append(x)
+            if len(out) >= n:
+                break
+        return out
+
+    def show(self, n: int = 20) -> None:
+        for x in self.take(n):
+            print(x)
+
+    def __repr__(self) -> str:
+        return (f"ParallelIterator[{len(self._shards_items)} shards, "
+                f"{len(self._ops)} ops]")
+
+
+def from_items(items: list, num_shards: int = 2) -> ParallelIterator:
+    shards: List[list] = [[] for _ in range(num_shards)]
+    for i, x in enumerate(items):
+        shards[i % num_shards].append(x)
+    return ParallelIterator(shards)
+
+
+def from_range(n: int, num_shards: int = 2) -> ParallelIterator:
+    return from_items(list(range(n)), num_shards)
+
+
+def from_iterators(generators: List[Iterable]) -> ParallelIterator:
+    return ParallelIterator([list(g) for g in generators])

@@ -1,0 +1,96 @@
+"""joblib backend over tasks (reference: ``python/ray/util/joblib/``).
+
+``register_ray_tpu()`` installs a ``ray_tpu`` joblib backend so existing
+scikit-learn-style code parallelizes over the cluster unchanged:
+
+    from ray_tpu.util.joblib import register_ray_tpu
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        joblib.Parallel()(joblib.delayed(f)(x) for x in data)
+
+Each joblib batch (a callable of pre-batched work items) becomes one task;
+``effective_n_jobs`` reports the cluster's CPU count so joblib sizes its
+batches for the whole cluster, not one host.
+"""
+
+from __future__ import annotations
+
+from joblib.parallel import AutoBatchingMixin, ParallelBackendBase
+
+import ray_tpu
+
+
+class _TaskBatchResult:
+    """Future-like wrapper joblib polls via ``get``."""
+
+    def __init__(self, ref, timeout: float | None):
+        self._ref = ref
+        self._timeout = timeout
+
+    def get(self, timeout=None):
+        return ray_tpu.get(self._ref, timeout=timeout or self._timeout)
+
+
+class RayTpuBackend(AutoBatchingMixin, ParallelBackendBase):
+    """One task per joblib batch; results stream back through the object
+    store (reference ``util/joblib/ray_backend.py`` shape)."""
+
+    supports_timeout = True
+    # joblib >= 1.3 probes this to decide whether to pass inner_n_jobs
+    supports_inner_max_num_threads = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._remote_batch = None
+
+    def effective_n_jobs(self, n_jobs: int) -> int:
+        if not ray_tpu.is_initialized():
+            return 1
+        cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+        if n_jobs == -1:
+            return max(1, cpus)
+        return max(1, min(n_jobs, cpus)) if n_jobs else 1
+
+    def configure(self, n_jobs: int = 1, parallel=None, **kwargs) -> int:
+        n = self.effective_n_jobs(n_jobs)
+        self.parallel = parallel
+        self._remote_batch = ray_tpu.remote(_run_joblib_batch)
+        return n
+
+    def submit(self, func, callback=None):
+        """joblib >= 1.3 entry point; older releases call apply_async."""
+        return self.apply_async(func, callback)
+
+    def apply_async(self, func, callback=None):
+        ref = self._remote_batch.remote(func)
+        result = _TaskBatchResult(ref, timeout=None)
+        if callback is not None:
+            # joblib's callback just schedules the next batch; resolving in
+            # a daemon thread keeps submission pipelined like the
+            # reference's actor-pool backend.
+            import threading
+
+            def waiter():
+                try:
+                    result.get()
+                finally:
+                    callback(result)
+
+            threading.Thread(target=waiter, daemon=True).start()
+        return result
+
+    def abort_everything(self, ensure_ready: bool = True):
+        if ensure_ready:
+            self.configure(n_jobs=self.parallel.n_jobs,
+                           parallel=self.parallel)
+
+
+def _run_joblib_batch(batch):
+    return batch()
+
+
+def register_ray_tpu() -> None:
+    """Register the backend under the name ``"ray_tpu"``."""
+    from joblib import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

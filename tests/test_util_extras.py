@@ -1,0 +1,112 @@
+"""util parity batch: joblib backend, ParallelIterator, check_serialize,
+usage stats, Dataset.iter_torch_batches (reference ``python/ray/util/``
++ ``_private/usage/usage_lib.py``)."""
+
+import json
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_joblib_backend():
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel()(
+            joblib.delayed(lambda x: x * x)(i) for i in range(20))
+    assert out == [i * i for i in range(20)]
+
+
+def test_parallel_iterator():
+    from ray_tpu.util.iter import from_items, from_range
+
+    it = from_items(list(range(12)), num_shards=3)
+    assert it.num_shards() == 3
+    out = sorted(
+        it.for_each(lambda x: x * 2).filter(lambda x: x >= 8).gather_sync())
+    assert out == [8, 10, 12, 14, 16, 18, 20, 22]
+
+    batches = list(from_range(10, num_shards=2).batch(3).gather_sync())
+    assert sorted(x for b in batches for x in b) == list(range(10))
+    assert all(len(b) <= 3 for b in batches)
+
+    # union before transforms; take() stops early
+    u = from_items([1, 2]).union(from_items([3, 4]))
+    assert sorted(u.gather_sync()) == [1, 2, 3, 4]
+    assert len(from_range(100, num_shards=2).take(5)) == 5
+
+
+def test_check_serialize_finds_offender():
+    import threading
+
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    ok, _ = inspect_serializability(lambda x: x + 1, print_failures=False)
+    assert ok
+
+    lock = threading.Lock()
+
+    def closure_over_lock():
+        return lock
+
+    ok, failures = inspect_serializability(
+        closure_over_lock, print_failures=False)
+    assert not ok
+    assert any("lock" in f.name for f in failures), failures
+
+
+def test_usage_stats_offline_report(monkeypatch, tmp_path):
+    from ray_tpu._private import usage
+
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+    monkeypatch.setattr(usage, "_report_path",
+                        lambda: str(tmp_path / "usage.jsonl"))
+    usage.record_library_usage("data")
+    usage.record_extra_usage_tag("test", "yes")
+    path = usage.write_report()
+    assert path is not None
+    rec = json.loads(open(path).read().splitlines()[-1])
+    assert "data" in rec["library_usages"]
+    assert rec["extra_usage_tags"]["test"] == "yes"
+    assert rec["total_num_nodes"] >= 1
+
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    assert usage.write_report() is None  # disabled = no local ping either
+
+
+def test_iter_torch_batches():
+    import torch
+
+    from ray_tpu import data
+
+    ds = data.from_numpy(np.arange(100, dtype=np.float32).reshape(100, 1))
+    seen = 0
+    for batch in ds.iter_torch_batches(batch_size=32):
+        t = batch if isinstance(batch, torch.Tensor) else batch["data"]
+        assert isinstance(t, torch.Tensor)
+        seen += t.shape[0]
+    assert seen == 100
+
+    cols = data.from_items(
+        [{"x": float(i), "y": float(-i)} for i in range(10)])
+    b = next(cols.iter_torch_batches(batch_size=10,
+                                     dtypes={"x": torch.float64}))
+    assert b["x"].dtype == torch.float64
+    assert float(b["y"].sum()) == -45.0
