@@ -100,6 +100,25 @@ class WorkerHandler:
         # executor-thread ident of each currently running task (so a
         # cooperative cancel can target the right thread).
         self._cancels = CancelRegistry(threading.Lock())
+        # Async actors (reference: asyncio event loop per actor,
+        # _raylet.pyx:1023): one loop thread, created on first coroutine
+        # method; in-flight coroutine futures by task id for cancel.
+        self._aio_loop = None
+        self._aio_lock = threading.Lock()
+        self._async_futs: dict[str, object] = {}
+        # Whether this worker hosts an ASYNC actor (any coroutine method):
+        # set after the ctor; async actors route every call via the loop.
+        self._actor_is_async = False
+        # Completion bookkeeping for async tasks runs here, off the loop.
+        self._async_done_q: queue.Queue = queue.Queue()
+        threading.Thread(target=self._async_done_loop, daemon=True).start()
+        # Function-table cache: content hash -> deserialized function
+        # (bounded LRU — long-lived workers must not accumulate every
+        # function a driver ever exported).
+        import collections
+
+        self._fn_cache: "collections.OrderedDict[str, object]" = (
+            collections.OrderedDict())
         sys.stdout = _TeeStream(sys.stdout, self._log_lines, self._ev_lock)
         sys.stderr = _TeeStream(sys.stderr, self._log_lines, self._ev_lock)
         threading.Thread(target=self._event_flush_loop, daemon=True).start()
@@ -194,9 +213,15 @@ class WorkerHandler:
         """Cancel a task this worker holds. Queued: marked so the executor
         skips it and stores TaskCancelledError. Running: the class is
         injected into the executor thread (best-effort — delivery waits
-        out any C-level block). ``force`` is handled by the agent killing
-        the process; by the time it reaches us it degrades to cooperative.
+        out any C-level block); a running COROUTINE is cancelled through
+        its asyncio future instead. ``force`` is handled by the agent
+        killing the process; by the time it reaches us it degrades to
+        cooperative.
         """
+        with self._ev_lock:
+            fut = self._async_futs.get(task_id)
+        if fut is not None:
+            return "running" if fut.cancel() else "queued"
         running = self._cancels.cancel(task_id, TaskCancelledError)
         return "running" if running else "queued"
 
@@ -241,6 +266,32 @@ class WorkerHandler:
                     self._run_actor_task(spec)
             except Exception:
                 traceback.print_exc()
+
+    def _resolve_function(self, spec):
+        """Function-table lookup (reference function_manager fetch +
+        cache): specs carry a content hash; the blob comes from the
+        cluster KV once and the DESERIALIZED function is reused for
+        every subsequent task with the same hash."""
+        blob = spec.get("func")
+        if blob is not None:  # legacy inline-blob spec (lineage replays)
+            return ser.loads(blob)
+        h = spec["func_hash"]
+        func = self._fn_cache.get(h)
+        if func is None:
+            blob = self.backend.head.call("kv_get", h)
+            if blob is None:
+                raise TaskError(
+                    spec.get("fname", "task"),
+                    f"function {h} missing from the cluster function table",
+                    "fn-table-miss",
+                )
+            func = ser.loads(blob)
+            self._fn_cache[h] = func
+            if len(self._fn_cache) > 256:
+                self._fn_cache.popitem(last=False)
+        else:
+            self._fn_cache.move_to_end(h)
+        return func
 
     def _resolve(self, args, kwargs):
         args = [
@@ -294,7 +345,7 @@ class WorkerHandler:
         try:
             from ray_tpu.util import tracing
 
-            func = ser.loads(spec["func"])
+            func = self._resolve_function(spec)
             args, kwargs = ser.loads(spec["args"])
             args, kwargs = self._resolve(args, kwargs)
             if spec.get("trace_ctx"):
@@ -350,6 +401,20 @@ class WorkerHandler:
             except Exception:
                 pass
         finally:
+            import asyncio
+            import inspect
+
+            inst = self._actor_instance
+            if inst is not None:
+                # Async actor = any public coroutine method (class-level
+                # scan; instance descriptors stay untouched). Reference:
+                # async actors get an asyncio loop, and ALL their methods
+                # run on it.
+                self._actor_is_async = any(
+                    asyncio.iscoroutinefunction(f)
+                    for _, f in inspect.getmembers(
+                        type(inst), inspect.isfunction)
+                )
             self._end_borrows(spec)
             self._finish(rec, err)
             self._actor_ready.set()
@@ -362,8 +427,119 @@ class WorkerHandler:
                         target=self._exec_loop, args=(gq,), daemon=True
                     ).start()
 
+    def _ensure_aio_loop(self):
+        import asyncio
+
+        with self._aio_lock:
+            if self._aio_loop is None:
+                loop = asyncio.new_event_loop()
+                threading.Thread(
+                    target=loop.run_forever, daemon=True).start()
+                self._aio_loop = loop
+        return self._aio_loop
+
+    def _run_actor_task_async(self, spec, method):
+        """Async-actor call (reference async actors: EVERY method of an
+        async actor runs on its ONE event loop — coroutines interleave at
+        await points, sync methods block the loop while they run, so
+        actor state keeps loop-serialized mutual exclusion). The executor
+        thread only resolves args and schedules; completion bookkeeping
+        (store/borrows/record, which do blocking RPCs) runs on a
+        dedicated completion thread, never the loop."""
+        import asyncio
+
+        rec = self._record(spec, "ACTOR_TASK")
+        if not self._begin_cancellable(spec):
+            self._store_cancelled(spec, rec)
+            return
+        task_id = spec.get("task_id")
+        fut = None
+        try:
+            args, kwargs = ser.loads(spec["args"])
+            args, kwargs = self._resolve(args, kwargs)
+            if asyncio.iscoroutinefunction(
+                    getattr(method, "__func__", method)):
+                coro = method(*args, **kwargs)
+            else:
+                # sync method of an async actor: run ON the loop (blocks
+                # other coroutines for its duration — reference behavior)
+                async def coro_wrapper():
+                    return method(*args, **kwargs)
+
+                coro = coro_wrapper()
+            fut = asyncio.run_coroutine_threadsafe(
+                coro, self._ensure_aio_loop())
+            if task_id:
+                with self._ev_lock:
+                    self._async_futs[task_id] = fut
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, (TaskError, ActorError)):
+                self._store_error(spec, e)
+            else:
+                self._store_error(
+                    spec,
+                    TaskError(spec.get("method", "actor_task"),
+                              traceback.format_exc(), repr(e)),
+                )
+            self._end_borrows(spec)
+            self._finish(rec, repr(e))
+            return
+        finally:
+            # Registered in _async_futs (or failed): cancel now targets
+            # the future, not this thread. A cancel landing inside the
+            # resolve phase above still injects into this thread and is
+            # handled by the except path like the sync flow.
+            self._end_cancellable(spec)
+
+        def done(f):
+            if task_id:
+                with self._ev_lock:
+                    self._async_futs.pop(task_id, None)
+            if f.cancelled():
+                # Same record shape as a sync cancel: CANCELLED, not FAILED.
+                self._store_cancelled(spec, rec)
+                return
+            err = None
+            try:
+                self._store_result(spec, f.result())
+            except BaseException as e:  # noqa: BLE001
+                err = repr(e)
+                if isinstance(e, (TaskError, ActorError)):
+                    self._store_error(spec, e)
+                else:
+                    self._store_error(
+                        spec,
+                        TaskError(spec.get("method", "actor_task"),
+                                  "".join(traceback.format_exception(e)),
+                                  repr(e)),
+                    )
+            finally:
+                try:
+                    self._end_borrows(spec)
+                finally:
+                    self._finish(rec, err)
+
+        # Done-callbacks fire on the thread that resolves the future (the
+        # loop thread) — hand the blocking bookkeeping to the completion
+        # worker so a slow head RPC can't stall every other coroutine.
+        fut.add_done_callback(
+            lambda f: self._async_done_q.put((done, f)))
+
+    def _async_done_loop(self):
+        while True:
+            fn, fut = self._async_done_q.get()
+            try:
+                fn(fut)
+            except Exception:
+                traceback.print_exc()
+
     def _run_actor_task(self, spec):
         self._actor_ready.wait(timeout=300.0)
+        inst = self._actor_instance
+        if inst is not None and self._actor_is_async:
+            m = getattr(inst, spec.get("method", ""), None)
+            if m is not None:
+                return self._run_actor_task_async(spec, m)
         rec = self._record(spec, "ACTOR_TASK")
         if not self._begin_cancellable(spec):
             self._store_cancelled(spec, rec)

@@ -234,6 +234,20 @@ class LocalBackend:
         # for cooperative mid-run interruption (cancellation.py).
         self._cancels = CancelRegistry(threading.Lock())
         self.node_id = "local"
+        # Shared asyncio loop for async actor methods, created lazily.
+        self._aio_loop_obj = None
+        self._aio_lock = threading.Lock()
+
+    def _aio_loop(self):
+        import asyncio
+
+        with self._aio_lock:
+            if self._aio_loop_obj is None:
+                loop = asyncio.new_event_loop()
+                threading.Thread(target=loop.run_forever,
+                                 daemon=True).start()
+                self._aio_loop_obj = loop
+        return self._aio_loop_obj
 
     # -- internal KV -------------------------------------------------------
 
@@ -835,6 +849,16 @@ class LocalBackend:
                 method = getattr(state.instance, method_name)
                 self._record_task_state(call_tid, "RUNNING")
                 result = method(*a, **kw)
+                import asyncio
+
+                if asyncio.iscoroutine(result):
+                    # Async actor method: run on the backend's shared event
+                    # loop so concurrent async calls interleave at await
+                    # points (reference async actors; the executor thread
+                    # blocks, so per-actor parallelism is still bounded by
+                    # max_concurrency — set it >1 for interleaving).
+                    result = asyncio.run_coroutine_threadsafe(
+                        result, self._aio_loop()).result()
                 self._store_returns(oids, result, num_returns)
                 self._record_task_state(call_tid, "FINISHED")
             except BaseException as e:  # noqa: BLE001
