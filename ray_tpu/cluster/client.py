@@ -70,6 +70,12 @@ class ClusterBackend:
         # JSON (reference packages once per job; we package once per
         # distinct env per driver — content re-hashed only on first use).
         self._rtenv_cache: dict[str, dict] = {}
+        # Function-table export memo: func -> (hash, closure_refs)
+        # (reference function_manager export-once semantics).
+        import weakref
+
+        self._fn_exports = weakref.WeakKeyDictionary()
+        self._fn_keys: set[str] = set()  # for close-time KV cleanup
         self._pins: dict[str, Any] = {}  # zero-copy views we hold alive
         # Set by the worker process: (on_block, on_unblock) callbacks that
         # tell the node agent to release/reacquire this task's resources
@@ -714,14 +720,20 @@ class ClusterBackend:
         refs = [self.make_ref(o) for o in oids]
         borrowed: list[str] = []
         args_blob = ser.dumps((args, kwargs), found_refs=borrowed)
-        # Refs captured in the function's closure are borrows too.
-        func_blob = ser.dumps(func, found_refs=borrowed)
+        # Function table (reference: function export to the GCS function
+        # table, _private/function_manager.py): the function serializes
+        # ONCE per driver, lands in the cluster KV under its content
+        # hash, and specs carry only the hash — workers cache the
+        # deserialized function. Refs captured in the closure are borrows
+        # of every task using the function.
+        fn_hash, closure_refs = self._export_function(func)
+        borrowed.extend(closure_refs)
         spec = {
             "task_id": task_id,
             "oids": oids,
             "num_returns": num_returns,
             "fname": name or getattr(func, "__name__", "task"),
-            "func": func_blob,
+            "func_hash": fn_hash,
             "args": args_blob,
             "borrowed": borrowed,
             "demand": demand_of(options, is_actor=False),
@@ -760,6 +772,37 @@ class ClusterBackend:
                         oid, TaskError(spec["fname"], str(e), repr(e)),
                         is_error=True)
         return refs
+
+    def _export_function(self, func) -> tuple[str, list]:
+        """(function_table_key, closure_ref_ids); exports to the KV on
+        first sight. Keys are namespaced per driver (``fn:<client_id>:
+        <hash>``) and deleted when the driver closes, so closure-heavy
+        drivers can't grow the head without bound — the reference's
+        function table is likewise scoped and cleaned per job. The memo
+        is weak-keyed so dynamically created lambdas don't accumulate;
+        unhashable callables just re-export."""
+        import hashlib
+
+        cached = None
+        try:
+            cached = self._fn_exports.get(func)
+        except TypeError:
+            pass
+        if cached is None:
+            closure_refs: list[str] = []
+            blob = ser.dumps(func, found_refs=closure_refs)
+            key = (f"fn:{self.client_id}:"
+                   f"{hashlib.sha1(blob).hexdigest()}")
+            # overwrite=False: first writer wins; same key = same bytes.
+            self.head.call("kv_put", key, blob, False)
+            with self._ref_lock:
+                self._fn_keys.add(key)
+            cached = (key, closure_refs)
+            try:
+                self._fn_exports[func] = cached
+            except TypeError:
+                pass
+        return cached
 
     def submit_cpp_task(
         self,
@@ -1166,6 +1209,15 @@ class ClusterBackend:
                 )
             except (ConnectionLost, OSError):
                 pass
+        # Function-table cleanup: this driver's exports are namespaced by
+        # client_id, so deleting them can't break other drivers.
+        with self._ref_lock:
+            fn_keys, self._fn_keys = self._fn_keys, set()
+        for key in fn_keys:
+            try:
+                self.head.call("kv_del", key)
+            except (ConnectionLost, OSError):
+                break  # head gone: its KV dies with it anyway
         with self._lock:
             clients = (
                 list(self._node_clients.values())
