@@ -11,6 +11,7 @@ from ray_tpu.parallel.mesh import (
     AXIS_ORDER,
     MeshConfig,
     auto_mesh_config,
+    build_hybrid_mesh,
     build_mesh,
     local_device_count,
 )
@@ -29,6 +30,7 @@ __all__ = [
     "AXIS_ORDER",
     "MeshConfig",
     "auto_mesh_config",
+    "build_hybrid_mesh",
     "build_mesh",
     "local_device_count",
     "DEFAULT_RULES",

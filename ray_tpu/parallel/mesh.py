@@ -107,6 +107,80 @@ def build_mesh(
     return mesh_devices
 
 
+def build_hybrid_mesh(
+    per_slice: MeshConfig | None = None,
+    *,
+    dcn_dp: int | None = None,
+    dcn_pp: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+    axis_types: AxisType = AxisType.Auto,
+) -> Mesh:
+    """Multi-slice mesh: DCN between slices, ICI within (SURVEY §5.8).
+
+    TPU pods beyond one slice have a two-tier network — fast ICI inside a
+    slice, slower data-center network (DCN) between slices. The scaling
+    recipe ("How to Scale Your Model"; jax ``mesh_utils.create_hybrid_
+    device_mesh`` shape) is: put only DCN-tolerant axes across slices —
+    pure data parallelism (``dcn_dp``: gradient all-reduce once per step)
+    and/or pipeline stages (``dcn_pp``: point-to-point activations) — and
+    keep tp/sp/fsdp collectives inside a slice.
+
+    Devices are grouped by ``slice_index``; on hosts without one (CPU
+    simulation, single slice) the device list is partitioned evenly into
+    ``dcn_dp * dcn_pp`` synthetic slices so the layout is testable
+    anywhere. ``per_slice`` shapes the ICI axes of one slice; the result
+    is a standard AXIS_ORDER mesh whose ``dp``/``pp`` sizes are the
+    DCN-times-ICI products.
+    """
+    import numpy as np
+
+    devices = list(devices) if devices is not None else jax.devices()
+    groups: dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0) or 0, []).append(d)
+    n_slices_wanted = (dcn_dp if dcn_dp is not None else
+                       max(1, len(groups) // dcn_pp)) * dcn_pp
+    if len(groups) == 1 and n_slices_wanted > 1:
+        devs = next(iter(groups.values()))
+        if len(devs) % n_slices_wanted:
+            raise ValueError(
+                f"{len(devs)} devices not divisible into "
+                f"{n_slices_wanted} synthetic slices")
+        per = len(devs) // n_slices_wanted
+        groups = {i: devs[i * per:(i + 1) * per]
+                  for i in range(n_slices_wanted)}
+    slices = [groups[k] for k in sorted(groups)]
+    num_slices = len(slices)
+    if len({len(s) for s in slices}) != 1:
+        raise ValueError("slices have unequal device counts")
+    if dcn_dp is None:
+        if num_slices % dcn_pp:
+            raise ValueError(f"{num_slices} slices not divisible by "
+                             f"dcn_pp={dcn_pp}")
+        dcn_dp = num_slices // dcn_pp
+    if dcn_dp * dcn_pp != num_slices:
+        raise ValueError(
+            f"dcn_dp({dcn_dp}) * dcn_pp({dcn_pp}) != slices({num_slices})")
+
+    cfg = per_slice or MeshConfig(fsdp=-1)
+    sizes = cfg.axis_sizes(len(slices[0]))
+    # [dcn_pp, dcn_dp, pp, dp, fsdp, ep, sp, tp] — each slice keeps its
+    # devices contiguous over the inner (ICI) dims.
+    stacked = np.stack([
+        np.array(s, dtype=object).reshape(
+            [sizes[a] for a in AXIS_ORDER])
+        for s in slices
+    ]).reshape(dcn_pp, dcn_dp, *[sizes[a] for a in AXIS_ORDER])
+    # Merge DCN dims into their ICI counterparts: pp-total outermost.
+    stacked = np.moveaxis(stacked, 2, 1)  # [dcn_pp, pp, dcn_dp, dp, ...]
+    final_shape = (
+        dcn_pp * sizes["pp"], dcn_dp * sizes["dp"], sizes["fsdp"],
+        sizes["ep"], sizes["sp"], sizes["tp"],
+    )
+    return Mesh(stacked.reshape(final_shape), AXIS_ORDER,
+                axis_types=(axis_types,) * len(AXIS_ORDER))
+
+
 def single_device_mesh() -> Mesh:
     """1-device mesh (all axes size 1) — lets model code be mesh-agnostic."""
     return build_mesh(MeshConfig(fsdp=1, devices=jax.devices()[:1]))
