@@ -130,5 +130,9 @@ class GcsClient:
     def ping(self) -> bool:
         return self._rpc.call("ping") == "pong"
 
+    def event_stats(self) -> dict:
+        """Head per-RPC-handler timing stats (event_stats.h analog)."""
+        return self._rpc.call("event_stats")
+
     def close(self) -> None:
         self._rpc.close()

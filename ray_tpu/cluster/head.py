@@ -1165,6 +1165,12 @@ class HeadServer:
     def rpc_ping(self):
         return "pong"
 
+    def rpc_event_stats(self):
+        """Per-RPC-handler timing stats (event_stats.h analog): the
+        control plane's own instrumentation, for finding hot/slow
+        handlers without external profilers."""
+        return self._server.handler_stats()
+
     def rpc_shutdown_cluster(self):
         with self._lock:
             nodes = [n for n in self._nodes.values() if n.alive]

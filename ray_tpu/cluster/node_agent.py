@@ -961,6 +961,10 @@ class NodeAgent:
             except Exception:
                 continue
 
+    def rpc_event_stats(self):
+        """Per-RPC-handler timing stats (event_stats.h analog)."""
+        return self._server.handler_stats()
+
     def rpc_ping(self):
         return "pong"
 

@@ -89,8 +89,37 @@ class RpcServer:
         self._stopped = threading.Event()
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
+        # Handler instrumentation (reference: the asio instrumented event
+        # loop's per-handler stats, src/ray/common/asio event_stats.h):
+        # per-method call count / cumulative / max seconds, cheap enough
+        # to stay always-on.
+        self._stats: dict[str, list] = {}  # method -> [count, total_s, max_s]
+        self._stats_lock = threading.Lock()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
+
+    def _record_stat(self, method: str, dt: float) -> None:
+        with self._stats_lock:
+            ent = self._stats.get(method)
+            if ent is None:
+                self._stats[method] = [1, dt, dt]
+            else:
+                ent[0] += 1
+                ent[1] += dt
+                if dt > ent[2]:
+                    ent[2] = dt
+
+    def handler_stats(self) -> dict:
+        """{method: {count, total_s, max_s, mean_ms}} snapshot."""
+        with self._stats_lock:
+            return {
+                m: {
+                    "count": c, "total_s": round(t, 6),
+                    "max_s": round(mx, 6),
+                    "mean_ms": round(1000.0 * t / c, 3) if c else 0.0,
+                }
+                for m, (c, t, mx) in self._stats.items()
+            }
 
     def _accept_loop(self):
         while not self._stopped.is_set():
@@ -140,13 +169,18 @@ class RpcServer:
                 return
             while True:
                 req = _recv_msg(conn)
+                t0 = time.perf_counter()
                 try:
                     fn = getattr(self._handler, "rpc_" + req["m"])
                     value = fn(*req.get("a", ()), **req.get("k", {}))
+                    self._record_stat(req["m"], time.perf_counter() - t0)
                     _send_msg(conn, {"ok": True, "v": value})
                 except ConnectionLost:
                     raise
                 except BaseException as e:  # noqa: BLE001 — shipped to caller
+                    # Raising handlers count too — they are exactly the
+                    # ones an operator reads event_stats to find.
+                    self._record_stat(req["m"], time.perf_counter() - t0)
                     _send_msg(
                         conn,
                         {"ok": False, "e": e, "tb": traceback.format_exc()},

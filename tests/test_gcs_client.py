@@ -63,3 +63,18 @@ def test_accessors_end_to_end(cluster):
     assert seen_dead
     assert isinstance(gcs.tasks.all(), list)
     gcs.close()
+
+
+def test_event_stats_instrumentation(cluster):
+    """The control plane instruments its own handlers
+    (asio event_stats.h analog): counts and timings per RPC method."""
+    gcs = GcsClient(cluster.address)
+    gcs.ping()
+    gcs.nodes.all()
+    stats = gcs.event_stats()
+    assert stats["ping"]["count"] >= 1
+    assert stats["nodes"]["count"] >= 1
+    assert stats["nodes"]["mean_ms"] >= 0.0
+    assert stats["nodes"]["max_s"] >= stats["nodes"]["total_s"] / (
+        stats["nodes"]["count"] + 1)
+    gcs.close()
