@@ -13,6 +13,7 @@ from ray_tpu.data.dataset import (
     ActorPoolStrategy,
     Dataset,
     from_items,
+    from_arrow,
     from_numpy,
     from_pandas,
     range,
@@ -31,6 +32,7 @@ __all__ = [
     "Dataset",
     "DatasetPipeline",
     "from_items",
+    "from_arrow",
     "from_numpy",
     "from_pandas",
     "range",

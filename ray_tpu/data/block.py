@@ -1,9 +1,11 @@
 """Blocks: the unit of distributed data (reference: ``python/ray/data/block.py``).
 
-Two physical layouts, mirroring the reference's simple vs Arrow blocks:
+Three physical layouts, mirroring the reference's simple vs Arrow blocks:
   * list block — ``list`` of rows (arbitrary Python objects / dicts);
-  * columnar block — ``dict[str, np.ndarray]`` (the Arrow-table analog;
-    zero-copy friendly through the shm object store's pickle-5 buffers).
+  * columnar block — ``dict[str, np.ndarray]`` (tensor-friendly);
+  * arrow block — ``pyarrow.Table`` (the reference's default block type;
+    zero-copy through the shm object store — Arrow buffers ride the
+    pickle-5 out-of-band path like numpy arrays do).
 
 ``BlockAccessor``-style helpers are plain functions here.
 """
@@ -14,7 +16,12 @@ from typing import Any, Iterable, List, Union
 
 import numpy as np
 
-Block = Union[List[Any], dict]
+Block = Union[List[Any], dict, "pyarrow.Table"]
+
+
+def is_arrow(block: Block) -> bool:
+    # Cheap check without importing pyarrow for non-arrow blocks.
+    return type(block).__module__.startswith("pyarrow")
 
 
 def is_columnar(block: Block) -> bool:
@@ -22,6 +29,8 @@ def is_columnar(block: Block) -> bool:
 
 
 def num_rows(block: Block) -> int:
+    if is_arrow(block):
+        return block.num_rows
     if is_columnar(block):
         if not block:
             return 0
@@ -30,6 +39,8 @@ def num_rows(block: Block) -> int:
 
 
 def slice_block(block: Block, start: int, end: int) -> Block:
+    if is_arrow(block):
+        return block.slice(start, end - start)
     if is_columnar(block):
         return {k: v[start:end] for k, v in block.items()}
     return block[start:end]
@@ -39,6 +50,10 @@ def concat_blocks(blocks: List[Block]) -> Block:
     blocks = [b for b in blocks if num_rows(b) > 0]
     if not blocks:
         return []
+    if is_arrow(blocks[0]):
+        import pyarrow as pa
+
+        return pa.concat_tables(blocks)
     if is_columnar(blocks[0]):
         keys = blocks[0].keys()
         return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
@@ -49,7 +64,9 @@ def concat_blocks(blocks: List[Block]) -> Block:
 
 
 def rows_of(block: Block) -> Iterable[Any]:
-    if is_columnar(block):
+    if is_arrow(block):
+        yield from block.to_pylist()
+    elif is_columnar(block):
         keys = list(block.keys())
         for i in range(num_rows(block)):
             yield {k: block[k][i] for k in keys}
@@ -59,6 +76,10 @@ def rows_of(block: Block) -> Iterable[Any]:
 
 def from_rows(rows: List[Any], like: Block) -> Block:
     """Rebuild a block from rows, keeping the input layout when possible."""
+    if is_arrow(like) and rows and isinstance(rows[0], dict):
+        import pyarrow as pa
+
+        return pa.Table.from_pylist(rows)
     if is_columnar(like) and rows and isinstance(rows[0], dict):
         keys = rows[0].keys()
         return {k: np.asarray([r[k] for r in rows]) for k in keys}
@@ -71,6 +92,11 @@ def to_batch(block: Block, batch_format: str):
     if batch_format in ("default", "native"):
         return block
     if batch_format == "numpy":
+        if is_arrow(block):
+            return {
+                name: block.column(name).to_numpy(zero_copy_only=False)
+                for name in block.column_names
+            }
         if is_columnar(block):
             return block
         if block and isinstance(block[0], dict):
@@ -80,18 +106,33 @@ def to_batch(block: Block, batch_format: str):
     if batch_format == "pandas":
         import pandas as pd
 
+        if is_arrow(block):
+            return block.to_pandas()
         if is_columnar(block):
             return pd.DataFrame({k: list(v) for k, v in block.items()})
         if block and isinstance(block[0], dict):
             return pd.DataFrame(block)
         return pd.DataFrame({"value": block})
+    if batch_format == "pyarrow":
+        import pyarrow as pa
+
+        if is_arrow(block):
+            return block
+        if is_columnar(block):
+            return pa.table({k: np.asarray(v) for k, v in block.items()})
+        if block and isinstance(block[0], dict):
+            return pa.Table.from_pylist(list(block))
+        return pa.table({"value": list(block)})
     raise ValueError(f"unknown batch_format {batch_format!r}")
 
 
 def from_batch(batch) -> Block:
-    """Normalize a user-returned batch back into a block."""
+    """Normalize a user-returned batch back into a block. Arrow tables
+    stay Arrow (the block type is preserved end to end)."""
     import pandas as pd
 
+    if is_arrow(batch):
+        return batch
     if isinstance(batch, pd.DataFrame):
         return {k: batch[k].to_numpy() for k in batch.columns}
     if isinstance(batch, dict):
@@ -104,6 +145,8 @@ def from_batch(batch) -> Block:
 
 
 def schema_of(block: Block):
+    if is_arrow(block):
+        return block.schema
     if is_columnar(block):
         return {k: v.dtype for k, v in block.items()}
     if block and isinstance(block[0], dict):

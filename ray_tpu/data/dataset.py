@@ -536,6 +536,16 @@ class Dataset:
         dfs = [B.to_batch(ray_tpu.get(r), "pandas") for r in self._execute()]
         return pd.concat(dfs, ignore_index=True) if dfs else pd.DataFrame()
 
+    def to_arrow(self):
+        """Materialize as one ``pyarrow.Table`` (reference
+        ``to_arrow_refs`` flattened — the driver-side convenience form)."""
+        import pyarrow as pa
+
+        tables = [B.to_batch(ray_tpu.get(r), "pyarrow")
+                  for r in self._execute()]
+        tables = [t for t in tables if t.num_rows]
+        return pa.concat_tables(tables) if tables else pa.table({})
+
     def __repr__(self) -> str:
         return f"Dataset(num_blocks={self.num_blocks}, stages={len(self._stages)})"
 
@@ -658,6 +668,14 @@ def from_numpy(arr: np.ndarray, *, parallelism: int = 8) -> Dataset:
     )
 
 
+def from_arrow(tables) -> Dataset:
+    """One or more ``pyarrow.Table``s -> Dataset of Arrow blocks
+    (reference ``from_arrow``)."""
+    if not isinstance(tables, (list, tuple)):
+        tables = [tables]
+    return Dataset([ray_tpu.put(t) for t in tables])
+
+
 def from_pandas(df, *, parallelism: int = 8) -> Dataset:
     n = max(1, min(parallelism, len(df)))
     cuts = [round(i * len(df) / n) for i in _py_range(n + 1)]
@@ -712,17 +730,18 @@ def _rg_splits(files: list, parallelism: int) -> list:
     return tasks
 
 
-def read_parquet(paths, *, parallelism: int = 8) -> Dataset:
+def read_parquet(paths, *, parallelism: int = 8,
+                 columns: Optional[list] = None) -> Dataset:
+    """Parquet -> ARROW blocks (the reference's default block type):
+    row-group-split read tasks each return a ``pyarrow.Table`` that
+    travels zero-copy through the object store."""
     files = _expand_paths(paths)
 
     def load(path, row_groups):
         import pyarrow.parquet as pq
 
-        t = pq.ParquetFile(path).read_row_groups(row_groups)
-        return {
-            name: t.column(name).to_numpy(zero_copy_only=False)
-            for name in t.column_names
-        }
+        return pq.ParquetFile(path).read_row_groups(
+            row_groups, columns=columns)
 
     load_task = ray_tpu.remote(load)
     return Dataset([

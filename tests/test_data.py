@@ -236,3 +236,43 @@ def test_train_integration_get_dataset_shard():
         datasets={"train": ds},
     ).fit()
     assert result.metrics["n"] == 8
+
+
+def test_arrow_blocks_end_to_end(tmp_path):
+    """Arrow tables as first-class blocks (the reference's default block
+    type): parquet read keeps tables, transformations preserve
+    arrow-ness, batch formats interconvert, zero-copy store round trip."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(
+        pa.table({"x": list(range(100)), "y": [float(i) for i in range(100)]}),
+        path, row_group_size=25)
+
+    ds = rtd.read_parquet(path, parallelism=4)
+    assert ds.num_blocks >= 2  # row-group splits
+    first = ray_tpu.get(ds._execute()[0])
+    assert isinstance(first, pa.Table)
+
+    # map_batches in pyarrow format, returning a Table, stays arrow
+    out = ds.map_batches(
+        lambda t: t.append_column("z", pa.array([v * 2 for v in t["x"].to_pylist()])),
+        batch_format="pyarrow",
+    )
+    blk = ray_tpu.get(out._execute()[0])
+    assert isinstance(blk, pa.Table) and "z" in blk.column_names
+
+    tbl = out.to_arrow()
+    assert tbl.num_rows == 100
+    assert sorted(tbl["z"].to_pylist()) == [2 * i for i in range(100)]
+
+    # row ops + sort on arrow blocks
+    small = out.filter(lambda r: r["x"] < 10).sort(key="x", descending=True)
+    rows = small.take_all()
+    assert [r["x"] for r in rows] == list(range(9, -1, -1))
+
+    # from_arrow / iter_batches numpy view
+    ds2 = rtd.from_arrow(pa.table({"a": [1, 2, 3]}))
+    batches = list(ds2.iter_batches(batch_size=3, batch_format="numpy"))
+    assert list(batches[0]["a"]) == [1, 2, 3]
