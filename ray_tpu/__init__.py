@@ -26,6 +26,7 @@ from ray_tpu.version import __version__
 
 from ray_tpu.core.object_ref import (
     ActorError,
+    ObjectRefGenerator,
     GetTimeoutError,
     ObjectLostError,
     OutOfMemoryError,
@@ -54,6 +55,7 @@ __all__ = [
     "__version__",
     "cross_language",
     "ActorError",
+    "ObjectRefGenerator",
     "GetTimeoutError",
     "ObjectLostError",
     "ObjectRef",

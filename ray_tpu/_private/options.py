@@ -9,7 +9,9 @@ _COMMON = {
     "num_tpus": (int, float, type(None)),
     "num_gpus": (int, float, type(None)),
     "resources": (dict, type(None)),
-    "num_returns": (int,),
+    # int, or "streaming"/"dynamic" for generator tasks (both reference
+    # spellings accepted; normalized to "streaming" at validation).
+    "num_returns": (int, str),
     "max_retries": (int,),
     "retry_exceptions": (bool, tuple),
     "name": (str, type(None)),
@@ -47,11 +49,23 @@ def _validate(options: dict[str, Any], allowed: dict[str, tuple], kind: str):
 
 
 def validate_task_options(options: dict[str, Any]) -> dict[str, Any]:
-    return _validate(options, {**_COMMON, **_TASK_ONLY}, "task")
+    out = _validate(options, {**_COMMON, **_TASK_ONLY}, "task")
+    nr = out.get("num_returns")
+    if isinstance(nr, str):
+        if nr not in ("streaming", "dynamic"):
+            raise ValueError(
+                f'num_returns must be an int or "streaming", got {nr!r}')
+        out["num_returns"] = "streaming"
+    return out
 
 
 def validate_actor_options(options: dict[str, Any]) -> dict[str, Any]:
     out = _validate(options, {**_COMMON, **_ACTOR_ONLY}, "actor")
+    if isinstance(out.get("num_returns"), str):
+        raise ValueError(
+            "actors do not support streaming returns; num_returns must "
+            "be an int for actor options"
+        )
     groups = out.get("concurrency_groups")
     if groups:
         for gname, n in groups.items():

@@ -38,6 +38,11 @@ class ActorMethod:
         """Per-call overrides (reference ``@ray.method`` options):
         ``concurrency_group`` routes the call to one of the actor's
         declared executor groups instead of the default queue."""
+        if not isinstance(num_returns, int) or isinstance(num_returns, bool):
+            raise ValueError(
+                "actor methods do not support streaming returns; "
+                f"num_returns must be an int, got {num_returns!r}"
+            )
         return ActorMethod(self._handle, self._method_name, num_returns,
                            concurrency_group)
 

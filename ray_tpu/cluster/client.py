@@ -432,6 +432,17 @@ class ClusterBackend:
         """Lineage reconstruction: resubmit the creating task if its node
         died before the object appeared. Returns True if resubmitted."""
         spec = self._lineage.get(oid)
+        if spec is None:
+            # Streaming indices > 0 are synthesized by the generator and
+            # never entered the lineage table themselves — recover
+            # through the stream's index-0 spec (whole-task re-run; the
+            # re-execution re-stores every index).
+            tid, idx = ids.task_of_object(oid)
+            if idx > 0:
+                root = self._lineage.get(ids.object_id_for(tid, 0))
+                if root is not None and \
+                        root.get("num_returns") == "streaming":
+                    spec = root
         if spec is None or spec.get("retries_left", 0) <= 0:
             return False
         assigned = spec.get("assigned_node")
@@ -716,7 +727,10 @@ class ClusterBackend:
         if max_retries is None:
             max_retries = config.task_default_max_retries
         task_id = ids.new_task_id()
-        oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
+        # Streaming generators: one tracked oid (index 0 = first yield);
+        # later indices are synthesized by the ObjectRefGenerator.
+        n_oids = 1 if num_returns == "streaming" else num_returns
+        oids = [ids.object_id_for(task_id, i) for i in range(n_oids)]
         refs = [self.make_ref(o) for o in oids]
         borrowed: list[str] = []
         args_blob = ser.dumps((args, kwargs), found_refs=borrowed)
@@ -772,6 +786,32 @@ class ClusterBackend:
                         oid, TaskError(spec["fname"], str(e), repr(e)),
                         is_error=True)
         return refs
+
+    def release_stream(self, task_id: str, from_index: int) -> None:
+        """Drop an abandoned stream's unconsumed items (ObjectRefGenerator
+        finalizer): cooperatively cancel a still-running producer —
+        bypassing cancel()'s finished-task guard, which a stream with one
+        yielded item always trips — then have the head free the tail,
+        present and future (stream_release)."""
+        spec = self._lineage.get(ids.object_id_for(task_id, 0))
+        if spec is not None:
+            spec["retries_left"] = 0
+            spec["cancelled"] = True
+            assigned = spec.get("assigned_node")
+            if assigned is not None:
+                try:
+                    nodes = {n["NodeID"]: n
+                             for n in self.head.call("nodes")}
+                    node = nodes.get(assigned)
+                    if node is not None and node["Alive"]:
+                        self._node_client(node["Address"]).call(
+                            "cancel_task", spec["task_id"], False)
+                except (ConnectionLost, OSError):
+                    pass
+        try:
+            self.head.call("stream_release", task_id, from_index)
+        except (ConnectionLost, OSError):
+            pass
 
     def _export_function(self, func) -> tuple[str, list]:
         """(function_table_key, closure_ref_ids); exports to the KV on

@@ -138,6 +138,10 @@ class HeadServer:
         self._inflight_by_task: dict[str, tuple] = {}  # task_id -> (node, oids)
         self._contained: dict[str, list] = {}  # container oid -> inner oids
         self._freed: dict[str, bool] = {}  # tombstones (bounded)
+        # Abandoned streaming tasks: task_id -> first unconsumed index.
+        # Items at indices >= that are freed on sight — including ones
+        # the (possibly still running) producer stores AFTER the release.
+        self._released_streams: dict[str, int] = {}
         self._free_queue: list[tuple] = []  # (address, oid) delete fanout
         self._free_cv = threading.Condition(self._lock)
         # Unsatisfiable demand log: the autoscaler's input signal
@@ -535,10 +539,48 @@ class HeadServer:
 
     # -- object directory -------------------------------------------------
 
+    def rpc_stream_release(self, task_id: str, from_index: int):
+        """Abandoned ObjectRefGenerator: free the stream's unconsumed
+        items — present AND future (a still-running producer's later
+        add_locations are deleted on sight)."""
+        with self._lock:
+            self._released_streams[task_id] = int(from_index)
+            if len(self._released_streams) > 100_000:
+                for k in list(self._released_streams)[:50_000]:
+                    del self._released_streams[k]
+            doomed = [
+                oid for oid in self._objects
+                if oid[:32] == task_id
+                and int(oid[32:], 16) >= from_index
+            ]
+        for oid in doomed:
+            with self._lock:
+                self._refs.pop(oid, None)
+                self._freed[oid] = True
+                entry = self._objects.pop(oid, None)
+                if entry is not None:
+                    for nid in entry["nodes"]:
+                        node = self._nodes.get(nid)
+                        if node is not None and node.alive:
+                            self._free_queue.append((node, oid))
+                    self._free_cv.notify_all()
+        return len(doomed)
+
+    def _stream_released(self, oid: str) -> bool:
+        """Locked-context check: is this object part of a released
+        stream's unconsumed tail?"""
+        idx = self._released_streams.get(oid[:32])
+        if idx is None or len(oid) < 40:
+            return False
+        try:
+            return int(oid[32:], 16) >= idx
+        except ValueError:
+            return False
+
     def rpc_add_location(self, oid, node_id, is_error=False, size=0,
                          contained=None):
         with self._lock:
-            if oid in self._freed:
+            if oid in self._freed or self._stream_released(oid):
                 # Freed while the task computing it was still running:
                 # delete the fresh copy straight away.
                 node = self._nodes.get(node_id)

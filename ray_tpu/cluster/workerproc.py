@@ -306,6 +306,36 @@ class WorkerHandler:
 
     def _store_result(self, spec, result):
         oids, num_returns = spec["oids"], spec.get("num_returns", 1)
+        if num_returns == "streaming":
+            # Generator protocol: yield i -> return-index i; a _StreamEnd
+            # after the last item marks the length. A mid-stream failure
+            # stores the error AT the failing index (the consumer raises
+            # there) — the generic oids error path is disabled since
+            # index 0 may already hold a yielded item.
+            from ray_tpu.core.ids import task_of_object
+            from ray_tpu.core.object_ref import _StreamEnd
+
+            task_id = task_of_object(oids[0])[0]
+            from ray_tpu.core import ids as _ids
+
+            spec["oids"] = []
+            i = 0
+            try:
+                for item in result:
+                    self.backend.put_with_id(
+                        _ids.object_id_for(task_id, i), item)
+                    i += 1
+                self.backend.put_with_id(
+                    _ids.object_id_for(task_id, i), _StreamEnd())
+            except BaseException as e:  # noqa: BLE001
+                self.backend.put_with_id(
+                    _ids.object_id_for(task_id, i),
+                    TaskError(spec.get("fname", "task"),
+                              traceback.format_exc(), repr(e)),
+                    is_error=True,
+                )
+                raise
+            return
         if num_returns == 1:
             values = [result]
         else:

@@ -637,7 +637,33 @@ class LocalBackend:
         }
         return args, kwargs
 
-    def _store_returns(self, oids: list[str], result, num_returns: int):
+    def _store_returns(self, oids: list[str], result, num_returns):
+        if num_returns == "streaming":
+            # Generator protocol (see workerproc._store_result): items at
+            # successive return indices, then a _StreamEnd terminator;
+            # a mid-stream error lands AT the failing index. Returns
+            # False on failure (contained here — a partially consumed
+            # stream must not retry, and index 0 may already hold a
+            # yielded item the generic error path would clobber).
+            from ray_tpu.core.object_ref import _StreamEnd
+
+            task_id = ids.task_of_object(oids[0])[0]
+            i = 0
+            try:
+                for item in result:
+                    self._entry(ids.object_id_for(task_id, i)).set(item)
+                    i += 1
+                self._entry(
+                    ids.object_id_for(task_id, i)).set(_StreamEnd())
+            except BaseException as e:  # noqa: BLE001
+                self._entry(ids.object_id_for(task_id, i)).set_error(
+                    TaskError("streaming_task", traceback.format_exc(),
+                              repr(e)))
+                self._record_task_state(task_id, "FAILED", repr(e))
+                self._gc_unreferenced(oids)
+                return False
+            self._gc_unreferenced(oids)
+            return True
         if num_returns == 1:
             self._entry(oids[0]).set(result)
         else:
@@ -650,6 +676,21 @@ class LocalBackend:
             for oid, v in zip(oids, vals):
                 self._entry(oid).set(v)
         self._gc_unreferenced(oids)
+
+    def release_stream(self, task_id: str, from_index: int) -> None:
+        """Drop an abandoned stream's unconsumed items (ObjectRefGenerator
+        finalizer). Cooperatively cancels a still-running producer, then
+        deletes produced-but-unread entries from ``from_index`` on."""
+        self._cancels.cancel(task_id, TaskCancelledError)
+        i = from_index
+        while True:
+            oid = ids.object_id_for(task_id, i)
+            with self._objects_lock:
+                e = self._objects.get(oid)
+                if e is None or not e.event.is_set():
+                    break
+                del self._objects[oid]
+            i += 1
 
     def _store_error(self, oids: list[str], err: BaseException):
         for oid in oids:
@@ -675,7 +716,8 @@ class LocalBackend:
         **_options,
     ) -> list[ObjectRef]:
         task_id = ids.new_task_id()
-        oids = [ids.object_id_for(task_id, i) for i in range(num_returns)]
+        n_oids = 1 if num_returns == "streaming" else num_returns
+        oids = [ids.object_id_for(task_id, i) for i in range(n_oids)]
         refs = [self.make_ref(o) for o in oids]
         fname = name or getattr(func, "__name__", "task")
         self._record_task(task_id, fname)
@@ -708,11 +750,22 @@ class LocalBackend:
                         self._record_task_state(task_id, "RUNNING")
                         try:
                             result = func(*a, **kw)
+                            if num_returns == "streaming":
+                                # The generator BODY runs during
+                                # iteration — keep the lease held for it
+                                # (parity with the cluster worker, which
+                                # holds resources until task_done).
+                                ok = self._store_returns(
+                                    oids, result, num_returns)
                         finally:
                             self._current_lease.lease = None
                             lease.release()
                             if plan["capture"]:
                                 self._current_pg.info = None
+                        if num_returns == "streaming":
+                            if ok:
+                                self._record_task_state(task_id, "FINISHED")
+                            return  # FAILED already recorded inside
                         self._store_returns(oids, result, num_returns)
                         self._record_task_state(task_id, "FINISHED")
                         return

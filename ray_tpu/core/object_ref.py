@@ -149,3 +149,69 @@ class GetTimeoutError(TimeoutError):
 
 class ObjectLostError(Exception):
     """Object is gone and cannot be recovered (cf. ray.exceptions.ObjectLostError)."""
+
+
+class _StreamEnd:
+    """Terminator a streaming task stores after its last yielded item
+    (``num_returns="streaming"`` protocol: item i lives at return-index
+    i of the task; the first index holding a ``_StreamEnd`` marks the
+    stream's length)."""
+
+    def __reduce__(self):
+        return (_StreamEnd, ())
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's output refs (reference
+    ``ObjectRefGenerator`` / ``num_returns="streaming"``): each
+    ``__next__`` blocks until the task yields its next item, then
+    returns that item's ObjectRef (the value is already local, so the
+    caller's ``get`` is cheap). Iteration ends at the task's return; a
+    mid-stream task error raises at the failing index's ``get``.
+
+    Lineage note: only the stream's index-0 object is tracked for
+    re-execution; losing a later chunk after the driver dropped its ref
+    is not recoverable (v1 limitation)."""
+
+    def __init__(self, task_id: str, first_ref: "ObjectRef | None" = None):
+        self._task_id = task_id
+        self._i = 0
+        self._first = first_ref
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def _ref_at(self, i: int) -> "ObjectRef":
+        from ray_tpu._private import worker as _worker
+        from ray_tpu.core import ids
+
+        if i == 0 and self._first is not None:
+            return self._first
+        return _worker.backend().make_ref(
+            ids.object_id_for(self._task_id, i))
+
+    def __next__(self) -> "ObjectRef":
+        from ray_tpu._private import worker as _worker
+
+        ref = self._ref_at(self._i)
+        value = _worker.backend().get([ref])[0]  # raises task errors
+        if isinstance(value, _StreamEnd):
+            raise StopIteration
+        self._i += 1
+        return ref
+
+    def __del__(self):
+        # Abandoned stream: release unconsumed tail items (and ask the
+        # producer to stop) — otherwise they sit in the store with no
+        # holder until process exit. Best-effort: at interpreter
+        # shutdown the backend may already be gone.
+        try:
+            from ray_tpu._private import worker as _worker
+
+            if _worker.is_initialized():
+                _worker.backend().release_stream(self._task_id, self._i)
+        except Exception:
+            pass
+
+    def __repr__(self) -> str:
+        return f"ObjectRefGenerator(task={self._task_id[:12]}…, next={self._i})"

@@ -32,7 +32,14 @@ class RemoteFunction:
         refs = _worker.backend().submit_task(
             self._func, args, kwargs, **options
         )
-        return refs[0] if options.get("num_returns", 1) == 1 else refs
+        num_returns = options.get("num_returns", 1)
+        if num_returns == "streaming":
+            from ray_tpu.core.ids import task_of_object
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(task_of_object(refs[0].id)[0],
+                                      first_ref=refs[0])
+        return refs[0] if num_returns == 1 else refs
 
     @property
     def func(self) -> Callable:

@@ -1,0 +1,134 @@
+"""Streaming generator tasks (reference ``num_returns="streaming"`` /
+ObjectRefGenerator): a task yields values that become objects one by
+one; the consumer iterates refs as they are produced."""
+
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster import Cluster
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_streaming_basic(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    out = [ray_tpu.get(ref, timeout=30) for ref in gen.remote(5)]
+    assert out == [0, 1, 4, 9, 16]
+
+
+def test_streaming_is_incremental(cluster):
+    """The first item is consumable long before the task finishes."""
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        yield "first"
+        time.sleep(3.0)
+        yield "second"
+
+    it = iter(slow_gen.remote())
+    t0 = time.time()
+    first = ray_tpu.get(next(it), timeout=30)
+    assert first == "first"
+    assert time.time() - t0 < 2.0  # didn't wait for the whole task
+    assert ray_tpu.get(next(it), timeout=30) == "second"
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_streaming_midstream_error(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def bad_gen():
+        yield 1
+        yield 2
+        raise RuntimeError("stream-boom")
+
+    it = iter(bad_gen.remote())
+    assert ray_tpu.get(next(it), timeout=30) == 1
+    assert ray_tpu.get(next(it), timeout=30) == 2
+    with pytest.raises(ray_tpu.TaskError, match="stream-boom"):
+        next(it)
+
+
+def test_streaming_dynamic_alias_and_local_backend():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(num_returns="dynamic")  # reference's older spelling
+        def gen():
+            yield "a"
+            yield "b"
+
+        assert [ray_tpu.get(r, timeout=30) for r in gen.remote()] == \
+            ["a", "b"]
+
+        @ray_tpu.remote(num_returns="streaming")
+        def boom():
+            yield 1
+            raise ValueError("local-boom")
+
+        it = iter(boom.remote())
+        assert ray_tpu.get(next(it), timeout=30) == 1
+        with pytest.raises(ray_tpu.TaskError, match="local-boom"):
+            next(it)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_abandoned_stream_releases_tail_and_stops_producer(cluster):
+    """Dropping an ObjectRefGenerator frees the unconsumed tail (present
+    and future items) and cancels the still-running producer."""
+    import gc
+
+    from ray_tpu.cluster.gcs_client import GcsClient
+    from ray_tpu.core.ids import object_id_for
+
+    @ray_tpu.remote(num_returns="streaming")
+    def endless():
+        i = 0
+        while True:
+            yield i
+            i += 1
+            time.sleep(0.02)
+
+    it = iter(endless.remote())
+    assert ray_tpu.get(next(it), timeout=30) == 0
+    assert ray_tpu.get(next(it), timeout=30) == 1
+    tid = it._task_id
+    del it
+    gc.collect()
+
+    gcs = GcsClient(cluster.address)
+    deadline = time.monotonic() + 30
+    gone = False
+    while time.monotonic() < deadline and not gone:
+        # Index 3 was either produced-and-freed or never stored; in both
+        # end states its location must become (and stay) empty while the
+        # producer stops minting new ones.
+        loc = gcs.objects.locations(object_id_for(tid, 3))
+        gone = loc is None or not loc["nodes"]
+        time.sleep(0.2)
+    assert gone
+    # Producer stopped: no NEW indices appear after a grace period.
+    time.sleep(1.0)
+    high = gcs.objects.locations(object_id_for(tid, 500))
+    assert high is None or not high["nodes"]
+    gcs.close()
