@@ -1,0 +1,141 @@
+"""Cluster launcher: YAML config -> running cluster (``ray up``/``down``).
+
+Reference parity: ``python/ray/autoscaler`` commands + ``ray-schema.json``
+— a YAML file declares the cluster (provider, node types with resources
+and min/max workers, head node type); ``create_or_update_cluster`` brings
+it up and attaches a ``StandardAutoscaler``; ``teardown_cluster`` tears
+it down. Cloud providers plug in through ``register_node_provider`` (the
+reference's aws/gcp/azure modules resolve the same way); the built-in
+``"local"`` provider launches real head/agent processes on this machine
+(fake_multi_node parity), which is also the TPU-pod dev story: one agent
+per host shape.
+
+    cluster_name: demo
+    max_workers: 4
+    provider: {type: local}
+    head_node_type: head
+    available_node_types:
+      head:    {num_cpus: 4, min_workers: 0}
+      worker:  {num_cpus: 2, resources: {TPU: 4}, min_workers: 1,
+               max_workers: 3}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.autoscaler import LocalNodeProvider, NodeProvider, StandardAutoscaler
+
+_PROVIDERS: Dict[str, Callable[..., NodeProvider]] = {}
+
+
+def register_node_provider(type_name: str, factory) -> None:
+    """Plugin registry (reference ``_get_node_provider`` import table)."""
+    _PROVIDERS[type_name] = factory
+
+
+def _provider_for(config: dict, cluster) -> NodeProvider:
+    ptype = (config.get("provider") or {}).get("type", "local")
+    if ptype == "local":
+        return LocalNodeProvider(cluster)
+    factory = _PROVIDERS.get(ptype)
+    if factory is None:
+        raise ValueError(
+            f"unknown provider type {ptype!r}; registered: "
+            f"{sorted(_PROVIDERS) + ['local']}"
+        )
+    return factory(config["provider"], cluster)
+
+
+def load_cluster_config(path_or_dict) -> dict:
+    if isinstance(path_or_dict, dict):
+        config = dict(path_or_dict)
+    else:
+        import yaml
+
+        with open(path_or_dict) as f:
+            config = yaml.safe_load(f)
+    config.setdefault("cluster_name", "default")
+    config.setdefault("max_workers", 8)
+    types = config.get("available_node_types")
+    if not types:
+        raise ValueError("config needs available_node_types")
+    head_type = config.get("head_node_type")
+    if head_type not in types:
+        raise ValueError(f"head_node_type {head_type!r} not in "
+                         f"available_node_types {sorted(types)}")
+    return config
+
+
+class ClusterHandle:
+    """What ``create_or_update_cluster`` returns: address + teardown."""
+
+    def __init__(self, config: dict, cluster, provider, autoscaler):
+        self.config = config
+        self.cluster = cluster
+        self.provider = provider
+        self.autoscaler = autoscaler
+
+    @property
+    def address(self) -> str:
+        return self.cluster.address
+
+    def teardown(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        self.cluster.shutdown()
+
+
+def create_or_update_cluster(
+    config_path_or_dict,
+    *,
+    start_autoscaler: bool = True,
+) -> ClusterHandle:
+    """``ray up`` analog: start the head (head node type's shape), launch
+    every node type's ``min_workers``, attach the autoscaler for demand
+    beyond that."""
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    config = load_cluster_config(config_path_or_dict)
+    types = config["available_node_types"]
+    head_cfg = types[config["head_node_type"]]
+    cluster = Cluster()
+    cluster.add_node(
+        num_cpus=head_cfg.get("num_cpus"),
+        resources=head_cfg.get("resources"),
+    )
+    provider = _provider_for(config, cluster)
+    for type_name, tcfg in types.items():
+        extra = int(tcfg.get("min_workers", 0) or 0)
+        for _ in range(extra):
+            provider.create_node(type_name, tcfg)
+    cluster.wait_for_nodes()
+
+    autoscaler = None
+    if start_autoscaler:
+        node_types = {
+            name: {
+                "num_cpus": tcfg.get("num_cpus"),
+                "resources": tcfg.get("resources"),
+            }
+            for name, tcfg in types.items()
+            if name != config["head_node_type"]
+        } or {
+            config["head_node_type"]: {
+                "num_cpus": head_cfg.get("num_cpus"),
+                "resources": head_cfg.get("resources"),
+            }
+        }
+        autoscaler = StandardAutoscaler(
+            cluster.address, provider,
+            node_types=node_types,
+            max_workers=int(config["max_workers"]),
+            idle_timeout_s=float(config.get("idle_timeout_minutes", 1)) * 60,
+        )
+        autoscaler.start()  # spawns its own reconcile-loop daemon thread
+    return ClusterHandle(config, cluster, provider, autoscaler)
+
+
+def teardown_cluster(handle: ClusterHandle) -> None:
+    """``ray down`` analog."""
+    handle.teardown()

@@ -137,6 +137,24 @@ def cmd_client_server(args):
         srv.shutdown()
 
 
+def cmd_up(args):
+    """Foreground cluster from YAML; Ctrl-C tears it down (``ray up``)."""
+    import signal
+    import threading as _threading
+
+    from ray_tpu.autoscaler.launcher import create_or_update_cluster
+
+    handle = create_or_update_cluster(args.config)
+    print(f"cluster '{handle.config['cluster_name']}' up at "
+          f"{handle.address} — Ctrl-C to tear down")
+    done = _threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: done.set())
+    signal.signal(signal.SIGTERM, lambda *a: done.set())
+    done.wait()
+    print("tearing down…")
+    handle.teardown()
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray-tpu")
     parser.add_argument("--address", default=None,
@@ -170,6 +188,11 @@ def main(argv=None):
     p.add_argument("--wait", action="store_true")
     p.add_argument("entrypoint", nargs=argparse.REMAINDER)
     p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser(
+        "up", help="launch a cluster from a YAML config (ray up analog)")
+    p.add_argument("config", help="cluster YAML path")
+    p.set_defaults(fn=cmd_up)
 
     p = sub.add_parser("dashboard", help="serve the REST dashboard")
     p.add_argument("--host", default="127.0.0.1")

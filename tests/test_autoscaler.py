@@ -122,3 +122,83 @@ def test_scale_down_idle_nodes():
         autoscaler.stop()
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_cluster_launcher_from_yaml(tmp_path):
+    """YAML config -> running cluster (ray up/down analog): head shape,
+    min_workers per node type, provider registry, teardown."""
+    import yaml
+
+    from ray_tpu.autoscaler import launcher
+
+    config = {
+        "cluster_name": "yaml-demo",
+        "max_workers": 4,
+        "provider": {"type": "local"},
+        "head_node_type": "head",
+        "available_node_types": {
+            "head": {"num_cpus": 2, "min_workers": 0},
+            "tpu_worker": {"num_cpus": 1, "resources": {"TPU": 4},
+                           "min_workers": 1},
+        },
+    }
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(config))
+
+    ray_tpu.shutdown()
+    handle = launcher.create_or_update_cluster(str(path),
+                                               start_autoscaler=False)
+    try:
+        ray_tpu.init(address=handle.address)
+        nodes = ray_tpu.nodes()
+        assert len(nodes) == 2  # head + 1 min tpu_worker
+        total = ray_tpu.cluster_resources()
+        assert total["CPU"] == 3.0 and total.get("TPU") == 4.0
+    finally:
+        ray_tpu.shutdown()
+        handle.teardown()
+
+
+def test_launcher_provider_registry_and_validation(tmp_path):
+    from ray_tpu.autoscaler import launcher
+
+    with pytest.raises(ValueError, match="available_node_types"):
+        launcher.load_cluster_config({"head_node_type": "x"})
+    with pytest.raises(ValueError, match="head_node_type"):
+        launcher.load_cluster_config(
+            {"available_node_types": {"a": {}}, "head_node_type": "b"})
+
+    created = []
+
+    class FakeCloud(launcher.NodeProvider):
+        def __init__(self, provider_cfg, cluster):
+            self.cfg = provider_cfg
+
+        def create_node(self, node_type, node_config):
+            created.append(node_type)
+            return f"fake-{len(created)}"
+
+        def terminate_node(self, node_id):
+            pass
+
+        def non_terminated_nodes(self):
+            return [f"fake-{i+1}" for i in range(len(created))]
+
+    launcher.register_node_provider("fake_cloud", FakeCloud)
+    ray_tpu.shutdown()
+    handle = launcher.create_or_update_cluster(
+        {
+            "provider": {"type": "fake_cloud", "region": "tpu-west"},
+            "head_node_type": "head",
+            "available_node_types": {
+                "head": {"num_cpus": 1},
+                "pod": {"num_cpus": 8, "min_workers": 2},
+            },
+        },
+        start_autoscaler=False,
+    )
+    try:
+        assert created == ["pod", "pod"]
+        assert handle.provider.cfg["region"] == "tpu-west"
+    finally:
+        handle.teardown()
