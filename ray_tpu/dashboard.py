@@ -41,6 +41,13 @@ class Dashboard:
             def log_message(self, *a):  # quiet
                 pass
 
+            def _respond(self, status, ctype, body):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 try:
                     status, ctype, body = dash._route(self.path)
@@ -49,11 +56,30 @@ class Dashboard:
                         500, "application/json",
                         json.dumps({"error": repr(e)}).encode(),
                     )
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._respond(status, ctype, body)
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                payload = self.rfile.read(n)
+                try:
+                    status, ctype, body = dash._route_put(
+                        self.path, payload)
+                except Exception as e:
+                    status, ctype, body = (
+                        500, "application/json",
+                        json.dumps({"error": repr(e)}).encode(),
+                    )
+                self._respond(status, ctype, body)
+
+            def do_DELETE(self):
+                try:
+                    status, ctype, body = dash._route_delete(self.path)
+                except Exception as e:
+                    status, ctype, body = (
+                        500, "application/json",
+                        json.dumps({"error": repr(e)}).encode(),
+                    )
+                self._respond(status, ctype, body)
 
         # Single-threaded on purpose: requests serialize through ONE
         # handler thread, whose pooled RpcClient connection to the head is
@@ -106,7 +132,100 @@ class Dashboard:
                     "placement_group_table")})
         if route == "/api/pubsub_stats":
             return ok_json(self.head.call("pubsub_stats"))
+        if route == "/api/serve/applications":
+            # Read-only: a cluster that never used serve must stay
+            # untouched — probe the controller through the head's named
+            # actor table instead of get_or_create (a GET must not spawn
+            # a controller actor).
+            from ray_tpu.serve import _private as serve_private
+
+            if self.head.call(
+                    "get_named_actor", serve_private.CONTROLLER_NAME) is None:
+                return ok_json({"applications": {}})
+            from ray_tpu import serve
+
+            self._ensure_client()
+            return ok_json({"applications": serve.status()})
         return 404, "application/json", b'{"error": "no such route"}'
+
+    # -- serve REST (reference dashboard/modules/serve) --------------------
+
+    def _ensure_client(self):
+        """Serve operations need a cluster client in this process (the
+        controller is an actor); the read-only routes stay head-RPC-only."""
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=self._head_address)
+
+    def _route_put(self, path: str, payload: bytes):
+        route = urlparse(path).path.rstrip("/")
+        if route != "/api/serve/applications":
+            return 404, "application/json", b'{"error": "no such route"}'
+        # Declarative deploy (reference serve REST schema): applications
+        # with an import_path "module:attr" resolving to a bound
+        # Application (or Deployment), plus per-deployment overrides.
+        import importlib
+
+        from ray_tpu import serve
+
+        self._ensure_client()
+        cfg = json.loads(payload or b"{}")
+        deployed = []
+        _OVERRIDABLE = ("num_replicas", "max_concurrent_queries",
+                        "autoscaling_config")
+
+        def apply_overrides(value, overrides):
+            """Per-deployment overrides apply ANYWHERE in the app's graph
+            by deployment name (reference serve REST schema semantics),
+            not just to the ingress."""
+            if isinstance(value, serve.Deployment):
+                value = value.bind()
+            if isinstance(value, serve.Application):
+                dep = value.deployment
+                ov = overrides.get(dep.name)
+                if ov:
+                    dep = dep.options(**{k: v for k, v in ov.items()
+                                         if k in _OVERRIDABLE})
+                return serve.Application(
+                    dep,
+                    tuple(apply_overrides(a, overrides)
+                          for a in value.init_args),
+                    {k: apply_overrides(v, overrides)
+                     for k, v in value.init_kwargs.items()},
+                )
+            if isinstance(value, (list, tuple)):
+                return type(value)(
+                    apply_overrides(v, overrides) for v in value)
+            if isinstance(value, dict):
+                return {k: apply_overrides(v, overrides)
+                        for k, v in value.items()}
+            return value
+
+        for app in cfg.get("applications", []):
+            mod_name, _, attr = app["import_path"].partition(":")
+            target = getattr(importlib.import_module(mod_name), attr)
+            overrides = {d["name"]: d for d in app.get("deployments", [])}
+            target = apply_overrides(target, overrides)
+            handle = serve.run(
+                target,
+                name=app.get("name"),
+                route_prefix=app.get("route_prefix"),
+            )
+            deployed.append(handle.deployment_name)
+        return 200, "application/json", json.dumps(
+            {"deployed": deployed}).encode()
+
+    def _route_delete(self, path: str):
+        route = urlparse(path).path.rstrip("/")
+        prefix = "/api/serve/applications/"
+        if not route.startswith(prefix):
+            return 404, "application/json", b'{"error": "no such route"}'
+        from ray_tpu import serve
+
+        self._ensure_client()
+        serve.delete(route[len(prefix):])
+        return 200, "application/json", b'{"deleted": true}'
 
     def _cluster_status(self):
         nodes = self.head.call("nodes")

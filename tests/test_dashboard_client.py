@@ -195,3 +195,58 @@ def test_client_nested_ref_in_value(client):
     inner = box["inner"]
     assert isinstance(inner, ray_tpu.ObjectRef)
     assert ray_tpu.get(inner, timeout=60) == "nested-payload"
+
+
+def test_serve_rest_deploy(cluster, dashboard, tmp_path):
+    """Declarative serve deploy over the dashboard REST API (reference
+    dashboard/modules/serve): PUT config with an import_path, GET
+    status, DELETE application."""
+    ray_tpu.shutdown()
+    ray_tpu.init(address=cluster.address)
+
+    mod = tmp_path / "serve_rest_app.py"
+    mod.write_text(
+        "import ray_tpu\n"
+        "from ray_tpu import serve\n\n"
+        "@serve.deployment\n"
+        "class Doubler:\n"
+        "    def __call__(self, x):\n"
+        "        return x * 2\n\n"
+        "app = Doubler.bind()\n"
+    )
+    sys.path.insert(0, str(tmp_path))
+    try:
+        body = json.dumps({
+            "applications": [{
+                "name": "doubler",
+                "import_path": "serve_rest_app:app",
+                "route_prefix": "/double",
+                "deployments": [{"name": "Doubler", "num_replicas": 2}],
+            }]
+        }).encode()
+        req = urllib.request.Request(
+            dashboard.url + "/api/serve/applications", data=body,
+            headers={"Content-Type": "application/json"}, method="PUT")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert json.loads(r.read())["deployed"] == ["doubler"]
+
+        apps = _get_json(dashboard.url + "/api/serve/applications")
+        assert apps["applications"]["doubler"]["num_replicas"] == 2
+
+        from ray_tpu import serve
+
+        handle = serve.get_deployment_handle("doubler")
+        assert ray_tpu.get(handle.remote(21), timeout=30) == 42
+
+        req = urllib.request.Request(
+            dashboard.url + "/api/serve/applications/doubler",
+            method="DELETE")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert json.loads(r.read())["deleted"] is True
+        assert "doubler" not in _get_json(
+            dashboard.url + "/api/serve/applications")["applications"]
+    finally:
+        sys.path.remove(str(tmp_path))
+        from ray_tpu import serve
+
+        serve.shutdown()
