@@ -12,14 +12,24 @@ from ray_tpu.models.llama import (
     llama_init,
     llama_loss,
 )
+from ray_tpu.models.moe import (
+    MoEConfig,
+    moe_forward,
+    moe_init,
+    moe_loss,
+)
 
 __all__ = [
     "GPT2Config",
     "LlamaConfig",
+    "MoEConfig",
     "gpt2_forward",
     "gpt2_init",
     "gpt2_loss",
     "llama_forward",
     "llama_init",
     "llama_loss",
+    "moe_forward",
+    "moe_init",
+    "moe_loss",
 ]
