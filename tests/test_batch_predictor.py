@@ -1,0 +1,71 @@
+"""BatchPredictor: dataset scoring through predictor actors (reference
+``train/batch_predictor.py``)."""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+from ray_tpu.train.batch_predictor import BatchPredictor, JaxPredictor, Predictor
+from ray_tpu.train.checkpoint import Checkpoint
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+class CountingPredictor(Predictor):
+    """Doubles inputs; counts constructions to prove one-per-actor."""
+
+    builds = 0
+
+    def __init__(self, scale):
+        type(self).builds += 1
+        self.scale = scale
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint, **kwargs):
+        return cls(checkpoint.to_dict()["scale"])
+
+    def predict(self, batch):
+        return {"out": batch["x"] * self.scale}
+
+
+def test_batch_predictor_scores_dataset():
+    ckpt = Checkpoint.from_dict({"scale": 3.0})
+    bp = BatchPredictor.from_checkpoint(ckpt, CountingPredictor)
+    ds = data.from_numpy(np.arange(64, dtype=np.float32).reshape(64, 1))
+    ds = ds.map_batches(lambda b: {"x": b["data"]})  # rename column
+
+    out = bp.predict(ds, batch_size=8, max_scoring_workers=2)
+    got = np.sort(np.concatenate(
+        [r["out"] for r in out.take_all()], axis=None))
+    np.testing.assert_allclose(got, 3.0 * np.arange(64, dtype=np.float32))
+
+
+def test_jax_predictor_from_checkpoint():
+    import jax.numpy as jnp
+
+    w = np.array([[2.0], [1.0]], np.float32)
+    ckpt = Checkpoint.from_dict({"params": {"w": w}})
+
+    def apply_fn(params, batch):
+        return batch["x"] @ jnp.asarray(params["w"])
+
+    bp = BatchPredictor.from_checkpoint(
+        ckpt, JaxPredictor, apply_fn=apply_fn)
+    ds = data.from_items(
+        [{"x": np.array([float(i), 1.0], np.float32)} for i in range(10)])
+    out = bp.predict(ds, batch_size=5)
+    vals = sorted(float(np.ravel(r["predictions"])[0])
+                  for r in out.take_all())
+    assert vals == [2.0 * i + 1.0 for i in range(10)]
