@@ -132,6 +132,70 @@ def export_jsonl(path: str) -> int:
     return len(spans)
 
 
+def export_otel(spans: Optional[List[dict]] = None,
+                tracer_name: str = "ray_tpu") -> int:
+    """Re-emit finished spans through the OpenTelemetry API (reference
+    tracing_helper.py emits OTel spans directly). The environment ships
+    only the OTel API — with no provider configured this is a no-op by
+    OTel's own design; when the application installs a provider (OTLP,
+    Jaeger, ...), the same call exports there.
+
+    Span-id note: an SDK always mints fresh span ids (the API offers no
+    way to force ours), so the TREE is preserved by re-emitting in
+    topological order and parenting each child under the freshly created
+    parent span; only spans whose parent is outside the batch fall back
+    to a remote NonRecordingSpan context with the original ids."""
+    import opentelemetry.trace as ot
+    from opentelemetry.trace import (
+        NonRecordingSpan,
+        SpanContext,
+        TraceFlags,
+        set_span_in_context,
+    )
+
+    spans = spans if spans is not None else collect()
+    tracer = ot.get_tracer(tracer_name)
+    by_id = {s["span_id"]: s for s in spans}
+    created: Dict[str, Any] = {}  # our span_id -> emitted otel span
+    n = 0
+
+    def emit(s: dict):
+        nonlocal n
+        sid = s["span_id"]
+        if sid in created:
+            return created[sid]
+        parent_id = s.get("parent_id")
+        ctx = None
+        if parent_id:
+            if parent_id in by_id:
+                # In-batch parent: emit it first, nest under ITS fresh id.
+                ctx = set_span_in_context(emit(by_id[parent_id]))
+            else:
+                ctx = set_span_in_context(NonRecordingSpan(SpanContext(
+                    trace_id=int(s["trace_id"], 16),
+                    span_id=int(parent_id, 16),
+                    is_remote=True,
+                    trace_flags=TraceFlags(TraceFlags.SAMPLED),
+                )))
+        otel_span = tracer.start_span(
+            s["name"], context=ctx, start_time=s.get("start_ns"),
+            attributes={k: str(v) for k, v in
+                        (s.get("attributes") or {}).items()},
+        )
+        if s.get("status") and s["status"] != "OK":
+            from opentelemetry.trace import Status, StatusCode
+
+            otel_span.set_status(Status(StatusCode.ERROR, s["status"]))
+        otel_span.end(end_time=s.get("end_ns"))
+        created[sid] = otel_span
+        n += 1
+        return otel_span
+
+    for s in spans:
+        emit(s)
+    return n
+
+
 def chrome_events(spans: List[dict]) -> List[dict]:
     """Chrome trace 'X' events (same target format as `ray timeline`)."""
     return [

@@ -102,3 +102,54 @@ def test_trace_crosses_task_boundary(cluster):
         assert run_spans[0]["pid"] != submit["pid"]
     finally:
         tracing.disable()
+
+
+def test_otel_export_bridge():
+    """export_otel re-emits spans through the OpenTelemetry API with
+    parent links (reference tracing_helper.py emits OTel spans). The
+    recording provider here is a minimal stand-in — the env ships the
+    OTel API without an SDK."""
+    import opentelemetry.trace as ot
+
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+    tracing.drain()
+    with tracing.span("parent-op", {"k": "v"}):
+        with tracing.span("child-op"):
+            pass
+
+    recorded = []
+
+    class _Span(ot.NonRecordingSpan):
+        pass
+
+    class _Tracer(ot.NoOpTracer):
+        def start_span(self, name, context=None, kind=ot.SpanKind.INTERNAL,
+                       attributes=None, links=None, start_time=None,
+                       record_exception=True, set_status_on_exception=True):
+            parent = ot.get_current_span(context).get_span_context() \
+                if context is not None else None
+            recorded.append({"name": name, "attributes": attributes,
+                             "start_time": start_time, "parent": parent})
+            return super().start_span(name, context)
+
+    class _Provider(ot.NoOpTracerProvider):
+        def get_tracer(self, *a, **k):
+            return _Tracer()
+
+    prev = ot.get_tracer_provider()
+    ot._TRACER_PROVIDER = None
+    ot.set_tracer_provider(_Provider())
+    try:
+        n = tracing.export_otel(tracing.collect())
+        assert n == 2
+        by_name = {r["name"]: r for r in recorded}
+        assert by_name["parent-op"]["attributes"] == {"k": "v"}
+        assert by_name["parent-op"]["start_time"] is not None
+        # child carries its parent's span context
+        assert by_name["child-op"]["parent"] is not None
+    finally:
+        ot._TRACER_PROVIDER = prev
+        tracing.disable()
+        tracing.drain()
