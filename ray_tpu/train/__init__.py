@@ -25,6 +25,8 @@ from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
 from ray_tpu.train import session
 from ray_tpu.train import torch as torch_backend
 from ray_tpu.train.torch import TorchConfig, TorchTrainer
+from ray_tpu.train.huggingface import HuggingFaceTrainer
+from ray_tpu.train.batch_predictor import BatchPredictor, JaxPredictor, Predictor
 
 # Session API at package level too (reference exposes ray.air.session).
 report = session.report
@@ -51,6 +53,10 @@ __all__ = [
     "JaxTrainer",
     "TorchConfig",
     "TorchTrainer",
+    "HuggingFaceTrainer",
+    "BatchPredictor",
+    "JaxPredictor",
+    "Predictor",
     "torch_backend",
     "Result",
     "session",

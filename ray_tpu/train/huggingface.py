@@ -1,0 +1,54 @@
+"""HuggingFaceTrainer: transformers.Trainer per worker over torch DDP.
+
+Reference parity: ``python/ray/train/huggingface/huggingface_trainer.py``
+— the user supplies ``trainer_init_per_worker(train_dataset,
+eval_dataset, **config) -> transformers.Trainer``; each worker joins the
+gloo process group first (TorchTrainer backend), and the HF Trainer's
+accelerate integration detects the already-initialized process group, so
+its inner loop runs DDP without further wiring. Results flow back
+through the standard session.report channel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train import session
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.torch import TorchConfig, TorchTrainer
+
+
+class HuggingFaceTrainer(TorchTrainer):
+    def __init__(
+        self,
+        trainer_init_per_worker: Callable,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        torch_config: Optional[TorchConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        trainer_init_config: Optional[dict] = None,
+    ):
+        init_fn = trainer_init_per_worker
+
+        def loop(config):
+            # RANK/WORLD_SIZE/LOCAL_RANK/MASTER_ADDR/PORT are exported by
+            # setup_torch before this loop runs; accelerate attaches to
+            # the already-initialized gloo group from those.
+            train_ds = session.get_dataset_shard("train")
+            eval_ds = session.get_dataset_shard("evaluation")
+            hf_trainer = init_fn(train_ds, eval_ds, **config)
+            result = hf_trainer.train()
+            metrics = dict(result.metrics or {})
+            metrics.setdefault("training_loss",
+                               getattr(result, "training_loss", None))
+            session.report(metrics)
+
+        super().__init__(
+            loop,
+            train_loop_config=trainer_init_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            torch_config=torch_config,
+            datasets=datasets or {},
+        )

@@ -99,6 +99,14 @@ class _TrainWorker:
         else:
             addr = rdz.wait_coordinator(group, torch_config.init_timeout)
         os.environ["RAY_TPU_LOCAL_RANK"] = str(local_rank)
+        # Torch-ecosystem conventions (accelerate/transformers read these
+        # even when the process group is already initialized).
+        host, _, port = addr.rpartition(":")
+        os.environ["MASTER_ADDR"] = host
+        os.environ["MASTER_PORT"] = port
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world_size)
+        os.environ["LOCAL_RANK"] = str(local_rank)
         tdist.init_process_group(
             torch_config.backend,
             init_method=f"tcp://{addr}",
