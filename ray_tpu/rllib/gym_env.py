@@ -1,0 +1,133 @@
+"""External (gymnasium) environments: host-side rollout workers.
+
+The reference's PRIMARY rollout model is actors stepping Python gym envs
+(``rllib/evaluation/rollout_worker.py:153``); this build's fast path is
+pure-jax on-device envs (``rllib/env.py``), but real workloads bring
+arbitrary Python simulators. ``GymRolloutWorker`` covers them: an actor
+holding a batch of gymnasium envs, sampling with the current policy
+(jax forward on the worker's host devices), computing GAE host-side,
+and returning the same flat batch dict the PPO learner consumes — so
+``PPO`` can mix jax and gym workers freely.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib.ppo import policy_apply
+
+
+class GymRolloutWorker:
+    """Actor: N gymnasium envs, PPO-shaped sample batches."""
+
+    def __init__(self, env_name: str, *, num_envs: int = 8,
+                 rollout_length: int = 128, gamma: float = 0.99,
+                 gae_lambda: float = 0.95, seed: int = 0,
+                 env_kwargs: Optional[dict] = None):
+        import gymnasium as gym
+
+        self.envs = [gym.make(env_name, **(env_kwargs or {}))
+                     for _ in range(num_envs)]
+        self.obs = np.stack([
+            e.reset(seed=seed + i)[0] for i, e in enumerate(self.envs)
+        ]).astype(np.float32)
+        self.num_envs = num_envs
+        self.rollout_length = rollout_length
+        self.gamma = gamma
+        self.gae_lambda = gae_lambda
+        self._rng = np.random.default_rng(seed)
+        self._apply = None  # jitted policy forward, built on first sample
+        # Per-env running episode return for REAL reward reporting.
+        self._ep_return = np.zeros(num_envs, np.float64)
+
+    def sample(self, params) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        t_, n = self.rollout_length, self.num_envs
+        obs_buf = np.zeros((t_, n) + self.obs.shape[1:], np.float32)
+        act_buf = np.zeros((t_, n), np.int64)
+        logp_buf = np.zeros((t_, n), np.float32)
+        val_buf = np.zeros((t_ + 1, n), np.float32)
+        rew_buf = np.zeros((t_, n), np.float32)
+        done_buf = np.zeros((t_, n), np.float32)
+
+        if self._apply is None:
+            self._apply = jax.jit(policy_apply)  # once per worker lifetime
+        apply = self._apply
+        ep_returns: list = []
+        truncated_at: list = []  # (t, i, final_obs) — bootstrap targets
+        for t in range(t_):
+            logits, values = apply(params, jnp.asarray(self.obs))
+            logits = np.asarray(logits)
+            val_buf[t] = np.asarray(values)
+            # Gumbel-max categorical sample (numpy side)
+            g = self._rng.gumbel(size=logits.shape)
+            actions = np.argmax(logits + g, axis=-1)
+            logp_all = logits - _logsumexp(logits)
+            logp_buf[t] = np.take_along_axis(
+                logp_all, actions[:, None], axis=1)[:, 0]
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            for i, env in enumerate(self.envs):
+                nobs, rew, term, trunc, _ = env.step(int(actions[i]))
+                rew_buf[t, i] = rew
+                self._ep_return[i] += rew
+                done = term or trunc
+                done_buf[t, i] = float(done)
+                if trunc and not term:
+                    # Time-limit truncation is NOT failure: bootstrap the
+                    # return from V(final_obs) instead of zeroing it
+                    # (reference rollout postprocessing semantics).
+                    truncated_at.append((t, i, np.asarray(
+                        nobs, np.float32)))
+                if done:
+                    ep_returns.append(self._ep_return[i])
+                    self._ep_return[i] = 0.0
+                    nobs, _ = env.reset()
+                self.obs[i] = nobs
+        _, last_vals = apply(params, jnp.asarray(self.obs))
+        val_buf[t_] = np.asarray(last_vals)
+        if truncated_at:
+            finals = np.stack([o for _, _, o in truncated_at])
+            _, vfin = apply(params, jnp.asarray(finals))
+            vfin = np.asarray(vfin)
+            for k, (t, i, _) in enumerate(truncated_at):
+                rew_buf[t, i] += self.gamma * vfin[k]
+
+        # GAE(lambda) host-side.
+        adv = np.zeros((t_, n), np.float32)
+        last = np.zeros(n, np.float32)
+        for t in range(t_ - 1, -1, -1):
+            nonterminal = 1.0 - done_buf[t]
+            delta = (rew_buf[t] + self.gamma * val_buf[t + 1] * nonterminal
+                     - val_buf[t])
+            last = delta + self.gamma * self.gae_lambda * nonterminal * last
+            adv[t] = last
+        returns = adv + val_buf[:t_]
+        # Raw advantages (like the jax RolloutWorker): normalization
+        # happens ONCE, per minibatch in ppo_loss — normalizing here too
+        # would distort relative scale across concatenated workers.
+        return {
+            "obs": obs_buf.reshape(t_ * n, -1),
+            "actions": act_buf.reshape(-1),
+            "logp": logp_buf.reshape(-1),
+            "adv": adv.reshape(-1),
+            "returns": returns.reshape(-1),
+            "dones_sum": float(done_buf.sum()),
+            # REAL episode returns (steps/episodes is only valid for
+            # +1-per-step envs like the builtin CartPole).
+            "episode_return_sum": float(sum(ep_returns)),
+            "episodes_done": float(len(ep_returns)),
+        }
+
+    def close(self):
+        for e in self.envs:
+            e.close()
+
+
+def _logsumexp(x: np.ndarray) -> np.ndarray:
+    m = x.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))

@@ -88,6 +88,7 @@ class PPOConfig:
         self.grad_clip = 0.5
         self.hidden_sizes = (64, 64)
         self.num_rollout_workers = 0
+        self.gym_env = None  # gymnasium env id for external-env workers
         self.seed = 0
 
     def environment(self, env=None) -> "PPOConfig":
@@ -97,13 +98,19 @@ class PPOConfig:
 
     def rollouts(self, *, num_envs: Optional[int] = None,
                  rollout_length: Optional[int] = None,
-                 num_rollout_workers: Optional[int] = None) -> "PPOConfig":
+                 num_rollout_workers: Optional[int] = None,
+                 gym_env: Optional[str] = None) -> "PPOConfig":
         if num_envs is not None:
             self.num_envs = num_envs
         if rollout_length is not None:
             self.rollout_length = rollout_length
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
+        if gym_env is not None:
+            # External-env mode (reference rollout_worker.py): workers
+            # step real gymnasium envs host-side instead of the pure-jax
+            # vectorized env. Requires num_rollout_workers > 0.
+            self.gym_env = gym_env
         return self
 
     def training(self, **kwargs) -> "PPOConfig":
@@ -295,9 +302,27 @@ class PPO:
         self.config = config
         rng = jax.random.key(config.seed)
         k_param, k_env, self._rng = jax.random.split(rng, 3)
+        gym_mode = bool(getattr(config, "gym_env", None))
+        if gym_mode and config.num_rollout_workers <= 0:
+            raise ValueError(
+                "gym_env requires num_rollout_workers > 0 — external "
+                "gymnasium envs are stepped by worker actors, not by the "
+                "jitted local sampler"
+            )
+        if gym_mode:
+            # Policy geometry comes from the GYM env's spaces, not the
+            # (unused) jax env default.
+            import gymnasium as gym
+
+            probe = gym.make(config.gym_env)
+            obs_size = int(probe.observation_space.shape[0])
+            num_actions = int(probe.action_space.n)
+            probe.close()
+        else:
+            obs_size = config.env.observation_size
+            num_actions = config.env.num_actions
         self.params = policy_init(
-            k_param, config.env.observation_size, config.env.num_actions,
-            config.hidden_sizes,
+            k_param, obs_size, num_actions, config.hidden_sizes,
         )
         self.opt = {
             "mu": jax.tree.map(jnp.zeros_like, self.params),
@@ -306,18 +331,37 @@ class PPO:
         }
         pieces = _make_train_iter(config)
         self._reset, self._train_iter, self._update_only = pieces[0:3]
-        self._states = self._reset(k_env)
+        # Worker modes never use the local jitted sampler: skip building
+        # (and compiling) its env-state batch.
+        self._states = (None if config.num_rollout_workers > 0
+                        else self._reset(k_env))
         self._iteration = 0
         self._workers: List = []
         if config.num_rollout_workers > 0:
-            worker_cls = ray_tpu.remote(RolloutWorker)
-            # FULL config crosses (env included) — workers must sample
-            # the configured env, not a rebuilt default.
-            cfg_dict = dict(config.__dict__)
-            self._workers = [
-                worker_cls.remote(cfg_dict, config.seed + 100 + i)
-                for i in range(config.num_rollout_workers)
-            ]
+            if getattr(config, "gym_env", None):
+                from ray_tpu.rllib.gym_env import GymRolloutWorker
+
+                worker_cls = ray_tpu.remote(GymRolloutWorker)
+                self._workers = [
+                    worker_cls.remote(
+                        config.gym_env,
+                        num_envs=config.num_envs,
+                        rollout_length=config.rollout_length,
+                        gamma=config.gamma,
+                        gae_lambda=config.gae_lambda,
+                        seed=config.seed + 100 + i,
+                    )
+                    for i in range(config.num_rollout_workers)
+                ]
+            else:
+                worker_cls = ray_tpu.remote(RolloutWorker)
+                # FULL config crosses (env included) — workers must
+                # sample the configured env, not a rebuilt default.
+                cfg_dict = dict(config.__dict__)
+                self._workers = [
+                    worker_cls.remote(cfg_dict, config.seed + 100 + i)
+                    for i in range(config.num_rollout_workers)
+                ]
 
     def train(self) -> Dict[str, Any]:
         start = time.perf_counter()
@@ -336,8 +380,16 @@ class PPO:
                 self.params, self.opt, flat, k
             )
             steps = flat["obs"].shape[0]
-            n_done = max(1.0, sum(b["dones_sum"] for b in batches))
-            reward_mean = steps / n_done
+            if "episode_return_sum" in batches[0]:
+                # Real per-episode returns (gym workers report them).
+                n_done = max(1.0, sum(b["episodes_done"] for b in batches))
+                reward_mean = sum(
+                    b["episode_return_sum"] for b in batches) / n_done
+            else:
+                # +1-per-step envs only (builtin CartPole): episode
+                # length == return.
+                n_done = max(1.0, sum(b["dones_sum"] for b in batches))
+                reward_mean = steps / n_done
             metrics = {k: float(v) for k, v in aux.items()}
         else:
             (self.params, self.opt, self._states, self._rng,
