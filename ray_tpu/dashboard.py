@@ -58,6 +58,19 @@ class Dashboard:
                     )
                 self._respond(status, ctype, body)
 
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                payload = self.rfile.read(n)
+                try:
+                    status, ctype, body = dash._route_post(
+                        self.path, payload)
+                except Exception as e:
+                    status, ctype, body = (
+                        500, "application/json",
+                        json.dumps({"error": repr(e)}).encode(),
+                    )
+                self._respond(status, ctype, body)
+
             def do_PUT(self):
                 n = int(self.headers.get("Content-Length", 0) or 0)
                 payload = self.rfile.read(n)
@@ -132,6 +145,8 @@ class Dashboard:
                     "placement_group_table")})
         if route == "/api/pubsub_stats":
             return ok_json(self.head.call("pubsub_stats"))
+        if route == "/api/jobs" or route.startswith("/api/jobs/"):
+            return self._jobs_get(route)
         if route == "/api/serve/applications":
             # Read-only: a cluster that never used serve must stay
             # untouched — probe the controller through the head's named
@@ -146,6 +161,56 @@ class Dashboard:
 
             self._ensure_client()
             return ok_json({"applications": serve.status()})
+        return 404, "application/json", b'{"error": "no such route"}'
+
+    # -- jobs REST (reference dashboard/modules/job/job_head.py) -----------
+
+    def _jobs_client(self):
+        if getattr(self, "_jobs", None) is None:
+            from ray_tpu.job_submission import JobSubmissionClient
+
+            self._ensure_client()
+            self._jobs = JobSubmissionClient()
+        return self._jobs
+
+    def _jobs_get(self, route: str):
+        def ok(payload):
+            return 200, "application/json", json.dumps(
+                payload, default=str).encode()
+
+        client = self._jobs_client()
+        if route == "/api/jobs":
+            return ok({"jobs": client.list_jobs()})
+        rest = route[len("/api/jobs/"):]
+        job_id = rest[: -len("/logs")] if rest.endswith("/logs") else rest
+        if not any(j["job_id"] == job_id for j in client.list_jobs()):
+            return (404, "application/json",
+                    json.dumps({"error": f"no such job {job_id!r}"}).encode())
+        if rest.endswith("/logs"):
+            return ok({"logs": client.get_job_logs(job_id)})
+        return ok(client.get_job_info(job_id))
+
+    def _route_post(self, path: str, payload: bytes):
+        route = urlparse(path).path.rstrip("/")
+        if route == "/api/jobs":
+            cfg = json.loads(payload or b"{}")
+            if "entrypoint" not in cfg:
+                return (400, "application/json",
+                        b'{"error": "entrypoint is required"}')
+            client = self._jobs_client()
+            job_id = client.submit_job(
+                entrypoint=cfg["entrypoint"],
+                job_id=cfg.get("submission_id") or cfg.get("job_id"),
+                runtime_env=cfg.get("runtime_env"),
+                metadata=cfg.get("metadata"),
+            )
+            return 200, "application/json", json.dumps(
+                {"submission_id": job_id, "job_id": job_id}).encode()
+        if route.startswith("/api/jobs/") and route.endswith("/stop"):
+            job_id = route[len("/api/jobs/"):-len("/stop")]
+            stopped = self._jobs_client().stop_job(job_id)
+            return 200, "application/json", json.dumps(
+                {"stopped": bool(stopped)}).encode()
         return 404, "application/json", b'{"error": "no such route"}'
 
     # -- serve REST (reference dashboard/modules/serve) --------------------

@@ -250,3 +250,49 @@ def test_serve_rest_deploy(cluster, dashboard, tmp_path):
         from ray_tpu import serve
 
         serve.shutdown()
+
+
+def test_jobs_rest_api(cluster, dashboard):
+    """Job submission over the dashboard REST API (reference
+    dashboard/modules/job/job_head.py): POST submit, GET list/info/logs."""
+    ray_tpu.shutdown()
+    ray_tpu.init(address=cluster.address)
+
+    body = json.dumps({
+        "entrypoint": "python -c \"print('job-ran-ok')\"",
+        "metadata": {"who": "rest-test"},
+    }).encode()
+    req = urllib.request.Request(
+        dashboard.url + "/api/jobs", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        job_id = json.loads(r.read())["submission_id"]
+    assert job_id
+
+    import time as _time
+
+    deadline = _time.monotonic() + 60
+    status = None
+    while _time.monotonic() < deadline:
+        info = _get_json(dashboard.url + f"/api/jobs/{job_id}")
+        status = info["status"]
+        if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+            break
+        _time.sleep(0.3)
+    assert status == "SUCCEEDED", info
+    assert info["metadata"]["who"] == "rest-test"
+
+    logs = _get_json(dashboard.url + f"/api/jobs/{job_id}/logs")["logs"]
+    assert "job-ran-ok" in logs
+    jobs = _get_json(dashboard.url + "/api/jobs")["jobs"]
+    assert any(j["job_id"] == job_id for j in jobs)
+
+
+def test_jobs_rest_unknown_id_is_404(cluster, dashboard):
+    try:
+        urllib.request.urlopen(
+            dashboard.url + "/api/jobs/raysubmit_nope", timeout=15)
+        raised = False
+    except urllib.error.HTTPError as e:
+        raised = e.code == 404
+    assert raised
