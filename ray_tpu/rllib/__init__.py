@@ -8,7 +8,9 @@ algorithm families:
 * DQN — off-policy double-Q with an ON-DEVICE replay buffer, the whole
   act/store/sample/update iteration as one jitted program;
 * IMPALA — the distributed actor-learner architecture: stale behavior
-  policies on rollout actors, V-trace correction on the learner.
+  policies on rollout actors, V-trace correction on the learner;
+* SAC — continuous control: squashed-Gaussian actor, twin Q critics,
+  on-device replay, automatic entropy temperature.
 The execution model (jit the whole train iteration; actors only for
 off-device sampling) is the part of the reference's ~30 algorithms that
 generalizes.
@@ -19,7 +21,9 @@ _rlu("rllib")
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, make_vec_env
+from ray_tpu.rllib.env import Pendulum
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
+from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig, RolloutWorker, policy_apply
 from ray_tpu.rllib.sample_batch import SampleBatch
 
@@ -30,6 +34,9 @@ __all__ = [
     "DQNConfig",
     "IMPALA",
     "IMPALAConfig",
+    "SAC",
+    "SACConfig",
+    "Pendulum",
     "vtrace",
     "PPO",
     "PPOConfig",

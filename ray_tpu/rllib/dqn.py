@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from ray_tpu.rllib.env import CartPole, make_vec_env
 from ray_tpu.rllib.optim import adam_step as _adam
 from ray_tpu.rllib.ppo import mlp_apply, mlp_init
+from ray_tpu.rllib.replay import buffer_add as _buf_add
+from ray_tpu.rllib.replay import buffer_init, buffer_sample
 
 
 class DQNConfig:
@@ -78,17 +80,8 @@ def _make_train_iter(cfg: DQNConfig):
     reset_fn, step_fn, obs_fn = make_vec_env(env, cfg.num_envs)
 
     def buffer_add(buf, obs, actions, rewards, next_obs, dones):
-        n_new = obs.shape[0]
-        idx = (buf["ptr"] + jnp.arange(n_new)) % cfg.buffer_size
-        return {
-            "obs": buf["obs"].at[idx].set(obs),
-            "actions": buf["actions"].at[idx].set(actions),
-            "rewards": buf["rewards"].at[idx].set(rewards),
-            "next_obs": buf["next_obs"].at[idx].set(next_obs),
-            "dones": buf["dones"].at[idx].set(dones),
-            "ptr": (buf["ptr"] + n_new) % cfg.buffer_size,
-            "size": jnp.minimum(buf["size"] + n_new, cfg.buffer_size),
-        }
+        return _buf_add(buf, cfg.buffer_size, obs=obs, actions=actions,
+                        rewards=rewards, next_obs=next_obs, dones=dones)
 
     def epsilon_at(global_step):
         frac = jnp.clip(global_step / cfg.epsilon_decay_steps, 0.0, 1.0)
@@ -146,16 +139,9 @@ def _make_train_iter(cfg: DQNConfig):
             learner, rng = carry
             rng, k = jax.random.split(rng)
             buf = learner["buffer"]
-            idx = jax.random.randint(
-                k, (cfg.batch_size,), 0,
-                jnp.maximum(buf["size"], 1))
-            batch = {
-                "obs": buf["obs"][idx],
-                "actions": buf["actions"][idx],
-                "rewards": buf["rewards"][idx],
-                "next_obs": buf["next_obs"][idx],
-                "dones": buf["dones"][idx],
-            }
+            batch = buffer_sample(
+                buf, k, cfg.batch_size,
+                ("obs", "actions", "rewards", "next_obs", "dones"))
             loss, grads = jax.value_and_grad(td_loss)(
                 learner["params"], learner["target_params"], batch)
             # Gate the whole update on learning_starts: before the buffer
@@ -205,15 +191,12 @@ class DQN:
                 "nu": jax.tree.map(jnp.zeros_like, params),
                 "t": jnp.zeros((), jnp.int32),
             },
-            "buffer": {
-                "obs": jnp.zeros((n, obs_size), jnp.float32),
-                "actions": jnp.zeros((n,), jnp.int32),
-                "rewards": jnp.zeros((n,), jnp.float32),
-                "next_obs": jnp.zeros((n, obs_size), jnp.float32),
-                "dones": jnp.zeros((n,), jnp.float32),
-                "ptr": jnp.zeros((), jnp.int32),
-                "size": jnp.zeros((), jnp.int32),
-            },
+            "buffer": buffer_init(
+                n,
+                {"obs": (obs_size,), "actions": (), "rewards": (),
+                 "next_obs": (obs_size,), "dones": ()},
+                dtypes={"actions": jnp.int32},
+            ),
             "env_steps": jnp.zeros((), jnp.int32),
             "done_count": jnp.zeros((), jnp.int32),
         }

@@ -79,6 +79,64 @@ class CartPole:
         return nxt, self.obs(nxt), reward, done
 
 
+class PendulumState(NamedTuple):
+    theta: jax.Array
+    theta_dot: jax.Array
+    t: jax.Array
+
+
+class Pendulum:
+    """Classic control Pendulum-v1 dynamics (continuous torque in
+    [-2, 2]) — the continuous-action counterpart to CartPole for SAC.
+    obs = [cos(theta), sin(theta), theta_dot]; reward = -(angle^2 +
+    0.1*thetadot^2 + 0.001*torque^2); fixed-length 200-step episodes."""
+
+    GRAVITY = 10.0
+    MASS = 1.0
+    LENGTH = 1.0
+    DT = 0.05
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    MAX_STEPS = 200
+    TIME_LIMIT_ONLY = True  # "done" is truncation, never a terminal state
+
+    observation_size = 3
+    action_size = 1  # continuous
+    num_actions = None  # marker: not discrete
+
+    def reset(self, rng: jax.Array) -> PendulumState:
+        k1, k2 = jax.random.split(rng)
+        return PendulumState(
+            jax.random.uniform(k1, (), minval=-jnp.pi, maxval=jnp.pi),
+            jax.random.uniform(k2, (), minval=-1.0, maxval=1.0),
+            jnp.zeros((), jnp.int32),
+        )
+
+    def obs(self, s: PendulumState) -> jax.Array:
+        return jnp.stack([jnp.cos(s.theta), jnp.sin(s.theta), s.theta_dot])
+
+    def step(self, s: PendulumState, action: jax.Array, rng: jax.Array):
+        """action: [1] torque -> (next_state, obs, reward, done)."""
+        u = jnp.clip(action[0], -self.MAX_TORQUE, self.MAX_TORQUE)
+        th = ((s.theta + jnp.pi) % (2 * jnp.pi)) - jnp.pi  # wrap to [-pi,pi]
+        cost = th ** 2 + 0.1 * s.theta_dot ** 2 + 0.001 * u ** 2
+        g, m, ln, dt = self.GRAVITY, self.MASS, self.LENGTH, self.DT
+        new_dot = s.theta_dot + (
+            3 * g / (2 * ln) * jnp.sin(th) + 3.0 / (m * ln ** 2) * u) * dt
+        new_dot = jnp.clip(new_dot, -self.MAX_SPEED, self.MAX_SPEED)
+        new_theta = s.theta + new_dot * dt
+        t = s.t + 1
+        done = t >= self.MAX_STEPS
+        # auto-reset on done (fixed-horizon episode)
+        fresh = self.reset(rng)
+        nxt = PendulumState(
+            jnp.where(done, fresh.theta, new_theta),
+            jnp.where(done, fresh.theta_dot, new_dot),
+            jnp.where(done, fresh.t, t),
+        )
+        return nxt, self.obs(nxt), -cost, done
+
+
 def make_vec_env(env: CartPole, n_envs: int):
     """(reset_fn, step_fn) vmapped over the env batch."""
 

@@ -1,0 +1,45 @@
+"""Shared on-device replay buffer (one copy for dqn/sac — the
+``utils/replay_buffers`` analog, jit-native: a plain pytree of
+fixed-shape arrays with ring-buffer add and uniform sampling)."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def buffer_init(capacity: int, fields: Dict[str, Tuple[int, ...]],
+                dtypes: Dict[str, object] | None = None) -> dict:
+    """``fields``: name -> per-item trailing shape (() for scalars)."""
+    dtypes = dtypes or {}
+    buf = {
+        name: jnp.zeros((capacity, *shape),
+                        dtypes.get(name, jnp.float32))
+        for name, shape in fields.items()
+    }
+    buf["ptr"] = jnp.zeros((), jnp.int32)
+    buf["size"] = jnp.zeros((), jnp.int32)
+    return buf
+
+
+def buffer_add(buf: dict, capacity: int, **items) -> dict:
+    """Append a batch of items (arrays [n_new, ...]); ring-wraps."""
+    n_new = next(iter(items.values())).shape[0]
+    idx = (buf["ptr"] + jnp.arange(n_new)) % capacity
+    out = dict(buf)
+    for name, value in items.items():
+        out[name] = buf[name].at[idx].set(value)
+    out["ptr"] = (buf["ptr"] + n_new) % capacity
+    out["size"] = jnp.minimum(buf["size"] + n_new, capacity)
+    return out
+
+
+def buffer_sample(buf: dict, rng, batch_size: int,
+                  fields: Tuple[str, ...]) -> dict:
+    """Uniform sample over the filled region (valid once size >= 1;
+    callers gate updates on their own learning_starts threshold)."""
+    idx = jax.random.randint(
+        rng, (batch_size,), 0, jnp.maximum(buf["size"], 1))
+    return {name: buf[name][idx] for name in fields}

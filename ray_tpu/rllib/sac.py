@@ -1,0 +1,294 @@
+"""SAC: soft actor-critic for continuous control, fully on-device.
+
+Fourth algorithm family (reference ``rllib/algorithms/sac/``), covering
+the continuous-action side of the reference's catalog. Same TPU-native
+Anakin shape as DQN: vectorized env, squashed-Gaussian actor, twin Q
+critics with target networks, ON-DEVICE replay buffer, and automatic
+entropy-temperature tuning — the whole act/store/sample/update iteration
+is one jitted program (the reference's SAC moves batches host-side
+through replay actors).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.env import Pendulum, make_vec_env
+from ray_tpu.rllib.optim import adam_step as _adam
+from ray_tpu.rllib.ppo import mlp_apply, mlp_init
+from ray_tpu.rllib.replay import buffer_add, buffer_init, buffer_sample
+
+_LOG_STD_MIN, _LOG_STD_MAX = -5.0, 2.0
+
+
+class SACConfig:
+    """Builder-style config (``SACConfig().training(...)``)."""
+
+    def __init__(self):
+        self.env = Pendulum()
+        self.num_envs = 16
+        self.steps_per_iter = 64        # env steps (per env) per train()
+        self.buffer_size = 50_000
+        self.batch_size = 256
+        self.updates_per_iter = 32
+        self.gamma = 0.99
+        self.tau = 0.005                # polyak target update rate
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.hidden_sizes = (128, 128)
+        self.learning_starts = 1_000
+        self.action_scale = 2.0         # Pendulum torque range
+        self.seed = 0
+
+    def environment(self, env=None) -> "SACConfig":
+        if env is not None:
+            self.env = env
+        return self
+
+    def rollouts(self, *, num_envs: Optional[int] = None) -> "SACConfig":
+        if num_envs is not None:
+            self.num_envs = num_envs
+        return self
+
+    def training(self, **kwargs) -> "SACConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown SAC option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "SACConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+def actor_init(rng, obs_size, act_size, hidden):
+    return mlp_init(rng, (obs_size, *hidden, 2 * act_size))
+
+
+def actor_dist(params, obs):
+    """-> (mean, log_std) of the pre-squash Gaussian."""
+    out = mlp_apply(params, obs)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+    return mean, log_std
+
+
+def actor_sample(params, obs, rng, action_scale):
+    """Squashed-Gaussian sample -> (action, logp). tanh squash with the
+    standard log-det-Jacobian correction."""
+    mean, log_std = actor_dist(params, obs)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(rng, mean.shape)
+    pre = mean + std * eps
+    logp_gauss = jnp.sum(
+        -0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi)), axis=-1)
+    squashed = jnp.tanh(pre)
+    # log|d tanh/dx| summed over action dims (numerically stable form),
+    # plus the scale Jacobian: action = scale*tanh(pre) contributes
+    # act_size * log(scale) to the log-density change.
+    log_det = jnp.sum(
+        2.0 * (jnp.log(2.0) - pre - jax.nn.softplus(-2.0 * pre)), axis=-1)
+    log_det = log_det + mean.shape[-1] * jnp.log(action_scale)
+    return action_scale * squashed, logp_gauss - log_det
+
+
+def critic_init(rng, obs_size, act_size, hidden):
+    k1, k2 = jax.random.split(rng)
+    sizes = (obs_size + act_size, *hidden, 1)
+    return {"q1": mlp_init(k1, sizes), "q2": mlp_init(k2, sizes)}
+
+
+def critic_apply(params, obs, act):
+    x = jnp.concatenate([obs, act], axis=-1)
+    return mlp_apply(params["q1"], x)[..., 0], mlp_apply(params["q2"], x)[..., 0]
+
+
+def _make_train_iter(cfg: SACConfig):
+    env = cfg.env
+    obs_size, act_size = env.observation_size, env.action_size
+    reset_fn, step_fn, obs_fn = make_vec_env(env, cfg.num_envs)
+    target_entropy = -float(act_size)
+
+    # Time-limit-only envs (Pendulum): a "done" is truncation, not a
+    # terminal state — store done=0 so the critic bootstraps THROUGH the
+    # horizon (standard SAC truncation handling).
+    time_limit_only = bool(getattr(env, "TIME_LIMIT_ONLY", False))
+
+    @jax.jit
+    def reset(rng):
+        return reset_fn(rng)
+
+    @jax.jit
+    def train_iter(learner, states, rng):
+        def env_step(carry, _):
+            learner, states, rng = carry
+            rng, k_act, k_step = jax.random.split(rng, 3)
+            obs = obs_fn(states)
+            act, _ = actor_sample(
+                learner["actor"], obs, k_act, cfg.action_scale)
+            nstates, nobs, rew, done = step_fn(states, act, k_step)
+            done_f = done.astype(jnp.float32)
+            stored_done = jnp.zeros_like(done_f) if time_limit_only \
+                else done_f
+            learner = dict(
+                learner,
+                buffer=buffer_add(
+                    learner["buffer"], cfg.buffer_size,
+                    obs=obs, act=act, rew=rew, nobs=nobs,
+                    done=stored_done),
+                env_steps=learner["env_steps"] + cfg.num_envs,
+                reward_sum=learner["reward_sum"] + jnp.sum(rew),
+                done_count=learner["done_count"] + jnp.sum(done),
+            )
+            return (learner, nstates, rng), None
+
+        (learner, states, rng), _ = jax.lax.scan(
+            env_step, (learner, states, rng), None,
+            length=cfg.steps_per_iter)
+
+        def critic_loss(cp, actor_p, target_p, alpha, batch, k):
+            next_act, next_logp = actor_sample(
+                actor_p, batch["nobs"], k, cfg.action_scale)
+            tq1, tq2 = critic_apply(target_p, batch["nobs"], next_act)
+            target_q = jnp.minimum(tq1, tq2) - alpha * next_logp
+            y = batch["rew"] + cfg.gamma * (1 - batch["done"]) * \
+                jax.lax.stop_gradient(target_q)
+            q1, q2 = critic_apply(cp, batch["obs"], batch["act"])
+            return jnp.mean((q1 - y) ** 2 + (q2 - y) ** 2)
+
+        def actor_loss(ap, cp, alpha, batch, k):
+            act, logp = actor_sample(ap, batch["obs"], k, cfg.action_scale)
+            q1, q2 = critic_apply(cp, batch["obs"], act)
+            return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+        def update(carry, _):
+            learner, rng = carry
+            rng, k_idx, k1, k2 = jax.random.split(rng, 4)
+            buf = learner["buffer"]
+            batch = buffer_sample(buf, k_idx, cfg.batch_size,
+                                  ("obs", "act", "rew", "nobs", "done"))
+            alpha = jnp.exp(learner["log_alpha"])
+            ready = (buf["size"] >= cfg.learning_starts).astype(jnp.float32)
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(
+                learner["critic"], learner["actor"], learner["target"],
+                alpha, batch, k1)
+            cgrads = jax.tree.map(lambda g: g * ready, cgrads)
+            critic, copt = _adam(learner["critic"], learner["copt"],
+                                 cgrads, lr=cfg.critic_lr)
+
+            (aloss, logp), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True)(
+                learner["actor"], critic, alpha, batch, k2)
+            agrads = jax.tree.map(lambda g: g * ready, agrads)
+            actor, aopt = _adam(learner["actor"], learner["aopt"],
+                                agrads, lr=cfg.actor_lr)
+
+            # Automatic temperature: push E[logp] toward target entropy.
+            alpha_grad = -jnp.mean(
+                jax.lax.stop_gradient(logp) + target_entropy) * \
+                jnp.exp(learner["log_alpha"])
+            log_alpha = learner["log_alpha"] - \
+                cfg.alpha_lr * ready * alpha_grad
+
+            target = jax.tree.map(
+                lambda t, p: (1 - cfg.tau * ready) * t
+                + cfg.tau * ready * p,
+                learner["target"], critic,
+            )
+            learner = dict(learner, actor=actor, critic=critic,
+                           target=target, aopt=aopt, copt=copt,
+                           log_alpha=log_alpha)
+            return (learner, rng), {"critic_loss": closs * ready,
+                                    "actor_loss": aloss * ready}
+
+        (learner, rng), losses = jax.lax.scan(
+            update, (learner, rng), None, length=cfg.updates_per_iter)
+        metrics = {
+            "critic_loss": jnp.mean(losses["critic_loss"]),
+            "actor_loss": jnp.mean(losses["actor_loss"]),
+            "alpha": jnp.exp(learner["log_alpha"]),
+            "buffer_size": learner["buffer"]["size"].astype(jnp.float32),
+        }
+        return learner, states, rng, metrics
+
+    return reset, train_iter
+
+
+class SAC:
+    """Algorithm: ``.train()`` one iteration -> result dict
+    (``rllib/algorithms/algorithm.py:142`` Trainable contract)."""
+
+    def __init__(self, config: SACConfig):
+        self.config = config
+        env = config.env
+        rng = jax.random.key(config.seed)
+        ka, kc, k_env, self._rng = jax.random.split(rng, 4)
+        obs_size, act_size = env.observation_size, env.action_size
+        actor = actor_init(ka, obs_size, act_size, config.hidden_sizes)
+        critic = critic_init(kc, obs_size, act_size, config.hidden_sizes)
+        n = config.buffer_size
+
+        def opt_for(p):
+            return {"mu": jax.tree.map(jnp.zeros_like, p),
+                    "nu": jax.tree.map(jnp.zeros_like, p),
+                    "t": jnp.zeros((), jnp.int32)}
+
+        self._learner = {
+            "actor": actor,
+            "critic": critic,
+            "target": jax.tree.map(jnp.copy, critic),
+            "aopt": opt_for(actor),
+            "copt": opt_for(critic),
+            "log_alpha": jnp.zeros((), jnp.float32),
+            "buffer": buffer_init(n, {
+                "obs": (obs_size,), "act": (act_size,), "rew": (),
+                "nobs": (obs_size,), "done": (),
+            }),
+            "env_steps": jnp.zeros((), jnp.int32),
+            "reward_sum": jnp.zeros((), jnp.float32),
+            "done_count": jnp.zeros((), jnp.int32),
+        }
+        self._reset, self._train_iter = _make_train_iter(config)
+        self._states = self._reset(k_env)
+        self._iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        prev_steps = int(self._learner["env_steps"])
+        prev_rew = float(self._learner["reward_sum"])
+        prev_dones = int(self._learner["done_count"])
+        self._learner, self._states, self._rng, metrics = self._train_iter(
+            self._learner, self._states, self._rng)
+        self._iteration += 1
+        steps = int(self._learner["env_steps"]) - prev_steps
+        rew = float(self._learner["reward_sum"]) - prev_rew
+        dones = int(self._learner["done_count"]) - prev_dones
+        # Real episode boundaries; before the first one completes, report
+        # the running mean over the partial episodes instead of inf.
+        episodes = dones if dones > 0 else max(
+            1e-6, steps / max(1, int(getattr(self.config.env,
+                                             "MAX_STEPS", steps))))
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter": steps,
+            "episode_reward_mean": rew / episodes,
+            "time_this_iter_s": time.perf_counter() - start,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def compute_single_action(self, obs):
+        mean, _ = actor_dist(self._learner["actor"],
+                             jnp.asarray(obs)[None])
+        return (self.config.action_scale
+                * jnp.tanh(mean[0])).tolist()
