@@ -84,3 +84,5 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 1
+    # Trial stop condition (tune): Stopper | {metric: threshold} | callable
+    stop: Optional[object] = None

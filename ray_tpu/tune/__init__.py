@@ -26,8 +26,16 @@ from ray_tpu.tune.search_space import (
     sample_from,
     uniform,
 )
+from ray_tpu.tune.stopper import (
+    CombinedStopper,
+    FunctionStopper,
+    MaximumIterationStopper,
+    Stopper,
+    TrialPlateauStopper,
+)
 from ray_tpu.tune.trial_runner import Trial, TrialRunner
-from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner, run
+from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig,
+                                Tuner, run, with_resources)
 
 
 def report(metrics: dict | None = None, *, checkpoint: Checkpoint | None = None,
@@ -48,6 +56,12 @@ def get_trial_id() -> str | None:
 
 
 __all__ = [
+    "Stopper",
+    "MaximumIterationStopper",
+    "TrialPlateauStopper",
+    "FunctionStopper",
+    "CombinedStopper",
+    "with_resources",
     "Tuner",
     "TuneConfig",
     "ResultGrid",

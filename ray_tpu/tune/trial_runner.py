@@ -84,6 +84,7 @@ class TrialRunner:
         scheduler=None,
         max_concurrent: int = 8,
         max_failures: int = 0,
+        stopper=None,
     ):
         self.trainable = trainable
         self.trials = trials
@@ -91,6 +92,7 @@ class TrialRunner:
         self.scheduler = scheduler or FIFOScheduler()
         self.max_concurrent = max_concurrent
         self.max_failures = max_failures
+        self.stopper = stopper  # RunConfig(stop=...) condition
         self.queue = Queue()
         self._actor_cls = ray_tpu.remote(_TrialActor)
 
@@ -254,6 +256,19 @@ class TrialRunner:
         trial.metrics_history.append(result)
         if msg["checkpoint"] is not None:
             trial.checkpoint = msg["checkpoint"]
+        if self.stopper is not None and self.stopper(
+                trial.trial_id, result):
+            self._stop_actor(trial)
+            trial.status = TERMINATED
+            self.scheduler.on_trial_complete(self, trial, result)
+            if self.stopper.stop_all():
+                for t in self.trials:
+                    if t.status in (RUNNING, PENDING):
+                        self._stop_actor(t)
+                        t.status = TERMINATED
+                        self.scheduler.on_trial_complete(
+                            self, t, t.last_result or {})
+            return
         decision = self.scheduler.on_trial_result(self, trial, result)
         if decision == STOP:
             self._stop_actor(trial)

@@ -107,13 +107,18 @@ class Tuner:
             num_samples=self.tune_config.num_samples,
             seed=self.tune_config.seed,
         )
-        trials = [Trial(cfg, self.resources_per_trial) for cfg in variants]
+        resources = self.resources_per_trial or getattr(
+            self.trainable, "_tune_resources", None)
+        trials = [Trial(cfg, resources) for cfg in variants]
+        from ray_tpu.tune.stopper import coerce_stopper
+
         runner = TrialRunner(
             self.trainable,
             trials,
             scheduler=self.tune_config.scheduler,
             max_concurrent=self.tune_config.max_concurrent_trials,
             max_failures=self.run_config.failure_config.max_failures,
+            stopper=coerce_stopper(self.run_config.stop),
         )
         runner.run()
         results = [
@@ -146,3 +151,19 @@ def run(
             scheduler=scheduler, max_concurrent_trials=max_concurrent_trials,
         ),
     ).fit()
+
+
+def with_resources(trainable, resources: dict):
+    """Attach per-trial resource requests to a trainable (reference
+    ``tune.with_resources``): ``{"CPU": 2, "TPU": 4}`` or a
+    placement-group shape ``{"bundles": [...], "strategy": "PACK"}``.
+    Always returns a NEW wrapper — re-wrapping never mutates a trainable
+    another experiment may still be holding."""
+    import functools
+
+    @functools.wraps(trainable)
+    def wrapped(*a, **kw):
+        return trainable(*a, **kw)
+
+    wrapped._tune_resources = dict(resources)
+    return wrapped

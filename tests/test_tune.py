@@ -226,3 +226,68 @@ def test_tuner_over_trainer():
                                max_concurrent_trials=2),
     ).fit()
     assert grid.get_best_result().config["lr"] == 0.01
+
+
+def test_stoppers_and_with_resources():
+    """RunConfig(stop=...) conditions + tune.with_resources (reference
+    tune/stopper/ and tune.with_resources)."""
+    from ray_tpu.train import RunConfig
+    from ray_tpu.tune import (
+        MaximumIterationStopper,
+        TrialPlateauStopper,
+        with_resources,
+    )
+
+    def trainable(config):
+        for i in range(50):
+            tune.report({"score": float(min(i, 10))})  # plateaus at 10
+
+    # dict stop: score >= 5 ends the trial early
+    grid = tune.Tuner(
+        trainable, param_space={},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(stop={"score": 5.0}),
+    ).fit()
+    assert not grid.errors
+    assert grid[0].metrics["score"] == 5.0
+    assert len(grid[0].metrics_history) <= 7
+
+    # Stopper instance: max iterations
+    grid = tune.Tuner(
+        trainable, param_space={},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(stop=MaximumIterationStopper(3)),
+    ).fit()
+    assert len(grid[0].metrics_history) <= 3
+
+    # plateau stopper fires once the metric flatlines at 10
+    grid = tune.Tuner(
+        trainable, param_space={},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(stop=TrialPlateauStopper(
+            "score", std=0.0, num_results=3)),
+    ).fit()
+    assert len(grid[0].metrics_history) < 50
+
+    # with_resources attaches per-trial resources
+    wrapped = with_resources(trainable, {"CPU": 2})
+    assert wrapped._tune_resources == {"CPU": 2}
+    grid = tune.Tuner(
+        wrapped, param_space={},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(stop=MaximumIterationStopper(2)),
+    ).fit()
+    assert not grid.errors
+
+
+def test_with_resources_rewrap_does_not_mutate():
+    from ray_tpu.tune import with_resources
+
+    def fn(config):
+        pass
+
+    w1 = with_resources(fn, {"CPU": 1})
+    w2 = with_resources(w1, {"CPU": 4})
+    assert w1._tune_resources == {"CPU": 1}
+    assert w2._tune_resources == {"CPU": 4}
+    assert w1 is not w2
