@@ -26,9 +26,13 @@ import subprocess
 import sys
 import time
 
-TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT", "1200"))
-TPU_RETRY_TIMEOUT_S = int(os.environ.get("BENCH_TPU_RETRY_TIMEOUT", "900"))
-CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
+# Budget (round 4): worst case total must fit any sane driver window even
+# when the TPU backend HANGS (observed round 3: jax.devices() blocked forever
+# and the driver killed the whole script at rc=124 with no JSON emitted).
+# Worst case now: 240 + 120 + 2*120 = ~10 min of subprocess time.
+TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT", "240"))
+TPU_RETRY_TIMEOUT_S = int(os.environ.get("BENCH_TPU_RETRY_TIMEOUT", "120"))
+CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "120"))
 
 # bf16 peak TFLOP/s per chip by device kind substring.
 PEAK_TFLOPS = {

@@ -30,7 +30,14 @@ def _compile(out: str, sources: list, flags: list) -> str:
     """mtime-cached g++ compile to ``out`` (atomic tmp+rename)."""
     with _LOCK:
         if os.path.exists(out):
-            src_mtime = max(os.path.getmtime(s) for s in sources)
+            # Headers count: every .cc includes headers from src/, and a
+            # protocol change in e.g. rpc_channel.h must invalidate cached
+            # binaries or old workers would fail the new handshake.
+            headers = [
+                os.path.join(_SRC_DIR, f)
+                for f in os.listdir(_SRC_DIR) if f.endswith(".h")
+            ]
+            src_mtime = max(os.path.getmtime(s) for s in sources + headers)
             if os.path.getmtime(out) >= src_mtime:
                 return out
         os.makedirs(_LIB_DIR, exist_ok=True)

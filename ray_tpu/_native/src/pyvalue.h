@@ -499,6 +499,10 @@ class PickleReader {
   static void setmemo(std::vector<Value>& memo, size_t idx,
                       std::vector<Value>& stack) {
     if (stack.empty()) throw CodecError("PUT on empty stack");
+    // CPython emits dense consecutive memo indices; a sparse jump means a
+    // malformed/hostile frame. Without this cap a 5-byte LONG_BINPUT with
+    // idx 0xFFFFFFFF would force a ~4-billion-Value allocation.
+    if (idx > memo.size() + 1024) throw CodecError("sparse memo index");
     if (memo.size() <= idx) memo.resize(idx + 1);
     memo[idx] = stack.back();
   }

@@ -81,6 +81,14 @@ inline void fill_random(uint8_t* out, size_t n) {
   for (size_t i = 0; i < n; i++) out[i] = uint8_t(rng());
 }
 
+// Constant-time 32-byte digest comparison (timing-side-channel hardening
+// to match Python's hmac.compare_digest).
+inline bool digest_eq32(const uint8_t* a, const uint8_t* b) {
+  volatile uint8_t acc = 0;
+  for (size_t i = 0; i < 32; i++) acc = uint8_t(acc | (a[i] ^ b[i]));
+  return acc == 0;
+}
+
 // Client side of the hello/challenge exchange (rpc.py _handshake_server).
 inline void handshake_client(int fd, const std::string& token) {
   char hello[38];
@@ -88,7 +96,15 @@ inline void handshake_client(int fd, const std::string& token) {
   if (std::memcmp(hello, "RTPA1", 5) != 0)
     throw RpcError("bad hello magic from peer");
   bool required = hello[5] == '\x01';
-  if (!required) return;
+  if (!required) {
+    // Downgrade guard (mirrors rpc.py AuthError): a token-configured
+    // client must never talk to an unauthenticated server — a spoofed
+    // listener on a dead peer's port would otherwise feed us frames.
+    if (!token.empty())
+      throw RpcError("peer does not require the cluster token this "
+                     "client is configured with (spoofed server?)");
+    return;
+  }
   if (token.empty())
     throw RpcError("cluster requires a token but none is configured "
                    "(set RAY_TPU_CLUSTER_TOKEN)");
@@ -103,10 +119,15 @@ inline void handshake_client(int fd, const std::string& token) {
   uint8_t verdict[33];
   recv_exact(fd, verdict, 33);
   if (verdict[0] != 1) throw RpcError("cluster token rejected");
+  // Proof is bound to challenge || client_nonce so it cannot be harvested
+  // by relaying our nonce under a different server challenge.
+  uint8_t both[64];
+  std::memcpy(both, hello + 6, 32);
+  std::memcpy(both + 32, nonce, 32);
   uint8_t proof[32];
   hmac_sha256(reinterpret_cast<const uint8_t*>(token.data()), token.size(),
-              nonce, 32, proof);
-  if (std::memcmp(verdict + 1, proof, 32) != 0)
+              both, 64, proof);
+  if (!digest_eq32(verdict + 1, proof))
     throw RpcError("server failed mutual auth (spoofed head?)");
 }
 
@@ -125,13 +146,19 @@ inline bool handshake_server(int fd, const std::string& token) {
     uint8_t expect[32];
     hmac_sha256(reinterpret_cast<const uint8_t*>(token.data()), token.size(),
                 challenge, 32, expect);
-    bool ok = std::memcmp(frame, expect, 32) == 0;
-    uint8_t proof[32];
-    hmac_sha256(reinterpret_cast<const uint8_t*>(token.data()), token.size(),
-                frame + 32, 32, proof);
+    bool ok = digest_eq32(frame, expect);
     uint8_t verdict[33];
     verdict[0] = ok ? 1 : 0;
-    std::memcpy(verdict + 1, proof, 32);
+    // Only a client that proved token knowledge receives a proof, and the
+    // proof covers challenge || client_nonce (anti-relay; see rpc.py).
+    std::memset(verdict + 1, 0, 32);
+    if (ok) {
+      uint8_t both[64];
+      std::memcpy(both, challenge, 32);
+      std::memcpy(both + 32, frame + 32, 32);
+      hmac_sha256(reinterpret_cast<const uint8_t*>(token.data()), token.size(),
+                  both, 64, verdict + 1);
+    }
     send_all(fd, verdict, 33);
     return ok;
   } catch (const RpcError&) {

@@ -156,8 +156,17 @@ class RpcServer:
             expect = hmac.new(
                 self._token, challenge, hashlib.sha256).digest()
             ok = hmac.compare_digest(digest, expect)
-            proof = hmac.new(
-                self._token, client_nonce, hashlib.sha256).digest()
+            # The proof is bound to BOTH nonces and only sent to a client
+            # that proved token knowledge first. Either property alone
+            # stops the relay attack (a MITM forwarding our nonce to a
+            # live server with a garbage digest to harvest a proof);
+            # belt-and-braces we do both.
+            if ok:
+                proof = hmac.new(
+                    self._token, challenge + client_nonce,
+                    hashlib.sha256).digest()
+            else:
+                proof = bytes(32)
             conn.sendall((b"\x01" if ok else b"\x00") + proof)
             return ok
         except (ConnectionLost, OSError):
@@ -280,7 +289,7 @@ class RpcClient:
         if reply[:1] != b"\x01":
             raise AuthError(f"{self.address} rejected the cluster token")
         expect = hmac.new(
-            self._token, client_nonce, hashlib.sha256).digest()
+            self._token, challenge + client_nonce, hashlib.sha256).digest()
         if not hmac.compare_digest(reply[1:], expect):
             raise AuthError(
                 f"{self.address} failed to prove the cluster token "

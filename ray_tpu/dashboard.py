@@ -19,6 +19,7 @@ CLI: ``python -m ray_tpu.scripts.cli dashboard --address <head>``.
 
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 import time
@@ -32,9 +33,19 @@ class Dashboard:
     def __init__(self, head_address: str, host: str = "127.0.0.1",
                  port: int = DEFAULT_PORT):
         from ray_tpu.cluster.rpc import RpcClient
+        from ray_tpu.core.config import config
 
         self._head_address = head_address
         self.head = RpcClient(head_address)
+        self._token = config.cluster_token.encode() or None
+        # Host values a legitimate request can carry; anything else is a
+        # browser being pointed at us via DNS rebinding. Only enforceable
+        # for loopback binds: an operator binding 0.0.0.0 is reachable
+        # under any address, so there the token (mutations) is the guard.
+        if host in ("127.0.0.1", "localhost", "::1"):
+            self._allowed_hosts = {host, "localhost", "127.0.0.1", "::1", ""}
+        else:
+            self._allowed_hosts = None  # non-loopback: any Host
         dash = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -48,9 +59,45 @@ class Dashboard:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def do_GET(self):
+            def _guard(self) -> bytes | None:
+                """CSRF/DNS-rebinding + auth guard; None means allowed.
+
+                Every request must carry a Host header matching the bound
+                address (a rebinding page reaches us with its own domain in
+                Host). Mutating requests — POST /api/jobs runs an arbitrary
+                entrypoint, PUT /api/serve/applications imports a module —
+                additionally require the cluster token when one is
+                configured (cf. the reference's ShadowRay history: its
+                dashboard shipped these routes unauthenticated)."""
+                if dash._allowed_hosts is not None:
+                    raw = self.headers.get("Host") or ""
+                    if raw.startswith("["):  # bracketed IPv6 literal
+                        hosthdr = raw[1:].partition("]")[0]
+                    else:
+                        hosthdr = raw.partition(":")[0]
+                    if hosthdr not in dash._allowed_hosts:
+                        return b'{"error": "bad Host header"}'
+                if self.command == "GET":
+                    return None
+                if dash._token:
+                    auth = self.headers.get("Authorization") or ""
+                    supplied = auth.removeprefix("Bearer ").strip()
+                    # Compare as bytes: header values are latin-1 strs and
+                    # compare_digest(str, str) raises on non-ASCII.
+                    if not hmac.compare_digest(
+                            supplied.encode("latin-1", "replace"),
+                            dash._token):
+                        return (b'{"error": "cluster token required '
+                                b'(Authorization: Bearer <token>)"}')
+                return None
+
+            def _handle(self, fn, *args):
+                denied = self._guard()
+                if denied is not None:
+                    self._respond(403, "application/json", denied)
+                    return
                 try:
-                    status, ctype, body = dash._route(self.path)
+                    status, ctype, body = fn(*args)
                 except Exception as e:  # surface handler bugs as 500s
                     status, ctype, body = (
                         500, "application/json",
@@ -58,41 +105,19 @@ class Dashboard:
                     )
                 self._respond(status, ctype, body)
 
+            def do_GET(self):
+                self._handle(dash._route, self.path)
+
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0) or 0)
-                payload = self.rfile.read(n)
-                try:
-                    status, ctype, body = dash._route_post(
-                        self.path, payload)
-                except Exception as e:
-                    status, ctype, body = (
-                        500, "application/json",
-                        json.dumps({"error": repr(e)}).encode(),
-                    )
-                self._respond(status, ctype, body)
+                self._handle(dash._route_post, self.path, self.rfile.read(n))
 
             def do_PUT(self):
                 n = int(self.headers.get("Content-Length", 0) or 0)
-                payload = self.rfile.read(n)
-                try:
-                    status, ctype, body = dash._route_put(
-                        self.path, payload)
-                except Exception as e:
-                    status, ctype, body = (
-                        500, "application/json",
-                        json.dumps({"error": repr(e)}).encode(),
-                    )
-                self._respond(status, ctype, body)
+                self._handle(dash._route_put, self.path, self.rfile.read(n))
 
             def do_DELETE(self):
-                try:
-                    status, ctype, body = dash._route_delete(self.path)
-                except Exception as e:
-                    status, ctype, body = (
-                        500, "application/json",
-                        json.dumps({"error": repr(e)}).encode(),
-                    )
-                self._respond(status, ctype, body)
+                self._handle(dash._route_delete, self.path)
 
         # Single-threaded on purpose: requests serialize through ONE
         # handler thread, whose pooled RpcClient connection to the head is

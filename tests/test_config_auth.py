@@ -120,7 +120,39 @@ def test_raw_bytes_never_reach_pickle():
         s.sendall(b"A" * 64)
         verdict = s.recv(33)  # verdict + server proof
         assert verdict[:1] == b"\x00"  # rejected
+        # No proof oracle: a client that failed auth must NOT receive a
+        # valid HMAC over its nonce — an attacker could otherwise relay a
+        # victim's nonce through any live server to complete a spoofed
+        # mutual handshake.
+        assert verdict[1:] == bytes(32)
         assert s.recv(1) == b""  # closed, nothing served
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_server_proof_bound_to_challenge():
+    """The mutual-auth proof covers challenge || client_nonce, so a proof
+    harvested under one server challenge can never satisfy a client that
+    hashed a different challenge."""
+    import hashlib
+    import hmac as _hmac
+    import socket as _socket
+
+    srv = RpcServer(_Echo(), token=b"sekrit")
+    try:
+        host, port = srv.address.rsplit(":", 1)
+        s = _socket.create_connection((host, int(port)), timeout=5)
+        hello = s.recv(38)
+        challenge = hello[6:]
+        nonce = b"N" * 32
+        s.sendall(
+            _hmac.new(b"sekrit", challenge, hashlib.sha256).digest() + nonce)
+        verdict = s.recv(33)
+        assert verdict[:1] == b"\x01"
+        expect = _hmac.new(
+            b"sekrit", challenge + nonce, hashlib.sha256).digest()
+        assert verdict[1:] == expect
         s.close()
     finally:
         srv.stop()
@@ -142,6 +174,40 @@ def test_authenticated_cluster_end_to_end(monkeypatch):
             return 2 * x
 
         assert ray_tpu.get(double.remote(21), timeout=60) == 42
+        ray_tpu.shutdown()
+        c.shutdown()
+    finally:
+        config.reset("cluster_token")
+
+
+def test_authenticated_cross_language(monkeypatch):
+    """C++ worker + C++ driver handshake under a cluster token — exercises
+    rpc_channel.h's client AND server sides of the bound mutual proof
+    against the Python implementations."""
+    import subprocess
+
+    from ray_tpu import cross_language
+    from ray_tpu._native.build import build_cpp_worker
+
+    monkeypatch.setenv("RAY_TPU_CLUSTER_TOKEN", "xlang-token")
+    config.reset("cluster_token")
+    bin_path = build_cpp_worker()
+    try:
+        ray_tpu.shutdown()
+        c = Cluster()
+        c.add_node(num_cpus=2)
+        c.wait_for_nodes()
+        ray_tpu.init(address=c.address)
+
+        add = cross_language.cpp_function("add", worker_bin=bin_path)
+        assert ray_tpu.get(add.remote(40, 2), timeout=60) == 42
+
+        out = subprocess.run(
+            [bin_path, "--driver", c.address, bin_path],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "add=42" in out.stdout
         ray_tpu.shutdown()
         c.shutdown()
     finally:

@@ -82,6 +82,42 @@ def test_dashboard_nodes_actors_tasks(cluster, dashboard):
     ray_tpu.shutdown()
 
 
+def test_dashboard_rejects_bad_host_header(dashboard):
+    """DNS-rebinding guard: a request whose Host names a foreign domain is
+    refused even though it reached the loopback socket."""
+    req = urllib.request.Request(
+        dashboard.url + "/api/cluster_status",
+        headers={"Host": "evil.example.com"})
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        raise AssertionError("expected 403")
+    except urllib.error.HTTPError as e:
+        assert e.code == 403
+
+
+def test_dashboard_mutations_require_token(cluster, dashboard):
+    """With a cluster token configured, POST/PUT/DELETE need
+    Authorization: Bearer <token>; GETs stay open (read-only). The token
+    is injected post-construction: the shared module cluster runs
+    un-tokened, and the guard only consults ``dash._token``."""
+    dashboard._token = b"dash-token"
+    try:
+        assert _get_json(
+            dashboard.url + "/api/cluster_status")["alive_nodes"] == 1
+        body = json.dumps({"entrypoint": "echo hi"}).encode()
+        req = urllib.request.Request(
+            dashboard.url + "/api/jobs", data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected 403")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+            assert b"token" in e.read()
+    finally:
+        dashboard._token = None
+
+
 def test_dashboard_index_and_404(dashboard):
     with urllib.request.urlopen(dashboard.url + "/", timeout=10) as r:
         assert b"ray_tpu cluster" in r.read()
