@@ -38,6 +38,8 @@ def _provider_for(config: dict, cluster) -> NodeProvider:
     ptype = (config.get("provider") or {}).get("type", "local")
     if ptype == "local":
         return LocalNodeProvider(cluster)
+    if ptype == "tpu_pod" and ptype not in _PROVIDERS:
+        import ray_tpu.autoscaler.tpu_pod  # noqa: F401 — self-registers
     factory = _PROVIDERS.get(ptype)
     if factory is None:
         raise ValueError(
@@ -113,19 +115,17 @@ def create_or_update_cluster(
 
     autoscaler = None
     if start_autoscaler:
+        def shape(tcfg):
+            # Everything but the scaling bounds flows to the provider
+            # (cloud providers read extra keys, e.g. accelerator_type).
+            return {k: v for k, v in tcfg.items()
+                    if k not in ("min_workers", "max_workers")}
+
         node_types = {
-            name: {
-                "num_cpus": tcfg.get("num_cpus"),
-                "resources": tcfg.get("resources"),
-            }
+            name: shape(tcfg)
             for name, tcfg in types.items()
             if name != config["head_node_type"]
-        } or {
-            config["head_node_type"]: {
-                "num_cpus": head_cfg.get("num_cpus"),
-                "resources": head_cfg.get("resources"),
-            }
-        }
+        } or {config["head_node_type"]: shape(head_cfg)}
         autoscaler = StandardAutoscaler(
             cluster.address, provider,
             node_types=node_types,

@@ -1,0 +1,182 @@
+"""TPU-pod node provider: provision TPU VM hosts via external CLIs.
+
+Reference parity: the cloud ``NodeProvider`` plugins + SSH/docker command
+runner (``python/ray/autoscaler/node_provider.py:23``,
+``_private/command_runner.py``), specialized for the TPU deployment story
+(SURVEY.md §7 step 12): a worker node type maps to a TPU VM shape
+(``gcloud compute tpus tpu-vm create ... --accelerator-type v5e-8``) or a
+GKE node-pool resize.
+
+The provider shells out through a pluggable :class:`CommandRunner`, so
+the same reconcile logic drives:
+
+* real ``gcloud`` (default command templates),
+* any other CLI (override ``commands`` in the provider section),
+* **dry-run mode** (``dry_run: true``): commands are recorded instead of
+  executed, and each "created" pod is simulated by attaching a local node
+  of the declared shape to the cluster — the full autoscaler loop
+  (demand -> launch -> join -> idle -> terminate) runs end-to-end with no
+  cloud, the fake_multi_node testing story.
+
+YAML:
+
+    provider:
+      type: tpu_pod
+      project: my-proj
+      zone: us-central2-b
+      runtime_version: tpu-ubuntu2204-base
+      dry_run: true
+    available_node_types:
+      v5e_host:
+        num_cpus: 8
+        resources: {TPU: 4}
+        accelerator_type: v5litepod-4
+        min_workers: 0
+        max_workers: 4
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler import NodeProvider
+
+# Command templates; {name}/{zone}/{project}/{accelerator_type}/
+# {runtime_version} are filled per call. Overridable via the provider
+# section's "commands" mapping.
+DEFAULT_COMMANDS = {
+    "create": (
+        "gcloud compute tpus tpu-vm create {name} --zone {zone} "
+        "--project {project} --accelerator-type {accelerator_type} "
+        "--version {runtime_version}"
+    ),
+    "delete": (
+        "gcloud compute tpus tpu-vm delete {name} --zone {zone} "
+        "--project {project} --quiet"
+    ),
+    "list": (
+        "gcloud compute tpus tpu-vm list --zone {zone} "
+        "--project {project} --format value(name)"
+    ),
+}
+
+
+class CommandRunner:
+    """Executes provisioning commands (reference command_runner.py)."""
+
+    def run(self, argv: List[str]) -> str:
+        return subprocess.check_output(argv, text=True)
+
+
+class DryRunCommandRunner(CommandRunner):
+    """Records what WOULD run; returns empty output."""
+
+    def __init__(self):
+        self.commands: List[List[str]] = []
+
+    def run(self, argv: List[str]) -> str:
+        self.commands.append(list(argv))
+        return ""
+
+
+class TPUPodNodeProvider(NodeProvider):
+    def __init__(self, provider_config: dict, cluster=None,
+                 runner: Optional[CommandRunner] = None):
+        self.config = dict(provider_config)
+        self.dry_run = bool(self.config.get("dry_run"))
+        self.runner = runner or (
+            DryRunCommandRunner() if self.dry_run else CommandRunner())
+        self.commands = {**DEFAULT_COMMANDS,
+                         **(self.config.get("commands") or {})}
+        self.cluster = cluster  # simulation target in dry-run mode
+        self._seq = 0
+        # pod name -> simulated local agent (dry-run) or None (real)
+        self._pods: Dict[str, object] = {}
+
+    # -- command plumbing --------------------------------------------------
+
+    def _argv(self, which: str, **fields) -> List[str]:
+        tpl = self.commands[which]
+        filled = tpl.format(
+            project=self.config.get("project", ""),
+            zone=self.config.get("zone", ""),
+            runtime_version=self.config.get(
+                "runtime_version", "tpu-ubuntu2204-base"),
+            **fields,
+        )
+        return shlex.split(filled)
+
+    # -- NodeProvider ------------------------------------------------------
+
+    def create_node(self, node_type: str, node_config: dict) -> str:
+        self._seq += 1
+        prefix = self.config.get("name_prefix", "ray-tpu")
+        name = f"{prefix}-{node_type}-{self._seq}"
+        accel = node_config.get(
+            "accelerator_type",
+            self.config.get("accelerator_type", "v5litepod-4"))
+        self.runner.run(self._argv("create", name=name,
+                                   accelerator_type=accel))
+        agent = None
+        if self.dry_run and self.cluster is not None:
+            # Simulate the pod host joining the cluster with the declared
+            # shape, so demand actually drains and idle-scale-down has a
+            # real node to observe.
+            agent = self.cluster.add_node(
+                num_cpus=node_config.get("num_cpus"),
+                resources=node_config.get("resources"),
+            )
+        self._pods[name] = agent
+        # In dry-run the provider's node id must match the joined node's
+        # cluster id (the autoscaler cross-references the head's view).
+        return agent.node_id if agent is not None else name
+
+    def terminate_node(self, node_id: str) -> None:
+        name = self._name_of(node_id)
+        if name is None:
+            return
+        self.runner.run(self._argv("delete", name=name))
+        agent = self._pods.pop(name, None)
+        if agent is not None and self.cluster is not None:
+            self.cluster.remove_node(agent)
+
+    def non_terminated_nodes(self) -> List[str]:
+        if not self.dry_run:
+            # Reconcile against the cloud's view (a restarted launcher
+            # must adopt — and be able to terminate — pods a previous
+            # incarnation created, instead of double-provisioning).
+            prefix = self.config.get("name_prefix", "ray-tpu") + "-"
+            try:
+                out = self.runner.run(self._argv("list"))
+            except (OSError, subprocess.CalledProcessError):
+                out = ""
+            for line in out.splitlines():
+                name = line.strip()
+                if name.startswith(prefix) and name not in self._pods:
+                    self._pods[name] = None
+        return [
+            (agent.node_id if agent is not None else name)
+            for name, agent in self._pods.items()
+        ]
+
+    def _name_of(self, node_id: str) -> Optional[str]:
+        for name, agent in self._pods.items():
+            if name == node_id or (
+                    agent is not None and agent.node_id == node_id):
+                return name
+        return None
+
+
+def _factory(provider_config: dict, cluster) -> TPUPodNodeProvider:
+    return TPUPodNodeProvider(provider_config, cluster)
+
+
+def register() -> None:
+    from ray_tpu.autoscaler.launcher import register_node_provider
+
+    register_node_provider("tpu_pod", _factory)
+
+
+register()

@@ -98,6 +98,25 @@ class ClusterBackend:
         self._flush_io_lock = threading.Lock()
         self._closed = False
         threading.Thread(target=self._ref_flush_loop, daemon=True).start()
+        # Pipelined submission (direct_task_transport.h:57 in spirit):
+        # submit_task enqueues; the submitter thread drains bursts and
+        # (a) pushes default-strategy specs straight to THIS client's own
+        # node under strict admission — the decentralized prefer-local
+        # half of the reference's hybrid policy, no head RPC at all — then
+        # (b) places whatever the local node rejected (plus SPREAD/
+        # affinity/PG specs) with ONE schedule_batch call. Natural
+        # batching: a lone task dispatches immediately; under load
+        # batches grow.
+        import collections as _collections
+
+        self._submit_q: "_collections.deque[dict]" = _collections.deque()
+        self._submit_cv = threading.Condition()
+        self._dispatching = 0  # specs popped from the queue, mid-dispatch
+        # task_id -> borrowed oids held locally until borrow registration
+        # reaches the head (so callers may drop arg handles immediately
+        # even though dispatch is now asynchronous).
+        self._submit_holds: dict[str, list[str]] = {}
+        threading.Thread(target=self._submit_loop, daemon=True).start()
         self.process_kind = process_kind
         if process_kind == "d":
             # Drivers stream worker stdout/stderr from the head via the
@@ -144,7 +163,7 @@ class ClusterBackend:
 
     # -- ref counting ------------------------------------------------------
 
-    def make_ref(self, oid: str, owner: str | None = None) -> ObjectRef:
+    def _incref(self, oid: str) -> None:
         with self._ref_lock:
             n = self._local_refs.get(oid, 0)
             self._local_refs[oid] = n + 1
@@ -154,6 +173,9 @@ class ClusterBackend:
                 else:
                     self._dirty_add.add(oid)
                 self._ref_cv.notify_all()
+
+    def make_ref(self, oid: str, owner: str | None = None) -> ObjectRef:
+        self._incref(oid)
         ref = ObjectRef(oid, owner if owner is not None else self.node_id)
         import weakref
 
@@ -669,6 +691,200 @@ class ClusterBackend:
                 "ref_task_begin", spec["task_id"], node_id, spec["borrowed"],
                 spec.get("actor_id") if spec.get("method") else None,
             )
+        self._drop_holds(spec)
+
+    def _register_borrows_batch(self, specs: list, node_id: str) -> None:
+        entries = [
+            (s["task_id"], node_id, s["borrowed"],
+             s.get("actor_id") if s.get("method") else None)
+            for s in specs if s.get("borrowed")
+        ]
+        if entries:
+            self.head.call("ref_task_begin_batch", entries)
+        for s in specs:
+            self._drop_holds(s)
+
+    def _deliver_late_cancels(self, specs: list, address: str) -> None:
+        """cancel() racing the asynchronous dispatch sees assigned_node
+        None and sends no node RPC; now that these specs have a home,
+        forward the flag (the agent's cancelled-set covers every
+        queue/checkout window)."""
+        for s in specs:
+            if s.get("cancelled"):
+                try:
+                    self._node_client(address).call(
+                        "cancel_task", s["task_id"], False)
+                except (ConnectionLost, OSError):
+                    pass
+
+    def _drop_holds(self, spec: dict) -> None:
+        """Release the submission-window holds on a task's borrowed args
+        (safe once the head knows the borrows, or the task has failed)."""
+        oids = self._submit_holds.pop(spec.get("task_id"), None)
+        if oids:
+            for oid in oids:
+                self._deref(oid)
+
+    def _fail_spec(self, spec: dict, err: Exception) -> None:
+        self._drop_holds(spec)
+        for oid in spec["oids"]:
+            self._lineage.pop(oid, None)
+            self.put_with_id(oid, err, is_error=True)
+
+    # -- lease-pipelined submission ----------------------------------------
+
+    @staticmethod
+    def _leasable(spec: dict) -> bool:
+        """Only default-strategy tasks with real demand take the
+        prefer-local direct path; SPREAD/affinity/PG placement must
+        consult the head every time, and zero-demand specs fit local
+        admission unconditionally (they'd never spill — the head
+        round-robins them instead)."""
+        s = spec["sinfo"]
+        return (s["pg_id"] is None and s["node_affinity"] is None
+                and s["strategy"] is None and bool(spec["demand"]))
+
+    def _submit_loop(self) -> None:
+        while True:
+            with self._submit_cv:
+                while not self._submit_q and not self._closed:
+                    self._submit_cv.wait(0.5)
+                if self._closed and not self._submit_q:
+                    return
+                batch = []
+                limit = config.submit_batch_max
+                while self._submit_q and len(batch) < limit:
+                    batch.append(self._submit_q.popleft())
+                # Popped-but-not-dispatched specs count as in flight so
+                # shutdown()'s drain cannot slip between the pop and the
+                # dispatch and release the submit holds early.
+                self._dispatching = len(batch)
+            try:
+                self._dispatch_batch(batch)
+            except BaseException as e:  # noqa: BLE001 — submitter must live
+                for spec in batch:
+                    try:
+                        self._fail_spec(spec, TaskError(
+                            spec.get("fname", "task"),
+                            f"submission failed: {e!r}", repr(e)))
+                    except BaseException:
+                        pass
+            finally:
+                with self._submit_cv:
+                    self._dispatching = 0
+
+    def _dispatch_batch(self, batch: list) -> None:
+        from ray_tpu.core.object_ref import TaskCancelledError
+
+        head_specs: list[dict] = []
+        local_specs: list[dict] = []
+        for spec in batch:
+            if spec.get("cancelled"):
+                self._fail_spec(
+                    spec, TaskCancelledError(spec.get("fname", "task")))
+                continue
+            if spec["sinfo"]["pg_id"] is not None:
+                # PG bundles block on readiness server-side: keep them on
+                # the per-spec path (rare, latency-insensitive).
+                try:
+                    self._submit_spec(spec, allow_pending=True)
+                except (ValueError, TimeoutError, ConnectionLost, OSError) as e:
+                    self._fail_spec(spec, TaskError(
+                        spec.get("fname", "task"), str(e), repr(e)))
+                continue
+            if self._leasable(spec):
+                local_specs.append(spec)
+            else:
+                head_specs.append(spec)
+
+        if local_specs:
+            # Prefer-local without the head: push to this client's own
+            # node agent, which admits only what fits its UNCOMMITTED
+            # capacity. Borrows register BEFORE dispatch (a begin must
+            # never lose the race against the worker's task-end); a
+            # rejected spec is re-registered by the head path
+            # (begin-replaces semantics).
+            try:
+                agent = self._agent_client()
+                self._register_borrows_batch(local_specs, self.node_id)
+                for s in local_specs:
+                    s["assigned_node"] = self.node_id
+                rejected = agent.call("submit_tasks_leased", local_specs)
+            except (ConnectionLost, OSError, RuntimeError) as e:
+                # Ambiguous outcome: the agent may have enqueued the batch
+                # before the connection died. Resubmitting could fork a
+                # task into two executions — fail the refs instead (the
+                # old synchronous path surfaced the same condition as an
+                # error too).
+                for s in local_specs:
+                    self._end_borrows(s)
+                    self._fail_spec(s, TaskError(
+                        s.get("fname", "task"),
+                        f"local agent unreachable during submit: {e!r}",
+                        repr(e)))
+                local_specs = []
+                rejected = []
+            rejected = set(rejected)
+            for i in rejected:
+                # Spillback: local node saturated (or agent unreachable) —
+                # the head places these on the cluster view. The spilled
+                # flag tells it to avoid the caller's node: its heartbeat
+                # hasn't reflected the leased admissions that caused the
+                # rejection yet.
+                local_specs[i]["assigned_node"] = None
+                local_specs[i]["_spilled"] = True
+                head_specs.append(local_specs[i])
+            self._deliver_late_cancels(
+                [s for i, s in enumerate(local_specs)
+                 if i not in rejected],
+                self._agent_address)
+
+        if not head_specs:
+            return
+        reqs = [
+            {"demand": s["demand"], "caller_node": self.node_id,
+             "strategy": s["sinfo"]["strategy"],
+             "node_affinity": s["sinfo"]["node_affinity"],
+             "task_id": s.get("task_id"),
+             "spilled": s.pop("_spilled", False)}
+            for s in head_specs
+        ]
+        try:
+            placements = self.head.call("schedule_batch", reqs)
+        except (ConnectionLost, OSError) as e:
+            for s in head_specs:
+                self._fail_spec(s, TaskError(
+                    s.get("fname", "task"),
+                    f"head unreachable during submit: {e!r}", repr(e)))
+            return
+        by_node: dict[tuple, list[dict]] = {}
+        for spec, placed in zip(head_specs, placements):
+            if placed is None:
+                # Infeasible now: park it on the pending-retry path (the
+                # head has recorded the demand for the autoscaler).
+                threading.Thread(
+                    target=self._retry_submit, args=(spec,), daemon=True
+                ).start()
+                continue
+            node_id, address = placed
+            spec["assigned_node"] = node_id
+            by_node.setdefault((node_id, address), []).append(spec)
+        for (node_id, address), specs in by_node.items():
+            try:
+                self._register_borrows_batch(specs, node_id)
+                self._node_client(address).call("submit_tasks", specs)
+                self._deliver_late_cancels(specs, address)
+            except (ConnectionLost, OSError):
+                # Leave the borrow registrations in place: they pin the
+                # args through the retry window (the caller may have
+                # dropped its handles already). _retry_submit re-registers
+                # on success (begin-replaces) and ends them on its error
+                # paths.
+                for s in specs:
+                    s["assigned_node"] = None
+                    threading.Thread(
+                        target=self._retry_submit, args=(s,), daemon=True
+                    ).start()
 
     def _retry_submit(self, spec: dict, timeout: float | None = None):
         from ray_tpu.core.object_ref import TaskCancelledError
@@ -679,6 +895,8 @@ class ClusterBackend:
         while time.monotonic() < deadline:
             time.sleep(0.25)
             if spec.get("cancelled"):
+                self._drop_holds(spec)
+                self._end_borrows(spec)
                 err = TaskCancelledError(spec.get("fname", "task"))
                 for oid in spec["oids"]:
                     self.put_with_id(oid, err, is_error=True)
@@ -704,6 +922,8 @@ class ClusterBackend:
                     except (ConnectionLost, OSError):
                         pass
                 return
+        self._drop_holds(spec)
+        self._end_borrows(spec)  # no-op unless a leased attempt registered
         err = TaskError(
             spec.get("fname", "task"),
             f"demand {spec['demand']} unsatisfiable for {timeout}s",
@@ -763,10 +983,10 @@ class ClusterBackend:
 
         from ray_tpu.util import tracing
 
-        # Submission span wraps the ACTUAL submit (schedule RPC included)
-        # so its duration/status mean something; its context rides the
-        # spec so the worker parents the execution span under it
-        # (tracing_helper.py).
+        # Submission span covers the client-side submit (enqueue); its
+        # context rides the spec so the worker parents the execution span
+        # under it (tracing_helper.py). Dispatch itself is asynchronous —
+        # the submitter thread batches it with its neighbors.
         span_cm = (tracing.span(f"submit:{spec['fname']}",
                                 {"task_id": task_id})
                    if tracing.is_enabled() else nullcontext())
@@ -777,14 +997,16 @@ class ClusterBackend:
                 }
             for oid in oids:
                 self._lineage[oid] = spec
-            try:
-                self._submit_spec(spec, allow_pending=True)
-            except (ValueError, TimeoutError) as e:
-                for oid in oids:
-                    self._lineage.pop(oid, None)
-                    self.put_with_id(
-                        oid, TaskError(spec["fname"], str(e), repr(e)),
-                        is_error=True)
+            if borrowed:
+                # Hold borrowed args until the head learns of the borrows
+                # (dispatch is async; the caller may drop its handles the
+                # moment we return).
+                for oid in borrowed:
+                    self._incref(oid)
+                self._submit_holds[task_id] = list(borrowed)
+            with self._submit_cv:
+                self._submit_q.append(spec)
+                self._submit_cv.notify()
         return refs
 
     def release_stream(self, task_id: str, from_index: int) -> None:
@@ -964,7 +1186,8 @@ class ClusterBackend:
         with self._lock:
             info = self._actor_cache.get(actor_id)
         if info is None or refresh or info["state"] != "ALIVE":
-            info = self.head.call("get_actor", actor_id, 30.0, timeout=45.0)
+            t = config.actor_register_timeout_s
+            info = self.head.call("get_actor", actor_id, t, timeout=t * 1.5)
             if info is None:
                 raise ValueError(f"no such actor: {actor_id}")
             with self._lock:
@@ -1233,6 +1456,15 @@ class ClusterBackend:
     def shutdown(self) -> None:
         """Disconnect this client (the cluster keeps running; use
         Cluster.shutdown / shutdown_cluster to tear it down)."""
+        # Drain the submit queue first: tasks handed to submit_task before
+        # shutdown must reach a node (or fail into their refs) — then the
+        # closed flag stops the submitter thread. "_dispatching" covers
+        # the window where the submitter has popped a batch but not yet
+        # registered its borrows.
+        deadline = time.monotonic() + 5.0
+        while ((self._submit_q or self._dispatching)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
         # Release every hold this process still has so the cluster can
         # free the objects (clean-exit ref release).
         with self._ref_lock:

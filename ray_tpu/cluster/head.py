@@ -458,6 +458,17 @@ class HeadServer:
                 self._inflight[oid] = self._inflight.get(oid, 0) + 1
         return True
 
+    def rpc_ref_task_begin_batch(self, entries):
+        """One lock pass for a submitter batch's borrow registrations."""
+        with self._lock:
+            for task_id, node_id, oids, actor_id in entries:
+                self._end_task_borrows(task_id)  # resubmission replaces
+                self._inflight_by_task[task_id] = (
+                    node_id, list(oids), actor_id)
+                for oid in oids:
+                    self._inflight[oid] = self._inflight.get(oid, 0) + 1
+        return True
+
     def rpc_ref_task_end(self, task_id):
         with self._lock:
             self._end_task_borrows(task_id)
@@ -934,50 +945,84 @@ class HeadServer:
         """Pick a node for a task/actor; returns (node_id, address) or None
         if no alive node can ever fit the demand."""
         with self._lock:
-            alive = [n for n in self._nodes.values() if n.alive]
-            if node_affinity is not None:
-                node = self._nodes.get(node_affinity)
-                if node is not None and node.alive:
-                    return self._pick(node, demand)
-                return None
-            feasible = [
-                n
-                for n in alive
-                if all(n.resources.get(k, 0.0) >= v for k, v in demand.items())
+            return self._schedule_locked(
+                demand, caller_node, strategy, node_affinity, task_id)
+
+    def rpc_schedule_batch(self, requests):
+        """Place many tasks under ONE lock acquisition (the head-side half
+        of lease pipelining, cf. the reference's backlog-aware
+        RequestWorkerLease batching in direct_task_transport.h:57).
+        ``requests``: list of dicts with the rpc_schedule kwargs; returns a
+        placement (or None) per request, with the optimistic debit applied
+        sequentially so a burst spreads across feasible nodes. A request
+        marked ``spilled`` was just REJECTED by the caller's own node
+        (leased-push admission) — the view of that node is stale-high, so
+        prefer-local is suppressed and other feasible nodes win ties."""
+        with self._lock:
+            return [
+                self._schedule_locked(
+                    r["demand"], r.get("caller_node"), r.get("strategy"),
+                    r.get("node_affinity"), r.get("task_id"),
+                    spilled=r.get("spilled", False))
+                for r in requests
             ]
-            if not feasible:
-                # One live entry per pending task: retries refresh the
-                # timestamp instead of inflating apparent demand.
-                if task_id is not None:
-                    self._demand_misses = [
-                        m for m in self._demand_misses
-                        if m.get("task_id") != task_id
-                    ]
-                self._demand_misses.append(
-                    {"demand": dict(demand), "ts": time.monotonic(),
-                     "task_id": task_id}
-                )
-                del self._demand_misses[:-1000]
-                return None
 
-            def headroom(n: NodeInfo) -> float:
-                return min(
-                    (n.available.get(k, 0.0) - v for k, v in demand.items()),
-                    default=1.0,
-                )
+    def _schedule_locked(self, demand, caller_node=None, strategy=None,
+                         node_affinity=None, task_id=None, spilled=False):
+        alive = [n for n in self._nodes.values() if n.alive]
+        if node_affinity is not None:
+            node = self._nodes.get(node_affinity)
+            if node is not None and node.alive:
+                return self._pick(node, demand)
+            return None
+        feasible = [
+            n
+            for n in alive
+            if all(n.resources.get(k, 0.0) >= v for k, v in demand.items())
+        ]
+        if not feasible:
+            # One live entry per pending task: retries refresh the
+            # timestamp instead of inflating apparent demand.
+            if task_id is not None:
+                self._demand_misses = [
+                    m for m in self._demand_misses
+                    if m.get("task_id") != task_id
+                ]
+            self._demand_misses.append(
+                {"demand": dict(demand), "ts": time.monotonic(),
+                 "task_id": task_id}
+            )
+            del self._demand_misses[:-1000]
+            return None
 
-            if strategy == "SPREAD":
-                self._rr_counter += 1
-                return self._pick(
-                    feasible[self._rr_counter % len(feasible)], demand)
-            # Hybrid: prefer caller's node while it has headroom.
-            if caller_node is not None:
-                local = self._nodes.get(caller_node)
-                if local is not None and local.alive and local in feasible:
-                    if headroom(local) >= 0:
-                        return self._pick(local, demand)
-            best = max(feasible, key=headroom)
-            return self._pick(best, demand)
+        def headroom(n: NodeInfo) -> float:
+            return min(
+                (n.available.get(k, 0.0) - v for k, v in demand.items()),
+                default=1.0,
+            )
+
+        if strategy == "SPREAD" or not demand:
+            # Zero-demand tasks/actors have headroom EVERYWHERE, so
+            # hybrid prefer-local would pile every one of them onto the
+            # caller's node (and through its worker pool) forever —
+            # round-robin them instead.
+            self._rr_counter += 1
+            return self._pick(
+                feasible[self._rr_counter % len(feasible)], demand)
+        # Hybrid: prefer caller's node while it has headroom — unless
+        # the caller's node itself just rejected this spec (spilled).
+        if caller_node is not None and not spilled:
+            local = self._nodes.get(caller_node)
+            if local is not None and local.alive and local in feasible:
+                if headroom(local) >= 0:
+                    return self._pick(local, demand)
+        if spilled and len(feasible) > 1:
+            others = [n for n in feasible
+                      if n.node_id != caller_node]
+            if others:
+                return self._pick(max(others, key=headroom), demand)
+        best = max(feasible, key=headroom)
+        return self._pick(best, demand)
 
     def _pick(self, node: NodeInfo, demand):
         # Optimistically debit the view so bursts spread before the next

@@ -100,6 +100,9 @@ class NodeAgent:
         self._bundle_state: dict[tuple, str] = {}  # PREPARED | COMMITTED
         self._task_queue: list[dict] = []
         self._queue_cv = threading.Condition(self._lock)
+        # Demand of queued-or-acquiring tasks, not yet debited from the
+        # pool: leased-push admission compares against available minus this.
+        self._committed: dict[str, float] = {}
         self._shutdown = threading.Event()
         # Task state records for the state API (GetTasksInfo analog):
         # PENDING on enqueue, RUNNING on dispatch, final state from the
@@ -136,6 +139,41 @@ class NodeAgent:
             limit_bytes=memory_limit_bytes,
         )
         self.memory_monitor.start()
+        # Prestart plain-env workers up to the node's CPU count (reference:
+        # worker_pool.cc PrestartWorkers) so a first burst that spills onto
+        # this node doesn't serialize behind interpreter cold starts.
+        n_prestart = min(
+            int(config.worker_prestart_per_cpu
+                * self.total_resources.get("CPU", 0.0)),
+            self._max_workers,
+        )
+        if n_prestart > 0:
+            threading.Thread(
+                target=self._prestart_workers, args=(n_prestart,),
+                daemon=True,
+            ).start()
+
+    def _prestart_workers(self, n: int) -> None:
+        # Deferred + serialized: a cluster booting many agents at once must
+        # not fork an interpreter storm that starves node registration;
+        # each fork waits for the previous worker to come up, and demand
+        # that arrives meanwhile shrinks what's left to prestart.
+        self._shutdown.wait(config.worker_prestart_delay_s)
+        for _ in range(n):
+            if self._shutdown.is_set():
+                return
+            with self._lock:
+                idle = sum(len(v) for v in self._idle.values())
+                live = len([w for w in self._workers.values()
+                            if w.proc.poll() is None])
+                if idle >= n or live >= self._max_workers:
+                    return
+            try:
+                w = self._spawn_worker()
+                if w.ready.wait(config.worker_start_timeout_s):
+                    self._return_worker(w)
+            except (OSError, RuntimeError):
+                return  # prestart is an optimization, never fatal
 
     # -- worker pool ------------------------------------------------------
 
@@ -210,9 +248,14 @@ class NodeAgent:
 
     def _checkout_worker(self, timeout: float | None = None,
                          env_key: str = "",
-                         resolved_env: dict | None = None) -> _Worker:
+                         resolved_env: dict | None = None,
+                         dedicated: bool = False) -> _Worker:
         """Idle worker of the SAME runtime env, or a fresh one spawned
-        into it (lease grant, ``PopWorker`` analog)."""
+        into it (lease grant, ``PopWorker`` analog). ``dedicated`` (actor
+        creation) bypasses the pool cap: an actor keeps its process for
+        life, so counting it against the task pool would let N long-lived
+        actors starve every future task on the node — the reference's
+        worker pool likewise caps only poolable workers."""
         if timeout is None:
             timeout = config.worker_start_timeout_s
         with self._lock:
@@ -220,8 +263,8 @@ class NodeAgent:
             if pool:
                 return pool.pop()
             n_live = len([w for w in self._workers.values()
-                          if w.proc.poll() is None])
-            can_spawn = n_live < self._max_workers
+                          if w.proc.poll() is None and not w.is_actor])
+            can_spawn = dedicated or n_live < self._max_workers
             victim = None
             if not can_spawn:
                 # At capacity with nothing idle in THIS env: retire an
@@ -261,7 +304,8 @@ class NodeAgent:
                         w = pool.pop()
                         break
                     n_live = len([w_ for w_ in self._workers.values()
-                                  if w_.proc.poll() is None])
+                                  if w_.proc.poll() is None
+                                  and not w_.is_actor])
                     if n_live < self._max_workers:
                         can_spawn = True
                         break
@@ -287,9 +331,79 @@ class NodeAgent:
         allow. Returns immediately (results flow through the store)."""
         self._record_task(spec, "PENDING")
         with self._queue_cv:
+            self._commit_locked(spec)
             self._task_queue.append(spec)
             self._queue_cv.notify()
         return True
+
+    def rpc_submit_tasks(self, specs: list):
+        """Head-placed batch enqueue: one RPC, one queue notify."""
+        for spec in specs:
+            self._record_task(spec, "PENDING")
+        with self._queue_cv:
+            for spec in specs:
+                self._commit_locked(spec)
+            self._task_queue.extend(specs)
+            self._queue_cv.notify()
+        return True
+
+    def rpc_submit_tasks_leased(self, specs: list):
+        """Direct (head-bypassing) submission under a client-held
+        scheduling-key lease — the decentralized half of lease pipelining
+        (reference: leased-worker task pushes, direct_task_transport.cc).
+        This node is NOT obligated to accept: a spec is admitted only if
+        its demand fits the node's UNCOMMITTED capacity (available minus
+        everything already queued), so a leased burst can never pile up
+        behind running tasks while other nodes sit idle — overflow spills
+        back through the head, which still balances the cluster. Returns
+        the list of REJECTED indices; the client reschedules those through
+        the head and drops its lease."""
+        rejected = []
+        accepted = []
+        with self._queue_cv:
+            avail = self.pool.available()
+            for k, v in self._committed.items():
+                avail[k] = avail.get(k, 0.0) - v
+            for i, spec in enumerate(specs):
+                demand = spec["demand"]
+                if all(avail.get(k, 0.0) >= v for k, v in demand.items()):
+                    for k, v in demand.items():
+                        avail[k] = avail.get(k, 0.0) - v
+                    self._commit_locked(spec)
+                    accepted.append(spec)
+                else:
+                    rejected.append(i)
+            for spec in accepted:
+                # Record BEFORE the dispatcher can see the spec (the lock
+                # is reentrant): a fast task's RUNNING/FINISHED must not
+                # be overwritten by a late PENDING.
+                self._record_task(spec, "PENDING")
+            self._task_queue.extend(accepted)
+            self._queue_cv.notify()
+        return rejected
+
+    # -- queued-demand accounting (admission control for leased pushes) ----
+
+    def _commit_locked(self, spec: dict) -> None:
+        """Caller holds self._lock. PG tasks draw on bundle capacity (carved
+        out of the pool at prepare time), not on free node capacity."""
+        if spec.get("pg_id") is not None:
+            return
+        for k, v in spec.get("demand", {}).items():
+            self._committed[k] = self._committed.get(k, 0.0) + v
+
+    def _uncommit(self, spec: dict) -> None:
+        """After the dispatcher's acquire resolves (either way), the demand
+        is reflected in (or irrelevant to) pool availability."""
+        if spec.get("pg_id") is not None:
+            return
+        with self._lock:
+            for k, v in spec.get("demand", {}).items():
+                n = self._committed.get(k, 0.0) - v
+                if n <= 1e-9:
+                    self._committed.pop(k, None)
+                else:
+                    self._committed[k] = n
 
     # -- task state records (state API) -----------------------------------
 
@@ -395,6 +509,7 @@ class NodeAgent:
 
     def _dispatch_one(self, spec: dict):
         if self._consume_cancel(spec.get("task_id")):
+            self._uncommit(spec)
             self._cancel_spec(spec)
             return
         demand = spec.get("demand", {})
@@ -413,6 +528,7 @@ class NodeAgent:
                 time.sleep(0.01)
         else:
             acquired = pool.acquire(demand, timeout=300.0)
+            self._uncommit(spec)  # demand now reflected in pool (or failed)
         if not acquired:
             self._fail_task(spec, f"resources {demand} unavailable")
             return
@@ -434,6 +550,7 @@ class NodeAgent:
             w = self._checkout_worker(
                 env_key=env_key,
                 resolved_env=rtenv,
+                dedicated=bool(spec.get("actor_create")),
             )
         except (TimeoutError, RuntimeError, OSError) as e:
             # RuntimeError/OSError: runtime-env materialization failed
@@ -577,6 +694,7 @@ class NodeAgent:
             else:
                 spec = None
         if spec is not None:
+            self._uncommit(spec)
             self._cancel_spec(spec)
             return True
         with self._lock:

@@ -38,6 +38,10 @@ _DEFS: dict[str, tuple[type, Any]] = {
     "workers_per_cpu": (int, 4),
     "worker_start_timeout_s": (float, 60.0),
     "worker_min_pool": (int, 4),
+    # Plain-env workers forked at agent boot (worker_pool.cc prestart);
+    # 0 disables. The delay keeps mass cluster boots from fork-storming.
+    "worker_prestart_per_cpu": (float, 1.0),
+    "worker_prestart_delay_s": (float, 2.0),
     # -- object plane ------------------------------------------------------
     "object_store_capacity_bytes": (int, 512 << 20),
     "transfer_chunk_bytes": (int, 4 << 20),
@@ -51,6 +55,15 @@ _DEFS: dict[str, tuple[type, Any]] = {
     # -- tasks -------------------------------------------------------------
     "task_default_max_retries": (int, 3),
     "pending_task_timeout_s": (float, 120.0),
+    # How long a caller blocks for an actor's registration to appear on
+    # the head (mass actor creation forks one process per actor; deep
+    # bursts need room).
+    "actor_register_timeout_s": (float, 60.0),
+    # Lease pipelining (direct_task_transport.h analog): how many specs a
+    # client batches into one schedule/submit RPC. (Leased-push admission
+    # itself is capacity-based, not depth-based — see
+    # node_agent.rpc_submit_tasks_leased.)
+    "submit_batch_max": (int, 256),
     # -- pubsub ------------------------------------------------------------
     "pubsub_max_buffer": (int, 10_000),
     "pubsub_subscriber_ttl_s": (float, 120.0),

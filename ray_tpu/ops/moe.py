@@ -124,30 +124,10 @@ def moe_ffn_ep(params: dict, x: jax.Array, mesh: Mesh, *,
     if e % ep:
         raise ValueError(f"n_experts {e} must divide by ep={ep}")
 
-    def local(px, p_router, p_win, p_wout):
-        b, t, d = px.shape
-        x2d = px.reshape(b * t, d)
-        capacity = max(1, int(capacity_factor * (b * t) / e))
-        dispatch, combine, aux = _route(x2d, p_router, e, top_k, capacity)
-        # [E, C, D] on this device -> exchange so device i holds expert
-        # rows for its local experts from ALL devices' tokens:
-        expert_in = jnp.einsum("tec,td->ecd", dispatch, x2d.astype(jnp.float32))
-        # [E, C, D] -> [E/ep, ep*C, D]: split experts, concat capacity.
-        expert_in = jax.lax.all_to_all(
-            expert_in, axis, split_axis=0, concat_axis=1, tiled=True
-        )
-        h = activation(jnp.einsum(
-            "ecd,edf->ecf", expert_in, p_win.astype(jnp.float32)
-        ))
-        expert_out = jnp.einsum("ecf,efd->ecd", h, p_wout.astype(jnp.float32))
-        # Route back: [E/ep, ep*C, D] -> [E, C, D].
-        expert_out = jax.lax.all_to_all(
-            expert_out, axis, split_axis=1, concat_axis=0, tiled=True
-        )
-        out = jnp.einsum("tec,ecd->td", combine, expert_out)
-        aux = jax.lax.pmean(aux, axis)
-        return out.reshape(b, t, d).astype(px.dtype), aux
-
+    local = functools.partial(
+        moe_ffn_ep_local, n_experts=e, axis=axis, top_k=top_k,
+        capacity_factor=capacity_factor, activation=activation,
+    )
     xspec = P(batch_axes, None, None)
     fn = shard_map(
         local,
@@ -157,3 +137,36 @@ def moe_ffn_ep(params: dict, x: jax.Array, mesh: Mesh, *,
         check_vma=False,
     )
     return fn(x, params["router"], params["w_in"], params["w_out"])
+
+
+def moe_ffn_ep_local(px, p_router, p_win, p_wout, *, n_experts: int,
+                     axis: str = "ep", top_k: int = 1,
+                     capacity_factor: float = 1.25,
+                     activation=jax.nn.gelu):
+    """Per-device expert-parallel FFN body — usable inside ANY shard_map
+    whose mesh has an ``axis`` dimension (e.g. a pipeline stage under the
+    ``pp`` shard_map), not just the one ``moe_ffn_ep`` builds. w_in/w_out
+    carry this device's E/ep expert slices; the router is replicated."""
+    e = n_experts
+    b, t, d = px.shape
+    x2d = px.reshape(b * t, d)
+    capacity = max(1, int(capacity_factor * (b * t) / e))
+    dispatch, combine, aux = _route(x2d, p_router, e, top_k, capacity)
+    # [E, C, D] on this device -> exchange so device i holds expert
+    # rows for its local experts from ALL devices' tokens:
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x2d.astype(jnp.float32))
+    # [E, C, D] -> [E/ep, ep*C, D]: split experts, concat capacity.
+    expert_in = jax.lax.all_to_all(
+        expert_in, axis, split_axis=0, concat_axis=1, tiled=True
+    )
+    h = activation(jnp.einsum(
+        "ecd,edf->ecf", expert_in, p_win.astype(jnp.float32)
+    ))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, p_wout.astype(jnp.float32))
+    # Route back: [E/ep, ep*C, D] -> [E, C, D].
+    expert_out = jax.lax.all_to_all(
+        expert_out, axis, split_axis=1, concat_axis=0, tiled=True
+    )
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    aux = jax.lax.pmean(aux, axis)
+    return out.reshape(b, t, d).astype(px.dtype), aux

@@ -23,6 +23,19 @@ from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, make_vec_env
 from ray_tpu.rllib.env import Pendulum
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentGridWorld,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
+from ray_tpu.rllib.offline import (
+    JsonReader,
+    JsonWriter,
+    OfflineDQN,
+    collect_transitions,
+    read_sample_batches,
+)
 from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig, RolloutWorker, policy_apply
 from ray_tpu.rllib.sample_batch import SampleBatch
@@ -43,4 +56,13 @@ __all__ = [
     "RolloutWorker",
     "policy_apply",
     "SampleBatch",
+    "MultiAgentEnv",
+    "MultiAgentGridWorld",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "JsonReader",
+    "JsonWriter",
+    "OfflineDQN",
+    "collect_transitions",
+    "read_sample_batches",
 ]

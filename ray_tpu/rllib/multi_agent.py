@@ -1,0 +1,371 @@
+"""Multi-agent RL: env API, per-policy batches, multi-policy PPO.
+
+Reference parity: ``rllib/env/multi_agent_env.py:24`` (dict-keyed
+obs/action/reward spaces per agent), ``rllib/policy/policy_map.py`` (a map
+of independently-updated policies) and the config's
+``multi_agent(policies=..., policy_mapping_fn=...)`` surface — rebuilt
+TPU-native: the env is vmapped jax code, the agent set and the
+agent->policy mapping are static, so the multi-agent rollout AND every
+policy's PPO update compile into ONE jitted train iteration.
+
+* ``MultiAgentEnv`` — the Python-level API contract (host envs / external
+  simulators), matching the reference's reset/step dict shapes;
+* ``MultiAgentGridWorld`` — a jax N-agent gridworld (each agent walks to
+  its own goal corner; per-agent shaped rewards);
+* ``MultiAgentPPO`` — one policy per policy_id, agents routed by
+  ``policy_mapping``; each policy trains on the concatenated batches of
+  ITS agents only.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.optim import adam_step as _adam
+from ray_tpu.rllib.ppo import policy_apply, policy_init
+
+
+class MultiAgentEnv:
+    """API contract for host-side multi-agent envs (reference
+    ``env/multi_agent_env.py:24``): dict-keyed per-agent views.
+
+    ``reset() -> {agent_id: obs}``
+    ``step({agent_id: action}) -> (obs_dict, reward_dict, done_dict, info)``
+    where ``done_dict`` carries the special key ``"__all__"``.
+    """
+
+    agent_ids: tuple = ()
+
+    def reset(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, Any]):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# jax gridworld
+# ---------------------------------------------------------------------------
+
+
+class GridState(NamedTuple):
+    pos: jax.Array  # [n_agents, 2] int32
+    t: jax.Array
+
+
+class MultiAgentGridWorld:
+    """N agents on a size x size grid, each assigned a goal corner; actions
+    are the 4 moves; reward = potential-based shaping toward the agent's
+    own goal + terminal bonus. Episodes are fixed-horizon with auto-reset
+    (vmap/scan friendly: no dynamic shapes)."""
+
+    observation_size = 4  # own (x, y), goal (x, y) — normalized
+    num_actions = 4       # up, down, left, right
+
+    def __init__(self, size: int = 5, n_agents: int = 2,
+                 max_steps: int = 24):
+        self.size = size
+        self.n_agents = n_agents
+        self.max_steps = max_steps
+        self.agent_ids = tuple(f"agent_{i}" for i in range(n_agents))
+        corners = jnp.array(
+            [[size - 1, size - 1], [0, 0], [size - 1, 0], [0, size - 1]],
+            jnp.int32)
+        self.goals = jnp.stack(
+            [corners[i % 4] for i in range(n_agents)])  # [n_agents, 2]
+
+    def reset(self, rng: jax.Array) -> GridState:
+        pos = jax.random.randint(
+            rng, (self.n_agents, 2), 0, self.size, jnp.int32)
+        return GridState(pos, jnp.zeros((), jnp.int32))
+
+    def obs(self, s: GridState) -> jax.Array:
+        """[n_agents, 4] — each row is that agent's view."""
+        scale = 1.0 / max(self.size - 1, 1)
+        return jnp.concatenate(
+            [s.pos.astype(jnp.float32) * scale,
+             self.goals.astype(jnp.float32) * scale], axis=1)
+
+    def step(self, s: GridState, actions: jax.Array, rng: jax.Array):
+        """actions: [n_agents] int -> (state, obs, rewards [n_agents],
+        done). Auto-resets on the shared fixed horizon."""
+        moves = jnp.array(
+            [[0, 1], [0, -1], [-1, 0], [1, 0]], jnp.int32)
+        nxt = jnp.clip(s.pos + moves[actions], 0, self.size - 1)
+        d_old = jnp.abs(s.pos - self.goals).sum(axis=1).astype(jnp.float32)
+        d_new = jnp.abs(nxt - self.goals).sum(axis=1).astype(jnp.float32)
+        at_goal = (d_new == 0).astype(jnp.float32)
+        rewards = 0.1 * (d_old - d_new) + at_goal * 1.0 - 0.01
+        t = s.t + 1
+        done = t >= self.max_steps
+        fresh = self.reset(rng)
+        state = GridState(
+            jnp.where(done, fresh.pos, nxt),
+            jnp.where(done, fresh.t, t),
+        )
+        return state, self.obs(state), rewards, done
+
+
+# ---------------------------------------------------------------------------
+# multi-policy PPO
+# ---------------------------------------------------------------------------
+
+
+class MultiAgentPPOConfig:
+    """``.multi_agent(policies=..., policy_mapping=...)`` mirrors the
+    reference's AlgorithmConfig.multi_agent surface."""
+
+    def __init__(self):
+        self.env = MultiAgentGridWorld()
+        self.num_envs = 32
+        self.rollout_length = 64
+        self.gamma = 0.99
+        self.gae_lambda = 0.95
+        self.clip_param = 0.2
+        self.lr = 3e-3
+        self.entropy_coeff = 0.01
+        self.vf_coeff = 0.5
+        self.num_sgd_iter = 4
+        self.minibatch_count = 4
+        self.grad_clip = 0.5
+        self.hidden_sizes = (64, 64)
+        self.policies: tuple = ()            # policy ids
+        self.policy_mapping: Dict[str, str] = {}  # agent_id -> policy_id
+        self.seed = 0
+
+    def environment(self, env=None) -> "MultiAgentPPOConfig":
+        if env is not None:
+            self.env = env
+        return self
+
+    def multi_agent(self, *, policies=None,
+                    policy_mapping=None) -> "MultiAgentPPOConfig":
+        if policies is not None:
+            self.policies = tuple(policies)
+        if policy_mapping is not None:
+            self.policy_mapping = dict(policy_mapping)
+        return self
+
+    def rollouts(self, *, num_envs: Optional[int] = None,
+                 rollout_length: Optional[int] = None):
+        if num_envs is not None:
+            self.num_envs = num_envs
+        if rollout_length is not None:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, **kwargs) -> "MultiAgentPPOConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+def _make_ma_train_iter(cfg: MultiAgentPPOConfig):
+    env = cfg.env
+    agent_ids = env.agent_ids
+    n_agents = len(agent_ids)
+    n_envs, t_len = cfg.num_envs, cfg.rollout_length
+    # agent index -> policy id (static; baked into the jitted program).
+    agent_policy = [cfg.policy_mapping[a] for a in agent_ids]
+
+    def vreset(rng):
+        return jax.vmap(env.reset)(jax.random.split(rng, n_envs))
+
+    def vobs(states):
+        return jax.vmap(env.obs)(states)  # [n_envs, n_agents, obs]
+
+    def vstep(states, actions, rng):
+        return jax.vmap(env.step)(
+            states, actions, jax.random.split(rng, n_envs))
+
+    def apply_per_agent(policies, obs):
+        """obs [n_envs, n_agents, D] -> (logits, values) stacked on the
+        agent axis, each agent through ITS policy (static routing)."""
+        logits, values = [], []
+        for i in range(n_agents):
+            lg, v = policy_apply(policies[agent_policy[i]], obs[:, i])
+            logits.append(lg)
+            values.append(v)
+        return jnp.stack(logits, 1), jnp.stack(values, 1)
+
+    def sample_rollout(policies, states, rng):
+        def step_fn(carry, _):
+            states, rng = carry
+            rng, k_act, k_step = jax.random.split(rng, 3)
+            obs = vobs(states)                       # [E, A, D]
+            logits, values = apply_per_agent(policies, obs)
+            action = jax.random.categorical(k_act, logits)  # [E, A]
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), action[..., None], -1)[..., 0]
+            nstates, _, rewards, done = vstep(states, action, k_step)
+            out = {"obs": obs, "actions": action, "rewards": rewards,
+                   "dones": done, "logp": logp, "values": values}
+            return (nstates, rng), out
+
+        (states, rng), traj = jax.lax.scan(
+            step_fn, (states, rng), None, length=t_len)
+        return states, rng, traj  # leaves [T, E, (A,) ...]
+
+    def compute_gae(traj, last_values):
+        """Per-agent GAE over the shared done signal."""
+        def scan_fn(adv, x):
+            reward, done, value, next_value = x
+            nonterm = 1.0 - done[:, None].astype(jnp.float32)
+            delta = reward + cfg.gamma * next_value * nonterm - value
+            adv = delta + cfg.gamma * cfg.gae_lambda * nonterm * adv
+            return adv, adv
+
+        values = traj["values"]                       # [T, E, A]
+        next_values = jnp.concatenate(
+            [values[1:], last_values[None]], axis=0)
+        _, advs = jax.lax.scan(
+            scan_fn, jnp.zeros_like(last_values),
+            (traj["rewards"], traj["dones"], values, next_values),
+            reverse=True)
+        return advs, advs + values
+
+    def ppo_loss(params, batch):
+        logits, value = policy_apply(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], 1)[:, 0]
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["adv"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg = -jnp.mean(jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv))
+        vf = jnp.mean((value - batch["returns"]) ** 2)
+        ent = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+        return pg + cfg.vf_coeff * vf - cfg.entropy_coeff * ent
+
+    def sgd_policy(params, opt, flat, rng):
+        n = flat["obs"].shape[0]
+        mb = n // cfg.minibatch_count
+
+        def epoch(carry, _):
+            params, opt, rng = carry
+            rng, k = jax.random.split(rng)
+            perm = jax.random.permutation(k, n)
+
+            def mb_step(carry, i):
+                params, opt = carry
+                idx = jax.lax.dynamic_slice_in_dim(perm, i * mb, mb)
+                batch = jax.tree.map(lambda x: x[idx], flat)
+                loss, grads = jax.value_and_grad(ppo_loss)(params, batch)
+                params, opt = _adam(params, opt, grads, lr=cfg.lr,
+                                    max_grad_norm=cfg.grad_clip, eps=1e-5)
+                return (params, opt), loss
+
+            (params, opt), losses = jax.lax.scan(
+                mb_step, (params, opt), jnp.arange(cfg.minibatch_count))
+            return (params, opt, rng), losses
+
+        (params, opt, rng), losses = jax.lax.scan(
+            epoch, (params, opt, rng), None, length=cfg.num_sgd_iter)
+        return params, opt, losses[-1, -1]
+
+    @jax.jit
+    def train_iter(policies, opts, states, rng):
+        states, rng, traj = sample_rollout(policies, states, rng)
+        _, last_values = apply_per_agent(policies, vobs(states))
+        advs, returns = compute_gae(traj, last_values)
+        obs_size = env.observation_size
+
+        metrics = {}
+        new_policies, new_opts = dict(policies), dict(opts)
+        for pid in cfg.policies:
+            # Per-policy batch: concat the columns of every agent mapped
+            # to this policy (reference policy_map semantics).
+            mine = [i for i in range(n_agents) if agent_policy[i] == pid]
+            flat = {
+                "obs": jnp.concatenate(
+                    [traj["obs"][:, :, i].reshape(-1, obs_size)
+                     for i in mine]),
+                "actions": jnp.concatenate(
+                    [traj["actions"][:, :, i].reshape(-1) for i in mine]),
+                "logp": jnp.concatenate(
+                    [traj["logp"][:, :, i].reshape(-1) for i in mine]),
+                "adv": jnp.concatenate(
+                    [advs[:, :, i].reshape(-1) for i in mine]),
+                "returns": jnp.concatenate(
+                    [returns[:, :, i].reshape(-1) for i in mine]),
+            }
+            rng, k = jax.random.split(rng)
+            p, o, loss = sgd_policy(policies[pid], opts[pid], flat, k)
+            new_policies[pid] = p
+            new_opts[pid] = o
+            metrics[f"{pid}/loss"] = loss
+            metrics[f"{pid}/reward_mean"] = jnp.mean(jnp.stack(
+                [traj["rewards"][:, :, i] for i in mine]))
+        return new_policies, new_opts, states, rng, metrics
+
+    return vreset, train_iter
+
+
+class MultiAgentPPO:
+    """Algorithm (Trainable contract) with one policy per policy_id."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        env = config.env
+        if not config.policies:
+            config.policies = ("default",)
+            config.policy_mapping = {a: "default" for a in env.agent_ids}
+        missing = [a for a in env.agent_ids
+                   if a not in config.policy_mapping]
+        if missing:
+            raise ValueError(f"agents with no policy mapping: {missing}")
+        self.config = config
+        rng = jax.random.key(config.seed)
+        keys = jax.random.split(rng, len(config.policies) + 2)
+        self.policies = {
+            pid: policy_init(
+                keys[i], env.observation_size, env.num_actions,
+                config.hidden_sizes)
+            for i, pid in enumerate(config.policies)
+        }
+        self.opts = {
+            pid: {
+                "mu": jax.tree.map(jnp.zeros_like, p),
+                "nu": jax.tree.map(jnp.zeros_like, p),
+                "t": jnp.zeros((), jnp.int32),
+            }
+            for pid, p in self.policies.items()
+        }
+        self._reset, self._train_iter = _make_ma_train_iter(config)
+        self._states = self._reset(keys[-2])
+        self._rng = keys[-1]
+        self._iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        (self.policies, self.opts, self._states, self._rng,
+         metrics) = self._train_iter(
+            self.policies, self.opts, self._states, self._rng)
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter":
+                self.config.num_envs * self.config.rollout_length,
+            "time_this_iter_s": time.perf_counter() - start,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def compute_single_action(self, agent_id: str, obs) -> int:
+        pid = self.config.policy_mapping[agent_id]
+        logits, _ = policy_apply(self.policies[pid], jnp.asarray(obs)[None])
+        return int(jnp.argmax(logits[0]))

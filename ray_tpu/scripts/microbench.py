@@ -58,9 +58,16 @@ def run_all(num_nodes: int = 2, cpus_per_node: int = 4) -> dict:
                 self.n += 1
                 return self.n
 
-        # Warm the worker pool so measurements exclude process forks.
-        ray_tpu.get([noop.remote() for _ in range(cpus_per_node * num_nodes)],
-                    timeout=60)
+        # Warm every node's worker pool so measurements exclude process
+        # forks (SPREAD defeats the prefer-local fast path, which would
+        # otherwise keep the warmup on the driver's node).
+        ray_tpu.get(
+            [
+                noop.options(scheduling_strategy="SPREAD").remote()
+                for _ in range(2 * cpus_per_node * num_nodes)
+            ],
+            timeout=120,
+        )
 
         # 1. tasks, sync: submit one, wait, repeat.
         n = 200
@@ -148,12 +155,27 @@ def main() -> None:
     ap.add_argument("--cpus", type=int, default=4)
     args = ap.parse_args()
     results = run_all(args.nodes, args.cpus)
+    # Preserve sections other writers own (scalebench.py merges its
+    # "scalability" results into the same file).
+    extra = {}
+    import os
+
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prior = json.load(f)
+            extra = {k: v for k, v in prior.items()
+                     if k not in ("cmd", "backend", "nodes",
+                                  "cpus_per_node", "metrics")}
+        except (OSError, ValueError):
+            pass
     payload = {
         "cmd": " ".join(sys.argv),
         "backend": "cluster",
         "nodes": args.nodes,
         "cpus_per_node": args.cpus,
         "metrics": results,
+        **extra,
     }
     with open(args.out, "w") as f:
         json.dump(payload, f, indent=2)

@@ -1,0 +1,150 @@
+"""Control-plane scalability benchmark: many nodes, deep task queues,
+actor fan-out, cluster-wide object broadcast.
+
+Mirrors the reference's scalability envelope harness
+(``release/benchmarks/README.md:8-31``: 250+ nodes, 10k+ tasks, 1M queued,
+10k actors, 1 GiB broadcast to 50+ nodes) scaled to one machine: N raylet
+processes on one host (the ``cluster_utils.Cluster`` trick the reference
+uses for multi-node tests, ``python/ray/cluster_utils.py:99``).
+
+Usage:
+    python -m ray_tpu.scripts.scalebench [--nodes 16] [--cpus 2]
+        [--tasks 2000] [--actors 200] [--broadcast-mb 256]
+        [--out MICROBENCH.json]
+
+With --out pointing at MICROBENCH.json the results merge under a
+"scalability" key (the per-op numbers from microbench.py stay put).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def run(nodes: int = 16, cpus: int = 2, tasks: int = 2000,
+        actors: int = 200, broadcast_mb: int = 256) -> dict:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    out: dict = {"nodes": nodes, "cpus_per_node": cpus}
+
+    def record(name, value, unit):
+        out[name] = {"value": round(value, 2), "unit": unit}
+        print(f"{name}: {value:,.2f} {unit}", file=sys.stderr, flush=True)
+
+    ray_tpu.shutdown()
+    t0 = time.perf_counter()
+    cluster = Cluster()
+    for _ in range(nodes):
+        cluster.add_node(num_cpus=cpus)
+    cluster.wait_for_nodes(timeout=30.0 + 5.0 * nodes)
+    record("cluster_boot_s", time.perf_counter() - t0, "s")
+    ray_tpu.init(cluster.address)
+
+    try:
+        @ray_tpu.remote
+        def noop():
+            return os.environ.get("RAY_TPU_NODE_ID")
+
+        # Warm pools everywhere (SPREAD defeats the prefer-local fast
+        # path so every node forks its workers before timing starts).
+        from ray_tpu.util.scheduling_strategies import (  # noqa: F401
+            NodeAffinitySchedulingStrategy,
+        )
+
+        warm = [
+            noop.options(scheduling_strategy="SPREAD").remote()
+            for _ in range(nodes * cpus)
+        ]
+        ray_tpu.get(warm, timeout=600)
+
+        # 1. Deep queue: submit `tasks` CPU:1 noops in one burst —
+        # ~tasks/(nodes*cpus) deep per slot — and drain.
+        t0 = time.perf_counter()
+        refs = [noop.remote() for _ in range(tasks)]
+        submit_dt = time.perf_counter() - t0
+        where = ray_tpu.get(refs, timeout=1200)
+        drain_dt = time.perf_counter() - t0
+        record("burst_submit_per_s", tasks / submit_dt, "ops/s")
+        record("burst_tasks_per_s", tasks / drain_dt, "ops/s")
+        record("burst_nodes_used", float(len(set(where))), "nodes")
+
+        # 2. Actor fan-out: create `actors` zero-CPU actors, call each
+        # once (reference envelope: 10k+ actors cluster-wide).
+        @ray_tpu.remote(num_cpus=0)
+        class Probe:
+            def pid(self):
+                return os.getpid()
+
+        t0 = time.perf_counter()
+        handles = [Probe.remote() for _ in range(actors)]
+        pids = ray_tpu.get(
+            [h.pid.remote() for h in handles], timeout=1200)
+        dt = time.perf_counter() - t0
+        record("actor_create_call_per_s", actors / dt, "ops/s")
+        record("actor_distinct_pids", float(len(set(pids))), "workers")
+        for h in handles:
+            ray_tpu.kill(h)
+
+        # 3. Broadcast: one large object pulled by every node (reference:
+        # 1 GiB broadcast to 50+ nodes via chunked node-to-node pulls).
+        blob = np.random.default_rng(0).integers(
+            0, 255, broadcast_mb * 1024 * 1024, dtype=np.uint8)
+        ref = ray_tpu.put(blob)
+
+        @ray_tpu.remote
+        def touch(x):
+            return int(x[-1]) + len(x) % 7
+
+        t0 = time.perf_counter()
+        sums = ray_tpu.get(
+            [
+                touch.options(scheduling_strategy="SPREAD").remote(ref)
+                for _ in range(nodes)
+            ],
+            timeout=1200,
+        )
+        dt = time.perf_counter() - t0
+        assert len(set(sums)) == 1
+        gib = broadcast_mb / 1024.0
+        record("broadcast_object_gib", gib, "GiB")
+        record("broadcast_nodes_per_s", nodes / dt, "nodes/s")
+        record("broadcast_agg_gib_per_s", gib * nodes / dt, "GiB/s")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=16)
+    ap.add_argument("--cpus", type=int, default=2)
+    ap.add_argument("--tasks", type=int, default=2000)
+    ap.add_argument("--actors", type=int, default=200)
+    ap.add_argument("--broadcast-mb", type=int, default=256)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    res = run(args.nodes, args.cpus, args.tasks, args.actors,
+              args.broadcast_mb)
+    print(json.dumps(res, indent=1))
+    if args.out:
+        merged = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                merged = json.load(f)
+        merged["scalability"] = res
+        with open(args.out, "w") as f:
+            json.dump(merged, f, indent=1)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

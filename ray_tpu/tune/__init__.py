@@ -13,9 +13,15 @@ from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantSearcher,
+    Searcher,
+    TPESearcher,
 )
 from ray_tpu.tune.search_space import (
     choice,
@@ -82,7 +88,11 @@ __all__ = [
     "TrialScheduler",
     "FIFOScheduler",
     "ASHAScheduler",
+    "HyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
+    "Searcher",
+    "BasicVariantSearcher",
+    "TPESearcher",
     "Checkpoint",
 ]

@@ -90,6 +90,55 @@ class ASHAScheduler(TrialScheduler):
         return CONTINUE
 
 
+class HyperBandScheduler(TrialScheduler):
+    """HyperBand (Li et al. 2017): several successive-halving brackets with
+    different exploration/exploitation trade-offs run side by side; new
+    trials deal round-robin into brackets, each bracket stops trials below
+    its top-1/eta quantile at its rung milestones.
+
+    Async-bracket formulation (the reference's
+    ``schedulers/async_hyperband.py`` with ``brackets=N``; its synchronous
+    ``hyperband.py`` blocks rungs on stragglers — deliberately avoided
+    here, same trade-off the reference recommends): bracket s has grace
+    period max_t * eta^-s, so s=0 never early-stops and higher s cut
+    earlier and more aggressively.
+    """
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 max_t: int = 81, eta: int = 3, brackets: int = 3,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.brackets = [
+            ASHAScheduler(
+                metric=metric, mode=mode, max_t=max_t,
+                grace_period=max(1, int(max_t * eta ** -s)),
+                reduction_factor=eta, time_attr=time_attr,
+            )
+            for s in range(brackets)
+        ]
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def _bracket_for(self, trial) -> "ASHAScheduler":
+        b = self._assignment.get(trial.trial_id)
+        if b is None:
+            b = self._assignment[trial.trial_id] = (
+                self._next % len(self.brackets))
+            self._next += 1
+        return self.brackets[b]
+
+    def on_trial_result(self, runner, trial, result: dict) -> str:
+        return self._bracket_for(trial).on_trial_result(
+            runner, trial, result)
+
+    def on_trial_complete(self, runner, trial, result) -> None:
+        if trial.trial_id in self._assignment:
+            self._bracket_for(trial).on_trial_complete(
+                runner, trial, result)
+
+
 class MedianStoppingRule(TrialScheduler):
     """Stop a trial whose running mean is below the median of completed
     means at the same step."""

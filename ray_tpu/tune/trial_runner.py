@@ -85,6 +85,9 @@ class TrialRunner:
         max_concurrent: int = 8,
         max_failures: int = 0,
         stopper=None,
+        searcher=None,
+        num_samples: int = 0,
+        trial_resources: Optional[dict] = None,
     ):
         self.trainable = trainable
         self.trials = trials
@@ -93,8 +96,26 @@ class TrialRunner:
         self.max_concurrent = max_concurrent
         self.max_failures = max_failures
         self.stopper = stopper  # RunConfig(stop=...) condition
+        # Ask/tell search (reference searcher.py:21): when set, trials are
+        # created lazily — suggest() sees completed results before it
+        # proposes the next config (model-based search needs that).
+        self.searcher = searcher
+        self.num_samples = num_samples
+        self.trial_resources = trial_resources
         self.queue = Queue()
         self._actor_cls = ray_tpu.remote(_TrialActor)
+
+    def _maybe_create_trial(self) -> Optional[Trial]:
+        if self.searcher is None or len(self.trials) >= self.num_samples:
+            return None
+        trial = Trial({}, self.trial_resources)
+        cfg = self.searcher.suggest(trial.trial_id)
+        if cfg is None:
+            return None  # exhausted, or waiting on results
+        trial.config = cfg
+        self.trials.append(trial)
+        self.by_id[trial.trial_id] = trial
+        return trial
 
     # -- lifecycle of one trial -------------------------------------------
 
@@ -211,6 +232,11 @@ class TrialRunner:
                 # (and possibly commit) a PG up front, hoarding cluster
                 # resources far beyond max_concurrent.
                 slots = len(running)
+                while (slots + len(pending) < self.max_concurrent):
+                    t = self._maybe_create_trial()
+                    if t is None:
+                        break
+                    pending.append(t)
                 for t in pending:
                     if slots >= self.max_concurrent:
                         break
@@ -222,6 +248,9 @@ class TrialRunner:
                         slots += 1  # PG pending: holds its slot
                 if not running and not any(
                         t.status == PENDING for t in self.trials):
+                    # With a searcher, idle + no new suggestion means the
+                    # search is exhausted (suggest() already saw every
+                    # completed result).
                     break
                 self._drain_queue()
                 self._poll_completions()
@@ -230,6 +259,11 @@ class TrialRunner:
                 self._stop_actor(t)
             self.queue.shutdown()
         return self.trials
+
+    def _notify_searcher_complete(self, trial, result, error=False):
+        if self.searcher is not None:
+            self.searcher.on_trial_complete(
+                trial.trial_id, result, error=error)
 
     def _drain_queue(self):
         try:
@@ -254,6 +288,8 @@ class TrialRunner:
         result.setdefault("training_iteration", msg["iteration"])
         trial.last_result = result
         trial.metrics_history.append(result)
+        if self.searcher is not None:
+            self.searcher.on_trial_result(trial.trial_id, result)
         if msg["checkpoint"] is not None:
             trial.checkpoint = msg["checkpoint"]
         if self.stopper is not None and self.stopper(
@@ -261,6 +297,7 @@ class TrialRunner:
             self._stop_actor(trial)
             trial.status = TERMINATED
             self.scheduler.on_trial_complete(self, trial, result)
+            self._notify_searcher_complete(trial, result)
             if self.stopper.stop_all():
                 for t in self.trials:
                     if t.status in (RUNNING, PENDING):
@@ -268,12 +305,14 @@ class TrialRunner:
                         t.status = TERMINATED
                         self.scheduler.on_trial_complete(
                             self, t, t.last_result or {})
+                        self._notify_searcher_complete(t, t.last_result)
             return
         decision = self.scheduler.on_trial_result(self, trial, result)
         if decision == STOP:
             self._stop_actor(trial)
             trial.status = TERMINATED
             self.scheduler.on_trial_complete(self, trial, result)
+            self._notify_searcher_complete(trial, result)
 
     def _drain_all_nowait(self):
         while True:
@@ -310,7 +349,9 @@ class TrialRunner:
                 trial.error = e
                 self._stop_actor(trial)
                 self.scheduler.on_trial_complete(self, trial, None)
+                self._notify_searcher_complete(trial, None, error=True)
                 continue
             trial.status = TERMINATED
             self._stop_actor(trial)
             self.scheduler.on_trial_complete(self, trial, trial.last_result)
+            self._notify_searcher_complete(trial, trial.last_result)

@@ -26,6 +26,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 8
     scheduler: Optional[TrialScheduler] = None
+    # Ask/tell search algorithm (reference tune.TuneConfig.search_alg);
+    # None = the BasicVariant grid x random expansion.
+    search_alg: Optional[Any] = None
     seed: Optional[int] = None
 
 
@@ -102,16 +105,27 @@ class Tuner:
         self.resources_per_trial = resources_per_trial
 
     def fit(self) -> ResultGrid:
-        variants = generate_variants(
-            self.param_space,
-            num_samples=self.tune_config.num_samples,
-            seed=self.tune_config.seed,
-        )
-        resources = self.resources_per_trial or getattr(
-            self.trainable, "_tune_resources", None)
-        trials = [Trial(cfg, resources) for cfg in variants]
         from ray_tpu.tune.stopper import coerce_stopper
 
+        resources = self.resources_per_trial or getattr(
+            self.trainable, "_tune_resources", None)
+        searcher = self.tune_config.search_alg
+        if searcher is not None:
+            ok = searcher.set_search_properties(
+                self.tune_config.metric, self.tune_config.mode,
+                self.param_space)
+            if not ok:
+                raise ValueError(
+                    "search_alg was constructed with its own space/metric; "
+                    "pass param_space/metric only in one place")
+            trials: List[Trial] = []
+        else:
+            variants = generate_variants(
+                self.param_space,
+                num_samples=self.tune_config.num_samples,
+                seed=self.tune_config.seed,
+            )
+            trials = [Trial(cfg, resources) for cfg in variants]
         runner = TrialRunner(
             self.trainable,
             trials,
@@ -119,8 +133,12 @@ class Tuner:
             max_concurrent=self.tune_config.max_concurrent_trials,
             max_failures=self.run_config.failure_config.max_failures,
             stopper=coerce_stopper(self.run_config.stop),
+            searcher=searcher,
+            num_samples=self.tune_config.num_samples,
+            trial_resources=resources,
         )
         runner.run()
+        trials = runner.trials
         results = [
             TrialResult(
                 t.trial_id, t.config, t.last_result, t.checkpoint, t.error,

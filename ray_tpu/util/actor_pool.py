@@ -2,11 +2,15 @@
 
 Reference parity: ``python/ray/util/actor_pool.py`` — same surface
 (map / map_unordered / submit / get_next / get_next_unordered / has_next /
-has_free / push / pop_idle).
+has_free / push / pop_idle). Internals are queue-structured rather than
+index-counted: submission order lives in one FIFO of futures that ordered
+consumption drains (lazily skipping entries already taken out of order),
+so there are no return-index bookkeeping counters to keep in sync.
 """
 
 from __future__ import annotations
 
+import collections
 from typing import Any, Callable, Iterable, List
 
 import ray_tpu
@@ -15,11 +19,15 @@ import ray_tpu
 class ActorPool:
     def __init__(self, actors: List[Any]):
         self._idle = list(actors)
-        self._future_to_actor: dict = {}
-        self._index_to_future: dict = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: list = []
+        # future -> the actor running it (membership = still in flight).
+        self._actor_of: dict = {}
+        # Futures in submission order; entries consumed unordered stay in
+        # the deque and are skipped lazily when an ordered get reaches
+        # them (reference behavior: mixing ordered/unordered gets skips
+        # past results already taken).
+        self._order: "collections.deque" = collections.deque()
+        # Submissions waiting for an actor to free up.
+        self._backlog: "collections.deque" = collections.deque()
 
     def map(self, fn: Callable, values: Iterable):
         """Apply fn(actor, value) over values, yielding results in order."""
@@ -38,14 +46,19 @@ class ActorPool:
         if self._idle:
             actor = self._idle.pop()
             future = fn(actor, value)
-            self._future_to_actor[future] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
+            self._actor_of[future] = actor
+            self._order.append(future)
         else:
-            self._pending_submits.append((fn, value))
+            self._backlog.append((fn, value))
 
     def has_next(self) -> bool:
-        return bool(self._future_to_actor) or bool(self._pending_submits)
+        return bool(self._actor_of) or bool(self._backlog)
+
+    def _oldest_pending(self):
+        """Front of the submission queue that is still in flight."""
+        while self._order and self._order[0] not in self._actor_of:
+            self._order.popleft()  # consumed unordered: skip
+        return self._order[0] if self._order else None
 
     def get_next(self, timeout: float | None = None):
         """Next result in submission order. A timeout leaves the pool
@@ -54,7 +67,7 @@ class ActorPool:
 
         if not self.has_next():
             raise StopIteration("no more results to get")
-        future = self._index_to_future[self._next_return_index]
+        future = self._oldest_pending()
         try:
             value = ray_tpu.get(future, timeout=timeout)
         except GetTimeoutError:
@@ -70,7 +83,7 @@ class ActorPool:
         if not self.has_next():
             raise StopIteration("no more results to get")
         ready, _ = ray_tpu.wait(
-            list(self._future_to_actor), num_returns=1, timeout=timeout
+            list(self._actor_of), num_returns=1, timeout=timeout
         )
         if not ready:
             raise TimeoutError("timed out waiting for a result")
@@ -82,29 +95,21 @@ class ActorPool:
         return value
 
     def _consume(self, future):
-        i, actor = self._future_to_actor.pop(future)
-        self._index_to_future.pop(i, None)
-        # Ordered gets resume past everything consumed out of order
-        # (reference behavior: mixing ordered/unordered skips indices).
-        if i >= self._next_return_index:
-            self._next_return_index = i + 1
-        self._return_actor(actor)
+        actor = self._actor_of.pop(future)
+        self._recycle(actor)
 
-    def _return_actor(self, actor):
+    def _recycle(self, actor):
         self._idle.append(actor)
-        if self._pending_submits:
-            fn, value = self._pending_submits.pop(0)
+        if self._backlog:
+            fn, value = self._backlog.popleft()
             self.submit(fn, value)
 
     def has_free(self) -> bool:
-        return bool(self._idle) and not self._pending_submits
+        return bool(self._idle) and not self._backlog
 
     def push(self, actor):
         """Add a new idle actor to the pool."""
-        self._idle.append(actor)
-        if self._pending_submits:
-            fn, value = self._pending_submits.pop(0)
-            self.submit(fn, value)
+        self._recycle(actor)
 
     def pop_idle(self):
         """Remove and return an idle actor, or None if none are idle."""

@@ -1,0 +1,158 @@
+"""Multi-agent envs + per-policy training, and offline RL
+(reference: ``rllib/env/multi_agent_env.py``, ``rllib/policy/policy_map.py``,
+``rllib/offline/json_reader.py``)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib import (
+    DQNConfig,
+    JsonReader,
+    JsonWriter,
+    MultiAgentGridWorld,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    OfflineDQN,
+    SampleBatch,
+    collect_transitions,
+)
+from ray_tpu.rllib.dqn import DQN
+
+
+def test_gridworld_dynamics():
+    env = MultiAgentGridWorld(size=5, n_agents=2, max_steps=8)
+    s = env.reset(jax.random.key(0))
+    assert s.pos.shape == (2, 2)
+    obs = env.obs(s)
+    assert obs.shape == (2, 4)
+    # Moving toward the goal yields positive shaped reward for that agent.
+    s2, obs2, rew, done = env.step(
+        s, jnp.asarray([0, 1]), jax.random.key(1))
+    assert rew.shape == (2,)
+    assert not bool(done)
+    # Fixed horizon: after max_steps the episode resets.
+    state = s
+    for t in range(8):
+        state, _, _, done = env.step(
+            state, jnp.asarray([0, 0]), jax.random.key(t + 2))
+    assert bool(done)
+    assert int(state.t) == 0  # auto-reset
+
+
+def test_two_policy_gridworld_learns():
+    """Two agents with different goals, one policy each: both policies'
+    rewards improve and the learned greedy actions walk each agent toward
+    ITS OWN goal (per-policy batches actually route)."""
+    env = MultiAgentGridWorld(size=5, n_agents=2, max_steps=16)
+    cfg = (
+        MultiAgentPPOConfig()
+        .environment(env)
+        .multi_agent(
+            policies=("walker_a", "walker_b"),
+            policy_mapping={"agent_0": "walker_a", "agent_1": "walker_b"},
+        )
+        .rollouts(num_envs=32, rollout_length=32)
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    first = algo.train()
+    for _ in range(14):
+        last = algo.train()
+    assert last["walker_a/reward_mean"] > first["walker_a/reward_mean"]
+    assert last["walker_b/reward_mean"] > first["walker_b/reward_mean"]
+    # Near-goal reward means both policies reach their corners often.
+    assert last["walker_a/reward_mean"] > 0.1, last
+    assert last["walker_b/reward_mean"] > 0.1, last
+
+    # Greedy check from the same mid-grid square: agent_0 must move toward
+    # (4,4) — up or right; agent_1 toward (0,0) — down or left.
+    state = type(env.reset(jax.random.key(3)))(
+        pos=jnp.asarray([[2, 2], [2, 2]], jnp.int32),
+        t=jnp.zeros((), jnp.int32),
+    )
+    obs = env.obs(state)
+    a0 = algo.compute_single_action("agent_0", np.asarray(obs[0]))
+    a1 = algo.compute_single_action("agent_1", np.asarray(obs[1]))
+    assert a0 in (0, 3), a0  # up or right
+    assert a1 in (1, 2), a1  # down or left
+
+
+def test_unmapped_agent_rejected():
+    env = MultiAgentGridWorld(n_agents=2)
+    cfg = MultiAgentPPOConfig().environment(env).multi_agent(
+        policies=("p0",), policy_mapping={"agent_0": "p0"})
+    with pytest.raises(ValueError, match="no policy mapping"):
+        cfg.build()
+
+
+# -- offline ---------------------------------------------------------------
+
+
+def test_json_writer_reader_roundtrip(tmp_path):
+    path = str(tmp_path / "batches.jsonl")
+    w = JsonWriter(path)
+    b1 = SampleBatch({
+        "obs": np.random.randn(5, 4).astype(np.float32),
+        "actions": np.array([0, 1, 0, 1, 1], np.int32),
+    })
+    b2 = SampleBatch({
+        "obs": np.random.randn(3, 4).astype(np.float32),
+        "actions": np.array([1, 1, 0], np.int32),
+    })
+    w.write(b1)
+    w.write(b2)
+    w.close()
+    back = list(JsonReader(path))
+    assert len(back) == 2
+    np.testing.assert_array_equal(back[0]["actions"], b1["actions"])
+    np.testing.assert_allclose(back[1]["obs"], b2["obs"], rtol=1e-6)
+    assert back[0]["obs"].dtype == np.float32
+
+
+def test_dqn_trains_from_saved_dataset(tmp_path):
+    """Behavior policy -> JSON dataset -> fresh OfflineDQN trains from it
+    and clearly beats a random-init policy on CartPole."""
+    cfg = (
+        DQNConfig()
+        .rollouts(num_envs=16)
+        .training(steps_per_iter=128, updates_per_iter=128,
+                  learning_starts=256, target_update_every=100,
+                  buffer_size=30_000)
+        .debugging(seed=0)
+    )
+    behavior = cfg.build()
+    for _ in range(6):  # a decent (not perfect) behavior policy
+        behavior.train()
+
+    path = str(tmp_path / "cartpole.jsonl")
+    writer = JsonWriter(path)
+    for chunk in range(4):
+        writer.write(collect_transitions(
+            behavior, 4000, epsilon=0.25, seed=chunk))
+    writer.close()
+
+    # Fresh learner from a DIFFERENT (bad) init; epsilon-noised eval (see
+    # OfflineDQN.evaluate — a lucky deterministic init can balance
+    # CartPole but can't recover from perturbations).
+    fresh_cfg = (
+        DQNConfig()
+        .rollouts(num_envs=16)
+        .training(steps_per_iter=128, updates_per_iter=128,
+                  learning_starts=256, target_update_every=100,
+                  buffer_size=30_000)
+        .debugging(seed=1)
+    )
+    offline = OfflineDQN(fresh_cfg, dataset=path)
+    baseline = offline.evaluate(n_steps=1600)
+    # 10 iterations = ~1.3k gradient steps: enough to distill the behavior
+    # policy; offline DQN over-trained on a FIXED dataset eventually
+    # diverges (extrapolation error — the instability CQL-style methods
+    # address), so the test stops at the distillation point.
+    for _ in range(10):
+        res = offline.train()
+    assert res["timesteps_this_iter"] == 0  # no env interaction
+    trained = offline.evaluate(n_steps=1600)
+    assert trained > max(100.0, 3.0 * baseline), (baseline, trained)
