@@ -28,7 +28,23 @@ import os
 import zipfile
 
 KV_PREFIX = "rtenv:pkg:"
-_ALLOWED_KEYS = {"env_vars", "working_dir", "py_modules"}
+_ALLOWED_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
+
+
+def _pip_list(env: dict) -> list:
+    """Normalize the ``pip`` field: list[str] or {"packages": [...]}
+    (reference ``runtime_env/pip.py`` accepts both shapes)."""
+    pip = env.get("pip")
+    if pip is None:
+        return []
+    if isinstance(pip, dict):
+        pip = pip.get("packages", [])
+    if not (isinstance(pip, (list, tuple))
+            and all(isinstance(r, str) for r in pip)):
+        raise TypeError(
+            "runtime_env['pip'] must be a list of requirement strings "
+            "or {'packages': [...]}")
+    return list(pip)
 
 
 def validate(env: dict) -> None:
@@ -50,6 +66,7 @@ def validate(env: dict) -> None:
     for m in env.get("py_modules") or []:
         if not os.path.exists(m):
             raise ValueError(f"runtime_env py_module {m!r} does not exist")
+    _pip_list(env)
 
 
 def _zip_path(root: str) -> bytes:
@@ -99,6 +116,7 @@ def package(env: dict, kv_put) -> dict:
         upload(env["working_dir"], "working_dir")
     for m in env.get("py_modules") or []:
         upload(m, "py_module")
+    resolved["pip"] = _pip_list(env)
     resolved["env_key"] = env_key(resolved)
     return resolved
 
@@ -107,20 +125,80 @@ def env_key(resolved: dict) -> str:
     canon = json.dumps(
         {"env_vars": resolved.get("env_vars", {}),
          "packages": [(p["uri"], p["kind"]) for p in
-                      resolved.get("packages", [])]},
+                      resolved.get("packages", [])],
+         "pip": resolved.get("pip", [])},
         sort_keys=True,
     )
     return hashlib.sha256(canon.encode()).hexdigest()[:16]
 
 
+def _ensure_venv(pip_reqs: list, cache_root: str) -> str:
+    """Per-requirements-hash virtualenv (reference ``runtime_env/pip.py``:
+    one venv per pip spec, cached). Returns the venv python executable.
+
+    The venv is seeded with the PARENT interpreter's site-packages via a
+    ``.pth`` file rather than ``--system-site-packages`` alone: when the
+    cluster itself runs inside a venv (common container layout),
+    system-site only exposes the BASE interpreter's packages and jax/numpy
+    would vanish from workers. The child venv's own site-packages precede
+    the parent's on sys.path, so a pip-installed version shadows the
+    cluster-wide one — the isolation property the feature exists for.
+    Built in a tmp dir + atomic rename (concurrent builders: one wins,
+    losers clean up)."""
+    import glob
+    import shutil
+    import site
+    import subprocess
+    import sys
+
+    digest = hashlib.sha256(
+        json.dumps(pip_reqs, sort_keys=True).encode()).hexdigest()[:16]
+    dest = os.path.join(cache_root, f"venv-{digest}")
+    vpy = os.path.join(dest, "bin", "python")
+    if os.path.exists(vpy):
+        return vpy
+    tmp = dest + f".tmp.{os.getpid()}"
+    subprocess.run(
+        [sys.executable, "-m", "venv", "--system-site-packages", tmp],
+        check=True, capture_output=True, text=True)
+    parents = list(dict.fromkeys(
+        p for p in site.getsitepackages() + sys.path
+        if p.endswith("site-packages") and os.path.isdir(p)))
+    sitedirs = glob.glob(os.path.join(tmp, "lib", "python*",
+                                      "site-packages"))
+    for sd in sitedirs:
+        with open(os.path.join(sd, "zz_parent_site.pth"), "w") as f:
+            f.write("\n".join(parents) + "\n")
+    proc = subprocess.run(
+        [os.path.join(tmp, "bin", "python"), "-m", "pip", "install",
+         "--no-warn-script-location", "--disable-pip-version-check",
+         *pip_reqs],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise RuntimeError(
+            f"pip install {pip_reqs} failed: {proc.stderr[-800:]}")
+    try:
+        os.replace(tmp, dest)
+    except OSError:
+        # Lost the race to a concurrent build of the same spec.
+        shutil.rmtree(tmp, ignore_errors=True)
+    return vpy
+
+
 def ensure_local(resolved: dict, kv_get, cache_root: str) -> dict:
     """Materialize a resolved env on this node. Returns the worker-process
-    recipe: {"env_vars", "cwd", "py_paths"}. Package extraction is cached
+    recipe: {"env_vars", "cwd", "py_paths", "python"} ("python" is the
+    interpreter to spawn — a per-env virtualenv when pip packages are
+    requested, else None for the default). Package extraction is cached
     by content hash — concurrent ensures of the same URI extract into a
     tmp dir and rename (atomic; losers are no-ops)."""
     env_vars = dict(resolved.get("env_vars", {}))
     cwd = None
     py_paths: list[str] = []
+    python = None
+    if resolved.get("pip"):
+        python = _ensure_venv(resolved["pip"], cache_root)
     for pkg in resolved.get("packages", []):
         dest = os.path.join(cache_root, pkg["uri"])
         if not os.path.isdir(dest):
@@ -145,4 +223,5 @@ def ensure_local(resolved: dict, kv_get, cache_root: str) -> dict:
             py_paths.append(cwd)
         else:  # py_module: importable from the cache dir holding it
             py_paths.append(dest)
-    return {"env_vars": env_vars, "cwd": cwd, "py_paths": py_paths}
+    return {"env_vars": env_vars, "cwd": cwd, "py_paths": py_paths,
+            "python": python}

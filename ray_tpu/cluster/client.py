@@ -31,6 +31,51 @@ from ray_tpu.core.config import config
 from ray_tpu.core.resources import demand_of
 
 
+class _PullManager:
+    """Admission control for chunked remote pulls (the client-side analog
+    of ``src/ray/object_manager/pull_manager.h:48``): total in-flight
+    pulled bytes are capped per process, and blocked pulls are admitted
+    strictly by priority class — explicit ``get`` before ``wait``
+    prefetches before task-argument materialization — FIFO within a
+    class. A single pull larger than the cap is admitted alone (a huge
+    object must not deadlock)."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._waiters: list = []  # heap of (priority, seq)
+        self._seq = 0
+
+    def acquire(self, nbytes: int, priority: int) -> None:
+        import heapq
+
+        with self._cv:
+            seq = self._seq
+            self._seq += 1
+            heapq.heappush(self._waiters, (priority, seq))
+            while True:
+                cap = config.pull_max_inflight_bytes
+                at_front = self._waiters[0] == (priority, seq)
+                fits = self._inflight == 0 or \
+                    self._inflight + nbytes <= cap
+                if at_front and fits:
+                    heapq.heappop(self._waiters)
+                    self._inflight += nbytes
+                    self._cv.notify_all()
+                    return
+                self._cv.wait(0.5)
+
+    def release(self, nbytes: int) -> None:
+        with self._cv:
+            self._inflight -= nbytes
+            self._cv.notify_all()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"inflight_bytes": self._inflight,
+                    "queued": len(self._waiters)}
+
+
 class ClusterBackend:
     def __init__(self, head_address: str, *, node_id: str | None = None,
                  store_path: str | None = None, agent_address: str | None = None,
@@ -112,6 +157,12 @@ class ClusterBackend:
         self._submit_q: "_collections.deque[dict]" = _collections.deque()
         self._submit_cv = threading.Condition()
         self._dispatching = 0  # specs popped from the queue, mid-dispatch
+        self._retry_heap: list = []  # (due, seq, spec) — shared retry timer
+        self._retry_seq = 0
+        # Pull admission (get > wait > args, bounded in-flight bytes).
+        self._pulls = _PullManager()
+        self._pull_prio = threading.local()
+        self._prefetching: set[str] = set()
         # task_id -> borrowed oids held locally until borrow registration
         # reaches the head (so callers may drop arg handles immediately
         # even though dispatch is now asynchronous).
@@ -396,10 +447,36 @@ class ClusterBackend:
     # the config registry AT CALL TIME so env/override changes apply
     # without re-importing (RAY_TPU_TRANSFER_*).
 
+    PULL_GET, PULL_WAIT, PULL_ARGS = 0, 1, 2
+
+    def _pull_priority(self) -> int:
+        return getattr(self._pull_prio, "v", self.PULL_GET)
+
+    def pull_priority_override(self, prio: int):
+        """Context manager: pulls on this thread use the given class
+        (workers lower arg-materialization below explicit gets)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            prev = getattr(self._pull_prio, "v", None)
+            self._pull_prio.v = prio
+            try:
+                yield
+            finally:
+                if prev is None:
+                    del self._pull_prio.v
+                else:
+                    self._pull_prio.v = prev
+
+        return cm()
+
     def _pull_object(self, address: str, oid: str):
         """(meta, data) from a peer node: ONE round trip for small objects
         (data inlined in the info reply), bounded chunked streaming for
-        large ones."""
+        large ones — the latter admitted through the pull manager
+        (priority get > wait > args, total in-flight bytes capped:
+        pull_manager.h:48 admission control)."""
         chunk_size = config.transfer_chunk_bytes
         client = self._node_client(address)
         info = client.call(
@@ -409,7 +486,13 @@ class ClusterBackend:
         meta, size, inline = info
         if inline is not None:
             return meta, inline
+        self._pulls.acquire(size, self._pull_priority())
+        try:
+            return meta, self._pull_chunked(client, oid, size, chunk_size)
+        finally:
+            self._pulls.release(size)
 
+    def _pull_chunked(self, client, oid: str, size: int, chunk_size: int):
         buf = bytearray(size)
         offsets = list(range(0, size, chunk_size))
 
@@ -433,7 +516,7 @@ class ClusterBackend:
                 err = err or e
         if err is not None:
             raise err
-        return meta, buf
+        return buf
 
     def _pull_pool(self):
         """One long-lived chunk-pull executor per backend: its threads
@@ -577,12 +660,57 @@ class ClusterBackend:
                 if loc and loc["nodes"]:
                     ready.append(r)
                     pending.remove(r)
+                    if fetch_local:
+                        self._prefetch(r.id, loc["nodes"])
             if len(ready) >= num_returns:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
             time.sleep(0.005)
         return ready, pending
+
+    def _prefetch(self, oid: str, locations: list) -> None:
+        """``wait(fetch_local=True)`` semantics (reference: ready objects
+        are pulled to the caller's node): replicate the raw bytes into the
+        LOCAL store in the background at wait priority, so the eventual
+        ``get`` is a local read. Best-effort — failures leave the remote
+        copy authoritative."""
+        if any(node_id == self.node_id for node_id, _a, _s in locations):
+            return  # already local
+        with self._lock:
+            if oid in self._prefetching:
+                return
+            self._prefetching.add(oid)
+            pool = getattr(self, "_prefetch_pool", None)
+            if pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                # Separate from the chunk pool: a prefetch occupies a
+                # slot WHILE it submits chunk work there — sharing one
+                # executor would deadlock at saturation.
+                pool = self._prefetch_pool = ThreadPoolExecutor(
+                    2, thread_name_prefix="prefetch")
+
+        def job():
+            try:
+                with self.pull_priority_override(self.PULL_WAIT):
+                    for _node_id, address, _sp in locations:
+                        got = self._pull_object(address, oid)
+                        if got is None:
+                            continue
+                        meta, data = got
+                        self.store.put(oid, [bytes(data)], meta)
+                        self.head.call(
+                            "add_location", oid, self.node_id,
+                            meta[:1] == b"E", len(data))
+                        return
+            except BaseException:  # noqa: BLE001 — best-effort
+                pass
+            finally:
+                with self._lock:
+                    self._prefetching.discard(oid)
+
+        pool.submit(job)
 
     # -- internal KV -------------------------------------------------------
 
@@ -726,6 +854,7 @@ class ClusterBackend:
                 self._deref(oid)
 
     def _fail_spec(self, spec: dict, err: Exception) -> None:
+        spec["_handled"] = True
         self._drop_holds(spec)
         for oid in spec["oids"]:
             self._lineage.pop(oid, None)
@@ -745,10 +874,22 @@ class ClusterBackend:
                 and s["strategy"] is None and bool(spec["demand"]))
 
     def _submit_loop(self) -> None:
+        import heapq
+
         while True:
             with self._submit_cv:
-                while not self._submit_q and not self._closed:
-                    self._submit_cv.wait(0.5)
+                while True:
+                    now = time.monotonic()
+                    while (self._retry_heap
+                           and self._retry_heap[0][0] <= now):
+                        self._submit_q.append(
+                            heapq.heappop(self._retry_heap)[2])
+                    if self._submit_q or self._closed:
+                        break
+                    wait = 0.5
+                    if self._retry_heap:
+                        wait = min(wait, self._retry_heap[0][0] - now)
+                    self._submit_cv.wait(max(wait, 0.01))
                 if self._closed and not self._submit_q:
                     return
                 batch = []
@@ -759,10 +900,18 @@ class ClusterBackend:
                 # shutdown()'s drain cannot slip between the pop and the
                 # dispatch and release the submit holds early.
                 self._dispatching = len(batch)
+            for spec in batch:
+                spec.pop("_handled", None)
             try:
                 self._dispatch_batch(batch)
             except BaseException as e:  # noqa: BLE001 — submitter must live
+                # Fail only specs the dispatch never handed off anywhere:
+                # earlier specs in the batch may already be RUNNING on a
+                # node, and writing a TaskError over their oids would race
+                # their real results.
                 for spec in batch:
+                    if spec.get("_handled"):
+                        continue
                     try:
                         self._fail_spec(spec, TaskError(
                             spec.get("fname", "task"),
@@ -773,6 +922,44 @@ class ClusterBackend:
                 with self._submit_cv:
                     self._dispatching = 0
 
+    def _queue_retry(self, spec: dict, delay: float = 0.25) -> None:
+        """Park a temporarily unplaceable spec for ONE shared retry timer
+        (not a thread per spec): due specs re-enter the submit queue and
+        re-batch through the normal dispatch path."""
+        import heapq
+
+        spec["_handled"] = True
+        spec.setdefault("_pending_since", time.monotonic())
+        with self._submit_cv:
+            self._retry_seq += 1
+            heapq.heappush(
+                self._retry_heap,
+                (time.monotonic() + delay, self._retry_seq, spec))
+            self._submit_cv.notify()
+
+    def _park_pending(self, spec: dict) -> None:
+        """No feasible node right now: bounded retry via the shared timer
+        (the head has recorded the demand for the autoscaler), honoring
+        cancellation and the pending-task timeout."""
+        from ray_tpu.core.object_ref import TaskCancelledError
+
+        if spec.get("cancelled"):
+            self._end_borrows(spec)
+            self._fail_spec(
+                spec, TaskCancelledError(spec.get("fname", "task")))
+            return
+        since = spec.setdefault("_pending_since", time.monotonic())
+        timeout = config.pending_task_timeout_s
+        if time.monotonic() - since > timeout:
+            self._end_borrows(spec)
+            self._fail_spec(spec, TaskError(
+                spec.get("fname", "task"),
+                f"demand {spec['demand']} unsatisfiable for {timeout}s",
+                "infeasible",
+            ))
+            return
+        self._queue_retry(spec)
+
     def _dispatch_batch(self, batch: list) -> None:
         from ray_tpu.core.object_ref import TaskCancelledError
 
@@ -780,6 +967,7 @@ class ClusterBackend:
         local_specs: list[dict] = []
         for spec in batch:
             if spec.get("cancelled"):
+                self._end_borrows(spec)
                 self._fail_spec(
                     spec, TaskCancelledError(spec.get("fname", "task")))
                 continue
@@ -788,6 +976,7 @@ class ClusterBackend:
                 # the per-spec path (rare, latency-insensitive).
                 try:
                     self._submit_spec(spec, allow_pending=True)
+                    spec["_handled"] = True
                 except (ValueError, TimeoutError, ConnectionLost, OSError) as e:
                     self._fail_spec(spec, TaskError(
                         spec.get("fname", "task"), str(e), repr(e)))
@@ -804,40 +993,47 @@ class ClusterBackend:
             # never lose the race against the worker's task-end); a
             # rejected spec is re-registered by the head path
             # (begin-replaces semantics).
+            rejected: set = set()
             try:
                 agent = self._agent_client()
                 self._register_borrows_batch(local_specs, self.node_id)
                 for s in local_specs:
                     s["assigned_node"] = self.node_id
-                rejected = agent.call("submit_tasks_leased", local_specs)
+                rejected = set(agent.call(
+                    "submit_tasks_leased", local_specs))
             except (ConnectionLost, OSError, RuntimeError) as e:
-                # Ambiguous outcome: the agent may have enqueued the batch
-                # before the connection died. Resubmitting could fork a
-                # task into two executions — fail the refs instead (the
-                # old synchronous path surfaced the same condition as an
-                # error too).
-                for s in local_specs:
-                    self._end_borrows(s)
-                    self._fail_spec(s, TaskError(
-                        s.get("fname", "task"),
-                        f"local agent unreachable during submit: {e!r}",
-                        repr(e)))
-                local_specs = []
-                rejected = []
-            rejected = set(rejected)
-            for i in rejected:
-                # Spillback: local node saturated (or agent unreachable) —
-                # the head places these on the cluster view. The spilled
-                # flag tells it to avoid the caller's node: its heartbeat
-                # hasn't reflected the leased admissions that caused the
-                # rejection yet.
-                local_specs[i]["assigned_node"] = None
-                local_specs[i]["_spilled"] = True
-                head_specs.append(local_specs[i])
-            self._deliver_late_cancels(
-                [s for i, s in enumerate(local_specs)
-                 if i not in rejected],
-                self._agent_address)
+                if getattr(e, "maybe_executed", False):
+                    # The push itself died mid-call: the agent may have
+                    # enqueued the batch. Resubmitting could fork a task
+                    # into two executions — fail the refs instead.
+                    for s in local_specs:
+                        self._end_borrows(s)
+                        self._fail_spec(s, TaskError(
+                            s.get("fname", "task"),
+                            f"local agent unreachable during submit: "
+                            f"{e!r}", repr(e)))
+                    local_specs = []
+                else:
+                    # Nothing reached the agent (connect refused, borrow
+                    # registration failed, ...): the whole set spills to
+                    # head scheduling, exactly like a full local node.
+                    rejected = set(range(len(local_specs)))
+            for i, s in enumerate(local_specs):
+                if i in rejected:
+                    # Spillback: the head places these on the cluster
+                    # view. The spilled flag tells it to avoid the
+                    # caller's node: its heartbeat hasn't reflected the
+                    # leased admissions that caused the rejection yet.
+                    s["assigned_node"] = None
+                    s["_spilled"] = True
+                    head_specs.append(s)
+                else:
+                    s["_handled"] = True
+            if local_specs and len(rejected) < len(local_specs):
+                self._deliver_late_cancels(
+                    [s for i, s in enumerate(local_specs)
+                     if i not in rejected],
+                    self._agent_address)
 
         if not head_specs:
             return
@@ -853,6 +1049,7 @@ class ClusterBackend:
             placements = self.head.call("schedule_batch", reqs)
         except (ConnectionLost, OSError) as e:
             for s in head_specs:
+                self._end_borrows(s)
                 self._fail_spec(s, TaskError(
                     s.get("fname", "task"),
                     f"head unreachable during submit: {e!r}", repr(e)))
@@ -860,11 +1057,7 @@ class ClusterBackend:
         by_node: dict[tuple, list[dict]] = {}
         for spec, placed in zip(head_specs, placements):
             if placed is None:
-                # Infeasible now: park it on the pending-retry path (the
-                # head has recorded the demand for the autoscaler).
-                threading.Thread(
-                    target=self._retry_submit, args=(spec,), daemon=True
-                ).start()
+                self._park_pending(spec)
                 continue
             node_id, address = placed
             spec["assigned_node"] = node_id
@@ -873,18 +1066,17 @@ class ClusterBackend:
             try:
                 self._register_borrows_batch(specs, node_id)
                 self._node_client(address).call("submit_tasks", specs)
+                for s in specs:
+                    s["_handled"] = True
                 self._deliver_late_cancels(specs, address)
             except (ConnectionLost, OSError):
-                # Leave the borrow registrations in place: they pin the
+                # Leave any borrow registrations in place: they pin the
                 # args through the retry window (the caller may have
-                # dropped its handles already). _retry_submit re-registers
-                # on success (begin-replaces) and ends them on its error
-                # paths.
+                # dropped its handles already); the retried dispatch
+                # re-registers (begin-replaces) or ends them on failure.
                 for s in specs:
                     s["assigned_node"] = None
-                    threading.Thread(
-                        target=self._retry_submit, args=(s,), daemon=True
-                    ).start()
+                    self._queue_retry(s)
 
     def _retry_submit(self, spec: dict, timeout: float | None = None):
         from ray_tpu.core.object_ref import TaskCancelledError
@@ -1499,9 +1691,10 @@ class ClusterBackend:
             self._worker_clients.clear()
         for c in clients:
             c.close()
-        pool = getattr(self, "_chunk_pool", None)
-        if pool is not None:
-            pool.shutdown(wait=False)
+        for attr in ("_chunk_pool", "_prefetch_pool"):
+            pool = getattr(self, attr, None)
+            if pool is not None:
+                pool.shutdown(wait=False)
         if self.process_kind == "d":
             # Only drivers subscribe; workers have nothing to clean up.
             try:

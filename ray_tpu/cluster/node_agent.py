@@ -183,6 +183,7 @@ class NodeAgent:
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id
         cwd = None
+        python = sys.executable
         if resolved_env is not None:
             # Materialize packages (content-hash cached) and bake the env
             # into the subprocess: env_vars directly, py_modules +
@@ -208,6 +209,10 @@ class NodeAgent:
                 + ([prior] if prior else [])
             )
             cwd = recipe["cwd"]
+            if recipe.get("python"):
+                # pip env: the worker runs under the per-env virtualenv
+                # interpreter (its site-packages shadow the cluster's).
+                python = recipe["python"]
         # The pool is language-aware like the reference's (worker_pool.h:80
         # keys processes by language + runtime env): a "cpp::<bin>" key
         # spawns that native binary with the same worker flags the Python
@@ -215,7 +220,7 @@ class NodeAgent:
         if env_key.startswith("cpp::"):
             argv = [env_key[len("cpp::"):]]
         else:
-            argv = [sys.executable, "-m", "ray_tpu.cluster.workerproc"]
+            argv = [python, "-m", "ray_tpu.cluster.workerproc"]
         proc = subprocess.Popen(
             [
                 *argv,

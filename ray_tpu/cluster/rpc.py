@@ -323,12 +323,21 @@ class RpcClient:
                 f"connect to {self.address}: {e}") from e
         if timeout is not None:
             conn.settimeout(timeout)
+        sent = False
         try:
             _send_msg(conn, {"m": method, "a": args, "k": kwargs})
+            sent = True
             resp = _recv_msg(conn)
         except (OSError, EOFError, ConnectionLost) as e:
             self._drop_conn()
-            raise ConnectionLost(f"rpc {method} to {self.address}: {e}") from e
+            err = ConnectionLost(f"rpc {method} to {self.address}: {e}")
+            # Callers with non-idempotent requests need to know whether
+            # the peer might have EXECUTED this call. A connect/send
+            # failure cannot have (a partial length-prefixed frame never
+            # decodes); only a lost reply after a complete send is
+            # ambiguous.
+            err.maybe_executed = sent
+            raise err from e
         finally:
             if timeout is not None:
                 try:

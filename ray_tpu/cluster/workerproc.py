@@ -294,14 +294,20 @@ class WorkerHandler:
         return func
 
     def _resolve(self, args, kwargs):
-        args = [
-            self.backend.get([a])[0] if isinstance(a, ObjectRef) else a
-            for a in args
-        ]
-        kwargs = {
-            k: self.backend.get([v])[0] if isinstance(v, ObjectRef) else v
-            for k, v in kwargs.items()
-        }
+        # Argument materialization pulls at the LOWEST priority class
+        # (pull_manager.h ordering: get > wait > task args) — a worker
+        # hydrating a queued task's args must not starve a user's
+        # explicit ray.get.
+        with self.backend.pull_priority_override(self.backend.PULL_ARGS):
+            args = [
+                self.backend.get([a])[0] if isinstance(a, ObjectRef) else a
+                for a in args
+            ]
+            kwargs = {
+                k: self.backend.get([v])[0] if isinstance(v, ObjectRef)
+                else v
+                for k, v in kwargs.items()
+            }
         return args, kwargs
 
     def _store_result(self, spec, result):

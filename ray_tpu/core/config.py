@@ -47,6 +47,9 @@ _DEFS: dict[str, tuple[type, Any]] = {
     "transfer_chunk_bytes": (int, 4 << 20),
     "transfer_whole_fetch_max_bytes": (int, 8 << 20),
     "transfer_pull_concurrency": (int, 8),
+    # Cap on total in-flight chunked-pull bytes per process; blocked
+    # pulls admit by priority get > wait > args (pull_manager.h analog).
+    "pull_max_inflight_bytes": (int, 256 << 20),
     "spill_headroom_bytes": (int, 64 << 10),
     # -- memory protection -------------------------------------------------
     "memory_usage_threshold": (float, 0.95),

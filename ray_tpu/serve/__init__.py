@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
 import ray_tpu
+from ray_tpu.serve import _private
 from ray_tpu.serve._private import (
     CONTROLLER_NAME,
     DeploymentHandle,
@@ -204,13 +205,32 @@ class DAGDriver:
 
 
 def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> int:
-    """Start the HTTP ingress; returns the bound port."""
+    """Start a single HTTP ingress; returns the bound port. For one
+    ingress per node (reference default: an HTTPProxyActor on every node,
+    ``http_state.py:30``) use :func:`start_http_proxies`."""
     global _proxy_handle
     proxy_cls = ray_tpu.remote(HTTPProxy)
     _proxy_handle = proxy_cls.options(num_cpus=0, max_concurrency=16).remote(
         host, port
     )
     return ray_tpu.get(_proxy_handle.get_port.remote(), timeout=60)
+
+
+def start_http_proxies(host: str = "127.0.0.1") -> Dict[str, int]:
+    """One HTTP ingress per alive node, owned and kept alive by the
+    controller: a dead proxy (or a proxy whose node died) is recreated on
+    the next reconcile tick, and new nodes get proxies as they join.
+    Returns {node_id: port}; call :func:`proxy_ports` later for the
+    current mapping (recreated proxies bind fresh ports)."""
+    controller = _private.get_or_create_controller()
+    return ray_tpu.get(
+        controller.ensure_proxies.remote(host), timeout=120)
+
+
+def proxy_ports() -> Dict[str, int]:
+    """Current {node_id: port} of the controller-managed proxy fleet."""
+    controller = _private.get_or_create_controller()
+    return ray_tpu.get(controller.proxy_ports.remote(), timeout=30)
 
 
 def shutdown() -> None:
@@ -245,6 +265,8 @@ __all__ = [
     "delete",
     "status",
     "start_http_proxy",
+    "start_http_proxies",
+    "proxy_ports",
     "shutdown",
     "batch",
 ]

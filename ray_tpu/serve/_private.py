@@ -102,6 +102,14 @@ class ServeController:
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
         self._stop = False
+        # node_id -> {"handle", "port"}; goal set by ensure_proxies and
+        # maintained by the reconcile loop (http_state.py:30 analog).
+        self._proxies: Dict[str, dict] = {}
+        self._proxy_goal: Optional[dict] = None
+        # Serializes whole reconcile passes (the loop vs. concurrent
+        # ensure_proxies actor calls): check-then-create outside it would
+        # double-start proxies and leak the losers.
+        self._proxy_pass_lock = threading.Lock()
         threading.Thread(target=self._reconcile_loop, daemon=True).start()
 
     # -- goal-state writes --------------------------------------------------
@@ -199,6 +207,10 @@ class ServeController:
                 self._reconcile_once()
             except Exception:
                 pass  # next tick retries; the loop must never die
+            try:
+                self._reconcile_proxies()
+            except Exception:
+                pass
 
     def _reconcile_once(self):
         with self._lock:
@@ -296,6 +308,69 @@ class ServeController:
                     for r in started:
                         self._kill_replica(r)
 
+    # -- per-node HTTP proxies (http_state.py:30 analog) ---------------------
+
+    def ensure_proxies(self, host: str = "127.0.0.1") -> Dict[str, int]:
+        """Goal-state write: one HTTPProxy actor on EVERY alive node,
+        recreated by the reconcile loop when a proxy or its node dies —
+        the reference starts an HTTPProxyActor per node the same way.
+        Returns {node_id: port} (ports are ephemeral per proxy; a
+        recreated proxy reports a fresh one via proxy_ports)."""
+        with self._lock:
+            self._proxy_goal = {"host": host}
+        self._reconcile_proxies()
+        return self.proxy_ports()
+
+    def proxy_ports(self) -> Dict[str, int]:
+        with self._lock:
+            return {nid: p["port"] for nid, p in self._proxies.items()}
+
+    def _reconcile_proxies(self):
+        with self._proxy_pass_lock:
+            self._reconcile_proxies_locked()
+
+    def _reconcile_proxies_locked(self):
+        with self._lock:
+            goal = self._proxy_goal
+            current = dict(self._proxies)
+        if goal is None:
+            return
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        alive = {n["NodeID"] for n in ray_tpu.nodes() if n["Alive"]}
+        for nid in list(current):
+            if nid not in alive:
+                current.pop(nid, None)
+                with self._lock:
+                    self._proxies.pop(nid, None)
+        for nid in sorted(alive):
+            ent = current.get(nid)
+            if ent is not None:
+                try:
+                    ray_tpu.get(ent["handle"].get_port.remote(), timeout=10)
+                    continue  # healthy
+                except Exception:
+                    try:
+                        ray_tpu.kill(ent["handle"])
+                    except Exception:
+                        pass
+                    with self._lock:
+                        self._proxies.pop(nid, None)
+            proxy_cls = ray_tpu.remote(HTTPProxy)
+            handle = proxy_cls.options(
+                num_cpus=0, max_concurrency=16,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(nid),
+            ).remote(goal["host"], 0)
+            try:
+                port = ray_tpu.get(handle.get_port.remote(), timeout=60)
+            except Exception:
+                self._kill_replica(handle)
+                continue  # node may be going away; next tick retries
+            with self._lock:
+                self._proxies[nid] = {"handle": handle, "port": port}
+
     # -- config plane ---------------------------------------------------------
 
     def get_routing_table(self):
@@ -337,6 +412,15 @@ class ServeController:
         self._stop = True
         for name in list(self.apps):
             self.delete_deployment(name)
+        with self._lock:
+            proxies, self._proxies = dict(self._proxies), {}
+            self._proxy_goal = None
+        for ent in proxies.values():
+            try:
+                ray_tpu.get(ent["handle"].stop.remote(), timeout=5)
+            except Exception:
+                pass
+            self._kill_replica(ent["handle"])
         return True
 
 

@@ -148,3 +148,71 @@ def test_bad_runtime_env_rejected(cluster):
 
     with pytest.raises(ValueError):
         never.remote()
+
+
+def _build_wheel(tmp_path, version: str) -> str:
+    """Build a local wheel for graftdemo_rt==<version> with the system
+    interpreter (offline: no index access needed to install a wheel)."""
+    import subprocess
+
+    src = tmp_path / f"src_{version.replace('.', '_')}"
+    pkg = src / "graftdemo_rt"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text(f'__version__ = "{version}"\n')
+    (src / "setup.py").write_text(
+        'from setuptools import setup\n'
+        f'setup(name="graftdemo_rt", version="{version}", '
+        'packages=["graftdemo_rt"])\n')
+    wheels = tmp_path / "wheels"
+    subprocess.run(
+        [sys.executable, "-m", "pip", "wheel", "--no-deps",
+         "--no-build-isolation", "-q", "-w", str(wheels), str(src)],
+        check=True, capture_output=True, text=True)
+    (whl,) = [w for w in wheels.iterdir()
+              if w.name.startswith(f"graftdemo_rt-{version}")]
+    return str(whl)
+
+
+def test_pip_env_two_versions(cluster, tmp_path):
+    """Reference runtime_env/pip.py behavior: two tasks using different
+    pip specs of the SAME package import different versions, each from
+    its own per-env-hash virtualenv (workers never shared across envs),
+    while the cluster's own packages stay importable."""
+    whl1 = _build_wheel(tmp_path, "1.0")
+    whl2 = _build_wheel(tmp_path, "2.0")
+
+    @ray_tpu.remote(runtime_env={"pip": [whl1]})
+    def v1():
+        import graftdemo_rt
+        import numpy  # parent-site seeding keeps cluster deps visible
+
+        return graftdemo_rt.__version__, sys.executable, bool(numpy)
+
+    @ray_tpu.remote(runtime_env={"pip": {"packages": [whl2]}})
+    def v2():
+        import graftdemo_rt
+
+        return graftdemo_rt.__version__, sys.executable
+
+    # First use builds each venv (venv + pip install): generous timeout.
+    (ver1, py1, has_np), (ver2, py2) = ray_tpu.get(
+        [v1.remote(), v2.remote()], timeout=420)
+    assert ver1 == "1.0" and ver2 == "2.0"
+    assert has_np
+    assert py1 != py2  # distinct interpreters
+    assert "venv-" in py1 and "venv-" in py2
+
+    @ray_tpu.remote
+    def plain():
+        try:
+            import graftdemo_rt  # noqa: F401
+            return "leaked"
+        except ImportError:
+            return "clean"
+
+    # Plain-env workers never see the pip packages.
+    assert ray_tpu.get(plain.remote(), timeout=60) == "clean"
+    # Cached venv: the second task in the same env is fast.
+    t0 = time.monotonic()
+    assert ray_tpu.get(v1.remote(), timeout=60)[0] == "1.0"
+    assert time.monotonic() - t0 < 30.0

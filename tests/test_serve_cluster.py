@@ -67,3 +67,76 @@ def test_serve_on_cluster_backend(cluster):
     ids = {r._actor_id for r in table["Echo"]["replicas"]}
     assert len(ids) == 2 and dead_id not in ids
     assert ray_tpu.get(handle.remote(99), timeout=60)[1] == 99
+
+
+def _http_get(port: int, path: str, payload: int, timeout=15):
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_per_node_proxies_and_failover():
+    """One HTTP ingress per node, controller-owned (reference
+    http_state.py:30): both nodes serve traffic; a killed proxy actor is
+    recreated by the reconcile loop and serves again (router failover —
+    the old single-proxy design was an ingress SPOF)."""
+    ray_tpu.shutdown()
+    serve._proxy_handle = None
+    c = Cluster()
+    c.add_node(num_cpus=4)
+    c.add_node(num_cpus=4)
+    c.wait_for_nodes()
+    ray_tpu.init(c.address)
+    try:
+        @serve.deployment(num_replicas=2, route_prefix="/double")
+        class Double:
+            def __call__(self, x):
+                return 2 * x
+
+        serve.run(Double.bind())
+        ports = serve.start_http_proxies()
+        assert len(ports) == 2  # one ingress per node
+        for nid, port in ports.items():
+            assert _http_get(port, "/double", 21) == 42
+
+        # Kill one proxy ACTOR (process-level failure); the controller's
+        # reconcile loop recreates it on the same node with a fresh port.
+        from ray_tpu._private import worker as _worker
+        from ray_tpu.state import list_actors
+
+        victim_nid = sorted(ports)[0]
+        victims = [a for a in list_actors()
+                   if a["class_name"] == "HTTPProxy"
+                   and a["state"] == "ALIVE"
+                   and a["node_id"] == victim_nid]
+        assert victims, victim_nid
+        _worker.backend().kill_actor(victims[0]["actor_id"])
+
+        deadline = time.monotonic() + 60
+        new_port = None
+        while time.monotonic() < deadline:
+            cur = serve.proxy_ports()
+            if victim_nid in cur and cur[victim_nid] != ports[victim_nid]:
+                new_port = cur[victim_nid]
+                break
+            time.sleep(0.5)
+        assert new_port is not None, "proxy was never recreated"
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                assert _http_get(new_port, "/double", 5) == 10
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        c.shutdown()

@@ -138,3 +138,85 @@ def test_broadcast_to_all_nodes(cluster):
     nodes_seen = {nid for nid, _ in results}
     assert len(nodes_seen) == 3
     assert all(d == expect for _, d in results), results
+
+
+def test_pull_manager_priority_and_cap():
+    """Admission control (pull_manager.h analog): bounded in-flight bytes;
+    a blocked GET-class pull is admitted before an earlier-queued
+    ARGS-class pull."""
+    import threading
+    import time as _time
+
+    from ray_tpu.cluster.client import _PullManager
+    from ray_tpu.core.config import config
+
+    config.override("pull_max_inflight_bytes", 100)
+    try:
+        pm = _PullManager()
+        pm.acquire(80, 0)  # holds most of the budget
+        order = []
+
+        def grab(tag, prio):
+            pm.acquire(50, prio)
+            order.append(tag)
+            pm.release(50)
+
+        t_args = threading.Thread(target=grab, args=("args", 2))
+        t_args.start()
+        _time.sleep(0.1)  # args queued first...
+        t_get = threading.Thread(target=grab, args=("get", 0))
+        t_get.start()
+        _time.sleep(0.1)
+        assert order == []  # both blocked on the cap
+        pm.release(80)
+        t_args.join(5)
+        t_get.join(5)
+        assert order == ["get", "args"]  # ...but get admits first
+        assert pm.stats() == {"inflight_bytes": 0, "queued": 0}
+    finally:
+        config.reset("pull_max_inflight_bytes")
+
+
+def test_pull_manager_oversized_pull_admits_alone():
+    from ray_tpu.cluster.client import _PullManager
+    from ray_tpu.core.config import config
+
+    config.override("pull_max_inflight_bytes", 10)
+    try:
+        pm = _PullManager()
+        pm.acquire(1000, 0)  # larger than the cap: admitted when alone
+        pm.release(1000)
+    finally:
+        config.reset("pull_max_inflight_bytes")
+
+
+def test_wait_fetch_local_prefetches(cluster):
+    """wait(fetch_local=True) replicates a remote-ready object into the
+    caller's store so the later get() is a local read (reference wait
+    semantics; pulls run at WAIT priority)."""
+    import time as _time
+
+    from ray_tpu._private import worker as _worker
+
+    other = [n for n in cluster.nodes
+             if n.node_id != _worker.backend().node_id][0]
+
+    @ray_tpu.remote
+    def big():
+        return np.arange(3 << 20, dtype=np.uint8)
+
+    ref = big.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(other.node_id)
+    ).remote()
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60,
+                            fetch_local=True)
+    assert ready
+    be = _worker.backend()
+    deadline = _time.monotonic() + 30
+    while _time.monotonic() < deadline:
+        if be.store.contains(ref.id):
+            break
+        _time.sleep(0.05)
+    assert be.store.contains(ref.id), "prefetch never landed locally"
+    val = ray_tpu.get(ref, timeout=30)
+    assert val.nbytes == 3 << 20 and int(val[12345]) == (12345 % 256)
