@@ -145,11 +145,7 @@ def _ensure_venv(pip_reqs: list, cache_root: str) -> str:
     cluster-wide one — the isolation property the feature exists for.
     Built in a tmp dir + atomic rename (concurrent builders: one wins,
     losers clean up)."""
-    import glob
-    import shutil
-    import site
-    import subprocess
-    import sys
+    import threading
 
     digest = hashlib.sha256(
         json.dumps(pip_reqs, sort_keys=True).encode()).hexdigest()[:16]
@@ -157,6 +153,27 @@ def _ensure_venv(pip_reqs: list, cache_root: str) -> str:
     vpy = os.path.join(dest, "bin", "python")
     if os.path.exists(vpy):
         return vpy
+    # Serialize builds in this process: the node agent dispatches tasks on
+    # separate THREADS, so a burst of first-use tasks for one env would
+    # otherwise race whole venv builds (pid-suffixed tmp dirs don't
+    # separate threads). Cross-process the tmp+rename stays the guard.
+    lock = _VENV_LOCKS.setdefault(digest, threading.Lock())
+    with lock:
+        if os.path.exists(vpy):
+            return vpy
+        return _build_venv(pip_reqs, dest, vpy)
+
+
+_VENV_LOCKS: dict = {}
+
+
+def _build_venv(pip_reqs: list, dest: str, vpy: str) -> str:
+    import glob
+    import shutil
+    import site
+    import subprocess
+    import sys
+
     tmp = dest + f".tmp.{os.getpid()}"
     subprocess.run(
         [sys.executable, "-m", "venv", "--system-site-packages", tmp],

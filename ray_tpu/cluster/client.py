@@ -31,6 +31,16 @@ from ray_tpu.core.config import config
 from ray_tpu.core.resources import demand_of
 
 
+class _GetError:
+    """An exception captured for one ref of a multi-ref get, deferred so
+    errors raise in ref order."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class _PullManager:
     """Admission control for chunked remote pulls (the client-side analog
     of ``src/ray/object_manager/pull_manager.h:48``): total in-flight
@@ -609,34 +619,83 @@ class ClusterBackend:
                 entry["incarnation"] -= 1  # didn't actually replay
 
     def get(self, refs: Sequence[ObjectRef], timeout: float | None = None):
+        """Resolve every ref: local reads first, then ONE batched
+        wait_locations long-poll per round for everything still missing,
+        with ready objects fetched concurrently (the reference batches
+        GetObjectStatus the same way). Errors raise in ref order — an
+        error ref raises once every ref before it has resolved."""
         deadline = None if timeout is None else time.monotonic() + timeout
         hooks = self._block_hooks
         blocked = False
-        out = []
+        _UNSET = object()
+        slots = [_UNSET] * len(refs)
+        pending: dict[str, list[int]] = {}
+
+        def ordered_raise():
+            for v in slots:
+                if v is _UNSET:
+                    return
+                if isinstance(v, _GetError):
+                    raise v.exc
+
+        def resolve_value(oid: str, i: int):
+            boxed = self._read_local(oid)
+            if boxed is not None:
+                slots[i] = boxed[0]
+                return True
+            return False
+
         try:
-            for r in refs:
-                while True:
-                    # Local fast path (stored errors re-raise from _decode).
-                    boxed = self._read_local(r.id)
-                    if boxed is not None:
-                        out.append(boxed[0])
-                        break
-                    if hooks is not None and not blocked:
-                        hooks[0]()  # give our CPUs back while we block
-                        blocked = True
-                    loc = self.head.call("wait_location", r.id, 1.0, timeout=15.0)
-                    if loc is None:
-                        self._maybe_recover(r.id)
-                        self._check_actor_alive(r.id)
-                        if deadline is not None and time.monotonic() > deadline:
-                            raise GetTimeoutError(f"ray_tpu.get timed out on {r}")
-                        continue
-                    out.append(self._fetch_remote(r.id, loc["nodes"]))
-                    break
-                self._actor_tasks.pop(r.id, None)  # resolved; stop tracking
+            for i, r in enumerate(refs):
+                try:
+                    if not resolve_value(r.id, i):
+                        pending.setdefault(r.id, []).append(i)
+                except BaseException as e:  # noqa: BLE001 — ordered raise
+                    slots[i] = _GetError(e)
+            ordered_raise()
+            while pending:
+                if hooks is not None and not blocked:
+                    hooks[0]()  # give our CPUs back while we block
+                    blocked = True
+                # Window the poll: the head rescans the requested oids on
+                # every store event while blocked, so a 5k-ref get must
+                # not make each scan 5k wide. Refs resolve roughly in
+                # submission order; polling the first unresolved window
+                # keeps scans O(64) and still batches.
+                window = list(pending)[:64]
+                locs = self.head.call(
+                    "wait_locations", window, 1.0, timeout=15.0)
+                ready = [(oid, loc) for oid, loc in locs.items()
+                         if oid in pending]
+                if ready:
+                    def fetch(oid, loc):
+                        try:
+                            return self._fetch_remote(oid, loc["nodes"])
+                        except BaseException as e:  # noqa: BLE001
+                            return _GetError(e)
+
+                    if len(ready) == 1:
+                        values = [fetch(*ready[0])]
+                    else:
+                        values = list(self._get_pool().map(
+                            lambda p: fetch(*p), ready))
+                    for (oid, _), value in zip(ready, values):
+                        for i in pending.pop(oid):
+                            slots[i] = value
+                for oid in window:
+                    if oid in pending and oid not in locs:
+                        self._maybe_recover(oid)
+                        self._check_actor_alive(oid)
+                ordered_raise()
+                if pending and deadline is not None \
+                        and time.monotonic() > deadline:
+                    raise GetTimeoutError(
+                        f"ray_tpu.get timed out on {len(pending)} ref(s)")
         finally:
             if blocked:
                 hooks[1]()
+        for r in refs:
+            self._actor_tasks.pop(r.id, None)  # resolved; stop tracking
         # Values may have carried nested ObjectRefs: make sure the head
         # knows about our new holds before our caller can release the
         # containers they arrived in.
@@ -644,7 +703,22 @@ class ClusterBackend:
             dirty = bool(self._dirty_add)
         if dirty:
             self.flush_refs()
-        return out
+        return slots
+
+    def _get_pool(self):
+        """Concurrent fetches for multi-ref gets. Separate from the chunk
+        pool (a fetch SUBMITS chunk work there; sharing would deadlock at
+        saturation)."""
+        pool = getattr(self, "_fetch_pool", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._lock:
+                pool = getattr(self, "_fetch_pool", None)
+                if pool is None:
+                    pool = self._fetch_pool = ThreadPoolExecutor(
+                        4, thread_name_prefix="get-fetch")
+        return pool
 
     def wait(self, refs, num_returns, timeout, fetch_local=True):
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -1691,7 +1765,7 @@ class ClusterBackend:
             self._worker_clients.clear()
         for c in clients:
             c.close()
-        for attr in ("_chunk_pool", "_prefetch_pool"):
+        for attr in ("_chunk_pool", "_prefetch_pool", "_fetch_pool"):
             pool = getattr(self, attr, None)
             if pool is not None:
                 pool.shutdown(wait=False)

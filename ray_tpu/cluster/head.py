@@ -660,6 +660,40 @@ class HeadServer:
                     return None
                 self._objects_cv.wait(remaining if remaining is None else min(remaining, 1.0))
 
+    def rpc_wait_locations(self, oids, timeout=None):
+        """Batched long-poll: block until AT LEAST ONE of ``oids`` has a
+        live location (or timeout); returns {oid: {"nodes", "error"}} for
+        every oid currently resolvable. One lock pass + one RPC instead
+        of a serial wait_location per ref (GetObjectStatus batching)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                found = {}
+                for oid in oids:
+                    entry = self._objects.get(oid)
+                    if not (entry and entry["nodes"]):
+                        continue
+                    nodes = [
+                        (nid, self._nodes[nid].address,
+                         self._nodes[nid].store_path)
+                        for nid in entry["nodes"]
+                        if self._nodes.get(nid) and self._nodes[nid].alive
+                    ]
+                    if nodes:
+                        found[oid] = {"nodes": nodes,
+                                      "error": entry["error"]}
+                if found:
+                    return found
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return {}
+                self._objects_cv.wait(
+                    remaining if remaining is None
+                    else min(remaining, 1.0))
+
     def rpc_locations(self, oid):
         with self._lock:
             entry = self._objects.get(oid)

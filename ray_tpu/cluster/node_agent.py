@@ -558,10 +558,23 @@ class NodeAgent:
                 dedicated=bool(spec.get("actor_create")),
             )
         except (TimeoutError, RuntimeError, OSError) as e:
+            pool.release(demand)
+            if isinstance(e, TimeoutError) and \
+                    spec.setdefault("_checkout_misses", 0) < 2:
+                # No worker became available in time — transient under
+                # load (interpreter cold starts on a saturated host are
+                # unbounded). Requeue rather than fail: the reference's
+                # lease request simply stays queued in this situation.
+                spec["_checkout_misses"] += 1
+                self._record_task(spec, "PENDING")
+                with self._queue_cv:
+                    self._commit_locked(spec)
+                    self._task_queue.append(spec)
+                    self._queue_cv.notify()
+                return
             # RuntimeError/OSError: runtime-env materialization failed
             # (missing package, bad zip) — surfaced as the task's error,
             # matching the reference's runtime-env setup failures.
-            pool.release(demand)
             self._fail_task(spec, f"worker setup failed: {e}")
             return
         self._record_task(spec, "RUNNING")

@@ -297,15 +297,19 @@ class WorkerHandler:
         # Argument materialization pulls at the LOWEST priority class
         # (pull_manager.h ordering: get > wait > task args) — a worker
         # hydrating a queued task's args must not starve a user's
-        # explicit ray.get.
+        # explicit ray.get. ONE batched get for all ref args: the
+        # location long-poll batches and fetches run concurrently.
+        refs = [a for a in args if isinstance(a, ObjectRef)] + [
+            v for v in kwargs.values() if isinstance(v, ObjectRef)
+        ]
+        if not refs:
+            return list(args), dict(kwargs)
         with self.backend.pull_priority_override(self.backend.PULL_ARGS):
-            args = [
-                self.backend.get([a])[0] if isinstance(a, ObjectRef) else a
-                for a in args
-            ]
+            values = iter(self.backend.get(refs))
+            args = [next(values) if isinstance(a, ObjectRef) else a
+                    for a in args]
             kwargs = {
-                k: self.backend.get([v])[0] if isinstance(v, ObjectRef)
-                else v
+                k: next(values) if isinstance(v, ObjectRef) else v
                 for k, v in kwargs.items()
             }
         return args, kwargs

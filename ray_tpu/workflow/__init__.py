@@ -5,13 +5,20 @@ persisted to storage (``workflow_storage.py:229,315``); re-running (or
 ``resume``-ing) a workflow id skips completed tasks and recomputes only
 what's missing (``workflow_executor.py``). Storage is a local/NFS
 directory; task identity is the node's deterministic structural id.
+Also covered: per-task retry/catch policies (the reference's
+``workflow.options(max_retries, catch_exceptions)``), external events
+(``event_listener.py``: a workflow blocks on ``wait_for_event`` and the
+delivered payload is checkpointed, so resume never re-waits), and the
+metadata API (``list_all`` / ``get_metadata`` / ``get_output``).
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import pickle
+import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
@@ -20,23 +27,59 @@ from ray_tpu.dag import DAGNode, InputNode, MultiOutputNode
 _DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu/workflows")
 
 
-def _node_ids(root: DAGNode) -> Dict[DAGNode, str]:
+def _walk_values(node):
+    return list(node._bound_args) + [
+        v for _, v in sorted(node._bound_kwargs.items())
+    ]
+
+
+def _assign_event_ids(root: DAGNode) -> dict:
+    """Deterministic ids for every _EventNode by STRUCTURAL position: one
+    full DFS over the whole DAG (never short-circuited by checkpoints, so
+    a resumed run numbers the same events the same way the first run
+    did)."""
+    ev_ids: dict = {}
+    counter: Dict[str, int] = {}
+    seen: set = set()
+
+    def visit(node):
+        if isinstance(node, _EventNode):
+            if node in ev_ids:
+                return
+            base = node._structure_name()
+            n = counter.get(base, 0)
+            counter[base] = n + 1
+            ev_ids[node] = f"{base}_{n}" if n else base
+            return
+        if not isinstance(node, DAGNode) or id(node) in seen:
+            return
+        seen.add(id(node))
+        for v in _walk_values(node):
+            visit(v)
+
+    visit(root)
+    return ev_ids
+
+
+def _node_ids(root: DAGNode, ev_ids: Optional[dict] = None) -> Dict[DAGNode, str]:
     """Deterministic structural ids: name + dep ids + literal args hash,
-    disambiguated by visit order for identical structures."""
+    disambiguated by visit order for identical structures. Event args
+    contribute their ASSIGNED ids (hashing the listener object would bake
+    a memory address into the id and break resume)."""
     ids: Dict[DAGNode, str] = {}
     counter: Dict[str, int] = {}
+    ev_ids = ev_ids or {}
 
     def visit(node: DAGNode) -> str:
         if node in ids:
             return ids[node]
         dep_ids = []
         literals = []
-        values = list(node._bound_args) + [
-            v for _, v in sorted(node._bound_kwargs.items())
-        ]
-        for v in values:
+        for v in _walk_values(node):
             if isinstance(v, DAGNode):
                 dep_ids.append(visit(v))
+            elif isinstance(v, _EventNode):
+                dep_ids.append(ev_ids.get(v, v._structure_name()))
             else:
                 try:
                     literals.append(pickle.dumps(v))
@@ -82,6 +125,30 @@ class _Storage:
     def mark_status(self, status: str) -> None:
         with open(os.path.join(self.dir, "STATUS"), "w") as f:
             f.write(status)
+        self.update_meta(status=status, **(
+            {"end_time": time.time()}
+            if status in ("SUCCESSFUL", "FAILED") else {}))
+
+    def update_meta(self, **fields) -> None:
+        meta = self.meta()
+        meta.update(fields)
+        tmp = os.path.join(self.dir, "META.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, default=str)
+        os.replace(tmp, os.path.join(self.dir, "META.json"))
+
+    def meta(self) -> dict:
+        try:
+            with open(os.path.join(self.dir, "META.json")) as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return {}
+
+    def record_task(self, task_id: str, **fields) -> None:
+        meta = self.meta()
+        tasks = meta.setdefault("tasks", {})
+        tasks.setdefault(task_id, {}).update(fields)
+        self.update_meta(tasks=tasks)
 
     def status(self) -> Optional[str]:
         try:
@@ -91,25 +158,76 @@ class _Storage:
             return None
 
 
+class EventListener:
+    """Await an external event (reference ``event_listener.py``): subclass
+    and implement ``poll_for_event(*args, **kwargs) -> payload``, which
+    BLOCKS until the event arrives (poll a queue, a file, an HTTP
+    endpoint...). The payload is checkpointed like any task output, so a
+    resumed workflow never waits for an already-delivered event."""
+
+    def poll_for_event(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class _EventNode:
+    """A wait-for-event step usable as an argument to downstream tasks."""
+
+    def __init__(self, listener_cls, args, kwargs):
+        self.listener_cls = listener_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def _structure_name(self) -> str:
+        return f"event_{self.listener_cls.__name__}"
+
+
+def wait_for_event(listener_cls, *args, **kwargs) -> _EventNode:
+    if not (isinstance(listener_cls, type)
+            and issubclass(listener_cls, EventListener)):
+        raise TypeError("wait_for_event needs an EventListener subclass")
+    return _EventNode(listener_cls, args, kwargs)
+
+
 def run(
     dag: DAGNode,
     *args,
     workflow_id: str = "default",
     storage: Optional[str] = None,
+    max_task_retries: int = 0,
+    catch_exceptions: bool = False,
     **kwargs,
 ) -> Any:
     """Execute the DAG durably; completed node outputs are checkpointed
-    and skipped on re-run/resume."""
+    and skipped on re-run/resume. ``max_task_retries`` re-runs a failed
+    task before giving up (reference ``workflow.options(max_retries)``);
+    ``catch_exceptions=True`` returns ``(result, None)`` on success or
+    ``(None, exception)`` instead of raising."""
     store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
     store.mark_status("RUNNING")
-    ids = _node_ids(dag)
-    results: Dict[DAGNode, Any] = {}
+    if not store.meta().get("start_time"):
+        store.update_meta(start_time=time.time(),
+                          workflow_id=workflow_id)
+    ev_ids = _assign_event_ids(dag)
+    ids = _node_ids(dag, ev_ids)
+    results: Dict[Any, Any] = {}
 
-    def resolve(node: DAGNode):
+    def resolve(node):
         if node in results:
             return results[node]
         if isinstance(node, InputNode):
             value = args[0] if args else kwargs
+            results[node] = value
+            return value
+        if isinstance(node, _EventNode):
+            task_id = ev_ids[node]
+            if store.has(task_id):
+                value = store.load(task_id)
+            else:
+                store.record_task(task_id, state="WAITING")
+                value = node.listener_cls().poll_for_event(
+                    *node.args, **node.kwargs)
+                store.save(task_id, value)
+                store.record_task(task_id, state="SUCCESSFUL")
             results[node] = value
             return value
         task_id = ids[node]
@@ -118,29 +236,46 @@ def run(
             results[node] = value
             return value
         rargs = [
-            resolve(a) if isinstance(a, DAGNode) else a
+            resolve(a) if isinstance(a, (DAGNode, _EventNode)) else a
             for a in node._bound_args
         ]
         rkwargs = {
-            k: resolve(v) if isinstance(v, DAGNode) else v
+            k: resolve(v) if isinstance(v, (DAGNode, _EventNode)) else v
             for k, v in node._bound_kwargs.items()
         }
         if isinstance(node, MultiOutputNode):
             results[node] = list(rargs)
             return results[node]
-        ref = node._submit(rargs, rkwargs)
-        value = ray_tpu.get(ref) if isinstance(ref, ray_tpu.ObjectRef) else ref
+        attempts = 0
+        while True:
+            try:
+                ref = node._submit(rargs, rkwargs)
+                value = (ray_tpu.get(ref)
+                         if isinstance(ref, ray_tpu.ObjectRef) else ref)
+                break
+            except BaseException as e:  # noqa: BLE001 — retry policy
+                attempts += 1
+                store.record_task(
+                    task_id, state="RETRYING", failures=attempts,
+                    last_error=repr(e))
+                if attempts > max_task_retries:
+                    store.record_task(task_id, state="FAILED")
+                    raise
         store.save(task_id, value)
+        store.record_task(task_id, state="SUCCESSFUL")
         results[node] = value
         return value
 
     try:
         out = resolve(dag)
-    except BaseException:
+    except BaseException as e:  # noqa: BLE001 — status + policy
         store.mark_status("FAILED")
+        if catch_exceptions:
+            return None, e
         raise
     store.mark_status("SUCCESSFUL")
-    return out
+    store.save("__output__", out)
+    return (out, None) if catch_exceptions else out
 
 
 def resume(workflow_id: str, dag: DAGNode, *args,
@@ -156,6 +291,39 @@ def get_status(workflow_id: str, storage: Optional[str] = None) -> Optional[str]
     return store.status()
 
 
+def get_metadata(workflow_id: str, storage: Optional[str] = None) -> dict:
+    """Workflow-level metadata (reference ``workflow.get_metadata``):
+    status, start/end times, and per-task states/failure counts."""
+    store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    meta = store.meta()
+    meta["status"] = store.status()
+    return meta
+
+
+def get_output(workflow_id: str, storage: Optional[str] = None):
+    """The checkpointed final output of a finished workflow."""
+    store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    if not store.has("__output__"):
+        raise ValueError(
+            f"workflow {workflow_id!r} has no stored output "
+            f"(status: {store.status()})")
+    return store.load("__output__")
+
+
+def list_all(storage: Optional[str] = None) -> Dict[str, Optional[str]]:
+    """{workflow_id: status} for every workflow in the storage root."""
+    base = storage or _DEFAULT_STORAGE
+    out: Dict[str, Optional[str]] = {}
+    try:
+        entries = sorted(os.listdir(base))
+    except FileNotFoundError:
+        return out
+    for wid in entries:
+        if os.path.isdir(os.path.join(base, wid)):
+            out[wid] = _Storage(base, wid).status()
+    return out
+
+
 def delete(workflow_id: str, storage: Optional[str] = None) -> None:
     import shutil
 
@@ -163,4 +331,7 @@ def delete(workflow_id: str, storage: Optional[str] = None) -> None:
     shutil.rmtree(path, ignore_errors=True)
 
 
-__all__ = ["run", "resume", "get_status", "delete"]
+__all__ = [
+    "run", "resume", "get_status", "get_metadata", "get_output",
+    "list_all", "delete", "EventListener", "wait_for_event",
+]

@@ -1,0 +1,164 @@
+"""Workflow retries, external events, and the metadata API
+(reference: ``python/ray/workflow`` — ``workflow.options(max_retries,
+catch_exceptions)``, ``event_listener.py``, ``get_metadata``/``list_all``)."""
+
+import sys
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_retries_then_succeeds(tmp_path):
+    marker = tmp_path / "attempts"
+    marker.write_text("0")
+
+    @ray_tpu.remote
+    def flaky(x):
+        n = int(marker.read_text()) + 1
+        marker.write_text(str(n))
+        if n < 3:
+            raise RuntimeError(f"boom {n}")
+        return x * 10
+
+    out = workflow.run(flaky.bind(7), workflow_id="retry",
+                       storage=str(tmp_path / "wf"), max_task_retries=3)
+    assert out == 70
+    assert marker.read_text() == "3"
+    meta = workflow.get_metadata("retry", storage=str(tmp_path / "wf"))
+    (task_meta,) = [v for k, v in meta["tasks"].items()
+                    if k.startswith("flaky")]
+    assert task_meta["state"] == "SUCCESSFUL"
+    assert task_meta["failures"] == 2
+
+
+def test_catch_exceptions(tmp_path):
+    @ray_tpu.remote
+    def bad():
+        raise ValueError("nope")
+
+    result, err = workflow.run(
+        bad.bind(), workflow_id="catching",
+        storage=str(tmp_path / "wf"), catch_exceptions=True)
+    assert result is None
+    assert "nope" in repr(err)
+    assert workflow.get_status(
+        "catching", storage=str(tmp_path / "wf")) == "FAILED"
+
+
+def test_event_checkpointed_across_resume(tmp_path):
+    """The event payload is durable: the first run blocks for the event;
+    the resumed run must NOT wait again (a listener that would fail if
+    polled twice proves it)."""
+    flag = tmp_path / "event_payload"
+    flag.write_text("sensor-42")
+    polls = tmp_path / "polls"
+    polls.write_text("0")
+
+    class FileEvent(workflow.EventListener):
+        def poll_for_event(self, path, count_path):
+            n = int(open(count_path).read()) + 1
+            open(count_path, "w").write(str(n))
+            if n > 1:
+                raise AssertionError("event polled twice")
+            return open(path).read()
+
+    @ray_tpu.remote
+    def combine(payload, x):
+        return f"{payload}:{x}"
+
+    ev = workflow.wait_for_event(FileEvent, str(flag), str(polls))
+    dag = combine.bind(ev, 5)
+    out = workflow.run(dag, workflow_id="evt",
+                       storage=str(tmp_path / "wf"))
+    assert out == "sensor-42:5"
+
+    # Resume re-supplies the DAG; both the event and the task load from
+    # storage (poll count stays 1).
+    ev2 = workflow.wait_for_event(FileEvent, str(flag), str(polls))
+    out2 = workflow.resume("evt", combine.bind(ev2, 5),
+                           storage=str(tmp_path / "wf"))
+    assert out2 == "sensor-42:5"
+    assert polls.read_text() == "1"
+
+
+def test_two_same_class_events_resume_correctly(tmp_path):
+    """Event ids are assigned by structural position (full DFS), not by
+    resolution order: after a crash between two same-listener events, the
+    resumed run must match each event to ITS OWN checkpoint — not hand
+    the first event's payload to the second."""
+    store = str(tmp_path / "wf")
+    e1 = tmp_path / "e1"
+    e1.write_text("payload-one")
+    e2 = tmp_path / "e2"
+    e2.write_text("payload-two")
+    gate = tmp_path / "gate"  # absent => task b crashes
+
+    class FileEvent(workflow.EventListener):
+        def poll_for_event(self, path):
+            return open(path).read()
+
+    @ray_tpu.remote
+    def a(payload):
+        return f"a:{payload}"
+
+    @ray_tpu.remote
+    def b(payload, gate_path):
+        import os
+        if not os.path.exists(gate_path):
+            raise RuntimeError("crash before b")
+        return f"b:{payload}"
+
+    @ray_tpu.remote
+    def join(x, y):
+        return (x, y)
+
+    def build():
+        ev_a = workflow.wait_for_event(FileEvent, str(e1))
+        ev_b = workflow.wait_for_event(FileEvent, str(e2))
+        return join.bind(a.bind(ev_a), b.bind(ev_b, str(gate)))
+
+    with pytest.raises(ray_tpu.TaskError, match="crash before b"):
+        workflow.run(build(), workflow_id="two-ev", storage=store)
+
+    gate.write_text("go")
+    out = workflow.resume("two-ev", build(), storage=store)
+    assert out == ("a:payload-one", "b:payload-two")
+
+
+def test_metadata_and_output_api(tmp_path):
+    store = str(tmp_path / "wf")
+
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    with InputNode() as inp:
+        dag = double.bind(double.bind(inp))
+    assert workflow.run(dag, 3, workflow_id="meta-a", storage=store) == 12
+
+    meta = workflow.get_metadata("meta-a", storage=store)
+    assert meta["status"] == "SUCCESSFUL"
+    assert meta["start_time"] <= meta["end_time"]
+    assert all(t["state"] == "SUCCESSFUL" for t in meta["tasks"].values())
+    assert workflow.get_output("meta-a", storage=store) == 12
+
+    workflow.run(double.bind(1), workflow_id="meta-b", storage=store)
+    listing = workflow.list_all(storage=store)
+    assert listing == {"meta-a": "SUCCESSFUL", "meta-b": "SUCCESSFUL"}
+
+    with pytest.raises(ValueError, match="no stored output"):
+        workflow.get_output("never-ran", storage=store)
