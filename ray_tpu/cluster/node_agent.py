@@ -575,7 +575,15 @@ class NodeAgent:
             # RuntimeError/OSError: runtime-env materialization failed
             # (missing package, bad zip) — surfaced as the task's error,
             # matching the reference's runtime-env setup failures.
-            self._fail_task(spec, f"worker setup failed: {e}")
+            if isinstance(e, TimeoutError):
+                self._fail_task(
+                    spec,
+                    f"no worker became available after "
+                    f"{spec.get('_checkout_misses', 0) + 1} attempts of "
+                    f"{config.worker_start_timeout_s:.0f}s (node "
+                    f"saturated?)")
+            else:
+                self._fail_task(spec, f"worker setup failed: {e}")
             return
         self._record_task(spec, "RUNNING")
         w.current_task = {

@@ -10,7 +10,11 @@ algorithm families:
 * IMPALA — the distributed actor-learner architecture: stale behavior
   policies on rollout actors, V-trace correction on the learner;
 * SAC — continuous control: squashed-Gaussian actor, twin Q critics,
-  on-device replay, automatic entropy temperature.
+  on-device replay, automatic entropy temperature;
+* A2C — the on-policy family's simplest member (shared PPO substrate);
+* TD3 — deterministic continuous control: twin delayed critics, target
+  smoothing (shared SAC substrate);
+* multi-agent PPO (policy-map routing) and offline DQN (JSON datasets).
 The execution model (jit the whole train iteration; actors only for
 off-device sampling) is the part of the reference's ~30 algorithms that
 generalizes.
@@ -19,6 +23,7 @@ generalizes.
 from ray_tpu._private.usage import record_library_usage as _rlu
 _rlu("rllib")
 
+from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, make_vec_env
 from ray_tpu.rllib.env import Pendulum
@@ -37,10 +42,15 @@ from ray_tpu.rllib.offline import (
     read_sample_batches,
 )
 from ray_tpu.rllib.sac import SAC, SACConfig
+from ray_tpu.rllib.td3 import TD3, TD3Config
 from ray_tpu.rllib.ppo import PPO, PPOConfig, RolloutWorker, policy_apply
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 __all__ = [
+    "A2C",
+    "A2CConfig",
+    "TD3",
+    "TD3Config",
     "CartPole",
     "make_vec_env",
     "DQN",

@@ -1,0 +1,167 @@
+"""A2C: synchronous advantage actor-critic (reference
+``rllib/algorithms/a2c``): the on-policy family's simplest member — one
+policy-gradient step per rollout on n-step advantages, no surrogate
+clipping, no minibatch epochs. Shares PPO's model, vectorized envs, and
+Anakin execution shape (rollout + GAE + update in ONE jitted program)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.env import CartPole, make_vec_env
+from ray_tpu.rllib.optim import adam_step as _adam
+from ray_tpu.rllib.ppo import policy_apply, policy_init
+
+
+class A2CConfig:
+    def __init__(self):
+        self.env = CartPole()
+        self.num_envs = 64
+        self.rollout_length = 32
+        self.gamma = 0.99
+        self.gae_lambda = 1.0           # A2C default: plain n-step returns
+        self.lr = 2.5e-3
+        self.entropy_coeff = 0.01
+        self.vf_coeff = 0.5
+        self.grad_clip = 0.5
+        self.hidden_sizes = (64, 64)
+        self.seed = 0
+
+    def environment(self, env=None) -> "A2CConfig":
+        if env is not None:
+            self.env = env
+        return self
+
+    def rollouts(self, *, num_envs: Optional[int] = None,
+                 rollout_length: Optional[int] = None) -> "A2CConfig":
+        if num_envs is not None:
+            self.num_envs = num_envs
+        if rollout_length is not None:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, **kwargs) -> "A2CConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown A2C option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "A2CConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "A2C":
+        return A2C(self)
+
+
+def _make_train_iter(cfg: A2CConfig):
+    env = cfg.env
+    n_envs, t_len = cfg.num_envs, cfg.rollout_length
+    reset, vstep, vobs = make_vec_env(env, n_envs)
+
+    @jax.jit
+    def train_iter(params, opt, states, rng):
+        def step_fn(carry, _):
+            states, rng = carry
+            rng, k_act, k_step = jax.random.split(rng, 3)
+            obs = vobs(states)
+            logits, value = policy_apply(params, obs)
+            action = jax.random.categorical(k_act, logits)
+            nxt, _, reward, done = vstep(states, action, k_step)
+            out = {"obs": obs, "actions": action, "rewards": reward,
+                   "dones": done, "values": value}
+            return (nxt, rng), out
+
+        (states, rng), traj = jax.lax.scan(
+            step_fn, (states, rng), None, length=t_len)
+        _, last_value = policy_apply(params, vobs(states))
+
+        def adv_scan(adv, x):
+            reward, done, value, next_value = x
+            nonterm = 1.0 - done.astype(jnp.float32)
+            delta = reward + cfg.gamma * next_value * nonterm - value
+            adv = delta + cfg.gamma * cfg.gae_lambda * nonterm * adv
+            return adv, adv
+
+        values = traj["values"]
+        next_values = jnp.concatenate([values[1:], last_value[None]], 0)
+        _, advs = jax.lax.scan(
+            adv_scan, jnp.zeros_like(last_value),
+            (traj["rewards"], traj["dones"], values, next_values),
+            reverse=True)
+        returns = advs + values
+
+        def loss_fn(p):
+            logits, value = policy_apply(
+                p, traj["obs"].reshape(-1, env.observation_size))
+            logp_all = jax.nn.log_softmax(logits)
+            acts = traj["actions"].reshape(-1)
+            logp = jnp.take_along_axis(logp_all, acts[:, None], 1)[:, 0]
+            adv = advs.reshape(-1)
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pg = -jnp.mean(logp * adv)
+            vf = jnp.mean((value - returns.reshape(-1)) ** 2)
+            ent = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+            return pg + cfg.vf_coeff * vf - cfg.entropy_coeff * ent, ent
+
+        (loss, entropy), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        params, opt = _adam(params, opt, grads, lr=cfg.lr,
+                            max_grad_norm=cfg.grad_clip, eps=1e-5)
+        n_done = jnp.maximum(
+            jnp.sum(traj["dones"].astype(jnp.float32)), 1.0)
+        metrics = {
+            "loss": loss,
+            "entropy": entropy,
+            # True mean return of episodes that ended this rollout (works
+            # for any reward scheme, not just +1-per-step envs).
+            "episode_reward_mean": jnp.sum(traj["rewards"]) / n_done,
+        }
+        return params, opt, states, rng, metrics
+
+    return reset, train_iter
+
+
+class A2C:
+    """Algorithm (Trainable contract: ``.train()`` -> result dict)."""
+
+    def __init__(self, config: A2CConfig):
+        self.config = config
+        rng = jax.random.key(config.seed)
+        k_param, k_env, self._rng = jax.random.split(rng, 3)
+        env = config.env
+        self.params = policy_init(
+            k_param, env.observation_size, env.num_actions,
+            config.hidden_sizes)
+        self.opt = {
+            "mu": jax.tree.map(jnp.zeros_like, self.params),
+            "nu": jax.tree.map(jnp.zeros_like, self.params),
+            "t": jnp.zeros((), jnp.int32),
+        }
+        self._reset, self._train_iter = _make_train_iter(config)
+        self._states = self._reset(k_env)
+        self._iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        (self.params, self.opt, self._states, self._rng,
+         metrics) = self._train_iter(
+            self.params, self.opt, self._states, self._rng)
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter":
+                self.config.num_envs * self.config.rollout_length,
+            "time_this_iter_s": time.perf_counter() - start,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def compute_single_action(self, obs) -> int:
+        logits, _ = policy_apply(self.params, jnp.asarray(obs)[None])
+        return int(jnp.argmax(logits[0]))

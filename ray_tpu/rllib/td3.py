@@ -1,0 +1,243 @@
+"""TD3: twin-delayed deep deterministic policy gradient (reference
+``rllib/algorithms/td3``/``ddpg``) — the deterministic-policy counterpart
+to SAC for continuous control. Shares SAC's twin critics, on-device
+replay, Polyak targets, and Anakin execution shape; differs in the three
+TD3 tricks: clipped target-policy smoothing noise, taking min(Q1, Q2) for
+the target, and DELAYED (every ``policy_delay`` updates) actor + target
+synchronization."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.env import Pendulum, make_vec_env
+from ray_tpu.rllib.optim import adam_step as _adam
+from ray_tpu.rllib.ppo import mlp_apply, mlp_init
+from ray_tpu.rllib.replay import buffer_add, buffer_init, buffer_sample
+from ray_tpu.rllib.sac import critic_apply, critic_init
+
+
+class TD3Config:
+    def __init__(self):
+        self.env = Pendulum()
+        self.num_envs = 16
+        self.steps_per_iter = 64
+        self.buffer_size = 50_000
+        self.batch_size = 256
+        self.updates_per_iter = 32
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.hidden_sizes = (128, 128)
+        self.learning_starts = 1_000
+        self.action_scale = 2.0
+        self.explore_noise = 0.1        # behavior-policy gaussian noise
+        self.target_noise = 0.2         # target-policy smoothing
+        self.target_noise_clip = 0.5
+        self.policy_delay = 2           # actor updates every N critic steps
+        self.seed = 0
+
+    def environment(self, env=None) -> "TD3Config":
+        if env is not None:
+            self.env = env
+        return self
+
+    def rollouts(self, *, num_envs: Optional[int] = None) -> "TD3Config":
+        if num_envs is not None:
+            self.num_envs = num_envs
+        return self
+
+    def training(self, **kwargs) -> "TD3Config":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown TD3 option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "TD3Config":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "TD3":
+        return TD3(self)
+
+
+def _actor_apply(params, obs, scale):
+    return scale * jnp.tanh(mlp_apply(params, obs))
+
+
+def _make_train_iter(cfg: TD3Config):
+    env = cfg.env
+    reset_fn, step_fn, obs_fn = make_vec_env(env, cfg.num_envs)
+    scale = cfg.action_scale
+    time_limit_only = bool(getattr(env, "TIME_LIMIT_ONLY", False))
+
+    @jax.jit
+    def reset(rng):
+        return reset_fn(rng)
+
+    @jax.jit
+    def train_iter(learner, states, rng):
+        def env_step(carry, _):
+            learner, states, rng = carry
+            rng, k_n, k_step = jax.random.split(rng, 3)
+            obs = obs_fn(states)
+            act = _actor_apply(learner["actor"], obs, scale)
+            act = jnp.clip(
+                act + cfg.explore_noise * scale
+                * jax.random.normal(k_n, act.shape),
+                -scale, scale)
+            nstates, _, rew, done = step_fn(states, act, k_step)
+            done_f = done.astype(jnp.float32)
+            stored = jnp.zeros_like(done_f) if time_limit_only else done_f
+            learner = dict(
+                learner,
+                buffer=buffer_add(
+                    learner["buffer"], cfg.buffer_size,
+                    obs=obs, act=act, rew=rew, nobs=obs_fn(nstates),
+                    done=stored),
+                env_steps=learner["env_steps"] + cfg.num_envs,
+                reward_sum=learner["reward_sum"] + jnp.sum(rew),
+                done_count=learner["done_count"] + jnp.sum(done),
+            )
+            return (learner, nstates, rng), None
+
+        (learner, states, rng), _ = jax.lax.scan(
+            env_step, (learner, states, rng), None,
+            length=cfg.steps_per_iter)
+
+        def critic_loss(cp, batch, k):
+            # Target-policy smoothing: clipped noise on the target action.
+            noise = jnp.clip(
+                cfg.target_noise * scale
+                * jax.random.normal(k, batch["act"].shape),
+                -cfg.target_noise_clip * scale,
+                cfg.target_noise_clip * scale)
+            next_act = jnp.clip(
+                _actor_apply(learner["target_actor"], batch["nobs"], scale)
+                + noise, -scale, scale)
+            tq1, tq2 = critic_apply(
+                learner["target_critic"], batch["nobs"], next_act)
+            y = batch["rew"] + cfg.gamma * (1 - batch["done"]) * \
+                jax.lax.stop_gradient(jnp.minimum(tq1, tq2))
+            q1, q2 = critic_apply(cp, batch["obs"], batch["act"])
+            return jnp.mean((q1 - y) ** 2 + (q2 - y) ** 2)
+
+        def actor_loss(ap, cp, batch):
+            act = _actor_apply(ap, batch["obs"], scale)
+            q1, _ = critic_apply(cp, batch["obs"], act)
+            return -jnp.mean(q1)
+
+        def update(carry, i):
+            learner, rng = carry
+            rng, k_idx, k_t = jax.random.split(rng, 3)
+            buf = learner["buffer"]
+            batch = buffer_sample(buf, k_idx, cfg.batch_size,
+                                  ("obs", "act", "rew", "nobs", "done"))
+            ready = (buf["size"] >= cfg.learning_starts).astype(jnp.float32)
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(
+                learner["critic"], batch, k_t)
+            cgrads = jax.tree.map(lambda g: g * ready, cgrads)
+            critic, copt = _adam(learner["critic"], learner["copt"],
+                                 cgrads, lr=cfg.critic_lr)
+
+            # Delayed policy + target updates (TD3 trick #3).
+            do_pi = ready * ((i % cfg.policy_delay) == 0)
+            aloss, agrads = jax.value_and_grad(actor_loss)(
+                learner["actor"], critic, batch)
+            agrads = jax.tree.map(lambda g: g * do_pi, agrads)
+            actor, aopt = _adam(learner["actor"], learner["aopt"],
+                                agrads, lr=cfg.actor_lr)
+            blend = cfg.tau * do_pi
+            target_actor = jax.tree.map(
+                lambda t, p: (1 - blend) * t + blend * p,
+                learner["target_actor"], actor)
+            target_critic = jax.tree.map(
+                lambda t, p: (1 - blend) * t + blend * p,
+                learner["target_critic"], critic)
+            learner = dict(learner, actor=actor, critic=critic,
+                           aopt=aopt, copt=copt,
+                           target_actor=target_actor,
+                           target_critic=target_critic)
+            return (learner, rng), {"critic_loss": closs * ready,
+                                    "actor_loss": aloss * do_pi}
+
+        (learner, rng), losses = jax.lax.scan(
+            update, (learner, rng), jnp.arange(cfg.updates_per_iter))
+        metrics = {
+            "critic_loss": jnp.mean(losses["critic_loss"]),
+            "actor_loss": jnp.mean(losses["actor_loss"]),
+            "buffer_size": learner["buffer"]["size"].astype(jnp.float32),
+        }
+        return learner, states, rng, metrics
+
+    return reset, train_iter
+
+
+class TD3:
+    """Algorithm (Trainable contract: ``.train()`` -> result dict)."""
+
+    def __init__(self, config: TD3Config):
+        self.config = config
+        env = config.env
+        rng = jax.random.key(config.seed)
+        ka, kc, k_env, self._rng = jax.random.split(rng, 4)
+        obs_size, act_size = env.observation_size, env.action_size
+        actor = mlp_init(ka, (obs_size, *config.hidden_sizes, act_size))
+        critic = critic_init(kc, obs_size, act_size, config.hidden_sizes)
+
+        def opt0(params):
+            return {"mu": jax.tree.map(jnp.zeros_like, params),
+                    "nu": jax.tree.map(jnp.zeros_like, params),
+                    "t": jnp.zeros((), jnp.int32)}
+
+        self._learner = {
+            "actor": actor,
+            "critic": critic,
+            "target_actor": jax.tree.map(jnp.copy, actor),
+            "target_critic": jax.tree.map(jnp.copy, critic),
+            "aopt": opt0(actor),
+            "copt": opt0(critic),
+            "buffer": buffer_init(
+                config.buffer_size,
+                {"obs": (obs_size,), "act": (act_size,), "rew": (),
+                 "nobs": (obs_size,), "done": ()},
+            ),
+            "env_steps": jnp.zeros((), jnp.int32),
+            "reward_sum": jnp.zeros(()),
+            "done_count": jnp.zeros((), jnp.int32),
+        }
+        self._reset, self._train_iter = _make_train_iter(config)
+        self._states = self._reset(k_env)
+        self._iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        prev_rew = float(self._learner["reward_sum"])
+        prev_done = int(self._learner["done_count"])
+        prev_steps = int(self._learner["env_steps"])
+        self._learner, self._states, self._rng, metrics = self._train_iter(
+            self._learner, self._states, self._rng)
+        self._iteration += 1
+        steps = int(self._learner["env_steps"]) - prev_steps
+        drew = float(self._learner["reward_sum"]) - prev_rew
+        ddone = max(1, int(self._learner["done_count"]) - prev_done)
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter": steps,
+            "episode_reward_mean": drew / ddone,
+            "time_this_iter_s": time.perf_counter() - start,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def compute_single_action(self, obs):
+        return _actor_apply(
+            self._learner["actor"], jnp.asarray(obs)[None],
+            self.config.action_scale)[0]

@@ -129,8 +129,8 @@ class _Storage:
             {"end_time": time.time()}
             if status in ("SUCCESSFUL", "FAILED") else {}))
 
-    def update_meta(self, **fields) -> None:
-        meta = self.meta()
+    def update_meta(self, _meta: Optional[dict] = None, **fields) -> None:
+        meta = self.meta() if _meta is None else _meta
         meta.update(fields)
         tmp = os.path.join(self.dir, "META.json.tmp")
         with open(tmp, "w") as f:
@@ -146,9 +146,8 @@ class _Storage:
 
     def record_task(self, task_id: str, **fields) -> None:
         meta = self.meta()
-        tasks = meta.setdefault("tasks", {})
-        tasks.setdefault(task_id, {}).update(fields)
-        self.update_meta(tasks=tasks)
+        meta.setdefault("tasks", {}).setdefault(task_id, {}).update(fields)
+        self.update_meta(_meta=meta)
 
     def status(self) -> Optional[str]:
         try:
@@ -253,7 +252,7 @@ def run(
                 value = (ray_tpu.get(ref)
                          if isinstance(ref, ray_tpu.ObjectRef) else ref)
                 break
-            except BaseException as e:  # noqa: BLE001 — retry policy
+            except Exception as e:  # KeyboardInterrupt etc. abort, not retry
                 attempts += 1
                 store.record_task(
                     task_id, state="RETRYING", failures=attempts,
@@ -268,13 +267,14 @@ def run(
 
     try:
         out = resolve(dag)
-    except BaseException as e:  # noqa: BLE001 — status + policy
+    except BaseException as e:  # noqa: BLE001 — status marking only
         store.mark_status("FAILED")
-        if catch_exceptions:
+        if catch_exceptions and isinstance(e, Exception):
             return None, e
-        raise
-    store.mark_status("SUCCESSFUL")
+        raise  # KeyboardInterrupt/SystemExit always propagate
+    # Output BEFORE the status flip: SUCCESSFUL must imply get_output works.
     store.save("__output__", out)
+    store.mark_status("SUCCESSFUL")
     return (out, None) if catch_exceptions else out
 
 
