@@ -170,6 +170,13 @@ class Dashboard:
                     "placement_group_table")})
         if route == "/api/pubsub_stats":
             return ok_json(self.head.call("pubsub_stats"))
+        if route == "/api/grafana_dashboard":
+            # Generated Grafana JSON (reference
+            # grafana_dashboard_factory.py): import into Grafana against
+            # a Prometheus source scraping the cluster's /metrics.
+            from ray_tpu.util.grafana import generate_dashboard
+
+            return ok_json(generate_dashboard())
         if route == "/api/jobs" or route.startswith("/api/jobs/"):
             return self._jobs_get(route)
         if route == "/api/serve/applications":

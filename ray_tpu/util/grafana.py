@@ -1,0 +1,109 @@
+"""Grafana dashboard generation from the metrics registry.
+
+Reference parity:
+``dashboard/modules/metrics/grafana_dashboard_factory.py`` — the
+reference ships generated Grafana dashboard JSON wired to its Prometheus
+metrics; here the dashboard is generated FROM the live metric registry,
+so every registered Counter/Gauge/Histogram gets a panel whose query
+matches exactly what this repo's exporter emits (names verbatim — no
+implicit ``_total`` suffixing; see ``util/metrics.py`` exposition).
+
+    from ray_tpu.util.grafana import generate_dashboard, write_dashboard
+    write_dashboard("grafana/ray_tpu_dashboard.json")
+
+Import the JSON into Grafana with a Prometheus data source scraping the
+cluster's ``/metrics`` endpoints (``ray_tpu.util.metrics
+.start_metrics_server``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from ray_tpu.util import metrics as _metrics
+
+
+def _panel(panel_id: int, title: str, expr: str, unit: str = "short",
+           x: int = 0, y: int = 0) -> dict:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": [{
+            "expr": expr,
+            "legendFormat": "{{instance}}",
+            "refId": "A",
+        }],
+    }
+
+
+def _registry_panels() -> List[tuple]:
+    panels = []
+    for m in _metrics.registered():
+        name = m.name
+        if isinstance(m, _metrics.Counter):
+            # The exporter emits the registered name VERBATIM (callers
+            # who want the prometheus _total convention put it in the
+            # name) — query exactly that.
+            expr = f"rate({name}[1m])"
+            title = f"{name} /s"
+        elif isinstance(m, _metrics.Histogram):
+            expr = (f"histogram_quantile(0.99, "
+                    f"rate({name}_bucket[5m]))")
+            title = f"{name} p99"
+        else:  # Gauge
+            expr = name
+            title = name
+        if m.description:
+            title = f"{title} — {m.description}"
+        panels.append((title, expr))
+    return panels
+
+
+def generate_dashboard(title: str = "ray_tpu cluster",
+                       include_registry: bool = True) -> dict:
+    """Grafana v10 dashboard JSON: one panel per registered metric
+    (rate for counters, p99 for histograms, value for gauges)."""
+    entries: List[tuple] = []
+    if include_registry:
+        entries += _registry_panels()
+    panels = []
+    for i, (ptitle, expr) in enumerate(entries):
+        panels.append(_panel(
+            i + 1, ptitle, expr,
+            x=(i % 2) * 12, y=(i // 2) * 8,
+        ))
+    return {
+        "title": title,
+        "uid": "ray-tpu-default",
+        "schemaVersion": 39,
+        "timezone": "browser",
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {"list": [{
+            "name": "datasource",
+            "type": "datasource",
+            "query": "prometheus",
+        }]},
+        "panels": panels,
+    }
+
+
+def write_dashboard(path: str, title: str = "ray_tpu cluster",
+                    include_registry: bool = True) -> str:
+    """Write the generated dashboard JSON; returns the path (the
+    reference's dashboard factory writes into the session dir the same
+    way)."""
+    dash = generate_dashboard(title, include_registry)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dash, f, indent=1)
+    os.replace(tmp, path)
+    return path

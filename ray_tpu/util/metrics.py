@@ -155,12 +155,16 @@ class Histogram(Metric):
         return out
 
 
+def registered() -> "List[Metric]":
+    """Snapshot of the registry (exporters and dashboard generators)."""
+    with _registry_lock:
+        return list(_registry)
+
+
 def prometheus_text() -> str:
     """Full registry in Prometheus exposition format (the /metrics body)."""
     lines: List[str] = []
-    with _registry_lock:
-        metrics = list(_registry)
-    for m in metrics:
+    for m in registered():
         lines.extend(m.expose())
     return "\n".join(lines) + "\n"
 

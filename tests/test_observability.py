@@ -211,3 +211,39 @@ def test_cli_status_and_summary(capsys):
     main(["summary"])
     out = capsys.readouterr().out
     assert "tasks" in out
+
+
+def test_grafana_dashboard_generation(tmp_path):
+    """Generated Grafana JSON (reference grafana_dashboard_factory.py):
+    core panels plus one per registered user metric."""
+    import json
+
+    from ray_tpu.util import metrics
+    from ray_tpu.util.grafana import generate_dashboard, write_dashboard
+
+    c = metrics.Counter("graftest_requests", "requests handled")
+    g = metrics.Gauge("graftest_inflight", "in flight")
+    h = metrics.Histogram("graftest_latency", "latency s")
+    c.inc()
+    g.set(3)
+    h.observe(0.2)
+
+    dash = generate_dashboard()
+    titles = [p["title"] for p in dash["panels"]]
+    assert any(t.startswith("graftest_requests /s") for t in titles)
+    assert any("graftest_latency p99" in t for t in titles)
+    exprs = [p["targets"][0]["expr"] for p in dash["panels"]]
+    # Queries must match the exporter's series names VERBATIM.
+    from ray_tpu.util.metrics import prometheus_text
+
+    text = prometheus_text()
+    assert "rate(graftest_requests[1m])" in exprs
+    assert "graftest_requests 1" in text  # the series the query hits
+    assert any("histogram_quantile(0.99" in e for e in exprs)
+    assert "graftest_latency_bucket" in text
+    assert "graftest_inflight" in exprs
+    # Valid importable JSON with a datasource variable.
+    path = write_dashboard(str(tmp_path / "dash.json"))
+    loaded = json.load(open(path))
+    assert loaded["templating"]["list"][0]["type"] == "datasource"
+    assert all("gridPos" in p for p in loaded["panels"])
