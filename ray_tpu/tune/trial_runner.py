@@ -88,6 +88,7 @@ class TrialRunner:
         searcher=None,
         num_samples: int = 0,
         trial_resources: Optional[dict] = None,
+        experiment_dir: Optional[str] = None,
     ):
         self.trainable = trainable
         self.trials = trials
@@ -102,8 +103,84 @@ class TrialRunner:
         self.searcher = searcher
         self.num_samples = num_samples
         self.trial_resources = trial_resources
+        # Experiment persistence (reference experiment_state snapshots):
+        # a changed trial state rewrites <dir>/experiment_state.json so
+        # Tuner.restore can resume unfinished trials after a crash.
+        self.experiment_dir = experiment_dir
+        self.experiment_meta: dict = {}  # metric/mode etc., persisted too
+        self._persisted_sig = None
         self.queue = Queue()
         self._actor_cls = ray_tpu.remote(_TrialActor)
+
+    # -- experiment persistence -------------------------------------------
+
+    @staticmethod
+    def _json_default(o):
+        import numpy as np
+
+        if isinstance(o, np.generic):
+            return o.item()
+        raise TypeError(type(o).__name__)
+
+    def _persist(self) -> None:
+        if not self.experiment_dir:
+            return
+        sig = tuple(
+            (t.trial_id, t.status, t.num_failures,
+             id(t.checkpoint), id(t.last_result))
+            for t in self.trials
+        )
+        if sig == self._persisted_sig:
+            return  # nothing changed since the last snapshot
+        import json
+        import pickle
+
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        records = []
+        for t in self.trials:
+            ckpt_file = None
+            if t.checkpoint is not None:
+                ckpt_file = os.path.join(
+                    self.experiment_dir, f"ckpt_{t.trial_id}.pkl")
+                if getattr(t, "_persisted_ckpt", None) is t.checkpoint \
+                        and os.path.exists(ckpt_file):
+                    pass  # unchanged since last snapshot
+                else:
+                    try:
+                        with open(ckpt_file + ".tmp", "wb") as f:
+                            pickle.dump(t.checkpoint.to_dict(), f)
+                        os.replace(ckpt_file + ".tmp", ckpt_file)
+                        t._persisted_ckpt = t.checkpoint
+                    except Exception:
+                        ckpt_file = None  # unserializable (e.g. dead ref)
+            rec = {
+                "trial_id": t.trial_id,
+                "config": t.config,
+                "status": t.status,
+                "last_result": t.last_result,
+                "num_failures": t.num_failures,
+                "checkpoint_file": ckpt_file,
+                "resources": t.resources,
+                "error": repr(t.error) if t.error is not None else None,
+            }
+            try:
+                json.dumps(rec, default=self._json_default)
+            except TypeError:
+                # Exotic values (beyond numpy scalars) can't round-trip:
+                # mark the record so restore refuses to re-run it with a
+                # corrupted config instead of silently stringifying.
+                rec["config"] = repr(t.config)
+                rec["last_result"] = None
+                rec["lossy"] = True
+            records.append(rec)
+        tmp = os.path.join(self.experiment_dir, "experiment_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"trials": records, "meta": self.experiment_meta},
+                      f, default=self._json_default)
+        os.replace(
+            tmp, os.path.join(self.experiment_dir,
+                              "experiment_state.json"))
+        self._persisted_sig = sig
 
     def _maybe_create_trial(self) -> Optional[Trial]:
         if self.searcher is None or len(self.trials) >= self.num_samples:
@@ -254,7 +331,9 @@ class TrialRunner:
                     break
                 self._drain_queue()
                 self._poll_completions()
+                self._persist()
         finally:
+            self._persist()
             for t in self.trials:
                 self._stop_actor(t)
             self.queue.shutdown()

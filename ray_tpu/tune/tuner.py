@@ -103,6 +103,85 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self.resources_per_trial = resources_per_trial
+        self._restored_trials: Optional[List[Trial]] = None
+
+    def experiment_dir(self) -> Optional[str]:
+        """Where experiment state snapshots live (None = no persistence):
+        RunConfig(storage_path=...)/[name]."""
+        import os
+
+        if not self.run_config.storage_path:
+            return None
+        return os.path.join(
+            self.run_config.storage_path,
+            self.run_config.name or "experiment")
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                *, tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None,
+                resources_per_trial: Optional[dict] = None) -> "Tuner":
+        """Resume a crashed/interrupted experiment from its state
+        snapshots (reference ``Tuner.restore(path, trainable)``):
+        finished trials keep their results, errored trials keep their
+        error, unfinished ones restart from their last persisted
+        checkpoint when ``fit()`` is called. Pass ``tune_config`` to
+        continue a search_alg-driven experiment (un-suggested samples are
+        generated with the restored results replayed into the searcher);
+        metric/mode default to the persisted values."""
+        import copy
+        import json
+        import os
+        import pickle
+
+        path = os.path.abspath(path)
+        state_file = os.path.join(path, "experiment_state.json")
+        with open(state_file) as f:
+            state = json.load(f)
+        from ray_tpu.tune.trial_runner import ERROR, PENDING, TERMINATED
+
+        trials: List[Trial] = []
+        for rec in state["trials"]:
+            t = Trial(rec["config"] if not rec.get("lossy") else {},
+                      rec.get("resources"))
+            t.trial_id = rec["trial_id"]
+            t.last_result = rec.get("last_result")
+            if t.last_result:
+                t.metrics_history = [t.last_result]
+            t.num_failures = rec.get("num_failures", 0)
+            ckpt_file = rec.get("checkpoint_file")
+            if ckpt_file and os.path.exists(ckpt_file):
+                with open(ckpt_file, "rb") as f:
+                    t.checkpoint = Checkpoint.from_dict(pickle.load(f))
+            status = rec.get("status")
+            if status == ERROR or rec.get("lossy"):
+                # Keep the failure (or the un-round-trippable config)
+                # visible instead of re-running or masquerading as done.
+                t.status = ERROR
+                t.error = RuntimeError(
+                    rec.get("error")
+                    or "config could not be restored losslessly")
+            elif status == TERMINATED:
+                t.status = TERMINATED
+            else:
+                t.status = PENDING  # re-runs from its checkpoint
+            trials.append(t)
+        meta = state.get("meta") or {}
+        if tune_config is None:
+            tune_config = TuneConfig(
+                metric=meta.get("metric"),
+                mode=meta.get("mode") or "max",
+                num_samples=int(meta.get("num_samples") or len(trials)),
+            )
+        storage_root, name = os.path.split(path.rstrip(os.sep))
+        rc = copy.copy(run_config) if run_config is not None \
+            else RunConfig()
+        rc.storage_path = storage_root
+        rc.name = name
+        tuner = cls(trainable, tune_config=tune_config, run_config=rc,
+                    resources_per_trial=resources_per_trial)
+        tuner._restored_trials = trials
+        return tuner
 
     def fit(self) -> ResultGrid:
         from ray_tpu.tune.stopper import coerce_stopper
@@ -110,7 +189,22 @@ class Tuner:
         resources = self.resources_per_trial or getattr(
             self.trainable, "_tune_resources", None)
         searcher = self.tune_config.search_alg
-        if searcher is not None:
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+            if searcher is not None:
+                # Continue the search: replay finished trials into the
+                # ask/tell state, then let the runner request the
+                # remaining num_samples suggestions.
+                searcher.set_search_properties(
+                    self.tune_config.metric, self.tune_config.mode,
+                    self.param_space)
+                from ray_tpu.tune.trial_runner import TERMINATED as _T
+
+                for t in trials:
+                    if t.status == _T and t.last_result:
+                        searcher.on_trial_complete(
+                            t.trial_id, t.last_result)
+        elif searcher is not None:
             ok = searcher.set_search_properties(
                 self.tune_config.metric, self.tune_config.mode,
                 self.param_space)
@@ -136,7 +230,13 @@ class Tuner:
             searcher=searcher,
             num_samples=self.tune_config.num_samples,
             trial_resources=resources,
+            experiment_dir=self.experiment_dir(),
         )
+        runner.experiment_meta = {
+            "metric": self.tune_config.metric,
+            "mode": self.tune_config.mode,
+            "num_samples": self.tune_config.num_samples,
+        }
         runner.run()
         trials = runner.trials
         results = [
