@@ -24,6 +24,16 @@ from ray_tpu._private.usage import record_library_usage as _rlu
 _rlu("rllib")
 
 from ray_tpu.rllib.a2c import A2C, A2CConfig
+from ray_tpu.rllib.connectors import (
+    ClipActions,
+    ClipObs,
+    Connector,
+    ConnectorPipeline,
+    FlattenObs,
+    FrameStack,
+    NormalizeObs,
+    UnsquashActions,
+)
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, make_vec_env
 from ray_tpu.rllib.env import Pendulum
@@ -48,6 +58,14 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 
 __all__ = [
     "A2C",
+    "Connector",
+    "ConnectorPipeline",
+    "ClipObs",
+    "ClipActions",
+    "FlattenObs",
+    "FrameStack",
+    "NormalizeObs",
+    "UnsquashActions",
     "A2CConfig",
     "TD3",
     "TD3Config",

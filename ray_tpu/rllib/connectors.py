@@ -1,0 +1,185 @@
+"""Connectors: composable transforms between env and policy.
+
+Reference parity: ``rllib/connectors/`` — per-policy pipelines that
+reshape observations on the way INTO the policy (env-to-module) and
+actions on the way OUT (module-to-env), checkpointable alongside the
+policy so a trained policy can be served against raw env data.
+
+Rebuilt TPU-native: a connector is a PURE function over (state, value) —
+state is an explicit pytree, so the same pipeline runs host-side (numpy,
+gym workers) or inside a jitted rollout (jax arrays through lax.scan),
+and serializes with plain pickle. Stateful connector state travels with
+the algorithm checkpoint (``PPO.save`` pulls it from the gym workers and
+``restore`` pushes it back) and ``compute_single_action`` applies the
+same pipeline at inference. With several rollout workers each maintains
+its own running stats (the reference's per-worker observation filters
+behave the same way without an explicit sync).
+
+    pipe = ConnectorPipeline([ClipObs(-5, 5), NormalizeObs(4)])
+    state = pipe.init()
+    state, obs = pipe(state, obs)       # env -> module
+    act_pipe = ConnectorPipeline([ClipActions(-2.0, 2.0)])
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import numpy as np
+
+
+class Connector:
+    """One transform. ``init() -> state``; ``__call__(state, x) ->
+    (state, x)``. Stateless connectors return their state unchanged."""
+
+    def init(self):
+        return ()
+
+    def __call__(self, state, x):
+        raise NotImplementedError
+
+    def reset_rows(self, state, done_mask):
+        """Clear per-env rows of the state at episode boundaries (only
+        meaningful for per-env-stateful connectors like FrameStack)."""
+        return state
+
+
+class ConnectorPipeline(Connector):
+    """Left-to-right composition; state is the tuple of stage states
+    (a pytree — jit/scan friendly)."""
+
+    def __init__(self, connectors: Sequence[Connector]):
+        self.connectors = list(connectors)
+
+    def init(self) -> Tuple:
+        return tuple(c.init() for c in self.connectors)
+
+    def __call__(self, state, x):
+        out_states = []
+        for c, s in zip(self.connectors, state):
+            s, x = c(s, x)
+            out_states.append(s)
+        return tuple(out_states), x
+
+    def append(self, connector: Connector) -> "ConnectorPipeline":
+        return ConnectorPipeline(self.connectors + [connector])
+
+    def reset_rows(self, state, done_mask):
+        return tuple(
+            c.reset_rows(s, done_mask)
+            for c, s in zip(self.connectors, state))
+
+
+# -- observation connectors (env -> module) ---------------------------------
+
+
+class ClipObs(Connector):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def __call__(self, state, x):
+        import jax.numpy as jnp
+
+        xp = jnp if not isinstance(x, np.ndarray) else np
+        return state, xp.clip(x, self.low, self.high)
+
+
+class FlattenObs(Connector):
+    """[..., *dims] -> [..., prod(dims)] keeping the batch axis."""
+
+    def __call__(self, state, x):
+        return state, x.reshape(x.shape[0], -1)
+
+
+class NormalizeObs(Connector):
+    """Running mean/std normalization (the reference's
+    MeanStdObservationFilter): Welford-style accumulators carried in the
+    explicit state, updated on every batch seen during sampling."""
+
+    def __init__(self, obs_size: int, clip: float = 10.0,
+                 update: bool = True):
+        self.obs_size = obs_size
+        self.clip = clip
+        self.update = update
+
+    def init(self):
+        return {
+            "count": np.float32(1e-4),
+            "mean": np.zeros(self.obs_size, np.float32),
+            "m2": np.zeros(self.obs_size, np.float32),
+        }
+
+    def __call__(self, state, x):
+        import jax.numpy as jnp
+
+        xp = jnp if not isinstance(x, np.ndarray) else np
+        if self.update:
+            b = x.shape[0]
+            b_mean = x.mean(axis=0)
+            b_var = x.var(axis=0)
+            count = state["count"] + b
+            delta = b_mean - state["mean"]
+            mean = state["mean"] + delta * (b / count)
+            m2 = (state["m2"] + b_var * b
+                  + (delta ** 2) * state["count"] * b / count)
+            state = {"count": count, "mean": mean, "m2": m2}
+        std = xp.sqrt(state["m2"] / state["count"]) + 1e-8
+        return state, xp.clip(
+            (x - state["mean"]) / std, -self.clip, self.clip)
+
+
+class FrameStack(Connector):
+    """Stack the last k observations along the feature axis (Atari-style
+    temporal context without recurrence). State holds the ring of k-1
+    previous frames per batch row."""
+
+    def __init__(self, obs_size: int, num_envs: int, k: int = 4):
+        self.obs_size = obs_size
+        self.num_envs = num_envs
+        self.k = k
+
+    def init(self):
+        return np.zeros(
+            (self.k - 1, self.num_envs, self.obs_size), np.float32)
+
+    def __call__(self, state, x):
+        import jax.numpy as jnp
+
+        xp = jnp if not isinstance(x, np.ndarray) else np
+        frames = xp.concatenate([state, x[None]], axis=0)  # [k, B, D]
+        stacked = xp.concatenate(
+            [frames[i] for i in range(self.k)], axis=-1)   # [B, k*D]
+        return frames[1:], stacked
+
+    def reset_rows(self, state, done_mask):
+        """Zero a finished env's history so a new episode never stacks
+        against the previous one's frames."""
+        import jax.numpy as jnp
+
+        xp = jnp if not isinstance(state, np.ndarray) else np
+        mask = xp.asarray(done_mask, bool)[None, :, None]
+        return xp.where(mask, 0.0, state)
+
+
+# -- action connectors (module -> env) --------------------------------------
+
+
+class ClipActions(Connector):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def __call__(self, state, x):
+        import jax.numpy as jnp
+
+        xp = jnp if not isinstance(x, np.ndarray) else np
+        return state, xp.clip(x, self.low, self.high)
+
+
+class UnsquashActions(Connector):
+    """[-1, 1] policy outputs -> the env's [low, high] box."""
+
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def __call__(self, state, x):
+        return state, self.low + (x + 1.0) * 0.5 * (self.high - self.low)

@@ -25,7 +25,8 @@ class GymRolloutWorker:
     def __init__(self, env_name: str, *, num_envs: int = 8,
                  rollout_length: int = 128, gamma: float = 0.99,
                  gae_lambda: float = 0.95, seed: int = 0,
-                 env_kwargs: Optional[dict] = None):
+                 env_kwargs: Optional[dict] = None,
+                 obs_connectors: Optional[list] = None):
         import gymnasium as gym
 
         self.envs = [gym.make(env_name, **(env_kwargs or {}))
@@ -41,13 +42,55 @@ class GymRolloutWorker:
         self._apply = None  # jitted policy forward, built on first sample
         # Per-env running episode return for REAL reward reporting.
         self._ep_return = np.zeros(num_envs, np.float64)
+        # Env-to-module connector pipeline (reference rllib/connectors):
+        # the policy sees (and trains on) TRANSFORMED observations, and
+        # stateful connectors (running normalization) carry their state
+        # across sample() calls for the worker's lifetime.
+        self._obs_pipe = None
+        if obs_connectors:
+            from ray_tpu.rllib.connectors import ConnectorPipeline
+
+            self._obs_pipe = ConnectorPipeline(list(obs_connectors))
+            self._obs_state = self._obs_pipe.init()
+
+    def _transform_obs(self, obs: np.ndarray,
+                       update: bool = True) -> np.ndarray:
+        if self._obs_pipe is None:
+            return obs
+        state, out = self._obs_pipe(self._obs_state, obs)
+        if update:
+            self._obs_state = state
+        return np.asarray(out, np.float32)
+
+    def _transform_single(self, obs_row: np.ndarray,
+                          env_idx: int) -> np.ndarray:
+        """Transform ONE env's observation through connectors whose state
+        is batch-shape-bound (e.g. FrameStack): substitute the row into a
+        copy of the current full batch and take its output row — shape
+        correct for every connector, never updating the stats."""
+        if self._obs_pipe is None:
+            return obs_row
+        batch = np.array(self.obs, np.float32)
+        batch[env_idx] = obs_row
+        _, out = self._obs_pipe(self._obs_state, batch)
+        return np.asarray(out, np.float32)[env_idx]
+
+    def get_connector_state(self):
+        """Pipeline state for checkpointing (PPO.save pulls this)."""
+        return self._obs_state if self._obs_pipe is not None else None
+
+    def set_connector_state(self, state) -> None:
+        if self._obs_pipe is not None and state is not None:
+            self._obs_state = state
+        return None
 
     def sample(self, params) -> dict:
         import jax
         import jax.numpy as jnp
 
         t_, n = self.rollout_length, self.num_envs
-        obs_buf = np.zeros((t_, n) + self.obs.shape[1:], np.float32)
+        probe = self._transform_obs(self.obs, update=False)
+        obs_buf = np.zeros((t_, n) + probe.shape[1:], np.float32)
         act_buf = np.zeros((t_, n), np.int64)
         logp_buf = np.zeros((t_, n), np.float32)
         val_buf = np.zeros((t_ + 1, n), np.float32)
@@ -60,7 +103,8 @@ class GymRolloutWorker:
         ep_returns: list = []
         truncated_at: list = []  # (t, i, final_obs) — bootstrap targets
         for t in range(t_):
-            logits, values = apply(params, jnp.asarray(self.obs))
+            cur = self._transform_obs(self.obs)
+            logits, values = apply(params, jnp.asarray(cur))
             logits = np.asarray(logits)
             val_buf[t] = np.asarray(values)
             # Gumbel-max categorical sample (numpy side)
@@ -69,7 +113,7 @@ class GymRolloutWorker:
             logp_all = logits - _logsumexp(logits)
             logp_buf[t] = np.take_along_axis(
                 logp_all, actions[:, None], axis=1)[:, 0]
-            obs_buf[t] = self.obs
+            obs_buf[t] = cur
             act_buf[t] = actions
             for i, env in enumerate(self.envs):
                 nobs, rew, term, trunc, _ = env.step(int(actions[i]))
@@ -88,10 +132,20 @@ class GymRolloutWorker:
                     self._ep_return[i] = 0.0
                     nobs, _ = env.reset()
                 self.obs[i] = nobs
-        _, last_vals = apply(params, jnp.asarray(self.obs))
+            if self._obs_pipe is not None and done_buf[t].any():
+                # Episode boundaries: clear per-env connector history
+                # (frame stacks must not span episodes).
+                self._obs_state = self._obs_pipe.reset_rows(
+                    self._obs_state, done_buf[t] > 0)
+        _, last_vals = apply(
+            params, jnp.asarray(self._transform_obs(self.obs,
+                                                    update=False)))
         val_buf[t_] = np.asarray(last_vals)
         if truncated_at:
-            finals = np.stack([o for _, _, o in truncated_at])
+            finals = np.stack([
+                self._transform_single(o, i)
+                for _t, i, o in truncated_at
+            ])
             _, vfin = apply(params, jnp.asarray(finals))
             vfin = np.asarray(vfin)
             for k, (t, i, _) in enumerate(truncated_at):

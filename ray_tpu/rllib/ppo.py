@@ -89,6 +89,7 @@ class PPOConfig:
         self.hidden_sizes = (64, 64)
         self.num_rollout_workers = 0
         self.gym_env = None  # gymnasium env id for external-env workers
+        self.obs_connectors = None  # env-to-module pipeline (connectors.py)
         self.seed = 0
 
     def environment(self, env=None) -> "PPOConfig":
@@ -99,7 +100,8 @@ class PPOConfig:
     def rollouts(self, *, num_envs: Optional[int] = None,
                  rollout_length: Optional[int] = None,
                  num_rollout_workers: Optional[int] = None,
-                 gym_env: Optional[str] = None) -> "PPOConfig":
+                 gym_env: Optional[str] = None,
+                 obs_connectors: Optional[list] = None) -> "PPOConfig":
         if num_envs is not None:
             self.num_envs = num_envs
         if rollout_length is not None:
@@ -111,6 +113,11 @@ class PPOConfig:
             # step real gymnasium envs host-side instead of the pure-jax
             # vectorized env. Requires num_rollout_workers > 0.
             self.gym_env = gym_env
+        if obs_connectors is not None:
+            # Env-to-module connector pipeline (reference
+            # rllib/connectors): gym workers transform observations
+            # before the policy sees them.
+            self.obs_connectors = list(obs_connectors)
         return self
 
     def training(self, **kwargs) -> "PPOConfig":
@@ -318,6 +325,24 @@ class PPO:
             obs_size = int(probe.observation_space.shape[0])
             num_actions = int(probe.action_space.n)
             probe.close()
+            if config.obs_connectors:
+                # Shape-changing connectors (FrameStack, Flatten...) set
+                # the POLICY's input width: probe the pipeline with a
+                # batch shaped like the workers' (stateful connectors are
+                # batch-shape-bound).
+                import numpy as _np
+
+                from ray_tpu.rllib.connectors import ConnectorPipeline
+
+                pipe = ConnectorPipeline(list(config.obs_connectors))
+                _, out = pipe(
+                    pipe.init(),
+                    _np.zeros((config.num_envs, obs_size), _np.float32))
+                obs_size = int(_np.asarray(out).shape[-1])
+                self._infer_pipe = pipe
+            else:
+                self._infer_pipe = None
+            self._infer_state = None
         else:
             obs_size = config.env.observation_size
             num_actions = config.env.num_actions
@@ -350,6 +375,7 @@ class PPO:
                         gamma=config.gamma,
                         gae_lambda=config.gae_lambda,
                         seed=config.seed + 100 + i,
+                        obs_connectors=config.obs_connectors,
                     )
                     for i in range(config.num_rollout_workers)
                 ]
@@ -410,14 +436,34 @@ class PPO:
 
     # Trainable contract: save/restore.
     def save(self) -> dict:
-        return {
+        out = {
             "params": jax.tree.map(np.asarray, self.params),
             "iteration": self._iteration,
         }
+        if getattr(self, "_infer_pipe", None) is not None and self._workers:
+            # Connector state (running obs stats etc.) checkpoints with
+            # the policy — worker 0's view (per-worker stats, like the
+            # reference's per-worker observation filters).
+            try:
+                out["connector_state"] = ray_tpu.get(
+                    self._workers[0].get_connector_state.remote(),
+                    timeout=30)
+            except Exception:
+                pass
+        return out
 
     def restore(self, state: dict) -> None:
         self.params = jax.tree.map(jnp.asarray, state["params"])
         self._iteration = state["iteration"]
+        cs = state.get("connector_state")
+        if cs is not None:
+            self._infer_state = cs
+            for w in self._workers:
+                try:
+                    ray_tpu.get(
+                        w.set_connector_state.remote(cs), timeout=30)
+                except Exception:
+                    pass
 
     def stop(self) -> None:
         for w in self._workers:
@@ -427,5 +473,24 @@ class PPO:
                 pass
 
     def compute_single_action(self, obs) -> int:
-        logits, _ = policy_apply(self.params, jnp.asarray(obs)[None])
+        obs = jnp.asarray(obs)[None]
+        pipe = getattr(self, "_infer_pipe", None)
+        if pipe is not None:
+            # Inference applies the SAME env-to-module pipeline the
+            # policy trained through, with frozen stats (pulled from
+            # worker 0 lazily, or set by restore()).
+            if self._infer_state is None and self._workers:
+                try:
+                    self._infer_state = ray_tpu.get(
+                        self._workers[0].get_connector_state.remote(),
+                        timeout=30)
+                except Exception:
+                    pass
+            state = (self._infer_state if self._infer_state is not None
+                     else pipe.init())
+            import numpy as _np
+
+            _, out = pipe(state, _np.asarray(obs, _np.float32))
+            obs = jnp.asarray(out)
+        logits, _ = policy_apply(self.params, obs)
         return int(jnp.argmax(logits[0]))

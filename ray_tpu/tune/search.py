@@ -65,6 +65,12 @@ class Searcher:
                           error: bool = False) -> None:
         pass
 
+    def tell(self, config: Dict[str, Any],
+             result: Optional[dict]) -> None:
+        """Inject an out-of-band observation (experiment restore replays
+        finished trials this way — their ids were never suggest()-ed)."""
+        pass
+
     # -- shared helpers ----------------------------------------------------
 
     def _score(self, result: Optional[dict]) -> Optional[float]:
@@ -343,3 +349,9 @@ class TPESearcher(Searcher):
         score = self._score(result)
         if score is not None and np.isfinite(score):
             self._obs.append((flat, score))
+
+    def tell(self, config: Dict[str, Any],
+             result: Optional[dict]) -> None:
+        score = self._score(result)
+        if score is not None and np.isfinite(score):
+            self._obs.append((_flatten(config), score))

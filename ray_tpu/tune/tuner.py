@@ -118,7 +118,8 @@ class Tuner:
 
     @classmethod
     def restore(cls, path: str, trainable: Callable,
-                *, tune_config: Optional[TuneConfig] = None,
+                *, param_space: Optional[Dict[str, Any]] = None,
+                tune_config: Optional[TuneConfig] = None,
                 run_config: Optional[RunConfig] = None,
                 resources_per_trial: Optional[dict] = None) -> "Tuner":
         """Resume a crashed/interrupted experiment from its state
@@ -178,7 +179,8 @@ class Tuner:
             else RunConfig()
         rc.storage_path = storage_root
         rc.name = name
-        tuner = cls(trainable, tune_config=tune_config, run_config=rc,
+        tuner = cls(trainable, param_space=param_space,
+                    tune_config=tune_config, run_config=rc,
                     resources_per_trial=resources_per_trial)
         tuner._restored_trials = trials
         return tuner
@@ -202,8 +204,9 @@ class Tuner:
 
                 for t in trials:
                     if t.status == _T and t.last_result:
-                        searcher.on_trial_complete(
-                            t.trial_id, t.last_result)
+                        # tell(), not on_trial_complete(): these ids were
+                        # never suggest()-ed by THIS searcher instance.
+                        searcher.tell(t.config, t.last_result)
         elif searcher is not None:
             ok = searcher.set_search_properties(
                 self.tune_config.metric, self.tune_config.mode,

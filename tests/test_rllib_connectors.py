@@ -1,0 +1,133 @@
+"""Connector pipelines (reference ``rllib/connectors/``): pure
+state-explicit transforms between env and policy, host- and jax-side."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ray_tpu.rllib import (
+    ClipActions,
+    ClipObs,
+    ConnectorPipeline,
+    FlattenObs,
+    FrameStack,
+    NormalizeObs,
+    UnsquashActions,
+)
+
+
+def test_pipeline_composes_and_threads_state():
+    pipe = ConnectorPipeline([ClipObs(-1.0, 1.0), NormalizeObs(3)])
+    state = pipe.init()
+    x = np.array([[5.0, -5.0, 0.5]] * 4, np.float32)
+    state, out = pipe(state, x)
+    assert out.shape == (4, 3)
+    # Clip ran before normalize: the raw 5.0 entered the stats as 1.0.
+    assert abs(float(state[1]["mean"][0]) - 1.0) < 1e-3
+    # Constant batch => (x - mean) ~ 0 after normalization.
+    np.testing.assert_allclose(out, 0.0, atol=1e-2)
+
+
+def test_normalize_obs_converges_to_unit_scale():
+    rng = np.random.default_rng(0)
+    norm = NormalizeObs(2)
+    state = norm.init()
+    for _ in range(50):
+        batch = rng.normal(loc=[10.0, -3.0], scale=[4.0, 0.5],
+                           size=(64, 2)).astype(np.float32)
+        state, out = norm(state, batch)
+    assert abs(float(out.mean(axis=0)[0])) < 0.3
+    assert 0.7 < float(out.std(axis=0)[0]) < 1.3
+    # Frozen (update=False equivalent): inference-time connectors reuse
+    # the trained stats without drift.
+    frozen = NormalizeObs(2, update=False)
+    s2, out2 = frozen(state, batch)
+    assert s2 is state  # state untouched
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out),
+                               atol=1e-5)
+
+
+def test_framestack_and_flatten():
+    fs = FrameStack(obs_size=2, num_envs=3, k=3)
+    state = fs.init()
+    outs = []
+    for step in range(4):
+        x = np.full((3, 2), float(step), np.float32)
+        state, out = fs(state, x)
+        outs.append(np.asarray(out))
+    assert outs[-1].shape == (3, 6)
+    # Last stacked row: frames [1, 2, 3] for each env.
+    np.testing.assert_allclose(outs[-1][0], [1, 1, 2, 2, 3, 3])
+
+    flat = FlattenObs()
+    _, y = flat((), np.zeros((5, 2, 3), np.float32))
+    assert y.shape == (5, 6)
+
+
+def test_action_connectors_jax_and_numpy():
+    pipe = ConnectorPipeline([UnsquashActions(-2.0, 2.0),
+                              ClipActions(-1.5, 1.5)])
+    state = pipe.init()
+    _, a_np = pipe(state, np.array([[-1.0], [0.0], [1.0]], np.float32))
+    np.testing.assert_allclose(a_np[:, 0], [-1.5, 0.0, 1.5])
+    _, a_jx = pipe(state, jnp.asarray([[-1.0], [0.0], [1.0]]))
+    np.testing.assert_allclose(np.asarray(a_jx)[:, 0], [-1.5, 0.0, 1.5])
+
+
+def test_gym_worker_with_normalize_connector():
+    """The gym rollout worker trains its policy on CONNECTOR-transformed
+    observations, with running stats persisting across sample() calls."""
+    pytest.importorskip("gymnasium")
+    import jax
+
+    from ray_tpu.rllib.gym_env import GymRolloutWorker
+    from ray_tpu.rllib.ppo import policy_init
+
+    w = GymRolloutWorker(
+        "CartPole-v1", num_envs=4, rollout_length=16, seed=0,
+        obs_connectors=[NormalizeObs(4)])
+    params = policy_init(jax.random.key(0), 4, 2)
+    b1 = w.sample(params)
+    count1 = float(w._obs_state[0]["count"])
+    b2 = w.sample(params)
+    count2 = float(w._obs_state[0]["count"])
+    assert count2 > count1 > 4  # stats accumulated across calls
+    assert b1["obs"].shape[1] == 4
+    # Transformed obs are roughly standardized (not raw cart positions).
+    assert abs(float(np.asarray(b2["obs"]).mean())) < 1.0
+    w.close()
+
+
+def test_ppo_gym_with_framestack_connector():
+    """Shape-changing connectors size the policy (k*D inputs) and the
+    whole train loop runs: rollout -> stacked obs -> update."""
+    pytest.importorskip("gymnasium")
+    import ray_tpu
+    from ray_tpu.rllib import PPOConfig
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    try:
+        algo = (
+            PPOConfig()
+            .rollouts(num_envs=4, rollout_length=16,
+                      num_rollout_workers=1, gym_env="CartPole-v1",
+                      obs_connectors=[FrameStack(obs_size=4, num_envs=4,
+                                                 k=3)])
+            .training(minibatch_count=2, num_sgd_iter=2)
+            .debugging(seed=0)
+            .build()
+        )
+        res = algo.train()
+        assert res["timesteps_this_iter"] == 64
+        # Inference path applies the same pipeline: a raw 4-dim obs works
+        # even though the policy takes 12-dim stacked inputs... only when
+        # the caller stacks; single-obs inference through a
+        # batch-shape-bound connector raises a clear shape error instead
+        # of silently feeding raw obs.
+        with pytest.raises(Exception):
+            algo.compute_single_action(np.zeros(4, np.float32))
+        algo.stop()
+    finally:
+        ray_tpu.shutdown()
