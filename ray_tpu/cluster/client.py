@@ -1005,11 +1005,22 @@ class ClusterBackend:
         spec["_handled"] = True
         spec.setdefault("_pending_since", time.monotonic())
         with self._submit_cv:
-            self._retry_seq += 1
-            heapq.heappush(
-                self._retry_heap,
-                (time.monotonic() + delay, self._retry_seq, spec))
-            self._submit_cv.notify()
+            if not self._closed:
+                self._retry_seq += 1
+                heapq.heappush(
+                    self._retry_heap,
+                    (time.monotonic() + delay, self._retry_seq, spec))
+                self._submit_cv.notify()
+                return
+        # Shutdown in progress: nothing will ever drain the retry heap
+        # again (shutdown's fail pass may already have run) — fail the
+        # spec into its refs now so no get() is left blocking.
+        self._end_borrows(spec)
+        self._fail_spec(spec, TaskError(
+            spec.get("fname", "task"),
+            "client shut down with the task still unscheduled",
+            "shutdown",
+        ))
 
     def _park_pending(self, spec: dict) -> None:
         """No feasible node right now: bounded retry via the shared timer
@@ -1731,6 +1742,29 @@ class ClusterBackend:
         while ((self._submit_q or self._dispatching)
                and time.monotonic() < deadline):
             time.sleep(0.02)
+        # Specs parked on the retry timer (unplaceable demand, node-submit
+        # retries) can never run now — fail them into their result refs so
+        # a concurrent get() raises instead of blocking to its own timeout.
+        # _closed is set under the same lock BEFORE the heap snapshot so a
+        # retry that comes due mid-shutdown cannot re-park after the clear
+        # (_queue_retry fails specs instead once closed).
+        with self._submit_cv:
+            self._closed = True
+            parked = [entry[2] for entry in self._retry_heap]
+            self._retry_heap.clear()
+            self._submit_cv.notify_all()
+        for spec in parked:
+            # Parked specs carry _handled=True (the dispatch loop took
+            # responsibility) but by definition have produced no result.
+            try:
+                self._end_borrows(spec)
+                self._fail_spec(spec, TaskError(
+                    spec.get("fname", "task"),
+                    "client shut down with the task still unscheduled",
+                    "shutdown",
+                ))
+            except Exception:
+                pass  # store may already be unreachable
         # Release every hold this process still has so the cluster can
         # free the objects (clean-exit ref release).
         with self._ref_lock:

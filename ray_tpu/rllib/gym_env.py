@@ -125,8 +125,11 @@ class GymRolloutWorker:
                     # Time-limit truncation is NOT failure: bootstrap the
                     # return from V(final_obs) instead of zeroing it
                     # (reference rollout postprocessing semantics).
-                    truncated_at.append((t, i, np.asarray(
-                        nobs, np.float32)))
+                    # Transform NOW, with the connector state as of this
+                    # step — deferring to rollout end would stack the
+                    # final obs against frames from later steps/episodes.
+                    truncated_at.append((t, i, self._transform_single(
+                        np.asarray(nobs, np.float32), i)))
                 if done:
                     ep_returns.append(self._ep_return[i])
                     self._ep_return[i] = 0.0
@@ -142,10 +145,7 @@ class GymRolloutWorker:
                                                     update=False)))
         val_buf[t_] = np.asarray(last_vals)
         if truncated_at:
-            finals = np.stack([
-                self._transform_single(o, i)
-                for _t, i, o in truncated_at
-            ])
+            finals = np.stack([o for _t, _i, o in truncated_at])
             _, vfin = apply(params, jnp.asarray(finals))
             vfin = np.asarray(vfin)
             for k, (t, i, _) in enumerate(truncated_at):

@@ -39,6 +39,12 @@ class Searcher:
         searcher.on_trial_complete(trial_id, result)    # final
     """
 
+    #: True for searchers that pre-expand their own trial budget (grid x
+    #: num_samples). The runner must then run them to exhaustion instead of
+    #: capping at ``num_samples`` — a grid of 3 with num_samples=2 is 6
+    #: trials, not 2.
+    expands_variants = False
+
     def __init__(self, metric: Optional[str] = None, mode: str = "max"):
         if mode not in ("max", "min"):
             raise ValueError("mode must be 'max' or 'min'")
@@ -85,6 +91,8 @@ class Searcher:
 class BasicVariantSearcher(Searcher):
     """The default searcher: pre-expands grid x num_samples variants and
     deals them out (``search/basic_variant.py`` semantics)."""
+
+    expands_variants = True
 
     def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
                  seed: Optional[int] = None, **kw):

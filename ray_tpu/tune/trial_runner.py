@@ -53,6 +53,20 @@ class Trial:
         self.generation = 0  # bumped on restart; stale reports are dropped
         self.actor = None
         self.run_ref = None
+        self.version = 0  # monotonic dirty counter, see __setattr__
+
+    # Persisted fields bump a monotonic version so the snapshot change
+    # signature never relies on id() — a fresh object at a GC-reused
+    # address would otherwise compare equal and skip a real state change
+    # (advisor r4).
+    _VERSIONED = frozenset(
+        {"status", "last_result", "checkpoint", "num_failures", "error"})
+
+    def __setattr__(self, name, value):
+        object.__setattr__(self, name, value)
+        if name in Trial._VERSIONED:
+            object.__setattr__(self, "version",
+                               getattr(self, "version", 0) + 1)
 
     def __repr__(self):
         return f"Trial({self.trial_id}, {self.status})"
@@ -125,11 +139,7 @@ class TrialRunner:
     def _persist(self) -> None:
         if not self.experiment_dir:
             return
-        sig = tuple(
-            (t.trial_id, t.status, t.num_failures,
-             id(t.checkpoint), id(t.last_result))
-            for t in self.trials
-        )
+        sig = tuple((t.trial_id, t.version) for t in self.trials)
         if sig == self._persisted_sig:
             return  # nothing changed since the last snapshot
         import json
@@ -183,7 +193,13 @@ class TrialRunner:
         self._persisted_sig = sig
 
     def _maybe_create_trial(self) -> Optional[Trial]:
-        if self.searcher is None or len(self.trials) >= self.num_samples:
+        if self.searcher is None:
+            return None
+        # Variant-expanding searchers (grid x num_samples) own their trial
+        # budget: run them until suggest() returns None. Capping those at
+        # num_samples would silently drop grid variants (advisor r4).
+        if (not getattr(self.searcher, "expands_variants", False)
+                and len(self.trials) >= self.num_samples):
             return None
         trial = Trial({}, self.trial_resources)
         cfg = self.searcher.suggest(trial.trial_id)

@@ -202,6 +202,13 @@ class Tuner:
                     self.param_space)
                 from ray_tpu.tune.trial_runner import TERMINATED as _T
 
+                if getattr(searcher, "expands_variants", False):
+                    # Variant-expanding searchers pre-deal a fixed set:
+                    # consume one variant per restored trial so resume
+                    # deals only what was never created, instead of
+                    # re-running the whole grid as duplicates.
+                    for t in trials:
+                        searcher.suggest(t.trial_id)
                 for t in trials:
                     if t.status == _T and t.last_result:
                         # tell(), not on_trial_complete(): these ids were

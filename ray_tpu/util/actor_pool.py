@@ -68,6 +68,12 @@ class ActorPool:
         if not self.has_next():
             raise StopIteration("no more results to get")
         future = self._oldest_pending()
+        if future is None:
+            # Backlogged work but nothing in flight: no actor can ever pick
+            # it up (pool built with zero actors, or all were pop_idle'd).
+            raise RuntimeError(
+                f"ActorPool has {len(self._backlog)} queued submission(s) "
+                "but no actors to run them; push() an actor first")
         try:
             value = ray_tpu.get(future, timeout=timeout)
         except GetTimeoutError:
@@ -82,6 +88,10 @@ class ActorPool:
         """Earliest-finishing result, any order."""
         if not self.has_next():
             raise StopIteration("no more results to get")
+        if not self._actor_of:
+            raise RuntimeError(
+                f"ActorPool has {len(self._backlog)} queued submission(s) "
+                "but no actors to run them; push() an actor first")
         ready, _ = ray_tpu.wait(
             list(self._actor_of), num_returns=1, timeout=timeout
         )
