@@ -60,13 +60,15 @@ def _peak_flops_per_chip(device_kind: str) -> float:
 # --------------------------------------------------------------------------
 
 
-def _worker(platform: str) -> None:
+def _worker(platform: str, variant: str = "auto") -> None:
     if platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    import dataclasses
+
     import jax.numpy as jnp
 
     from ray_tpu.models.gpt2 import (
@@ -85,61 +87,105 @@ def _worker(platform: str) -> None:
         # GPT-2 small, seq 1024. Measured-fastest v5e config (round 3):
         # Pallas flash attention, selective remat (save matmul outputs,
         # recompute elementwise), unrolled layer loop.
-        cfg = GPT2Config(use_flash=True, remat="dots", scan_layers=False)
+        base = GPT2Config(use_flash=True, remat="dots", scan_layers=False)
+        # Round-5 lever (PROFILE.md sink #2): bf16 head matmul + chunked-
+        # vocab online CE. 3 chunks keeps the 50304 vocab slice a
+        # multiple of 128 lanes (50304 = 3 * 131 * 128).
+        lever = dataclasses.replace(
+            base, logits_dtype=jnp.bfloat16, ce_vocab_chunks=3)
         batch, steps, warmup = 16 * n_dev, 20, 3
     else:
-        cfg = GPT2Config.tiny()
+        base = GPT2Config.tiny()
+        lever = dataclasses.replace(
+            base, logits_dtype=jnp.bfloat16, ce_vocab_chunks=4)
         batch, steps, warmup = 8, 5, 1
 
     mesh = build_mesh(MeshConfig(fsdp=-1))
-    shardings = gpt2_shardings(cfg, mesh)
-    init_fn = make_init_fn(lambda r: gpt2_init(r, cfg), shardings, mesh)
-    state = init_fn(jax.random.key(0))
-    step_fn = make_train_step(lambda p, b: gpt2_loss(p, b, cfg), shardings, mesh)
 
-    tokens = jax.random.randint(
-        jax.random.key(1), (batch, cfg.seq_len + 1), 0, cfg.vocab_size, jnp.int32
-    )
-    batch_data = {"tokens": tokens}
+    def measure(cfg):
+        shardings = gpt2_shardings(cfg, mesh)
+        init_fn = make_init_fn(lambda r: gpt2_init(r, cfg), shardings, mesh)
+        state = init_fn(jax.random.key(0))
+        step_fn = make_train_step(
+            lambda p, b: gpt2_loss(p, b, cfg), shardings, mesh)
+        tokens = jax.random.randint(
+            jax.random.key(1), (batch, cfg.seq_len + 1), 0, cfg.vocab_size,
+            jnp.int32,
+        )
+        batch_data = {"tokens": tokens}
+        for _ in range(warmup):
+            state, metrics = step_fn(state, batch_data)
+        # float() forces a device->host transfer of the whole dispatch
+        # chain; block_until_ready alone is not reliable on experimental
+        # backends.
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch_data)
+        final_loss = float(metrics["loss"])
+        dt = time.perf_counter() - t0
+        tok_s = batch * cfg.seq_len * steps / dt
+        return tok_s, final_loss, dt
 
-    for _ in range(warmup):
-        state, metrics = step_fn(state, batch_data)
-    # float() forces a device->host transfer of the whole dispatch chain;
-    # block_until_ready alone is not reliable on experimental backends.
-    float(metrics["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step_fn(state, batch_data)
-    final_loss = float(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    tokens_per_step = batch * cfg.seq_len
-    tok_s = tokens_per_step * steps / dt
-    flops_tok = gpt2_flops_per_token(cfg)
-    achieved = tok_s * flops_tok
+    configs = {"base": base, "lever": lever}
     device_kind = jax.devices()[0].device_kind
-    mfu = achieved / (_peak_flops_per_chip(device_kind) * n_dev) * 100.0
 
-    print(
-        f"gpt2 {cfg.n_params / 1e6:.0f}M params, batch={batch}, seq={cfg.seq_len}, "
-        f"{steps} steps in {dt:.2f}s, loss={final_loss:.3f}",
-        file=sys.stderr,
-    )
-    print(
-        json.dumps(
-            {
-                "metric": "gpt2_train_mfu",
-                "value": round(mfu, 2),
-                "unit": "%",
-                "vs_baseline": round(mfu / 45.0, 3),
-                "tokens_per_sec_per_chip": round(tok_s / n_dev, 1),
-                "device": device_kind,
-                "n_devices": n_dev,
-            }
-        ),
-        flush=True,
-    )
+    def emit(chosen: str, tok_s: float, final_loss: float, dt: float,
+             extras: dict) -> None:
+        cfg = configs[chosen]
+        achieved = tok_s * gpt2_flops_per_token(cfg)
+        mfu = achieved / (_peak_flops_per_chip(device_kind) * n_dev) * 100.0
+        print(
+            f"gpt2 {cfg.n_params / 1e6:.0f}M params, batch={batch}, "
+            f"seq={cfg.seq_len}, {steps} steps in {dt:.2f}s, "
+            f"loss={final_loss:.3f}, config={chosen}",
+            file=sys.stderr,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "gpt2_train_mfu",
+                    "value": round(mfu, 2),
+                    "unit": "%",
+                    # An off-TPU MFU ratioed against the TPU target is not
+                    # a comparable number — null it rather than mislead.
+                    "vs_baseline": round(mfu / 45.0, 3) if on_tpu else None,
+                    "tokens_per_sec_per_chip": round(tok_s / n_dev, 1),
+                    "device": device_kind,
+                    "n_devices": n_dev,
+                    "config": chosen,
+                    **extras,
+                }
+            ),
+            flush=True,
+        )
+
+    if variant == "auto":
+        # Measure both; report the faster. The base JSON line is emitted
+        # (and flushed) BEFORE the lever runs: if the lever hangs past
+        # the subprocess deadline, the orchestrator recovers the base
+        # measurement from partial stdout — a lever failure of any kind
+        # can never cost the headline number. The orchestrator keeps the
+        # LAST JSON line, so a faster lever simply supersedes base.
+        base_tok_s, base_loss, base_dt = measure(base)
+        emit("base", base_tok_s, base_loss, base_dt, {})
+        try:
+            tok_s2, loss2, dt2 = measure(lever)
+        except Exception as e:  # noqa: BLE001 — base line already out
+            print(f"lever config failed: {e!r}", file=sys.stderr)
+            return
+        if tok_s2 > base_tok_s:
+            emit("lever", tok_s2, loss2, dt2,
+                 {"base_tokens_per_sec_per_chip":
+                  round(base_tok_s / n_dev, 1)})
+        else:
+            # Re-emit base with the lever's number attached for the record.
+            emit("base", base_tok_s, base_loss, base_dt,
+                 {"lever_tokens_per_sec_per_chip":
+                  round(tok_s2 / n_dev, 1)})
+    else:
+        tok_s, final_loss, dt = measure(configs[variant])
+        emit(variant, tok_s, final_loss, dt, {})
 
 
 # --------------------------------------------------------------------------
@@ -163,7 +209,22 @@ def _run_subprocess(argv, platform: str, timeout: float):
             argv, env=_subproc_env(platform), capture_output=True, text=True,
             timeout=timeout,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # The worker flushes a JSON line per completed measurement: a
+        # hang partway (e.g. the lever config after base finished) still
+        # leaves a recoverable result in the captured partial stdout.
+        out = e.stdout or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        for line in reversed(out.strip().splitlines()):
+            try:
+                obj = json.loads(line)
+                if isinstance(obj, dict) and "metric" in obj:
+                    return True, obj, (
+                        f"timeout after {timeout:.0f}s; kept last "
+                        f"completed measurement")
+            except (json.JSONDecodeError, ValueError):
+                continue
         return False, None, f"timeout after {timeout:.0f}s"
     sys.stderr.write(proc.stderr[-4000:])
     if proc.returncode != 0:
@@ -187,10 +248,16 @@ def main() -> None:
     # honored), bounded + retried once. No separate probe: the chip may be
     # exclusively claimed, and a probe-then-run would claim it twice.
     for attempt, tmo in enumerate((TPU_TIMEOUT_S, TPU_RETRY_TIMEOUT_S)):
+        # First attempt races base + lever configs; the shorter retry
+        # window only fits the single proven-fastest config.
+        variant = "auto" if attempt == 0 else "base"
         ok, result, err = _run_subprocess(
-            [sys.executable, __file__, "--worker", "default"],
+            [sys.executable, __file__, "--worker", "default", variant],
             "default", tmo,
         )
+        if ok and err:
+            # Partial recovery (worker hung after a completed measurement).
+            errors.append(f"tpu run attempt {attempt + 1}: {err}")
         if ok and result.get("device", "").lower() == "cpu":
             # No TPU attached: the default backend ran the CPU measurement.
             # That outcome is deterministic — keep this result as the CPU
@@ -205,9 +272,12 @@ def main() -> None:
     if result is None:
         # Degrade to a CPU measurement so a number is always recorded.
         for attempt in range(2):
+            # Pinned to base: the lever can't win off-TPU (bf16 is
+            # emulated through fp32 on CPU) and a second compile+measure
+            # cycle would eat the 120s budget for nothing.
             ok3, result, err = _run_subprocess(
-                [sys.executable, __file__, "--worker", "cpu"], "cpu",
-                CPU_TIMEOUT_S,
+                [sys.executable, __file__, "--worker", "cpu", "base"],
+                "cpu", CPU_TIMEOUT_S,
             )
             if ok3:
                 break
@@ -228,6 +298,9 @@ def main() -> None:
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
-        _worker(sys.argv[2] if len(sys.argv) > 2 else "default")
+        _worker(
+            sys.argv[2] if len(sys.argv) > 2 else "default",
+            sys.argv[3] if len(sys.argv) > 3 else "auto",
+        )
     else:
         main()

@@ -1014,13 +1014,18 @@ class ClusterBackend:
                 return
         # Shutdown in progress: nothing will ever drain the retry heap
         # again (shutdown's fail pass may already have run) — fail the
-        # spec into its refs now so no get() is left blocking.
-        self._end_borrows(spec)
-        self._fail_spec(spec, TaskError(
-            spec.get("fname", "task"),
-            "client shut down with the task still unscheduled",
-            "shutdown",
-        ))
+        # spec into its refs now so no get() is left blocking. Guarded:
+        # the store may already be unreachable this late in shutdown, and
+        # an escape here would mark the spec handled-but-unfailed.
+        try:
+            self._end_borrows(spec)
+            self._fail_spec(spec, TaskError(
+                spec.get("fname", "task"),
+                "client shut down with the task still unscheduled",
+                "shutdown",
+            ))
+        except Exception:
+            pass
 
     def _park_pending(self, spec: dict) -> None:
         """No feasible node right now: bounded retry via the shared timer

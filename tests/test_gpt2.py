@@ -78,6 +78,90 @@ def test_config_paths_match_baseline(remat, scan_layers):
         grads, base_grads)
 
 
+def test_chunked_vocab_ce_matches_dense():
+    """ce_vocab_chunks>1 (online-logsumexp scan over the vocab) must match
+    the dense fp32 loss and gradients to float tolerance — same math,
+    different memory schedule."""
+    # fp32 compute: the chunked scan permutes reduction order, so parity
+    # is only bitwise-tight when rounding isn't bf16-coarse.
+    f32 = dataclasses.replace(CFG, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.key(2), (2, 33), 0, CFG.vocab_size)
+    batch = {"tokens": tokens.astype(jnp.int32)}
+    params = gpt2_init(jax.random.key(0), f32)
+
+    base_loss, base_grads = jax.value_and_grad(
+        lambda p: gpt2_loss(p, batch, f32))(params)
+    for n_chunks in (2, 8):
+        cfg = dataclasses.replace(f32, ce_vocab_chunks=n_chunks)
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt2_loss(p, batch, cfg))(params)
+        np.testing.assert_allclose(float(loss), float(base_loss), rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            grads, base_grads)
+
+
+def test_chunked_must_divide_vocab():
+    cfg = dataclasses.replace(CFG, ce_vocab_chunks=7)  # 256 % 7 != 0
+    tokens = jnp.zeros((1, 9), jnp.int32)
+    params = gpt2_init(jax.random.key(0), CFG)
+    with pytest.raises(ValueError, match="must divide"):
+        gpt2_loss(params, {"tokens": tokens}, cfg)
+
+
+def test_bf16_logits_loss_parity():
+    """bf16 head matmul output with fp32 CE reductions: the loss must stay
+    within bf16 tolerance of the fp32-logits path (MaxText ships this as
+    its default; accuracy loss is bounded by logit rounding, not by the
+    reduction, which stays fp32)."""
+    tokens = jax.random.randint(jax.random.key(3), (2, 33), 0, CFG.vocab_size)
+    batch = {"tokens": tokens.astype(jnp.int32)}
+    params = gpt2_init(jax.random.key(0), CFG)
+
+    base_loss, base_grads = jax.value_and_grad(
+        lambda p: gpt2_loss(p, batch, CFG))(params)
+    for n_chunks in (1, 4):
+        cfg = dataclasses.replace(
+            CFG, logits_dtype=jnp.bfloat16, ce_vocab_chunks=n_chunks)
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt2_loss(p, batch, cfg))(params)
+        # bf16 has ~3 decimal digits: 1% on the loss value is rounding.
+        np.testing.assert_allclose(float(loss), float(base_loss), rtol=1e-2)
+        # Gradients: compare direction+scale, not elementwise bits.
+        flat_a = jnp.concatenate(
+            [g.ravel() for g in jax.tree.leaves(grads)]).astype(jnp.float32)
+        flat_b = jnp.concatenate(
+            [g.ravel() for g in jax.tree.leaves(base_grads)])
+        cos = float(jnp.vdot(flat_a, flat_b) /
+                    (jnp.linalg.norm(flat_a) * jnp.linalg.norm(flat_b)))
+        assert cos > 0.999, cos
+
+
+def test_bf16_chunked_trains():
+    """The full bench-flag combo (bf16 logits + chunked CE + dots remat +
+    unrolled layers) must still optimize."""
+    from ray_tpu.train.train_step import make_init_fn, make_train_step
+
+    cfg = dataclasses.replace(
+        GPT2Config.tiny(), logits_dtype=jnp.bfloat16, ce_vocab_chunks=4,
+        remat="dots", scan_layers=False)
+    mesh = build_mesh(MeshConfig())
+    shardings = gpt2_shardings(cfg, mesh)
+    state = make_init_fn(lambda r: gpt2_init(r, cfg), shardings, mesh)(
+        jax.random.key(0))
+    step = make_train_step(lambda p, b: gpt2_loss(p, b, cfg), shardings, mesh)
+    tokens = jax.random.randint(jax.random.key(1), (8, cfg.seq_len + 1),
+                                0, cfg.vocab_size, jnp.int32)
+    batch = {"tokens": tokens}
+    first = None
+    for _ in range(10):
+        state, metrics = step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+
+
 def test_sharded_step_matches_single_device(devices8):
     """dp2 x fsdp2 x tp2 sharded training must match 1-device numerics."""
     tokens = jax.random.randint(jax.random.key(1), (8, 33), 0, CFG.vocab_size)
