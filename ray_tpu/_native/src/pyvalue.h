@@ -539,6 +539,356 @@ inline Value pickle_loads(const std::string& blob) {
   return r.load();
 }
 
+// ------------------------------------------- msgpack wire codec (r5)
+// The RPC envelope switched from restricted pickle to msgpack
+// (ray_tpu/cluster/wire.py). Same closed type set; tuples/sets travel as
+// extension types, exceptions as EXT_EXC. The pickle codec above remains
+// for object-STORE payloads (user-value plane), which are Python pickle
+// by design.
+
+constexpr int8_t kExtTuple = 1;
+constexpr int8_t kExtSet = 2;
+constexpr int8_t kExtFrozenset = 3;
+constexpr int8_t kExtExc = 4;
+constexpr int8_t kExtPickle = 127;
+
+inline void msgpack_encode_into(const Value& v, std::string& out);
+
+inline void msgpack_uint_into(uint64_t n, std::string& out) {
+  if (n <= 0x7f) {
+    out.push_back(char(n));
+  } else if (n <= 0xffffffffull) {
+    out.push_back('\xce');
+    for (int k = 3; k >= 0; k--) out.push_back(char(n >> (8 * k)));
+  } else {
+    out.push_back('\xcf');
+    for (int k = 7; k >= 0; k--) out.push_back(char(n >> (8 * k)));
+  }
+}
+
+inline void msgpack_str_into(const std::string& s, std::string& out) {
+  size_t n = s.size();
+  if (n <= 31) {
+    out.push_back(char(0xa0 | n));
+  } else if (n <= 0xff) {
+    out.push_back('\xd9');
+    out.push_back(char(n));
+  } else if (n <= 0xffff) {
+    out.push_back('\xda');
+    out.push_back(char(n >> 8));
+    out.push_back(char(n));
+  } else {
+    out.push_back('\xdb');
+    for (int k = 3; k >= 0; k--) out.push_back(char(n >> (8 * k)));
+  }
+  out.append(s);
+}
+
+inline void msgpack_ext_into(int8_t type, const std::string& payload,
+                             std::string& out) {
+  size_t n = payload.size();
+  if (n <= 0xff) {
+    out.push_back('\xc7');
+    out.push_back(char(n));
+  } else if (n <= 0xffff) {
+    out.push_back('\xc8');
+    out.push_back(char(n >> 8));
+    out.push_back(char(n));
+  } else {
+    out.push_back('\xc9');
+    for (int k = 3; k >= 0; k--) out.push_back(char(n >> (8 * k)));
+  }
+  out.push_back(char(type));
+  out.append(payload);
+}
+
+// Exception extension payload: [module, qualname, args, state, tb] —
+// mirrors wire.py's _exc_payload, so the Python peer reconstructs a real
+// builtins/ray_tpu exception from a C++ error response.
+inline void msgpack_exc_into(const std::string& module,
+                             const std::string& qualname,
+                             const std::string& msg, const std::string& tb,
+                             std::string& out) {
+  std::string payload;
+  payload.push_back('\x95');  // fixarray(5)
+  msgpack_str_into(module, payload);
+  msgpack_str_into(qualname, payload);
+  payload.push_back('\x91');  // args = [msg]
+  msgpack_str_into(msg, payload);
+  payload.push_back('\x80');  // state = {}
+  msgpack_str_into(tb, payload);
+  msgpack_ext_into(kExtExc, payload, out);
+}
+
+inline void msgpack_encode_into(const Value& v, std::string& out) {
+  switch (v.kind) {
+    case Value::NONE:
+      out.push_back('\xc0');
+      break;
+    case Value::BOOL:
+      out.push_back(v.b ? '\xc3' : '\xc2');
+      break;
+    case Value::INT:
+      if (v.i >= 0) {
+        msgpack_uint_into(uint64_t(v.i), out);
+      } else if (v.i >= -32) {
+        out.push_back(char(v.i));  // negative fixint
+      } else {
+        out.push_back('\xd3');
+        uint64_t u = uint64_t(v.i);
+        for (int k = 7; k >= 0; k--) out.push_back(char(u >> (8 * k)));
+      }
+      break;
+    case Value::FLOAT: {
+      out.push_back('\xcb');
+      uint64_t bits;
+      std::memcpy(&bits, &v.f, 8);
+      for (int k = 7; k >= 0; k--) out.push_back(char(bits >> (8 * k)));
+      break;
+    }
+    case Value::STR:
+      msgpack_str_into(v.s, out);
+      break;
+    case Value::BYTES: {
+      size_t n = v.s.size();
+      if (n <= 0xff) {
+        out.push_back('\xc4');
+        out.push_back(char(n));
+      } else if (n <= 0xffff) {
+        out.push_back('\xc5');
+        out.push_back(char(n >> 8));
+        out.push_back(char(n));
+      } else {
+        out.push_back('\xc6');
+        for (int k = 3; k >= 0; k--) out.push_back(char(n >> (8 * k)));
+      }
+      out.append(v.s);
+      break;
+    }
+    case Value::LIST: {
+      size_t n = v.items.size();
+      if (n <= 15) {
+        out.push_back(char(0x90 | n));
+      } else if (n <= 0xffff) {
+        out.push_back('\xdc');
+        out.push_back(char(n >> 8));
+        out.push_back(char(n));
+      } else {
+        out.push_back('\xdd');
+        for (int k = 3; k >= 0; k--) out.push_back(char(n >> (8 * k)));
+      }
+      for (const auto& it : v.items) msgpack_encode_into(it, out);
+      break;
+    }
+    case Value::TUPLE: {
+      std::string payload;
+      Value as_list = Value::List(v.items);
+      msgpack_encode_into(as_list, payload);
+      msgpack_ext_into(kExtTuple, payload, out);
+      break;
+    }
+    case Value::DICT: {
+      size_t n = v.pairs.size();
+      if (n <= 15) {
+        out.push_back(char(0x80 | n));
+      } else if (n <= 0xffff) {
+        out.push_back('\xde');
+        out.push_back(char(n >> 8));
+        out.push_back(char(n));
+      } else {
+        out.push_back('\xdf');
+        for (int k = 3; k >= 0; k--) out.push_back(char(n >> (8 * k)));
+      }
+      for (const auto& kv : v.pairs) {
+        msgpack_encode_into(kv.first, out);
+        msgpack_encode_into(kv.second, out);
+      }
+      break;
+    }
+  }
+}
+
+inline std::string msgpack_dumps(const Value& v) {
+  std::string out;
+  msgpack_encode_into(v, out);
+  return out;
+}
+
+class MsgpackReader {
+ public:
+  MsgpackReader(const uint8_t* data, size_t len)
+      : p_(data), end_(data + len) {}
+
+  Value load() {
+    Value v = item();
+    return v;
+  }
+
+ private:
+  const uint8_t* p_;
+  const uint8_t* end_;
+
+  void need(size_t n) const {
+    if (size_t(end_ - p_) < n) throw CodecError("truncated msgpack");
+  }
+  uint64_t be(size_t n) {
+    need(n);
+    uint64_t v = 0;
+    for (size_t k = 0; k < n; k++) v = (v << 8) | *p_++;
+    return v;
+  }
+  std::string take(size_t n) {
+    need(n);
+    std::string s(reinterpret_cast<const char*>(p_), n);
+    p_ += n;
+    return s;
+  }
+  Value array(size_t n) {
+    // Each element is >= 1 byte: a hostile count can't force a huge
+    // allocation past what the frame itself could hold.
+    if (n > size_t(end_ - p_)) throw CodecError("array count exceeds frame");
+    Value v = Value::List();
+    v.items.reserve(n);
+    for (size_t k = 0; k < n; k++) v.items.push_back(item());
+    return v;
+  }
+  Value map(size_t n) {
+    if (n > size_t(end_ - p_)) throw CodecError("map count exceeds frame");
+    Value v = Value::Dict();
+    v.pairs.reserve(n);
+    for (size_t k = 0; k < n; k++) {
+      Value key = item();
+      Value val = item();
+      v.pairs.emplace_back(std::move(key), std::move(val));
+    }
+    return v;
+  }
+  Value ext(size_t n) {
+    need(1);
+    int8_t type = int8_t(*p_++);
+    std::string payload = take(n);
+    switch (type) {
+      case kExtTuple: {
+        Value inner = msgpack_sub(payload);
+        if (inner.kind != Value::LIST)
+          throw CodecError("EXT_TUPLE payload is not an array");
+        Value t = Value::Tuple(std::move(inner.items));
+        return t;
+      }
+      case kExtSet: {  // surfaces as list (matches pickle reader)
+        Value inner = msgpack_sub(payload);
+        if (inner.kind != Value::LIST)
+          throw CodecError("EXT_SET payload is not an array");
+        return inner;
+      }
+      case kExtFrozenset: {  // surfaces as tuple
+        Value inner = msgpack_sub(payload);
+        if (inner.kind != Value::LIST)
+          throw CodecError("EXT_FROZENSET payload is not an array");
+        return Value::Tuple(std::move(inner.items));
+      }
+      case kExtExc: {
+        // [module, qualname, args, state, tb] -> representational string
+        // (same flattening the pickle reader did for exception objects).
+        Value inner = msgpack_sub(payload);
+        std::string desc = "<";
+        if (inner.kind == Value::LIST && inner.items.size() >= 2 &&
+            inner.items[0].kind == Value::STR &&
+            inner.items[1].kind == Value::STR)
+          desc += inner.items[0].s + "." + inner.items[1].s;
+        else
+          desc += "exception";
+        desc += ">";
+        if (inner.kind == Value::LIST && inner.items.size() >= 3 &&
+            inner.items[2].kind == Value::LIST)
+          for (const auto& a : inner.items[2].items)
+            if (a.kind == Value::STR) desc += " " + a.s.substr(0, 200);
+        return Value::Str(desc);
+      }
+      default:
+        // kExtPickle and unknown exts are refused: the C++ worker never
+        // feeds wire bytes to a pickle machine.
+        throw CodecError("unsupported msgpack ext type " +
+                         std::to_string(int(type)));
+    }
+  }
+  static Value msgpack_sub(const std::string& blob) {
+    MsgpackReader r(reinterpret_cast<const uint8_t*>(blob.data()),
+                    blob.size());
+    return r.load();
+  }
+
+  Value item() {
+    need(1);
+    uint8_t t = *p_++;
+    if (t <= 0x7f) return Value::Int(t);            // positive fixint
+    if (t >= 0xe0) return Value::Int(int8_t(t));    // negative fixint
+    if ((t & 0xe0) == 0xa0) return Value::Str(take(t & 0x1f));  // fixstr
+    if ((t & 0xf0) == 0x90) return array(t & 0x0f);             // fixarray
+    if ((t & 0xf0) == 0x80) return map(t & 0x0f);               // fixmap
+    switch (t) {
+      case 0xc0: return Value::None();
+      case 0xc2: return Value::Bool(false);
+      case 0xc3: return Value::Bool(true);
+      case 0xc4: return Value::Bytes(take(be(1)));
+      case 0xc5: return Value::Bytes(take(be(2)));
+      case 0xc6: return Value::Bytes(take(be(4)));
+      case 0xc7: return ext(be(1));
+      case 0xc8: return ext(be(2));
+      case 0xc9: return ext(be(4));
+      case 0xca: {  // float32
+        uint32_t bits = uint32_t(be(4));
+        float f;
+        std::memcpy(&f, &bits, 4);
+        return Value::Float(double(f));
+      }
+      case 0xcb: {  // float64
+        uint64_t bits = be(8);
+        double d;
+        std::memcpy(&d, &bits, 8);
+        return Value::Float(d);
+      }
+      case 0xcc: return Value::Int(int64_t(be(1)));
+      case 0xcd: return Value::Int(int64_t(be(2)));
+      case 0xce: return Value::Int(int64_t(be(4)));
+      case 0xcf: {
+        uint64_t u = be(8);
+        if (u > uint64_t(INT64_MAX))
+          throw CodecError("uint64 out of int64 range");
+        return Value::Int(int64_t(u));
+      }
+      case 0xd0: return Value::Int(int8_t(be(1)));
+      case 0xd1: return Value::Int(int16_t(be(2)));
+      case 0xd2: return Value::Int(int32_t(be(4)));
+      case 0xd3: return Value::Int(int64_t(be(8)));
+      case 0xd4: return ext(1);   // fixext1
+      case 0xd5: return ext(2);
+      case 0xd6: return ext(4);
+      case 0xd7: return ext(8);
+      case 0xd8: return ext(16);
+      case 0xd9: return Value::Str(take(be(1)));
+      case 0xda: return Value::Str(take(be(2)));
+      case 0xdb: return Value::Str(take(be(4)));
+      case 0xdc: return array(be(2));
+      case 0xdd: return array(be(4));
+      case 0xde: return map(be(2));
+      case 0xdf: return map(be(4));
+      default:
+        throw CodecError("unsupported msgpack tag 0x" + hex_(t));
+    }
+  }
+  static std::string hex_(uint8_t b) {
+    const char* d = "0123456789abcdef";
+    return std::string() + d[b >> 4] + d[b & 15];
+  }
+};
+
+inline Value msgpack_loads(const std::string& blob) {
+  MsgpackReader r(reinterpret_cast<const uint8_t*>(blob.data()),
+                  blob.size());
+  return r.load();
+}
+
 // ----------------------------------------------- object meta (msgpack)
 // Stored-object metadata is flag byte ('V' value / 'E' error) + msgpack
 // {"sizes": [payload_len, buf0_len, ...]} (core/serialization.py). The

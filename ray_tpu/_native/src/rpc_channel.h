@@ -4,13 +4,14 @@
 // challenge; when a cluster token is configured the client answers
 // HMAC-SHA256(token, challenge) || 32-byte nonce and verifies the
 // server's proof over that nonce (mutual auth). After the handshake,
-// frames are 4-byte big-endian length || pickle({"m","a","k"}) with
-// responses {"ok": bool, "v": value} / {"ok": false, "e": exc, "tb": str}.
+// frames are 4-byte big-endian length || msgpack({"m","a","k"}) with
+// responses {"ok": bool, "v": value} / {"ok": false, "e": exc, "tb": str}
+// (wire.py codec: tuples/sets/exceptions as msgpack extension types).
 //
-// The pickle here is the restricted codec (pyvalue.h); error responses
-// carry arbitrary pickled exception *objects*, so the reader used for
-// responses tolerates GLOBAL/REDUCE/NEWOBJ/BUILD by flattening them to
-// representational strings — enough to surface "tb" to the C++ caller.
+// The msgpack here is the restricted codec (pyvalue.h); exception
+// extensions in error responses flatten to representational strings —
+// enough to surface "tb" to the C++ caller — and the pickle extension
+// is refused outright: C++ never feeds wire bytes to a pickle machine.
 #pragma once
 
 #include <arpa/inet.h>
@@ -66,11 +67,16 @@ inline void send_frame(int fd, const std::string& blob) {
   send_all(fd, out.data(), out.size());
 }
 
+// Mirrors rpc.py MAX_FRAME_BYTES: a corrupt/hostile length prefix must
+// not commit us to a multi-GiB allocation.
+constexpr uint32_t kMaxFrameBytes = 1u << 30;
+
 inline std::string recv_frame(int fd) {
   uint8_t len[4];
   recv_exact(fd, len, 4);
   uint32_t n = (uint32_t(len[0]) << 24) | (uint32_t(len[1]) << 16) |
                (uint32_t(len[2]) << 8) | uint32_t(len[3]);
+  if (n > kMaxFrameBytes) throw RpcError("frame length exceeds cap");
   std::string blob(n, '\0');
   if (n) recv_exact(fd, blob.data(), n);
   return blob;
@@ -210,14 +216,14 @@ class RpcChannel {
     req.set("k", std::move(kwargs));
     std::string resp;
     try {
-      send_frame(fd_, pickle_dumps(req));
+      send_frame(fd_, msgpack_dumps(req));
       resp = recv_frame(fd_);
     } catch (const RpcError&) {
       close();  // transport failure: reconnect on the next call
       throw;
     }
     try {
-      Value r = pickle_loads(resp);
+      Value r = msgpack_loads(resp);
       const Value* ok = r.get("ok");
       if (ok && ok->truthy()) {
         const Value* v = r.get("v");
@@ -320,7 +326,7 @@ class RpcServer {
       }
       while (true) {
         std::string blob = recv_frame(fd);
-        Value req = pickle_loads(blob);
+        Value req = msgpack_loads(blob);
         const Value* m = req.get("m");
         const Value* a = req.get("a");
         const Value* k = req.get("k");
@@ -332,27 +338,13 @@ class RpcServer {
           resp.set("ok", Value::Bool(true));
           resp.set("v", std::move(out));
         } catch (const std::exception& e) {
-          // Python peers expect "e" to be an exception instance; a plain
-          // string would raise TypeError at the call site. Mirror rpc.py's
-          // shape with a RuntimeError the Python side can re-raise.
-          resp.set("ok", Value::Bool(false));
-          resp.set("tb", Value::Str(e.what()));
-          std::string exc;
-          exc.push_back('\x80');
-          exc.push_back('\x03');
-          // GLOBAL 'builtins RuntimeError' + msg tuple + REDUCE
-          exc.push_back('c');
-          exc += "builtins\nRuntimeError\n";
-          Value msg = Value::Tuple({Value::Str(e.what())});
-          pickle_encode_into(msg, exc);
-          exc.push_back('R');
-          exc.push_back('.');
-          // splice the pre-pickled exception into the response frame by
-          // sending a custom-built frame below.
-          send_custom_error(fd, resp, exc);
+          // Python peers expect "e" to be an exception instance; the
+          // msgpack exception extension reconstructs builtins.RuntimeError
+          // at the Python call site (wire.py _decode_exc).
+          send_error(fd, e.what());
           continue;
         }
-        send_frame(fd, pickle_dumps(resp));
+        send_frame(fd, msgpack_dumps(resp));
       }
     } catch (const std::exception&) {
       // connection closed or protocol error — drop the connection
@@ -360,24 +352,17 @@ class RpcServer {
     ::close(fd);
   }
 
-  // {"ok": False, "e": <pre-pickled exc>, "tb": str} — build the pickle
-  // by hand so the exception bytes embed as an object, not as bytes.
-  void send_custom_error(int fd, const Value& resp, const std::string& exc) {
+  // {"ok": False, "e": <exception ext>, "tb": str} — msgpack is
+  // compositional (no memo), so the pre-encoded ext splices in directly.
+  void send_error(int fd, const std::string& what) {
     std::string out;
-    out.push_back('\x80');
-    out.push_back('\x03');
-    out.push_back('}');
-    out.push_back('(');
-    pickle_encode_into(Value::Str("ok"), out);
-    pickle_encode_into(Value::Bool(false), out);
-    pickle_encode_into(Value::Str("tb"), out);
-    const Value* tb = resp.get("tb");
-    pickle_encode_into(tb ? *tb : Value::Str(""), out);
-    pickle_encode_into(Value::Str("e"), out);
-    // splice the exception body (strip its PROTO header and STOP)
-    out.append(exc.substr(2, exc.size() - 3));
-    out.push_back('u');
-    out.push_back('.');
+    out.push_back('\x83');  // fixmap(3)
+    msgpack_str_into("ok", out);
+    out.push_back('\xc2');  // false
+    msgpack_str_into("tb", out);
+    msgpack_str_into(what, out);
+    msgpack_str_into("e", out);
+    msgpack_exc_into("builtins", "RuntimeError", what, what, out);
     send_frame(fd, out);
   }
 

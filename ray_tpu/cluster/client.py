@@ -498,9 +498,29 @@ class ClusterBackend:
             return meta, inline
         self._pulls.acquire(size, self._pull_priority())
         try:
+            # Mid-size objects: ONE streaming request (server pipelines
+            # the chunk frames back-to-back — no per-chunk round trip).
+            # Huge objects still fan out over the parallel pull pool so
+            # multiple TCP connections share the copy work.
+            n_chunks = (size + chunk_size - 1) // chunk_size
+            if n_chunks <= config.transfer_stream_max_chunks:
+                return meta, self._pull_streamed(
+                    client, oid, size, chunk_size)
             return meta, self._pull_chunked(client, oid, size, chunk_size)
         finally:
             self._pulls.release(size)
+
+    def _pull_streamed(self, client, oid: str, size: int, chunk_size: int):
+        buf = bytearray(size)
+        off = 0
+        for piece in client.call_stream(
+                "fetch_object_stream", oid, size, chunk_size):
+            buf[off:off + len(piece)] = piece
+            off += len(piece)
+        if off != size:
+            raise ObjectLostError(
+                f"stream of {oid[:16]}… ended early at {off}/{size}")
+        return buf
 
     def _pull_chunked(self, client, oid: str, size: int, chunk_size: int):
         buf = bytearray(size)

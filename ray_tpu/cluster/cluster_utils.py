@@ -24,6 +24,12 @@ class Cluster:
         self.nodes: list[NodeAgent] = []
         self.session = f"c{os.getpid()}_{os.urandom(3).hex()}"
         self.persist_path = persist_path
+        # Auth-on by default (round 5): generate a per-cluster token
+        # unless one is configured or auth was explicitly disabled with
+        # RAY_TPU_CLUSTER_TOKEN="" — see rpc.ensure_cluster_token.
+        from ray_tpu.cluster.rpc import ensure_cluster_token
+
+        ensure_cluster_token()
         if initialize_head:
             self.head = HeadServer(persist_path=persist_path)
             if head_node_args is not None:

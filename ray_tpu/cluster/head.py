@@ -1320,8 +1320,14 @@ def main():
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     args = parser.parse_args()
+    from ray_tpu.cluster.rpc import ensure_cluster_token
+
+    token = ensure_cluster_token()
     head = HeadServer(args.host, args.port)
     print(f"HEAD_ADDRESS={head.address}", flush=True)
+    if token:
+        # Joining nodes/drivers need this in RAY_TPU_CLUSTER_TOKEN.
+        print(f"CLUSTER_TOKEN={token}", flush=True)
     signal.sigwait({signal.SIGTERM, signal.SIGINT})
     head.stop()
     sys.exit(0)

@@ -29,6 +29,7 @@ from typing import Optional
 from ray_tpu._native.shm_store import ShmStore
 from ray_tpu.cluster.rpc import RpcClient, RpcServer
 from ray_tpu.core import ids
+from ray_tpu.core.object_ref import ObjectLostError
 from ray_tpu.core.config import config
 from ray_tpu.core.resources import ResourcePool
 
@@ -975,6 +976,24 @@ class NodeAgent:
         if len(data) <= inline_max:
             return meta, len(data), bytes(data)
         return meta, len(data), None
+
+    def rpc_fetch_object_stream(self, oid, size: int, chunk: int):
+        """Server-streamed chunks of the object ([0, size) in ``chunk``
+        slices): ONE request, N pipelined frames — removes the per-chunk
+        round trip of rpc_fetch_object_chunk (the reference's object
+        manager push streams chunks the same way over gRPC,
+        ``object_manager.cc`` chunked push). Each chunk pins/releases
+        independently so eviction/spill mid-stream degrades to the
+        chunk-read fallback instead of holding a pin for the whole
+        transfer."""
+        self._fetch_stats["streams"] = self._fetch_stats.get("streams", 0) + 1
+        for off in range(0, size, chunk):
+            piece = self.rpc_fetch_object_chunk(
+                oid, off, min(chunk, size - off))
+            if piece is None:
+                raise ObjectLostError(
+                    f"object {oid[:16]}… lost mid-stream at offset {off}")
+            yield piece
 
     def rpc_fetch_object_chunk(self, oid, offset: int, length: int):
         """One bounded chunk of the object's data ([offset, offset+length)).

@@ -1,22 +1,32 @@
-"""Minimal threaded RPC: length-prefixed pickle over TCP.
+"""Threaded RPC: schema'd msgpack frames over TCP, with streaming.
 
 Plays the role of the reference's gRPC scaffolding (``src/ray/rpc/``):
 request/response with per-connection FIFO ordering (the property the direct
 actor transport relies on for in-order actor calls,
-``direct_actor_task_submitter.h``). Handlers run on a thread per connection;
-blocking handlers (long-poll style) are therefore fine.
+``direct_actor_task_submitter.h``) plus server-streaming calls (the
+reference's gRPC server-streaming, e.g. object-chunk/log streams).
+Handlers run on a thread per connection; blocking handlers (long-poll
+style) are therefore fine.
 
-Wire format: 4-byte big-endian length || pickled {"m": method, "a": args,
-"k": kwargs} — responses {"ok": bool, "v": value} or {"ok": False,
-"e": exception}.
+Wire format (round 5, replaces pickle-on-the-wire): 4-byte big-endian
+length || msgpack frame (``wire.WireCodec``). Requests are
+``{"m": method, "a": args, "k": kwargs[, "st": true]}``; responses
+``{"ok": true, "v": value}`` / ``{"ok": false, "e": exc, "tb": str}``;
+streaming responses are ``{"ok": true, "stream": true}`` followed by one
+``{"s": item}`` frame per yielded item and ``{"end": true}``. Hot-path
+messages (task-spec batches, schedule requests, heartbeats, location
+waits, object chunks) are pure primitive structures and encode natively;
+user payloads stay opaque cloudpickle bytes; arbitrary rich objects need
+the authenticated pickle extension (see ``wire.py`` for the threat
+model).
 
 Authentication: when a cluster token is configured (``RAY_TPU_CLUSTER_TOKEN``
-/ ``config.cluster_token``), every server sends a random challenge on
-accept and requires ``HMAC-SHA256(token, challenge)`` back before serving
-— unauthenticated peers never reach the pickle deserializer. The hello
+/ ``config.cluster_token`` — auto-generated per cluster since round 5),
+every server sends a random challenge on accept and requires
+``HMAC-SHA256(token, challenge)`` back before serving — and only
+authenticated connections may carry the pickle extension. The hello
 frame is sent either way so token/no-token peers fail fast instead of
-deadlocking. Without a token (the default for localhost dev clusters)
-behavior is unchanged.
+deadlocking.
 """
 
 from __future__ import annotations
@@ -24,21 +34,53 @@ from __future__ import annotations
 import hashlib
 import hmac
 import os
-import pickle
 import socket
 import struct
 import threading
 import time
 import traceback
-from typing import Any, Callable
+from typing import Any, Callable, Iterator
+
+from ray_tpu.cluster.wire import WireCodec, WireError
 
 _LEN = struct.Struct(">I")
+
+# Sanity cap on a single frame (defense against a hostile/corrupt length
+# prefix committing us to unbounded allocation). Object-plane chunks are
+# 4 MiB; function blobs and inlined objects stay well under this.
+MAX_FRAME_BYTES = 1 << 30
 
 
 def get_cluster_token() -> bytes:
     from ray_tpu.core.config import config
 
     return config.cluster_token.encode()
+
+
+def ensure_cluster_token() -> str:
+    """Make authenticated-by-default clusters: called at cluster
+    formation, generates a random per-cluster token when none is
+    configured, and exports it so worker/agent subprocesses inherit it.
+
+    An operator can still run auth-off by EXPLICITLY setting
+    ``RAY_TPU_CLUSTER_TOKEN=""`` (present-but-empty) — the insecure
+    posture must be chosen, never defaulted into (the reference's
+    historical default, see ShadowRay, is the cautionary tale)."""
+    from ray_tpu.core.config import config
+
+    raw = os.environ.get("RAY_TPU_CLUSTER_TOKEN")
+    if raw is not None:
+        config.override("cluster_token", raw)
+        return raw
+    if config.cluster_token:
+        # Configured via config.override: still export, or spawned
+        # worker subprocesses would read an empty token and fail auth.
+        os.environ["RAY_TPU_CLUSTER_TOKEN"] = config.cluster_token
+        return config.cluster_token
+    tok = os.urandom(16).hex()
+    config.override("cluster_token", tok)
+    os.environ["RAY_TPU_CLUSTER_TOKEN"] = tok
+    return tok
 
 
 class AuthError(Exception):
@@ -53,8 +95,8 @@ class ConnectionLost(RpcError):
     pass
 
 
-def _send_msg(sock: socket.socket, obj: Any) -> None:
-    blob = pickle.dumps(obj, protocol=5)
+def _send_msg(sock: socket.socket, obj: Any, codec: WireCodec) -> None:
+    blob = codec.packb(obj)
     sock.sendall(_LEN.pack(len(blob)) + blob)
 
 
@@ -69,9 +111,13 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def _recv_msg(sock: socket.socket) -> Any:
+def _recv_msg(sock: socket.socket, codec: WireCodec) -> Any:
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, length))
+    if length > MAX_FRAME_BYTES:
+        # The unread body makes the stream unframeable from here on:
+        # drop the connection rather than try to resync.
+        raise ConnectionLost(f"frame length {length} exceeds cap")
+    return codec.unpackb(_recv_exact(sock, length))
 
 
 class RpcServer:
@@ -173,17 +219,51 @@ class RpcServer:
             return False
 
     def _serve_conn(self, conn: socket.socket):
+        codec = WireCodec(allow_pickle=bool(self._token))
         try:
             if not self._handshake_server(conn):
                 return
             while True:
-                req = _recv_msg(conn)
+                try:
+                    req = _recv_msg(conn, codec)
+                except WireError as e:
+                    # The frame was length-delimited and fully consumed,
+                    # so framing is intact: answer the error and keep
+                    # serving (a fuzzer/buggy peer can't kill the conn
+                    # for its co-tenants; there are none — but FIFO
+                    # requires one response per request regardless).
+                    _send_msg(conn, {"ok": False, "e": e, "tb": ""}, codec)
+                    continue
+                if not isinstance(req, dict) or "m" not in req \
+                        or not isinstance(req.get("m"), str):
+                    _send_msg(conn, {
+                        "ok": False,
+                        "e": WireError("malformed request envelope"),
+                        "tb": "",
+                    }, codec)
+                    continue
                 t0 = time.perf_counter()
                 try:
                     fn = getattr(self._handler, "rpc_" + req["m"])
                     value = fn(*req.get("a", ()), **req.get("k", {}))
+                    if req.get("st"):
+                        self._stream_response(conn, codec, value)
+                        self._record_stat(
+                            req["m"], time.perf_counter() - t0)
+                        continue
+                    if hasattr(value, "__next__"):
+                        # Streaming handler invoked without st: drain so
+                        # the reply is still one frame.
+                        value = list(value)
                     self._record_stat(req["m"], time.perf_counter() - t0)
-                    _send_msg(conn, {"ok": True, "v": value})
+                    try:
+                        _send_msg(conn, {"ok": True, "v": value}, codec)
+                    except WireError as e:
+                        # Encoding the reply failed locally (strict
+                        # profile, rich object): nothing was written, so
+                        # convert to an error response in its place.
+                        _send_msg(
+                            conn, {"ok": False, "e": e, "tb": ""}, codec)
                 except ConnectionLost:
                     raise
                 except BaseException as e:  # noqa: BLE001 — shipped to caller
@@ -193,13 +273,32 @@ class RpcServer:
                     _send_msg(
                         conn,
                         {"ok": False, "e": e, "tb": traceback.format_exc()},
+                        codec,
                     )
-        except (ConnectionLost, OSError):
+        except (ConnectionLost, WireError, OSError):
             pass
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
             conn.close()
+
+    def _stream_response(self, conn: socket.socket, codec: WireCodec,
+                         value: Any) -> None:
+        """Server-streaming reply: one frame per yielded item. The
+        header goes out before the first item is pulled, so the client
+        can start consuming while the handler produces."""
+        _send_msg(conn, {"ok": True, "stream": True}, codec)
+        try:
+            for item in iter(value):
+                _send_msg(conn, {"s": item}, codec)
+        except ConnectionLost:
+            raise
+        except BaseException as e:  # noqa: BLE001 — shipped to caller
+            _send_msg(
+                conn, {"ok": False, "e": e, "tb": traceback.format_exc()},
+                codec)
+            return
+        _send_msg(conn, {"end": True}, codec)
 
     def stop(self):
         self._stopped.set()
@@ -245,18 +344,29 @@ class RpcClient:
         self._local = threading.local()
         self._closed = False
 
+    def _codec(self) -> WireCodec:
+        codec = getattr(self._local, "codec", None)
+        if codec is None:
+            codec = self._local.codec = WireCodec(
+                allow_pickle=bool(self._token))
+        return codec
+
+    def _new_socket(self) -> socket.socket:
+        host, port = self.address.rsplit(":", 1)
+        conn = socket.create_connection(
+            (host, int(port)), timeout=self._timeout)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            self._handshake_client(conn)
+        except BaseException:
+            conn.close()
+            raise
+        return conn
+
     def _conn(self) -> socket.socket:
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            host, port = self.address.rsplit(":", 1)
-            conn = socket.create_connection((host, int(port)), timeout=self._timeout)
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            try:
-                self._handshake_client(conn)
-            except BaseException:
-                conn.close()
-                raise
-            self._local.conn = conn
+            conn = self._local.conn = self._new_socket()
         return conn
 
     def _handshake_client(self, conn: socket.socket) -> None:
@@ -321,13 +431,20 @@ class RpcClient:
         except OSError as e:
             raise ConnectionLost(
                 f"connect to {self.address}: {e}") from e
+        codec = self._codec()
         if timeout is not None:
             conn.settimeout(timeout)
         sent = False
         try:
-            _send_msg(conn, {"m": method, "a": args, "k": kwargs})
+            # args as a list: skips one EXT_TUPLE nesting per message on
+            # the hottest path (the server *-unpacks either shape).
+            req = {"m": method, "a": list(args), "k": kwargs}
+            _send_msg(conn, req, codec)
             sent = True
-            resp = _recv_msg(conn)
+            resp = _recv_msg(conn, codec)
+            # (No "stream" handling here: without the "st" flag the
+            # server drains generator handlers itself and replies with
+            # one list-valued frame.)
         except (OSError, EOFError, ConnectionLost) as e:
             self._drop_conn()
             err = ConnectionLost(f"rpc {method} to {self.address}: {e}")
@@ -347,6 +464,60 @@ class RpcClient:
         if resp["ok"]:
             return resp["v"]
         raise resp["e"]
+
+    def call_stream(self, method: str, *args,
+                    timeout: float | None = None, **kwargs) -> Iterator:
+        """Server-streaming call: yields items as the handler produces
+        them (the reference's gRPC server-streaming analog). Runs on a
+        DEDICATED connection so a long-lived stream (log following,
+        object chunks) never blocks this thread's request channel; the
+        socket closes when the generator is exhausted or closed."""
+        if self._closed:
+            raise ConnectionLost(f"client to {self.address} is closed")
+        codec = WireCodec(allow_pickle=bool(self._token))
+        try:
+            conn = self._new_socket()
+        except OSError as e:
+            raise ConnectionLost(f"connect to {self.address}: {e}") from e
+        if timeout is not None:
+            conn.settimeout(timeout)
+
+        def gen():
+            try:
+                _send_msg(
+                    conn,
+                    {"m": method, "a": list(args), "k": kwargs, "st": True},
+                    codec)
+                first = _recv_msg(conn, codec)
+                if not first.get("stream"):
+                    if first.get("ok"):
+                        # Non-streaming handler: behave as a 1-item
+                        # (or len(list)-item) stream.
+                        value = first["v"]
+                        if isinstance(value, list):
+                            yield from value
+                        else:
+                            yield value
+                        return
+                    raise first["e"]
+                while True:
+                    frame = _recv_msg(conn, codec)
+                    if "s" in frame:
+                        yield frame["s"]
+                    elif frame.get("end"):
+                        return
+                    else:
+                        raise frame["e"]
+            except (OSError, EOFError) as e:
+                raise ConnectionLost(
+                    f"stream {method} to {self.address}: {e}") from e
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+        return gen()
 
     def _drop_conn(self):
         conn = getattr(self._local, "conn", None)

