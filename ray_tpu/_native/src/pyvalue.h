@@ -716,17 +716,34 @@ inline std::string msgpack_dumps(const Value& v) {
 
 class MsgpackReader {
  public:
-  MsgpackReader(const uint8_t* data, size_t len)
-      : p_(data), end_(data + len) {}
+  MsgpackReader(const uint8_t* data, size_t len, int depth = 0)
+      : p_(data), end_(data + len), depth_(depth) {}
 
   Value load() {
     Value v = item();
     return v;
   }
 
+  // Container recursion bound: item() -> array()/map()/ext() -> item()
+  // recurses on the C++ stack, and ext payloads re-enter through a sub-
+  // reader that INHERITS the depth — without the cap, ~100k bytes of
+  // nested fixarray(1) (well under the frame cap) would overflow the
+  // stack and kill the worker instead of raising CodecError.
+  static constexpr int kMaxDepth = 64;
+
  private:
   const uint8_t* p_;
   const uint8_t* end_;
+  int depth_;
+
+  struct DepthGuard {
+    MsgpackReader* r;
+    explicit DepthGuard(MsgpackReader* rd) : r(rd) {
+      if (++r->depth_ > kMaxDepth)
+        throw CodecError("msgpack nesting too deep");
+    }
+    ~DepthGuard() { --r->depth_; }
+  };
 
   void need(size_t n) const {
     if (size_t(end_ - p_) < n) throw CodecError("truncated msgpack");
@@ -744,6 +761,7 @@ class MsgpackReader {
     return s;
   }
   Value array(size_t n) {
+    DepthGuard g(this);
     // Each element is >= 1 byte: a hostile count can't force a huge
     // allocation past what the frame itself could hold.
     if (n > size_t(end_ - p_)) throw CodecError("array count exceeds frame");
@@ -753,6 +771,7 @@ class MsgpackReader {
     return v;
   }
   Value map(size_t n) {
+    DepthGuard g(this);
     if (n > size_t(end_ - p_)) throw CodecError("map count exceeds frame");
     Value v = Value::Dict();
     v.pairs.reserve(n);
@@ -764,6 +783,7 @@ class MsgpackReader {
     return v;
   }
   Value ext(size_t n) {
+    DepthGuard g(this);
     need(1);
     int8_t type = int8_t(*p_++);
     std::string payload = take(n);
@@ -812,9 +832,11 @@ class MsgpackReader {
                          std::to_string(int(type)));
     }
   }
-  static Value msgpack_sub(const std::string& blob) {
+  Value msgpack_sub(const std::string& blob) {
+    // Sub-reader INHERITS depth: chained ext payloads still recurse on
+    // this thread's stack, so a fresh counter would defeat the cap.
     MsgpackReader r(reinterpret_cast<const uint8_t*>(blob.data()),
-                    blob.size());
+                    blob.size(), depth_);
     return r.load();
   }
 

@@ -144,6 +144,7 @@ namespace {
 
 struct WorkerCtx {
   std::string head_addr, agent_addr, node_id, store_path, worker_id;
+  std::string token;
   Store store;
   std::unique_ptr<RpcChannel> head, agent;
   std::mutex mu;
@@ -151,9 +152,33 @@ struct WorkerCtx {
   std::deque<Value> queue;  // push_task specs
   std::atomic<bool> stopped{false};
   std::vector<Value> events;  // task records pending worker_events flush
+  // Owner-directory channels (exec_loop-only; no lock needed): results
+  // are announced to the submitting client's owner service so its get()
+  // resolves locally instead of long-polling the head (client.py
+  // _OwnerService parity with the Python worker path).
+  std::map<std::string, std::unique_ptr<RpcChannel>> owner_chans;
+
+  void report_owner(const std::string& owner, const std::string& oid,
+                    bool is_error, int64_t size) {
+    if (owner.empty()) return;
+    try {
+      auto it = owner_chans.find(owner);
+      if (it == owner_chans.end())
+        it = owner_chans
+                 .emplace(owner, std::make_unique<RpcChannel>(owner, token))
+                 .first;
+      it->second->call(
+          "owner_add_location",
+          {Value::Str(oid), Value::Str(node_id), Value::Str(agent_addr),
+           Value::Str(store_path), Value::Bool(is_error), Value::Int(size)});
+    } catch (const std::exception&) {
+      owner_chans.erase(owner);  // best-effort; the head's view covers
+    }
+  }
 
   // Serialize a Value result into the store + announce the location.
-  void store_result(const std::string& oid, const Value& v) {
+  void store_result(const std::string& oid, const Value& v,
+                    const std::string& owner) {
     std::string payload = pickle_dumps(v);
     std::string meta = meta_encode('V', payload.size());
     store.put(oid, payload, meta);
@@ -161,14 +186,16 @@ struct WorkerCtx {
     Value kw = Value::Dict();
     kw.set("is_error", Value::Bool(false));
     kw.set("size", Value::Int(int64_t(payload.size())));
+    kw.set("owner_addr", Value::Str(owner));
     head->call("add_location", {Value::Str(oid), Value::Str(node_id)},
                std::move(kw));
+    report_owner(owner, oid, false, int64_t(payload.size()));
   }
 
   // Store a TaskError instance Python can re-raise at get()
   // (core/object_ref.py TaskError.__reduce__ shape).
   void store_error(const std::string& oid, const std::string& fname,
-                   const std::string& message) {
+                   const std::string& message, const std::string& owner) {
     std::string payload;
     payload.push_back('\x80');
     payload.push_back('\x03');
@@ -185,8 +212,10 @@ struct WorkerCtx {
     Value kw = Value::Dict();
     kw.set("is_error", Value::Bool(true));
     kw.set("size", Value::Int(int64_t(payload.size())));
+    kw.set("owner_addr", Value::Str(owner));
     head->call("add_location", {Value::Str(oid), Value::Str(node_id)},
                std::move(kw));
+    report_owner(owner, oid, true, int64_t(payload.size()));
   }
 
   void record_event(const std::string& task_id, const std::string& name,
@@ -245,22 +274,26 @@ struct WorkerCtx {
         throw CodecError("no C++ function registered under '" + name +
                          "' in this worker binary");
       Value result = it->second(args);
+      const Value* ow = spec.get("owner_addr");
+      std::string owner = ow && ow->kind == Value::STR ? ow->s : "";
       if (spec.get("num_returns") && spec.get("num_returns")->as_int() > 1) {
         // multi-return: the function returns a tuple/list, one oid each
         const auto& outs = result.items;
         if (int64_t(outs.size()) != spec.get("num_returns")->as_int())
           throw CodecError("num_returns mismatch");
         for (size_t k = 0; k < outs.size(); k++)
-          store_result(oids->items[k].as_str(), outs[k]);
+          store_result(oids->items[k].as_str(), outs[k], owner);
       } else {
-        store_result(oids->items[0].as_str(), result);
+        store_result(oids->items[0].as_str(), result, owner);
       }
     } catch (const std::exception& e) {
       error = e.what();
+      const Value* ow = spec.get("owner_addr");
+      std::string owner = ow && ow->kind == Value::STR ? ow->s : "";
       if (oids)
         for (const auto& o : oids->items) {
           try {
-            store_error(o.as_str(), name, error);
+            store_error(o.as_str(), name, error, owner);
           } catch (const std::exception&) {
           }
         }
@@ -308,6 +341,7 @@ int WorkerMain(int argc, char** argv) {
     return 2;
   }
   std::string token = env_token();
+  ctx.token = token;
   try {
     ctx.store.attach(ctx.store_path);
     ctx.head = std::make_unique<RpcChannel>(ctx.head_addr, token);

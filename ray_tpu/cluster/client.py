@@ -17,7 +17,7 @@ import time
 from typing import Any, Callable, Sequence
 
 from ray_tpu._native.shm_store import ShmStore, StoreFullError
-from ray_tpu.cluster.rpc import ConnectionLost, RpcClient
+from ray_tpu.cluster.rpc import ConnectionLost, RpcClient, RpcServer
 from ray_tpu.core import ids
 from ray_tpu.core import serialization as ser
 from ray_tpu.core.object_ref import (
@@ -29,6 +29,12 @@ from ray_tpu.core.object_ref import (
 )
 from ray_tpu.core.config import config
 from ray_tpu.core.resources import demand_of
+
+
+# Poll-again sentinel: a fetch hit only stale/dead locations; the oid
+# stays pending and the next location round decides (recovery, head
+# fallback, or a fresh copy).
+_REFETCH = object()
 
 
 class _GetError:
@@ -86,6 +92,24 @@ class _PullManager:
                     "queued": len(self._waiters)}
 
 
+class _OwnerService:
+    """RPC surface of a client's owner directory (the per-worker half of
+    the reference's ownership protocol: executing workers report result
+    locations to the owner; borrowers resolve/wait against the owner)."""
+
+    def __init__(self, backend: "ClusterBackend"):
+        self._b = backend
+
+    def rpc_owner_add_location(self, oid, node_id, address, store_path,
+                               is_error=False, size=0):
+        self._b._owner_record(oid, node_id, address, store_path,
+                              is_error, size)
+        return True
+
+    def rpc_owner_wait_locations(self, oids, timeout=None):
+        return self._b.owner_wait_locations(oids, timeout)
+
+
 class ClusterBackend:
     def __init__(self, head_address: str, *, node_id: str | None = None,
                  store_path: str | None = None, agent_address: str | None = None,
@@ -107,6 +131,7 @@ class ClusterBackend:
             node_id, store_path = nodes[0]["NodeID"], nodes[0]["StorePath"]
             self._agent_address = nodes[0]["Address"]
         self.node_id = node_id
+        self.store_path = store_path
         # "d" = driver (survives node death), "w" = worker (dies with node).
         self.client_id = (
             f"{process_kind}:{node_id}:{os.getpid()}:{os.urandom(3).hex()}"
@@ -146,6 +171,11 @@ class ClusterBackend:
         self._local_refs: dict[str, int] = {}
         self._dirty_add: set[str] = set()
         self._dirty_remove: set[str] = set()
+        # Batched head location reports: put_with_id appends; the ref
+        # flusher ships them (always BEFORE ref updates, so container
+        # holds for nested refs reach the head ahead of any borrow
+        # release they must outlive).
+        self._loc_dirty: list = []
         self._ref_cv = threading.Condition(self._ref_lock)
         # Serializes flush I/O: flush_refs() must not return while another
         # thread's ref_update RPC is still in flight (borrower-handoff
@@ -169,6 +199,33 @@ class ClusterBackend:
         self._dispatching = 0  # specs popped from the queue, mid-dispatch
         self._retry_heap: list = []  # (due, seq, spec) — shared retry timer
         self._retry_seq = 0
+        # Owner-distributed object directory (reference ownership model:
+        # reference_count.h:61 holds per-object state on the OWNING worker,
+        # ownership_based_object_directory.h resolves locations from
+        # owners, not the GCS). This process is the authoritative location
+        # directory for every object it creates (put / outputs of tasks it
+        # submits): executing workers report result locations straight to
+        # the owner, get()/wait() on self-owned refs block on this local
+        # table with NO head RPC, and borrowers long-poll the owner's
+        # server. The head keeps object->owner routing plus its own
+        # asynchronously-batched location view as the FT fallback when an
+        # owner dies (owner death = objects lost, reference semantics).
+        self._owned: dict[str, dict] = {}
+        # RLock for the same reason as _ref_lock: _deref runs from
+        # weakref finalizers, which GC may invoke on a thread already
+        # holding this lock mid-allocation (e.g. inside
+        # owner_wait_locations building its result dict) — a plain Lock
+        # self-deadlocks there and stalls every location operation.
+        self._owned_lock = threading.RLock()
+        self._owned_cv = threading.Condition(self._owned_lock)
+        self._dead_owners: set[str] = set()
+        self._owner_clients: dict[str, RpcClient] = {}
+        host = (self._agent_address or "127.0.0.1:0").rsplit(":", 1)[0]
+        try:
+            self._owner_server = RpcServer(_OwnerService(self), host=host)
+        except OSError:
+            self._owner_server = RpcServer(_OwnerService(self))
+        self.owner_addr = self._owner_server.address
         # Pull admission (get > wait > args, bounded in-flight bytes).
         self._pulls = _PullManager()
         self._pull_prio = threading.local()
@@ -237,7 +294,9 @@ class ClusterBackend:
 
     def make_ref(self, oid: str, owner: str | None = None) -> ObjectRef:
         self._incref(oid)
-        ref = ObjectRef(oid, owner if owner is not None else self.node_id)
+        # The ref carries its owner's directory address: any borrower that
+        # deserializes it can resolve locations straight from the owner.
+        ref = ObjectRef(oid, owner if owner is not None else self.owner_addr)
         import weakref
 
         weakref.finalize(ref, self._deref, oid)
@@ -263,13 +322,15 @@ class ClusterBackend:
             self._dirty_remove.add(oid)
             self._ref_cv.notify_all()
         self._lineage.pop(oid, None)  # owner dropped it: no recovery needed
+        with self._owned_cv:
+            self._owned.pop(oid, None)
 
     def _ref_flush_loop(self) -> None:
         while True:
             with self._ref_cv:
                 while (
                     not self._dirty_add and not self._dirty_remove
-                    and not self._closed
+                    and not self._loc_dirty and not self._closed
                 ):
                     self._ref_cv.wait(0.5)
                 if self._closed:
@@ -285,10 +346,23 @@ class ClusterBackend:
         popped the dirty sets: we wait for its RPC to finish."""
         with self._flush_io_lock:
             with self._ref_lock:
-                if not self._dirty_add and not self._dirty_remove:
+                if not self._dirty_add and not self._dirty_remove \
+                        and not self._loc_dirty:
                     return
                 add, self._dirty_add = list(self._dirty_add), set()
                 remove, self._dirty_remove = list(self._dirty_remove), set()
+                locs, self._loc_dirty = self._loc_dirty, []
+            # Locations FIRST: an add_locations batch carries container
+            # holds for nested refs (contained=...), which must reach the
+            # head before any ref remove flushed after it can zero them.
+            if locs:
+                try:
+                    self.head.call("add_locations", locs)
+                except (ConnectionLost, OSError):
+                    with self._ref_lock:
+                        if not self._closed:
+                            self._loc_dirty = locs + self._loc_dirty
+                    return  # keep add-before-remove ordering on retry
             try:
                 self.head.call("ref_update", self.client_id, add, remove)
             except (ConnectionLost, OSError):
@@ -300,9 +374,106 @@ class ClusterBackend:
                         self._dirty_add.update(add)
                         self._dirty_remove.update(remove)
 
+    # -- owner directory ---------------------------------------------------
+
+    def _owner_record(self, oid: str, node_id: str, address: str,
+                      store_path: str, is_error: bool = False,
+                      size: int = 0) -> None:
+        """A copy of an object WE own appeared on ``node_id``."""
+        with self._owned_cv:
+            e = self._owned.setdefault(
+                oid, {"nodes": {}, "error": False, "size": 0})
+            e["nodes"][node_id] = (address, store_path)
+            e["error"] = e["error"] or bool(is_error)
+            e["size"] = max(e["size"], int(size))
+            self._owned_cv.notify_all()
+
+    def _owner_drop(self, oid: str, node_ids) -> None:
+        with self._owned_cv:
+            e = self._owned.get(oid)
+            if not e:
+                return
+            for nid in node_ids:
+                e["nodes"].pop(nid, None)
+            if not e["nodes"]:
+                self._owned.pop(oid, None)
+
+    def _owner_knows(self, oid: str) -> bool:
+        """Is this oid either resolvable or still expected (a pending
+        output of a task/actor call we submitted)? False = we dropped
+        our handle: a borrower should resolve through the head instead."""
+        if oid in self._owned or oid in self._lineage \
+                or oid in self._actor_tasks:
+            return True
+        # Streaming indices > 1 share the index-0 spec's lineage entry.
+        return ids.object_id_for(oid[:32], 0) in self._lineage
+
+    def owner_wait_locations(self, oids, timeout=None) -> dict:
+        """Head-``wait_locations`` semantics against the local owner
+        table: block until at least one of ``oids`` has a location (or
+        timeout); returns {oid: {"nodes": [(nid, addr, store_path)],
+        "error": bool}} for every currently-resolvable oid. Oids this
+        owner no longer tracks come back as {"forgotten": True} so a
+        borrower falls over to the head's FT view immediately."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._owned_cv:
+            while True:
+                found = {}
+                pending_known = False
+                for oid in oids:
+                    e = self._owned.get(oid)
+                    if e and e["nodes"]:
+                        found[oid] = {
+                            "nodes": [(nid, a, sp) for nid, (a, sp)
+                                      in e["nodes"].items()],
+                            "error": e["error"],
+                        }
+                    elif self._owner_knows(oid):
+                        pending_known = True
+                    else:
+                        found[oid] = {"forgotten": True}
+                if found or not pending_known:
+                    return found
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return {}
+                self._owned_cv.wait(
+                    1.0 if remaining is None else min(remaining, 1.0))
+
+    def _owner_client(self, addr: str) -> RpcClient:
+        with self._lock:
+            c = self._owner_clients.get(addr)
+            if c is None:
+                c = self._owner_clients[addr] = RpcClient(addr, timeout=30.0)
+            return c
+
+    def _report_location(self, oid: str, owner: str | None,
+                         is_error: bool, size: int) -> None:
+        """Tell the object's owner a copy now lives on this node. Local
+        record when we ARE the owner (the common case: the driver's own
+        puts); one direct RPC worker->owner otherwise — the head is not
+        on this path at all."""
+        if not owner or owner == self.owner_addr:
+            self._owner_record(oid, self.node_id, self._agent_address or "",
+                               self.store_path or "", is_error, size)
+            return
+        if owner in self._dead_owners:
+            return
+        try:
+            self._owner_client(owner).call(
+                "owner_add_location", oid, self.node_id,
+                self._agent_address or "", self.store_path or "",
+                is_error, size, timeout=10.0)
+        except (ConnectionLost, OSError):
+            # Owner gone: its objects are recoverable only through the
+            # head's batched view / lineage. Best-effort by design.
+            self._dead_owners.add(owner)
+
     # -- object plane ------------------------------------------------------
 
-    def put_with_id(self, oid: str, value: Any, is_error: bool = False) -> None:
+    def put_with_id(self, oid: str, value: Any, is_error: bool = False,
+                    owner: str | None = None) -> None:
         flag = b"E" if is_error else b"V"
         contained: list[str] = []
         meta, chunks = ser.serialize(value, found_refs=contained)
@@ -335,10 +506,17 @@ class ClusterBackend:
         # Primary copy: protect from LRU eviction until the cluster
         # ref-counter frees it (spilling is still allowed — data survives).
         self.store.pin(oid)
-        self.head.call(
-            "add_location", oid, self.node_id, is_error=is_error,
-            size=size, contained=contained,
-        )
+        # Ownership split: the owner learns the location synchronously
+        # (worker->owner direct, or a lock-free local record when we own
+        # it) — that is what unblocks a waiting get(). The head's copy is
+        # batched through the ref flusher: it serves FT fallback, free
+        # fanout, and spill candidacy, none of which need sync latency.
+        self._report_location(oid, owner, is_error, size)
+        with self._ref_lock:
+            self._loc_dirty.append(
+                (oid, self.node_id, is_error, size, contained,
+                 owner or self.owner_addr))
+            self._ref_cv.notify_all()
 
     def put(self, value: Any) -> ObjectRef:
         oid = ids.new_object_id()
@@ -638,18 +816,90 @@ class ClusterBackend:
                 self._end_borrows(spec)  # next get() round retries again
                 entry["incarnation"] -= 1  # didn't actually replay
 
+    def _poll_locations(self, window, owner_of, head_oids: set,
+                        sweep_head: bool, timeout: float = 1.0) -> dict:
+        """One location-poll round: self-owned oids block on the LOCAL
+        owner table (zero RPCs — the common case: a driver getting its
+        own tasks' results), borrowed oids long-poll their owner's
+        directory server directly, and only oids with no/dead/forgetful
+        owner touch the head (plus a whole-window head sweep every 4th
+        round as the safety net for owner-unaware reporters). Returns
+        {oid: {"nodes": [...], "error": bool}} for resolvable oids;
+        mutates ``head_oids`` as owners die or disavow oids."""
+        mine, by_owner, to_head = [], {}, []
+        for oid in window:
+            owner = owner_of.get(oid) or ""
+            if oid in head_oids or not owner \
+                    or owner in self._dead_owners:
+                to_head.append(oid)
+            elif owner == self.owner_addr:
+                mine.append(oid)
+            else:
+                by_owner.setdefault(owner, []).append(oid)
+        if sweep_head:
+            to_head = list(window)
+
+        jobs = []  # (kind, oids, thunk)
+        if mine:
+            jobs.append(("local", mine,
+                         lambda o=mine: self.owner_wait_locations(
+                             o, timeout)))
+        for owner, oids in by_owner.items():
+            jobs.append((owner, oids,
+                         lambda ow=owner, o=oids: self._owner_client(
+                             ow).call("owner_wait_locations", o, timeout,
+                                      timeout=timeout + 30.0)))
+        if to_head:
+            jobs.append(("head", to_head,
+                         lambda o=to_head: self.head.call(
+                             "wait_locations", o, timeout, timeout=15.0)))
+        results: dict = {}
+
+        def run(job):
+            kind, oids, thunk = job
+            try:
+                return thunk()
+            except (ConnectionLost, OSError):
+                if kind not in ("local", "head"):
+                    # Owner process is gone: its objects resolve through
+                    # the head's FT view from now on (or lineage re-exec).
+                    self._dead_owners.add(kind)
+                    head_oids.update(oids)
+                return {}
+
+        if len(jobs) == 1:
+            outs = [run(jobs[0])]
+        else:
+            outs = list(self._get_pool().map(run, jobs))
+        for out in outs:
+            for oid, entry in (out or {}).items():
+                if entry.get("forgotten"):
+                    # The owner dropped its handle but we still hold one:
+                    # the head's directory is the fallback of record.
+                    head_oids.add(oid)
+                elif entry.get("nodes"):
+                    results[oid] = entry
+        return results
+
     def get(self, refs: Sequence[ObjectRef], timeout: float | None = None):
-        """Resolve every ref: local reads first, then ONE batched
-        wait_locations long-poll per round for everything still missing,
-        with ready objects fetched concurrently (the reference batches
-        GetObjectStatus the same way). Errors raise in ref order — an
-        error ref raises once every ref before it has resolved."""
+        """Resolve every ref: local reads first, then one batched
+        location poll per round for everything still missing — against
+        the LOCAL owner table for self-owned refs (no RPC), each owner's
+        directory for borrowed refs, the head only as FT fallback — with
+        ready objects fetched concurrently (the reference resolves from
+        owners the same way, ownership_based_object_directory.h). Errors
+        raise in ref order — an error ref raises once every ref before
+        it has resolved."""
         deadline = None if timeout is None else time.monotonic() + timeout
         hooks = self._block_hooks
         blocked = False
         _UNSET = object()
         slots = [_UNSET] * len(refs)
         pending: dict[str, list[int]] = {}
+        owner_of = {r.id: getattr(r, "_owner", "") for r in refs}
+        head_oids: set[str] = set()  # oids demoted to head resolution
+        fetch_fails: dict[str, int] = {}
+        round_idx = 0
 
         def ordered_raise():
             for v in slots:
@@ -683,14 +933,32 @@ class ClusterBackend:
                 # submission order; polling the first unresolved window
                 # keeps scans O(64) and still batches.
                 window = list(pending)[:64]
-                locs = self.head.call(
-                    "wait_locations", window, 1.0, timeout=15.0)
+                locs = self._poll_locations(
+                    window, owner_of, head_oids,
+                    sweep_head=(round_idx % 4 == 3))
+                round_idx += 1
                 ready = [(oid, loc) for oid, loc in locs.items()
                          if oid in pending]
                 if ready:
                     def fetch(oid, loc):
                         try:
                             return self._fetch_remote(oid, loc["nodes"])
+                        except (ObjectLostError, ConnectionLost,
+                                OSError) as e:
+                            # Owner-table locations aren't liveness-
+                            # filtered the way the head's are: a died
+                            # node leaves stale entries. Purge and retry
+                            # the poll (recovery/re-exec decides next);
+                            # after repeated failures resolve through
+                            # the head, whose view drops dead nodes.
+                            self._owner_drop(
+                                oid, [nid for nid, _a, _s in loc["nodes"]])
+                            n = fetch_fails[oid] = fetch_fails.get(oid, 0) + 1
+                            if n >= 3:
+                                head_oids.add(oid)
+                            if n >= 6:
+                                return _GetError(e)
+                            return _REFETCH
                         except BaseException as e:  # noqa: BLE001
                             return _GetError(e)
 
@@ -700,6 +968,8 @@ class ClusterBackend:
                         values = list(self._get_pool().map(
                             lambda p: fetch(*p), ready))
                     for (oid, _), value in zip(ready, values):
+                        if value is _REFETCH:
+                            continue
                         for i in pending.pop(oid):
                             slots[i] = value
                 for oid in window:
@@ -744,18 +1014,31 @@ class ClusterBackend:
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: list[ObjectRef] = []
         pending = list(refs)
+        owner_of = {r.id: getattr(r, "_owner", "") for r in refs}
+        head_oids: set[str] = set()
+        round_idx = 0
         while len(ready) < num_returns:
             for r in list(pending):
                 if self.store.contains(r.id):
                     ready.append(r)
                     pending.remove(r)
-                    continue
-                loc = self.head.call("locations", r.id)
-                if loc and loc["nodes"]:
+            if len(ready) >= num_returns or not pending:
+                break
+            # One batched, owner-routed poll per round (non-blocking):
+            # self-owned refs cost zero RPCs; the 5 ms cadence below would
+            # otherwise hammer the head with a locations call per ref.
+            locs = self._poll_locations(
+                [r.id for r in pending], owner_of, head_oids,
+                sweep_head=(round_idx % 64 == 63), timeout=0)
+            round_idx += 1
+            for r in list(pending):
+                loc = locs.get(r.id)
+                if loc and loc.get("nodes"):
                     ready.append(r)
                     pending.remove(r)
                     if fetch_local:
-                        self._prefetch(r.id, loc["nodes"])
+                        self._prefetch(r.id, loc["nodes"],
+                                       owner=owner_of.get(r.id))
             if len(ready) >= num_returns:
                 break
             if deadline is not None and time.monotonic() >= deadline:
@@ -763,7 +1046,8 @@ class ClusterBackend:
             time.sleep(0.005)
         return ready, pending
 
-    def _prefetch(self, oid: str, locations: list) -> None:
+    def _prefetch(self, oid: str, locations: list,
+                  owner: str | None = None) -> None:
         """``wait(fetch_local=True)`` semantics (reference: ready objects
         are pulled to the caller's node): replicate the raw bytes into the
         LOCAL store in the background at wait priority, so the eventual
@@ -794,9 +1078,19 @@ class ClusterBackend:
                             continue
                         meta, data = got
                         self.store.put(oid, [bytes(data)], meta)
-                        self.head.call(
-                            "add_location", oid, self.node_id,
-                            meta[:1] == b"E", len(data))
+                        # Secondary copy: the owner's directory spreads
+                        # future pulls across it; the head's batched view
+                        # keeps it as a spill/FT candidate. No owner on
+                        # the ref -> head only (we must not claim
+                        # ownership of a borrowed object).
+                        if owner:
+                            self._report_location(
+                                oid, owner, meta[:1] == b"E", len(data))
+                        with self._ref_lock:
+                            self._loc_dirty.append(
+                                (oid, self.node_id, meta[:1] == b"E",
+                                 len(data), None, owner or ""))
+                            self._ref_cv.notify_all()
                         return
             except BaseException:  # noqa: BLE001 — best-effort
                 pass
@@ -1128,17 +1422,24 @@ class ClusterBackend:
                     # registration failed, ...): the whole set spills to
                     # head scheduling, exactly like a full local node.
                     rejected = set(range(len(local_specs)))
+            spilled = []
             for i, s in enumerate(local_specs):
                 if i in rejected:
-                    # Spillback: the head places these on the cluster
-                    # view. The spilled flag tells it to avoid the
-                    # caller's node: its heartbeat hasn't reflected the
-                    # leased admissions that caused the rejection yet.
+                    spilled.append(s)
+                else:
+                    s["_handled"] = True
+            if spilled:
+                # Decentralized spillback (ray_syncer.h consumer): place
+                # on a peer straight from the local agent's GOSSIPED
+                # load view — same leased admission there; only what no
+                # peer admits falls through to the head. The spilled
+                # flag tells the head to avoid the caller's node (its
+                # heartbeat hasn't reflected the leased admissions that
+                # caused the rejection yet).
+                for s in self._spill_to_peers(spilled):
                     s["assigned_node"] = None
                     s["_spilled"] = True
                     head_specs.append(s)
-                else:
-                    s["_handled"] = True
             if local_specs and len(rejected) < len(local_specs):
                 self._deliver_late_cancels(
                     [s for i, s in enumerate(local_specs)
@@ -1187,6 +1488,75 @@ class ClusterBackend:
                 for s in specs:
                     s["assigned_node"] = None
                     self._queue_retry(s)
+
+    def _spill_to_peers(self, specs: list) -> list:
+        """Try to place locally-rejected leasable specs on peers chosen
+        from the local agent's gossiped cluster view (no head RPC).
+        Returns the specs no peer admitted; everything else is handed
+        off (leased push, same admission as the local path)."""
+        try:
+            view = self._agent_client().call("peer_view", timeout=5.0)
+        except (ConnectionLost, OSError):
+            return specs
+        now = time.time()
+        avail: dict[str, dict] = {}
+        addr_of: dict[str, str] = {}
+        for nid, e in (view or {}).items():
+            if nid == self.node_id or not e.get("address"):
+                continue
+            if now - e.get("ts", 0.0) > 5.0:
+                continue  # stale gossip: not a safe placement basis
+            avail[nid] = dict(e.get("available") or {})
+            addr_of[nid] = e["address"]
+        if not avail:
+            return specs
+        by_peer: dict[str, list] = {}
+        unplaced: list = []
+        for s in specs:
+            demand = s["demand"]
+            best = None
+            for nid, av in avail.items():
+                if all(av.get(k, 0.0) >= v for k, v in demand.items()):
+                    if best is None or av.get("CPU", 0.0) > \
+                            avail[best].get("CPU", 0.0):
+                        best = nid
+            if best is None:
+                unplaced.append(s)
+                continue
+            for k, v in demand.items():
+                avail[best][k] = avail[best].get(k, 0.0) - v
+            by_peer.setdefault(best, []).append(s)
+        for nid, group in by_peer.items():
+            address = addr_of[nid]
+            try:
+                self._register_borrows_batch(group, nid)
+                for s in group:
+                    s["assigned_node"] = nid
+                rej = set(self._node_client(address).call(
+                    "submit_tasks_leased", group))
+            except (ConnectionLost, OSError, RuntimeError) as e:
+                if getattr(e, "maybe_executed", False):
+                    # The push died mid-call: the peer may have enqueued
+                    # the batch; resubmitting could fork execution.
+                    for s in group:
+                        self._end_borrows(s)
+                        self._fail_spec(s, TaskError(
+                            s.get("fname", "task"),
+                            f"peer agent unreachable during spillback: "
+                            f"{e!r}", repr(e)))
+                    continue
+                rej = set(range(len(group)))
+            for i, s in enumerate(group):
+                if i in rej:
+                    s["assigned_node"] = None
+                    unplaced.append(s)
+                else:
+                    s["_handled"] = True
+            if len(rej) < len(group):
+                self._deliver_late_cancels(
+                    [s for i, s in enumerate(group) if i not in rej],
+                    address)
+        return unplaced
 
     def _retry_submit(self, spec: dict, timeout: float | None = None):
         from ray_tpu.core.object_ref import TaskCancelledError
@@ -1267,6 +1637,7 @@ class ClusterBackend:
         spec = {
             "task_id": task_id,
             "oids": oids,
+            "owner_addr": self.owner_addr,
             "num_returns": num_returns,
             "fname": name or getattr(func, "__name__", "task"),
             "func_hash": fn_hash,
@@ -1388,6 +1759,7 @@ class ClusterBackend:
         spec = {
             "task_id": task_id,
             "oids": oids,
+            "owner_addr": self.owner_addr,
             "num_returns": num_returns,
             "fname": fname,
             "lang": "cpp",
@@ -1516,6 +1888,7 @@ class ClusterBackend:
             "actor_id": actor_id,
             "method": method_name,
             "oids": oids,
+            "owner_addr": self.owner_addr,
             "num_returns": num_returns,
             "args": args_blob,
             "borrowed": borrowed,
@@ -1819,11 +2192,20 @@ class ClusterBackend:
             clients = (
                 list(self._node_clients.values())
                 + list(self._worker_clients.values())
+                + list(self._owner_clients.values())
             )
             self._node_clients.clear()
             self._worker_clients.clear()
+            self._owner_clients.clear()
         for c in clients:
             c.close()
+        # Owner directory dies with the owner (reference semantics: owner
+        # failure = its objects become unrecoverable except via the head's
+        # FT view / lineage). Borrowers fail over on ConnectionLost.
+        try:
+            self._owner_server.stop()
+        except Exception:
+            pass
         for attr in ("_chunk_pool", "_prefetch_pool", "_fetch_pool"):
             pool = getattr(self, attr, None)
             if pool is not None:

@@ -110,14 +110,29 @@ class Cluster:
 
     def wait_for_nodes(self, timeout: float = 10.0) -> None:
         assert self.head is not None
-        deadline = time.monotonic() + timeout
+        # Load-scaled deadline: on a saturated box (parallel suites,
+        # worker jax imports) node registration+heartbeats legitimately
+        # take several times longer; a fixed 10s produces the classic
+        # fixture-TimeoutError flake.
+        try:
+            load = os.getloadavg()[0] / max(os.cpu_count() or 1, 1)
+        except OSError:
+            load = 0.0
+        deadline = time.monotonic() + timeout * min(4.0, max(1.0, load))
         want = len(self.nodes)
         while time.monotonic() < deadline:
             alive = [n for n in self.head.rpc_nodes() if n["Alive"]]
             if len(alive) >= want:
                 return
             time.sleep(0.02)
-        raise TimeoutError(f"cluster did not reach {want} nodes")
+        states = [(n["NodeID"][-8:], n["Alive"]) for n in self.head.rpc_nodes()]
+        try:
+            load_s = f"{os.getloadavg()[0]:.1f}"
+        except OSError:
+            load_s = "?"
+        raise TimeoutError(
+            f"cluster did not reach {want} nodes; registered={states}, "
+            f"load={load_s}/{os.cpu_count()}cpu")
 
     def shutdown(self):
         for node in list(self.nodes):

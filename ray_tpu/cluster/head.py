@@ -299,14 +299,39 @@ class HeadServer:
             return total
 
     def _monitor_loop(self):
+        # Death needs BOTH (a) absolute staleness > DEAD_AFTER_S and (b)
+        # N consecutive monitor ticks each observing staleness. (b) is
+        # the false-positive guard for CPU-starved boxes (worker-fork
+        # storms at cluster boot, parallel test suites on one core):
+        # whatever starves the agents' heartbeat threads starves THIS
+        # loop identically, so the required tick count stretches the
+        # wall-clock window by exactly the starvation factor — a
+        # machine-independent analog of num_heartbeats_timeout counting
+        # MISSED heartbeats rather than wall time.
+        required = max(4, int(DEAD_AFTER_S / 0.25))
+        stale_after = 2 * config.heartbeat_interval_s
+        missed: dict[str, int] = {}
         while not self._stop.wait(0.25):
             now = time.monotonic()
             dead = []
             with self._lock:
                 for n in self._nodes.values():
-                    if n.alive and now - n.last_heartbeat > DEAD_AFTER_S:
-                        dead.append(n.node_id)
+                    if not n.alive:
+                        missed.pop(n.node_id, None)
+                        continue
+                    if now - n.last_heartbeat > stale_after:
+                        missed[n.node_id] = missed.get(n.node_id, 0) + 1
+                        # Both gates: enough consecutive stale ticks AND
+                        # absolute staleness — so detection lands at
+                        # ~DEAD_AFTER_S on a healthy box, later only by
+                        # however much the monitor itself was starved.
+                        if missed[n.node_id] >= required and \
+                                now - n.last_heartbeat > DEAD_AFTER_S:
+                            dead.append(n.node_id)
+                    else:
+                        missed.pop(n.node_id, None)
             for node_id in dead:
+                missed.pop(node_id, None)
                 self._mark_dead(node_id, "heartbeat timeout")
 
     def _mark_dead(self, node_id: str, cause: str):
@@ -588,8 +613,30 @@ class HeadServer:
         except ValueError:
             return False
 
+    def rpc_add_locations(self, items):
+        """Batched location adds from a client's ref flusher. Each item:
+        (oid, node_id, is_error, size, contained, owner_addr). The head's
+        directory is the FT fallback + free/spill authority; the latency-
+        critical wait path resolves at owners (client.py owner service),
+        so these arrive asynchronously batched. owner_addr is recorded as
+        object->owner routing (ownership_based_object_directory.h: the
+        GCS keeps owner routing, not the authoritative location set)."""
+        for oid, node_id, is_error, size, contained, owner_addr in items:
+            self.rpc_add_location(oid, node_id, is_error, size, contained,
+                                  owner_addr)
+        return True
+
+    def rpc_owner_of(self, oids):
+        """{oid: owner_addr} routing for refs that lost their owner
+        binding (O(1) lookup per oid; '' = unknown)."""
+        with self._lock:
+            return {
+                oid: (self._objects.get(oid) or {}).get("owner", "")
+                for oid in oids
+            }
+
     def rpc_add_location(self, oid, node_id, is_error=False, size=0,
-                         contained=None):
+                         contained=None, owner_addr=""):
         with self._lock:
             if oid in self._freed or self._stream_released(oid):
                 # Freed while the task computing it was still running:
@@ -605,6 +652,8 @@ class HeadServer:
             entry["nodes"].add(node_id)
             entry["error"] = entry["error"] or is_error
             entry["size"] = max(entry["size"], size)
+            if owner_addr:
+                entry["owner"] = owner_addr
             if contained:
                 # The container holds its nested refs until it is freed.
                 self._contained[oid] = list(contained)

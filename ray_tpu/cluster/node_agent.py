@@ -27,7 +27,7 @@ import time
 from typing import Optional
 
 from ray_tpu._native.shm_store import ShmStore
-from ray_tpu.cluster.rpc import RpcClient, RpcServer
+from ray_tpu.cluster.rpc import ConnectionLost, RpcClient, RpcServer
 from ray_tpu.core import ids
 from ray_tpu.core.object_ref import ObjectLostError
 from ray_tpu.core.config import config
@@ -120,6 +120,24 @@ class NodeAgent:
         )
         # Object-serving counters (tests assert the chunked path is used).
         self._fetch_stats = {"whole": 0, "info": 0, "chunks": 0}
+        # Owner-directory clients, for pushing dead-worker error
+        # locations straight to the owning client (bounded LRU).
+        self._owner_clients: "collections.OrderedDict[str, RpcClient]" = (
+            collections.OrderedDict()
+        )
+        # Resource-view gossip (reference: ray_syncer.h:88 — nodes share
+        # resource views so scheduling needn't centralize). Membership
+        # (who exists / who died) still comes from the head, the GCS's
+        # job; LOAD flows node<->node by anti-entropy push-pull: each
+        # tick we bump our own versioned entry and exchange views with
+        # `gossip_fanout` random peers; entries merge by per-origin
+        # version. Consumers: rpc_peer_view (clients pick spillback
+        # targets without a head RPC).
+        self._cluster_view: dict[str, dict] = {}
+        self._view_version = 0
+        self._gossip_clients: "collections.OrderedDict[str, RpcClient]" = (
+            collections.OrderedDict()
+        )
 
         self._server = RpcServer(self, host)
         self.address = self._server.address
@@ -130,6 +148,8 @@ class NodeAgent:
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         threading.Thread(target=self._dispatch_loop, daemon=True).start()
         threading.Thread(target=self._reap_loop, daemon=True).start()
+        if config.gossip_interval_s > 0:
+            threading.Thread(target=self._gossip_loop, daemon=True).start()
         # OOM protection (memory_monitor.h / worker_killing_policy.h
         # analog): watch node memory, kill the newest task's worker under
         # pressure; its refs raise OutOfMemoryError.
@@ -148,11 +168,46 @@ class NodeAgent:
                 * self.total_resources.get("CPU", 0.0)),
             self._max_workers,
         )
+        self._prestart_target = n_prestart
+        self._replenish_evt = threading.Event()
         if n_prestart > 0:
             threading.Thread(
                 target=self._prestart_workers, args=(n_prestart,),
                 daemon=True,
             ).start()
+            # Keep the plain-env pool warm for the REST of the node's
+            # life: actor creations consume idle workers permanently
+            # (dedicated processes), so without replenishment the Nth
+            # actor cold-forks again (reference worker_pool prestart is
+            # likewise demand-refreshed).
+            threading.Thread(
+                target=self._replenish_loop, daemon=True).start()
+
+    def _replenish_loop(self) -> None:
+        while not self._shutdown.is_set():
+            if not self._replenish_evt.wait(1.0):
+                continue  # not signaled: only checkout demand replenishes
+            if self._shutdown.is_set():
+                return
+            self._replenish_evt.clear()
+            while not self._shutdown.is_set():
+                with self._lock:
+                    idle = len(self._idle.get("", []))
+                    live = len([w for w in self._workers.values()
+                                if w.proc.poll() is None
+                                and not w.is_actor])
+                    need = (idle < self._prestart_target
+                            and live < self._max_workers)
+                if not need:
+                    break
+                try:
+                    w = self._spawn_worker()
+                    if w.ready.wait(config.worker_start_timeout_s):
+                        self._return_worker(w)
+                    else:
+                        break
+                except (OSError, RuntimeError):
+                    break  # replenish is an optimization, never fatal
 
     def _prestart_workers(self, n: int) -> None:
         # Deferred + serialized: a cluster booting many agents at once must
@@ -183,6 +238,28 @@ class NodeAgent:
         worker_id = "w-" + os.urandom(6).hex()
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id
+        # Lazy heavy imports in workers (reference: Ray workers import
+        # `ray` only; torch/tf load when a task first uses them). Site
+        # hooks that pre-import jax at interpreter startup (e.g. a TPU
+        # plugin's sitecustomize) cost seconds per fork and serialize
+        # actor creation; strip matching PYTHONPATH entries so workers
+        # start in ~0.3s and tasks that use jax pay its import lazily.
+        strip = config.worker_pythonpath_exclude
+        if strip and env.get("PYTHONPATH"):
+            keep = [p for p in env["PYTHONPATH"].split(os.pathsep)
+                    if not any(s and s in p for s in strip.split(","))]
+            env["PYTHONPATH"] = os.pathsep.join(keep)
+        # The framework must be importable by `-m ray_tpu...` no matter
+        # where the DRIVER ran from (it may have put ray_tpu on sys.path
+        # itself): pin our own package root onto the worker's path.
+        import ray_tpu as _pkg
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(_pkg.__file__)))
+        prior = env.get("PYTHONPATH", "")
+        if pkg_root not in prior.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + prior if prior else ""))
         cwd = None
         python = sys.executable
         if resolved_env is not None:
@@ -267,7 +344,14 @@ class NodeAgent:
         with self._lock:
             pool = self._idle.get(env_key)
             if pool:
-                return pool.pop()
+                w = pool.pop()
+                if dedicated and env_key == "":
+                    # The actor keeps this process for life: top the
+                    # plain pool back up in the background.
+                    self._replenish_evt.set()
+                return w
+            if env_key == "":
+                self._replenish_evt.set()  # pool empty: warm it for next
             n_live = len([w for w in self._workers.values()
                           if w.proc.poll() is None and not w.is_actor])
             can_spawn = dedicated or n_live < self._max_workers
@@ -695,12 +779,33 @@ class NodeAgent:
         self._record_task(spec, state)
         self._end_borrows(spec)
         meta, chunks = ser.serialize(err)
+        owner = spec.get("owner_addr")
         for oid in spec["oids"]:
             try:
                 self.store.put(oid, chunks, b"E" + meta)
             except Exception:
                 continue
-            self.head.call("add_location", oid, self.node_id, is_error=True)
+            self.head.call("add_location", oid, self.node_id, is_error=True,
+                           owner_addr=owner or "")
+            if owner:
+                # Unblock the owner's local wait directly (its get() no
+                # longer long-polls the head for self-owned refs).
+                try:
+                    self._owner_notify(owner, oid)
+                except Exception:
+                    pass
+
+    def _owner_notify(self, owner: str, oid: str) -> None:
+        with self._lock:
+            c = self._owner_clients.get(owner)
+            if c is None:
+                if len(self._owner_clients) > 256:
+                    old = self._owner_clients.popitem(last=False)[1]
+                    old.close()
+                c = self._owner_clients[owner] = RpcClient(
+                    owner, timeout=10.0)
+        c.call("owner_add_location", oid, self.node_id, self.address,
+               self.store_path, True, 0, timeout=10.0)
 
     def rpc_cancel_task(self, task_id: str, force: bool = False):
         """CancelTask analog (``core_worker.proto`` CancelTask → raylet).
@@ -1108,6 +1213,100 @@ class NodeAgent:
         return stats
 
     # -- lifecycle --------------------------------------------------------
+
+    # -- resource-view gossip ----------------------------------------------
+
+    def _my_view_entry(self) -> dict:
+        with self._lock:
+            qdepth = len(self._task_queue)
+            self._view_version += 1
+            version = self._view_version
+        return {
+            "available": dict(self.pool.available()),
+            "queue": qdepth,
+            "version": version,
+            "address": self.address,
+            "ts": time.time(),
+        }
+
+    def _merge_view(self, theirs: dict) -> None:
+        with self._lock:
+            for nid, entry in (theirs or {}).items():
+                if nid == self.node_id:
+                    continue  # we are authoritative for ourselves
+                cur = self._cluster_view.get(nid)
+                if cur is None or entry.get("version", 0) > \
+                        cur.get("version", 0):
+                    self._cluster_view[nid] = entry
+
+    def rpc_gossip(self, their_view: dict) -> dict:
+        """Push-pull anti-entropy exchange: merge the caller's view,
+        return ours (ray_syncer.h bidirectional sync analog)."""
+        self._merge_view(their_view)
+        with self._lock:
+            return dict(self._cluster_view)
+
+    def rpc_peer_view(self) -> dict:
+        """The gossiped cluster load view, for client-side spillback
+        target selection (no head involved)."""
+        with self._lock:
+            return dict(self._cluster_view)
+
+    def _gossip_client(self, address: str) -> RpcClient:
+        with self._lock:
+            c = self._gossip_clients.get(address)
+            if c is None:
+                if len(self._gossip_clients) > 128:
+                    self._gossip_clients.popitem(last=False)[1].close()
+                c = self._gossip_clients[address] = RpcClient(
+                    address, timeout=10.0)
+            return c
+
+    def _gossip_loop(self):
+        import random
+
+        tick = 0
+        while not self._shutdown.wait(config.gossip_interval_s):
+            tick += 1
+            mine = self._my_view_entry()
+            with self._lock:
+                self._cluster_view[self.node_id] = mine
+            if tick % max(1, config.gossip_membership_every) == 1:
+                # Membership from the head (its job): learn joins, drop
+                # nodes it declared dead.
+                try:
+                    nodes = self.head.call("nodes", timeout=5.0)
+                    alive = {n["NodeID"]: n["Address"]
+                             for n in nodes if n["Alive"]}
+                    with self._lock:
+                        for nid, addr in alive.items():
+                            if nid != self.node_id and \
+                                    nid not in self._cluster_view:
+                                self._cluster_view[nid] = {
+                                    "available": {}, "queue": 0,
+                                    "version": 0, "address": addr,
+                                    "ts": 0.0,
+                                }
+                        for nid in list(self._cluster_view):
+                            if nid != self.node_id and nid not in alive:
+                                del self._cluster_view[nid]
+                except Exception:
+                    pass  # head hiccup: keep gossiping the stale view
+            with self._lock:
+                peers = [(nid, e["address"])
+                         for nid, e in self._cluster_view.items()
+                         if nid != self.node_id and e.get("address")]
+                snapshot = dict(self._cluster_view)
+            if not peers:
+                continue
+            for _nid, addr in random.sample(
+                    peers, min(config.gossip_fanout, len(peers))):
+                try:
+                    theirs = self._gossip_client(addr).call(
+                        "gossip", snapshot, timeout=5.0)
+                    self._merge_view(theirs)
+                except (ConnectionLost, OSError):
+                    continue  # peer down: membership refresh cleans up
 
     def _heartbeat_loop(self):
         while not self._shutdown.wait(config.heartbeat_interval_s):

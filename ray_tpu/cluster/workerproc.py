@@ -151,6 +151,15 @@ class WorkerHandler:
         from ray_tpu.util import tracing
 
         pid = os.getpid()
+        # Agent-liveness watchdog (reference: a worker whose raylet dies
+        # exits with it, core_worker shutdown-on-raylet-death). Workers
+        # are killed by the agent on clean shutdown; when the agent dies
+        # ABRUPTLY (node crash, chaos kill, aborted test fixture) nothing
+        # would reap us — a jax-loaded orphan per worker piles real load
+        # onto the box. The flusher doubles as the probe: consecutive
+        # failed agent calls over ~3s mean the agent is gone.
+        consecutive_fail = 0
+        idle_rounds = 0
         while True:
             time.sleep(0.25)
             with self._ev_lock:
@@ -162,13 +171,22 @@ class WorkerHandler:
                 del self._task_events[:]
             spans = tracing.drain() if tracing.is_enabled() else []
             if not lines and not events and not spans:
-                continue
+                idle_rounds += 1
+                # Probe liveness every ~2s when idle; every round while
+                # failures are accumulating (fast exit once the agent
+                # actually died).
+                if idle_rounds < 8 and consecutive_fail == 0:
+                    continue
+            idle_rounds = 0
             try:
                 self.agent.call(
                     "worker_events", self.worker_id, pid, events, lines,
                     spans)
+                consecutive_fail = 0
             except Exception:
-                pass
+                consecutive_fail += 1
+                if consecutive_fail >= 12:
+                    os._exit(1)  # agent is gone: die with the node
 
     # -- rpc surface (called by agent and by remote callers) ---------------
 
@@ -329,20 +347,22 @@ class WorkerHandler:
             from ray_tpu.core import ids as _ids
 
             spec["oids"] = []
+            owner = spec.get("owner_addr")
             i = 0
             try:
                 for item in result:
                     self.backend.put_with_id(
-                        _ids.object_id_for(task_id, i), item)
+                        _ids.object_id_for(task_id, i), item, owner=owner)
                     i += 1
                 self.backend.put_with_id(
-                    _ids.object_id_for(task_id, i), _StreamEnd())
+                    _ids.object_id_for(task_id, i), _StreamEnd(),
+                    owner=owner)
             except BaseException as e:  # noqa: BLE001
                 self.backend.put_with_id(
                     _ids.object_id_for(task_id, i),
                     TaskError(spec.get("fname", "task"),
                               traceback.format_exc(), repr(e)),
-                    is_error=True,
+                    is_error=True, owner=owner,
                 )
                 raise
             return
@@ -356,11 +376,12 @@ class WorkerHandler:
                     f"{len(values)}"
                 )
         for oid, v in zip(oids, values):
-            self.backend.put_with_id(oid, v)
+            self.backend.put_with_id(oid, v, owner=spec.get("owner_addr"))
 
     def _store_error(self, spec, err: BaseException):
         for oid in spec["oids"]:
-            self.backend.put_with_id(oid, err, is_error=True)
+            self.backend.put_with_id(oid, err, is_error=True,
+                                     owner=spec.get("owner_addr"))
 
     def _end_borrows(self, spec):
         """Release the task's arg borrows — AFTER flushing our own holder

@@ -42,6 +42,18 @@ _DEFS: dict[str, tuple[type, Any]] = {
     # 0 disables. The delay keeps mass cluster boots from fork-storming.
     "worker_prestart_per_cpu": (float, 1.0),
     "worker_prestart_delay_s": (float, 2.0),
+    # Comma-separated substrings: PYTHONPATH entries matching any are
+    # stripped from WORKER processes so site hooks that pre-import heavy
+    # frameworks at interpreter startup (a TPU plugin's sitecustomize
+    # importing jax) don't serialize every fork. "" disables.
+    "worker_pythonpath_exclude": (str, ".axon_site"),
+    # -- resource-view gossip (ray_syncer.h analog) ------------------------
+    # Node agents exchange per-node load views peer-to-peer so spillback
+    # can place directly on a peer without the head. 0 disables gossip.
+    "gossip_interval_s": (float, 0.5),
+    "gossip_fanout": (int, 2),
+    # Refresh membership (join/dead) from the head every N gossip ticks.
+    "gossip_membership_every": (int, 10),
     # -- object plane ------------------------------------------------------
     "object_store_capacity_bytes": (int, 512 << 20),
     "transfer_chunk_bytes": (int, 4 << 20),

@@ -272,8 +272,11 @@ class LocalBackend:
 
     # -- ref counting ------------------------------------------------------
 
-    def make_ref(self, oid: str) -> ObjectRef:
-        """Mint an ObjectRef whose lifetime pins the object-table entry."""
+    def make_ref(self, oid: str, owner: str | None = None) -> ObjectRef:
+        """Mint an ObjectRef whose lifetime pins the object-table entry.
+        ``owner`` is the cluster backend's directory address — meaningless
+        in local mode (single process owns everything), accepted for
+        call-compatibility with ObjectRefGenerator."""
         with self._objects_lock:
             self._refcounts[oid] = self._refcounts.get(oid, 0) + 1
         ref = ObjectRef(oid)

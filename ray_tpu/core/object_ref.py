@@ -187,8 +187,12 @@ class ObjectRefGenerator:
 
         if i == 0 and self._first is not None:
             return self._first
+        # Later indices inherit the stream's owner from the index-0 ref
+        # (the submitting client), so a consumer that is NOT the owner
+        # still resolves locations against the right directory.
+        owner = getattr(self._first, "_owner", None) if self._first else None
         return _worker.backend().make_ref(
-            ids.object_id_for(self._task_id, i))
+            ids.object_id_for(self._task_id, i), owner)
 
     def __next__(self) -> "ObjectRef":
         from ray_tpu._private import worker as _worker

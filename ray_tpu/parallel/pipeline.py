@@ -102,10 +102,10 @@ def pipeline_apply(stage_params, x, mesh: Mesh, *, stage_fn: Callable,
 # ---------------------------------------------------------------------------
 
 
-def build_1f1b_schedule(n_micro: int, pp: int):
-    """Static 1F1B timetable. Returns (fwd, bwd, fwd_arrive, bwd_arrive),
-    each a [T, pp] int list: the microbatch index stage s handles (or
-    receives) at tick t, -1 for idle.
+def build_1f1b_schedule(n_micro: int, pp: int, style: str = "1f1b"):
+    """Static pipeline timetable. Returns (fwd, bwd, fwd_arrive,
+    bwd_arrive), each a [T, pp] int list: the microbatch index stage s
+    handles (or receives) at tick t, -1 for idle.
 
     Rules (greedy, backward-priority — the canonical 1F1B shape):
       * stage s may forward mb i once stage s-1 forwarded it on an earlier
@@ -115,6 +115,12 @@ def build_1f1b_schedule(n_micro: int, pp: int):
         forwards it (the fwd slot runs first within a tick);
       * in-flight forwards per stage are capped at pp - s (the 1F1B
         memory bound).
+
+    ``style="gpipe"``: no in-flight cap, and backwards wait for EVERY
+    forward to finish (all-fwd-then-all-bwd) — the schedule GPipe runs,
+    with O(n_micro) live activations instead of 1F1B's O(pp). Kept for
+    the pipeline microbenchmark and as the reference point the 1F1B
+    memory claim is measured against.
     """
     f_time = [[None] * n_micro for _ in range(pp)]
     b_time = [[None] * n_micro for _ in range(pp)]
@@ -128,7 +134,8 @@ def build_1f1b_schedule(n_micro: int, pp: int):
             i = f_next[s]
             if i >= n_micro:
                 continue
-            if f_next[s] - b_next[s] >= max(1, pp - s):
+            if style == "1f1b" \
+                    and f_next[s] - b_next[s] >= max(1, pp - s):
                 continue  # 1F1B in-flight cap
             ready = (s == 0) or (
                 f_time[s - 1][i] is not None and f_time[s - 1][i] < t)
@@ -137,10 +144,13 @@ def build_1f1b_schedule(n_micro: int, pp: int):
                 f_time[s][i] = t
                 f_next[s] += 1
         brow = [-1] * pp
+        all_fwd_done = all(f >= n_micro for f in f_next)
         for s in range(pp):
             i = b_next[s]
             if i >= n_micro:
                 continue
+            if style == "gpipe" and not all_fwd_done:
+                continue  # flush phase: backwards only after every fwd
             if s == pp - 1:
                 ready = f_time[s][i] is not None and f_time[s][i] <= t
             else:
@@ -169,7 +179,8 @@ def build_1f1b_schedule(n_micro: int, pp: int):
 
 def _1f1b_local(stage_params, x_micro, y_micro, fwd_sched, bwd_sched,
                 fwd_arrive, bwd_arrive, *, stage_fn: Callable,
-                loss_fn: Callable, axis: str, axis_size: int):
+                loss_fn: Callable, axis: str, axis_size: int,
+                grad_psum_axes: tuple = (), save_slots: int = 0):
     """Per-device 1F1B body (inside shard_map over ``axis``).
 
     Every tick executes one (masked) stage forward AND one (masked)
@@ -189,6 +200,10 @@ def _1f1b_local(stage_params, x_micro, y_micro, fwd_sched, bwd_sched,
     bperm = [(i, (i - 1) % pp) for i in range(pp)]
     zero_act = jnp.zeros(act_shape, x_micro.dtype)
     buf0 = jnp.zeros((pp, *act_shape), x_micro.dtype)
+    # Activation stash: pp slots suffice under the 1F1B in-flight cap
+    # (THE memory win); a GPipe schedule keeps all n_micro alive.
+    n_save = save_slots or pp
+    saved0 = jnp.zeros((n_save, *act_shape), x_micro.dtype)
 
     def tick(carry, t):
         fwd_msg, bwd_msg, in_buf, gbuf, saved, gacc, loss_sum = carry
@@ -206,7 +221,7 @@ def _1f1b_local(stage_params, x_micro, y_micro, fwd_sched, bwd_sched,
         fi = jnp.clip(fmb, 0)
         x_in = jnp.where(is_first, x_micro[fi], in_buf[fi % pp])
         out = stage_fn(params, x_in).astype(x_micro.dtype)
-        saved = jnp.where(fvalid, saved.at[fi % pp].set(x_in), saved)
+        saved = jnp.where(fvalid, saved.at[fi % n_save].set(x_in), saved)
         fwd_msg = jax.lax.ppermute(
             jnp.where(fvalid, out, zero_act), axis, fperm)
 
@@ -217,7 +232,7 @@ def _1f1b_local(stage_params, x_micro, y_micro, fwd_sched, bwd_sched,
         bmb = bwd_sched[t, s]
         bvalid = bmb >= 0
         bi = jnp.clip(bmb, 0)
-        x_saved = saved[bi % pp]
+        x_saved = saved[bi % n_save]
         y_mb = jax.lax.dynamic_index_in_dim(y_micro, bi, 0, keepdims=False)
 
         def f(p, xx):
@@ -241,12 +256,25 @@ def _1f1b_local(stage_params, x_micro, y_micro, fwd_sched, bwd_sched,
         return (fwd_msg, bwd_msg, in_buf, gbuf, saved, gacc, loss_sum), None
 
     grad0 = jax.tree.map(jnp.zeros_like, params)
-    init = (zero_act, zero_act, buf0, buf0, buf0, grad0,
+    init = (zero_act, zero_act, buf0, buf0, saved0, grad0,
             jnp.zeros((), jnp.float32))
     (_, _, _, _, _, gacc, loss_sum), _ = jax.lax.scan(
         tick, init, jnp.arange(T))
     # Mean-over-microbatches semantics for both value and grads.
     loss = jax.lax.psum(loss_sum, axis) / n_micro
+    if grad_psum_axes:
+        # Data-like in-stage axes (sp sequence shards, dp replicas inside
+        # the stage): every param's grad is a partial sum over the tokens
+        # that axis split — reduce it here, inside the shard_map, exactly
+        # like the reference's grad allreduce over dp x sp. Params
+        # SHARDED over one of these axes keep local grads (their tokens
+        # are local by construction); callers pass only axes that shard
+        # data, not params.
+        # pmean, matching the mean-loss convention (loss_fn averages over
+        # its LOCAL tokens; the global loss is the mean of shard means).
+        gacc = jax.tree.map(
+            lambda g: jax.lax.pmean(g, grad_psum_axes), gacc)
+        loss = jax.lax.pmean(loss, grad_psum_axes)
     grads = jax.tree.map(lambda g: (g / n_micro)[None], gacc)
     return loss, grads
 
@@ -254,7 +282,9 @@ def _1f1b_local(stage_params, x_micro, y_micro, fwd_sched, bwd_sched,
 def pipeline_value_and_grad(stage_params, x, y, mesh: Mesh, *,
                             stage_fn: Callable, loss_fn: Callable,
                             n_micro: int, axis: str = "pp",
-                            param_specs=None):
+                            param_specs=None, data_spec=None,
+                            grad_psum_axes: tuple = (),
+                            style: str = "1f1b"):
     """1F1B training pass: returns (mean microbatch loss, d loss / d
     stage_params) for ``loss_fn(stage_fn(...last stage...), y)``.
 
@@ -264,6 +294,13 @@ def pipeline_value_and_grad(stage_params, x, y, mesh: Mesh, *,
     naming other mesh axes (e.g. an expert axis) to combine pp with
     in-stage parallelism; collectives over those axes are legal inside
     ``stage_fn``.
+
+    ``data_spec``: PartitionSpec for the POST-microbatching activations
+    [n_micro, mb, ...] (and y), e.g. ``P(None, None, "sp")`` to run
+    sequence-parallel ring attention inside each stage. Any axis that
+    shards data this way must also appear in ``grad_psum_axes`` so param
+    grads (partial sums over that axis's token shard) are reduced inside
+    the shard_map — the dp x sp grad-allreduce of a classic trainer.
     """
     pp = mesh.shape[axis]
     b = x.shape[0]
@@ -271,19 +308,21 @@ def pipeline_value_and_grad(stage_params, x, y, mesh: Mesh, *,
         raise ValueError(f"batch {b} must divide into {n_micro} microbatches")
     x_micro = x.reshape(n_micro, b // n_micro, *x.shape[1:])
     y_micro = y.reshape(n_micro, b // n_micro, *y.shape[1:])
-    fwd, bwd, f_arr, b_arr = build_1f1b_schedule(n_micro, pp)
+    fwd, bwd, f_arr, b_arr = build_1f1b_schedule(n_micro, pp, style)
     tables = tuple(
         jnp.asarray(a, jnp.int32) for a in (fwd, bwd, f_arr, b_arr))
     if param_specs is None:
         param_specs = jax.tree.map(
             lambda p: P(axis, *([None] * (p.ndim - 1))), stage_params)
+    dspec = data_spec if data_spec is not None else P()
     fn = shard_map(
         functools.partial(
             _1f1b_local, stage_fn=stage_fn, loss_fn=loss_fn, axis=axis,
-            axis_size=pp,
+            axis_size=pp, grad_psum_axes=tuple(grad_psum_axes),
+            save_slots=(pp if style == "1f1b" else n_micro),
         ),
         mesh=mesh,
-        in_specs=(param_specs, P(), P(), P(), P(), P(), P()),
+        in_specs=(param_specs, dspec, dspec, P(), P(), P(), P()),
         out_specs=(P(), param_specs),
         check_vma=False,
     )

@@ -1,0 +1,231 @@
+"""Evolution Strategies (Salimans et al. 2017).
+
+Reference parity: ``rllib/algorithms/es`` — antithetic gaussian
+perturbations, centered-rank fitness shaping, seed-only communication
+for distributed rollouts. TPU-native twist: the DEFAULT path evaluates
+the entire population inside one jitted program — perturbation sampling,
+P×E vectorized env rollouts, rank shaping, and the gradient estimate all
+compile together (population is just another vmapped axis; the MXU eats
+the [P, params] matmuls). The distributed path keeps the reference's
+trick: workers receive (params, seeds), return only (seed, fitness)
+pairs, and the learner regenerates the noise from seeds.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.rllib.env import CartPole
+from ray_tpu.rllib.ppo import mlp_apply, mlp_init
+
+
+class ESConfig:
+    def __init__(self):
+        self.env = CartPole()
+        self.population = 128        # perturbation PAIRS are population/2
+        self.sigma = 0.05
+        self.lr = 0.03
+        self.l2_coeff = 0.005
+        self.episode_length = 500
+        self.hidden_sizes = (32, 32)
+        self.num_rollout_workers = 0
+        self.seed = 0
+
+    def training(self, **kw) -> "ESConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown config key {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "ES":
+        return ES(self)
+
+
+def _flatten_params(params):
+    leaves, treedef = jax.tree.flatten(params)
+    sizes = [l.size for l in leaves]
+    shapes = [l.shape for l in leaves]
+    flat = jnp.concatenate([l.reshape(-1) for l in leaves])
+
+    def unflatten(v):
+        out, off = [], 0
+        for size, shape in zip(sizes, shapes):
+            out.append(v[off:off + size].reshape(shape))
+            off += size
+        return jax.tree.unflatten(treedef, out)
+
+    return flat, unflatten
+
+
+def _episode_fitness(env, apply_fn, T):
+    """Fitness of ONE policy: single-episode return (reward stream
+    masked once the first done fires — auto-reset must not inflate)."""
+
+    def fitness(flat_params, unflatten, rng):
+        params = unflatten(flat_params)
+        state = env.reset(rng)
+
+        def step_fn(carry, _):
+            state, alive, rng = carry
+            rng, k = jax.random.split(rng)
+            logits = apply_fn(params, env.obs(state))
+            action = jnp.argmax(logits, axis=-1)
+            state, _, reward, done = env.step(state, action, k)
+            out = reward * alive
+            alive = alive * (1.0 - done.astype(jnp.float32))
+            return (state, alive, rng), out
+
+        (_, _, _), rewards = jax.lax.scan(
+            step_fn, (state, jnp.ones(()), rng), None, length=T)
+        return rewards.sum()
+
+    return fitness
+
+
+def _centered_ranks(fitness):
+    """Fitness shaping: ranks scaled into [-0.5, 0.5] (ES paper §2)."""
+    ranks = jnp.argsort(jnp.argsort(fitness))
+    return ranks.astype(jnp.float32) / (fitness.shape[0] - 1) - 0.5
+
+
+class ESWorker:
+    """Distributed evaluator: regenerates noise from seeds so only
+    (seeds, fitnesses) cross the wire (reference es.py seed protocol)."""
+
+    def __init__(self, cfg_dict: dict):
+        self.cfg = cfg_dict
+        self._fit = None
+
+    def evaluate(self, flat_params: np.ndarray, seeds: List[int],
+                 sigma: float) -> List[float]:
+        env = self.cfg["env"]
+        T = self.cfg["episode_length"]
+
+        def apply_fn(params, obs):
+            return mlp_apply(params["pi"], obs)
+
+        if self._fit is None:
+            unflatten = self.cfg["unflatten"]
+            base = _episode_fitness(env, apply_fn, T)
+            self._fit = jax.jit(
+                lambda fp, rng: base(fp, unflatten, rng))
+        flat = jnp.asarray(flat_params)
+        out = []
+        for seed in seeds:
+            noise = jax.random.normal(
+                jax.random.key(seed), flat.shape)
+            for sign in (1.0, -1.0):  # antithetic pair
+                out.append(float(self._fit(
+                    flat + sign * sigma * noise,
+                    jax.random.key(seed + 1))))
+        return out
+
+
+class ES:
+    """Algorithm: ``.train()`` one generation -> result dict."""
+
+    def __init__(self, config: ESConfig):
+        self.config = config
+        env = config.env
+        rng = jax.random.key(config.seed)
+        k_param, self._rng = jax.random.split(rng)
+        params = {"pi": mlp_init(
+            k_param, (env.observation_size, *config.hidden_sizes,
+                      env.num_actions))}
+        self._flat, self._unflatten = _flatten_params(params)
+        self._iteration = 0
+        self._workers: List = []
+        if config.num_rollout_workers > 0:
+            cls = ray_tpu.remote(ESWorker)
+            cfg_dict = {"env": env,
+                        "episode_length": config.episode_length,
+                        "unflatten": self._unflatten}
+            self._workers = [cls.remote(cfg_dict)
+                             for _ in range(config.num_rollout_workers)]
+        else:
+            self._gen_iter = self._build_local()
+
+    def _build_local(self):
+        cfg = self.config
+        env = cfg.env
+        half = cfg.population // 2
+
+        def apply_fn(params, obs):
+            return mlp_apply(params["pi"], obs)
+
+        fitness1 = _episode_fitness(env, apply_fn, cfg.episode_length)
+
+        @jax.jit
+        def gen_iter(flat, rng):
+            k_noise, k_ep = jax.random.split(rng)
+            eps = jax.random.normal(k_noise, (half,) + flat.shape)
+            ep_keys = jax.random.split(k_ep, half)
+            vfit = jax.vmap(
+                lambda p, k: fitness1(p, self._unflatten, k))
+            # Antithetic pairs share episode keys (common random numbers
+            # cancel env stochasticity out of the pair difference).
+            fit_pos = vfit(flat[None] + cfg.sigma * eps, ep_keys)
+            fit_neg = vfit(flat[None] - cfg.sigma * eps, ep_keys)
+            fit = jnp.concatenate([fit_pos, fit_neg])
+            shaped = _centered_ranks(fit)
+            w_pos, w_neg = shaped[:half], shaped[half:]
+            grad = ((w_pos - w_neg)[:, None] * eps).mean(0) / cfg.sigma
+            flat = flat + cfg.lr * grad - cfg.lr * cfg.l2_coeff * flat
+            return flat, fit
+
+        return gen_iter
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        cfg = self.config
+        self._rng, k = jax.random.split(self._rng)
+        if self._workers:
+            half = cfg.population // 2
+            base_seed = int(jax.random.randint(k, (), 0, 2**31 - 1))
+            seeds = [base_seed + i for i in range(half)]
+            chunks = np.array_split(seeds, len(self._workers))
+            flat_np = np.asarray(self._flat)
+            outs = ray_tpu.get(
+                [w.evaluate.remote(flat_np, list(map(int, c)), cfg.sigma)
+                 for w, c in zip(self._workers, chunks) if len(c)],
+                timeout=600)
+            fit_pos, fit_neg, eps_rows = [], [], []
+            flat_out = [f for o in outs for f in o]
+            for i, seed in enumerate(seeds):
+                fit_pos.append(flat_out[2 * i])
+                fit_neg.append(flat_out[2 * i + 1])
+                eps_rows.append(np.asarray(jax.random.normal(
+                    jax.random.key(seed), self._flat.shape)))
+            fit = jnp.asarray(fit_pos + fit_neg)
+            shaped = _centered_ranks(fit)
+            w_pos, w_neg = shaped[:half], shaped[half:]
+            eps = jnp.asarray(np.stack(eps_rows))
+            grad = ((w_pos - w_neg)[:, None] * eps).mean(0) / cfg.sigma
+            self._flat = (self._flat + cfg.lr * grad
+                          - cfg.lr * cfg.l2_coeff * self._flat)
+        else:
+            self._flat, fit = self._gen_iter(self._flat, k)
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_reward_mean": float(jnp.mean(fit)),
+            "episode_reward_max": float(jnp.max(fit)),
+            "timesteps_this_iter": cfg.population * cfg.episode_length,
+            "time_this_iter_s": time.perf_counter() - start,
+        }
+
+    def save(self) -> dict:
+        return {"flat": np.asarray(self._flat),
+                "iteration": self._iteration}
+
+    def restore(self, state: dict) -> None:
+        self._flat = jnp.asarray(state["flat"])
+        self._iteration = state["iteration"]

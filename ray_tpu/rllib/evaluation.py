@@ -1,0 +1,115 @@
+"""Evaluation workers: rollouts with frozen, (near-)greedy policies,
+separate from training sample collection.
+
+Reference parity: ``rllib/evaluation/worker_set.py:77`` (the evaluation
+WorkerSet an Algorithm keeps NEXT TO its training workers) +
+``algorithm.py`` ``evaluation_interval`` / ``evaluation_duration``
+handling — eval metrics are collected with their own workers/config and
+nested under ``result["evaluation"]`` so training throughput and eval
+quality never contaminate each other.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+class EvalWorker:
+    """Steps a gymnasium env with the given params for N episodes.
+    Greedy (argmax) by default — evaluation measures the policy, not the
+    exploration noise (reference: ``explore=False`` eval config)."""
+
+    def __init__(self, env_name: str, *, seed: int = 0,
+                 obs_connectors: Optional[list] = None,
+                 greedy: bool = True, max_steps: int = 1000):
+        import gymnasium as gym
+
+        self.env = gym.make(env_name)
+        self.greedy = greedy
+        self.max_steps = max_steps
+        self.seed = seed
+        self._apply = None
+        if obs_connectors:
+            from ray_tpu.rllib.connectors import ConnectorPipeline
+
+            self._pipe = ConnectorPipeline(list(obs_connectors))
+            self._pipe_state = self._pipe.init()
+        else:
+            self._pipe = None
+
+    def _transform(self, obs: np.ndarray) -> np.ndarray:
+        row = obs[None].astype(np.float32)
+        if self._pipe is None:
+            return row
+        self._pipe_state, out = self._pipe(self._pipe_state, row)
+        return np.asarray(out, np.float32)
+
+    def evaluate(self, params, num_episodes: int = 5) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.ppo import policy_apply
+
+        if self._apply is None:
+            self._apply = jax.jit(policy_apply)
+        rng = np.random.default_rng(self.seed)
+        returns: List[float] = []
+        lengths: List[int] = []
+        for ep in range(num_episodes):
+            if self._pipe is not None:
+                self._pipe_state = self._pipe.init()  # fresh episode stats
+            obs, _ = self.env.reset(seed=self.seed + 1000 * ep)
+            total, steps = 0.0, 0
+            for _ in range(self.max_steps):
+                logits, _v = self._apply(
+                    params, jnp.asarray(self._transform(obs)))
+                logits = np.asarray(logits)[0]
+                if self.greedy:
+                    action = int(np.argmax(logits))
+                else:
+                    g = rng.gumbel(size=logits.shape)
+                    action = int(np.argmax(logits + g))
+                obs, reward, term, trunc, _ = self.env.step(action)
+                total += float(reward)
+                steps += 1
+                if term or trunc:
+                    break
+            returns.append(total)
+            lengths.append(steps)
+        return {"episode_returns": returns, "episode_lengths": lengths}
+
+
+class EvaluationWorkerSet:
+    """The eval half of the reference's WorkerSet: owns its actors, its
+    own config (greedy, duration), aggregates across workers."""
+
+    def __init__(self, env_name: str, *, num_workers: int = 1,
+                 duration_episodes: int = 5, seed: int = 0,
+                 obs_connectors: Optional[list] = None,
+                 greedy: bool = True):
+        cls = ray_tpu.remote(EvalWorker)
+        self.duration = duration_episodes
+        self._workers = [
+            cls.remote(env_name, seed=seed + 7000 + i,
+                       obs_connectors=obs_connectors, greedy=greedy)
+            for i in range(max(1, num_workers))
+        ]
+
+    def evaluate(self, params) -> Dict[str, Any]:
+        per = max(1, self.duration // len(self._workers))
+        outs = ray_tpu.get(
+            [w.evaluate.remote(params, per) for w in self._workers],
+            timeout=300)
+        returns = [r for o in outs for r in o["episode_returns"]]
+        lengths = [l for o in outs for l in o["episode_lengths"]]
+        return {
+            "episode_reward_mean": float(np.mean(returns)),
+            "episode_reward_min": float(np.min(returns)),
+            "episode_reward_max": float(np.max(returns)),
+            "episode_len_mean": float(np.mean(lengths)),
+            "episodes_this_eval": len(returns),
+        }

@@ -1,0 +1,194 @@
+"""Model catalog: policy network families behind a uniform interface.
+
+Reference parity: ``rllib/models/catalog.py`` (ModelCatalog — the
+registry mapping model_config to a network: fcnet, LSTM wrapper,
+attention nets) re-done functionally for jax: every model is an
+``(init, initial_state, apply)`` triple
+
+    params = init(rng, obs_size, num_actions, cfg)
+    state  = initial_state(params, batch_size)          # pytree, may be ()
+    logits, value, state' = apply(params, obs[B, D], state)
+
+so recurrent and stateless models share one rollout loop (the reference
+wraps torch modules with hidden-state plumbing in ``use_lstm`` /
+``use_attention``; here state is an explicit scan carry — the natural
+jax/Anakin shape).
+
+Models:
+  * ``mlp``       — tanh MLP, separate value head (fcnet analog)
+  * ``lstm``      — MLP encoder -> LSTM cell -> pi/vf heads
+                    (``rllib/models/torch/recurrent_net.py`` analog)
+  * ``attention`` — MLP encoder -> causal attention over a rolling
+                    K-step memory of encodings -> pi/vf heads (GTrXL-
+                    lite: ``rllib/models/torch/attention_net.py`` analog)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _dense_init(rng, din, dout, scale):
+    return {"w": jax.random.normal(rng, (din, dout)) * scale,
+            "b": jnp.zeros((dout,))}
+
+
+def _dense(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def _mlp_init(rng, sizes, out_scale=0.01):
+    params = []
+    for i, (din, dout) in enumerate(zip(sizes[:-1], sizes[1:])):
+        k, rng = jax.random.split(rng)
+        scale = np.sqrt(2.0 / din) if i < len(sizes) - 2 else out_scale
+        params.append(_dense_init(k, din, dout, scale))
+    return params
+
+
+def _mlp(params, x, act=jnp.tanh):
+    for i, layer in enumerate(params):
+        x = _dense(layer, x)
+        if i < len(params) - 1:
+            x = act(x)
+    return x
+
+
+# -- mlp --------------------------------------------------------------------
+
+
+def _make_mlp(obs_size: int, num_actions: int, cfg: Dict[str, Any]):
+    hidden = tuple(cfg.get("fcnet_hiddens", (64, 64)))
+
+    def init(rng):
+        kp, kv = jax.random.split(rng)
+        return {"pi": _mlp_init(kp, (obs_size, *hidden, num_actions)),
+                "vf": _mlp_init(kv, (obs_size, *hidden, 1), out_scale=1.0)}
+
+    def initial_state(params, batch_size):
+        return ()
+
+    def apply(params, obs, state):
+        return (_mlp(params["pi"], obs),
+                _mlp(params["vf"], obs)[..., 0], state)
+
+    return init, initial_state, apply
+
+
+# -- lstm -------------------------------------------------------------------
+
+
+def _make_lstm(obs_size: int, num_actions: int, cfg: Dict[str, Any]):
+    embed = int(cfg.get("embed_size", 64))
+    cell = int(cfg.get("lstm_cell_size", 64))
+
+    def init(rng):
+        ke, kx, kh, kp, kv = jax.random.split(rng, 5)
+        return {
+            "enc": _mlp_init(ke, (obs_size, embed), out_scale=1.0),
+            # One fused matmul computes all four gates (i, f, g, o).
+            "wx": _dense_init(kx, embed, 4 * cell,
+                              np.sqrt(1.0 / embed)),
+            "wh": _dense_init(kh, cell, 4 * cell, np.sqrt(1.0 / cell)),
+            "pi": _mlp_init(kp, (cell, num_actions)),
+            "vf": _mlp_init(kv, (cell, 1), out_scale=1.0),
+        }
+
+    def initial_state(params, batch_size):
+        z = jnp.zeros((batch_size, cell))
+        return (z, z)
+
+    def apply(params, obs, state):
+        h, c = state
+        x = jnp.tanh(_mlp(params["enc"], obs))
+        gates = _dense(params["wx"], x) + _dense(params["wh"], h)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (_mlp(params["pi"], h), _mlp(params["vf"], h)[..., 0],
+                (h, c))
+
+    return init, initial_state, apply
+
+
+# -- attention --------------------------------------------------------------
+
+
+def _make_attention(obs_size: int, num_actions: int, cfg: Dict[str, Any]):
+    embed = int(cfg.get("embed_size", 64))
+    memory = int(cfg.get("attention_memory", 16))
+    heads = int(cfg.get("attention_heads", 2))
+    head_dim = embed // heads
+
+    def init(rng):
+        ke, kq, kk, kv_, ko, kp, kv = jax.random.split(rng, 7)
+        s = np.sqrt(1.0 / embed)
+        return {
+            "enc": _mlp_init(ke, (obs_size, embed), out_scale=1.0),
+            "q": _dense_init(kq, embed, embed, s),
+            "k": _dense_init(kk, embed, embed, s),
+            "v": _dense_init(kv_, embed, embed, s),
+            "o": _dense_init(ko, embed, embed, s),
+            "pi": _mlp_init(kp, (embed, num_actions)),
+            "vf": _mlp_init(kv, (embed, 1), out_scale=1.0),
+        }
+
+    def initial_state(params, batch_size):
+        # Rolling memory of the last K step encodings + a validity mask
+        # (GTrXL's memory tensor; fixed shape keeps everything jittable).
+        return (jnp.zeros((batch_size, memory, embed)),
+                jnp.zeros((batch_size, memory)))
+
+    def apply(params, obs, state):
+        mem, mask = state
+        x = jnp.tanh(_mlp(params["enc"], obs))          # [B, E]
+        mem = jnp.concatenate([mem[:, 1:], x[:, None]], axis=1)
+        mask = jnp.concatenate(
+            [mask[:, 1:], jnp.ones_like(mask[:, :1])], axis=1)
+        B = x.shape[0]
+
+        def split_heads(t):  # [B, K, E] -> [B, H, K, hd]
+            return t.reshape(B, -1, heads, head_dim).transpose(0, 2, 1, 3)
+
+        q = split_heads(_dense(params["q"], x[:, None]))   # [B,H,1,hd]
+        k = split_heads(_dense(params["k"], mem))          # [B,H,K,hd]
+        v = split_heads(_dense(params["v"], mem))
+        att = (q @ k.transpose(0, 1, 3, 2))[..., 0, :] / np.sqrt(head_dim)
+        att = jnp.where(mask[:, None] > 0, att, -1e9)      # [B,H,K]
+        w = jax.nn.softmax(att, axis=-1)
+        ctx = jnp.einsum("bhk,bhkd->bhd", w, v).reshape(B, embed)
+        y = x + _dense(params["o"], ctx)                   # residual
+        return (_mlp(params["pi"], y), _mlp(params["vf"], y)[..., 0],
+                (mem, mask))
+
+    return init, initial_state, apply
+
+
+_REGISTRY = {"mlp": _make_mlp, "lstm": _make_lstm,
+             "attention": _make_attention}
+
+
+class ModelCatalog:
+    """``rllib/models/catalog.py`` registry analog."""
+
+    @staticmethod
+    def register(name: str, factory) -> None:
+        _REGISTRY[name] = factory
+
+    @staticmethod
+    def get(obs_size: int, num_actions: int,
+            model_config: Dict[str, Any] | None = None):
+        cfg = dict(model_config or {})
+        name = cfg.get("model", "mlp")
+        try:
+            factory = _REGISTRY[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown model {name!r} (known: {sorted(_REGISTRY)})"
+            ) from None
+        return factory(obs_size, num_actions, cfg)

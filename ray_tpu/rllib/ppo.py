@@ -90,6 +90,12 @@ class PPOConfig:
         self.num_rollout_workers = 0
         self.gym_env = None  # gymnasium env id for external-env workers
         self.obs_connectors = None  # env-to-module pipeline (connectors.py)
+        # Evaluation (rllib/evaluation/worker_set.py:77 analog): every
+        # `evaluation_interval` train() calls, run greedy rollouts on
+        # SEPARATE eval workers; results nest under result["evaluation"].
+        self.evaluation_interval = 0  # 0 = never evaluate
+        self.evaluation_num_workers = 1
+        self.evaluation_duration = 5  # episodes per evaluation
         self.seed = 0
 
     def environment(self, env=None) -> "PPOConfig":
@@ -125,6 +131,17 @@ class PPOConfig:
             if not hasattr(self, k):
                 raise ValueError(f"unknown training option {k!r}")
             setattr(self, k, v)
+        return self
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_num_workers: Optional[int] = None,
+                   evaluation_duration: Optional[int] = None) -> "PPOConfig":
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_num_workers is not None:
+            self.evaluation_num_workers = evaluation_num_workers
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
         return self
 
     def debugging(self, *, seed: Optional[int] = None) -> "PPOConfig":
@@ -269,6 +286,33 @@ def _make_train_iter(cfg: PPOConfig):
 # -- rollout worker (Sebulba path) -----------------------------------------
 
 
+def _make_greedy_eval(cfg: "PPOConfig"):
+    """Jitted greedy evaluation on the pure-jax env (the in-process
+    analog of the reference's explore=False eval workers)."""
+    env = cfg.env
+    n = cfg.num_envs
+    reset, vstep, vobs = make_vec_env(env, n)
+    T = cfg.rollout_length * 2
+
+    @jax.jit
+    def eval_iter(params, rng):
+        states = reset(rng)
+
+        def step_fn(carry, _):
+            states, rng = carry
+            rng, k_step = jax.random.split(rng)
+            logits, _v = policy_apply(params, vobs(states))
+            action = jnp.argmax(logits, axis=-1)
+            states, _, reward, done = vstep(states, action, k_step)
+            return (states, rng), (reward, done)
+
+        _, (rewards, dones) = jax.lax.scan(
+            step_fn, (states, rng), None, length=T)
+        return rewards.sum(), dones.sum()
+
+    return eval_iter
+
+
 class RolloutWorker:
     """Actor sampling with its own env batch (WorkerSet parity)."""
 
@@ -361,6 +405,21 @@ class PPO:
         self._states = (None if config.num_rollout_workers > 0
                         else self._reset(k_env))
         self._iteration = 0
+        self._eval_set = None
+        if config.evaluation_interval > 0:
+            if gym_mode:
+                from ray_tpu.rllib.evaluation import EvaluationWorkerSet
+
+                self._eval_set = EvaluationWorkerSet(
+                    config.gym_env,
+                    num_workers=config.evaluation_num_workers,
+                    duration_episodes=config.evaluation_duration,
+                    seed=config.seed,
+                    obs_connectors=config.obs_connectors,
+                )
+            else:
+                # Pure-jax env: greedy eval rollout, jitted once.
+                self._eval_iter = _make_greedy_eval(config)
         self._workers: List = []
         if config.num_rollout_workers > 0:
             if getattr(config, "gym_env", None):
@@ -426,13 +485,28 @@ class PPO:
             reward_mean = float(metrics.pop("episode_reward_mean"))
             metrics = {k: float(v) for k, v in metrics.items()}
         self._iteration += 1
-        return {
+        result = {
             "training_iteration": self._iteration,
             "episode_reward_mean": reward_mean,
             "timesteps_this_iter": int(steps),
             "time_this_iter_s": time.perf_counter() - start,
             **metrics,
         }
+        interval = self.config.evaluation_interval
+        if interval > 0 and self._iteration % interval == 0:
+            # Separate workers/config (greedy, no exploration): eval
+            # metrics stay distinct from training sample stats.
+            if self._eval_set is not None:
+                result["evaluation"] = self._eval_set.evaluate(self.params)
+            else:
+                self._rng, k = jax.random.split(self._rng)
+                rsum, ndone = self._eval_iter(self.params, k)
+                ndone = max(1.0, float(ndone))
+                result["evaluation"] = {
+                    "episode_reward_mean": float(rsum) / ndone,
+                    "episodes_this_eval": int(ndone),
+                }
+        return result
 
     # Trainable contract: save/restore.
     def save(self) -> dict:

@@ -1,0 +1,249 @@
+"""Recurrent policy optimization (LSTM/attention PPO) + a memory task.
+
+Reference parity: ``rllib/models/torch/recurrent_net.py`` +
+``use_lstm``/``use_attention`` in ``rllib/models/catalog.py`` — policies
+with hidden state threaded through the rollout, trained with truncated
+BPTT. TPU-native shape: the whole thing (rollout with state carry, GAE,
+BPTT epochs) is ONE jitted Anakin program; hidden state is just another
+``lax.scan`` carry, reset on episode boundaries.
+
+``MemoryChain`` is the acceptance task (reference: RepeatAfterMeEnv in
+``rllib/examples/envs``): the cue appears only at t=0 and the reward
+depends on acting on it at the episode's last step — an MLP cannot beat
+chance, an LSTM solves it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.models import ModelCatalog
+from ray_tpu.rllib.optim import adam_step as _adam
+
+
+class MemoryChainState(NamedTuple):
+    cue: jax.Array   # which of 2 signals flashed at t=0
+    t: jax.Array
+
+
+class MemoryChain:
+    """Flash a 2-way cue at t=0; reward 1 iff the action at the LAST step
+    matches the cue. Chance = 0.5; solving requires memory."""
+
+    length = 10
+    observation_size = 3   # [cue==0, cue==1] (only at t=0) + phase
+    num_actions = 2
+
+    def reset(self, rng: jax.Array) -> MemoryChainState:
+        return MemoryChainState(
+            jax.random.bernoulli(rng).astype(jnp.int32),
+            jnp.zeros((), jnp.int32))
+
+    def obs(self, s: MemoryChainState) -> jax.Array:
+        show = (s.t == 0).astype(jnp.float32)
+        return jnp.stack([
+            show * (s.cue == 0), show * (s.cue == 1),
+            s.t.astype(jnp.float32) / self.length,
+        ])
+
+    def step(self, s: MemoryChainState, action: jax.Array, rng: jax.Array):
+        last = s.t >= self.length - 1
+        reward = (last & (action == s.cue)).astype(jnp.float32)
+        nxt = MemoryChainState(s.cue, s.t + 1)
+        fresh = self.reset(rng)
+        nxt = jax.tree.map(lambda a, b: jnp.where(last, a, b), fresh, nxt)
+        return nxt, self.obs(nxt), reward, last
+
+
+class RecurrentPPOConfig:
+    def __init__(self):
+        self.env = MemoryChain()
+        self.model: Dict[str, Any] = {"model": "lstm"}
+        self.num_envs = 64
+        self.rollout_length = 40
+        self.gamma = 0.99
+        self.gae_lambda = 0.95
+        self.clip_param = 0.2
+        self.lr = 3e-3
+        self.grad_clip = 0.5
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_sgd_iter = 4
+        self.seed = 0
+
+    def training(self, **kw) -> "RecurrentPPOConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown config key {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "RecurrentPPO":
+        return RecurrentPPO(self)
+
+
+class RecurrentPPO:
+    """PPO with a stateful catalog model; ``.train()`` -> result dict
+    (Trainable contract, ``rllib/algorithms/algorithm.py:142``)."""
+
+    def __init__(self, config: RecurrentPPOConfig):
+        self.config = config
+        env = config.env
+        init, self._initial_state, apply = ModelCatalog.get(
+            env.observation_size, env.num_actions, config.model)
+        rng = jax.random.key(config.seed)
+        k_param, k_env, self._rng = jax.random.split(rng, 3)
+        self.params = init(k_param)
+        self.opt = {
+            "mu": jax.tree.map(jnp.zeros_like, self.params),
+            "nu": jax.tree.map(jnp.zeros_like, self.params),
+            "t": jnp.zeros((), jnp.int32),
+        }
+        self._train_iter = self._build(apply)
+        reset1 = jax.vmap(env.reset)
+        self._env_states = reset1(
+            jax.random.split(k_env, config.num_envs))
+        self._model_state = self._initial_state(
+            self.params, config.num_envs)
+        self._iteration = 0
+
+    def _build(self, apply):
+        cfg = self.config
+        env = cfg.env
+        n, T = cfg.num_envs, cfg.rollout_length
+        vobs = jax.vmap(env.obs)
+        vstep = jax.vmap(env.step)
+
+        def mask_state(state, done):
+            # Episode boundary resets the policy memory for that row.
+            return jax.tree.map(
+                lambda z: jnp.where(
+                    done.reshape((-1,) + (1,) * (z.ndim - 1)), 0.0, z),
+                state)
+
+        def rollout(params, env_states, model_state, rng):
+            def step_fn(carry, _):
+                es, ms, rng = carry
+                rng, k_act, k_step = jax.random.split(rng, 3)
+                obs = vobs(es)
+                logits, value, ms2 = apply(params, obs, ms)
+                action = jax.random.categorical(k_act, logits)
+                logp = jax.nn.log_softmax(logits)[jnp.arange(n), action]
+                es2, _, reward, done = vstep(
+                    es, action, jax.random.split(k_step, n))
+                ms2 = mask_state(ms2, done)
+                out = {"obs": obs, "actions": action, "rewards": reward,
+                       "dones": done, "logp": logp, "values": value}
+                return (es2, ms2, rng), out
+
+            (env_states, model_state, rng), traj = jax.lax.scan(
+                step_fn, (env_states, model_state, rng), None, length=T)
+            return env_states, model_state, rng, traj
+
+        def gae(traj, last_value):
+            def scan_fn(adv, x):
+                reward, done, value, next_value = x
+                nonterminal = 1.0 - done.astype(jnp.float32)
+                delta = (reward + cfg.gamma * next_value * nonterminal
+                         - value)
+                adv = (delta
+                       + cfg.gamma * cfg.gae_lambda * nonterminal * adv)
+                return adv, adv
+
+            values = traj["values"]
+            next_values = jnp.concatenate(
+                [values[1:], last_value[None]], axis=0)
+            _, advs = jax.lax.scan(
+                scan_fn, jnp.zeros_like(last_value),
+                (traj["rewards"], traj["dones"], values, next_values),
+                reverse=True)
+            return advs, advs + values
+
+        def loss(params, traj, init_model_state):
+            # BPTT replay: re-run the model over the stored observation
+            # sequence from the rollout's initial state; gradients flow
+            # through the state carry (truncated at the rollout edge).
+            def replay(ms, x):
+                obs, done = x
+                logits, value, ms = apply(params, obs, ms)
+                ms = mask_state(ms, done)
+                return ms, (logits, value)
+
+            _, (logits, values) = jax.lax.scan(
+                replay, init_model_state, (traj["obs"], traj["dones"]))
+            logp_all = jax.nn.log_softmax(logits)        # [T, n, A]
+            logp = jnp.take_along_axis(
+                logp_all, traj["actions"][..., None], axis=-1)[..., 0]
+            ratio = jnp.exp(logp - traj["logp"])
+            adv = traj["adv"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pg1 = ratio * adv
+            pg2 = jnp.clip(
+                ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv
+            pg_loss = -jnp.mean(jnp.minimum(pg1, pg2))
+            vf_loss = jnp.mean((values - traj["returns"]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = (pg_loss + cfg.vf_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        @jax.jit
+        def train_iter(params, opt, env_states, model_state, rng):
+            init_ms = model_state
+            env_states, model_state, rng, traj = rollout(
+                params, env_states, model_state, rng)
+            obs_last = vobs(env_states)
+            _, last_value, _ = apply(params, obs_last, model_state)
+            adv, ret = gae(traj, last_value)
+            traj = {**traj, "adv": adv, "returns": ret}
+
+            def epoch(carry, _):
+                params, opt = carry
+                (_, aux), grads = jax.value_and_grad(
+                    loss, has_aux=True)(params, traj, init_ms)
+                params, opt = _adam(params, opt, grads, lr=cfg.lr,
+                                    max_grad_norm=cfg.grad_clip, eps=1e-5)
+                return (params, opt), aux
+
+            (params, opt), auxs = jax.lax.scan(
+                epoch, (params, opt), None, length=cfg.num_sgd_iter)
+            metrics = jax.tree.map(lambda x: x[-1], auxs)
+            metrics["reward_sum"] = traj["rewards"].sum()
+            metrics["episodes_done"] = traj["dones"].sum()
+            return params, opt, env_states, model_state, rng, metrics
+
+        return train_iter
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        (self.params, self.opt, self._env_states, self._model_state,
+         self._rng, metrics) = self._train_iter(
+            self.params, self.opt, self._env_states, self._model_state,
+            self._rng)
+        self._iteration += 1
+        n_done = max(1.0, float(metrics.pop("episodes_done")))
+        reward_mean = float(metrics.pop("reward_sum")) / n_done
+        return {
+            "training_iteration": self._iteration,
+            "episode_reward_mean": reward_mean,
+            "timesteps_this_iter":
+                self.config.num_envs * self.config.rollout_length,
+            "time_this_iter_s": time.perf_counter() - start,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def save(self) -> dict:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "iteration": self._iteration}
+
+    def restore(self, state: dict) -> None:
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self._iteration = state["iteration"]

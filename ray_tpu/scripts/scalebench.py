@@ -102,6 +102,12 @@ def run(nodes: int = 16, cpus: int = 2, tasks: int = 2000,
         def touch(x):
             return int(x[-1]) + len(x) % 7
 
+        # Per-RPC accounting for the ownership protocol (verdict r4 #3
+        # "Done" criterion): during the broadcast, location waits resolve
+        # at the OWNER (this driver's directory server), so the head's
+        # wait_locations count must stay O(1)-ish instead of O(nodes x
+        # poll rounds), and its handler time flat.
+        stats0 = cluster.head._server.handler_stats()
         t0 = time.perf_counter()
         sums = ray_tpu.get(
             [
@@ -111,11 +117,27 @@ def run(nodes: int = 16, cpus: int = 2, tasks: int = 2000,
             timeout=1200,
         )
         dt = time.perf_counter() - t0
+        stats1 = cluster.head._server.handler_stats()
         assert len(set(sums)) == 1
         gib = broadcast_mb / 1024.0
         record("broadcast_object_gib", gib, "GiB")
         record("broadcast_nodes_per_s", nodes / dt, "nodes/s")
         record("broadcast_agg_gib_per_s", gib * nodes / dt, "GiB/s")
+
+        def delta(method, field="count"):
+            return (stats1.get(method, {}).get(field, 0)
+                    - stats0.get(method, {}).get(field, 0))
+
+        record("broadcast_head_wait_locations", float(
+            delta("wait_locations")), "rpcs")
+        record("broadcast_head_handler_s", float(round(
+            sum(stats1.get(m, {}).get("total_s", 0.0)
+                for m in stats1)
+            - sum(stats0.get(m, {}).get("total_s", 0.0)
+                  for m in stats0), 4)), "s")
+        out["head_rpc_counts"] = {
+            m: stats1[m]["count"] for m in sorted(stats1)
+        }
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()

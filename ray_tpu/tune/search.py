@@ -209,14 +209,26 @@ class TPESearcher(Searcher):
     "good" top-``gamma`` quantile and the rest; each dimension gets 1-D
     Parzen density estimates l(x) (good) and g(x) (bad), and the next
     suggestion maximizes l/g over ``n_candidates`` draws from l.
-    Dimensions are modeled independently (canonical TPE).
+
+    ``multivariate`` (optuna's ``TPESampler(multivariate=True)`` analog,
+    default ``"auto"``): model the good/bad sets with JOINT per-
+    observation product kernels over the whole unit hypercube instead of
+    independent per-dimension estimates. Candidates are drawn as whole
+    vectors around good observations, so correlations between dimensions
+    (e.g. lr x batch-size ridges) survive into the suggestions — the
+    canonical independent model mixes marginals and loses them. "auto"
+    uses the joint model when every dimension is numeric/categorical and
+    both split sides have >= 2 observations, falling back to the
+    univariate model otherwise.
     """
 
     def __init__(self, metric: Optional[str] = None, mode: str = "max",
                  param_space: Optional[Dict[str, Any]] = None,
                  n_initial: int = 8, gamma: float = 0.25,
-                 n_candidates: int = 32, seed: Optional[int] = None):
+                 n_candidates: int = 32, seed: Optional[int] = None,
+                 multivariate: "bool | str" = "auto"):
         super().__init__(metric=metric, mode=mode)
+        self.multivariate = multivariate
         self._space: Dict[str, Any] = {}
         if param_space:
             self._set_space(param_space)
@@ -271,6 +283,15 @@ class TPESearcher(Searcher):
             self.gamma * np.sqrt(len(scores))))))
         order = np.argsort(-scores)  # maximize internally
         good_idx = set(order[:n_good].tolist())
+        if self.multivariate in (True, "auto"):
+            # The joint KDE needs a denser good set than the univariate
+            # elite-only split: per-observation product kernels around 2
+            # points don't carve out a manifold. Proportional split
+            # (optuna's gamma): ~15% of observations, at least 4.
+            n_good_j = max(4, min(25, int(np.ceil(0.15 * len(scores)))))
+            out = self._joint_suggest(set(order[:n_good_j].tolist()))
+            if out is not None:
+                return out
         out = {}
         for k, dom in self._space.items():
             if not isinstance(dom, Domain):
@@ -288,6 +309,130 @@ class TPESearcher(Searcher):
                 # Unmodellable domain (e.g. SampleFrom): keep sampling
                 # from it rather than crash the search mid-experiment.
                 out[k] = dom.sample(self.rng)
+        return out
+
+    # -- joint (multivariate) model ---------------------------------------
+
+    def _joint_suggest(self, good_idx) -> Optional[Dict[str, Any]]:
+        """Joint-kernel TPE over the whole space: l(x) and g(x) are
+        mixtures of per-OBSERVATION product kernels (gaussian on numeric
+        dims in unit space, Aitchison–Aitken-style on categoricals), and
+        candidates are whole vectors drawn around good observations.
+        Returns None when the space/observations don't support the joint
+        model (caller falls back to the univariate path)."""
+        numd: list = []   # (key, _NumDim)
+        catd: list = []   # (key, Choice, {cat_key: idx})
+        fixed: Dict[str, Any] = {}
+        for k, dom in self._space.items():
+            if not isinstance(dom, Domain):
+                fixed[k] = dom
+            elif isinstance(dom, Choice):
+                catd.append((k, dom, {self._cat_key(c): i
+                                      for i, c in enumerate(dom.categories)}))
+            elif isinstance(dom, (Uniform, LogUniform, QUniform, RandInt)):
+                numd.append((k, _NumDim(dom)))
+            else:
+                return None  # SampleFrom etc.: not jointly modellable
+        if not numd and not catd:
+            return None
+
+        def rows(idx_filter):
+            num, cat = [], []
+            for i, (cfg, _) in enumerate(self._obs):
+                if not idx_filter(i):
+                    continue
+                try:
+                    num.append([nd.to_unit(cfg[k]) for k, nd in numd])
+                    cat.append([lut[self._cat_key(cfg[k])]
+                                for k, _dom, lut in catd])
+                except (KeyError, TypeError):
+                    continue  # stale/partial observation: skip
+            return (np.array(num, dtype=float).reshape(len(num), len(numd)),
+                    np.array(cat, dtype=int).reshape(len(cat), len(catd)))
+
+        g_num, g_cat = rows(lambda i: i in good_idx)
+        b_num, b_cat = rows(lambda i: i not in good_idx)
+        if len(g_num) < 2 or len(b_num) < 2:
+            return None
+
+        # Per-point per-dim bandwidths from the neighbor-gap heuristic.
+        def bws(mat):
+            out = np.empty_like(mat)
+            for d in range(mat.shape[1]):
+                out[:, d] = _adaptive_bw(mat[:, d])
+            return out
+
+        bw_g, bw_b = bws(g_num), bws(b_num)
+        ncat = np.array([len(dom.categories) for _k, dom, _l in catd],
+                        dtype=float)
+        w_same = 0.8  # categorical kernel mass on the observed category
+
+        n = max(self.n_candidates, 4 * (len(numd) + len(catd)))
+        rng = self.rng
+        w_prior = 1.0 / (len(g_num) + 1.0)
+        from_prior = rng.uniform(size=n) < w_prior
+        pick = rng.integers(0, len(g_num), n)
+        # Numeric dims: gaussian around the picked good ROW (whole-vector
+        # draws keep cross-dim structure), reflected at the bounds.
+        if numd:
+            centers = np.where(from_prior[:, None],
+                               rng.uniform(0, 1, (n, len(numd))),
+                               g_num[pick])
+            widths = np.where(from_prior[:, None], 0.25, bw_g[pick])
+            cand = centers + rng.normal(0, 1, (n, len(numd))) * widths
+            cand = np.abs(cand)
+            cand = 1.0 - np.abs(1.0 - cand)
+            cand = np.clip(cand, 0.0, 1.0)
+        else:
+            cand = np.zeros((n, 0))
+        if catd:
+            keep = rng.uniform(size=(n, len(catd))) < w_same
+            rand_cat = np.stack(
+                [rng.integers(0, len(dom.categories), n)
+                 for _k, dom, _l in catd], axis=1)
+            cand_cat = np.where(from_prior[:, None] | ~keep,
+                                rand_cat, g_cat[pick])
+        else:
+            cand_cat = np.zeros((n, 0), dtype=int)
+
+        def log_density(num_mat, cat_mat, bw):
+            """log mixture density of each candidate under the set's
+            per-observation product kernels (+ uniform prior mixture)."""
+            if len(num_mat) == 0:
+                return np.zeros(n)
+            # [n_cand, n_obs, D] broadcasting; n and n_obs are both small
+            # (tens), so the dense intermediate is fine.
+            if numd:
+                d = (cand[:, None, :] - num_mat[None, :, :]) / bw[None, :, :]
+                log_k = (-0.5 * d * d
+                         - np.log(bw[None, :, :] * np.sqrt(2 * np.pi))
+                         ).sum(axis=2)
+            else:
+                log_k = np.zeros((n, len(num_mat)))
+            if catd:
+                same = cand_cat[:, None, :] == cat_mat[None, :, :]
+                log_k = log_k + np.where(
+                    same, np.log(w_same),
+                    np.log((1 - w_same) / np.maximum(ncat - 1, 1.0))
+                ).sum(axis=2)
+            m = log_k.max(axis=1, keepdims=True)
+            kde = m[:, 0] + np.log(
+                np.mean(np.exp(log_k - m), axis=1))
+            # Uniform prior over the hypercube: density 1 on numeric
+            # dims, 1/K per categorical dim.
+            log_uniform = -np.log(ncat).sum() if catd else 0.0
+            pw = 1.0 / (len(num_mat) + 1.0)
+            return np.logaddexp(np.log(pw) + log_uniform,
+                                np.log1p(-pw) + kde)
+
+        score = (log_density(g_num, g_cat, bw_g)
+                 - log_density(b_num, b_cat, bw_b))
+        best = int(np.argmax(score))
+        out = dict(fixed)
+        for j, (k, nd) in enumerate(numd):
+            out[k] = nd.from_unit(float(cand[best, j]))
+        for j, (k, dom, _lut) in enumerate(catd):
+            out[k] = dom.categories[int(cand_cat[best, j])]
         return out
 
     def _suggest_numeric(self, dom, good, bad):

@@ -103,6 +103,7 @@ class TrialRunner:
         num_samples: int = 0,
         trial_resources: Optional[dict] = None,
         experiment_dir: Optional[str] = None,
+        sync=None,
     ):
         self.trainable = trainable
         self.trials = trials
@@ -121,6 +122,10 @@ class TrialRunner:
         # a changed trial state rewrites <dir>/experiment_state.json so
         # Tuner.restore can resume unfinished trials after a crash.
         self.experiment_dir = experiment_dir
+        # Optional syncer driver (tune/syncer.py _PeriodicSync): pushes
+        # the persisted experiment dir to remote storage, throttled
+        # during the run + unconditionally at the end.
+        self.sync = sync
         self.experiment_meta: dict = {}  # metric/mode etc., persisted too
         self._persisted_sig = None
         self.queue = Queue()
@@ -191,6 +196,11 @@ class TrialRunner:
             tmp, os.path.join(self.experiment_dir,
                               "experiment_state.json"))
         self._persisted_sig = sig
+        if self.sync is not None:
+            try:
+                self.sync.maybe_sync()
+            except Exception:
+                pass  # remote hiccup must not kill the experiment
 
     def _maybe_create_trial(self) -> Optional[Trial]:
         if self.searcher is None:
@@ -350,6 +360,11 @@ class TrialRunner:
                 self._persist()
         finally:
             self._persist()
+            if self.sync is not None:
+                try:
+                    self.sync.final()
+                except Exception:
+                    pass
             for t in self.trials:
                 self._stop_actor(t)
             self.queue.shutdown()

@@ -106,15 +106,31 @@ class Tuner:
         self._restored_trials: Optional[List[Trial]] = None
 
     def experiment_dir(self) -> Optional[str]:
-        """Where experiment state snapshots live (None = no persistence):
-        RunConfig(storage_path=...)/[name]."""
+        """Where experiment state snapshots live LOCALLY (None = no
+        persistence): RunConfig(storage_path=...)/[name]. A remote
+        ``storage_path`` URI (``file://``, ``s3://``) persists to a local
+        mirror that the Syncer pushes up (reference tune/syncer.py:185)."""
+        local, _uri = self._storage()
+        return local
+
+    def _storage(self):
+        """(local_experiment_dir, remote_uri_or_None)."""
+        import hashlib
         import os
 
-        if not self.run_config.storage_path:
-            return None
-        return os.path.join(
-            self.run_config.storage_path,
-            self.run_config.name or "experiment")
+        sp = self.run_config.storage_path
+        if not sp:
+            return None, None
+        name = self.run_config.name or "experiment"
+        from ray_tpu.tune.syncer import is_remote_uri
+
+        if is_remote_uri(sp):
+            uri = sp.rstrip("/") + "/" + name
+            mirror = os.path.join(
+                os.path.expanduser("~/.ray_tpu/mirrors"),
+                hashlib.sha1(uri.encode()).hexdigest()[:12], name)
+            return mirror, uri
+        return os.path.join(sp, name), None
 
     @classmethod
     def restore(cls, path: str, trainable: Callable,
@@ -135,7 +151,26 @@ class Tuner:
         import os
         import pickle
 
-        path = os.path.abspath(path)
+        from ray_tpu.tune.syncer import get_syncer, is_remote_uri
+
+        restore_uri = None
+        if is_remote_uri(path):
+            # Pull the synced experiment down into the deterministic
+            # mirror dir, then restore from there; fit() keeps syncing
+            # up to the same URI.
+            import hashlib
+
+            restore_uri = path.rstrip("/")
+            name = os.path.basename(restore_uri)
+            local = os.path.join(
+                os.path.expanduser("~/.ray_tpu/mirrors"),
+                hashlib.sha1(restore_uri.encode()).hexdigest()[:12], name)
+            if not get_syncer(restore_uri).sync_down(restore_uri, local):
+                raise FileNotFoundError(
+                    f"nothing to restore at {restore_uri}")
+            path = local
+        else:
+            path = os.path.abspath(path)
         state_file = os.path.join(path, "experiment_state.json")
         with open(state_file) as f:
             state = json.load(f)
@@ -174,7 +209,10 @@ class Tuner:
                 mode=meta.get("mode") or "max",
                 num_samples=int(meta.get("num_samples") or len(trials)),
             )
-        storage_root, name = os.path.split(path.rstrip(os.sep))
+        if restore_uri is not None:
+            storage_root, name = restore_uri.rsplit("/", 1)
+        else:
+            storage_root, name = os.path.split(path.rstrip(os.sep))
         rc = copy.copy(run_config) if run_config is not None \
             else RunConfig()
         rc.storage_path = storage_root
@@ -230,6 +268,12 @@ class Tuner:
                 seed=self.tune_config.seed,
             )
             trials = [Trial(cfg, resources) for cfg in variants]
+        local_dir, sync_uri = self._storage()
+        sync = None
+        if sync_uri:
+            from ray_tpu.tune.syncer import _PeriodicSync, get_syncer
+
+            sync = _PeriodicSync(get_syncer(sync_uri), local_dir, sync_uri)
         runner = TrialRunner(
             self.trainable,
             trials,
@@ -240,7 +284,8 @@ class Tuner:
             searcher=searcher,
             num_samples=self.tune_config.num_samples,
             trial_resources=resources,
-            experiment_dir=self.experiment_dir(),
+            experiment_dir=local_dir,
+            sync=sync,
         )
         runner.experiment_meta = {
             "metric": self.tune_config.metric,

@@ -48,7 +48,18 @@ def test_accessors_end_to_end(cluster):
     assert gcs.kv.delete("gcs:k")
 
     ref = ray_tpu.put("loc-probe")
-    loc = gcs.objects.locations(ref.id)
+    # The authoritative directory for a put object is its OWNER (the
+    # driver's owner service); the head's view arrives via the batched
+    # ref flusher and is eventually consistent — poll briefly.
+    import time as _time
+
+    _deadline = _time.monotonic() + 10
+    loc = None
+    while _time.monotonic() < _deadline:
+        loc = gcs.objects.locations(ref.id)
+        if loc and loc["nodes"]:
+            break
+        _time.sleep(0.05)
     assert loc and loc["nodes"]
 
     gcs.pubsub.subscribe("gcs-sub", "ACTORS")

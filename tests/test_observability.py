@@ -123,9 +123,17 @@ def test_list_objects_cluster(_runtime):
     import numpy as np
 
     ref = ray_tpu.put(np.zeros(1024, dtype=np.uint8))
-    records = state.list_objects()
-    rec = next((r for r in records if r["object_id"] == ref.id), None)
-    assert rec is not None, records[:5]
+    # The head's object view is fed by the batched ref flusher
+    # (ownership model: the owner is authoritative, the head is
+    # eventually consistent) — poll briefly.
+    deadline = time.monotonic() + 10
+    rec = None
+    while rec is None and time.monotonic() < deadline:
+        records = state.list_objects()
+        rec = next((r for r in records if r["object_id"] == ref.id), None)
+        if rec is None:
+            time.sleep(0.05)
+    assert rec is not None, state.list_objects()[:5]
     assert rec["size"] > 0
     assert len(rec["locations"]) >= 1
     del ref

@@ -1,0 +1,214 @@
+"""Owner-distributed object directory.
+
+Reference parity: ``src/ray/core_worker/reference_count.h:61`` (per-object
+state lives on the owning worker) and
+``src/ray/core_worker/ownership_based_object_directory.h`` (locations are
+resolved from owners, not the GCS). Here: every client hosts an owner
+directory server (``cluster/client.py`` ``_OwnerService``); executing
+workers report result locations straight to the submitting client; get()
+on self-owned refs blocks on the local table with no head RPC; borrowers
+long-poll the owner; the head keeps object->owner routing plus an
+asynchronously-batched location view as the FT fallback.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster.cluster_utils import Cluster
+
+
+def wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2, store_capacity=64 << 20)
+    c.add_node(num_cpus=2, store_capacity=64 << 20)
+    c.wait_for_nodes()
+    ray_tpu.init(c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _backend():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod._backend
+
+
+def _head_stat(cluster, method):
+    stats = cluster.head._server.handler_stats()
+    return stats.get(method, {}).get("count", 0)
+
+
+def test_refs_carry_owner_address(cluster):
+    b = _backend()
+    ref = ray_tpu.put(1)
+    assert ref._owner == b.owner_addr
+    host, port = b.owner_addr.rsplit(":", 1)
+    assert int(port) > 0
+
+
+def test_self_owned_get_skips_head_wait(cluster):
+    """The hot path: a driver getting its own tasks' results resolves
+    from the LOCAL owner table — zero head wait_locations RPCs."""
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    # Warm up (function export, worker start).
+    assert ray_tpu.get(f.remote(0), timeout=60) == 1
+
+    before = _head_stat(cluster, "wait_locations")
+    refs = [f.remote(i) for i in range(40)]
+    assert ray_tpu.get(refs, timeout=60) == [i + 1 for i in range(40)]
+    after = _head_stat(cluster, "wait_locations")
+    # The straggler sweep (every 4th poll round) may fire a couple of
+    # times under load; O(tasks) would be >= 40.
+    assert after - before <= 6, (
+        f"expected near-zero head wait_locations, got {after - before}")
+
+
+def test_worker_reports_result_to_owner(cluster):
+    b = _backend()
+
+    @ray_tpu.remote
+    def g():
+        return "hello"
+
+    ref = g.remote()
+    assert ray_tpu.get(ref, timeout=60) == "hello"
+    # The executing worker reported the location to this driver's table.
+    entry = b._owned.get(ref.id)
+    assert entry is not None and entry["nodes"], entry
+
+
+def test_borrower_resolves_via_owner(cluster):
+    """A worker that receives a ref as a task arg (borrower) resolves the
+    location from the owner's directory server."""
+
+    @ray_tpu.remote
+    def produce():
+        return {"payload": list(range(100))}
+
+    @ray_tpu.remote
+    def consume(d):
+        return len(d["payload"])
+
+    ref = produce.remote()
+    # Pass the REF (not the value): consume's worker borrows + resolves.
+    out = consume.remote(ref)
+    assert ray_tpu.get(out, timeout=60) == 100
+
+
+def test_error_results_reach_owner_table(cluster):
+    b = _backend()
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    ref = boom.remote()
+    with pytest.raises(Exception, match="kaboom"):
+        ray_tpu.get(ref, timeout=60)
+    entry = b._owned.get(ref.id)
+    assert entry is not None and entry["error"] is True
+
+
+def test_head_keeps_owner_routing(cluster):
+    """The head's batched view records object->owner routing."""
+    b = _backend()
+    ref = ray_tpu.put("routed")
+    b.flush_refs()  # push the batched add_locations now
+    wait_for(
+        lambda: b.head.call("owner_of", [ref.id]).get(ref.id)
+        == b.owner_addr,
+        msg="owner routing at head",
+    )
+
+
+def test_owner_death_falls_back_to_head(cluster):
+    """A borrower whose owner process died resolves through the head's
+    FT view (owner death degrades, not breaks, already-stored objects)."""
+    import ray_tpu.cluster.client as client_mod
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    b = _backend()
+    # Place the object on node 1 so the resolver (attached to node 0's
+    # store) cannot short-circuit through a local read.
+    node1 = cluster.nodes[1].node_id
+
+    @ray_tpu.remote
+    def produce():
+        return [1, 2, 3]
+
+    ref = produce.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node1, soft=False)).remote()
+    assert ray_tpu.get(ref, timeout=60) == [1, 2, 3]
+    b.flush_refs()  # head's batched view must know the location
+    # Simulate owner death for the RESOLVER: point the ref at a dead
+    # owner address and resolve through a fresh backend (a different
+    # client process in spirit).
+    other = client_mod.ClusterBackend(cluster.address)
+    try:
+        dead_ref = other.make_ref(ref.id, owner="127.0.0.1:1")
+        vals = other.get([dead_ref], timeout=30)
+        assert vals == [[1, 2, 3]]
+        assert "127.0.0.1:1" in other._dead_owners
+    finally:
+        other.shutdown()
+
+
+def test_forgotten_oid_redirects_borrower_to_head(cluster):
+    """An owner that dropped its handle answers 'forgotten'; the borrower
+    falls over to the head (which still tracks the pinned copy while the
+    borrower holds a ref)."""
+    b = _backend()
+    ref = ray_tpu.put("keepsake")
+    oid = ref.id
+    b.flush_refs()
+    # Owner drops its table entry (as _deref would) while the head still
+    # has the location.
+    with b._owned_cv:
+        b._owned.pop(oid, None)
+    got = b.owner_wait_locations([oid], timeout=0)
+    assert got.get(oid, {}).get("forgotten") is True
+    # A borrower-side get still resolves via head fallback.
+    import ray_tpu.cluster.client as client_mod
+
+    other = client_mod.ClusterBackend(cluster.address)
+    try:
+        bref = other.make_ref(oid, owner=b.owner_addr)
+        assert other.get([bref], timeout=30) == ["keepsake"]
+    finally:
+        other.shutdown()
+
+
+def test_wait_uses_owner_table(cluster):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.4)
+        return 7
+
+    before = _head_stat(cluster, "wait_locations")
+    refs = [slow.remote() for _ in range(4)]
+    ready, pending = ray_tpu.wait(refs, num_returns=4, timeout=30)
+    assert len(ready) == 4 and not pending
+    after = _head_stat(cluster, "wait_locations")
+    # Old behavior: one head poll per pending ref per 5 ms round
+    # (hundreds). Now: local table + occasional sweep.
+    assert after - before <= 8, f"head polls in wait: {after - before}"

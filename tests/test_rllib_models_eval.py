@@ -1,0 +1,141 @@
+"""RLlib round-5 surfaces: model catalog (LSTM/attention), recurrent PPO
+on a memory task, evaluation workers, and Evolution Strategies.
+
+Reference parity: ``rllib/models/catalog.py``,
+``rllib/models/torch/recurrent_net.py``, ``rllib/evaluation/worker_set.py:77``,
+``rllib/algorithms/es``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.rllib.models import ModelCatalog
+from ray_tpu.rllib.recurrent import MemoryChain, RecurrentPPOConfig
+
+
+@pytest.fixture(scope="module", autouse=True)
+def local_mode():
+    ray_tpu.shutdown()
+    ray_tpu.init()
+    yield
+    ray_tpu.shutdown()
+
+
+def test_catalog_shapes_and_state():
+    for name, has_state in (("mlp", False), ("lstm", True),
+                            ("attention", True)):
+        init, istate, apply = ModelCatalog.get(5, 3, {"model": name})
+        params = init(jax.random.key(0))
+        state = istate(params, 7)
+        logits, value, state2 = apply(params, jnp.ones((7, 5)), state)
+        assert logits.shape == (7, 3)
+        assert value.shape == (7,)
+        if has_state:
+            leaves = jax.tree.leaves(state2)
+            assert leaves and all(l.shape[0] == 7 for l in leaves)
+        else:
+            assert state2 == ()
+
+
+def test_catalog_unknown_model_raises():
+    with pytest.raises(ValueError, match="unknown model"):
+        ModelCatalog.get(4, 2, {"model": "transformer-xxl"})
+
+
+def test_catalog_register_custom():
+    called = {}
+
+    def factory(obs, act, cfg):
+        called["yes"] = True
+        return ModelCatalog.get(obs, act, {"model": "mlp"})
+
+    ModelCatalog.register("custom-test", factory)
+    init, _s, _a = ModelCatalog.get(4, 2, {"model": "custom-test"})
+    assert called.get("yes")
+
+
+def test_lstm_state_distinguishes_history():
+    """Same current obs, different history -> different logits (the
+    property an MLP cannot have)."""
+    init, istate, apply = ModelCatalog.get(3, 2, {"model": "lstm"})
+    params = init(jax.random.key(1))
+    s = istate(params, 1)
+    cue0 = jnp.asarray([[1.0, 0.0, 0.0]])
+    cue1 = jnp.asarray([[0.0, 1.0, 0.0]])
+    blank = jnp.asarray([[0.0, 0.0, 0.5]])
+    _, _, s_a = apply(params, cue0, s)
+    _, _, s_b = apply(params, cue1, s)
+    la, _, _ = apply(params, blank, s_a)
+    lb, _, _ = apply(params, blank, s_b)
+    assert not np.allclose(np.asarray(la), np.asarray(lb))
+
+
+def test_recurrent_ppo_lstm_solves_memory_mlp_fails():
+    """The verdict's acceptance bar: an LSTM policy solves a task the
+    MLP cannot (cue at t=0, act on it at the end)."""
+
+    def run(model, iters):
+        algo = RecurrentPPOConfig().training(
+            model={"model": model}, seed=1).build()
+        for _ in range(iters):
+            r = algo.train()
+        return r["episode_reward_mean"]
+
+    # Chance is 0.5. LSTM should be near-perfect; MLP near chance.
+    assert run("lstm", 150) > 0.9
+    assert run("mlp", 60) < 0.7
+
+
+def test_memory_chain_env_semantics():
+    env = MemoryChain()
+    s = env.reset(jax.random.key(0))
+    obs = env.obs(s)
+    assert float(obs[:2].sum()) == 1.0  # cue visible at t=0
+    s2, obs2, r, done = env.step(s, jnp.asarray(0), jax.random.key(1))
+    assert float(obs2[:2].sum()) == 0.0  # hidden afterwards
+    assert not bool(done)
+
+
+def test_ppo_jax_env_evaluation_nested():
+    from ray_tpu.rllib.ppo import PPOConfig
+
+    algo = (PPOConfig()
+            .rollouts(num_envs=16, rollout_length=32)
+            .evaluation(evaluation_interval=2)
+            .debugging(seed=0)
+            .build())
+    r1 = algo.train()
+    assert "evaluation" not in r1  # interval=2: not yet
+    r2 = algo.train()
+    assert "evaluation" in r2
+    ev = r2["evaluation"]
+    assert ev["episodes_this_eval"] >= 1
+    assert "episode_reward_mean" in ev
+
+
+def test_es_improves_cartpole():
+    from ray_tpu.rllib.es import ESConfig
+
+    algo = ESConfig().training(
+        population=64, episode_length=200, seed=3).build()
+    first = algo.train()["episode_reward_mean"]
+    for _ in range(14):
+        last = algo.train()
+    assert last["episode_reward_mean"] > max(2 * first, 150.0), (
+        first, last["episode_reward_mean"])
+
+
+def test_es_save_restore_roundtrip():
+    from ray_tpu.rllib.es import ESConfig
+
+    algo = ESConfig().training(population=16, episode_length=50).build()
+    algo.train()
+    snap = algo.save()
+    algo2 = ESConfig().training(population=16, episode_length=50).build()
+    algo2.restore(snap)
+    assert np.allclose(np.asarray(algo2._flat), snap["flat"])
+    assert algo2._iteration == snap["iteration"]
