@@ -28,7 +28,172 @@ import os
 import zipfile
 
 KV_PREFIX = "rtenv:pkg:"
-_ALLOWED_KEYS = {"env_vars", "working_dir", "py_modules", "pip"}
+_BASE_KEYS = {"env_vars", "working_dir", "py_modules"}
+
+
+class RuntimeEnvPlugin:
+    """Extension point for runtime_env fields (reference
+    ``python/ray/_private/runtime_env/plugin.py``: plugins own one env
+    key each; the agent calls them in priority order to set an env up).
+
+    Lifecycle:
+      * ``validate(value)`` — driver-side, at options time;
+      * ``package(value, kv_put)`` — driver-side: upload any content to
+        the cluster KV, return the SHIPPABLE resolved value (must be
+        JSON-serializable — it is hashed into ``env_key``, which also
+        keys the node agents' worker pools);
+      * ``ensure_local(value, ctx)`` — node-side, once per env per node
+        (then cached by env_key): materialize state under
+        ``ctx["cache_root"]`` and mutate the worker recipe
+        ``ctx["recipe"]`` ({"env_vars", "cwd", "py_paths", "python"}).
+    """
+
+    #: The runtime_env dict key this plugin owns.
+    name: str = ""
+    #: Node-side setup order (lower runs first).
+    priority: int = 10
+
+    def validate(self, value) -> None:
+        pass
+
+    def package(self, value, kv_put):
+        return value
+
+    def ensure_local(self, value, ctx: dict) -> None:
+        pass
+
+
+_PLUGINS: dict = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    """Register a plugin cluster-wide for this process (drivers validate
+    + package with it; node agents must have it registered too — ship it
+    via ``py_modules`` or install it on the image)."""
+    if not plugin.name or plugin.name in _BASE_KEYS:
+        raise ValueError(f"invalid plugin name {plugin.name!r}")
+    _PLUGINS[plugin.name] = plugin
+
+
+class PipPlugin(RuntimeEnvPlugin):
+    """Per-requirements-hash virtualenvs (runtime_env/pip.py analog)."""
+
+    name = "pip"
+    priority = 0  # the interpreter choice must precede everything else
+
+    def validate(self, value) -> None:
+        _pip_list({"pip": value})
+
+    def package(self, value, kv_put):
+        return _pip_list({"pip": value})
+
+    def ensure_local(self, value, ctx: dict) -> None:
+        if value:
+            ctx["recipe"]["python"] = _ensure_venv(
+                value, ctx["cache_root"])
+
+
+class CondaPlugin(RuntimeEnvPlugin):
+    """Conda environments (runtime_env/conda.py analog): an env spec
+    dict ({"dependencies": [...]}) or an existing env name/prefix.
+    Requires the ``conda`` binary on the node; absent, the env fails at
+    setup with a clear error — or, with RAY_TPU_CONDA_DRY_RUN=1, the
+    plugin records what it WOULD build and leaves the default
+    interpreter in place (CI boxes without conda)."""
+
+    name = "conda"
+    priority = 0
+
+    def validate(self, value) -> None:
+        if not isinstance(value, (str, dict)):
+            raise TypeError(
+                "runtime_env['conda'] must be an env name/prefix or a "
+                "spec dict")
+
+    def package(self, value, kv_put):
+        return value
+
+    def ensure_local(self, value, ctx: dict) -> None:
+        import shutil
+        import subprocess
+
+        digest = hashlib.sha256(
+            json.dumps(value, sort_keys=True).encode()).hexdigest()[:16]
+        conda = shutil.which("conda")
+        if conda is None:
+            if os.environ.get("RAY_TPU_CONDA_DRY_RUN"):
+                marker = os.path.join(
+                    ctx["cache_root"], f"conda-dryrun-{digest}")
+                with open(marker, "w") as f:
+                    json.dump(value, f)
+                return
+            raise RuntimeError(
+                "runtime_env['conda'] requires the conda binary on the "
+                "node (not installed); use pip instead or set "
+                "RAY_TPU_CONDA_DRY_RUN=1 to validate without it")
+        if isinstance(value, str):
+            # Existing env by name/prefix.
+            prefix = value if os.path.isdir(value) else None
+            argv = ["conda", "run"] + (
+                ["-p", prefix] if prefix else ["-n", value]
+            ) + ["python", "-c", "import sys; print(sys.executable)"]
+            out = subprocess.run(argv, capture_output=True, text=True)
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"conda env {value!r} unusable: {out.stderr[-400:]}")
+            ctx["recipe"]["python"] = out.stdout.strip()
+            return
+        prefix = os.path.join(ctx["cache_root"], f"conda-{digest}")
+        vpy = os.path.join(prefix, "bin", "python")
+        if not os.path.exists(vpy):
+            spec_file = prefix + ".yml"
+            with open(spec_file, "w") as f:
+                json.dump(value, f)
+            out = subprocess.run(
+                ["conda", "env", "create", "-p", prefix,
+                 "-f", spec_file, "-y"],
+                capture_output=True, text=True)
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"conda env create failed: {out.stderr[-800:]}")
+        ctx["recipe"]["python"] = vpy
+
+
+class ContainerPlugin(RuntimeEnvPlugin):
+    """Container image envs (runtime_env/container.py analog) — STUB:
+    validated and hashed into env_key so pools key correctly, but
+    worker-in-container launch needs a container runtime this node
+    plane doesn't drive yet. Setup fails with a clear error (or records
+    a dry-run marker under RAY_TPU_CONTAINER_DRY_RUN=1)."""
+
+    name = "container"
+    priority = 0
+
+    def validate(self, value) -> None:
+        if not (isinstance(value, dict) and
+                isinstance(value.get("image"), str)):
+            raise TypeError(
+                "runtime_env['container'] must be {'image': str, ...}")
+
+    def ensure_local(self, value, ctx: dict) -> None:
+        if os.environ.get("RAY_TPU_CONTAINER_DRY_RUN"):
+            marker = os.path.join(
+                ctx["cache_root"],
+                "container-dryrun-" + hashlib.sha256(
+                    json.dumps(value, sort_keys=True).encode()
+                ).hexdigest()[:16])
+            with open(marker, "w") as f:
+                json.dump(value, f)
+            return
+        raise RuntimeError(
+            "runtime_env['container'] is not supported on this node "
+            "(no container runtime integration); set "
+            "RAY_TPU_CONTAINER_DRY_RUN=1 to validate the spec only")
+
+
+register_plugin(PipPlugin())
+register_plugin(CondaPlugin())
+register_plugin(ContainerPlugin())
 
 
 def _pip_list(env: dict) -> list:
@@ -50,11 +215,12 @@ def _pip_list(env: dict) -> list:
 def validate(env: dict) -> None:
     if not isinstance(env, dict):
         raise TypeError(f"runtime_env must be a dict, got {type(env)}")
-    unknown = set(env) - _ALLOWED_KEYS
+    allowed = _BASE_KEYS | set(_PLUGINS)
+    unknown = set(env) - allowed
     if unknown:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(unknown)}; "
-            f"supported: {sorted(_ALLOWED_KEYS)}"
+            f"supported: {sorted(allowed)}"
         )
     ev = env.get("env_vars") or {}
     if not all(isinstance(k, str) and isinstance(v, str)
@@ -66,7 +232,9 @@ def validate(env: dict) -> None:
     for m in env.get("py_modules") or []:
         if not os.path.exists(m):
             raise ValueError(f"runtime_env py_module {m!r} does not exist")
-    _pip_list(env)
+    for name, plugin in _PLUGINS.items():
+        if name in env:
+            plugin.validate(env[name])
 
 
 def _zip_path(root: str) -> bytes:
@@ -116,7 +284,10 @@ def package(env: dict, kv_put) -> dict:
         upload(env["working_dir"], "working_dir")
     for m in env.get("py_modules") or []:
         upload(m, "py_module")
-    resolved["pip"] = _pip_list(env)
+    for name, plugin in _PLUGINS.items():
+        if name in env:
+            resolved[name] = plugin.package(env[name], kv_put)
+    resolved.setdefault("pip", [])  # wire-shape compat
     resolved["env_key"] = env_key(resolved)
     return resolved
 
@@ -126,7 +297,11 @@ def env_key(resolved: dict) -> str:
         {"env_vars": resolved.get("env_vars", {}),
          "packages": [(p["uri"], p["kind"]) for p in
                       resolved.get("packages", [])],
-         "pip": resolved.get("pip", [])},
+         # Every plugin's resolved value keys the env (and with it the
+         # node agents' worker pools): two tasks with different plugin
+         # state can never share a worker process.
+         "plugins": {name: resolved.get(name) for name in sorted(_PLUGINS)
+                     if resolved.get(name) is not None}},
         sort_keys=True,
     )
     return hashlib.sha256(canon.encode()).hexdigest()[:16]
@@ -214,8 +389,6 @@ def ensure_local(resolved: dict, kv_get, cache_root: str) -> dict:
     cwd = None
     py_paths: list[str] = []
     python = None
-    if resolved.get("pip"):
-        python = _ensure_venv(resolved["pip"], cache_root)
     for pkg in resolved.get("packages", []):
         dest = os.path.join(cache_root, pkg["uri"])
         if not os.path.isdir(dest):
@@ -240,5 +413,20 @@ def ensure_local(resolved: dict, kv_get, cache_root: str) -> dict:
             py_paths.append(cwd)
         else:  # py_module: importable from the cache dir holding it
             py_paths.append(dest)
-    return {"env_vars": env_vars, "cwd": cwd, "py_paths": py_paths,
-            "python": python}
+    recipe = {"env_vars": env_vars, "cwd": cwd, "py_paths": py_paths,
+              "python": python}
+    known = _BASE_KEYS | set(_PLUGINS) | {"packages", "env_key", "pip"}
+    for key in resolved:
+        if key not in known and resolved[key]:
+            # A plugin the driver had but this node doesn't: running the
+            # task without its env state would be silent corruption.
+            raise RuntimeError(
+                f"runtime_env field {key!r} has no registered plugin on "
+                f"this node (register it in the agent process or ship "
+                f"it via py_modules)")
+    ctx = {"kv_get": kv_get, "cache_root": cache_root, "recipe": recipe}
+    for plugin in sorted(_PLUGINS.values(), key=lambda p: p.priority):
+        value = resolved.get(plugin.name)
+        if value:
+            plugin.ensure_local(value, ctx)
+    return recipe

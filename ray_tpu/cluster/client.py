@@ -359,9 +359,15 @@ class ClusterBackend:
                 try:
                     self.head.call("add_locations", locs)
                 except (ConnectionLost, OSError):
+                    # Restore EVERYTHING popped — the ref batches too:
+                    # dropping them would leak holders (lost removes) or
+                    # free held objects (lost adds), same invariant as
+                    # the ref_update failure path below.
                     with self._ref_lock:
                         if not self._closed:
                             self._loc_dirty = locs + self._loc_dirty
+                            self._dirty_add.update(add)
+                            self._dirty_remove.update(remove)
                     return  # keep add-before-remove ordering on retry
             try:
                 self.head.call("ref_update", self.client_id, add, remove)
@@ -1504,8 +1510,12 @@ class ClusterBackend:
         for nid, e in (view or {}).items():
             if nid == self.node_id or not e.get("address"):
                 continue
-            if now - e.get("ts", 0.0) > 5.0:
-                continue  # stale gossip: not a safe placement basis
+            # Staleness gate is generous (gossip cadence stretches with
+            # cluster size): the peer's LEASED admission is the real
+            # correctness check — stale availability just costs a
+            # rejected push and a head fallback.
+            if now - e.get("ts", 0.0) > 10.0:
+                continue
             avail[nid] = dict(e.get("available") or {})
             addr_of[nid] = e["address"]
         if not avail:

@@ -94,6 +94,10 @@ class NodeAgent:
             config.worker_min_pool,
             int(node_res.get("CPU", 4)) * config.workers_per_cpu,
         )
+        # Set BEFORE the dispatch thread starts: _checkout_worker touches
+        # these, and a task can dispatch while __init__ is still running.
+        self._prestart_target = 0
+        self._replenish_evt = threading.Event()
         # Materialized runtime-env package cache (per node, content-hashed).
         self._rtenv_cache_root = f"/tmp/ray_tpu_rtenv_{session}"
         os.makedirs(self._rtenv_cache_root, exist_ok=True)
@@ -169,7 +173,6 @@ class NodeAgent:
             self._max_workers,
         )
         self._prestart_target = n_prestart
-        self._replenish_evt = threading.Event()
         if n_prestart > 0:
             threading.Thread(
                 target=self._prestart_workers, args=(n_prestart,),
@@ -230,6 +233,13 @@ class NodeAgent:
                     self._return_worker(w)
             except (OSError, RuntimeError):
                 return  # prestart is an optimization, never fatal
+            # Space the forks out: since workers stopped pre-importing
+            # jax, forks complete in ~0.3s and N agents' prestarts
+            # otherwise compress into one interpreter storm exactly when
+            # a mass cluster boot needs the CPU (the slow-fork era
+            # staggered this by accident).
+            if self._shutdown.wait(config.worker_prestart_spacing_s):
+                return
 
     # -- worker pool ------------------------------------------------------
 
@@ -711,8 +721,41 @@ class NodeAgent:
             else:
                 w.client.call("push_task", spec)
         except Exception as e:  # worker died between checkout and push
-            self._release_current(w)
-            self._on_worker_failure(w, f"dispatch failed: {e}")
+            # The task never STARTED on the corpse, so retrying with a
+            # fresh worker is always safe (unlike a mid-execution death,
+            # which _on_worker_failure handles with retry budgets). A
+            # pooled worker can die in this window legitimately: its
+            # agent-death watchdog fires under extreme load, the OOM
+            # killer picks it, an operator kills the pid.
+            # CLAIM the task atomically against the reap loop: whoever
+            # pops current_task owns the spec's fate — without this, the
+            # reaper could fail the refs while we requeue (spurious error
+            # + duplicate execution).
+            with self._lock:
+                current = w.current_task
+                w.current_task = None
+            if current is not None and not current["released"]:
+                current["released"] = True
+                current["pool"].release(current["demand"])
+            retries = spec.setdefault("_dispatch_retries", 0)
+            if current is not None and not spec.get("actor_create") \
+                    and retries < 2:
+                spec["_dispatch_retries"] = retries + 1
+                self._record_task(spec, "PENDING")
+                with self._queue_cv:
+                    self._commit_locked(spec)
+                    self._task_queue.append(spec)
+                    self._queue_cv.notify()
+                self._on_worker_failure(w, f"dispatch failed: {e}",
+                                        requeued=True)
+            elif current is not None:
+                self._on_worker_failure(w, f"dispatch failed: {e}")
+                self._fail_task(spec, f"worker died: dispatch failed: {e}")
+            else:
+                # The reaper claimed it first and already settled the
+                # task's fate; just make sure the corpse is cleaned up.
+                self._on_worker_failure(w, f"dispatch failed: {e}",
+                                        requeued=True)
 
     @staticmethod
     def _release_current(w: _Worker):
@@ -869,13 +912,17 @@ class NodeAgent:
             w.proc.kill()
         return True
 
-    def _on_worker_failure(self, w: _Worker, cause: str):
+    def _on_worker_failure(self, w: _Worker, cause: str,
+                           requeued: bool = False):
+        """Clean up a dead worker. ``requeued``: the caller already put
+        the task back on the queue (pre-start death), so its refs must
+        NOT be failed here."""
         with self._lock:
             self._workers.pop(w.worker_id, None)
             pool = self._idle.get(w.env_key)
             if pool is not None and w in pool:
                 pool.remove(w)
-            current = w.current_task
+            current = None if requeued else w.current_task
             w.current_task = None
         if w.proc.poll() is None:
             w.proc.kill()
@@ -1266,8 +1313,25 @@ class NodeAgent:
         import random
 
         tick = 0
-        while not self._shutdown.wait(config.gossip_interval_s):
+        interval = config.gossip_interval_s
+        while not self._shutdown.wait(interval):
             tick += 1
+            # Adaptive cadence: anti-entropy converges in O(log n) rounds
+            # regardless of interval, so large clusters don't need a
+            # faster drum — but n agents x fanout at a fixed 0.5s means
+            # O(n) cluster-wide RPCs/s, which measurably drags small
+            # shared-core deployments (and the 1-core CI box). Stretch
+            # the interval with peer count; freshness consumers gate on
+            # entry ts anyway.
+            with self._lock:
+                n_peers = max(0, len(self._cluster_view) - 1)  # minus self
+            # Capped stretch: entries must stay fresher than the
+            # spillback consumer's staleness gate (client.py
+            # _spill_to_peers, 10s) even after O(log n) propagation hops
+            # — unbounded growth would silently disable peer spillback
+            # at exactly the scale gossip exists for.
+            interval = config.gossip_interval_s * min(
+                8.0, max(1.0, n_peers / 4.0))
             mine = self._my_view_entry()
             with self._lock:
                 self._cluster_view[self.node_id] = mine

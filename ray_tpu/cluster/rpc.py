@@ -426,8 +426,26 @@ class RpcClient:
         try:
             # Connect inside the ConnectionLost mapping: a refused
             # reconnect (server restarting) must feed the retry window,
-            # not escape it as a bare OSError.
-            conn = self._conn()
+            # not escape it as a bare OSError. LOOPBACK connect TIMEOUTS
+            # get bounded retries: on localhost a timeout means the
+            # server's accept loop is CPU-starved (fork storms on a
+            # shared-core box), not that the peer is gone, and no request
+            # was sent so retrying is safe. Remote-host timeouts fail
+            # fast like refusals — a crashed/partitioned HOST times out
+            # rather than refusing, and tripling failover latency for
+            # every dead peer (gossip, spillback, owner polls) would
+            # multiply across their single-threaded consumers.
+            retry_connect = self.address.startswith(
+                ("127.", "localhost:"))
+            conn = None
+            for attempt in range(3 if retry_connect else 1):
+                try:
+                    conn = self._conn()
+                    break
+                except (socket.timeout, TimeoutError):
+                    if not retry_connect or attempt == 2:
+                        raise
+                    time.sleep(0.5 * (attempt + 1))
         except OSError as e:
             raise ConnectionLost(
                 f"connect to {self.address}: {e}") from e

@@ -42,6 +42,10 @@ _DEFS: dict[str, tuple[type, Any]] = {
     # 0 disables. The delay keeps mass cluster boots from fork-storming.
     "worker_prestart_per_cpu": (float, 1.0),
     "worker_prestart_delay_s": (float, 2.0),
+    # Pause between consecutive prestart forks (per agent): keeps a mass
+    # cluster boot's fork storm off the CPU exactly when node
+    # registration needs it.
+    "worker_prestart_spacing_s": (float, 1.0),
     # Comma-separated substrings: PYTHONPATH entries matching any are
     # stripped from WORKER processes so site hooks that pre-import heavy
     # frameworks at interpreter startup (a TPU plugin's sitecustomize

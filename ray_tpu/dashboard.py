@@ -146,6 +146,12 @@ class Dashboard:
                 payload, default=str).encode()
 
         if route == "/":
+            # Web frontend (dashboard/client analog): self-contained SPA
+            # polling the same /api routes; no build step, no assets.
+            from ray_tpu.dashboard_ui import INDEX_HTML
+
+            return 200, "text/html", INDEX_HTML.encode()
+        if route == "/status":
             return 200, "text/html", self._index_html().encode()
         if route == "/api/cluster_status":
             return ok_json(self._cluster_status())

@@ -1,0 +1,307 @@
+"""Dashboard web frontend: a single-file SPA over the REST API.
+
+Reference parity: ``dashboard/client/`` — the reference ships a React/TS
+client built to static assets the dashboard server serves. Same
+architecture here at a sane scope: one self-contained HTML+JS page
+(no build step, no dependencies) that polls the same ``/api/...`` routes
+a human would otherwise curl, with tabs for cluster / nodes / actors /
+tasks / objects / placement groups / jobs / serve and a live log tail
+(cursor-incremental, ``/api/logs`` long-poll analog). All rendering goes
+through ``textContent`` — cluster-user-controlled strings (names,
+addresses, log lines) are never interpolated as HTML.
+"""
+
+INDEX_HTML = r"""<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  :root { --bg:#101418; --panel:#1a2026; --fg:#d8dee6; --dim:#8b98a5;
+          --acc:#4fa3ff; --ok:#39c07b; --bad:#e25d5d; --warn:#e2b33d; }
+  body { margin:0; background:var(--bg); color:var(--fg);
+         font:13px/1.5 system-ui, sans-serif; }
+  header { display:flex; align-items:baseline; gap:16px;
+           padding:10px 16px; background:var(--panel);
+           border-bottom:1px solid #2a323a; }
+  header h1 { font-size:15px; margin:0; }
+  header .dim { color:var(--dim); font-size:12px; }
+  nav { display:flex; gap:2px; padding:0 12px; background:var(--panel); }
+  nav button { background:none; border:none; color:var(--dim);
+               padding:8px 12px; cursor:pointer; font:inherit;
+               border-bottom:2px solid transparent; }
+  nav button.active { color:var(--fg); border-color:var(--acc); }
+  main { padding:14px 16px; }
+  .tiles { display:flex; flex-wrap:wrap; gap:10px; margin-bottom:14px; }
+  .tile { background:var(--panel); border:1px solid #2a323a;
+          border-radius:6px; padding:10px 14px; min-width:130px; }
+  .tile .v { font-size:20px; font-weight:600; }
+  .tile .k { color:var(--dim); font-size:11px;
+             text-transform:uppercase; letter-spacing:.05em; }
+  table { border-collapse:collapse; width:100%; background:var(--panel);
+          border:1px solid #2a323a; }
+  th, td { text-align:left; padding:5px 10px;
+           border-bottom:1px solid #242c34; font-size:12.5px; }
+  th { color:var(--dim); font-weight:500; position:sticky; top:0;
+       background:var(--panel); }
+  td.mono, .mono { font-family:ui-monospace, monospace; font-size:12px; }
+  .ALIVE, .FINISHED, .RUNNING_OK, .ok { color:var(--ok); }
+  .DEAD, .FAILED, .ERROR, .bad { color:var(--bad); }
+  .PENDING, .RESTARTING, .warn { color:var(--warn); }
+  #logs { background:#0b0e11; border:1px solid #2a323a; padding:10px;
+          height:60vh; overflow-y:auto; white-space:pre-wrap;
+          font-family:ui-monospace, monospace; font-size:12px; }
+  .err { color:var(--bad); padding:8px 0; }
+  input[type=text] { background:#0b0e11; color:var(--fg);
+          border:1px solid #2a323a; border-radius:4px; padding:4px 8px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <span class="dim" id="addr"></span>
+  <span class="dim" id="updated"></span>
+  <span class="err" id="error"></span>
+</header>
+<nav id="tabs"></nav>
+<main>
+  <div class="tiles" id="tiles"></div>
+  <div id="view"></div>
+</main>
+<script>
+"use strict";
+const TABS = ["cluster", "nodes", "actors", "tasks", "objects",
+              "placement_groups", "jobs", "serve", "logs"];
+let active = location.hash.slice(1) || "cluster";
+let logCursor = 0;
+const logBuf = [];
+
+const $ = (id) => document.getElementById(id);
+
+function el(tag, cls, text) {
+  const e = document.createElement(tag);
+  if (cls) e.className = cls;
+  if (text !== undefined) e.textContent = String(text);
+  return e;
+}
+
+function table(cols, rows, cellFn) {
+  const t = el("table");
+  const tr = el("tr");
+  cols.forEach(c => tr.appendChild(el("th", "", c)));
+  t.appendChild(tr);
+  rows.forEach(r => {
+    const row = el("tr");
+    cols.forEach(c => row.appendChild(cellFn(r, c)));
+    t.appendChild(row);
+  });
+  return t;
+}
+
+function stateCell(v) {
+  const td = el("td", /^[A-Z_]+$/.test(String(v)) ? String(v) : "", v);
+  return td;
+}
+
+async function api(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(path + " -> " + r.status);
+  return r.json();
+}
+
+function setTiles(items) {
+  const box = $("tiles");
+  box.replaceChildren();
+  items.forEach(([k, v, cls]) => {
+    const t = el("div", "tile");
+    t.appendChild(el("div", "v " + (cls || ""), v));
+    t.appendChild(el("div", "k", k));
+    box.appendChild(t);
+  });
+}
+
+function short(id) { return id && id.length > 14 ? id.slice(0, 14) + "…" : id; }
+
+const RENDER = {
+  async cluster() {
+    const s = await api("/api/cluster_status");
+    const res = s.resources_total || {}, avail = s.resources_available || {};
+    setTiles([
+      ["nodes alive", s.alive_nodes ?? "?",
+       (s.dead_nodes || 0) > 0 ? "warn" : "ok"],
+      ["nodes dead", s.dead_nodes ?? 0,
+       (s.dead_nodes || 0) > 0 ? "bad" : ""],
+      ["CPU avail / total", `${avail.CPU ?? "?"} / ${res.CPU ?? "?"}`],
+      ["head", s.head_address ?? "?"],
+    ]);
+    const rows = Object.entries(s).map(([k, v]) => ({k, v}));
+    $("view").replaceChildren(table(["field", "value"], rows, (r, c) => {
+      if (c === "field") return el("td", "", r.k);
+      const td = el("td", "mono");
+      td.textContent = typeof r.v === "object"
+        ? JSON.stringify(r.v) : String(r.v);
+      return td;
+    }));
+  },
+  async nodes() {
+    const d = await api("/api/nodes");
+    $("view").replaceChildren(table(
+      ["NodeID", "Address", "Alive", "Resources", "StorePath"],
+      d.nodes || [], (r, c) => {
+        if (c === "Alive") return stateCell(r.Alive ? "ALIVE" : "DEAD");
+        if (c === "Resources") {
+          const td = el("td", "mono");
+          td.textContent = JSON.stringify(r.Resources || r.resources || {});
+          return td;
+        }
+        const td = el("td", c === "NodeID" ? "mono" : "");
+        td.textContent = c === "NodeID" ? short(r[c]) : (r[c] ?? "");
+        return td;
+      }));
+  },
+  async actors() {
+    const d = await api("/api/actors");
+    $("view").replaceChildren(table(
+      ["actor_id", "class_name", "name", "state", "node_id", "pid",
+       "num_restarts"],
+      d.actors || [], (r, c) => {
+        if (c === "state") return stateCell(r.state);
+        const td = el("td",
+          (c === "actor_id" || c === "node_id") ? "mono" : "");
+        td.textContent = (c === "actor_id" || c === "node_id")
+          ? short(r[c] || "") : (r[c] ?? "");
+        return td;
+      }));
+  },
+  async tasks() {
+    const d = await api("/api/tasks?limit=500");
+    const tasks = d.tasks || [];
+    const byState = {};
+    tasks.forEach(t => { byState[t.state] = (byState[t.state] || 0) + 1; });
+    setTiles(Object.entries(byState).map(([k, v]) =>
+      [k.toLowerCase(), v, k === "FAILED" ? "bad" : ""]));
+    $("view").replaceChildren(table(
+      ["task_id", "name", "type", "state", "node_id", "error"],
+      tasks, (r, c) => {
+        if (c === "state") return stateCell(r.state);
+        const td = el("td",
+          (c === "task_id" || c === "node_id") ? "mono" : "");
+        td.textContent = (c === "task_id" || c === "node_id")
+          ? short(r[c] || "") : (r[c] ?? "");
+        return td;
+      }));
+  },
+  async objects() {
+    const d = await api("/api/objects?limit=500");
+    $("view").replaceChildren(table(
+      ["object_id", "size", "locations", "error"],
+      d.objects || [], (r, c) => {
+        const td = el("td", c === "object_id" ? "mono" : "");
+        if (c === "locations")
+          td.textContent = (r.locations || []).map(short).join(", ");
+        else td.textContent = c === "object_id"
+          ? short(r[c] || "") : (r[c] ?? "");
+        return td;
+      }));
+  },
+  async placement_groups() {
+    const d = await api("/api/placement_groups");
+    let pgs = d.placement_groups || [];
+    if (!Array.isArray(pgs))  // head returns {pg_id: info}
+      pgs = Object.entries(pgs).map(([id, info]) =>
+        ({pg_id: id, ...info}));
+    $("view").replaceChildren(table(
+      ["pg_id", "name", "state", "strategy", "bundles"],
+      pgs, (r, c) => {
+        if (c === "state") return stateCell(r.state);
+        const td = el("td", c === "pg_id" ? "mono" : "");
+        if (c === "bundles")
+          td.textContent = JSON.stringify(r.bundles || []);
+        else td.textContent = c === "pg_id"
+          ? short(r.pg_id || r.id || "") : (r[c] ?? "");
+        return td;
+      }));
+  },
+  async jobs() {
+    const d = await api("/api/jobs");
+    const jobs = d.jobs || [];
+    $("view").replaceChildren(table(
+      ["job_id", "status", "entrypoint", "message"],
+      jobs, (r, c) => {
+        if (c === "status") return stateCell(r.status);
+        const td = el("td", c === "job_id" ? "mono" : "");
+        td.textContent = r[c] ?? "";
+        return td;
+      }));
+  },
+  async serve() {
+    const d = await api("/api/serve/applications");
+    const apps = d.applications || {};
+    const rows = Object.entries(apps).flatMap(([app, info]) =>
+      (info.deployments ? Object.entries(info.deployments) : [["", info]])
+        .map(([dep, di]) => ({app, dep, info: di})));
+    $("view").replaceChildren(table(
+      ["application", "deployment", "detail"],
+      rows, (r, c) => {
+        if (c === "application") return el("td", "", r.app);
+        if (c === "deployment") return el("td", "", r.dep);
+        const td = el("td", "mono");
+        td.textContent = JSON.stringify(r.info);
+        return td;
+      }));
+  },
+  async logs() {
+    if (!$("logs")) {
+      const pre = el("div"); pre.id = "logs";
+      $("view").replaceChildren(pre);
+      logBuf.forEach(line => pre.appendChild(el("div", "", line)));
+    }
+    const d = await api(`/api/logs?after_seq=${logCursor}&limit=500`);
+    logCursor = d.cursor ?? logCursor;
+    const pre = $("logs");
+    (d.entries || []).forEach(e => {
+      const line = typeof e === "string" ? e
+        : `[${e.pid ?? "?"}@${short(e.node_id || "")}] ${e.line ?? JSON.stringify(e)}`;
+      logBuf.push(line);
+      pre.appendChild(el("div", "", line));
+    });
+    while (logBuf.length > 3000) { logBuf.shift(); pre.firstChild.remove(); }
+    pre.scrollTop = pre.scrollHeight;
+  },
+};
+
+function buildTabs() {
+  const nav = $("tabs");
+  TABS.forEach(t => {
+    const b = el("button", t === active ? "active" : "", t.replace("_", " "));
+    b.onclick = () => {
+      active = t; location.hash = t;
+      [...nav.children].forEach(x => x.classList.remove("active"));
+      b.classList.add("active");
+      if (t !== "logs") $("view").replaceChildren();
+      setTiles([]);
+      refresh();
+    };
+    nav.appendChild(b);
+  });
+}
+
+async function refresh() {
+  try {
+    await RENDER[active]();
+    $("error").textContent = "";
+    $("updated").textContent =
+      "updated " + new Date().toLocaleTimeString();
+  } catch (e) {
+    $("error").textContent = String(e);
+  }
+}
+
+buildTabs();
+$("addr").textContent = location.host;
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -37,6 +37,7 @@ from ray_tpu.rllib.connectors import (
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, make_vec_env
 from ray_tpu.rllib.env import Pendulum
+from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.multi_agent import (
     MultiAgentEnv,
@@ -50,6 +51,14 @@ from ray_tpu.rllib.offline import (
     OfflineDQN,
     collect_transitions,
     read_sample_batches,
+)
+from ray_tpu.rllib.es import ES, ESConfig
+from ray_tpu.rllib.evaluation import EvalWorker, EvaluationWorkerSet
+from ray_tpu.rllib.models import ModelCatalog
+from ray_tpu.rllib.recurrent import (
+    MemoryChain,
+    RecurrentPPO,
+    RecurrentPPOConfig,
 )
 from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.td3 import TD3, TD3Config
@@ -73,8 +82,18 @@ __all__ = [
     "make_vec_env",
     "DQN",
     "DQNConfig",
+    "APPO",
+    "APPOConfig",
+    "ES",
+    "ESConfig",
+    "EvalWorker",
+    "EvaluationWorkerSet",
     "IMPALA",
     "IMPALAConfig",
+    "MemoryChain",
+    "ModelCatalog",
+    "RecurrentPPO",
+    "RecurrentPPOConfig",
     "SAC",
     "SACConfig",
     "Pendulum",

@@ -1,0 +1,28 @@
+"""APPO: Asynchronous Proximal Policy Optimization.
+
+Reference parity: ``rllib/algorithms/appo`` — the IMPALA actor-learner
+architecture (stale behavior snapshots, V-trace off-policy correction)
+with PPO's clipped surrogate as the policy loss, bounding how far one
+update can move the target policy from the behavior data. Implemented
+exactly the way the reference does it: a thin specialization of IMPALA
+(``impala.py`` carries the shared machinery; ``surrogate="ppo_clip"``
+selects the clipped objective on V-trace advantages).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.surrogate = "ppo_clip"
+        self.clip_param = 0.3
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    """``.train()`` one iteration -> result dict (Trainable contract)."""

@@ -100,9 +100,14 @@ class EvaluationWorkerSet:
         ]
 
     def evaluate(self, params) -> Dict[str, Any]:
-        per = max(1, self.duration // len(self._workers))
+        # Distribute duration_episodes exactly: base episodes everywhere,
+        # remainder to the first workers (5 episodes / 2 workers = 3+2,
+        # not 2+2).
+        n = len(self._workers)
+        base, rem = divmod(max(self.duration, n), n)
         outs = ray_tpu.get(
-            [w.evaluate.remote(params, per) for w in self._workers],
+            [w.evaluate.remote(params, base + (1 if i < rem else 0))
+             for i, w in enumerate(self._workers)],
             timeout=300)
         returns = [r for o in outs for r in o["episode_returns"]]
         lengths = [l for o in outs for l in o["episode_lengths"]]

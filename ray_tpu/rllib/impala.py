@@ -46,6 +46,11 @@ class IMPALAConfig:
         self.rho_clip = 1.0              # V-trace rho-bar
         self.c_clip = 1.0                # V-trace c-bar
         self.max_grad_norm = 40.0
+        # Policy-gradient surrogate: "is" = plain importance-weighted PG
+        # (canonical IMPALA); "ppo_clip" = the clipped PPO surrogate on
+        # V-trace advantages (APPO, rllib/algorithms/appo).
+        self.surrogate = "is"
+        self.clip_param = 0.3
         self.seed = 0
 
     def environment(self, env=None) -> "IMPALAConfig":
@@ -147,7 +152,17 @@ def _make_pieces(cfg: IMPALAConfig):
         vs, pg_adv = vtrace(
             values, bootstrap, batch["rewards"], batch["dones"],
             logp_target, batch["logp"], cfg.gamma, cfg.rho_clip, cfg.c_clip)
-        pg_loss = -jnp.mean(logp_target * pg_adv)
+        if cfg.surrogate == "ppo_clip":
+            # APPO: PPO's clipped objective with V-trace advantages —
+            # bounds the update the stale behavior data can drive
+            # (rllib/algorithms/appo; note pg_adv already carries the
+            # rho clip, so the ratio here is target/behavior fresh).
+            from ray_tpu.rllib.optim import clipped_surrogate
+
+            pg_loss = clipped_surrogate(
+                logp_target, batch["logp"], pg_adv, cfg.clip_param)
+        else:
+            pg_loss = -jnp.mean(logp_target * pg_adv)
         vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
         entropy = -jnp.mean(
             jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))

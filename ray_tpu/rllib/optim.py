@@ -32,3 +32,16 @@ def adam_step(params, opt, grads, *, lr: float,
         params, mu, nu,
     )
     return params, {"mu": mu, "nu": nu, "t": t}
+
+
+def clipped_surrogate(logp, logp_old, adv, clip_param: float,
+                      normalize: bool = True):
+    """PPO's clipped policy-gradient surrogate (one copy for
+    ppo/recurrent/appo): -E[min(r*A, clip(r, 1-eps, 1+eps)*A)] with
+    advantages standardized over the batch."""
+    if normalize:
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    ratio = jnp.exp(logp - logp_old)
+    pg1 = ratio * adv
+    pg2 = jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv
+    return -jnp.mean(jnp.minimum(pg1, pg2))

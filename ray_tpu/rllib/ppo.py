@@ -204,12 +204,10 @@ def _make_train_iter(cfg: PPOConfig):
         logp = jnp.take_along_axis(
             logp_all, batch["actions"][:, None], axis=1
         )[:, 0]
-        ratio = jnp.exp(logp - batch["logp"])
-        adv = batch["adv"]
-        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-        pg1 = ratio * adv
-        pg2 = jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv
-        pg_loss = -jnp.mean(jnp.minimum(pg1, pg2))
+        from ray_tpu.rllib.optim import clipped_surrogate
+
+        pg_loss = clipped_surrogate(
+            logp, batch["logp"], batch["adv"], cfg.clip_param)
         vf_loss = jnp.mean((value - batch["returns"]) ** 2)
         entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
         total = pg_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * entropy

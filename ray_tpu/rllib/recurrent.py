@@ -180,13 +180,10 @@ class RecurrentPPO:
             logp_all = jax.nn.log_softmax(logits)        # [T, n, A]
             logp = jnp.take_along_axis(
                 logp_all, traj["actions"][..., None], axis=-1)[..., 0]
-            ratio = jnp.exp(logp - traj["logp"])
-            adv = traj["adv"]
-            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-            pg1 = ratio * adv
-            pg2 = jnp.clip(
-                ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv
-            pg_loss = -jnp.mean(jnp.minimum(pg1, pg2))
+            from ray_tpu.rllib.optim import clipped_surrogate
+
+            pg_loss = clipped_surrogate(
+                logp, traj["logp"], traj["adv"], cfg.clip_param)
             vf_loss = jnp.mean((values - traj["returns"]) ** 2)
             entropy = -jnp.mean(
                 jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))

@@ -119,7 +119,13 @@ def test_dashboard_mutations_require_token(cluster, dashboard):
 
 
 def test_dashboard_index_and_404(dashboard):
+    # "/" serves the SPA frontend (dashboard/client analog); "/status"
+    # keeps the server-rendered snapshot.
     with urllib.request.urlopen(dashboard.url + "/", timeout=10) as r:
+        body = r.read()
+        assert b"ray_tpu dashboard" in body
+        assert b"/api/cluster_status" in body  # the SPA polls the API
+    with urllib.request.urlopen(dashboard.url + "/status", timeout=10) as r:
         assert b"ray_tpu cluster" in r.read()
     try:
         urllib.request.urlopen(dashboard.url + "/api/nope", timeout=10)
