@@ -232,6 +232,13 @@ def validate(env: dict) -> None:
     for m in env.get("py_modules") or []:
         if not os.path.exists(m):
             raise ValueError(f"runtime_env py_module {m!r} does not exist")
+    if "pip" in env and "conda" in env:
+        # Both want to own the worker interpreter; the later one would
+        # silently drop the other's packages (the reference rejects the
+        # combination too — put pip deps inside the conda spec instead).
+        raise ValueError(
+            "runtime_env cannot combine 'pip' and 'conda'; add pip "
+            "requirements to the conda spec's dependencies instead")
     for name, plugin in _PLUGINS.items():
         if name in env:
             plugin.validate(env[name])

@@ -429,12 +429,18 @@ class NodeAgent:
     def rpc_submit_task(self, spec: dict):
         """Enqueue a task; the dispatcher leases a worker when resources
         allow. Returns immediately (results flow through the store)."""
+        self._requeue(spec)
+        return True
+
+    def _requeue(self, spec: dict) -> None:
+        """The one queue-admission sequence (record + commit + enqueue +
+        notify) — submit, checkout-timeout retry, and dispatch-failure
+        retry must all account identically."""
         self._record_task(spec, "PENDING")
         with self._queue_cv:
             self._commit_locked(spec)
             self._task_queue.append(spec)
             self._queue_cv.notify()
-        return True
 
     def rpc_submit_tasks(self, specs: list):
         """Head-placed batch enqueue: one RPC, one queue notify."""
@@ -661,11 +667,7 @@ class NodeAgent:
                 # unbounded). Requeue rather than fail: the reference's
                 # lease request simply stays queued in this situation.
                 spec["_checkout_misses"] += 1
-                self._record_task(spec, "PENDING")
-                with self._queue_cv:
-                    self._commit_locked(spec)
-                    self._task_queue.append(spec)
-                    self._queue_cv.notify()
+                self._requeue(spec)
                 return
             # RuntimeError/OSError: runtime-env materialization failed
             # (missing package, bad zip) — surfaced as the task's error,
@@ -738,24 +740,38 @@ class NodeAgent:
                 current["released"] = True
                 current["pool"].release(current["demand"])
             retries = spec.setdefault("_dispatch_retries", 0)
-            if current is not None and not spec.get("actor_create") \
-                    and retries < 2:
-                spec["_dispatch_retries"] = retries + 1
-                self._record_task(spec, "PENDING")
-                with self._queue_cv:
-                    self._commit_locked(spec)
-                    self._task_queue.append(spec)
-                    self._queue_cv.notify()
-                self._on_worker_failure(w, f"dispatch failed: {e}",
-                                        requeued=True)
-            elif current is not None:
-                self._on_worker_failure(w, f"dispatch failed: {e}")
-                self._fail_task(spec, f"worker died: dispatch failed: {e}")
-            else:
+            if current is None:
                 # The reaper claimed it first and already settled the
                 # task's fate; just make sure the corpse is cleaned up.
                 self._on_worker_failure(w, f"dispatch failed: {e}",
                                         requeued=True)
+            elif current.get("cancelled"):
+                # A force-cancel killed the worker in this very window:
+                # the task's fate is TaskCancelledError, never a retry
+                # (the cancel marker was consumed; a requeue would run
+                # a cancelled task to completion).
+                self._on_worker_failure(w, f"dispatch failed: {e}",
+                                        requeued=True)
+                self._cancel_spec(spec)
+            elif current.get("oom_reason"):
+                from ray_tpu.core.object_ref import OutOfMemoryError
+
+                self._on_worker_failure(w, f"dispatch failed: {e}",
+                                        requeued=True)
+                self._store_task_error(
+                    spec,
+                    OutOfMemoryError(spec.get("fname", "task"),
+                                     current["oom_reason"]),
+                    "FAILED",
+                )
+            elif not spec.get("actor_create") and retries < 2:
+                spec["_dispatch_retries"] = retries + 1
+                self._requeue(spec)
+                self._on_worker_failure(w, f"dispatch failed: {e}",
+                                        requeued=True)
+            else:
+                self._on_worker_failure(w, f"dispatch failed: {e}")
+                self._fail_task(spec, f"worker died: dispatch failed: {e}")
 
     @staticmethod
     def _release_current(w: _Worker):

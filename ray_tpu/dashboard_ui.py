@@ -259,6 +259,9 @@ const RENDER = {
     const d = await api(`/api/logs?after_seq=${logCursor}&limit=500`);
     logCursor = d.cursor ?? logCursor;
     const pre = $("logs");
+    // Autoscroll ONLY when the user was already at the bottom —
+    // scrollback must survive the 2s refresh cadence.
+    const pinned = pre.scrollHeight - pre.scrollTop - pre.clientHeight < 40;
     (d.entries || []).forEach(e => {
       const line = typeof e === "string" ? e
         : `[${e.pid ?? "?"}@${short(e.node_id || "")}] ${e.line ?? JSON.stringify(e)}`;
@@ -266,7 +269,7 @@ const RENDER = {
       pre.appendChild(el("div", "", line));
     });
     while (logBuf.length > 3000) { logBuf.shift(); pre.firstChild.remove(); }
-    pre.scrollTop = pre.scrollHeight;
+    if (pinned) pre.scrollTop = pre.scrollHeight;
   },
 };
 

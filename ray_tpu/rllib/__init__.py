@@ -52,7 +52,14 @@ from ray_tpu.rllib.offline import (
     collect_transitions,
     read_sample_batches,
 )
-from ray_tpu.rllib.es import ES, ESConfig
+from ray_tpu.rllib.offline_algos import (
+    BC,
+    BCConfig,
+    CQL,
+    MARWIL,
+    MARWILConfig,
+)
+from ray_tpu.rllib.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.evaluation import EvalWorker, EvaluationWorkerSet
 from ray_tpu.rllib.models import ModelCatalog
 from ray_tpu.rllib.recurrent import (
@@ -84,6 +91,13 @@ __all__ = [
     "DQNConfig",
     "APPO",
     "APPOConfig",
+    "ARS",
+    "ARSConfig",
+    "BC",
+    "BCConfig",
+    "CQL",
+    "MARWIL",
+    "MARWILConfig",
     "ES",
     "ESConfig",
     "EvalWorker",

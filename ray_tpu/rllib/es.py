@@ -36,6 +36,12 @@ class ESConfig:
         self.episode_length = 500
         self.hidden_sizes = (32, 32)
         self.num_rollout_workers = 0
+        # Gradient estimator: "es" = rank-shaped average over the whole
+        # population (Salimans); "ars" = top-k directions by
+        # max(f+, f-), step scaled by the reward std of the survivors
+        # (Mania et al. 2018, rllib/algorithms/ars).
+        self.estimator = "es"
+        self.top_k = 0  # 0 = population/4 (ARS default-ish)
         self.seed = 0
 
     def training(self, **kw) -> "ESConfig":
@@ -175,9 +181,23 @@ class ES:
             fit_pos = vfit(flat[None] + cfg.sigma * eps, ep_keys)
             fit_neg = vfit(flat[None] - cfg.sigma * eps, ep_keys)
             fit = jnp.concatenate([fit_pos, fit_neg])
-            shaped = _centered_ranks(fit)
-            w_pos, w_neg = shaped[:half], shaped[half:]
-            grad = ((w_pos - w_neg)[:, None] * eps).mean(0) / cfg.sigma
+            if cfg.estimator == "ars":
+                # ARS V1-t: keep the top-k directions by max(f+, f-),
+                # weight by raw reward differences, scale by the
+                # surviving rewards' std (the paper's sigma_R).
+                # Clamp: there are only `half` antithetic directions; a
+                # larger user top_k would crash lax.top_k at trace time.
+                k = min(cfg.top_k or max(1, half // 4), half)
+                direction_best = jnp.maximum(fit_pos, fit_neg)
+                _, top = jax.lax.top_k(direction_best, k)
+                diff = (fit_pos - fit_neg)[top]
+                sigma_r = jnp.std(
+                    jnp.concatenate([fit_pos[top], fit_neg[top]])) + 1e-8
+                grad = (diff[:, None] * eps[top]).mean(0) / sigma_r
+            else:
+                shaped = _centered_ranks(fit)
+                w_pos, w_neg = shaped[:half], shaped[half:]
+                grad = ((w_pos - w_neg)[:, None] * eps).mean(0) / cfg.sigma
             flat = flat + cfg.lr * grad - cfg.lr * cfg.l2_coeff * flat
             return flat, fit
 
@@ -229,3 +249,23 @@ class ES:
     def restore(self, state: dict) -> None:
         self._flat = jnp.asarray(state["flat"])
         self._iteration = state["iteration"]
+
+
+class ARSConfig(ESConfig):
+    """Augmented Random Search (Mania et al. 2018;
+    ``rllib/algorithms/ars``): the ES machinery with the V1-t estimator —
+    top-k antithetic directions by max(f+, f-), raw reward-difference
+    weights, step normalized by the survivors' reward std."""
+
+    def __init__(self):
+        super().__init__()
+        self.estimator = "ars"
+        self.lr = 0.02
+        self.sigma = 0.05
+
+    def build(self) -> "ARS":
+        return ARS(self)
+
+
+class ARS(ES):
+    pass

@@ -96,6 +96,8 @@ def read_dataset(ds) -> SampleBatch:
     JSON-lines path, or a ``ray_tpu.data.Dataset`` of row dicts."""
     if isinstance(ds, str):
         batches = read_sample_batches(ds)
+    elif isinstance(ds, dict):  # a single SampleBatch / transition dict
+        batches = [SampleBatch(ds)]
     elif isinstance(ds, (list, tuple)):
         batches = [SampleBatch(b) for b in ds]
     else:  # ray_tpu.data.Dataset

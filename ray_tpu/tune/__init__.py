@@ -20,6 +20,7 @@ from ray_tpu.tune.schedulers import (
 )
 from ray_tpu.tune.search import (
     BasicVariantSearcher,
+    BOHBSearcher,
     Searcher,
     TPESearcher,
 )
@@ -88,6 +89,7 @@ __all__ = [
     "TrialScheduler",
     "FIFOScheduler",
     "ASHAScheduler",
+    "BOHBSearcher",
     "HyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",

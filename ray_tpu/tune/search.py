@@ -508,3 +508,73 @@ class TPESearcher(Searcher):
         score = self._score(result)
         if score is not None and np.isfinite(score):
             self._obs.append((_flatten(config), score))
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model-based half (Falkner et al. 2018; the reference wires
+    it as ``search/bohb/TuneBOHB`` + ``HyperBandForBOHB``): TPE
+    suggestions fit on observations grouped by BUDGET (training
+    iteration at which the score was reported), always modeling the
+    LARGEST budget that has enough observations — early-rung data guides
+    the search until enough high-budget results exist, then the model
+    upgrades to the fidelity that matters. Pair it with
+    ``HyperBandScheduler`` (the successive-halving rungs produce exactly
+    the multi-fidelity observations this models).
+
+    Unlike plain TPE (final results only), intermediate results feed the
+    model: every ``on_trial_result`` records (config, score) at that
+    budget, keeping the freshest score per trial per budget.
+    """
+
+    def __init__(self, *args, min_points_in_model: int = 8, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.min_points_in_model = min_points_in_model
+        # budget -> {trial_id: (flat_cfg, score)}
+        self._by_budget: Dict[int, Dict[str, tuple]] = {}
+
+    def _record(self, trial_id: str, result: dict) -> None:
+        flat = self._live.get(trial_id)
+        score = self._score(result)
+        if flat is None or score is None or not np.isfinite(score):
+            return
+        budget = int(result.get("training_iteration", 1))
+        self._by_budget.setdefault(budget, {})[trial_id] = (flat, score)
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        self._record(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None,
+                          error: bool = False) -> None:
+        if result is not None and not error:
+            self._record(trial_id, result)
+        self._live.pop(trial_id, None)
+
+    def _refresh_obs(self) -> None:
+        """Point self._obs at the largest budget with enough points
+        (falling back to pooling everything when no budget qualifies)."""
+        best_budget = None
+        for budget in sorted(self._by_budget, reverse=True):
+            if len(self._by_budget[budget]) >= self.min_points_in_model:
+                best_budget = budget
+                break
+        if best_budget is not None:
+            self._obs = list(self._by_budget[best_budget].values())
+        else:
+            pooled: Dict[str, tuple] = {}
+            for budget in sorted(self._by_budget):  # highest budget wins
+                pooled.update(self._by_budget[budget])
+            self._obs = list(pooled.values())
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        self._refresh_obs()
+        return super().suggest(trial_id)
+
+    def tell(self, config: Dict[str, Any], result: Optional[dict]) -> None:
+        score = self._score(result)
+        if score is not None and np.isfinite(score):
+            budget = int((result or {}).get("training_iteration", 1))
+            pool = self._by_budget.setdefault(budget, {})
+            # Budget-qualified key: the pooled fallback in _refresh_obs
+            # merges budget dicts by key, so bare counters would collide
+            # across budgets and drop distinct observations.
+            pool[f"told-b{budget}-{len(pool)}"] = (_flatten(config), score)

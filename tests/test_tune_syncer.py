@@ -134,3 +134,66 @@ def test_tuner_syncs_experiment_to_uri_and_restores(tmp_path):
     best = results2.get_best_result()
     assert best.metrics["score"] == pytest.approx(
         results.get_best_result().metrics["score"])
+
+
+def test_bohb_searcher_models_highest_ready_budget():
+    """BOHB (Falkner et al. 2018; reference search/bohb): intermediate
+    results feed per-budget observation pools; the model fits the
+    LARGEST budget with enough points, so multi-fidelity rungs guide
+    suggestions."""
+    from ray_tpu.tune import BOHBSearcher
+
+    s = BOHBSearcher(metric="score", mode="max",
+                     param_space={"x": Uniform(0, 1)},
+                     n_initial=4, min_points_in_model=6, seed=0)
+    # Budget-1 results for 10 trials: optimum near x=0.2 at low budget.
+    for t in range(10):
+        cfg = s.suggest(f"a{t}")
+        s.on_trial_result(
+            f"a{t}", {"score": -abs(cfg["x"] - 0.2),
+                      "training_iteration": 1})
+        s.on_trial_complete(
+            f"a{t}", {"score": -abs(cfg["x"] - 0.2),
+                      "training_iteration": 1})
+    s._refresh_obs()
+    assert len(s._obs) >= 6  # budget-1 pool models
+    # High-budget (iteration 9) results — e.g. promoted rungs covering
+    # the space — reveal the TRUE optimum at 0.8; once enough
+    # accumulate, the model switches to them.
+    for i, x in enumerate(np.linspace(0.05, 0.95, 8)):
+        s.tell({"x": float(x)},
+               {"score": -abs(float(x) - 0.8), "training_iteration": 9})
+    s._refresh_obs()
+    budgets = {b for b, pool in s._by_budget.items() if len(pool) >= 6}
+    assert 9 in budgets
+    assert len(s._obs) == len(s._by_budget[9])  # budget-9 pool models
+    # Suggestions now chase the high-budget optimum.
+    late = [s.suggest(f"c{i}")["x"] for i in range(8)]
+    assert np.mean([abs(x - 0.8) for x in late]) < \
+        np.mean([abs(x - 0.2) for x in late])
+
+
+def test_bohb_with_hyperband_in_tuner():
+    from ray_tpu.tune import BOHBSearcher, HyperBandScheduler
+
+    def trainable(config):
+        from ray_tpu.train import session
+
+        for i in range(8):
+            session.report(
+                {"score": config["x"] * (i + 1) / 8.0,
+                 "training_iteration": i + 1})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": Uniform(0, 1)},
+        tune_config=TuneConfig(
+            metric="score", mode="max", num_samples=12,
+            search_alg=BOHBSearcher(n_initial=4, seed=1),
+            scheduler=HyperBandScheduler(metric="score", mode="max",
+                                         max_t=8),
+        ),
+    )
+    results = tuner.fit()
+    assert len(results) == 12
+    assert results.get_best_result().metrics["score"] > 0.5
