@@ -1,0 +1,79 @@
+"""One-off TPU config sweep for the GPT-2 headline bench.
+
+Measures step time / MFU for a grid of (config, batch) points on whatever
+device is attached, printing one JSON line per point. Used to pick the
+shipped `bench.py` config; results are recorded in PROFILE.md.
+
+Run: python -m ray_tpu.scripts.tpu_sweep '[["base",16],["lever",24],...]'
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.gpt2 import (
+    GPT2Config,
+    gpt2_flops_per_token,
+    gpt2_init,
+    gpt2_loss,
+    gpt2_shardings,
+)
+from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+from ray_tpu.train.train_step import make_init_fn, make_train_step
+
+PEAK = 197.0e12  # v5e bf16
+
+
+def measure(cfg: GPT2Config, batch: int, steps: int = 20, warmup: int = 3):
+    mesh = build_mesh(MeshConfig(fsdp=-1))
+    shardings = gpt2_shardings(cfg, mesh)
+    init_fn = make_init_fn(lambda r: gpt2_init(r, cfg), shardings, mesh)
+    state = init_fn(jax.random.key(0))
+    step_fn = make_train_step(lambda p, b: gpt2_loss(p, b, cfg), shardings, mesh)
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, cfg.seq_len + 1), 0, cfg.vocab_size, jnp.int32)
+    batch_data = {"tokens": tokens}
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch_data)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch_data)
+    loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    tok_s = batch * cfg.seq_len * steps / dt
+    mfu = tok_s * gpt2_flops_per_token(cfg) / PEAK * 100.0
+    return {"tok_s": round(tok_s, 1), "mfu": round(mfu, 2),
+            "ms_step": round(dt / steps * 1000, 2), "loss": round(loss, 3)}
+
+
+def main() -> None:
+    base = GPT2Config(use_flash=True, remat="dots", scan_layers=False)
+    named = {
+        "base": base,
+        "lever": dataclasses.replace(
+            base, logits_dtype=jnp.bfloat16, ce_vocab_chunks=3),
+        "bf16_only": dataclasses.replace(base, logits_dtype=jnp.bfloat16),
+        "chunk_only": dataclasses.replace(base, ce_vocab_chunks=3),
+        "chunk6": dataclasses.replace(
+            base, logits_dtype=jnp.bfloat16, ce_vocab_chunks=6),
+    }
+    points = json.loads(sys.argv[1]) if len(sys.argv) > 1 else [
+        ["base", 16], ["lever", 24], ["lever", 32]]
+    for name, batch in points:
+        try:
+            r = measure(named[name], int(batch))
+            print(json.dumps({"config": name, "batch": batch, **r}), flush=True)
+        except Exception as e:  # noqa: BLE001 — sweep survives OOM points
+            print(json.dumps({"config": name, "batch": batch,
+                              "error": repr(e)[:200]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
