@@ -14,7 +14,20 @@ algorithm families:
 * A2C — the on-policy family's simplest member (shared PPO substrate);
 * TD3 — deterministic continuous control: twin delayed critics, target
   smoothing (shared SAC substrate);
-* multi-agent PPO (policy-map routing) and offline DQN (JSON datasets).
+* multi-agent PPO (policy-map routing) and offline DQN (JSON datasets);
+* PG / SimpleQ / DDPG — the family ancestors, each the tricks-off point
+  of its descendant's jitted program;
+* A3C — asynchronous gradient application over worker actors (the
+  HogWild ancestor; workers run A2C's factored-out gradient program);
+* Ape-X DQN — epsilon-ladder actors + prioritized replay;
+* MADDPG — centralized critics / decentralized actors for cooperative
+  continuous control (spread coverage task);
+* R2D2 — recurrent sequence replay with stored state + burn-in;
+* QMIX (with VDN) — monotonic value factorization for cooperative MARL;
+* Decision Transformer — offline RL as return-conditioned sequence
+  modeling (a control-sized causal GPT);
+* LinUCB / LinTS contextual bandits — closed-form posterior updates as
+  one jitted scan.
 The execution model (jit the whole train iteration; actors only for
 off-device sampling) is the part of the reference's ~30 algorithms that
 generalizes.
@@ -24,6 +37,7 @@ from ray_tpu._private.usage import record_library_usage as _rlu
 _rlu("rllib")
 
 from ray_tpu.rllib.a2c import A2C, A2CConfig
+from ray_tpu.rllib.a3c import A3C, A3CConfig
 from ray_tpu.rllib.connectors import (
     ClipActions,
     ClipObs,
@@ -59,9 +73,24 @@ from ray_tpu.rllib.offline_algos import (
     MARWIL,
     MARWILConfig,
 )
+from ray_tpu.rllib.apex import ApexDQN, ApexDQNConfig
+from ray_tpu.rllib.bandit import (
+    BanditConfig,
+    BanditLinTS,
+    BanditLinUCB,
+    LinearBanditEnv,
+)
+from ray_tpu.rllib.ddpg import DDPG, DDPGConfig
+from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, MultiAgentSpread
+from ray_tpu.rllib.dt import DT, DTConfig, collect_episodes
 from ray_tpu.rllib.es import ARS, ARSConfig, ES, ESConfig
+from ray_tpu.rllib.pg import PG, PGConfig
+from ray_tpu.rllib.qmix import QMIX, QMIXConfig, TwoStepGame
+from ray_tpu.rllib.r2d2 import R2D2, R2D2Config
+from ray_tpu.rllib.simple_q import SimpleQ, SimpleQConfig
 from ray_tpu.rllib.evaluation import EvalWorker, EvaluationWorkerSet
 from ray_tpu.rllib.models import ModelCatalog
+from ray_tpu.rllib.registry import get_algorithm_class, get_algorithm_config
 from ray_tpu.rllib.recurrent import (
     MemoryChain,
     RecurrentPPO,
@@ -83,6 +112,11 @@ __all__ = [
     "NormalizeObs",
     "UnsquashActions",
     "A2CConfig",
+    "A3C",
+    "A3CConfig",
+    "MADDPG",
+    "MADDPGConfig",
+    "MultiAgentSpread",
     "TD3",
     "TD3Config",
     "CartPole",
@@ -126,4 +160,26 @@ __all__ = [
     "OfflineDQN",
     "collect_transitions",
     "read_sample_batches",
+    "ApexDQN",
+    "ApexDQNConfig",
+    "BanditConfig",
+    "BanditLinTS",
+    "BanditLinUCB",
+    "LinearBanditEnv",
+    "DDPG",
+    "DDPGConfig",
+    "DT",
+    "DTConfig",
+    "collect_episodes",
+    "PG",
+    "PGConfig",
+    "QMIX",
+    "QMIXConfig",
+    "TwoStepGame",
+    "R2D2",
+    "R2D2Config",
+    "SimpleQ",
+    "SimpleQConfig",
+    "get_algorithm_class",
+    "get_algorithm_config",
 ]

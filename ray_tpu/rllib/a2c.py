@@ -60,13 +60,17 @@ class A2CConfig:
         return A2C(self)
 
 
-def _make_train_iter(cfg: A2CConfig):
+def _make_grad_fn(cfg: A2CConfig):
+    """(reset, grad_fn) where grad_fn(params, states, rng) -> (grads,
+    states, rng, metrics) — the rollout + n-step-advantage + gradient
+    half of A2C, factored out so A3C's worker actors can compute the
+    SAME gradient remotely and push it to an async learner."""
     env = cfg.env
     n_envs, t_len = cfg.num_envs, cfg.rollout_length
     reset, vstep, vobs = make_vec_env(env, n_envs)
 
     @jax.jit
-    def train_iter(params, opt, states, rng):
+    def grad_fn(params, states, rng):
         def step_fn(carry, _):
             states, rng = carry
             rng, k_act, k_step = jax.random.split(rng, 3)
@@ -112,8 +116,6 @@ def _make_train_iter(cfg: A2CConfig):
 
         (loss, entropy), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
-        params, opt = _adam(params, opt, grads, lr=cfg.lr,
-                            max_grad_norm=cfg.grad_clip, eps=1e-5)
         n_done = jnp.maximum(
             jnp.sum(traj["dones"].astype(jnp.float32)), 1.0)
         metrics = {
@@ -123,6 +125,19 @@ def _make_train_iter(cfg: A2CConfig):
             # for any reward scheme, not just +1-per-step envs).
             "episode_reward_mean": jnp.sum(traj["rewards"]) / n_done,
         }
+        return grads, states, rng, metrics
+
+    return reset, grad_fn
+
+
+def _make_train_iter(cfg: A2CConfig):
+    reset, grad_fn = _make_grad_fn(cfg)
+
+    @jax.jit
+    def train_iter(params, opt, states, rng):
+        grads, states, rng, metrics = grad_fn(params, states, rng)
+        params, opt = _adam(params, opt, grads, lr=cfg.lr,
+                            max_grad_norm=cfg.grad_clip, eps=1e-5)
         return params, opt, states, rng, metrics
 
     return reset, train_iter

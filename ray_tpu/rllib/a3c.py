@@ -1,0 +1,127 @@
+"""A3C: ASYNCHRONOUS advantage actor-critic (reference
+``rllib/algorithms/a3c/a3c.py``) — the HogWild ancestor of A2C. The
+reference's execution plan is exactly "workers compute gradients on
+their own rollouts against a stale parameter snapshot; the learner
+applies each gradient the moment it arrives" (``a3c.py``'s
+``training_step`` waits on ``ray.wait`` for the next gradient, applies,
+and re-dispatches THAT worker) — no synchronization barrier, which is
+the entire difference from A2C.
+
+Mapped here: worker actors run A2C's factored-out ``_make_grad_fn`` (the
+same jitted rollout+gradient program the synchronous learner uses, so
+A2C and A3C provably optimize the same objective), the learner loop is
+``ray_tpu.wait(num_returns=1)`` -> adam -> redispatch with fresh
+params. With ``num_rollout_workers=0`` it degenerates to exactly A2C.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.rllib.a2c import A2C, A2CConfig, _make_grad_fn
+from ray_tpu.rllib.optim import adam_step as _adam
+
+__all__ = ["A3C", "A3CConfig"]
+
+
+class A3CConfig(A2CConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_rollout_workers = 2
+        self.grads_per_iter = 8     # async applies per .train() call
+
+    def rollouts(self, *, num_envs: Optional[int] = None,
+                 rollout_length: Optional[int] = None,
+                 num_rollout_workers: Optional[int] = None) -> "A3CConfig":
+        super().rollouts(num_envs=num_envs, rollout_length=rollout_length)
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        return self
+
+    def build(self) -> "A3C":
+        return A3C(self)
+
+
+class A3CGradientWorker:
+    """Actor computing A2C gradients on a stale parameter snapshot."""
+
+    def __init__(self, cfg_dict: dict, seed: int):
+        cfg = A2CConfig()
+        for k, v in cfg_dict.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        self.cfg = cfg
+        reset, self._grad_fn = _make_grad_fn(cfg)
+        self.rng = jax.random.key(seed)
+        self.states = reset(jax.random.key(seed + 1))
+
+    def compute_grads(self, params) -> dict:
+        grads, self.states, self.rng, metrics = self._grad_fn(
+            params, self.states, self.rng)
+        return {"grads": jax.tree.map(np.asarray, grads),
+                "metrics": {k: float(v) for k, v in metrics.items()}}
+
+
+class A3C(A2C):
+    """Algorithm (Trainable contract): async gradient application when
+    workers are configured, plain A2C otherwise."""
+
+    def __init__(self, config: A3CConfig):
+        super().__init__(config)
+        self._workers: List = []
+        self._inflight: Dict = {}
+        if config.num_rollout_workers > 0:
+            worker_cls = ray_tpu.remote(A3CGradientWorker)
+            self._workers = [
+                worker_cls.remote(dict(config.__dict__),
+                                  config.seed + 100 + i)
+                for i in range(config.num_rollout_workers)
+            ]
+            self._apply = jax.jit(
+                lambda p, o, g: _adam(p, o, g, lr=config.lr,
+                                      max_grad_norm=config.grad_clip,
+                                      eps=1e-5))
+
+    def train(self) -> Dict[str, Any]:
+        if not self._workers:
+            return super().train()
+        cfg = self.config
+        start = time.perf_counter()
+        if not self._inflight:
+            self._inflight = {
+                w.compute_grads.remote(self.params): w
+                for w in self._workers}
+        applied, last_metrics = 0, {}
+        while applied < cfg.grads_per_iter:
+            # The A3C kernel: take whichever worker finishes FIRST,
+            # apply its (stale) gradient, send it fresh params.
+            ready, _ = ray_tpu.wait(
+                list(self._inflight), num_returns=1, timeout=120)
+            if not ready:
+                raise TimeoutError("A3C worker stalled")
+            ref = ready[0]
+            worker = self._inflight.pop(ref)
+            out = ray_tpu.get(ref, timeout=60)
+            grads = jax.tree.map(jnp.asarray, out["grads"])
+            self.params, self.opt = self._apply(
+                self.params, self.opt, grads)
+            last_metrics = out["metrics"]
+            applied += 1
+            self._inflight[worker.compute_grads.remote(self.params)] = \
+                worker
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter":
+                applied * cfg.num_envs * cfg.rollout_length,
+            "gradients_applied": applied,
+            "time_this_iter_s": time.perf_counter() - start,
+            **last_metrics,
+        }

@@ -1,0 +1,28 @@
+"""Shared Trainable-contract plumbing for learner-dict algorithms.
+
+The Anakin-style algorithms carry ``reward_sum`` / ``done_count``
+counters inside their jitted learner state; every ``.train()`` reports
+the mean episodic reward of the episodes that finished THIS iteration
+(reference semantics: ``episode_reward_mean`` over the recent window,
+``rllib/algorithms/algorithm.py``). One copy of that delta bookkeeping
+lives here instead of per algorithm.
+"""
+
+from __future__ import annotations
+
+
+class EpisodeStats:
+    """Mixin for classes whose ``self._learner`` dict tracks
+    ``reward_sum`` (float accumulator) and ``done_count`` (int)."""
+
+    def _episode_snapshot(self) -> tuple:
+        return (float(self._learner["reward_sum"]),
+                int(self._learner["done_count"]))
+
+    def _episode_reward_mean(self, snapshot: tuple) -> float:
+        """Mean reward of episodes finished since ``snapshot`` (clamped
+        to one episode so a done-free iteration reports progress-so-far
+        rather than dividing by zero)."""
+        drew = float(self._learner["reward_sum"]) - snapshot[0]
+        ddone = max(1, int(self._learner["done_count"]) - snapshot[1])
+        return drew / ddone

@@ -1,0 +1,31 @@
+"""DDPG: deep deterministic policy gradient (reference
+``rllib/algorithms/ddpg/ddpg.py``). Historically DDPG came first and TD3
+is "DDPG + three tricks"; the reference implements them as separate
+algorithms sharing a policy class. Here the lineage runs the other way
+through config space — DDPG is the TD3 program with every trick turned
+off: no target-policy smoothing (``target_noise=0``), no delayed actor
+(``policy_delay=1``), a single critic (``twin_q=False``). The jitted
+train iteration, replay buffer, and Polyak targets are shared code.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.td3 import TD3, TD3Config
+
+__all__ = ["DDPG", "DDPGConfig"]
+
+
+class DDPGConfig(TD3Config):
+    def __init__(self):
+        super().__init__()
+        self.target_noise = 0.0
+        self.target_noise_clip = 0.0
+        self.policy_delay = 1
+        self.twin_q = False
+
+    def build(self) -> "DDPG":
+        return DDPG(self)
+
+
+class DDPG(TD3):
+    """Algorithm (Trainable contract: ``.train()`` -> result dict)."""

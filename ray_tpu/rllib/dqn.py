@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from ray_tpu.rllib.env import CartPole, make_vec_env
 from ray_tpu.rllib.optim import adam_step as _adam
+from ray_tpu.rllib.optim import linear_epsilon, periodic_target_sync
 from ray_tpu.rllib.ppo import mlp_apply, mlp_init
 from ray_tpu.rllib.replay import buffer_add as _buf_add
 from ray_tpu.rllib.replay import buffer_init, buffer_sample
@@ -46,6 +47,7 @@ class DQNConfig:
         self.epsilon_decay_steps = 5_000
         self.target_update_every = 500  # gradient steps between syncs
         self.learning_starts = 500      # buffer fill before updates
+        self.double_q = True            # False -> SimpleQ (max over target)
         self.seed = 0
 
     def environment(self, env=None) -> "DQNConfig":
@@ -74,6 +76,27 @@ class DQNConfig:
         return DQN(self)
 
 
+def q_td_errors(params, target_params, batch, gamma: float,
+                double_q: bool = True):
+    """Per-element TD errors for the DQN family (one copy for
+    dqn/apex): double-Q decouples argmax (online) from evaluation
+    (target); ``double_q=False`` is SimpleQ's overestimating max."""
+    q = mlp_apply(params, batch["obs"])  # [B, A]
+    q_taken = jnp.take_along_axis(
+        q, batch["actions"][:, None], axis=1)[:, 0]
+    next_target = mlp_apply(target_params, batch["next_obs"])
+    if double_q:
+        next_online = mlp_apply(params, batch["next_obs"])
+        next_act = jnp.argmax(next_online, axis=1)
+        next_q = jnp.take_along_axis(
+            next_target, next_act[:, None], axis=1)[:, 0]
+    else:
+        next_q = jnp.max(next_target, axis=1)
+    y = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+        jax.lax.stop_gradient(next_q)
+    return q_taken - y
+
+
 def _make_train_iter(cfg: DQNConfig):
     env = cfg.env
     obs_size, n_act = env.observation_size, env.num_actions
@@ -84,22 +107,12 @@ def _make_train_iter(cfg: DQNConfig):
                         rewards=rewards, next_obs=next_obs, dones=dones)
 
     def epsilon_at(global_step):
-        frac = jnp.clip(global_step / cfg.epsilon_decay_steps, 0.0, 1.0)
-        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+        return linear_epsilon(global_step, cfg.epsilon_start,
+                              cfg.epsilon_end, cfg.epsilon_decay_steps)
 
     def td_loss(params, target_params, batch):
-        q = mlp_apply(params, batch["obs"])  # [B, A]
-        q_taken = jnp.take_along_axis(
-            q, batch["actions"][:, None], axis=1)[:, 0]
-        # Double DQN: online net picks, target net evaluates.
-        next_online = mlp_apply(params, batch["next_obs"])
-        next_act = jnp.argmax(next_online, axis=1)
-        next_target = mlp_apply(target_params, batch["next_obs"])
-        next_q = jnp.take_along_axis(
-            next_target, next_act[:, None], axis=1)[:, 0]
-        target = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) * \
-            jax.lax.stop_gradient(next_q)
-        err = q_taken - target
+        err = q_td_errors(params, target_params, batch, cfg.gamma,
+                          double_q=cfg.double_q)
         return jnp.mean(err * err)
 
     def adam_step(params, opt, grads):
@@ -149,11 +162,9 @@ def _make_train_iter(cfg: DQNConfig):
             ready = (buf["size"] >= cfg.learning_starts).astype(jnp.float32)
             grads = jax.tree.map(lambda g: g * ready, grads)
             params, opt = adam_step(learner["params"], learner["opt"], grads)
-            sync = (opt["t"] % cfg.target_update_every) == 0
-            target = jax.tree.map(
-                lambda t_, p: jnp.where(sync, p, t_),
-                learner["target_params"], params,
-            )
+            target = periodic_target_sync(
+                learner["target_params"], params, opt["t"],
+                cfg.target_update_every)
             learner = dict(learner, params=params, opt=opt,
                            target_params=target)
             return (learner, rng), loss * ready

@@ -1,0 +1,340 @@
+"""Decision Transformer (reference ``rllib/algorithms/dt/dt.py``, after
+Chen et al. 2021): offline RL as conditional sequence modeling — a causal
+transformer over interleaved (return-to-go, state, action) tokens,
+trained with action cross-entropy on logged episodes and STEERED at eval
+time by the target return it is conditioned on.
+
+This is the most TPU-native member of the offline family: the model IS a
+small GPT (same pre-LN block structure as ``models/gpt2.py``, sized for
+control), so training is pure MXU matmuls over [B, 3K, d] token batches
+— no TD bootstrapping, no replay priorities, no target networks. The
+collector and the jitted update follow the offline-family conventions of
+``rllib/offline_algos.py``; episodes are fixed-horizon padded arrays so
+everything stays static-shaped.
+
+The acceptance test (``tests/test_rllib_dt.py``) exercises the paper's
+defining property, return-conditioned steering: the SAME trained model
+rolled out with a high target return recovers near-expert behavior from
+a mostly-random mixture, and with a low target it obeys and performs
+poorly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.env import CartPole
+from ray_tpu.rllib.optim import adam_step as _adam
+
+__all__ = ["DT", "DTConfig", "collect_episodes"]
+
+
+# ---------------------------------------------------------------------------
+# episode collection (fixed-horizon padded arrays)
+# ---------------------------------------------------------------------------
+
+
+def collect_episodes(policy_fn, n_episodes: int, max_len: int,
+                     seed: int = 0, env=None) -> Dict[str, np.ndarray]:
+    """Roll ``policy_fn(obs [N, O], rng) -> actions [N]`` for one episode
+    per vmapped lane; steps after the first done are masked out (the env
+    auto-resets, so the mask is what delimits the episode).
+
+    Returns {obs [N,T,O], actions [N,T], rewards [N,T], mask [N,T]}.
+    """
+    env = env or CartPole()
+    vreset = jax.vmap(env.reset)
+    vobs = jax.vmap(env.obs)
+    vstep = jax.vmap(env.step)
+
+    @jax.jit
+    def rollout(rng):
+        states = vreset(jax.random.split(rng, n_episodes))
+
+        def step(carry, _):
+            states, alive, rng = carry
+            rng, k_p, k_s = jax.random.split(rng, 3)
+            obs = vobs(states)
+            act = policy_fn(obs, k_p)
+            nstates, _, rew, done = vstep(
+                states, act, jax.random.split(k_s, n_episodes))
+            out = {"obs": obs, "actions": act, "rewards": rew * alive,
+                   "mask": alive}
+            return (nstates, alive * (1.0 - done.astype(jnp.float32)),
+                    rng), out
+
+        _, traj = jax.lax.scan(
+            step, (states, jnp.ones(n_episodes), jax.random.fold_in(rng, 1)),
+            None, length=max_len)
+        return traj
+
+    traj = rollout(jax.random.key(seed))
+    return {k: np.asarray(jnp.swapaxes(v, 0, 1)) for k, v in traj.items()}
+
+
+# ---------------------------------------------------------------------------
+# the model: a control-sized causal GPT over (rtg, s, a) token triples
+# ---------------------------------------------------------------------------
+
+
+class DTConfig:
+    """Builder-style config (``DTConfig().training(context_len=16)``)."""
+
+    def __init__(self):
+        self.env = CartPole()
+        self.context_len = 16       # K timesteps = 3K tokens
+        self.max_ep_len = 256       # timestep-embedding table size
+        self.d_model = 64
+        self.n_heads = 2
+        self.n_layers = 2
+        self.lr = 1e-3
+        self.batch_size = 64
+        self.updates_per_iter = 100
+        self.rtg_scale = 100.0      # normalize returns into O(1)
+        self.seed = 0
+
+    def environment(self, env=None) -> "DTConfig":
+        if env is not None:
+            self.env = env
+        return self
+
+    def training(self, **kwargs) -> "DTConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown DT option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "DTConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self, episodes: Dict[str, np.ndarray]) -> "DT":
+        return DT(self, episodes)
+
+
+def _dt_init(rng, cfg: DTConfig, obs_size: int, n_act: int):
+    d = cfg.d_model
+    keys = jax.random.split(rng, 6 + cfg.n_layers)
+
+    def lin(k, din, dout, scale=None):
+        scale = scale if scale is not None else 1.0 / np.sqrt(din)
+        return {"w": jax.random.normal(k, (din, dout)) * scale,
+                "b": jnp.zeros((dout,))}
+
+    def block(k):
+        k1, k2, k3, k4 = jax.random.split(k, 4)
+        return {
+            "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+            "attn": {"wqkv": lin(k1, d, 3 * d),
+                     "wo": lin(k2, d, d, scale=0.5 / np.sqrt(d))},
+            "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+            "mlp": {"up": lin(k3, d, 4 * d),
+                    "down": lin(k4, 4 * d, d, scale=0.25 / np.sqrt(d))},
+        }
+
+    return {
+        "embed_rtg": lin(keys[0], 1, d),
+        "embed_obs": lin(keys[1], obs_size, d),
+        "embed_act": jax.random.normal(keys[2], (n_act + 1, d)) * 0.02,
+        "embed_t": jax.random.normal(keys[3], (cfg.max_ep_len, d)) * 0.02,
+        "blocks": [block(k) for k in keys[4:4 + cfg.n_layers]],
+        "ln_f": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+        "head": lin(keys[4 + cfg.n_layers], d, n_act),
+    }
+
+
+def _ln(p, x):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return p["g"] * (x - mu) * jax.lax.rsqrt(var + 1e-5) + p["b"]
+
+
+def _dt_forward(params, cfg: DTConfig, rtg, obs, acts, timesteps):
+    """rtg [B,K], obs [B,K,O], acts [B,K] (-1 = not-yet-taken),
+    timesteps [B,K] -> action logits [B,K,A] read at the state tokens."""
+    B, K = rtg.shape
+    d, H = cfg.d_model, cfg.n_heads
+    t_emb = params["embed_t"][jnp.clip(timesteps, 0, cfg.max_ep_len - 1)]
+    e_rtg = rtg[..., None] @ params["embed_rtg"]["w"] \
+        + params["embed_rtg"]["b"] + t_emb
+    e_obs = obs @ params["embed_obs"]["w"] \
+        + params["embed_obs"]["b"] + t_emb
+    # Index -1 ("not yet taken") maps to the table's extra last row.
+    e_act = params["embed_act"][
+        jnp.where(acts < 0, params["embed_act"].shape[0] - 1, acts)] + t_emb
+    # Interleave (rtg_t, s_t, a_t): [B, 3K, d].
+    x = jnp.stack([e_rtg, e_obs, e_act], axis=2).reshape(B, 3 * K, d)
+
+    causal = jnp.tril(jnp.ones((3 * K, 3 * K), bool))
+    for blk in params["blocks"]:
+        h = _ln(blk["ln1"], x)
+        qkv = h @ blk["attn"]["wqkv"]["w"] + blk["attn"]["wqkv"]["b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(z):
+            return z.reshape(B, 3 * K, H, d // H).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = q @ k.transpose(0, 1, 3, 2) / np.sqrt(d // H)
+        scores = jnp.where(causal, scores, -1e9)
+        att = jax.nn.softmax(scores, axis=-1) @ v
+        att = att.transpose(0, 2, 1, 3).reshape(B, 3 * K, d)
+        x = x + att @ blk["attn"]["wo"]["w"] + blk["attn"]["wo"]["b"]
+        h = _ln(blk["ln2"], x)
+        h = jax.nn.gelu(h @ blk["mlp"]["up"]["w"] + blk["mlp"]["up"]["b"])
+        x = x + h @ blk["mlp"]["down"]["w"] + blk["mlp"]["down"]["b"]
+
+    x = _ln(params["ln_f"], x)
+    state_tokens = x.reshape(B, K, 3, d)[:, :, 1]   # position 3t+1
+    return state_tokens @ params["head"]["w"] + params["head"]["b"]
+
+
+class DT:
+    """Algorithm (Trainable contract: ``.train()`` -> result dict)."""
+
+    def __init__(self, config: DTConfig, episodes: Dict[str, np.ndarray]):
+        self.config = config
+        env = config.env
+        self._n_act = env.num_actions
+        rng = jax.random.key(config.seed)
+        k_param, self._rng = jax.random.split(rng)
+        self.params = _dt_init(
+            k_param, config, env.observation_size, env.num_actions)
+        self.opt = {"mu": jax.tree.map(jnp.zeros_like, self.params),
+                    "nu": jax.tree.map(jnp.zeros_like, self.params),
+                    "t": jnp.zeros((), jnp.int32)}
+
+        # Precompute per-episode returns-to-go (gamma = 1, as the paper).
+        rew, mask = episodes["rewards"], episodes["mask"]
+        rtg = np.flip(np.cumsum(np.flip(rew * mask, 1), 1), 1)
+        self._data = {
+            "obs": np.asarray(episodes["obs"], np.float32),
+            "actions": np.asarray(episodes["actions"], np.int32),
+            "rtg": (rtg / config.rtg_scale).astype(np.float32),
+            "mask": np.asarray(mask, np.float32),
+            "lengths": np.maximum(
+                mask.sum(1).astype(np.int64), 1),
+        }
+        self._np_rng = np.random.default_rng(config.seed)
+        self._update = self._build_update()
+        self._iteration = 0
+
+    def _build_update(self):
+        cfg = self.config
+
+        def loss_fn(params, batch):
+            logits = _dt_forward(
+                params, cfg, batch["rtg"], batch["obs"], batch["acts_in"],
+                batch["timesteps"])
+            logp = jax.nn.log_softmax(logits)
+            taken = jnp.take_along_axis(
+                logp, batch["actions"][..., None], axis=-1)[..., 0]
+            m = batch["mask"]
+            return -jnp.sum(taken * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+        @jax.jit
+        def update(params, opt, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            params, opt = _adam(params, opt, grads, lr=cfg.lr,
+                                max_grad_norm=1.0)
+            return params, opt, loss
+
+        return update
+
+    def _sample_windows(self) -> Dict[str, jnp.ndarray]:
+        cfg = self.config
+        K, B = cfg.context_len, cfg.batch_size
+        d = self._data
+        n = d["obs"].shape[0]
+        ep = self._np_rng.integers(0, n, B)
+        lengths = d["lengths"][ep]
+        # RIGHT-aligned windows ending at a sampled position e in
+        # [1, len]: an early-episode window is LEFT-padded with the same
+        # zero obs / zero rtg / -1 action / timestep-0 filler the eval
+        # loop's history buffer starts from — so the padding the model
+        # attends to at eval time is in-distribution.
+        end = 1 + (self._np_rng.random(B) * lengths).astype(np.int64)
+        idx = end[:, None] - K + np.arange(K)[None]        # [B, K], <0 pad
+        valid = (idx >= 0) & (idx < lengths[:, None])
+        idx_c = np.clip(idx, 0, d["obs"].shape[1] - 1)
+        gather = lambda a: a[ep[:, None], idx_c]           # noqa: E731
+        vf = valid.astype(np.float32)
+        actions = np.where(valid, gather(d["actions"]), 0)
+        acts_in = np.where(valid, actions, -1)
+        return {
+            "obs": jnp.asarray(gather(d["obs"]) * vf[..., None]),
+            "actions": jnp.asarray(actions.astype(np.int32)),
+            "acts_in": jnp.asarray(acts_in.astype(np.int32)),
+            "rtg": jnp.asarray(gather(d["rtg"]) * vf),
+            "timesteps": jnp.asarray((idx_c * valid).astype(np.int32)),
+            "mask": jnp.asarray(vf * gather(d["mask"])),
+        }
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        losses = []
+        for _ in range(self.config.updates_per_iter):
+            batch = self._sample_windows()
+            self.params, self.opt, loss = self._update(
+                self.params, self.opt, batch)
+            losses.append(float(loss))
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "loss": float(np.mean(losses)),
+            "time_this_iter_s": time.perf_counter() - start,
+        }
+
+    # -- return-conditioned evaluation ---------------------------------
+
+    def evaluate(self, target_return: float, *, n_episodes: int = 8,
+                 max_len: int = 200, seed: int = 123) -> float:
+        """Greedy rollout conditioned on ``target_return``; the rtg token
+        decrements by each observed reward (the paper's eval loop)."""
+        cfg = self.config
+        env = cfg.env
+        K = cfg.context_len
+
+        @jax.jit
+        def act_fn(params, rtg_h, obs_h, act_h, t_h):
+            logits = _dt_forward(params, cfg, rtg_h[None], obs_h[None],
+                                 act_h[None], t_h[None])
+            return jnp.argmax(logits[0, -1])
+
+        total = 0.0
+        for ep in range(n_episodes):
+            rng = jax.random.key(seed + ep)
+            s = env.reset(rng)
+            rtg_h = jnp.zeros((K,)).at[-1].set(
+                target_return / cfg.rtg_scale)
+            obs_h = jnp.zeros((K, env.observation_size)).at[-1].set(
+                env.obs(s))
+            act_h = jnp.full((K,), -1, jnp.int32)
+            t_h = jnp.zeros((K,), jnp.int32)
+            ret, rtg = 0.0, target_return
+            for t in range(max_len):
+                a = act_fn(self.params, rtg_h, obs_h, act_h, t_h)
+                rng, k = jax.random.split(rng)
+                s, _, rew, done = env.step(s, a, k)
+                ret += float(rew)
+                rtg -= float(rew)
+                if bool(done):
+                    break
+                # Record the taken action, then shift history left and
+                # open a fresh (rtg, obs, pending-action) slot.
+                act_h = act_h.at[-1].set(a)
+                act_h = jnp.roll(act_h, -1).at[-1].set(-1)
+                rtg_h = jnp.roll(rtg_h, -1).at[-1].set(rtg / cfg.rtg_scale)
+                obs_h = jnp.roll(obs_h, -1).at[-1].set(env.obs(s))
+                t_h = jnp.roll(t_h, -1).at[-1].set(
+                    min(t + 1, cfg.max_ep_len - 1))
+            total += ret
+        return total / n_episodes

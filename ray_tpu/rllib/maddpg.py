@@ -1,0 +1,348 @@
+"""MADDPG: multi-agent DDPG with centralized critics (reference
+``rllib/algorithms/maddpg/maddpg.py``, after Lowe et al. 2017) — the
+continuous-action counterpart to QMIX in the multi-agent corner of the
+inventory: DECENTRALIZED deterministic actors (each sees only its own
+observation) trained against CENTRALIZED critics Q_i(o_1..o_n, a_1..a_n)
+that condition on every agent's observation and action, which removes
+the non-stationarity that breaks independent DDPG.
+
+TPU-native shape: all n actors, n critics, their targets, the joint
+replay buffer, and the environment batch live in ONE jitted Anakin
+program; the agent axis is a static Python loop over small per-agent
+parameter pytrees (n is 2-4 — unrolling beats a lax axis here). The
+actor gradient follows the paper's eq. 6: agent i's own action comes
+from its CURRENT policy, the other agents' actions from the replay
+sample.
+
+``MultiAgentSpread`` is a jitted simplification of the MPE
+``simple_spread`` task the reference benchmarks MADDPG on: n agents
+must cover n landmarks under a shared reward.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithm import EpisodeStats
+from ray_tpu.rllib.optim import adam_step as _adam
+from ray_tpu.rllib.ppo import mlp_apply, mlp_init
+from ray_tpu.rllib.replay import buffer_add, buffer_init, buffer_sample
+
+__all__ = ["MADDPG", "MADDPGConfig", "MultiAgentSpread"]
+
+
+class SpreadState(NamedTuple):
+    pos: jax.Array        # [n_agents, 2]
+    landmarks: jax.Array  # [n_agents, 2]
+    t: jax.Array
+
+
+class MultiAgentSpread:
+    """n agents cover n landmarks on [-1, 1]^2; continuous velocity
+    actions; shared reward = -sum over landmarks of the closest agent's
+    distance (cooperative coverage). Fixed horizon, auto-reset."""
+
+    def __init__(self, n_agents: int = 2, max_steps: int = 25,
+                 dt: float = 0.25):
+        self.n_agents = n_agents
+        self.max_steps = max_steps
+        self.dt = dt
+        self.action_size = 2
+        # own pos + all landmarks (relative) + other agents (relative)
+        self.observation_size = 2 + 2 * n_agents + 2 * (n_agents - 1)
+
+    def reset(self, rng: jax.Array) -> SpreadState:
+        kp, kl = jax.random.split(rng)
+        return SpreadState(
+            jax.random.uniform(kp, (self.n_agents, 2), minval=-1.0,
+                               maxval=1.0),
+            jax.random.uniform(kl, (self.n_agents, 2), minval=-1.0,
+                               maxval=1.0),
+            jnp.zeros((), jnp.int32))
+
+    def obs(self, s: SpreadState) -> jax.Array:
+        """[n_agents, obs_size]."""
+        n = self.n_agents
+        rel_lm = (s.landmarks[None] - s.pos[:, None]).reshape(n, -1)
+        rel_ag = (s.pos[None] - s.pos[:, None])          # [n, n, 2]
+        # Drop the self row per agent (numpy mask: concrete under jit).
+        mask = ~np.eye(n, dtype=bool)
+        rel_others = rel_ag[mask].reshape(n, -1)
+        return jnp.concatenate([s.pos, rel_lm, rel_others], axis=1)
+
+    def _coverage_cost(self, pos, landmarks) -> jax.Array:
+        d = jnp.linalg.norm(
+            landmarks[:, None] - pos[None], axis=-1)      # [lm, agent]
+        return jnp.sum(jnp.min(d, axis=1))
+
+    def step(self, s: SpreadState, actions: jax.Array, rng: jax.Array):
+        """actions [n_agents, 2] in [-1, 1] -> (state, obs, rewards
+        [n_agents] (shared), done)."""
+        npos = jnp.clip(s.pos + self.dt * jnp.clip(actions, -1, 1),
+                        -1.0, 1.0)
+        reward = -self._coverage_cost(npos, s.landmarks)
+        t = s.t + 1
+        done = t >= self.max_steps
+        fresh = self.reset(rng)
+        nxt = SpreadState(
+            jnp.where(done, fresh.pos, npos),
+            jnp.where(done, fresh.landmarks, s.landmarks),
+            jnp.where(done, fresh.t, t))
+        return nxt, self.obs(nxt), jnp.full((self.n_agents,), reward), done
+
+
+class MADDPGConfig:
+    """Builder-style config (``MADDPGConfig().training(tau=0.01)``)."""
+
+    def __init__(self):
+        self.env = MultiAgentSpread()
+        self.num_envs = 16
+        self.steps_per_iter = 64
+        self.buffer_size = 50_000
+        self.batch_size = 256
+        self.updates_per_iter = 32
+        self.gamma = 0.95
+        self.tau = 0.01
+        self.actor_lr = 1e-3
+        self.critic_lr = 1e-3
+        self.hidden_sizes = (64, 64)
+        self.learning_starts = 1_000
+        self.explore_noise = 0.2
+        self.centralized = True     # False -> independent DDPG baseline
+        self.seed = 0
+
+    def environment(self, env=None) -> "MADDPGConfig":
+        if env is not None:
+            self.env = env
+        return self
+
+    def rollouts(self, *, num_envs: Optional[int] = None
+                 ) -> "MADDPGConfig":
+        if num_envs is not None:
+            self.num_envs = num_envs
+        return self
+
+    def training(self, **kwargs) -> "MADDPGConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown MADDPG option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "MADDPGConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "MADDPG":
+        return MADDPG(self)
+
+
+def _make_train_iter(cfg: MADDPGConfig):
+    env = cfg.env
+    n, act_size = env.n_agents, env.action_size
+    obs_size = env.observation_size
+
+    vreset = jax.vmap(env.reset)
+    vobs = jax.vmap(env.obs)
+    vstep = jax.vmap(env.step)
+
+    def actor_apply(ap, obs_i):
+        return jnp.tanh(mlp_apply(ap, obs_i))
+
+    def critic_in(batch_obs, batch_act, i):
+        """Centralized: concat every agent's obs+act; independent: own."""
+        if cfg.centralized:
+            return jnp.concatenate(
+                [batch_obs.reshape(batch_obs.shape[0], -1),
+                 batch_act.reshape(batch_act.shape[0], -1)], axis=1)
+        return jnp.concatenate(
+            [batch_obs[:, i], batch_act[:, i]], axis=1)
+
+    def critic_loss(cp, i, learner, batch):
+        next_acts = jnp.stack(
+            [actor_apply(learner["target_actors"][j], batch["nobs"][:, j])
+             for j in range(n)], axis=1)
+        tq = mlp_apply(learner["target_critics"][i],
+                       critic_in(batch["nobs"], next_acts, i))[:, 0]
+        y = batch["rew"][:, i] + cfg.gamma * (1 - batch["done"]) * \
+            jax.lax.stop_gradient(tq)
+        q = mlp_apply(cp, critic_in(batch["obs"], batch["act"], i))[:, 0]
+        return jnp.mean((q - y) ** 2)
+
+    def actor_loss(ap, i, critic_i, batch):
+        # Paper eq. 6: own action from the CURRENT policy, other agents'
+        # actions from the replay sample.
+        own = actor_apply(ap, batch["obs"][:, i])
+        acts = batch["act"].at[:, i].set(own)
+        q = mlp_apply(critic_i, critic_in(batch["obs"], acts, i))[:, 0]
+        return -jnp.mean(q)
+
+    @jax.jit
+    def reset(rng):
+        return vreset(jax.random.split(rng, cfg.num_envs))
+
+    @jax.jit
+    def train_iter(learner, states, rng):
+        def env_step(carry, _):
+            learner, states, rng = carry
+            rng, k_n, k_step = jax.random.split(rng, 3)
+            obs = vobs(states)                        # [E, n, O]
+            act = jnp.stack(
+                [actor_apply(learner["actors"][i], obs[:, i])
+                 for i in range(n)], axis=1)
+            act = jnp.clip(
+                act + cfg.explore_noise
+                * jax.random.normal(k_n, act.shape), -1.0, 1.0)
+            nstates, nobs, rew, done = vstep(
+                states, act, jax.random.split(k_step, cfg.num_envs))
+            # Spread terminates only on the time limit — store done=0 so
+            # the critic bootstraps THROUGH truncation (td3.py's
+            # TIME_LIMIT_ONLY convention).
+            learner = dict(
+                learner,
+                buffer=buffer_add(
+                    learner["buffer"], cfg.buffer_size,
+                    obs=obs, act=act, rew=rew, nobs=nobs,
+                    done=jnp.zeros(cfg.num_envs)),
+                env_steps=learner["env_steps"] + cfg.num_envs,
+                reward_sum=learner["reward_sum"] + jnp.sum(rew[:, 0]),
+                done_count=learner["done_count"] + jnp.sum(done),
+            )
+            return (learner, nstates, rng), None
+
+        (learner, states, rng), _ = jax.lax.scan(
+            env_step, (learner, states, rng), None,
+            length=cfg.steps_per_iter)
+
+        def update(carry, _):
+            learner, rng = carry
+            rng, k = jax.random.split(rng)
+            buf = learner["buffer"]
+            batch = buffer_sample(
+                buf, k, cfg.batch_size,
+                ("obs", "act", "rew", "nobs", "done"))
+            ready = (buf["size"] >= cfg.learning_starts).astype(jnp.float32)
+
+            closs_sum = 0.0
+            new_c, new_copt, new_a, new_aopt = [], [], [], []
+            for i in range(n):
+                closs, cg = jax.value_and_grad(critic_loss)(
+                    learner["critics"][i], i, learner, batch)
+                cg = jax.tree.map(lambda g: g * ready, cg)
+                ci, coi = _adam(learner["critics"][i],
+                                learner["copts"][i], cg,
+                                lr=cfg.critic_lr)
+                new_c.append(ci)
+                new_copt.append(coi)
+                closs_sum = closs_sum + closs
+
+                aloss, ag = jax.value_and_grad(actor_loss)(
+                    learner["actors"][i], i, ci, batch)
+                ag = jax.tree.map(lambda g: g * ready, ag)
+                ai, aoi = _adam(learner["actors"][i],
+                                learner["aopts"][i], ag,
+                                lr=cfg.actor_lr)
+                new_a.append(ai)
+                new_aopt.append(aoi)
+
+            blend = cfg.tau * ready
+            polyak = lambda t_, p_: jax.tree.map(      # noqa: E731
+                lambda a, b: (1 - blend) * a + blend * b, t_, p_)
+            learner = dict(
+                learner,
+                actors=new_a, critics=new_c,
+                aopts=new_aopt, copts=new_copt,
+                target_actors=[polyak(t_, p_) for t_, p_ in
+                               zip(learner["target_actors"], new_a)],
+                target_critics=[polyak(t_, p_) for t_, p_ in
+                                zip(learner["target_critics"], new_c)],
+            )
+            return (learner, rng), closs_sum * ready / n
+
+        (learner, rng), losses = jax.lax.scan(
+            update, (learner, rng), None, length=cfg.updates_per_iter)
+        return learner, states, rng, {"critic_loss": jnp.mean(losses)}
+
+    return reset, train_iter
+
+
+class MADDPG(EpisodeStats):
+    """Algorithm (Trainable contract: ``.train()`` -> result dict)."""
+
+    def __init__(self, config: MADDPGConfig):
+        self.config = config
+        env = config.env
+        n = env.n_agents
+        obs_size, act_size = env.observation_size, env.action_size
+        cin = (obs_size + act_size) * (n if config.centralized else 1)
+        rng = jax.random.key(config.seed)
+        keys = jax.random.split(rng, 2 * n + 2)
+        self._rng = keys[-1]
+        actors = [mlp_init(keys[i],
+                           (obs_size, *config.hidden_sizes, act_size))
+                  for i in range(n)]
+        critics = [mlp_init(keys[n + i],
+                            (cin, *config.hidden_sizes, 1))
+                   for i in range(n)]
+
+        def opt0(p):
+            return {"mu": jax.tree.map(jnp.zeros_like, p),
+                    "nu": jax.tree.map(jnp.zeros_like, p),
+                    "t": jnp.zeros((), jnp.int32)}
+
+        self._learner = {
+            "actors": actors,
+            "critics": critics,
+            "target_actors": jax.tree.map(jnp.copy, actors),
+            "target_critics": jax.tree.map(jnp.copy, critics),
+            "aopts": [opt0(a) for a in actors],
+            "copts": [opt0(c) for c in critics],
+            "buffer": buffer_init(
+                config.buffer_size,
+                {"obs": (n, obs_size), "act": (n, act_size),
+                 "rew": (n,), "nobs": (n, obs_size), "done": ()}),
+            "env_steps": jnp.zeros((), jnp.int32),
+            "reward_sum": jnp.zeros(()),
+            "done_count": jnp.zeros((), jnp.int32),
+        }
+        self._reset, self._train_iter = _make_train_iter(config)
+        self._states = self._reset(keys[-2])
+        self._iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        snap = self._episode_snapshot()
+        self._learner, self._states, self._rng, metrics = self._train_iter(
+            self._learner, self._states, self._rng)
+        self._iteration += 1
+        reward_mean = self._episode_reward_mean(snap)
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter":
+                self.config.num_envs * self.config.steps_per_iter,
+            "episode_reward_mean": reward_mean,
+            "time_this_iter_s": time.perf_counter() - start,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def greedy_coverage(self, rng) -> float:
+        """Play one greedy episode; return the FINAL coverage cost
+        (sum over landmarks of distance to the closest agent)."""
+        env = self.config.env
+        s = env.reset(rng)
+        for _ in range(env.max_steps - 1):
+            obs = env.obs(s)
+            act = jnp.stack(
+                [jnp.tanh(mlp_apply(self._learner["actors"][i],
+                                    obs[i][None]))[0]
+                 for i in range(env.n_agents)])
+            rng, k = jax.random.split(rng)
+            s, _, _, _ = env.step(s, act, k)
+        return float(env._coverage_cost(s.pos, s.landmarks))

@@ -34,6 +34,30 @@ def adam_step(params, opt, grads, *, lr: float,
     return params, {"mu": mu, "nu": nu, "t": t}
 
 
+def adam_init(params):
+    """Zeroed Adam state for ``adam_step`` (one copy of the
+    {"mu","nu","t"} pytree constructor every algorithm carries)."""
+    return {"mu": jax.tree.map(jnp.zeros_like, params),
+            "nu": jax.tree.map(jnp.zeros_like, params),
+            "t": jnp.zeros((), jnp.int32)}
+
+
+def linear_epsilon(global_step, start: float, end: float,
+                   decay_steps: int):
+    """Linearly decayed exploration epsilon (one copy for
+    dqn/qmix/r2d2): start -> end over ``decay_steps`` env steps."""
+    frac = jnp.clip(global_step / decay_steps, 0.0, 1.0)
+    return start + frac * (end - start)
+
+
+def periodic_target_sync(target_params, params, t, every: int):
+    """Hard target-network sync every ``every`` optimizer steps (one
+    copy for the DQN family): jit-safe elementwise where."""
+    sync = (t % every) == 0
+    return jax.tree.map(
+        lambda tp, p: jnp.where(sync, p, tp), target_params, params)
+
+
 def clipped_surrogate(logp, logp_old, adv, clip_param: float,
                       normalize: bool = True):
     """PPO's clipped policy-gradient surrogate (one copy for

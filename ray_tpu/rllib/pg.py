@@ -1,0 +1,169 @@
+"""PG: vanilla policy gradient / REINFORCE (reference
+``rllib/algorithms/pg/pg.py``) — the simplest on-policy family, kept in
+the inventory for the same reason the reference keeps it: a didactic
+baseline and the ancestor the PPO/APPO/IMPALA losses specialize.
+
+Same Anakin shape as ``ppo.py``: the vectorized env rollout and the
+update are one jitted program. The loss is the score function estimator
+on *reward-to-go* (computed by a reverse ``lax.scan`` that resets the
+running return at episode boundaries), with an exponential-moving-average
+scalar baseline for variance reduction — no learned critic, which is
+exactly what separates PG from A2C in the reference's taxonomy.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.env import CartPole, make_vec_env
+from ray_tpu.rllib.optim import adam_step as _adam
+from ray_tpu.rllib.ppo import mlp_apply, mlp_init
+
+__all__ = ["PG", "PGConfig"]
+
+
+class PGConfig:
+    """Builder-style config (``PGConfig().environment(...).training(...)``)."""
+
+    def __init__(self):
+        self.env = CartPole()
+        self.num_envs = 32
+        self.rollout_length = 128
+        self.gamma = 0.99
+        self.lr = 3e-3
+        self.hidden_sizes = (64, 64)
+        self.baseline_decay = 0.9   # EMA over batch-mean return-to-go
+        self.entropy_coeff = 0.0
+        self.seed = 0
+
+    def environment(self, env=None) -> "PGConfig":
+        if env is not None:
+            self.env = env
+        return self
+
+    def rollouts(self, *, num_envs: Optional[int] = None,
+                 rollout_length: Optional[int] = None) -> "PGConfig":
+        if num_envs is not None:
+            self.num_envs = num_envs
+        if rollout_length is not None:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, **kwargs) -> "PGConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown PG option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "PGConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "PG":
+        return PG(self)
+
+
+def _make_train_iter(cfg: PGConfig):
+    reset_fn, step_fn, obs_fn = make_vec_env(cfg.env, cfg.num_envs)
+
+    @jax.jit
+    def reset(rng):
+        return reset_fn(rng)
+
+    @jax.jit
+    def train_iter(params, opt, baseline, states, rng):
+        def env_step(carry, _):
+            states, rng = carry
+            rng, k_act, k_step = jax.random.split(rng, 3)
+            obs = obs_fn(states)
+            logits = mlp_apply(params, obs)
+            actions = jax.random.categorical(k_act, logits)
+            nstates, _, rew, done = step_fn(states, actions, k_step)
+            return (nstates, rng), {
+                "obs": obs, "actions": actions, "rewards": rew,
+                "dones": done.astype(jnp.float32)}
+
+        (states, rng), traj = jax.lax.scan(
+            env_step, (states, rng), None, length=cfg.rollout_length)
+
+        def rtg_step(running, step):
+            # Reward-to-go, zeroed across episode boundaries. The episode
+            # tail cut off by the fixed horizon is left unbootstrapped —
+            # PG has no value net to bootstrap with (that's A2C).
+            running = step["rewards"] + cfg.gamma * running * \
+                (1.0 - step["dones"])
+            return running, running
+
+        _, rtg = jax.lax.scan(
+            rtg_step, jnp.zeros(cfg.num_envs), traj, reverse=True)
+
+        new_baseline = cfg.baseline_decay * baseline + \
+            (1.0 - cfg.baseline_decay) * jnp.mean(rtg)
+        adv = rtg - new_baseline
+
+        def pg_loss(p):
+            logits = mlp_apply(
+                p, traj["obs"].reshape(-1, traj["obs"].shape[-1]))
+            logp = jax.nn.log_softmax(logits)
+            taken = jnp.take_along_axis(
+                logp, traj["actions"].reshape(-1)[:, None], axis=1)[:, 0]
+            ent = -jnp.mean(jnp.sum(jnp.exp(logp) * logp, axis=1))
+            return -jnp.mean(taken * adv.reshape(-1)) \
+                - cfg.entropy_coeff * ent
+
+        loss, grads = jax.value_and_grad(pg_loss)(params)
+        params, opt = _adam(params, opt, grads, lr=cfg.lr)
+        n_done = jnp.maximum(1.0, jnp.sum(traj["dones"]))
+        metrics = {
+            "loss": loss,
+            # True mean return of episodes ending this rollout (any
+            # reward scheme, not just +1-per-step — a2c.py convention).
+            "episode_reward_mean": jnp.sum(traj["rewards"]) / n_done,
+        }
+        return params, opt, new_baseline, states, rng, metrics
+
+    return reset, train_iter
+
+
+class PG:
+    """Algorithm (Trainable contract: ``.train()`` -> result dict)."""
+
+    def __init__(self, config: PGConfig):
+        self.config = config
+        env = config.env
+        k_param, k_env, self._rng = jax.random.split(
+            jax.random.key(config.seed), 3)
+        self._params = mlp_init(
+            k_param, (env.observation_size, *config.hidden_sizes,
+                      env.num_actions))
+        self._opt = {"mu": jax.tree.map(jnp.zeros_like, self._params),
+                     "nu": jax.tree.map(jnp.zeros_like, self._params),
+                     "t": jnp.zeros((), jnp.int32)}
+        self._baseline = jnp.zeros(())
+        self._reset, self._train_iter = _make_train_iter(config)
+        self._states = self._reset(k_env)
+        self._iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        (self._params, self._opt, self._baseline, self._states, self._rng,
+         metrics) = self._train_iter(
+            self._params, self._opt, self._baseline, self._states, self._rng)
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter":
+                self.config.num_envs * self.config.rollout_length,
+            "time_this_iter_s": time.perf_counter() - start,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def compute_single_action(self, obs) -> int:
+        logits = mlp_apply(self._params, jnp.asarray(obs)[None])
+        return int(jnp.argmax(logits[0]))

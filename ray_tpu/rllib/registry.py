@@ -1,0 +1,70 @@
+"""Algorithm registry (reference ``rllib/algorithms/registry.py``):
+string name -> (Algorithm class, default config factory), the lookup
+that lets Tune experiments name an algorithm ("PPO") instead of
+importing it. Lazy imports keep ``ray_tpu.rllib.registry`` cheap to
+load and avoid importing every algorithm at once.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+__all__ = ["get_algorithm_class", "get_algorithm_config", "ALGORITHMS"]
+
+
+def _lazy(module: str, algo: str, config: str) -> Callable:
+    def load() -> Tuple[type, type]:
+        import importlib
+
+        mod = importlib.import_module(f"ray_tpu.rllib.{module}")
+        return getattr(mod, algo), getattr(mod, config)
+
+    return load
+
+
+ALGORITHMS = {
+    "A2C": _lazy("a2c", "A2C", "A2CConfig"),
+    "A3C": _lazy("a3c", "A3C", "A3CConfig"),
+    "APPO": _lazy("appo", "APPO", "APPOConfig"),
+    "ARS": _lazy("es", "ARS", "ARSConfig"),
+    "ApexDQN": _lazy("apex", "ApexDQN", "ApexDQNConfig"),
+    "BC": _lazy("offline_algos", "BC", "BCConfig"),
+    "BanditLinTS": _lazy("bandit", "BanditLinTS", "BanditConfig"),
+    "BanditLinUCB": _lazy("bandit", "BanditLinUCB", "BanditConfig"),
+    "CQL": _lazy("offline_algos", "CQL", "MARWILConfig"),
+    "DDPG": _lazy("ddpg", "DDPG", "DDPGConfig"),
+    "DQN": _lazy("dqn", "DQN", "DQNConfig"),
+    "DT": _lazy("dt", "DT", "DTConfig"),
+    "ES": _lazy("es", "ES", "ESConfig"),
+    "IMPALA": _lazy("impala", "IMPALA", "IMPALAConfig"),
+    "MADDPG": _lazy("maddpg", "MADDPG", "MADDPGConfig"),
+    "MARWIL": _lazy("offline_algos", "MARWIL", "MARWILConfig"),
+    "PG": _lazy("pg", "PG", "PGConfig"),
+    "PPO": _lazy("ppo", "PPO", "PPOConfig"),
+    "QMIX": _lazy("qmix", "QMIX", "QMIXConfig"),
+    "R2D2": _lazy("r2d2", "R2D2", "R2D2Config"),
+    "SAC": _lazy("sac", "SAC", "SACConfig"),
+    "SimpleQ": _lazy("simple_q", "SimpleQ", "SimpleQConfig"),
+    "TD3": _lazy("td3", "TD3", "TD3Config"),
+}
+
+
+def get_algorithm_class(name: str, return_config: bool = False):
+    """Resolve an algorithm by its registry name
+    (``rllib/algorithms/registry.py:get_algorithm_class``)."""
+    try:
+        loader = ALGORITHMS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown algorithm {name!r} "
+            f"(known: {sorted(ALGORITHMS)})") from None
+    cls, config_cls = loader()
+    if return_config:
+        return cls, config_cls
+    return cls
+
+
+def get_algorithm_config(name: str):
+    """Default config instance for a registered algorithm."""
+    _, config_cls = get_algorithm_class(name, return_config=True)
+    return config_cls()

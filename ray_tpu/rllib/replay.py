@@ -43,3 +43,64 @@ def buffer_sample(buf: dict, rng, batch_size: int,
     idx = jax.random.randint(
         rng, (batch_size,), 0, jnp.maximum(buf["size"], 1))
     return {name: buf[name][idx] for name in fields}
+
+
+# -- prioritized variant (Ape-X / PER; reference
+# ``utils/replay_buffers/prioritized_replay_buffer.py``) ------------------
+#
+# The reference uses a segment tree for O(log n) sampling on the host; on
+# an accelerator the O(n) normalized-categorical draw over the whole
+# priority vector is a single fused reduction + gumbel top-k, which at
+# these capacities is faster than pointer chasing would be — so the jax
+# design drops the tree entirely.
+
+
+def pbuffer_init(capacity: int, fields: Dict[str, Tuple[int, ...]],
+                 dtypes: Dict[str, object] | None = None) -> dict:
+    buf = buffer_init(capacity, fields, dtypes)
+    buf["priority"] = jnp.zeros((capacity,))
+    buf["max_priority"] = jnp.ones(())
+    return buf
+
+
+def pbuffer_add(buf: dict, capacity: int, **items) -> dict:
+    """New items enter at the running max priority so every transition
+    is sampled at least once before its TD error takes over."""
+    n_new = next(iter(items.values())).shape[0]
+    idx = (buf["ptr"] + jnp.arange(n_new)) % capacity
+    out = buffer_add(buf, capacity, **items)
+    out["priority"] = out["priority"].at[idx].set(buf["max_priority"])
+    return out
+
+
+def pbuffer_sample(buf: dict, rng, batch_size: int,
+                   fields: Tuple[str, ...], *, alpha: float = 0.6,
+                   beta: float = 0.4) -> dict:
+    """Sample ~ p^alpha; returns the batch plus ``indices`` and the
+    importance weights ``weights`` (max-normalized, (N*P)^-beta)."""
+    capacity = buf["priority"].shape[0]
+    # Like buffer_sample, valid once size >= 1 — but fail SAFE on an
+    # empty buffer: slot 0 stays sampleable so the categorical draw and
+    # the weights are finite (all-(-inf) logits would yield NaN weights
+    # that no ready-gating downstream could mask out, since NaN*0=NaN).
+    valid = jnp.arange(capacity) < jnp.maximum(buf["size"], 1)
+    logits = jnp.where(
+        valid, alpha * jnp.log(jnp.maximum(buf["priority"], 1e-12)),
+        -jnp.inf)
+    idx = jax.random.categorical(rng, logits, shape=(batch_size,))
+    probs = jax.nn.softmax(logits)
+    n = jnp.maximum(buf["size"], 1).astype(jnp.float32)
+    w = (n * jnp.maximum(probs[idx], 1e-12)) ** (-beta)
+    out = {name: buf[name][idx] for name in fields}
+    out["indices"] = idx
+    out["weights"] = w / jnp.maximum(jnp.max(w), 1e-12)
+    return out
+
+
+def pbuffer_update_priorities(buf: dict, indices, priorities,
+                              eps: float = 1e-3) -> dict:
+    p = jnp.abs(priorities) + eps
+    out = dict(buf)
+    out["priority"] = buf["priority"].at[indices].set(p)
+    out["max_priority"] = jnp.maximum(buf["max_priority"], jnp.max(p))
+    return out

@@ -1,0 +1,27 @@
+"""SimpleQ: plain deep Q-learning (reference
+``rllib/algorithms/simple_q/simple_q.py``) — the reference keeps the
+un-extended Q-learner as its own algorithm (the DQN class ADDS double-Q,
+dueling, n-step, prioritized replay on top of it); here the relationship
+is expressed the jax way: SimpleQ is the ``double_q=False`` point of the
+same jitted DQN program, so the TD target is the overestimating
+``max_a Q_target(s', a)`` instead of the decoupled argmax/eval pair.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.dqn import DQN, DQNConfig
+
+__all__ = ["SimpleQ", "SimpleQConfig"]
+
+
+class SimpleQConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.double_q = False
+
+    def build(self) -> "SimpleQ":
+        return SimpleQ(self)
+
+
+class SimpleQ(DQN):
+    """Algorithm (Trainable contract: ``.train()`` -> result dict)."""

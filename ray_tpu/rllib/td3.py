@@ -14,11 +14,12 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.rllib.algorithm import EpisodeStats
 from ray_tpu.rllib.env import Pendulum, make_vec_env
 from ray_tpu.rllib.optim import adam_step as _adam
 from ray_tpu.rllib.ppo import mlp_apply, mlp_init
 from ray_tpu.rllib.replay import buffer_add, buffer_init, buffer_sample
-from ray_tpu.rllib.sac import critic_apply, critic_init
+from ray_tpu.rllib.sac import critic_init
 
 
 class TD3Config:
@@ -40,6 +41,7 @@ class TD3Config:
         self.target_noise = 0.2         # target-policy smoothing
         self.target_noise_clip = 0.5
         self.policy_delay = 2           # actor updates every N critic steps
+        self.twin_q = True              # False -> DDPG's single critic
         self.seed = 0
 
     def environment(self, env=None) -> "TD3Config":
@@ -70,6 +72,16 @@ class TD3Config:
 
 def _actor_apply(params, obs, scale):
     return scale * jnp.tanh(mlp_apply(params, obs))
+
+
+def critic_apply(params, obs, act):
+    """SAC's twin forward, tolerating the single-critic (DDPG) pytree:
+    with no "q2" both returns alias q1, and the twin-only terms are
+    never used because the loss branches on cfg.twin_q."""
+    x = jnp.concatenate([obs, act], axis=-1)
+    q1 = mlp_apply(params["q1"], x)[..., 0]
+    q2 = mlp_apply(params["q2"], x)[..., 0] if "q2" in params else q1
+    return q1, q2
 
 
 def _make_train_iter(cfg: TD3Config):
@@ -124,10 +136,13 @@ def _make_train_iter(cfg: TD3Config):
                 + noise, -scale, scale)
             tq1, tq2 = critic_apply(
                 learner["target_critic"], batch["nobs"], next_act)
+            tq = jnp.minimum(tq1, tq2) if cfg.twin_q else tq1
             y = batch["rew"] + cfg.gamma * (1 - batch["done"]) * \
-                jax.lax.stop_gradient(jnp.minimum(tq1, tq2))
+                jax.lax.stop_gradient(tq)
             q1, q2 = critic_apply(cp, batch["obs"], batch["act"])
-            return jnp.mean((q1 - y) ** 2 + (q2 - y) ** 2)
+            if cfg.twin_q:
+                return jnp.mean((q1 - y) ** 2 + (q2 - y) ** 2)
+            return jnp.mean((q1 - y) ** 2)
 
         def actor_loss(ap, cp, batch):
             act = _actor_apply(ap, batch["obs"], scale)
@@ -181,7 +196,7 @@ def _make_train_iter(cfg: TD3Config):
     return reset, train_iter
 
 
-class TD3:
+class TD3(EpisodeStats):
     """Algorithm (Trainable contract: ``.train()`` -> result dict)."""
 
     def __init__(self, config: TD3Config):
@@ -192,6 +207,8 @@ class TD3:
         obs_size, act_size = env.observation_size, env.action_size
         actor = mlp_init(ka, (obs_size, *config.hidden_sizes, act_size))
         critic = critic_init(kc, obs_size, act_size, config.hidden_sizes)
+        if not config.twin_q:
+            critic = {"q1": critic["q1"]}  # DDPG: one critic, half the state
 
         def opt0(params):
             return {"mu": jax.tree.map(jnp.zeros_like, params),
@@ -220,19 +237,17 @@ class TD3:
 
     def train(self) -> Dict[str, Any]:
         start = time.perf_counter()
-        prev_rew = float(self._learner["reward_sum"])
-        prev_done = int(self._learner["done_count"])
+        snap = self._episode_snapshot()
         prev_steps = int(self._learner["env_steps"])
         self._learner, self._states, self._rng, metrics = self._train_iter(
             self._learner, self._states, self._rng)
         self._iteration += 1
         steps = int(self._learner["env_steps"]) - prev_steps
-        drew = float(self._learner["reward_sum"]) - prev_rew
-        ddone = max(1, int(self._learner["done_count"]) - prev_done)
+        reward_mean = self._episode_reward_mean(snap)
         return {
             "training_iteration": self._iteration,
             "timesteps_this_iter": steps,
-            "episode_reward_mean": drew / ddone,
+            "episode_reward_mean": reward_mean,
             "time_this_iter_s": time.perf_counter() - start,
             **{k: float(v) for k, v in metrics.items()},
         }

@@ -31,6 +31,7 @@ PEAK = 197.0e12  # v5e bf16
 
 
 def measure(cfg: GPT2Config, batch: int, steps: int = 20, warmup: int = 3):
+    warmup = max(warmup, 1)  # >=1: the post-warmup sync reads metrics
     mesh = build_mesh(MeshConfig(fsdp=-1))
     shardings = gpt2_shardings(cfg, mesh)
     init_fn = make_init_fn(lambda r: gpt2_init(r, cfg), shardings, mesh)

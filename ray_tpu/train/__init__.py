@@ -26,6 +26,7 @@ from ray_tpu.train import session
 from ray_tpu.train import torch as torch_backend
 from ray_tpu.train.torch import TorchConfig, TorchTrainer
 from ray_tpu.train.huggingface import HuggingFaceTrainer
+from ray_tpu.train.sklearn import SklearnTrainer
 from ray_tpu.train.batch_predictor import BatchPredictor, JaxPredictor, Predictor
 
 # Session API at package level too (reference exposes ray.air.session).
@@ -54,6 +55,7 @@ __all__ = [
     "TorchConfig",
     "TorchTrainer",
     "HuggingFaceTrainer",
+    "SklearnTrainer",
     "BatchPredictor",
     "JaxPredictor",
     "Predictor",

@@ -1,0 +1,140 @@
+"""PG / SimpleQ / DDPG / bandits — the round-5 small-family additions.
+
+Each test exercises the algorithm's REASON to exist, not just that it
+runs: PG improves CartPole without any critic; SimpleQ's plain-max
+target still solves CartPole while being measurably more optimistic
+than double-DQN on the same stream; DDPG solves Pendulum with the TD3
+tricks disabled; LinUCB/LinTS drive per-round regret toward zero and
+beat a uniform-random puller.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_tpu.rllib.bandit import (
+    BanditConfig,
+    BanditLinTS,
+    BanditLinUCB,
+    LinearBanditEnv,
+)
+from ray_tpu.rllib.ddpg import DDPG, DDPGConfig
+from ray_tpu.rllib.pg import PG, PGConfig
+from ray_tpu.rllib.simple_q import SimpleQ, SimpleQConfig
+
+
+def test_pg_improves_cartpole():
+    algo = PGConfig().rollouts(num_envs=32, rollout_length=128) \
+        .training(lr=3e-3).debugging(seed=0).build()
+    first = algo.train()["episode_reward_mean"]
+    last = None
+    for _ in range(30):
+        last = algo.train()["episode_reward_mean"]
+        if last > 3 * first and last > 60:
+            break
+    assert last > 3 * first and last > 60, (first, last)
+
+
+def test_pg_has_no_value_net():
+    # The family split from A2C: a PG policy is ONE mlp, no critic pytree.
+    algo = PGConfig().build()
+    assert isinstance(algo._params, list)  # bare mlp layers, no {"pi","vf"}
+
+
+def test_simple_q_solves_cartpole():
+    algo = SimpleQConfig().build()
+    assert algo.config.double_q is False
+    best = 0.0
+    for _ in range(25):
+        best = max(best, algo.train()["episode_reward_mean"])
+        if best > 80:
+            break
+    assert best > 80, best
+
+
+def test_simple_q_target_dominates_double_pointwise():
+    """The structural relation between the two targets: with the SAME
+    target net, SimpleQ's max_a Q_t(s',a) >= Q_t(s', argmax_online) —
+    i.e. dropping double-Q re-admits the overestimating max. Checked on
+    real (online != target) nets from a briefly trained DQN."""
+    from ray_tpu.rllib.dqn import DQNConfig
+    from ray_tpu.rllib.ppo import mlp_apply
+
+    algo = DQNConfig().debugging(seed=3).build()
+    for _ in range(3):
+        algo.train()
+    p, tp = algo._learner["params"], algo._learner["target_params"]
+    obs = jax.random.normal(jax.random.key(0), (256, 4)) * 0.1
+    next_target = mlp_apply(tp, obs)
+    simple = jnp.max(next_target, axis=1)
+    next_act = jnp.argmax(mlp_apply(p, obs), axis=1)
+    double = jnp.take_along_axis(
+        next_target, next_act[:, None], axis=1)[:, 0]
+    assert bool(jnp.all(simple >= double))
+    # And the nets have actually diverged enough that the bound is
+    # strict somewhere (otherwise the test is vacuous).
+    assert float(jnp.max(simple - double)) > 0, "nets identical"
+
+
+def test_ddpg_improves_pendulum():
+    algo = DDPGConfig().debugging(seed=0).build()
+    assert algo.config.twin_q is False and algo.config.policy_delay == 1
+    first = None
+    last = None
+    for i in range(30):
+        r = algo.train()["episode_reward_mean"]
+        if i == 2:
+            first = r        # after warmup, before learning bites
+        last = r
+        if first is not None and last > first + 300:
+            break
+    # Pendulum episodic return rises from ~-1400 toward > -900.
+    assert last > first + 300, (first, last)
+
+
+@pytest.mark.parametrize("cls", [BanditLinUCB, BanditLinTS])
+def test_bandit_regret_shrinks_and_beats_random(cls):
+    env = LinearBanditEnv(num_arms=5, context_dim=8, noise=0.1, seed=1)
+    cfg = BanditConfig().environment(env).debugging(seed=0)
+    algo = cls(cfg)
+    first = algo.train()["regret_this_iter"]
+    for _ in range(5):
+        last = algo.train()["regret_this_iter"]
+    assert last < 0.3 * first, (first, last)
+
+    # Uniform-random baseline regret per round, computed in closed form
+    # over fresh contexts: E[max arm - random arm].
+    rng = jax.random.key(7)
+    xs = jax.random.normal(rng, (512, env.context_dim))
+    means = xs @ env.theta.T
+    rand_regret = float(jnp.mean(jnp.max(means, axis=1)
+                                 - jnp.mean(means, axis=1)))
+    per_round = last / cfg.rounds_per_iter
+    assert per_round < 0.2 * rand_regret, (per_round, rand_regret)
+
+
+def test_bandit_greedy_action_matches_oracle():
+    env = LinearBanditEnv(num_arms=4, context_dim=6, noise=0.05, seed=2)
+    algo = BanditLinUCB(BanditConfig().environment(env))
+    for _ in range(6):
+        algo.train()
+    xs = jax.random.normal(jax.random.key(11), (64, env.context_dim))
+    hits = sum(
+        int(algo.compute_single_action(x) == int(jnp.argmax(env.means(x))))
+        for x in xs)
+    assert hits >= 55, hits
+
+
+def test_algorithm_registry_resolves_all():
+    from ray_tpu.rllib.registry import ALGORITHMS, get_algorithm_class
+
+    for name in ALGORITHMS:
+        cls, cfg_cls = get_algorithm_class(name, return_config=True)
+        assert isinstance(cls, type), name
+        assert isinstance(cfg_cls, type), name
+    # The Tune-style flow: name -> config -> build.
+    cls, cfg_cls = get_algorithm_class("PG", return_config=True)
+    algo = cfg_cls().rollouts(num_envs=4, rollout_length=8).build()
+    assert isinstance(algo, cls)
+    with pytest.raises(ValueError, match="unknown algorithm"):
+        get_algorithm_class("NOPE")

@@ -1,0 +1,53 @@
+"""Decision Transformer: return-conditioned steering on a mixed-quality
+offline CartPole dataset — the SAME model produces near-expert behavior
+when conditioned high and obeys a low target when conditioned low."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.dt import DT, DTConfig, collect_episodes
+
+
+def _expert(obs, rng):
+    return (obs[:, 2] + 0.5 * obs[:, 3] > 0).astype(jnp.int32)
+
+
+def _random(obs, rng):
+    return jax.random.randint(rng, (obs.shape[0],), 0, 2)
+
+
+def _mixed_episodes(max_len=120):
+    exp = collect_episodes(_expert, 24, max_len, seed=0)
+    rnd = collect_episodes(_random, 72, max_len, seed=1)
+    return {k: np.concatenate([exp[k], rnd[k]]) for k in exp}
+
+
+def test_collect_masks_after_done():
+    eps = collect_episodes(_random, 8, 60, seed=3)
+    mask = eps["mask"]
+    # Mask is a prefix: once it drops to 0 it stays 0.
+    assert np.all(np.diff(mask, axis=1) <= 0)
+    # Random CartPole dies well before the horizon.
+    assert mask.sum(1).mean() < 40
+
+
+def test_dt_return_conditioning_steers_behavior():
+    data = _mixed_episodes()
+    behavior_mean = float(data["rewards"].sum(1).mean())
+    best = float(data["rewards"].sum(1).max())
+    cfg = DTConfig().training(
+        context_len=16, updates_per_iter=250, batch_size=64)
+    algo = cfg.build(data)
+    for _ in range(4):
+        r = algo.train()
+    assert r["loss"] < 0.45, r   # mixture CE floor is ~0.3-0.4
+
+    high = algo.evaluate(best, n_episodes=6, max_len=150)
+    low = algo.evaluate(8.0, n_episodes=6, max_len=150)
+    # Conditioned high: recovers (near-)expert behavior from a mixture
+    # whose average is poor; conditioned low: obeys and does poorly.
+    assert high > 2.0 * behavior_mean, (high, behavior_mean)
+    assert high > 60.0, high
+    assert low < 0.6 * high, (low, high)

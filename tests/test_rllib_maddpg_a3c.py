@@ -1,0 +1,82 @@
+"""MADDPG (centralized critics on the spread coverage task) and A3C
+(asynchronous gradient application over worker actors)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.rllib.a3c import A3C, A3CConfig
+from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, MultiAgentSpread
+
+
+def test_spread_env_shapes_and_reward():
+    env = MultiAgentSpread(n_agents=3)
+    s = env.reset(jax.random.key(0))
+    obs = env.obs(s)
+    assert obs.shape == (3, env.observation_size)
+    ns, nobs, rew, done = env.step(
+        s, jnp.zeros((3, 2)), jax.random.key(1))
+    # Shared cooperative reward: identical across agents, negative cost.
+    assert rew.shape == (3,)
+    assert float(jnp.std(rew)) < 1e-6
+    assert float(rew[0]) <= 0.0
+    # Moving every agent onto its landmark zeroes the cost.
+    on_lm = s._replace(pos=s.landmarks)
+    assert float(env._coverage_cost(on_lm.pos, on_lm.landmarks)) == \
+        pytest.approx(0.0)
+
+
+def test_maddpg_learns_coverage():
+    algo = MADDPGConfig().debugging(seed=0).build()
+    rewards = [algo.train()["episode_reward_mean"] for _ in range(40)]
+    # Exploration rollouts are noisy; compare window means. Rewards are
+    # negative costs: early ~-49, trained ~-25 (cost halves).
+    early = np.mean(rewards[:3])
+    late = np.mean(rewards[-5:])
+    assert late > 0.65 * early, (early, late)
+    # Greedy coverage separates cleanly from the untrained-policy (~1.4)
+    # and random-action (~1.5) baselines measured on this env.
+    cov = np.mean([algo.greedy_coverage(jax.random.key(50 + i))
+                   for i in range(8)])
+    assert cov < 1.1, cov
+
+
+def test_maddpg_critic_input_is_centralized():
+    cfg = MADDPGConfig()
+    algo = cfg.build()
+    env = cfg.env
+    n = env.n_agents
+    cin = algo._learner["critics"][0][0]["w"].shape[0]
+    assert cin == n * (env.observation_size + env.action_size)
+    ind = MADDPGConfig().training(centralized=False).build()
+    assert ind._learner["critics"][0][0]["w"].shape[0] == \
+        env.observation_size + env.action_size
+
+
+def test_a3c_async_gradients_improve_cartpole():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    try:
+        algo = A3CConfig().rollouts(
+            num_envs=16, rollout_length=32, num_rollout_workers=2) \
+            .training(lr=2.5e-3).debugging(seed=0).build()
+        first = algo.train()
+        assert first["gradients_applied"] == algo.config.grads_per_iter
+        best = 0.0
+        for _ in range(12):
+            best = max(best, algo.train()["episode_reward_mean"])
+            if best > 60:
+                break
+        assert best > 60, best
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_a3c_without_workers_is_a2c():
+    algo = A3CConfig().rollouts(num_rollout_workers=0).build()
+    r = algo.train()
+    assert "gradients_applied" not in r
+    assert r["training_iteration"] == 1

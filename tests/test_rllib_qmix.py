@@ -1,0 +1,55 @@
+"""QMIX vs VDN on the QMIX paper's two-step game (§6.1): the monotonic
+state-conditioned mixer reaches the coordinated payoff 8 while additive
+VDN — whose factored bootstrap values branch B at a0+b1 < 7 — settles
+for the flat-7 branch. This separation IS the algorithm's reason to
+exist; both runs share every other hyperparameter, with uniform
+exploration (eps fixed at 1.0) as in the paper's representational study
+so the difference is the mixer, not the visitation distribution.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.qmix import QMIX, QMIXConfig, TwoStepGame, TwoStepState
+
+
+def _greedy_return(algo: QMIX) -> float:
+    """Play one greedy episode of the two-step game."""
+    env = algo.config.env
+    s = TwoStepState(jnp.zeros((1,), jnp.int32))
+    total = 0.0
+    for _ in range(2):
+        acts = algo.greedy_actions(s)[0]
+        ns, _, rew, _ = env.step(
+            TwoStepState(s.phase[0]), acts, jax.random.key(0))
+        total += float(rew[0])
+        s = TwoStepState(ns.phase[None])
+    return total
+
+
+def _train(mixer: str, seed: int) -> "QMIX":
+    algo = QMIXConfig().training(
+        mixer=mixer, epsilon_start=1.0, epsilon_end=1.0,
+        lr=5e-3, updates_per_iter=64).debugging(seed=seed).build()
+    for _ in range(25):
+        algo.train()
+    return algo
+
+
+def test_qmix_reaches_8_vdn_stuck_at_7():
+    qmix_ret = _greedy_return(_train("qmix", seed=0))
+    vdn_ret = _greedy_return(_train("vdn", seed=0))
+    assert qmix_ret == 8.0, qmix_ret
+    assert vdn_ret == 7.0, vdn_ret
+
+
+def test_mixer_is_monotone_in_agent_utilities():
+    algo = QMIXConfig().build()
+    mp = algo._learner["params"]["mixer"]
+    from ray_tpu.rllib.qmix import _mixer_apply
+    state = jnp.eye(3)[None, 2].repeat(4, axis=0)
+    base = jnp.array([[1.0, 1.0]] * 4)
+    bump = base.at[:, 0].add(0.5)
+    q0 = _mixer_apply(mp, base, state, 2, algo.config.mixing_embed)
+    q1 = _mixer_apply(mp, bump, state, 2, algo.config.mixing_embed)
+    assert bool(jnp.all(q1 >= q0 - 1e-6))

@@ -1,0 +1,78 @@
+"""SklearnTrainer: remote fit, parallel CV fan-out, checkpointed
+estimator, extra-dataset scoring (reference
+``python/ray/train/sklearn/sklearn_trainer.py`` surface)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import SklearnTrainer
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _blobs(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(int)
+    return x, y
+
+
+def test_fit_and_checkpoint_roundtrip():
+    from sklearn.linear_model import LogisticRegression
+
+    x, y = _blobs()
+    result = SklearnTrainer(
+        estimator=LogisticRegression(max_iter=200),
+        datasets={"train": (x[:300], y[:300]), "valid": (x[300:], y[300:])},
+    ).fit()
+    assert result.metrics["valid_score"] > 0.9
+    est = result.checkpoint.to_dict()["estimator"]
+    assert est.score(x[300:], y[300:]) > 0.9
+
+
+def test_parallel_cv_scores():
+    from sklearn.tree import DecisionTreeClassifier
+
+    x, y = _blobs(seed=1)
+    result = SklearnTrainer(
+        estimator=DecisionTreeClassifier(max_depth=4),
+        datasets={"train": (x, y)},
+        cv=4,
+    ).fit()
+    cv = result.metrics["cv"]
+    assert len(cv["test_score"]) == 4
+    assert cv["test_score_mean"] > 0.8
+    assert cv["test_score_std"] < 0.2
+
+
+def test_dataframe_datasets_via_label_column():
+    pd = pytest.importorskip("pandas")
+    from sklearn.linear_model import LogisticRegression
+
+    x, y = _blobs(seed=2)
+    df = pd.DataFrame(x, columns=[f"f{i}" for i in range(4)])
+    df["label"] = y
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items(df.to_dict("records"))
+    result = SklearnTrainer(
+        estimator=LogisticRegression(max_iter=200),
+        datasets={"train": ds},
+        label_column="label",
+    ).fit()
+    assert "fit_time" in result.metrics
+
+
+def test_requires_train_dataset():
+    from sklearn.linear_model import LogisticRegression
+
+    with pytest.raises(ValueError, match="train"):
+        SklearnTrainer(
+            estimator=LogisticRegression(), datasets={"valid": ([], [])})
