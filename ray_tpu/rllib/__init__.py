@@ -27,7 +27,11 @@ algorithm families:
 * Decision Transformer — offline RL as return-conditioned sequence
   modeling (a control-sized causal GPT);
 * LinUCB / LinTS contextual bandits — closed-form posterior updates as
-  one jitted scan.
+  one jitted scan;
+* AlphaZero — PUCT MCTS self-play (host tree, batched leaf evals on
+  device) + policy-value net, tactical tests exact on TicTacToe;
+* CRR — critic-regularized regression, the continuous offline member
+  (binary/exp advantage weighting vs its BC ablation).
 The execution model (jit the whole train iteration; actors only for
 off-device sampling) is the part of the reference's ~30 algorithms that
 generalizes.
@@ -73,6 +77,7 @@ from ray_tpu.rllib.offline_algos import (
     MARWIL,
     MARWILConfig,
 )
+from ray_tpu.rllib.alpha_zero import AlphaZero, AlphaZeroConfig, TicTacToe
 from ray_tpu.rllib.apex import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.bandit import (
     BanditConfig,
@@ -80,6 +85,7 @@ from ray_tpu.rllib.bandit import (
     BanditLinUCB,
     LinearBanditEnv,
 )
+from ray_tpu.rllib.crr import CRR, CRRConfig
 from ray_tpu.rllib.ddpg import DDPG, DDPGConfig
 from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, MultiAgentSpread
 from ray_tpu.rllib.dt import DT, DTConfig, collect_episodes
@@ -160,8 +166,13 @@ __all__ = [
     "OfflineDQN",
     "collect_transitions",
     "read_sample_batches",
+    "AlphaZero",
+    "AlphaZeroConfig",
+    "TicTacToe",
     "ApexDQN",
     "ApexDQNConfig",
+    "CRR",
+    "CRRConfig",
     "BanditConfig",
     "BanditLinTS",
     "BanditLinUCB",

@@ -1,0 +1,458 @@
+"""AlphaZero: MCTS self-play + policy-value network (reference
+``rllib/algorithms/alpha_zero/``, after Silver et al. 2017). The
+reference runs a numpy MCTS per rollout worker around a torch net
+(``alpha_zero/mcts.py``); the structure here is the same host/device
+split done the jax way — the TREE lives on the host (python dicts of
+small numpy arrays; pointer-chasing is host work), while every leaf
+evaluation crosses to the device BATCHED: all parallel self-play games
+advance their searches in lockstep, so one jitted net call serves one
+leaf per game per simulation instead of a call per leaf.
+
+Pieces: PUCT selection with Dirichlet root noise, visit-count policy
+targets with a temperature cutoff, value targets from the game outcome
+propagated with alternating signs, CE + MSE + L2 training on a replay
+window of recent games, and a canonical-board representation (the board
+always from the player-to-move's perspective) so one net plays both
+sides.
+
+``TicTacToe`` is the acceptance game: small enough that the tactical
+unit tests are exact (an untrained net's MCTS must already find a
+mate-in-1 — tree search, not the net, supplies tactics), and large
+enough that self-play measurably improves play vs. random and 1-ply
+opponents.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.optim import adam_init, adam_step
+from ray_tpu.rllib.ppo import mlp_init
+
+__all__ = ["AlphaZero", "AlphaZeroConfig", "TicTacToe", "MCTS"]
+
+
+class TicTacToe:
+    """3x3; board is a length-9 int8 array in {+1 (to move), -1, 0} —
+    CANONICAL: always from the perspective of the player to move."""
+
+    n_actions = 9
+    obs_size = 9
+    max_moves = 9
+    _LINES = np.array([
+        [0, 1, 2], [3, 4, 5], [6, 7, 8],
+        [0, 3, 6], [1, 4, 7], [2, 5, 8],
+        [0, 4, 8], [2, 4, 6]])
+
+    def initial_state(self) -> np.ndarray:
+        return np.zeros(9, np.int8)
+
+    def legal_mask(self, board: np.ndarray) -> np.ndarray:
+        return board == 0
+
+    def next_state(self, board: np.ndarray, action: int) -> np.ndarray:
+        """Play for the player to move (+1), then flip perspective."""
+        nxt = board.copy()
+        nxt[action] = 1
+        return -nxt
+
+    def terminal_value(self, board: np.ndarray) -> Optional[float]:
+        """From the PLAYER TO MOVE's perspective: -1 if the opponent
+        (who just moved) completed a line, 0 for a draw, None if the
+        game continues."""
+        sums = board[self._LINES].sum(axis=1)
+        if (sums == -3).any():
+            return -1.0
+        if (board != 0).all():
+            return 0.0
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the net: canonical board -> (move logits, value in [-1, 1])
+# ---------------------------------------------------------------------------
+
+
+def _net_init(rng, obs_size: int, n_actions: int, hidden):
+    kt, kp, kv = jax.random.split(rng, 3)
+    return {
+        "trunk": mlp_init(kt, (obs_size, *hidden)),
+        "pi": mlp_init(kp, (hidden[-1], n_actions)),
+        "v": mlp_init(kv, (hidden[-1], 1)),
+    }
+
+
+def _net_apply(params, boards):
+    x = boards
+    for layer in params["trunk"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"][0]["w"] + params["pi"][0]["b"]
+    value = jnp.tanh(x @ params["v"][0]["w"] + params["v"][0]["b"])[..., 0]
+    return logits, value
+
+
+# ---------------------------------------------------------------------------
+# MCTS (host): PUCT tree over canonical boards
+# ---------------------------------------------------------------------------
+
+
+class _Node:
+    __slots__ = ("prior", "children", "n", "w")
+
+    def __init__(self, prior: float):
+        self.prior = prior
+        self.children: Dict[int, "_Node"] = {}
+        self.n = 0
+        self.w = 0.0
+
+    @property
+    def q(self) -> float:
+        return self.w / self.n if self.n else 0.0
+
+
+class MCTS:
+    """One search tree; ``run_batch`` advances many trees in lockstep so
+    leaf evaluations batch into single device calls."""
+
+    def __init__(self, game, c_puct: float = 1.5,
+                 dirichlet_alpha: float = 0.6, noise_frac: float = 0.25):
+        self.game = game
+        self.c_puct = c_puct
+        self.alpha = dirichlet_alpha
+        self.noise_frac = noise_frac
+
+    def _select(self, root: _Node, board: np.ndarray
+                ) -> Tuple[List[Tuple[_Node, int]], np.ndarray,
+                           Optional[float]]:
+        """Walk PUCT to a leaf; returns (path, leaf board, terminal value
+        at the leaf from its player-to-move's perspective or None)."""
+        node, path = root, []
+        while True:
+            term = self.game.terminal_value(board)
+            if term is not None:
+                return path, board, term
+            if not node.children:
+                return path, board, None
+            total_n = max(1, sum(c.n for c in node.children.values()))
+            best, best_score = None, -np.inf
+            for a, child in node.children.items():
+                u = self.c_puct * child.prior * np.sqrt(total_n) / \
+                    (1 + child.n)
+                # child.q is from the CHILD's player perspective: negate.
+                score = -child.q + u
+                if score > best_score:
+                    best, best_score = a, score
+            path.append((node, best))
+            node = node.children[best]
+            board = self.game.next_state(board, best)
+
+    def _backprop(self, path, value: float) -> None:
+        """``value`` is from the LEAF's player-to-move perspective; node
+        n_j on the chain root->leaf sees it as value * (-1)^(k-j). Each
+        child node stores (n, w) from its OWN perspective — which is why
+        selection scores ``-child.q`` for the parent's mover."""
+        chain = [parent.children[action] for parent, action in path]
+        k = len(chain)
+        for j, child in enumerate(chain, start=1):
+            child.w += value * ((-1.0) ** (k - j))
+            child.n += 1
+
+    def run_batch(self, params, boards: List[np.ndarray],
+                  n_simulations: int, rng: np.random.Generator,
+                  add_noise: bool = True) -> List[np.ndarray]:
+        """For each board, run ``n_simulations`` and return visit-count
+        vectors [n_actions]. All trees advance in lockstep; leaf net
+        evaluations are one batched device call per simulation round."""
+        game = self.game
+        n_act = game.n_actions
+        roots = [_Node(0.0) for _ in boards]
+
+        # Root expansion: one batched eval.
+        logits, _ = _net_apply(params, jnp.asarray(
+            np.stack(boards).astype(np.float32)))
+        logits = np.asarray(logits)
+        for i, (root, board) in enumerate(zip(roots, boards)):
+            mask = game.legal_mask(board)
+            p = _masked_softmax(logits[i], mask)
+            if add_noise:
+                noise = rng.dirichlet([self.alpha] * int(mask.sum()))
+                p_noisy = p.copy()
+                p_noisy[mask] = (1 - self.noise_frac) * p[mask] + \
+                    self.noise_frac * noise
+                p = p_noisy
+            for a in np.flatnonzero(mask):
+                root.children[int(a)] = _Node(float(p[a]))
+
+        for _ in range(n_simulations):
+            paths, leaf_boards, terms, idxs = [], [], [], []
+            for i, (root, board) in enumerate(zip(roots, boards)):
+                path, leaf, term = self._select(root, board.copy())
+                paths.append(path)
+                terms.append(term)
+                if term is None:
+                    idxs.append(i)
+                    leaf_boards.append(leaf)
+            if leaf_boards:
+                logits, values = _net_apply(params, jnp.asarray(
+                    np.stack(leaf_boards).astype(np.float32)))
+                logits, values = np.asarray(logits), np.asarray(values)
+            li = 0
+            for i in range(len(boards)):
+                path, term = paths[i], terms[i]
+                if term is None:
+                    leaf = leaf_boards[li]
+                    mask = game.legal_mask(leaf)
+                    p = _masked_softmax(logits[li], mask)
+                    # Expand the leaf.
+                    if path:
+                        leaf_node = path[-1][0].children[path[-1][1]]
+                    else:
+                        leaf_node = roots[i]
+                    if not leaf_node.children:
+                        for a in np.flatnonzero(mask):
+                            leaf_node.children[int(a)] = _Node(float(p[a]))
+                    value = float(values[li])
+                    li += 1
+                else:
+                    value = term
+                self._backprop(path, value)
+
+        visits = []
+        for root in roots:
+            v = np.zeros(n_act)
+            for a, child in root.children.items():
+                v[a] = child.n
+            visits.append(v)
+        return visits
+
+
+def _masked_softmax(logits: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    x = np.where(mask, logits, -1e9)
+    x = x - x.max()
+    e = np.exp(x) * mask
+    s = e.sum()
+    return e / s if s > 0 else mask / max(1, mask.sum())
+
+
+# ---------------------------------------------------------------------------
+# the algorithm
+# ---------------------------------------------------------------------------
+
+
+class AlphaZeroConfig:
+    """Builder-style config (``AlphaZeroConfig().training(...)``)."""
+
+    def __init__(self):
+        self.game = TicTacToe()
+        self.games_per_iter = 16
+        self.num_simulations = 48
+        self.temperature_moves = 4   # sample ~ N^1 before, argmax after
+        self.buffer_games = 256
+        self.batch_size = 128
+        self.updates_per_iter = 48
+        self.lr = 3e-3
+        self.l2 = 1e-4
+        self.hidden = (64, 64)
+        self.c_puct = 1.5
+        self.dirichlet_alpha = 0.6
+        self.noise_frac = 0.25
+        self.seed = 0
+
+    def environment(self, game=None) -> "AlphaZeroConfig":
+        if game is not None:
+            self.game = game
+        return self
+
+    def training(self, **kwargs) -> "AlphaZeroConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown AlphaZero option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlphaZeroConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "AlphaZero":
+        return AlphaZero(self)
+
+
+class AlphaZero:
+    """Algorithm (Trainable contract: ``.train()`` -> result dict)."""
+
+    def __init__(self, config: AlphaZeroConfig):
+        self.config = config
+        game = config.game
+        k_param, _ = jax.random.split(jax.random.key(config.seed))
+        self.params = _net_init(
+            k_param, game.obs_size, game.n_actions, config.hidden)
+        self.opt = adam_init(self.params)
+        self._rng = np.random.default_rng(config.seed)
+        self._mcts = MCTS(game, config.c_puct, config.dirichlet_alpha,
+                          config.noise_frac)
+        self._examples: List[Tuple[np.ndarray, np.ndarray, float]] = []
+        self._iteration = 0
+        self._update = self._build_update()
+
+    def _build_update(self):
+        cfg = self.config
+
+        def loss_fn(params, boards, pis, zs):
+            logits, values = _net_apply(params, boards)
+            ce = -jnp.mean(jnp.sum(
+                pis * jax.nn.log_softmax(logits), axis=1))
+            mse = jnp.mean((values - zs) ** 2)
+            l2 = sum(jnp.sum(l["w"] ** 2)
+                     for l in jax.tree.leaves(
+                         params, is_leaf=lambda x: isinstance(x, dict)
+                         and "w" in x))
+            return ce + mse + cfg.l2 * l2
+
+        @jax.jit
+        def update(params, opt, boards, pis, zs):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, boards, pis, zs)
+            params, opt = adam_step(params, opt, grads, lr=cfg.lr)
+            return params, opt, loss
+
+        return update
+
+    # -- self-play ------------------------------------------------------
+
+    def _self_play(self) -> Tuple[int, float]:
+        """Play ``games_per_iter`` games in lockstep; append (board, pi,
+        z) examples. Returns (n_examples, mean_game_len)."""
+        cfg, game = self.config, self.config.game
+        G = cfg.games_per_iter
+        boards = [game.initial_state() for _ in range(G)]
+        histories: List[List[Tuple[np.ndarray, np.ndarray]]] = \
+            [[] for _ in range(G)]
+        results: List[Optional[float]] = [None] * G  # z for player 0
+        move_no = 0
+        live = list(range(G))
+        # Track each game's perspective parity: board is canonical, so
+        # z flips sign per move when assigned at the end.
+        while live:
+            live_boards = [boards[i] for i in live]
+            visits = self._mcts.run_batch(
+                self.params, live_boards, cfg.num_simulations, self._rng)
+            next_live = []
+            for j, i in enumerate(live):
+                v = visits[j]
+                pi = v / v.sum()
+                histories[i].append((boards[i].copy(), pi))
+                if move_no < cfg.temperature_moves:
+                    a = int(self._rng.choice(game.n_actions, p=pi))
+                else:
+                    a = int(np.argmax(v))
+                boards[i] = game.next_state(boards[i], a)
+                term = game.terminal_value(boards[i])
+                if term is not None:
+                    # term: perspective of the player to move AFTER the
+                    # final move; the player who made move k sees
+                    # (-term) if an odd number of flips separate them.
+                    n_moves = len(histories[i])
+                    for k, (b, p) in enumerate(histories[i]):
+                        # mover at step k is (n_moves - k) flips before
+                        # the terminal perspective.
+                        sign = -1.0 if (n_moves - k) % 2 == 1 else 1.0
+                        self._examples.append((b, p, sign * term))
+                    results[i] = term
+                else:
+                    next_live.append(i)
+            live = next_live
+            move_no += 1
+
+        # Trim the example window to the most recent games.
+        max_examples = cfg.buffer_games * getattr(
+            game, "max_moves", game.n_actions)
+        if len(self._examples) > max_examples:
+            self._examples = self._examples[-max_examples:]
+        lens = [len(h) for h in histories]
+        return sum(lens), float(np.mean(lens))
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        cfg = self.config
+        n_new, mean_len = self._self_play()
+        losses = []
+        n = len(self._examples)
+        for _ in range(cfg.updates_per_iter):
+            idx = self._rng.integers(0, n, min(cfg.batch_size, n))
+            boards = jnp.asarray(np.stack(
+                [self._examples[i][0] for i in idx]).astype(np.float32))
+            pis = jnp.asarray(np.stack(
+                [self._examples[i][1] for i in idx]).astype(np.float32))
+            zs = jnp.asarray(np.asarray(
+                [self._examples[i][2] for i in idx], np.float32))
+            self.params, self.opt, loss = self._update(
+                self.params, self.opt, boards, pis, zs)
+            losses.append(float(loss))
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter": n_new,
+            "mean_game_length": mean_len,
+            "loss": float(np.mean(losses)),
+            "examples": n,
+            "time_this_iter_s": time.perf_counter() - start,
+        }
+
+    # -- evaluation -----------------------------------------------------
+
+    def compute_action(self, board: np.ndarray,
+                       num_simulations: Optional[int] = None) -> int:
+        visits = self._mcts.run_batch(
+            self.params, [board],
+            num_simulations or self.config.num_simulations,
+            self._rng, add_noise=False)[0]
+        return int(np.argmax(visits))
+
+    def play_vs(self, opponent_fn, as_first: bool, rng) -> float:
+        """One game vs ``opponent_fn(board, rng) -> action``; returns
+        +1 win / 0 draw / -1 loss from OUR perspective."""
+        game = self.config.game
+        board = game.initial_state()
+        our_turn = as_first
+        while True:
+            if our_turn:
+                a = self.compute_action(board)
+            else:
+                a = opponent_fn(board, rng)
+            board = game.next_state(board, a)
+            term = game.terminal_value(board)
+            if term is not None:
+                # term is from the NEXT player's perspective; the mover
+                # just played, so mover sees -term.
+                mover_score = -term
+                return mover_score if our_turn else -mover_score
+            our_turn = not our_turn
+
+
+def random_player(board: np.ndarray, rng) -> int:
+    return int(rng.choice(np.flatnonzero(board == 0)))
+
+
+def one_ply_player(board: np.ndarray, rng) -> int:
+    """Takes an immediate win if present, else blocks an immediate
+    opponent win, else random — the classic 1-ply heuristic."""
+    game = TicTacToe()
+    legal = np.flatnonzero(board == 0)
+    for a in legal:
+        # terminal_value is from the NEXT player's view: -1 == we won.
+        if game.terminal_value(game.next_state(board, int(a))) == -1.0:
+            return int(a)
+    for a in legal:
+        pretend = board.copy()
+        pretend[a] = -1   # what if the opponent got this square?
+        if (pretend[TicTacToe._LINES].sum(axis=1) == -3).any():
+            return int(a)  # block
+    return int(rng.choice(legal))

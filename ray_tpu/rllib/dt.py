@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.rllib.env import CartPole
+from ray_tpu.rllib.optim import adam_init
 from ray_tpu.rllib.optim import adam_step as _adam
 
 __all__ = ["DT", "DTConfig", "collect_episodes"]
@@ -208,9 +209,7 @@ class DT:
         k_param, self._rng = jax.random.split(rng)
         self.params = _dt_init(
             k_param, config, env.observation_size, env.num_actions)
-        self.opt = {"mu": jax.tree.map(jnp.zeros_like, self.params),
-                    "nu": jax.tree.map(jnp.zeros_like, self.params),
-                    "t": jnp.zeros((), jnp.int32)}
+        self.opt = adam_init(self.params)
 
         # Precompute per-episode returns-to-go (gamma = 1, as the paper).
         rew, mask = episodes["rewards"], episodes["mask"]

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.rllib.algorithm import EpisodeStats
+from ray_tpu.rllib.optim import adam_init
 from ray_tpu.rllib.optim import adam_step as _adam
 from ray_tpu.rllib.ppo import mlp_apply, mlp_init
 from ray_tpu.rllib.replay import buffer_add, buffer_init, buffer_sample
@@ -292,18 +293,13 @@ class MADDPG(EpisodeStats):
                             (cin, *config.hidden_sizes, 1))
                    for i in range(n)]
 
-        def opt0(p):
-            return {"mu": jax.tree.map(jnp.zeros_like, p),
-                    "nu": jax.tree.map(jnp.zeros_like, p),
-                    "t": jnp.zeros((), jnp.int32)}
-
         self._learner = {
             "actors": actors,
             "critics": critics,
             "target_actors": jax.tree.map(jnp.copy, actors),
             "target_critics": jax.tree.map(jnp.copy, critics),
-            "aopts": [opt0(a) for a in actors],
-            "copts": [opt0(c) for c in critics],
+            "aopts": [adam_init(a) for a in actors],
+            "copts": [adam_init(c) for c in critics],
             "buffer": buffer_init(
                 config.buffer_size,
                 {"obs": (n, obs_size), "act": (n, act_size),

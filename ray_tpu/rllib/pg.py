@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.rllib.env import CartPole, make_vec_env
+from ray_tpu.rllib.optim import adam_init
 from ray_tpu.rllib.optim import adam_step as _adam
 from ray_tpu.rllib.ppo import mlp_apply, mlp_init
 
@@ -142,9 +143,7 @@ class PG:
         self._params = mlp_init(
             k_param, (env.observation_size, *config.hidden_sizes,
                       env.num_actions))
-        self._opt = {"mu": jax.tree.map(jnp.zeros_like, self._params),
-                     "nu": jax.tree.map(jnp.zeros_like, self._params),
-                     "t": jnp.zeros((), jnp.int32)}
+        self._opt = adam_init(self._params)
         self._baseline = jnp.zeros(())
         self._reset, self._train_iter = _make_train_iter(config)
         self._states = self._reset(k_env)

@@ -24,6 +24,7 @@ def _lazy(module: str, algo: str, config: str) -> Callable:
 
 ALGORITHMS = {
     "A2C": _lazy("a2c", "A2C", "A2CConfig"),
+    "AlphaZero": _lazy("alpha_zero", "AlphaZero", "AlphaZeroConfig"),
     "A3C": _lazy("a3c", "A3C", "A3CConfig"),
     "APPO": _lazy("appo", "APPO", "APPOConfig"),
     "ARS": _lazy("es", "ARS", "ARSConfig"),
@@ -32,6 +33,7 @@ ALGORITHMS = {
     "BanditLinTS": _lazy("bandit", "BanditLinTS", "BanditConfig"),
     "BanditLinUCB": _lazy("bandit", "BanditLinUCB", "BanditConfig"),
     "CQL": _lazy("offline_algos", "CQL", "MARWILConfig"),
+    "CRR": _lazy("crr", "CRR", "CRRConfig"),
     "DDPG": _lazy("ddpg", "DDPG", "DDPGConfig"),
     "DQN": _lazy("dqn", "DQN", "DQNConfig"),
     "DT": _lazy("dt", "DT", "DTConfig"),

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from ray_tpu.rllib.algorithm import EpisodeStats
 from ray_tpu.rllib.env import Pendulum, make_vec_env
+from ray_tpu.rllib.optim import adam_init
 from ray_tpu.rllib.optim import adam_step as _adam
 from ray_tpu.rllib.ppo import mlp_apply, mlp_init
 from ray_tpu.rllib.replay import buffer_add, buffer_init, buffer_sample
@@ -210,18 +211,13 @@ class TD3(EpisodeStats):
         if not config.twin_q:
             critic = {"q1": critic["q1"]}  # DDPG: one critic, half the state
 
-        def opt0(params):
-            return {"mu": jax.tree.map(jnp.zeros_like, params),
-                    "nu": jax.tree.map(jnp.zeros_like, params),
-                    "t": jnp.zeros((), jnp.int32)}
-
         self._learner = {
             "actor": actor,
             "critic": critic,
             "target_actor": jax.tree.map(jnp.copy, actor),
             "target_critic": jax.tree.map(jnp.copy, critic),
-            "aopt": opt0(actor),
-            "copt": opt0(critic),
+            "aopt": adam_init(actor),
+            "copt": adam_init(critic),
             "buffer": buffer_init(
                 config.buffer_size,
                 {"obs": (obs_size,), "act": (act_size,), "rew": (),

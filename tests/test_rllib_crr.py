@@ -1,0 +1,88 @@
+"""CRR on mixed-quality offline Pendulum data: the critic-weighted
+regression recovers near-expert control from a mostly-random mixture
+while plain BC (the f==1 ablation of the same program) clones the
+mixture and stays poor — the separation that justifies the algorithm."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.crr import CRR, CRRConfig
+from ray_tpu.rllib.env import Pendulum
+
+
+def _expert(obs):
+    cos, sin, dot = obs[:, 0], obs[:, 1], obs[:, 2]
+    th = jnp.arctan2(sin, cos)
+    energy = 0.5 * dot ** 2 + 15.0 * cos
+    pump = jnp.clip(0.6 * (15.0 - energy) * jnp.sign(dot + 1e-3), -2, 2)
+    pd = jnp.clip(-10.0 * th - 2.0 * dot, -2, 2)
+    return jnp.where(cos > 0.85, pd, pump)[:, None]
+
+
+def _collect(policy_fn, n_envs, n_steps, seed):
+    env = Pendulum()
+    vreset = jax.vmap(env.reset)
+    vobs = jax.vmap(env.obs)
+    vstep = jax.vmap(env.step)
+
+    @jax.jit
+    def rollout(rng):
+        states = vreset(jax.random.split(rng, n_envs))
+
+        def step(carry, _):
+            states, rng = carry
+            rng, k_p, k_s = jax.random.split(rng, 3)
+            obs = vobs(states)
+            act = policy_fn(obs, k_p)
+            nstates, nobs, rew, done = vstep(
+                states, act, jax.random.split(k_s, n_envs))
+            # Time-limit-only env: store done=0, bootstrap through.
+            out = {"obs": obs, "act": act, "rew": rew, "nobs": nobs,
+                   "done": jnp.zeros_like(rew)}
+            return (nstates, rng), out
+
+        _, traj = jax.lax.scan(step, (states, jax.random.fold_in(rng, 1)),
+                               None, length=n_steps)
+        return traj
+
+    traj = rollout(jax.random.key(seed))
+    return {k: np.asarray(v).reshape(-1, *np.asarray(v).shape[2:])
+            for k, v in traj.items()}
+
+
+def _mixed_dataset():
+    exp = _collect(lambda o, k: _expert(o), 8, 200, seed=0)
+    rnd = _collect(
+        lambda o, k: jax.random.uniform(k, (o.shape[0], 1),
+                                        minval=-2.0, maxval=2.0),
+        32, 200, seed=1)
+    return {k: np.concatenate([exp[k], rnd[k]]) for k in exp}
+
+
+def _train_eval(mode: str, data) -> float:
+    algo = CRRConfig().training(mode=mode).debugging(seed=0).build(data)
+    for _ in range(8):
+        r = algo.train()
+    if mode == "binary":
+        # The indicator must be selective: neither all-zero nor all-one.
+        assert 0.05 < r["weight_mean"] < 0.95, r
+    return algo.evaluate(n_episodes=4)
+
+
+def test_crr_binary_beats_bc_on_mixture():
+    data = _mixed_dataset()
+    crr_ret = _train_eval("binary", data)
+    bc_ret = _train_eval("bc", data)
+    # Behavior mean is ~-1090 (20% expert at -140, 80% random at -1330);
+    # measured: binary ~-300, bc ~-1070.
+    assert crr_ret > -550, crr_ret
+    assert crr_ret > bc_ret + 300, (crr_ret, bc_ret)
+
+
+def test_crr_exp_mode_also_learns():
+    data = _mixed_dataset()
+    ret = _train_eval("exp", data)
+    assert ret > -600, ret
