@@ -31,7 +31,16 @@ algorithm families:
 * AlphaZero — PUCT MCTS self-play (host tree, batched leaf evals on
   device) + policy-value net, tactical tests exact on TicTacToe;
 * CRR — critic-regularized regression, the continuous offline member
-  (binary/exp advantage weighting vs its BC ablation).
+  (binary/exp advantage weighting vs its BC ablation);
+* MAML — meta-learned initialization whose inner PG adaptation is a
+  literal ``grad`` composed under the outer ``grad`` (second-order
+  term included), vmapped over the task batch;
+* DD-PPO — decentralized PPO: no central learner, per-rank minibatch
+  gradients allreduced through util.collective, parameters
+  bit-identical across ranks by construction;
+* SlateQ — slate recommendation through the user-choice-model Q
+  decomposition; its gamma=0 ablation falls into the clickbait trap
+  (worse than random) while SlateQ sustains the user state.
 The execution model (jit the whole train iteration; actors only for
 off-device sampling) is the part of the reference's ~30 algorithms that
 generalizes.
@@ -87,13 +96,16 @@ from ray_tpu.rllib.bandit import (
 )
 from ray_tpu.rllib.crr import CRR, CRRConfig
 from ray_tpu.rllib.ddpg import DDPG, DDPGConfig
+from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, MultiAgentSpread
+from ray_tpu.rllib.maml import MAML, MAMLConfig, PointGoalTasks
 from ray_tpu.rllib.dt import DT, DTConfig, collect_episodes
 from ray_tpu.rllib.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.qmix import QMIX, QMIXConfig, TwoStepGame
 from ray_tpu.rllib.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.simple_q import SimpleQ, SimpleQConfig
+from ray_tpu.rllib.slateq import SlateDocEnv, SlateQ, SlateQConfig
 from ray_tpu.rllib.evaluation import EvalWorker, EvaluationWorkerSet
 from ray_tpu.rllib.models import ModelCatalog
 from ray_tpu.rllib.registry import get_algorithm_class, get_algorithm_config
@@ -123,6 +135,9 @@ __all__ = [
     "MADDPG",
     "MADDPGConfig",
     "MultiAgentSpread",
+    "MAML",
+    "MAMLConfig",
+    "PointGoalTasks",
     "TD3",
     "TD3Config",
     "CartPole",
@@ -179,6 +194,8 @@ __all__ = [
     "LinearBanditEnv",
     "DDPG",
     "DDPGConfig",
+    "DDPPO",
+    "DDPPOConfig",
     "DT",
     "DTConfig",
     "collect_episodes",
@@ -191,6 +208,9 @@ __all__ = [
     "R2D2Config",
     "SimpleQ",
     "SimpleQConfig",
+    "SlateQ",
+    "SlateQConfig",
+    "SlateDocEnv",
     "get_algorithm_class",
     "get_algorithm_config",
 ]

@@ -1,0 +1,215 @@
+"""DD-PPO: decentralized distributed PPO (reference
+``rllib/algorithms/ddppo/ddppo.py``, after Wijmans et al. 2019). The
+architecture inverts the Sebulba learner/worker split the other PPO
+path uses: there is NO central learner and sample batches never move.
+Each worker rolls out on its own envs, computes gradients on its own
+minibatches, ALLREDUCES the gradients with its peers (the reference
+rides torch.distributed; here it is ``ray_tpu.util.collective`` over
+the object plane — the same group API the XLA in-mesh path shares),
+and applies the identical averaged update locally. Parameters start
+identical (same init seed) and stay bit-identical by construction —
+asserted in the tests, because that invariant IS the algorithm.
+
+Gradients cross the wire as ONE ravelled vector per minibatch
+(``jax.flatten_util.ravel_pytree``) rather than a call per leaf.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.rllib.ppo import PPOConfig, _make_train_iter, policy_apply, \
+    policy_init, ppo_surrogate_loss
+from ray_tpu.rllib.optim import adam_init
+from ray_tpu.rllib.optim import adam_step as _adam
+from ray_tpu.util import collective
+
+__all__ = ["DDPPO", "DDPPOConfig"]
+
+
+class DDPPOConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_workers = 2
+        self.group_name = "ddppo"
+
+    def build(self) -> "DDPPO":
+        return DDPPO(self)
+
+
+class DDPPOWorker:
+    """One decentralized rank: rollout, local minibatch grads, peer
+    allreduce, local apply."""
+
+    def __init__(self, cfg_dict: dict, rank: int, world_size: int,
+                 group_name: str, seed: int):
+        cfg = PPOConfig()
+        for k, v in cfg_dict.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        cfg.num_rollout_workers = 0
+        self.cfg = cfg
+        self.rank, self.world = rank, world_size
+        collective.init_collective_group(
+            world_size, rank, group_name=group_name)
+        self.group = group_name
+
+        (self._reset, _, _, sample, gae, self._vobs) = \
+            _make_train_iter(cfg)
+        # The PPO factory only ever runs these inside its own jitted
+        # train_iter; here they are called directly, so jit them once.
+        self._sample = jax.jit(sample)
+        self._gae = jax.jit(gae)
+        self._policy_apply = jax.jit(policy_apply)
+        env = cfg.env
+        # SAME param seed on every rank — the decentralized invariant.
+        self.params = policy_init(
+            jax.random.key(seed), env.observation_size, env.num_actions,
+            cfg.hidden_sizes)
+        self.opt = adam_init(self.params)
+        # Per-rank env/rollout seeds (the data is what differs).
+        self.rng = jax.random.key(seed + 1000 + rank)
+        self.states = self._reset(jax.random.key(seed + 2000 + rank))
+
+        from jax.flatten_util import ravel_pytree
+
+        flat0, self._unravel = ravel_pytree(self.params)
+        self._grad_size = flat0.shape[0]
+
+        def mb_grads(params, batch):
+            (_, aux), grads = jax.value_and_grad(
+                ppo_surrogate_loss, has_aux=True)(
+                params, batch, clip_param=cfg.clip_param,
+                vf_coeff=cfg.vf_coeff, entropy_coeff=cfg.entropy_coeff)
+            return ravel_pytree(grads)[0], aux
+
+        self._mb_grads = jax.jit(mb_grads)
+        self._apply = jax.jit(
+            lambda p, o, g: _adam(p, o, self._unravel(g), lr=cfg.lr,
+                                  max_grad_norm=cfg.grad_clip, eps=1e-5))
+
+    def train_iter(self) -> dict:
+        cfg = self.cfg
+        self.states, self.rng, traj = self._sample(
+            self.params, self.states, self.rng)
+        _, last_value = self._policy_apply(
+            self.params, self._vobs(self.states))
+        advs, returns = self._gae(traj, last_value)
+        env = cfg.env
+        flat = {
+            "obs": traj["obs"].reshape(-1, env.observation_size),
+            "actions": traj["actions"].reshape(-1),
+            "logp": traj["logp"].reshape(-1),
+            "adv": advs.reshape(-1),
+            "returns": returns.reshape(-1),
+        }
+        n = flat["obs"].shape[0]
+        mb = n // cfg.minibatch_count
+        aux = {}
+        rng = np.random.default_rng(int(jax.random.randint(
+            jax.random.fold_in(self.rng, 7), (), 0, 2**31 - 1)))
+        for _ in range(cfg.num_sgd_iter):
+            perm = rng.permutation(n)
+            for i in range(cfg.minibatch_count):
+                idx = perm[i * mb:(i + 1) * mb]
+                batch = jax.tree.map(lambda x: x[idx], flat)
+                g, aux = self._mb_grads(self.params, batch)
+                # The DD-PPO kernel: average gradients across ranks,
+                # apply the identical update everywhere.
+                g = collective.allreduce(
+                    np.asarray(g), group_name=self.group) / self.world
+                self.params, self.opt = self._apply(
+                    self.params, self.opt, jnp.asarray(g))
+        dones = float(np.asarray(traj["dones"]).sum())
+        return {
+            "timesteps": n,
+            "episodes": dones,
+            "reward_sum": float(np.asarray(traj["rewards"]).sum()),
+            **{k: float(v) for k, v in aux.items()},
+        }
+
+    def destroy_group(self) -> None:
+        collective.destroy_collective_group(self.group)
+
+    def params_digest(self) -> str:
+        import hashlib
+
+        leaves = jax.tree.leaves(self.params)
+        h = hashlib.sha256()
+        for leaf in leaves:
+            h.update(np.asarray(leaf).tobytes())
+        return h.hexdigest()
+
+    def get_params(self):
+        return jax.tree.map(np.asarray, self.params)
+
+
+class DDPPO:
+    """Algorithm (Trainable contract: ``.train()`` -> result dict)."""
+
+    def __init__(self, config: DDPPOConfig):
+        self.config = config
+        # Per-instance collective group: two concurrent DDPPO runs (a
+        # Tune sweep) must not share a coordinator or their allreduce
+        # slots would mix gradients across unrelated models.
+        import uuid
+
+        self._group = f"{config.group_name}-{uuid.uuid4().hex[:8]}"
+        worker_cls = ray_tpu.remote(DDPPOWorker)
+        self._workers: List = [
+            worker_cls.remote(dict(config.__dict__), rank,
+                              config.num_workers, self._group,
+                              config.seed)
+            for rank in range(config.num_workers)
+        ]
+        self._iteration = 0
+
+    def stop(self) -> None:
+        """Tear down the collective group and the worker actors."""
+        try:
+            ray_tpu.get(
+                [w.destroy_group.remote() for w in self._workers],
+                timeout=30)
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self._workers = []
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        outs = ray_tpu.get(
+            [w.train_iter.remote() for w in self._workers], timeout=600)
+        self._iteration += 1
+        steps = sum(o["timesteps"] for o in outs)
+        episodes = max(1.0, sum(o["episodes"] for o in outs))
+        rewards = sum(o["reward_sum"] for o in outs)
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter": steps,
+            "episode_reward_mean": rewards / episodes,
+            "time_this_iter_s": time.perf_counter() - start,
+            **{k: float(np.mean([o[k] for o in outs]))
+               for k in ("pg_loss", "vf_loss", "entropy") if k in outs[0]},
+        }
+
+    def params_digests(self) -> List[str]:
+        return ray_tpu.get(
+            [w.params_digest.remote() for w in self._workers], timeout=60)
+
+    def compute_single_action(self, obs) -> int:
+        params = jax.tree.map(
+            jnp.asarray,
+            ray_tpu.get(self._workers[0].get_params.remote(), timeout=60))
+        logits, _ = policy_apply(params, jnp.asarray(obs)[None])
+        return int(jnp.argmax(logits[0]))

@@ -1,0 +1,219 @@
+"""MAML: model-agnostic meta-learning for RL (reference
+``rllib/algorithms/maml/maml.py``, after Finn et al. 2017) — the
+meta-learning member of the inventory: train an INITIALIZATION such
+that one inner-loop policy-gradient step on a new task's own rollouts
+produces a good task-specific policy.
+
+This is the algorithm jax was built for: the inner adaptation is
+``theta' = theta - alpha * grad(L_inner)(theta)`` written literally,
+and the outer gradient differentiates THROUGH it (the second-order
+MAML term comes from composing ``jax.grad`` twice — no manual Hessian
+plumbing like the reference's torch higher-order workarounds). The
+whole meta-iteration — vmapped over the task batch: inner rollout,
+inner update, post-update rollout, outer surrogate — is ONE jitted
+program.
+
+The task family is the reference's point-navigation example
+(``rllib/examples/env/point_env.py`` analog): goal positions the agent
+cannot observe, so the meta-learned behavior must (a) explore enough
+that the inner PG carries goal information and (b) sit in a parameter
+region where one gradient step specializes it. The acceptance test is
+the paper's claim itself: one adaptation step on a HELD-OUT task jumps
+the return, and the meta-trained init adapts far better than a random
+init given the identical update rule.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.optim import adam_init, adam_step
+from ray_tpu.rllib.ppo import mlp_apply, mlp_init
+
+__all__ = ["MAML", "MAMLConfig", "PointGoalTasks"]
+
+
+class PointGoalTasks:
+    """2D point navigation; a TASK is a hidden goal in [-1, 1]^2. The
+    observation is the position only — the goal reaches the learner
+    exclusively through rewards, which is what makes adaptation
+    necessary. Fixed horizon, no terminal states."""
+
+    observation_size = 2
+    action_size = 2
+    horizon = 20
+    max_step = 0.15
+
+    def sample_tasks(self, rng, n: int) -> jax.Array:
+        return jax.random.uniform(rng, (n, 2), minval=-1.0, maxval=1.0)
+
+    def rollout_reward(self, pos, goal):
+        return -jnp.linalg.norm(pos - goal, axis=-1)
+
+
+class MAMLConfig:
+    """Builder-style config (``MAMLConfig().training(inner_lr=0.2)``)."""
+
+    def __init__(self):
+        self.tasks = PointGoalTasks()
+        self.meta_batch_size = 8     # tasks per meta-iteration
+        self.num_envs = 32           # rollouts per task per phase
+        self.inner_lr = 0.3
+        self.outer_lr = 5e-3
+        self.inner_steps = 2
+        self.gamma = 0.99
+        self.hidden_sizes = (64, 64)
+        self.log_std = -0.5          # fixed exploration noise (log scale)
+        self.seed = 0
+
+    def environment(self, tasks=None) -> "MAMLConfig":
+        if tasks is not None:
+            self.tasks = tasks
+        return self
+
+    def training(self, **kwargs) -> "MAMLConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown MAML option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "MAMLConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "MAML":
+        return MAML(self)
+
+
+def _make_meta_iter(cfg: MAMLConfig):
+    tasks = cfg.tasks
+    T, E = tasks.horizon, cfg.num_envs
+    std = jnp.exp(cfg.log_std)
+
+    def rollout(params, goal, rng):
+        """E parallel episodes on one task -> (traj, mean_return)."""
+        def step(carry, _):
+            pos, rng = carry
+            rng, k = jax.random.split(rng)
+            mean = tasks.max_step * jnp.tanh(mlp_apply(params, pos))
+            act = mean + tasks.max_step * std * \
+                jax.random.normal(k, mean.shape)
+            npos = jnp.clip(pos + act, -1.5, 1.5)
+            rew = tasks.rollout_reward(npos, goal)
+            return (npos, rng), {"obs": pos, "act": act, "rew": rew}
+
+        pos0 = jnp.zeros((E, 2))
+        (_, _), traj = jax.lax.scan(step, (pos0, rng), None, length=T)
+        return traj
+
+    def pg_loss(params, traj):
+        """REINFORCE on reward-to-go with a batch-mean baseline. The
+        logp is the Gaussian density of the STORED actions under
+        ``params`` — differentiable wrt params, so this same function
+        serves as inner loss, and (applied to post-update trajectories
+        with the adapted params) as the outer surrogate."""
+        def rtg_step(running, rew):
+            running = rew + cfg.gamma * running
+            return running, running
+
+        _, rtg = jax.lax.scan(
+            rtg_step, jnp.zeros(traj["rew"].shape[1]), traj["rew"],
+            reverse=True)
+        # Standardized advantages: the inner update must have a
+        # task-independent gradient SCALE or a single inner_lr cannot
+        # serve every task (far goals have larger raw reward-to-go).
+        adv = (rtg - jnp.mean(rtg)) / (jnp.std(rtg) + 1e-6)
+        mean = tasks.max_step * jnp.tanh(mlp_apply(params, traj["obs"]))
+        sigma = tasks.max_step * std
+        logp = jnp.sum(
+            -0.5 * ((traj["act"] - mean) / sigma) ** 2, axis=-1)
+        return -jnp.mean(logp * adv)
+
+    def adapt(params, goal, rng):
+        """Inner loop: ``inner_steps`` plain-SGD PG updates on fresh
+        task rollouts. Differentiable wrt ``params``."""
+        for i in range(cfg.inner_steps):
+            traj = rollout(params, goal, jax.random.fold_in(rng, i))
+            grads = jax.grad(pg_loss)(params, traj)
+            params = jax.tree.map(
+                lambda p, g: p - cfg.inner_lr * g, params, grads)
+        return params
+
+    def task_outer_loss(params, goal, rng):
+        k_in, k_out = jax.random.split(rng)
+        adapted = adapt(params, goal, k_in)
+        traj = rollout(adapted, goal, k_out)
+        post_return = jnp.mean(jnp.sum(traj["rew"], axis=0))
+        return pg_loss(adapted, traj), post_return
+
+    @jax.jit
+    def meta_iter(params, opt, rng):
+        rng, k_task, k_roll = jax.random.split(rng, 3)
+        goals = tasks.sample_tasks(k_task, cfg.meta_batch_size)
+        keys = jax.random.split(k_roll, cfg.meta_batch_size)
+
+        def mean_outer(p):
+            losses, post = jax.vmap(
+                lambda g, k: task_outer_loss(p, g, k))(goals, keys)
+            return jnp.mean(losses), jnp.mean(post)
+
+        (loss, post_return), grads = jax.value_and_grad(
+            mean_outer, has_aux=True)(params)
+        params, opt = adam_step(params, opt, grads, lr=cfg.outer_lr,
+                                max_grad_norm=1.0)
+        return params, opt, rng, {"meta_loss": loss,
+                                  "post_adapt_return": post_return}
+
+    return rollout, adapt, meta_iter
+
+
+class MAML:
+    """Algorithm (Trainable contract: ``.train()`` -> result dict)."""
+
+    def __init__(self, config: MAMLConfig):
+        self.config = config
+        tasks = config.tasks
+        k_param, self._rng = jax.random.split(
+            jax.random.key(config.seed))
+        self.params = mlp_init(
+            k_param,
+            (tasks.observation_size, *config.hidden_sizes,
+             tasks.action_size))
+        self.opt = adam_init(self.params)
+        self._rollout, self._adapt, self._meta_iter = \
+            _make_meta_iter(config)
+        self._iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        self.params, self.opt, self._rng, metrics = self._meta_iter(
+            self.params, self.opt, self._rng)
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter":
+                self.config.meta_batch_size * self.config.num_envs
+                * self.config.tasks.horizon
+                * (self.config.inner_steps + 1),
+            "episode_reward_mean": float(metrics["post_adapt_return"]),
+            "time_this_iter_s": time.perf_counter() - start,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    # -- evaluation -----------------------------------------------------
+
+    def mean_return(self, params, goal, rng) -> float:
+        traj = self._rollout(params, jnp.asarray(goal), rng)
+        return float(jnp.mean(jnp.sum(traj["rew"], axis=0)))
+
+    def adapt_to(self, goal, rng, params=None):
+        """One full inner-loop adaptation on a (held-out) task."""
+        return self._adapt(
+            params if params is not None else self.params,
+            jnp.asarray(goal), rng)

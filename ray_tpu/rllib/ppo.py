@@ -156,6 +156,25 @@ class PPOConfig:
 # -- jitted train iteration -------------------------------------------------
 
 
+def ppo_surrogate_loss(params, batch, *, clip_param, vf_coeff,
+                       entropy_coeff):
+    """The PPO loss on a flat minibatch (module-level so ddppo.py's
+    decentralized workers compute the IDENTICAL objective)."""
+    logits, value = policy_apply(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][:, None], axis=1)[:, 0]
+    from ray_tpu.rllib.optim import clipped_surrogate
+
+    pg_loss = clipped_surrogate(
+        logp, batch["logp"], batch["adv"], clip_param)
+    vf_loss = jnp.mean((value - batch["returns"]) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
+    total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+    return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                   "entropy": entropy}
+
+
 def _make_train_iter(cfg: PPOConfig):
     env = cfg.env
     n_envs, t_len = cfg.num_envs, cfg.rollout_length
@@ -199,20 +218,9 @@ def _make_train_iter(cfg: PPOConfig):
         return advs, advs + values
 
     def ppo_loss(params, batch):
-        logits, value = policy_apply(params, batch["obs"])
-        logp_all = jax.nn.log_softmax(logits)
-        logp = jnp.take_along_axis(
-            logp_all, batch["actions"][:, None], axis=1
-        )[:, 0]
-        from ray_tpu.rllib.optim import clipped_surrogate
-
-        pg_loss = clipped_surrogate(
-            logp, batch["logp"], batch["adv"], cfg.clip_param)
-        vf_loss = jnp.mean((value - batch["returns"]) ** 2)
-        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=1))
-        total = pg_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * entropy
-        return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
-                       "entropy": entropy}
+        return ppo_surrogate_loss(
+            params, batch, clip_param=cfg.clip_param,
+            vf_coeff=cfg.vf_coeff, entropy_coeff=cfg.entropy_coeff)
 
     def adam_step(params, opt, grads):
         return _adam(params, opt, grads, lr=cfg.lr,

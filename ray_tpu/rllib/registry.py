@@ -35,11 +35,13 @@ ALGORITHMS = {
     "CQL": _lazy("offline_algos", "CQL", "MARWILConfig"),
     "CRR": _lazy("crr", "CRR", "CRRConfig"),
     "DDPG": _lazy("ddpg", "DDPG", "DDPGConfig"),
+    "DDPPO": _lazy("ddppo", "DDPPO", "DDPPOConfig"),
     "DQN": _lazy("dqn", "DQN", "DQNConfig"),
     "DT": _lazy("dt", "DT", "DTConfig"),
     "ES": _lazy("es", "ES", "ESConfig"),
     "IMPALA": _lazy("impala", "IMPALA", "IMPALAConfig"),
     "MADDPG": _lazy("maddpg", "MADDPG", "MADDPGConfig"),
+    "MAML": _lazy("maml", "MAML", "MAMLConfig"),
     "MARWIL": _lazy("offline_algos", "MARWIL", "MARWILConfig"),
     "PG": _lazy("pg", "PG", "PGConfig"),
     "PPO": _lazy("ppo", "PPO", "PPOConfig"),
@@ -47,6 +49,7 @@ ALGORITHMS = {
     "R2D2": _lazy("r2d2", "R2D2", "R2D2Config"),
     "SAC": _lazy("sac", "SAC", "SACConfig"),
     "SimpleQ": _lazy("simple_q", "SimpleQ", "SimpleQConfig"),
+    "SlateQ": _lazy("slateq", "SlateQ", "SlateQConfig"),
     "TD3": _lazy("td3", "TD3", "TD3Config"),
 }
 

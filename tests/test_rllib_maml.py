@@ -1,0 +1,65 @@
+"""MAML: the paper's claim, tested directly — one inner-loop gradient
+step on a held-out task's own rollouts jumps the return, and the
+meta-trained initialization adapts far better than a random init under
+the IDENTICAL update rule."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.maml import MAML, MAMLConfig
+from ray_tpu.rllib.ppo import mlp_init
+
+
+HELD_OUT_GOALS = [(0.8, 0.6), (-0.7, 0.5), (0.4, -0.9), (-0.6, -0.6)]
+
+
+def _adaptation_gain(algo, params, seed=100):
+    """Mean (pre, post) return over held-out tasks for an init."""
+    pres, posts = [], []
+    for i, goal in enumerate(HELD_OUT_GOALS):
+        k1 = jax.random.key(seed + 2 * i)
+        k2 = jax.random.key(seed + 2 * i + 1)
+        pres.append(algo.mean_return(params, goal, k1))
+        adapted = algo.adapt_to(goal, k1, params=params)
+        posts.append(algo.mean_return(adapted, goal, k2))
+    return float(np.mean(pres)), float(np.mean(posts))
+
+
+def test_maml_adaptation_jumps_on_held_out_tasks():
+    algo = MAMLConfig().debugging(seed=0).build()
+    for _ in range(250):
+        r = algo.train()
+
+    pre, post = _adaptation_gain(algo, algo.params)
+    # Pre-adaptation the goal is unknown (returns ~ -goal_dist * T, the
+    # held-out goals sit ~1.0 away: pre ~ -21); the inner loop on the
+    # task's own rollouts must close most of the gap (measured: -13).
+    assert post > pre + 4.0, (pre, post)
+    assert post > -15.0, (pre, post)
+
+    # The init is what was learned: a random init under the IDENTICAL
+    # update rule adapts measurably worse (measured: -15.9 vs -13.0 —
+    # normalized-PG inner steps help any init, the meta-trained one
+    # more).
+    rand_params = mlp_init(
+        jax.random.key(123),
+        (2, *algo.config.hidden_sizes, 2))
+    _, rand_post = _adaptation_gain(algo, rand_params)
+    assert post > rand_post + 1.5, (post, rand_post)
+
+
+def test_second_order_term_flows():
+    """The outer gradient must differentiate THROUGH the inner update:
+    with inner_lr=0 the adapted params equal the init, so the two
+    configs' meta-gradients must differ — a cheap structural check that
+    the composition isn't silently first-order-only."""
+    algo = MAMLConfig().training(meta_batch_size=2, num_envs=4) \
+        .debugging(seed=1).build()
+    r1 = algo.train()
+    algo0 = MAMLConfig().training(
+        meta_batch_size=2, num_envs=4, inner_lr=0.0).debugging(
+        seed=1).build()
+    r0 = algo0.train()
+    assert r1["meta_loss"] != r0["meta_loss"]

@@ -1,0 +1,64 @@
+"""SlateQ: the choice-model decomposition and the myopic trap — the
+long-horizon recommender sustains the user's interest while the
+gamma=0 ablation of the SAME program spirals into clickbait and ends
+up WORSE than random slates (that reversal is the trap working)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.slateq import SlateDocEnv, SlateQ, SlateQConfig
+
+
+def _random_baseline(env, n_episodes=8, seed=200):
+    tot = 0.0
+    for ep in range(n_episodes):
+        rng = jax.random.key(seed + ep)
+        s = env.reset(rng)
+        for _ in range(env.max_steps):
+            rng, k1, k2 = jax.random.split(rng, 3)
+            slate = jax.random.choice(
+                k1, env.n_docs, (env.slate_size,), replace=False)
+            s, rew, _, _ = env.step(s, slate, k2)
+            tot += float(rew)
+    return tot / n_episodes
+
+
+def test_choice_model_basics():
+    env = SlateDocEnv()
+    s = env.reset(jax.random.key(0))
+    # Clickbait's choice bonus: same-topic doc with the bonus must get
+    # a strictly higher choice logit.
+    slate = jnp.array([0, 6, 7])     # doc 0 is clickbait, 6/7 are not
+    logits = env.choice_logits(s.u, slate)
+    cb_advantage = float(logits[0] - env.beta * (env.topics[0] @ s.u))
+    assert cb_advantage == pytest.approx(2.0)
+    # Clicking clickbait shrinks the interest norm; clicking a quality
+    # doc ALIGNED with u grows it (a misaligned one may not — pick the
+    # best-aligned non-clickbait doc explicitly).
+    s2, _, _, _ = env.step(s, jnp.array([0, 0, 0]), jax.random.key(1))
+    best_q = int(jnp.argmax(env.topics[6:] @ s.u)) + 6
+    s3, _, _, _ = env.step(
+        s, jnp.array([best_q] * 3), jax.random.key(1))
+    assert float(jnp.linalg.norm(s2.u)) < float(jnp.linalg.norm(s.u))
+    assert float(jnp.linalg.norm(s3.u)) > float(jnp.linalg.norm(s.u))
+
+
+def test_slateq_beats_myopic_and_random():
+    def train(gamma):
+        algo = SlateQConfig().training(gamma=gamma).debugging(
+            seed=0).build()
+        for _ in range(12):
+            algo.train()
+        return algo.evaluate()
+
+    env = SlateDocEnv()
+    rand = _random_baseline(env)
+    slateq_ret = train(0.95)
+    myopic_ret = train(0.0)
+    # Measured: slateq ~32, random ~11, myopic ~4.
+    assert slateq_ret > 2.0 * rand, (slateq_ret, rand)
+    assert myopic_ret < rand, (myopic_ret, rand)
+    assert slateq_ret > myopic_ret + 15.0, (slateq_ret, myopic_ret)
