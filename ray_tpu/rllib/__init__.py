@@ -1,8 +1,11 @@
 """RLlib-equivalent: RL algorithms on the task/actor substrate.
 
 Reference parity (SURVEY.md §7 step 11): Algorithm/Trainable contract,
-builder-style configs, pure-jax vectorized envs, SampleBatch. Two
-algorithm families:
+builder-style configs, pure-jax vectorized envs, SampleBatch, and a
+string-name registry (``registry.get_algorithm_class``). The algorithm
+inventory now spans every family class the reference ships (~30
+algorithms): on-policy, off-policy/replay, distributed, multi-agent,
+offline, meta-learning, search-based, bandits, and recommendation:
 * PPO — fully jitted on-policy learner (Anakin) plus RolloutWorker
   actors (Sebulba);
 * DQN — off-policy double-Q with an ON-DEVICE replay buffer, the whole
