@@ -22,7 +22,9 @@ offline, meta-learning, search-based, bandits, and recommendation:
   of its descendant's jitted program;
 * A3C — asynchronous gradient application over worker actors (the
   HogWild ancestor; workers run A2C's factored-out gradient program);
-* Ape-X DQN — epsilon-ladder actors + prioritized replay;
+* Ape-X DQN — epsilon-ladder actors + prioritized replay — and
+  Ape-X DDPG, the continuous noise-ladder variant on the TD3 substrate
+  (twin_q=True is Apex-TD3);
 * MADDPG — centralized critics / decentralized actors for cooperative
   continuous control (spread coverage task);
 * R2D2 — recurrent sequence replay with stored state + burn-in;
@@ -91,6 +93,7 @@ from ray_tpu.rllib.offline_algos import (
 )
 from ray_tpu.rllib.alpha_zero import AlphaZero, AlphaZeroConfig, TicTacToe
 from ray_tpu.rllib.apex import ApexDQN, ApexDQNConfig
+from ray_tpu.rllib.apex_ddpg import ApexDDPG, ApexDDPGConfig
 from ray_tpu.rllib.bandit import (
     BanditConfig,
     BanditLinTS,
@@ -189,6 +192,8 @@ __all__ = [
     "TicTacToe",
     "ApexDQN",
     "ApexDQNConfig",
+    "ApexDDPG",
+    "ApexDDPGConfig",
     "CRR",
     "CRRConfig",
     "BanditConfig",

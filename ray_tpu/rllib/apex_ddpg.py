@@ -1,0 +1,268 @@
+"""Ape-X DDPG (reference ``rllib/algorithms/apex_ddpg/apex_ddpg.py``):
+the continuous-control member of the Ape-X family — DDPG/TD3 learning
+from PRIORITIZED replay fed by a fleet of actors exploring at a ladder
+of noise scales (the continuous analog of Ape-X DQN's epsilon ladder,
+Horgan et al. 2018 §A.2).
+
+Composition over duplication: the critic/actor machinery is td3.py's
+(twin critics, target smoothing, delayed policy — all still config
+switches, so both ApexDDPG and "Apex-TD3" are points of this one
+program), the prioritized buffer is replay.pbuffer_* shared with Ape-X
+DQN, and the noise ladder lives on the vectorized env axis exactly as
+in apex.py. TD errors from the twin-min target refresh the priorities
+of the sampled rows each update.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithm import EpisodeStats
+from ray_tpu.rllib.env import Pendulum, make_vec_env
+from ray_tpu.rllib.optim import adam_init, adam_step
+from ray_tpu.rllib.ppo import mlp_init
+from ray_tpu.rllib.replay import (
+    pbuffer_add,
+    pbuffer_init,
+    pbuffer_sample,
+    pbuffer_update_priorities,
+)
+from ray_tpu.rllib.sac import critic_init
+from ray_tpu.rllib.td3 import _actor_apply, critic_apply
+
+__all__ = ["ApexDDPG", "ApexDDPGConfig", "noise_ladder"]
+
+
+def noise_ladder(n: int, low: float, high: float) -> jnp.ndarray:
+    """Per-lane exploration noise scales, log-spaced low..high — the
+    continuous analog of the Ape-X epsilon ladder."""
+    i = jnp.arange(n, dtype=jnp.float32) / jnp.maximum(n - 1, 1)
+    return low * (high / low) ** i
+
+
+class ApexDDPGConfig:
+    """Builder-style config (``ApexDDPGConfig().training(twin_q=True)``
+    is Apex-TD3)."""
+
+    def __init__(self):
+        self.env = Pendulum()
+        self.num_envs = 16              # noise-ladder lanes
+        self.steps_per_iter = 64
+        self.buffer_size = 50_000
+        self.batch_size = 256
+        self.updates_per_iter = 32
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.hidden_sizes = (128, 128)
+        self.learning_starts = 1_000
+        self.action_scale = 2.0
+        self.noise_low = 0.05           # ladder endpoints
+        self.noise_high = 0.8
+        self.per_alpha = 0.6
+        self.per_beta = 0.4
+        self.twin_q = False             # DDPG default; True -> Apex-TD3
+        self.target_noise = 0.0
+        self.target_noise_clip = 0.0
+        self.policy_delay = 1
+        self.seed = 0
+
+    def environment(self, env=None) -> "ApexDDPGConfig":
+        if env is not None:
+            self.env = env
+        return self
+
+    def rollouts(self, *, num_envs: Optional[int] = None
+                 ) -> "ApexDDPGConfig":
+        if num_envs is not None:
+            self.num_envs = num_envs
+        return self
+
+    def training(self, **kwargs) -> "ApexDDPGConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown ApexDDPG option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "ApexDDPGConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "ApexDDPG":
+        return ApexDDPG(self)
+
+
+def _make_train_iter(cfg: ApexDDPGConfig):
+    env = cfg.env
+    reset_fn, step_fn, obs_fn = make_vec_env(env, cfg.num_envs)
+    scale = cfg.action_scale
+    ladder = noise_ladder(cfg.num_envs, cfg.noise_low, cfg.noise_high)
+    time_limit_only = bool(getattr(env, "TIME_LIMIT_ONLY", False))
+
+    def td_errors(cp, learner, batch, k):
+        noise = jnp.clip(
+            cfg.target_noise * scale
+            * jax.random.normal(k, batch["act"].shape),
+            -cfg.target_noise_clip * scale,
+            cfg.target_noise_clip * scale)
+        next_act = jnp.clip(
+            _actor_apply(learner["target_actor"], batch["nobs"], scale)
+            + noise, -scale, scale)
+        tq1, tq2 = critic_apply(
+            learner["target_critic"], batch["nobs"], next_act)
+        tq = jnp.minimum(tq1, tq2) if cfg.twin_q else tq1
+        y = batch["rew"] + cfg.gamma * (1 - batch["done"]) * \
+            jax.lax.stop_gradient(tq)
+        q1, q2 = critic_apply(cp, batch["obs"], batch["act"])
+        return q1 - y, q2 - y
+
+    def critic_loss(cp, learner, batch, k):
+        e1, e2 = td_errors(cp, learner, batch, k)
+        w = batch["weights"]
+        if cfg.twin_q:
+            loss = jnp.mean(w * (e1 ** 2 + e2 ** 2))
+        else:
+            loss = jnp.mean(w * e1 ** 2)
+        return loss, e1
+
+    def actor_loss(ap, cp, batch):
+        act = _actor_apply(ap, batch["obs"], scale)
+        q1, _ = critic_apply(cp, batch["obs"], act)
+        return -jnp.mean(q1)
+
+    @jax.jit
+    def reset(rng):
+        return reset_fn(rng)
+
+    @jax.jit
+    def train_iter(learner, states, rng):
+        def env_step(carry, _):
+            learner, states, rng = carry
+            rng, k_n, k_step = jax.random.split(rng, 3)
+            obs = obs_fn(states)
+            act = _actor_apply(learner["actor"], obs, scale)
+            # The ladder: lane i explores at its own fixed noise scale.
+            act = jnp.clip(
+                act + ladder[:, None] * scale
+                * jax.random.normal(k_n, act.shape),
+                -scale, scale)
+            nstates, _, rew, done = step_fn(states, act, k_step)
+            done_f = done.astype(jnp.float32)
+            stored = jnp.zeros_like(done_f) if time_limit_only else done_f
+            learner = dict(
+                learner,
+                buffer=pbuffer_add(
+                    learner["buffer"], cfg.buffer_size,
+                    obs=obs, act=act, rew=rew, nobs=obs_fn(nstates),
+                    done=stored),
+                env_steps=learner["env_steps"] + cfg.num_envs,
+                reward_sum=learner["reward_sum"] + jnp.sum(rew),
+                done_count=learner["done_count"] + jnp.sum(done),
+            )
+            return (learner, nstates, rng), None
+
+        (learner, states, rng), _ = jax.lax.scan(
+            env_step, (learner, states, rng), None,
+            length=cfg.steps_per_iter)
+
+        def update(carry, i):
+            learner, rng = carry
+            rng, k_idx, k_t = jax.random.split(rng, 3)
+            buf = learner["buffer"]
+            batch = pbuffer_sample(
+                buf, k_idx, cfg.batch_size,
+                ("obs", "act", "rew", "nobs", "done"),
+                alpha=cfg.per_alpha, beta=cfg.per_beta)
+            ready = (buf["size"] >= cfg.learning_starts).astype(jnp.float32)
+
+            (closs, e1), cgrads = jax.value_and_grad(
+                critic_loss, has_aux=True)(
+                learner["critic"], learner, batch, k_t)
+            cgrads = jax.tree.map(lambda g: g * ready, cgrads)
+            critic, copt = adam_step(learner["critic"], learner["copt"],
+                                     cgrads, lr=cfg.critic_lr)
+            new_p = ready * jnp.abs(e1) + (1.0 - ready) * \
+                buf["priority"][batch["indices"]]
+            buf = pbuffer_update_priorities(buf, batch["indices"], new_p)
+
+            do_pi = ready * ((i % cfg.policy_delay) == 0)
+            aloss, agrads = jax.value_and_grad(actor_loss)(
+                learner["actor"], critic, batch)
+            agrads = jax.tree.map(lambda g: g * do_pi, agrads)
+            actor, aopt = adam_step(learner["actor"], learner["aopt"],
+                                    agrads, lr=cfg.actor_lr)
+            blend = cfg.tau * do_pi
+            polyak = lambda t_, p_: jax.tree.map(      # noqa: E731
+                lambda a, b: (1 - blend) * a + blend * b, t_, p_)
+            learner = dict(
+                learner, actor=actor, critic=critic, aopt=aopt,
+                copt=copt, buffer=buf,
+                target_actor=polyak(learner["target_actor"], actor),
+                target_critic=polyak(learner["target_critic"], critic))
+            return (learner, rng), closs * ready
+
+        (learner, rng), losses = jax.lax.scan(
+            update, (learner, rng), jnp.arange(cfg.updates_per_iter))
+        return learner, states, rng, {"critic_loss": jnp.mean(losses)}
+
+    return reset, train_iter
+
+
+class ApexDDPG(EpisodeStats):
+    """Algorithm (Trainable contract: ``.train()`` -> result dict)."""
+
+    def __init__(self, config: ApexDDPGConfig):
+        self.config = config
+        env = config.env
+        rng = jax.random.key(config.seed)
+        ka, kc, k_env, self._rng = jax.random.split(rng, 4)
+        obs_size, act_size = env.observation_size, env.action_size
+        actor = mlp_init(ka, (obs_size, *config.hidden_sizes, act_size))
+        critic = critic_init(kc, obs_size, act_size, config.hidden_sizes)
+        if not config.twin_q:
+            critic = {"q1": critic["q1"]}
+        self._learner = {
+            "actor": actor,
+            "critic": critic,
+            "target_actor": jax.tree.map(jnp.copy, actor),
+            "target_critic": jax.tree.map(jnp.copy, critic),
+            "aopt": adam_init(actor),
+            "copt": adam_init(critic),
+            "buffer": pbuffer_init(
+                config.buffer_size,
+                {"obs": (obs_size,), "act": (act_size,), "rew": (),
+                 "nobs": (obs_size,), "done": ()}),
+            "env_steps": jnp.zeros((), jnp.int32),
+            "reward_sum": jnp.zeros(()),
+            "done_count": jnp.zeros((), jnp.int32),
+        }
+        self._reset, self._train_iter = _make_train_iter(config)
+        self._states = self._reset(k_env)
+        self._iteration = 0
+
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        snap = self._episode_snapshot()
+        self._learner, self._states, self._rng, metrics = self._train_iter(
+            self._learner, self._states, self._rng)
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_this_iter":
+                self.config.num_envs * self.config.steps_per_iter,
+            "episode_reward_mean": self._episode_reward_mean(snap),
+            "time_this_iter_s": time.perf_counter() - start,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def compute_single_action(self, obs):
+        return _actor_apply(
+            self._learner["actor"], jnp.asarray(obs)[None],
+            self.config.action_scale)[0]

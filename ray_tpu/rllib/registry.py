@@ -29,6 +29,7 @@ ALGORITHMS = {
     "APPO": _lazy("appo", "APPO", "APPOConfig"),
     "ARS": _lazy("es", "ARS", "ARSConfig"),
     "ApexDQN": _lazy("apex", "ApexDQN", "ApexDQNConfig"),
+    "ApexDDPG": _lazy("apex_ddpg", "ApexDDPG", "ApexDDPGConfig"),
     "BC": _lazy("offline_algos", "BC", "BCConfig"),
     "BanditLinTS": _lazy("bandit", "BanditLinTS", "BanditConfig"),
     "BanditLinUCB": _lazy("bandit", "BanditLinUCB", "BanditConfig"),

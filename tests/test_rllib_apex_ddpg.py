@@ -1,0 +1,47 @@
+"""Ape-X DDPG: the continuous noise ladder, prioritized-replay wiring,
+and Pendulum learning (plus the twin_q point = Apex-TD3)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ray_tpu.rllib.apex_ddpg import ApexDDPG, ApexDDPGConfig, noise_ladder
+
+
+def test_noise_ladder_shape():
+    lad = np.asarray(noise_ladder(8, 0.05, 0.8))
+    assert lad[0] == pytest.approx(0.05)
+    assert lad[-1] == pytest.approx(0.8)
+    assert np.all(np.diff(lad) > 0)          # log-spaced, increasing
+    ratios = lad[1:] / lad[:-1]
+    assert np.allclose(ratios, ratios[0])    # geometric
+
+
+def test_apex_ddpg_learns_pendulum_and_refreshes_priorities():
+    algo = ApexDDPGConfig().debugging(seed=0).build()
+    first = None
+    last = None
+    for i in range(30):
+        r = algo.train()["episode_reward_mean"]
+        if i == 2:
+            first = r
+        last = r
+        if first is not None and last > first + 300:
+            break
+    assert last > first + 300, (first, last)
+    # TD-error refresh actually ran: the priority vector is no longer
+    # the uniform insert value everywhere.
+    pri = algo._learner["buffer"]["priority"]
+    size = int(algo._learner["buffer"]["size"])
+    live = pri[:size]
+    assert float(jnp.std(live)) > 1e-3
+
+
+def test_apex_td3_point_builds_and_trains():
+    algo = ApexDDPGConfig().training(
+        twin_q=True, target_noise=0.2, target_noise_clip=0.5,
+        policy_delay=2).debugging(seed=1).build()
+    assert "q2" in algo._learner["critic"]
+    r = algo.train()
+    assert "critic_loss" in r
