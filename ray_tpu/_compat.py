@@ -1,0 +1,101 @@
+"""Version compatibility shims for the jax surface this repo uses.
+
+The codebase targets current jax (``jax.shard_map`` with ``check_vma``),
+but must import — and run its CPU test harness — on older installs
+where shard_map still lives in ``jax.experimental.shard_map`` and the
+replication check is spelled ``check_rep``. Keep every such difference
+HERE so feature modules import one stable name.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.5: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _ACCEPTS_CHECK_VMA = (
+        "check_vma" in inspect.signature(_shard_map).parameters)
+except (TypeError, ValueError):  # C-accelerated / wrapped callable
+    _ACCEPTS_CHECK_VMA = True
+
+
+def shard_map(f, *args, **kwargs):
+    """``jax.shard_map`` with ``check_vma`` translated to the old
+    ``check_rep`` spelling where needed."""
+    if not _ACCEPTS_CHECK_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, *args, **kwargs)
+
+
+try:  # jax >= 0.5: explicit-sharding axis types
+    from jax.sharding import AxisType
+except ImportError:
+    import enum
+
+    class AxisType(enum.Enum):  # type: ignore[no-redef]
+        """Stub of jax.sharding.AxisType for older jax, where every mesh
+        axis is implicitly Auto (GSPMD propagation) — exactly what the
+        stub degrades to (make_mesh below drops the argument)."""
+
+        Auto = "auto"
+        Explicit = "explicit"
+        Manual = "manual"
+
+
+def set_num_cpu_devices(n: int) -> None:
+    """Configure N virtual XLA CPU devices. New jax has a config option
+    (and REJECTS also having the XLA flag set); older jax only honors
+    the XLA flag, which must land in the environment BEFORE the backend
+    initializes (callers here all run pre-first-backend-touch: worker
+    setup_jax, bench harness entry). So: config first, flag only as the
+    old-jax fallback — never both."""
+    import os
+
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    except AttributeError:
+        pass  # pre-0.5 jax: only the XLA flag exists
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def mesh(device_array, axis_names, *, axis_types=None):
+    """``jax.sharding.Mesh`` from an explicit device array, dropping
+    ``axis_types`` on jax versions whose Mesh doesn't accept it."""
+    from jax.sharding import Mesh as _Mesh
+
+    if axis_types is not None:
+        try:
+            return _Mesh(device_array, axis_names, axis_types=axis_types)
+        except (TypeError, AttributeError, ValueError):
+            # Older Mesh spells axis_types differently (dict keyed by
+            # AxisTypes) or not at all; Auto propagation is its default.
+            pass
+    return _Mesh(device_array, axis_names)
+
+
+def make_mesh(axis_shapes, axis_names, *, axis_types=None, devices=None):
+    """``jax.make_mesh`` that tolerates older signatures without
+    ``axis_types`` (where Auto is the only behavior anyway)."""
+    import inspect as _inspect
+
+    import jax as _jax
+
+    kwargs = {"devices": devices}
+    try:
+        if axis_types is not None and "axis_types" in _inspect.signature(
+                _jax.make_mesh).parameters:
+            kwargs["axis_types"] = axis_types
+    except (TypeError, ValueError):
+        kwargs["axis_types"] = axis_types
+    return _jax.make_mesh(tuple(axis_shapes), tuple(axis_names), **kwargs)

@@ -48,11 +48,19 @@ def _compile(out: str, sources: list, flags: list) -> str:
     return out
 
 
-def ensure_built(name: str) -> str:
-    """Compile lib<name>.so if missing or stale; return its path."""
+def ensure_built(name: str, force: bool = False) -> str:
+    """Compile lib<name>.so if missing or stale; return its path.
+    ``force`` discards the cached binary first — the dlopen self-heal
+    path for a checked-out .so built against an incompatible glibc."""
+    out = lib_path(name)
+    if force:
+        with _LOCK:
+            try:
+                os.unlink(out)
+            except OSError:
+                pass
     sources = [os.path.join(_SRC_DIR, s) for s in _LIBS[name]]
-    return _compile(lib_path(name), sources,
-                    ["-O2", "-g", "-fPIC", "-shared"])
+    return _compile(out, sources, ["-O2", "-g", "-fPIC", "-shared"])
 
 
 def build_cpp_worker() -> str:

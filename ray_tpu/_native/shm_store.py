@@ -28,7 +28,13 @@ class ObjectExistsError(Exception):
 
 
 def _load():
-    lib = ctypes.CDLL(ensure_built("shm_store"))
+    try:
+        lib = ctypes.CDLL(ensure_built("shm_store"))
+    except OSError:
+        # The cached (possibly checked-in) binary doesn't load on THIS
+        # machine — e.g. built against a newer glibc than the container
+        # ships. Rebuild from source and retry once.
+        lib = ctypes.CDLL(ensure_built("shm_store", force=True))
     lib.ts_create.restype = ctypes.c_void_p
     lib.ts_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
     lib.ts_attach.restype = ctypes.c_void_p

@@ -2093,6 +2093,41 @@ class ClusterBackend:
     def list_objects(self, limit: int = 1000) -> list:
         return self.head.call("list_objects", limit)
 
+    # -- node reporter surface (logs / stacks / telemetry) -----------------
+
+    def list_logs(self) -> list:
+        """Per-worker captured log files across the cluster."""
+        return self.head.call("list_logs", timeout=15.0)
+
+    def get_log(self, worker_id: str, stream: str = "out",
+                offset=None, max_bytes: int = 1 << 20,
+                tail_lines=None, node_id=None) -> dict:
+        return self.head.call(
+            "get_log", worker_id, stream, offset, max_bytes, tail_lines,
+            node_id, timeout=20.0)
+
+    def follow_log(self, worker_id: str, stream: str = "out",
+                   offset: int = 0, idle_timeout_s: float = 10.0,
+                   node_id=None):
+        """Iterator of {"offset", "data"} chunks — streamed end-to-end
+        (agent file -> head proxy -> here) over the RPC plane."""
+        return self.head.call_stream(
+            "follow_log", worker_id, stream, offset, idle_timeout_s,
+            node_id, timeout=idle_timeout_s + 60.0)
+
+    def dump_worker_stack(self, worker_id: str, node_id=None) -> str:
+        return self.head.call(
+            "dump_worker_stack", worker_id, node_id, timeout=30.0)
+
+    def profile_worker(self, worker_id: str, duration_s: float = 1.0,
+                       interval_s: float = 0.01, node_id=None) -> dict:
+        return self.head.call(
+            "profile_worker", worker_id, duration_s, interval_s, node_id,
+            timeout=float(duration_s) + 60.0)
+
+    def worker_stats(self, fresh: bool = False) -> list:
+        return self.head.call("worker_stats", fresh, timeout=15.0)
+
     def _log_poll_loop(self, subscribed: bool = False) -> None:
         """Driver-side log streaming over the pubsub LOGS channel
         (long-poll push, ``src/ray/pubsub`` analog — replaces the old

@@ -1021,6 +1021,87 @@ class HeadServer:
             cursor = entries[-1]["seq"] if entries else self._log_seq
             return cursor, entries
 
+    # -- node reporter routing (logs / stacks / telemetry) -----------------
+    # The per-worker data lives on the agents; the head only routes —
+    # the same shape as the reference dashboard head querying each
+    # node's reporter agent.
+
+    def _alive_agents(self):
+        with self._lock:
+            return [(n.node_id, n.client)
+                    for n in self._nodes.values() if n.alive]
+
+    def _route_worker(self, worker_id, node_id=None, need_live=False):
+        """(node_id, client) of the agent that owns ``worker_id``."""
+        agents = self._alive_agents()
+        if node_id is not None:
+            for nid, client in agents:
+                if nid == node_id:
+                    return nid, client
+            raise ValueError(f"node {node_id!r} is not alive")
+        for nid, client in agents:
+            try:
+                got = client.call("has_worker", worker_id, timeout=5.0)
+            except Exception:
+                continue
+            if got.get("live") or (not need_live and got.get("known")):
+                return nid, client
+        raise ValueError(
+            f"worker {worker_id!r} not found on any alive node")
+
+    def rpc_list_logs(self):
+        """Captured worker logs across the cluster (live + recently
+        dead workers), merged from every alive agent."""
+        out = []
+        for _nid, client in self._alive_agents():
+            try:
+                out.extend(client.call("list_worker_logs", timeout=5.0))
+            except Exception:
+                continue  # node died mid-query: best-effort
+        out.sort(key=lambda r: r.get("started_at") or 0)
+        return out
+
+    def rpc_get_log(self, worker_id, stream: str = "out",
+                    offset=None, max_bytes: int = 1 << 20,
+                    tail_lines=None, node_id=None):
+        _nid, client = self._route_worker(worker_id, node_id)
+        return client.call(
+            "read_worker_log", worker_id, stream, offset, max_bytes,
+            tail_lines, timeout=15.0)
+
+    def rpc_follow_log(self, worker_id, stream: str = "out",
+                       offset: int = 0, idle_timeout_s: float = 10.0,
+                       node_id=None):
+        """Server-streamed tail -f proxied from the owning agent (one
+        streaming hop per leg of the RPC plane)."""
+        _nid, client = self._route_worker(worker_id, node_id)
+        return client.call_stream(
+            "follow_worker_log", worker_id, stream, offset,
+            idle_timeout_s, timeout=idle_timeout_s + 30.0)
+
+    def rpc_dump_worker_stack(self, worker_id, node_id=None):
+        _nid, client = self._route_worker(
+            worker_id, node_id, need_live=True)
+        return client.call("dump_worker_stack", worker_id, timeout=20.0)
+
+    def rpc_profile_worker(self, worker_id, duration_s: float = 1.0,
+                           interval_s: float = 0.01, node_id=None):
+        _nid, client = self._route_worker(
+            worker_id, node_id, need_live=True)
+        return client.call(
+            "profile_worker", worker_id, duration_s, interval_s,
+            timeout=float(duration_s) + 45.0)
+
+    def rpc_worker_stats(self, fresh: bool = False):
+        """Per-worker CPU/RSS/uptime across the cluster."""
+        out = []
+        for _nid, client in self._alive_agents():
+            try:
+                out.extend(client.call("worker_stats", fresh, timeout=10.0))
+            except Exception:
+                continue
+        return out
+
     # -- scheduling -------------------------------------------------------
 
     def rpc_schedule(self, demand, caller_node=None, strategy=None,

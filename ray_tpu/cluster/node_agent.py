@@ -41,6 +41,7 @@ class _Worker:
         self.worker_id = worker_id
         self.proc = proc
         self.address = address
+        self.started_at = time.time()
         self.client: Optional[RpcClient] = None
         self.client_id: Optional[str] = None  # ref-table holder id
         self.ready = threading.Event()
@@ -129,6 +130,30 @@ class NodeAgent:
         self._owner_clients: "collections.OrderedDict[str, RpcClient]" = (
             collections.OrderedDict()
         )
+        # Node reporter (reference: dashboard/modules/reporter +
+        # _private/log_monitor.py). Worker stdout/stderr is captured to
+        # per-worker files under log_dir (the batched worker_events tee
+        # to the head stays the live-follow push path); the index below
+        # keeps dead workers' logs reachable for post-mortems.
+        self.log_dir = f"/tmp/ray_tpu_wlogs_{session}_{self.node_id[-8:]}"
+        try:
+            os.makedirs(self.log_dir, exist_ok=True)
+        except OSError:
+            self.log_dir = None  # degrade: workers inherit our fds
+        self._worker_logs: "collections.OrderedDict[str, dict]" = (
+            collections.OrderedDict()
+        )
+        # Per-worker CPU/RSS telemetry: latest snapshot + /proc cpu-tick
+        # history for utilization deltas + the gauge children we have
+        # exported (so dead workers' series get pruned).
+        self._worker_stats: dict[str, dict] = {}
+        self._cpu_prev: dict[str, tuple] = {}
+        self._exported_gauges: set[tuple] = set()
+        # One sampler at a time: a fresh=True RPC racing the telemetry
+        # loop would otherwise compute cpu%% over a ~ms window (one
+        # scheduler tick reads as ~1000%%) and fight over the gauge set.
+        self._telemetry_lock = threading.Lock()
+        self._last_sample = 0.0
         # Resource-view gossip (reference: ray_syncer.h:88 — nodes share
         # resource views so scheduling needn't centralize). Membership
         # (who exists / who died) still comes from the head, the GCS's
@@ -152,6 +177,9 @@ class NodeAgent:
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         threading.Thread(target=self._dispatch_loop, daemon=True).start()
         threading.Thread(target=self._reap_loop, daemon=True).start()
+        if config.worker_telemetry_interval_s > 0:
+            threading.Thread(
+                target=self._telemetry_loop, daemon=True).start()
         if config.gossip_interval_s > 0:
             threading.Thread(target=self._gossip_loop, daemon=True).start()
         # OOM protection (memory_monitor.h / worker_killing_policy.h
@@ -248,6 +276,7 @@ class NodeAgent:
         worker_id = "w-" + os.urandom(6).hex()
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id
+        env["RAY_TPU_WORKER_ID"] = worker_id
         # Lazy heavy imports in workers (reference: Ray workers import
         # `ray` only; torch/tf load when a task first uses them). Site
         # hooks that pre-import jax at interpreter startup (e.g. a TPU
@@ -309,23 +338,68 @@ class NodeAgent:
             argv = [env_key[len("cpp::"):]]
         else:
             argv = [python, "-m", "ray_tpu.cluster.workerproc"]
-        proc = subprocess.Popen(
-            [
-                *argv,
-                "--head", self.head_address,
-                "--agent", self.address,
-                "--node-id", self.node_id,
-                "--store", self.store_path,
-                "--worker-id", worker_id,
-            ],
-            env=env,
-            cwd=cwd,
-            stdout=sys.stdout.fileno() if hasattr(sys.stdout, "fileno") else None,
-            stderr=sys.stderr.fileno() if hasattr(sys.stderr, "fileno") else None,
-        )
+        # Per-worker log capture (log_monitor.py analog): the process's
+        # raw stdout/stderr land in files the reporter RPCs serve; the
+        # structured line tee to the head (worker_events) is unaffected.
+        out_path = err_path = None
+        out_f = err_f = None
+        if self.log_dir is not None:
+            try:
+                out_path = os.path.join(self.log_dir, f"{worker_id}.out")
+                err_path = os.path.join(self.log_dir, f"{worker_id}.err")
+                out_f = open(out_path, "ab")
+                err_f = open(err_path, "ab")
+            except OSError:
+                if out_f is not None:  # second open failed: no fd leak
+                    out_f.close()
+                out_path = err_path = out_f = err_f = None
+        if out_f is None:
+            stdout = (sys.stdout.fileno()
+                      if hasattr(sys.stdout, "fileno") else None)
+            stderr = (sys.stderr.fileno()
+                      if hasattr(sys.stderr, "fileno") else None)
+        else:
+            stdout, stderr = out_f, err_f
+        try:
+            proc = subprocess.Popen(
+                [
+                    *argv,
+                    "--head", self.head_address,
+                    "--agent", self.address,
+                    "--node-id", self.node_id,
+                    "--store", self.store_path,
+                    "--worker-id", worker_id,
+                ],
+                env=env,
+                cwd=cwd,
+                stdout=stdout,
+                stderr=stderr,
+            )
+        finally:
+            # Popen holds its own descriptors; ours would just leak.
+            for f in (out_f, err_f):
+                if f is not None:
+                    f.close()
         w = _Worker(worker_id, proc, env_key=env_key)
         with self._lock:
             self._workers[worker_id] = w
+            if out_path is not None:
+                self._worker_logs[worker_id] = {
+                    "worker_id": worker_id,
+                    "node_id": self.node_id,
+                    "pid": proc.pid,
+                    "stdout_path": out_path,
+                    "stderr_path": err_path,
+                    "started_at": w.started_at,
+                    "ended_at": None,
+                }
+                while len(self._worker_logs) > config.worker_log_retention:
+                    old = self._worker_logs.popitem(last=False)[1]
+                    for p in (old["stdout_path"], old["stderr_path"]):
+                        try:
+                            os.unlink(p)
+                        except OSError:
+                            pass
         return w
 
     def rpc_register_worker(self, worker_id, address, client_id=None):
@@ -938,6 +1012,9 @@ class NodeAgent:
             pool = self._idle.get(w.env_key)
             if pool is not None and w in pool:
                 pool.remove(w)
+            rec = self._worker_logs.get(w.worker_id)
+            if rec is not None and rec["ended_at"] is None:
+                rec["ended_at"] = time.time()
             current = None if requeued else w.current_task
             w.current_task = None
         if w.proc.poll() is None:
@@ -1078,6 +1155,265 @@ class NodeAgent:
             # trial starving the next trial's PG).
             self.pool.release(pool.total)
         return True
+
+    # -- node reporter: logs / stacks / telemetry --------------------------
+    # (reference: dashboard/modules/reporter/reporter_agent.py and
+    # _private/log_monitor.py — per-worker log files, py-spy stack
+    # dumps/profiles, and per-process cpu/mem stats, served by the node.)
+
+    def _log_record(self, worker_id: str) -> dict:
+        with self._lock:
+            rec = self._worker_logs.get(worker_id)
+        if rec is None:
+            raise ValueError(
+                f"no log capture for worker {worker_id!r} on node "
+                f"{self.node_id} (unknown worker, or capture disabled)")
+        return rec
+
+    @staticmethod
+    def _log_path(rec: dict, stream: str) -> str:
+        if stream in ("out", "stdout"):
+            return rec["stdout_path"]
+        if stream in ("err", "stderr"):
+            return rec["stderr_path"]
+        raise ValueError(f"stream must be out|err, got {stream!r}")
+
+    def rpc_list_worker_logs(self):
+        """Every worker (live and recently dead) with captured logs:
+        id, pid, file paths+sizes, lifetime, actor binding."""
+        with self._lock:
+            recs = [dict(r) for r in self._worker_logs.values()]
+            live = {
+                w.worker_id: w for w in self._workers.values()
+                if w.proc.poll() is None
+            }
+        out = []
+        for rec in recs:
+            w = live.get(rec["worker_id"])
+            rec["alive"] = w is not None
+            rec["is_actor"] = bool(w is not None and w.is_actor)
+            rec["actor_id"] = w.actor_id if w is not None else None
+            for stream in ("stdout", "stderr"):
+                try:
+                    rec[f"{stream}_bytes"] = os.path.getsize(
+                        rec[f"{stream}_path"])
+                except OSError:
+                    rec[f"{stream}_bytes"] = 0
+            out.append(rec)
+        return out
+
+    def rpc_read_worker_log(self, worker_id, stream: str = "out",
+                            offset: int | None = None,
+                            max_bytes: int = 1 << 20,
+                            tail_lines: int | None = None):
+        """One bounded read of a worker's captured stdout/stderr.
+        ``tail_lines`` reads the file end (the ``ray logs`` default);
+        otherwise reads [offset, offset+max_bytes) — pass the returned
+        ``offset`` back to poll-follow."""
+        path = self._log_path(self._log_record(worker_id), stream)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        max_bytes = max(1, min(int(max_bytes), 8 << 20))
+        if tail_lines is not None:
+            start = max(0, size - max_bytes)
+            try:
+                with open(path, "rb") as f:
+                    f.seek(start)
+                    blob = f.read(max_bytes)
+            except OSError:  # file evicted/unlinked between stat and read
+                blob = b""
+            n = int(tail_lines)
+            lines = blob.decode("utf-8", "replace").splitlines()
+            data = "\n".join(lines[-n:]) if n > 0 else ""
+            if data:
+                data += "\n"
+            return {"worker_id": worker_id, "stream": stream,
+                    "offset": size, "size": size, "data": data}
+        start = min(max(0, int(offset or 0)), size)
+        try:
+            with open(path, "rb") as f:
+                f.seek(start)
+                blob = f.read(max_bytes)
+        except OSError:
+            blob = b""
+        return {"worker_id": worker_id, "stream": stream,
+                "offset": start + len(blob), "size": size,
+                "data": blob.decode("utf-8", "replace")}
+
+    def rpc_follow_worker_log(self, worker_id, stream: str = "out",
+                              offset: int = 0, idle_timeout_s: float = 10.0,
+                              poll_s: float = 0.2):
+        """Server-streamed tail -f of a worker log (use with
+        ``call_stream``): yields ``{"offset", "data"}`` chunks as the
+        file grows, ends after the worker is gone and drained, or after
+        ``idle_timeout_s`` without growth."""
+        rec = self._log_record(worker_id)
+        path = self._log_path(rec, stream)
+        offset = max(0, int(offset))
+        last_growth = time.monotonic()
+        while not self._shutdown.is_set():
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                return
+            if offset < size:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    blob = f.read(1 << 16)
+                offset += len(blob)
+                last_growth = time.monotonic()
+                yield {"offset": offset,
+                       "data": blob.decode("utf-8", "replace")}
+                continue
+            with self._lock:
+                w = self._workers.get(worker_id)
+                dead = w is None or w.proc.poll() is not None
+            if dead or time.monotonic() - last_growth > idle_timeout_s:
+                return
+            time.sleep(poll_s)
+
+    def _live_worker(self, worker_id) -> _Worker:
+        with self._lock:
+            w = self._workers.get(worker_id)
+        if w is None or w.proc.poll() is not None:
+            raise ValueError(
+                f"no live worker {worker_id!r} on node {self.node_id}")
+        if w.client is None and not w.ready.wait(5.0):
+            raise ValueError(f"worker {worker_id!r} is not serving yet")
+        return w
+
+    def rpc_dump_worker_stack(self, worker_id):
+        """Instantaneous all-thread stack report of one worker
+        (``ray stack`` per-worker hop)."""
+        return self._live_worker(worker_id).client.call(
+            "dump_stack", timeout=15.0)
+
+    def rpc_profile_worker(self, worker_id, duration_s: float = 1.0,
+                           interval_s: float = 0.01):
+        """Time-sampled profile of one worker (py-spy record analog);
+        returns the plain-data profile from util/stack_sampler."""
+        w = self._live_worker(worker_id)
+        prof = w.client.call(
+            "profile", float(duration_s), float(interval_s),
+            timeout=float(duration_s) + 30.0)
+        prof["node_id"] = self.node_id
+        prof["pid"] = w.proc.pid
+        return prof
+
+    def rpc_has_worker(self, worker_id):
+        """Routing probe for the head: does this node know the worker?"""
+        with self._lock:
+            w = self._workers.get(worker_id)
+            return {
+                "known": worker_id in self._worker_logs or w is not None,
+                "live": w is not None and w.proc.poll() is None,
+            }
+
+    @staticmethod
+    def _read_proc(pid: int):
+        """(cpu_ticks, rss_bytes) for a pid from /proc, or None where
+        /proc isn't available (telemetry degrades to disabled)."""
+        try:
+            with open(f"/proc/{pid}/stat", "rb") as f:
+                # Fields after the parenthesized comm (which may contain
+                # spaces): index 11/12 are utime/stime (fields 14/15).
+                parts = f.read().rsplit(b")", 1)[1].split()
+            ticks = int(parts[11]) + int(parts[12])
+            with open(f"/proc/{pid}/statm", "rb") as f:
+                rss_pages = int(f.read().split()[1])
+            return ticks, rss_pages * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, ValueError, IndexError):
+            return None
+
+    def _sample_worker_stats(self) -> list:
+        """Sample every live worker's CPU/RSS/uptime, refresh the
+        Prometheus gauges (pruning dead workers' series), and cache the
+        snapshot for rpc_worker_stats. Serialized, and rate-limited to
+        one pass per 200ms: cpu%% needs a meaningful tick delta."""
+        with self._telemetry_lock:
+            return self._sample_worker_stats_locked()
+
+    def _sample_worker_stats_locked(self) -> list:
+        from ray_tpu.util import metrics as _metrics
+
+        hz = os.sysconf("SC_CLK_TCK") or 100
+        now = time.monotonic()
+        if self._shutdown.is_set():
+            return []  # stopping: never re-export retracted series
+        if now - self._last_sample < 0.2 and self._worker_stats:
+            with self._lock:
+                return [dict(s) for s in self._worker_stats.values()]
+        self._last_sample = now
+        with self._lock:
+            workers = [
+                (w.worker_id, w.proc.pid, w.started_at, w.is_actor,
+                 w.actor_id)
+                for w in self._workers.values() if w.proc.poll() is None
+            ]
+        stats: dict[str, dict] = {}
+        for wid, pid, started_at, is_actor, actor_id in workers:
+            got = self._read_proc(pid)
+            if got is None:
+                continue
+            ticks, rss = got
+            prev = self._cpu_prev.get(wid)
+            cpu = 0.0
+            if prev is not None and now > prev[1]:
+                cpu = max(0.0, (ticks - prev[0]) / hz / (now - prev[1])
+                          * 100.0)
+            self._cpu_prev[wid] = (ticks, now)
+            stats[wid] = {
+                "worker_id": wid,
+                "node_id": self.node_id,
+                "pid": pid,
+                "cpu_percent": round(cpu, 2),
+                "rss_bytes": rss,
+                "uptime_s": round(time.time() - started_at, 2),
+                "is_actor": is_actor,
+                "actor_id": actor_id,
+            }
+        exported = set()
+        for s in stats.values():
+            tags = {"node_id": self.node_id, "worker_id": s["worker_id"],
+                    "pid": str(s["pid"])}
+            exported.add((s["worker_id"], str(s["pid"])))
+            _metrics.WORKER_CPU_PERCENT.set(s["cpu_percent"], tags=tags)
+            _metrics.WORKER_RSS_BYTES.set(s["rss_bytes"], tags=tags)
+            _metrics.WORKER_UPTIME_SECONDS.set(s["uptime_s"], tags=tags)
+        _metrics.NODE_WORKER_COUNT.set(
+            len(stats), tags={"node_id": self.node_id})
+        for wid, pid in self._exported_gauges - exported:
+            tags = {"node_id": self.node_id, "worker_id": wid, "pid": pid}
+            _metrics.WORKER_CPU_PERCENT.remove(tags=tags)
+            _metrics.WORKER_RSS_BYTES.remove(tags=tags)
+            _metrics.WORKER_UPTIME_SECONDS.remove(tags=tags)
+            self._cpu_prev.pop(wid, None)
+        self._exported_gauges = exported
+        with self._lock:
+            self._worker_stats = stats
+        return list(stats.values())
+
+    def _telemetry_loop(self):
+        interval = config.worker_telemetry_interval_s
+        while not self._shutdown.wait(interval):
+            try:
+                self._sample_worker_stats()
+            except Exception:
+                continue  # telemetry is best-effort, never fatal
+
+    def rpc_worker_stats(self, fresh: bool = False):
+        """Latest per-worker CPU/RSS/uptime snapshot (GetNodeStats
+        analog); ``fresh`` forces an immediate sample pass."""
+        with self._lock:
+            snap = [dict(s) for s in self._worker_stats.values()]
+        if fresh or not snap:
+            try:
+                snap = self._sample_worker_stats()
+            except Exception:
+                pass
+        return snap
 
     # -- object serving ---------------------------------------------------
 
@@ -1420,6 +1756,25 @@ class NodeAgent:
                 return
             self._stopped = True
         self._shutdown.set()
+        # Retract this node's telemetry series (tests run many agents per
+        # process; a stopped node must not leave stale gauge children).
+        try:
+            from ray_tpu.util import metrics as _metrics
+
+            # Under the telemetry lock so a sampling pass in flight
+            # can't re-export a series after we retract it.
+            with self._telemetry_lock:
+                for wid, pid in self._exported_gauges:
+                    tags = {"node_id": self.node_id, "worker_id": wid,
+                            "pid": pid}
+                    _metrics.WORKER_CPU_PERCENT.remove(tags=tags)
+                    _metrics.WORKER_RSS_BYTES.remove(tags=tags)
+                    _metrics.WORKER_UPTIME_SECONDS.remove(tags=tags)
+                self._exported_gauges = set()
+                _metrics.NODE_WORKER_COUNT.remove(
+                    tags={"node_id": self.node_id})
+        except Exception:
+            pass
         with self._lock:
             workers = list(self._workers.values())
         for w in workers:

@@ -227,6 +227,27 @@ class WorkerHandler:
     def rpc_ping(self):
         return "pong"
 
+    # -- stack introspection (reporter-agent py-spy analog, in-process) ----
+
+    def rpc_dump_stack(self):
+        """Instantaneous stack report of every thread in this worker
+        (``ray stack`` target; serves the agent/head routing chain)."""
+        from ray_tpu.util import stack_sampler
+
+        return stack_sampler.dump_stacks(
+            header=f"worker {self.worker_id} (pid {os.getpid()})")
+
+    def rpc_profile(self, duration_s: float = 1.0,
+                    interval_s: float = 0.01):
+        """Time-sampled profile of this worker's threads. Blocking is
+        fine: the RPC server is thread-per-connection, so the executor
+        keeps running the task being profiled."""
+        from ray_tpu.util import stack_sampler
+
+        prof = stack_sampler.sample(duration_s, interval_s)
+        prof["worker_id"] = self.worker_id
+        return prof
+
     def rpc_cancel_task(self, task_id: str, force: bool = False):
         """Cancel a task this worker holds. Queued: marked so the executor
         skips it and stores TaskCancelledError. Running: the class is

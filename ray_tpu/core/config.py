@@ -51,6 +51,12 @@ _DEFS: dict[str, tuple[type, Any]] = {
     # frameworks at interpreter startup (a TPU plugin's sitecustomize
     # importing jax) don't serialize every fork. "" disables.
     "worker_pythonpath_exclude": (str, ".axon_site"),
+    # -- node reporter (per-worker observability) --------------------------
+    # Agent sampling cadence for per-worker CPU/RSS/uptime gauges
+    # (reporter_agent.py analog); 0 disables the telemetry loop.
+    "worker_telemetry_interval_s": (float, 1.0),
+    # Dead workers whose log files stay indexed (and on disk) per agent.
+    "worker_log_retention": (int, 1000),
     # -- resource-view gossip (ray_syncer.h analog) ------------------------
     # Node agents exchange per-node load views peer-to-peer so spillback
     # can place directly on a peer without the head. 0 disables gossip.

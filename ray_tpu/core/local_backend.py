@@ -617,6 +617,42 @@ class LocalBackend:
                 })
             return out
 
+    # -- node reporter surface (logs / stacks / telemetry) -----------------
+    # Local mode runs everything in THIS process: profiling/stack dumps
+    # sample our own threads (tasks run on pool threads here, so the
+    # busy task IS visible); there are no per-worker log files or child
+    # processes, so those surfaces return empty/raise.
+
+    def list_logs(self) -> list[dict]:
+        return []
+
+    def get_log(self, worker_id: str, *a, **kw):
+        raise ValueError(
+            "the local backend runs tasks in-process and captures no "
+            "per-worker log files (use a cluster for state.get_log)")
+
+    def dump_worker_stack(self, worker_id: str | None = None,
+                          node_id=None) -> str:
+        from ray_tpu.util import stack_sampler
+
+        import os as _os
+
+        return stack_sampler.dump_stacks(
+            header=f"local backend (pid {_os.getpid()})")
+
+    def profile_worker(self, worker_id: str | None = None,
+                       duration_s: float = 1.0, interval_s: float = 0.01,
+                       node_id=None) -> dict:
+        from ray_tpu.util import stack_sampler
+
+        prof = stack_sampler.sample(duration_s, interval_s)
+        prof["worker_id"] = worker_id or "local"
+        prof["node_id"] = self.node_id
+        return prof
+
+    def worker_stats(self, fresh: bool = False) -> list[dict]:
+        return []
+
     # -- task plane -------------------------------------------------------
 
     def _pin_ref_args(self, args, kwargs) -> list[str]:

@@ -170,6 +170,52 @@ class Dashboard:
             limit = int(qs.get("limit", 1000))
             cursor, entries = self.head.call("drain_logs", after, limit)
             return ok_json({"cursor": cursor, "entries": entries})
+        if route == "/api/worker_logs":
+            # Node reporter surface (reference dashboard's log index).
+            return ok_json({"workers": self.head.call(
+                "list_logs", timeout=15.0)})
+        if route == "/api/worker_log":
+            if "worker_id" not in qs:
+                return (400, "application/json",
+                        b'{"error": "worker_id is required"}')
+            kwargs: dict = {"stream": qs.get("stream", "out")}
+            if "offset" in qs:
+                kwargs["offset"] = int(qs["offset"])
+            else:
+                kwargs["tail_lines"] = int(qs.get("tail", 200))
+            return ok_json(self.head.call(
+                "get_log", qs["worker_id"], timeout=20.0, **kwargs))
+        if route == "/api/worker_stats":
+            return ok_json({"workers": self.head.call(
+                "worker_stats", qs.get("fresh") == "1", timeout=15.0)})
+        if route == "/api/stack":
+            if "worker_id" not in qs:
+                return (400, "application/json",
+                        b'{"error": "worker_id is required"}')
+            text = self.head.call(
+                "dump_worker_stack", qs["worker_id"], timeout=30.0)
+            return 200, "text/plain; charset=utf-8", text.encode()
+        if route == "/api/profile":
+            if "worker_id" not in qs:
+                return (400, "application/json",
+                        b'{"error": "worker_id is required"}')
+            duration = min(float(qs.get("duration", 0.5)), 30.0)
+            interval = float(qs.get("interval", 0.01))
+            fmt = qs.get("fmt", "text")
+            prof = self.head.call(
+                "profile_worker", qs["worker_id"], duration, interval,
+                timeout=duration + 60.0)
+            from ray_tpu.util import stack_sampler
+
+            if fmt == "text":
+                return (200, "text/plain; charset=utf-8",
+                        stack_sampler.text_report(prof).encode())
+            if fmt == "collapsed":
+                return (200, "text/plain; charset=utf-8",
+                        stack_sampler.collapsed(prof).encode())
+            if fmt == "chrome":
+                return ok_json(stack_sampler.chrome_trace(prof))
+            return ok_json(prof)
         if route == "/api/placement_groups":
             return ok_json(
                 {"placement_groups": self.head.call(
@@ -356,6 +402,7 @@ class Dashboard:
         )
         api = ["/api/cluster_status", "/api/nodes", "/api/actors",
                "/api/tasks", "/api/objects", "/api/logs",
+               "/api/worker_logs", "/api/worker_stats",
                "/api/placement_groups", "/api/pubsub_stats"]
         links = "".join(f'<li><a href="{r}">{r}</a></li>' for r in api)
         return (

@@ -70,7 +70,7 @@ INDEX_HTML = r"""<!doctype html>
 </main>
 <script>
 "use strict";
-const TABS = ["cluster", "nodes", "actors", "tasks", "objects",
+const TABS = ["cluster", "nodes", "workers", "actors", "tasks", "objects",
               "placement_groups", "jobs", "serve", "logs"];
 let active = location.hash.slice(1) || "cluster";
 let logCursor = 0;
@@ -158,6 +158,93 @@ const RENDER = {
         td.textContent = c === "NodeID" ? short(r[c]) : (r[c] ?? "");
         return td;
       }));
+  },
+  async workers() {
+    // Node reporter pane: per-worker telemetry merged with the log
+    // index, plus on-demand log tail / stack dump / profile detail.
+    const [statsD, logsD] = await Promise.all(
+      [api("/api/worker_stats"), api("/api/worker_logs")]);
+    const stats = {};
+    (statsD.workers || []).forEach(s => { stats[s.worker_id] = s; });
+    const rows = (logsD.workers || []).map(r =>
+      ({...r, ...(stats[r.worker_id] || {})}));
+    rows.sort((a, b) => (b.alive - a.alive)
+      || (b.cpu_percent || 0) - (a.cpu_percent || 0));
+    const alive = rows.filter(r => r.alive);
+    setTiles([
+      ["workers alive", alive.length],
+      ["actors", alive.filter(r => r.is_actor).length],
+      ["total cpu %", alive.reduce(
+        (s, r) => s + (r.cpu_percent || 0), 0).toFixed(0)],
+      ["total rss MiB", (alive.reduce(
+        (s, r) => s + (r.rss_bytes || 0), 0) / 1048576).toFixed(0)],
+    ]);
+    const detail = el("pre", "");
+    detail.id = "wdetail";
+    detail.style.cssText = "background:#0b0e11;border:1px solid #2a323a;" +
+      "padding:10px;max-height:45vh;overflow:auto;white-space:pre-wrap;" +
+      "font:12px ui-monospace,monospace;";
+    detail.textContent =
+      "select log / stack / profile on a worker above";
+    const show = async (label, path, isJson) => {
+      detail.textContent = label + " …";
+      try {
+        const r = await fetch(path);
+        const body = await r.text();
+        detail.textContent = label + "\n\n" + (isJson
+          ? JSON.stringify(JSON.parse(body), null, 1) : body);
+      } catch (e) { detail.textContent = label + " failed: " + e; }
+    };
+    const t = table(
+      ["worker_id", "node", "pid", "state", "cpu %", "rss MiB",
+       "uptime s", "actor", "inspect"],
+      rows, (r, c) => {
+        if (c === "worker_id")
+          { const td = el("td", "mono"); td.textContent = r.worker_id; return td; }
+        if (c === "node")
+          { const td = el("td", "mono"); td.textContent = short(r.node_id || ""); return td; }
+        if (c === "pid") return el("td", "", r.pid ?? "");
+        if (c === "state") return stateCell(r.alive ? "ALIVE" : "DEAD");
+        if (c === "cpu %") return el("td", "", r.cpu_percent ?? "");
+        if (c === "rss MiB") return el("td", "",
+          r.rss_bytes ? (r.rss_bytes / 1048576).toFixed(1) : "");
+        if (c === "uptime s") return el("td", "", r.uptime_s ?? "");
+        if (c === "actor") return el("td", "mono",
+          r.is_actor ? short(r.actor_id || "") : "");
+        const td = el("td");
+        const wid = encodeURIComponent(r.worker_id);
+        [["out", `/api/worker_log?worker_id=${wid}&stream=out&tail=200`, true],
+         ["err", `/api/worker_log?worker_id=${wid}&stream=err&tail=200`, true],
+         ...(r.alive ? [
+           ["stack", `/api/stack?worker_id=${wid}`, false],
+           ["profile", `/api/profile?worker_id=${wid}&duration=0.5`, false],
+         ] : [])].forEach(([label, path, isLog]) => {
+          const b = el("button", "", label);
+          b.style.cssText = "margin-right:4px;background:#0b0e11;" +
+            "color:var(--fg);border:1px solid #2a323a;border-radius:3px;" +
+            "cursor:pointer;font:11px inherit;padding:2px 6px;";
+          b.onclick = async () => {
+            if (!isLog) return show(`${label} ${r.worker_id}`, path, false);
+            // worker_log returns JSON with a "data" field.
+            detail.textContent = `${label} ${r.worker_id} …`;
+            try {
+              const d = await api(path);
+              detail.textContent =
+                `${label} ${r.worker_id} (${d.size} bytes)\n\n` + d.data;
+            } catch (e) { detail.textContent = "failed: " + e; }
+          };
+          td.appendChild(b);
+        });
+        return td;
+      });
+    const wrap = el("div");
+    wrap.appendChild(t);
+    wrap.appendChild(el("div", "", " "));
+    wrap.appendChild(detail);
+    const old = $("wdetail");
+    if (old && old.textContent && !old.textContent.startsWith("select"))
+      detail.textContent = old.textContent;  // survive the 2s refresh
+    $("view").replaceChildren(wrap);
   },
   async actors() {
     const d = await api("/api/actors");

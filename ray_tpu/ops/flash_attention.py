@@ -41,6 +41,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):
+    # Older pallas spells it TPUCompilerParams (same fields).
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 _NEG_INF = -1e30
 
 # Backward dQ strategy switch: up to this many k-blocks the fused dKV+dQ

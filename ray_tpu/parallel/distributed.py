@@ -102,7 +102,9 @@ def initialize(
         os.environ["JAX_PLATFORMS"] = platform
         jax.config.update("jax_platforms", platform)
     if num_cpu_devices is not None:
-        jax.config.update("jax_num_cpu_devices", num_cpu_devices)
+        from ray_tpu._compat import set_num_cpu_devices
+
+        set_num_cpu_devices(num_cpu_devices)
 
     if world_size == 1 and coordinator_address is None:
         return  # single-process: nothing to rendezvous

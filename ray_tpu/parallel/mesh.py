@@ -25,7 +25,9 @@ import math
 from typing import Sequence
 
 import jax
-from jax.sharding import AxisType, Mesh
+from jax.sharding import Mesh
+
+from ray_tpu._compat import AxisType, make_mesh
 
 # Outermost -> innermost. ep shares the dims between sp and tp so MoE models
 # can all_to_all over experts without a dedicated physical axis.
@@ -97,7 +99,7 @@ def build_mesh(
     devices = list(config.devices) if config.devices is not None else jax.devices()
     sizes = config.axis_sizes(len(devices))
     mesh_devices = (
-        jax.make_mesh(
+        make_mesh(
             tuple(sizes[a] for a in AXIS_ORDER),
             AXIS_ORDER,
             axis_types=(axis_types,) * len(AXIS_ORDER),
@@ -177,8 +179,10 @@ def build_hybrid_mesh(
         dcn_pp * sizes["pp"], dcn_dp * sizes["dp"], sizes["fsdp"],
         sizes["ep"], sizes["sp"], sizes["tp"],
     )
-    return Mesh(stacked.reshape(final_shape), AXIS_ORDER,
-                axis_types=(axis_types,) * len(AXIS_ORDER))
+    from ray_tpu._compat import mesh as _mesh
+
+    return _mesh(stacked.reshape(final_shape), AXIS_ORDER,
+                 axis_types=(axis_types,) * len(AXIS_ORDER))
 
 
 def single_device_mesh() -> Mesh:

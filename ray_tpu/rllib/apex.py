@@ -172,9 +172,13 @@ def _make_pieces(cfg: ApexDQNConfig, ladder_slice=None):
                                 lr=cfg.lr)
             # Priority refresh for the sampled rows (gated like the
             # gradient so warmup doesn't overwrite the insert priority).
-            new_p = ready * jnp.abs(err) + (1.0 - ready) * \
+            # new_p is FINAL either way (the TD branch bakes the eps in),
+            # so eps=0: a warm-up rewrite must preserve priorities
+            # exactly, not creep them by eps per update.
+            new_p = ready * (jnp.abs(err) + 1e-3) + (1.0 - ready) * \
                 buf["priority"][batch["indices"]]
-            buf = pbuffer_update_priorities(buf, batch["indices"], new_p)
+            buf = pbuffer_update_priorities(
+                buf, batch["indices"], new_p, eps=0.0)
             target = periodic_target_sync(
                 learner["target_params"], params, opt["t"],
                 cfg.target_update_every)

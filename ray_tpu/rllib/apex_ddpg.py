@@ -188,9 +188,12 @@ def _make_train_iter(cfg: ApexDDPGConfig):
             cgrads = jax.tree.map(lambda g: g * ready, cgrads)
             critic, copt = adam_step(learner["critic"], learner["copt"],
                                      cgrads, lr=cfg.critic_lr)
-            new_p = ready * jnp.abs(e1) + (1.0 - ready) * \
+            # Final priorities either way (TD branch bakes the eps in);
+            # eps=0 so warm-up rewrites preserve priorities exactly.
+            new_p = ready * (jnp.abs(e1) + 1e-3) + (1.0 - ready) * \
                 buf["priority"][batch["indices"]]
-            buf = pbuffer_update_priorities(buf, batch["indices"], new_p)
+            buf = pbuffer_update_priorities(
+                buf, batch["indices"], new_p, eps=0.0)
 
             do_pi = ready * ((i % cfg.policy_delay) == 0)
             aloss, agrads = jax.value_and_grad(actor_loss)(

@@ -64,6 +64,10 @@ class BanditConfig:
         self.alpha = 1.0          # LinUCB confidence width
         self.ts_scale = 0.5       # LinTS posterior scale v
         self.seed = 0
+        # Which bandit build() constructs. ONE config class serves both
+        # registry entries; get_algorithm_config binds the resolved
+        # algorithm class here so "BanditLinTS" builds a LinTS.
+        self.algo_class: Optional[type] = None
 
     def environment(self, env=None) -> "BanditConfig":
         if env is not None:
@@ -81,6 +85,13 @@ class BanditConfig:
         if seed is not None:
             self.seed = seed
         return self
+
+    def build(self) -> "_BanditBase":
+        """Construct the configured bandit (LinUCB unless algo_class
+        says otherwise) — the Trainable build() contract every other
+        registered config satisfies."""
+        cls = self.algo_class or BanditLinUCB
+        return cls(self)
 
 
 def _make_iter(cfg: BanditConfig, kind: str):

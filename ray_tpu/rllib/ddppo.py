@@ -171,8 +171,31 @@ class DDPPO:
         ]
         self._iteration = 0
 
+    def __enter__(self) -> "DDPPO":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    def __del__(self):
+        # Repeated builds (a Tune sweep) must not leak worker actors /
+        # collective groups when a trial forgets stop(). Guarded: at
+        # interpreter teardown the backend may already be gone, and
+        # stop() on an un-initialized runtime would auto-init one.
+        try:
+            from ray_tpu._private import worker as _worker_mod
+
+            if self._workers and _worker_mod.is_initialized():
+                self.stop()
+        except Exception:  # noqa: BLE001 — destructors never raise
+            pass
+
     def stop(self) -> None:
-        """Tear down the collective group and the worker actors."""
+        """Tear down the collective group and the worker actors.
+        Idempotent; also runs via the context-manager exit and __del__."""
+        if not self._workers:
+            return
         try:
             ray_tpu.get(
                 [w.destroy_group.remote() for w in self._workers],

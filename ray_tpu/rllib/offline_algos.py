@@ -221,6 +221,20 @@ class BC(MARWIL):
     pass
 
 
+class CQLConfig(DQNConfig):
+    """CQL's natural config is the DQN family's (CQL extends OfflineDQN)
+    plus the conservative-penalty weight. Registered as the "CQL" config
+    so ``get_algorithm_config("CQL").build(dataset)`` yields a CQL — the
+    earlier MARWILConfig pairing silently built a MARWIL instead."""
+
+    def __init__(self):
+        super().__init__()
+        self.cql_alpha = 1.0
+
+    def build(self, dataset) -> "CQL":
+        return CQL(self, dataset, cql_alpha=self.cql_alpha)
+
+
 class CQL(OfflineDQN):
     """Discrete CQL(H) (Kumar et al. 2020; ``rllib/algorithms/cql``):
     the OfflineDQN TD loss plus the conservative penalty

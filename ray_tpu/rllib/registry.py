@@ -33,7 +33,7 @@ ALGORITHMS = {
     "BC": _lazy("offline_algos", "BC", "BCConfig"),
     "BanditLinTS": _lazy("bandit", "BanditLinTS", "BanditConfig"),
     "BanditLinUCB": _lazy("bandit", "BanditLinUCB", "BanditConfig"),
-    "CQL": _lazy("offline_algos", "CQL", "MARWILConfig"),
+    "CQL": _lazy("offline_algos", "CQL", "CQLConfig"),
     "CRR": _lazy("crr", "CRR", "CRRConfig"),
     "DDPG": _lazy("ddpg", "DDPG", "DDPGConfig"),
     "DDPPO": _lazy("ddppo", "DDPPO", "DDPPOConfig"),
@@ -71,6 +71,13 @@ def get_algorithm_class(name: str, return_config: bool = False):
 
 
 def get_algorithm_config(name: str):
-    """Default config instance for a registered algorithm."""
-    _, config_cls = get_algorithm_class(name, return_config=True)
-    return config_cls()
+    """Default config instance for a registered algorithm. Configs
+    shared by several entries (BanditConfig serves LinUCB and LinTS)
+    expose an ``algo_class`` slot; binding the resolved class there
+    makes ``get_algorithm_config(name).build(...)`` construct exactly
+    the algorithm ``name`` resolves to."""
+    cls, config_cls = get_algorithm_class(name, return_config=True)
+    cfg = config_cls()
+    if getattr(cfg, "algo_class", "__absent__") is None:
+        cfg.algo_class = cls
+    return cfg

@@ -99,6 +99,10 @@ def pbuffer_sample(buf: dict, rng, batch_size: int,
 
 def pbuffer_update_priorities(buf: dict, indices, priorities,
                               eps: float = 1e-3) -> dict:
+    """Write |priorities| + eps at ``indices``. Pass ``eps=0.0`` when the
+    values are ALREADY final priorities (e.g. re-writing unchanged rows
+    during learning_starts gating — an unconditional +eps there made
+    insert priorities creep upward on every warm-up update)."""
     p = jnp.abs(priorities) + eps
     out = dict(buf)
     out["priority"] = buf["priority"].at[indices].set(p)

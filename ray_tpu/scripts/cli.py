@@ -92,6 +92,87 @@ def cmd_memory(args):
         print(json.dumps({"num_objects": len(objs)}, indent=2))
 
 
+def cmd_logs(args):
+    """List captured worker logs, or print (and follow) one worker's."""
+    from ray_tpu import state
+
+    _connect(args)
+    if not args.worker_id:
+        rows = state.list_logs()
+        if not rows:
+            print("no captured worker logs (local backend, or no "
+                  "workers spawned yet)")
+            return
+        print(f"{'WORKER':<16} {'NODE':<10} {'PID':>7} {'ALIVE':<5} "
+              f"{'ACTOR':<10} {'OUT':>9} {'ERR':>9}")
+        for r in rows:
+            print(f"{r['worker_id']:<16} {r['node_id'][-8:]:<10} "
+                  f"{r['pid']:>7} {str(r['alive']):<5} "
+                  f"{(r.get('actor_id') or '')[-8:]:<10} "
+                  f"{r.get('stdout_bytes', 0):>9} "
+                  f"{r.get('stderr_bytes', 0):>9}")
+        return
+    from ray_tpu._private import worker as worker_mod
+
+    backend = worker_mod.backend()
+    rec = backend.get_log(args.worker_id, args.stream,
+                          tail_lines=args.tail)
+    sys.stdout.write(rec["data"])
+    sys.stdout.flush()
+    if args.follow:
+        for chunk in state.follow_log(
+                args.worker_id, args.stream, offset=rec["offset"],
+                idle_timeout_s=args.idle_timeout):
+            sys.stdout.write(chunk["data"])
+            sys.stdout.flush()
+
+
+def cmd_stack(args):
+    """Stack dump (or timed stack profile) of live workers
+    (``ray stack`` / py-spy analog)."""
+    import json as _json
+
+    from ray_tpu import state
+
+    _connect(args)
+    if args.worker_id:
+        targets = [args.worker_id]
+    else:
+        targets = [r["worker_id"] for r in state.list_logs()
+                   if r.get("alive")]
+        if not targets:
+            from ray_tpu._private import worker as worker_mod
+
+            if hasattr(worker_mod.backend(), "head"):
+                # Cluster with no live workers: routing a None worker
+                # would just produce a lookup traceback.
+                print("no live workers to inspect")
+                return
+            targets = [None]  # local backend: dump this process
+    outputs = []
+    for wid in targets:
+        if args.duration:
+            out = state.profile_worker(
+                wid, duration_s=args.duration, interval_s=args.interval,
+                fmt=args.format)
+        else:
+            out = state.dump_stack(wid)
+        outputs.append(out)
+    if args.format == "chrome" and args.duration:
+        events = [e for ev in outputs for e in ev]
+        if args.output:
+            with open(args.output, "w") as f:
+                _json.dump(events, f)
+            print(f"wrote chrome trace to {args.output}")
+        else:
+            print(_json.dumps(events))
+        return
+    for wid, out in zip(targets, outputs):
+        if len(targets) > 1:
+            print(f"==== worker {wid} ====")
+        print(out if isinstance(out, str) else _json.dumps(out, indent=1))
+
+
 def cmd_submit(args):
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -183,6 +264,31 @@ def main(argv=None):
 
     p = sub.add_parser("memory", help="object store stats")
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser(
+        "logs", help="list/print captured worker logs (ray logs analog)")
+    p.add_argument("worker_id", nargs="?", default=None)
+    p.add_argument("--stream", choices=["out", "err"], default="out")
+    p.add_argument("--tail", type=int, default=200)
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="stream the log as it grows")
+    p.add_argument("--idle-timeout", type=float, default=10.0,
+                   help="stop following after this long without growth")
+    p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser(
+        "stack", help="stack dump / profile of workers (ray stack analog)")
+    p.add_argument("worker_id", nargs="?", default=None,
+                   help="default: every live worker (local: this process)")
+    p.add_argument("--duration", "-d", type=float, default=None,
+                   help="time-sample for this many seconds instead of "
+                        "an instantaneous dump")
+    p.add_argument("--interval", type=float, default=0.01)
+    p.add_argument("--format", choices=["text", "collapsed", "chrome"],
+                   default="text")
+    p.add_argument("--output", "-o", default=None,
+                   help="write chrome-trace output here")
+    p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("submit", help="submit a job entrypoint")
     p.add_argument("--wait", action="store_true")

@@ -32,7 +32,9 @@ def run_all() -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from ray_tpu._compat import set_num_cpu_devices
+
+    set_num_cpu_devices(8)
     import jax.numpy as jnp
     from jax.sharding import Mesh
 

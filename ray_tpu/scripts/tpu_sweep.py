@@ -67,10 +67,20 @@ def main() -> None:
     }
     points = json.loads(sys.argv[1]) if len(sys.argv) > 1 else [
         ["base", 16], ["lever", 24], ["lever", 32]]
+    from ray_tpu.scripts.bench_log import record_if_on_chip
+
+    device_kind = jax.devices()[0].device_kind
+    n_dev = jax.device_count()
     for name, batch in points:
         try:
             r = measure(named[name], int(batch))
             print(json.dumps({"config": name, "batch": batch, **r}), flush=True)
+            # Evidence trail (VERDICT r5 item 1a): every successful
+            # on-chip point lands in BENCH_TPU_SESSIONS.jsonl.
+            record_if_on_chip({
+                "script": "tpu_sweep", "config": name, "batch": int(batch),
+                "device": device_kind, "n_devices": n_dev, **r,
+            })
         except Exception as e:  # noqa: BLE001 — sweep survives OOM points
             print(json.dumps({"config": name, "batch": batch,
                               "error": repr(e)[:200]}), flush=True)

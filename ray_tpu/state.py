@@ -1,8 +1,11 @@
-"""State observability API: list tasks/actors/objects, summaries, timeline.
+"""State observability API: list tasks/actors/objects, summaries, timeline,
+per-worker logs, stack dumps/profiles, and live worker telemetry.
 
 Reference parity: ``python/ray/experimental/state/api.py:729,952,1269``
-(``ray list tasks/actors/objects``, ``ray summary``) and the Chrome-trace
-timeline dump of ``ray timeline`` (``_private/state.py:414-431``).
+(``ray list tasks/actors/objects``, ``ray summary``), the Chrome-trace
+timeline dump of ``ray timeline`` (``_private/state.py:414-431``), plus
+the log/stack surface of ``ray logs`` / ``ray stack`` (the reference's
+log_monitor + py-spy reporter agent; here ``util/stack_sampler``).
 """
 
 from __future__ import annotations
@@ -32,6 +35,91 @@ def list_objects(limit: int = 1000) -> List[dict]:
     backend = _worker.backend()
     if hasattr(backend, "list_objects"):
         return backend.list_objects(limit)
+    return []
+
+
+def list_logs() -> List[dict]:
+    """Captured per-worker log files across the cluster (``ray logs``)."""
+    backend = _worker.backend()
+    if hasattr(backend, "list_logs"):
+        return backend.list_logs()
+    return []
+
+
+def get_log(worker_id: str, stream: str = "out", tail_lines: int = 200,
+            offset: Optional[int] = None, node_id: Optional[str] = None):
+    """A worker's captured stdout/stderr.
+
+    Default: the last ``tail_lines`` lines as a string. With ``offset``
+    set (an integer byte position; pass 0 to start), returns the raw
+    ``{"data", "offset", "size"}`` record so callers can poll-follow.
+    """
+    backend = _worker.backend()
+    if not hasattr(backend, "get_log"):
+        raise ValueError("this backend captures no per-worker logs")
+    if offset is not None:
+        return backend.get_log(worker_id, stream, offset=offset,
+                               node_id=node_id)
+    rec = backend.get_log(worker_id, stream, tail_lines=tail_lines,
+                          node_id=node_id)
+    return rec["data"]
+
+
+def follow_log(worker_id: str, stream: str = "out", offset: int = 0,
+               idle_timeout_s: float = 10.0,
+               node_id: Optional[str] = None):
+    """Iterator of ``{"offset", "data"}`` chunks, streamed over the RPC
+    plane while the worker's log grows (``ray logs --follow``)."""
+    backend = _worker.backend()
+    if not hasattr(backend, "follow_log"):
+        raise ValueError("this backend captures no per-worker logs")
+    return backend.follow_log(worker_id, stream, offset, idle_timeout_s,
+                              node_id)
+
+
+def dump_stack(worker_id: Optional[str] = None,
+               node_id: Optional[str] = None) -> str:
+    """Instantaneous all-thread stack report of a worker (``ray stack``).
+    On the local backend, dumps this process."""
+    backend = _worker.backend()
+    if not hasattr(backend, "dump_worker_stack"):
+        raise ValueError("this backend supports no stack dumps")
+    return backend.dump_worker_stack(worker_id, node_id=node_id)
+
+
+def profile_worker(worker_id: Optional[str] = None,
+                   duration_s: float = 1.0, interval_s: float = 0.01,
+                   fmt: str = "raw", node_id: Optional[str] = None):
+    """Time-sampled stack profile of a worker (py-spy record analog).
+
+    ``fmt``: ``raw`` (plain-data profile dict), ``text`` (aggregated
+    report), ``collapsed`` (flame-graph input), or ``chrome``
+    (trace-event list mergeable with ``state.timeline()`` output).
+    """
+    backend = _worker.backend()
+    if not hasattr(backend, "profile_worker"):
+        raise ValueError("this backend supports no stack profiling")
+    prof = backend.profile_worker(worker_id, duration_s, interval_s,
+                                  node_id=node_id)
+    from ray_tpu.util import stack_sampler
+
+    if fmt == "raw":
+        return prof
+    if fmt == "text":
+        return stack_sampler.text_report(prof)
+    if fmt == "collapsed":
+        return stack_sampler.collapsed(prof)
+    if fmt == "chrome":
+        return stack_sampler.chrome_trace(prof)
+    raise ValueError(
+        f"fmt must be raw|text|collapsed|chrome, got {fmt!r}")
+
+
+def worker_stats(fresh: bool = False) -> List[dict]:
+    """Live per-worker CPU/RSS/uptime telemetry across the cluster."""
+    backend = _worker.backend()
+    if hasattr(backend, "worker_stats"):
+        return backend.worker_stats(fresh)
     return []
 
 

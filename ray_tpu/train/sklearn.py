@@ -95,12 +95,17 @@ class SklearnTrainer:
         fit_task = ray_tpu.remote(_fit_task)
         fitted_ref = fit_task.remote(est_bytes, x_ref, y_ref)
 
+        fold_splits = []
+        refs = []
         if self.cv:
             # Deterministic contiguous folds (sklearn KFold default).
+            # Parallel folds are SUBMITTED before the fit is awaited (so
+            # they overlap it), but fit_time below covers only the
+            # estimator fit — not the CV gather (reference SklearnTrainer
+            # semantics).
             n = len(y)
             folds = np.array_split(np.arange(n), self.cv)
             fold_task = ray_tpu.remote(_cv_fold_task)
-            refs = []
             for i in range(self.cv):
                 test_idx = folds[i]
                 train_idx = np.concatenate(
@@ -109,18 +114,21 @@ class SklearnTrainer:
                     refs.append(fold_task.remote(
                         est_bytes, x_ref, y_ref, train_idx, test_idx))
                 else:
-                    refs.append(_cv_fold_task(
-                        est_bytes, x, y, train_idx, test_idx))
+                    fold_splits.append((train_idx, test_idx))
+
+        fitted = pickle.loads(ray_tpu.get(fitted_ref, timeout=600))
+        metrics["fit_time"] = time.perf_counter() - t0
+
+        if self.cv:
             scores = ray_tpu.get(refs, timeout=600) \
-                if self.parallelize_cv else refs
+                if self.parallelize_cv else [
+                    _cv_fold_task(est_bytes, x, y, train_idx, test_idx)
+                    for train_idx, test_idx in fold_splits]
             metrics["cv"] = {
                 "test_score": list(scores),
                 "test_score_mean": float(np.mean(scores)),
                 "test_score_std": float(np.std(scores)),
             }
-
-        fitted = pickle.loads(ray_tpu.get(fitted_ref, timeout=600))
-        metrics["fit_time"] = time.perf_counter() - t0
 
         for name, ds in self.datasets.items():
             if name == "train":

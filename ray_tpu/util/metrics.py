@@ -44,6 +44,19 @@ class Metric:
             raise ValueError(f"metric {self.name} missing tags {missing}")
         return tuple(merged.get(k, "") for k in self.tag_keys)
 
+    def remove(self, tags: Optional[Dict[str, str]] = None) -> bool:
+        """Drop one tagged series (e.g. a dead worker's gauges) so the
+        exposition doesn't accumulate stale children forever. Returns
+        whether the series existed."""
+        key = self._key(tags)
+        removed = False
+        with self._lock:
+            for table in ("_values", "_counts", "_sums", "_totals"):
+                d = getattr(self, table, None)
+                if d is not None and d.pop(key, None) is not None:
+                    removed = True
+        return removed
+
     def _fmt_tags(self, key: Tuple) -> str:
         if not self.tag_keys:
             return ""
@@ -153,6 +166,33 @@ class Histogram(Metric):
                     f"{self.name}_count{self._fmt_tags(key)} {self._totals[key]}"
                 )
         return out
+
+
+# -- node reporter gauges (reference: dashboard/modules/reporter's
+# per-worker cpu/mem stats flowing into the Prometheus exporter). The
+# node agent's telemetry loop samples /proc for each worker process and
+# sets these; a process that runs no agent just exposes the empty
+# families. Tagged per worker so one scrape shows the whole node.
+WORKER_CPU_PERCENT = Gauge(
+    "ray_tpu_worker_cpu_percent",
+    "CPU utilization of a worker process (percent of one core)",
+    tag_keys=("node_id", "worker_id", "pid"),
+)
+WORKER_RSS_BYTES = Gauge(
+    "ray_tpu_worker_rss_bytes",
+    "Resident set size of a worker process in bytes",
+    tag_keys=("node_id", "worker_id", "pid"),
+)
+WORKER_UPTIME_SECONDS = Gauge(
+    "ray_tpu_worker_uptime_seconds",
+    "Seconds since the worker process was spawned",
+    tag_keys=("node_id", "worker_id", "pid"),
+)
+NODE_WORKER_COUNT = Gauge(
+    "ray_tpu_node_worker_count",
+    "Live worker processes on a node",
+    tag_keys=("node_id",),
+)
 
 
 def registered() -> "List[Metric]":

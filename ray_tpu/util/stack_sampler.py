@@ -1,0 +1,211 @@
+"""Pure-Python stack profiling: on-demand dumps and time-sampled profiles.
+
+Reference parity: the reference's reporter agent shells out to py-spy for
+``ray stack`` and per-worker CPU flame graphs
+(``dashboard/modules/reporter/reporter_agent.py``). A dependency-free
+equivalent is enough here: ``sys._current_frames()`` exposes every
+thread's frame from inside the process, so the worker itself serves
+dump/profile RPCs — no ptrace, no external binary, works in any
+container.
+
+Three output forms per profile, all derived from the same samples:
+
+* text report — aggregated stacks sorted by sample count (``ray stack``);
+* collapsed format — ``thread;frame;...;frame count`` lines, directly
+  consumable by flamegraph.pl / speedscope / inferno;
+* chrome-trace events — ``ph: "X"`` slices (consecutive samples with a
+  common stack prefix are coalesced into one slice per frame), the same
+  event shape ``state.timeline()`` emits so a profile can be merged into
+  the task timeline and opened in Perfetto.
+
+Everything returned is plain dicts/lists/strings so profiles cross the
+RPC plane natively (no pickle) and serialize straight to JSON.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Tuple
+
+__all__ = [
+    "dump_stacks",
+    "sample",
+    "collapsed",
+    "text_report",
+    "chrome_trace",
+]
+
+
+def _thread_names() -> Dict[int, str]:
+    return {t.ident: t.name for t in threading.enumerate()
+            if t.ident is not None}
+
+
+def _frame_label(frame) -> str:
+    code = frame.f_code
+    return f"{code.co_name} ({os.path.basename(code.co_filename)}:{frame.f_lineno})"
+
+
+def _frame_key(frame) -> str:
+    """Aggregation key: no line number, so a function busy across several
+    lines collapses into one flame-graph frame."""
+    code = frame.f_code
+    return f"{os.path.basename(code.co_filename)}:{code.co_name}"
+
+
+def _capture(skip_idents=()) -> Dict[int, Tuple[str, ...]]:
+    """One sample: {thread_ident: stack as (root, ..., leaf) frame keys}."""
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        if ident in skip_idents:
+            continue
+        stack: List[str] = []
+        while frame is not None:
+            stack.append(_frame_key(frame))
+            frame = frame.f_back
+        stack.reverse()
+        out[ident] = tuple(stack)
+    return out
+
+
+def dump_stacks(header: str = "") -> str:
+    """Instantaneous stack report of every thread (``ray stack`` /
+    ``py-spy dump`` analog), leaf frame last."""
+    names = _thread_names()
+    me = threading.get_ident()
+    lines: List[str] = []
+    if header:
+        lines.append(header)
+    lines.append(
+        f"pid {os.getpid()}: {len(sys._current_frames())} threads "
+        f"at {time.strftime('%Y-%m-%d %H:%M:%S')}")
+    for ident, frame in sorted(sys._current_frames().items()):
+        marker = " (this dump)" if ident == me else ""
+        lines.append(
+            f"\n-- thread {names.get(ident, '?')} (ident {ident}){marker} --")
+        lines.extend(
+            line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(lines) + "\n"
+
+
+def sample(duration_s: float = 1.0, interval_s: float = 0.01) -> dict:
+    """Time-sample every thread of this process for ``duration_s``.
+
+    Returns a plain-data profile::
+
+        {
+          "pid", "duration_s", "interval_s", "num_samples",
+          "threads": {name: samples_observed},
+          "stacks": [{"thread", "frames": [root..leaf], "count"}, ...],
+          "trace_events": [chrome "X" events, coalesced],
+        }
+    """
+    duration_s = max(0.0, float(duration_s))
+    interval_s = min(max(float(interval_s), 0.001), 1.0)
+    me = threading.get_ident()
+    timeline: List[Tuple[float, Dict[int, Tuple[str, ...]]]] = []
+    t0 = time.perf_counter()
+    deadline = t0 + duration_s
+    while True:
+        now = time.perf_counter()
+        timeline.append((now - t0, _capture(skip_idents=(me,))))
+        if now >= deadline:
+            break
+        time.sleep(min(interval_s, max(0.0, deadline - now)))
+    names = _thread_names()
+
+    agg: Dict[Tuple[str, Tuple[str, ...]], int] = {}
+    per_thread: Dict[str, int] = {}
+    for _ts, stacks in timeline:
+        for ident, frames in stacks.items():
+            name = names.get(ident, f"thread-{ident}")
+            per_thread[name] = per_thread.get(name, 0) + 1
+            agg[(name, frames)] = agg.get((name, frames), 0) + 1
+
+    stacks_out = [
+        {"thread": name, "frames": list(frames), "count": count}
+        for (name, frames), count in sorted(
+            agg.items(), key=lambda kv: -kv[1])
+    ]
+    return {
+        "pid": os.getpid(),
+        "duration_s": round(time.perf_counter() - t0, 4),
+        "interval_s": interval_s,
+        "num_samples": len(timeline),
+        "threads": per_thread,
+        "stacks": stacks_out,
+        "trace_events": _trace_events(timeline, names, interval_s),
+    }
+
+
+def _trace_events(timeline, names, interval_s) -> List[dict]:
+    """Coalesce consecutive samples sharing a stack prefix into one
+    chrome-trace "X" slice per frame (what py-spy's chrometrace format
+    does); compatible with the events ``state.timeline()`` emits."""
+    events: List[dict] = []
+    open_frames: Dict[int, List[Tuple[str, float]]] = {}
+
+    def close_from(ident, depth, now):
+        cur = open_frames.get(ident, [])
+        for frame, start in reversed(cur[depth:]):
+            events.append({
+                "name": frame,
+                "cat": "stack_sample",
+                "ph": "X",
+                "ts": start * 1e6,
+                "dur": max(1.0, (now - start) * 1e6),
+                "pid": f"pid-{os.getpid()}",
+                "tid": names.get(ident, f"thread-{ident}"),
+            })
+        del cur[depth:]
+
+    end_ts = (timeline[-1][0] + interval_s) if timeline else 0.0
+    for ts, stacks in timeline:
+        for ident in set(open_frames) | set(stacks):
+            new = stacks.get(ident, ())
+            cur = open_frames.setdefault(ident, [])
+            i = 0
+            while i < len(cur) and i < len(new) and cur[i][0] == new[i]:
+                i += 1
+            close_from(ident, i, ts)
+            for frame in new[i:]:
+                cur.append((frame, ts))
+    for ident in list(open_frames):
+        close_from(ident, 0, end_ts)
+    events.sort(key=lambda e: e["ts"])
+    return events
+
+
+def collapsed(profile: dict) -> str:
+    """Flame-graph collapsed format: ``thread;root;...;leaf count``."""
+    lines = [
+        ";".join([s["thread"], *s["frames"]]) + f" {s['count']}"
+        for s in profile.get("stacks", [])
+    ]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def text_report(profile: dict) -> str:
+    """Human-readable aggregated report, hottest stacks first."""
+    n = max(1, profile.get("num_samples", 1))
+    lines = [
+        f"pid {profile.get('pid', '?')}: {profile.get('num_samples', 0)} "
+        f"samples over {profile.get('duration_s', 0.0):.2f}s "
+        f"(interval {profile.get('interval_s', 0.0) * 1000:.0f}ms)"
+    ]
+    for s in profile.get("stacks", []):
+        pct = 100.0 * s["count"] / n
+        lines.append(
+            f"\n{s['count']:>5} samples ({pct:4.1f}%) thread {s['thread']}")
+        lines.extend(f"    {frame}" for frame in s["frames"])
+    return "\n".join(lines) + "\n"
+
+
+def chrome_trace(profile: dict) -> List[dict]:
+    """The profile's chrome-trace events (mergeable with
+    ``state.timeline()`` output; open in Perfetto / chrome://tracing)."""
+    return list(profile.get("trace_events", []))

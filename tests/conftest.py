@@ -17,7 +17,17 @@ import jax
 # The environment may force a TPU backend via a site hook that overrides
 # JAX_PLATFORMS by config; undo it before any backend is initialized.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Pre-0.5 jax has only the XLA flag. It is read at first backend
+    # initialization (which hasn't happened yet), and new jax REJECTS
+    # having both mechanisms set — hence flag-only on this fallback path.
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
 
 
 @pytest.fixture(scope="session")
