@@ -13,6 +13,9 @@ import ctypes
 import hashlib
 import mmap
 import os
+import threading
+import time
+from contextlib import contextmanager
 
 from ray_tpu._native.build import ensure_built
 
@@ -117,6 +120,30 @@ class ShmStore:
         self._fd = os.open(path, os.O_RDWR)
         self._mm = mmap.mmap(self._fd, 0)
         self._owner = create
+        # In-process close gate: every native call runs inside _op(),
+        # and close() waits for in-flight ops to drain before ts_detach
+        # munmaps the segment — without this, a teardown-time close
+        # racing a concurrent caller (reap loop's release_dead, a spill
+        # pass, a buffer finalizer) is a native use-after-free segfault.
+        self._op_cv = threading.Condition()
+        self._ops = 0
+
+    @contextmanager
+    def _op(self):
+        """Yield the native handle (or None if closed), holding off a
+        concurrent close() for the duration of the native call."""
+        with self._op_cv:
+            h = self._h
+            if h:
+                self._ops += 1
+        try:
+            yield h
+        finally:
+            if h:
+                with self._op_cv:
+                    self._ops -= 1
+                    if self._ops == 0:
+                        self._op_cv.notify_all()
 
     # -- object lifecycle -------------------------------------------------
 
@@ -124,7 +151,10 @@ class ShmStore:
         """Allocate an unsealed object; returns a writable view of its data
         region. Write, then ``seal``."""
         key = store_key(object_id)
-        off = _get_lib().ts_alloc(self._h, key, data_size, len(meta))
+        with self._op() as h:
+            if not h:
+                raise OSError(f"store {self.path} is closed")
+            off = _get_lib().ts_alloc(h, key, data_size, len(meta))
         if off == -2:
             raise ObjectExistsError(object_id)
         if off < 0:
@@ -149,7 +179,10 @@ class ShmStore:
         self.seal(object_id)
 
     def seal(self, object_id: str) -> None:
-        rc = _get_lib().ts_seal(self._h, store_key(object_id))
+        with self._op() as h:
+            if not h:
+                raise KeyError(f"seal({object_id}): store is closed")
+            rc = _get_lib().ts_seal(h, store_key(object_id))
         if rc != 0:
             raise KeyError(f"seal({object_id}) failed: {rc}")
 
@@ -159,10 +192,13 @@ class ShmStore:
         off = ctypes.c_uint64()
         dsz = ctypes.c_uint64()
         msz = ctypes.c_uint64()
-        rc = _get_lib().ts_get(
-            self._h, store_key(object_id), ctypes.byref(off), ctypes.byref(dsz),
-            ctypes.byref(msz),
-        )
+        with self._op() as h:
+            if not h:
+                return None
+            rc = _get_lib().ts_get(
+                h, store_key(object_id), ctypes.byref(off),
+                ctypes.byref(dsz), ctypes.byref(msz),
+            )
         if rc != 0:
             return None
         o, d, m = off.value, dsz.value, msz.value
@@ -174,40 +210,57 @@ class ShmStore:
         # Guard post-close calls: zero-copy buffer finalizers (weakref)
         # can fire at interpreter exit, after shutdown() detached the
         # store — ts_* on a NULL handle is a segfault.
-        if not self._h:
-            return
-        _get_lib().ts_release(self._h, store_key(object_id))
+        with self._op() as h:
+            if not h:
+                return
+            _get_lib().ts_release(h, store_key(object_id))
 
     def contains(self, object_id: str) -> bool:
-        if not self._h:
-            return False
-        return bool(_get_lib().ts_contains(self._h, store_key(object_id)))
+        with self._op() as h:
+            if not h:
+                return False
+            return bool(_get_lib().ts_contains(h, store_key(object_id)))
 
     def delete(self, object_id: str) -> bool:
-        if not self._h:
-            return False
-        return _get_lib().ts_delete(self._h, store_key(object_id)) == 0
+        with self._op() as h:
+            if not h:
+                return False
+            return _get_lib().ts_delete(h, store_key(object_id)) == 0
 
     def abort(self, object_id: str) -> bool:
-        if not self._h:
-            return False
-        return _get_lib().ts_abort(self._h, store_key(object_id)) == 0
+        with self._op() as h:
+            if not h:
+                return False
+            return _get_lib().ts_abort(h, store_key(object_id)) == 0
 
     def release_dead(self, pid: int) -> int:
         """Reclaim all pins held by a dead process + abort its unsealed
-        creations; returns slots touched (crash-leak cleanup)."""
-        return _get_lib().ts_release_dead(self._h, pid)
+        creations; returns slots touched (crash-leak cleanup). A no-op
+        once the store is closed — cleanup of a dead process is moot
+        when the segment itself is gone (this call racing teardown was
+        the observed whole-process segfault)."""
+        with self._op() as h:
+            if not h:
+                return 0
+            return _get_lib().ts_release_dead(h, pid)
 
     def pin(self, object_id: str, pinned: bool = True) -> bool:
         """Primary-copy pin: pinned objects are never LRU-evicted (only
         spilled). Set on put by owners; cleared when the cluster
         ref-counter frees the object."""
-        return _get_lib().ts_pin(self._h, store_key(object_id), int(pinned)) == 0
+        with self._op() as h:
+            if not h:
+                return False
+            return _get_lib().ts_pin(
+                h, store_key(object_id), int(pinned)) == 0
 
     def evict(self, object_id: str) -> bool:
         """Remove a sealed object regardless of pin (its bytes are safe
         elsewhere, e.g. spilled). Fails if actively read (refcount > 0)."""
-        return _get_lib().ts_evict(self._h, store_key(object_id)) == 0
+        with self._op() as h:
+            if not h:
+                return False
+            return _get_lib().ts_evict(h, store_key(object_id)) == 0
 
     def info(self, object_id: str) -> dict | None:
         """Sealed-object metadata (spill-candidate selection)."""
@@ -216,11 +269,14 @@ class ShmStore:
         ref = ctypes.c_int64()
         pin = ctypes.c_uint32()
         lru = ctypes.c_uint64()
-        rc = _get_lib().ts_info(
-            self._h, store_key(object_id), ctypes.byref(dsz),
-            ctypes.byref(msz), ctypes.byref(ref), ctypes.byref(pin),
-            ctypes.byref(lru),
-        )
+        with self._op() as h:
+            if not h:
+                return None
+            rc = _get_lib().ts_info(
+                h, store_key(object_id), ctypes.byref(dsz),
+                ctypes.byref(msz), ctypes.byref(ref), ctypes.byref(pin),
+                ctypes.byref(lru),
+            )
         if rc != 0:
             return None
         return {
@@ -235,7 +291,11 @@ class ShmStore:
 
     def stats(self) -> dict:
         vals = [ctypes.c_uint64() for _ in range(4)]
-        _get_lib().ts_stats(self._h, *[ctypes.byref(v) for v in vals])
+        with self._op() as h:
+            if not h:
+                return {"capacity": 0, "used": 0, "num_objects": 0,
+                        "num_evictions": 0}
+            _get_lib().ts_stats(h, *[ctypes.byref(v) for v in vals])
         return {
             "capacity": vals[0].value,
             "used": vals[1].value,
@@ -245,15 +305,30 @@ class ShmStore:
 
     def list_keys(self, max_ids: int = 1 << 16) -> list[bytes]:
         buf = ctypes.create_string_buffer(max_ids * ID_SIZE)
-        n = _get_lib().ts_list(self._h, buf, max_ids)
+        with self._op() as h:
+            if not h:
+                return []
+            n = _get_lib().ts_list(h, buf, max_ids)
         return [buf.raw[i * ID_SIZE : (i + 1) * ID_SIZE] for i in range(n)]
 
     # -- lifecycle --------------------------------------------------------
 
     def close(self, unlink: bool = False) -> None:
-        if self._h:
-            _get_lib().ts_detach(self._h)
-            self._h = None
+        # Null the handle first (new callers see "closed"), then wait
+        # for in-flight native calls to drain before detaching — the
+        # reverse order left a window where ts_* ran on a just-munmapped
+        # segment (observed as a release_dead segfault at teardown that
+        # took the whole test process down).
+        with self._op_cv:
+            h, self._h = self._h, None
+            deadline = time.monotonic() + 5.0
+            while h and self._ops > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break  # a stuck caller must not hang shutdown
+                self._op_cv.wait(remaining)
+        if h:
+            _get_lib().ts_detach(h)
             try:
                 self._mm.close()
             except BufferError:

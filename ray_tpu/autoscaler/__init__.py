@@ -72,13 +72,22 @@ class StandardAutoscaler:
         max_workers: int = 8,
         idle_timeout_s: float = 60.0,
         launch_cooldown_s: float = 2.0,
+        drain_deadline_s: float | None = None,
     ):
+        from ray_tpu.core.config import config
+
         self.head = RpcClient(head_address)
         self.provider = provider
         self.node_types = node_types
         self.max_workers = max_workers
         self.idle_timeout_s = idle_timeout_s
         self.launch_cooldown_s = launch_cooldown_s
+        self.drain_deadline_s = (
+            config.drain_deadline_s if drain_deadline_s is None
+            else drain_deadline_s)
+        # Nodes whose scale-down drain was initiated; terminated once
+        # the head reports them DEAD (possibly on a later pass).
+        self._draining: set = set()
         self._idle_since: Dict[str, float] = {}
         self._last_launch = 0.0
         self._stop = threading.Event()
@@ -135,11 +144,22 @@ class StandardAutoscaler:
                 report["launched"].append(node_id)
                 self._last_launch = now
 
-        # Scale down: provider-owned nodes fully idle past the timeout.
+        # Scale down: provider-owned nodes fully idle past the timeout
+        # are DRAINED before the provider terminate hook — a task that
+        # landed during the idle window finishes (or its actors migrate)
+        # instead of being killed mid-flight, and the node is excluded
+        # from new placements the moment the drain starts, so the window
+        # cannot refill either. Drains are initiated asynchronously
+        # (wait=False) so one busy node cannot stall the whole reconcile
+        # pass; termination lands once the head reports the node DEAD.
+        self._reap_drained({n["NodeID"]: n for n in nodes}, report)
         by_id = {n["NodeID"]: n for n in alive}
+        started: list = []
         for node_id in list(self.provider.non_terminated_nodes()):
+            if node_id in self._draining:
+                continue  # drain in flight; _reap_drained settles it
             info = by_id.get(node_id)
-            if info is None:
+            if info is None or info.get("State", "ALIVE") != "ALIVE":
                 continue
             idle = info["Available"] == info["Resources"]
             if not idle:
@@ -147,10 +167,42 @@ class StandardAutoscaler:
                 continue
             since = self._idle_since.setdefault(node_id, now)
             if now - since >= self.idle_timeout_s:
-                self.provider.terminate_node(node_id)
+                try:
+                    self.head.call(
+                        "drain_node", node_id, "autoscaler_idle",
+                        self.drain_deadline_s, False, timeout=15.0)
+                    self._draining.add(node_id)
+                    started.append(node_id)
+                except Exception:
+                    # Head hiccup: terminate ungracefully (old behavior)
+                    # rather than leak the provider node.
+                    self.provider.terminate_node(node_id)
+                    report["terminated"].append(node_id)
                 self._idle_since.pop(node_id, None)
-                report["terminated"].append(node_id)
+        if started:
+            # Bounded settle: an idle node drains in well under a
+            # second, so give this pass a brief window to finish the
+            # common case in place; busy nodes settle on a later pass.
+            deadline = time.monotonic() + min(3.0, self.drain_deadline_s + 1.0)
+            while started and time.monotonic() < deadline:
+                time.sleep(0.05)
+                try:
+                    table = {n["NodeID"]: n for n in self.head.call("nodes")}
+                except Exception:
+                    break
+                self._reap_drained(table, report)
+                started = [n for n in started if n in self._draining]
         return report
+
+    def _reap_drained(self, node_table: dict, report: dict) -> None:
+        """Terminate provider nodes whose scale-down drain completed."""
+        for node_id in list(self._draining):
+            info = node_table.get(node_id)
+            if info is not None and info["Alive"]:
+                continue  # still draining
+            self._draining.discard(node_id)
+            self.provider.terminate_node(node_id)
+            report["terminated"].append(node_id)
 
     def start(self, interval_s: float = 1.0) -> None:
         def loop():

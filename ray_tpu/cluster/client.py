@@ -37,6 +37,14 @@ from ray_tpu.core.resources import demand_of
 _REFETCH = object()
 
 
+def _is_preemption_loss(cause) -> bool:
+    """Was this loss caused by a planned drain / preemption? Such losses
+    are exempt from retry budgets (Ray's preemption exemption: work lost
+    to a preempted node does not consume ``max_retries``)."""
+    c = (cause or "").lower()
+    return c.startswith("drained") or "draining" in c or "preempt" in c
+
+
 class _GetError:
     """An exception captured for one ref of a multi-ref get, deferred so
     errors raise in ref order."""
@@ -762,15 +770,23 @@ class ClusterBackend:
                 if root is not None and \
                         root.get("num_returns") == "streaming":
                     spec = root
-        if spec is None or spec.get("retries_left", 0) <= 0:
+        if spec is None:
             return False
         assigned = spec.get("assigned_node")
         if assigned is None:
             return False  # not yet placed; the pending-retry thread owns it
         nodes = {n["NodeID"]: n for n in self.head.call("nodes")}
-        if nodes.get(assigned, {}).get("Alive"):
-            return False  # still computing
-        spec["retries_left"] -= 1
+        info = nodes.get(assigned, {})
+        if info.get("Alive"):
+            return False  # still computing (a DRAINING node finishes work)
+        # Preemption exemption: a task lost to a drained/preempted node
+        # re-executes WITHOUT consuming retries_left — planned node
+        # departure is the platform's fault, not the task's.
+        exempt = _is_preemption_loss(info.get("DeathCause"))
+        if spec.get("retries_left", 0) <= 0 and not exempt:
+            return False
+        if not exempt:
+            spec["retries_left"] -= 1
         # Soft affinity on recovery: the pinned node is gone, so let the
         # scheduler place the retry anywhere feasible.
         spec["sinfo"]["node_affinity"] = None
@@ -801,15 +817,18 @@ class ClusterBackend:
             return  # restarting: keep waiting
         if info.get("num_restarts", 0) > entry["incarnation"]:
             # The call was in flight across a restart: its execution (and
-            # queued successors) died with the old worker.
-            if entry["retries_left"] == 0:
+            # queued successors) died with the old worker. Calls lost to
+            # a drain-migration replay budget-free (preemption exemption,
+            # mirroring the task-retry exemption).
+            exempt = _is_preemption_loss(info.get("restart_cause"))
+            if entry["retries_left"] == 0 and not exempt:
                 for o in entry.get("oids", [oid]):
                     self._actor_tasks.pop(o, None)
                 raise ActorError(
                     f"actor {actor_id} restarted and the call was lost "
                     f"(max_task_retries exhausted)"
                 )
-            if entry["retries_left"] > 0:
+            if entry["retries_left"] > 0 and not exempt:
                 entry["retries_left"] -= 1
             entry["incarnation"] = info["num_restarts"]
             spec = entry["spec"]

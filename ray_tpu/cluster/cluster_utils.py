@@ -87,10 +87,17 @@ class Cluster:
         self.nodes.append(node)
         return node
 
-    def remove_node(self, node: NodeAgent, graceful: bool = True):
+    def remove_node(self, node: NodeAgent, graceful: bool = True, *,
+                    reason: str = "removed",
+                    deadline_s: float | None = None):
+        """Remove a node. ``graceful`` routes through the head's drain
+        protocol (deadline-bounded: in-flight tasks finish, restartable
+        actors migrate first, owners get the retry exemption); the
+        ungraceful path stays an instant removal for chaos tests."""
         if graceful and self.head is not None:
             try:
-                self.head._mark_dead(node.node_id, "removed")
+                self.head.rpc_drain_node(
+                    node.node_id, reason, deadline_s, wait=True)
             except Exception:
                 pass
         node.stop()

@@ -21,6 +21,18 @@ from typing import Any, Optional
 from ray_tpu.cluster.rpc import RpcClient
 
 
+def drain_rpc_timeout(deadline_s: Optional[float]) -> float:
+    """Client RPC timeout for a blocking drain_node call: the effective
+    server-side deadline (mirroring the head's config fallback) plus
+    margin covering the coordinator's own evt.wait slack — so the RPC
+    always outlives the drain it is waiting on."""
+    from ray_tpu.core.config import config
+
+    effective = (config.drain_deadline_s if deadline_s is None
+                 else float(deadline_s))
+    return effective + 45.0
+
+
 class _Accessor:
     def __init__(self, rpc: RpcClient):
         self._rpc = rpc
@@ -39,8 +51,14 @@ class NodeInfoAccessor(_Accessor):
     def resources_available(self) -> dict:
         return self._rpc.call("available_resources")
 
-    def drain(self, node_id: str) -> None:
-        self._rpc.call("drain_node", node_id)
+    def drain(self, node_id: str, reason: str = "requested",
+              deadline_s: Optional[float] = None,
+              wait: bool = True) -> dict:
+        """Graceful, deadline-bounded drain through the head's drain
+        protocol (DRAINING -> migrate actors -> quiesce -> DEAD)."""
+        return self._rpc.call(
+            "drain_node", node_id, reason, deadline_s, wait,
+            timeout=drain_rpc_timeout(deadline_s))
 
 
 class ActorInfoAccessor(_Accessor):

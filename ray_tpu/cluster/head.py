@@ -96,7 +96,22 @@ class NodeInfo:
         self.store_path = store_path
         self.last_heartbeat = time.monotonic()
         self.alive = True
+        # Lifecycle: ALIVE -> (DRAINING ->) DEAD. A DRAINING node keeps
+        # heartbeating and serving objects but takes no new placements
+        # (node_manager.proto DrainRaylet analog).
+        self.state = "ALIVE"
+        self.drain_reason = None
+        self.drain_started = None
+        self.drain_done: threading.Event | None = None
+        self.drain_forced = False
+        self.drain_duration = None
+        self.migrated_actors: list[str] = []
+        self.death_cause = None
         self.client = RpcClient(address)
+
+    @property
+    def schedulable(self) -> bool:
+        return self.alive and self.state != "DRAINING"
 
 
 class HeadServer:
@@ -259,10 +274,168 @@ class HeadServer:
             node.available = dict(available)
             return {"ok": True}
 
-    def rpc_drain_node(self, node_id):
-        """Graceful removal (cluster_utils.remove_node)."""
-        self._mark_dead(node_id, "drained")
-        return True
+    def rpc_drain_node(self, node_id, reason: str = "requested",
+                       deadline_s: float | None = None, wait: bool = True):
+        """Graceful node removal (DrainRaylet analog): the node enters
+        DRAINING — excluded from every new task/actor/PG placement while
+        heartbeats keep flowing — in-flight tasks get up to ``deadline_s``
+        to finish, restartable actors are PROACTIVELY reconstructed on
+        other nodes (budget-free: a planned drain must not consume
+        ``max_restarts``), then the node is deregistered and its agent
+        shut down. ``wait=False`` returns after initiating (the path a
+        preempted agent takes: it must not block on its own removal)."""
+        if deadline_s is None:
+            deadline_s = config.drain_deadline_s
+        deadline_s = max(0.0, float(deadline_s))
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return {"ok": False, "node_id": node_id, "state": "UNKNOWN"}
+            if not node.alive:
+                return {"ok": False, "node_id": node_id, "state": "DEAD",
+                        "cause": node.death_cause}
+            started = node.state != "DRAINING"
+            if started:
+                node.state = "DRAINING"
+                node.drain_reason = reason
+                node.drain_started = time.monotonic()
+                node.drain_done = threading.Event()
+            evt = node.drain_done
+        if started:
+            from ray_tpu.util import metrics as _metrics
+
+            _metrics.NODE_DRAINS_TOTAL.inc(tags={"reason": reason})
+            self.pubsub.publish("NODES", node_id, {
+                "node_id": node_id, "state": "DRAINING", "reason": reason,
+            })
+            threading.Thread(
+                target=self._drain_coordinator,
+                args=(node_id, reason, deadline_s), daemon=True,
+            ).start()
+        if wait and evt is not None:
+            evt.wait(deadline_s + 30.0)
+        with self._lock:
+            node = self._nodes.get(node_id)
+            return {
+                "ok": True,
+                "node_id": node_id,
+                "state": node.state if node else "UNKNOWN",
+                "reason": reason,
+                "migrated_actors": list(node.migrated_actors) if node else [],
+                "forced": bool(node.drain_forced) if node else False,
+                "duration_s": node.drain_duration if node else None,
+            }
+
+    def _drain_coordinator(self, node_id: str, reason: str,
+                           deadline_s: float):
+        """One drain's lifecycle: migrate restartable actors off, let the
+        agent quiesce (finish queued+running tasks) up to the deadline,
+        then deregister. Tasks force-killed at deadline expiry recover
+        through owner lineage — exempt from their retry budget because
+        the death cause below marks the loss as a drain."""
+        t0 = time.monotonic()
+        deadline = t0 + deadline_s
+        with self._lock:
+            node = self._nodes.get(node_id)
+        if node is None:
+            return
+        node.migrated_actors = self._migrate_actors_off(node_id, reason)
+        try:
+            node.client.call("drain_self", reason, deadline_s, timeout=5.0)
+        except Exception:
+            pass  # agent may already be gone; the mark-dead below settles it
+        forced = True
+        probe_misses = 0
+        while time.monotonic() < deadline and not self._stop.is_set():
+            with self._lock:
+                if not node.alive:
+                    forced = False  # heartbeat monitor raced us to DEAD
+                    break
+            try:
+                st = node.client.call("drain_status", timeout=5.0)
+            except Exception:
+                # One failed probe may just be a busy agent (RPC
+                # timeout); only repeated failures mean it exited on
+                # its own and there is nothing left to wait for.
+                probe_misses += 1
+                if probe_misses >= 3:
+                    forced = False
+                    break
+                time.sleep(0.1)
+                continue
+            probe_misses = 0
+            if st.get("queued", 0) == 0 and st.get("running", 0) == 0 and \
+                    all(self._actor_settled_elsewhere(aid, node_id)
+                        for aid in node.migrated_actors):
+                # Quiet, and every migrated actor is live on another node
+                # (or terminally settled) BEFORE the drained agent exits.
+                forced = False
+                break
+            time.sleep(0.1)
+        node.drain_forced = forced
+        node.drain_duration = round(time.monotonic() - t0, 3)
+        from ray_tpu.util import metrics as _metrics
+
+        _metrics.NODE_DRAIN_DURATION_SECONDS.observe(
+            node.drain_duration, tags={"reason": reason})
+        self._mark_dead(node_id, f"drained: {reason}")
+        try:
+            node.client.call("shutdown_node", timeout=5.0)
+        except Exception:
+            pass
+        if node.drain_done is not None:
+            node.drain_done.set()
+
+    def _actor_settled_elsewhere(self, actor_id: str, node_id: str) -> bool:
+        """Locked-free check: has a migrated actor finished leaving the
+        draining node (ALIVE on another node, or terminally DEAD)?"""
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None or info["state"] == "DEAD":
+                return True  # restart failed/killed meanwhile: settled
+            return info["state"] == "ALIVE" and info["node_id"] != node_id
+
+    def _migrate_actors_off(self, node_id: str, reason: str) -> list[str]:
+        """Proactive migration (the drain half of ReconstructActor): every
+        restartable ALIVE actor on the node transitions to RESTARTING
+        WITHOUT burning ``restarts_left`` — planned removal is not a crash
+        — and reconstructs through the ordinary restart path, which the
+        scheduler now points away from this node. The old incarnation's
+        worker is detached-and-killed agent-side so its death is plain
+        worker cleanup, not a second (budget-consuming) actor death."""
+        moved: list[str] = []
+        with self._lock:
+            node = self._nodes.get(node_id)
+            for info in self._actors.values():
+                if info["node_id"] != node_id or info["state"] != "ALIVE":
+                    continue
+                rec = self._actor_specs.get(info["actor_id"])
+                if rec is None or rec["restarts_left"] == 0:
+                    continue  # not restartable: rides the node down
+                info["state"] = "RESTARTING"
+                info["death_cause"] = (
+                    f"node {node_id} draining: {reason}")
+                info["num_restarts"] = info.get("num_restarts", 0) + 1
+                moved.append(info["actor_id"])
+                self.pubsub.publish("ACTORS", info["actor_id"], dict(info))
+            if moved:
+                self._actors_cv.notify_all()
+        if moved:
+            from ray_tpu.util import metrics as _metrics
+
+            _metrics.NODE_DRAIN_ACTORS_MIGRATED.inc(
+                len(moved), tags={"reason": reason})
+        for actor_id in moved:
+            if node is not None:
+                try:
+                    node.client.call(
+                        "detach_actor_worker", actor_id, timeout=5.0)
+                except Exception:
+                    pass
+            threading.Thread(
+                target=self._restart_actor, args=(actor_id,), daemon=True,
+            ).start()
+        return moved
 
     def rpc_nodes(self):
         with self._lock:
@@ -270,6 +443,9 @@ class HeadServer:
                 {
                     "NodeID": n.node_id,
                     "Alive": n.alive,
+                    "State": n.state,
+                    "DrainReason": n.drain_reason,
+                    "DeathCause": n.death_cause,
                     "Address": n.address,
                     "Resources": dict(n.resources),
                     "Available": dict(n.available),
@@ -292,7 +468,7 @@ class HeadServer:
         with self._lock:
             total: dict[str, float] = {}
             for n in self._nodes.values():
-                if not n.alive:
+                if not n.schedulable:  # draining: no capacity for new work
                     continue
                 for k, v in n.available.items():
                     total[k] = total.get(k, 0.0) + v
@@ -340,7 +516,16 @@ class HeadServer:
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
                 return  # already dead/unknown: no duplicate DEAD event
+            if node.state == "DRAINING" and not cause.startswith("drained"):
+                # A draining (e.g. preempted) VM can vanish before the
+                # coordinator finishes — the heartbeat monitor then wins
+                # the race to declare death. Fold the drain reason into
+                # the cause so owners still get the retry-budget
+                # exemption for exactly the loss it was built for.
+                cause = f"drained: {node.drain_reason} ({cause})"
             node.alive = False
+            node.state = "DEAD"
+            node.death_cause = cause
             self.pubsub.publish("NODES", node_id, {
                 "node_id": node_id, "state": "DEAD", "cause": cause,
             })
@@ -809,6 +994,9 @@ class HeadServer:
                 # Incarnation counter: callers detect restarts (and replay
                 # lost calls) by comparing this against their submit-time view.
                 "num_restarts": prev.get("num_restarts", 0) if prev else 0,
+                # Why the previous incarnation died: callers exempt calls
+                # lost to a drain/preemption from max_task_retries.
+                "restart_cause": prev.get("death_cause") if prev else None,
                 "max_task_retries": rec.get("max_task_retries", 0),
             }
             self._actors_cv.notify_all()
@@ -835,8 +1023,30 @@ class HeadServer:
                 return None
             return dict(self._actors[actor_id])
 
-    def rpc_mark_actor_dead(self, actor_id, cause, allow_restart=True):
+    def rpc_mark_actor_dead(self, actor_id, cause, allow_restart=True,
+                            worker_address=None):
+        """``worker_address`` (when the reporter is a node agent: the
+        dead worker's RPC address) identifies WHICH incarnation died, so
+        the head can drop reports about a PREVIOUS one: a drain-migrated
+        actor's OLD worker dying on the node it left (e.g. the
+        migration's detach RPC was lost and the worker died still bound)
+        must not read as a second death — whether the new incarnation is
+        still RESTARTING or already ALIVE. A death of any OTHER worker
+        (in particular a restart's constructor process, even one placed
+        back on the same node) is processed normally so failed restarts
+        still settle to DEAD."""
         with self._lock:
+            info = self._actors.get(actor_id)
+            if info is not None and allow_restart and worker_address:
+                if info["state"] == "ALIVE" and \
+                        info.get("address") != worker_address:
+                    return True  # stale: the live incarnation is another
+                    # process; this report is about a predecessor
+                if info["state"] == "RESTARTING" and \
+                        info.get("address") == worker_address:
+                    return True  # the departing incarnation's death —
+                    # the restart it triggered (or the migration that
+                    # abandoned it) is already in flight
             self._on_actor_death(actor_id, cause, allow_restart)
         return True
 
@@ -1133,10 +1343,12 @@ class HeadServer:
 
     def _schedule_locked(self, demand, caller_node=None, strategy=None,
                          node_affinity=None, task_id=None, spilled=False):
-        alive = [n for n in self._nodes.values() if n.alive]
+        # DRAINING nodes are excluded from every new placement (they only
+        # finish what they already have).
+        alive = [n for n in self._nodes.values() if n.schedulable]
         if node_affinity is not None:
             node = self._nodes.get(node_affinity)
-            if node is not None and node.alive:
+            if node is not None and node.schedulable:
                 return self._pick(node, demand)
             return None
         feasible = [
@@ -1177,7 +1389,7 @@ class HeadServer:
         # the caller's node itself just rejected this spec (spilled).
         if caller_node is not None and not spilled:
             local = self._nodes.get(caller_node)
-            if local is not None and local.alive and local in feasible:
+            if local is not None and local.schedulable and local in feasible:
                 if headroom(local) >= 0:
                     return self._pick(local, demand)
         if spilled and len(feasible) > 1:
@@ -1232,7 +1444,7 @@ class HeadServer:
     def _pg_assign(self, bundles, strategy) -> Optional[list]:
         """Choose a node per bundle against total capacities."""
         with self._lock:
-            alive = [n for n in self._nodes.values() if n.alive]
+            alive = [n for n in self._nodes.values() if n.schedulable]
         if not alive:
             return None
         # Track what this PG adds per node to respect totals.

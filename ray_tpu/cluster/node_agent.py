@@ -106,6 +106,17 @@ class NodeAgent:
         self._bundle_state: dict[tuple, str] = {}  # PREPARED | COMMITTED
         self._task_queue: list[dict] = []
         self._queue_cv = threading.Condition(self._lock)
+        # Draining (DrainRaylet analog): set by the head's drain
+        # coordinator (or a preemption self-drain). A draining node
+        # finishes what it has but admits no new leased pushes and
+        # gossips zero availability.
+        self._draining = False
+        self._drain_reason: Optional[str] = None
+        # Specs popped from the queue but not yet bound to a worker
+        # (acquiring resources / waiting for a fork): they are neither
+        # "queued" nor "running", and the drain coordinator's quiescence
+        # probe must not mistake that window for an idle node.
+        self._dispatch_inflight = 0
         # Demand of queued-or-acquiring tasks, not yet debited from the
         # pool: leased-push admission compares against available minus this.
         self._committed: dict[str, float] = {}
@@ -176,12 +187,21 @@ class NodeAgent:
         )
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         threading.Thread(target=self._dispatch_loop, daemon=True).start()
-        threading.Thread(target=self._reap_loop, daemon=True).start()
+        # Kept joinable: stop() waits the reaper out before detaching the
+        # shm store (its release_dead on a detached segment is a crash).
+        self._reap_thread = threading.Thread(
+            target=self._reap_loop, daemon=True)
+        self._reap_thread.start()
         if config.worker_telemetry_interval_s > 0:
             threading.Thread(
                 target=self._telemetry_loop, daemon=True).start()
         if config.gossip_interval_s > 0:
             threading.Thread(target=self._gossip_loop, daemon=True).start()
+        if config.preemption_poll_interval_s > 0 and (
+                config.preemption_signal_file
+                or config.preemption_metadata_url):
+            threading.Thread(
+                target=self._preemption_watcher, daemon=True).start()
         # OOM protection (memory_monitor.h / worker_killing_policy.h
         # analog): watch node memory, kill the newest task's worker under
         # pressure; its refs raise OutOfMemoryError.
@@ -541,6 +561,10 @@ class NodeAgent:
         rejected = []
         accepted = []
         with self._queue_cv:
+            if self._draining:
+                # A draining node takes no new work: the client's leased
+                # burst spills back through the head, which excludes us.
+                return list(range(len(specs)))
             avail = self.pool.available()
             for k, v in self._committed.items():
                 avail[k] = avail.get(k, 0.0) - v
@@ -664,9 +688,17 @@ class NodeAgent:
                 if self._shutdown.is_set():
                     return
                 spec = self._task_queue.pop(0)
+                self._dispatch_inflight += 1
             threading.Thread(
-                target=self._dispatch_one, args=(spec,), daemon=True
+                target=self._dispatch_tracked, args=(spec,), daemon=True
             ).start()
+
+    def _dispatch_tracked(self, spec: dict):
+        try:
+            self._dispatch_one(spec)
+        finally:
+            with self._lock:
+                self._dispatch_inflight -= 1
 
     def _bundle_pool(self, spec) -> Optional[ResourcePool]:
         pg_id, idx = spec.get("pg_id"), spec.get("bundle_index", -1)
@@ -1030,7 +1062,8 @@ class NodeAgent:
             pass
         if w.is_actor and w.actor_id:
             try:
-                self.head.call("mark_actor_dead", w.actor_id, cause)
+                self.head.call("mark_actor_dead", w.actor_id, cause,
+                               True, w.address)
             except Exception:
                 pass
         if w.client_id:
@@ -1111,6 +1144,117 @@ class NodeAgent:
         except Exception:
             pass
         return True
+
+    def rpc_detach_actor_worker(self, actor_id):
+        """Drain-migration support: the head already owns this actor's
+        state transition (RESTARTING on another node), so the OLD
+        incarnation's worker is detached from its actor binding and
+        killed — the reap loop then does plain worker cleanup instead of
+        reporting a second, budget-consuming actor death."""
+        with self._lock:
+            target = next(
+                (w for w in self._workers.values()
+                 if w.actor_id == actor_id),
+                None,
+            )
+            if target is None:
+                return False
+            target.is_actor = False
+            target.actor_id = None
+        target.proc.kill()
+        return True
+
+    # -- drain / preemption (node_manager.proto DrainRaylet analog) --------
+
+    def rpc_drain_self(self, reason: str = "requested",
+                       deadline_s: float | None = None):
+        """The head's drain coordinator (or our own preemption watcher)
+        says this node is leaving: stop admitting leased pushes; queued
+        and running tasks keep going until the coordinator's deadline."""
+        with self._lock:
+            self._draining = True
+            self._drain_reason = reason
+        return True
+
+    def rpc_drain_status(self):
+        """Quiescence probe for the drain coordinator: queued tasks plus
+        busy non-actor workers (actor processes hold their creation spec
+        as current_task for life, so they never count as 'running')."""
+        with self._lock:
+            running = self._dispatch_inflight + sum(
+                1 for w in self._workers.values()
+                if w.current_task is not None and not w.is_actor
+                and w.proc.poll() is None
+            )
+            return {
+                "draining": self._draining,
+                "reason": self._drain_reason,
+                "queued": len(self._task_queue),
+                "running": running,
+            }
+
+    def _self_drain(self, reason: str = "preemption") -> None:
+        """Self-initiated drain (SIGTERM / preemption notice): ask the
+        head to run the drain protocol for us — wait=False because the
+        coordinator will call back into this agent (drain_self, then
+        shutdown_node once quiesced)."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+            self._drain_reason = reason
+        try:
+            self.head.call(
+                "drain_node", self.node_id, reason, None, False,
+                timeout=10.0)
+        except Exception:
+            # Head unreachable and the node is going away regardless:
+            # local stop is the only remaining graceful option.
+            self.stop()
+
+    def _preemption_watcher(self) -> None:
+        """Pluggable preemption-signal poll (the metadata-server watcher
+        of cloud deployments; file-triggered in tests). Detection
+        self-initiates a drain with reason="preemption" so actors migrate
+        and owners get the retry-budget exemption BEFORE the VM vanishes."""
+        interval = max(0.05, config.preemption_poll_interval_s)
+        sig_file = config.preemption_signal_file
+        url = config.preemption_metadata_url
+        while not self._shutdown.wait(interval):
+            with self._lock:
+                if self._draining:
+                    return
+            if sig_file and self._signal_file_hit(sig_file):
+                self._self_drain("preemption")
+                return
+            if url and self._metadata_preempted(url):
+                self._self_drain("preemption")
+                return
+
+    def _signal_file_hit(self, path: str) -> bool:
+        """The signal file preempts every node when empty, or only the
+        nodes whose ids appear in its contents."""
+        try:
+            with open(path) as f:
+                body = f.read().strip()
+        except OSError:
+            return False
+        return body == "" or self.node_id in body
+
+    @staticmethod
+    def _metadata_preempted(url: str) -> bool:
+        """GCE-shaped poll: .../instance/preempted returns "TRUE" once
+        the termination notice lands."""
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(
+                url, headers={"Metadata-Flavor": "Google"})
+            with urllib.request.urlopen(req, timeout=2.0) as resp:
+                body = resp.read().decode("utf-8", "replace").strip()
+            return body.upper() in ("TRUE", "PREEMPTED", "1")
+        except Exception:
+            return False
 
     # -- placement group bundles (2PC participant) ------------------------
 
@@ -1620,8 +1764,11 @@ class NodeAgent:
             qdepth = len(self._task_queue)
             self._view_version += 1
             version = self._view_version
+            draining = self._draining
         return {
-            "available": dict(self.pool.available()),
+            # A draining node gossips zero availability so no peer picks
+            # it as a spillback target (leased admission rejects anyway).
+            "available": {} if draining else dict(self.pool.available()),
             "queue": qdepth,
             "version": version,
             "address": self.address,
@@ -1753,8 +1900,24 @@ class NodeAgent:
     def stop(self):
         with self._lock:
             if getattr(self, "_stopped", False):
-                return
-            self._stopped = True
+                done = self._stop_done
+            else:
+                done = None
+                self._stopped = True
+                self._stop_done = threading.Event()
+        if done is not None:
+            # Another thread (e.g. the drain coordinator's shutdown_node
+            # RPC) is already stopping this agent: wait it out so callers
+            # get the synchronous contract — by return, the store is
+            # closed/unlinked and no native call can race a new segment.
+            done.wait(15.0)
+            return
+        try:
+            self._stop_inner()
+        finally:
+            self._stop_done.set()
+
+    def _stop_inner(self):
         self._shutdown.set()
         # Retract this node's telemetry series (tests run many agents per
         # process; a stopped node must not leave stale gauge children).
@@ -1786,6 +1949,13 @@ class NodeAgent:
             except Exception:
                 pass
         self._server.stop()
+        # The reap loop may be mid-iteration on the workers just killed;
+        # let it finish before the store detaches (release_dead on a
+        # closed segment is guarded, but ordering keeps cleanup complete).
+        try:
+            self._reap_thread.join(timeout=10.0)
+        except RuntimeError:
+            pass  # stop() invoked from the reap thread itself
         self.store.close(unlink=True)
 
 
@@ -1810,7 +1980,26 @@ def main():
         session=args.session,
     )
     print(f"NODE_ADDRESS={agent.address}", flush=True)
-    signal.sigwait({signal.SIGTERM, signal.SIGINT})
+
+    # SIGTERM is a preemption/termination notice (spot TPU pods get one
+    # seconds before the VM vanishes): self-drain so the head migrates
+    # actors and owners get the retry exemption, instead of dying as a
+    # crash. A second SIGTERM (or SIGINT) stops immediately.
+    def _on_signal(signum, _frame):
+        if signum == signal.SIGTERM and not agent._shutdown.is_set():
+            with agent._lock:
+                first = not agent._draining
+            if first:
+                threading.Thread(
+                    target=agent._self_drain, args=("preemption",),
+                    daemon=True,
+                ).start()
+                return
+        threading.Thread(target=agent.stop, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    agent._shutdown.wait()
     agent.stop()
 
 

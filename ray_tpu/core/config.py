@@ -93,6 +93,19 @@ _DEFS: dict[str, tuple[type, Any]] = {
     # itself is capacity-based, not depth-based — see
     # node_agent.rpc_submit_tasks_leased.)
     "submit_batch_max": (int, 256),
+    # -- node drain / preemption -------------------------------------------
+    # Default deadline a graceful drain gives in-flight tasks before the
+    # node is force-removed (DrainRaylet deadline analog).
+    "drain_deadline_s": (float, 30.0),
+    # Agent-side preemption watcher cadence; the watcher thread only
+    # starts when a signal source below is configured.
+    "preemption_poll_interval_s": (float, 1.0),
+    # Test/ops hook: a node self-drains with reason="preemption" when
+    # this file exists and is empty or contains its node id.
+    "preemption_signal_file": (str, ""),
+    # Cloud hook: metadata endpoint polled for a termination notice
+    # (GCE: .../computeMetadata/v1/instance/preempted returns "TRUE").
+    "preemption_metadata_url": (str, ""),
     # -- pubsub ------------------------------------------------------------
     "pubsub_max_buffer": (int, 10_000),
     "pubsub_subscriber_ttl_s": (float, 120.0),

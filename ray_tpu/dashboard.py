@@ -383,7 +383,11 @@ class Dashboard:
         return {
             "head_address": self._head_address,
             "time": time.time(),
-            "alive_nodes": sum(1 for n in nodes if n["Alive"]),
+            "alive_nodes": sum(
+                1 for n in nodes
+                if n["Alive"] and n.get("State", "ALIVE") != "DRAINING"),
+            "draining_nodes": sum(
+                1 for n in nodes if n.get("State") == "DRAINING"),
             "dead_nodes": sum(1 for n in nodes if not n["Alive"]),
             "resources_total": total,
             "resources_available": avail,

@@ -47,7 +47,7 @@ INDEX_HTML = r"""<!doctype html>
   td.mono, .mono { font-family:ui-monospace, monospace; font-size:12px; }
   .ALIVE, .FINISHED, .RUNNING_OK, .ok { color:var(--ok); }
   .DEAD, .FAILED, .ERROR, .bad { color:var(--bad); }
-  .PENDING, .RESTARTING, .warn { color:var(--warn); }
+  .PENDING, .RESTARTING, .DRAINING, .warn { color:var(--warn); }
   #logs { background:#0b0e11; border:1px solid #2a323a; padding:10px;
           height:60vh; overflow-y:auto; white-space:pre-wrap;
           font-family:ui-monospace, monospace; font-size:12px; }
@@ -129,6 +129,8 @@ const RENDER = {
     setTiles([
       ["nodes alive", s.alive_nodes ?? "?",
        (s.dead_nodes || 0) > 0 ? "warn" : "ok"],
+      ["nodes draining", s.draining_nodes ?? 0,
+       (s.draining_nodes || 0) > 0 ? "warn" : ""],
       ["nodes dead", s.dead_nodes ?? 0,
        (s.dead_nodes || 0) > 0 ? "bad" : ""],
       ["CPU avail / total", `${avail.CPU ?? "?"} / ${res.CPU ?? "?"}`],
@@ -146,9 +148,16 @@ const RENDER = {
   async nodes() {
     const d = await api("/api/nodes");
     $("view").replaceChildren(table(
-      ["NodeID", "Address", "Alive", "Resources", "StorePath"],
+      ["NodeID", "Address", "State", "Cause", "Resources", "StorePath"],
       d.nodes || [], (r, c) => {
-        if (c === "Alive") return stateCell(r.Alive ? "ALIVE" : "DEAD");
+        if (c === "State")
+          return stateCell(r.State || (r.Alive ? "ALIVE" : "DEAD"));
+        if (c === "Cause") {
+          // DRAINING shows its reason; DEAD its cause (crash vs drain).
+          const td = el("td", "mono");
+          td.textContent = r.DeathCause || r.DrainReason || "";
+          return td;
+        }
         if (c === "Resources") {
           const td = el("td", "mono");
           td.textContent = JSON.stringify(r.Resources || r.resources || {});

@@ -58,3 +58,28 @@ def record_if_on_chip(entry: dict, path: str | None = None) -> str | None:
     if not device or device == "cpu":
         return None
     return record(entry, path)
+
+
+def record_drain_recovery(proactive_drain_ms: float,
+                          crash_detection_ms: float, *,
+                          device: str = "", path: str | None = None,
+                          **extra) -> dict:
+    """Drain-vs-crash actor recovery latency evidence
+    (``scripts/drain_bench.py``): how long until an actor lost from a
+    departing node is ALIVE on another node, proactive drain vs
+    heartbeat-timeout crash detection. Committed to the evidence trail
+    only when run on a real (accelerator) cluster; returns the entry
+    (with ``committed_to``) either way so callers print the same record
+    that lands in the trail."""
+    entry = {
+        "bench": "drain_recovery_ms",
+        "device": device,
+        "proactive_drain_ms": round(float(proactive_drain_ms), 1),
+        "crash_detection_ms": round(float(crash_detection_ms), 1),
+        "speedup": round(
+            float(crash_detection_ms) / max(float(proactive_drain_ms),
+                                            1e-9), 2),
+    }
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry

@@ -43,9 +43,40 @@ def cmd_status(args):
     total = ray_tpu.cluster_resources()
     avail = ray_tpu.available_resources()
     nodes = ray_tpu.nodes()
-    print(f"nodes: {sum(1 for n in nodes if n['Alive'])} alive / {len(nodes)}")
+
+    def node_state(n):
+        return n.get("State") or ("ALIVE" if n["Alive"] else "DEAD")
+
+    alive = sum(1 for n in nodes if node_state(n) == "ALIVE")
+    draining = sum(1 for n in nodes if node_state(n) == "DRAINING")
+    extra = f", {draining} draining" if draining else ""
+    print(f"nodes: {alive} alive{extra} / {len(nodes)}")
+    for n in nodes:
+        state = node_state(n)
+        why = n.get("DrainReason") if state == "DRAINING" \
+            else n.get("DeathCause")
+        print(f"  {n['NodeID'][-12:]:<14} {state:<9}"
+              + (f" ({why})" if why else ""))
     for k in sorted(total):
         print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
+
+
+def cmd_drain(args):
+    """Gracefully drain a node: exclude it from scheduling, migrate
+    restartable actors, let in-flight tasks finish to the deadline, then
+    deregister (the ``ray drain-node`` analog)."""
+    _connect(args)
+    from ray_tpu._private import worker as worker_mod
+
+    backend = worker_mod.backend()
+    if not hasattr(backend, "head"):
+        raise SystemExit("drain requires a cluster (--address <head>)")
+    from ray_tpu.cluster.gcs_client import NodeInfoAccessor
+
+    result = NodeInfoAccessor(backend.head).drain(
+        args.node_id, reason=args.reason, deadline_s=args.deadline,
+        wait=not args.no_wait)
+    print(json.dumps(result, indent=2, default=str))
 
 
 def cmd_list(args):
@@ -250,6 +281,18 @@ def main(argv=None):
 
     p = sub.add_parser("status", help="cluster resource status")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser(
+        "drain",
+        help="gracefully drain a node (migrate actors, finish tasks, "
+             "then remove)")
+    p.add_argument("node_id")
+    p.add_argument("--reason", default="cli")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="seconds in-flight tasks get before force-removal")
+    p.add_argument("--no-wait", action="store_true",
+                   help="initiate the drain and return immediately")
+    p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser("list", help="list tasks/actors/objects")
     p.add_argument("kind", choices=["tasks", "actors", "objects"])

@@ -1,0 +1,100 @@
+"""Drain-vs-crash actor recovery latency benchmark.
+
+At pod scale, recovery LATENCY — not just recovery correctness —
+dominates (MLPerf TPU-pod studies, PAPERS.md): a heartbeat-timeout crash
+detection burns ``node_death_timeout_s`` of dead time per preemption,
+while a proactive drain reconstructs actors on surviving nodes before
+the departing node exits. This script measures both paths on a local
+multi-node ``Cluster`` and emits one ``drain_recovery_ms`` record:
+
+    python -m ray_tpu.scripts.drain_bench
+
+The record is appended to the committed ``BENCH_TPU_SESSIONS.jsonl``
+evidence trail only when run on a real accelerator cluster
+(``bench_log.record_drain_recovery`` gates on device); elsewhere the
+JSON line is just printed.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return ""
+
+
+def _wait_actor_on_other_node(head, actor_id: str, avoid_node: str,
+                              timeout: float = 60.0) -> float:
+    """Seconds until the actor is ALIVE on a node other than
+    ``avoid_node``."""
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    while time.monotonic() < deadline:
+        info = head.rpc_get_actor(actor_id, timeout=1.0)
+        if info and info["state"] == "ALIVE" and \
+                info["node_id"] != avoid_node:
+            return time.monotonic() - t0
+        time.sleep(0.01)
+    raise TimeoutError(f"actor {actor_id} not recovered in {timeout}s")
+
+
+def _one_round(proactive: bool) -> float:
+    """Recovery latency (s) for one fresh cluster: actor pinned on a
+    victim node, victim removed via drain (proactive) or SIGKILL-style
+    crash (heartbeat-timeout detection)."""
+    import ray_tpu
+    from ray_tpu.cluster.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)  # survivor (hosts the driver store)
+    victim = cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(cluster.address)
+    try:
+        @ray_tpu.remote
+        class Probe:
+            def ping(self):
+                return "pong"
+
+        actor = Probe.options(
+            max_restarts=-1,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                victim.node_id),
+        ).remote()
+        assert ray_tpu.get(actor.ping.remote(), timeout=30) == "pong"
+        if proactive:
+            cluster.head.rpc_drain_node(
+                victim.node_id, "bench", 30.0, wait=False)
+        else:
+            cluster.kill_node(victim)
+        return _wait_actor_on_other_node(
+            cluster.head, actor._actor_id, victim.node_id)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def main() -> dict:
+    from ray_tpu.scripts import bench_log
+
+    drain_s = _one_round(proactive=True)
+    crash_s = _one_round(proactive=False)
+    entry = bench_log.record_drain_recovery(
+        drain_s * 1000, crash_s * 1000, device=_device_kind())
+    print(json.dumps(entry))
+    return entry
+
+
+if __name__ == "__main__":
+    main()

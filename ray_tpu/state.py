@@ -17,6 +17,17 @@ from typing import List, Optional
 from ray_tpu._private import worker as _worker
 
 
+def nodes() -> List[dict]:
+    """Cluster node table with lifecycle state. Each record carries
+    ``State`` (``ALIVE`` -> ``DRAINING`` -> ``DEAD``) plus ``DrainReason``
+    / ``DeathCause`` so planned departures (autoscaler scale-down, spot
+    preemption) are distinguishable from crashes."""
+    backend = _worker.backend()
+    if hasattr(backend, "nodes"):
+        return backend.nodes()
+    return []
+
+
 def list_tasks(limit: int = 1000) -> List[dict]:
     backend = _worker.backend()
     if hasattr(backend, "list_tasks"):

@@ -16,6 +16,7 @@ placement, sessions, checkpoints, and failure handling only.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -46,6 +47,26 @@ class Result:
     checkpoint: Optional[Checkpoint]
     error: Optional[BaseException] = None
     metrics_history: List[dict] = field(default_factory=list)
+
+
+def _lost_to_drain(exc: BaseException) -> bool:
+    """Did this failure come from the cluster's drain/preemption path?
+    Matched against the HEAD-generated cause formats only ("node <id>
+    died: drained: …" / "node <id> draining: …"), so an application
+    error that merely mentions draining can never loop the trainer."""
+    import re
+
+    return re.search(
+        r"node \S+ (died: drained:|draining:)", str(exc)) is not None
+
+
+class TrainingWorkerPreempted(ActorError):
+    """A node hosting training workers entered DRAINING (preemption
+    notice / scale-down): the attempt restarts from the latest checkpoint
+    PROACTIVELY — before the node dies — instead of waiting out a
+    heartbeat timeout, and the restart does not consume
+    ``FailureConfig.max_failures`` (the trainer-level analog of the
+    task retry-budget preemption exemption)."""
 
 
 class _TrainWorker:
@@ -230,6 +251,17 @@ class DataParallelTrainer:
         """Run the worker group to completion; returns last metrics.
         Raises on worker failure (caller handles elasticity)."""
         n = self.scaling.num_workers
+        drain_stop = threading.Event()
+        drained_nodes: set = set()
+        # Subscribe to drain events BEFORE placing anything: a preemption
+        # notice for a node hosting this group triggers a checkpoint-
+        # restore restart while the node is still up, not after a
+        # heartbeat timeout. (The watcher records every draining node;
+        # the consume loop intersects with the group's nodes.)
+        threading.Thread(
+            target=self._watch_drains,
+            args=(drained_nodes, drain_stop), daemon=True,
+        ).start()
         group = WorkerGroup(self.scaling)
         queue = Queue()
         try:
@@ -237,7 +269,7 @@ class DataParallelTrainer:
                 name: _shard_dataset(ds, n) for name, ds in self.datasets.items()
             }
             start_ckpt = ckpt_mgr.latest or self.resume_checkpoint
-            node_ranks, local_ranks = self._compute_ranks(group)
+            node_ranks, local_ranks, node_ids = self._compute_ranks(group)
             self._on_group_start(group, node_ranks, local_ranks)
             session_kwargs = [
                 {
@@ -255,15 +287,18 @@ class DataParallelTrainer:
             ]
             run_refs = group.run_all(self.train_fn, self.config, session_kwargs)
             return self._consume_results(
-                queue, run_refs, n, ckpt_mgr, metrics_history
+                queue, run_refs, n, ckpt_mgr, metrics_history,
+                drained_nodes=drained_nodes, group_nodes=set(node_ids),
             )
         finally:
+            drain_stop.set()
             queue.shutdown()
             group.shutdown()
 
-    def _compute_ranks(self, group: WorkerGroup) -> tuple[list, list]:
-        """node_rank + local_rank per worker, from actual actor placement
-        (``backend_executor.py:339-404`` init_session rank layout)."""
+    def _compute_ranks(self, group: WorkerGroup) -> tuple[list, list, list]:
+        """node_rank + local_rank (+ raw node id) per worker, from actual
+        actor placement (``backend_executor.py:339-404`` init_session
+        rank layout)."""
         node_ids = ray_tpu.get(
             [w.node_id.remote() for w in group.workers], timeout=60
         )
@@ -277,20 +312,71 @@ class DataParallelTrainer:
             node_ranks.append(node_order.index(nid))
             local_ranks.append(counts[nid])
             counts[nid] += 1
-        return node_ranks, local_ranks
+        return node_ranks, local_ranks, node_ids
+
+    def _watch_drains(self, drained_nodes: set,
+                      stop_evt: threading.Event) -> None:
+        """Long-poll the head's NODES pubsub feed and record every node
+        that enters DRAINING (the local backend has no head/pubsub: the
+        watcher is a no-op there)."""
+        from ray_tpu._private import worker as worker_mod
+
+        head = getattr(worker_mod.backend(), "head", None)
+        if head is None:
+            return
+        sub_id = f"train-drain:{ids.new_task_id()[:12]}"
+        try:
+            head.call("pubsub_subscribe", sub_id, "NODES")
+            while not stop_evt.is_set():
+                try:
+                    got = head.call("pubsub_poll", sub_id, 1.0,
+                                    timeout=10.0)
+                except Exception:
+                    return  # backend shutting down / head gone
+                if got is None:
+                    # Head restarted / subscription TTL'd away: poll
+                    # returns None instantly for an unknown sub, so
+                    # re-subscribe (not re-poll) or this would hot-spin.
+                    time.sleep(0.5)
+                    try:
+                        head.call("pubsub_subscribe", sub_id, "NODES")
+                    except Exception:
+                        return
+                    continue
+                for m in got[0]:
+                    data = m.get("data") or {}
+                    if data.get("state") == "DRAINING" and \
+                            data.get("node_id"):
+                        drained_nodes.add(data["node_id"])
+        finally:
+            try:
+                head.call("pubsub_unsubscribe", sub_id)
+            except Exception:
+                pass
 
     def _on_group_start(self, group, node_ranks, local_ranks) -> None:
         """Framework-backend hook run before the training loops start
         (``Backend.on_start`` analog). Default: nothing."""
 
     def _consume_results(
-        self, queue, run_refs, n, ckpt_mgr, metrics_history
+        self, queue, run_refs, n, ckpt_mgr, metrics_history,
+        drained_nodes: Optional[set] = None,
+        group_nodes: Optional[set] = None,
     ) -> Optional[dict]:
         """TrainingIterator: drain worker reports; rank-0 metrics win
         (``train/trainer.py:155 _fetch_next_result``)."""
         finished: set[int] = set()
         last_metrics: Optional[dict] = None
         while len(finished) < n:
+            if drained_nodes and group_nodes and \
+                    (drained_nodes & group_nodes):
+                # A worker's node is leaving (preemption/scale-down):
+                # restart from the latest checkpoint NOW, while that
+                # node still serves its objects, instead of discovering
+                # the loss via heartbeat timeout mid-step.
+                raise TrainingWorkerPreempted(
+                    "a training worker's node is draining; restarting "
+                    "the group from the latest checkpoint")
             # Fail fast if a worker actor died (its queue would stay silent).
             ready, _ = ray_tpu.wait(run_refs, num_returns=n, timeout=0.0)
             for r in ready:
@@ -329,7 +415,18 @@ class DataParallelTrainer:
                     checkpoint=ckpt_mgr.best,
                     metrics_history=metrics_history,
                 )
+            except TrainingWorkerPreempted:
+                # Preemption exemption: a planned node departure restarts
+                # the group (from the latest checkpoint) WITHOUT
+                # consuming the failure budget.
+                time.sleep(0.2)
             except (ActorError, TaskError) as e:
+                if _lost_to_drain(e):
+                    # A group actor (worker or results queue) died WITH a
+                    # draining/preempted node before the drain watcher
+                    # could classify it: same exemption, same restart.
+                    time.sleep(0.2)
+                    continue
                 attempt += 1
                 if max_failures >= 0 and attempt > max_failures:
                     return Result(

@@ -194,6 +194,26 @@ NODE_WORKER_COUNT = Gauge(
     tag_keys=("node_id",),
 )
 
+# -- node drain lifecycle (head-side; the drain coordinator records one
+# increment per initiated drain and the wall time from DRAINING to
+# deregistration, so preemption churn is visible per reason).
+NODE_DRAINS_TOTAL = Counter(
+    "ray_tpu_node_drains_total",
+    "Node drains initiated, by reason (preemption, autoscaler_idle, ...)",
+    tag_keys=("reason",),
+)
+NODE_DRAIN_DURATION_SECONDS = Histogram(
+    "ray_tpu_node_drain_duration_seconds",
+    "Wall time from drain start to node deregistration",
+    boundaries=[0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0],
+    tag_keys=("reason",),
+)
+NODE_DRAIN_ACTORS_MIGRATED = Counter(
+    "ray_tpu_node_drain_actors_migrated_total",
+    "Actors proactively reconstructed off draining nodes",
+    tag_keys=("reason",),
+)
+
 
 def registered() -> "List[Metric]":
     """Snapshot of the registry (exporters and dashboard generators)."""

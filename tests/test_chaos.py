@@ -124,6 +124,170 @@ def test_kill_no_restart_beats_budget(cluster):
         ray_tpu.get(a.incr.remote(), timeout=30)
 
 
+@pytest.fixture()
+def duo_cluster():
+    """Driver node (survives) + victim node, for drain scenarios."""
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=4)  # driver node: holds the driver's store
+    victim = c.add_node(num_cpus=4)
+    c.wait_for_nodes()
+    ray_tpu.init(c.address)
+    yield c, victim
+    ray_tpu.shutdown()
+    c.shutdown()
+    gc.collect()
+
+
+def test_graceful_drain_under_load(duo_cluster):
+    """Drain a node running tasks and a restartable actor: zero
+    driver-visible errors, all results correct, and the actor is live on
+    another node before the drained agent exits — with its restart
+    budget untouched (planned removal is not a crash)."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    c, victim = duo_cluster
+    a = Counter.options(
+        max_restarts=2,
+        max_task_retries=-1,
+        scheduling_strategy=NodeAffinitySchedulingStrategy(victim.node_id),
+    ).remote()
+    assert ray_tpu.get(a.incr.remote(), timeout=30) == 1
+
+    @ray_tpu.remote
+    def work(i):
+        time.sleep(0.05)
+        return i * i
+
+    pending = [
+        work.options(scheduling_strategy="SPREAD").remote(i)
+        for i in range(30)
+    ]
+    res = c.head.rpc_drain_node(victim.node_id, "test-drain", 30.0)
+    assert res["ok"] and res["state"] == "DEAD"
+    assert not res["forced"], "drain should quiesce, not force-kill"
+    # Proactive migration: the actor was reconstructed elsewhere BEFORE
+    # the drained agent exited, and the crash-restart budget is intact.
+    assert a._actor_id in res["migrated_actors"]
+    info = c.head.rpc_get_actor(a._actor_id)
+    assert info["state"] == "ALIVE" and info["node_id"] != victim.node_id
+    assert c.head._actor_specs[a._actor_id]["restarts_left"] == 2
+    # Zero driver-visible errors: every task result is correct.
+    assert ray_tpu.get(pending, timeout=120) == [i * i for i in range(30)]
+    assert ray_tpu.get(a.incr.remote(), timeout=60) >= 1
+    nodes = {n["NodeID"]: n for n in ray_tpu.nodes()}
+    assert nodes[victim.node_id]["State"] == "DEAD"
+    assert "drained" in nodes[victim.node_id]["DeathCause"]
+
+
+def test_preemption_signal_self_drain(tmp_path):
+    """A preemption notice (file-triggered watcher hook) makes the node
+    self-initiate a drain: its actor migrates and the node deregisters
+    with a preemption cause, all without a heartbeat timeout."""
+    from ray_tpu.core.config import config
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    sig = tmp_path / "preempt-notice"
+    config.override("preemption_signal_file", str(sig))
+    config.override("preemption_poll_interval_s", 0.1)
+    ray_tpu.shutdown()
+    c = Cluster()
+    try:
+        c.add_node(num_cpus=4)
+        victim = c.add_node(num_cpus=4)
+        c.wait_for_nodes()
+        ray_tpu.init(c.address)
+        a = Counter.options(
+            max_restarts=1,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                victim.node_id),
+        ).remote()
+        assert ray_tpu.get(a.incr.remote(), timeout=30) == 1
+        # Target ONLY the victim (the driver node polls the same file).
+        sig.write_text(victim.node_id)
+        wait_for(
+            lambda: next(
+                n["State"] for n in c.head.rpc_nodes()
+                if n["NodeID"] == victim.node_id) == "DEAD",
+            timeout=30.0, msg="preempted node deregistered",
+        )
+        nodes = {n["NodeID"]: n for n in ray_tpu.nodes()}
+        assert "preemption" in nodes[victim.node_id]["DeathCause"]
+        wait_for(
+            lambda: c.head.rpc_get_actor(a._actor_id)["state"] == "ALIVE"
+            and c.head.rpc_get_actor(a._actor_id)["node_id"]
+            != victim.node_id,
+            msg="actor migrated off preempted node",
+        )
+        # Budget-free migration: the single crash-restart is still there.
+        assert c.head._actor_specs[a._actor_id]["restarts_left"] == 1
+        assert ray_tpu.get(a.incr.remote(), timeout=60) >= 1
+    finally:
+        config.reset("preemption_signal_file")
+        config.reset("preemption_poll_interval_s")
+        ray_tpu.shutdown()
+        c.shutdown()
+        gc.collect()
+
+
+def test_drain_deadline_force_kill(duo_cluster):
+    """A task slower than the drain deadline is force-killed with the
+    node — the drain completes near the deadline (not after the task) and
+    the task still finishes correctly via lineage re-execution."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    c, victim = duo_cluster
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5.0)
+        return "ok"
+
+    ref = slow.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(victim.node_id)
+    ).remote()
+    time.sleep(0.5)  # let it start running on the victim
+    t0 = time.monotonic()
+    res = c.head.rpc_drain_node(victim.node_id, "test-deadline", 1.0)
+    took = time.monotonic() - t0
+    assert res["ok"] and res["state"] == "DEAD"
+    assert res["forced"], "deadline expiry must force-remove the node"
+    assert took < 4.0, f"drain waited past its deadline ({took:.1f}s)"
+    # The force-killed task re-executes elsewhere with no visible error.
+    assert ray_tpu.get(ref, timeout=120) == "ok"
+
+
+def test_retry_budget_exempt_on_preemption(duo_cluster):
+    """The preemption exemption: a max_retries=0 task lost to a
+    drained/preempted node is resubmitted WITHOUT consuming the retry
+    budget and still completes."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    c, victim = duo_cluster
+
+    @ray_tpu.remote(max_retries=0)
+    def fragile():
+        time.sleep(2.0)
+        return "done"
+
+    ref = fragile.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(victim.node_id)
+    ).remote()
+    time.sleep(0.5)  # in flight on the victim
+    res = c.head.rpc_drain_node(victim.node_id, "preemption", 0.5)
+    assert res["ok"] and res["forced"]
+    # Lost mid-run to a preempting node: re-executes despite max_retries=0.
+    assert ray_tpu.get(ref, timeout=120) == "done"
+
+
 def test_chaos_node_killer():
     """Kill a random non-driver node mid-workload: tasks re-execute via
     lineage, actors reconstruct, everything completes."""
