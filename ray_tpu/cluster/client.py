@@ -2147,6 +2147,75 @@ class ClusterBackend:
     def worker_stats(self, fresh: bool = False) -> list:
         return self.head.call("worker_stats", fresh, timeout=15.0)
 
+    def device_stats(self, fresh: bool = False) -> list:
+        """Per-worker JAX/XLA device snapshots across the cluster."""
+        return self.head.call("device_stats", fresh, timeout=20.0)
+
+    def capture_profile(self, worker_id: str, duration_s: float = 1.0,
+                        interval_s: float = 0.01, out_dir=None,
+                        node_id=None) -> dict:
+        """Remote profiler capture: jax.profiler.trace in the worker
+        (stack-sampler fallback), trace files streamed back in bounded
+        chunks through the log-read plane and written under ``out_dir``
+        (a fresh temp dir by default)."""
+        import tempfile
+
+        from ray_tpu.util.device_telemetry import resolve_capture_path
+
+        manifest = self.head.call(
+            "capture_profile", worker_id, float(duration_s),
+            float(interval_s), node_id,
+            timeout=float(duration_s) + 120.0)
+        out_dir = out_dir or tempfile.mkdtemp(prefix="ray_tpu_tprof_")
+        paths = []
+        for f in manifest.get("files", []):
+            path = resolve_capture_path(out_dir, f["name"])
+            if path is None:
+                continue  # never let a remote name escape out_dir
+            offset = 0
+            with open(path, "wb") as fh:
+                while True:
+                    chunk = self.head.call(
+                        "read_capture_file", manifest["node_id"],
+                        manifest["capture_id"], f["name"], offset,
+                        1 << 20, timeout=60.0)
+                    data = chunk.get("data") or b""
+                    if data:
+                        fh.write(data)
+                        offset = chunk["offset"]
+                    if not data or offset >= chunk.get("size", 0):
+                        break
+            if offset < f.get("size", 0):
+                # The agent served less than the manifest promised
+                # (capture evicted mid-download): a partial trace is
+                # corrupt, not a smaller one — fail the whole capture.
+                raise ValueError(
+                    f"capture file {f['name']!r} truncated at "
+                    f"{offset}/{f['size']} bytes (capture evicted?)")
+            paths.append(path)
+        return {
+            "kind": manifest.get("kind"),
+            "worker_id": worker_id,
+            "node_id": manifest.get("node_id"),
+            "duration_s": manifest.get("duration_s"),
+            "dir": out_dir,
+            "files": paths,
+        }
+
+    def list_spans(self, trace_id=None, limit: int = 10_000) -> list:
+        """Finished tracing spans from the head's span store (fed by the
+        workers' batched event reports)."""
+        return self.head.call("list_spans", trace_id, limit, timeout=15.0)
+
+    def cluster_metrics_text(self) -> str:
+        """The head's federated /metrics/cluster body."""
+        return self.head.call("cluster_metrics_text", timeout=30.0)
+
+    def metrics_endpoint(self):
+        """The head's scrape endpoint {address, cluster_path,
+        targets_path}, or None when disabled."""
+        return self.head.call("metrics_endpoint")
+
     def _log_poll_loop(self, subscribed: bool = False) -> None:
         """Driver-side log streaming over the pubsub LOGS channel
         (long-poll push, ``src/ray/pubsub`` analog — replaces the old

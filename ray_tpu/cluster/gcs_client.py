@@ -133,6 +133,25 @@ class TaskInfoAccessor(_Accessor):
         return self._rpc.call("list_spans", trace_id, limit)
 
 
+class MetricsAccessor(_Accessor):
+    """Cluster-wide observability exports: the federated Prometheus
+    scrape, its HTTP endpoint, and device telemetry snapshots."""
+
+    def cluster_text(self) -> str:
+        """Federated exposition body (what ``/metrics/cluster`` serves):
+        the head's registry merged with every alive agent's."""
+        return self._rpc.call("cluster_metrics_text", timeout=30.0)
+
+    def endpoint(self) -> Optional[dict]:
+        """The head's scrape endpoint {address, cluster_path,
+        targets_path}, or None when the HTTP exposition is disabled."""
+        return self._rpc.call("metrics_endpoint")
+
+    def device_stats(self, fresh: bool = False) -> list[dict]:
+        """Per-worker JAX/XLA device snapshots across the cluster."""
+        return self._rpc.call("device_stats", fresh, timeout=20.0)
+
+
 class GcsClient:
     def __init__(self, address: str, reconnect_window: float = 15.0):
         self.address = address
@@ -144,6 +163,7 @@ class GcsClient:
         self.kv = InternalKvAccessor(self._rpc)
         self.pubsub = PubsubAccessor(self._rpc)
         self.tasks = TaskInfoAccessor(self._rpc)
+        self.metrics = MetricsAccessor(self._rpc)
 
     def ping(self) -> bool:
         return self._rpc.call("ping") == "pong"

@@ -116,7 +116,8 @@ class NodeInfo:
 
 class HeadServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 persist_path: str | None = None):
+                 persist_path: str | None = None,
+                 metrics_port: int | None = 0):
         self._store = _PersistentStore(persist_path) if persist_path else None
         self._lock = threading.RLock()
         self._nodes: dict[str, NodeInfo] = {}
@@ -171,6 +172,29 @@ class HeadServer:
             self._load_persisted()
         self._server = RpcServer(self, host, port)
         self.address = self._server.address
+        # Cluster metrics federation: one HTTP endpoint whose
+        # /metrics/cluster body merges every alive agent's registry into
+        # a single scrape (plus /metrics for the head's own process and
+        # /metrics/targets as a Prometheus file-SD document). Pass
+        # metrics_port=None to disable.
+        self.metrics_address: str | None = None
+        self._metrics_shutdown = None
+        if metrics_port is not None:
+            from ray_tpu.util import metrics as _metrics
+
+            try:
+                bound, self._metrics_shutdown = _metrics.serve_metrics(
+                    host, metrics_port, routes={
+                        "/metrics": (_metrics.prometheus_text,
+                                     _metrics.PROM_CONTENT_TYPE),
+                        "/metrics/cluster": (self.cluster_metrics_text,
+                                             _metrics.PROM_CONTENT_TYPE),
+                        "/metrics/targets": (self._file_sd_text,
+                                             "application/json"),
+                    })
+                self.metrics_address = f"{host}:{bound}"
+            except OSError:
+                pass  # federation endpoint is best-effort; RPC plane is not
         self._stop = threading.Event()
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
         self._monitor.start()
@@ -1305,12 +1329,104 @@ class HeadServer:
     def rpc_worker_stats(self, fresh: bool = False):
         """Per-worker CPU/RSS/uptime across the cluster."""
         out = []
-        for _nid, client in self._alive_agents():
-            try:
-                out.extend(client.call("worker_stats", fresh, timeout=10.0))
-            except Exception:
-                continue
+        for stats in self._fanout_agents("worker_stats", fresh,
+                                         timeout=10.0):
+            out.extend(stats)
         return out
+
+    def _fanout_agents(self, method: str, *args, timeout: float = 5.0):
+        """Call one RPC on every alive agent CONCURRENTLY and return the
+        successful results. The scrape-path aggregations use this so
+        latency is the slowest single agent (bounded by ``timeout``),
+        not the sum over the cluster — one wedged agent must not stall
+        /metrics/cluster past Prometheus's scrape deadline."""
+        agents = self._alive_agents()
+        if not agents:
+            return []
+        if len(agents) == 1:
+            try:
+                return [agents[0][1].call(method, *args, timeout=timeout)]
+            except Exception:
+                return []
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(client):
+            try:
+                return client.call(method, *args, timeout=timeout)
+            except Exception:
+                return None  # node died/wedged mid-query: best-effort
+
+        with ThreadPoolExecutor(
+                max_workers=min(16, len(agents))) as pool:
+            results = list(pool.map(
+                one, [client for _nid, client in agents]))
+        return [r for r in results if r is not None]
+
+    def rpc_device_stats(self, fresh: bool = False):
+        """Per-worker JAX/XLA device snapshots across the cluster
+        (HBM in use/peak/limit per device + compile counters)."""
+        out = []
+        for snaps in self._fanout_agents("device_stats", fresh,
+                                         timeout=10.0):
+            out.extend(snaps)
+        return out
+
+    def rpc_capture_profile(self, worker_id, duration_s: float = 1.0,
+                            interval_s: float = 0.01, node_id=None):
+        """Route a remote profiler capture to the agent owning the
+        worker; returns the capture manifest (files stream back through
+        rpc_read_capture_file)."""
+        _nid, client = self._route_worker(
+            worker_id, node_id, need_live=True)
+        return client.call(
+            "capture_profile", worker_id, duration_s, interval_s,
+            timeout=float(duration_s) + 90.0)
+
+    def rpc_read_capture_file(self, node_id, capture_id, name,
+                              offset: int = 0, max_bytes: int = 1 << 20):
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                raise ValueError(f"node {node_id!r} is not alive")
+            client = node.client
+        return client.call(
+            "read_capture_file", capture_id, name, offset, max_bytes,
+            timeout=30.0)
+
+    # -- cluster metrics federation ----------------------------------------
+
+    def cluster_metrics_text(self) -> str:
+        """One Prometheus exposition body covering the whole cluster:
+        the head's own registry merged with every alive agent's
+        (``/metrics/cluster``) — one scrape config instead of one
+        endpoint per process."""
+        from ray_tpu.util import metrics as _metrics
+
+        chunks = [_metrics.prometheus_text()]
+        chunks.extend(self._fanout_agents("metrics_text", timeout=5.0))
+        return _metrics.merge_prometheus(chunks)
+
+    def rpc_cluster_metrics_text(self) -> str:
+        return self.cluster_metrics_text()
+
+    def _file_sd_text(self) -> str:
+        import json as _json
+
+        from ray_tpu.util import metrics as _metrics
+
+        return _json.dumps(
+            _metrics.file_sd_targets(self.metrics_address or ""), indent=1)
+
+    def rpc_metrics_endpoint(self):
+        """Where to scrape this cluster: the head's metrics HTTP server
+        (None when disabled)."""
+        if self.metrics_address is None:
+            return None
+        return {
+            "address": self.metrics_address,
+            "cluster_path": "/metrics/cluster",
+            "targets_path": "/metrics/targets",
+        }
 
     # -- scheduling -------------------------------------------------------
 
@@ -1648,6 +1764,11 @@ class HeadServer:
         self._stop.set()
         with self._free_cv:
             self._free_cv.notify_all()
+        if self._metrics_shutdown is not None:
+            try:
+                self._metrics_shutdown()
+            except Exception:
+                pass
         self._server.stop()
         if self._store is not None:
             self._store.close()
@@ -1667,6 +1788,10 @@ def main():
     token = ensure_cluster_token()
     head = HeadServer(args.host, args.port)
     print(f"HEAD_ADDRESS={head.address}", flush=True)
+    if head.metrics_address:
+        # Point Prometheus here with metrics_path=/metrics/cluster (or
+        # fetch /metrics/targets as a file-SD document).
+        print(f"METRICS_ADDRESS={head.metrics_address}", flush=True)
     if token:
         # Joining nodes/drivers need this in RAY_TPU_CLUSTER_TOKEN.
         print(f"CLUSTER_TOKEN={token}", flush=True)

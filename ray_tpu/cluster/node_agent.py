@@ -160,6 +160,19 @@ class NodeAgent:
         self._worker_stats: dict[str, dict] = {}
         self._cpu_prev: dict[str, tuple] = {}
         self._exported_gauges: set[tuple] = set()
+        # Per-worker JAX/XLA device snapshots (util/device_telemetry),
+        # shipped on the worker-events batch; exported as
+        # ray_tpu_device_* gauges by the telemetry pass and pruned with
+        # the worker. The exported set tracks (worker_id, device|None)
+        # children so retraction is exact.
+        self._device_stats: dict[str, dict] = {}
+        self._exported_device: set[tuple] = set()
+        # Remote profiler captures (state.capture_profile): manifest by
+        # capture id; trace files live under log_dir and stream back
+        # through read_capture_file (the log-read plane's chunked shape).
+        self._captures: "collections.OrderedDict[str, dict]" = (
+            collections.OrderedDict()
+        )
         # One sampler at a time: a fresh=True RPC racing the telemetry
         # loop would otherwise compute cpu%% over a ~ms window (one
         # scheduler tick reads as ~1000%%) and fight over the gauge set.
@@ -637,11 +650,29 @@ class NodeAgent:
             self._task_records[rec["task_id"]] = rec
 
     def rpc_worker_events(self, worker_id, pid, task_events, log_lines,
-                          spans=None):
+                          spans=None, device=None):
         """Batched observability report from a worker: authoritative task
-        records (with timings/outcome), captured stdout/stderr lines, and
-        finished tracing spans (forwarded to the head's span store)."""
+        records (with timings/outcome + per-phase wall-ns), captured
+        stdout/stderr lines, finished tracing spans (forwarded to the
+        head's span store), and an optional device-telemetry snapshot."""
+        if task_events:
+            # Feed the phase histogram so p50/p99 per phase is
+            # scrapeable without the state API (one observe per phase
+            # per finished task; tag cardinality is bounded by the
+            # three phase names).
+            from ray_tpu.util import metrics as _metrics
+
+            for rec in task_events:
+                for phase, ns in (rec.get("phases") or {}).items():
+                    try:
+                        _metrics.TASK_PHASE_SECONDS.observe(
+                            ns / 1e9,
+                            tags={"node_id": self.node_id, "phase": phase})
+                    except Exception:
+                        pass
         with self._lock:
+            if device is not None:
+                self._device_stats[worker_id] = device
             for rec in task_events:
                 old = self._task_records.get(rec["task_id"])
                 if old is not None and rec.get("submitted_at") is None:
@@ -1047,6 +1078,9 @@ class NodeAgent:
             rec = self._worker_logs.get(w.worker_id)
             if rec is not None and rec["ended_at"] is None:
                 rec["ended_at"] = time.time()
+            # Latest device snapshot dies with the worker; its exported
+            # gauge children are retracted on the next telemetry pass.
+            self._device_stats.pop(w.worker_id, None)
             current = None if requeued else w.current_task
             w.current_task = None
         if w.proc.poll() is None:
@@ -1446,6 +1480,143 @@ class NodeAgent:
         prof["pid"] = w.proc.pid
         return prof
 
+    def rpc_device_stats(self, fresh: bool = False):
+        """Per-worker JAX/XLA device snapshots on this node. Steady
+        state comes from the workers' batched reports; ``fresh`` RPCs
+        every live worker for an immediate snapshot (workers that never
+        imported jax answer with a stub)."""
+        with self._lock:
+            live = {
+                w.worker_id: w for w in self._workers.values()
+                if w.proc.poll() is None
+            }
+            snaps = {wid: dict(s) for wid, s in self._device_stats.items()
+                     if wid in live}
+        if fresh:
+            # Concurrent, short per-worker budget: a GIL-starved worker
+            # must not serialize the poll past the head's per-agent
+            # fanout timeout (which would drop this node's HEALTHY
+            # snapshots along with the stuck one).
+            targets = [(wid, w.client) for wid, w in live.items()
+                       if w.client is not None]
+            if targets:
+                from concurrent.futures import ThreadPoolExecutor
+
+                def one(item):
+                    wid, client = item
+                    try:
+                        return wid, client.call("device_stats",
+                                                timeout=3.0)
+                    except Exception:
+                        return wid, None
+
+                with ThreadPoolExecutor(
+                        max_workers=min(8, len(targets))) as pool:
+                    for wid, snap in pool.map(one, targets):
+                        if snap is not None:
+                            snaps[wid] = snap
+        out = []
+        for wid, snap in snaps.items():
+            snap["worker_id"] = wid
+            snap["node_id"] = self.node_id
+            out.append(snap)
+        return out
+
+    def rpc_capture_profile(self, worker_id, duration_s: float = 1.0,
+                            interval_s: float = 0.01):
+        """Remote profiler capture: open a timed ``jax.profiler.trace``
+        window in the worker (stack-sampler fallback off-jax). The
+        worker writes the trace files DIRECTLY into this node's capture
+        dir (same host, shared filesystem — no trace bytes on the
+        worker→agent hop); the returned manifest's files stream back to
+        remote clients via read_capture_file."""
+        import shutil
+
+        w = self._live_worker(worker_id)
+        base = self.log_dir
+        if base is None:
+            import tempfile
+
+            base = tempfile.mkdtemp(prefix="ray_tpu_tprof_")
+        cap_id = f"tprof-{worker_id}-{os.urandom(3).hex()}"
+        cap_dir = os.path.join(base, cap_id)
+        os.makedirs(cap_dir, exist_ok=True)
+        try:
+            res = w.client.call(
+                "capture_profile", float(duration_s), float(interval_s),
+                cap_dir, timeout=float(duration_s) + 60.0)
+        except Exception:
+            shutil.rmtree(cap_dir, ignore_errors=True)
+            raise
+        # Manifest from OUR walk of the dir, not the worker's claim —
+        # read_capture_file trusts these names when joining paths.
+        names = []
+        for dirpath, _dirs, fnames in os.walk(cap_dir):
+            for fname in fnames:
+                path = os.path.join(dirpath, fname)
+                try:
+                    names.append({
+                        "name": os.path.relpath(path, cap_dir),
+                        "size": os.path.getsize(path),
+                    })
+                except OSError:
+                    continue
+        manifest = {
+            "capture_id": cap_id,
+            "node_id": self.node_id,
+            "worker_id": worker_id,
+            "kind": res.get("kind"),
+            "duration_s": res.get("duration_s"),
+            "files": sorted(names, key=lambda f: f["name"]),
+        }
+        with self._lock:
+            self._captures[cap_id] = {**manifest, "dir": cap_dir}
+            evict = []
+            while len(self._captures) > 16:  # bound trace-dir disk use
+                evict.append(self._captures.popitem(last=False)[1])
+        for old in evict:
+            shutil.rmtree(old["dir"], ignore_errors=True)
+        return manifest
+
+    def rpc_read_capture_file(self, capture_id, name, offset: int = 0,
+                              max_bytes: int = 1 << 20):
+        """One bounded read of a capture's trace file ([offset,
+        offset+max_bytes)) — the same poll-follow shape as
+        read_worker_log, so big TPU traces stream instead of riding one
+        frame."""
+        with self._lock:
+            m = self._captures.get(capture_id)
+        if m is None:
+            raise ValueError(
+                f"no capture {capture_id!r} on node {self.node_id}")
+        if not any(f["name"] == name for f in m["files"]):
+            raise ValueError(
+                f"capture {capture_id} has no file {name!r}")
+        path = os.path.join(m["dir"], name)
+        start = max(0, int(offset))
+        max_bytes = max(1, min(int(max_bytes), 8 << 20))
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(start)
+                blob = f.read(max_bytes)
+        except OSError as e:
+            # The trace file vanished mid-stream (external cleanup):
+            # raising makes the client's download FAIL rather than
+            # silently hand over a truncated, corrupt trace.
+            raise ValueError(
+                f"capture {capture_id} file {name!r} unreadable: {e}")
+        return {"name": name, "offset": start + len(blob), "size": size,
+                "data": blob}
+
+    def rpc_metrics_text(self):
+        """This agent process's full registry in Prometheus exposition
+        format — the per-node input to the head's /metrics/cluster
+        federation."""
+        from ray_tpu.util import metrics as _metrics
+
+        return _metrics.prometheus_text()
+
     def rpc_has_worker(self, worker_id):
         """Routing probe for the head: does this node know the worker?"""
         with self._lock:
@@ -1535,9 +1706,73 @@ class NodeAgent:
             _metrics.WORKER_UPTIME_SECONDS.remove(tags=tags)
             self._cpu_prev.pop(wid, None)
         self._exported_gauges = exported
+        self._export_device_gauges(set(stats))
         with self._lock:
             self._worker_stats = stats
         return list(stats.values())
+
+    def _export_device_gauges(self, live_workers: set) -> None:
+        """Refresh the ray_tpu_device_* families from the workers' latest
+        device snapshots, pruning dead workers' children (same lifecycle
+        as the /proc gauges). The node-level device count is always set —
+        0 is the documented stub on nodes where jax never loads."""
+        from ray_tpu.util import metrics as _metrics
+
+        with self._lock:
+            for wid in list(self._device_stats):
+                if wid not in live_workers:
+                    del self._device_stats[wid]
+            snaps = {wid: s for wid, s in self._device_stats.items()}
+        exported: set[tuple] = set()
+        n_devices = 0
+        for wid, snap in snaps.items():
+            wtags = {"node_id": self.node_id, "worker_id": wid}
+            comp = snap.get("compile") or {}
+            _metrics.DEVICE_JAX_COMPILES.set(
+                comp.get("backend_compiles", 0), tags=wtags)
+            _metrics.DEVICE_JAX_COMPILE_SECONDS.set(
+                comp.get("compile_seconds", 0.0), tags=wtags)
+            _metrics.DEVICE_JAX_CACHE_HITS.set(
+                comp.get("cache_hits", 0), tags=wtags)
+            _metrics.DEVICE_JAX_CACHE_MISSES.set(
+                comp.get("cache_misses", 0), tags=wtags)
+            exported.add((wid, None))
+            devices = snap.get("devices") or []
+            n_devices = max(n_devices, len(devices))
+            for d in devices:
+                dev = f"{d.get('platform', '?')}:{d.get('id', -1)}"
+                dtags = {**wtags, "device": dev}
+                _metrics.DEVICE_MEM_IN_USE.set(
+                    d.get("bytes_in_use", 0), tags=dtags)
+                _metrics.DEVICE_MEM_PEAK.set(
+                    d.get("peak_bytes_in_use", 0), tags=dtags)
+                _metrics.DEVICE_MEM_LIMIT.set(
+                    d.get("bytes_limit", 0), tags=dtags)
+                exported.add((wid, dev))
+        _metrics.DEVICE_COUNT.set(
+            n_devices, tags={"node_id": self.node_id})
+        for wid, dev in self._exported_device - exported:
+            self._retract_device_series(wid, dev)
+        self._exported_device = exported
+
+    def _retract_device_series(self, wid: str, dev: str | None) -> None:
+        """Drop one exported device-gauge child: the compile-counter
+        family for ``dev is None``, the per-device memory family
+        otherwise. The ONE place listing the gauge families, shared by
+        the telemetry prune pass and agent-stop cleanup."""
+        from ray_tpu.util import metrics as _metrics
+
+        wtags = {"node_id": self.node_id, "worker_id": wid}
+        if dev is None:
+            _metrics.DEVICE_JAX_COMPILES.remove(tags=wtags)
+            _metrics.DEVICE_JAX_COMPILE_SECONDS.remove(tags=wtags)
+            _metrics.DEVICE_JAX_CACHE_HITS.remove(tags=wtags)
+            _metrics.DEVICE_JAX_CACHE_MISSES.remove(tags=wtags)
+        else:
+            dtags = {**wtags, "device": dev}
+            _metrics.DEVICE_MEM_IN_USE.remove(tags=dtags)
+            _metrics.DEVICE_MEM_PEAK.remove(tags=dtags)
+            _metrics.DEVICE_MEM_LIMIT.remove(tags=dtags)
 
     def _telemetry_loop(self):
         interval = config.worker_telemetry_interval_s
@@ -1935,6 +2170,11 @@ class NodeAgent:
                     _metrics.WORKER_UPTIME_SECONDS.remove(tags=tags)
                 self._exported_gauges = set()
                 _metrics.NODE_WORKER_COUNT.remove(
+                    tags={"node_id": self.node_id})
+                for wid, dev in self._exported_device:
+                    self._retract_device_series(wid, dev)
+                self._exported_device = set()
+                _metrics.DEVICE_COUNT.remove(
                     tags={"node_id": self.node_id})
         except Exception:
             pass

@@ -62,6 +62,25 @@ class _TeeStream(io.TextIOBase):
         return False
 
 
+class _PhaseClock:
+    """Wall-ns accumulator for the per-task phase breakdown
+    (``get_args`` = arg fetch + deserialize, ``execute`` = user code,
+    ``put_outputs`` = result serialize + object-store put). ``lap``
+    closes the current phase; phases ride the task-event record to the
+    agent and surface in ``state.summarize_tasks()``/``timeline()``."""
+
+    __slots__ = ("_phases", "_t")
+
+    def __init__(self, phases: dict):
+        self._phases = phases
+        self._t = time.monotonic_ns()
+
+    def lap(self, name: str) -> None:
+        now = time.monotonic_ns()
+        self._phases[name] = self._phases.get(name, 0) + (now - self._t)
+        self._t = now
+
+
 class WorkerHandler:
     def __init__(self, head_address, agent_address, node_id, store_path, worker_id):
         from ray_tpu.cluster.client import ClusterBackend
@@ -137,6 +156,9 @@ class WorkerHandler:
             "start_time": time.time(),
             "end_time": None,
             "error": None,
+            # Wall-ns per execution phase (get_args/execute/put_outputs),
+            # filled by a _PhaseClock as the task advances.
+            "phases": {},
         }
         return rec
 
@@ -148,9 +170,10 @@ class WorkerHandler:
             self._task_events.append(rec)
 
     def _event_flush_loop(self):
-        from ray_tpu.util import tracing
+        from ray_tpu.util import device_telemetry, tracing
 
         pid = os.getpid()
+        last_dev_ship = 0.0
         # Agent-liveness watchdog (reference: a worker whose raylet dies
         # exits with it, core_worker shutdown-on-raylet-death). Workers
         # are killed by the agent on clean shutdown; when the agent dies
@@ -162,6 +185,13 @@ class WorkerHandler:
         idle_rounds = 0
         while True:
             time.sleep(0.25)
+            # Attach jax compile-counter listeners the moment a task's
+            # import makes jax available (idempotent; narrows the
+            # uncounted window to compiles racing this tick).
+            try:
+                device_telemetry.ensure_listeners()
+            except Exception:
+                pass
             with self._ev_lock:
                 # Drain in place: the tee streams hold a reference to
                 # THESE list objects — rebinding would orphan them.
@@ -178,10 +208,21 @@ class WorkerHandler:
                 if idle_rounds < 8 and consecutive_fail == 0:
                     continue
             idle_rounds = 0
+            # Device telemetry rides the same batch, throttled to ~1/s;
+            # None until something in this process imports jax (the
+            # snapshot itself never triggers the import).
+            device = None
+            now = time.monotonic()
+            if device_telemetry.jax_loaded() and now - last_dev_ship >= 1.0:
+                try:
+                    device = device_telemetry.snapshot()
+                    last_dev_ship = now
+                except Exception:
+                    device = None
             try:
                 self.agent.call(
                     "worker_events", self.worker_id, pid, events, lines,
-                    spans)
+                    spans, device)
                 consecutive_fail = 0
             except Exception:
                 consecutive_fail += 1
@@ -247,6 +288,33 @@ class WorkerHandler:
         prof = stack_sampler.sample(duration_s, interval_s)
         prof["worker_id"] = self.worker_id
         return prof
+
+    def rpc_capture_profile(self, duration_s: float = 1.0,
+                            interval_s: float = 0.01,
+                            out_dir: str | None = None):
+        """Timed profiler window over this worker: ``jax.profiler.trace``
+        when this process has jax loaded (XLA host+device tracks), the
+        stack sampler otherwise. With ``out_dir`` (the agent's capture
+        dir — same host, shared filesystem) the trace files are written
+        THERE and only a ``{kind, files: {name: size}}`` manifest rides
+        the RPC; a multi-hundred-MB TPU trace never transits a frame.
+        Without it, falls back to inline ``{name: bytes}``."""
+        from ray_tpu.util import device_telemetry
+
+        if out_dir is not None:
+            return device_telemetry.capture_to_dir(
+                out_dir, float(duration_s), float(interval_s),
+                worker_id=self.worker_id)
+        return device_telemetry.capture(
+            float(duration_s), float(interval_s),
+            worker_id=self.worker_id)
+
+    def rpc_device_stats(self):
+        """Immediate device snapshot of this worker (state API's fresh
+        path; the batched flusher remains the steady-state feed)."""
+        from ray_tpu.util import device_telemetry
+
+        return device_telemetry.snapshot()
 
     def rpc_cancel_task(self, task_id: str, force: bool = False):
         """Cancel a task this worker holds. Queued: marked so the executor
@@ -424,12 +492,14 @@ class WorkerHandler:
         # blocked; actor lifetime resources stay held (reference semantics).
         self.backend._block_hooks = self._hooks
         err = None
+        clock = _PhaseClock(rec["phases"])
         try:
             from ray_tpu.util import tracing
 
             func = self._resolve_function(spec)
             args, kwargs = ser.loads(spec["args"])
             args, kwargs = self._resolve(args, kwargs)
+            clock.lap("get_args")
             if spec.get("trace_ctx"):
                 tracing.enable()  # the driver traces: continue here
                 with tracing.span(
@@ -440,7 +510,9 @@ class WorkerHandler:
                     result = func(*args, **kwargs)
             else:
                 result = func(*args, **kwargs)
+            clock.lap("execute")
             self._store_result(spec, result)
+            clock.lap("put_outputs")
         except BaseException as e:  # noqa: BLE001 — stored, not dropped
             err = repr(e)
             if isinstance(e, (TaskError, ActorError)):
@@ -468,11 +540,14 @@ class WorkerHandler:
     def _run_actor_ctor(self, spec):
         rec = self._record(spec, "ACTOR_CREATION_TASK")
         err = None
+        clock = _PhaseClock(rec["phases"])
         try:
             cls = ser.loads(spec["func"])
             args, kwargs = ser.loads(spec["args"])
             args, kwargs = self._resolve(args, kwargs)
+            clock.lap("get_args")
             self._actor_instance = cls(*args, **kwargs)
+            clock.lap("execute")
         except BaseException as e:  # noqa: BLE001
             err = repr(e)
             self._actor_dead_cause = traceback.format_exc()
@@ -536,9 +611,11 @@ class WorkerHandler:
             return
         task_id = spec.get("task_id")
         fut = None
+        clock = _PhaseClock(rec["phases"])
         try:
             args, kwargs = ser.loads(spec["args"])
             args, kwargs = self._resolve(args, kwargs)
+            clock.lap("get_args")
             if asyncio.iscoroutinefunction(
                     getattr(method, "__func__", method)):
                 coro = method(*args, **kwargs)
@@ -581,9 +658,14 @@ class WorkerHandler:
                 # Same record shape as a sync cancel: CANCELLED, not FAILED.
                 self._store_cancelled(spec, rec)
                 return
+            # The coroutine ran between the schedule and this callback:
+            # everything since the get_args lap is the execute phase
+            # (includes loop queueing — the time the CALL took).
+            clock.lap("execute")
             err = None
             try:
                 self._store_result(spec, f.result())
+                clock.lap("put_outputs")
             except BaseException as e:  # noqa: BLE001
                 err = repr(e)
                 if isinstance(e, (TaskError, ActorError)):
@@ -627,6 +709,7 @@ class WorkerHandler:
             self._store_cancelled(spec, rec)
             return
         err = None
+        clock = _PhaseClock(rec["phases"])
         try:
             if self._actor_instance is None:
                 raise ActorError(
@@ -634,9 +717,12 @@ class WorkerHandler:
                 )
             args, kwargs = ser.loads(spec["args"])
             args, kwargs = self._resolve(args, kwargs)
+            clock.lap("get_args")
             method = getattr(self._actor_instance, spec["method"])
             result = method(*args, **kwargs)
+            clock.lap("execute")
             self._store_result(spec, result)
+            clock.lap("put_outputs")
         except BaseException as e:  # noqa: BLE001
             err = repr(e)
             if isinstance(e, (TaskError, ActorError)):

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import traceback
 import weakref
 from typing import Any, Callable, Sequence
@@ -574,7 +575,33 @@ class LocalBackend:
                 "start_time": None,
                 "end_time": None,
                 "error": None,
+                # Wall-ns per execution phase (get_args/execute/
+                # put_outputs) — same shape the cluster workers report.
+                "phases": {},
             }
+
+    def _record_task_phase(self, task_id: str, name: str, ns: int) -> None:
+        with self._lock:
+            rec = self._task_records.get(task_id)
+            if rec is not None:
+                phases = rec.setdefault("phases", {})
+                phases[name] = phases.get(name, 0) + int(ns)
+
+    def _record_task_attempt(self, task_id: str) -> None:
+        """A new execution attempt begins: stamp start_time (first
+        attempt anchors the timeline slice) and drop the previous
+        attempt's phases — a retried task must report the phases of the
+        attempt that produced its outcome, not an N-attempt sum that
+        overflows the slice (cluster workers get this for free: each
+        attempt ships a fresh record)."""
+        import time as _time
+
+        with self._lock:
+            rec = self._task_records.get(task_id)
+            if rec is not None:
+                if rec["start_time"] is None:
+                    rec["start_time"] = _time.time()
+                rec["phases"] = {}
 
     def _record_task_state(self, task_id: str, state: str, error: str | None = None):
         import time as _time
@@ -584,7 +611,11 @@ class LocalBackend:
             return
         rec["state"] = state
         if state == "RUNNING":
-            rec["start_time"] = _time.time()
+            # Keep the earliest stamp: _record_task_attempt anchors the
+            # timeline slice before arg resolution; RUNNING here only
+            # flips the reported state once resources are actually held.
+            if rec["start_time"] is None:
+                rec["start_time"] = _time.time()
         elif state in ("FINISHED", "FAILED"):
             rec["end_time"] = _time.time()
             rec["error"] = error
@@ -652,6 +683,58 @@ class LocalBackend:
 
     def worker_stats(self, fresh: bool = False) -> list[dict]:
         return []
+
+    def device_stats(self, fresh: bool = False) -> list[dict]:
+        """This process's JAX/XLA device view (a stub until something
+        imports jax — the snapshot never triggers the import itself)."""
+        from ray_tpu.util import device_telemetry
+
+        snap = device_telemetry.snapshot()
+        snap["worker_id"] = "local"
+        snap["node_id"] = self.node_id
+        return [snap]
+
+    def capture_profile(self, worker_id=None, duration_s: float = 1.0,
+                        interval_s: float = 0.01, out_dir=None,
+                        node_id=None) -> dict:
+        """Timed profiler window over this process: jax.profiler.trace
+        when jax is loaded, the stack sampler otherwise; trace files
+        land in ``out_dir`` (a fresh temp dir by default)."""
+        import os as _os
+        import tempfile
+
+        from ray_tpu.util import device_telemetry
+
+        out_dir = out_dir or tempfile.mkdtemp(prefix="ray_tpu_tprof_")
+        res = device_telemetry.capture_to_dir(
+            out_dir, duration_s, interval_s,
+            worker_id=worker_id or "local")
+        return {
+            "kind": res["kind"],
+            "worker_id": worker_id or "local",
+            "node_id": self.node_id,
+            "duration_s": res["duration_s"],
+            "dir": out_dir,
+            "files": [_os.path.join(out_dir, rel)
+                      for rel in sorted(res["files"])],
+        }
+
+    def list_spans(self, trace_id=None, limit: int = 10_000) -> list[dict]:
+        """This process's finished tracing spans (the cluster backend
+        reads the head's span store instead)."""
+        from ray_tpu.util import tracing
+
+        spans = tracing.collect()
+        if trace_id is not None:
+            spans = [s for s in spans if s["trace_id"] == trace_id]
+        return spans[-limit:]
+
+    def cluster_metrics_text(self) -> str:
+        """Single-process 'cluster': the federated view IS the local
+        registry."""
+        from ray_tpu.util import metrics as _metrics
+
+        return _metrics.prometheus_text()
 
     # -- task plane -------------------------------------------------------
 
@@ -776,7 +859,18 @@ class LocalBackend:
                     return
                 while True:
                     try:
+                        # Stamp start BEFORE arg resolution (cluster
+                        # workers stamp at executor pickup, also
+                        # pre-resolve — timeline children must nest);
+                        # the state stays PENDING until resources are
+                        # held so a resource-queued task never reads as
+                        # RUNNING.
+                        self._record_task_attempt(task_id)
+                        t_phase = time.monotonic_ns()
                         a, kw = self._resolve_args(args, kwargs)
+                        self._record_task_phase(
+                            task_id, "get_args",
+                            time.monotonic_ns() - t_phase)
                         lease = self._acquire_planned(plan)
                         self._current_lease.lease = lease
                         if plan["capture"]:
@@ -787,8 +881,13 @@ class LocalBackend:
                                 "name": plan["pg"].name,
                             }
                         self._record_task_state(task_id, "RUNNING")
+                        t_phase = time.monotonic_ns()
                         try:
                             result = func(*a, **kw)
+                            self._record_task_phase(
+                                task_id, "execute",
+                                time.monotonic_ns() - t_phase)
+                            t_phase = time.monotonic_ns()
                             if num_returns == "streaming":
                                 # The generator BODY runs during
                                 # iteration — keep the lease held for it
@@ -796,6 +895,9 @@ class LocalBackend:
                                 # holds resources until task_done).
                                 ok = self._store_returns(
                                     oids, result, num_returns)
+                                self._record_task_phase(
+                                    task_id, "put_outputs",
+                                    time.monotonic_ns() - t_phase)
                         finally:
                             self._current_lease.lease = None
                             lease.release()
@@ -806,6 +908,9 @@ class LocalBackend:
                                 self._record_task_state(task_id, "FINISHED")
                             return  # FAILED already recorded inside
                         self._store_returns(oids, result, num_returns)
+                        self._record_task_phase(
+                            task_id, "put_outputs",
+                            time.monotonic_ns() - t_phase)
                         self._record_task_state(task_id, "FINISHED")
                         return
                     except BaseException as e:  # noqa: BLE001 — stored, not dropped
@@ -937,9 +1042,16 @@ class LocalBackend:
                 self._store_error(oids, TaskCancelledError(method_name))
                 return
             try:
+                # Pre-resolve stamp, same reason as submit_task: the
+                # get_args slice must fall inside the call's timeline.
+                self._record_task_attempt(call_tid)
+                t_phase = time.monotonic_ns()
                 a, kw = self._resolve_args(m_args, m_kwargs)
+                self._record_task_phase(
+                    call_tid, "get_args", time.monotonic_ns() - t_phase)
                 method = getattr(state.instance, method_name)
                 self._record_task_state(call_tid, "RUNNING")
+                t_phase = time.monotonic_ns()
                 result = method(*a, **kw)
                 import asyncio
 
@@ -951,7 +1063,12 @@ class LocalBackend:
                     # max_concurrency — set it >1 for interleaving).
                     result = asyncio.run_coroutine_threadsafe(
                         result, self._aio_loop()).result()
+                self._record_task_phase(
+                    call_tid, "execute", time.monotonic_ns() - t_phase)
+                t_phase = time.monotonic_ns()
                 self._store_returns(oids, result, num_returns)
+                self._record_task_phase(
+                    call_tid, "put_outputs", time.monotonic_ns() - t_phase)
                 self._record_task_state(call_tid, "FINISHED")
             except BaseException as e:  # noqa: BLE001
                 if isinstance(e, TaskCancelledError):

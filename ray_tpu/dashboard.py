@@ -188,6 +188,16 @@ class Dashboard:
         if route == "/api/worker_stats":
             return ok_json({"workers": self.head.call(
                 "worker_stats", qs.get("fresh") == "1", timeout=15.0)})
+        if route == "/api/device_stats":
+            # Devices pane: per-worker JAX/XLA snapshots (HBM + compile
+            # counters), stubs where jax never loaded.
+            return ok_json({"devices": self.head.call(
+                "device_stats", qs.get("fresh") == "1", timeout=20.0)})
+        if route == "/api/cluster_metrics":
+            # The federated scrape body, proxied for humans/curl (the
+            # head's own HTTP endpoint is the one Prometheus scrapes).
+            text = self.head.call("cluster_metrics_text", timeout=30.0)
+            return 200, "text/plain; version=0.0.4", text.encode()
         if route == "/api/stack":
             if "worker_id" not in qs:
                 return (400, "application/json",
@@ -407,6 +417,7 @@ class Dashboard:
         api = ["/api/cluster_status", "/api/nodes", "/api/actors",
                "/api/tasks", "/api/objects", "/api/logs",
                "/api/worker_logs", "/api/worker_stats",
+               "/api/device_stats", "/api/cluster_metrics",
                "/api/placement_groups", "/api/pubsub_stats"]
         links = "".join(f'<li><a href="{r}">{r}</a></li>' for r in api)
         return (

@@ -70,8 +70,8 @@ INDEX_HTML = r"""<!doctype html>
 </main>
 <script>
 "use strict";
-const TABS = ["cluster", "nodes", "workers", "actors", "tasks", "objects",
-              "placement_groups", "jobs", "serve", "logs"];
+const TABS = ["cluster", "nodes", "workers", "devices", "actors", "tasks",
+              "objects", "placement_groups", "jobs", "serve", "logs"];
 let active = location.hash.slice(1) || "cluster";
 let logCursor = 0;
 const logBuf = [];
@@ -254,6 +254,55 @@ const RENDER = {
     if (old && old.textContent && !old.textContent.startsWith("select"))
       detail.textContent = old.textContent;  // survive the 2s refresh
     $("view").replaceChildren(wrap);
+  },
+  async devices() {
+    // JAX/XLA device telemetry: one row per (jax-loaded worker, device)
+    // — HBM in use/peak/limit where the backend reports it — plus a
+    // per-worker compile-counter row set. Stub workers (jax never
+    // imported) are omitted; the tiles say how many reported.
+    const d = await api("/api/device_stats");
+    const snaps = (d.devices || []).filter(s => s.available);
+    const rows = [];
+    snaps.forEach(s => {
+      const comp = s.compile || {};
+      (s.devices || []).forEach(dev => rows.push({
+        worker: s.worker_id, node: s.node_id,
+        device: `${dev.platform}:${dev.id}`, kind: dev.device_kind,
+        used: dev.bytes_in_use, peak: dev.peak_bytes_in_use,
+        limit: dev.bytes_limit,
+        compiles: comp.backend_compiles,
+        compile_s: comp.compile_seconds,
+      }));
+    });
+    const gib = v => v === undefined ? "" : (v / 2 ** 30).toFixed(2);
+    const usedT = rows.reduce((a, r) => a + (r.used || 0), 0);
+    const limitT = rows.reduce((a, r) => a + (r.limit || 0), 0);
+    setTiles([
+      ["jax workers", snaps.length],
+      ["devices", rows.length],
+      ["HBM used GiB", gib(usedT) || "0.00"],
+      ["HBM total GiB", gib(limitT) || "0.00"],
+    ]);
+    if (!rows.length) {
+      $("view").replaceChildren(el("div", "",
+        "no jax-loaded workers reported device telemetry yet"));
+      return;
+    }
+    $("view").replaceChildren(table(
+      ["worker", "node", "device", "kind", "HBM used GiB",
+       "HBM peak GiB", "HBM limit GiB", "compiles", "compile s"],
+      rows, (r, c) => {
+        if (c === "worker" || c === "node") {
+          const td = el("td", "mono");
+          td.textContent = c === "node" ? short(r.node || "") : r.worker;
+          return td;
+        }
+        if (c === "HBM used GiB") return el("td", "", gib(r.used));
+        if (c === "HBM peak GiB") return el("td", "", gib(r.peak));
+        if (c === "HBM limit GiB") return el("td", "", gib(r.limit));
+        if (c === "compile s") return el("td", "", r.compile_s ?? "");
+        return el("td", c === "device" ? "mono" : "", r[c] ?? "");
+      }));
   },
   async actors() {
     const d = await api("/api/actors");

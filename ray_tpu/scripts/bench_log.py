@@ -60,6 +60,41 @@ def record_if_on_chip(entry: dict, path: str | None = None) -> str | None:
     return record(entry, path)
 
 
+def record_task_overhead(task_records: list, *, device: str = "",
+                         path: str | None = None, **extra) -> dict:
+    """Framework task-overhead evidence (``scripts/overhead_bench.py``):
+    p50/p99 submit→start latency and per-phase (get_args / execute /
+    put_outputs) wall time, computed from state-API task records that
+    carry the worker-side phase breakdown. Committed to the evidence
+    trail only on an accelerator; returns the entry (with
+    ``committed_to``) either way."""
+    from ray_tpu.util.metrics import latency_dist_ms
+
+    submit_ms = []
+    phase_samples: dict[str, list] = {}
+    n = 0
+    for rec in task_records:
+        if rec.get("start_time") is None:
+            continue
+        n += 1
+        if rec.get("submitted_at") is not None:
+            submit_ms.append(
+                max(0.0, (rec["start_time"] - rec["submitted_at"]) * 1e3))
+        for phase, ns in (rec.get("phases") or {}).items():
+            phase_samples.setdefault(phase, []).append(ns / 1e6)
+    entry: dict = {"bench": "task_overhead", "device": device, "n_tasks": n}
+    if submit_ms:
+        entry["submit_to_start"] = latency_dist_ms(submit_ms)
+    if phase_samples:
+        entry["phases"] = {
+            phase: latency_dist_ms(vals)
+            for phase, vals in phase_samples.items()
+        }
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
 def record_drain_recovery(proactive_drain_ms: float,
                           crash_detection_ms: float, *,
                           device: str = "", path: str | None = None,

@@ -59,6 +59,24 @@ def cmd_status(args):
               + (f" ({why})" if why else ""))
     for k in sorted(total):
         print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
+    from ray_tpu import state
+
+    snaps = [s for s in state.device_stats() if s.get("available")]
+    if snaps:
+        # One line per jax-loaded worker process: platform, device
+        # count, HBM in use / limit where the backend reports it.
+        for s in snaps:
+            devs = s.get("devices") or []
+            used = sum(d.get("bytes_in_use", 0) for d in devs)
+            limit = sum(d.get("bytes_limit", 0) for d in devs)
+            mem = (f" HBM {used / 2**30:.2f}/{limit / 2**30:.2f} GiB"
+                   if limit else "")
+            comp = (s.get("compile") or {}).get("backend_compiles", 0)
+            print(f"  devices[{s.get('worker_id', '?')}]: "
+                  f"{len(devs)}x {s.get('platform')}{mem}, "
+                  f"{comp} compiles")
+    else:
+        print("  devices: none reported (no jax-loaded worker)")
 
 
 def cmd_drain(args):
@@ -204,6 +222,63 @@ def cmd_stack(args):
         print(out if isinstance(out, str) else _json.dumps(out, indent=1))
 
 
+def cmd_tprof(args):
+    """Remote profiler capture (``jax.profiler.trace`` in the worker;
+    stack-sampler fallback off-jax): trace files stream back and land
+    in --output, TensorBoard/Perfetto-loadable."""
+    from ray_tpu import state
+
+    _connect(args)
+    wid = args.worker_id
+    if wid is None:
+        from ray_tpu._private import worker as worker_mod
+
+        if hasattr(worker_mod.backend(), "head"):
+            live = [r["worker_id"] for r in state.list_logs()
+                    if r.get("alive")]
+            if not live:
+                print("no live workers to profile")
+                return
+            wid = live[0]
+    res = state.capture_profile(
+        wid, duration_s=args.duration, interval_s=args.interval,
+        out_dir=args.output)
+    print(f"captured {res['kind']} profile of "
+          f"{res.get('worker_id') or 'this process'} "
+          f"({res['duration_s']:g}s) -> {res['dir']}")
+    for path in res["files"]:
+        print(f"  {path}")
+
+
+def cmd_metrics(args):
+    """Dump the federated Prometheus scrape (one body covering every
+    alive agent), or write a file-SD targets document for
+    scrape-config bootstrap."""
+    from ray_tpu._private import worker as worker_mod
+
+    _connect(args)
+    backend = worker_mod.backend()
+    if args.targets_json:
+        import json as _json
+
+        from ray_tpu.util.metrics import file_sd_targets
+
+        ep = (backend.metrics_endpoint()
+              if hasattr(backend, "metrics_endpoint") else None)
+        if ep is None:
+            raise SystemExit(
+                "no metrics endpoint (local backend, or exposition "
+                "disabled on the head)")
+        doc = file_sd_targets(ep["address"], path=ep["cluster_path"])
+        with open(args.targets_json, "w") as f:
+            _json.dump(doc, f, indent=1)
+        print(f"wrote prometheus file-SD targets to {args.targets_json}")
+        return
+    if not hasattr(backend, "cluster_metrics_text"):
+        raise SystemExit("this backend exports no metrics")
+    sys.stdout.write(backend.cluster_metrics_text())
+
+
 def cmd_submit(args):
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -332,6 +407,27 @@ def main(argv=None):
     p.add_argument("--output", "-o", default=None,
                    help="write chrome-trace output here")
     p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser(
+        "tprof",
+        help="remote profiler capture (jax.profiler.trace / stack "
+             "sampler fallback)")
+    p.add_argument("worker_id", nargs="?", default=None,
+                   help="default: first live worker (local: this process)")
+    p.add_argument("--duration", "-d", type=float, default=2.0)
+    p.add_argument("--interval", type=float, default=0.01,
+                   help="stack-sampler fallback interval")
+    p.add_argument("--output", "-o", default=None,
+                   help="directory for the trace files (default: tmp)")
+    p.set_defaults(fn=cmd_tprof)
+
+    p = sub.add_parser(
+        "metrics",
+        help="dump the federated /metrics/cluster scrape body")
+    p.add_argument("--targets-json", default=None,
+                   help="instead write a prometheus file-SD targets "
+                        "document here")
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("submit", help="submit a job entrypoint")
     p.add_argument("--wait", action="store_true")

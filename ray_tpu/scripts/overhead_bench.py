@@ -1,0 +1,104 @@
+"""Task-overhead microbench: submit→start and per-phase latencies.
+
+Runs a burst of no-op tasks (and actor calls) against the current
+backend, waits for their state-API records — which carry the
+worker-side phase breakdown (get_args / execute / put_outputs wall-ns)
+— and emits p50/p99 evidence through
+``bench_log.record_task_overhead`` (committed to
+``BENCH_TPU_SESSIONS.jsonl`` only when run on an accelerator).
+
+    python -m ray_tpu.scripts.overhead_bench                # local backend
+    python -m ray_tpu.scripts.overhead_bench --cluster -n 200
+    python -m ray_tpu.scripts.overhead_bench --address <head host:port>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def run(n_tasks: int = 100, payload_bytes: int = 1024,
+        actor_calls: int = 20, wait_s: float = 30.0) -> list:
+    """Drive the workload; returns the phase-carrying task records."""
+    import ray_tpu
+    from ray_tpu import state
+
+    payload = b"x" * payload_bytes
+
+    @ray_tpu.remote
+    def noop(blob):
+        return len(blob)
+
+    @ray_tpu.remote
+    class Probe:
+        def ping(self, blob):
+            return len(blob)
+
+    ray_tpu.get([noop.remote(payload) for _ in range(n_tasks)])
+    if actor_calls > 0:
+        probe = Probe.remote()
+        ray_tpu.get([probe.ping.remote(payload)
+                     for _ in range(actor_calls)])
+    # Worker task events flush in batches: wait until the records (with
+    # phases) land, bounded.
+    want = n_tasks + max(0, actor_calls)
+    deadline = time.time() + wait_s
+    records: list = []
+    while time.time() < deadline:
+        records = [
+            r for r in state.list_tasks(limit=100_000)
+            if r["name"] in ("noop", "ping") and r.get("phases")
+        ]
+        if len(records) >= want:
+            break
+        time.sleep(0.25)
+    return records
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", default=None,
+                        help="existing cluster head (default: local)")
+    parser.add_argument("--cluster", action="store_true",
+                        help="spin up a throwaway 2-node local cluster")
+    parser.add_argument("-n", "--num-tasks", type=int, default=100)
+    parser.add_argument("--payload-bytes", type=int, default=1024)
+    parser.add_argument("--actor-calls", type=int, default=20)
+    parser.add_argument("--device", default="",
+                        help="accelerator label for the evidence trail "
+                             "(empty/cpu = print only, don't commit)")
+    args = parser.parse_args(argv)
+
+    import ray_tpu
+    from ray_tpu.scripts import bench_log
+
+    cluster = None
+    if args.cluster and args.address is None:
+        from ray_tpu.cluster.cluster_utils import Cluster
+
+        cluster = Cluster()
+        cluster.add_node()
+        cluster.add_node()
+        cluster.wait_for_nodes()
+        ray_tpu.init(cluster.address)
+    else:
+        ray_tpu.init(args.address)
+
+    try:
+        records = run(args.num_tasks, args.payload_bytes,
+                      args.actor_calls)
+        entry = bench_log.record_task_overhead(
+            records, device=args.device,
+            backend="cluster" if (cluster or args.address) else "local",
+            payload_bytes=args.payload_bytes)
+        print(json.dumps(entry, indent=1))
+    finally:
+        ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -134,18 +134,62 @@ def worker_stats(fresh: bool = False) -> List[dict]:
     return []
 
 
+def device_stats(fresh: bool = False) -> List[dict]:
+    """JAX/XLA device telemetry across the cluster: one snapshot per
+    worker process that has jax loaded (per-device HBM bytes in use /
+    peak / limit where the backend reports them, plus compile-cache
+    counters). Stubs (``available: False``) where jax never loaded."""
+    backend = _worker.backend()
+    if hasattr(backend, "device_stats"):
+        return backend.device_stats(fresh)
+    return []
+
+
+def capture_profile(worker_id: Optional[str] = None,
+                    duration_s: float = 1.0, interval_s: float = 0.01,
+                    out_dir: Optional[str] = None,
+                    node_id: Optional[str] = None) -> dict:
+    """Remote profiler capture (``ray-tpu tprof``): open a timed
+    ``jax.profiler.trace()`` window in the target worker — XLA host +
+    device activity in a TensorBoard-loadable trace directory — falling
+    back to the stack sampler where ``jax.profiler`` is unavailable.
+    Trace files stream back over the RPC plane; returns
+    ``{kind, dir, files, ...}`` with the local paths written."""
+    backend = _worker.backend()
+    if not hasattr(backend, "capture_profile"):
+        raise ValueError("this backend supports no profiler capture")
+    return backend.capture_profile(
+        worker_id, duration_s, interval_s, out_dir=out_dir,
+        node_id=node_id)
+
+
 def summarize_tasks() -> dict:
-    """Counts by (name, state) — `ray summary tasks` analog."""
+    """Counts by (name, state) — `ray summary tasks` analog — plus the
+    per-phase latency distribution (``phases``: p50/p99/mean ms per
+    get_args/execute/put_outputs) from the workers' phase breakdown."""
     by_name: dict = {}
+    samples: dict = {}
     for rec in list_tasks(limit=100_000):
         entry = by_name.setdefault(
             rec["name"], {"type": rec["type"], "states": Counter()}
         )
         entry["states"][rec["state"]] += 1
-    return {
-        name: {"type": e["type"], "states": dict(e["states"])}
-        for name, e in by_name.items()
-    }
+        for phase, ns in (rec.get("phases") or {}).items():
+            samples.setdefault(rec["name"], {}).setdefault(
+                phase, []).append(ns / 1e6)
+    from ray_tpu.util.metrics import latency_dist_ms
+
+    out = {}
+    for name, e in by_name.items():
+        summary = {"type": e["type"], "states": dict(e["states"])}
+        phases = {
+            phase: latency_dist_ms(vals)
+            for phase, vals in samples.get(name, {}).items()
+        }
+        if phases:
+            summary["phases"] = phases
+        out[name] = summary
+    return out
 
 
 def summarize_actors() -> dict:
@@ -160,8 +204,19 @@ def summarize_actors() -> dict:
     }
 
 
-def timeline(filename: Optional[str] = None) -> "list | str":
+# Phase slices nest in the order the worker records them.
+_PHASE_ORDER = ("get_args", "execute", "put_outputs")
+
+
+def timeline(filename: Optional[str] = None,
+             include_spans: bool = True) -> "list | str":
     """Chrome trace (``chrome://tracing`` / Perfetto) of task execution.
+
+    Each task slice carries nested per-phase child slices
+    (``phase:get_args`` / ``phase:execute`` / ``phase:put_outputs``)
+    on its track, and — when tracing is enabled — the distributed
+    ``util/tracing`` spans are merged into the SAME trace, so one file
+    follows a request submit → schedule → phase slices end to end.
 
     Returns the event list, or writes JSON to ``filename`` if given.
     """
@@ -170,6 +225,7 @@ def timeline(filename: Optional[str] = None) -> "list | str":
         if rec["start_time"] is None:
             continue
         end = rec["end_time"] or rec["start_time"]
+        tid = rec["task_id"][:8]
         events.append({
             "name": rec["name"],
             "cat": rec["type"],
@@ -177,9 +233,54 @@ def timeline(filename: Optional[str] = None) -> "list | str":
             "ts": rec["start_time"] * 1e6,
             "dur": max(1.0, (end - rec["start_time"]) * 1e6),
             "pid": "ray_tpu",
-            "tid": rec["task_id"][:8],
+            "tid": tid,
             "args": {"state": rec["state"]},
         })
+        # Nested phase slices: contiguous children from the task's
+        # start, in recording order (Perfetto nests same-track slices
+        # by time containment). In-flight tasks are skipped: their
+        # parent slice is a 1µs stub while phases already carry real
+        # durations, which would render children outside the parent.
+        if rec["end_time"] is None:
+            continue
+        ts = rec["start_time"] * 1e6
+        phases = rec.get("phases") or {}
+        for phase in _PHASE_ORDER:
+            ns = phases.get(phase)
+            if ns is None:
+                continue
+            dur = max(0.1, ns / 1e3)
+            events.append({
+                "name": f"phase:{phase}",
+                "cat": "phase",
+                "ph": "X",
+                "ts": ts,
+                "dur": dur,
+                "pid": "ray_tpu",
+                "tid": tid,
+                "args": {"task": rec["name"]},
+            })
+            ts += dur
+    if include_spans:
+        try:
+            from ray_tpu.util import tracing as _tracing
+
+            # Backend spans (cluster: the head's store, fed by worker
+            # event batches) PLUS this process's own buffer — driver
+            # submit spans never leave the driver, and without them the
+            # submit → schedule → phase-slices chain has no head.
+            # Dedup by span_id: on the local backend both sources are
+            # the same buffer.
+            spans = {}
+            backend = _worker.backend()
+            if hasattr(backend, "list_spans"):
+                for s in backend.list_spans():
+                    spans[s["span_id"]] = s
+            for s in _tracing.collect():
+                spans.setdefault(s["span_id"], s)
+            events.extend(_tracing.chrome_events(list(spans.values())))
+        except Exception:
+            pass  # spans are an overlay; the task trace stands alone
     if filename is not None:
         with open(filename, "w") as f:
             json.dump(events, f)

@@ -26,7 +26,8 @@ from ray_tpu.util import metrics as _metrics
 
 
 def _panel(panel_id: int, title: str, expr: str, unit: str = "short",
-           x: int = 0, y: int = 0) -> dict:
+           x: int = 0, y: int = 0,
+           legend: str = "{{instance}}") -> dict:
     return {
         "id": panel_id,
         "title": title,
@@ -36,16 +37,40 @@ def _panel(panel_id: int, title: str, expr: str, unit: str = "short",
         "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
         "targets": [{
             "expr": expr,
-            "legendFormat": "{{instance}}",
+            "legendFormat": legend,
             "refId": "A",
         }],
     }
 
 
+def _unit_of(name: str) -> str:
+    """Grafana unit inferred from the prometheus naming convention."""
+    if name.endswith("_bytes") or "_bytes_" in name:
+        return "bytes"
+    if name.endswith(("_seconds", "_seconds_total")):
+        return "s"
+    if name.endswith("_percent"):
+        return "percent"
+    return "short"
+
+
+def _legend_of(m: "_metrics.Metric") -> str:
+    """Series legend from the metric's OWN tag keys (a registry-driven
+    dashboard must label by what the exporter actually tags, not a
+    hardcoded {{instance}})."""
+    if not m.tag_keys:
+        return "{{instance}}"
+    return " ".join("{{" + k + "}}" for k in m.tag_keys)
+
+
 def _registry_panels() -> List[tuple]:
+    """(title, expr, unit, legend) per registered metric — derived from
+    the live registry, so new families (device gauges, phase
+    histograms, ...) get panels without touching this module."""
     panels = []
     for m in _metrics.registered():
         name = m.name
+        legend = _legend_of(m)
         if isinstance(m, _metrics.Counter):
             # The exporter emits the registered name VERBATIM (callers
             # who want the prometheus _total convention put it in the
@@ -56,12 +81,14 @@ def _registry_panels() -> List[tuple]:
             expr = (f"histogram_quantile(0.99, "
                     f"rate({name}_bucket[5m]))")
             title = f"{name} p99"
+            if m.tag_keys:
+                legend = _legend_of(m) + " p99"
         else:  # Gauge
             expr = name
             title = name
         if m.description:
             title = f"{title} — {m.description}"
-        panels.append((title, expr))
+        panels.append((title, expr, _unit_of(name), legend))
     return panels
 
 
@@ -73,10 +100,11 @@ def generate_dashboard(title: str = "ray_tpu cluster",
     if include_registry:
         entries += _registry_panels()
     panels = []
-    for i, (ptitle, expr) in enumerate(entries):
+    for i, (ptitle, expr, unit, legend) in enumerate(entries):
         panels.append(_panel(
-            i + 1, ptitle, expr,
+            i + 1, ptitle, expr, unit,
             x=(i % 2) * 12, y=(i // 2) * 8,
+            legend=legend,
         ))
     return {
         "title": title,

@@ -194,6 +194,65 @@ NODE_WORKER_COUNT = Gauge(
     tag_keys=("node_id",),
 )
 
+# -- task execution phases (fed by the agents from the workers' batched
+# task-event reports: each finished task carries wall-ns per phase —
+# arg fetch/deserialize, execute, output serialize+store — so p50/p99
+# per phase is scrapeable without the state API).
+TASK_PHASE_SECONDS = Histogram(
+    "ray_tpu_task_phase_seconds",
+    "Wall time of one task execution phase (get_args/execute/put_outputs)",
+    boundaries=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0],
+    # node_id like every per-node family: on a real multi-host cluster
+    # each agent has its OWN registry, and a phase-only label set would
+    # federate as duplicate series (Prometheus rejects the scrape).
+    tag_keys=("node_id", "phase"),
+)
+
+# -- JAX/XLA device telemetry (util/device_telemetry.py snapshots,
+# sampled per worker process and exported by its node agent; stubbed —
+# device count 0, no per-device children — when jax never loads).
+DEVICE_COUNT = Gauge(
+    "ray_tpu_device_count",
+    "Accelerator devices visible on a node (0 = no jax-loaded process)",
+    tag_keys=("node_id",),
+)
+DEVICE_MEM_IN_USE = Gauge(
+    "ray_tpu_device_memory_bytes_in_use",
+    "Device (HBM) bytes in use by a worker process, per device",
+    tag_keys=("node_id", "worker_id", "device"),
+)
+DEVICE_MEM_PEAK = Gauge(
+    "ray_tpu_device_memory_peak_bytes",
+    "Peak device (HBM) bytes in use by a worker process, per device",
+    tag_keys=("node_id", "worker_id", "device"),
+)
+DEVICE_MEM_LIMIT = Gauge(
+    "ray_tpu_device_memory_bytes_limit",
+    "Device (HBM) byte capacity visible to a worker process, per device",
+    tag_keys=("node_id", "worker_id", "device"),
+)
+DEVICE_JAX_COMPILES = Gauge(
+    "ray_tpu_device_jax_compiles",
+    "Cumulative XLA backend compiles in a worker process",
+    tag_keys=("node_id", "worker_id"),
+)
+DEVICE_JAX_COMPILE_SECONDS = Gauge(
+    "ray_tpu_device_jax_compile_seconds",
+    "Cumulative XLA backend compile wall seconds in a worker process",
+    tag_keys=("node_id", "worker_id"),
+)
+DEVICE_JAX_CACHE_HITS = Gauge(
+    "ray_tpu_device_jax_cache_hits",
+    "Cumulative JAX compilation-cache hits in a worker process",
+    tag_keys=("node_id", "worker_id"),
+)
+DEVICE_JAX_CACHE_MISSES = Gauge(
+    "ray_tpu_device_jax_cache_misses",
+    "Cumulative JAX compilation-cache misses in a worker process",
+    tag_keys=("node_id", "worker_id"),
+)
+
 # -- node drain lifecycle (head-side; the drain coordinator records one
 # increment per initiated drain and the wall time from DRAINING to
 # deregistration, so preemption churn is visible per reason).
@@ -215,6 +274,27 @@ NODE_DRAIN_ACTORS_MIGRATED = Counter(
 )
 
 
+def percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending-sorted non-empty
+    sequence (shared by state.summarize_tasks and the bench evidence
+    writers — one definition, so summaries and committed evidence can
+    never disagree)."""
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def latency_dist_ms(vals_ms: Sequence[float]) -> Dict[str, float]:
+    """{count, p50_ms, p99_ms, mean_ms} of a non-empty ms sample set."""
+    vals = sorted(vals_ms)
+    return {
+        "count": len(vals),
+        "p50_ms": round(percentile(vals, 0.50), 3),
+        "p99_ms": round(percentile(vals, 0.99), 3),
+        "mean_ms": round(sum(vals) / len(vals), 3),
+    }
+
+
 def registered() -> "List[Metric]":
     """Snapshot of the registry (exporters and dashboard generators)."""
     with _registry_lock:
@@ -229,15 +309,83 @@ def prometheus_text() -> str:
     return "\n".join(lines) + "\n"
 
 
-def start_metrics_server(host: str = "127.0.0.1", port: int = 0) -> int:
-    """Serve /metrics for Prometheus scraping; returns the bound port."""
+def merge_prometheus(chunks: Sequence[str]) -> str:
+    """Merge several exposition bodies into one scrape-able document
+    (the head's ``/metrics/cluster`` federation). ``# HELP``/``# TYPE``
+    headers are kept once per metric family, and duplicate SERIES
+    (same metric name + label set) keep their first-seen sample —
+    in-process multi-agent clusters (tests, ``cluster_utils.Cluster``)
+    share ONE process registry, so every agent reports the same series
+    (possibly re-sampled to a different value between chunk renders —
+    identity must be the name+labels, not the whole line, or a gauge
+    that moved mid-merge duplicates and Prometheus rejects the body);
+    per-node series stay distinct through their ``node_id`` tag."""
+    seen_headers: set = set()
+    seen_series: set = set()
+    out: List[str] = []
+    for chunk in chunks:
+        for line in (chunk or "").splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                key = tuple(parts[1:3])  # ("HELP"|"TYPE", metric name)
+                if key in seen_headers:
+                    continue
+                seen_headers.add(key)
+            else:
+                series = line.rsplit(" ", 1)[0]  # name{labels}
+                if series in seen_series:
+                    continue
+                seen_series.add(series)
+            out.append(line)
+    return "\n".join(out) + "\n"
+
+
+def file_sd_targets(address: str, labels: Optional[Dict[str, str]] = None,
+                    path: str = "/metrics/cluster") -> List[dict]:
+    """Prometheus file-SD document pointing one scrape job at the head's
+    federated endpoint — one entry covers the whole cluster (write it
+    with ``json.dump`` to a file named in a ``file_sd_configs`` block,
+    with ``metrics_path: /metrics/cluster``)."""
+    return [{
+        "targets": [address],
+        "labels": {"job": "ray_tpu", "__metrics_path__": path,
+                   **(labels or {})},
+    }]
+
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def serve_metrics(host: str = "127.0.0.1", port: int = 0,
+                  routes: Optional[Dict[str, tuple]] = None):
+    """HTTP exposition server. ``routes`` maps a path to
+    ``(body_fn, content_type)``; defaults to the process registry at
+    ``/metrics``. Returns ``(port, shutdown_fn)``."""
     import http.server
+
+    route_map = dict(routes or {})
+    route_map.setdefault("/metrics", (prometheus_text, PROM_CONTENT_TYPE))
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
-            body = prometheus_text().encode()
+            path = self.path.split("?", 1)[0].rstrip("/")
+            entry = route_map.get(path or "/metrics")
+            if entry is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            fn, ctype = entry
+            try:
+                body = fn().encode()
+            except Exception as e:  # scrape must see the failure, not hang
+                self.send_response(500)
+                self.end_headers()
+                self.wfile.write(repr(e).encode())
+                return
             self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -247,4 +395,15 @@ def start_metrics_server(host: str = "127.0.0.1", port: int = 0) -> int:
 
     server = http.server.ThreadingHTTPServer((host, port), Handler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
-    return server.server_address[1]
+
+    def shutdown():
+        server.shutdown()
+        server.server_close()
+
+    return server.server_address[1], shutdown
+
+
+def start_metrics_server(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Serve /metrics for Prometheus scraping; returns the bound port."""
+    bound, _shutdown = serve_metrics(host, port)
+    return bound

@@ -197,11 +197,13 @@ def export_otel(spans: Optional[List[dict]] = None,
 
 
 def chrome_events(spans: List[dict]) -> List[dict]:
-    """Chrome trace 'X' events (same target format as `ray timeline`)."""
+    """Chrome trace 'X' events, mergeable with ``state.timeline()``'s
+    task/phase slices into one trace (distinct ``cat`` so a merged view
+    can filter spans vs task slices)."""
     return [
         {
             "name": s["name"],
-            "cat": "task",
+            "cat": "span",
             "ph": "X",
             "ts": s["start_ns"] / 1e3,
             "dur": ((s["end_ns"] or s["start_ns"]) - s["start_ns"]) / 1e3,
