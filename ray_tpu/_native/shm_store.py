@@ -262,6 +262,23 @@ class ShmStore:
                 return False
             return _get_lib().ts_evict(h, store_key(object_id)) == 0
 
+    def _check_linked(self) -> None:
+        """Fail LOUD when the segment file was unlinked by another
+        process while our handle is still open (e.g. the owning agent
+        shut down and a stale client keeps introspecting): the native
+        stats/info would read a mapping whose backing file is gone and
+        hand back garbage. Mirrors the closed-handle guards — but an
+        unlinked segment is an error, not an empty result."""
+        try:
+            os.stat(self.path)
+        except FileNotFoundError:
+            raise RuntimeError(
+                f"shm store segment {self.path} was unlinked by another "
+                f"process (owner shut down?); reattach to a live store"
+            ) from None
+        except OSError:
+            pass  # stat hiccup: let the native call proceed
+
     def info(self, object_id: str) -> dict | None:
         """Sealed-object metadata (spill-candidate selection)."""
         dsz = ctypes.c_uint64()
@@ -272,6 +289,7 @@ class ShmStore:
         with self._op() as h:
             if not h:
                 return None
+            self._check_linked()
             rc = _get_lib().ts_info(
                 h, store_key(object_id), ctypes.byref(dsz),
                 ctypes.byref(msz), ctypes.byref(ref), ctypes.byref(pin),
@@ -295,6 +313,7 @@ class ShmStore:
             if not h:
                 return {"capacity": 0, "used": 0, "num_objects": 0,
                         "num_evictions": 0}
+            self._check_linked()
             _get_lib().ts_stats(h, *[ctypes.byref(v) for v in vals])
         return {
             "capacity": vals[0].value,

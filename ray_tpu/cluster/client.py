@@ -109,9 +109,9 @@ class _OwnerService:
         self._b = backend
 
     def rpc_owner_add_location(self, oid, node_id, address, store_path,
-                               is_error=False, size=0):
+                               is_error=False, size=0, attr=None):
         self._b._owner_record(oid, node_id, address, store_path,
-                              is_error, size)
+                              is_error, size, attr)
         return True
 
     def rpc_owner_wait_locations(self, oids, timeout=None):
@@ -392,7 +392,7 @@ class ClusterBackend:
 
     def _owner_record(self, oid: str, node_id: str, address: str,
                       store_path: str, is_error: bool = False,
-                      size: int = 0) -> None:
+                      size: int = 0, attr: dict | None = None) -> None:
         """A copy of an object WE own appeared on ``node_id``."""
         with self._owned_cv:
             e = self._owned.setdefault(
@@ -400,6 +400,10 @@ class ClusterBackend:
             e["nodes"][node_id] = (address, store_path)
             e["error"] = e["error"] or bool(is_error)
             e["size"] = max(e["size"], int(size))
+            if attr and "attr" not in e:
+                # Creation attribution (owner/task/callsite): first
+                # writer wins — replica reports carry no attr.
+                e["attr"] = dict(attr)
             self._owned_cv.notify_all()
 
     def _owner_drop(self, oid: str, node_ids) -> None:
@@ -463,14 +467,15 @@ class ClusterBackend:
             return c
 
     def _report_location(self, oid: str, owner: str | None,
-                         is_error: bool, size: int) -> None:
+                         is_error: bool, size: int,
+                         attr: dict | None = None) -> None:
         """Tell the object's owner a copy now lives on this node. Local
         record when we ARE the owner (the common case: the driver's own
         puts); one direct RPC worker->owner otherwise — the head is not
         on this path at all."""
         if not owner or owner == self.owner_addr:
             self._owner_record(oid, self.node_id, self._agent_address or "",
-                               self.store_path or "", is_error, size)
+                               self.store_path or "", is_error, size, attr)
             return
         if owner in self._dead_owners:
             return
@@ -478,7 +483,7 @@ class ClusterBackend:
             self._owner_client(owner).call(
                 "owner_add_location", oid, self.node_id,
                 self._agent_address or "", self.store_path or "",
-                is_error, size, timeout=10.0)
+                is_error, size, attr, timeout=10.0)
         except (ConnectionLost, OSError):
             # Owner gone: its objects are recoverable only through the
             # head's batched view / lineage. Best-effort by design.
@@ -488,9 +493,18 @@ class ClusterBackend:
 
     def put_with_id(self, oid: str, value: Any, is_error: bool = False,
                     owner: str | None = None) -> None:
+        from ray_tpu.core import attribution
+
         flag = b"E" if is_error else b"V"
         contained: list[str] = []
-        meta, chunks = ser.serialize(value, found_refs=contained)
+        # Put-time attribution (owner worker id, creating task, optional
+        # callsite) rides the store-entry meta so any node holding a
+        # replica can answer "whose bytes are these" without the head.
+        attr = attribution.make(
+            self.client_id,
+            default_task="driver" if self.process_kind == "d" else "worker")
+        meta, chunks = ser.serialize(value, found_refs=contained,
+                                     extra_meta={"attr": attr})
         size = ser.total_size(chunks)
         for attempt in range(8):
             try:
@@ -525,11 +539,11 @@ class ClusterBackend:
         # it) — that is what unblocks a waiting get(). The head's copy is
         # batched through the ref flusher: it serves FT fallback, free
         # fanout, and spill candidacy, none of which need sync latency.
-        self._report_location(oid, owner, is_error, size)
+        self._report_location(oid, owner, is_error, size, attr)
         with self._ref_lock:
             self._loc_dirty.append(
                 (oid, self.node_id, is_error, size, contained,
-                 owner or self.owner_addr))
+                 owner or self.owner_addr, attr))
             self._ref_cv.notify_all()
 
     def put(self, value: Any) -> ObjectRef:
@@ -1114,7 +1128,7 @@ class ClusterBackend:
                         with self._ref_lock:
                             self._loc_dirty.append(
                                 (oid, self.node_id, meta[:1] == b"E",
-                                 len(data), None, owner or ""))
+                                 len(data), None, owner or "", None))
                             self._ref_cv.notify_all()
                         return
             except BaseException:  # noqa: BLE001 — best-effort
@@ -1679,6 +1693,13 @@ class ClusterBackend:
             "retries_left": max_retries,
             "runtime_env": self._resolve_runtime_env(options),
         }
+        from ray_tpu.core import attribution
+
+        site = attribution.submit_site()
+        if site:
+            # Submit-time callsite: the worker attributes the task's
+            # return objects to the .remote() line.
+            spec["callsite"] = site
         spec["pg_id"] = spec["sinfo"]["pg_id"]
         spec["bundle_index"] = spec["sinfo"]["bundle_index"]
         from contextlib import nullcontext
@@ -1923,6 +1944,11 @@ class ClusterBackend:
             "borrowed": borrowed,
             "concurrency_group": _options.get("concurrency_group"),
         }
+        from ray_tpu.core import attribution
+
+        site = attribution.submit_site()
+        if site:
+            spec["callsite"] = site
         try:
             info = self._actor_info(actor_id)
             if info["state"] != "ALIVE":
@@ -2109,8 +2135,31 @@ class ClusterBackend:
     def list_actors(self) -> list:
         return self.head.call("list_actors")
 
-    def list_objects(self, limit: int = 1000) -> list:
+    def list_objects(self, limit: int = 1000) -> dict:
+        """{"objects": [...], "truncated": bool, "total": int} — records
+        sorted by size descending, enriched with owner/callsite/age."""
         return self.head.call("list_objects", limit)
+
+    def memory_summary(self, top_k: int = 20,
+                       group_by: str = "callsite") -> dict:
+        """Cluster-wide object/memory rollup: totals + per-node shm
+        occupancy + top-K objects + bytes grouped by callsite/task/node
+        (``ray memory`` summary analog)."""
+        return self.head.call("memory_summary", top_k, group_by,
+                              timeout=30.0)
+
+    def memory_leaks(self) -> list:
+        """Objects the head's leak sweeper currently flags (alive past
+        the age threshold with no reachable refs, or held refs whose
+        every replica is gone)."""
+        return self.head.call("memory_leaks", timeout=15.0)
+
+    def object_store_stats(self, node_id=None,
+                           include_objects: bool = True) -> list:
+        """Per-node shm store stats, with the per-key size/refcount/
+        pinned/attribution join when ``include_objects``."""
+        return self.head.call("object_store_stats", node_id,
+                              include_objects, timeout=30.0)
 
     # -- node reporter surface (logs / stacks / telemetry) -----------------
 

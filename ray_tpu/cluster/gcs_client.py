@@ -77,7 +77,8 @@ class ActorInfoAccessor(_Accessor):
 
 
 class ObjectInfoAccessor(_Accessor):
-    def all(self, limit: int = 1000) -> list[dict]:
+    def all(self, limit: int = 1000) -> dict:
+        """{"objects": [...size-descending...], "truncated", "total"}."""
         return self._rpc.call("list_objects", limit)
 
     def locations(self, object_id: str) -> Optional[dict]:
@@ -85,6 +86,23 @@ class ObjectInfoAccessor(_Accessor):
 
     def on_node(self, node_id: str) -> list[str]:
         return self._rpc.call("objects_on_node", node_id)
+
+    def store_stats(self, node_id: Optional[str] = None,
+                    include_objects: bool = True) -> list[dict]:
+        """Per-node shm store stats with the per-key attribution join."""
+        return self._rpc.call("object_store_stats", node_id,
+                              include_objects, timeout=30.0)
+
+    def memory_summary(self, top_k: int = 20,
+                       group_by: str = "callsite") -> dict:
+        """Cluster memory rollup (totals / per-node occupancy / top-K /
+        grouped attribution)."""
+        return self._rpc.call("memory_summary", top_k, group_by,
+                              timeout=30.0)
+
+    def leaks(self) -> list[dict]:
+        """Objects the head's leak sweeper currently flags."""
+        return self._rpc.call("memory_leaks", timeout=15.0)
 
 
 class PlacementGroupAccessor(_Accessor):

@@ -160,6 +160,9 @@ class HeadServer:
         self._released_streams: dict[str, int] = {}
         self._free_queue: list[tuple] = []  # (address, oid) delete fanout
         self._free_cv = threading.Condition(self._lock)
+        # Leak sweeper state: oid -> flag record (state.memory_leaks()).
+        # Initialized BEFORE the RPC server: _maybe_free clears flags.
+        self._leaks: dict[str, dict] = {}
         # Unsatisfiable demand log: the autoscaler's input signal
         # (load_metrics.py / resource_demand_scheduler.py analog).
         self._demand_misses: list[dict] = []
@@ -199,6 +202,9 @@ class HeadServer:
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
         self._monitor.start()
         threading.Thread(target=self._free_loop, daemon=True).start()
+        if config.leak_sweep_interval_s > 0:
+            threading.Thread(
+                target=self._leak_sweep_loop, daemon=True).start()
         if self._store is not None:
             threading.Thread(target=self._snapshot_loop, daemon=True).start()
 
@@ -736,7 +742,19 @@ class HeadServer:
             for k in list(self._freed)[:100_000]:
                 del self._freed[k]
         entry = self._objects.pop(oid, None)
+        self._leaks.pop(oid, None)  # freed: by definition not leaked
         if entry is not None:
+            created = (entry.get("attr") or {}).get("created_at")
+            if created:
+                # Lifetime distribution of freed objects: long tails
+                # here mean refs (or leaks) outlive their usefulness.
+                from ray_tpu.util import metrics as _metrics
+
+                try:
+                    _metrics.OBJECT_AGE_SECONDS.observe(
+                        max(0.0, time.time() - created))
+                except Exception:
+                    pass
             for nid in entry["nodes"]:
                 node = self._nodes.get(nid)
                 if node is not None and node.alive:
@@ -824,15 +842,17 @@ class HeadServer:
 
     def rpc_add_locations(self, items):
         """Batched location adds from a client's ref flusher. Each item:
-        (oid, node_id, is_error, size, contained, owner_addr). The head's
-        directory is the FT fallback + free/spill authority; the latency-
-        critical wait path resolves at owners (client.py owner service),
-        so these arrive asynchronously batched. owner_addr is recorded as
-        object->owner routing (ownership_based_object_directory.h: the
-        GCS keeps owner routing, not the authoritative location set)."""
-        for oid, node_id, is_error, size, contained, owner_addr in items:
-            self.rpc_add_location(oid, node_id, is_error, size, contained,
-                                  owner_addr)
+        (oid, node_id, is_error, size, contained, owner_addr[, attr]).
+        The head's directory is the FT fallback + free/spill authority;
+        the latency-critical wait path resolves at owners (client.py
+        owner service), so these arrive asynchronously batched.
+        owner_addr is recorded as object->owner routing
+        (ownership_based_object_directory.h: the GCS keeps owner
+        routing, not the authoritative location set); attr is the
+        put-time attribution record (owner worker id / creating task /
+        callsite) feeding memory_summary and the leak sweeper."""
+        for item in items:
+            self.rpc_add_location(*item)
         return True
 
     def rpc_owner_of(self, oids):
@@ -845,7 +865,7 @@ class HeadServer:
             }
 
     def rpc_add_location(self, oid, node_id, is_error=False, size=0,
-                         contained=None, owner_addr=""):
+                         contained=None, owner_addr="", attr=None):
         with self._lock:
             if oid in self._freed or self._stream_released(oid):
                 # Freed while the task computing it was still running:
@@ -863,6 +883,22 @@ class HeadServer:
             entry["size"] = max(entry["size"], size)
             if owner_addr:
                 entry["owner"] = owner_addr
+            # Creation attribution: first writer wins PER KEY (replica/
+            # restore reports pass attr=None but may stamp created_at
+            # first — the owner's real owner/task/callsite must still
+            # land when its batched report arrives later, and the
+            # earliest created_at is the creation, not the fetch);
+            # attribution-unaware reporters still get a created_at so
+            # ages and the leak sweeper work everywhere.
+            dst = entry.setdefault("attr", {})
+            if attr:
+                for k, v in attr.items():
+                    if k == "created_at":
+                        dst["created_at"] = min(
+                            dst.get("created_at", v), v)
+                    else:
+                        dst.setdefault(k, v)
+            dst.setdefault("created_at", round(time.time(), 3))
             if contained:
                 # The container holds its nested refs until it is freed.
                 self._contained[oid] = list(contained)
@@ -1208,18 +1244,34 @@ class HeadServer:
         return records[-limit:]
 
     def rpc_list_objects(self, limit: int = 1000):
-        """Object records from the directory + ref table (no agent RPC)."""
+        """Object records from the directory + ref table (no agent RPC),
+        sorted by size DESCENDING with the limit applied after the sort
+        — ``limit=N`` is the N largest objects, and clipping is reported
+        ({"objects", "truncated", "total"}), never silent. Records carry
+        the put-time attribution (owner worker id, creating task,
+        callsite) and age."""
+        now = time.time()
         with self._lock:
             out = []
-            for oid, entry in list(self._objects.items())[:limit]:
+            for oid, entry in self._objects.items():
+                attr = entry.get("attr") or {}
+                created = attr.get("created_at")
                 out.append({
                     "object_id": oid,
                     "size": entry.get("size", 0),
                     "locations": sorted(entry["nodes"]),
                     "is_error": entry.get("error", False),
                     "ref_holders": len(self._refs.get(oid, ())),
+                    "owner": attr.get("owner", ""),
+                    "owner_addr": entry.get("owner", ""),
+                    "task": attr.get("task", ""),
+                    "callsite": attr.get("callsite", ""),
+                    "age_s": round(now - created, 3) if created else None,
                 })
-            return out
+        out.sort(key=lambda r: r["size"], reverse=True)
+        total = len(out)
+        return {"objects": out[:limit], "truncated": total > limit,
+                "total": total}
 
     def rpc_worker_logs(self, node_id, pid, lines):
         with self._lock:
@@ -1334,32 +1386,36 @@ class HeadServer:
             out.extend(stats)
         return out
 
-    def _fanout_agents(self, method: str, *args, timeout: float = 5.0):
+    def _fanout_agents(self, method: str, *args, timeout: float = 5.0,
+                       agents=None, args_for=None):
         """Call one RPC on every alive agent CONCURRENTLY and return the
         successful results. The scrape-path aggregations use this so
         latency is the slowest single agent (bounded by ``timeout``),
         not the sum over the cluster — one wedged agent must not stall
-        /metrics/cluster past Prometheus's scrape deadline."""
-        agents = self._alive_agents()
+        /metrics/cluster past Prometheus's scrape deadline.
+        ``args_for(node_id)`` supplies per-agent call args (overriding
+        ``*args``) for aggregations whose input is sharded per node,
+        e.g. each node's slice of the object directory."""
+        agents = self._alive_agents() if agents is None else agents
         if not agents:
             return []
-        if len(agents) == 1:
-            try:
-                return [agents[0][1].call(method, *args, timeout=timeout)]
-            except Exception:
-                return []
-        from concurrent.futures import ThreadPoolExecutor
 
-        def one(client):
+        def one(item):
+            nid, client = item
+            call_args = args if args_for is None else args_for(nid)
             try:
-                return client.call(method, *args, timeout=timeout)
+                return client.call(method, *call_args, timeout=timeout)
             except Exception:
                 return None  # node died/wedged mid-query: best-effort
 
-        with ThreadPoolExecutor(
-                max_workers=min(16, len(agents))) as pool:
-            results = list(pool.map(
-                one, [client for _nid, client in agents]))
+        if len(agents) == 1:
+            results = [one(agents[0])]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(16, len(agents))) as pool:
+                results = list(pool.map(one, agents))
         return [r for r in results if r is not None]
 
     def rpc_device_stats(self, fresh: bool = False):
@@ -1370,6 +1426,178 @@ class HeadServer:
                                          timeout=10.0):
             out.extend(snaps)
         return out
+
+    # -- memory observability (`ray memory` / memory_summary analog) -------
+
+    def rpc_object_store_stats(self, node_id=None,
+                               include_objects: bool = True):
+        """Per-node object-store reports: each agent's shm ``stats()``
+        joined with per-key ``info()`` (size/refcount/pinned) and the
+        attribution embedded in each entry's meta; the head fills
+        attribution gaps (e.g. spilled-and-restored copies) from its
+        directory and stamps ref-holder counts."""
+        with self._lock:
+            agents = [
+                (n.node_id, n.client) for n in self._nodes.values()
+                if n.alive and (node_id is None or n.node_id == node_id)
+            ]
+            oids_by_node: dict[str, list] = {}
+            attr_by_oid: dict[str, dict] = {}
+            holders_by_oid: dict[str, int] = {}
+            if include_objects:
+                for oid, e in self._objects.items():
+                    for nid in e["nodes"]:
+                        oids_by_node.setdefault(nid, []).append(oid)
+                    attr_by_oid[oid] = dict(e.get("attr") or {})
+                    holders_by_oid[oid] = len(self._refs.get(oid, ()))
+        reports = self._fanout_agents(
+            "object_store_stats", timeout=15.0, agents=agents,
+            args_for=lambda nid: (oids_by_node.get(nid, []),
+                                  include_objects))
+        now = time.time()
+        for rep in reports:
+            for rec in rep.get("objects") or []:
+                attr = attr_by_oid.get(rec["object_id"]) or {}
+                for key in ("owner", "task", "callsite"):
+                    if not rec.get(key) and attr.get(key):
+                        rec[key] = attr[key]
+                if rec.get("age_s") is None and attr.get("created_at"):
+                    rec["age_s"] = round(now - attr["created_at"], 3)
+                rec["ref_holders"] = holders_by_oid.get(
+                    rec["object_id"], 0)
+        return reports
+
+    def rpc_memory_summary(self, top_k: int = 20,
+                           group_by: str = "callsite"):
+        """Cluster-wide memory rollup: per-node shm totals/occupancy,
+        top-K resident objects (replicas deduped), and live bytes
+        grouped by creation callsite / task / node / owner."""
+        if group_by not in ("callsite", "task", "node", "owner"):
+            raise ValueError(
+                f"group_by must be callsite|task|node|owner, "
+                f"got {group_by!r}")
+        reports = self.rpc_object_store_stats()
+        totals = {"bytes_used": 0, "bytes_capacity": 0, "objects": 0,
+                  "evictions": 0, "spilled_bytes": 0, "spilled_objects": 0,
+                  "nodes": len(reports)}
+        nodes: dict[str, dict] = {}
+        best: dict[str, dict] = {}
+        for rep in reports:
+            st = rep.get("stats") or {}
+            nid = rep.get("node_id", "?")
+            totals["bytes_used"] += st.get("used", 0)
+            totals["bytes_capacity"] += st.get("capacity", 0)
+            totals["objects"] += st.get("num_objects", 0)
+            totals["evictions"] += st.get("num_evictions", 0)
+            totals["spilled_bytes"] += st.get("spilled_bytes", 0)
+            totals["spilled_objects"] += st.get("spilled_objects", 0)
+            cap = st.get("capacity", 0)
+            nodes[nid] = {
+                "bytes_used": st.get("used", 0), "bytes_capacity": cap,
+                "occupancy": round(st.get("used", 0) / cap, 4) if cap
+                else 0.0,
+                "objects": st.get("num_objects", 0),
+                "evictions": st.get("num_evictions", 0),
+                "spilled_bytes": st.get("spilled_bytes", 0),
+                "oom_reports": [r.get("path")
+                                for r in rep.get("oom_reports") or []],
+            }
+            for rec in rep.get("objects") or []:
+                cur = best.get(rec["object_id"])
+                if cur is None:
+                    cur = best[rec["object_id"]] = dict(rec)
+                    cur["nodes"] = [nid]
+                else:
+                    # A replica: one entry, all its homes; size is the
+                    # primary's (max — replicas are byte-identical).
+                    cur["nodes"].append(nid)
+                    cur["size"] = max(cur["size"], rec.get("size", 0))
+        objs = sorted(best.values(), key=lambda r: r.get("size", 0),
+                      reverse=True)
+        groups: dict[str, dict] = {}
+        for rec in objs:
+            if group_by == "node":
+                keys = rec.get("nodes") or ["(unknown)"]
+            else:
+                keys = [rec.get(group_by) or "(unknown)"]
+            for key in keys:
+                g = groups.setdefault(
+                    key, {"key": key, "bytes": 0, "objects": 0})
+                g["bytes"] += rec.get("size", 0)
+                g["objects"] += 1
+        with self._lock:
+            n_leaks = len(self._leaks)
+        return {
+            "totals": totals,
+            "nodes": nodes,
+            "top_objects": objs[:top_k],
+            "group_by": group_by,
+            "groups": sorted(groups.values(),
+                             key=lambda g: g["bytes"], reverse=True),
+            "leaks": n_leaks,
+        }
+
+    def rpc_memory_leaks(self):
+        """Objects the sweeper currently flags, largest first."""
+        with self._lock:
+            leaks = [dict(v) for v in self._leaks.values()]
+        leaks.sort(key=lambda r: r.get("size", 0), reverse=True)
+        return leaks
+
+    def _leak_sweep_loop(self):
+        interval = max(0.25, config.leak_sweep_interval_s)
+        while not self._stop.wait(interval):
+            try:
+                self._sweep_leaks_once()
+            except Exception:
+                continue  # observability must never take the head down
+
+    def _sweep_leaks_once(self):
+        """Flag objects alive past the age threshold that nothing can
+        reach anymore: either NO registered holder (an owner that died
+        before its ref flush leaves a pinned, untracked primary copy —
+        the classic shm leak), or held refs whose every replica is gone
+        (primary copy lost: the refs can never resolve again without
+        lineage). Flags clear the moment a holder appears or the object
+        frees."""
+        threshold = config.leak_age_threshold_s
+        if threshold <= 0:
+            return
+        now = time.time()
+        with self._lock:
+            flagged: dict[str, dict] = {}
+            for oid, entry in self._objects.items():
+                attr = entry.get("attr") or {}
+                created = attr.get("created_at")
+                if not created or now - created < threshold:
+                    continue
+                holders = self._refs.get(oid)
+                inflight = self._inflight.get(oid, 0)
+                live_nodes = [
+                    nid for nid in entry["nodes"]
+                    if self._nodes.get(nid) and self._nodes[nid].alive
+                ]
+                if not holders and inflight == 0:
+                    kind = "no_reachable_refs"
+                elif holders and not live_nodes:
+                    kind = "primary_copy_lost"
+                else:
+                    continue
+                prev = self._leaks.get(oid)
+                flagged[oid] = {
+                    "object_id": oid,
+                    "kind": kind,
+                    "size": entry.get("size", 0),
+                    "nodes": sorted(entry["nodes"]),
+                    "age_s": round(now - created, 1),
+                    "owner": attr.get("owner", ""),
+                    "task": attr.get("task", ""),
+                    "callsite": attr.get("callsite", ""),
+                    "holders": sorted(holders or ()),
+                    "first_flagged": (prev or {}).get(
+                        "first_flagged", round(now, 3)),
+                }
+            self._leaks = flagged
 
     def rpc_capture_profile(self, worker_id, duration_s: float = 1.0,
                             interval_s: float = 0.01, node_id=None):

@@ -120,9 +120,41 @@ class MemoryMonitor:
         if picked is None:
             return False
         victim, expected_task = picked
-        if not self.agent.kill_worker_oom(victim, reason, expected_task):
-            return False  # victim's task ended meanwhile: re-evaluate next tick
+        # OOM forensics: snapshot the memory state (per-worker RSS, shm
+        # occupancy, top objects by owner/callsite) BEFORE the kill
+        # destroys the evidence, and fold the report path into the death
+        # cause so the victim's OutOfMemoryError explains *why*.
+        report_path = None
+        writer = getattr(self.agent, "write_oom_report", None)
+        if writer is not None:
+            try:
+                report_path = writer(reason, victim, expected_task)
+            except Exception:
+                report_path = None
+        cause = reason if report_path is None else (
+            f"{reason} (memory report: {report_path})")
+        if not self.agent.kill_worker_oom(victim, cause, expected_task):
+            # Victim's task ended meanwhile: re-evaluate next tick, and
+            # drop the report nothing will ever reference (sustained
+            # pressure with fast task turnover would otherwise churn
+            # orphan files every 0.25s check).
+            if report_path is not None:
+                discard = getattr(self.agent, "discard_oom_report", None)
+                if discard is not None:
+                    try:
+                        discard(report_path)
+                    except Exception:
+                        pass
+            return False
         self.kills += 1
+        # Control-plane visibility: structured head event (drain-event
+        # shape) + ray_tpu_oom_kills_total, only for kills that landed.
+        recorder = getattr(self.agent, "record_oom_kill", None)
+        if recorder is not None:
+            try:
+                recorder(cause, victim, expected_task, report_path)
+            except Exception:
+                pass
         # Give the kill time to actually release memory before the next
         # check re-fires (the reap loop runs async).
         time.sleep(0.2)

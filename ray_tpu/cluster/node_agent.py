@@ -178,6 +178,16 @@ class NodeAgent:
         # scheduler tick reads as ~1000%%) and fight over the gauge set.
         self._telemetry_lock = threading.Lock()
         self._last_sample = 0.0
+        # OOM forensics: bounded index of pre-kill memory reports the
+        # monitor wrote under log_dir (ray-tpu memory --node surfaces
+        # them; the victim's death cause carries the path).
+        self._oom_reports: list[dict] = []
+        # Object-store gauge bookkeeping: evictions is cumulative in the
+        # native stats — exported as a counter by delta. spill_denied is
+        # agent-side cumulative (surfaced in store stats for the bench).
+        self._evictions_exported = 0
+        self._store_gauges_exported = False
+        self._spill_denied = 0
         # Resource-view gossip (reference: ray_syncer.h:88 — nodes share
         # resource views so scheduling needn't centralize). Membership
         # (who exists / who died) still comes from the head, the GCS's
@@ -225,6 +235,12 @@ class NodeAgent:
             limit_bytes=memory_limit_bytes,
         )
         self.memory_monitor.start()
+        # Object-store occupancy gauges exist from boot (the telemetry
+        # loop keeps them fresh; scrapes refresh them too).
+        try:
+            self._export_store_gauges()
+        except Exception:
+            pass
         # Prestart plain-env workers up to the node's CPU count (reference:
         # worker_pool.cc PrestartWorkers) so a first burst that spills onto
         # this node doesn't serialize behind interpreter cold starts.
@@ -1065,6 +1081,126 @@ class NodeAgent:
             w.proc.kill()
         return True
 
+    def write_oom_report(self, reason: str, victim: _Worker,
+                         current_task=None):
+        """OOM forensics: snapshot WHY the node is out of memory —
+        per-worker RSS, shm store occupancy, and the top resident
+        objects by owner/callsite — to a bounded JSON report under the
+        agent's log dir BEFORE the kill destroys the evidence. Returns
+        the report path (None when log capture is disabled); the
+        victim's death cause carries it so a post-mortem
+        ``ray-tpu memory --node <id>`` / ``state.get_log`` explains the
+        kill instead of just reporting it."""
+        if self.log_dir is None:
+            return None
+        import json as _json
+
+        from ray_tpu.cluster.memory_monitor import system_memory
+
+        used, total = system_memory()
+        try:
+            workers = self.rpc_worker_stats(fresh=True)
+        except Exception:
+            workers = []
+        top_objects = []
+        store_stats = {}
+        try:
+            # Bounded scan: the node is OUT OF MEMORY right now — a
+            # capped join (may miss objects on a huge directory) beats
+            # deferring the kill while RSS keeps climbing.
+            rep = self.rpc_object_store_stats(max_objects=256)
+            store_stats = rep.get("stats", {})
+            top_objects = (rep.get("objects") or [])[:20]
+        except Exception:
+            pass
+        spec = (current_task or {}).get("spec") or {}
+        ts = time.time()
+        report = {
+            "ts": round(ts, 3),
+            "node_id": self.node_id,
+            "reason": reason,
+            "victim": {
+                "worker_id": victim.worker_id,
+                "pid": victim.proc.pid,
+                "is_actor": victim.is_actor,
+                "actor_id": victim.actor_id,
+                "task": spec.get("fname") or spec.get("method")
+                or spec.get("class_name"),
+                "task_id": spec.get("task_id"),
+            },
+            "system_memory": {"used_bytes": used, "total_bytes": total},
+            "workers": [
+                {"worker_id": s.get("worker_id"), "pid": s.get("pid"),
+                 "rss_bytes": s.get("rss_bytes"),
+                 "is_actor": s.get("is_actor")}
+                for s in workers
+            ],
+            "object_store": store_stats,
+            "top_objects": top_objects,
+        }
+        path = os.path.join(
+            self.log_dir,
+            f"oom_report_{victim.worker_id}_{int(ts * 1000)}.json")
+        try:
+            with open(path, "w") as f:
+                _json.dump(report, f, indent=1, default=str)
+        except OSError:
+            return None
+        with self._lock:
+            self._oom_reports.append({
+                "path": path, "ts": round(ts, 3), "reason": reason,
+                "worker_id": victim.worker_id,
+            })
+            # Bounded like the capture index — evicted entries take
+            # their FILES with them (sustained pressure churns victims;
+            # the index trim alone would grow log_dir without bound).
+            evicted, self._oom_reports = (
+                self._oom_reports[:-16], self._oom_reports[-16:])
+        for old in evicted:
+            try:
+                os.unlink(old["path"])
+            except OSError:
+                pass
+        return path
+
+    def discard_oom_report(self, path: str) -> None:
+        """The kill this report was written for never landed (the
+        victim's task ended meanwhile): drop the orphan — no death
+        cause references it."""
+        with self._lock:
+            self._oom_reports = [r for r in self._oom_reports
+                                 if r.get("path") != path]
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def record_oom_kill(self, cause: str, victim: _Worker,
+                        current_task=None, report_path=None):
+        """An OOM kill actually happened: bump the per-node counter
+        (visible in /metrics/cluster via federation) and emit a
+        structured NODES event in the drain-event shape, so OOM kills
+        surface on the control plane, not just in the victim's stderr."""
+        from ray_tpu.util import metrics as _metrics
+
+        try:
+            _metrics.OOM_KILLS_TOTAL.inc(tags={"node_id": self.node_id})
+        except Exception:
+            pass
+        spec = (current_task or {}).get("spec") or {}
+        try:
+            self.head.call("publish", "NODES", self.node_id, {
+                "node_id": self.node_id,
+                "state": "OOM_KILL",
+                "reason": cause,
+                "worker_id": victim.worker_id,
+                "task": spec.get("fname") or spec.get("method")
+                or spec.get("class_name"),
+                "report_path": report_path,
+            })
+        except Exception:
+            pass  # head restarting: the kill itself is not best-effort
+
     def _on_worker_failure(self, w: _Worker, cause: str,
                            requeued: bool = False):
         """Clean up a dead worker. ``requeued``: the caller already put
@@ -1612,9 +1748,14 @@ class NodeAgent:
     def rpc_metrics_text(self):
         """This agent process's full registry in Prometheus exposition
         format — the per-node input to the head's /metrics/cluster
-        federation."""
+        federation. Store occupancy is refreshed per scrape (it is one
+        cheap native call; worker /proc sampling stays on the loop)."""
         from ray_tpu.util import metrics as _metrics
 
+        try:
+            self._export_store_gauges()
+        except Exception:
+            pass
         return _metrics.prometheus_text()
 
     def rpc_has_worker(self, worker_id):
@@ -1707,6 +1848,7 @@ class NodeAgent:
             self._cpu_prev.pop(wid, None)
         self._exported_gauges = exported
         self._export_device_gauges(set(stats))
+        self._export_store_gauges_locked()
         with self._lock:
             self._worker_stats = stats
         return list(stats.values())
@@ -1910,7 +2052,10 @@ class NodeAgent:
                 oids = []
             cands = []
             for oid in oids:
-                info = self.store.info(oid)
+                try:
+                    info = self.store.info(oid)
+                except RuntimeError:
+                    return 0  # segment unlinked under us: nothing to spill
                 if info is not None and info["refcount"] == 0:
                     cands.append(
                         (info["lru_tick"], oid,
@@ -1944,6 +2089,18 @@ class NodeAgent:
                         os.unlink(path)
                     except OSError:
                         pass
+            if freed < bytes_needed:
+                # Pressure signal: the store could not make the room a
+                # put asked for (everything left is referenced/pinned) —
+                # the put will raise StoreFullError after its retries.
+                from ray_tpu.util import metrics as _metrics
+
+                self._spill_denied += 1
+                try:
+                    _metrics.OBJECT_SPILL_DENIED.inc(
+                        tags={"node_id": self.node_id})
+                except Exception:
+                    pass
             return freed
 
     def rpc_free_object(self, oid):
@@ -1988,7 +2145,102 @@ class NodeAgent:
         except OSError:
             stats["spilled_objects"] = 0
             stats["spilled_bytes"] = 0
+        stats["spill_denied"] = self._spill_denied
         return stats
+
+    def _object_attr(self, oid: str) -> dict:
+        """The put-time attribution embedded in a sealed object's store
+        meta ({} when absent — pre-attribution writers, error markers)."""
+        from ray_tpu.core import serialization as ser
+
+        got = self.store.get(oid)
+        if got is None:
+            return {}
+        _data, meta = got
+        try:
+            return ser.meta_field(meta[1:], "attr") or {}
+        except Exception:
+            return {}
+        finally:
+            self.store.release(oid)
+
+    def rpc_object_store_stats(self, oids=None,
+                               include_objects: bool = True,
+                               max_objects: int | None = None):
+        """Memory-observability report for this node: shm ``stats()``
+        joined with per-key ``info()`` (size/refcount/pinned) and the
+        attribution riding each entry's meta, plus the OOM-report index.
+        ``oids`` is normally the head's directory slice for this node
+        (the store keys are digests, so the oid list comes from the
+        directory); None = ask the head ourselves. ``max_objects``
+        bounds the per-key scan for latency-sensitive callers (the
+        pre-kill OOM snapshot) — a capped scan may miss objects."""
+        with self._lock:
+            reports = [dict(r) for r in self._oom_reports]
+        report = {"node_id": self.node_id, "ts": time.time(),
+                  "stats": self.rpc_store_stats(),
+                  "oom_reports": reports}
+        if not include_objects:
+            return report
+        if oids is None:
+            try:
+                oids = self.head.call("objects_on_node", self.node_id,
+                                      timeout=5.0)
+            except Exception:
+                oids = []
+        objs = []
+        now = time.time()
+        if max_objects is not None:
+            oids = list(oids)[:max_objects]
+        for oid in oids:
+            try:
+                info = self.store.info(oid)
+            except RuntimeError:
+                break  # segment unlinked under us: stats-only report
+            if info is None:
+                continue  # freed/spilled since the directory snapshot
+            attr = self._object_attr(oid)
+            created = attr.get("created_at")
+            objs.append({
+                "object_id": oid,
+                "size": info["data_size"] + info["meta_size"],
+                "refcount": info["refcount"],
+                "pinned": info["pinned"],
+                "sealed": True,
+                "owner": attr.get("owner", ""),
+                "task": attr.get("task", ""),
+                "callsite": attr.get("callsite", ""),
+                "age_s": round(now - created, 3) if created else None,
+            })
+        objs.sort(key=lambda r: r["size"], reverse=True)
+        report["objects"] = objs
+        return report
+
+    def _export_store_gauges(self):
+        with self._telemetry_lock:
+            self._export_store_gauges_locked()
+
+    def _export_store_gauges_locked(self):
+        """Refresh the per-node object-store gauge family (used/capacity/
+        objects + the eviction counter by delta). Same lifecycle as the
+        worker gauges: the stop path retracts the node's series."""
+        from ray_tpu.util import metrics as _metrics
+
+        if self._shutdown.is_set():
+            return  # stopping: never re-export retracted series
+        try:
+            st = self.rpc_store_stats()
+        except RuntimeError:
+            return  # segment unlinked under us
+        tags = {"node_id": self.node_id}
+        _metrics.OBJECT_STORE_BYTES_USED.set(st["used"], tags=tags)
+        _metrics.OBJECT_STORE_BYTES_CAPACITY.set(st["capacity"], tags=tags)
+        _metrics.OBJECT_STORE_OBJECTS.set(st["num_objects"], tags=tags)
+        delta = st["num_evictions"] - self._evictions_exported
+        if delta > 0:
+            _metrics.OBJECT_STORE_EVICTIONS.inc(delta, tags=tags)
+        self._evictions_exported = st["num_evictions"]
+        self._store_gauges_exported = True
 
     # -- lifecycle --------------------------------------------------------
 
@@ -2176,6 +2428,18 @@ class NodeAgent:
                 self._exported_device = set()
                 _metrics.DEVICE_COUNT.remove(
                     tags={"node_id": self.node_id})
+                # Object-store + OOM series die with the node: a dead
+                # node must not keep reporting occupancy into the
+                # federated scrape.
+                tags = {"node_id": self.node_id}
+                if self._store_gauges_exported:
+                    _metrics.OBJECT_STORE_BYTES_USED.remove(tags=tags)
+                    _metrics.OBJECT_STORE_BYTES_CAPACITY.remove(tags=tags)
+                    _metrics.OBJECT_STORE_OBJECTS.remove(tags=tags)
+                    self._store_gauges_exported = False
+                _metrics.OBJECT_STORE_EVICTIONS.remove(tags=tags)
+                _metrics.OBJECT_SPILL_DENIED.remove(tags=tags)
+                _metrics.OOM_KILLS_TOTAL.remove(tags=tags)
         except Exception:
             pass
         with self._lock:

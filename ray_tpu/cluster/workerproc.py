@@ -25,6 +25,7 @@ import time
 import traceback
 
 from ray_tpu.cluster.rpc import RpcClient, RpcServer
+from ray_tpu.core import attribution
 from ray_tpu.core import serialization as ser
 from ray_tpu.core.cancellation import CancelRegistry
 from ray_tpu.core.object_ref import (
@@ -500,19 +501,24 @@ class WorkerHandler:
             args, kwargs = ser.loads(spec["args"])
             args, kwargs = self._resolve(args, kwargs)
             clock.lap("get_args")
-            if spec.get("trace_ctx"):
-                tracing.enable()  # the driver traces: continue here
-                with tracing.span(
-                        f"run:{spec.get('fname', 'task')}",
-                        {"task_id": spec.get("task_id"),
-                         "worker_id": self.worker_id},
-                        parent=spec["trace_ctx"]):
+            # Attribution context: puts made while the task runs (its
+            # returns AND nested ray_tpu.put calls in user code) carry
+            # the creating task's name.
+            with attribution.task_context(spec.get("fname", "task"),
+                                          spec.get("callsite")):
+                if spec.get("trace_ctx"):
+                    tracing.enable()  # the driver traces: continue here
+                    with tracing.span(
+                            f"run:{spec.get('fname', 'task')}",
+                            {"task_id": spec.get("task_id"),
+                             "worker_id": self.worker_id},
+                            parent=spec["trace_ctx"]):
+                        result = func(*args, **kwargs)
+                else:
                     result = func(*args, **kwargs)
-            else:
-                result = func(*args, **kwargs)
-            clock.lap("execute")
-            self._store_result(spec, result)
-            clock.lap("put_outputs")
+                clock.lap("execute")
+                self._store_result(spec, result)
+                clock.lap("put_outputs")
         except BaseException as e:  # noqa: BLE001 — stored, not dropped
             err = repr(e)
             if isinstance(e, (TaskError, ActorError)):
@@ -546,7 +552,10 @@ class WorkerHandler:
             args, kwargs = ser.loads(spec["args"])
             args, kwargs = self._resolve(args, kwargs)
             clock.lap("get_args")
-            self._actor_instance = cls(*args, **kwargs)
+            with attribution.task_context(
+                    spec.get("fname", "actor.__init__"),
+                    spec.get("callsite")):
+                self._actor_instance = cls(*args, **kwargs)
             clock.lap("execute")
         except BaseException as e:  # noqa: BLE001
             err = repr(e)
@@ -626,8 +635,19 @@ class WorkerHandler:
                     return method(*args, **kwargs)
 
                 coro = coro_wrapper()
+
+            # Attribution rides the asyncio Task's context (contextvar):
+            # nested ray_tpu.put calls inside the method attribute to it
+            # like every sync path, without leaking to interleaved
+            # coroutines at await points.
+            async def attributed(inner=coro):
+                with attribution.task_context(
+                        spec.get("method", "actor_task"),
+                        spec.get("callsite")):
+                    return await inner
+
             fut = asyncio.run_coroutine_threadsafe(
-                coro, self._ensure_aio_loop())
+                attributed(), self._ensure_aio_loop())
             if task_id:
                 with self._ev_lock:
                     self._async_futs[task_id] = fut
@@ -664,7 +684,10 @@ class WorkerHandler:
             clock.lap("execute")
             err = None
             try:
-                self._store_result(spec, f.result())
+                with attribution.task_context(
+                        spec.get("method", "actor_task"),
+                        spec.get("callsite")):
+                    self._store_result(spec, f.result())
                 clock.lap("put_outputs")
             except BaseException as e:  # noqa: BLE001
                 err = repr(e)
@@ -719,10 +742,13 @@ class WorkerHandler:
             args, kwargs = self._resolve(args, kwargs)
             clock.lap("get_args")
             method = getattr(self._actor_instance, spec["method"])
-            result = method(*args, **kwargs)
-            clock.lap("execute")
-            self._store_result(spec, result)
-            clock.lap("put_outputs")
+            with attribution.task_context(
+                    spec.get("method", "actor_task"),
+                    spec.get("callsite")):
+                result = method(*args, **kwargs)
+                clock.lap("execute")
+                self._store_result(spec, result)
+                clock.lap("put_outputs")
         except BaseException as e:  # noqa: BLE001
             err = repr(e)
             if isinstance(e, (TaskError, ActorError)):

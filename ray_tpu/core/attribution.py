@@ -1,0 +1,113 @@
+"""Object attribution: who created an object, from which task, where.
+
+The put-time half of the memory observability plane (reference: Ray's
+``ray memory`` owner/callsite columns, fed by the CoreWorker stamping
+each object's owner and call site when ``RAY_record_ref_creation_sites``
+is set). Every ``put``/task-return records cheap always-on fields — the
+owning process id, the creating task's name, and the creation wall time
+— plus, when ``RAY_TPU_RECORD_CALLSITE`` is on, a trimmed user-code
+callsite. The callsite stack walk costs tens of microseconds, so hot
+put paths keep it opt-in; everything else is dict assembly.
+
+The attribution dict rides the object's store-entry metadata (an extra
+key in the serialization meta — msgpack consumers ignore unknown keys),
+the owner's location table, and the head's object directory, so
+``ray-tpu memory`` can group live store bytes by task/callsite and the
+leak sweeper can say *what* leaked, not just that bytes are stuck.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import time
+import traceback
+
+# Frames under the package root are framework plumbing, and stdlib
+# frames (threading bootstrap, executor loops) are scaffolding — neither
+# is the creation site the user wants to see.
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_STDLIB_DIR = os.path.dirname(os.path.abspath(threading.__file__))
+
+# ContextVar, not a thread-local: per-thread for the sync executor paths
+# AND per-asyncio-task for async actor methods (each Task steps in its
+# own context copy, so interleaved coroutines can't see each other's
+# task name the way a loop-thread-local would leak at await points).
+_ctx: "contextvars.ContextVar[tuple | None]" = contextvars.ContextVar(
+    "ray_tpu_attribution", default=None)
+
+
+@contextlib.contextmanager
+def task_context(name: str, site: str | None = None):
+    """Mark this thread as executing task ``name``: puts (explicit or
+    task-return) attribute to it. ``site`` is the task's SUBMIT-time
+    callsite (captured where ``.remote()`` ran): by the time a return
+    value is stored the user frames are off the stack, so the submit
+    site is the fallback creation site. Nests; restores the previous."""
+    token = _ctx.set((name, site))
+    try:
+        yield
+    finally:
+        _ctx.reset(token)
+
+
+def current_task() -> str | None:
+    """Name of the task executing in this context, if any."""
+    cur = _ctx.get()
+    return cur[0] if cur else None
+
+
+def current_site() -> str | None:
+    """The running task's submit-time callsite, if one was recorded."""
+    cur = _ctx.get()
+    return cur[1] if cur else None
+
+
+def callsite(limit: int = 3) -> str:
+    """Trimmed creation callsite: the innermost ``limit`` user-code
+    frames (framework/importlib frames skipped), innermost first, as
+    ``file.py:LINE in func`` joined by " < "."""
+    out = []
+    for fr in reversed(traceback.extract_stack()):
+        fname = fr.filename or ""
+        if fname.startswith(_PKG_DIR) or fname.startswith(_STDLIB_DIR) \
+                or "importlib" in fname or fname.startswith("<"):
+            continue
+        out.append(f"{os.path.basename(fname)}:{fr.lineno} in {fr.name}")
+        if len(out) >= limit:
+            break
+    return " < ".join(out)
+
+
+def make(owner: str, default_task: str = "driver") -> dict:
+    """Attribution record for an object created right now by ``owner``
+    (a client/worker process id). ``task`` is the task running on this
+    thread, or ``default_task`` outside any task."""
+    from ray_tpu.core.config import config
+
+    attr = {
+        "owner": owner,
+        "task": current_task() or default_task,
+        "created_at": round(time.time(), 3),
+    }
+    if config.record_callsite:
+        # Prefer the live stack (a ray_tpu.put in user code points at
+        # that line); fall back to the running task's submit site for
+        # task returns, whose user frames already unwound.
+        site = callsite() or current_site()
+        if site:
+            attr["callsite"] = site
+    return attr
+
+
+def submit_site() -> str | None:
+    """Callsite of a task submission, recorded onto the spec so the
+    executing worker can attribute the task's return objects to the
+    ``.remote()`` line (None when callsite recording is off)."""
+    from ray_tpu.core.config import config
+
+    if not config.record_callsite:
+        return None
+    return callsite() or None

@@ -81,6 +81,18 @@ _DEFS: dict[str, tuple[type, Any]] = {
     "memory_usage_threshold": (float, 0.95),
     "memory_limit_bytes": (int, 0),  # 0 = no aggregate-RSS limit
     "memory_monitor_interval_s": (float, 0.25),
+    # -- memory observability ----------------------------------------------
+    # Record a trimmed user-code callsite on every put/task-return object
+    # (``ray memory`` callsite column analog). Off by default: the stack
+    # walk is measurable on hot put paths; the cheap fields — owner
+    # worker id, creating task name, creation time — are always on.
+    "record_callsite": (bool, False),
+    # Head-side leak sweeper: an object alive longer than the threshold
+    # with zero registered holders (or held refs whose every replica is
+    # gone) is flagged in ``state.memory_leaks()`` / ``ray-tpu memory
+    # --leaks``. 0 disables the sweeper.
+    "leak_age_threshold_s": (float, 300.0),
+    "leak_sweep_interval_s": (float, 5.0),
     # -- tasks -------------------------------------------------------------
     "task_default_max_retries": (int, 3),
     "pending_task_timeout_s": (float, 120.0),

@@ -72,15 +72,35 @@ class _DaemonPool:
                 traceback.print_exc()
 
 
+def _approx_size(value) -> int:
+    """Cheap size estimate for the state API's size ordering: exact for
+    buffer-bearing values (nbytes), shallow ``getsizeof`` otherwise —
+    the local backend never serializes, so this is the analog of the
+    cluster store's data_size."""
+    nbytes = getattr(value, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes
+    import sys as _sys
+
+    try:
+        return _sys.getsizeof(value)
+    except Exception:
+        return 0
+
+
 class _Entry:
     """Object-table slot: either a concrete value or a pending event."""
 
-    __slots__ = ("event", "value", "error")
+    __slots__ = ("event", "value", "error", "attr", "size")
 
     def __init__(self):
         self.event = threading.Event()
         self.value = None
         self.error: BaseException | None = None
+        # Put-time attribution (owner/task/callsite/created_at) + size
+        # estimate, for state.list_objects / memory_summary.
+        self.attr: dict | None = None
+        self.size = 0
 
     def set(self, value):
         self.value = value
@@ -312,9 +332,14 @@ class LocalBackend:
             return e
 
     def put(self, value: Any) -> ObjectRef:
+        from ray_tpu.core import attribution
+
         oid = ids.new_object_id()
         ref = self.make_ref(oid)
-        self._entry(oid).set(value)
+        e = self._entry(oid)
+        e.attr = attribution.make("local")
+        e.size = _approx_size(value)
+        e.set(value)
         return ref
 
     def get(self, refs: Sequence[ObjectRef], timeout: float | None = None):
@@ -637,16 +662,91 @@ class LocalBackend:
             })
         return out
 
-    def list_objects(self, limit: int = 1000) -> list[dict]:
+    def list_objects(self, limit: int = 1000) -> dict:
+        """{"objects": [...], "truncated": bool, "total": int} sorted by
+        size descending — the limit clips AFTER the sort, so `limit=N`
+        means the N largest objects, never N arbitrary insertion-order
+        ones, and clipping is reported instead of silent."""
+        import time as _time
+
+        now = _time.time()
         with self._objects_lock:
             out = []
-            for oid, entry in list(self._objects.items())[:limit]:
+            for oid, entry in self._objects.items():
+                attr = entry.attr or {}
+                created = attr.get("created_at")
                 out.append({
                     "object_id": oid,
                     "status": "READY" if entry.event.is_set() else "PENDING",
                     "refcount": self._refcounts.get(oid, 0),
+                    "size": entry.size,
+                    "owner": attr.get("owner", ""),
+                    "task": attr.get("task", ""),
+                    "callsite": attr.get("callsite", ""),
+                    "nodes": ["local"],
+                    "age_s": round(now - created, 3) if created else None,
                 })
-            return out
+        out.sort(key=lambda r: r["size"], reverse=True)
+        total = len(out)
+        return {"objects": out[:limit], "truncated": total > limit,
+                "total": total}
+
+    def memory_summary(self, top_k: int = 20,
+                       group_by: str = "callsite") -> dict:
+        """Single-process analog of the cluster memory rollup: this
+        backend's object table grouped by callsite/task (sizes are the
+        local estimates — there is no shm segment to meter)."""
+        if group_by not in ("callsite", "task", "node", "owner"):
+            # Same contract as the head: a typo'd group_by must fail
+            # loud, not return everything under "(unknown)".
+            raise ValueError(
+                f"group_by must be callsite|task|node|owner, "
+                f"got {group_by!r}")
+        listing = self.list_objects(limit=1 << 20)["objects"]
+        bytes_used = sum(r["size"] for r in listing)
+        groups: dict[str, dict] = {}
+        for r in listing:
+            key = (self.node_id if group_by == "node"
+                   else r.get(group_by)) or "(unknown)"
+            g = groups.setdefault(key, {"key": key, "bytes": 0,
+                                        "objects": 0})
+            g["bytes"] += r["size"]
+            g["objects"] += 1
+        node = {"bytes_used": bytes_used, "bytes_capacity": 0,
+                "occupancy": 0.0, "objects": len(listing), "evictions": 0,
+                "spilled_bytes": 0, "oom_reports": []}
+        return {
+            "totals": {"bytes_used": bytes_used, "bytes_capacity": 0,
+                       "objects": len(listing), "evictions": 0,
+                       "spilled_bytes": 0, "spilled_objects": 0,
+                       "nodes": 1},
+            "nodes": {self.node_id: node},
+            "top_objects": listing[:top_k],
+            "group_by": group_by,
+            "groups": sorted(groups.values(),
+                             key=lambda g: g["bytes"], reverse=True),
+            "leaks": 0,
+        }
+
+    def memory_leaks(self) -> list[dict]:
+        """Local mode frees on the last decref — there is no unreachable-
+        but-pinned state to leak-sweep."""
+        return []
+
+    def object_store_stats(self, node_id=None,
+                           include_objects: bool = True) -> list[dict]:
+        listing = self.list_objects(limit=1 << 20)["objects"]
+        report = {
+            "node_id": self.node_id,
+            "stats": {"capacity": 0,
+                      "used": sum(r["size"] for r in listing),
+                      "num_objects": len(listing), "num_evictions": 0,
+                      "spilled_objects": 0, "spilled_bytes": 0},
+            "oom_reports": [],
+        }
+        if include_objects:
+            report["objects"] = listing
+        return [report]
 
     # -- node reporter surface (logs / stacks / telemetry) -----------------
     # Local mode runs everything in THIS process: profiling/stack dumps
@@ -759,6 +859,16 @@ class LocalBackend:
         }
         return args, kwargs
 
+    def _set_result(self, oid: str, value) -> None:
+        """Store one task-return value with put-time attribution (the
+        creating task's name comes from the ambient task_context)."""
+        from ray_tpu.core import attribution
+
+        e = self._entry(oid)
+        e.attr = attribution.make("local", default_task="task")
+        e.size = _approx_size(value)
+        e.set(value)
+
     def _store_returns(self, oids: list[str], result, num_returns):
         if num_returns == "streaming":
             # Generator protocol (see workerproc._store_result): items at
@@ -773,7 +883,7 @@ class LocalBackend:
             i = 0
             try:
                 for item in result:
-                    self._entry(ids.object_id_for(task_id, i)).set(item)
+                    self._set_result(ids.object_id_for(task_id, i), item)
                     i += 1
                 self._entry(
                     ids.object_id_for(task_id, i)).set(_StreamEnd())
@@ -787,7 +897,7 @@ class LocalBackend:
             self._gc_unreferenced(oids)
             return True
         if num_returns == 1:
-            self._entry(oids[0]).set(result)
+            self._set_result(oids[0], result)
         else:
             vals = list(result)
             if len(vals) != num_returns:
@@ -796,7 +906,7 @@ class LocalBackend:
                     f"{len(vals)} values"
                 )
             for oid, v in zip(oids, vals):
-                self._entry(oid).set(v)
+                self._set_result(oid, v)
         self._gc_unreferenced(oids)
 
     def release_stream(self, task_id: str, from_index: int) -> None:
@@ -849,15 +959,33 @@ class LocalBackend:
             self._store_error(oids, e)
             return refs
         pins = self._pin_ref_args(args, kwargs)
+        from ray_tpu.core import attribution
+
+        # Submit-time callsite: by store time the user frames are gone,
+        # so the .remote() line is the return objects' creation site.
+        submit_site = attribution.submit_site()
 
         def run():
-            attempts = 0
+            from ray_tpu.core import attribution
+
             try:
                 if not self._cancels.begin(task_id, threading.get_ident()):
                     self._record_task_state(task_id, "CANCELLED")
                     self._store_error(oids, TaskCancelledError(fname))
                     return
-                while True:
+                # Attribution context: the task's returns and any nested
+                # puts its user code makes attribute to this task name.
+                with attribution.task_context(fname, submit_site):
+                    run_attempts()
+            finally:
+                try:
+                    self._cancels.end(task_id, threading.get_ident())
+                finally:
+                    self._unpin(pins)
+
+        def run_attempts():
+            attempts = 0
+            while True:
                     try:
                         # Stamp start BEFORE arg resolution (cluster
                         # workers stamp at executor pickup, also
@@ -934,11 +1062,6 @@ class LocalBackend:
                                 TaskError(fname, traceback.format_exc(), repr(e)),
                             )
                         return
-            finally:
-                try:
-                    self._cancels.end(task_id, threading.get_ident())
-                finally:
-                    self._unpin(pins)
 
         self._pool.submit(run)
         return refs
@@ -1005,12 +1128,13 @@ class LocalBackend:
                 item = state.queue.get()
                 if item is _POISON:
                     return
-                oids, method_name, m_args, m_kwargs, num_returns, pins = item
+                (oids, method_name, m_args, m_kwargs, num_returns, site,
+                 pins) = item
                 call_tid = ids.task_of_object(oids[0])[0]
                 try:
                     self._run_actor_item(
                         state, cls, actor_id, oids, method_name, m_args,
-                        m_kwargs, num_returns, pins, call_tid)
+                        m_kwargs, num_returns, pins, call_tid, site)
                 except BaseException:  # noqa: BLE001
                     # A cancel injection delivered after the item's own
                     # handlers (e.g. inside a finally) must not kill this
@@ -1024,7 +1148,8 @@ class LocalBackend:
         return actor_id
 
     def _run_actor_item(self, state, cls, actor_id, oids, method_name,
-                        m_args, m_kwargs, num_returns, pins, call_tid):
+                        m_args, m_kwargs, num_returns, pins, call_tid,
+                        site=None):
         """Execute one dequeued actor call (body of the actor's executor
         loop, factored out so worker_loop can shield its thread from a
         late-delivered cancel injection)."""
@@ -1052,21 +1177,32 @@ class LocalBackend:
                 method = getattr(state.instance, method_name)
                 self._record_task_state(call_tid, "RUNNING")
                 t_phase = time.monotonic_ns()
-                result = method(*a, **kw)
-                import asyncio
+                from ray_tpu.core import attribution
 
-                if asyncio.iscoroutine(result):
-                    # Async actor method: run on the backend's shared event
-                    # loop so concurrent async calls interleave at await
-                    # points (reference async actors; the executor thread
-                    # blocks, so per-actor parallelism is still bounded by
-                    # max_concurrency — set it >1 for interleaving).
-                    result = asyncio.run_coroutine_threadsafe(
-                        result, self._aio_loop()).result()
-                self._record_task_phase(
-                    call_tid, "execute", time.monotonic_ns() - t_phase)
-                t_phase = time.monotonic_ns()
-                self._store_returns(oids, result, num_returns)
+                with attribution.task_context(method_name, site):
+                    result = method(*a, **kw)
+                    import asyncio
+
+                    if asyncio.iscoroutine(result):
+                        # Async actor method: run on the backend's shared
+                        # event loop so concurrent async calls interleave
+                        # at await points (reference async actors; the
+                        # executor thread blocks, so per-actor parallelism
+                        # is still bounded by max_concurrency — set it >1
+                        # for interleaving). Attribution rides the
+                        # asyncio Task's own context: the executor
+                        # thread's contextvar doesn't reach the loop.
+                        async def attributed(inner=result):
+                            with attribution.task_context(
+                                    method_name, site):
+                                return await inner
+
+                        result = asyncio.run_coroutine_threadsafe(
+                            attributed(), self._aio_loop()).result()
+                    self._record_task_phase(
+                        call_tid, "execute", time.monotonic_ns() - t_phase)
+                    t_phase = time.monotonic_ns()
+                    self._store_returns(oids, result, num_returns)
                 self._record_task_phase(
                     call_tid, "put_outputs", time.monotonic_ns() - t_phase)
                 self._record_task_state(call_tid, "FINISHED")
@@ -1119,8 +1255,11 @@ class LocalBackend:
             self._record_task_state(task_id, "FAILED", "no-such-group")
             return refs
 
+        from ray_tpu.core import attribution
+
         pins = self._pin_ref_args(args, kwargs)
-        item = (oids, method_name, args, kwargs, num_returns, pins)
+        item = (oids, method_name, args, kwargs, num_returns,
+                attribution.submit_site(), pins)
         caller = threading.get_ident()
 
         # Unresolved ObjectRef args are resolved OFF the actor's execution

@@ -27,11 +27,15 @@ def _aligned(n: int) -> int:
 
 
 def serialize(
-    value: Any, found_refs: list | None = None
+    value: Any, found_refs: list | None = None,
+    extra_meta: dict | None = None,
 ) -> tuple[bytes, list[bytes | memoryview]]:
     """Returns (meta, chunks). Concatenating chunks gives the data payload.
     ``found_refs``: optional list that receives the ids of any ObjectRefs
-    nested in ``value`` (feeds distributed ref-counting)."""
+    nested in ``value`` (feeds distributed ref-counting).
+    ``extra_meta``: extra keys packed into the meta document (e.g. the
+    put-time attribution record) — ``deserialize`` only reads "sizes",
+    so consumers that don't know a key ignore it."""
     from ray_tpu.core.object_ref import capture_refs
 
     buffers: list[pickle.PickleBuffer] = []
@@ -50,7 +54,10 @@ def serialize(
             offset += pad
         chunks.append(part)
         offset += len(part)
-    meta = msgpack.packb({"sizes": sizes})
+    doc = {"sizes": sizes}
+    if extra_meta:
+        doc.update(extra_meta)
+    meta = msgpack.packb(doc)
     return meta, chunks
 
 
@@ -78,6 +85,16 @@ def deserialize(meta: bytes, data) -> Any:
 def num_buffers(meta: bytes) -> int:
     """Out-of-band buffer count recorded in a serialized object's meta."""
     return len(msgpack.unpackb(meta)["sizes"]) - 1
+
+
+def meta_field(meta: bytes, key: str, default=None):
+    """One extra key out of a serialized object's meta document (e.g.
+    ``attr`` — the put-time attribution record); ``default`` on absent
+    keys or undecodable meta (error markers from pre-attribution code)."""
+    try:
+        return msgpack.unpackb(meta).get(key, default)
+    except Exception:
+        return default
 
 
 def dumps(value: Any, found_refs: list | None = None) -> bytes:

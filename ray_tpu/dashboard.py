@@ -164,7 +164,21 @@ class Dashboard:
             return ok_json({"tasks": self.head.call("list_tasks", limit)})
         if route == "/api/objects":
             limit = int(qs.get("limit", 1000))
-            return ok_json({"objects": self.head.call("list_objects", limit)})
+            # The head returns {"objects", "truncated", "total"} — pass
+            # the clipping report through rather than hiding it.
+            got = self.head.call("list_objects", limit)
+            if not isinstance(got, dict):
+                got = {"objects": got, "truncated": False,
+                       "total": len(got)}
+            return ok_json(got)
+        if route == "/api/memory_summary":
+            top = int(qs.get("top", 20))
+            group_by = qs.get("group_by", "callsite")
+            return ok_json(self.head.call(
+                "memory_summary", top, group_by, timeout=30.0))
+        if route == "/api/memory_leaks":
+            return ok_json({"leaks": self.head.call(
+                "memory_leaks", timeout=15.0)})
         if route == "/api/logs":
             after = int(qs.get("after_seq", 0))
             limit = int(qs.get("limit", 1000))
@@ -415,7 +429,8 @@ class Dashboard:
             for k, v in s.items()
         )
         api = ["/api/cluster_status", "/api/nodes", "/api/actors",
-               "/api/tasks", "/api/objects", "/api/logs",
+               "/api/tasks", "/api/objects", "/api/memory_summary",
+               "/api/memory_leaks", "/api/logs",
                "/api/worker_logs", "/api/worker_stats",
                "/api/device_stats", "/api/cluster_metrics",
                "/api/placement_groups", "/api/pubsub_stats"]

@@ -71,7 +71,8 @@ INDEX_HTML = r"""<!doctype html>
 <script>
 "use strict";
 const TABS = ["cluster", "nodes", "workers", "devices", "actors", "tasks",
-              "objects", "placement_groups", "jobs", "serve", "logs"];
+              "objects", "memory", "placement_groups", "jobs", "serve",
+              "logs"];
 let active = location.hash.slice(1) || "cluster";
 let logCursor = 0;
 const logBuf = [];
@@ -338,16 +339,110 @@ const RENDER = {
   },
   async objects() {
     const d = await api("/api/objects?limit=500");
+    setTiles([
+      ["objects", d.total ?? (d.objects || []).length],
+      ...(d.truncated ? [["showing", (d.objects || []).length, "warn"]]
+                      : []),
+    ]);
     $("view").replaceChildren(table(
-      ["object_id", "size", "locations", "error"],
+      ["object_id", "size", "owner", "task", "callsite", "age s",
+       "locations", "error"],
       d.objects || [], (r, c) => {
-        const td = el("td", c === "object_id" ? "mono" : "");
+        const td = el("td",
+          (c === "object_id" || c === "callsite") ? "mono" : "");
         if (c === "locations")
           td.textContent = (r.locations || []).map(short).join(", ");
+        else if (c === "age s") td.textContent = r.age_s ?? "";
+        else if (c === "owner") td.textContent = short(r.owner || "");
         else td.textContent = c === "object_id"
           ? short(r[c] || "") : (r[c] ?? "");
         return td;
       }));
+  },
+  async memory() {
+    // Memory pane: cluster object-store rollup + per-node occupancy +
+    // top objects with put-time attribution + the leak sweeper's flags.
+    const [d, leaksD] = await Promise.all(
+      [api("/api/memory_summary?group_by=callsite"),
+       api("/api/memory_leaks")]);
+    const t = d.totals || {};
+    const mib = v => ((v || 0) / 1048576).toFixed(1);
+    const leaks = leaksD.leaks || [];
+    setTiles([
+      ["store used MiB", mib(t.bytes_used)],
+      ["capacity MiB", mib(t.bytes_capacity)],
+      ["objects", t.objects ?? 0],
+      ["evictions", t.evictions ?? 0, (t.evictions || 0) > 0 ? "warn" : ""],
+      ["spilled MiB", mib(t.spilled_bytes)],
+      ["leaks", leaks.length, leaks.length > 0 ? "bad" : "ok"],
+    ]);
+    const wrap = el("div");
+    const nodes = Object.entries(d.nodes || {}).map(([id, n]) =>
+      ({node: id, ...n}));
+    wrap.appendChild(el("h3", "", "per-node occupancy"));
+    wrap.appendChild(table(
+      ["node", "used MiB", "capacity MiB", "occupancy", "objects",
+       "evictions", "spilled MiB", "oom reports"],
+      nodes, (r, c) => {
+        if (c === "node")
+          { const td = el("td", "mono"); td.textContent = short(r.node); return td; }
+        if (c === "used MiB") return el("td", "", mib(r.bytes_used));
+        if (c === "capacity MiB") return el("td", "", mib(r.bytes_capacity));
+        if (c === "occupancy") return el("td",
+          (r.occupancy || 0) > 0.8 ? "warn" : "",
+          ((r.occupancy || 0) * 100).toFixed(0) + "%");
+        if (c === "spilled MiB") return el("td", "", mib(r.spilled_bytes));
+        if (c === "oom reports") {
+          const td = el("td", "mono");
+          td.textContent = (r.oom_reports || []).join(", ");
+          return td;
+        }
+        return el("td", "", r[c.replace(" ", "_")] ?? r[c] ?? "");
+      }));
+    if (leaks.length) {
+      wrap.appendChild(el("h3", "bad", "leaked objects"));
+      wrap.appendChild(table(
+        ["object_id", "kind", "size MiB", "age s", "task", "owner",
+         "callsite"],
+        leaks, (r, c) => {
+          const td = el("td",
+            (c === "object_id" || c === "callsite") ? "mono" : "");
+          if (c === "size MiB") td.textContent = mib(r.size);
+          else if (c === "age s") td.textContent = r.age_s ?? "";
+          else if (c === "object_id") td.textContent = short(r.object_id);
+          else if (c === "owner") td.textContent = short(r.owner || "");
+          else td.textContent = r[c] ?? "";
+          return td;
+        }));
+    }
+    wrap.appendChild(el("h3", "", "top objects by size"));
+    wrap.appendChild(table(
+      ["object_id", "size MiB", "refs", "pinned", "task", "owner",
+       "callsite", "age s", "nodes"],
+      d.top_objects || [], (r, c) => {
+        const td = el("td",
+          (c === "object_id" || c === "callsite") ? "mono" : "");
+        if (c === "size MiB") td.textContent = mib(r.size);
+        else if (c === "refs")
+          td.textContent = r.refcount ?? r.ref_holders ?? "";
+        else if (c === "pinned") td.textContent = r.pinned ? "yes" : "";
+        else if (c === "age s") td.textContent = r.age_s ?? "";
+        else if (c === "object_id") td.textContent = short(r.object_id);
+        else if (c === "owner") td.textContent = short(r.owner || "");
+        else if (c === "nodes")
+          td.textContent = (r.nodes || []).map(short).join(", ");
+        else td.textContent = r[c] ?? "";
+        return td;
+      }));
+    wrap.appendChild(el("h3", "",
+      "bytes by " + (d.group_by || "callsite")));
+    wrap.appendChild(table(
+      ["key", "bytes MiB", "objects"],
+      d.groups || [], (r, c) => {
+        if (c === "bytes MiB") return el("td", "", mib(r.bytes));
+        return el("td", c === "key" ? "mono" : "", r[c] ?? "");
+      }));
+    $("view").replaceChildren(wrap);
   },
   async placement_groups() {
     const d = await api("/api/placement_groups");

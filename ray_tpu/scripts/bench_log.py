@@ -95,6 +95,37 @@ def record_task_overhead(task_records: list, *, device: str = "",
     return entry
 
 
+def record_memory_pressure(samples: list, *, device: str = "",
+                           path: str | None = None, **extra) -> dict:
+    """Object-store pressure evidence (``scripts/memory_bench.py``):
+    peak/mean occupancy, evictions, and spill denials over a churn
+    workload, computed from per-round ``stats()`` samples (dicts with
+    used/capacity/num_evictions[/spill_denied]). Committed to the
+    evidence trail only on an accelerator; returns the entry (with
+    ``committed_to``) either way."""
+    entry: dict = {"bench": "memory_pressure", "device": device,
+                   "n_samples": len(samples)}
+    if samples:
+        used = [int(s.get("used", 0)) for s in samples]
+        capacity = max(int(s.get("capacity", 0)) for s in samples)
+        evictions = [int(s.get("num_evictions", 0)) for s in samples]
+        denied = [int(s.get("spill_denied", 0)) for s in samples]
+        entry["capacity_bytes"] = capacity
+        entry["peak_used_bytes"] = max(used)
+        entry["mean_used_bytes"] = round(sum(used) / len(used))
+        if capacity:
+            entry["peak_occupancy"] = round(max(used) / capacity, 4)
+        entry["evictions"] = max(evictions) - min(evictions)
+        if any("spill_denied" in s for s in samples):
+            # Only samples that actually carry the stat (agent store
+            # stats do; ad-hoc sample dicts may not) — a fabricated 0
+            # would misreport a pressure run as denial-free.
+            entry["spill_denied"] = max(denied) - min(denied)
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
 def record_drain_recovery(proactive_drain_ms: float,
                           crash_detection_ms: float, *,
                           device: str = "", path: str | None = None,

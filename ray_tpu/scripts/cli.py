@@ -107,7 +107,12 @@ def cmd_list(args):
         "actors": state.list_actors,
         "objects": state.list_objects,
     }[kind]()
-    print(json.dumps(rows, indent=2, default=str))
+    if getattr(rows, "truncated", False):
+        # No silent caps: a clipped object listing says so.
+        print(json.dumps({"truncated": True, "total": rows.total,
+                          "objects": list(rows)}, indent=2, default=str))
+        return
+    print(json.dumps(list(rows), indent=2, default=str))
 
 
 def cmd_summary(args):
@@ -128,17 +133,85 @@ def cmd_timeline(args):
     print(f"wrote chrome trace to {out}")
 
 
-def cmd_memory(args):
-    ray_tpu = _connect(args)
-    backend = ray_tpu._private.worker.backend() if hasattr(ray_tpu, "_private") else None
-    from ray_tpu._private import worker as worker_mod
+def _mib(n) -> str:
+    return f"{(n or 0) / 1048576:.1f}"
 
-    backend = worker_mod.backend()
-    if hasattr(backend, "store"):
-        print(json.dumps(backend.store.stats(), indent=2))
-    else:
-        objs = backend.list_objects() if hasattr(backend, "list_objects") else []
-        print(json.dumps({"num_objects": len(objs)}, indent=2))
+
+def cmd_memory(args):
+    """Object & memory observability (``ray memory`` analog): cluster
+    totals + per-node shm occupancy + top objects with owner/task/
+    callsite attribution; ``--group-by`` aggregates live bytes by
+    creation site, ``--leaks`` prints the head sweeper's flags,
+    ``--stats-only`` the raw per-node store stats."""
+    from ray_tpu import state
+
+    _connect(args)
+    if args.stats_only:
+        reports = state.object_store_stats(node_id=args.node,
+                                           include_objects=False)
+        print(json.dumps(reports, indent=2, default=str))
+        return
+    if args.leaks:
+        leaks = state.memory_leaks()
+        if not leaks:
+            print("no leaked objects flagged")
+            return
+        print(f"{len(leaks)} leaked object(s) "
+              f"(alive past the age threshold, unreachable):")
+        for r in leaks:
+            print(f"  {r['object_id'][:20]}…  {_mib(r.get('size'))} MiB  "
+                  f"{r.get('kind')}  age {r.get('age_s')}s  "
+                  f"task={r.get('task') or '?'}  "
+                  f"owner={r.get('owner') or '?'}")
+            if r.get("callsite"):
+                print(f"    created at: {r['callsite']}")
+        return
+    summary = state.memory_summary(top_k=args.top,
+                                   group_by=args.group_by or "callsite")
+    t = summary["totals"]
+    print(f"object store: {_mib(t['bytes_used'])}/"
+          f"{_mib(t['bytes_capacity'])} MiB used across "
+          f"{t['nodes']} node(s), {t['objects']} object(s), "
+          f"{t['evictions']} eviction(s), "
+          f"{_mib(t['spilled_bytes'])} MiB spilled, "
+          f"{summary.get('leaks', 0)} leak(s)")
+    for nid, n in sorted(summary["nodes"].items()):
+        if args.node and nid != args.node:
+            continue
+        line = (f"  node {nid[-12:]:<14} {_mib(n['bytes_used'])}/"
+                f"{_mib(n['bytes_capacity'])} MiB "
+                f"({n['occupancy'] * 100:.0f}%)  "
+                f"{n['objects']} obj  {n['evictions']} evict  "
+                f"{_mib(n['spilled_bytes'])} MiB spilled")
+        print(line)
+        for path in n.get("oom_reports") or []:
+            print(f"    oom report: {path}")
+    top = summary.get("top_objects") or []
+    if args.node:
+        top = [r for r in top
+               if args.node in (r.get("nodes") or [])]
+    if top:
+        print("top objects by size:")
+        for r in top:
+            # Holders (processes keeping the ref alive) over the shm
+            # active-reader count: "who still references this" is the
+            # question a full store asks.
+            refs = r.get("ref_holders")
+            if refs is None:
+                refs = r.get("refcount", "?")
+            print(f"  {r['object_id'][:20]}…  {_mib(r.get('size'))} MiB  "
+                  f"refs={refs}  "
+                  f"{'pinned' if r.get('pinned') else 'unpinned':<8}  "
+                  f"task={r.get('task') or '?'}  "
+                  f"age={r.get('age_s', '?')}s")
+            if r.get("callsite"):
+                print(f"    created at: {r['callsite']}")
+    groups = summary.get("groups") or []
+    if groups:
+        print(f"by {summary.get('group_by', 'callsite')}:")
+        for g in groups:
+            print(f"  {_mib(g['bytes']):>9} MiB  {g['objects']:>5} obj  "
+                  f"{g['key']}")
 
 
 def cmd_logs(args):
@@ -380,7 +453,24 @@ def main(argv=None):
     p.add_argument("--output", "-o", default="/tmp/ray_tpu_timeline.json")
     p.set_defaults(fn=cmd_timeline)
 
-    p = sub.add_parser("memory", help="object store stats")
+    p = sub.add_parser(
+        "memory",
+        help="object & memory observability (ray memory analog): "
+             "occupancy, attribution, leaks, OOM reports")
+    p.add_argument("--group-by", choices=["callsite", "task", "node",
+                                          "owner"],
+                   default=None,
+                   help="aggregate live bytes by creation site "
+                        "(default: callsite)")
+    p.add_argument("--leaks", action="store_true",
+                   help="print objects the leak sweeper flags")
+    p.add_argument("--stats-only", action="store_true",
+                   help="raw per-node store stats, no per-object join")
+    p.add_argument("--node", default=None,
+                   help="restrict to one node id (also surfaces its "
+                        "OOM reports)")
+    p.add_argument("--top", type=int, default=20,
+                   help="how many top-by-size objects to show")
     p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser(

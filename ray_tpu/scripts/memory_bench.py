@@ -1,0 +1,101 @@
+"""Object-store pressure microbench: occupancy/evictions under churn.
+
+Drives a put/get/drop churn workload sized against the node's store
+capacity, samples per-node shm occupancy (``state.object_store_stats``)
+every round, and emits peak/mean occupancy + eviction/spill-denial
+deltas through ``bench_log.record_memory_pressure`` (committed to
+``BENCH_TPU_SESSIONS.jsonl`` only when run on an accelerator — same
+policy as ``record_task_overhead``).
+
+    python -m ray_tpu.scripts.memory_bench --cluster
+    python -m ray_tpu.scripts.memory_bench --address <head host:port> \
+        --rounds 40 --object-mb 8 --window 6
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def run(rounds: int = 30, object_mb: float = 4.0,
+        window: int = 8) -> list:
+    """Churn: each round puts one ``object_mb`` array and drops refs
+    beyond a ``window``-deep keep-alive set, so the store fills, the
+    ref-counter frees, and (when capacity is tight) spill/eviction
+    engage. Returns one summed-stats sample per round."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import state
+
+    nbytes = int(object_mb * (1 << 20))
+    keep: list = []
+    samples: list = []
+    for i in range(rounds):
+        keep.append(ray_tpu.put(
+            np.full(nbytes, i % 251, dtype=np.uint8)))
+        if len(keep) > window:
+            # Read-then-drop: the churn half of the workload.
+            ray_tpu.get(keep.pop(0))
+        reports = state.object_store_stats(include_objects=False)
+        agg = {"used": 0, "capacity": 0, "num_evictions": 0,
+               "num_objects": 0, "spilled_bytes": 0, "spill_denied": 0}
+        for rep in reports:
+            st = rep.get("stats") or {}
+            for k in agg:
+                agg[k] += int(st.get(k, 0))
+        samples.append(agg)
+    del keep
+    return samples
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", default=None,
+                        help="existing cluster head (default: local)")
+    parser.add_argument("--cluster", action="store_true",
+                        help="spin up a throwaway 2-node local cluster")
+    parser.add_argument("--store-mb", type=int, default=96,
+                        help="per-node store capacity for --cluster "
+                             "(small = pressure engages)")
+    parser.add_argument("--rounds", type=int, default=30)
+    parser.add_argument("--object-mb", type=float, default=4.0)
+    parser.add_argument("--window", type=int, default=8,
+                        help="live refs kept during the churn")
+    parser.add_argument("--device", default="",
+                        help="accelerator label for the evidence trail "
+                             "(empty/cpu = print only, don't commit)")
+    args = parser.parse_args(argv)
+
+    import ray_tpu
+    from ray_tpu.scripts import bench_log
+
+    cluster = None
+    if args.cluster and args.address is None:
+        from ray_tpu.cluster.cluster_utils import Cluster
+
+        cluster = Cluster()
+        cluster.add_node(store_capacity=args.store_mb << 20)
+        cluster.add_node(store_capacity=args.store_mb << 20)
+        cluster.wait_for_nodes()
+        ray_tpu.init(cluster.address)
+    else:
+        ray_tpu.init(args.address)
+
+    try:
+        samples = run(args.rounds, args.object_mb, args.window)
+        entry = bench_log.record_memory_pressure(
+            samples, device=args.device,
+            backend="cluster" if (cluster or args.address) else "local",
+            rounds=args.rounds, object_mb=args.object_mb,
+            window=args.window)
+        print(json.dumps(entry, indent=1))
+    finally:
+        ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -42,10 +42,68 @@ def list_actors() -> List[dict]:
     return []
 
 
-def list_objects(limit: int = 1000) -> List[dict]:
+class ObjectList(list):
+    """``list_objects`` result: a plain list of object records (size-
+    descending) that also reports clipping — ``truncated`` is True when
+    ``limit`` cut the listing and ``total`` is the unclipped count, so
+    a capped listing is never mistaken for the whole cluster."""
+
+    truncated: bool = False
+    total: int = 0
+
+
+def list_objects(limit: int = 1000) -> "ObjectList":
+    """Cluster objects sorted by size DESCENDING (the limit keeps the
+    largest, applied after the sort), enriched with the put-time
+    attribution: owner worker id, creating task, callsite (when
+    ``RAY_TPU_RECORD_CALLSITE`` is on), node replicas, and age."""
     backend = _worker.backend()
-    if hasattr(backend, "list_objects"):
-        return backend.list_objects(limit)
+    out = ObjectList()
+    if not hasattr(backend, "list_objects"):
+        return out
+    got = backend.list_objects(limit)
+    if isinstance(got, dict):
+        out.extend(got.get("objects") or [])
+        out.truncated = bool(got.get("truncated"))
+        out.total = int(got.get("total", len(out)))
+    else:  # legacy backend shape: a bare list
+        out.extend(got)
+        out.total = len(out)
+    return out
+
+
+def memory_summary(top_k: int = 20, group_by: str = "callsite") -> dict:
+    """Cluster-wide object/memory rollup (``ray memory`` analog):
+    per-node shm occupancy + cluster totals, the top-K resident objects,
+    and live bytes grouped by creation ``callsite`` / ``task`` /
+    ``node`` / ``owner`` — the first stop when a TPU host's object store
+    fills up (see also :func:`memory_leaks`)."""
+    backend = _worker.backend()
+    if not hasattr(backend, "memory_summary"):
+        raise ValueError("this backend exposes no memory summary")
+    return backend.memory_summary(top_k, group_by)
+
+
+def memory_leaks() -> List[dict]:
+    """Objects the head's leak sweeper currently flags: alive past
+    ``RAY_TPU_LEAK_AGE_THRESHOLD_S`` with zero reachable refs (an owner
+    died before registering its hold — a pinned, immortal shm copy) or
+    held refs whose every replica is gone. Each record carries the
+    creation attribution so the report says *what* leaked."""
+    backend = _worker.backend()
+    if hasattr(backend, "memory_leaks"):
+        return backend.memory_leaks()
+    return []
+
+
+def object_store_stats(node_id: Optional[str] = None,
+                       include_objects: bool = True) -> List[dict]:
+    """Per-node object-store reports: shm ``stats()`` plus (optionally)
+    the per-key size/refcount/pinned/attribution join and the node's
+    OOM-report index."""
+    backend = _worker.backend()
+    if hasattr(backend, "object_store_stats"):
+        return backend.object_store_stats(node_id, include_objects)
     return []
 
 

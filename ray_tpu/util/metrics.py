@@ -273,6 +273,47 @@ NODE_DRAIN_ACTORS_MIGRATED = Counter(
     tag_keys=("reason",),
 )
 
+# -- object store / memory observability (agent-side per-node occupancy
+# sampled from the shm store's native stats; the head observes object
+# lifetimes into the age histogram as the ref-counter frees them, and
+# OOM kills count where they happen — on the killing node's agent).
+OBJECT_STORE_BYTES_USED = Gauge(
+    "ray_tpu_object_store_bytes_used",
+    "Bytes resident in a node's shared-memory object store",
+    tag_keys=("node_id",),
+)
+OBJECT_STORE_BYTES_CAPACITY = Gauge(
+    "ray_tpu_object_store_bytes_capacity",
+    "Byte capacity of a node's shared-memory object store",
+    tag_keys=("node_id",),
+)
+OBJECT_STORE_OBJECTS = Gauge(
+    "ray_tpu_object_store_objects",
+    "Objects resident in a node's shared-memory object store",
+    tag_keys=("node_id",),
+)
+OBJECT_STORE_EVICTIONS = Counter(
+    "ray_tpu_object_store_evictions_total",
+    "Objects evicted from a node's object store (LRU or spill-evict)",
+    tag_keys=("node_id",),
+)
+OBJECT_SPILL_DENIED = Counter(
+    "ray_tpu_object_spill_denied_total",
+    "Spill requests that could not free the requested bytes "
+    "(everything left referenced or pinned — a put is about to fail)",
+    tag_keys=("node_id",),
+)
+OBJECT_AGE_SECONDS = Histogram(
+    "ray_tpu_object_age_seconds",
+    "Lifetime of cluster objects at free time (creation to last-ref)",
+    boundaries=[0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0, 7200.0],
+)
+OOM_KILLS_TOTAL = Counter(
+    "ray_tpu_oom_kills_total",
+    "Workers killed by the node memory monitor under memory pressure",
+    tag_keys=("node_id",),
+)
+
 
 def percentile(sorted_vals: Sequence[float], q: float) -> float:
     """Nearest-rank percentile of an ascending-sorted non-empty
