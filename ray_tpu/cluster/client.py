@@ -29,6 +29,7 @@ from ray_tpu.core.object_ref import (
 )
 from ray_tpu.core.config import config
 from ray_tpu.core.resources import demand_of
+from ray_tpu.util import failpoints
 
 
 # Poll-again sentinel: a fetch hit only stale/dead locations; the oid
@@ -151,6 +152,12 @@ class ClusterBackend:
         self._lock = threading.Lock()
         # Owner-side lineage: oid -> task spec, for re-execution on loss.
         self._lineage: dict[str, dict] = {}
+        # Actor-creation lineage: actor_id -> creation spec, until the
+        # actor registers. A ctor lost WITH its node (killed before the
+        # agent could dispatch/register) has no worker/agent left to
+        # report anything — the creating driver resubmits, exactly like
+        # task lineage (safe: the assigned node is dead).
+        self._actor_creations: dict[str, dict] = {}
         # Pending actor-task results: oid -> actor_id (for fail-fast when
         # the actor dies with calls in flight).
         self._actor_tasks: dict[str, str] = {}
@@ -234,6 +241,15 @@ class ClusterBackend:
         except OSError:
             self._owner_server = RpcServer(_OwnerService(self))
         self.owner_addr = self._owner_server.address
+        # Chaos source identity: worker processes carry their NODE's
+        # identity (the agent address) so node-keyed partition rules cut
+        # worker-originated traffic too; drivers carry their own
+        # owner-directory address (an endpoint of their own).
+        self._chaos_tag = (
+            self._agent_address
+            if process_kind == "w" and self._agent_address
+            else self.owner_addr)
+        self.head.chaos_src = self._chaos_tag
         # Pull admission (get > wait > args, bounded in-flight bytes).
         self._pulls = _PullManager()
         self._pull_prio = threading.local()
@@ -267,6 +283,7 @@ class ClusterBackend:
             c = self._node_clients.get(address)
             if c is None:
                 c = self._node_clients[address] = RpcClient(address)
+                c.chaos_src = self._chaos_tag
             return c
 
     def _worker_client(self, address: str) -> RpcClient:
@@ -274,6 +291,7 @@ class ClusterBackend:
             c = self._worker_clients.get(address)
             if c is None:
                 c = self._worker_clients[address] = RpcClient(address)
+                c.chaos_src = self._chaos_tag
             return c
 
     def _agent_client(self) -> RpcClient:
@@ -344,7 +362,14 @@ class ClusterBackend:
                 if self._closed:
                     return
             time.sleep(0.02)  # coalesce bursts into one RPC
-            self.flush_refs()
+            try:
+                self.flush_refs()
+            except Exception:
+                # The flusher must survive anything one flush throws
+                # (chaos failpoints, a head mid-restart edge): a dead
+                # flusher silently stops all ref/location reporting for
+                # the rest of the process's life.
+                continue
 
     def flush_refs(self) -> None:
         """Push pending holder add/removes to the head. Workers call this
@@ -352,6 +377,7 @@ class ClusterBackend:
         can never lose the race against the borrow release. The io lock
         makes that guarantee hold even when the background flusher already
         popped the dirty sets: we wait for its RPC to finish."""
+        failpoints.hit("client.flush_refs.before")
         with self._flush_io_lock:
             with self._ref_lock:
                 if not self._dirty_add and not self._dirty_remove \
@@ -464,6 +490,7 @@ class ClusterBackend:
             c = self._owner_clients.get(addr)
             if c is None:
                 c = self._owner_clients[addr] = RpcClient(addr, timeout=30.0)
+                c.chaos_src = self._chaos_tag
             return c
 
     def _report_location(self, oid: str, owner: str | None,
@@ -804,6 +831,7 @@ class ClusterBackend:
         # Soft affinity on recovery: the pinned node is gone, so let the
         # scheduler place the retry anywhere feasible.
         spec["sinfo"]["node_affinity"] = None
+        failpoints.hit("client.recover.before_resubmit")
         try:
             self._submit_spec(spec)
         except (ValueError, TimeoutError):
@@ -1406,6 +1434,7 @@ class ClusterBackend:
     def _dispatch_batch(self, batch: list) -> None:
         from ray_tpu.core.object_ref import TaskCancelledError
 
+        failpoints.hit("client.dispatch.before_push")
         head_specs: list[dict] = []
         local_specs: list[dict] = []
         for spec in batch:
@@ -1609,6 +1638,7 @@ class ClusterBackend:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             time.sleep(0.25)
+            failpoints.hit("client.retry_submit.tick")
             if spec.get("cancelled"):
                 self._drop_holds(spec)
                 self._end_borrows(spec)
@@ -1885,15 +1915,61 @@ class ClusterBackend:
             options.get("max_task_retries", 0),
             spec,
         )
+        with self._lock:
+            self._actor_creations[actor_id] = spec
         self._submit_spec(spec)  # raises if infeasible
         return actor_id
+
+    def _recover_actor_creation(self, actor_id: str) -> bool:
+        """The actor never registered and the node its creation was
+        dispatched to is gone: resubmit the creation spec (driver-side
+        lineage for actor ctors; duplicate-safe because the assigned
+        node is dead — its queue died with it). Returns True if a
+        resubmission happened."""
+        with self._lock:
+            spec = self._actor_creations.get(actor_id)
+            if spec is None or spec.get("_recovering"):
+                # Another thread is already recovering this creation:
+                # report True so the caller re-enters its wait instead
+                # of failing — a second concurrent resubmit would fork
+                # the ctor into two incarnations.
+                return spec is not None and bool(spec.get("_recovering"))
+            spec["_recovering"] = True
+        try:
+            assigned = spec.get("assigned_node")
+            if assigned is None:
+                return False  # not dispatched yet: absence is slowness
+            try:
+                nodes = {n["NodeID"]: n for n in self.head.call("nodes")}
+            except (ConnectionLost, OSError):
+                return False
+            if nodes.get(assigned, {}).get("Alive"):
+                return False  # creation still in flight on a live node
+            spec["assigned_node"] = None
+            spec["sinfo"]["node_affinity"] = None
+            try:
+                self._submit_spec(spec)
+            except (ValueError, TimeoutError):
+                return False
+            return True
+        finally:
+            with self._lock:
+                spec.pop("_recovering", None)
 
     def _wait_actor_alive(self, actor_id: str, timeout: float = 60.0) -> dict:
         """Block through a RESTARTING window until the actor is ALIVE (or
         raise if it ends up DEAD / never recovers)."""
         deadline = time.monotonic() + timeout
         while True:
-            info = self._actor_info(actor_id, refresh=True)
+            try:
+                info = self._actor_info(actor_id, refresh=True)
+            except ValueError:
+                # Never registered: the creation itself may have died
+                # with its node — resubmit through creation lineage.
+                if self._recover_actor_creation(actor_id) and \
+                        time.monotonic() < deadline:
+                    continue
+                raise
             if info["state"] == "ALIVE":
                 return info
             if info["state"] == "DEAD":
@@ -1916,6 +1992,9 @@ class ClusterBackend:
                 raise ValueError(f"no such actor: {actor_id}")
             with self._lock:
                 self._actor_cache[actor_id] = info
+                # Registered: the head owns restarts from here on; the
+                # creation-lineage spec is spent.
+                self._actor_creations.pop(actor_id, None)
         return info
 
     def submit_actor_task(
@@ -1950,11 +2029,30 @@ class ClusterBackend:
         if site:
             spec["callsite"] = site
         try:
-            info = self._actor_info(actor_id)
+            try:
+                info = self._actor_info(actor_id)
+            except ValueError:
+                # Creation lost with its node before registering: the
+                # creation-lineage resubmit (duplicate-safe — the
+                # assigned node is dead) brings it up elsewhere.
+                if not self._recover_actor_creation(actor_id):
+                    raise
+                info = self._wait_actor_alive(actor_id)
             if info["state"] != "ALIVE":
                 info = self._wait_actor_alive(actor_id)
+            # Push under a TIME budget, not an attempt count: under
+            # chaos (node kills, partitions, drain migrations) several
+            # consecutive targets can each be transiently unreachable,
+            # and a fixed attempt count burns out in milliseconds while
+            # the head's view is stale. Genuine permanent death still
+            # fails fast — _wait_actor_alive raises the moment the head
+            # settles the actor DEAD.
+            detect_s = max(config.node_death_timeout_s,
+                           10 * config.heartbeat_interval_s)
+            push_deadline = time.monotonic() + max(
+                60.0, 3 * detect_s + 30.0)
             pushed = False
-            for _attempt in range(3):
+            while time.monotonic() < push_deadline:
                 self._register_borrows(spec, info["node_id"])
                 try:
                     self._worker_client(info["address"]).call(
@@ -1962,10 +2060,34 @@ class ClusterBackend:
                     )
                     pushed = True
                     break
-                except (ConnectionLost, OSError):
+                except (ConnectionLost, OSError) as e:
                     self._end_borrows(spec)
-                    # Worker died under us: wait out a restart and retry.
-                    info = self._wait_actor_alive(actor_id)
+                    if getattr(e, "maybe_executed", False):
+                        # The push was FULLY sent and only the reply was
+                        # lost: the worker most likely has (or ran) the
+                        # call — its task-id dup-suppression makes the
+                        # immediate re-push safe, so probe right away.
+                        time.sleep(0.1)
+                        info = self._wait_actor_alive(actor_id)
+                        continue
+                    # Worker unreachable at connect: the head may still
+                    # report the dead incarnation ALIVE at this address
+                    # for up to the death-detection window. Wait for the
+                    # head's view to MOVE (restarted incarnation or new
+                    # address) before re-pushing; fall out periodically
+                    # to re-probe the same address in case the loss was
+                    # a transient blip (chaos partition healing).
+                    prev_addr = info["address"]
+                    prev_restarts = info.get("num_restarts", 0)
+                    moved_deadline = min(
+                        time.monotonic() + detect_s + 5.0, push_deadline)
+                    while time.monotonic() < moved_deadline:
+                        info = self._wait_actor_alive(actor_id)
+                        if info["address"] != prev_addr or \
+                                info.get("num_restarts",
+                                         0) > prev_restarts:
+                            break
+                        time.sleep(0.25)
             if not pushed:
                 raise ActorError(f"actor {actor_id}: push failed repeatedly")
             # ONE shared entry for all return oids: a restart must replay
@@ -2160,6 +2282,35 @@ class ClusterBackend:
         pinned/attribution join when ``include_objects``."""
         return self.head.call("object_store_stats", node_id,
                               include_objects, timeout=30.0)
+
+    # -- chaos / fault-injection control plane ------------------------------
+
+    def set_failpoints(self, specs: dict,
+                       include_workers: bool = True) -> dict:
+        """Arm/disarm named failpoints cluster-wide (head -> agents ->
+        workers). ``{site: spec}``; falsy spec disarms the site."""
+        return self.head.call("set_failpoints", specs, include_workers,
+                              timeout=30.0)
+
+    def list_failpoints(self) -> dict:
+        return self.head.call("list_failpoints", timeout=30.0)
+
+    def set_channel_chaos(self, rules: list, label: str = "") -> dict:
+        """Arm network-chaos rules (delay/drop/duplicate/sever) on the
+        RPC plane of every cluster process."""
+        return self.head.call("set_channel_chaos", rules, label,
+                              timeout=30.0)
+
+    def clear_channel_chaos(self, label=None) -> dict:
+        return self.head.call("clear_channel_chaos", label, timeout=30.0)
+
+    def partition(self, groups: list) -> dict:
+        """Symmetric network partition between endpoint groups (lists of
+        node ids, or the string "head"). Heal with ``heal()``."""
+        return self.head.call("partition", groups, timeout=30.0)
+
+    def heal(self) -> dict:
+        return self.head.call("heal", timeout=30.0)
 
     # -- node reporter surface (logs / stacks / telemetry) -----------------
 

@@ -24,6 +24,7 @@ class Cluster:
         self.nodes: list[NodeAgent] = []
         self.session = f"c{os.getpid()}_{os.urandom(3).hex()}"
         self.persist_path = persist_path
+        self._partitioned = False
         # Auth-on by default (round 5): generate a per-cluster token
         # unless one is configured or auth was explicitly disabled with
         # RAY_TPU_CLUSTER_TOKEN="" — see rpc.ensure_cluster_token.
@@ -112,8 +113,50 @@ class Cluster:
             if w.proc.poll() is None:
                 w.proc.kill()
         node._server.stop()
+        # Break the corpse's outbound clients too: a killed node's
+        # heartbeat/gossip thread mid-call in a reconnect window (or
+        # spinning against an armed partition rule) would otherwise keep
+        # retrying for up to the window — stray threads bleeding into
+        # whatever the chaos run does next.
+        node.close_outbound_clients()
         if node in self.nodes:
             self.nodes.remove(node)
+
+    # -- network chaos ------------------------------------------------------
+
+    def partition(self, groups) -> dict:
+        """Partition the cluster's RPC plane between endpoint groups.
+        Each group is a list whose members are ``NodeAgent`` instances,
+        node ids, or the string ``"head"``. Delegates to the head's
+        ``rpc_partition`` (the one implementation the control plane/CLI
+        also uses): symmetric drop rules for every cross-group pair —
+        both directions, fanned to every agent and its live workers —
+        so heartbeats, gossip, head fan-outs, and object traffic all
+        genuinely observe the cut; every affected call surfaces as
+        ``ConnectionLost`` (never silent corruption) and retry-windowed
+        callers keep probing until ``heal()``. Endpoints not named in
+        any group (e.g. the driver) are unaffected."""
+        assert self.head is not None
+        id_groups = [
+            [m.node_id if isinstance(m, NodeAgent) else m for m in group]
+            for group in groups
+        ]
+        self._partitioned = True
+        return self.head.rpc_partition(id_groups)
+
+    def heal(self) -> dict | int:
+        """Remove every partition rule this cluster armed."""
+        if self.head is not None:
+            out = self.head.rpc_heal()
+        else:
+            from ray_tpu.cluster.rpc import channel_chaos
+
+            out = channel_chaos.clear("partition")
+        # Only after the heal actually landed: a raised heal must leave
+        # the flag set so shutdown()'s auto-heal still fires instead of
+        # leaking armed drop rules into the next test's cluster.
+        self._partitioned = False
+        return out
 
     def wait_for_nodes(self, timeout: float = 10.0) -> None:
         assert self.head is not None
@@ -142,6 +185,16 @@ class Cluster:
             f"load={load_s}/{os.cpu_count()}cpu")
 
     def shutdown(self):
+        # Chaos rules must never outlive the cluster that armed them
+        # (the table is process-global; a forgotten partition would drop
+        # the NEXT test's RPCs).
+        if self._partitioned:
+            try:
+                self.heal()
+            except Exception:
+                from ray_tpu.cluster.rpc import channel_chaos
+
+                channel_chaos.clear("partition")
         for node in list(self.nodes):
             try:
                 node.stop()

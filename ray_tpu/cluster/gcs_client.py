@@ -170,6 +170,44 @@ class MetricsAccessor(_Accessor):
         return self._rpc.call("device_stats", fresh, timeout=20.0)
 
 
+class ChaosAccessor(_Accessor):
+    """Cluster-wide deterministic fault injection: failpoints (named
+    sites, armed head -> agents -> workers) and network chaos on the RPC
+    plane (delay / drop / duplicate / sever rules; partitions)."""
+
+    def set_failpoints(self, specs: dict,
+                       include_workers: bool = True) -> dict:
+        """``{site: "action[:arg][,selector...]"}``; falsy spec disarms."""
+        return self._rpc.call("set_failpoints", specs, include_workers,
+                              timeout=30.0)
+
+    def arm(self, site: str, spec: str) -> dict:
+        return self.set_failpoints({site: spec})
+
+    def disarm(self, site: str) -> dict:
+        return self.set_failpoints({site: None})
+
+    def list(self) -> dict:
+        return self._rpc.call("list_failpoints", timeout=30.0)
+
+    def set_channel_chaos(self, rules: list, label: str = "") -> dict:
+        return self._rpc.call("set_channel_chaos", rules, label,
+                              timeout=30.0)
+
+    def clear_channel_chaos(self, label: Optional[str] = None) -> dict:
+        return self._rpc.call("clear_channel_chaos", label, timeout=30.0)
+
+    def list_channel_chaos(self) -> dict:
+        return self._rpc.call("list_channel_chaos", timeout=30.0)
+
+    def partition(self, groups: list) -> dict:
+        """Symmetric drop rules between groups of node ids (or "head")."""
+        return self._rpc.call("partition", groups, timeout=30.0)
+
+    def heal(self) -> dict:
+        return self._rpc.call("heal", timeout=30.0)
+
+
 class GcsClient:
     def __init__(self, address: str, reconnect_window: float = 15.0):
         self.address = address
@@ -182,6 +220,7 @@ class GcsClient:
         self.pubsub = PubsubAccessor(self._rpc)
         self.tasks = TaskInfoAccessor(self._rpc)
         self.metrics = MetricsAccessor(self._rpc)
+        self.chaos = ChaosAccessor(self._rpc)
 
     def ping(self) -> bool:
         return self._rpc.call("ping") == "pong"

@@ -21,9 +21,10 @@ import threading
 import time
 from typing import Any, Optional
 
-from ray_tpu.cluster.rpc import RpcClient, RpcServer
+from ray_tpu.cluster.rpc import RpcClient, RpcServer, channel_chaos
 from ray_tpu.core import ids
 from ray_tpu.core.config import config
+from ray_tpu.util import failpoints
 
 # Heartbeat timeout (reference: num_heartbeats_timeout). The config knob
 # scales it: death is declared after node_death_timeout_s with a floor
@@ -175,6 +176,13 @@ class HeadServer:
             self._load_persisted()
         self._server = RpcServer(self, host, port)
         self.address = self._server.address
+        # Chaos source identity: the head's outbound clients (per-node
+        # fanouts, drain probes, free broadcasts) are tagged with the
+        # head address so Cluster.partition's symmetric drop rules catch
+        # head->agent traffic. Nodes reloaded from the persisted store
+        # were created before the server bound, so tag them here.
+        for n in self._nodes.values():
+            n.client.chaos_src = self.address
         # Cluster metrics federation: one HTTP endpoint whose
         # /metrics/cluster body merges every alive agent's registry into
         # a single scrape (plus /metrics for the head's own process and
@@ -258,6 +266,7 @@ class HeadServer:
         last: dict[str, bytes] = {}
         while not self._stop.wait(config.head_snapshot_interval_s):
             try:
+                failpoints.hit("head.snapshot.before_persist")
                 with self._lock:
                     snap = {
                         "actors": {k: dict(v) for k, v in self._actors.items()},
@@ -284,7 +293,9 @@ class HeadServer:
 
     def rpc_register_node(self, node_id, address, resources, store_path):
         with self._lock:
-            self._nodes[node_id] = NodeInfo(node_id, address, resources, store_path)
+            info = NodeInfo(node_id, address, resources, store_path)
+            info.client.chaos_src = self.address
+            self._nodes[node_id] = info
         self._persist("node", node_id, {
             "address": address, "resources": dict(resources),
             "store_path": store_path,
@@ -365,6 +376,7 @@ class HeadServer:
         the death cause below marks the loss as a drain."""
         t0 = time.monotonic()
         deadline = t0 + deadline_s
+        failpoints.hit("head.drain.before_migrate")
         with self._lock:
             node = self._nodes.get(node_id)
         if node is None:
@@ -878,7 +890,16 @@ class HeadServer:
             entry = self._objects.setdefault(
                 oid, {"nodes": set(), "error": False, "size": 0}
             )
-            entry["nodes"].add(node_id)
+            node = self._nodes.get(node_id)
+            if node is not None and node.alive:
+                # A location report can arrive AFTER its node died (a
+                # batched/reconnect-retried flush landing late):
+                # _mark_dead already swept this node's locations, and
+                # re-adding one would leave the directory pointing at a
+                # store that no longer exists. The attribution/holder
+                # bookkeeping below still applies — the object may have
+                # live replicas elsewhere.
+                entry["nodes"].add(node_id)
             entry["error"] = entry["error"] or is_error
             entry["size"] = max(entry["size"], size)
             if owner_addr:
@@ -1061,6 +1082,21 @@ class HeadServer:
             }
             self._actors_cv.notify_all()
             info = dict(self._actors[actor_id])
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                # Registration raced the node's death (a drain completing
+                # or heartbeat timeout landed between placement and this
+                # RPC): _mark_dead's actor sweep already ran and missed
+                # this record, so without this check the actor would stay
+                # ALIVE at a dead address FOREVER. Process it as the
+                # node-death loss it is — same cause format as the sweep,
+                # so drain/preemption retry exemptions still apply — and
+                # let restartable actors reconstruct elsewhere.
+                cause = (node.death_cause if node is not None
+                         else None) or "unknown"
+                self._on_actor_death(
+                    actor_id, f"node {node_id} died: {cause}", True)
+                info = dict(self._actors[actor_id])
         self.pubsub.publish("ACTORS", actor_id, info)
         return True
 
@@ -1193,6 +1229,7 @@ class HeadServer:
         spec["pg_id"], spec["bundle_index"] = None, -1
         deadline = time.monotonic() + 120.0
         while time.monotonic() < deadline and not self._stop.is_set():
+            failpoints.hit("head.restart_actor.tick")
             with self._lock:
                 info = self._actors.get(actor_id)
                 if info is None or info["state"] != "RESTARTING":
@@ -1656,6 +1693,126 @@ class HeadServer:
             "targets_path": "/metrics/targets",
         }
 
+    # -- chaos / fault-injection control plane -----------------------------
+    # The head is the arming point for cluster-wide deterministic fault
+    # injection: failpoint specs and network-chaos rules fan out to every
+    # alive agent (which fans failpoints on to its live workers), so one
+    # `state.set_failpoints(...)` / `ray-tpu chaos` call arms the whole
+    # cluster regardless of process layout.
+
+    def rpc_set_failpoints(self, specs: dict, include_workers: bool = True):
+        """Arm/disarm failpoints everywhere: ``{site: spec}`` (falsy spec
+        disarms). Returns {"head": armed, <node_id>: armed-or-error}."""
+        out = {"head": failpoints.set_failpoints(specs)}
+        for nid, client in self._alive_agents():
+            try:
+                out[nid] = client.call(
+                    "set_failpoints", specs, include_workers, timeout=10.0)
+            except Exception as e:
+                out[nid] = {"error": repr(e)}
+        return out
+
+    def rpc_list_failpoints(self):
+        """Armed failpoints per process: {"head": {...}, <node_id>: {...}}
+        (worker tables are folded in by each agent)."""
+        out = {"head": failpoints.list_armed()}
+        for nid, client in self._alive_agents():
+            try:
+                out[nid] = client.call("list_failpoints", timeout=10.0)
+            except Exception as e:
+                out[nid] = {"error": repr(e)}
+        return out
+
+    def rpc_set_channel_chaos(self, rules: list, label: str = ""):
+        """Arm network-chaos rules (wire-shaped dicts: action/src/dst/
+        method/arg/prob/times) in the head's process, every alive
+        agent's, and — best-effort, via each agent — its live workers,
+        so both directions of a partition/delay are observed everywhere.
+        Returns the per-process count armed."""
+        # Arming RPCs are chaos-exempt (rpc.CHAOS_CONTROL_METHODS), so
+        # the fan-out reaches every agent even once the first in-process
+        # arm lands rules in the shared table; fanning out before the
+        # local arm keeps multi-process agents symmetric regardless.
+        out = {}
+        for nid, client in self._alive_agents():
+            try:
+                out[nid] = client.call(
+                    "set_channel_chaos", rules, label, timeout=10.0)
+            except Exception as e:
+                out[nid] = {"error": repr(e)}
+        out["head"] = channel_chaos.add_rule_dicts(rules, label)
+        return out
+
+    def rpc_clear_channel_chaos(self, label: str | None = None):
+        """Remove network-chaos rules everywhere (all, or one label —
+        e.g. "partition" for ``heal``). Returns per-process counts."""
+        out = {"head": channel_chaos.clear(label)}
+        for nid, client in self._alive_agents():
+            try:
+                out[nid] = client.call(
+                    "clear_channel_chaos", label, timeout=10.0)
+            except Exception as e:
+                out[nid] = {"error": repr(e)}
+        return out
+
+    def rpc_list_channel_chaos(self):
+        out = {"head": channel_chaos.describe()}
+        for nid, client in self._alive_agents():
+            try:
+                out[nid] = client.call("list_channel_chaos", timeout=10.0)
+            except Exception as e:
+                out[nid] = [{"error": repr(e)}]
+        return out
+
+    def rpc_partition(self, groups: list):
+        """Network partition between groups of endpoints: each group is a
+        list of node ids (or the string "head"). Symmetric drop rules —
+        (src in A, dst in B) AND (src in B, dst in A) for every pair —
+        are armed in every process so heartbeats, gossip, fan-outs, and
+        object traffic all observe the cut. Heal with rpc_heal()."""
+        with self._lock:
+            addr_of = {nid: n.address for nid, n in self._nodes.items()}
+            client_of = {nid: n.client for nid, n in self._nodes.items()}
+        addr_groups = []
+        for group in groups:
+            addrs = set()
+            for member in group:
+                if member == "head":
+                    addrs.add(self.address)
+                elif member in addr_of:
+                    addrs.add(addr_of[member])
+                    # A node's cut covers its workers' own RPC servers
+                    # too — cross-node actor pushes and owner notifies
+                    # go straight to worker addresses, not the agent's.
+                    # Best-effort (pre-arming, so never chaos-dropped):
+                    # an unreachable agent still gets the agent-level
+                    # cut.
+                    try:
+                        addrs.update(client_of[member].call(
+                            "worker_addresses", timeout=5.0))
+                    except Exception:
+                        pass
+                elif ":" in member:
+                    addrs.add(member)  # already a host:port address
+                else:
+                    # A typo'd/stale node id would arm a never-matching
+                    # rule: a "partition" that silently cuts nothing.
+                    raise ValueError(
+                        f"unknown partition group member {member!r} "
+                        f"(known node ids: {sorted(addr_of)} or 'head')")
+            addr_groups.append(addrs)
+        rules = []
+        for i, a in enumerate(addr_groups):
+            for b in addr_groups[i + 1:]:
+                rules.append({"action": "drop", "src": sorted(a),
+                              "dst": sorted(b), "label": "partition"})
+                rules.append({"action": "drop", "src": sorted(b),
+                              "dst": sorted(a), "label": "partition"})
+        return self.rpc_set_channel_chaos(rules, label="partition")
+
+    def rpc_heal(self):
+        return self.rpc_clear_channel_chaos("partition")
+
     # -- scheduling -------------------------------------------------------
 
     def rpc_schedule(self, demand, caller_node=None, strategy=None,
@@ -1676,6 +1833,7 @@ class HeadServer:
         marked ``spilled`` was just REJECTED by the caller's own node
         (leased-push admission) — the view of that node is stale-high, so
         prefer-local is suppressed and other feasible nodes win ties."""
+        failpoints.hit("head.schedule.batch")
         with self._lock:
             return [
                 self._schedule_locked(

@@ -27,8 +27,14 @@ import time
 from typing import Optional
 
 from ray_tpu._native.shm_store import ShmStore
-from ray_tpu.cluster.rpc import ConnectionLost, RpcClient, RpcServer
+from ray_tpu.cluster.rpc import (
+    ConnectionLost,
+    RpcClient,
+    RpcServer,
+    channel_chaos,
+)
 from ray_tpu.core import ids
+from ray_tpu.util import failpoints
 from ray_tpu.core.object_ref import ObjectLostError
 from ray_tpu.core.config import config
 from ray_tpu.core.resources import ResourcePool
@@ -201,9 +207,23 @@ class NodeAgent:
         self._gossip_clients: "collections.OrderedDict[str, RpcClient]" = (
             collections.OrderedDict()
         )
+        # Runtime-armed failpoint table for THIS node's workers: kept so
+        # workers forked AFTER a cluster-wide arm still inherit it (they
+        # are armed at registration) — without this, a chaos arm only
+        # covers the workers alive at fanout time.
+        self._worker_failpoints: dict[str, str] = {}
+        # Same replay contract for network-chaos rules: wire-shaped rule
+        # dicts (label folded in) re-applied to late-forked workers, so
+        # an in-force partition isn't invisible to a worker spawned
+        # mid-experiment.
+        self._worker_channel_rules: list[dict] = []
 
         self._server = RpcServer(self, host)
         self.address = self._server.address
+        # Chaos source identity: this agent's outbound clients (head
+        # heartbeats, gossip, owner notifies) carry the agent address so
+        # Cluster.partition's symmetric drop rules cut both directions.
+        self.head.chaos_src = self.address
         self.head.call(
             "register_node", self.node_id, self.address,
             self.total_resources, self.store_path,
@@ -459,7 +479,24 @@ class NodeAgent:
             w.address = address
             w.client_id = client_id  # its holder id in the head's ref table
             w.client = RpcClient(address)
-            w.ready.set()
+            w.client.chaos_src = self.address
+            armed = dict(self._worker_failpoints)
+            chan_rules = list(self._worker_channel_rules)
+        if armed:
+            # Late-forked workers inherit the runtime-armed table —
+            # BEFORE ready.set(), so no task can dispatch to a
+            # not-yet-armed worker.
+            try:
+                w.client.call("set_failpoints", armed, timeout=5.0)
+            except Exception:
+                pass
+        if chan_rules:
+            try:
+                w.client.call(
+                    "set_channel_chaos", chan_rules, "", timeout=5.0)
+            except Exception:
+                pass
+        w.ready.set()
         return True
 
     def _checkout_worker(self, timeout: float | None = None,
@@ -587,6 +624,7 @@ class NodeAgent:
         back through the head, which still balances the cluster. Returns
         the list of REJECTED indices; the client reschedules those through
         the head and drops its lease."""
+        failpoints.hit("agent.lease.push")
         rejected = []
         accepted = []
         with self._queue_cv:
@@ -659,6 +697,14 @@ class NodeAgent:
         with self._lock:
             old = self._task_records.get(rec["task_id"])
             if old is not None:
+                if state in ("PENDING", "RUNNING") and \
+                        old.get("state") in ("FINISHED", "FAILED",
+                                             "CANCELLED"):
+                    # A duplicate delivery of an already-settled task
+                    # (retried push whose first reply was lost) must not
+                    # regress the terminal record: the duplicate will be
+                    # refused at the worker and no further event comes.
+                    return
                 old["state"] = state
                 return
             if len(self._task_records) >= self._task_records_cap:
@@ -671,6 +717,7 @@ class NodeAgent:
         records (with timings/outcome + per-phase wall-ns), captured
         stdout/stderr lines, finished tracing spans (forwarded to the
         head's span store), and an optional device-telemetry snapshot."""
+        failpoints.hit("agent.worker_events.upload")
         if task_events:
             # Feed the phase histogram so p50/p99 per phase is
             # scrapeable without the state API (one observe per phase
@@ -849,6 +896,7 @@ class NodeAgent:
             self._cancel_spec(spec)
             return
         try:
+            failpoints.hit("agent.dispatch.before_push")
             if spec.get("actor_create"):
                 w.is_actor = True
                 w.actor_id = spec["actor_id"]
@@ -874,7 +922,16 @@ class NodeAgent:
                         pass
                     w.proc.kill()
             else:
-                w.client.call("push_task", spec)
+                if w.client.call("push_task", spec) is False:
+                    # Duplicate admission: this worker process already
+                    # accepted the same task id (a retried push whose
+                    # first delivery lost only its reply). The first
+                    # copy owns the task's fate — just release this
+                    # dispatch's lease and return the worker.
+                    with self._lock:
+                        self._release_current(w)
+                        w.current_task = None
+                    self._return_worker(w)
         except Exception as e:  # worker died between checkout and push
             # The task never STARTED on the corpse, so retrying with a
             # fresh worker is always safe (unlike a mid-execution death,
@@ -997,8 +1054,16 @@ class NodeAgent:
                 self.store.put(oid, chunks, b"E" + meta)
             except Exception:
                 continue
-            self.head.call("add_location", oid, self.node_id, is_error=True,
-                           owner_addr=owner or "")
+            try:
+                self.head.call("add_location", oid, self.node_id,
+                               is_error=True, owner_addr=owner or "")
+            except Exception:
+                # Head unreachable (partition / shutdown): the owner
+                # notify below still unblocks the owner directly, and
+                # the owner's lineage path recovers otherwise. A failed
+                # directory report must not kill the calling thread
+                # (the reap loop runs through here).
+                pass
             if owner:
                 # Unblock the owner's local wait directly (its get() no
                 # longer long-polls the head for self-owned refs).
@@ -1016,6 +1081,7 @@ class NodeAgent:
                     old.close()
                 c = self._owner_clients[owner] = RpcClient(
                     owner, timeout=10.0)
+                c.chaos_src = self.address
         c.call("owner_add_location", oid, self.node_id, self.address,
                self.store_path, True, 0, timeout=10.0)
 
@@ -1275,9 +1341,16 @@ class NodeAgent:
                 ]
                 deferred = list(self._deferred_deletes)
             for w in dead:
-                self._on_worker_failure(
-                    w, f"exit code {w.proc.returncode}"
-                )
+                try:
+                    self._on_worker_failure(
+                        w, f"exit code {w.proc.returncode}"
+                    )
+                except Exception:
+                    # The reap loop must survive anything one corpse's
+                    # cleanup throws (chaos-partitioned head, store
+                    # teardown races): a dead reaper leaks every later
+                    # worker death.
+                    continue
             for oid in deferred:
                 if self.store.delete(oid) or not self.store.contains(oid):
                     with self._lock:
@@ -2024,6 +2097,7 @@ class NodeAgent:
         """One bounded chunk of the object's data ([offset, offset+length)).
         Stateless: each chunk pins/releases independently, so eviction or
         spilling mid-transfer is handled by the spill-file fallback."""
+        failpoints.hit("agent.fetch.chunk")
         self._fetch_stats["chunks"] += 1
         got = self.store.get(oid)
         if got is not None:
@@ -2293,6 +2367,7 @@ class NodeAgent:
                     self._gossip_clients.popitem(last=False)[1].close()
                 c = self._gossip_clients[address] = RpcClient(
                     address, timeout=10.0)
+                c.chaos_src = self.address
             return c
 
     def _gossip_loop(self):
@@ -2361,6 +2436,7 @@ class NodeAgent:
     def _heartbeat_loop(self):
         while not self._shutdown.wait(config.heartbeat_interval_s):
             try:
+                failpoints.hit("agent.heartbeat")
                 resp = self.head.call(
                     "heartbeat", self.node_id, self.pool.available(),
                     timeout=5.0,
@@ -2373,6 +2449,106 @@ class NodeAgent:
             except Exception:
                 continue
 
+    # -- chaos / fault-injection control plane -----------------------------
+
+    def rpc_set_failpoints(self, specs: dict, include_workers: bool = True):
+        """Arm/disarm failpoints in this agent's process and (by default)
+        every live worker process on this node — including workers forked
+        LATER (the armed table re-applies at worker registration)."""
+        out = {"agent": failpoints.set_failpoints(specs)}
+        if include_workers:
+            with self._lock:
+                for site, spec in (specs or {}).items():
+                    if spec:
+                        self._worker_failpoints[site] = spec
+                    else:
+                        self._worker_failpoints.pop(site, None)
+            with self._lock:
+                workers = [w for w in self._workers.values()
+                           if w.client is not None
+                           and w.proc.poll() is None]
+            for w in workers:
+                try:
+                    out[w.worker_id] = w.client.call(
+                        "set_failpoints", specs, timeout=5.0)
+                except Exception as e:
+                    out[w.worker_id] = {"error": repr(e)}
+        return out
+
+    def rpc_list_failpoints(self):
+        """This agent's armed table plus each live worker's (the fold
+        the head's list surface promises — a worker-side arm that
+        errored must be visible as its absence here)."""
+        out = {"agent": failpoints.list_armed()}
+        with self._lock:
+            workers = [(w.worker_id, w.client)
+                       for w in self._workers.values()
+                       if w.client is not None and w.proc.poll() is None]
+        for wid, client in workers:
+            try:
+                out[wid] = client.call("list_failpoints", timeout=5.0)
+            except Exception as e:
+                out[wid] = {"error": repr(e)}
+        return out
+
+    def rpc_set_channel_chaos(self, rules: list, label: str = "",
+                              include_workers: bool = True):
+        n = channel_chaos.add_rule_dicts(rules, label)
+        if include_workers:
+            with self._lock:
+                # Kept for replay at worker registration (the failpoint
+                # table's contract): a worker forked mid-partition must
+                # still observe the cut.
+                self._worker_channel_rules.extend(
+                    dict(r, label=label) if label and not r.get("label")
+                    else dict(r)
+                    for r in rules)
+            # Workers tag their clients with THIS node's identity, so
+            # node-keyed rules (partitions) genuinely cut their traffic
+            # too. Best-effort: a worker mid-spawn arms nothing.
+            for w in self._live_worker_clients():
+                try:
+                    w.call("set_channel_chaos", rules, label, timeout=5.0)
+                except Exception:
+                    continue
+        return n
+
+    def rpc_clear_channel_chaos(self, label: str | None = None,
+                                include_workers: bool = True):
+        n = channel_chaos.clear(label)
+        if include_workers:
+            with self._lock:
+                if label is None:
+                    self._worker_channel_rules = []
+                else:
+                    self._worker_channel_rules = [
+                        r for r in self._worker_channel_rules
+                        if r.get("label") != label]
+            for w in self._live_worker_clients():
+                try:
+                    w.call("clear_channel_chaos", label, timeout=5.0)
+                except Exception:
+                    continue
+        return n
+
+    def _live_worker_clients(self):
+        with self._lock:
+            return [w.client for w in self._workers.values()
+                    if w.client is not None and w.proc.poll() is None]
+
+    def rpc_worker_addresses(self):
+        """Live workers' RPC server addresses. Partition group
+        resolution folds these into a node's address set: traffic
+        addressed DIRECTLY to a worker (cross-node actor pushes, owner
+        notifies) must observe the node's cut, not just traffic to the
+        agent."""
+        with self._lock:
+            return [w.address for w in self._workers.values()
+                    if w.address and w.proc.poll() is None]
+
+    def rpc_list_channel_chaos(self):
+        return channel_chaos.describe()
+
     def rpc_event_stats(self):
         """Per-RPC-handler timing stats (event_stats.h analog)."""
         return self._server.handler_stats()
@@ -2383,6 +2559,23 @@ class NodeAgent:
     def rpc_shutdown_node(self):
         threading.Thread(target=self.stop, daemon=True).start()
         return True
+
+    def close_outbound_clients(self):
+        """Close this agent's outbound clients (head, gossip, owner) so
+        threads blocked in a reconnect window (head client retries for
+        head_reconnect_window_s) or spinning against an armed chaos rule
+        observe ``_closed`` and exit NOW — a stopped or chaos-killed
+        agent must not leave heartbeat/gossip threads retrying past
+        teardown into the next test's cluster. Used by the graceful stop
+        path and by ``Cluster.kill_node``'s ungraceful chaos path."""
+        with self._lock:
+            outbound = [self.head, *self._gossip_clients.values(),
+                        *self._owner_clients.values()]
+        for c in outbound:
+            try:
+                c.close()
+            except Exception:
+                pass
 
     def stop(self):
         with self._lock:
@@ -2453,6 +2646,7 @@ class NodeAgent:
             except Exception:
                 pass
         self._server.stop()
+        self.close_outbound_clients()
         # The reap loop may be mid-iteration on the workers just killed;
         # let it finish before the store detaches (release_dead on a
         # closed segment is guarded, but ordering keeps cleanup complete).

@@ -34,6 +34,7 @@ from __future__ import annotations
 import hashlib
 import hmac
 import os
+import random
 import socket
 import struct
 import threading
@@ -93,6 +94,237 @@ class RpcError(Exception):
 
 class ConnectionLost(RpcError):
     pass
+
+
+# -- network chaos (deterministic fault injection on the RPC plane) -----
+#
+# A process-global policy table consulted by every RpcClient call. Rules
+# match on (source endpoint tag, destination address, method prefix) and
+# inject one of four faults. Every injected fault surfaces as
+# ConnectionLost — exactly what a real network failure produces — never
+# as silent corruption:
+#
+#   delay      sleep a (seeded) uniform draw from [lo, hi] before sending
+#   drop       the call never reaches the peer (partition semantics);
+#              retry-windowed callers keep retrying until the window ends
+#              or the rule is removed (heal), so a partition shorter than
+#              the reconnect window is invisible to the application
+#   sever      the request is FULLY sent, then the connection is severed
+#              before the reply — the peer executes, the caller sees
+#              ConnectionLost with maybe_executed=True (the at-most-once
+#              ambiguity path every non-idempotent caller must handle)
+#   duplicate  the call is made twice (second reply discarded): exercises
+#              task-id dup-suppression on the receiver
+#
+# Sources are identified by an endpoint tag (`RpcClient.chaos_src`) set
+# by whoever owns the client — the head tags its per-node clients with
+# the head address, agents tag theirs with the agent address, drivers
+# with their owner-directory address — so `Cluster.partition(groups)`
+# can arm SYMMETRIC drop rules between address sets and heartbeats,
+# gossip, fan-outs, and object traffic all genuinely observe the
+# partition. Untagged clients only match rules with src=None.
+
+
+class ChaosRule:
+    __slots__ = ("rule_id", "src", "dst", "method", "action", "arg",
+                 "prob", "label", "times")
+
+    def __init__(self, action: str, *, src=None, dst=None, method=None,
+                 arg=None, prob: float = 1.0, label: str = "",
+                 times: int | None = None, rule_id: int = 0):
+        if action not in ("delay", "drop", "sever", "duplicate"):
+            raise ValueError(f"unknown chaos action {action!r}")
+        self.rule_id = rule_id
+        # A bare string is one address, not an iterable of characters —
+        # frozenset("host:port") would silently never match anything.
+        if isinstance(src, str):
+            src = (src,)
+        if isinstance(dst, str):
+            dst = (dst,)
+        self.src = frozenset(src) if src else None
+        self.dst = frozenset(dst) if dst else None
+        self.method = method  # exact method name or prefix ending in '*'
+        self.action = action
+        self.arg = arg  # delay: (lo, hi) seconds
+        self.prob = prob
+        self.label = label
+        # Firing budget: the rule expires after this many injections
+        # (None = unlimited). times=1 gives one-shot faults — e.g. sever
+        # exactly one push, then let the retry through.
+        self.times = times
+
+    def matches(self, src, dst: str, method: str) -> bool:
+        if self.src is not None and src not in self.src:
+            return False
+        if self.dst is not None and dst not in self.dst:
+            return False
+        if self.method:
+            if self.method.endswith("*"):
+                if not method.startswith(self.method[:-1]):
+                    return False
+            elif method != self.method:
+                return False
+        return True
+
+    def describe(self) -> dict:
+        return {
+            "rule_id": self.rule_id,
+            "src": sorted(self.src) if self.src else None,
+            "dst": sorted(self.dst) if self.dst else None,
+            "method": self.method,
+            "action": self.action,
+            "arg": list(self.arg) if isinstance(self.arg, tuple)
+            else self.arg,
+            "prob": self.prob,
+            "label": self.label,
+            "times": self.times,
+        }
+
+
+class ChannelChaos:
+    """Process-global chaos policy for the RPC plane. Zero-cost when
+    empty: callers gate on the plain ``active`` flag before touching the
+    lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: list[ChaosRule] = []
+        self._next_id = 1
+        self._rng = None
+        self._rng_seed = None
+        self.active = False  # lock-free fast-path gate
+
+    def _ensure_rng(self):
+        # Rebuilt whenever the effective chaos seed changes: a draw made
+        # before RAY_TPU_CHAOS_SEED was set must not pin an unseeded RNG
+        # for the process lifetime (same-seed replay would diverge).
+        from ray_tpu.util.failpoints import effective_seed, seeded_rng
+
+        seed = effective_seed()
+        if self._rng is None or seed != self._rng_seed:
+            self._rng = seeded_rng("channel-chaos")
+            self._rng_seed = seed
+        return self._rng
+
+    def add_rule(self, action: str, *, src=None, dst=None, method=None,
+                 arg=None, prob: float = 1.0, label: str = "",
+                 times: int | None = None) -> int:
+        with self._lock:
+            rule = ChaosRule(action, src=src, dst=dst, method=method,
+                             arg=arg, prob=prob, label=label,
+                             times=times, rule_id=self._next_id)
+            self._next_id += 1
+            self._rules.append(rule)
+            self.active = True
+            return rule.rule_id
+
+    def add_rule_dict(self, rec: dict) -> int:
+        """Wire-shaped rule (the control-plane fanout ships dicts).
+        IDEMPOTENT: an identical rule already armed is not added again —
+        on an in-process cluster the head's fanout reaches the same
+        process-global table once per agent, and a ``times``-budgeted
+        one-shot must not silently become an N-shot."""
+        arg = rec.get("arg")
+        if isinstance(arg, (list, tuple)):
+            arg = tuple(arg)
+        key = (rec["action"],
+               frozenset(rec.get("src") or ()) or None,
+               frozenset(rec.get("dst") or ()) or None,
+               rec.get("method"), arg, rec.get("prob", 1.0),
+               rec.get("label", ""), rec.get("times"))
+        with self._lock:
+            for r in self._rules:
+                if (r.action, r.src, r.dst, r.method, r.arg, r.prob,
+                        r.label, r.times) == key:
+                    return r.rule_id
+        return self.add_rule(
+            rec["action"], src=rec.get("src"), dst=rec.get("dst"),
+            method=rec.get("method"), arg=arg,
+            prob=rec.get("prob", 1.0), label=rec.get("label", ""),
+            times=rec.get("times"))
+
+    def add_rule_dicts(self, rules: list, label: str = "") -> int:
+        """Arm a batch of wire-shaped rules, folding ``label`` into any
+        rule that lacks one — the one arming loop every control-plane
+        surface (head, agent, worker) shares. Returns the count armed
+        (idempotent re-arms included)."""
+        n = 0
+        for rec in rules:
+            if label and not rec.get("label"):
+                rec = dict(rec, label=label)
+            self.add_rule_dict(rec)
+            n += 1
+        return n
+
+    def remove(self, rule_id: int) -> bool:
+        with self._lock:
+            before = len(self._rules)
+            self._rules = [r for r in self._rules if r.rule_id != rule_id]
+            self.active = bool(self._rules)
+            return len(self._rules) != before
+
+    def clear(self, label: str | None = None) -> int:
+        with self._lock:
+            before = len(self._rules)
+            if label is None:
+                self._rules = []
+            else:
+                self._rules = [r for r in self._rules if r.label != label]
+            self.active = bool(self._rules)
+            return before - len(self._rules)
+
+    def match(self, src, dst: str, method: str, actions=None):
+        """First matching rule that passes its probability draw; rules
+        with a ``times`` budget expire once it is spent. ``actions``
+        restricts which rule actions are considered at all — callers
+        that cannot apply an action (streams can't sever/duplicate)
+        must not consume its firing budget."""
+        with self._lock:
+            for rule in self._rules:
+                if actions is not None and rule.action not in actions:
+                    continue
+                if rule.matches(src, dst, method):
+                    if rule.prob < 1.0 and \
+                            self._ensure_rng().random() >= rule.prob:
+                        continue
+                    if rule.times is not None:
+                        rule.times -= 1
+                        if rule.times <= 0:
+                            self._rules.remove(rule)
+                            self.active = bool(self._rules)
+                    return rule
+            return None
+
+    def delay_draw(self, arg) -> float:
+        lo, hi = (arg if isinstance(arg, tuple) and len(arg) == 2
+                  else (arg or 0.05, arg or 0.05))
+        lo, hi = float(lo), float(hi)
+        if hi <= lo:
+            return lo
+        with self._lock:
+            return self._ensure_rng().uniform(lo, hi)
+
+    def describe(self) -> list[dict]:
+        with self._lock:
+            return [r.describe() for r in self._rules]
+
+
+channel_chaos = ChannelChaos()
+
+# The chaos CONTROL plane rides above the chaos it arms: arming, healing
+# and listing RPCs are exempt from injection. Otherwise a partition rule
+# would drop its own fan-out to far-side agents (leaving their workers
+# unarmed and the "partition" one-directional) and heal could never
+# reach a partitioned peer to clear it.
+CHAOS_CONTROL_METHODS = frozenset((
+    "set_channel_chaos", "clear_channel_chaos", "list_channel_chaos",
+    "set_failpoints", "list_failpoints",
+))
+
+
+class _ChaosSevered(Exception):
+    """Internal: the chaos policy severed this connection after a
+    complete send (mapped to ConnectionLost with maybe_executed=True)."""
 
 
 def _send_msg(sock: socket.socket, obj: Any, codec: WireCodec) -> None:
@@ -334,6 +566,12 @@ class RpcClient:
     safe for idempotent calls (all head mutations are: tables are keyed by
     caller-generated ids and writes are last-write-wins)."""
 
+    # Reconnect backoff: jittered exponential, 50ms -> 1s cap (+/-25%).
+    # A flat retry interval synchronizes every reconnecting peer into
+    # thundering-herd rounds against a restarting head.
+    RECONNECT_BASE_S = 0.05
+    RECONNECT_CAP_S = 1.0
+
     def __init__(self, address: str, timeout: float = 60.0,
                  reconnect_window: float = 0.0,
                  token: bytes | None = None):
@@ -343,6 +581,10 @@ class RpcClient:
         self._token = get_cluster_token() if token is None else token
         self._local = threading.local()
         self._closed = False
+        # Chaos source tag: the owning endpoint's address (set by whoever
+        # created this client), matched against ChannelChaos rule src
+        # sets. None = untagged (matches only src-wildcard rules).
+        self.chaos_src: str | None = None
 
     def _codec(self) -> WireCodec:
         codec = getattr(self._local, "codec", None)
@@ -411,16 +653,74 @@ class RpcClient:
             time.monotonic() + self._reconnect_window
             if self._reconnect_window > 0 else None
         )
+        attempt = 0
         while True:
+            sever = duplicate = False
+            if channel_chaos.active and method not in CHAOS_CONTROL_METHODS:
+                rule = channel_chaos.match(
+                    self.chaos_src, self.address, method)
+                if rule is not None:
+                    if rule.action == "delay":
+                        time.sleep(channel_chaos.delay_draw(rule.arg))
+                    elif rule.action == "drop":
+                        # The request never reaches the peer. Surfaces
+                        # as ConnectionLost below so retry-windowed
+                        # callers keep probing (and succeed on heal).
+                        err = ConnectionLost(
+                            f"rpc {method} to {self.address}: "
+                            f"chaos drop (partitioned)")
+                        err.maybe_executed = False
+                        if (deadline is None or self._closed
+                                or time.monotonic() >= deadline):
+                            raise err
+                        attempt += 1
+                        self._reconnect_sleep(attempt)
+                        continue
+                    elif rule.action == "sever":
+                        sever = True
+                    elif rule.action == "duplicate":
+                        duplicate = True
             try:
-                return self._call_once(method, args, kwargs, timeout)
+                result = self._call_once(
+                    method, args, kwargs, timeout, chaos_sever=sever)
+                if duplicate:
+                    # Duplicate delivery: the same request again, reply
+                    # discarded — the receiver's dup-suppression is the
+                    # thing under test. Failures of the duplicate never
+                    # surface.
+                    try:
+                        self._call_once(method, args, kwargs, timeout)
+                    except (ConnectionLost, RpcError, OSError):
+                        pass
+                return result
             except ConnectionLost:
+                # Retrying ambiguous losses (maybe_executed) here is safe
+                # by this class's contract: reconnect_window is only set
+                # on clients whose calls are idempotent (head tables are
+                # keyed by caller-generated ids, last-write-wins).
                 if (deadline is None or self._closed
                         or time.monotonic() >= deadline):
                     raise
-                time.sleep(0.3)
+                attempt += 1
+                self._reconnect_sleep(attempt)
 
-    def _call_once(self, method: str, args, kwargs, timeout: float | None):
+    def _reconnect_sleep(self, attempt: int) -> None:
+        """Jittered exponential backoff between reconnect attempts, and
+        one counter tick so reconnect storms are visible on the
+        federated scrape."""
+        try:
+            from ray_tpu.util import metrics as _metrics
+
+            _metrics.RPC_RECONNECTS_TOTAL.inc(
+                tags={"peer": self.address})
+        except Exception:
+            pass
+        delay = min(self.RECONNECT_CAP_S,
+                    self.RECONNECT_BASE_S * (2 ** (attempt - 1)))
+        time.sleep(delay * random.uniform(0.75, 1.25))
+
+    def _call_once(self, method: str, args, kwargs, timeout: float | None,
+                   chaos_sever: bool = False):
         if self._closed:
             raise ConnectionLost(f"client to {self.address} is closed")
         try:
@@ -459,11 +759,17 @@ class RpcClient:
             req = {"m": method, "a": list(args), "k": kwargs}
             _send_msg(conn, req, codec)
             sent = True
+            if chaos_sever:
+                # Network chaos: the request is fully on the wire (the
+                # peer WILL execute it) but the reply path dies — the
+                # strongest form of the maybe_executed ambiguity.
+                raise _ChaosSevered(
+                    f"chaos sever after send of {method}")
             resp = _recv_msg(conn, codec)
             # (No "stream" handling here: without the "st" flag the
             # server drains generator handlers itself and replies with
             # one list-valued frame.)
-        except (OSError, EOFError, ConnectionLost) as e:
+        except (OSError, EOFError, ConnectionLost, _ChaosSevered) as e:
             self._drop_conn()
             err = ConnectionLost(f"rpc {method} to {self.address}: {e}")
             # Callers with non-idempotent requests need to know whether
@@ -492,6 +798,20 @@ class RpcClient:
         socket closes when the generator is exhausted or closed."""
         if self._closed:
             raise ConnectionLost(f"client to {self.address} is closed")
+        if channel_chaos.active and method not in CHAOS_CONTROL_METHODS:
+            rule = channel_chaos.match(
+                self.chaos_src, self.address, method,
+                actions=("drop", "delay"))
+            if rule is not None:
+                # Streams keep chaos simple: drop raises (a partitioned
+                # peer's stream can't start), delay defers the start;
+                # sever/duplicate don't apply to streaming calls.
+                if rule.action == "drop":
+                    raise ConnectionLost(
+                        f"stream {method} to {self.address}: "
+                        f"chaos drop (partitioned)")
+                if rule.action == "delay":
+                    time.sleep(channel_chaos.delay_draw(rule.arg))
         codec = WireCodec(allow_pickle=bool(self._token))
         try:
             conn = self._new_socket()

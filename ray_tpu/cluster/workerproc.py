@@ -26,6 +26,7 @@ import traceback
 
 from ray_tpu.cluster.rpc import RpcClient, RpcServer
 from ray_tpu.core import attribution
+from ray_tpu.util import failpoints
 from ray_tpu.core import serialization as ser
 from ray_tpu.core.cancellation import CancelRegistry
 from ray_tpu.core.object_ref import (
@@ -139,6 +140,14 @@ class WorkerHandler:
 
         self._fn_cache: "collections.OrderedDict[str, object]" = (
             collections.OrderedDict())
+        # Duplicate-delivery suppression for pushed calls (bounded,
+        # insertion-ordered): a caller that loses the REPLY to a push
+        # (sever-after-send chaos, network blip) retries the same spec —
+        # same task id — against this incarnation; accepting it twice
+        # would double user-visible side effects. An actor RESTART is a
+        # fresh process (empty set), so legitimate replay still runs.
+        self._seen_pushes: "collections.OrderedDict[str, bool]" = (
+            collections.OrderedDict())
         sys.stdout = _TeeStream(sys.stdout, self._log_lines, self._ev_lock)
         sys.stderr = _TeeStream(sys.stderr, self._log_lines, self._ev_lock)
         threading.Thread(target=self._event_flush_loop, daemon=True).start()
@@ -232,7 +241,25 @@ class WorkerHandler:
 
     # -- rpc surface (called by agent and by remote callers) ---------------
 
+    def _is_duplicate_push(self, spec: dict) -> bool:
+        """Record-and-test the spec's task id against pushes this process
+        already accepted (at-most-once admission per incarnation)."""
+        task_id = spec.get("task_id")
+        if not task_id:
+            return False
+        with self._ev_lock:
+            if task_id in self._seen_pushes:
+                return True
+            self._seen_pushes[task_id] = True
+            while len(self._seen_pushes) > 4096:
+                self._seen_pushes.popitem(last=False)
+        return False
+
     def rpc_push_task(self, spec: dict):
+        if self._is_duplicate_push(spec):
+            # Refused (False): the agent releases this dispatch's lease;
+            # the first delivery owns the task's fate.
+            return False
         self._q.put(("task", spec))
         return True
 
@@ -247,6 +274,11 @@ class WorkerHandler:
         return True
 
     def rpc_push_actor_task(self, spec: dict):
+        if self._is_duplicate_push(spec):
+            # The caller's retry after a lost reply (sever-after-send):
+            # the first delivery is (or was) executing — exactly-once
+            # observable effect per incarnation.
+            return True
         group = spec.get("concurrency_group")
         q = self._group_queues.get(group) if group else None
         if group and q is None:
@@ -268,6 +300,24 @@ class WorkerHandler:
 
     def rpc_ping(self):
         return "pong"
+
+    def rpc_set_failpoints(self, specs: dict):
+        """Arm/disarm failpoints in this worker process (the tail of the
+        head -> agents -> workers control-plane fanout)."""
+        return failpoints.set_failpoints(specs)
+
+    def rpc_list_failpoints(self):
+        return failpoints.list_armed()
+
+    def rpc_set_channel_chaos(self, rules: list, label: str = ""):
+        from ray_tpu.cluster.rpc import channel_chaos
+
+        return channel_chaos.add_rule_dicts(rules, label)
+
+    def rpc_clear_channel_chaos(self, label: str | None = None):
+        from ray_tpu.cluster.rpc import channel_chaos
+
+        return channel_chaos.clear(label)
 
     # -- stack introspection (reporter-agent py-spy analog, in-process) ----
 
@@ -501,6 +551,11 @@ class WorkerHandler:
             args, kwargs = ser.loads(spec["args"])
             args, kwargs = self._resolve(args, kwargs)
             clock.lap("get_args")
+            # Chaos sites inside the try: a raise-action failpoint is
+            # stored as the task's error (visible, retryable), a kill
+            # action crashes the process mid-protocol — both the faults
+            # the owner-side recovery machinery must absorb.
+            failpoints.hit("worker.execute.before")
             # Attribution context: puts made while the task runs (its
             # returns AND nested ray_tpu.put calls in user code) carry
             # the creating task's name.
@@ -519,6 +574,7 @@ class WorkerHandler:
                 clock.lap("execute")
                 self._store_result(spec, result)
                 clock.lap("put_outputs")
+                failpoints.hit("worker.execute.after")
         except BaseException as e:  # noqa: BLE001 — stored, not dropped
             err = repr(e)
             if isinstance(e, (TaskError, ActorError)):
@@ -625,6 +681,7 @@ class WorkerHandler:
             args, kwargs = ser.loads(spec["args"])
             args, kwargs = self._resolve(args, kwargs)
             clock.lap("get_args")
+            failpoints.hit("worker.execute.before")
             if asyncio.iscoroutinefunction(
                     getattr(method, "__func__", method)):
                 coro = method(*args, **kwargs)
@@ -689,6 +746,7 @@ class WorkerHandler:
                         spec.get("callsite")):
                     self._store_result(spec, f.result())
                 clock.lap("put_outputs")
+                failpoints.hit("worker.execute.after")
             except BaseException as e:  # noqa: BLE001
                 err = repr(e)
                 if isinstance(e, (TaskError, ActorError)):
@@ -741,6 +799,7 @@ class WorkerHandler:
             args, kwargs = ser.loads(spec["args"])
             args, kwargs = self._resolve(args, kwargs)
             clock.lap("get_args")
+            failpoints.hit("worker.execute.before")
             method = getattr(self._actor_instance, spec["method"])
             with attribution.task_context(
                     spec.get("method", "actor_task"),
@@ -749,6 +808,7 @@ class WorkerHandler:
                 clock.lap("execute")
                 self._store_result(spec, result)
                 clock.lap("put_outputs")
+                failpoints.hit("worker.execute.after")
         except BaseException as e:  # noqa: BLE001
             err = repr(e)
             if isinstance(e, (TaskError, ActorError)):

@@ -118,6 +118,12 @@ _DEFS: dict[str, tuple[type, Any]] = {
     # Cloud hook: metadata endpoint polled for a termination notice
     # (GCE: .../computeMetadata/v1/instance/preempted returns "TRUE").
     "preemption_metadata_url": (str, ""),
+    # -- chaos / fault injection -------------------------------------------
+    # One seed for ALL chaos randomness (failpoint probability RNGs,
+    # network-chaos delay/jitter draws, soak schedules, the chaos test's
+    # victim choice) so any chaos run replays from one env var. 0 =
+    # unseeded (OS entropy).
+    "chaos_seed": (int, 0),
     # -- pubsub ------------------------------------------------------------
     "pubsub_max_buffer": (int, 10_000),
     "pubsub_subscriber_ttl_s": (float, 120.0),

@@ -228,7 +228,12 @@ class LocalBackend:
         self._pool = _DaemonPool(max_workers=max(64, self._ncpu * 8))
         self._objects: dict[str, _Entry] = {}
         self._refcounts: dict[str, int] = {}
-        self._objects_lock = threading.Lock()
+        # MUST be reentrant: ObjectRef finalizers call _decref, and a GC
+        # pass can fire them on whatever thread happens to allocate —
+        # including one already inside this lock (e.g. _entry building a
+        # threading.Event). A plain Lock self-deadlocks the whole
+        # backend when that happens.
+        self._objects_lock = threading.RLock()
         self._actors: dict[str, _ActorState] = {}
         self._named_actors: dict[str, str] = {}
         self._lock = threading.Lock()
@@ -671,8 +676,13 @@ class LocalBackend:
 
         now = _time.time()
         with self._objects_lock:
+            # Snapshot first: building the per-object dicts below
+            # allocates, which can trigger GC -> an ObjectRef finalizer
+            # -> a reentrant _decref (the lock is an RLock for exactly
+            # that reason) deleting from the live table mid-iteration.
+            items = list(self._objects.items())
             out = []
-            for oid, entry in self._objects.items():
+            for oid, entry in items:
                 attr = entry.attr or {}
                 created = attr.get("created_at")
                 out.append({

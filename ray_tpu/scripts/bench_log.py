@@ -126,6 +126,42 @@ def record_memory_pressure(samples: list, *, device: str = "",
     return entry
 
 
+def record_chaos_soak(*, seed, duration_s: float, faults: dict,
+                      violations: list, mttr_ms: list,
+                      tasks_ok: int, actor_calls_ok: int, puts_ok: int,
+                      device: str = "", path: str | None = None,
+                      **extra) -> dict:
+    """Chaos-soak evidence (``scripts/chaos_soak.py``): the seeded fault
+    schedule's class counts, invariant violations (must be [] for a
+    passing soak), and per-fault MTTR (fault injection -> first
+    successful probe round-trip). Committed to the evidence trail only
+    on an accelerator; returns the entry (with ``committed_to``) either
+    way. The seed makes any line replayable:
+    ``RAY_TPU_CHAOS_SEED=<seed> python -m ray_tpu.scripts.chaos_soak``."""
+    entry: dict = {
+        "bench": "chaos_soak",
+        "device": device,
+        "seed": seed,
+        "duration_s": round(float(duration_s), 1),
+        "faults": dict(faults),
+        "faults_injected": sum(faults.values()),
+        "violations": list(violations),
+        "n_violations": len(violations),
+        "tasks_ok": tasks_ok,
+        "actor_calls_ok": actor_calls_ok,
+        "puts_ok": puts_ok,
+    }
+    if mttr_ms:
+        entry["mttr_ms"] = {
+            "mean": round(sum(mttr_ms) / len(mttr_ms), 1),
+            "max": round(max(mttr_ms), 1),
+            "n": len(mttr_ms),
+        }
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
 def record_drain_recovery(proactive_drain_ms: float,
                           crash_detection_ms: float, *,
                           device: str = "", path: str | None = None,

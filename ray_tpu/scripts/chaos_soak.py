@@ -1,0 +1,462 @@
+"""Chaos soak harness: a mixed workload under a seeded fault schedule.
+
+The standing adversarial test for the recovery machinery (drain
+protocol, lineage re-execution, retry-budget exemption, actor
+reconstruction, RPC reconnect windows): drive tasks, restartable
+actors, and puts/gets on a multi-node ``Cluster`` while a seeded
+scheduler injects faults from ≥4 classes —
+
+  * **partition** — symmetric drop rules head↔victim
+    (``Cluster.partition``), healed inside the heartbeat-death window;
+  * **delay** — a delay-range rule on every RPC to a victim agent;
+  * **sever** — sever-after-send on agent→head traffic (the
+    ``maybe_executed`` ambiguity path) at p<1;
+  * **kill** — ``Cluster.kill_node`` on a victim (heartbeat-timeout
+    death; lineage re-execution + actor reconstruction), with a
+    replacement node added so capacity survives;
+  * **failpoints** — raise/delay arms at absorbed sites
+    (event-batch upload, head snapshot, client ref flush);
+
+plus exactly one graceful drain carrying a ``max_retries=0`` probe task
+(the retry-budget-exemption invariant). Everything is derived from ONE
+seed (``--seed`` / ``RAY_TPU_CHAOS_SEED``): the same seed replays the
+same fault schedule, and the seed is printed on failure.
+
+Invariants checked after the run settles:
+
+  1. every driver-visible result is correct (tasks, actor calls, puts);
+  2. the drain-exempt ``max_retries=0`` task completed (budgets burn
+     only for non-exempt causes);
+  3. ``state.memory_leaks()`` is empty;
+  4. the federated ``/metrics/cluster`` body still scrapes;
+  5. the head directory is consistent with the agent stores (no
+     location on a dead node; per-node store reports join cleanly).
+
+Usage::
+
+    python -m ray_tpu.scripts.chaos_soak --seed 7 --duration 20
+
+``bench_log.record_chaos_soak`` prints the evidence line (committed to
+BENCH_TPU_SESSIONS.jsonl only on an accelerator).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import threading
+import time
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return ""
+
+
+class _Soak:
+    def __init__(self, seed: int, duration_s: float, n_victims: int = 2):
+        self.seed = seed
+        self.duration_s = duration_s
+        self.n_victims = n_victims
+        self.rng = random.Random(f"{seed}:soak-schedule")
+        self.faults: dict[str, int] = {}
+        self.violations: list[str] = []
+        self.mttr_ms: list[float] = []
+        self.tasks_ok = 0
+        self.actor_calls_ok = 0
+        self.puts_ok = 0
+        self._stop = threading.Event()
+        # The graceful-drain victim: the fault injector must not kill or
+        # partition the node the drain (and its retry-exemption probe)
+        # is pinned to — that would be the harness racing itself, not a
+        # system fault.
+        self._drain_victim = None
+
+    # -- fault injection ---------------------------------------------------
+
+    def _probe_mttr(self, fault: str, t_fault: float,
+                    victim_node_id: str | None = None) -> None:
+        """Time from fault injection to the next successful round trip
+        THROUGH the faulted path: pinned to the victim node while it
+        lives (default scheduling would stay on the driver's node and
+        measure nothing), SPREAD across the survivors after a kill."""
+        import ray_tpu
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        @ray_tpu.remote(max_retries=3)
+        def _probe():
+            return "ok"
+
+        def strategy():
+            # Re-evaluated every round: the victim can die (a drain or
+            # kill racing this probe) mid-wait, and pinning every
+            # remaining round to a corpse would read as a violation.
+            if victim_node_id is not None:
+                try:
+                    if any(n["NodeID"] == victim_node_id and n["Alive"]
+                           for n in ray_tpu.nodes()):
+                        return NodeAffinitySchedulingStrategy(
+                            victim_node_id)
+                except Exception:
+                    pass
+            return "SPREAD"
+
+        # Generous deadline: on a saturated CI box, kill recovery is
+        # death-detection (~5s) + worker cold-forks, which stretches
+        # arbitrarily under load — a tight bound here reads as a fake
+        # invariant violation.
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if self._stop.is_set():
+                return  # soak is settling: don't probe a closing cluster
+            try:
+                ref = _probe.options(
+                    scheduling_strategy=strategy()).remote()
+                if ray_tpu.get(ref, timeout=10.0) == "ok":
+                    self.mttr_ms.append(
+                        (time.monotonic() - t_fault) * 1e3)
+                    return
+            except Exception:
+                pass
+            time.sleep(0.1)
+        if not self._stop.is_set():
+            self.violations.append(
+                f"{fault}: no successful probe within 120s of injection")
+
+    def _fault_loop(self, cluster) -> None:
+        from ray_tpu.cluster.rpc import channel_chaos
+        from ray_tpu.util import failpoints
+
+        classes = ["partition", "delay", "sever", "kill", "failpoint"]
+        # One kill max per soak (each kill spends a node + respawn);
+        # everything else repeats on the seeded schedule.
+        killed = False
+        while not self._stop.is_set():
+            time.sleep(self.rng.uniform(1.0, 2.5))
+            if self._stop.is_set():
+                return
+            victims = [n for n in cluster.nodes[1:]  # node 0 = driver's
+                       if n is not self._drain_victim]
+            if not victims:
+                continue
+            victim = self.rng.choice(victims)
+            fault = self.rng.choice(classes)
+            if fault == "kill" and killed:
+                fault = "partition"
+            t0 = time.monotonic()
+            try:
+                if fault == "partition":
+                    # Shorter than the heartbeat-death window: the cut
+                    # must be invisible to the application.
+                    cluster.partition([["head"], [victim]])
+                    time.sleep(self.rng.uniform(0.5, 2.0))
+                    cluster.heal()
+                elif fault == "delay":
+                    rid = channel_chaos.add_rule(
+                        "delay", dst=[victim.address],
+                        arg=(0.005, 0.05), label="soak")
+                    time.sleep(self.rng.uniform(1.0, 3.0))
+                    channel_chaos.remove(rid)
+                elif fault == "sever":
+                    rid = channel_chaos.add_rule(
+                        "sever", src=[victim.address],
+                        dst=[cluster.head.address],
+                        prob=0.3, label="soak")
+                    time.sleep(self.rng.uniform(1.0, 3.0))
+                    channel_chaos.remove(rid)
+                elif fault == "kill":
+                    killed = True
+                    cluster.kill_node(victim)
+                    cluster.add_node(num_cpus=4)  # replacement capacity
+                elif fault == "failpoint":
+                    arm = self.rng.choice([
+                        {"agent.worker_events.upload": "raise,p=0.3"},
+                        {"head.snapshot.before_persist": "raise"},
+                        {"client.flush_refs.before": "delay:0.02"},
+                        {"agent.heartbeat": "delay:0.2"},
+                    ])
+                    failpoints.set_failpoints(arm)
+                    time.sleep(self.rng.uniform(1.0, 3.0))
+                    failpoints.set_failpoints(
+                        {site: None for site in arm})
+            except Exception as e:
+                self.violations.append(f"injecting {fault}: {e!r}")
+                continue
+            self.faults[fault] = self.faults.get(fault, 0) + 1
+            self._probe_mttr(
+                fault, t0,
+                victim_node_id=None if fault == "kill"
+                else victim.node_id)
+
+    # -- workload ----------------------------------------------------------
+
+    def _workload(self, cluster, deadline: float) -> None:
+        import ray_tpu
+
+        @ray_tpu.remote
+        def work(i):
+            time.sleep(0.02)
+            return i * i
+
+        @ray_tpu.remote
+        class Tally:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return 1
+
+        actors = [Tally.options(max_restarts=-1,
+                                max_task_retries=-1).remote()
+                  for _ in range(2)]
+        rng = random.Random(f"{self.seed}:workload")
+        batch = 0
+        while time.monotonic() < deadline:
+            batch += 1
+            n = rng.randint(6, 12)
+            # SPREAD so the tasks actually land on victim nodes (the
+            # default hybrid policy would keep them on the driver's).
+            refs = [work.options(scheduling_strategy="SPREAD").remote(i)
+                    for i in range(n)]
+            call_refs = [a.bump.remote() for a in actors]
+            payload = os.urandom(rng.randint(1 << 10, 64 << 10))
+            put_ref = ray_tpu.put(payload)
+            try:
+                results = ray_tpu.get(refs, timeout=120.0)
+                if results != [i * i for i in range(n)]:
+                    self.violations.append(
+                        f"batch {batch}: wrong task results {results!r}")
+                else:
+                    self.tasks_ok += n
+                for r in ray_tpu.get(call_refs, timeout=120.0):
+                    if r != 1:
+                        self.violations.append(
+                            f"batch {batch}: actor call returned {r!r}")
+                    else:
+                        self.actor_calls_ok += 1
+                back = ray_tpu.get(put_ref, timeout=60.0)
+                if back != payload:
+                    self.violations.append(
+                        f"batch {batch}: put/get roundtrip corrupted")
+                else:
+                    self.puts_ok += 1
+            except Exception as e:
+                self.violations.append(
+                    f"batch {batch}: driver-visible error {e!r}")
+            del put_ref
+
+    def _drain_once(self, cluster) -> None:
+        """One graceful drain mid-soak with a budget-exemption probe: a
+        max_retries=0 task pinned to the drained node must complete."""
+        import ray_tpu
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        victims = cluster.nodes[1:]
+        if not victims:
+            return
+        victim = self.rng.choice(victims)
+        self._drain_victim = victim  # injector steers clear of it
+
+        @ray_tpu.remote(max_retries=0)
+        def fragile():
+            time.sleep(1.5)
+            return "exempt-ok"
+
+        ref = fragile.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                victim.node_id)).remote()
+        time.sleep(0.4)  # in flight on the victim
+        try:
+            res = cluster.head.rpc_drain_node(
+                victim.node_id, "soak-drain", 1.0)
+            if not res.get("ok"):
+                self.violations.append(f"drain refused: {res!r}")
+            if victim in cluster.nodes:
+                cluster.nodes.remove(victim)
+                victim.stop()
+            if ray_tpu.get(ref, timeout=120.0) != "exempt-ok":
+                self.violations.append(
+                    "drain-exempt task returned wrong value")
+        except Exception as e:
+            self.violations.append(
+                f"retry-budget exemption violated (max_retries=0 task "
+                f"lost to a drain did not complete): {e!r}")
+        self.faults["drain"] = self.faults.get("drain", 0) + 1
+
+    # -- invariants --------------------------------------------------------
+
+    def _check_invariants(self, cluster) -> None:
+        from ray_tpu import state
+
+        # Leak sweeper: nothing flagged after settle.
+        try:
+            leaks = state.memory_leaks()
+            if leaks:
+                self.violations.append(
+                    f"memory_leaks non-empty after settle: "
+                    f"{[r['object_id'][:16] for r in leaks]}")
+        except Exception as e:
+            self.violations.append(f"memory_leaks unreachable: {e!r}")
+        # Federated scrape still serves the whole cluster.
+        try:
+            from ray_tpu.cluster.gcs_client import GcsClient
+
+            gcs = GcsClient(cluster.address)
+            try:
+                body = gcs.metrics.cluster_text()
+            finally:
+                gcs.close()
+            if "ray_tpu_" not in body:
+                self.violations.append(
+                    "federated /metrics/cluster body has no ray_tpu_ "
+                    "series")
+        except Exception as e:
+            self.violations.append(f"/metrics/cluster scrape: {e!r}")
+        # Head directory consistent with the agent stores: no location
+        # pointing at a dead node, and the per-node store reports join.
+        try:
+            alive = {n["NodeID"] for n in state.nodes() if n["Alive"]}
+            for rec in state.list_objects(limit=10_000):
+                stale = set(rec.get("locations") or ()) - alive
+                if stale:
+                    self.violations.append(
+                        f"directory entry {rec['object_id'][:16]} "
+                        f"located on dead node(s) {sorted(stale)}")
+            for rep in state.object_store_stats():
+                if rep.get("node_id") not in alive:
+                    self.violations.append(
+                        f"store report from non-alive node "
+                        f"{rep.get('node_id')!r}")
+        except Exception as e:
+            self.violations.append(f"directory/store check: {e!r}")
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self) -> dict:
+        import ray_tpu
+        from ray_tpu.cluster.cluster_utils import Cluster
+        from ray_tpu.core.config import config
+        from ray_tpu.scripts import bench_log
+
+        # One knob seeds every chaos RNG in this process AND (via env)
+        # every process the cluster spawns; restored on exit so an
+        # in-process caller doesn't inherit the soak's seed.
+        prev_env_seed = os.environ.get("RAY_TPU_CHAOS_SEED")
+        os.environ["RAY_TPU_CHAOS_SEED"] = str(self.seed)
+        config.override("chaos_seed", self.seed)
+        try:
+            return self._run_seeded(ray_tpu, Cluster, bench_log)
+        finally:
+            if prev_env_seed is None:
+                os.environ.pop("RAY_TPU_CHAOS_SEED", None)
+            else:
+                os.environ["RAY_TPU_CHAOS_SEED"] = prev_env_seed
+            config.reset("chaos_seed")
+
+    def _run_seeded(self, ray_tpu, Cluster, bench_log) -> dict:
+        ray_tpu.shutdown()
+        cluster = Cluster()
+        cluster.add_node(num_cpus=4)  # driver node: survives
+        for _ in range(self.n_victims):
+            cluster.add_node(num_cpus=4)
+        cluster.wait_for_nodes()
+        ray_tpu.init(cluster.address)
+        deadline = time.monotonic() + self.duration_s
+        injector = threading.Thread(
+            target=self._fault_loop, args=(cluster,), daemon=True)
+        injector.start()
+        try:
+            # First third: faults only; then one graceful drain rides
+            # along; workload runs throughout.
+            workload = threading.Thread(
+                target=self._workload, args=(cluster, deadline),
+                daemon=True)
+            workload.start()
+            time.sleep(min(self.duration_s / 3.0, 10.0))
+            self._drain_once(cluster)
+            workload.join(timeout=self.duration_s + 180.0)
+            if workload.is_alive():
+                self.violations.append("workload wedged past deadline")
+            # Fault quota: a soak that recovered slowly (MTTR probes
+            # stretch the schedule on a loaded box) keeps injecting —
+            # bounded — until at least 4 DISTINCT fault classes landed
+            # (the drain rides along and doesn't count), so a short run
+            # still earns its adversarial coverage instead of passing on
+            # e.g. three delays and nothing else.
+            quota_deadline = time.monotonic() + 2 * self.duration_s
+            while (len(set(self.faults) - {"drain"}) < 4
+                   and not self.violations
+                   and time.monotonic() < quota_deadline):
+                time.sleep(0.5)
+        finally:
+            self._stop.set()
+            # The injector's MTTR probe can run up to 120s per fault;
+            # the join must outlast it or an orphaned probe records
+            # spurious violations into a settling cluster.
+            injector.join(timeout=150.0)
+        # Settle: heal everything, let frees/heartbeats drain.
+        cluster.heal()
+        from ray_tpu.cluster.rpc import channel_chaos
+        from ray_tpu.util import failpoints
+
+        channel_chaos.clear("soak")
+        failpoints.reset()
+        time.sleep(2.0)
+        self._check_invariants(cluster)
+        entry = bench_log.record_chaos_soak(
+            seed=self.seed,
+            duration_s=self.duration_s,
+            faults=self.faults,
+            violations=self.violations,
+            mttr_ms=self.mttr_ms,
+            tasks_ok=self.tasks_ok,
+            actor_calls_ok=self.actor_calls_ok,
+            puts_ok=self.puts_ok,
+            device=_device_kind(),
+            script="chaos_soak",
+        )
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        return entry
+
+
+def run(seed: int, duration_s: float = 20.0, n_victims: int = 2) -> dict:
+    return _Soak(seed, duration_s, n_victims).run()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int,
+                        default=int(os.environ.get(
+                            "RAY_TPU_CHAOS_SEED", "0")) or None,
+                        help="chaos seed (default: RAY_TPU_CHAOS_SEED, "
+                             "else random)")
+    parser.add_argument("--duration", type=float, default=20.0)
+    parser.add_argument("--victims", type=int, default=2)
+    args = parser.parse_args(argv)
+    seed = args.seed if args.seed is not None \
+        else random.SystemRandom().randrange(1 << 31)
+    entry = run(seed, args.duration, args.victims)
+    print(json.dumps(entry, default=str))
+    if entry["n_violations"]:
+        print(f"CHAOS SOAK FAILED ({entry['n_violations']} violations); "
+              f"replay with RAY_TPU_CHAOS_SEED={seed}", flush=True)
+        return 1
+    print(f"chaos soak passed: {entry['faults_injected']} faults "
+          f"({entry['faults']}), seed={seed}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -352,6 +352,71 @@ def cmd_metrics(args):
     sys.stdout.write(backend.cluster_metrics_text())
 
 
+def cmd_chaos(args):
+    """Deterministic fault injection: arm/disarm failpoints cluster-wide
+    and manage network-chaos partitions (``GcsClient.chaos``)."""
+    if not args.address:
+        raise SystemExit("chaos requires --address <head>")
+    from ray_tpu.cluster.gcs_client import GcsClient
+
+    gcs = GcsClient(args.address)
+    try:
+        op = args.op
+        if op == "list":
+            print(json.dumps({
+                "failpoints": gcs.chaos.list(),
+                "channel_chaos": gcs.chaos.list_channel_chaos(),
+            }, indent=2, default=str))
+        elif op == "arm":
+            if not args.site or not args.spec:
+                raise SystemExit("chaos arm <site> <spec>")
+            print(json.dumps(gcs.chaos.arm(args.site, args.spec),
+                             indent=2, default=str))
+        elif op == "disarm":
+            if args.all:
+                sites = set()
+
+                def walk(table):
+                    # Armed tables nest per process ({"head": {...},
+                    # node: {"agent": {...}, worker: {...}}}); a site's
+                    # leaf record always carries its "spec".
+                    for key, val in (table or {}).items():
+                        if not isinstance(val, dict):
+                            continue
+                        if "spec" in val and "site" in val:
+                            sites.add(key)
+                        else:
+                            walk(val)
+
+                walk(gcs.chaos.list())
+                print(json.dumps(gcs.chaos.set_failpoints(
+                    {s: None for s in sites}), indent=2, default=str))
+            elif args.site:
+                print(json.dumps(gcs.chaos.disarm(args.site),
+                                 indent=2, default=str))
+            else:
+                raise SystemExit("chaos disarm <site> (or --all)")
+        elif op == "partition":
+            # Groups arrive via --groups, but the first two also land in
+            # the (site, spec) positional slots when given bare.
+            raw = list(args.groups or ())
+            if not raw:
+                raw = [g for g in (args.site, args.spec) if g]
+            if len(raw) < 2:
+                raise SystemExit(
+                    "chaos partition <group> <group> ... — each group a "
+                    "comma-separated list of node ids (or 'head')")
+            groups = [g.split(",") for g in raw]
+            print(json.dumps(gcs.chaos.partition(groups),
+                             indent=2, default=str))
+        elif op == "heal":
+            print(json.dumps(gcs.chaos.heal(), indent=2, default=str))
+        else:
+            raise SystemExit(f"unknown chaos op {op!r}")
+    finally:
+        gcs.close()
+
+
 def cmd_submit(args):
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -518,6 +583,24 @@ def main(argv=None):
                    help="instead write a prometheus file-SD targets "
                         "document here")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "chaos",
+        help="deterministic fault injection: failpoints + partitions")
+    p.add_argument("op",
+                   choices=["list", "arm", "disarm", "partition", "heal"])
+    p.add_argument("site", nargs="?", default=None,
+                   help="failpoint site (arm/disarm)")
+    p.add_argument("spec", nargs="?", default=None,
+                   help="failpoint spec, e.g. 'raise,once' / 'delay:0.2' "
+                        "/ 'kill,p=0.1' (arm)")
+    p.add_argument("--all", action="store_true",
+                   help="disarm: clear every armed site")
+    p.add_argument("--groups", nargs="*", default=None,
+                   help="partition: comma-separated node ids per group "
+                        "(use 'head' for the head), e.g. "
+                        "--groups head,node-a node-b")
+    p.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser("submit", help="submit a job entrypoint")
     p.add_argument("--wait", action="store_true")

@@ -203,6 +203,52 @@ def device_stats(fresh: bool = False) -> List[dict]:
     return []
 
 
+def set_failpoints(specs: dict, include_workers: bool = True) -> dict:
+    """Arm/disarm deterministic failpoints cluster-wide: ``{site: spec}``
+    where spec is ``action[:arg][,selector...]`` (see
+    ``ray_tpu.util.failpoints``; a falsy spec disarms the site). On a
+    cluster backend the specs fan out head -> agents -> live workers;
+    on the local backend they arm this process directly."""
+    backend = _worker.backend()
+    if hasattr(backend, "set_failpoints"):
+        return backend.set_failpoints(specs, include_workers)
+    from ray_tpu.util import failpoints as _fp
+
+    return {"local": _fp.set_failpoints(specs)}
+
+
+def list_failpoints() -> dict:
+    """Armed failpoints per cluster process (head, agents, workers)."""
+    backend = _worker.backend()
+    if hasattr(backend, "list_failpoints"):
+        return backend.list_failpoints()
+    from ray_tpu.util import failpoints as _fp
+
+    return {"local": _fp.list_armed()}
+
+
+def set_channel_chaos(rules: list, label: str = "") -> dict:
+    """Arm network-chaos rules on the RPC plane: the head, every alive
+    agent, and (best-effort) each agent's live workers — workers tag
+    their clients with their node's identity, so node-keyed partition
+    rules cut worker-originated traffic too. The calling driver's own
+    process arms via ``Cluster.partition``/``rpc.channel_chaos``
+    directly. Rule dicts: action=delay|drop|duplicate|sever, src/dst
+    address lists, method, arg, prob, times. Faults surface as
+    ``ConnectionLost``, never silent corruption."""
+    backend = _worker.backend()
+    if hasattr(backend, "set_channel_chaos"):
+        return backend.set_channel_chaos(rules, label)
+    raise ValueError("network chaos requires a cluster backend")
+
+
+def clear_channel_chaos(label: Optional[str] = None) -> dict:
+    backend = _worker.backend()
+    if hasattr(backend, "clear_channel_chaos"):
+        return backend.clear_channel_chaos(label)
+    raise ValueError("network chaos requires a cluster backend")
+
+
 def capture_profile(worker_id: Optional[str] = None,
                     duration_s: float = 1.0, interval_s: float = 0.01,
                     out_dir: Optional[str] = None,

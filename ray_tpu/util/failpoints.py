@@ -1,0 +1,245 @@
+"""Deterministic failpoints: named fault-injection sites.
+
+Reference parity: the reference ships deterministic delay injection into
+its event loop (``RAY_testing_asio_delay_us``, ``ray_config_def.h:706``)
+plus chaos node-killer tests; this module generalizes that into named,
+cluster-armable failpoints (the FreeBSD ``fail(9)`` / Rust ``fail-rs``
+idiom). Load-bearing code paths call::
+
+    from ray_tpu.util import failpoints
+    ...
+    failpoints.hit("agent.dispatch.before_push")
+
+which is **zero-cost when unarmed** — one module-level dict truthiness
+check, no locks, no allocation — so sites stay compiled into production
+paths permanently.
+
+Arming
+------
+* Environment (inherited by every spawned worker/agent process)::
+
+      RAY_TPU_FAILPOINTS="agent.heartbeat=delay:0.5;client.recover.before_resubmit=raise,once"
+
+* Runtime, cluster-wide, over the control plane:
+  ``state.set_failpoints({...})`` / ``ray-tpu chaos arm`` →
+  head ``rpc_set_failpoints`` → every agent → every live worker.
+
+Spec grammar (one failpoint per site)::
+
+    <action>[:<arg>][,<selector>...]
+
+actions:
+    raise[:message]   raise FailpointError(message) at the site
+    delay:<seconds>   sleep that long, then continue
+    hang[:<seconds>]  block (until disarmed, max <seconds>, default 60)
+    kill              os._exit(1) — a hard process crash mid-protocol
+    off               no-op (placeholder; equivalent to disarmed)
+
+selectors (combinable):
+    p=<float>         fire with this probability per hit (seeded RNG)
+    nth=<int>         fire only on the N-th hit of the site (1-based)
+    once              disarm the site after its first firing
+
+All chaos randomness (failpoint probability, soak schedules, jitter in
+network chaos) seeds from one knob — ``RAY_TPU_CHAOS_SEED`` — via
+:func:`seeded_rng`, so any chaos repro is one env var away.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Optional
+
+# site -> _Failpoint. `hit()` gates on plain truthiness of this dict:
+# the unarmed fast path must never take a lock.
+_ARMED: dict = {}
+_lock = threading.Lock()
+
+
+class FailpointError(RuntimeError):
+    """The error a ``raise``-action failpoint injects."""
+
+
+def effective_seed() -> Optional[int]:
+    """The chaos seed in effect (``RAY_TPU_CHAOS_SEED``), or None when
+    chaos randomness is unseeded. Printed by harnesses on failure so a
+    repro is one env var away."""
+    from ray_tpu.core.config import config
+
+    seed = config.chaos_seed
+    return int(seed) if seed else None
+
+
+def seeded_rng(salt: str = "") -> random.Random:
+    """A ``random.Random`` for chaos decisions: deterministic from
+    ``RAY_TPU_CHAOS_SEED`` (+ a per-consumer salt so independent
+    consumers don't replay each other's streams), OS entropy when the
+    knob is unset."""
+    seed = effective_seed()
+    if seed is None:
+        return random.Random()
+    return random.Random(f"{seed}:{salt}")
+
+
+class _Failpoint:
+    __slots__ = ("site", "action", "arg", "prob", "nth", "once",
+                 "hits", "fired", "rng", "spec")
+
+    def __init__(self, site: str, spec: str):
+        self.site = site
+        self.spec = spec
+        head, *selectors = [p.strip() for p in spec.split(",")]
+        action, _, arg = head.partition(":")
+        action = action.strip().lower()
+        if action not in ("raise", "delay", "hang", "kill", "off"):
+            raise ValueError(
+                f"failpoint {site!r}: unknown action {action!r} "
+                f"(want raise|delay|hang|kill|off)")
+        self.action = action
+        self.arg = arg
+        if action == "delay":
+            self.arg = float(arg or 0.05)
+        elif action == "hang":
+            self.arg = float(arg or 60.0)
+        self.prob: Optional[float] = None
+        self.nth: Optional[int] = None
+        self.once = False
+        for sel in selectors:
+            if not sel:
+                continue
+            if sel == "once":
+                self.once = True
+            elif sel.startswith("p="):
+                self.prob = float(sel[2:])
+            elif sel.startswith("nth="):
+                self.nth = int(sel[4:])
+            else:
+                raise ValueError(
+                    f"failpoint {site!r}: unknown selector {sel!r}")
+        self.hits = 0
+        self.fired = 0
+        self.rng = seeded_rng("failpoint:" + site)
+
+    def should_fire(self) -> bool:
+        """Caller holds _lock. Applies selectors against the hit count."""
+        self.hits += 1
+        if self.nth is not None and self.hits != self.nth:
+            return False
+        if self.prob is not None and self.rng.random() >= self.prob:
+            return False
+        self.fired += 1
+        return True
+
+    def describe(self) -> dict:
+        return {"site": self.site, "spec": self.spec,
+                "hits": self.hits, "fired": self.fired}
+
+
+def hit(site: str) -> None:
+    """Fault-injection site. No-op (one dict check) unless armed."""
+    if not _ARMED:
+        return
+    fp = _ARMED.get(site)
+    if fp is None:
+        return
+    with _lock:
+        # Re-read under the lock: a concurrent disarm must win.
+        fp = _ARMED.get(site)
+        if fp is None or not fp.should_fire():
+            return
+        action, arg = fp.action, fp.arg
+        if fp.once and action != "hang":
+            # `hang,once` keeps the site armed THROUGH the hang (the
+            # hang loop's release condition is "site disarmed") and
+            # auto-disarms after it; everything else disarms now.
+            _ARMED.pop(site, None)
+    if action == "off":
+        return
+    if action == "raise":
+        raise FailpointError(arg or f"failpoint {site}")
+    if action == "delay":
+        time.sleep(arg)
+        return
+    if action == "hang":
+        deadline = time.monotonic() + arg
+        try:
+            while time.monotonic() < deadline:
+                if site not in _ARMED:  # disarm releases the hang
+                    return
+                time.sleep(0.05)
+        finally:
+            if fp.once:
+                with _lock:
+                    if _ARMED.get(site) is fp:
+                        _ARMED.pop(site, None)
+        return
+    if action == "kill":
+        os._exit(1)
+
+
+def arm(site: str, spec: str) -> None:
+    """Arm (or re-arm) one site. The spec is validated here, so a bad
+    spec fails at arm time at the control plane, never inside a site."""
+    fp = _Failpoint(site, spec)
+    with _lock:
+        _ARMED[site] = fp
+
+
+def disarm(site: str) -> bool:
+    with _lock:
+        return _ARMED.pop(site, None) is not None
+
+
+def reset() -> None:
+    """Disarm everything (test teardown / `ray-tpu chaos disarm --all`)."""
+    with _lock:
+        _ARMED.clear()
+
+
+def set_failpoints(specs: dict) -> dict:
+    """Batch arm/disarm: ``{site: spec}``; a None/"" spec disarms the
+    site. Returns the surviving armed table (``list_armed()``).
+
+    All-or-nothing: every spec is parsed before any table mutation, so
+    one invalid spec in a batch cannot leave this process (or, through
+    the head's fanout, the cluster) partially armed."""
+    parsed = [(site, _Failpoint(site, spec) if spec else None)
+              for site, spec in (specs or {}).items()]
+    with _lock:
+        for site, fp in parsed:
+            if fp is None:
+                _ARMED.pop(site, None)
+            else:
+                _ARMED[site] = fp
+    return list_armed()
+
+
+def list_armed() -> dict:
+    """{site: {spec, hits, fired}} snapshot of this process's table."""
+    with _lock:
+        return {site: fp.describe() for site, fp in _ARMED.items()}
+
+
+def arm_from_env() -> None:
+    """Arm from ``RAY_TPU_FAILPOINTS`` (``site=spec;site=spec``): read at
+    import so spawned workers/agents inherit armed sites through their
+    environment with no control-plane round trip."""
+    raw = os.environ.get("RAY_TPU_FAILPOINTS", "")
+    if not raw:
+        return
+    for part in raw.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, spec = part.partition("=")
+        try:
+            arm(site.strip(), spec.strip())
+        except ValueError:
+            # A bad env spec must not take the process down at import.
+            continue
+
+
+arm_from_env()

@@ -273,6 +273,15 @@ NODE_DRAIN_ACTORS_MIGRATED = Counter(
     tag_keys=("reason",),
 )
 
+# -- RPC plane (client-side; one increment per reconnect attempt a
+# retry-windowed call makes after losing its connection — a reconnect
+# storm against one peer is visible on the federated scrape).
+RPC_RECONNECTS_TOTAL = Counter(
+    "ray_tpu_rpc_reconnects_total",
+    "RPC reconnect attempts after connection loss, by peer address",
+    tag_keys=("peer",),
+)
+
 # -- object store / memory observability (agent-side per-node occupancy
 # sampled from the shm store's native stats; the head observes object
 # lifetimes into the age histogram as the ref-counter frees them, and

@@ -30,6 +30,14 @@ except AttributeError:
             + " --xla_force_host_platform_device_count=8").strip()
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running chaos/bench workouts, deselected by the "
+        "tier-1 run's -m 'not slow'",
+    )
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()
