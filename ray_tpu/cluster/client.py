@@ -214,6 +214,11 @@ class ClusterBackend:
         self._dispatching = 0  # specs popped from the queue, mid-dispatch
         self._retry_heap: list = []  # (due, seq, spec) — shared retry timer
         self._retry_seq = 0
+        # (ts, {NodeID: info}) head node-table snapshot shared by the
+        # loss-recovery paths (_maybe_recover, actor recovery, parked-
+        # affinity fallback); refreshed at most ~1/s so a mass-recovery
+        # storm costs one `nodes` RPC per second, not one per spec.
+        self._nodes_cache: tuple = (-1e9, None)
         # Owner-distributed object directory (reference ownership model:
         # reference_count.h:61 holds per-object state on the OWNING worker,
         # ownership_based_object_directory.h resolves locations from
@@ -796,6 +801,23 @@ class ClusterBackend:
                         thread_name_prefix="chunk-pull")
         return pool
 
+    def _nodes_snapshot(self, max_age_s: float = 1.0) -> dict | None:
+        """{NodeID: info} head node-table snapshot, cached ``max_age_s``:
+        the loss-recovery paths poll repeatedly, so ≤1s-stale liveness
+        only defers a recovery to the next poll — it never recovers a
+        task whose node is actually alive (dead nodes stay dead; node
+        ids are never reused). Returns None when the head is
+        unreachable (callers treat that as "retry later")."""
+        now = time.monotonic()
+        ts, nodes = self._nodes_cache
+        if nodes is None or now - ts > max_age_s:
+            try:
+                nodes = {n["NodeID"]: n for n in self.head.call("nodes")}
+            except (ConnectionLost, OSError):
+                return None
+            self._nodes_cache = (now, nodes)
+        return nodes
+
     def _maybe_recover(self, oid: str) -> bool:
         """Lineage reconstruction: resubmit the creating task if its node
         died before the object appeared. Returns True if resubmitted."""
@@ -816,7 +838,9 @@ class ClusterBackend:
         assigned = spec.get("assigned_node")
         if assigned is None:
             return False  # not yet placed; the pending-retry thread owns it
-        nodes = {n["NodeID"]: n for n in self.head.call("nodes")}
+        nodes = self._nodes_snapshot()
+        if nodes is None:
+            return False  # head unreachable: the get loop retries
         info = nodes.get(assigned, {})
         if info.get("Alive"):
             return False  # still computing (a DRAINING node finishes work)
@@ -1333,12 +1357,26 @@ class ClusterBackend:
 
         while True:
             with self._submit_cv:
+                limit = config.submit_batch_max
                 while True:
                     now = time.monotonic()
+                    # Re-inject due retries AT MOST one batch per loop
+                    # pass: at 100k parked specs hitting max backoff,
+                    # every spec comes due inside the same window, and
+                    # draining them ALL here would put ~400 consecutive
+                    # retry batches ahead of any fresh submission (a
+                    # feasible probe task measured 40s queue latency
+                    # behind the circulating backlog). Bounded, the
+                    # remainder stays at the heap top — still due, so
+                    # the next pass drains the next batch — and fresh
+                    # work interleaves at batch granularity.
+                    drained = 0
                     while (self._retry_heap
-                           and self._retry_heap[0][0] <= now):
+                           and self._retry_heap[0][0] <= now
+                           and drained < limit):
                         self._submit_q.append(
                             heapq.heappop(self._retry_heap)[2])
+                        drained += 1
                     if self._submit_q or self._closed:
                         break
                     wait = 0.5
@@ -1346,9 +1384,10 @@ class ClusterBackend:
                         wait = min(wait, self._retry_heap[0][0] - now)
                     self._submit_cv.wait(max(wait, 0.01))
                 if self._closed and not self._submit_q:
+                    # Anything still in the retry heap is shutdown()'s
+                    # to snapshot-and-fail; don't dispatch it here.
                     return
                 batch = []
-                limit = config.submit_batch_max
                 while self._submit_q and len(batch) < limit:
                     batch.append(self._submit_q.popleft())
                 # Popped-but-not-dispatched specs count as in flight so
@@ -1377,14 +1416,25 @@ class ClusterBackend:
                 with self._submit_cv:
                     self._dispatching = 0
 
-    def _queue_retry(self, spec: dict, delay: float = 0.25) -> None:
+    def _queue_retry(self, spec: dict, delay: float | None = None) -> None:
         """Park a temporarily unplaceable spec for ONE shared retry timer
         (not a thread per spec): due specs re-enter the submit queue and
-        re-batch through the normal dispatch path."""
+        re-batch through the normal dispatch path.
+
+        Per-spec exponential backoff (submit_retry_base_s doubling to
+        submit_retry_max_s): at 100k parked specs a flat 0.25s timer
+        re-batched the ENTIRE backlog through schedule_batch every tick
+        (~400 head RPCs per 250ms of pure misses, forever); backoff
+        decays a standing backlog to a trickle while the first few
+        attempts still land fast when capacity appears quickly."""
         import heapq
 
         spec["_handled"] = True
         spec.setdefault("_pending_since", time.monotonic())
+        if delay is None:
+            delay = spec.get("_retry_delay", config.submit_retry_base_s)
+            spec["_retry_delay"] = min(
+                config.submit_retry_max_s, delay * 2.0)
         with self._submit_cv:
             if not self._closed:
                 self._retry_seq += 1
@@ -1419,6 +1469,24 @@ class ClusterBackend:
             self._fail_spec(
                 spec, TaskCancelledError(spec.get("fname", "task")))
             return
+        aff = spec["sinfo"].get("node_affinity")
+        if aff is not None:
+            # Hard affinity to a node that is DRAINING/DEAD can never
+            # place. Recovery of PLACED specs already falls back to
+            # soft affinity when the pinned node dies (_maybe_recover);
+            # a never-placed spec parked on the same loss deserves the
+            # same fallback instead of a guaranteed pending-timeout —
+            # the chaos soak's drain-exemption probe hits exactly this
+            # window when the drain lands before first placement.
+            # Cached snapshot: a batch of parked affinity specs costs at
+            # most one `nodes` RPC per second on the dispatch thread,
+            # not one full-table fetch per spec per retry round.
+            nodes = self._nodes_snapshot()
+            if nodes is not None:
+                info = nodes.get(aff)
+                if info is None or not info.get("Alive") or \
+                        info.get("State") == "DRAINING":
+                    spec["sinfo"]["node_affinity"] = None
         since = spec.setdefault("_pending_since", time.monotonic())
         timeout = config.pending_task_timeout_s
         if time.monotonic() - since > timeout:
@@ -1540,6 +1608,10 @@ class ClusterBackend:
                 continue
             node_id, address = placed
             spec["assigned_node"] = node_id
+            # Placement succeeded: the unplaceable-backoff streak is
+            # over. A later transient push failure re-parks at the base
+            # delay, not this spec's stale max-backoff cadence.
+            spec.pop("_retry_delay", None)
             by_node.setdefault((node_id, address), []).append(spec)
         for (node_id, address), specs in by_node.items():
             try:
@@ -1939,9 +2011,8 @@ class ClusterBackend:
             assigned = spec.get("assigned_node")
             if assigned is None:
                 return False  # not dispatched yet: absence is slowness
-            try:
-                nodes = {n["NodeID"]: n for n in self.head.call("nodes")}
-            except (ConnectionLost, OSError):
+            nodes = self._nodes_snapshot()
+            if nodes is None:
                 return False
             if nodes.get(assigned, {}).get("Alive"):
                 return False  # creation still in flight on a live node

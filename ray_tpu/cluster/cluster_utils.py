@@ -43,6 +43,11 @@ class Cluster:
         address = self.head.address
         self.head._stop.set()
         self.head._server.stop()
+        if self.head._store is not None:
+            # A real crash loses the write-behind dirty queue (whole
+            # batches, never torn rows) and must not leave a zombie
+            # flusher writing under the restarted head.
+            self.head._store.abandon()
         self.head = None
         return address
 

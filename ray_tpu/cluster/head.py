@@ -34,10 +34,32 @@ DEAD_AFTER_S = max(config.node_death_timeout_s,
 
 
 class _PersistentStore:
-    """Write-through sqlite store behind the head tables (GCS fault
+    """Write-BEHIND sqlite store behind the head tables (GCS fault
     tolerance: ``store_client/redis_store_client.h:28`` role — here a
     local file so the head can restart on the same address and reload,
-    ``gcs_init_data.h`` analog). Namespaced key -> pickled value."""
+    ``gcs_init_data.h`` analog). Namespaced key -> pickled value.
+
+    Round 6: the store used to commit one fsync'd transaction PER WRITE
+    on the caller's thread — the first thing to melt under a 100k-task
+    burst (every kv_put / node register / snapshot blob serialized the
+    control plane behind sqlite). Writes now land in a per-key-coalesced
+    dirty queue (last write or delete per (ns, key) wins) that a
+    dedicated flusher thread drains as ONE batched transaction every
+    ``head_persist_flush_interval_s``, at most ``head_persist_max_batch``
+    statements per transaction. Durability contract:
+
+    * a batch commits atomically — a crash mid-flush loses whole batches
+      (at most the last interval's writes), never a torn row;
+    * a failed flush requeues the batch at the FRONT (newer queued
+      writes for the same key win), so transient sqlite errors retry
+      without reordering;
+    * ``flush()`` drains synchronously — the snapshot loop calls it
+      every tick (so ``head.snapshot.before_persist`` failpoints still
+      gate real disk writes) and ``close()`` calls it on shutdown;
+    * ``load_ns`` flushes first: readers always see their own writes.
+    """
+
+    _DELETE = object()  # queue sentinel: key deleted
 
     def __init__(self, path: str):
         import sqlite3
@@ -50,42 +72,222 @@ class _PersistentStore:
             "(ns TEXT, k TEXT, v BLOB, PRIMARY KEY (ns, k))"
         )
         self._conn.commit()
-        self._mu = threading.Lock()
+        self._mu = threading.Lock()  # sqlite connection
+        # Dirty queue: (ns, key) -> blob | _DELETE, insertion-ordered so
+        # flush batches drain oldest-first.
+        self._dirty: "collections.OrderedDict[tuple, object]" = (
+            collections.OrderedDict()
+        )
+        self._dirty_mu = threading.Lock()
+        self._flush_mu = threading.Lock()  # serializes whole flush passes
+        self._stop_flusher = threading.Event()
+        self._n_coalesced = 0
+        self._n_flushes = 0
+        self._n_flush_failures = 0
+        self._flusher = threading.Thread(
+            target=self._flush_loop, daemon=True)
+        self._flusher.start()
 
     def put(self, ns: str, key: str, value) -> None:
         import pickle
 
         self.put_blob(ns, key, pickle.dumps(value, protocol=5))
 
+    def _enqueue(self, ns: str, key: str, value) -> None:
+        from ray_tpu.util import metrics as _metrics
+
+        with self._dirty_mu:
+            if (ns, key) in self._dirty:
+                # Coalesced: this key's previous pending write never
+                # reaches disk — under round-6 shapes most per-key churn
+                # (heartbeat-refreshed node records, snapshot blobs)
+                # collapses here instead of becoming transactions.
+                self._n_coalesced += 1
+                try:
+                    _metrics.HEAD_PERSIST_COALESCED.inc()
+                except Exception:
+                    pass
+            self._dirty[(ns, key)] = value
+            depth = len(self._dirty)
+        try:
+            _metrics.HEAD_PERSIST_QUEUE_DEPTH.set(depth)
+        except Exception:
+            pass
+
     def put_blob(self, ns: str, key: str, blob: bytes) -> None:
-        with self._mu:
-            self._conn.execute(
-                "INSERT OR REPLACE INTO t (ns, k, v) VALUES (?, ?, ?)",
-                (ns, key, blob),
-            )
-            self._conn.commit()
+        self._enqueue(ns, key, blob)
 
     def delete(self, ns: str, key: str) -> None:
-        with self._mu:
-            self._conn.execute(
-                "DELETE FROM t WHERE ns = ? AND k = ?", (ns, key))
-            self._conn.commit()
+        self._enqueue(ns, key, self._DELETE)
+
+    def _flush_loop(self) -> None:
+        interval = max(0.005, config.head_persist_flush_interval_s)
+        while not self._stop_flusher.wait(interval):
+            try:
+                self.flush()
+            except Exception:
+                continue  # requeued by flush(); next tick retries
+
+    def flush(self) -> int:
+        """Synchronously drain the dirty queue; returns statements
+        written. Batches are single transactions (all-or-none)."""
+        from ray_tpu.util import metrics as _metrics
+
+        max_batch = max(1, config.head_persist_max_batch)
+        written = 0
+        with self._flush_mu:
+            while True:
+                with self._dirty_mu:
+                    if not self._dirty:
+                        break
+                    batch = []
+                    while self._dirty and len(batch) < max_batch:
+                        batch.append(self._dirty.popitem(last=False))
+                t0 = time.perf_counter()
+                try:
+                    with self._mu:
+                        for (ns, key), v in batch:
+                            if v is self._DELETE:
+                                self._conn.execute(
+                                    "DELETE FROM t WHERE ns = ? AND k = ?",
+                                    (ns, key))
+                            else:
+                                self._conn.execute(
+                                    "INSERT OR REPLACE INTO t (ns, k, v) "
+                                    "VALUES (?, ?, ?)", (ns, key, v))
+                        self._conn.commit()
+                except Exception:
+                    try:
+                        with self._mu:
+                            self._conn.rollback()
+                    except Exception:
+                        pass
+                    # Requeue the whole batch at the FRONT, oldest last
+                    # so order is preserved; a NEWER pending write for
+                    # the same key supersedes the failed one.
+                    with self._dirty_mu:
+                        self._n_flush_failures += 1
+                        for k, v in reversed(batch):
+                            if k not in self._dirty:
+                                self._dirty[k] = v
+                                self._dirty.move_to_end(k, last=False)
+                    raise
+                self._n_flushes += 1
+                written += len(batch)
+                try:
+                    _metrics.HEAD_PERSIST_FLUSH_SECONDS.observe(
+                        time.perf_counter() - t0)
+                except Exception:
+                    pass
+        try:
+            with self._dirty_mu:
+                depth = len(self._dirty)
+            _metrics.HEAD_PERSIST_QUEUE_DEPTH.set(depth)
+        except Exception:
+            pass
+        return written
+
+    def stats(self) -> dict:
+        with self._dirty_mu:
+            return {
+                "queued": len(self._dirty),
+                "coalesced": self._n_coalesced,
+                "flushes": self._n_flushes,
+                "flush_failures": self._n_flush_failures,
+            }
 
     def load_ns(self, ns: str) -> dict:
         import pickle
 
+        try:
+            self.flush()  # read-your-writes
+        except Exception:
+            pass
         with self._mu:
             rows = self._conn.execute(
                 "SELECT k, v FROM t WHERE ns = ?", (ns,)).fetchall()
         return {k: pickle.loads(v) for k, v in rows}
 
     def close(self) -> None:
+        self._stop_flusher.set()
+        try:
+            self.flush()
+        except Exception:
+            pass
         with self._mu:
             try:
                 self._conn.commit()
                 self._conn.close()
             except Exception:
                 pass
+
+    def abandon(self) -> None:
+        """Crash simulation (``Cluster.kill_head``): stop the flusher and
+        DROP the dirty queue — pending writes die exactly as they would
+        in a process kill (whole batches lost, committed batches intact),
+        and no zombie flusher keeps writing under a restarted head's
+        fresh connection to the same file."""
+        self._stop_flusher.set()
+        with self._dirty_mu:
+            self._dirty.clear()
+        with self._mu:
+            try:
+                self._conn.close()  # uncommitted work rolls back
+            except Exception:
+                pass
+
+
+class _ShardLock:
+    """RLock that observes time spent WAITING on a contended acquire
+    into ``ray_tpu_head_lock_wait_seconds{shard=...}``. An uncontended
+    acquire (the overwhelming majority) costs one extra try-acquire and
+    records nothing. Condition-compatible: the private RLock protocol
+    methods ``threading.Condition`` probes for are delegated, so
+    ``Condition(shard_lock)`` behaves exactly like ``Condition(RLock())``
+    (cv re-acquires after ``wait`` bypass instrumentation — the wait
+    itself isn't contention)."""
+
+    __slots__ = ("_rl", "_shard")
+
+    def __init__(self, shard: str):
+        self._rl = threading.RLock()
+        self._shard = shard
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if self._rl.acquire(False):
+            return True
+        if not blocking:
+            return False
+        t0 = time.perf_counter()
+        ok = self._rl.acquire(True, timeout)
+        try:
+            from ray_tpu.util import metrics as _metrics
+
+            _metrics.HEAD_LOCK_WAIT_SECONDS.observe(
+                time.perf_counter() - t0, tags={"shard": self._shard})
+        except Exception:
+            pass
+        return ok
+
+    def release(self) -> None:
+        self._rl.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._rl.release()
+
+    # threading.Condition integration (RLock protocol delegates).
+    def _is_owned(self):
+        return self._rl._is_owned()
+
+    def _release_save(self):
+        return self._rl._release_save()
+
+    def _acquire_restore(self, state):
+        return self._rl._acquire_restore(state)
 
 
 class NodeInfo:
@@ -120,8 +322,31 @@ class HeadServer:
                  persist_path: str | None = None,
                  metrics_port: int | None = 0):
         self._store = _PersistentStore(persist_path) if persist_path else None
-        self._lock = threading.RLock()
+        # Round 6 lock sharding: the single RLock that serialized EVERY
+        # head RPC is split along table boundaries so the hot planes
+        # stop contending with each other. Fixed acquisition order for
+        # cross-shard paths (_mark_dead, actor death, drains):
+        #
+        #   _lock (nodes/actors/PGs)  ->  _obj_lock (objects/refs)
+        #                             ->  _event_lock (spans/logs)
+        #
+        # Object-plane code reads NodeInfo entries (alive/address/
+        # store_path) WITHOUT the node lock: _nodes is insert-only (dead
+        # nodes stay, re-registration swaps a fresh NodeInfo), dict gets
+        # are GIL-atomic, and every consumer tolerates a node dying
+        # between the read and the use (the same race existed across
+        # RPCs under the global lock).
+        self._lock = _ShardLock("nodes")
+        self._obj_lock = _ShardLock("objects")
+        self._event_lock = _ShardLock("events")
         self._nodes: dict[str, NodeInfo] = {}
+        # Incrementally-maintained cluster resource totals: rebuilt on
+        # membership/lifecycle transitions (register/drain/death — rare),
+        # delta-updated on heartbeats and scheduling debits, so the
+        # status-poll RPCs are O(1) dict copies instead of an O(nodes)
+        # rebuild under the global lock per poll.
+        self._res_total: dict[str, float] = {}
+        self._res_avail: dict[str, float] = {}
         self._kv: dict[str, Any] = {}
         self._kv_lock = threading.Lock()  # see rpc_kv_put — KV I/O only
         # Generalized pub/sub plane (src/ray/pubsub analog): LOGS/ACTORS/
@@ -129,12 +354,16 @@ class HeadServer:
         from ray_tpu.cluster.pubsub import Publisher
 
         self.pubsub = Publisher()
-        # Tracing span store (bounded; util/tracing.py feeds it through
-        # the agents' worker-event batches).
-        self._spans: list = []
+        # Tracing span store: bounded ring (util/tracing.py feeds it
+        # through the agents' worker-event batches); a 100k-task burst's
+        # span upload drops oldest instead of growing head RSS, and the
+        # drop count surfaces in rpc_pubsub_stats + metrics.
+        self._spans: "collections.deque" = collections.deque(
+            maxlen=max(16, config.head_span_retention))
+        self._spans_dropped = 0
         # object directory: oid -> {"nodes": set, "error": bool}
         self._objects: dict[str, dict] = {}
-        self._objects_cv = threading.Condition(self._lock)
+        self._objects_cv = threading.Condition(self._obj_lock)
         # actor directory: actor_id -> info dict
         self._actors: dict[str, dict] = {}
         self._actor_specs: dict[str, dict] = {}  # restart policy + spec
@@ -160,13 +389,20 @@ class HeadServer:
         # the (possibly still running) producer stores AFTER the release.
         self._released_streams: dict[str, int] = {}
         self._free_queue: list[tuple] = []  # (address, oid) delete fanout
-        self._free_cv = threading.Condition(self._lock)
+        self._free_cv = threading.Condition(self._obj_lock)
         # Leak sweeper state: oid -> flag record (state.memory_leaks()).
         # Initialized BEFORE the RPC server: _maybe_free clears flags.
         self._leaks: dict[str, dict] = {}
         # Unsatisfiable demand log: the autoscaler's input signal
         # (load_metrics.py / resource_demand_scheduler.py analog).
-        self._demand_misses: list[dict] = []
+        # Keyed by task id (anonymous misses get a synthetic key) so the
+        # retry-refresh is an O(1) move-to-end, not an O(len) list
+        # rebuild — at 100k parked infeasible specs the old list filter
+        # was quadratic work under the node lock every retry round.
+        self._demand_misses: "collections.OrderedDict[str, dict]" = (
+            collections.OrderedDict()
+        )
+        self._demand_miss_seq = 0
         # Worker stdout/stderr ring buffer for driver log streaming
         # (log_monitor.py -> GCS pubsub -> driver analog; drivers poll
         # rpc_drain_logs with their last-seen seq).
@@ -174,7 +410,10 @@ class HeadServer:
         self._log_seq = 0
         if self._store is not None:
             self._load_persisted()
-        self._server = RpcServer(self, host, port)
+        from ray_tpu.util import metrics as _metrics
+
+        self._server = RpcServer(
+            self, host, port, rpc_histogram=_metrics.HEAD_RPC_SECONDS)
         self.address = self._server.address
         # Chaos source identity: the head's outbound clients (per-node
         # fanouts, drain probes, free broadcasts) are tagged with the
@@ -254,6 +493,7 @@ class HeadServer:
                 "error": rec["error"],
                 "size": rec["size"],
             }
+        self._rebuild_res_caches()
 
     def _snapshot_loop(self) -> None:
         """Persist the high-churn tables (actors/specs/PGs/object
@@ -272,30 +512,55 @@ class HeadServer:
                         "actors": {k: dict(v) for k, v in self._actors.items()},
                         "aspecs": dict(self._actor_specs),
                         "pgs": {k: dict(v) for k, v in self._pgs.items()},
-                        "objects": {
-                            oid: {"nodes": sorted(e["nodes"]),
-                                  "error": e["error"],
-                                  "size": e.get("size", 0)}
-                            for oid, e in self._objects.items()
-                        },
                     }
+                with self._obj_lock:
+                    snap["objects"] = {
+                        oid: {"nodes": sorted(e["nodes"]),
+                              "error": e["error"],
+                              "size": e.get("size", 0)}
+                        for oid, e in self._objects.items()
+                    }
+                blobs: dict[str, bytes] = {}
                 for key, table in snap.items():
                     blob = _pickle.dumps(table, protocol=5)
                     if last.get(key) != blob:
-                        # Record success only after the write lands, so a
-                        # transient sqlite failure is retried next tick.
+                        blobs[key] = blob
                         self._store.put_blob("snap", key, blob)
-                        last[key] = blob
+                if blobs:
+                    # Synchronous drain: the write-behind queue must not
+                    # defer snapshot durability past the tick the
+                    # failpoint above gated — and ``last`` records
+                    # success only after the transaction lands, so a
+                    # sqlite failure (blobs requeued) retries next tick.
+                    self._store.flush()
+                    last.update(blobs)
             except Exception:
                 continue  # next tick retries; persistence is best-effort
 
     # -- nodes ------------------------------------------------------------
+
+    def _rebuild_res_caches(self) -> None:
+        """Caller holds self._lock. O(nodes) — only on membership or
+        lifecycle transitions (register/drain/death); heartbeats and
+        scheduling debits maintain the available cache incrementally."""
+        total: dict[str, float] = {}
+        avail: dict[str, float] = {}
+        for n in self._nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.resources.items():
+                total[k] = total.get(k, 0.0) + v
+            if n.schedulable:
+                for k, v in n.available.items():
+                    avail[k] = avail.get(k, 0.0) + v
+        self._res_total, self._res_avail = total, avail
 
     def rpc_register_node(self, node_id, address, resources, store_path):
         with self._lock:
             info = NodeInfo(node_id, address, resources, store_path)
             info.client.chaos_src = self.address
             self._nodes[node_id] = info
+            self._rebuild_res_caches()
         self._persist("node", node_id, {
             "address": address, "resources": dict(resources),
             "store_path": store_path,
@@ -312,6 +577,16 @@ class HeadServer:
             if node is None or not node.alive:
                 return {"ok": False}  # node was declared dead; it must exit
             node.last_heartbeat = time.monotonic()
+            if node.schedulable:
+                # Incremental availability maintenance: apply the delta
+                # between the node's previous view (including any
+                # optimistic _pick debits) and the fresh report.
+                avail = self._res_avail
+                old = node.available
+                for k in old.keys() | available.keys():
+                    d = available.get(k, 0.0) - old.get(k, 0.0)
+                    if d:
+                        avail[k] = avail.get(k, 0.0) + d
             node.available = dict(available)
             return {"ok": True}
 
@@ -341,6 +616,7 @@ class HeadServer:
                 node.drain_reason = reason
                 node.drain_started = time.monotonic()
                 node.drain_done = threading.Event()
+                self._rebuild_res_caches()  # no longer schedulable
             evt = node.drain_done
         if started:
             from ray_tpu.util import metrics as _metrics
@@ -497,24 +773,18 @@ class HeadServer:
             ]
 
     def rpc_cluster_resources(self):
+        # O(keys) snapshot of the incrementally-maintained cache: status
+        # pollers no longer rebuild dicts over every node under the lock.
         with self._lock:
-            total: dict[str, float] = {}
-            for n in self._nodes.values():
-                if not n.alive:
-                    continue
-                for k, v in n.resources.items():
-                    total[k] = total.get(k, 0.0) + v
-            return total
+            return dict(self._res_total)
 
     def rpc_available_resources(self):
         with self._lock:
-            total: dict[str, float] = {}
-            for n in self._nodes.values():
-                if not n.schedulable:  # draining: no capacity for new work
-                    continue
-                for k, v in n.available.items():
-                    total[k] = total.get(k, 0.0) + v
-            return total
+            # Clamp float-delta dust from the incremental maintenance:
+            # repeated add/subtract of nearly-equal heartbeat values
+            # leaves ~1e-16 residue where the true sum is 0.0.
+            return {k: (0.0 if -1e-9 < v < 1e-9 else v)
+                    for k, v in self._res_avail.items()}
 
     def _monitor_loop(self):
         # Death needs BOTH (a) absolute staleness > DEAD_AFTER_S and (b)
@@ -553,6 +823,11 @@ class HeadServer:
                 self._mark_dead(node_id, "heartbeat timeout")
 
     def _mark_dead(self, node_id: str, cause: str):
+        # Cross-shard path: node/actor/PG work under the node lock, THEN
+        # the object/ref sweep under the object lock (fixed order). The
+        # alive=False flag is written first, so an add_location racing
+        # the sweep either sees the flag (skips the node) or lands the
+        # location before the sweep removes it — never after.
         self._persist_del("node", node_id)
         with self._lock:
             node = self._nodes.get(node_id)
@@ -568,6 +843,7 @@ class HeadServer:
             node.alive = False
             node.state = "DEAD"
             node.death_cause = cause
+            self._rebuild_res_caches()
             self.pubsub.publish("NODES", node_id, {
                 "node_id": node_id, "state": "DEAD", "cause": cause,
             })
@@ -579,6 +855,15 @@ class HeadServer:
                         info["actor_id"], f"node {node_id} died: {cause}",
                         True,
                     )
+            # Placement groups with bundles there become DEAD (rescheduling
+            # PGs is round-2 work; Train-level elasticity handles restarts).
+            for pg in self._pgs.values():
+                if pg["state"] == "CREATED" and any(
+                    nid == node_id for nid, _ in pg["placement"]
+                ):
+                    pg["state"] = "DEAD"
+            self._actors_cv.notify_all()
+        with self._obj_lock:
             # Drop its object locations; lineage re-execution is the
             # client's job (object_recovery_manager.h:41 analog).
             for entry in self._objects.values():
@@ -598,14 +883,6 @@ class HeadServer:
             ):
                 if nid == node_id:
                     self._end_task_borrows(task_id)
-            # Placement groups with bundles there become DEAD (rescheduling
-            # PGs is round-2 work; Train-level elasticity handles restarts).
-            for pg in self._pgs.values():
-                if pg["state"] == "CREATED" and any(
-                    nid == node_id for nid, _ in pg["placement"]
-                ):
-                    pg["state"] = "DEAD"
-            self._actors_cv.notify_all()
             self._objects_cv.notify_all()
 
     # -- KV ---------------------------------------------------------------
@@ -661,19 +938,39 @@ class HeadServer:
         return self.pubsub.publish(channel, key, message)
 
     def rpc_pubsub_stats(self):
-        return self.pubsub.stats()
+        """Pubsub health + the head's other bounded-retention planes
+        (span ring, write-behind persistence queue): one RPC answers
+        "is the head dropping/queueing anything" at any scale."""
+        out = self.pubsub.stats()
+        with self._event_lock:
+            out["spans"] = {
+                "retained": len(self._spans),
+                "cap": self._spans.maxlen,
+                "dropped": self._spans_dropped,
+            }
+        if self._store is not None:
+            out["persist"] = self._store.stats()
+        return out
 
     # -- tracing span store (util/tracing.py; OTel-shaped) ----------------
 
     def rpc_report_spans(self, spans):
-        with self._lock:
+        with self._event_lock:
+            overflow = max(
+                0, len(self._spans) + len(spans) - self._spans.maxlen)
             self._spans.extend(spans)
-            if len(self._spans) > 100_000:
-                del self._spans[: len(self._spans) - 100_000]
+            if overflow:
+                self._spans_dropped += overflow
+                try:
+                    from ray_tpu.util import metrics as _metrics
+
+                    _metrics.HEAD_SPANS_DROPPED.inc(overflow)
+                except Exception:
+                    pass
         return True
 
     def rpc_list_spans(self, trace_id=None, limit: int = 10_000):
-        with self._lock:
+        with self._event_lock:
             out = [s for s in self._spans
                    if trace_id is None or s["trace_id"] == trace_id]
             return out[-limit:]
@@ -682,7 +979,7 @@ class HeadServer:
 
     def rpc_ref_update(self, client_id, add, remove):
         """Batched holder registration/release from one client process."""
-        with self._lock:
+        with self._obj_lock:
             for oid in add:
                 if oid in self._freed:
                     continue  # already freed: don't create ghost holders
@@ -703,7 +1000,7 @@ class HeadServer:
         """Args of a submitted task borrow their objects until the task
         ends (borrower registration at submission, so the caller may drop
         its handles while the task is in flight)."""
-        with self._lock:
+        with self._obj_lock:
             self._end_task_borrows(task_id)  # resubmission replaces
             self._inflight_by_task[task_id] = (node_id, list(oids), actor_id)
             for oid in oids:
@@ -712,7 +1009,7 @@ class HeadServer:
 
     def rpc_ref_task_begin_batch(self, entries):
         """One lock pass for a submitter batch's borrow registrations."""
-        with self._lock:
+        with self._obj_lock:
             for task_id, node_id, oids, actor_id in entries:
                 self._end_task_borrows(task_id)  # resubmission replaces
                 self._inflight_by_task[task_id] = (
@@ -722,7 +1019,7 @@ class HeadServer:
         return True
 
     def rpc_ref_task_end(self, task_id):
-        with self._lock:
+        with self._obj_lock:
             self._end_task_borrows(task_id)
         return True
 
@@ -741,7 +1038,10 @@ class HeadServer:
 
     def _maybe_free(self, oid):
         """Free the object cluster-wide when nothing can reach it anymore.
-        Caller holds self._lock. Untracked oids are conservatively kept."""
+        Caller holds self._obj_lock. Untracked oids are conservatively
+        kept. NodeInfo reads below are lock-free (see the shard-order
+        comment in __init__): a node dying between the alive check and
+        the free fanout just costs one failed best-effort RPC."""
         if oid not in self._freed:
             holders = self._refs.get(oid)
             if holders is None or holders:
@@ -794,7 +1094,7 @@ class HeadServer:
 
     def rpc_ref_client_dead(self, client_id):
         """A client process died: drop every hold it registered."""
-        with self._lock:
+        with self._obj_lock:
             for oid, holders in list(self._refs.items()):
                 if client_id in holders:
                     holders.discard(client_id)
@@ -803,7 +1103,7 @@ class HeadServer:
 
     def rpc_ref_counts(self):
         """Introspection: live tracked refs (tests / debugging)."""
-        with self._lock:
+        with self._obj_lock:
             return {
                 "tracked": len(self._refs),
                 "inflight_tasks": len(self._inflight_by_task),
@@ -818,7 +1118,7 @@ class HeadServer:
         """Abandoned ObjectRefGenerator: free the stream's unconsumed
         items — present AND future (a still-running producer's later
         add_locations are deleted on sight)."""
-        with self._lock:
+        with self._obj_lock:
             self._released_streams[task_id] = int(from_index)
             if len(self._released_streams) > 100_000:
                 for k in list(self._released_streams)[:50_000]:
@@ -829,7 +1129,7 @@ class HeadServer:
                 and int(oid[32:], 16) >= from_index
             ]
         for oid in doomed:
-            with self._lock:
+            with self._obj_lock:
                 self._refs.pop(oid, None)
                 self._freed[oid] = True
                 entry = self._objects.pop(oid, None)
@@ -870,7 +1170,7 @@ class HeadServer:
     def rpc_owner_of(self, oids):
         """{oid: owner_addr} routing for refs that lost their owner
         binding (O(1) lookup per oid; '' = unknown)."""
-        with self._lock:
+        with self._obj_lock:
             return {
                 oid: (self._objects.get(oid) or {}).get("owner", "")
                 for oid in oids
@@ -878,7 +1178,7 @@ class HeadServer:
 
     def rpc_add_location(self, oid, node_id, is_error=False, size=0,
                          contained=None, owner_addr="", attr=None):
-        with self._lock:
+        with self._obj_lock:
             if oid in self._freed or self._stream_released(oid):
                 # Freed while the task computing it was still running:
                 # delete the fresh copy straight away.
@@ -930,14 +1230,14 @@ class HeadServer:
 
     def rpc_objects_on_node(self, node_id):
         """Oids the directory places on this node (spill-candidate input)."""
-        with self._lock:
+        with self._obj_lock:
             return [
                 oid for oid, e in self._objects.items()
                 if node_id in e["nodes"]
             ]
 
     def rpc_remove_location(self, oid, node_id):
-        with self._lock:
+        with self._obj_lock:
             entry = self._objects.get(oid)
             if entry:
                 entry["nodes"].discard(node_id)
@@ -950,7 +1250,7 @@ class HeadServer:
         {"nodes": [...], "error": bool} or None on timeout. The long-poll
         analog of GetObjectStatus."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._lock:
+        with self._obj_lock:
             while True:
                 entry = self._objects.get(oid)
                 if entry and entry["nodes"]:
@@ -981,7 +1281,7 @@ class HeadServer:
         every oid currently resolvable. One lock pass + one RPC instead
         of a serial wait_location per ref (GetObjectStatus batching)."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._lock:
+        with self._obj_lock:
             while True:
                 found = {}
                 for oid in oids:
@@ -1010,7 +1310,7 @@ class HeadServer:
                     else min(remaining, 1.0))
 
     def rpc_locations(self, oid):
-        with self._lock:
+        with self._obj_lock:
             entry = self._objects.get(oid)
             if not entry:
                 return None
@@ -1039,11 +1339,13 @@ class HeadServer:
             if max_restarts != 0:
                 # A restart replays the ctor, which needs its arg objects:
                 # hold them for the actor's whole lifetime (released when
-                # it is permanently DEAD).
-                for oid in spec.get("borrowed", []):
-                    self._refs.setdefault(oid, set()).add(
-                        "actor:" + actor_id
-                    )
+                # it is permanently DEAD). Nested obj-lock acquisition —
+                # shard order nodes -> objects.
+                with self._obj_lock:
+                    for oid in spec.get("borrowed", []):
+                        self._refs.setdefault(oid, set()).add(
+                            "actor:" + actor_id
+                        )
         return True
 
     def rpc_register_actor(
@@ -1199,19 +1501,22 @@ class HeadServer:
             del self._named_actors[name]
         # Calls queued on the dead actor will never report task-end:
         # release their arg borrows. (Kept alive through RESTARTING so
-        # replayed calls still find their args.)
-        for task_id, (_n, _o, aid) in list(self._inflight_by_task.items()):
-            if aid == actor_id:
-                self._end_task_borrows(task_id)
-        # Release the lifetime holds on the ctor's arg objects.
+        # replayed calls still find their args.) Nested obj-lock
+        # acquisition — shard order nodes -> objects.
         rec = self._actor_specs.pop(actor_id, None)
-        if rec is not None:
-            holder = "actor:" + actor_id
-            for oid in rec["spec"].get("borrowed", []):
-                holders = self._refs.get(oid)
-                if holders is not None:
-                    holders.discard(holder)
-                    self._maybe_free(oid)
+        with self._obj_lock:
+            for task_id, (_n, _o, aid) in list(
+                    self._inflight_by_task.items()):
+                if aid == actor_id:
+                    self._end_task_borrows(task_id)
+            # Release the lifetime holds on the ctor's arg objects.
+            if rec is not None:
+                holder = "actor:" + actor_id
+                for oid in rec["spec"].get("borrowed", []):
+                    holders = self._refs.get(oid)
+                    if holders is not None:
+                        holders.discard(holder)
+                        self._maybe_free(oid)
         self._actors_cv.notify_all()
 
     def _restart_actor(self, actor_id):
@@ -1288,7 +1593,7 @@ class HeadServer:
         the put-time attribution (owner worker id, creating task,
         callsite) and age."""
         now = time.time()
-        with self._lock:
+        with self._obj_lock:
             out = []
             for oid, entry in self._objects.items():
                 attr = entry.get("attr") or {}
@@ -1311,7 +1616,7 @@ class HeadServer:
                 "total": total}
 
     def rpc_worker_logs(self, node_id, pid, lines):
-        with self._lock:
+        with self._event_lock:
             for line in lines:
                 self._log_seq += 1
                 self._logs.append({
@@ -1333,7 +1638,7 @@ class HeadServer:
         pass it back to resume without loss when truncated. Seqs are
         monotone in the ring, so the common nothing-new poll scans O(1)
         from the right."""
-        with self._lock:
+        with self._event_lock:
             newer: list = []
             for e in reversed(self._logs):
                 if e["seq"] <= after_seq:
@@ -1478,10 +1783,11 @@ class HeadServer:
                 (n.node_id, n.client) for n in self._nodes.values()
                 if n.alive and (node_id is None or n.node_id == node_id)
             ]
-            oids_by_node: dict[str, list] = {}
-            attr_by_oid: dict[str, dict] = {}
-            holders_by_oid: dict[str, int] = {}
-            if include_objects:
+        oids_by_node: dict[str, list] = {}
+        attr_by_oid: dict[str, dict] = {}
+        holders_by_oid: dict[str, int] = {}
+        if include_objects:
+            with self._obj_lock:
                 for oid, e in self._objects.items():
                     for nid in e["nodes"]:
                         oids_by_node.setdefault(nid, []).append(oid)
@@ -1562,7 +1868,7 @@ class HeadServer:
                     key, {"key": key, "bytes": 0, "objects": 0})
                 g["bytes"] += rec.get("size", 0)
                 g["objects"] += 1
-        with self._lock:
+        with self._obj_lock:
             n_leaks = len(self._leaks)
         return {
             "totals": totals,
@@ -1576,7 +1882,7 @@ class HeadServer:
 
     def rpc_memory_leaks(self):
         """Objects the sweeper currently flags, largest first."""
-        with self._lock:
+        with self._obj_lock:
             leaks = [dict(v) for v in self._leaks.values()]
         leaks.sort(key=lambda r: r.get("size", 0), reverse=True)
         return leaks
@@ -1601,7 +1907,7 @@ class HeadServer:
         if threshold <= 0:
             return
         now = time.time()
-        with self._lock:
+        with self._obj_lock:
             flagged: dict[str, dict] = {}
             for oid, entry in self._objects.items():
                 attr = entry.get("attr") or {}
@@ -1860,17 +2166,20 @@ class HeadServer:
         ]
         if not feasible:
             # One live entry per pending task: retries refresh the
-            # timestamp instead of inflating apparent demand.
-            if task_id is not None:
-                self._demand_misses = [
-                    m for m in self._demand_misses
-                    if m.get("task_id") != task_id
-                ]
-            self._demand_misses.append(
-                {"demand": dict(demand), "ts": time.monotonic(),
-                 "task_id": task_id}
-            )
-            del self._demand_misses[:-1000]
+            # timestamp (and slot order) instead of inflating apparent
+            # demand.
+            if task_id is None:
+                self._demand_miss_seq += 1
+                key = f"_anon:{self._demand_miss_seq}"
+            else:
+                key = task_id
+            self._demand_misses.pop(key, None)
+            self._demand_misses[key] = {
+                "demand": dict(demand), "ts": time.monotonic(),
+                "task_id": task_id,
+            }
+            while len(self._demand_misses) > 1000:
+                self._demand_misses.popitem(last=False)
             return None
 
         def headroom(n: NodeInfo) -> float:
@@ -1905,19 +2214,25 @@ class HeadServer:
     def _pick(self, node: NodeInfo, demand):
         # Optimistically debit the view so bursts spread before the next
         # heartbeat refreshes truth (the node agent's heartbeat remains
-        # authoritative and restores the real availability).
+        # authoritative and restores the real availability). The cached
+        # cluster-available sum tracks the same debit so status pollers
+        # see it; the node's next heartbeat delta restores both together.
+        debit_cache = node.schedulable
         for k, v in demand.items():
             node.available[k] = node.available.get(k, 0.0) - v
+            if debit_cache:
+                self._res_avail[k] = self._res_avail.get(k, 0.0) - v
         return node.node_id, node.address
 
     def rpc_pending_demands(self, window_s: float = 30.0):
         """Recent demands no alive node could fit (autoscaler input)."""
         cutoff = time.monotonic() - window_s
         with self._lock:
-            self._demand_misses = [
-                m for m in self._demand_misses if m["ts"] >= cutoff
-            ]
-            return [dict(m["demand"]) for m in self._demand_misses]
+            for key in [k for k, m in self._demand_misses.items()
+                        if m["ts"] < cutoff]:
+                del self._demand_misses[key]
+            return [dict(m["demand"])
+                    for m in self._demand_misses.values()]
 
     # -- placement groups (2-phase commit) --------------------------------
 

@@ -133,7 +133,7 @@ class NodeAgent:
         self._task_records: "collections.OrderedDict[str, dict]" = (
             collections.OrderedDict()
         )
-        self._task_records_cap = 10_000
+        self._task_records_cap = max(16, config.task_record_retention)
         # Task ids cancelled before the dispatcher picked them up (covers
         # the queue→checkout window where a task is in neither place).
         # Ordered so the bound evicts oldest-first.
@@ -709,7 +709,19 @@ class NodeAgent:
                 return
             if len(self._task_records) >= self._task_records_cap:
                 self._task_records.popitem(last=False)
+                self._count_task_record_eviction()
             self._task_records[rec["task_id"]] = rec
+
+    def _count_task_record_eviction(self) -> None:
+        """One tick per record the bounded ring pushed out — a 100k-task
+        burst keeps agent RSS flat and the eviction rate visible."""
+        from ray_tpu.util import metrics as _metrics
+
+        try:
+            _metrics.TASK_RECORDS_EVICTED.inc(
+                tags={"node_id": self.node_id})
+        except Exception:
+            pass
 
     def rpc_worker_events(self, worker_id, pid, task_events, log_lines,
                           spans=None, device=None):
@@ -743,6 +755,7 @@ class NodeAgent:
                     rec["submitted_at"] = old.get("submitted_at")
                 if len(self._task_records) >= self._task_records_cap:
                     self._task_records.popitem(last=False)
+                    self._count_task_record_eviction()
                 self._task_records[rec["task_id"]] = rec
         if log_lines:
             try:

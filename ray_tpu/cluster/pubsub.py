@@ -15,6 +15,28 @@ stalling the publisher). Same protocol here, hosted in the head:
 
 Channels in use: ``LOGS`` (worker stdout/stderr), ``ACTORS`` (lifecycle
 state changes), ``NODES`` (membership), ``ERRORS`` (pushed task errors).
+
+Round 6 (head at scale) restructured the hot path twice over:
+
+* **Key-indexed matching.** ``publish`` used to scan every subscriber
+  per message — O(subscribers) even when none matched. The publisher now
+  keeps a ``channel -> key -> {sub_id}`` index (plus a channel-wide
+  set for keys=None subscriptions), so a publish touches exactly the
+  subscribers it delivers to. 1k actor FSM updates against hundreds of
+  log pollers no longer pay for each other.
+* **Per-(subscriber, channel, key) coalescing.** ``ACTORS`` and
+  ``NODES`` messages carry the entity's FULL latest state, so a slow
+  subscriber doesn't need history — it needs the newest value. For
+  those channels, a publish whose (channel, key) is already buffered
+  for a subscriber REPLACES the buffered payload in place instead of
+  appending; the message keeps its queue position (delivery order of
+  first occurrence) and counts into ``coalesced``. Append-only feeds
+  (``LOGS``, ``ERRORS``) never coalesce — every line matters.
+
+Slow subscribers still lose oldest on buffer overflow (drop counter per
+subscriber, surfaced in ``poll`` and ``stats``), and a subscriber that
+stops polling past the TTL is reaped — on publish, and on the periodic
+``stats`` scrape, so idle-channel ghosts can't pin buffers forever.
 """
 
 from __future__ import annotations
@@ -24,19 +46,35 @@ import threading
 import time
 
 from ray_tpu.core.config import config
+from ray_tpu.util.metrics import (
+    PUBSUB_COALESCED as _PUBSUB_COALESCED,
+    PUBSUB_DROPPED as _PUBSUB_DROPPED,
+)
 
 CHANNELS = ("LOGS", "ACTORS", "NODES", "ERRORS")
 
+# State-update channels: each message is the entity's complete latest
+# state keyed by entity id, so replacing a buffered message with a newer
+# one loses nothing a subscriber could act on. Event/stream channels
+# (LOGS, ERRORS) are deliberately absent.
+COALESCE_CHANNELS = frozenset(("ACTORS", "NODES"))
+
 
 class _Subscriber:
-    __slots__ = ("queue", "dropped", "channels", "last_seen")
+    __slots__ = ("sub_id", "queue", "dropped", "coalesced", "channels",
+                 "last_seen", "pending")
 
-    def __init__(self):
+    def __init__(self, sub_id: str):
+        self.sub_id = sub_id
         self.queue: collections.deque = collections.deque()
         self.dropped = 0
+        self.coalesced = 0
         # channel -> None (all keys) | set of keys
         self.channels: dict[str, set | None] = {}
         self.last_seen = time.monotonic()
+        # (channel, key) -> the buffered message dict for coalescible
+        # channels, so a newer publish can swap the payload in place.
+        self.pending: dict[tuple, dict] = {}
 
 
 class Publisher:
@@ -45,11 +83,24 @@ class Publisher:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._subs: dict[str, _Subscriber] = {}
+        # Delivery index: channel -> {"*": {sub_id}, key: {sub_id}}.
+        # Publish unions the channel-wide set with the exact-key set —
+        # O(matching subscribers), not O(all subscribers).
+        self._index: dict[str, dict[str, set]] = {
+            ch: {"*": set()} for ch in CHANNELS
+        }
         # Config read at construction (not import) so overrides apply.
         self._max_buffer = (config.pubsub_max_buffer
                             if max_buffer is None else max_buffer)
         self._ttl = (config.pubsub_subscriber_ttl_s
                      if subscriber_ttl_s is None else subscriber_ttl_s)
+        # Cumulative totals survive subscriber reap/unsubscribe so
+        # rpc_pubsub_stats can expose lifetime drop/coalesce counts.
+        self._total_dropped = 0
+        self._total_coalesced = 0
+        self._total_published = 0
+
+    # -- membership --------------------------------------------------------
 
     def subscribe(self, sub_id: str, channel: str,
                   keys: list | None = None) -> bool:
@@ -58,15 +109,26 @@ class Publisher:
         with self._lock:
             sub = self._subs.get(sub_id)
             if sub is None:
-                sub = self._subs[sub_id] = _Subscriber()
+                sub = self._subs[sub_id] = _Subscriber(sub_id)
+            sub.last_seen = time.monotonic()
+            idx = self._index[channel]
             if keys is None:
+                # Widening to all-keys supersedes any per-key entries.
+                have = sub.channels.get(channel)
+                if have:
+                    for k in have:
+                        self._index_discard(channel, k, sub_id)
                 sub.channels[channel] = None
+                idx["*"].add(sub_id)
             else:
                 have = sub.channels.get(channel)
                 if have is None and channel in sub.channels:
                     pass  # already subscribed to ALL keys: keep that
                 else:
-                    sub.channels[channel] = (have or set()) | set(keys)
+                    merged = (have or set()) | set(keys)
+                    sub.channels[channel] = merged
+                    for k in keys:
+                        idx.setdefault(k, set()).add(sub_id)
         return True
 
     def unsubscribe(self, sub_id: str, channel: str | None = None) -> bool:
@@ -75,34 +137,102 @@ class Publisher:
             if sub is None:
                 return False
             if channel is None:
-                del self._subs[sub_id]
+                self._drop_subscriber(sub)
             else:
+                self._unindex_channel(sub, channel)
                 sub.channels.pop(channel, None)
+                sub.pending = {
+                    pk: m for pk, m in sub.pending.items()
+                    if pk[0] != channel
+                }
                 if not sub.channels:
-                    del self._subs[sub_id]
+                    self._drop_subscriber(sub)
         return True
 
+    def _index_discard(self, channel: str, key: str, sub_id: str) -> None:
+        entry = self._index[channel].get(key)
+        if entry is not None:
+            entry.discard(sub_id)
+            if not entry and key != "*":
+                del self._index[channel][key]
+
+    def _unindex_channel(self, sub: _Subscriber, channel: str) -> None:
+        keys = sub.channels.get(channel, ())
+        if keys is None:
+            self._index[channel]["*"].discard(sub.sub_id)
+        else:
+            for k in keys:
+                self._index_discard(channel, k, sub.sub_id)
+
+    def _drop_subscriber(self, sub: _Subscriber) -> None:
+        """Caller holds the lock: remove the subscriber and every index
+        entry pointing at it. Lifetime drop totals keep its count."""
+        for channel in list(sub.channels):
+            self._unindex_channel(sub, channel)
+        # Overflow drops already landed in _total_dropped at publish
+        # time; only the never-delivered buffered tail is new loss.
+        self._total_dropped += len(sub.queue)
+        self._subs.pop(sub.sub_id, None)
+
+    def _reap_stale(self, now: float) -> None:
+        """Caller holds the lock: drop every subscriber whose last poll
+        is older than the TTL (poller gone: stop buffering for it)."""
+        stale = [s for s in self._subs.values()
+                 if now - s.last_seen > self._ttl]
+        for sub in stale:
+            self._drop_subscriber(sub)
+
+    # -- hot path ----------------------------------------------------------
+
     def publish(self, channel: str, key: str, message) -> int:
-        """Returns the number of subscribers the message was queued to."""
+        """Returns the number of subscribers the message was queued to
+        (coalesced replacements count — the subscriber WILL see it)."""
         delivered = 0
         now = time.monotonic()
+        coalesce = channel in COALESCE_CHANNELS
+        idx = self._index.get(channel)
+        if idx is None:
+            raise ValueError(f"unknown channel {channel!r}")
         with self._cv:
-            dead = []
-            for sub_id, sub in self._subs.items():
-                keys = sub.channels.get(channel, "absent")
-                if keys == "absent" or (keys is not None and key not in keys):
+            self._total_published += 1
+            targets = idx["*"] | idx.get(key, set())
+            if not targets:
+                return 0
+            stale = []
+            for sub_id in targets:
+                sub = self._subs.get(sub_id)
+                if sub is None:
                     continue
                 if now - sub.last_seen > self._ttl:
-                    dead.append(sub_id)  # poller gone: stop buffering
+                    stale.append(sub)  # poller gone: stop buffering
                     continue
-                sub.queue.append(
-                    {"channel": channel, "key": key, "data": message})
+                if coalesce:
+                    buffered = sub.pending.get((channel, key))
+                    if buffered is not None:
+                        # Latest-state-wins: swap the payload in place;
+                        # the subscriber sees ONE message with the
+                        # newest data at the old queue position.
+                        buffered["data"] = message
+                        sub.coalesced += 1
+                        self._total_coalesced += 1
+                        _PUBSUB_COALESCED.inc()
+                        delivered += 1
+                        continue
+                entry = {"channel": channel, "key": key, "data": message}
+                sub.queue.append(entry)
+                if coalesce:
+                    sub.pending[(channel, key)] = entry
                 if len(sub.queue) > self._max_buffer:
-                    sub.queue.popleft()
+                    lost = sub.queue.popleft()
                     sub.dropped += 1
+                    self._total_dropped += 1
+                    _PUBSUB_DROPPED.inc()
+                    pk = (lost["channel"], lost["key"])
+                    if sub.pending.get(pk) is lost:
+                        del sub.pending[pk]
                 delivered += 1
-            for sub_id in dead:
-                del self._subs[sub_id]
+            for sub in stale:
+                self._drop_subscriber(sub)
             if delivered:
                 self._cv.notify_all()
         return delivered
@@ -122,7 +252,11 @@ class Publisher:
                 if sub.queue:
                     out = []
                     while sub.queue and len(out) < max_msgs:
-                        out.append(sub.queue.popleft())
+                        msg = sub.queue.popleft()
+                        pk = (msg["channel"], msg["key"])
+                        if sub.pending.get(pk) is msg:
+                            del sub.pending[pk]
+                        out.append(msg)
                     dropped, sub.dropped = sub.dropped, 0
                     return out, dropped
                 remaining = deadline - time.monotonic()
@@ -131,8 +265,18 @@ class Publisher:
                 self._cv.wait(remaining)
 
     def stats(self) -> dict:
+        """Pubsub health counters (fed into ``rpc_pubsub_stats``). The
+        scrape doubles as the idle-channel reaper: a subscriber past the
+        TTL is dropped here even if nothing publishes to its channels."""
         with self._lock:
+            self._reap_stale(time.monotonic())
             return {
                 "subscribers": len(self._subs),
                 "buffered": sum(len(s.queue) for s in self._subs.values()),
+                "published": self._total_published,
+                "dropped": self._total_dropped,
+                "coalesced": self._total_coalesced,
+                "indexed_keys": {
+                    ch: len(idx) - 1 for ch, idx in self._index.items()
+                },
             }

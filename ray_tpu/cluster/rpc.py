@@ -356,9 +356,14 @@ class RpcServer:
     """Serves ``rpc_<method>`` methods of a handler object."""
 
     def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0,
-                 token: bytes | None = None):
+                 token: bytes | None = None, rpc_histogram=None):
         self._handler = handler
         self._token = get_cluster_token() if token is None else token
+        # Optional per-method latency histogram (a metrics.Histogram with
+        # a "method" tag key): the head passes ray_tpu_head_rpc_seconds
+        # so handler latency lands on the federated scrape; agents skip
+        # it (their per-method stats stay in handler_stats only).
+        self._rpc_histogram = rpc_histogram
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -386,6 +391,11 @@ class RpcServer:
                 ent[1] += dt
                 if dt > ent[2]:
                     ent[2] = dt
+        if self._rpc_histogram is not None:
+            try:
+                self._rpc_histogram.observe(dt, tags={"method": method})
+            except Exception:
+                pass  # instrumentation must never fail a handler
 
     def handler_stats(self) -> dict:
         """{method: {count, total_s, max_s, mean_ms}} snapshot."""

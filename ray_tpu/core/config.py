@@ -34,6 +34,17 @@ _DEFS: dict[str, tuple[type, Any]] = {
     "node_death_timeout_s": (float, 5.0),
     "head_reconnect_window_s": (float, 15.0),
     "head_snapshot_interval_s": (float, 0.2),
+    # Write-behind persistence (head _PersistentStore): the flusher
+    # thread commits the coalesced dirty queue as ONE sqlite transaction
+    # every interval (whole batches land or don't — never a torn row);
+    # max_batch bounds one transaction's statement count. Crash loss
+    # window <= one interval; snapshot/shutdown flush synchronously.
+    "head_persist_flush_interval_s": (float, 0.05),
+    "head_persist_max_batch": (int, 2_000),
+    # Tracing spans the head retains (ring buffer; older spans drop and
+    # the drop counter surfaces in rpc_pubsub_stats / metrics). Bounds
+    # head RSS under a 100k-task burst's span upload.
+    "head_span_retention": (int, 100_000),
     # -- worker pool -------------------------------------------------------
     "workers_per_cpu": (int, 4),
     "worker_start_timeout_s": (float, 60.0),
@@ -105,6 +116,17 @@ _DEFS: dict[str, tuple[type, Any]] = {
     # itself is capacity-based, not depth-based — see
     # node_agent.rpc_submit_tasks_leased.)
     "submit_batch_max": (int, 256),
+    # Unplaceable-spec retry backoff (client _retry_heap): the first
+    # re-schedule attempt comes after base_s, doubling per miss up to
+    # max_s. A flat timer at 100k parked specs re-batched EVERY tick
+    # through schedule_batch — ~400 head RPCs per 250ms of pure misses;
+    # backoff decays that to a trickle while staying responsive when
+    # capacity appears within the first few attempts.
+    "submit_retry_base_s": (float, 0.25),
+    "submit_retry_max_s": (float, 2.0),
+    # Finished-task records each node agent retains (ring; evictions
+    # count into ray_tpu_task_records_evicted_total).
+    "task_record_retention": (int, 10_000),
     # -- node drain / preemption -------------------------------------------
     # Default deadline a graceful drain gives in-flight tasks before the
     # node is force-removed (DrainRaylet deadline analog).

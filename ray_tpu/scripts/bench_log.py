@@ -162,6 +162,53 @@ def record_chaos_soak(*, seed, duration_s: float, faults: dict,
     return entry
 
 
+def record_scalebench(*, scalability: dict | None = None,
+                      head_scale: dict | None = None,
+                      device: str = "", path: str | None = None,
+                      **extra) -> dict:
+    """Control-plane envelope evidence (``scripts/scalebench.py``): the
+    real-cluster section's rates and the head-at-scale section's
+    machine-independent per-RPC accounting, flattened to the headline
+    numbers (the full artifact lives in MICROBENCH.json — this line is
+    the timestamped when/at-what-shape trail). Committed to
+    BENCH_TPU_SESSIONS.jsonl only on an accelerator; returns the entry
+    (with ``committed_to``) either way."""
+
+    def headline(section: dict | None, keys: tuple) -> dict:
+        if not section:
+            return {}
+        out = {}
+        for k in keys:
+            e = section.get(k)
+            if isinstance(e, dict) and "value" in e:
+                out[k] = e["value"]
+            elif e is not None and not isinstance(e, dict):
+                out[k] = e
+        return out
+
+    entry: dict = {"bench": "scalebench", "device": device}
+    sc = headline(scalability, (
+        "nodes", "cpus_per_node", "cluster_boot_s", "burst_tasks_per_s",
+        "burst_submit_per_s", "actor_create_call_per_s",
+        "broadcast_agg_gib_per_s", "queued_pending",
+        "queued_sched_rpcs_per_s", "queued_probe_latency_s",
+        "queued_shutdown_s", "queued_rss_growth_mb"))
+    if sc:
+        entry["scalability"] = sc
+    hs = headline(head_scale, (
+        "nodes", "queued", "actors", "subscribers", "spans",
+        "heartbeats_per_s", "status_polls_per_s", "sched_feasible_per_s",
+        "sched_infeasible_per_s", "ref_begin_per_s", "add_location_per_s",
+        "actor_register_per_s", "actor_updates_per_s",
+        "pubsub_coalesced", "pubsub_dropped", "span_dropped",
+        "persist_coalesced", "rss_growth_mb", "head_handler_total_s"))
+    if hs:
+        entry["head_scale"] = hs
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
 def record_drain_recovery(proactive_drain_ms: float,
                           crash_detection_ms: float, *,
                           device: str = "", path: str | None = None,

@@ -40,6 +40,7 @@ def _render_table(artifact: dict) -> str:
     artifact (no hand-copied values)."""
     m = artifact.get("metrics", {})
     s = artifact.get("scalability", {})
+    h = artifact.get("head_scale", {})
     p = artifact.get("pipeline", {})
     meta = artifact.get("meta", {})
 
@@ -49,6 +50,10 @@ def _render_table(artifact: dict) -> str:
 
     def sv(key):
         e = s.get(key)
+        return f"{e['value']:,.1f} {e['unit']}" if e else "—"
+
+    def hv(key):
+        e = h.get(key)
         return f"{e['value']:,.1f} {e['unit']}" if e else "—"
 
     lines = [
@@ -76,6 +81,45 @@ def _render_table(artifact: dict) -> str:
         f"({sv('broadcast_object_gib')} object) |",
         f"| cluster boot | — | {sv('cluster_boot_s')} |",
     ]
+    if s.get("queued_pending"):
+        n_pending = s["queued_pending"].get("value", 0)
+        lines += [
+            "",
+            f"| Parked-queue audit ({n_pending:,.0f} infeasible specs) | |",
+            "|---|---|",
+            f"| submit into client queue | {sv('queued_submit_per_s')} |",
+            f"| steady-state head schedule RPCs | "
+            f"{sv('queued_sched_rpcs_per_s')} |",
+            f"| feasible probe latency under backlog | "
+            f"{sv('queued_probe_latency_s')} |",
+            f"| driver RSS growth | {sv('queued_rss_growth_mb')} |",
+            f"| shutdown (fails whole backlog) | "
+            f"{sv('queued_shutdown_s')} |",
+        ]
+    if h:
+        lines += [
+            "",
+            f"| Head at scale ({h.get('nodes', 0)} nodes, "
+            f"{h.get('queued', 0):,} queued, {h.get('actors', 0):,} "
+            f"actors, {h.get('subscribers', 0)} slow subscribers) "
+            f"| rate |",
+            "|---|---|",
+            f"| heartbeats | {hv('heartbeats_per_s')} |",
+            f"| status polls (cached totals) | {hv('status_polls_per_s')} |",
+            f"| schedule_batch, feasible | {hv('sched_feasible_per_s')} |",
+            f"| schedule_batch, infeasible | "
+            f"{hv('sched_infeasible_per_s')} |",
+            f"| borrow registrations | {hv('ref_begin_per_s')} |",
+            f"| location adds | {hv('add_location_per_s')} |",
+            f"| actor register | {hv('actor_register_per_s')} |",
+            f"| actor FSM updates (pubsub) | {hv('actor_updates_per_s')} |",
+            f"| pubsub coalesced / dropped | {hv('pubsub_coalesced')} / "
+            f"{hv('pubsub_dropped')} |",
+            f"| spans dropped at cap | {hv('span_dropped')} |",
+            f"| persist writes coalesced | {hv('persist_coalesced')} |",
+            f"| head RSS growth | {hv('rss_growth_mb')} |",
+            f"| head handler CPU total | {hv('head_handler_total_s')} |",
+        ]
     if p:
         lines += [
             "",
@@ -118,6 +162,9 @@ def main() -> None:
     ap.add_argument("--tasks", type=int, default=2000)
     ap.add_argument("--actors", type=int, default=200)
     ap.add_argument("--broadcast-mb", type=int, default=256)
+    ap.add_argument("--queued", type=int, default=0,
+                    help="parked-queue audit depth for scalebench")
+    ap.add_argument("--skip-head-scale", action="store_true")
     ap.add_argument("--skip-pipeline", action="store_true")
     args = ap.parse_args()
 
@@ -131,7 +178,9 @@ def main() -> None:
         [sys.executable, "-m", "ray_tpu.scripts.scalebench",
          "--nodes", str(args.nodes), "--cpus", str(args.cpus),
          "--tasks", str(args.tasks), "--actors", str(args.actors),
-         "--broadcast-mb", str(args.broadcast_mb), "--out", args.out],
+         "--broadcast-mb", str(args.broadcast_mb),
+         "--queued", str(args.queued), "--out", args.out]
+        + ([] if args.skip_head_scale else ["--head-scale"]),
     ]
     if not args.skip_pipeline:
         steps.append([sys.executable, "-m",

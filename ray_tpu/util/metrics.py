@@ -273,6 +273,61 @@ NODE_DRAIN_ACTORS_MIGRATED = Counter(
     tag_keys=("reason",),
 )
 
+# -- head control plane (head-side; the contention instrumentation the
+# 100k-task/1k-actor envelope reads: per-method handler latency on the
+# head's RPC server, time spent WAITING on each head lock shard — an
+# uncontended acquire observes nothing — and the write-behind
+# persistence queue, so "the head is melting" shows up in the federated
+# scrape as a named shard/method instead of a vibe).
+HEAD_RPC_SECONDS = Histogram(
+    "ray_tpu_head_rpc_seconds",
+    "Head RPC handler wall time, per method",
+    boundaries=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5],
+    tag_keys=("method",),
+)
+HEAD_LOCK_WAIT_SECONDS = Histogram(
+    "ray_tpu_head_lock_wait_seconds",
+    "Time head threads spent blocked acquiring a contended lock shard "
+    "(nodes = node/actor/PG tables, objects = object/ref tables, "
+    "events = spans/logs)",
+    boundaries=[0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0],
+    tag_keys=("shard",),
+)
+HEAD_PERSIST_QUEUE_DEPTH = Gauge(
+    "ray_tpu_head_persist_queue_depth",
+    "Dirty keys waiting in the head's write-behind persistence queue",
+)
+HEAD_PERSIST_FLUSH_SECONDS = Histogram(
+    "ray_tpu_head_persist_flush_seconds",
+    "Wall time of one write-behind sqlite batch transaction",
+    boundaries=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 1.0],
+)
+HEAD_PERSIST_COALESCED = Counter(
+    "ray_tpu_head_persist_coalesced_total",
+    "Per-key writes absorbed by the write-behind queue before a flush "
+    "(each one was a synchronous fsync'd transaction before round 6)",
+)
+HEAD_SPANS_DROPPED = Counter(
+    "ray_tpu_head_spans_dropped_total",
+    "Tracing spans dropped by the head's bounded span ring",
+)
+TASK_RECORDS_EVICTED = Counter(
+    "ray_tpu_task_records_evicted_total",
+    "Finished task records evicted from a node agent's bounded ring",
+    tag_keys=("node_id",),
+)
+PUBSUB_COALESCED = Counter(
+    "ray_tpu_pubsub_coalesced_total",
+    "Pubsub messages absorbed by per-(subscriber,channel,key) "
+    "coalescing (subscriber saw latest state instead of history)",
+)
+PUBSUB_DROPPED = Counter(
+    "ray_tpu_pubsub_dropped_total",
+    "Pubsub messages dropped on slow-subscriber buffer overflow",
+)
+
 # -- RPC plane (client-side; one increment per reconnect attempt a
 # retry-windowed call makes after losing its connection — a reconnect
 # storm against one peer is visible on the federated scrape).

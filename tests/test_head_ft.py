@@ -123,3 +123,182 @@ def test_state_survives_graceful_restart(tmp_path):
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+# -- round 6: write-behind persistence durability ---------------------------
+#
+# The store went write-through -> write-behind (coalesced dirty queue,
+# batched transactions). These tests pin the durability contract that
+# change must preserve: whole batches land or don't (never a torn row),
+# a crash loses at most the unflushed tail, close() is loss-free, and
+# the snapshot failpoint still gates real disk writes.
+
+
+def _read_disk(path, ns):
+    """Independent second connection: what is ACTUALLY on disk."""
+    import sqlite3
+
+    conn = sqlite3.connect(path)
+    try:
+        return dict(conn.execute(
+            "SELECT k, v FROM t WHERE ns = ?", (ns,)).fetchall())
+    finally:
+        conn.close()
+
+
+def test_write_behind_flush_is_transactional(tmp_path):
+    """A failing flush commits NOTHING from its batch (rollback +
+    front-requeue); the retry lands the whole batch."""
+    from ray_tpu.cluster.head import _PersistentStore
+    from ray_tpu.core.config import config
+
+    config.override("head_persist_flush_interval_s", 3600.0)  # manual
+    path = str(tmp_path / "wb.db")
+    try:
+        store = _PersistentStore(path)
+        for i in range(5):
+            store.put("ns", f"k{i}", i)
+        # Poison pill mid-batch: sqlite rejects the bind, failing the
+        # transaction AFTER five statements already executed — those
+        # five must roll back with it.
+        store._enqueue("ns", "poison", object())
+        for i in range(5, 10):
+            store.put("ns", f"k{i}", i)
+        with pytest.raises(Exception):
+            store.flush()
+        assert _read_disk(path, "ns") == {}  # all-or-none: none
+        assert store.stats()["flush_failures"] == 1
+        assert store.stats()["queued"] == 11  # requeued, not lost
+        with store._dirty_mu:
+            del store._dirty[("ns", "poison")]
+        store.flush()
+        assert len(_read_disk(path, "ns")) == 10  # ...and all
+        assert store.load_ns("ns") == {f"k{i}": i for i in range(10)}
+        store.close()
+    finally:
+        config.reset("head_persist_flush_interval_s")
+
+
+def test_write_behind_coalesces_per_key(tmp_path):
+    """N writes to one key before a flush become ONE row write, and the
+    LAST value wins — on disk and through load_ns."""
+    from ray_tpu.cluster.head import _PersistentStore
+    from ray_tpu.core.config import config
+
+    config.override("head_persist_flush_interval_s", 3600.0)
+    try:
+        store = _PersistentStore(str(tmp_path / "co.db"))
+        for i in range(100):
+            store.put("ns", "hot", i)
+        store.delete("ns", "hot")
+        store.put("ns", "hot", "final")
+        st = store.stats()
+        assert st["queued"] == 1
+        assert st["coalesced"] == 101
+        store.flush()
+        assert store.load_ns("ns") == {"hot": "final"}
+        store.close()
+    finally:
+        config.reset("head_persist_flush_interval_s")
+
+
+def test_crash_mid_flush_drops_whole_batches_only(tmp_path):
+    """An abandon() (process-kill analog) loses exactly the unflushed
+    tail: everything flushed before the crash reloads, nothing from the
+    pending batch appears partially."""
+    from ray_tpu.cluster.head import _PersistentStore
+    from ray_tpu.core.config import config
+
+    config.override("head_persist_flush_interval_s", 3600.0)
+    path = str(tmp_path / "crash.db")
+    try:
+        store = _PersistentStore(path)
+        store.put("ns", "committed-1", "a")
+        store.put("ns", "committed-2", "b")
+        store.flush()
+        for i in range(50):  # the doomed batch
+            store.put("ns", f"tail{i}", i)
+        store.abandon()  # crash: dirty queue dies unflushed
+        survivor = _PersistentStore(path)
+        got = survivor.load_ns("ns")
+        assert got == {"committed-1": "a", "committed-2": "b"}
+        survivor.close()
+    finally:
+        config.reset("head_persist_flush_interval_s")
+
+
+def test_head_reload_after_kill_matches_write_through(tmp_path):
+    """End-to-end parity with the old write-through behavior: state a
+    head persisted before an ungraceful kill (node registrations, KV,
+    snapshot tables) reloads into a fresh head on the same path."""
+    from ray_tpu.cluster.head import HeadServer
+    from ray_tpu.core import ids
+
+    path = str(tmp_path / "head.db")
+    head = HeadServer(persist_path=path, metrics_port=None)
+    nid = ids.new_node_id()
+    head.rpc_register_node(nid, "127.0.0.1:1", {"CPU": 4.0}, "/dev/null")
+    head.rpc_kv_put("cfg", b"v1")
+    aid = ids.new_actor_id()
+    head.rpc_create_actor_record(aid, 0, 0, {"spec": {}})
+    head.rpc_register_actor(aid, nid, "127.0.0.1:1", "Holder",
+                            name="holder")
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        # Snapshot loop tick + flush: actors land durably.
+        if _read_disk(path, "snap") and _read_disk(path, "node"):
+            if head._store.stats()["queued"] == 0:
+                break
+        time.sleep(0.1)
+    # Ungraceful kill: no close(), pending queue abandoned.
+    head._stop.set()
+    head._server.stop()
+    head._store.abandon()
+
+    reloaded = HeadServer(persist_path=path, metrics_port=None)
+    try:
+        assert reloaded.rpc_kv_get("cfg") == b"v1"
+        nodes = {n["NodeID"] for n in reloaded.rpc_nodes()}
+        assert nid in nodes
+        # Cached resource totals rebuilt from the reloaded node table.
+        assert reloaded.rpc_cluster_resources() == {"CPU": 4.0}
+        info = reloaded.rpc_get_named_actor("holder")
+        assert info is not None and info["actor_id"] == aid
+    finally:
+        reloaded.stop()
+
+
+def test_snapshot_failpoint_gates_write_behind_flush(tmp_path):
+    """``head.snapshot.before_persist`` armed to raise must keep actor
+    snapshots OFF disk even though writes are now asynchronous — the
+    flush rides the snapshot tick the failpoint gates."""
+    from ray_tpu.cluster.head import HeadServer
+    from ray_tpu.core import ids
+    from ray_tpu.util import failpoints
+
+    path = str(tmp_path / "fp.db")
+    head = HeadServer(persist_path=path, metrics_port=None)
+    try:
+        failpoints.arm("head.snapshot.before_persist", "raise")
+        time.sleep(0.3)  # let armed ticks pass
+        aid = ids.new_actor_id()
+        head.rpc_create_actor_record(aid, 0, 0, {"spec": {}})
+        head.rpc_register_actor_failed(aid, "test")  # any actor record
+        time.sleep(0.6)
+        import pickle
+
+        snap = _read_disk(path, "snap")
+        actors = pickle.loads(snap["actors"]) if "actors" in snap else {}
+        assert aid not in actors, "failpoint did not gate the snapshot"
+        failpoints.disarm("head.snapshot.before_persist")
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            snap = _read_disk(path, "snap")
+            if "actors" in snap and aid in pickle.loads(snap["actors"]):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("snapshot never landed after disarm")
+    finally:
+        failpoints.disarm("head.snapshot.before_persist")
+        head.stop()

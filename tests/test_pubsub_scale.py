@@ -1,0 +1,209 @@
+"""Pubsub backpressure at scale (round 6): key-indexed matching,
+per-(subscriber, channel, key) coalescing on state channels, bounded
+buffers with visible drop counters, and subscriber-TTL reap under churn
+— plus the head-side bounded planes (span ring, persistence queue)
+surfaced through ``rpc_pubsub_stats``.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.cluster.pubsub import Publisher
+from ray_tpu.core.config import config
+
+
+# -- coalescing ------------------------------------------------------------
+
+
+def test_actor_updates_coalesce_to_latest_per_key():
+    """A slow ACTORS subscriber sees ONE message per key carrying the
+    newest state, not the full history."""
+    p = Publisher(max_buffer=1000)
+    p.subscribe("slow", "ACTORS")
+    for rnd in range(50):
+        for aid in ("a1", "a2", "a3"):
+            p.publish("ACTORS", aid, {"state": "ALIVE", "round": rnd})
+    msgs, dropped = p.poll("slow", timeout=0.1)
+    assert dropped == 0
+    assert len(msgs) == 3  # one per key, 147 coalesced away
+    assert sorted(m["key"] for m in msgs) == ["a1", "a2", "a3"]
+    assert all(m["data"]["round"] == 49 for m in msgs)
+    st = p.stats()
+    assert st["coalesced"] == 147
+    assert st["dropped"] == 0
+
+
+def test_logs_never_coalesce():
+    """Append-only feeds deliver full history — every line matters."""
+    p = Publisher(max_buffer=1000)
+    p.subscribe("s", "LOGS")
+    for i in range(20):
+        p.publish("LOGS", "node-1", {"line": i})
+    msgs, _ = p.poll("s", timeout=0.1)
+    assert [m["data"]["line"] for m in msgs] == list(range(20))
+    assert p.stats()["coalesced"] == 0
+
+
+def test_coalesced_message_keeps_queue_position():
+    """The replaced payload rides the ORIGINAL message's slot: delivery
+    order is first-occurrence order, not update order."""
+    p = Publisher(max_buffer=1000)
+    p.subscribe("s", "ACTORS")
+    p.publish("ACTORS", "a1", 1)
+    p.publish("ACTORS", "a2", 2)
+    p.publish("ACTORS", "a1", 3)  # coalesces into slot 0
+    msgs, _ = p.poll("s", timeout=0.1)
+    assert [(m["key"], m["data"]) for m in msgs] == [("a1", 3), ("a2", 2)]
+
+
+def test_poll_then_new_publish_is_a_fresh_message():
+    """Coalescing only reaches messages still buffered: after a poll
+    drains the queue, the next publish is a new message (the subscriber
+    never misses a state it hasn't already superseded)."""
+    p = Publisher(max_buffer=1000)
+    p.subscribe("s", "ACTORS")
+    p.publish("ACTORS", "a1", {"v": 1})
+    msgs, _ = p.poll("s", timeout=0.1)
+    assert msgs[0]["data"] == {"v": 1}
+    p.publish("ACTORS", "a1", {"v": 2})
+    msgs, _ = p.poll("s", timeout=0.1)
+    assert msgs[0]["data"] == {"v": 2}
+
+
+# -- bounded buffers / drop counters ---------------------------------------
+
+
+def test_slow_subscriber_bounded_with_drop_counter():
+    p = Publisher(max_buffer=10)
+    p.subscribe("s", "LOGS")
+    for i in range(35):
+        p.publish("LOGS", "n", i)
+    msgs, dropped = p.poll("s", timeout=0.1)
+    assert len(msgs) == 10
+    assert dropped == 25
+    assert msgs[0]["data"] == 25  # oldest lost
+    assert p.stats()["dropped"] == 25
+
+
+def test_overflow_drop_clears_pending_slot():
+    """An overflow that evicts a coalescible message must clear its
+    pending slot so the NEXT publish for that key buffers fresh."""
+    p = Publisher(max_buffer=2)
+    p.subscribe("s", "ACTORS")
+    p.publish("ACTORS", "a1", 1)
+    p.publish("ACTORS", "a2", 2)
+    p.publish("ACTORS", "a3", 3)  # evicts a1's entry
+    p.publish("ACTORS", "a1", 4)  # must re-buffer (evicting a2), not
+    p.publish("ACTORS", "a1", 5)  # ...write into the evicted dict
+    msgs, dropped = p.poll("s", timeout=0.1)
+    assert dropped == 2
+    assert [(m["key"], m["data"]) for m in msgs] == [("a3", 3), ("a1", 5)]
+
+
+# -- key-indexed matching --------------------------------------------------
+
+
+def test_key_index_narrows_delivery():
+    p = Publisher()
+    p.subscribe("only-a1", "ACTORS", keys=["a1"])
+    p.subscribe("all", "ACTORS")
+    assert p.publish("ACTORS", "a1", 1) == 2
+    assert p.publish("ACTORS", "a2", 2) == 1  # only the wildcard sub
+    msgs, _ = p.poll("only-a1", timeout=0.1)
+    assert [m["key"] for m in msgs] == ["a1"]
+    st = p.stats()
+    assert st["indexed_keys"]["ACTORS"] == 1  # a1 (wildcard not counted)
+
+
+def test_widening_to_all_keys_supersedes_key_list():
+    p = Publisher()
+    p.subscribe("s", "ACTORS", keys=["a1"])
+    p.subscribe("s", "ACTORS")  # widen
+    assert p.publish("ACTORS", "other", 1) == 1
+    assert p.stats()["indexed_keys"]["ACTORS"] == 0
+
+
+def test_unsubscribe_cleans_index():
+    p = Publisher()
+    p.subscribe("s", "ACTORS", keys=["a1", "a2"])
+    p.unsubscribe("s", "ACTORS")
+    assert p.publish("ACTORS", "a1", 1) == 0
+    assert p.stats()["indexed_keys"]["ACTORS"] == 0
+    assert p.stats()["subscribers"] == 0
+
+
+# -- TTL reap --------------------------------------------------------------
+
+
+def test_stale_subscriber_reaped_on_publish():
+    p = Publisher(subscriber_ttl_s=0.2)
+    p.subscribe("ghost", "ACTORS")
+    p.subscribe("live", "ACTORS")
+    time.sleep(0.3)
+    p.poll("live", timeout=0.01)  # refreshes last_seen
+    p.publish("ACTORS", "a1", 1)
+    st = p.stats()
+    assert st["subscribers"] == 1
+    msgs, _ = p.poll("live", timeout=0.1)
+    assert len(msgs) == 1
+    assert p.poll("ghost", timeout=0.01) is None  # reaped: re-subscribe
+
+
+def test_idle_channel_ghost_reaped_by_stats():
+    """A subscriber on a channel nothing publishes to still reaps: the
+    stats scrape doubles as the reaper."""
+    p = Publisher(subscriber_ttl_s=0.2)
+    p.subscribe("ghost", "ERRORS")
+    time.sleep(0.3)
+    assert p.stats()["subscribers"] == 0
+
+
+def test_reap_under_churn_keeps_index_consistent():
+    p = Publisher(subscriber_ttl_s=0.15)
+    for i in range(20):
+        p.subscribe(f"s{i}", "ACTORS", keys=[f"a{i % 5}"])
+    time.sleep(0.25)
+    p.subscribe("fresh", "ACTORS", keys=["a0"])
+    assert p.publish("ACTORS", "a0", 1) == 1  # ghosts gone, fresh served
+    st = p.stats()
+    assert st["subscribers"] == 1
+    assert st["indexed_keys"]["ACTORS"] == 1
+
+
+# -- head integration: rpc_pubsub_stats surfaces every bounded plane -------
+
+
+@pytest.fixture()
+def bare_head(tmp_path):
+    from ray_tpu.cluster.head import HeadServer
+
+    config.override("head_span_retention", 100)
+    head = HeadServer(persist_path=str(tmp_path / "head.db"),
+                      metrics_port=None)
+    yield head
+    head.stop()
+    config.reset("head_span_retention")
+
+
+def test_rpc_pubsub_stats_reports_span_ring_and_persist(bare_head):
+    head = bare_head
+    spans = [{"trace_id": f"{i:016x}", "span_id": f"{i:016x}",
+              "name": "t", "t0": 0.0, "t1": 1.0} for i in range(260)]
+    head.rpc_report_spans(spans[:130])
+    head.rpc_report_spans(spans[130:])
+    st = head.rpc_pubsub_stats()
+    assert st["spans"]["cap"] == 100
+    assert st["spans"]["retained"] == 100
+    assert st["spans"]["dropped"] == 160
+    # Listing returns only the newest cap's worth.
+    listed = head.rpc_list_spans()
+    assert len(listed) == 100
+    assert listed[-1]["trace_id"] == f"{259:016x}"
+    # The write-behind store's counters ride the same RPC.
+    assert "persist" in st
+    assert set(st["persist"]) == {
+        "queued", "coalesced", "flushes", "flush_failures"}
+    # And the pubsub plane's own counters are present.
+    for key in ("subscribers", "buffered", "dropped", "coalesced"):
+        assert key in st
