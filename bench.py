@@ -34,25 +34,8 @@ TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT", "240"))
 TPU_RETRY_TIMEOUT_S = int(os.environ.get("BENCH_TPU_RETRY_TIMEOUT", "120"))
 CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "120"))
 
-# bf16 peak TFLOP/s per chip by device kind substring.
-PEAK_TFLOPS = {
-    "v5 lite": 197.0,
-    "v5litepod": 197.0,
-    "v5e": 197.0,
-    "v4": 275.0,
-    "v5p": 459.0,
-    "v6 lite": 918.0,
-    "v6e": 918.0,
-    "cpu": 0.5,  # nominal, so the script still runs off-TPU
-}
-
-
-def _peak_flops_per_chip(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for key, tf in PEAK_TFLOPS.items():
-        if key in kind:
-            return tf * 1e12
-    return 197.0e12
+# MFU denominators live in the shared harness (ray_tpu/scripts/measure.py)
+# next to the one timed-step protocol bench.py and tpu_sweep.py both use.
 
 
 # --------------------------------------------------------------------------
@@ -71,15 +54,9 @@ def _worker(platform: str, variant: str = "auto") -> None:
 
     import jax.numpy as jnp
 
-    from ray_tpu.models.gpt2 import (
-        GPT2Config,
-        gpt2_flops_per_token,
-        gpt2_init,
-        gpt2_loss,
-        gpt2_shardings,
-    )
+    from ray_tpu.models.gpt2 import GPT2Config
     from ray_tpu.parallel.mesh import MeshConfig, build_mesh
-    from ray_tpu.train.train_step import make_init_fn, make_train_step
+    from ray_tpu.scripts.measure import measure_gpt2
 
     on_tpu = jax.default_backend() not in ("cpu",)
     n_dev = jax.device_count()
@@ -99,58 +76,40 @@ def _worker(platform: str, variant: str = "auto") -> None:
         lever = dataclasses.replace(
             base, logits_dtype=jnp.bfloat16, ce_vocab_chunks=4)
         batch, steps, warmup = 8, 5, 1
+    # Round-7 lever (PROFILE.md sink #3): fused Pallas norm/residual/GELU
+    # backward kernels on top of the round-5 winner.
+    fused = dataclasses.replace(lever, fused_norm=True)
 
     mesh = build_mesh(MeshConfig(fsdp=-1))
 
     def measure(cfg):
-        shardings = gpt2_shardings(cfg, mesh)
-        init_fn = make_init_fn(lambda r: gpt2_init(r, cfg), shardings, mesh)
-        state = init_fn(jax.random.key(0))
-        step_fn = make_train_step(
-            lambda p, b: gpt2_loss(p, b, cfg), shardings, mesh)
-        tokens = jax.random.randint(
-            jax.random.key(1), (batch, cfg.seq_len + 1), 0, cfg.vocab_size,
-            jnp.int32,
-        )
-        batch_data = {"tokens": tokens}
-        for _ in range(warmup):
-            state, metrics = step_fn(state, batch_data)
-        # float() forces a device->host transfer of the whole dispatch
-        # chain; block_until_ready alone is not reliable on experimental
-        # backends.
-        float(metrics["loss"])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = step_fn(state, batch_data)
-        final_loss = float(metrics["loss"])
-        dt = time.perf_counter() - t0
-        tok_s = batch * cfg.seq_len * steps / dt
-        return tok_s, final_loss, dt
+        # The harness owns ALL accounting (tok/s, MFU vs this host's
+        # device peak) — bench.py and tpu_sweep.py report the same math.
+        return measure_gpt2(cfg, batch, steps=steps, warmup=warmup,
+                            mesh=mesh)
 
-    configs = {"base": base, "lever": lever}
+    configs = {"base": base, "lever": lever, "fused": fused}
     device_kind = jax.devices()[0].device_kind
 
-    def emit(chosen: str, tok_s: float, final_loss: float, dt: float,
-             extras: dict) -> None:
+    def emit(chosen: str, r: dict, extras: dict) -> None:
         cfg = configs[chosen]
-        achieved = tok_s * gpt2_flops_per_token(cfg)
-        mfu = achieved / (_peak_flops_per_chip(device_kind) * n_dev) * 100.0
         print(
             f"gpt2 {cfg.n_params / 1e6:.0f}M params, batch={batch}, "
-            f"seq={cfg.seq_len}, {steps} steps in {dt:.2f}s, "
-            f"loss={final_loss:.3f}, config={chosen}",
+            f"seq={cfg.seq_len}, {steps} steps in {r['dt']:.2f}s, "
+            f"loss={r['loss']:.3f}, config={chosen}",
             file=sys.stderr,
         )
         print(
             json.dumps(
                 {
                     "metric": "gpt2_train_mfu",
-                    "value": round(mfu, 2),
+                    "value": r["mfu"],
                     "unit": "%",
                     # An off-TPU MFU ratioed against the TPU target is not
                     # a comparable number — null it rather than mislead.
-                    "vs_baseline": round(mfu / 45.0, 3) if on_tpu else None,
-                    "tokens_per_sec_per_chip": round(tok_s / n_dev, 1),
+                    "vs_baseline": round(r["mfu"] / 45.0, 3)
+                    if on_tpu else None,
+                    "tokens_per_sec_per_chip": round(r["tok_s"] / n_dev, 1),
                     "device": device_kind,
                     "n_devices": n_dev,
                     "config": chosen,
@@ -161,31 +120,42 @@ def _worker(platform: str, variant: str = "auto") -> None:
         )
 
     if variant == "auto":
-        # Measure both; report the faster. The base JSON line is emitted
-        # (and flushed) BEFORE the lever runs: if the lever hangs past
-        # the subprocess deadline, the orchestrator recovers the base
-        # measurement from partial stdout — a lever failure of any kind
-        # can never cost the headline number. The orchestrator keeps the
-        # LAST JSON line, so a faster lever simply supersedes base.
-        base_tok_s, base_loss, base_dt = measure(base)
-        emit("base", base_tok_s, base_loss, base_dt, {})
-        try:
-            tok_s2, loss2, dt2 = measure(lever)
-        except Exception as e:  # noqa: BLE001 — base line already out
-            print(f"lever config failed: {e!r}", file=sys.stderr)
-            return
-        if tok_s2 > base_tok_s:
-            emit("lever", tok_s2, loss2, dt2,
-                 {"base_tokens_per_sec_per_chip":
-                  round(base_tok_s / n_dev, 1)})
-        else:
-            # Re-emit base with the lever's number attached for the record.
-            emit("base", base_tok_s, base_loss, base_dt,
-                 {"lever_tokens_per_sec_per_chip":
-                  round(tok_s2 / n_dev, 1)})
+        # Self-arbitration over three candidates: base first (the
+        # committed 52.x headline), then the round-7 fused-norm config,
+        # then the round-5 lever. Fused runs BEFORE lever so that if
+        # the 240s window only fits two compiles, the measurement that
+        # lands is the new base-vs-fused A/B — lever's on-chip numbers
+        # are already committed round-5 evidence. After EVERY successful
+        # measurement the current winner's JSON line is emitted (and
+        # flushed) with the losers' tok/s attached, and the orchestrator
+        # keeps the LAST complete line — so a later candidate that hangs
+        # past the subprocess deadline or raises (e.g. a fused-kernel
+        # compile failure) can never cost the already-flushed headline.
+        # A candidate only supersedes the winner by measuring strictly
+        # faster. Off-TPU the fused candidate is skipped: the tiny CPU
+        # config's d_model=64 can't tile the kernels (every norm falls
+        # back to XLA), so a third compile cycle would measure nothing
+        # but interpreter overhead — tests/test_fused_norm.py owns the
+        # CPU coverage instead.
+        results = {"base": measure(base)}
+        best = "base"
+        emit("base", results["base"], {})
+        for cand in (("fused", "lever") if on_tpu else ("lever",)):
+            try:
+                results[cand] = measure(configs[cand])
+            except Exception as e:  # noqa: BLE001 — winner line already out
+                print(f"{cand} config failed (headline keeps {best}): "
+                      f"{e!r}", file=sys.stderr)
+                continue
+            if results[cand]["tok_s"] > results[best]["tok_s"]:
+                best = cand
+            emit(best, results[best], {
+                f"{name}_tokens_per_sec_per_chip":
+                    round(r["tok_s"] / n_dev, 1)
+                for name, r in results.items() if name != best
+            })
     else:
-        tok_s, final_loss, dt = measure(configs[variant])
-        emit(variant, tok_s, final_loss, dt, {})
+        emit(variant, measure(configs[variant]), {})
 
 
 # --------------------------------------------------------------------------
@@ -248,7 +218,7 @@ def main() -> None:
     # honored), bounded + retried once. No separate probe: the chip may be
     # exclusively claimed, and a probe-then-run would claim it twice.
     for attempt, tmo in enumerate((TPU_TIMEOUT_S, TPU_RETRY_TIMEOUT_S)):
-        # First attempt races base + lever configs; the shorter retry
+        # First attempt races base + fused + lever; the shorter retry
         # window only fits the single proven-fastest config.
         variant = "auto" if attempt == 0 else "base"
         ok, result, err = _run_subprocess(

@@ -84,6 +84,19 @@ def mesh(device_array, axis_names, *, axis_types=None):
     return _Mesh(device_array, axis_names)
 
 
+def pallas_tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` across pallas spellings: older
+    pallas names the class ``TPUCompilerParams`` (same fields). Shared by
+    every Pallas kernel module (flash_attention, fused_norm) so the alias
+    probe lives in exactly one place."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
 def make_mesh(axis_shapes, axis_names, *, axis_types=None, devices=None):
     """``jax.make_mesh`` that tolerates older signatures without
     ``axis_types`` (where Auto is the only behavior anyway)."""

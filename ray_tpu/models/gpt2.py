@@ -64,6 +64,14 @@ class GPT2Config:
     # MXU (measured 59 -> ~120 TF/s for fp32- vs bf16-out on v5e) and
     # halves logits HBM traffic; CE reductions still accumulate in fp32.
     logits_dtype: Any = None
+    # Fused Pallas norm/residual/GELU kernels (ops/fused_norm.py): the
+    # LayerNorm forward saves only fp32 mean/rstd, and ONE backward
+    # kernel per row-block fuses dx/dscale/dbias with the residual-add
+    # gradient, so the fp32 LN recompute chain XLA materializes
+    # (PROFILE.md sink #3, ~15ms/step) never reaches HBM. The MLP GELU
+    # rides a fused tanh backward epilogue. Shapes the TPU lane layout
+    # can't tile (D % 128 != 0) fall back to the plain-XLA chain.
+    fused_norm: bool = False
     # Cross-entropy over vocab chunks (>1 enables): the loss runs an
     # online-logsumexp lax.scan over [V/n, D] slices of the tied head so
     # the full [B, T, V] logits tensor is NEVER materialized — fwd or
@@ -173,13 +181,25 @@ def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
     return (y * scale + bias).astype(x.dtype)
 
 
+def _norm_residual(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                   cfg: GPT2Config) -> tuple[jax.Array, jax.Array]:
+    """(LN(x), residual-skip x). With ``cfg.fused_norm`` the skip rides
+    through the fused op so the residual-add gradient lands inside the
+    one Pallas backward kernel."""
+    if cfg.fused_norm:
+        from ray_tpu.ops.fused_norm import fused_layer_norm_residual
+
+        return fused_layer_norm_residual(x, scale, bias)
+    return _layer_norm(x, scale, bias), x
+
+
 def _block(x: jax.Array, p: Params, cfg: GPT2Config) -> jax.Array:
     """One transformer block. x: [B, T, D] in cfg.dtype."""
     b, t, d = x.shape
     h, hd = cfg.n_head, cfg.head_dim
     dt = cfg.dtype
 
-    y = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    y, x_skip = _norm_residual(x, p["ln1_scale"], p["ln1_bias"], cfg)
     qkv = y @ p["attn_qkv_w"].astype(dt) + p["attn_qkv_b"].astype(dt)
     q, k_, v_ = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, t, h, hd)
@@ -196,14 +216,19 @@ def _block(x: jax.Array, p: Params, cfg: GPT2Config) -> jax.Array:
     else:
         attn = causal_attention(q, k_, v_, use_flash=cfg.use_flash)
     attn = attn.reshape(b, t, d)
-    x = x + attn @ p["attn_out_w"].astype(dt) + p["attn_out_b"].astype(dt)
+    x = x_skip + attn @ p["attn_out_w"].astype(dt) + p["attn_out_b"].astype(dt)
     x = with_logical_constraint(x, ("batch", "seq", None))
 
-    y = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    y, x_skip = _norm_residual(x, p["ln2_scale"], p["ln2_bias"], cfg)
     y = y @ p["mlp_in_w"].astype(dt) + p["mlp_in_b"].astype(dt)
     y = with_logical_constraint(y, ("batch", "seq", "mlp"))
-    y = jax.nn.gelu(y, approximate=True)
-    x = x + y @ p["mlp_out_w"].astype(dt) + p["mlp_out_b"].astype(dt)
+    if cfg.fused_norm:
+        from ray_tpu.ops.fused_norm import fused_gelu
+
+        y = fused_gelu(y)
+    else:
+        y = jax.nn.gelu(y, approximate=True)
+    x = x_skip + y @ p["mlp_out_w"].astype(dt) + p["mlp_out_b"].astype(dt)
     x = with_logical_constraint(x, ("batch", "seq", None))
     return x
 
@@ -231,6 +256,10 @@ def gpt2_hidden(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array
                 x, jax.tree.map(lambda a: a[i], params["blocks"])
             )
 
+    if cfg.fused_norm:
+        from ray_tpu.ops.fused_norm import fused_layer_norm
+
+        return fused_layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     return _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
 
 

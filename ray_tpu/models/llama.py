@@ -52,6 +52,12 @@ class LlamaConfig:
     scan_layers: bool = True
     use_flash: bool | None = None
     attention_impl: str = "auto"  # "auto" | "ring" | "ulysses"
+    # Fused Pallas RMSNorm kernels (ops/fused_norm.py — same kernel
+    # family GPT2Config.fused_norm gates): forward saves only the fp32
+    # rstd statistic, one backward kernel per row-block fuses
+    # dx/dscale with the residual-add gradient. Odd shapes (D % 128)
+    # fall back to the plain-XLA chain.
+    fused_norm: bool = False
     mesh: Any = dataclasses.field(default=None, compare=False)
 
     def __post_init__(self):
@@ -167,12 +173,23 @@ def _rope(x: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _norm_residual(x: jax.Array, scale: jax.Array,
+                   cfg: LlamaConfig) -> tuple[jax.Array, jax.Array]:
+    """(RMSNorm(x), residual-skip x); fused Pallas kernel when enabled —
+    the skip's cotangent lands inside the one backward kernel."""
+    if cfg.fused_norm:
+        from ray_tpu.ops.fused_norm import fused_rms_norm_residual
+
+        return fused_rms_norm_residual(x, scale)
+    return _rms_norm(x, scale), x
+
+
 def _block(x: jax.Array, p: Params, cfg: LlamaConfig) -> jax.Array:
     b, t, d = x.shape
     nh, nkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
     dt = cfg.dtype
 
-    y = _rms_norm(x, p["attn_norm"])
+    y, x_skip = _norm_residual(x, p["attn_norm"], cfg)
     q = (y @ p["wq"].astype(dt)).reshape(b, t, nh, hd)
     k = (y @ p["wk"].astype(dt)).reshape(b, t, nkv, hd)
     v = (y @ p["wv"].astype(dt)).reshape(b, t, nkv, hd)
@@ -193,15 +210,15 @@ def _block(x: jax.Array, p: Params, cfg: LlamaConfig) -> jax.Array:
         attn = ulysses_attention(q, k, v, cfg.mesh, axis="sp")
     else:
         attn = causal_attention(q, k, v, use_flash=cfg.use_flash)
-    x = x + attn.reshape(b, t, nh * hd) @ p["wo"].astype(dt)
+    x = x_skip + attn.reshape(b, t, nh * hd) @ p["wo"].astype(dt)
     x = with_logical_constraint(x, ("batch", "seq", None))
 
-    y = _rms_norm(x, p["mlp_norm"])
+    y, x_skip = _norm_residual(x, p["mlp_norm"], cfg)
     gate = y @ p["w_gate"].astype(dt)
     up = y @ p["w_up"].astype(dt)
     h = jax.nn.silu(gate) * up
     h = with_logical_constraint(h, ("batch", "seq", "mlp"))
-    x = x + h @ p["w_down"].astype(dt)
+    x = x_skip + h @ p["w_down"].astype(dt)
     x = with_logical_constraint(x, ("batch", "seq", None))
     return x
 
@@ -227,7 +244,12 @@ def llama_forward(params: Params, tokens: jax.Array,
         for i in range(cfg.n_layer):
             x, _ = block_fn(x, jax.tree.map(lambda a: a[i], params["blocks"]))
 
-    x = _rms_norm(x, params["final_norm"])
+    if cfg.fused_norm:
+        from ray_tpu.ops.fused_norm import fused_rms_norm
+
+        x = fused_rms_norm(x, params["final_norm"])
+    else:
+        x = _rms_norm(x, params["final_norm"])
     return jnp.einsum(
         "btd,dv->btv", x, params["lm_head"].astype(dt),
         preferred_element_type=jnp.float32,

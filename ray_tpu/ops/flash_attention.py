@@ -41,9 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-if not hasattr(pltpu, "CompilerParams"):
-    # Older pallas spells it TPUCompilerParams (same fields).
-    pltpu.CompilerParams = pltpu.TPUCompilerParams
+from ray_tpu._compat import pallas_tpu_compiler_params
 
 _NEG_INF = -1e30
 
@@ -169,7 +167,7 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, softmax_scale: float,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -372,7 +370,7 @@ def _flash_bwd(q, k, v, out, lse, g, *, block_q: int, block_k: int,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -401,7 +399,7 @@ def _flash_bwd(q, k, v, out, lse, g, *, block_q: int, block_k: int,
                 (None, block_q, d), lambda bhi, a, b_: (bhi, a, 0)),
             out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pallas_tpu_compiler_params(
                 dimension_semantics=("parallel", "parallel", "arbitrary"),
             ),
             interpret=interpret,

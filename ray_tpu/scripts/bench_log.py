@@ -21,6 +21,13 @@ import time
 ENV_VAR = "RAY_TPU_BENCH_LOG"
 FILENAME = "BENCH_TPU_SESSIONS.jsonl"
 
+# Named benches that append via the record_* helpers below (lines keyed
+# by "bench" rather than "script"+"config").
+KNOWN_BENCHES = frozenset({
+    "task_overhead", "memory_pressure", "chaos_soak", "scalebench",
+    "drain_recovery_ms",
+})
+
 
 def default_path() -> str:
     """Repo-root BENCH_TPU_SESSIONS.jsonl (this file lives in
@@ -209,6 +216,122 @@ def record_scalebench(*, scalability: dict | None = None,
     return entry
 
 
+# --------------------------------------------------------------------------
+# Evidence-gap lint (VERDICT r5 item 1, "the cheapest high-value fix"):
+# every line of the committed trail must parse and carry the fields a
+# later reader needs to reconstruct when/where/what was measured. Runs
+# in tier-1 against the committed file and as
+# ``python -m ray_tpu.scripts.bench_log --check [path]``.
+# --------------------------------------------------------------------------
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def check_line(obj: object, *, allow_header: bool = False) -> list[str]:
+    """Schema errors for one parsed JSONL entry ([] = valid).
+
+    Three valid shapes:
+    * header — ``{"schema": <str>, ...}``; ONLY the first line of the
+      file (``allow_header=True``) may take this shape, so a 'schema'
+      key on a data line can't smuggle it past validation;
+    * throughput point — ``script`` (+``config``) lines from bench.py /
+      tpu_sweep.py: need ts, a non-CPU device, tok/s and MFU numbers;
+    * named bench — ``bench`` lines from the record_* helpers: need ts
+      and a non-CPU device.
+    """
+    if not isinstance(obj, dict):
+        return ["not a JSON object"]
+    if "schema" in obj:
+        if not allow_header:
+            return ["'schema' header shape only valid on line 1"]
+        return [] if isinstance(obj["schema"], str) else [
+            "header 'schema' must be a string"]
+    errs = []
+    if not _is_num(obj.get("ts")):
+        errs.append("missing/non-numeric 'ts'")
+    iso = obj.get("iso")
+    if iso is not None and not isinstance(iso, str):
+        errs.append("'iso' must be a string")
+    device = obj.get("device")
+    if not isinstance(device, str) or not device:
+        errs.append("missing/empty 'device'")
+    elif device.lower() == "cpu":
+        errs.append("'device' is cpu — CPU numbers must not enter the "
+                    "on-chip evidence trail")
+    if "script" in obj:
+        if obj["script"] not in ("bench", "tpu_sweep"):
+            errs.append(f"unknown script {obj['script']!r}")
+        if not isinstance(obj.get("config"), str):
+            errs.append("script line missing 'config'")
+        if not any(_is_num(obj.get(k))
+                   for k in ("tok_s", "tokens_per_sec_per_chip")):
+            errs.append("script line missing tok_s/"
+                        "tokens_per_sec_per_chip")
+        if not any(_is_num(obj.get(k)) for k in ("mfu", "value")):
+            errs.append("script line missing mfu/value")
+    elif "bench" in obj:
+        if obj["bench"] not in KNOWN_BENCHES:
+            errs.append(f"unknown bench {obj['bench']!r}")
+    else:
+        errs.append("neither a header ('schema'), a throughput point "
+                    "('script'), nor a named bench ('bench')")
+    return errs
+
+
+def check_file(path: str) -> list[str]:
+    """All schema violations in an evidence file, as 'line N: why'
+    strings ([] = the file passes)."""
+    problems: list[str] = []
+    with open(path) as f:
+        for n, line in enumerate(f, 1):
+            if not line.strip():
+                problems.append(f"line {n}: blank line")
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError as e:
+                problems.append(f"line {n}: invalid JSON ({e})")
+                continue
+            problems.extend(
+                f"line {n}: {err}"
+                for err in check_line(obj, allow_header=n == 1))
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="On-chip benchmark evidence trail tools")
+    ap.add_argument("--check", action="store_true",
+                    help="validate every line of the evidence file "
+                         "against the expected schema; exit 1 on any "
+                         "malformed line")
+    ap.add_argument("path", nargs="?", default=None,
+                    help=f"evidence file (default: committed {FILENAME})")
+    args = ap.parse_args(argv)
+    if not args.check:
+        ap.error("nothing to do (pass --check)")
+    path = args.path or default_path()
+    try:
+        problems = check_file(path)
+    except OSError as e:
+        print(f"bench_log check: cannot read {path}: {e}")
+        return 1
+    if problems:
+        for p in problems:
+            print(f"bench_log check: {p}")
+        print(f"bench_log check: FAIL ({len(problems)} problem(s) in "
+              f"{path})")
+        return 1
+    with open(path) as f:
+        n_lines = sum(1 for _ in f)
+    print(f"bench_log check: OK ({n_lines} line(s) in {path})")
+    return 0
+
+
 def record_drain_recovery(proactive_drain_ms: float,
                           crash_detection_ms: float, *,
                           device: str = "", path: str | None = None,
@@ -232,3 +355,9 @@ def record_drain_recovery(proactive_drain_ms: float,
     entry.update(extra)
     entry["committed_to"] = record_if_on_chip(dict(entry), path)
     return entry
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -166,6 +166,9 @@ def main() -> None:
                     help="parked-queue audit depth for scalebench")
     ap.add_argument("--skip-head-scale", action="store_true")
     ap.add_argument("--skip-pipeline", action="store_true")
+    ap.add_argument("--fused-norm", action="store_true",
+                    help="add the fused-norm kernel microbench point "
+                         "(CPU interpret shape coverage + op counts)")
     args = ap.parse_args()
 
     # Each stage runs in its own subprocess: benchmark isolation (no
@@ -185,6 +188,9 @@ def main() -> None:
     if not args.skip_pipeline:
         steps.append([sys.executable, "-m",
                       "ray_tpu.scripts.pipeline_bench", "--out", args.out])
+    if args.fused_norm:
+        steps.append([sys.executable, "-m",
+                      "ray_tpu.scripts.fused_norm_bench", "--out", args.out])
     for argv in steps:
         print(f"perfsuite: {' '.join(argv[2:])}", file=sys.stderr,
               flush=True)

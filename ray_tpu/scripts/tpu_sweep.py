@@ -2,9 +2,24 @@
 
 Measures step time / MFU for a grid of (config, batch) points on whatever
 device is attached, printing one JSON line per point. Used to pick the
-shipped `bench.py` config; results are recorded in PROFILE.md.
+shipped `bench.py` config; results are recorded in PROFILE.md, and every
+successful on-chip point auto-appends to BENCH_TPU_SESSIONS.jsonl.
 
-Run: python -m ray_tpu.scripts.tpu_sweep '[["base",16],["lever",24],...]'
+The timed-step protocol (steps/warmup/sync/FLOPs accounting) is the
+shared harness in ``scripts/measure.py`` — the same loop ``bench.py``
+times, so sweep points and the headline number are directly comparable.
+Failed points record the full traceback tail, not a truncated repr: a
+one-shot tunnel-window failure must be diagnosable from the JSON alone.
+
+Run: python -m ray_tpu.scripts.tpu_sweep '[["base",16],["fused_norm",16],...]'
+
+Named configs: base (round-3 winner), lever (round-5: bf16 logits +
+chunked CE), bf16_only, chunk_only, chunk6, fused_norm (round-7: lever +
+fused Pallas norm/residual/GELU backward kernels), fused_only (base +
+fused kernels, isolating the kernel effect from the round-5 lever).
+The default point list is the round-7 before/after ablation —
+base/lever vs fused_norm at batch 16 and 24 — ready to run unattended
+in the next tunnel window.
 """
 
 from __future__ import annotations
@@ -12,78 +27,62 @@ from __future__ import annotations
 import dataclasses
 import json
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.models.gpt2 import (
-    GPT2Config,
-    gpt2_flops_per_token,
-    gpt2_init,
-    gpt2_loss,
-    gpt2_shardings,
-)
-from ray_tpu.parallel.mesh import MeshConfig, build_mesh
-from ray_tpu.train.train_step import make_init_fn, make_train_step
-
-PEAK = 197.0e12  # v5e bf16
+from ray_tpu.models.gpt2 import GPT2Config
+from ray_tpu.scripts.measure import error_entry, measure_gpt2
 
 
-def measure(cfg: GPT2Config, batch: int, steps: int = 20, warmup: int = 3):
-    warmup = max(warmup, 1)  # >=1: the post-warmup sync reads metrics
-    mesh = build_mesh(MeshConfig(fsdp=-1))
-    shardings = gpt2_shardings(cfg, mesh)
-    init_fn = make_init_fn(lambda r: gpt2_init(r, cfg), shardings, mesh)
-    state = init_fn(jax.random.key(0))
-    step_fn = make_train_step(lambda p, b: gpt2_loss(p, b, cfg), shardings, mesh)
-    tokens = jax.random.randint(
-        jax.random.key(1), (batch, cfg.seq_len + 1), 0, cfg.vocab_size, jnp.int32)
-    batch_data = {"tokens": tokens}
-    for _ in range(warmup):
-        state, metrics = step_fn(state, batch_data)
-    float(metrics["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step_fn(state, batch_data)
-    loss = float(metrics["loss"])
-    dt = time.perf_counter() - t0
-    tok_s = batch * cfg.seq_len * steps / dt
-    mfu = tok_s * gpt2_flops_per_token(cfg) / PEAK * 100.0
-    return {"tok_s": round(tok_s, 1), "mfu": round(mfu, 2),
-            "ms_step": round(dt / steps * 1000, 2), "loss": round(loss, 3)}
-
-
-def main() -> None:
+def named_configs() -> dict[str, GPT2Config]:
     base = GPT2Config(use_flash=True, remat="dots", scan_layers=False)
-    named = {
+    lever = dataclasses.replace(
+        base, logits_dtype=jnp.bfloat16, ce_vocab_chunks=3)
+    return {
         "base": base,
-        "lever": dataclasses.replace(
-            base, logits_dtype=jnp.bfloat16, ce_vocab_chunks=3),
+        "lever": lever,
         "bf16_only": dataclasses.replace(base, logits_dtype=jnp.bfloat16),
         "chunk_only": dataclasses.replace(base, ce_vocab_chunks=3),
         "chunk6": dataclasses.replace(
             base, logits_dtype=jnp.bfloat16, ce_vocab_chunks=6),
+        "fused_norm": dataclasses.replace(lever, fused_norm=True),
+        "fused_only": dataclasses.replace(base, fused_norm=True),
     }
-    points = json.loads(sys.argv[1]) if len(sys.argv) > 1 else [
-        ["base", 16], ["lever", 24], ["lever", 32]]
+
+
+# Round-7 ablation grid (PROFILE.md sink #3): before/after for the fused
+# norm kernels at the shipped batch and the next size up.
+DEFAULT_POINTS = [
+    ["base", 16],
+    ["lever", 16],
+    ["fused_norm", 16],
+    ["lever", 24],
+    ["fused_norm", 24],
+]
+
+
+def main() -> None:
+    named = named_configs()
+    points = json.loads(sys.argv[1]) if len(sys.argv) > 1 else DEFAULT_POINTS
     from ray_tpu.scripts.bench_log import record_if_on_chip
 
     device_kind = jax.devices()[0].device_kind
     n_dev = jax.device_count()
     for name, batch in points:
         try:
-            r = measure(named[name], int(batch))
-            print(json.dumps({"config": name, "batch": batch, **r}), flush=True)
+            r = measure_gpt2(named[name], int(batch))
+            r.pop("dt", None)
+            print(json.dumps({"config": name, **r}), flush=True)
             # Evidence trail (VERDICT r5 item 1a): every successful
             # on-chip point lands in BENCH_TPU_SESSIONS.jsonl.
             record_if_on_chip({
-                "script": "tpu_sweep", "config": name, "batch": int(batch),
+                "script": "tpu_sweep", "config": name,
                 "device": device_kind, "n_devices": n_dev, **r,
             })
         except Exception as e:  # noqa: BLE001 — sweep survives OOM points
             print(json.dumps({"config": name, "batch": batch,
-                              "error": repr(e)[:200]}), flush=True)
+                              **error_entry(e)}), flush=True)
 
 
 if __name__ == "__main__":
