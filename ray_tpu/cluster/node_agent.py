@@ -173,6 +173,11 @@ class NodeAgent:
         # children so retraction is exact.
         self._device_stats: dict[str, dict] = {}
         self._exported_device: set[tuple] = set()
+        # Serve gauge children created by each worker's shipped
+        # observations (replica ongoing / router queue depth /
+        # reconcile), retracted when the worker dies so a dead replica
+        # vanishes from the federated scrape.
+        self._serve_gauges: dict[str, set] = {}
         # Remote profiler captures (state.capture_profile): manifest by
         # capture id; trace files live under log_dir and stream back
         # through read_capture_file (the log-read plane's chunked shape).
@@ -724,12 +729,27 @@ class NodeAgent:
             pass
 
     def rpc_worker_events(self, worker_id, pid, task_events, log_lines,
-                          spans=None, device=None):
+                          spans=None, device=None, serve=None):
         """Batched observability report from a worker: authoritative task
         records (with timings/outcome + per-phase wall-ns), captured
         stdout/stderr lines, finished tracing spans (forwarded to the
-        head's span store), and an optional device-telemetry snapshot."""
+        head's span store), an optional device-telemetry snapshot, and
+        serve request-path observations (replayed into THIS registry —
+        the one the federated scrape sees; worker registries are never
+        scraped)."""
         failpoints.hit("agent.worker_events.upload")
+        if serve:
+            try:
+                from ray_tpu.serve import _observability as _serve_obs
+
+                keys = _serve_obs.apply_events(
+                    serve, node_id=self.node_id, worker=worker_id)
+                if keys:
+                    with self._lock:
+                        self._serve_gauges.setdefault(
+                            worker_id, set()).update(keys)
+            except Exception:
+                pass  # observability must never fail the event upload
         if task_events:
             # Feed the phase histogram so p50/p99 per phase is
             # scrapeable without the state API (one observe per phase
@@ -1932,6 +1952,20 @@ class NodeAgent:
             _metrics.WORKER_RSS_BYTES.remove(tags=tags)
             _metrics.WORKER_UPTIME_SECONDS.remove(tags=tags)
             self._cpu_prev.pop(wid, None)
+        # Serve gauges are keyed off THEIR OWN table, not the /proc
+        # sample history: a replica that shipped gauge events and died
+        # before its first telemetry sample never entered
+        # _exported_gauges, but its series must still be retracted.
+        # Liveness comes from the worker TABLE (not `stats`): serve
+        # gauges are event-driven, so a spurious retraction on one
+        # transient /proc read failure would never be re-exported for
+        # an idle replica.
+        live_wids = {wid for wid, *_ in workers}
+        with self._lock:
+            dead_serve = [wid for wid in self._serve_gauges
+                          if wid not in live_wids]
+        for wid in dead_serve:
+            self._retract_serve_series(wid)
         self._exported_gauges = exported
         self._export_device_gauges(set(stats))
         self._export_store_gauges_locked()
@@ -1982,6 +2016,19 @@ class NodeAgent:
         for wid, dev in self._exported_device - exported:
             self._retract_device_series(wid, dev)
         self._exported_device = exported
+
+    def _retract_serve_series(self, wid: str) -> None:
+        """Drop the serve gauge children a dead worker's events created
+        (same lifecycle as the /proc and device gauges)."""
+        with self._lock:
+            keys = self._serve_gauges.pop(wid, None)
+        if keys:
+            try:
+                from ray_tpu.serve import _observability as _serve_obs
+
+                _serve_obs.retract_gauges(keys, self.node_id)
+            except Exception:
+                pass
 
     def _retract_device_series(self, wid: str, dev: str | None) -> None:
         """Drop one exported device-gauge child: the compile-counter
@@ -2646,6 +2693,9 @@ class NodeAgent:
                 _metrics.OBJECT_STORE_EVICTIONS.remove(tags=tags)
                 _metrics.OBJECT_SPILL_DENIED.remove(tags=tags)
                 _metrics.OOM_KILLS_TOTAL.remove(tags=tags)
+                # Serve gauge children die with the node too.
+                for wid in list(self._serve_gauges):
+                    self._retract_serve_series(wid)
         except Exception:
             pass
         with self._lock:

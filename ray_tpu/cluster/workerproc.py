@@ -210,7 +210,18 @@ class WorkerHandler:
                 events = self._task_events[:]
                 del self._task_events[:]
             spans = tracing.drain() if tracing.is_enabled() else []
-            if not lines and not events and not spans:
+            # Serve request-path observations (phase histograms, shed
+            # counters, replica gauges) ride the same batch; the module
+            # is only consulted if something in this process imported
+            # serve (a worker that never served ships nothing).
+            serve_events = []
+            so = sys.modules.get("ray_tpu.serve._observability")
+            if so is not None:
+                try:
+                    serve_events = so.drain_events()
+                except Exception:
+                    serve_events = []
+            if not lines and not events and not spans and not serve_events:
                 idle_rounds += 1
                 # Probe liveness every ~2s when idle; every round while
                 # failures are accumulating (fast exit once the agent
@@ -232,9 +243,18 @@ class WorkerHandler:
             try:
                 self.agent.call(
                     "worker_events", self.worker_id, pid, events, lines,
-                    spans, device)
+                    spans, device, serve_events or None)
                 consecutive_fail = 0
             except Exception:
+                if serve_events:
+                    # The serve plane promises exact counts: requeue a
+                    # failed upload's observations (bounded; overflow
+                    # counts into the drop counter) so a transient
+                    # worker->agent blip doesn't silently lose them.
+                    try:
+                        so.requeue_events(serve_events)
+                    except Exception:
+                        pass
                 consecutive_fail += 1
                 if consecutive_fail >= 12:
                     os._exit(1)  # agent is gone: die with the node

@@ -255,6 +255,24 @@ class Dashboard:
             return ok_json(generate_dashboard())
         if route == "/api/jobs" or route.startswith("/api/jobs/"):
             return self._jobs_get(route)
+        if route == "/api/serve_stats":
+            # Serve pane: per-deployment SLO rollup from the request
+            # latency plane. Same no-controller guard as the
+            # applications route — a GET must not spawn a controller.
+            from ray_tpu.serve import _private as serve_private
+
+            if self.head.call(
+                    "get_named_actor", serve_private.CONTROLLER_NAME) is None:
+                return ok_json({"deployments": {}})
+            from ray_tpu import serve
+
+            self._ensure_client()
+            # Always a single scrape: the dashboard serves requests
+            # serially through ONE handler thread, so a windowed QPS
+            # sample (which sleeps) would stall every other pane. The
+            # SPA shows cumulative counts; use `ray-tpu serve stats`
+            # for a measured QPS.
+            return ok_json(serve.stats(window_s=0.0))
         if route == "/api/serve/applications":
             # Read-only: a cluster that never used serve must stay
             # untouched — probe the controller through the head's named
@@ -433,7 +451,8 @@ class Dashboard:
                "/api/memory_leaks", "/api/logs",
                "/api/worker_logs", "/api/worker_stats",
                "/api/device_stats", "/api/cluster_metrics",
-               "/api/placement_groups", "/api/pubsub_stats"]
+               "/api/placement_groups", "/api/pubsub_stats",
+               "/api/serve_stats"]
         links = "".join(f'<li><a href="{r}">{r}</a></li>' for r in api)
         return (
             "<!doctype html><title>ray_tpu dashboard</title>"

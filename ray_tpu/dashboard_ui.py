@@ -475,12 +475,65 @@ const RENDER = {
       }));
   },
   async serve() {
-    const d = await api("/api/serve/applications");
+    // Serve pane (memory-pane shape): SLO tiles + per-deployment
+    // latency/shed table from the request-path plane, then the raw
+    // application listing.
+    const [s, d] = await Promise.all(
+      [api("/api/serve_stats"), api("/api/serve/applications")]);
+    const deps = Object.entries(s.deployments || {})
+      .map(([name, info]) => ({name, ...info}));
+    const totals = deps.reduce((acc, r) => {
+      const req = r.requests || {};
+      acc.ok += req.ok || 0; acc.err += req.error || 0;
+      acc.shed += Object.values(r.shed || {}).reduce((a, b) => a + b, 0);
+      acc.ongoing += r.ongoing || 0;
+      return acc;
+    }, {ok: 0, err: 0, shed: 0, ongoing: 0});
+    const worstP99 = Math.max(0, ...deps.map(r => r.p99_ms || 0));
+    setTiles([
+      ["deployments", deps.length],
+      ["requests ok", totals.ok],
+      ["errors", totals.err, totals.err > 0 ? "bad" : "ok"],
+      ["shed (503)", totals.shed, totals.shed > 0 ? "warn" : ""],
+      ["in flight", totals.ongoing],
+      ["worst p99 ms", worstP99 ? worstP99.toFixed(1) : "—"],
+    ]);
+    const wrap = el("div");
+    wrap.appendChild(el("h3", "", "per-deployment SLO"));
+    // No qps column: the API route is single-scrape by design (a
+    // windowed sample would stall the single-threaded dashboard);
+    // counts are cumulative — `ray-tpu serve stats` measures QPS.
+    wrap.appendChild(table(
+      ["deployment", "replicas", "p50 ms", "p99 ms", "ok",
+       "errors", "shed", "ongoing", "queued", "phases"],
+      deps, (r, c) => {
+        const req = r.requests || {};
+        if (c === "deployment") return el("td", "", r.name);
+        if (c === "replicas") return el("td", "", r.replicas ?? "?");
+        if (c === "p50 ms") return el("td", "", r.p50_ms ?? "—");
+        if (c === "p99 ms") return el("td",
+          (r.p99_ms || 0) > 1000 ? "warn" : "", r.p99_ms ?? "—");
+        if (c === "ok") return el("td", "", req.ok || 0);
+        if (c === "errors") return el("td",
+          (req.error || 0) > 0 ? "bad" : "", req.error || 0);
+        if (c === "shed") {
+          const n = Object.values(r.shed || {})
+            .reduce((a, b) => a + b, 0);
+          return el("td", n > 0 ? "warn" : "", n);
+        }
+        if (c === "ongoing") return el("td", "", r.ongoing || 0);
+        if (c === "queued") return el("td", "", r.queued || 0);
+        const td = el("td", "mono");
+        td.textContent = Object.entries(r.phases || {})
+          .map(([p, v]) => `${p}:${v.p50_ms}ms`).join(" ");
+        return td;
+      }));
     const apps = d.applications || {};
     const rows = Object.entries(apps).flatMap(([app, info]) =>
       (info.deployments ? Object.entries(info.deployments) : [["", info]])
         .map(([dep, di]) => ({app, dep, info: di})));
-    $("view").replaceChildren(table(
+    wrap.appendChild(el("h3", "", "applications"));
+    wrap.appendChild(table(
       ["application", "deployment", "detail"],
       rows, (r, c) => {
         if (c === "application") return el("td", "", r.app);
@@ -489,6 +542,7 @@ const RENDER = {
         td.textContent = JSON.stringify(r.info);
         return td;
       }));
+    $("view").replaceChildren(wrap);
   },
   async logs() {
     if (!$("logs")) {

@@ -25,8 +25,20 @@ FILENAME = "BENCH_TPU_SESSIONS.jsonl"
 # by "bench" rather than "script"+"config").
 KNOWN_BENCHES = frozenset({
     "task_overhead", "memory_pressure", "chaos_soak", "scalebench",
-    "drain_recovery_ms",
+    "drain_recovery_ms", "serve_latency",
 })
+
+
+def device_kind() -> str:
+    """Platform of the first visible accelerator ("" when jax is absent
+    or broken) — the shared probe every bench stamps its evidence lines
+    with, so 'device' can never disagree across harnesses."""
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return ""
 
 
 def default_path() -> str:
@@ -169,6 +181,31 @@ def record_chaos_soak(*, seed, duration_s: float, faults: dict,
     return entry
 
 
+def record_serve_latency(*, client: dict, server: dict, agreement: dict,
+                         mode: str = "http", connections: int = 0,
+                         n_requests: int = 0, device: str = "",
+                         path: str | None = None, **extra) -> dict:
+    """Serve SLO latency evidence (``scripts/serve_bench.py``):
+    client-side p50/p99/QPS over N concurrent streams, the server-side
+    histogram view of the same requests, and the agreement verdict
+    between them (the two must match or the serve metrics are lying).
+    Committed to the evidence trail only on an accelerator; returns the
+    entry (with ``committed_to``) either way."""
+    entry: dict = {
+        "bench": "serve_latency",
+        "device": device,
+        "mode": mode,
+        "connections": int(connections),
+        "n_requests": int(n_requests),
+        "client": dict(client),
+        "server": dict(server),
+        "agreement": dict(agreement),
+    }
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
 def record_scalebench(*, scalability: dict | None = None,
                       head_scale: dict | None = None,
                       device: str = "", path: str | None = None,
@@ -260,7 +297,33 @@ def check_line(obj: object, *, allow_header: bool = False) -> list[str]:
     elif device.lower() == "cpu":
         errs.append("'device' is cpu — CPU numbers must not enter the "
                     "on-chip evidence trail")
-    if "script" in obj:
+    # 'bench' takes precedence: the record_* helpers also stamp a
+    # provenance 'script' key (chaos_soak, serve_bench), which must not
+    # route their lines into the throughput-point schema.
+    if "bench" in obj:
+        if obj["bench"] not in KNOWN_BENCHES:
+            errs.append(f"unknown bench {obj['bench']!r}")
+        elif obj["bench"] == "serve_latency":
+            # A serve latency line must carry both views AND the
+            # agreement verdict — a client-only (or server-only) number
+            # is exactly the uncross-checked claim this bench exists to
+            # prevent.
+            client = obj.get("client")
+            server = obj.get("server")
+            if not (isinstance(client, dict)
+                    and _is_num(client.get("p50_ms"))
+                    and _is_num(client.get("p99_ms"))):
+                errs.append("serve_latency line missing numeric "
+                            "client.p50_ms/p99_ms")
+            if not (isinstance(server, dict)
+                    and _is_num(server.get("count"))):
+                errs.append("serve_latency line missing server.count")
+            agreement = obj.get("agreement")
+            if not (isinstance(agreement, dict)
+                    and isinstance(agreement.get("ok"), bool)):
+                errs.append("serve_latency line missing boolean "
+                            "agreement.ok")
+    elif "script" in obj:
         if obj["script"] not in ("bench", "tpu_sweep"):
             errs.append(f"unknown script {obj['script']!r}")
         if not isinstance(obj.get("config"), str):
@@ -271,9 +334,6 @@ def check_line(obj: object, *, allow_header: bool = False) -> list[str]:
                         "tokens_per_sec_per_chip")
         if not any(_is_num(obj.get(k)) for k in ("mfu", "value")):
             errs.append("script line missing mfu/value")
-    elif "bench" in obj:
-        if obj["bench"] not in KNOWN_BENCHES:
-            errs.append(f"unknown bench {obj['bench']!r}")
     else:
         errs.append("neither a header ('schema'), a throughput point "
                     "('script'), nor a named bench ('bench')")

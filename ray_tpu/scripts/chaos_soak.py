@@ -30,7 +30,11 @@ Invariants checked after the run settles:
   3. ``state.memory_leaks()`` is empty;
   4. the federated ``/metrics/cluster`` body still scrapes;
   5. the head directory is consistent with the agent stores (no
-     location on a dead node; per-node store reports join cleanly).
+     location on a dead node; per-node store reports join cleanly);
+  6. the standing serve probe (a deployment serving throughout the
+     soak) completed at least one request, and every probe either
+     completed or shed/failed cleanly — a request that HANGS through a
+     partition/kill is a lost request the latency plane never saw.
 
 Usage::
 
@@ -51,12 +55,9 @@ import time
 
 
 def _device_kind() -> str:
-    try:
-        import jax
+    from ray_tpu.scripts.bench_log import device_kind
 
-        return jax.devices()[0].platform
-    except Exception:
-        return ""
+    return device_kind()
 
 
 class _Soak:
@@ -71,6 +72,8 @@ class _Soak:
         self.tasks_ok = 0
         self.actor_calls_ok = 0
         self.puts_ok = 0
+        self.serve_ok = 0
+        self.serve_shed = 0
         self._stop = threading.Event()
         # The graceful-drain victim: the fault injector must not kill or
         # partition the node the drain (and its retry-exemption probe)
@@ -254,6 +257,56 @@ class _Soak:
                     f"batch {batch}: driver-visible error {e!r}")
             del put_ref
 
+    def _serve_probe_setup(self) -> "object | None":
+        """Deploy the standing serve probe and verify one warm-up round
+        trip BEFORE any fault is injected (so the invariant separates
+        'serve broke under faults' from 'serve never worked')."""
+        from ray_tpu import serve
+
+        @serve.deployment(name="soak_probe", num_replicas=2)
+        def probe_fn(x):
+            return x
+
+        handle = serve.run(probe_fn.bind())
+        import ray_tpu
+
+        if ray_tpu.get(handle.remote(41), timeout=60.0) != 41:
+            raise RuntimeError("serve probe warm-up returned wrong value")
+        self.serve_ok += 1
+        return handle
+
+    def _serve_probe_loop(self, handle, deadline: float) -> None:
+        """Standing serve invariant under faults: every probe request
+        must either complete or fail FAST and cleanly (a deadline shed,
+        a replica error while the controller re-reconciles) — a request
+        that HANGS past its budget means the request path lost a
+        request without shedding it, which is the one behavior a
+        latency SLO cannot absorb."""
+        import ray_tpu
+
+        while time.monotonic() < deadline and not self._stop.is_set():
+            t0 = time.monotonic()
+            try:
+                r = ray_tpu.get(
+                    handle.options(deadline_s=20.0).remote(7),
+                    timeout=45.0)
+                if r == 7:
+                    self.serve_ok += 1
+                else:
+                    self.violations.append(
+                        f"serve probe returned wrong value {r!r}")
+            except Exception:  # noqa: BLE001 — classified by duration
+                took = time.monotonic() - t0
+                if self._stop.is_set():
+                    return  # settling cluster: not a verdict
+                if took > 40.0:
+                    self.violations.append(
+                        f"serve probe HUNG {took:.1f}s (neither "
+                        f"completed nor shed cleanly)")
+                else:
+                    self.serve_shed += 1
+            time.sleep(0.5)
+
     def _drain_once(self, cluster) -> None:
         """One graceful drain mid-soak with a budget-exemption probe: a
         max_retries=0 task pinned to the drained node must complete."""
@@ -373,6 +426,14 @@ class _Soak:
         cluster.wait_for_nodes()
         ray_tpu.init(cluster.address)
         deadline = time.monotonic() + self.duration_s
+        # Serve probe deploys (and proves one round trip) BEFORE faults
+        # start; under faults its standing invariant is complete-or-
+        # shed-cleanly, never hang.
+        serve_handle = None
+        try:
+            serve_handle = self._serve_probe_setup()
+        except Exception as e:  # noqa: BLE001
+            self.violations.append(f"serve probe deploy failed: {e!r}")
         injector = threading.Thread(
             target=self._fault_loop, args=(cluster,), daemon=True)
         injector.start()
@@ -383,6 +444,10 @@ class _Soak:
                 target=self._workload, args=(cluster, deadline),
                 daemon=True)
             workload.start()
+            if serve_handle is not None:
+                threading.Thread(
+                    target=self._serve_probe_loop,
+                    args=(serve_handle, deadline), daemon=True).start()
             time.sleep(min(self.duration_s / 3.0, 10.0))
             self._drain_once(cluster)
             workload.join(timeout=self.duration_s + 180.0)
@@ -414,6 +479,15 @@ class _Soak:
         failpoints.reset()
         time.sleep(2.0)
         self._check_invariants(cluster)
+        if serve_handle is not None and self.serve_ok < 1:
+            self.violations.append(
+                "serve probe never completed a request")
+        try:
+            from ray_tpu import serve
+
+            serve.shutdown()
+        except Exception:
+            pass
         entry = bench_log.record_chaos_soak(
             seed=self.seed,
             duration_s=self.duration_s,
@@ -425,6 +499,8 @@ class _Soak:
             puts_ok=self.puts_ok,
             device=_device_kind(),
             script="chaos_soak",
+            serve_ok=self.serve_ok,
+            serve_shed=self.serve_shed,
         )
         ray_tpu.shutdown()
         cluster.shutdown()

@@ -214,6 +214,50 @@ def cmd_memory(args):
                   f"{g['key']}")
 
 
+def cmd_serve(args):
+    """Serve observability: ``ray-tpu serve stats`` prints the
+    per-deployment SLO table (replicas, p50/p99, QPS over the sampling
+    window, status/shed counts, live ongoing/queued gauges) from the
+    request-path latency plane — the first stop before attributing
+    serving latency to the model itself."""
+    _connect(args)
+    from ray_tpu import serve
+
+    if args.action != "stats":
+        raise SystemExit(f"unknown serve action {args.action!r}")
+    stats = serve.stats(window_s=args.window)
+    if args.json:
+        print(json.dumps(stats, indent=2, default=str))
+        return
+    deployments = stats.get("deployments") or {}
+    if not deployments:
+        print("no deployments (or no serve traffic recorded yet)")
+        return
+    hdr = (f"{'deployment':<24} {'repl':>4} {'p50 ms':>8} {'p99 ms':>8} "
+           f"{'qps':>7} {'ok':>8} {'err':>5} {'shed':>5} {'ongoing':>7} "
+           f"{'queued':>6}")
+    print(hdr)
+    print("-" * len(hdr))
+    for name, d in deployments.items():
+        req = d.get("requests") or {}
+        shed = sum((d.get("shed") or {}).values())
+        qps = d.get("qps")
+        print(f"{name:<24} {d.get('replicas', '?'):>4} "
+              f"{d.get('p50_ms', '—'):>8} {d.get('p99_ms', '—'):>8} "
+              f"{qps if qps is not None else '—':>7} "
+              f"{req.get('ok', 0):>8} {req.get('error', 0):>5} "
+              f"{shed:>5} {d.get('ongoing', 0):>7} "
+              f"{d.get('queued', 0):>6}")
+        phases = d.get("phases") or {}
+        if args.phases and phases:
+            for phase, ph in phases.items():
+                print(f"    {phase:<12} p50 {ph.get('p50_ms', '—')} ms  "
+                      f"mean {ph.get('mean_ms', '—')} ms  "
+                      f"n={ph.get('count', 0)}")
+    if stats.get("reconcile_s") is not None:
+        print(f"controller reconcile: {stats['reconcile_s'] * 1e3:.1f} ms")
+
+
 def cmd_logs(args):
     """List captured worker logs, or print (and follow) one worker's."""
     from ray_tpu import state
@@ -601,6 +645,18 @@ def main(argv=None):
                         "(use 'head' for the head), e.g. "
                         "--groups head,node-a node-b")
     p.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser(
+        "serve",
+        help="serve observability (per-deployment p50/p99/QPS/shed)")
+    p.add_argument("action", choices=["stats"])
+    p.add_argument("--window", type=float, default=1.0,
+                   help="QPS sampling window seconds (0 = single scrape, "
+                        "no QPS)")
+    p.add_argument("--phases", action="store_true",
+                   help="also print the per-phase latency breakdown")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("submit", help="submit a job entrypoint")
     p.add_argument("--wait", action="store_true")

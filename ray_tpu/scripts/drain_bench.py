@@ -22,12 +22,9 @@ import time
 
 
 def _device_kind() -> str:
-    try:
-        import jax
+    from ray_tpu.scripts.bench_log import device_kind
 
-        return jax.devices()[0].platform
-    except Exception:
-        return ""
+    return device_kind()
 
 
 def _wait_actor_on_other_node(head, actor_id: str, avoid_node: str,

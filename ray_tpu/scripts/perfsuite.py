@@ -169,6 +169,10 @@ def main() -> None:
     ap.add_argument("--fused-norm", action="store_true",
                     help="add the fused-norm kernel microbench point "
                          "(CPU interpret shape coverage + op counts)")
+    ap.add_argument("--serve", action="store_true",
+                    help="add the serve request-path point "
+                         "(concurrent-stream harness + client/server "
+                         "latency cross-check)")
     args = ap.parse_args()
 
     # Each stage runs in its own subprocess: benchmark isolation (no
@@ -191,6 +195,9 @@ def main() -> None:
     if args.fused_norm:
         steps.append([sys.executable, "-m",
                       "ray_tpu.scripts.fused_norm_bench", "--out", args.out])
+    if args.serve:
+        steps.append([sys.executable, "-m",
+                      "ray_tpu.scripts.serve_bench", "--out", args.out])
     for argv in steps:
         print(f"perfsuite: {' '.join(argv[2:])}", file=sys.stderr,
               flush=True)

@@ -17,9 +17,11 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
 import ray_tpu
-from ray_tpu.serve import _private
+from ray_tpu.serve import _observability, _private
+from ray_tpu.serve._observability import RequestShedError
 from ray_tpu.serve._private import (
     CONTROLLER_NAME,
+    DEADLINE_HEADER,
     DeploymentHandle,
     HTTPProxy,
     batch,
@@ -184,6 +186,16 @@ def status() -> Dict[str, dict]:
     return ray_tpu.get(controller.status.remote(), timeout=30)
 
 
+def stats(window_s: float = 0.0) -> Dict[str, dict]:
+    """Per-deployment serving stats from the SLO latency plane:
+    replica counts, p50/p99/mean request latency, per-phase breakdown
+    (route / queue_wait / batch_wait / execute / serialize), status and
+    shed counts, live ongoing/queued gauges. ``window_s > 0`` adds a
+    measured QPS over that window. Surfaced as ``ray-tpu serve stats``
+    and the dashboard's ``/api/serve_stats``."""
+    return _observability.stats(window_s)
+
+
 _proxy_handle = None
 
 
@@ -264,6 +276,9 @@ __all__ = [
     "get_app_handle",
     "delete",
     "status",
+    "stats",
+    "RequestShedError",
+    "DEADLINE_HEADER",
     "start_http_proxy",
     "start_http_proxies",
     "proxy_ports",

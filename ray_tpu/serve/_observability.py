@@ -1,0 +1,496 @@
+"""Serve request-path observability: the SLO latency plane.
+
+Every hop of a serve request — router assign, replica ongoing queue,
+``@serve.batch`` queue, user callable, response serialize — records a
+phase observation here. Recording is two-sided by design:
+
+* the observation lands in THIS process's metric registry immediately
+  (the local backend runs replicas as in-process threads, so the
+  process registry is exactly what ``/metrics`` scrapes there);
+* the same observation is appended to a bounded ship buffer that the
+  worker's event flusher drains over the existing worker-events plane
+  (``rpc_worker_events`` grew a ``serve`` batch), so on the cluster
+  backend — where routers, replicas and proxies are worker processes
+  whose registries nothing scrapes — the node agent replays it into
+  the agent registry that federates on ``/metrics/cluster``.
+
+Gauge children created by a worker's events are tracked per worker by
+the agent and retracted when the worker dies (PR 3/4 retraction
+discipline: a dead replica must vanish from the federated scrape).
+
+Also here: the per-request deadline context that rides the trace
+context (``RequestShedError`` is what the router / replica / batch
+queue raise instead of executing dead work), and the Prometheus-text
+parsing used by ``serve.stats()`` and ``scripts/serve_bench.py`` to
+read the histograms back — the same parser serves the CLI, the
+dashboard and the client/server cross-check, so they can never
+disagree about what the exposition says.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import os
+import re
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.util import metrics as _metrics
+
+# Phases a request can observe (the serve histogram's phase tag values).
+PHASES = ("route", "queue_wait", "batch_wait", "execute", "serialize",
+          "total")
+
+
+class RequestShedError(Exception):
+    """A request whose deadline expired before execution: shed by the
+    router, the replica, or the batch queue instead of running dead
+    work. The HTTP proxy maps it to 503."""
+
+    def __init__(self, message: str, reason: str = "deadline"):
+        super().__init__(message)
+        self.reason = reason
+
+
+# -- per-request context (deadline rides the trace context) ----------------
+
+_request_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_serve_request", default=None)
+
+
+@contextmanager
+def request_scope(deployment: str, deadline_ts: Optional[float]):
+    """Active while the replica runs the user callable, so nested
+    machinery (the @serve.batch queue) can read the deployment name and
+    the absolute deadline without threading arguments through user
+    code."""
+    token = _request_ctx.set({"deployment": deployment,
+                              "deadline_ts": deadline_ts})
+    try:
+        yield
+    finally:
+        _request_ctx.reset(token)
+
+
+def current_request() -> Optional[dict]:
+    return _request_ctx.get()
+
+
+# -- recording -------------------------------------------------------------
+
+_LOCAL_NODE = "local"
+# Ship buffer drained by workerproc's event flusher; bounded so a
+# process nothing drains (the local-backend driver) stays flat.
+_buf: "collections.deque" = collections.deque(maxlen=8192)
+_buf_lock = threading.Lock()
+# Events the bounded buffer pushed out before a drain (nothing drains
+# the local backend's driver, or the flusher fell behind a burst):
+# reported as a drop event on the next drain — never a silent cap.
+_buf_dropped = 0
+# Router-side queue depth per deployment in THIS process.
+_router_queued: Dict[str, int] = {}
+_router_lock = threading.Lock()
+
+
+def _emit(ev: dict) -> None:
+    """Observe locally and queue for the agent (see module docstring)."""
+    global _buf_dropped
+    try:
+        apply_events([ev], node_id=_LOCAL_NODE)
+    except Exception:
+        pass
+    with _buf_lock:
+        if len(_buf) == _buf.maxlen:
+            _buf_dropped += 1  # deque discards the oldest silently
+        _buf.append(ev)
+
+
+def drain_events() -> List[dict]:
+    """Pop queued observations (the worker event flusher's hook). A
+    preceding overflow is reported as a leading drop event so the
+    agent's registry counts exactly what this process lost."""
+    global _buf_dropped
+    with _buf_lock:
+        out = list(_buf)
+        _buf.clear()
+        if _buf_dropped:
+            out.insert(0, {"k": "drop", "n": _buf_dropped})
+            _buf_dropped = 0
+    return out
+
+
+def requeue_events(events: List[dict]) -> None:
+    """Put drained observations back at the FRONT of the ship buffer
+    (the worker flusher calls this when the agent upload fails — a
+    chaos-severed worker->agent channel must not silently lose request
+    counts). Overflow beyond capacity is counted as drops, oldest
+    first, like every other loss on this plane."""
+    global _buf_dropped
+    if not events:
+        return
+    with _buf_lock:
+        space = _buf.maxlen - len(_buf)
+        if space < len(events):
+            _buf_dropped += len(events) - space
+            events = events[len(events) - space:]
+        _buf.extendleft(reversed(events))
+
+
+def record_phases(deployment: str, phases: Dict[str, float]) -> None:
+    """Observe wall seconds per request phase."""
+    phases = {p: s for p, s in phases.items() if p in PHASES and s >= 0}
+    if phases:
+        _emit({"k": "ph", "d": deployment, "p": phases})
+
+
+def record_status(deployment: str, status: str) -> None:
+    """Count one terminal request outcome (router-side only — the one
+    place every request passes exactly once)."""
+    _emit({"k": "st", "d": deployment, "s": status})
+
+
+def record_shed(deployment: str, reason: str) -> None:
+    """Count one deadline shed at the site that shed it."""
+    _emit({"k": "shed", "d": deployment, "r": reason})
+
+
+def record_batch(deployment: str, size: int) -> None:
+    _emit({"k": "batch", "d": deployment, "n": int(size)})
+
+
+def record_reconcile(seconds: float) -> None:
+    _emit({"k": "rec", "s": float(seconds)})
+
+
+def set_replica_ongoing(deployment: str, replica: str, ongoing: int) -> None:
+    _emit({"k": "g", "d": deployment, "r": replica, "n": int(ongoing)})
+
+
+def router_queue_delta(deployment: str, delta: int) -> None:
+    """Track requests blocked in this process's router ``assign`` and
+    export the absolute depth (the queued-demand signal replicas can't
+    see behind max_concurrent_queries)."""
+    with _router_lock:
+        n = max(0, _router_queued.get(deployment, 0) + delta)
+        _router_queued[deployment] = n
+    _emit({"k": "q", "d": deployment, "n": n})
+
+
+def apply_events(events: List[dict], node_id: str,
+                 worker: Optional[str] = None) -> List[Tuple]:
+    """Replay shipped observations into THIS process's registry (the
+    node agent calls this with its node_id + the reporting worker's id).
+    Returns the gauge keys the batch touched so the agent can retract
+    them when the worker dies."""
+    worker = worker or str(os.getpid())
+    gauge_keys: List[Tuple] = []
+    for ev in events or []:
+        try:
+            kind = ev.get("k")
+            dep = ev.get("d", "")
+            if kind == "ph":
+                for phase, sec in (ev.get("p") or {}).items():
+                    _metrics.SERVE_REQUEST_SECONDS.observe(
+                        float(sec), tags={"node_id": node_id,
+                                          "deployment": dep,
+                                          "phase": phase})
+            elif kind == "st":
+                _metrics.SERVE_REQUESTS_TOTAL.inc(
+                    tags={"node_id": node_id, "deployment": dep,
+                          "status": ev.get("s", "ok")})
+            elif kind == "shed":
+                _metrics.SERVE_SHED_TOTAL.inc(
+                    tags={"node_id": node_id, "deployment": dep,
+                          "reason": ev.get("r", "deadline")})
+            elif kind == "batch":
+                _metrics.SERVE_BATCH_SIZE.observe(
+                    float(ev.get("n", 0)),
+                    tags={"node_id": node_id, "deployment": dep})
+            elif kind == "rec":
+                _metrics.SERVE_RECONCILE_SECONDS.set(
+                    float(ev.get("s", 0.0)), tags={"node_id": node_id})
+                gauge_keys.append(("reconcile",))
+            elif kind == "g":
+                rep = ev.get("r", "")
+                _metrics.SERVE_REPLICA_ONGOING.set(
+                    float(ev.get("n", 0)),
+                    tags={"node_id": node_id, "deployment": dep,
+                          "replica": rep})
+                gauge_keys.append(("ongoing", dep, rep))
+            elif kind == "q":
+                _metrics.SERVE_ROUTER_QUEUE_DEPTH.set(
+                    float(ev.get("n", 0)),
+                    tags={"node_id": node_id, "deployment": dep,
+                          "worker": worker})
+                gauge_keys.append(("queued", dep, worker))
+            elif kind == "drop":
+                _metrics.SERVE_EVENTS_DROPPED.inc(
+                    float(ev.get("n", 0)), tags={"node_id": node_id})
+        except Exception:
+            continue  # one bad event must not drop the batch
+    return gauge_keys
+
+
+def retract_gauges(keys, node_id: str) -> None:
+    """Drop the gauge children a dead worker's events created (the
+    federated scrape must not keep reporting a dead replica)."""
+    for key in keys or ():
+        try:
+            if key[0] == "ongoing":
+                _metrics.SERVE_REPLICA_ONGOING.remove(tags={
+                    "node_id": node_id, "deployment": key[1],
+                    "replica": key[2]})
+            elif key[0] == "queued":
+                _metrics.SERVE_ROUTER_QUEUE_DEPTH.remove(tags={
+                    "node_id": node_id, "deployment": key[1],
+                    "worker": key[2]})
+            elif key[0] == "reconcile":
+                _metrics.SERVE_RECONCILE_SECONDS.remove(
+                    tags={"node_id": node_id})
+        except Exception:
+            pass
+
+
+# -- reading the plane back (serve.stats / serve_bench cross-check) --------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([^\s]+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def parse_prometheus(text: str) -> Dict[str, Dict[tuple, float]]:
+    """Exposition text -> {metric_name: {sorted (label, value) tuple:
+    sample value}} (comments skipped; NaN-free by construction here)."""
+    out: Dict[str, Dict[tuple, float]] = {}
+    for line in (text or "").splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labels_raw, value = m.groups()
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        labels = tuple(sorted(_LABEL_RE.findall(labels_raw or "")))
+        out.setdefault(name, {})[labels] = val
+    return out
+
+
+def _labels_get(labels: tuple, key: str) -> Optional[str]:
+    for k, v in labels:
+        if k == key:
+            return v
+    return None
+
+
+def sum_counter(parsed: dict, name: str, group_label: str,
+                **match: str) -> Dict[str, float]:
+    """Sum a family's samples across node_id (and any other untagged
+    label), grouped by one label, filtered by exact label matches."""
+    out: Dict[str, float] = {}
+    for labels, val in (parsed.get(name) or {}).items():
+        if any(_labels_get(labels, k) != v for k, v in match.items()):
+            continue
+        key = _labels_get(labels, group_label) or ""
+        out[key] = out.get(key, 0.0) + val
+    return out
+
+
+def histogram_dist(parsed: dict, name: str, **match: str) -> Optional[dict]:
+    """One histogram's cumulative buckets/sum/count, summed across
+    node_id, filtered by exact label matches (e.g. deployment=...,
+    phase=...). Returns {"buckets": [(le, cum)], "sum": s, "count": n}
+    or None when no sample matched."""
+    buckets: Dict[float, float] = {}
+    total = 0.0
+    count = 0.0
+    seen = False
+    for labels, val in (parsed.get(name + "_bucket") or {}).items():
+        if any(_labels_get(labels, k) != v for k, v in match.items()):
+            continue
+        le_raw = _labels_get(labels, "le")
+        le = float("inf") if le_raw == "+Inf" else float(le_raw)
+        buckets[le] = buckets.get(le, 0.0) + val
+        seen = True
+    for labels, val in (parsed.get(name + "_sum") or {}).items():
+        if not any(_labels_get(labels, k) != v for k, v in match.items()):
+            total += val
+    for labels, val in (parsed.get(name + "_count") or {}).items():
+        if not any(_labels_get(labels, k) != v for k, v in match.items()):
+            count += val
+    if not seen or count <= 0:
+        return None
+    return {"buckets": sorted(buckets.items()), "sum": total,
+            "count": count}
+
+
+def quantile_from_buckets(dist: Optional[dict], q: float) -> Optional[float]:
+    """Prometheus-style histogram_quantile: linear interpolation inside
+    the bucket containing the q-th sample (the +Inf bucket clamps to the
+    last finite bound — same convention as PromQL)."""
+    if not dist:
+        return None
+    buckets = dist["buckets"]
+    total = dist["count"]
+    rank = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    last_finite = 0.0
+    for le, cum in buckets:
+        if le != float("inf"):
+            last_finite = le
+        if cum >= rank and cum > prev_cum:
+            if le == float("inf"):
+                return last_finite
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = (0.0 if le == float("inf") else le), cum
+    return last_finite
+
+
+def bucket_width_at(dist: Optional[dict], value: float) -> float:
+    """Width of the histogram bucket a value falls in — the resolution
+    floor for any client/server latency agreement check."""
+    if not dist:
+        return float("inf")
+    prev = 0.0
+    for le, _ in dist["buckets"]:
+        if le == float("inf"):
+            break
+        if value <= le:
+            return le - prev
+        prev = le
+    return float("inf")
+
+
+def diff_parsed(before: dict, after: dict) -> dict:
+    """Per-series ``after - before`` (counters/histogram buckets): lets
+    a bench isolate ITS requests from whatever the shared registry
+    already accumulated."""
+    out: Dict[str, Dict[tuple, float]] = {}
+    for name, series in after.items():
+        base = before.get(name) or {}
+        out[name] = {labels: val - base.get(labels, 0.0)
+                     for labels, val in series.items()}
+    return out
+
+
+def metrics_text() -> str:
+    """The scrape body of record: the head's federated
+    ``/metrics/cluster`` on a cluster backend, this process's registry
+    on the local backend."""
+    from ray_tpu._private import worker as _worker
+
+    try:
+        backend = _worker.backend()
+    except Exception:
+        backend = None
+    if backend is not None and hasattr(backend, "cluster_metrics_text"):
+        try:
+            return backend.cluster_metrics_text()
+        except Exception:
+            pass
+    return _metrics.prometheus_text()
+
+
+def deployment_stats(parsed: dict, deployment: str) -> dict:
+    """One deployment's rollup from a parsed exposition snapshot."""
+    out: dict = {"deployment": deployment}
+    dist = histogram_dist(parsed, "ray_tpu_serve_request_seconds",
+                          deployment=deployment, phase="total")
+    if dist:
+        out["count"] = int(dist["count"])
+        out["mean_ms"] = round(dist["sum"] / dist["count"] * 1e3, 3)
+        p50 = quantile_from_buckets(dist, 0.50)
+        p99 = quantile_from_buckets(dist, 0.99)
+        out["p50_ms"] = round(p50 * 1e3, 3) if p50 is not None else None
+        out["p99_ms"] = round(p99 * 1e3, 3) if p99 is not None else None
+    phases = {}
+    for phase in PHASES:
+        if phase == "total":
+            continue
+        d = histogram_dist(parsed, "ray_tpu_serve_request_seconds",
+                           deployment=deployment, phase=phase)
+        if d:
+            p50 = quantile_from_buckets(d, 0.50)
+            phases[phase] = {
+                "count": int(d["count"]),
+                "mean_ms": round(d["sum"] / d["count"] * 1e3, 3),
+                "p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+            }
+    if phases:
+        out["phases"] = phases
+    statuses = sum_counter(parsed, "ray_tpu_serve_requests_total",
+                           "status", deployment=deployment)
+    if statuses:
+        out["requests"] = {k: int(v) for k, v in statuses.items()}
+    sheds = sum_counter(parsed, "ray_tpu_serve_shed_total", "reason",
+                        deployment=deployment)
+    if sheds:
+        out["shed"] = {k: int(v) for k, v in sheds.items()}
+    ongoing = sum_counter(parsed, "ray_tpu_serve_replica_ongoing",
+                          "deployment", deployment=deployment)
+    if ongoing:
+        out["ongoing"] = int(sum(ongoing.values()))
+    queued = sum_counter(parsed, "ray_tpu_serve_router_queue_depth",
+                         "deployment", deployment=deployment)
+    if queued:
+        out["queued"] = int(sum(queued.values()))
+    return out
+
+
+def stats(window_s: float = 0.0) -> dict:
+    """Per-deployment serving stats (``serve.stats()`` / ``ray-tpu serve
+    stats`` / dashboard ``/api/serve_stats``): replica counts from the
+    controller's routing table joined with p50/p99/mean, status counts,
+    shed counts and live gauges from the metrics plane. With
+    ``window_s > 0`` a second scrape after the window adds ``qps`` and
+    ``window_count`` deltas."""
+    import ray_tpu
+    from ray_tpu.serve import _private as sp
+
+    # A stats read must NOT spawn a controller on a cluster that never
+    # used serve (same contract as the dashboard's GET routes).
+    try:
+        controller = ray_tpu.get_actor(sp.CONTROLLER_NAME)
+    except ValueError:
+        controller = None
+    table = {}
+    if controller is not None:
+        _, table = ray_tpu.get(controller.get_routing_table.remote(),
+                               timeout=30)
+    text0 = metrics_text()
+    parsed = parse_prometheus(text0)
+    deltas: Optional[dict] = None
+    if window_s and window_s > 0:
+        time.sleep(window_s)
+        parsed_after = parse_prometheus(metrics_text())
+        deltas = diff_parsed(parsed, parsed_after)
+        parsed = parsed_after
+    deployments = {}
+    names = set(table) | set(
+        sum_counter(parsed, "ray_tpu_serve_requests_total", "deployment"))
+    for name in sorted(n for n in names if n):
+        entry = deployment_stats(parsed, name)
+        if name in table:
+            entry["replicas"] = len(table[name]["replicas"])
+            entry["max_concurrent_queries"] = \
+                table[name]["max_concurrent_queries"]
+            entry["route_prefix"] = table[name]["route_prefix"]
+        if deltas is not None:
+            done = sum(sum_counter(
+                deltas, "ray_tpu_serve_requests_total", "deployment",
+                deployment=name).values())
+            entry["qps"] = round(done / window_s, 2)
+            entry["window_count"] = int(done)
+        deployments[name] = entry
+    out = {"deployments": deployments}
+    rec = parsed.get("ray_tpu_serve_reconcile_seconds")
+    if rec:
+        out["reconcile_s"] = round(max(rec.values()), 6)
+    return out

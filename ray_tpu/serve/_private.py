@@ -22,12 +22,17 @@ from __future__ import annotations
 
 import asyncio
 import math
+import os
 import random
 import threading
 import time
+from contextlib import nullcontext
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.serve import _observability as _obs
+from ray_tpu.serve._observability import RequestShedError
+from ray_tpu.util import tracing
 
 CONTROLLER_NAME = "ray_tpu.serve.controller"
 # One reconcile pass every interval: health checks, autoscale decisions,
@@ -57,19 +62,87 @@ class Replica:
             self.callable = cls_or_fn
         self.num_ongoing = 0
         self._lock = threading.Lock()
+        # Stable per-replica metrics label: pid is unique per node and
+        # replicas are one actor per worker process; the id() suffix
+        # disambiguates the in-process replicas of the local backend.
+        self._replica_tag = f"{os.getpid()}-{id(self) & 0xFFFF:x}"
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict):
+    def _target(self, method: str):
+        return (self.callable if method == "__call__"
+                else getattr(self.callable, method))
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       request_meta: Optional[dict] = None):
+        """Execute one routed request.
+
+        ``request_meta`` (set by ``routed_call``) carries the serve
+        request context: deployment name, router enqueue timestamp
+        (queue_wait = now - enqueue_ts covers the RPC + this replica's
+        ongoing queue), the absolute deadline, and the trace context.
+        Instrumented requests return a ``{"__serve_envelope__": ...}``
+        dict so the replica-side phase breakdown rides back to the
+        router with the result; meta-less direct calls keep the legacy
+        bare-result shape. Controller health/autoscaling probes use
+        ``get_num_ongoing``/``check_health`` and never pass through
+        here, so they cannot pollute the request metrics."""
+        if request_meta is None:
+            with self._lock:
+                self.num_ongoing += 1
+            try:
+                return self._target(method)(*args, **kwargs)
+            finally:
+                with self._lock:
+                    self.num_ongoing -= 1
+
+        dep = request_meta.get("deployment", "")
+        now = time.time()
+        queue_wait = max(0.0, now - request_meta.get("enqueue_ts", now))
+        deadline_ts = request_meta.get("deadline_ts")
+        if deadline_ts is not None and now > deadline_ts:
+            # Arrived already expired (queued behind slow requests past
+            # its budget): shed instead of executing dead work.
+            _obs.record_shed(dep, "replica")
+            return {"__serve_envelope__": 1, "shed": "replica",
+                    "phases": {"queue_wait": queue_wait}}
+        trace_ctx = request_meta.get("trace_ctx")
+        if trace_ctx:
+            tracing.enable()  # the caller traces: continue here
+        span_cm = (tracing.span(
+            f"serve.replica:{dep}.{method}",
+            {"deployment": dep, "replica": self._replica_tag,
+             "queue_wait_ms": round(queue_wait * 1e3, 3)},
+            parent=trace_ctx, cat="serve")
+            if trace_ctx and tracing.is_enabled() else nullcontext())
+        # Gauge emits happen INSIDE the lock: counter capture and
+        # publish must be atomic, or two concurrent completions can
+        # publish out of order and strand the gauge at a stale nonzero
+        # value on an idle replica.
         with self._lock:
             self.num_ongoing += 1
+            _obs.set_replica_ongoing(dep, self._replica_tag,
+                                     self.num_ongoing)
         try:
-            target = (
-                self.callable if method == "__call__"
-                else getattr(self.callable, method)
-            )
-            return target(*args, **kwargs)
+            with span_cm, _obs.request_scope(dep, deadline_ts):
+                t_exec = time.time()
+                try:
+                    result = self._target(method)(*args, **kwargs)
+                except RequestShedError as e:
+                    # The @serve.batch queue shed this item (counted at
+                    # the shed site); report it up as a shed envelope so
+                    # the router raises a typed 503, not a user error.
+                    return {"__serve_envelope__": 1,
+                            "shed": getattr(e, "reason", "batch"),
+                            "phases": {"queue_wait": queue_wait}}
+                execute = time.time() - t_exec
         finally:
             with self._lock:
                 self.num_ongoing -= 1
+                _obs.set_replica_ongoing(dep, self._replica_tag,
+                                         self.num_ongoing)
+        phases = {"queue_wait": queue_wait, "execute": execute}
+        _obs.record_phases(dep, phases)
+        return {"__serve_envelope__": 1, "result": result,
+                "phases": phases, "replica": self._replica_tag}
 
     def get_num_ongoing(self) -> int:
         return self.num_ongoing
@@ -203,12 +276,22 @@ class ServeController:
     def _reconcile_loop(self):
         while not self._stop:
             time.sleep(RECONCILE_INTERVAL_S)
+            # Suppress tracing for the whole pass: health probes and
+            # autoscaling fan out actor calls every 250ms — with tracing
+            # enabled they would flood the span store and the timeline
+            # with control-plane noise that is not user traffic.
+            t0 = time.monotonic()
+            with tracing.suppressed():
+                try:
+                    self._reconcile_once()
+                except Exception:
+                    pass  # next tick retries; the loop must never die
+                try:
+                    self._reconcile_proxies()
+                except Exception:
+                    pass
             try:
-                self._reconcile_once()
-            except Exception:
-                pass  # next tick retries; the loop must never die
-            try:
-                self._reconcile_proxies()
+                _obs.record_reconcile(time.monotonic() - t0)
             except Exception:
                 pass
 
@@ -453,26 +536,33 @@ class _TableListener:
         self._apply_fn = apply_fn
         self._current_version = current_version
         self.stopped = False
-        self._apply_fn(*ray_tpu.get(
-            controller.get_routing_table.remote(), timeout=30))
+        with tracing.suppressed():  # config plane, not user traffic
+            self._apply_fn(*ray_tpu.get(
+                controller.get_routing_table.remote(), timeout=30))
         threading.Thread(target=self._loop, daemon=True).start()
 
     def refresh(self):
         """Synchronous out-of-band fetch (error-retry path)."""
         try:
-            self._apply_fn(*ray_tpu.get(
-                self.controller.get_routing_table.remote(), timeout=30))
+            with tracing.suppressed():
+                self._apply_fn(*ray_tpu.get(
+                    self.controller.get_routing_table.remote(),
+                    timeout=30))
         except Exception:
             pass
 
     def _loop(self):
+        # Suppressed like the reconcile loop: a long-poll re-issued
+        # every ~10s per router forever is config-plane traffic and
+        # must not pollute request traces.
         while not self.stopped:
             try:
-                version, table = ray_tpu.get(
-                    self.controller.listen_for_change.remote(
-                        self._current_version()),
-                    timeout=LONG_POLL_TIMEOUT_S + 30,
-                )
+                with tracing.suppressed():
+                    version, table = ray_tpu.get(
+                        self.controller.listen_for_change.remote(
+                            self._current_version()),
+                        timeout=LONG_POLL_TIMEOUT_S + 30,
+                    )
                 self._apply_fn(version, table)
             except Exception:
                 if self.stopped:
@@ -531,34 +621,58 @@ class Router:
     def refresh(self):
         self._listener.refresh()
 
-    def assign(self, exclude: Optional[set] = None):
+    def assign(self, exclude: Optional[set] = None,
+               deadline_ts: Optional[float] = None):
         """Pick a replica, skipping ``exclude``d actor ids (known-dead from
-        a failed attempt). Blocks while all candidates are saturated."""
+        a failed attempt). Blocks while all candidates are saturated;
+        raises :class:`RequestShedError` the moment ``deadline_ts``
+        (absolute ``time.time()``) expires — a request whose budget died
+        waiting for capacity must be shed, not executed late."""
         deadline = time.monotonic() + 60.0
-        while True:
-            with self._lock:
-                pool = self._replicas
-                if exclude:
-                    filtered = [r for r in pool
-                                if r._actor_id not in exclude]
-                    # All known-dead: fall back to the full set and let the
-                    # retry loop wait for the controller's replacement.
-                    pool = filtered or pool
-                n = len(pool)
-                if n:
-                    cands = [pool[0]] if n == 1 else random.sample(pool, 2)
-                    best = min(
-                        cands,
-                        key=lambda r: self._inflight.get(r._actor_id, 0))
-                    aid = best._actor_id
-                    if self._inflight.get(aid, 0) < self._max_q:
-                        self._inflight[aid] = self._inflight.get(aid, 0) + 1
-                        return aid, best
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"no replica of {self.name!r} available (backpressure)"
-                )
-            time.sleep(0.002)
+        waiting = False
+        try:
+            while True:
+                if deadline_ts is not None and time.time() > deadline_ts:
+                    _obs.record_shed(self.name, "router")
+                    raise RequestShedError(
+                        f"deadline expired while waiting for a replica "
+                        f"of {self.name!r}", reason="router")
+                with self._lock:
+                    pool = self._replicas
+                    if exclude:
+                        filtered = [r for r in pool
+                                    if r._actor_id not in exclude]
+                        # All known-dead: fall back to the full set and
+                        # let the retry loop wait for the controller's
+                        # replacement.
+                        pool = filtered or pool
+                    n = len(pool)
+                    if n:
+                        cands = [pool[0]] if n == 1 \
+                            else random.sample(pool, 2)
+                        best = min(
+                            cands,
+                            key=lambda r: self._inflight.get(
+                                r._actor_id, 0))
+                        aid = best._actor_id
+                        if self._inflight.get(aid, 0) < self._max_q:
+                            self._inflight[aid] = \
+                                self._inflight.get(aid, 0) + 1
+                            return aid, best
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no replica of {self.name!r} available "
+                        f"(backpressure)"
+                    )
+                if not waiting:
+                    # Queued demand invisible to replicas (the router cap
+                    # holds it here): export the depth while we wait.
+                    waiting = True
+                    _obs.router_queue_delta(self.name, +1)
+                time.sleep(0.002)
+        finally:
+            if waiting:
+                _obs.router_queue_delta(self.name, -1)
 
     def complete(self, aid: str):
         with self._lock:
@@ -602,66 +716,214 @@ def reset_routers() -> None:
         _routers.clear()
 
 
-def routed_call(deployment_name: str, method: str, args: tuple, kwargs: dict):
+def routed_call(deployment_name: str, method: str, args: tuple, kwargs: dict,
+                request_meta: Optional[dict] = None):
     """Route one request with retry-on-replica-death: a request that lands
     on a replica retired by a rolling update refreshes the routing table
-    and retries elsewhere (the handle-side retry of the reference router)."""
+    and retries elsewhere (the handle-side retry of the reference router).
+
+    The request-path instrumentation lives here: one ``serve.route``
+    span covering assign -> replica -> response (parented on the
+    caller's trace context, so ingress -> router -> replica -> nested
+    handle calls share one trace id across processes), the per-phase
+    latency histogram (route / queue_wait / execute / serialize /
+    total), the per-request status counter, and the deadline shed
+    (:class:`RequestShedError` — mapped to HTTP 503 by the proxy)."""
     from ray_tpu.core.object_ref import ActorError
 
-    router = _router_for(deployment_name)
-    last_err = None
-    dead: set = set()
-    for attempt in range(4):
-        aid, replica = router.assign(exclude=dead)
-        try:
-            return ray_tpu.get(
-                replica.handle_request.remote(method, args, kwargs),
-                timeout=120.0,
-            )
-        except ActorError as e:
-            last_err = e
-            dead.add(aid)
-            # Back off so the controller's reconcile tick (0.25s) can
-            # replace the dead replica before we run out of attempts.
-            time.sleep(0.2 * (attempt + 1))
-            router.refresh()
-            continue
-        finally:
-            router.complete(aid)
-    # Terminal failure: the router (and possibly its controller) may be
-    # stale from before a serve restart — evict so the next call rebuilds
-    # against the live controller.
-    _drop_router(deployment_name, router)
-    raise last_err
+    meta = dict(request_meta or {})
+    meta["deployment"] = deployment_name
+    deadline_ts = meta.get("deadline_ts")
+    trace_parent = meta.get("trace_ctx")
+    if trace_parent:
+        tracing.enable()  # the caller traces: continue here
+    t0 = time.time()
+    # Span only when the REQUEST carries trace context (same guard as
+    # the replica): tracing.enable() above ratchets the process-global
+    # flag, and gating on is_enabled() alone would make one traced
+    # request flip this router into recording a root span for every
+    # untraced request thereafter — flooding the head's span ring.
+    span_cm = (tracing.span(
+        f"serve.route:{deployment_name}",
+        {"deployment": deployment_name, "method": method},
+        parent=trace_parent, cat="serve")
+        if trace_parent and tracing.is_enabled() else nullcontext())
+    try:
+        with span_cm as route_span:
+            if route_span is not None:
+                # The replica parents its span under the route span —
+                # the serve trace context rides the request meta, not
+                # the task spec, so it survives thread-pool hops (HTTP
+                # proxy executor) and actor-call boundaries alike.
+                meta["trace_ctx"] = {"trace_id": route_span["trace_id"],
+                                     "span_id": route_span["span_id"]}
+            router = _router_for(deployment_name)
+            last_err = None
+            dead: set = set()
+            # route = time actually spent in assign, ACCUMULATED across
+            # attempts — a dead-replica retry must not fold the failed
+            # attempt's RPC time + backoff into the route histogram
+            # (PROFILE.md reads "growing route" as a capacity signal;
+            # retry losses land in the serialize remainder instead).
+            route_s = 0.0
+            for attempt in range(4):
+                t_assign = time.time()
+                aid, replica = router.assign(
+                    exclude=dead, deadline_ts=deadline_ts)
+                route_s += time.time() - t_assign
+                meta["enqueue_ts"] = time.time()
+                # A deadline bounds the IN-FLIGHT call too (+5s grace
+                # for the response to ship): a replica wedged behind a
+                # partition must not hold a deadlined request for the
+                # full 120s — the caller gets a timely typed shed even
+                # though the dispatched work itself cannot be recalled.
+                rpc_timeout = 120.0
+                if deadline_ts is not None:
+                    rpc_timeout = max(
+                        0.5, min(120.0, deadline_ts - time.time() + 5.0))
+                try:
+                    resp = ray_tpu.get(
+                        replica.handle_request.remote(
+                            method, args, kwargs, meta),
+                        timeout=rpc_timeout,
+                    )
+                except TimeoutError:
+                    if deadline_ts is None or time.time() < deadline_ts:
+                        raise
+                    _obs.record_shed(deployment_name, "inflight")
+                    raise RequestShedError(
+                        f"deadline expired while the request to "
+                        f"{deployment_name!r} was in flight",
+                        reason="inflight")
+                except ActorError as e:
+                    last_err = e
+                    dead.add(aid)
+                    # Back off so the controller's reconcile tick
+                    # (0.25s) can replace the dead replica before we
+                    # run out of attempts.
+                    time.sleep(0.2 * (attempt + 1))
+                    router.refresh()
+                    continue
+                finally:
+                    router.complete(aid)
+                return _finish_routed(
+                    deployment_name, resp, t0, route_s)
+            # Terminal failure: the router (and possibly its controller)
+            # may be stale from before a serve restart — evict so the
+            # next call rebuilds against the live controller.
+            _drop_router(deployment_name, router)
+            raise last_err
+    except RequestShedError:
+        _obs.record_status(deployment_name, "shed")
+        raise
+    except BaseException:
+        _obs.record_status(deployment_name, "error")
+        raise
+
+
+def _finish_routed(deployment_name: str, resp, t0: float, route_s: float):
+    """Unwrap the replica envelope; record the request's phase breakdown
+    and terminal status (this is the single place every routed request
+    passes exactly once)."""
+    replica_phases: dict = {}
+    if isinstance(resp, dict) and resp.get("__serve_envelope__"):
+        shed = resp.get("shed")
+        if shed:
+            raise RequestShedError(
+                f"request to {deployment_name!r} shed: deadline expired "
+                f"at {shed}", reason=shed)
+        replica_phases = resp.get("phases") or {}
+        result = resp.get("result")
+    else:  # legacy replica without envelope support
+        result = resp
+    total = time.time() - t0
+    accounted = route_s + sum(
+        replica_phases.get(p, 0.0) for p in ("queue_wait", "execute"))
+    # Router-side phases ONLY: the replica already observed
+    # queue_wait/execute (attributed to ITS node) when it ran the
+    # request — re-recording them here would double-count. The
+    # serialize remainder is the response's serialize/transfer/
+    # deserialize path (the worker stores+ships the envelope after
+    # execute returns).
+    _obs.record_phases(deployment_name, {
+        "route": route_s,
+        "total": total,
+        "serialize": max(0.0, total - accounted),
+    })
+    _obs.record_status(deployment_name, "ok")
+    return result
 
 
 class DeploymentHandle:
     """Python-level handle: ``handle.remote(...)`` / ``handle.method.remote``
     (reference ``serve/handle.py``). Requests go through a routing proxy
     task so callers get a plain ObjectRef while routing keeps retry
-    semantics."""
+    semantics.
 
-    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+    ``handle.options(deadline_s=...)`` attaches a per-request SLO
+    deadline that rides the request context: the router and the batch
+    queue shed the request (``RequestShedError`` / HTTP 503) instead of
+    executing it once the budget is spent. The deadline is an absolute
+    ``time.time()`` compared on whichever host the request reaches —
+    correct within one host, and within NTP skew (typically ms) across
+    hosts; sub-skew deadlines on unsynchronized multi-host clusters
+    will mis-shed. When tracing is enabled, the caller's active span
+    context rides along too, so the whole routed request joins the
+    caller's trace."""
+
+    def __init__(self, deployment_name: str, method_name: str = "__call__",
+                 deadline_s: Optional[float] = None):
         self.deployment_name = deployment_name
         self.method_name = method_name
+        self.deadline_s = deadline_s
+
+    _UNSET = object()
+
+    def options(self, *, deadline_s: "Optional[float]" = _UNSET
+                ) -> "DeploymentHandle":
+        # Sentinel default: an explicit deadline_s=None CLEARS an
+        # inherited deadline; omitting the argument keeps it.
+        return DeploymentHandle(
+            self.deployment_name, self.method_name,
+            deadline_s=self.deadline_s
+            if deadline_s is DeploymentHandle._UNSET else deadline_s)
+
+    def _request_meta(self) -> Optional[dict]:
+        meta: dict = {}
+        if self.deadline_s is not None:
+            meta["deadline_ts"] = time.time() + self.deadline_s
+        if tracing.is_enabled():
+            ctx = tracing.current_context()
+            if ctx:
+                meta["trace_ctx"] = ctx
+        return meta or None
 
     def remote(self, *args, **kwargs):
         call = ray_tpu.remote(routed_call).options(num_cpus=0)
-        return call.remote(self.deployment_name, self.method_name, args, kwargs)
+        return call.remote(self.deployment_name, self.method_name, args,
+                           kwargs, self._request_meta())
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self.deployment_name, name)
+        return DeploymentHandle(self.deployment_name, name,
+                                deadline_s=self.deadline_s)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self.method_name))
+        return (DeploymentHandle,
+                (self.deployment_name, self.method_name, self.deadline_s))
 
 
 # -- HTTP proxy -------------------------------------------------------------
 
 
-_REASONS = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}
+_REASONS = {200: "OK", 404: "Not Found", 500: "Internal Server Error",
+            503: "Service Unavailable"}
+
+# Per-request deadline header: milliseconds of budget from ingress; the
+# proxy converts it to the absolute deadline that rides the request
+# context through router and batch queue.
+DEADLINE_HEADER = "x-serve-deadline-ms"
 
 
 def make_asgi_app():
@@ -669,7 +931,13 @@ def make_asgi_app():
     ``route_prefix`` from the (long-poll-pushed) routing table, decodes a
     JSON body, and dispatches through the shared Router. The blocking
     replica RPC runs in a thread pool so the event loop keeps accepting
-    connections (http_proxy.py:218 uvicorn/ASGI analog)."""
+    connections (http_proxy.py:218 uvicorn/ASGI analog).
+
+    Request-path observability at the ingress: a W3C ``traceparent``
+    header joins the caller's distributed trace (one ``serve.http``
+    span covers the whole request, parenting the route/replica spans),
+    ``x-serve-deadline-ms`` arms the per-request deadline, and a shed
+    request answers 503 with the shedding site."""
     import asyncio
     import json as _json
     from concurrent.futures import ThreadPoolExecutor
@@ -724,14 +992,63 @@ def make_asgi_app():
         if name is None:
             await reply(404, {"error": f"no route for {scope['path']}"})
             return
+        headers = {}
+        for k, v in scope.get("headers") or ():
+            try:
+                headers[k.decode("latin-1").lower()] = v.decode("latin-1")
+            except Exception:
+                continue
+        meta: dict = {}
+        # An upstream traceparent joins the caller's trace ONLY when
+        # the operator enabled tracing here (RAY_TPU_TRACING_ENABLED /
+        # tracing.enable()): the sampling decision belongs to the
+        # server — an unauthenticated header must not be able to
+        # switch on process-wide span recording.
+        parent = (tracing.parse_traceparent(headers.get("traceparent"))
+                  if tracing.is_enabled() else None)
+        if parent is not None:
+            meta["trace_ctx"] = parent
+        deadline_raw = headers.get(DEADLINE_HEADER)
+        if deadline_raw is not None:
+            try:
+                meta["deadline_ts"] = (
+                    time.time() + max(0.0, float(deadline_raw)) / 1e3)
+            except ValueError:
+                pass  # malformed budget: serve without a deadline
+        # Manual (non-context-manager) span: it stays open across the
+        # await below, and interleaved request coroutines on this one
+        # event-loop thread would corrupt a thread-local span stack's
+        # restore order. Created only for requests that CARRY a
+        # traceparent (the route/replica guards mirror this): serving
+        # traces follow the caller's sampling decision — a proxy whose
+        # tracing flag got ratcheted on by one propagated request must
+        # not start recording every untraced request.
+        http_span = (tracing.start_span(
+            f"serve.http:{scope['path']}",
+            {"deployment": name, "path": scope["path"]},
+            parent=parent, cat="serve")
+            if parent is not None else None)
+        if http_span is not None:
+            meta["trace_ctx"] = {
+                "trace_id": http_span["trace_id"],
+                "span_id": http_span["span_id"]}
+        status = "OK"
         try:
             payload = _json.loads(body) if body else None
             loop = asyncio.get_running_loop()
             result = await loop.run_in_executor(
-                pool, routed_call, name, "__call__", (payload,), {})
+                pool, routed_call, name, "__call__", (payload,), {},
+                meta or None)
             await reply(200, result)
+        except RequestShedError as e:
+            status = "ERROR: RequestShedError"
+            await reply(503, {"error": str(e),
+                              "shed": getattr(e, "reason", "deadline")})
         except Exception as e:  # noqa: BLE001 — HTTP boundary
+            status = f"ERROR: {type(e).__name__}"
             await reply(500, {"error": repr(e)})
+        finally:
+            tracing.finish_span(http_span, status)
 
     app.table_listener = listener  # so the proxy can stop it
     return app
@@ -844,15 +1161,20 @@ class _BatchQueue:
         self.fn = fn
         self.max_batch_size = max_batch_size
         self.timeout = batch_wait_timeout_s
-        self.items: list = []  # (arg, event, result_box)
+        # (arg, event, result_box, enqueue wall-ts, request ctx or None)
+        self.items: list = []
         self.cv = threading.Condition()
         threading.Thread(target=self._loop, daemon=True).start()
 
     def submit(self, arg):
         event = threading.Event()
         box: list = [None, None]  # [value, error]
+        # The serve request context (deployment + absolute deadline) is
+        # captured HERE, on the request's own thread — the batch loop
+        # thread has no contextvars of its own.
+        ctx = _obs.current_request()
         with self.cv:
-            self.items.append((arg, event, box))
+            self.items.append((arg, event, box, time.time(), ctx))
             self.cv.notify()
         event.wait()
         if box[1] is not None:
@@ -870,7 +1192,32 @@ class _BatchQueue:
                     self.cv.wait(max(0.0, deadline - time.monotonic()))
                 batch = self.items[: self.max_batch_size]
                 del self.items[: self.max_batch_size]
-            args = [b[0] for b in batch]
+            # Shed items whose request deadline expired while they sat
+            # in the queue: executing them would spend batch capacity on
+            # work whose caller already gave up (503 at the boundary).
+            now = time.time()
+            run = []
+            for item in batch:
+                ctx = item[4]
+                dl = ctx.get("deadline_ts") if ctx else None
+                if dl is not None and now > dl:
+                    dep = ctx.get("deployment", "") if ctx else ""
+                    _obs.record_shed(dep, "batch")
+                    item[2][1] = RequestShedError(
+                        "deadline expired in the batch queue",
+                        reason="batch")
+                    item[1].set()
+                else:
+                    run.append(item)
+            if not run:
+                continue
+            dep = next((it[4]["deployment"] for it in run if it[4]), "")
+            _obs.record_batch(dep, len(run))
+            for item in run:
+                _obs.record_phases(
+                    item[4]["deployment"] if item[4] else dep or "",
+                    {"batch_wait": max(0.0, now - item[3])})
+            args = [b[0] for b in run]
             try:
                 results = self.fn(args)
                 if len(results) != len(args):
@@ -878,11 +1225,11 @@ class _BatchQueue:
                         f"batched fn returned {len(results)} results for "
                         f"{len(args)} inputs"
                     )
-                for (_, event, box), r in zip(batch, results):
+                for (_, event, box, _, _), r in zip(run, results):
                     box[0] = r
                     event.set()
             except BaseException as e:  # noqa: BLE001 — fan the error out
-                for _, event, box in batch:
+                for _, event, box, _, _ in run:
                     box[1] = e
                     event.set()
 

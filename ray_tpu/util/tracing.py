@@ -68,6 +68,27 @@ def current_span() -> Optional[dict]:
     return getattr(_current, "span", None)
 
 
+@contextmanager
+def suppressed():
+    """Suppress span creation on THIS thread (``span`` yields None).
+
+    Control-plane housekeeping — serve controller health probes,
+    autoscaling reconcile passes, routing-table long-polls — submits
+    actor calls on its own cadence; without suppression an enabled
+    tracer records a ``submit:get_num_ongoing`` span every 250ms
+    forever, drowning the request traces the operator actually wants."""
+    prev = getattr(_current, "suppress", False)
+    _current.suppress = True
+    try:
+        yield
+    finally:
+        _current.suppress = prev
+
+
+def is_suppressed() -> bool:
+    return bool(getattr(_current, "suppress", False))
+
+
 def current_context() -> Optional[dict]:
     """Injectable context of the active span (what task specs carry)."""
     s = current_span()
@@ -76,16 +97,8 @@ def current_context() -> Optional[dict]:
     return {"trace_id": s["trace_id"], "span_id": s["span_id"]}
 
 
-@contextmanager
-def span(name: str, attributes: Optional[Dict[str, Any]] = None,
-         parent: Optional[dict] = None):
-    """Start a span; ``parent`` is an injected context from another
-    process (or None to nest under this thread's active span)."""
-    if not _enabled:
-        yield None
-        return
-    if parent is None:
-        parent = current_context()
+def _make_span(name: str, attributes: Optional[Dict[str, Any]],
+               parent: Optional[dict], cat: Optional[str]) -> dict:
     s = {
         "trace_id": (parent or {}).get("trace_id") or _new_id(16),
         "span_id": _new_id(8),
@@ -97,6 +110,50 @@ def span(name: str, attributes: Optional[Dict[str, Any]] = None,
         "status": "OK",
         "pid": os.getpid(),
     }
+    if cat:
+        s["cat"] = cat
+    return s
+
+
+def start_span(name: str, attributes: Optional[Dict[str, Any]] = None,
+               parent: Optional[dict] = None,
+               cat: Optional[str] = None) -> Optional[dict]:
+    """Manually-managed span: never touches the thread-local current-
+    span stack, so it is safe to hold OPEN across ``await`` points in
+    async code (where interleaved coroutines on one thread would
+    corrupt a context-manager span's restore order). Pass ``parent={}``
+    to force a fresh root. Close with :func:`finish_span`."""
+    if not _enabled or is_suppressed():
+        return None
+    if parent is None:
+        parent = current_context()
+    return _make_span(name, attributes, parent, cat)
+
+
+def finish_span(s: Optional[dict], status: str = "OK") -> None:
+    """End and record a :func:`start_span` span."""
+    if s is None:
+        return
+    s["end_ns"] = time.time_ns()
+    if status != "OK":
+        s["status"] = status
+    _record(s)
+
+
+@contextmanager
+def span(name: str, attributes: Optional[Dict[str, Any]] = None,
+         parent: Optional[dict] = None, cat: Optional[str] = None):
+    """Start a span; ``parent`` is an injected context from another
+    process (or None to nest under this thread's active span).
+    ``cat`` labels the span's Chrome-trace category (default "span");
+    the Serve request path uses ``cat="serve"`` so request traces are
+    filterable from task spans in one merged timeline."""
+    if not _enabled or is_suppressed():
+        yield None
+        return
+    if parent is None:
+        parent = current_context()
+    s = _make_span(name, attributes, parent, cat)
     prev = getattr(_current, "span", None)
     _current.span = s
     try:
@@ -108,6 +165,39 @@ def span(name: str, attributes: Optional[Dict[str, Any]] = None,
         s["end_ns"] = time.time_ns()
         _current.span = prev
         _record(s)
+
+
+# -- W3C Trace Context (the HTTP proxy's wire format) ----------------------
+#
+# ``traceparent: 00-<32 hex trace id>-<16 hex parent span id>-<2 hex flags>``
+# — the standard header external clients/gateways already emit, so an
+# ingress request joins its caller's distributed trace.
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[dict]:
+    """W3C ``traceparent`` header -> injectable span context (or None on
+    anything malformed — a bad header must never fail the request)."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4 or parts[0] == "ff":
+        return None
+    trace_id, span_id = parts[1], parts[2]
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+            return None
+    except ValueError:
+        return None
+    return {"trace_id": trace_id.lower(), "span_id": span_id.lower()}
+
+
+def format_traceparent(ctx: Optional[dict]) -> Optional[str]:
+    """Span context -> W3C ``traceparent`` header value."""
+    if not ctx or not ctx.get("trace_id") or not ctx.get("span_id"):
+        return None
+    return f"00-{ctx['trace_id']}-{ctx['span_id']}-01"
 
 
 def collect(clear: bool = False) -> List[dict]:
@@ -203,7 +293,7 @@ def chrome_events(spans: List[dict]) -> List[dict]:
     return [
         {
             "name": s["name"],
-            "cat": "span",
+            "cat": s.get("cat") or "span",
             "ph": "X",
             "ts": s["start_ns"] / 1e3,
             "dur": ((s["end_ns"] or s["start_ns"]) - s["start_ns"]) / 1e3,
