@@ -178,6 +178,9 @@ class NodeAgent:
         # reconcile), retracted when the worker dies so a dead replica
         # vanishes from the federated scrape.
         self._serve_gauges: dict[str, set] = {}
+        # Training goodput gauge children (the per-rank straggler
+        # gauge), same retraction lifecycle as the serve gauges.
+        self._train_gauges: dict[str, set] = {}
         # Remote profiler captures (state.capture_profile): manifest by
         # capture id; trace files live under log_dir and stream back
         # through read_capture_file (the log-read plane's chunked shape).
@@ -729,14 +732,15 @@ class NodeAgent:
             pass
 
     def rpc_worker_events(self, worker_id, pid, task_events, log_lines,
-                          spans=None, device=None, serve=None):
+                          spans=None, device=None, serve=None,
+                          train=None):
         """Batched observability report from a worker: authoritative task
         records (with timings/outcome + per-phase wall-ns), captured
         stdout/stderr lines, finished tracing spans (forwarded to the
-        head's span store), an optional device-telemetry snapshot, and
-        serve request-path observations (replayed into THIS registry —
-        the one the federated scrape sees; worker registries are never
-        scraped)."""
+        head's span store), an optional device-telemetry snapshot,
+        serve request-path observations, and training goodput
+        observations (both replayed into THIS registry — the one the
+        federated scrape sees; worker registries are never scraped)."""
         failpoints.hit("agent.worker_events.upload")
         if serve:
             try:
@@ -750,6 +754,18 @@ class NodeAgent:
                             worker_id, set()).update(keys)
             except Exception:
                 pass  # observability must never fail the event upload
+        if train:
+            try:
+                from ray_tpu.util import goodput as _goodput
+
+                keys = _goodput.apply_events(
+                    train, node_id=self.node_id, worker=worker_id)
+                if keys:
+                    with self._lock:
+                        self._train_gauges.setdefault(
+                            worker_id, set()).update(keys)
+            except Exception:
+                pass
         if task_events:
             # Feed the phase histogram so p50/p99 per phase is
             # scrapeable without the state API (one observe per phase
@@ -1964,8 +1980,12 @@ class NodeAgent:
         with self._lock:
             dead_serve = [wid for wid in self._serve_gauges
                           if wid not in live_wids]
+            dead_train = [wid for wid in self._train_gauges
+                          if wid not in live_wids]
         for wid in dead_serve:
             self._retract_serve_series(wid)
+        for wid in dead_train:
+            self._retract_train_series(wid)
         self._exported_gauges = exported
         self._export_device_gauges(set(stats))
         self._export_store_gauges_locked()
@@ -2027,6 +2047,20 @@ class NodeAgent:
                 from ray_tpu.serve import _observability as _serve_obs
 
                 _serve_obs.retract_gauges(keys, self.node_id)
+            except Exception:
+                pass
+
+    def _retract_train_series(self, wid: str) -> None:
+        """Drop the goodput gauge children (per-rank step time) a dead
+        worker's events created — a finished trial's ranks must vanish
+        from the federated scrape."""
+        with self._lock:
+            keys = self._train_gauges.pop(wid, None)
+        if keys:
+            try:
+                from ray_tpu.util import goodput as _goodput
+
+                _goodput.retract_gauges(keys, self.node_id)
             except Exception:
                 pass
 
@@ -2693,9 +2727,11 @@ class NodeAgent:
                 _metrics.OBJECT_STORE_EVICTIONS.remove(tags=tags)
                 _metrics.OBJECT_SPILL_DENIED.remove(tags=tags)
                 _metrics.OOM_KILLS_TOTAL.remove(tags=tags)
-                # Serve gauge children die with the node too.
+                # Serve + goodput gauge children die with the node too.
                 for wid in list(self._serve_gauges):
                     self._retract_serve_series(wid)
+                for wid in list(self._train_gauges):
+                    self._retract_train_series(wid)
         except Exception:
             pass
         with self._lock:

@@ -221,7 +221,19 @@ class WorkerHandler:
                     serve_events = so.drain_events()
                 except Exception:
                     serve_events = []
-            if not lines and not events and not spans and not serve_events:
+            # Training goodput observations (dataset stage/iterator
+            # samples, step phases, downtime) ride the same batch; the
+            # module is only consulted if something in this process
+            # imported the data/train path.
+            train_events = []
+            go = sys.modules.get("ray_tpu.util.goodput")
+            if go is not None:
+                try:
+                    train_events = go.drain_events()
+                except Exception:
+                    train_events = []
+            if not lines and not events and not spans \
+                    and not serve_events and not train_events:
                 idle_rounds += 1
                 # Probe liveness every ~2s when idle; every round while
                 # failures are accumulating (fast exit once the agent
@@ -243,7 +255,8 @@ class WorkerHandler:
             try:
                 self.agent.call(
                     "worker_events", self.worker_id, pid, events, lines,
-                    spans, device, serve_events or None)
+                    spans, device, serve_events or None,
+                    train_events or None)
                 consecutive_fail = 0
             except Exception:
                 if serve_events:
@@ -253,6 +266,12 @@ class WorkerHandler:
                     # worker->agent blip doesn't silently lose them.
                     try:
                         so.requeue_events(serve_events)
+                    except Exception:
+                        pass
+                if train_events:
+                    # Same exact-count promise on the goodput plane.
+                    try:
+                        go.requeue_events(train_events)
                     except Exception:
                         pass
                 consecutive_fail += 1

@@ -255,6 +255,21 @@ class Dashboard:
             return ok_json(generate_dashboard())
         if route == "/api/jobs" or route.startswith("/api/jobs/"):
             return self._jobs_get(route)
+        if route == "/api/data_stats":
+            # Input-pipeline pane: per-stage rollup + consumer-loop
+            # stall fraction from the training goodput plane (pure
+            # metrics read — no actors spawned).
+            self._ensure_client()
+            from ray_tpu import state as _state
+
+            return ok_json(_state.data_stats())
+        if route == "/api/train_stats":
+            # Training goodput pane: per-trial step phases, rank skew,
+            # downtime ledger.
+            self._ensure_client()
+            from ray_tpu import state as _state
+
+            return ok_json(_state.train_stats())
         if route == "/api/serve_stats":
             # Serve pane: per-deployment SLO rollup from the request
             # latency plane. Same no-controller guard as the
@@ -452,7 +467,8 @@ class Dashboard:
                "/api/worker_logs", "/api/worker_stats",
                "/api/device_stats", "/api/cluster_metrics",
                "/api/placement_groups", "/api/pubsub_stats",
-               "/api/serve_stats"]
+               "/api/serve_stats", "/api/data_stats",
+               "/api/train_stats"]
         links = "".join(f'<li><a href="{r}">{r}</a></li>' for r in api)
         return (
             "<!doctype html><title>ray_tpu dashboard</title>"

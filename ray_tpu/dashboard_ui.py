@@ -72,7 +72,7 @@ INDEX_HTML = r"""<!doctype html>
 "use strict";
 const TABS = ["cluster", "nodes", "workers", "devices", "actors", "tasks",
               "objects", "memory", "placement_groups", "jobs", "serve",
-              "logs"];
+              "train", "logs"];
 let active = location.hash.slice(1) || "cluster";
 let logCursor = 0;
 const logBuf = [];
@@ -542,6 +542,83 @@ const RENDER = {
         td.textContent = JSON.stringify(r.info);
         return td;
       }));
+    $("view").replaceChildren(wrap);
+  },
+  async train() {
+    // Training goodput pane (serve-pane shape): stall-fraction /
+    // goodput tiles, per-trial step-phase table with the downtime
+    // ledger, then the input-pipeline stage rollup.
+    const [t, d] = await Promise.all(
+      [api("/api/train_stats"), api("/api/data_stats")]);
+    const trials = Object.entries(t.trials || {})
+      .map(([name, info]) => ({name, ...info}));
+    const reports = trials.reduce((a, r) => a + (r.reports || 0), 0);
+    const downtime = trials.reduce((a, r) =>
+      a + Object.values(r.downtime_s || {}).reduce((x, y) => x + y, 0),
+      0);
+    const worstSkew = Math.max(0, ...trials.map(r => r.rank_skew || 0));
+    const stall = d.stall_fraction;
+    setTiles([
+      ["trials", trials.length],
+      ["reports", reports],
+      ["stall fraction", stall != null
+        ? (stall * 100).toFixed(1) + "%" : "—",
+        stall > 0.3 ? "warn" : ""],
+      ["downtime s", downtime.toFixed(1),
+        downtime > 0 ? "warn" : ""],
+      ["worst rank skew", worstSkew ? worstSkew.toFixed(2) + "x" : "—"],
+    ]);
+    const wrap = el("div");
+    wrap.appendChild(el("h3", "", "per-trial goodput"));
+    wrap.appendChild(table(
+      ["trial", "reports", "goodput %", "rank skew", "downtime",
+       "phases (p50)"],
+      trials, (r, c) => {
+        if (c === "trial") return el("td", "", r.name);
+        if (c === "reports") return el("td", "", r.reports || 0);
+        if (c === "goodput %") return el("td",
+          (r.goodput_pct || 100) < 95 ? "warn" : "",
+          r.goodput_pct ?? "—");
+        if (c === "rank skew") return el("td", "", r.rank_skew ?? "—");
+        if (c === "downtime") {
+          const td = el("td", "mono");
+          td.textContent = Object.entries(r.downtime_s || {})
+            .map(([cz, s]) => `${cz}:${s.toFixed(1)}s`).join(" ");
+          return td;
+        }
+        const td = el("td", "mono");
+        td.textContent = Object.entries(r.phases || {})
+          .map(([p, v]) => `${p}:${v.p50_ms}ms`).join(" ");
+        return td;
+      }));
+    const stages = Object.entries(d.stages || {})
+      .map(([name, info]) => ({name, ...info}));
+    wrap.appendChild(el("h3", "", "input-pipeline stages"));
+    wrap.appendChild(table(
+      ["stage", "executions", "blocks", "rows", "wall ms", "MB/s"],
+      stages, (r, c) => {
+        if (c === "stage") return el("td", "", r.name);
+        if (c === "executions") return el("td", "", r.executions || 0);
+        if (c === "blocks") return el("td", "", r.blocks ?? "—");
+        if (c === "rows") return el("td", "", r.rows_total ?? "—");
+        if (c === "wall ms") return el("td", "", r.wall_ms ?? "—");
+        return el("td", "",
+          r.bytes_per_s ? (r.bytes_per_s / 1e6).toFixed(1) : "—");
+      }));
+    const it = d.iterator || {};
+    const iterRows = ["wait", "user", "transfer"]
+      .filter(p => it[p]).map(p => ({phase: p, ...it[p]}));
+    if (iterRows.length) {
+      wrap.appendChild(el("h3", "", "consumer loop"));
+      wrap.appendChild(table(
+        ["phase", "batches", "p50 ms", "mean ms"],
+        iterRows, (r, c) => {
+          if (c === "phase") return el("td", "", r.phase);
+          if (c === "batches") return el("td", "", r.count);
+          if (c === "p50 ms") return el("td", "", r.p50_ms ?? "—");
+          return el("td", "", r.mean_ms ?? "—");
+        }));
+    }
     $("view").replaceChildren(wrap);
   },
   async logs() {

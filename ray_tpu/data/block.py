@@ -38,6 +38,24 @@ def num_rows(block: Block) -> int:
     return len(block)
 
 
+def size_bytes(block: Block) -> int:
+    """Approximate in-memory bytes of a block (exact for arrow/columnar
+    via nbytes; list blocks are sampled — the stats plane wants
+    distribution shape, not an accountant)."""
+    if is_arrow(block):
+        return int(block.nbytes)
+    if is_columnar(block):
+        return int(sum(getattr(v, "nbytes", 0) for v in block.values()))
+    import sys as _sys
+
+    n = len(block)
+    if n == 0:
+        return 0
+    k = min(n, 64)
+    sampled = sum(_sys.getsizeof(r) for r in block[:k])
+    return int(sampled * n / k)
+
+
 def slice_block(block: Block, start: int, end: int) -> Block:
     if is_arrow(block):
         return block.slice(start, end - start)

@@ -38,6 +38,22 @@ def _remote_apply(fns, blk):
     return blk
 
 
+def _remote_apply_meta(fns, blk):
+    """One task: run the fused chain AND measure it. Returns
+    ``[block, meta]`` (two object refs via ``num_returns=2`` — the
+    per-block stats never ride the block itself, so downstream
+    consumers see blocks, not tuples)."""
+    t0 = time.perf_counter()
+    for fn in fns:
+        blk = fn(blk)
+    meta = {
+        "duration_s": time.perf_counter() - t0,
+        "rows": B.num_rows(blk),
+        "bytes": B.size_bytes(blk),
+    }
+    return [blk, meta]
+
+
 class _Stage:
     """One-to-one stage: fuseable block -> block function."""
 
@@ -46,19 +62,244 @@ class _Stage:
         self.fn = fn
 
 
-class DatasetStats:
-    def __init__(self):
-        self.stages: List[tuple] = []  # (name, seconds, n_blocks)
+def _goodput():
+    """The shared goodput recording plane (never a hard dependency:
+    stats objects must work even if the metrics plane is broken)."""
+    try:
+        from ray_tpu.util import goodput
 
-    def record(self, name, seconds, n_blocks):
-        self.stages.append((name, seconds, n_blocks))
+        return goodput
+    except Exception:
+        return None
+
+
+class StageStats:
+    """One executed stage: total wall time plus per-block duration and
+    size (rows/bytes) distributions. Block samples are BOUNDED
+    (``DatasetStats.MAX_BLOCK_SAMPLES``); totals stay exact."""
+
+    __slots__ = ("name", "wall_s", "n_blocks", "block_seconds",
+                 "block_rows", "block_bytes", "rows_total",
+                 "bytes_total", "sampled")
+
+    def __init__(self, name: str, wall_s: float, n_blocks: int,
+                 blocks: Optional[list] = None, max_samples: int = 256):
+        self.name = name
+        self.wall_s = float(wall_s)
+        self.n_blocks = int(n_blocks)
+        self.block_seconds: List[float] = []
+        self.block_rows: List[int] = []
+        self.block_bytes: List[int] = []
+        self.rows_total = 0
+        self.bytes_total = 0
+        self.sampled = False  # True when samples were clipped
+        for i, (dur, rows, nbytes) in enumerate(blocks or ()):
+            self.rows_total += int(rows)
+            self.bytes_total += int(nbytes)
+            if i < max_samples:
+                if dur is not None:  # None = duration unknown (pool)
+                    self.block_seconds.append(float(dur))
+                self.block_rows.append(int(rows))
+                self.block_bytes.append(int(nbytes))
+            else:
+                self.sampled = True
+
+    @property
+    def rows_per_s(self) -> float:
+        return self.rows_total / self.wall_s if self.wall_s > 0 else 0.0
+
+    @property
+    def bytes_per_s(self) -> float:
+        return self.bytes_total / self.wall_s if self.wall_s > 0 else 0.0
+
+    def _dist(self, vals: list) -> Optional[dict]:
+        if not vals:
+            return None
+        from ray_tpu.util.metrics import percentile
+
+        s = sorted(vals)
+        return {"min": s[0], "p50": percentile(s, 0.5),
+                "max": s[-1], "mean": sum(s) / len(s)}
+
+    def to_dict(self) -> dict:
+        out = {
+            "name": self.name,
+            "wall_s": round(self.wall_s, 6),
+            "n_blocks": self.n_blocks,
+            "rows_total": self.rows_total,
+            "bytes_total": self.bytes_total,
+            "rows_per_s": round(self.rows_per_s, 1),
+            "bytes_per_s": round(self.bytes_per_s, 1),
+            "sampled": self.sampled,
+        }
+        for key, vals in (("block_seconds", self.block_seconds),
+                          ("block_rows", self.block_rows),
+                          ("block_bytes", self.block_bytes)):
+            d = self._dist(vals)
+            if d:
+                out[key] = d
+        return out
+
+    def summary_lines(self, index: int) -> List[str]:
+        # First line keeps the pre-v2 string format verbatim (callers
+        # grep it); detail lines are indented below.
+        lines = [f"stage {index}: {self.name} — {self.wall_s * 1000:.1f}"
+                 f" ms over {self.n_blocks} blocks"]
+        if self.rows_total or self.bytes_total:
+            lines.append(
+                f"    {self.rows_total} rows, {self.bytes_total} bytes "
+                f"({self.rows_per_s:,.0f} rows/s, "
+                f"{self.bytes_per_s / 1e6:,.1f} MB/s)")
+        d = self._dist(self.block_seconds)
+        if d:
+            clipped = " (sampled)" if self.sampled else ""
+            lines.append(
+                f"    per-block: min {d['min'] * 1e3:.2f} / p50 "
+                f"{d['p50'] * 1e3:.2f} / max {d['max'] * 1e3:.2f} ms"
+                f"{clipped}")
+        return lines
+
+
+class IterationStats:
+    """One consumer loop over ``iter_batches``/``iter_device_batches``:
+    data-wait vs consumer time, host->device transfer seconds, prefetch
+    occupancy, and the derived stall fraction."""
+
+    __slots__ = ("batches", "wait_s", "user_s", "transfer_s",
+                 "occupancy", "device")
+
+    def __init__(self, device: bool = False):
+        self.batches = 0
+        self.wait_s = 0.0
+        self.user_s = 0.0
+        self.transfer_s = 0.0
+        self.occupancy: List[int] = []
+        self.device = device
+
+    @property
+    def stall_fraction(self) -> float:
+        denom = self.wait_s + self.user_s
+        return self.wait_s / denom if denom > 0 else 0.0
+
+    def to_dict(self) -> dict:
+        out = {
+            "batches": self.batches,
+            "wait_s": round(self.wait_s, 6),
+            "user_s": round(self.user_s, 6),
+            "stall_fraction": round(self.stall_fraction, 4),
+        }
+        if self.device:
+            out["transfer_s"] = round(self.transfer_s, 6)
+        if self.occupancy:
+            out["mean_occupancy"] = round(
+                sum(self.occupancy) / len(self.occupancy), 2)
+        return out
+
+    def summary_line(self) -> str:
+        extra = (f", transfer {self.transfer_s * 1e3:.1f} ms"
+                 if self.device else "")
+        return (f"iterator: {self.batches} batches, stall "
+                f"{self.stall_fraction:.1%} (wait "
+                f"{self.wait_s * 1e3:.1f} ms / user "
+                f"{self.user_s * 1e3:.1f} ms{extra})")
+
+
+class DatasetStats:
+    """Structured execution stats (v2). Derived datasets hold a
+    parent-LINKED child (never a shared mutable object — pre-v2, every
+    ``split``/``repartition``/``union``/``map_batches`` result aliased
+    one stats object, so one branch's stage records polluted its
+    siblings and the stage list grew without bound across reuse).
+
+    ``Dataset.stats()`` returns this object; ``summary()`` (also
+    ``str()``/``in``) keeps the old per-stage string format as its
+    first line per stage."""
+
+    MAX_BLOCK_SAMPLES = 256
+    MAX_STAGES = 64
+    MAX_ITERATIONS = 16
+
+    def __init__(self, parents: Optional[List["DatasetStats"]] = None):
+        self.stages: List[StageStats] = []
+        self.parents: List["DatasetStats"] = list(parents or [])
+        self.dropped_stages = 0
+        self.iterations: List[IterationStats] = []
+
+    def child(self, *extra_parents: "DatasetStats") -> "DatasetStats":
+        return DatasetStats(parents=[self, *extra_parents])
+
+    def record(self, name, seconds, n_blocks, blocks=None):
+        self.stages.append(StageStats(
+            name, seconds, n_blocks, blocks,
+            max_samples=self.MAX_BLOCK_SAMPLES))
+        if len(self.stages) > self.MAX_STAGES:
+            del self.stages[0]
+            self.dropped_stages += 1
+        gp = _goodput()
+        if gp is not None:
+            try:
+                gp.record_stage(name, seconds, blocks)
+            except Exception:
+                pass
+
+    def start_iteration(self, device: bool = False) -> IterationStats:
+        it = IterationStats(device=device)
+        self.iterations.append(it)
+        if len(self.iterations) > self.MAX_ITERATIONS:
+            del self.iterations[0]
+        return it
+
+    def lineage(self) -> List[StageStats]:
+        """Stages of this dataset AND its ancestry, execution order,
+        each ancestor visited once (a ``union`` of two branches of one
+        root must not double-report the root)."""
+        out: List[StageStats] = []
+        seen: set = set()
+
+        def walk(st: "DatasetStats"):
+            if id(st) in seen:
+                return
+            seen.add(id(st))
+            for p in st.parents:
+                walk(p)
+            out.extend(st.stages)
+
+        walk(self)
+        return out
+
+    def to_dict(self) -> dict:
+        out: dict = {
+            "stages": [s.to_dict() for s in self.lineage()],
+        }
+        if self.dropped_stages:
+            out["dropped_stages"] = self.dropped_stages
+        if self.iterations:
+            out["iterations"] = [it.to_dict() for it in self.iterations]
+        return out
 
     def summary(self) -> str:
-        lines = [
-            f"stage {i}: {name} — {sec*1000:.1f} ms over {nb} blocks"
-            for i, (name, sec, nb) in enumerate(self.stages)
-        ]
+        lines: List[str] = []
+        for i, stage in enumerate(self.lineage()):
+            lines.extend(stage.summary_lines(i))
+        if self.dropped_stages:
+            lines.append(f"({self.dropped_stages} older stage record(s) "
+                         f"dropped at the {self.MAX_STAGES}-stage cap)")
+        for it in self.iterations:
+            lines.append(it.summary_line())
         return "\n".join(lines) or "(no stages executed)"
+
+    def __str__(self) -> str:
+        return self.summary()
+
+    def __contains__(self, item) -> bool:
+        # Pre-v2 ``ds.stats()`` was the summary string; keep substring
+        # membership working for existing callers.
+        return item in self.summary()
+
+    def __repr__(self) -> str:
+        return (f"DatasetStats(stages={len(self.stages)}, "
+                f"parents={len(self.parents)}, "
+                f"iterations={len(self.iterations)})")
 
 
 class Dataset:
@@ -76,25 +317,43 @@ class Dataset:
             return self._computed
         fns = [s.fn for s in self._stages]
         name = "+".join(s.name for s in self._stages)
+        from ray_tpu.util import tracing
+
         start = time.perf_counter()
-        apply_task = ray_tpu.remote(_remote_apply)
-        out = [apply_task.remote(fns, b) for b in self._blocks]
-        ray_tpu.wait(out, num_returns=len(out), timeout=None)
-        self._stats.record(name, time.perf_counter() - start, len(out))
+        apply_task = ray_tpu.remote(_remote_apply_meta).options(
+            num_returns=2)
+        with tracing.span(f"data:{name}",
+                          {"blocks": len(self._blocks)}, cat="data"):
+            pairs = [apply_task.remote(fns, b) for b in self._blocks]
+            out = [p[0] for p in pairs]
+            ray_tpu.wait(out, num_returns=len(out), timeout=None)
+            wall = time.perf_counter() - start
+        # Per-block (duration, rows, bytes) metas are tiny side returns;
+        # best-effort — a stats fetch failure must not fail the plan.
+        blocks_meta = None
+        try:
+            metas = ray_tpu.get([p[1] for p in pairs])
+            blocks_meta = [(m["duration_s"], m["rows"], m["bytes"])
+                           for m in metas]
+        except Exception:
+            pass
+        self._stats.record(name, wall, len(out), blocks=blocks_meta)
         self._computed = out
         self._blocks, self._stages = out, []
         return out
 
     def _with_stage(self, name: str, fn: Callable) -> "Dataset":
         return Dataset(self._blocks, self._stages + [_Stage(name, fn)],
-                       self._stats)
+                       self._stats.child())
 
     def materialize(self) -> "Dataset":
         self._execute()
         return self
 
-    def stats(self) -> str:
-        return self._stats.summary()
+    def stats(self) -> "DatasetStats":
+        """Structured execution stats; ``str(ds.stats())`` (or substring
+        ``in``) keeps the old summary-string contract."""
+        return self._stats
 
     @property
     def num_blocks(self) -> int:
@@ -167,11 +426,18 @@ class Dataset:
             pool.map(lambda a, blk: a.apply.remote([do], blk), blocks)
         )
         out = [ray_tpu.put(v) for v in out_vals]
-        self._stats.record("map_batches(actors)",
-                           time.perf_counter() - start, len(out))
+        stats = self._stats.child()
+        # Per-block durations are unknown on the pool path (the pool
+        # interleaves blocks across actors); record sizes only — a
+        # fabricated 0.0s sample would poison the task-measured
+        # block-duration distribution.
+        stats.record("map_batches(actors)",
+                     time.perf_counter() - start, len(out),
+                     blocks=[(None, B.num_rows(v), B.size_bytes(v))
+                             for v in out_vals])
         for w in list(pool._idle):
             ray_tpu.kill(w)
-        return Dataset(out, [], self._stats)
+        return Dataset(out, [], stats)
 
     def limit(self, n: int) -> "Dataset":
         blocks = self._execute()
@@ -183,7 +449,7 @@ class Dataset:
             take = min(n - used, B.num_rows(blk))
             out.append(ray_tpu.put(B.slice_block(blk, 0, take)))
             used += take
-        return Dataset(out, [], self._stats)
+        return Dataset(out, [], self._stats.child())
 
     # -- all-to-all operations --------------------------------------------
 
@@ -209,8 +475,10 @@ class Dataset:
             ]
             out.append(concat_task.remote(*parts))
         ray_tpu.wait(out, num_returns=len(out), timeout=None)
-        self._stats.record("repartition", time.perf_counter() - start, num_blocks)
-        return Dataset(out, [], self._stats)
+        stats = self._stats.child()
+        stats.record("repartition", time.perf_counter() - start,
+                     num_blocks)
+        return Dataset(out, [], stats)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         """Two-phase all-to-all shuffle (push-based shuffle shape)."""
@@ -244,8 +512,10 @@ class Dataset:
             for j in _py_range(n_out)
         ]
         ray_tpu.wait(out, num_returns=len(out), timeout=None)
-        self._stats.record("random_shuffle", time.perf_counter() - start, n_out)
-        return Dataset(out, [], self._stats)
+        stats = self._stats.child()
+        stats.record("random_shuffle", time.perf_counter() - start,
+                     n_out)
+        return Dataset(out, [], stats)
 
     def sort(self, key: Optional[Any] = None, descending: bool = False) -> "Dataset":
         """Sample-partition-sort (range-partitioned distributed sort)."""
@@ -293,8 +563,9 @@ class Dataset:
         if descending:
             out = out[::-1]
         ray_tpu.wait(out, num_returns=len(out), timeout=None)
-        self._stats.record("sort", time.perf_counter() - start, len(out))
-        return Dataset(out, [], self._stats)
+        stats = self._stats.child()
+        stats.record("sort", time.perf_counter() - start, len(out))
+        return Dataset(out, [], stats)
 
     @staticmethod
     def _make_keyfn(key):
@@ -310,7 +581,8 @@ class Dataset:
     # -- combining --------------------------------------------------------
 
     def union(self, other: "Dataset") -> "Dataset":
-        return Dataset(self._execute() + other._execute(), [], self._stats)
+        return Dataset(self._execute() + other._execute(), [],
+                       self._stats.child(other._stats))
 
     def zip(self, other: "Dataset") -> "Dataset":
         a, b = self._execute(), other._execute()
@@ -322,7 +594,7 @@ class Dataset:
             b_rows = other.take_all()
             return from_items(list(zip(a_rows, b_rows)))
         return Dataset([zip_task.remote(x, y) for x, y in zip(a, b)], [],
-                       self._stats)
+                       self._stats.child(other._stats))
 
     def window(self, *, blocks_per_window: int = 10) -> "DatasetPipeline":
         """Windowed pipeline over this dataset's blocks: each window's
@@ -344,7 +616,8 @@ class Dataset:
         blocks = self._execute()
         if not equal:
             return [
-                Dataset(blocks[i::n], [], self._stats) for i in _py_range(n)
+                Dataset(blocks[i::n], [], self._stats.child())
+                for i in _py_range(n)
             ]
         counts = ray_tpu.get(
             [ray_tpu.remote(B.num_rows).remote(b) for b in blocks]
@@ -368,7 +641,7 @@ class Dataset:
                 if filled >= per:
                     shard_idx += 1
                     filled = 0
-        return [Dataset(s, [], self._stats) for s in shards]
+        return [Dataset(s, [], self._stats.child()) for s in shards]
 
     # -- consumption ------------------------------------------------------
 
@@ -412,10 +685,22 @@ class Dataset:
         batch_format: str = "numpy",
         prefetch_blocks: int = 1,
         drop_last: bool = False,
+        _iter_stats: Optional[IterationStats] = None,
     ) -> Iterable:
         """Batches with background block prefetch (the pipelined-ingest
-        analog of ``DatasetPipeline`` windows)."""
+        analog of ``DatasetPipeline`` windows).
+
+        Instrumented for the goodput plane: per yielded batch the loop
+        records consumer data-wait (time starved inside ``next()``) vs
+        consumer time (between batches), and the prefetch-buffer
+        occupancy it observed — the derived stall fraction is the
+        input-pipeline health number (``state.data_stats()``). Waits
+        also accrue to the active train session's ``data_wait`` step
+        phase."""
         refs = self._execute()
+        it_stats = _iter_stats if _iter_stats is not None \
+            else self._stats.start_iteration()
+        gp = _goodput()
         fetched: "dict[int, Any]" = {}
         cv = threading.Condition()
 
@@ -429,12 +714,55 @@ class Dataset:
                         cv.wait(0.1)
 
         threading.Thread(target=prefetcher, daemon=True).start()
+
+        def _record_wait(wait: float, occ: int):
+            # Recorded BEFORE the yield so the wait lands in the step
+            # the consumer is actually starved in (the session's
+            # data_wait phase attributes per report).
+            it_stats.wait_s += wait
+            it_stats.occupancy.append(occ)
+            if gp is not None:
+                try:
+                    gp.record_iter_batch(wait_s=wait, occupancy=occ)
+                except Exception:
+                    pass
+            # Accrue to the active train session WITHOUT importing the
+            # heavy train package from the data path: if no session
+            # module is loaded, no session can be active.
+            import sys as _sys
+
+            _session = _sys.modules.get("ray_tpu.train.session")
+            if _session is not None:
+                try:
+                    _session.add_data_wait(wait)
+                except Exception:
+                    pass
+
+        def _record_user(user: float):
+            it_stats.batches += 1
+            it_stats.user_s += user
+            if gp is not None:
+                try:
+                    gp.record_iter_batch(user_s=user)
+                except Exception:
+                    pass
+
+        # t_request marks when the consumer asked for the next batch
+        # (generator resume); wait = produce-ready - t_request, user =
+        # next resume - yield. Both this recorder and an outside client
+        # timing next() count exactly one wait + one user sample per
+        # yielded batch.
+        t_request = time.perf_counter()
         carry: Optional[B.Block] = None
         for i in _py_range(len(refs)):
             with cv:
                 while i not in fetched:
                     cv.wait(0.1)
                 blk = fetched.pop(i)
+                # Occupancy AFTER taking the current block: blocks the
+                # producer is ahead by. 0 = every batch starves (the
+                # documented starved bucket must be reachable).
+                occ = len(fetched)
                 cv.notify_all()
             if carry is not None and B.num_rows(carry):
                 blk = B.concat_blocks([carry, blk])
@@ -442,13 +770,25 @@ class Dataset:
             n = B.num_rows(blk)
             pos = 0
             while n - pos >= batch_size:
-                yield B.to_batch(B.slice_block(blk, pos, pos + batch_size),
-                                 batch_format)
+                batch = B.to_batch(
+                    B.slice_block(blk, pos, pos + batch_size),
+                    batch_format)
+                produced = time.perf_counter()
+                _record_wait(produced - t_request, occ)
+                yield batch
+                resumed = time.perf_counter()
+                _record_user(resumed - produced)
+                t_request = resumed
                 pos += batch_size
             if pos < n:
                 carry = B.slice_block(blk, pos, n)
         if carry is not None and B.num_rows(carry) and not drop_last:
-            yield B.to_batch(carry, batch_format)
+            batch = B.to_batch(carry, batch_format)
+            produced = time.perf_counter()
+            _record_wait(produced - t_request, 0)
+            yield batch
+            resumed = time.perf_counter()
+            _record_user(resumed - produced)
 
     def iter_torch_batches(
         self,
@@ -484,8 +824,17 @@ class Dataset:
     def iter_device_batches(self, *, batch_size: int, sharding=None,
                             dtype=None, drop_last: bool = True) -> Iterable:
         """Double-buffered host->device feeding: batch i+1 is transferred
-        while batch i is being consumed (TPU ingest path)."""
+        while batch i is being consumed (TPU ingest path).
+
+        Goodput instrumentation: the host-side ``device_put`` dispatch
+        seconds per batch land in the ``transfer`` phase of
+        ``ray_tpu_data_iter_seconds`` (the transfer itself is async —
+        overlap working means this stays small and the consumer's wait
+        stays near zero)."""
         import jax
+
+        it_stats = self._stats.start_iteration(device=True)
+        gp = _goodput()
 
         def to_device(batch):
             def put(x):
@@ -495,12 +844,22 @@ class Dataset:
                 return (jax.device_put(x, sharding) if sharding is not None
                         else jax.device_put(x))
 
-            if isinstance(batch, dict):
-                return {k: put(v) for k, v in batch.items()}
-            return put(batch)
+            t0 = time.perf_counter()
+            try:
+                if isinstance(batch, dict):
+                    return {k: put(v) for k, v in batch.items()}
+                return put(batch)
+            finally:
+                dt = time.perf_counter() - t0
+                it_stats.transfer_s += dt
+                if gp is not None:
+                    try:
+                        gp.record_iter_batch(transfer_s=dt)
+                    except Exception:
+                        pass
 
         it = self.iter_batches(batch_size=batch_size, batch_format="numpy",
-                               drop_last=drop_last)
+                               drop_last=drop_last, _iter_stats=it_stats)
         prev = None
         for batch in it:
             nxt = to_device(batch)  # async transfer starts immediately
@@ -582,7 +941,7 @@ class GroupedData:
         partial_task = ray_tpu.remote(partial)
         combine_task = ray_tpu.remote(combine)
         out = combine_task.remote(*[partial_task.remote(b) for b in blocks])
-        return Dataset([out], [], self.ds._stats)
+        return Dataset([out], [], self.ds._stats.child())
 
     def count(self) -> Dataset:
         return self._aggregate(

@@ -31,9 +31,10 @@ class DatasetPipeline:
         blocks = ds._blocks
         stages = ds._stages
         wins = [
-            Dataset(blocks[i:i + blocks_per_window], list(stages))
+            Dataset(blocks[i:i + blocks_per_window], list(stages),
+                    ds._stats.child())
             for i in range(0, len(blocks), blocks_per_window)
-        ] or [Dataset([], list(stages))]
+        ] or [Dataset([], list(stages), ds._stats.child())]
         return DatasetPipeline(wins)
 
     def repeat(self, times: int = 2) -> "DatasetPipeline":
@@ -102,4 +103,4 @@ class DatasetPipeline:
         return len(self._windows) * self._epochs
 
     def stats(self) -> str:
-        return "\n".join(w.stats() for w in self._windows)
+        return "\n".join(str(w.stats()) for w in self._windows)

@@ -25,7 +25,7 @@ FILENAME = "BENCH_TPU_SESSIONS.jsonl"
 # by "bench" rather than "script"+"config").
 KNOWN_BENCHES = frozenset({
     "task_overhead", "memory_pressure", "chaos_soak", "scalebench",
-    "drain_recovery_ms", "serve_latency",
+    "drain_recovery_ms", "serve_latency", "input_pipeline", "goodput",
 })
 
 
@@ -206,6 +206,54 @@ def record_serve_latency(*, client: dict, server: dict, agreement: dict,
     return entry
 
 
+def record_input_pipeline(*, client: dict, server: dict,
+                          agreement: dict, n_batches: int = 0,
+                          device: str = "", path: str | None = None,
+                          **extra) -> dict:
+    """Input-pipeline stall evidence (``scripts/input_bench.py``): the
+    client-measured stall fraction of a dataset->iterator->train-step
+    loop, the metrics-derived view of the same loop, and the agreement
+    verdict between them (count-exact per phase, stall within
+    tolerance — disagreement means the goodput metrics are lying).
+    Committed to the evidence trail only on an accelerator; returns the
+    entry (with ``committed_to``) either way."""
+    entry: dict = {
+        "bench": "input_pipeline",
+        "device": device,
+        "n_batches": int(n_batches),
+        "client": dict(client),
+        "server": dict(server),
+        "agreement": dict(agreement),
+    }
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
+def record_goodput(*, trial: str, goodput_pct: float, wall_s: float,
+                   downtime_s: float, by_cause: dict,
+                   device: str = "", path: str | None = None,
+                   **extra) -> dict:
+    """Training goodput evidence (``scripts/input_bench.py --drain``,
+    chaos soak train probe): a trial's goodput %% with its downtime
+    ledger — every non-productive second must carry a cause
+    (drain:<reason> / preemption / failure), never unaccounted wall
+    time. Committed to the evidence trail only on an accelerator;
+    returns the entry (with ``committed_to``) either way."""
+    entry: dict = {
+        "bench": "goodput",
+        "device": device,
+        "trial": str(trial),
+        "goodput_pct": float(goodput_pct),
+        "wall_s": round(float(wall_s), 3),
+        "downtime_s": round(float(downtime_s), 3),
+        "by_cause": dict(by_cause),
+    }
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
 def record_scalebench(*, scalability: dict | None = None,
                       head_scale: dict | None = None,
                       device: str = "", path: str | None = None,
@@ -303,6 +351,34 @@ def check_line(obj: object, *, allow_header: bool = False) -> list[str]:
     if "bench" in obj:
         if obj["bench"] not in KNOWN_BENCHES:
             errs.append(f"unknown bench {obj['bench']!r}")
+        elif obj["bench"] == "input_pipeline":
+            # The whole point of the line is the CROSS-CHECKED stall
+            # fraction: client AND server views plus the agreement flag
+            # — a one-sided stall number is exactly the unverified
+            # claim this bench exists to prevent.
+            client = obj.get("client")
+            server = obj.get("server")
+            if not (isinstance(client, dict)
+                    and _is_num(client.get("stall_fraction"))):
+                errs.append("input_pipeline line missing numeric "
+                            "client.stall_fraction")
+            if not (isinstance(server, dict)
+                    and _is_num(server.get("stall_fraction"))):
+                errs.append("input_pipeline line missing numeric "
+                            "server.stall_fraction")
+            agreement = obj.get("agreement")
+            if not (isinstance(agreement, dict)
+                    and isinstance(agreement.get("ok"), bool)):
+                errs.append("input_pipeline line missing boolean "
+                            "agreement.ok")
+        elif obj["bench"] == "goodput":
+            if not _is_num(obj.get("goodput_pct")):
+                errs.append("goodput line missing numeric goodput_pct")
+            if not _is_num(obj.get("downtime_s")):
+                errs.append("goodput line missing numeric downtime_s")
+            if not isinstance(obj.get("by_cause"), dict):
+                errs.append("goodput line missing by_cause attribution "
+                            "dict")
         elif obj["bench"] == "serve_latency":
             # A serve latency line must carry both views AND the
             # agreement verdict — a client-only (or server-only) number

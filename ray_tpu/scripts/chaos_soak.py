@@ -74,6 +74,8 @@ class _Soak:
         self.puts_ok = 0
         self.serve_ok = 0
         self.serve_shed = 0
+        self.train_reports = 0
+        self.train_goodput: "dict | None" = None
         self._stop = threading.Event()
         # The graceful-drain victim: the fault injector must not kill or
         # partition the node the drain (and its retry-exemption probe)
@@ -307,6 +309,63 @@ class _Soak:
                     self.serve_shed += 1
             time.sleep(0.5)
 
+    def _train_probe(self, deadline: float) -> None:
+        """Standing train invariant under faults: a small checkpointing
+        trial must keep reporting steps — or restart cleanly from its
+        checkpoint — for the whole fault schedule, and its downtime
+        ledger must attribute every non-productive second to a cause
+        (a gap the ledger can't explain means the goodput plane lost
+        track of the trial)."""
+        from ray_tpu import train
+        from ray_tpu.train import session
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        steps = max(6, int(self.duration_s / 0.6))
+
+        def train_fn(config):
+            start = 0
+            ckpt = session.get_checkpoint()
+            if ckpt is not None:
+                start = ckpt.to_dict().get("step", -1) + 1
+            for i in range(start, config["steps"]):
+                time.sleep(0.4)
+                session.report(
+                    {"step": i},
+                    checkpoint=Checkpoint.from_dict({"step": i}))
+
+        try:
+            result = train.DataParallelTrainer(
+                train_fn,
+                train_loop_config={"steps": steps},
+                scaling_config=train.ScalingConfig(num_workers=1),
+                run_config=train.RunConfig(
+                    failure_config=train.FailureConfig(max_failures=8)),
+            ).fit()
+        except Exception as e:  # noqa: BLE001
+            if not self._stop.is_set():
+                self.violations.append(f"train probe crashed: {e!r}")
+            return
+        if result.error is not None:
+            self.violations.append(
+                f"train probe ended in error: {result.error!r}")
+            return
+        self.train_reports = len(result.metrics_history)
+        gp = result.goodput or {}
+        self.train_goodput = gp
+        if not result.metrics or result.metrics.get("step") != steps - 1:
+            self.violations.append(
+                f"train probe lost steps: last metrics "
+                f"{result.metrics!r}")
+        by_cause = gp.get("by_cause") or {}
+        if abs(sum(by_cause.values())
+               - (gp.get("downtime_s") or 0.0)) > 1e-6:
+            self.violations.append(
+                f"train probe downtime not fully attributed: "
+                f"{gp!r}")
+        if any(not c for c in by_cause):
+            self.violations.append(
+                f"train probe downtime with empty cause: {by_cause!r}")
+
     def _drain_once(self, cluster) -> None:
         """One graceful drain mid-soak with a budget-exemption probe: a
         max_retries=0 task pinned to the drained node must complete."""
@@ -444,6 +503,9 @@ class _Soak:
                 target=self._workload, args=(cluster, deadline),
                 daemon=True)
             workload.start()
+            train_probe = threading.Thread(
+                target=self._train_probe, args=(deadline,), daemon=True)
+            train_probe.start()
             if serve_handle is not None:
                 threading.Thread(
                     target=self._serve_probe_loop,
@@ -453,6 +515,14 @@ class _Soak:
             workload.join(timeout=self.duration_s + 180.0)
             if workload.is_alive():
                 self.violations.append("workload wedged past deadline")
+            # The trial restarts from checkpoint under kills: give it
+            # the same generous settle the workload gets before calling
+            # a hang.
+            train_probe.join(timeout=self.duration_s + 240.0)
+            if train_probe.is_alive():
+                self.violations.append(
+                    "train probe wedged past deadline (neither "
+                    "reporting nor restarting)")
             # Fault quota: a soak that recovered slowly (MTTR probes
             # stretch the schedule on a loaded box) keeps injecting —
             # bounded — until at least 4 DISTINCT fault classes landed
@@ -501,6 +571,8 @@ class _Soak:
             script="chaos_soak",
             serve_ok=self.serve_ok,
             serve_shed=self.serve_shed,
+            train_reports=self.train_reports,
+            train_goodput=self.train_goodput,
         )
         ray_tpu.shutdown()
         cluster.shutdown()

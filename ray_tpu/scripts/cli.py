@@ -258,6 +258,87 @@ def cmd_serve(args):
         print(f"controller reconcile: {stats['reconcile_s'] * 1e3:.1f} ms")
 
 
+def cmd_data(args):
+    """Input-pipeline observability: ``ray-tpu data stats`` prints the
+    per-stage execution rollup and the consumer-loop stall fraction —
+    the input-pipeline gate in front of any kernel-level MFU work
+    (a starved loop means the kernels are idle, not slow)."""
+    _connect(args)
+    from ray_tpu import state
+
+    if args.action != "stats":
+        raise SystemExit(f"unknown data action {args.action!r}")
+    stats = state.data_stats()
+    if args.json:
+        print(json.dumps(stats, indent=2, default=str))
+        return
+    stages = stats.get("stages") or {}
+    if stages:
+        hdr = (f"{'stage':<28} {'execs':>5} {'blocks':>7} "
+               f"{'rows':>10} {'wall ms':>9} {'MB/s':>8}")
+        print(hdr)
+        print("-" * len(hdr))
+        for name, st in stages.items():
+            mb_s = (st.get("bytes_per_s") or 0) / 1e6
+            print(f"{name:<28} {st.get('executions', 0):>5} "
+                  f"{st.get('blocks', '—'):>7} "
+                  f"{st.get('rows_total', '—'):>10} "
+                  f"{st.get('wall_ms', 0):>9} {mb_s:>8.1f}")
+    else:
+        print("no dataset stages recorded")
+    it = stats.get("iterator") or {}
+    for phase in ("wait", "user", "transfer"):
+        d = it.get(phase)
+        if d:
+            print(f"iterator {phase:<9} n={d['count']:<7} "
+                  f"p50 {d['p50_ms']} ms  mean {d['mean_ms']} ms")
+    occ = it.get("occupancy")
+    if occ:
+        print(f"prefetch occupancy: mean {occ['mean']} "
+              f"({occ['samples']} samples)")
+    sf = stats.get("stall_fraction")
+    if sf is not None:
+        print(f"stall fraction: {sf:.1%} of consumer loop wall time "
+              f"starved for data")
+    else:
+        print("stall fraction: — (no consumer loops recorded)")
+
+
+def cmd_train(args):
+    """Training goodput: ``ray-tpu train stats`` prints per-trial
+    report counts, step-phase latencies, rank skew, and the downtime
+    ledger's goodput %."""
+    _connect(args)
+    from ray_tpu import state
+
+    if args.action != "stats":
+        raise SystemExit(f"unknown train action {args.action!r}")
+    stats = state.train_stats()
+    if args.json:
+        print(json.dumps(stats, indent=2, default=str))
+        return
+    trials = stats.get("trials") or {}
+    if not trials:
+        print("no train sessions recorded")
+        return
+    for name, t in trials.items():
+        gp = t.get("goodput_pct")
+        skew = t.get("rank_skew")
+        print(f"trial {name}: {t.get('reports', 0)} reports"
+              + (f", goodput {gp}%" if gp is not None else "")
+              + (f", rank skew {skew}x" if skew is not None else ""))
+        for phase, d in (t.get("phases") or {}).items():
+            print(f"    {phase:<18} n={d['count']:<7} "
+                  f"p50 {d['p50_ms']} ms  mean {d['mean_ms']} ms")
+        ranks = t.get("rank_step_s")
+        if ranks:
+            line = "  ".join(f"r{r}={s * 1e3:.1f}ms"
+                             for r, s in ranks.items())
+            print(f"    rank step: {line}")
+        for cause, s in (t.get("downtime_s") or {}).items():
+            print(f"    downtime [{cause}]: {s:.2f} s")
+
+
 def cmd_logs(args):
     """List captured worker logs, or print (and follow) one worker's."""
     from ray_tpu import state
@@ -657,6 +738,22 @@ def main(argv=None):
                    help="also print the per-phase latency breakdown")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "data",
+        help="input-pipeline observability (stage rollup + stall "
+             "fraction)")
+    p.add_argument("action", choices=["stats"])
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_data)
+
+    p = sub.add_parser(
+        "train",
+        help="training goodput (step phases, rank skew, downtime "
+             "ledger)")
+    p.add_argument("action", choices=["stats"])
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("submit", help="submit a job entrypoint")
     p.add_argument("--wait", action="store_true")

@@ -173,6 +173,11 @@ def main() -> None:
                     help="add the serve request-path point "
                          "(concurrent-stream harness + client/server "
                          "latency cross-check)")
+    ap.add_argument("--input-pipeline", action="store_true",
+                    dest="input_pipeline",
+                    help="add the training-goodput point "
+                         "(dataset->iterator->train-step harness + "
+                         "client/server stall-fraction cross-check)")
     args = ap.parse_args()
 
     # Each stage runs in its own subprocess: benchmark isolation (no
@@ -198,6 +203,9 @@ def main() -> None:
     if args.serve:
         steps.append([sys.executable, "-m",
                       "ray_tpu.scripts.serve_bench", "--out", args.out])
+    if args.input_pipeline:
+        steps.append([sys.executable, "-m",
+                      "ray_tpu.scripts.input_bench", "--out", args.out])
     for argv in steps:
         print(f"perfsuite: {' '.join(argv[2:])}", file=sys.stderr,
               flush=True)

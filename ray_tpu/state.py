@@ -203,6 +203,30 @@ def device_stats(fresh: bool = False) -> List[dict]:
     return []
 
 
+def data_stats() -> dict:
+    """Input-pipeline rollup from the training goodput plane: per-stage
+    wall time and per-block duration/rows/bytes distributions,
+    consumer-loop wait vs user time, prefetch-buffer occupancy, and the
+    derived **stall fraction** (the fraction of consumer loop wall time
+    spent starved for data — check it before blaming kernels). Reads
+    the federated metrics plane merged with this process's registry, so
+    driver-side dataset work and in-worker (training) ingest both
+    show."""
+    from ray_tpu.util import goodput
+
+    return goodput.data_stats()
+
+
+def train_stats() -> dict:
+    """Per-trial training goodput rollup: report counts, per-step phase
+    histograms (data_wait / step / report / checkpoint_save /
+    checkpoint_restore), per-rank step time with straggler skew, and
+    the downtime ledger's cause attribution yielding a goodput %."""
+    from ray_tpu.util import goodput
+
+    return goodput.train_stats()
+
+
 def set_failpoints(specs: dict, include_workers: bool = True) -> dict:
     """Arm/disarm deterministic failpoints cluster-wide: ``{site: spec}``
     where spec is ``action[:arg][,selector...]`` (see

@@ -1,0 +1,27 @@
+"""Training goodput plane — the train-package name for the shared core.
+
+The implementation lives in ``ray_tpu.util.goodput`` so the data layer
+and the cluster plane (workerproc event flusher, node-agent replay) can
+use it without importing the heavy ``ray_tpu.train`` package; this
+module is the same objects under the train-side name (mirroring
+``ray_tpu/serve/_observability.py`` for the serve plane). See that
+module's docstring for the recording contract.
+"""
+
+from ray_tpu.util.goodput import (  # noqa: F401
+    ITER_PHASES,
+    STEP_PHASES,
+    apply_events,
+    data_stats,
+    downtime_cause,
+    drain_events,
+    record_downtime,
+    record_iter_batch,
+    record_stage,
+    record_step,
+    requeue_events,
+    retract_gauges,
+    scrape_text,
+    stall_fraction_from,
+    train_stats,
+)

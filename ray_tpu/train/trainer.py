@@ -38,6 +38,7 @@ from ray_tpu.util import (
     placement_group,
     remove_placement_group,
 )
+from ray_tpu.util import goodput as _goodput
 from ray_tpu.util.queue import Queue
 
 
@@ -47,6 +48,15 @@ class Result:
     checkpoint: Optional[Checkpoint]
     error: Optional[BaseException] = None
     metrics_history: List[dict] = field(default_factory=list)
+    # Downtime-ledger rollup for the whole fit(): wall_s, downtime_s,
+    # by_cause (drain:<reason> / preemption / failure), restarts,
+    # goodput_pct, per-rank last step seconds + skew.
+    goodput: Optional[dict] = None
+
+
+# The downtime ledger is shared with Tune trials (one accounting
+# implementation): ray_tpu.util.goodput.GoodputLedger.
+_GoodputLedger = _goodput.GoodputLedger
 
 
 def _lost_to_drain(exc: BaseException) -> bool:
@@ -246,7 +256,8 @@ class DataParallelTrainer:
     # -- one attempt ------------------------------------------------------
 
     def _run_attempt(
-        self, ckpt_mgr: _CheckpointManager, metrics_history: List[dict]
+        self, ckpt_mgr: _CheckpointManager, metrics_history: List[dict],
+        ledger: Optional["_GoodputLedger"] = None,
     ) -> Optional[dict]:
         """Run the worker group to completion; returns last metrics.
         Raises on worker failure (caller handles elasticity)."""
@@ -289,6 +300,7 @@ class DataParallelTrainer:
             return self._consume_results(
                 queue, run_refs, n, ckpt_mgr, metrics_history,
                 drained_nodes=drained_nodes, group_nodes=set(node_ids),
+                ledger=ledger,
             )
         finally:
             drain_stop.set()
@@ -362,6 +374,7 @@ class DataParallelTrainer:
         self, queue, run_refs, n, ckpt_mgr, metrics_history,
         drained_nodes: Optional[set] = None,
         group_nodes: Optional[set] = None,
+        ledger: Optional["_GoodputLedger"] = None,
     ) -> Optional[dict]:
         """TrainingIterator: drain worker reports; rank-0 metrics win
         (``train/trainer.py:155 _fetch_next_result``)."""
@@ -389,6 +402,8 @@ class DataParallelTrainer:
                 finished.add(msg["rank"])
                 continue
             if msg["type"] == "report":
+                if ledger is not None:
+                    ledger.observe_report(msg)
                 if msg["checkpoint"] is not None and msg["rank"] == 0:
                     ckpt_mgr.register(
                         msg["checkpoint"], msg["metrics"], msg["iteration"]
@@ -406,27 +421,33 @@ class DataParallelTrainer:
         ckpt_mgr = _CheckpointManager(self.run_config.checkpoint_config)
         metrics_history: List[dict] = []
         max_failures = self.run_config.failure_config.max_failures
+        ledger = _GoodputLedger()
         attempt = 0
         while True:
             try:
-                last_metrics = self._run_attempt(ckpt_mgr, metrics_history)
+                last_metrics = self._run_attempt(
+                    ckpt_mgr, metrics_history, ledger)
                 return Result(
                     metrics=last_metrics,
                     checkpoint=ckpt_mgr.best,
                     metrics_history=metrics_history,
+                    goodput=ledger.summary(),
                 )
-            except TrainingWorkerPreempted:
+            except TrainingWorkerPreempted as e:
                 # Preemption exemption: a planned node departure restarts
                 # the group (from the latest checkpoint) WITHOUT
                 # consuming the failure budget.
+                ledger.mark_down(_goodput.downtime_cause(e))
                 time.sleep(0.2)
             except (ActorError, TaskError) as e:
                 if _lost_to_drain(e):
                     # A group actor (worker or results queue) died WITH a
                     # draining/preempted node before the drain watcher
                     # could classify it: same exemption, same restart.
+                    ledger.mark_down(_goodput.downtime_cause(e))
                     time.sleep(0.2)
                     continue
+                ledger.mark_down("failure")
                 attempt += 1
                 if max_failures >= 0 and attempt > max_failures:
                     return Result(
@@ -434,6 +455,7 @@ class DataParallelTrainer:
                         checkpoint=ckpt_mgr.best,
                         error=e,
                         metrics_history=metrics_history,
+                        goodput=ledger.summary(),
                     )
                 # Elastic restart: new group resumes from latest checkpoint.
                 time.sleep(0.2)

@@ -54,6 +54,24 @@ class Trial:
         self.actor = None
         self.run_ref = None
         self.version = 0  # monotonic dirty counter, see __setattr__
+        # Downtime ledger (the trainer's accounting, shared
+        # implementation): opened when an attempt fails, closed at the
+        # restarted attempt's first accepted report.
+        from ray_tpu.util.goodput import GoodputLedger
+
+        self.ledger = GoodputLedger(self.trial_id)
+
+    def mark_down(self, cause: str) -> None:
+        self.ledger.mark_down(cause)
+
+    def close_downtime(self) -> None:
+        self.ledger.mark_progress()
+
+    def goodput(self) -> dict:
+        """Per-trial goodput % — a NON-mutating read (an open downtime
+        interval shows in the view but stays open for the eventual
+        recovery to attribute)."""
+        return self.ledger.snapshot()
 
     # Persisted fields bump a monotonic version so the snapshot change
     # signature never relies on id() — a fresh object at a GC-reused
@@ -394,6 +412,7 @@ class TrialRunner:
             return
         if info.get("generation") != trial.generation or trial.status != RUNNING:
             return  # stale report from a superseded attempt
+        trial.close_downtime()  # a report proves progress again
         result = dict(msg["metrics"])
         result.setdefault("training_iteration", msg["iteration"])
         trial.last_result = result
@@ -447,6 +466,9 @@ class TrialRunner:
             try:
                 ray_tpu.get(trial.run_ref)
             except (ActorError, TaskError) as e:
+                from ray_tpu.util import goodput as _goodput
+
+                trial.mark_down(_goodput.downtime_cause(e))
                 trial.num_failures += 1
                 if trial.num_failures <= self.max_failures:
                     # Retry from the last checkpoint; back to PENDING so

@@ -392,6 +392,96 @@ SERVE_EVENTS_DROPPED = Counter(
     tag_keys=("node_id",),
 )
 
+# -- training goodput plane (input-pipeline + per-step train telemetry:
+# dataset stages, consumer-loop stall accounting, session-driven step
+# phases, the per-rank straggler gauge, and the trainer's downtime
+# ledger — recorded two-sided through ray_tpu/train/_observability.py,
+# the serve-plane shape: local registry immediately + worker-events
+# replay into the agent registry the federated scrape sees; per-rank
+# gauge children are retracted when the worker dies). node_id-tagged
+# like every per-node family so multi-host federation never duplicates
+# series.
+DATA_STAGE_SECONDS = Histogram(
+    "ray_tpu_data_stage_seconds",
+    "Wall time of one executed dataset stage (driver-observed, whole "
+    "stage across its blocks)",
+    boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0,
+                60.0, 300.0],
+    tag_keys=("node_id", "stage"),
+)
+DATA_BLOCK_SECONDS = Histogram(
+    "ray_tpu_data_block_seconds",
+    "Wall time of one block through one dataset stage (task-measured)",
+    boundaries=[0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                30.0],
+    tag_keys=("node_id", "stage"),
+)
+DATA_BLOCK_ROWS = Histogram(
+    "ray_tpu_data_block_rows",
+    "Rows per output block of a dataset stage (skew shows up here)",
+    boundaries=[1.0, 16.0, 64.0, 256.0, 1024.0, 4096.0, 16384.0,
+                65536.0, 262144.0, 1048576.0],
+    tag_keys=("node_id", "stage"),
+)
+DATA_BLOCK_BYTES = Histogram(
+    "ray_tpu_data_block_bytes",
+    "Bytes per output block of a dataset stage (a 10-GiB skewed block "
+    "shows up here before it OOMs the store)",
+    boundaries=[1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10],
+    tag_keys=("node_id", "stage"),
+)
+DATA_ITER_SECONDS = Histogram(
+    "ray_tpu_data_iter_seconds",
+    "Consumer-loop time per batch by phase (wait=consumer starved for "
+    "the next batch, user=consumer's own time between batches, "
+    "transfer=host->device dispatch in iter_device_batches); the "
+    "derived stall fraction is wait.sum / (wait.sum + user.sum)",
+    boundaries=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0],
+    tag_keys=("node_id", "phase"),
+)
+DATA_PREFETCH_OCCUPANCY = Histogram(
+    "ray_tpu_data_prefetch_occupancy",
+    "Prefetch-buffer occupancy observed as the consumer takes each "
+    "block batch (0 = the producer never gets ahead: every batch "
+    "starves)",
+    boundaries=[0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0],
+    tag_keys=("node_id",),
+)
+TRAIN_STEP_PHASE_SECONDS = Histogram(
+    "ray_tpu_train_step_phase_seconds",
+    "Wall time of one training-step phase per reported step (data_wait "
+    "/ step / report / checkpoint_save / checkpoint_restore), driven "
+    "from the session API",
+    boundaries=[0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 120.0],
+    tag_keys=("node_id", "trial", "phase"),
+)
+TRAIN_RANK_STEP_SECONDS = Gauge(
+    "ray_tpu_train_rank_step_seconds",
+    "Most recent step compute seconds per rank of a gang (the "
+    "straggler gauge: rank skew at a glance); retracted when the "
+    "worker dies",
+    tag_keys=("node_id", "trial", "rank"),
+)
+TRAIN_REPORTS_TOTAL = Counter(
+    "ray_tpu_train_reports_total",
+    "session.report calls per trial (all ranks)",
+    tag_keys=("node_id", "trial"),
+)
+TRAIN_DOWNTIME_SECONDS = Counter(
+    "ray_tpu_train_downtime_seconds_total",
+    "Non-productive trial wall seconds attributed by the trainer's "
+    "downtime ledger (cause: drain:<reason> / preemption / failure)",
+    tag_keys=("node_id", "trial", "cause"),
+)
+TRAIN_EVENTS_DROPPED = Counter(
+    "ray_tpu_train_events_dropped_total",
+    "Goodput observations discarded by a worker's bounded ship buffer "
+    "before the event flusher drained them (no silent caps)",
+    tag_keys=("node_id",),
+)
+
 # -- RPC plane (client-side; one increment per reconnect attempt a
 # retry-windowed call makes after losing its connection — a reconnect
 # storm against one peer is visible on the federated scrape).
