@@ -194,8 +194,10 @@ class ClusterBackend:
         self._ref_cv = threading.Condition(self._ref_lock)
         # Serializes flush I/O: flush_refs() must not return while another
         # thread's ref_update RPC is still in flight (borrower-handoff
-        # ordering depends on add-before-task-end).
-        self._flush_io_lock = threading.Lock()
+        # ordering depends on add-before-task-end). Holding it across
+        # the RPC is this lock's entire job — nothing else contends it
+        # except a concurrent flush, which must wait anyway.
+        self._flush_io_lock = threading.Lock()  # analyze: allow-blocking
         self._closed = False
         threading.Thread(target=self._ref_flush_loop, daemon=True).start()
         # Pipelined submission (direct_task_transport.h:57 in spirit):

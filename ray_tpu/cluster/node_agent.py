@@ -2213,11 +2213,16 @@ class NodeAgent:
         """Move cold, unreferenced primary copies to disk until
         ``bytes_needed`` arena bytes are freed. Returns bytes freed
         (local_object_manager.h:110,122 / SpillObjects analog)."""
+        # Ask the head for this node's directory slice BEFORE taking the
+        # spill lock: a slow/partitioned head (60s socket) must not wedge
+        # every other thread waiting to spill (memory monitor, puts
+        # under pressure). Staleness is already tolerated — each
+        # candidate is re-checked against the live store under the lock.
+        try:
+            oids = self.head.call("objects_on_node", self.node_id)
+        except Exception:
+            oids = []
         with self._spill_lock:
-            try:
-                oids = self.head.call("objects_on_node", self.node_id)
-            except Exception:
-                oids = []
             cands = []
             for oid in oids:
                 try:

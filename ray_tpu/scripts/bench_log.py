@@ -26,6 +26,7 @@ FILENAME = "BENCH_TPU_SESSIONS.jsonl"
 KNOWN_BENCHES = frozenset({
     "task_overhead", "memory_pressure", "chaos_soak", "scalebench",
     "drain_recovery_ms", "serve_latency", "input_pipeline", "goodput",
+    "analyze",
 })
 
 
@@ -254,6 +255,32 @@ def record_goodput(*, trial: str, goodput_pct: float, wall_s: float,
     return entry
 
 
+def record_analyze(*, rule_counts: dict, new: int, baselined: int,
+                   ok: bool, stale_baseline: int = 0,
+                   device: str = "", path: str | None = None,
+                   **extra) -> dict:
+    """Static-analysis gate evidence (``scripts/analyze.py --out``, the
+    perfsuite `analyze` stage): per-rule finding counts, how many are
+    baselined vs NEW, and the gate verdict — so an on-chip perf session
+    also records that its tree passed the concurrency/contract gate
+    (rule-count trends live in MICROBENCH.json's `analyze` section;
+    this line is the timestamped trail). Committed to the evidence
+    trail only on an accelerator; returns the entry (with
+    ``committed_to``) either way."""
+    entry: dict = {
+        "bench": "analyze",
+        "device": device,
+        "rule_counts": dict(rule_counts),
+        "new": int(new),
+        "baselined": int(baselined),
+        "stale_baseline": int(stale_baseline),
+        "ok": bool(ok),
+    }
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
 def record_scalebench(*, scalability: dict | None = None,
                       head_scale: dict | None = None,
                       device: str = "", path: str | None = None,
@@ -379,6 +406,19 @@ def check_line(obj: object, *, allow_header: bool = False) -> list[str]:
             if not isinstance(obj.get("by_cause"), dict):
                 errs.append("goodput line missing by_cause attribution "
                             "dict")
+        elif obj["bench"] == "analyze":
+            # The gate line must carry the verdict AND the per-rule
+            # breakdown: a bare "analyze ran" claim with no counts is
+            # exactly the unreviewable evidence this lint exists to
+            # prevent.
+            if not isinstance(obj.get("rule_counts"), dict):
+                errs.append("analyze line missing rule_counts dict")
+            if not _is_num(obj.get("new")):
+                errs.append("analyze line missing numeric 'new' "
+                            "finding count")
+            if not isinstance(obj.get("ok"), bool):
+                errs.append("analyze line missing boolean 'ok' gate "
+                            "verdict")
         elif obj["bench"] == "serve_latency":
             # A serve latency line must carry both views AND the
             # agreement verdict — a client-only (or server-only) number

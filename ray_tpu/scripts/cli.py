@@ -606,6 +606,9 @@ def cmd_up(args):
 
 
 def main(argv=None):
+    import sys as _sys
+
+    argv = list(_sys.argv[1:]) if argv is None else list(argv)
     parser = argparse.ArgumentParser(prog="ray-tpu")
     parser.add_argument("--address", default=None,
                         help="cluster head host:port (default: local)")
@@ -776,7 +779,25 @@ def main(argv=None):
     p.add_argument("--port", type=int, default=10001)
     p.set_defaults(fn=cmd_client_server)
 
-    args = parser.parse_args(argv)
+    p = sub.add_parser(
+        "analyze",
+        help="concurrency & contract static analysis (lock order, "
+             "blocking-under-lock, finalizer safety, async-holding-"
+             "lock, failpoint/metric contract drift); exits 1 on any "
+             "unbaselined finding")
+    p.set_defaults(fn=None)
+
+    # `analyze` forwards its whole tail verbatim to the analyzer's own
+    # parser: parse_known_args lets the main parser consume the global
+    # flags (wherever they sit) and leaves the analyzer's flags/paths
+    # in `rest` — no hardcoded list of value-taking globals.
+    args, rest = parser.parse_known_args(argv)
+    if args.command == "analyze":
+        from ray_tpu.scripts.analyze import main as analyze_main
+
+        raise SystemExit(analyze_main(rest))
+    if rest:
+        parser.error(f"unrecognized arguments: {' '.join(rest)}")
     args.fn(args)
 
 

@@ -166,6 +166,11 @@ def main() -> None:
                     help="parked-queue audit depth for scalebench")
     ap.add_argument("--skip-head-scale", action="store_true")
     ap.add_argument("--skip-pipeline", action="store_true")
+    ap.add_argument("--skip-analyze", action="store_true",
+                    help="skip the static-analysis gate stage (runs by "
+                         "default: cheap, and a perf artifact from a "
+                         "tree with unbaselined concurrency findings "
+                         "is not evidence)")
     ap.add_argument("--fused-norm", action="store_true",
                     help="add the fused-norm kernel microbench point "
                          "(CPU interpret shape coverage + op counts)")
@@ -184,7 +189,14 @@ def main() -> None:
     # leaked cluster state between stages) and jax platform independence
     # (pipeline_bench forces cpu).
     env = dict(os.environ)
-    steps = [
+    steps = []
+    if not args.skip_analyze:
+        # Gate first: rule counts land in the artifact's `analyze`
+        # section (merge-preserve), and an unbaselined finding fails
+        # the whole suite before any bench burns time.
+        steps.append([sys.executable, "-m", "ray_tpu.scripts.analyze",
+                      "--out", args.out])
+    steps += [
         [sys.executable, "-m", "ray_tpu.scripts.microbench",
          "--out", args.out],
         [sys.executable, "-m", "ray_tpu.scripts.scalebench",

@@ -1,0 +1,58 @@
+"""Concurrency & contract static analysis (``ray-tpu analyze``).
+
+The framework's worst shipped bugs were never logic errors — they were
+concurrency-contract violations found only at runtime: the PR-5
+GC-finalizer deadlock (a non-reentrant lock reachable from ``__del__``/
+``weakref.finalize`` callbacks wedged the whole local backend), the
+lock-order discipline the round-6 head shard split could only *document*
+in a comment, and blocking RPC/sqlite work under a shard lock that
+serialized the control plane. This package turns those postmortems into
+AST-level passes that run in tier-1, the same way ``bench_log --check``
+turned evidence hygiene into a gate.
+
+Passes (rule-id prefix):
+
+* ``lock-order`` (LO/GB) — lock acquisition partial order against the
+  declared ``LOCK_ORDER`` tuple + discovered nesting; non-reentrant
+  same-lock re-entry; ``# guarded-by: <lock>`` declared-intent checks.
+* ``blocking`` (BL) — RPC calls, thread joins / future results, event
+  waits, sleeps and sqlite commits inside a lock's critical section.
+* ``finalizer`` (FS) — code reachable from ``__del__`` / ``weakref
+  .finalize`` callbacks must only take RLock-protocol locks and must
+  never make RPC calls (the PR-5 deadlock, now a rule).
+* ``async-lock`` (AH) — ``await`` / blocking calls while a sync lock is
+  held inside ``async def`` (the serve/router path bug class).
+* ``contracts`` (CD) — every ``failpoints.hit(site)`` registered in
+  ``failpoints.SITES``; every metric family emitted with exactly its
+  declared tag keys and declared in the (grafana-feeding) registry;
+  two-sided recorders observing locally AND buffering for replay.
+
+Heuristic and precise-by-allowlist rather than sound-and-noisy: the
+committed ``ANALYZE_BASELINE.json`` allowlists justified findings so
+only *new* violations fail; in-code pragmas
+(``# analyze: allow-blocking(<why>)`` on a lock declaration,
+``# analyze: ignore[RULE]`` on a finding line) record intent next to
+the code they bless.
+
+Entry points: ``ray-tpu analyze [--rule ...] [--baseline] [--json]
+[--diff REV]`` and ``python -m ray_tpu.scripts.analyze``; the repo-wide
+run is asserted clean by ``tests/test_static_analysis.py``.
+"""
+
+from ray_tpu.util.analyze.core import (  # noqa: F401
+    Finding,
+    PASSES,
+    analysis_pass,
+    default_paths,
+    load_baseline,
+    run,
+    run_paths,
+)
+
+# Importing the pass modules registers them with the PASSES registry.
+from ray_tpu.util.analyze import (  # noqa: F401,E402
+    blocking,
+    contracts,
+    finalizers,
+    lock_order,
+)

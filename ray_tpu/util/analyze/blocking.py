@@ -1,0 +1,139 @@
+"""Pass 2 — blocking-under-lock; pass 4 — await/blocking in async defs.
+
+The TPU-concurrency-limits observation applies verbatim to the head:
+host-side serialization is what caps pod-scale throughput, so an RPC or
+sqlite commit inside a shard lock's critical section is a *performance*
+bug even before it's a hang risk (the exact shape PR-6 spent a round
+unwinding).
+
+Blocking rules (fire only while a resolved lock is held; a lock whose
+declaration carries ``# analyze: allow-blocking`` — a dedicated I/O
+mutex like the persistent store's sqlite connection lock — is exempt):
+
+* **BL001** — RPC (``.call`` / ``.call_stream``) under a lock.
+* **BL002** — ``time.sleep`` under a lock.
+* **BL003** — ``Thread.join`` / ``Future.result`` under a lock.
+* **BL004** — ``Event.wait`` (or a Condition wait that does NOT release
+  the held lock) under a lock.
+* **BL005** — sqlite/db ``commit`` under a lock.
+
+Async rules (inside ``async def`` — the serve/router path bug class:
+a sync lock held across a suspension point blocks every other coroutine
+on the loop AND every thread contending the lock):
+
+* **AH001** — ``await`` while a sync ``threading`` lock is held.
+* **AH002** — a known blocking call while a sync lock is held in a
+  coroutine (double trouble: stalls the loop and the lock).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ray_tpu.util.analyze.core import (
+    Finding,
+    FindingSink,
+    ParsedModule,
+    analysis_pass,
+)
+from ray_tpu.util.analyze.resolver import FunctionContext, iter_events
+
+import ast
+
+_BLOCK_RULE = {
+    "rpc": ("BL001", "an RPC round-trip"),
+    "sleep": ("BL002", "a sleep"),
+    "join": ("BL003", "a thread join"),
+    "future": ("BL003", "a future result wait"),
+    "wait": ("BL004", "an event/condition wait"),
+    "sqlite": ("BL005", "a sqlite commit"),
+}
+
+
+def _effective_held(held):
+    """Locks the finding charges: allow-blocking locks are exempt."""
+    return [h for h in held if not h.info.allow_blocking]
+
+
+@analysis_pass("blocking")
+def blocking_pass(mod: ParsedModule) -> List[Finding]:
+    model = mod.model()
+    sink = FindingSink(mod.relpath)
+    emit = sink.emit
+
+    for cm, fn, scope in model.functions():
+        if isinstance(fn, ast.AsyncFunctionDef):
+            continue  # pass 4's jurisdiction
+        ctx = FunctionContext(model, cm)
+        for ev in iter_events(fn, ctx):
+            if ev.kind == "blocking":
+                held = _effective_held(ev.held)
+                if not held:
+                    continue
+                kind, detail = ev.data
+                rule, what = _BLOCK_RULE[kind]
+                lock = held[-1]
+                emit(rule, ev.node.lineno, scope,
+                     f"{kind}:{lock.name}",
+                     f"{what} ({detail}) inside the critical section "
+                     f"of {lock.qualname}: every thread contending "
+                     f"this lock serializes behind the wait",
+                     "move the blocking work outside the lock (snapshot "
+                     "under the lock, act after release), or mark a "
+                     "dedicated I/O mutex with "
+                     "`# analyze: allow-blocking`")
+            elif ev.kind == "self_call" and ev.held and cm is not None:
+                held = _effective_held(ev.held)
+                if not held:
+                    continue
+                summary = model.summaries_for(cm).get(ev.data)
+                if summary is None:
+                    continue
+                lock = held[-1]
+                for kind, detail, hline in summary.blocking:
+                    rule, what = _BLOCK_RULE[kind]
+                    emit(rule, ev.node.lineno, scope,
+                         f"{kind}:{lock.name}:via:{ev.data}",
+                         f"{what} ({detail}, inside self.{ev.data}() "
+                         f"at line {hline}) runs under {lock.qualname} "
+                         f"held here",
+                         "hoist the helper call out of the critical "
+                         "section or split the helper")
+    return sink.findings
+
+
+@analysis_pass("async-lock")
+def async_lock_pass(mod: ParsedModule) -> List[Finding]:
+    model = mod.model()
+    sink = FindingSink(mod.relpath)
+    emit = sink.emit
+
+    for cm, fn, scope in model.functions():
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        ctx = FunctionContext(model, cm)
+        for ev in iter_events(fn, ctx):
+            held = _effective_held(ev.held)
+            if not held:
+                continue
+            lock = held[-1]
+            if ev.kind == "await":
+                emit("AH001", ev.node.lineno, scope,
+                     f"await:{lock.name}",
+                     f"await while holding sync lock {lock.qualname}: "
+                     f"the coroutine suspends with the lock held — "
+                     f"every thread AND every other coroutine touching "
+                     f"it stalls (the PR-8 span-restore bug class)",
+                     "release the lock before awaiting (snapshot state "
+                     "under it), or use an asyncio.Lock for "
+                     "loop-internal state")
+            elif ev.kind == "blocking":
+                kind, detail = ev.data
+                emit("AH002", ev.node.lineno, scope,
+                     f"{kind}:{lock.name}",
+                     f"blocking call ({detail}) while holding "
+                     f"{lock.qualname} inside a coroutine: stalls the "
+                     f"event loop and the lock at once",
+                     "run the blocking work in an executor after "
+                     "releasing the lock")
+    return sink.findings

@@ -1,0 +1,309 @@
+"""Pass 5 — contract drift: failpoints, metric families, recorders.
+
+Three contracts that previously lived only in convention:
+
+* **CD001** — every ``failpoints.hit("<site>")`` literal must be
+  registered in ``ray_tpu.util.failpoints.SITES``. A site that isn't
+  in the table is invisible to ``ray-tpu chaos list``, to the soak
+  schedule, and to anyone deciding what chaos coverage exists.
+* **CD003** — every metric emission with a literal ``tags={...}`` must
+  carry *exactly* the family's declared tag keys. A missing key raises
+  ``ValueError`` at runtime; an extra key is silently dropped by
+  ``Metric._key`` — a typo'd tag name loses the dimension with no
+  error anywhere (the federation-breaking drift class).
+* **CD004** — an UPPERCASE attribute read off the metrics module that
+  names no registered family: AttributeError at runtime, and the
+  registry-driven grafana dashboard can never have a panel for it.
+* **CD005/CD006** — two-sided recorder discipline (the serve/train/
+  goodput planes): a module that ships observations over the
+  worker-events plane (defines ``drain_events`` + ``apply_events``)
+  must do ALL local recording through its ``_emit`` (observe locally
+  AND buffer for replay); a function that calls a family directly
+  records one-sided — the cluster backend's federated scrape silently
+  loses those observations (CD005). ``_emit`` itself must do both
+  sides (CD006).
+
+The family/site tables come from the live registry (``ray_tpu.util
+.metrics`` / ``ray_tpu.util.failpoints``) — the same source the
+grafana generator and the chaos CLI read, so the checked contract and
+the served contract cannot diverge.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.util.analyze.core import Finding, ParsedModule, analysis_pass
+
+_EMIT_METHODS = frozenset({"inc", "dec", "set", "observe", "remove"})
+_METRIC_ALIASES = frozenset({"metrics", "_metrics"})
+
+_tables_cache: Optional[tuple] = None
+
+
+def _tables() -> Tuple[Dict[str, tuple], frozenset]:
+    """({family attr: declared tag keys}, registered failpoint sites)
+    from the live modules — loaded once."""
+    global _tables_cache
+    if _tables_cache is None:
+        from ray_tpu.util import failpoints
+        from ray_tpu.util import metrics as m
+
+        families = {
+            name: tuple(inst.tag_keys)
+            for name, inst in vars(m).items()
+            if isinstance(inst, m.Metric)
+        }
+        sites = frozenset(getattr(failpoints, "SITES", frozenset()))
+        _tables_cache = (families, sites)
+    return _tables_cache
+
+
+def _family_ref(expr: ast.expr,
+                imported: Dict[str, str]) -> Optional[Tuple[str, bool]]:
+    """(family attr name, via-module-alias) when the expression reads a
+    metric family: ``_metrics.FAMILY`` / ``metrics.FAMILY`` or a bare
+    name imported from the metrics module."""
+    if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name):
+        if expr.value.id in _METRIC_ALIASES and expr.attr.isupper():
+            return (expr.attr, True)
+        return None
+    if isinstance(expr, ast.Name) and expr.id in imported:
+        return (expr.id, False)
+    return None
+
+
+def _metric_imports(tree: ast.Module) -> Dict[str, str]:
+    """Names from-imported out of ray_tpu.util.metrics (pubsub.py
+    idiom) mapped to the original attr name."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.endswith("util.metrics"):
+            for alias in node.names:
+                if alias.name.isupper():
+                    out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def _literal_tag_keys(call: ast.Call,
+                      method: str) -> Optional[Tuple[str, ...]]:
+    """The literal tag keys this emission passes, () for an explicit
+    no-tags call, or None when the tags are dynamic (unknowable)."""
+    tags_expr = None
+    for kw in call.keywords:
+        if kw.arg == "tags":
+            tags_expr = kw.value
+            break
+    if tags_expr is None:
+        idx = 0 if method == "remove" else 1
+        if len(call.args) > idx:
+            tags_expr = call.args[idx]
+    if tags_expr is None:
+        return ()
+    if isinstance(tags_expr, ast.Constant) and tags_expr.value is None:
+        return ()
+    if not isinstance(tags_expr, ast.Dict):
+        return None
+    keys: List[str] = []
+    for k in tags_expr.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.append(k.value)
+        else:
+            return None  # dynamic key: unknowable
+    return tuple(keys)
+
+
+def _scope_of(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> str:
+    path: List[str] = []
+    cur: Optional[ast.AST] = node
+    while cur is not None and not isinstance(cur, ast.Module):
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            path.append(cur.name)
+        cur = parents.get(cur)
+    return ".".join(reversed(path)) or "<module>"
+
+
+def _hit_site_literals(tree: ast.Module) -> List[str]:
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "hit"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "failpoints"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.append(node.args[0].value)
+    return out
+
+
+def stale_site_findings(modules) -> List[Finding]:
+    """**CD002** — the reverse of CD001, checkable only with the whole
+    tree in view (so it runs from ``analyze.run()`` on full scans, not
+    per-module): a site registered in ``failpoints.SITES`` that no
+    scanned file hits advertises chaos coverage that no longer exists
+    — the same one-direction drift the stale-baseline report closes
+    for the allowlist."""
+    _, sites = _tables()
+    hits: set = set()
+    fp_mod = None
+    for mod in modules:
+        if mod.relpath.endswith("util/failpoints.py"):
+            fp_mod = mod
+            continue  # the docstring example is not a real site
+        hits.update(_hit_site_literals(mod.tree))
+    findings: List[Finding] = []
+    for site in sorted(sites - hits):
+        line = 1
+        if fp_mod is not None:
+            for i, text in enumerate(fp_mod.lines, 1):
+                if f'"{site}"' in text:
+                    line = i
+                    break
+        findings.append(Finding(
+            "CD002", "ray_tpu/util/failpoints.py", line, "<module>",
+            site,
+            f"failpoints.SITES registers {site!r} but no scanned file "
+            f"hits it — the table advertises chaos coverage that no "
+            f"longer exists",
+            "remove the stale SITES entry (or restore the hit() site)"))
+    return findings
+
+
+@analysis_pass("contracts")
+def contracts_pass(mod: ParsedModule) -> List[Finding]:
+    families, sites = _tables()
+    findings: List[Finding] = []
+    imported = _metric_imports(mod.tree)
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    is_failpoints_module = mod.relpath.endswith("util/failpoints.py")
+    is_metrics_module = mod.relpath.endswith("util/metrics.py")
+
+    # Two-sided recorder discovery: ships (drain_events) and replays
+    # (apply_events) — then every local observation must ride _emit.
+    top_funcs = {s.name: s for s in mod.tree.body
+                 if isinstance(s, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))}
+    is_recorder = ("drain_events" in top_funcs
+                   and "apply_events" in top_funcs
+                   and not is_metrics_module)
+    recorder_allowed = {"apply_events", "retract_gauges"}
+
+    if is_recorder:
+        emit_fn = top_funcs.get("_emit")
+        if emit_fn is None:
+            findings.append(Finding(
+                "CD006", mod.relpath, 1, "<module>", "_emit",
+                "two-sided recorder module (defines drain_events + "
+                "apply_events) has no _emit: nothing enforces that "
+                "observations land locally AND in the ship buffer",
+                "add _emit(ev) that calls apply_events([ev], ...) and "
+                "appends to the ship buffer"))
+        else:
+            names = {n.id for n in ast.walk(emit_fn)
+                     if isinstance(n, ast.Name)}
+            attrs = {n.attr for n in ast.walk(emit_fn)
+                     if isinstance(n, ast.Attribute)}
+            observes = "apply_events" in names
+            buffers = any(x.startswith("_buf") for x in names | attrs)
+            if not (observes and buffers):
+                missing = ("local observe (apply_events call)"
+                           if not observes else
+                           "ship-buffer append (_buf)")
+                findings.append(Finding(
+                    "CD006", mod.relpath, emit_fn.lineno, "_emit",
+                    "two-sided",
+                    f"recorder _emit is one-sided: missing the "
+                    f"{missing} half — observations will exist on one "
+                    f"backend and silently not the other",
+                    "observe into the local registry AND buffer for "
+                    "the worker-events replay in the same _emit"))
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # -- failpoint sites ------------------------------------------
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == "hit"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "failpoints"
+                and not is_failpoints_module):
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                site = node.args[0].value
+                if site not in sites:
+                    findings.append(Finding(
+                        "CD001", mod.relpath, node.lineno,
+                        _scope_of(node, parents), site,
+                        f"failpoint site {site!r} is not registered in "
+                        f"failpoints.SITES — invisible to `ray-tpu "
+                        f"chaos list`, the soak schedule and chaos "
+                        f"coverage review",
+                        "add the site to SITES in "
+                        "ray_tpu/util/failpoints.py"))
+            continue
+        # -- metric emissions -----------------------------------------
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in _EMIT_METHODS):
+            continue
+        ref = _family_ref(fn.value, imported)
+        if ref is None:
+            continue
+        attr, via_alias = ref
+        family = imported.get(attr, attr)
+        scope = _scope_of(node, parents)
+        if family not in families:
+            findings.append(Finding(
+                "CD004", mod.relpath, node.lineno, scope, family,
+                f"metric family {family} is not declared in the "
+                f"registry (ray_tpu/util/metrics.py) — AttributeError "
+                f"at runtime, and the registry-driven grafana "
+                f"dashboard can never panel it",
+                "declare the family in util/metrics.py (grafana panels "
+                "generate from the registry)"))
+            continue
+        declared = families[family]
+        passed = _literal_tag_keys(node, fn.attr)
+        if passed is not None and set(passed) != set(declared):
+            missing = sorted(set(declared) - set(passed))
+            extra = sorted(set(passed) - set(declared))
+            parts = []
+            if missing:
+                parts.append(f"missing {missing} (ValueError at "
+                             f"runtime)")
+            if extra:
+                parts.append(f"extra {extra} (silently dropped by "
+                             f"Metric._key — the dimension never "
+                             f"reaches the exposition)")
+            findings.append(Finding(
+                "CD003", mod.relpath, node.lineno, scope,
+                f"{family}:{','.join(sorted(passed))}",
+                f"emission of {family} with tag keys "
+                f"{sorted(passed)} != declared {sorted(declared)}: "
+                f"{'; '.join(parts)}",
+                "pass exactly the declared tag keys (or change the "
+                "declaration and the grafana legend with it)"))
+        if is_recorder:
+            leaf = scope.rsplit(".", 1)[-1] if scope else scope
+            root = scope.split(".", 1)[0]
+            if leaf not in recorder_allowed \
+                    and root not in recorder_allowed:
+                findings.append(Finding(
+                    "CD005", mod.relpath, node.lineno, scope, family,
+                    f"direct {family} emission in two-sided recorder "
+                    f"module outside apply_events/retract_gauges: this "
+                    f"observation is never buffered for the "
+                    f"worker-events replay, so the cluster backend's "
+                    f"federated scrape silently misses it",
+                    "route the observation through _emit so both sides "
+                    "record"))
+    return findings

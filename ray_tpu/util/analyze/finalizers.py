@@ -1,0 +1,164 @@
+"""Pass 3 — finalizer-safety: the PR-5 GC-deadlock class, as a rule.
+
+``__del__`` methods and ``weakref.finalize`` callbacks run from the
+garbage collector, which can fire on *whatever thread happens to be
+allocating* — including one already inside a critical section of the
+very lock the finalizer wants. PR 5 hit exactly this: ObjectRef
+finalizers calling ``_decref`` self-deadlocked the local backend when a
+GC pass fired inside ``_entry`` (building a ``threading.Event`` while
+holding the then non-reentrant ``_objects_lock``); the whole backend
+wedged behind one thread. Reproduced 3/3, diagnosed via faulthandler —
+now a static rule instead of a war story.
+
+Rules (checked over code reachable from a finalizer root through
+intra-class ``self.`` calls and module-level calls, three levels deep):
+
+* **FS001** — a non-reentrant ``threading.Lock`` acquired in
+  finalizer-reachable code: must be RLock-protocol, because the GC can
+  re-enter while the allocating thread holds it.
+* **FS002** — an RPC call (``.call`` / ``.call_stream``) in
+  finalizer-reachable code: a finalizer blocking on the network turns
+  any allocation into a potential multi-second stall (and a deadlock
+  when the RPC needs a lock the interrupted thread holds).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.util.analyze.core import (
+    Finding,
+    FindingSink,
+    ParsedModule,
+    analysis_pass,
+)
+from ray_tpu.util.analyze.resolver import (
+    FunctionContext,
+    callee_name,
+    iter_events,
+    receiver_of,
+)
+
+_MAX_DEPTH = 3
+
+
+def _finalize_callback(call: ast.Call) -> Optional[ast.expr]:
+    """The callback expr of a ``weakref.finalize(obj, cb, ...)`` call."""
+    name = callee_name(call)
+    if name != "finalize":
+        return None
+    recv = receiver_of(call)
+    if recv is not None and not (isinstance(recv, ast.Name)
+                                 and recv.id == "weakref"):
+        return None
+    if len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _callees(fn: ast.AST, module_funcs: Set[str]) -> Tuple[Set[str],
+                                                           Set[str]]:
+    """(self-method names, module-level function names) this function
+    calls anywhere in its body (nested defs included — a closure
+    defined in finalizer-reachable code may run there too)."""
+    self_calls: Set[str] = set()
+    mod_calls: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(
+                f.value, ast.Name) and f.value.id == "self":
+            self_calls.add(f.attr)
+        elif isinstance(f, ast.Name) and f.id in module_funcs:
+            mod_calls.add(f.id)
+    return self_calls, mod_calls
+
+
+@analysis_pass("finalizer")
+def finalizer_pass(mod: ParsedModule) -> List[Finding]:
+    model = mod.model()
+    funcs = model.functions()
+    # Index: (class name | "", function leaf name) -> (cm, fn, scope).
+    index: Dict[Tuple[str, str], tuple] = {}
+    module_funcs: Set[str] = set()
+    for cm, fn, scope in funcs:
+        owner = cm.name if cm is not None else ""
+        index.setdefault((owner, fn.name), (cm, fn, scope))
+        if cm is None and "." not in scope:
+            module_funcs.add(fn.name)
+
+    # Roots: __del__ methods + weakref.finalize callbacks. root_key is
+    # the stable per-root identity findings carry in their baseline key
+    # (two finalize callbacks in one class must never share a key).
+    roots: List[Tuple[str, str, str, str]] = []  # (owner, name, key, desc)
+    for cm, fn, scope in funcs:
+        if fn.name == "__del__" and cm is not None:
+            roots.append((cm.name, "__del__", f"{cm.name}.__del__",
+                          f"{cm.name}.__del__"))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            cb = _finalize_callback(node)
+            if cb is None:
+                continue
+            if (isinstance(cb, ast.Attribute)
+                    and isinstance(cb.value, ast.Name)
+                    and cb.value.id == "self" and cm is not None):
+                roots.append((cm.name, cb.attr,
+                              f"finalize.{cm.name}.{cb.attr}",
+                              f"weakref.finalize -> {cm.name}.{cb.attr}"
+                              f" ({mod.relpath}:{node.lineno})"))
+            elif isinstance(cb, ast.Name):
+                if cb.id in module_funcs:
+                    roots.append(("", cb.id, f"finalize.{cb.id}",
+                                  f"weakref.finalize -> {cb.id} "
+                                  f"({mod.relpath}:{node.lineno})"))
+
+    sink = FindingSink(mod.relpath)
+    emit = sink.emit
+
+    for owner, name, root_key, root_desc in roots:
+        # BFS through the call graph, bounded depth.
+        seen: Set[Tuple[str, str]] = set()
+        frontier = [(owner, name, 0)]
+        while frontier:
+            cur_owner, cur_name, depth = frontier.pop()
+            if (cur_owner, cur_name) in seen or depth > _MAX_DEPTH:
+                continue
+            seen.add((cur_owner, cur_name))
+            entry = index.get((cur_owner, cur_name))
+            if entry is None:
+                continue
+            cm, fn, scope = entry
+            ctx = FunctionContext(model, cm)
+            for ev in iter_events(fn, ctx):
+                if ev.kind == "acquire" \
+                        and ev.data.info.reentrant is False:
+                    emit("FS001", ev.node.lineno, scope,
+                         f"{ev.data.name}:{root_key}",
+                         f"non-reentrant lock {ev.data.qualname} "
+                         f"acquired in code reachable from finalizer "
+                         f"{root_desc}: a GC pass can fire the "
+                         f"finalizer on a thread already holding it — "
+                         f"the PR-5 self-deadlock",
+                         "make the lock RLock-protocol (threading.RLock "
+                         "or equivalent) or move the finalizer's work "
+                         "onto a queue drained outside GC")
+                elif ev.kind == "blocking" and ev.data[0] == "rpc":
+                    emit("FS002", ev.node.lineno, scope,
+                         f"rpc:{root_key}",
+                         f"RPC call in code reachable from finalizer "
+                         f"{root_desc}: finalizers run from GC on "
+                         f"arbitrary threads and must never block on "
+                         f"the network",
+                         "enqueue the work for a background flusher "
+                         "instead of calling out of the finalizer")
+            sc, mc = _callees(fn, module_funcs)
+            for callee in sc:
+                if cur_owner:
+                    frontier.append((cur_owner, callee, depth + 1))
+            for callee in mc:
+                frontier.append(("", callee, depth + 1))
+    return sink.findings

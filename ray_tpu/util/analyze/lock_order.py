@@ -1,0 +1,234 @@
+"""Pass 1 — lock-order: acquisition order, re-entry, declared intent.
+
+Rules:
+
+* **LO001** — lock-order inversion against the module's declared
+  ``LOCK_ORDER`` tuple (head.py commits ``("_lock", "_obj_lock",
+  "_event_lock")``): acquiring an earlier-ranked lock while holding a
+  later-ranked one is the deadlock shape the round-6 shard split could
+  only document in prose.
+* **LO002** — same-lock re-entry where the lock is a non-reentrant
+  ``threading.Lock`` (directly nested ``with``, through a Condition
+  alias, or via a helper called one level deep under the lock).
+* **LO003** — inconsistent discovered order: the same two locks are
+  nested in both directions somewhere in the module (a latent ABBA
+  deadlock even when no order was declared for them).
+* **LO004** — ``LOCK_ORDER`` drift: the declared tuple names a lock no
+  class in the module defines (the machine-readable order and the code
+  have diverged).
+* **GB001** — a ``# guarded-by: <lock>`` annotated attribute is
+  mutated without its declared lock held (init-time writes exempt;
+  private helpers whose every intra-class call site holds the lock are
+  treated as guarded by their callers).
+* **GB002** — a ``# guarded-by:`` annotation names a lock the class
+  does not define (declared intent that can't be checked is drift).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ray_tpu.util.analyze.core import (
+    Finding,
+    FindingSink,
+    ParsedModule,
+    analysis_pass,
+)
+from ray_tpu.util.analyze.resolver import (
+    ClassModel,
+    FunctionContext,
+    ModuleModel,
+    iter_events,
+)
+
+
+def _is_private_helper(name: str) -> bool:
+    return name.startswith("_") and not name.startswith("__")
+
+
+@analysis_pass("lock-order")
+def lock_order_pass(mod: ParsedModule) -> List[Finding]:
+    model = mod.model()
+    sink = FindingSink(mod.relpath)
+    emit = sink.emit
+    order_idx = {n: i for i, n in enumerate(model.lock_order)}
+
+    if model.lock_order:
+        defined = set()
+        for cls in model.classes.values():
+            defined |= set(cls.locks)
+        for name in model.lock_order:
+            if name not in defined:
+                emit("LO004", 1, "<module>", name,
+                     f"LOCK_ORDER names {name!r} but no class in this "
+                     f"module defines that lock — the declared order "
+                     f"and the code have drifted",
+                     "update LOCK_ORDER to match the live shard locks")
+
+    # Aggregated per lock-owner: (outer, inner) -> first (line, scope).
+    edges: Dict[str, Dict[Tuple[str, str], Tuple[int, str]]] = {}
+
+    def note_nesting(owner: str, outer, inner, line, scope, via=""):
+        suffix = f" (via {via})" if via else ""
+        if outer.qualname == inner.qualname:
+            if inner.info.reentrant is False:
+                emit("LO002", line, scope, inner.name,
+                     f"re-entry on non-reentrant lock "
+                     f"{inner.qualname}{suffix}: this thread already "
+                     f"holds it — threading.Lock self-deadlocks",
+                     "make the lock an RLock (or restructure so the "
+                     "critical sections don't nest)")
+            return
+        edges.setdefault(owner, {}).setdefault(
+            (outer.name, inner.name), (line, scope))
+        oi = order_idx.get(outer.name)
+        ii = order_idx.get(inner.name)
+        if oi is not None and ii is not None and oi > ii:
+            emit("LO001", line, scope, f"{outer.name}->{inner.name}",
+                 f"lock-order inversion: acquiring {inner.qualname} "
+                 f"while holding {outer.qualname}{suffix} inverts the "
+                 f"declared LOCK_ORDER "
+                 f"({' -> '.join(model.lock_order)})",
+                 "acquire the locks in declared order, or hoist the "
+                 "earlier lock's work out of the later lock's critical "
+                 "section")
+
+    for cm, fn, scope in model.functions():
+        ctx = FunctionContext(model, cm)
+        owner = cm.name if cm is not None else "<module>"
+        for ev in iter_events(fn, ctx):
+            if ev.kind == "acquire":
+                for h in ev.held:
+                    note_nesting(owner, h, ev.data, ev.node.lineno,
+                                 scope)
+            elif ev.kind == "self_call" and ev.held and cm is not None:
+                summary = model.summaries_for(cm).get(ev.data)
+                if summary is None:
+                    continue
+                for inner, _hline in summary.acquires:
+                    for h in ev.held:
+                        note_nesting(owner, h, inner, ev.node.lineno,
+                                     scope, via=f"self.{ev.data}()")
+
+    for owner, table in sorted(edges.items()):
+        for (a, b), (line, scope) in sorted(table.items()):
+            if (b, a) in table and a < b \
+                    and not (a in order_idx and b in order_idx):
+                other_line, other_scope = table[(b, a)]
+                emit("LO003", line, scope, f"{a}<->{b}",
+                     f"inconsistent lock order in {owner}: {a} -> {b} "
+                     f"here but {b} -> {a} at {mod.relpath}:"
+                     f"{other_line} ({other_scope}) — a latent ABBA "
+                     f"deadlock",
+                     "pick one order for the pair and add it to "
+                     "LOCK_ORDER so the analyzer enforces it")
+
+    for cls in model.classes.values():
+        sink.findings.extend(_guarded_by_findings(mod, model, cls))
+    return sink.findings
+
+
+def _guaranteed_held(cls: ClassModel,
+                     call_sites: Dict[str, List[Tuple[str, frozenset]]],
+                     closure_leafs: frozenset = frozenset()
+                     ) -> Dict[str, frozenset]:
+    """Locks every execution of a private helper provably runs under:
+    the meet over its intra-class call sites of (locks held at the
+    site) ∪ (locks the CALLER is itself guaranteed) — a small fixpoint
+    so ``rpc_schedule_batch -> _schedule_locked -> _pick`` chains carry
+    the lock two levels down. Self-recursive sites are skipped (the
+    recursive call inherits whatever the outer call proved). Public
+    methods are entry points: nothing is guaranteed for them; closures
+    (any name) qualify — their only callers are in this class by
+    construction, and one passed solely as a Thread target has no call
+    sites, so nothing is guaranteed and its body must lock for
+    itself."""
+    universe = frozenset(cls.locks)
+    guaranteed: Dict[str, frozenset] = {}
+    for name in call_sites:
+        if name in closure_leafs or (
+                _is_private_helper(name) and name in cls.methods):
+            guaranteed[name] = universe
+    for _ in range(10):
+        changed = False
+        for name in guaranteed:
+            sites = [(c, held) for c, held in call_sites[name]
+                     if c != name]
+            if not sites:
+                new = frozenset()
+            else:
+                new = universe
+                for caller, held in sites:
+                    new &= held | guaranteed.get(caller, frozenset())
+            if new != guaranteed[name]:
+                guaranteed[name] = new
+                changed = True
+        if not changed:
+            break
+    return guaranteed
+
+
+def _guarded_by_findings(mod: ParsedModule, model: ModuleModel,
+                         cls: ClassModel) -> List[Finding]:
+    findings: List[Finding] = []
+    if not cls.guarded_by:
+        return findings
+    for attr, lockname in sorted(cls.guarded_by.items()):
+        if lockname not in cls.locks \
+                and lockname not in model.module_locks:
+            findings.append(Finding(
+                "GB002", mod.relpath,
+                cls.node.lineno, cls.name, attr,
+                f"# guarded-by: {lockname} on {cls.name}.{attr} names "
+                f"a lock this class does not define",
+                "annotate with the real lock attribute name"))
+
+    # method/closure name -> (caller leaf, held-lock names) at every
+    # intra-class call site (callers-hold-the-lock inference). Bare
+    # local_call names count too: a closure defined AND invoked inside
+    # a critical section is guarded by its call site, not its own body.
+    call_sites: Dict[str, List[Tuple[str, frozenset]]] = {}
+    mutations: List[Tuple[str, str, ast.AST, set]] = []
+    closure_leafs: set = set()
+    for cm, fn, scope in model.functions():
+        if cm is None or cm.name != cls.name:
+            continue
+        if fn.name not in cls.methods:
+            closure_leafs.add(fn.name)
+        ctx = FunctionContext(model, cm)
+        caller_leaf = scope.rsplit(".", 1)[-1]
+        for ev in iter_events(fn, ctx):
+            held_names = {h.name for h in ev.held}
+            if ev.kind in ("self_call", "local_call"):
+                call_sites.setdefault(ev.data, []).append(
+                    (caller_leaf, frozenset(held_names)))
+            elif ev.kind == "mutate" and ev.data in cls.guarded_by:
+                mutations.append((scope, ev.data, ev.node, held_names))
+
+    guaranteed = _guaranteed_held(cls, call_sites, closure_leafs)
+
+    emitted: set = set()
+    for scope, attr, node, held_names in mutations:
+        leaf = scope.rsplit(".", 1)[-1]
+        if leaf == "__init__":
+            continue
+        lockname = cls.guarded_by[attr]
+        if lockname not in cls.locks \
+                and lockname not in model.module_locks:
+            continue  # GB002 already reported
+        if lockname in held_names:
+            continue
+        if lockname in guaranteed.get(leaf, frozenset()):
+            continue  # every (transitive) caller holds the lock
+        ident = (scope, attr, node.lineno)
+        if ident in emitted:
+            continue
+        emitted.add(ident)
+        findings.append(Finding(
+            "GB001", mod.relpath, node.lineno, scope, attr,
+            f"{cls.name}.{attr} is declared guarded-by {lockname} but "
+            f"is mutated here without it held",
+            f"take `with self.{lockname}:` around the mutation (or fix "
+            f"the guarded-by annotation if intent changed)"))
+    return findings

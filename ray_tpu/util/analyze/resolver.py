@@ -1,0 +1,580 @@
+"""Shared guarded-by / lock-alias resolver for the concurrency passes.
+
+Heuristic by design (and precise-by-allowlist): it tracks the idioms
+this codebase actually uses —
+
+* ``self._lock = threading.Lock() / RLock() / _ShardLock(...)`` lock
+  attributes (reentrancy from the factory name);
+* ``self._cv = threading.Condition(self._lock)`` aliases: acquiring the
+  condition acquires the underlying lock, and ``cv.wait()`` *releases*
+  it (so a wait under its own lock is not blocking-under-lock);
+* module-level locks (``_buf_lock = threading.Lock()``);
+* ``with self._lock:`` critical sections, nested and multi-item;
+* helper calls one level deep: ``self._helper()`` under a lock imports
+  the helper's own acquisitions and blocking calls to the call site;
+* ``# guarded-by: <lock>`` annotations on attribute declarations
+  (declared intent for pass 1) and ``# analyze: allow-blocking`` on a
+  lock declaration (this lock's entire job is serializing the blocking
+  I/O under it — e.g. a dedicated sqlite connection mutex).
+
+Anything it cannot resolve it stays silent about: an unrecognized
+context manager is not a lock, an unrecognized receiver is not a
+thread, and manual ``lock.acquire()``/``release()`` pairing is out of
+scope (this codebase's critical sections are ``with`` blocks). False
+negatives are acceptable; false positives go to the baseline with a
+justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+# Factory callables recognized as lock constructors: name -> reentrant.
+LOCK_FACTORIES = {
+    "Lock": False,
+    "RLock": True,
+    "_ShardLock": True,  # head.py: RLock-protocol instrumented shard
+    "ShardLock": True,
+}
+
+# Methods that mutate a container in place (guarded-by writes).
+MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popitem", "popleft", "clear", "update",
+    "setdefault", "move_to_end", "rotate",
+})
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+_ALLOW_BLOCKING_RE = re.compile(r"#\s*analyze:\s*allow-blocking")
+
+
+def callee_name(call: ast.Call) -> str:
+    """Trailing name of the called expression ('' when dynamic)."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def receiver_of(call: ast.Call) -> Optional[ast.expr]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.value
+    return None
+
+
+def _self_attr(expr: ast.expr) -> Optional[str]:
+    """'X' for a `self.X` expression, else None."""
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return expr.attr
+    return None
+
+
+class LockInfo:
+    __slots__ = ("name", "reentrant", "allow_blocking", "line", "owner")
+
+    def __init__(self, name: str, reentrant: Optional[bool], line: int,
+                 owner: str, allow_blocking: bool = False):
+        self.name = name
+        self.reentrant = reentrant  # None = unknown protocol
+        self.allow_blocking = allow_blocking
+        self.line = line
+        self.owner = owner  # "Class" or "" for module scope
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.owner}.{self.name}" if self.owner else self.name
+
+
+class ClassModel:
+    """Lock/alias/annotation facts for one class."""
+
+    def __init__(self, node: ast.ClassDef, lines: List[str]):
+        self.node = node
+        self.name = node.name
+        self.locks: Dict[str, LockInfo] = {}
+        self.conds: Dict[str, Optional[str]] = {}  # cv attr -> lock attr
+        self.events: set = set()  # threading.Event attrs
+        self.threads: set = set()  # threading.Thread attrs
+        self.guarded_by: Dict[str, str] = {}  # data attr -> lock name
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        for meth in self.methods.values():
+            for stmt in ast.walk(meth):
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    self._scan_assign(stmt, lines)
+
+    def _scan_assign(self, stmt, lines: List[str]) -> None:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        value = stmt.value
+        for tgt in targets:
+            attr = _self_attr(tgt)
+            if attr is None:
+                continue
+            text = lines[stmt.lineno - 1] if stmt.lineno <= len(lines) \
+                else ""
+            m = _GUARDED_BY_RE.search(text)
+            if m:
+                self.guarded_by.setdefault(attr, m.group(1))
+            if not isinstance(value, ast.Call):
+                continue
+            name = callee_name(value)
+            if name in LOCK_FACTORIES:
+                self.locks[attr] = LockInfo(
+                    attr, LOCK_FACTORIES[name], stmt.lineno, self.name,
+                    allow_blocking=bool(_ALLOW_BLOCKING_RE.search(text)))
+            elif name == "Condition":
+                arg = value.args[0] if value.args else None
+                under = _self_attr(arg) if arg is not None else None
+                if arg is None:
+                    # Condition() owns a fresh RLock: model the cv as a
+                    # reentrant lock in its own right.
+                    self.locks[attr] = LockInfo(
+                        attr, True, stmt.lineno, self.name,
+                        allow_blocking=bool(
+                            _ALLOW_BLOCKING_RE.search(text)))
+                    self.conds[attr] = attr
+                elif under is not None:
+                    self.conds[attr] = under
+            elif name == "Event":
+                self.events.add(attr)
+            elif name == "Thread":
+                self.threads.add(attr)
+
+
+class ModuleModel:
+    """Per-module lock facts: module-scope locks, classes, LOCK_ORDER.
+    Also caches the function walk and per-class method summaries so the
+    passes share one resolver pass per file."""
+
+    def __init__(self, tree: ast.Module, lines: List[str]):
+        self.tree = tree
+        self.lines = lines
+        self.module_locks: Dict[str, LockInfo] = {}
+        self.module_events: set = set()
+        self.classes: Dict[str, ClassModel] = {}
+        self.lock_order: Tuple[str, ...] = ()
+        self._functions = None
+        self._summaries: Dict[int, Dict[str, "MethodSummary"]] = {}
+        for stmt in tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self.classes[stmt.name] = ClassModel(stmt, lines)
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                self._scan_module_assign(stmt)
+
+    def _scan_module_assign(self, stmt) -> None:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        value = stmt.value
+        for tgt in targets:
+            if not isinstance(tgt, ast.Name):
+                continue
+            if tgt.id == "LOCK_ORDER" and isinstance(
+                    value, (ast.Tuple, ast.List)):
+                order = []
+                for elt in value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str):
+                        order.append(elt.value)
+                self.lock_order = tuple(order)
+                continue
+            if not isinstance(value, ast.Call):
+                continue
+            name = callee_name(value)
+            text = self.lines[stmt.lineno - 1] \
+                if stmt.lineno <= len(self.lines) else ""
+            if name in LOCK_FACTORIES:
+                self.module_locks[tgt.id] = LockInfo(
+                    tgt.id, LOCK_FACTORIES[name], stmt.lineno, "",
+                    allow_blocking=bool(_ALLOW_BLOCKING_RE.search(text)))
+            elif name == "Condition" and not value.args:
+                self.module_locks[tgt.id] = LockInfo(
+                    tgt.id, True, stmt.lineno, "")
+            elif name == "Event":
+                self.module_events.add(tgt.id)
+
+    def functions(self):
+        """Cached :func:`all_functions` over this module."""
+        if self._functions is None:
+            self._functions = all_functions(self.tree, self, self.lines)
+        return self._functions
+
+    def summaries_for(self, cls: "ClassModel"):
+        """Cached :func:`summarize_methods` for one of this module's
+        classes (keyed by the class NODE: an ad-hoc nested class must
+        not collide with a top-level class of the same name)."""
+        key = id(cls.node)
+        if key not in self._summaries:
+            self._summaries[key] = summarize_methods(cls, self)
+        return self._summaries[key]
+
+
+class LockRef:
+    """One resolved lock acquisition target."""
+
+    __slots__ = ("info", "via")
+
+    def __init__(self, info: LockInfo, via: str = ""):
+        self.info = info
+        self.via = via  # the condition attr it was reached through
+
+    @property
+    def name(self) -> str:
+        return self.info.name
+
+    @property
+    def qualname(self) -> str:
+        return self.info.qualname
+
+
+class FunctionContext:
+    """Resolution scope for one function body."""
+
+    def __init__(self, module: ModuleModel, cls: Optional[ClassModel]):
+        self.module = module
+        self.cls = cls
+
+    def resolve_lock(self, expr: ast.expr) -> Optional[LockRef]:
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None:
+            if attr in self.cls.conds:
+                under = self.cls.conds[attr]
+                info = self.cls.locks.get(under)
+                if info is not None:
+                    return LockRef(info, via=attr)
+                return None
+            info = self.cls.locks.get(attr)
+            if info is not None:
+                return LockRef(info)
+            return None
+        if isinstance(expr, ast.Name):
+            info = self.module.module_locks.get(expr.id)
+            if info is not None:
+                return LockRef(info)
+        return None
+
+    def is_event(self, expr: ast.expr) -> bool:
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None:
+            return attr in self.cls.events
+        if isinstance(expr, ast.Name):
+            return expr.id in self.module.module_events
+        return False
+
+    def is_thread(self, expr: ast.expr, local_threads: set) -> bool:
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None:
+            if attr in self.cls.threads:
+                return True
+            return "thread" in attr.lower() or "flusher" in attr.lower()
+        if isinstance(expr, ast.Name):
+            if expr.id in local_threads:
+                return True
+            return "thread" in expr.id.lower()
+        return False
+
+
+class Event:
+    """One fact the walker surfaced inside a function body."""
+
+    __slots__ = ("kind", "node", "held", "data")
+
+    def __init__(self, kind: str, node: ast.AST,
+                 held: Tuple[LockRef, ...], data):
+        self.kind = kind  # acquire|blocking|await|self_call|mutate
+        self.node = node
+        self.held = held
+        self.data = data
+
+
+def classify_blocking(call: ast.Call, ctx: FunctionContext,
+                      local_threads: set,
+                      held: Tuple[LockRef, ...]) -> Optional[Tuple[str, str]]:
+    """(kind, detail) when the call is a known blocking primitive.
+
+    ``wait`` on the condition of a lock currently held through that
+    condition's OWN lock is exempt for that lock (Condition.wait
+    releases it) — the caller still gets a finding for any *other*
+    lock held across the wait, which is exactly the two-lock hazard.
+    """
+    name = callee_name(call)
+    recv = receiver_of(call)
+    if name in ("call", "call_stream"):
+        return ("rpc", name)
+    if name == "sleep":
+        if recv is None or (isinstance(recv, ast.Name)
+                            and recv.id == "time"):
+            return ("sleep", "time.sleep")
+        return None
+    if name == "result":
+        return ("future", "result")
+    if name == "commit" and recv is not None:
+        return ("sqlite", "commit")
+    if name == "join" and recv is not None:
+        if ctx.is_thread(recv, local_threads):
+            return ("join", "thread.join")
+        return None
+    if name == "wait" and recv is not None:
+        if ctx.is_event(recv):
+            return ("wait", "event.wait")
+        lr = ctx.resolve_lock(recv)
+        if lr is not None and lr.via:
+            # Condition.wait: releases its own lock; blocking only for
+            # the OTHER locks held across it.
+            others = [h for h in held if h.qualname != lr.qualname]
+            if others:
+                return ("wait", f"cond.wait holding {others[0].qualname}")
+            return None
+        return None
+    return None
+
+
+def _local_threads(fn: ast.AST) -> set:
+    out = set()
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Call):
+            if callee_name(stmt.value) == "Thread":
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+    return out
+
+
+def _expr_calls(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """Call/Await nodes in a statement's expressions, NOT descending
+    into nested function/class definitions or nested statements (the
+    statement walker handles those with their own held context)."""
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.found: List[ast.AST] = []
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_ClassDef(self, node):
+            pass
+
+        def visit_Call(self, node):
+            self.found.append(node)
+            self.generic_visit(node)
+
+        def visit_Await(self, node):
+            self.found.append(node)
+            self.generic_visit(node)
+
+    v = V()
+    # Visit only the statement's direct expression fields; child
+    # statements are walked by iter_events with their own held state.
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers", "items"):
+            continue
+        if isinstance(value, ast.AST):
+            v.visit(value)
+        elif isinstance(value, list):
+            for item in value:
+                if isinstance(item, ast.AST) and isinstance(
+                        item, ast.expr):
+                    v.visit(item)
+    return iter(v.found)
+
+
+def _mutation_target(stmt: ast.stmt) -> Iterable[Tuple[str, ast.AST]]:
+    """Attr names of `self.X` containers this statement mutates."""
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        for tgt in targets:
+            # self.X[k] = v / self.X[k] += v
+            if isinstance(tgt, ast.Subscript):
+                attr = _self_attr(tgt.value)
+                if attr is not None:
+                    yield (attr, stmt)
+            else:
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    yield (attr, stmt)
+    elif isinstance(stmt, ast.Delete):
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Subscript):
+                attr = _self_attr(tgt.value)
+                if attr is not None:
+                    yield (attr, stmt)
+    elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        call = stmt.value
+        if callee_name(call) in MUTATOR_METHODS:
+            recv = receiver_of(call)
+            if recv is not None:
+                attr = _self_attr(recv)
+                if attr is not None:
+                    yield (attr, stmt)
+
+
+def iter_events(fn: ast.AST, ctx: FunctionContext,
+                held0: Tuple[LockRef, ...] = ()) -> Iterator[Event]:
+    """Walk one function body yielding acquisition / blocking / await /
+    self-call / mutation events with the set of locks held at each."""
+    local_threads = _local_threads(fn)
+
+    def scan_exprs(stmt: ast.stmt, held) -> Iterator[Event]:
+        for node in _expr_calls(stmt):
+            if isinstance(node, ast.Await):
+                yield Event("await", node, held, None)
+                continue
+            call = node
+            blocked = classify_blocking(call, ctx, local_threads, held)
+            if blocked is not None:
+                yield Event("blocking", call, held, blocked)
+            recv = receiver_of(call)
+            if recv is not None and isinstance(recv, ast.Name) \
+                    and recv.id == "self":
+                yield Event("self_call", call, held,
+                            callee_name(call))
+            elif isinstance(call.func, ast.Name):
+                # Bare-name call: a closure invoked in place (builtins
+                # land here too — consumers look names up against known
+                # functions, so the noise is inert).
+                yield Event("local_call", call, held, call.func.id)
+
+    def walk(stmts, held) -> Iterator[Event]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue  # deferred execution: own context
+            for attr, node in _mutation_target(stmt):
+                yield Event("mutate", node, held, attr)
+            yield from scan_exprs(stmt, held)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired = list(held)
+                for item in stmt.items:
+                    lr = ctx.resolve_lock(item.context_expr)
+                    # `async with` managers are asyncio primitives, not
+                    # threading locks — only sync `with` acquires here.
+                    if lr is not None and isinstance(stmt, ast.With):
+                        yield Event("acquire", item.context_expr,
+                                    tuple(acquired), lr)
+                        acquired.append(lr)
+                yield from walk(stmt.body, tuple(acquired))
+            elif isinstance(stmt, ast.Try):
+                yield from walk(stmt.body, held)
+                for handler in stmt.handlers:
+                    yield from walk(handler.body, held)
+                yield from walk(stmt.orelse, held)
+                yield from walk(stmt.finalbody, held)
+            elif isinstance(stmt, (ast.If,)):
+                yield from walk(stmt.body, held)
+                yield from walk(stmt.orelse, held)
+            elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                yield from walk(stmt.body, held)
+                yield from walk(stmt.orelse, held)
+            elif hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+                for case in stmt.cases:
+                    yield from walk(case.body, held)
+
+    yield from walk(getattr(fn, "body", []), tuple(held0))
+
+
+class MethodSummary:
+    """What one method does, for one-level helper expansion."""
+
+    __slots__ = ("acquires", "blocking", "awaits")
+
+    def __init__(self):
+        self.acquires: List[Tuple[LockRef, int]] = []
+        self.blocking: List[Tuple[str, str, int]] = []
+        self.awaits: List[int] = []
+
+
+def summarize_methods(cls: ClassModel,
+                      module: ModuleModel) -> Dict[str, MethodSummary]:
+    out: Dict[str, MethodSummary] = {}
+    for name, fn in cls.methods.items():
+        ctx = FunctionContext(module, cls)
+        s = MethodSummary()
+        for ev in iter_events(fn, ctx):
+            if ev.kind == "acquire":
+                s.acquires.append((ev.data, ev.node.lineno))
+            elif ev.kind == "blocking":
+                kind, detail = ev.data
+                # Export only blocking calls the helper makes while
+                # holding NO lock of its own: a call under the helper's
+                # allow-blocking lock is that lock's job, and a call
+                # under any other helper-held lock already gets its own
+                # direct finding in the helper's scope.
+                if ev.held:
+                    continue
+                s.blocking.append((kind, detail, ev.node.lineno))
+            elif ev.kind == "await":
+                s.awaits.append(ev.node.lineno)
+        out[name] = s
+    return out
+
+
+def all_functions(mod_tree: ast.Module, model: ModuleModel,
+                  lines: List[str]):
+    """Every function/coroutine in the module — top-level, methods AND
+    nested closures (drain-coordinator threads, serve's nested ``async
+    def app`` live inside methods) — each paired with its dotted scope
+    path and the ClassModel of its nearest enclosing class (``self`` in
+    a closure still binds the method's instance)."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(mod_tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    adhoc: Dict[int, ClassModel] = {}
+    out = []
+    for fn in ast.walk(mod_tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        path = [fn.name]
+        cls_node = None
+        cur = parents.get(fn)
+        while cur is not None and not isinstance(cur, ast.Module):
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                path.append(cur.name)
+                if cls_node is None and isinstance(cur, ast.ClassDef):
+                    cls_node = cur
+            cur = parents.get(cur)
+        scope = ".".join(reversed(path))
+        cm = None
+        if cls_node is not None:
+            cm = model.classes.get(cls_node.name)
+            if cm is None or cm.node is not cls_node:
+                key = id(cls_node)
+                if key not in adhoc:
+                    adhoc[key] = ClassModel(cls_node, lines)
+                cm = adhoc[key]
+        out.append((cm, fn, scope))
+    out.sort(key=lambda t: t[1].lineno)
+    return out
+
+
+def iter_functions(tree: ast.Module) -> Iterator[Tuple[
+        Optional[ast.ClassDef], ast.AST, str]]:
+    """(enclosing class | None, function node, scope string) for every
+    top-level and class-level function (nested defs ride their parent's
+    walk)."""
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, stmt, stmt.name
+        elif isinstance(stmt, ast.ClassDef):
+            for item in stmt.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    yield stmt, item, f"{stmt.name}.{item.name}"

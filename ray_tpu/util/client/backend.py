@@ -30,7 +30,13 @@ class ClientBackend:
         hello = self.rpc.call("client_hello", self.session_id)
         self._ttl = float(hello.get("ttl_s", 60.0))
         self._closed = False
-        self._release_lock = threading.Lock()
+        # MUST be reentrant: _queue_release runs as a weakref.finalize
+        # callback, so a GC pass can fire it on whatever thread is
+        # allocating — including the heartbeat thread while it holds
+        # this lock (extend() allocates). A plain Lock self-deadlocks
+        # there (the PR-5 local-backend bug class; ray-tpu analyze
+        # FS001 now guards this).
+        self._release_lock = threading.RLock()
         self._pending_release: list[str] = []
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
 

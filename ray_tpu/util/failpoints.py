@@ -53,6 +53,35 @@ import threading
 import time
 from typing import Optional
 
+# The registered site table: every `failpoints.hit("<site>")` compiled
+# into the codebase MUST be listed here (`ray-tpu analyze` rule CD001),
+# and every entry here must still have a live hit() site (rule CD002,
+# checked repo-wide) — so chaos coverage is reviewable in one place and
+# a site can't silently appear or vanish in either direction. Arming an
+# UNREGISTERED site is still allowed (tests arm ad-hoc sites), but a
+# production code path may only hit registered ones.
+SITES = frozenset({
+    # head control plane
+    "head.schedule.batch",
+    "head.drain.before_migrate",
+    "head.restart_actor.tick",
+    "head.snapshot.before_persist",
+    # node agent
+    "agent.lease.push",
+    "agent.dispatch.before_push",
+    "agent.worker_events.upload",
+    "agent.fetch.chunk",
+    "agent.heartbeat",
+    # driver/client
+    "client.dispatch.before_push",
+    "client.recover.before_resubmit",
+    "client.retry_submit.tick",
+    "client.flush_refs.before",
+    # worker
+    "worker.execute.before",
+    "worker.execute.after",
+})
+
 # site -> _Failpoint. `hit()` gates on plain truthiness of this dict:
 # the unarmed fast path must never take a lock.
 _ARMED: dict = {}
