@@ -864,16 +864,18 @@ class ClusterBackend:
             return False
         return True
 
-    def _check_actor_alive(self, oid: str) -> None:
+    def _check_actor_alive(self, oid: str, refresh: bool = True) -> None:
         """A pending actor-task result can never appear if the actor died —
         fail fast (RayActorError parity). If the actor RESTARTED and this
         call was lost with it, replay the call within the actor's
-        max_task_retries budget (direct_actor_task_submitter retry analog)."""
+        max_task_retries budget (direct_actor_task_submitter retry analog).
+        ``refresh=False`` trusts the actor cache a caller just refreshed
+        (wait()'s per-round dedup across refs of one actor)."""
         entry = self._actor_tasks.get(oid)
         if entry is None:
             return
         actor_id = entry["actor_id"]
-        info = self._actor_info(actor_id, refresh=True)
+        info = self._actor_info(actor_id, refresh=refresh)
         if info["state"] == "DEAD":
             for o in entry.get("oids", [oid]):
                 self._actor_tasks.pop(o, None)
@@ -1117,6 +1119,63 @@ class ClusterBackend:
                     pending.remove(r)
             if len(ready) >= num_returns or not pending:
                 break
+            # Actor-death fail-fast, same contract as get(): a pending
+            # actor-call ref whose actor is DEAD can never resolve — and
+            # its stored error object may have died WITH the actor's
+            # node (a preempted gang bundle vacated mid-call), so a
+            # wait()-based poller (Tune's event loop, the trainer's
+            # consume loop) would otherwise spin forever. The error
+            # lands in the local store and the ref reports ready (this
+            # pass or the caller's next poll); get() raises it.
+            # Replay-on-restart rides along (the same _check_actor_alive
+            # path get() uses). Throttled PER CLIENT: repeated
+            # wait(timeout=0) polls collectively sweep at most every
+            # quarter second — each check is a head RPC per distinct
+            # actor — and runs only after the contains-check above found
+            # unresolved refs.
+            now = time.monotonic()
+            if now - getattr(self, "_last_actor_check", 0.0) > 0.25:
+                self._last_actor_check = now
+                from ray_tpu.core.object_ref import ActorError
+
+                seen_actors: set = set()
+                for r in list(pending):
+                    entry = self._actor_tasks.get(r.id)
+                    if entry is None:
+                        continue
+                    aid = entry["actor_id"]
+                    # Only actors whose registration this client has
+                    # already seen: a ctor still forking has no head
+                    # record yet, and the lookup would BLOCK wait()
+                    # for the registration timeout (ctor failures
+                    # surface through the record the agent writes).
+                    with self._lock:
+                        known = aid in self._actor_cache
+                    if not known:
+                        continue
+                    # One head refresh per DISTINCT actor per round: a
+                    # wait over a 500-call fan-out to one actor must
+                    # not cost 500 get_actor RPCs every quarter second.
+                    # The entry's oids are captured BEFORE the check:
+                    # it pops every sibling of a multi-return call, so
+                    # the error must be stored for ALL of them or the
+                    # unchecked siblings would hang forever.
+                    call_oids = list(entry.get("oids") or [r.id])
+                    try:
+                        self._check_actor_alive(
+                            r.id, refresh=aid not in seen_actors)
+                    except ActorError as e:
+                        for oid in call_oids:
+                            self.put_with_id(oid, e, is_error=True)
+                    except Exception:
+                        pass  # lookup hiccup: next round retries
+                    seen_actors.add(aid)
+                for r in list(pending):
+                    if self.store.contains(r.id):
+                        ready.append(r)
+                        pending.remove(r)
+                if len(ready) >= num_returns or not pending:
+                    break
             # One batched, owner-routed poll per round (non-blocking):
             # self-owned refs cost zero RPCs; the 5 ms cadence below would
             # otherwise hammer the head with a locations call per ref.
@@ -1519,7 +1578,29 @@ class ClusterBackend:
                 try:
                     self._submit_spec(spec, allow_pending=True)
                     spec["_handled"] = True
-                except (ValueError, TimeoutError, ConnectionLost, OSError) as e:
+                except TimeoutError:
+                    # Not ready within the resolve window — the group is
+                    # still reserving, or RESCHEDULING while the head
+                    # migrates bundles off a lost node. Park with the
+                    # shared backoff timer: tasks pinned to a migrating
+                    # gang re-resolve when the reservation lands, they
+                    # don't error (bounded by pending_task_timeout_s).
+                    self._park_pending(spec)
+                except (ConnectionLost, OSError) as e:
+                    if getattr(e, "maybe_executed", False):
+                        # The push itself died mid-call: resubmitting
+                        # could fork the task into two executions.
+                        self._fail_spec(spec, TaskError(
+                            spec.get("fname", "task"), str(e), repr(e)))
+                    else:
+                        # Nothing reached the node — typically a bundle
+                        # host that died before the head declared it
+                        # (the resolution pointed at a corpse). Park:
+                        # the head flips the group to RESCHEDULING on
+                        # death detection and the retry re-resolves to
+                        # the bundle's new home.
+                        self._park_pending(spec)
+                except ValueError as e:
                     self._fail_spec(spec, TaskError(
                         spec.get("fname", "task"), str(e), repr(e)))
                 continue

@@ -505,6 +505,12 @@ class HeadServer:
                     self._named_actors[rec["name"]] = actor_id
             self._actor_specs.update(snap.get("aspecs", {}))
             self._pgs.update(snap.get("pgs", {}))
+            for pg in self._pgs.values():
+                # A snapshot taken mid-reschedule persisted the
+                # coordinator-active flag; the thread did not survive
+                # the restart — clear it so the monitor loop starts a
+                # fresh coordinator for any RESCHEDULING group.
+                pg["_resched_active"] = False
             self._rebuild_res_caches()
         with self._kv_lock:
             self._kv.update(kv)
@@ -679,6 +685,22 @@ class HeadServer:
         if node is None:
             return
         node.migrated_actors = self._migrate_actors_off(node_id, reason)
+        # Proactive gang migration: bundles on the draining node move to
+        # healthy nodes NOW (prepare/commit elsewhere, then return the
+        # old reservation), while the departing node still serves its
+        # objects — the placement-group half of the actor migration
+        # above. Work killed with the old bundle recovers through owner
+        # lineage with the drain retry-budget exemption.
+        with self._lock:
+            draining_pgs = [
+                pg for pg in self._pgs.values()
+                if pg["state"] in ("CREATED", "RESCHEDULING") and any(
+                    nid == node_id for nid, _ in pg["placement"]
+                )
+            ]
+            for pg in draining_pgs:
+                self._pg_mark_rescheduling_locked(
+                    pg, f"node {node_id} draining: {reason}")
         try:
             node.client.call("drain_self", reason, deadline_s, timeout=5.0)
         except Exception:
@@ -842,6 +864,24 @@ class HeadServer:
             for node_id in dead:
                 missed.pop(node_id, None)
                 self._mark_dead(node_id, "heartbeat timeout")
+            # Self-healing reschedule drivers: a RESCHEDULING group with
+            # no live coordinator (an injected coordinator crash, or a
+            # head restart that reloaded the state mid-reschedule) gets
+            # a fresh one here — the group can never wedge in
+            # RESCHEDULING with nothing driving it.
+            stuck: list[tuple] = []
+            with self._lock:
+                for pg in self._pgs.values():
+                    if pg["state"] == "RESCHEDULING" \
+                            and not pg.get("_resched_active"):
+                        pg["_resched_active"] = True
+                        stuck.append(
+                            (pg["placement_group_id"],
+                             pg.get("reschedule_cause") or "unknown"))
+            for pg_id, pg_cause in stuck:
+                threading.Thread(
+                    target=self._reschedule_pg,
+                    args=(pg_id, pg_cause), daemon=True).start()
 
     def _mark_dead(self, node_id: str, cause: str):
         # Cross-shard path: node/actor/PG work under the node lock, THEN
@@ -876,13 +916,21 @@ class HeadServer:
                         info["actor_id"], f"node {node_id} died: {cause}",
                         True,
                     )
-            # Placement groups with bundles there become DEAD (rescheduling
-            # PGs is round-2 work; Train-level elasticity handles restarts).
-            for pg in self._pgs.values():
-                if pg["state"] == "CREATED" and any(
+            # Placement groups with bundles there enter RESCHEDULING: the
+            # reservation outlives the node that held it — a coordinator
+            # re-runs the 2PC for the lost bundles on healthy nodes
+            # (gcs_placement_group_manager reschedule-on-dead path).
+            # Gangs on a preemptible fleet lose nodes as a matter of
+            # course; killing the whole reservation was round-2 debt.
+            to_reschedule = [
+                pg for pg in self._pgs.values()
+                if pg["state"] in ("CREATED", "RESCHEDULING") and any(
                     nid == node_id for nid, _ in pg["placement"]
-                ):
-                    pg["state"] = "DEAD"
+                )
+            ]
+            for pg in to_reschedule:
+                self._pg_mark_rescheduling_locked(
+                    pg, f"node {node_id} died: {cause}")
             self._actors_cv.notify_all()
         with self._obj_lock:
             # Drop its object locations; lineage re-execution is the
@@ -2273,6 +2321,7 @@ class HeadServer:
                 "name": name,
                 "state": "PENDING",
                 "placement": [],  # [(node_id, bundle_index)]
+                "reschedules": 0,
             }
         threading.Thread(
             target=self._reserve_pg, args=(pg_id,), daemon=True
@@ -2280,61 +2329,12 @@ class HeadServer:
         return pg_id
 
     def _pg_assign(self, bundles, strategy) -> Optional[list]:
-        """Choose a node per bundle against total capacities."""
-        with self._lock:
-            alive = [n for n in self._nodes.values() if n.schedulable]
-        if not alive:
-            return None
-        # Track what this PG adds per node to respect totals.
-        planned: dict[str, dict[str, float]] = {n.node_id: {} for n in alive}
-
-        def fits(n: NodeInfo, b: dict) -> bool:
-            add = planned[n.node_id]
-            return all(
-                n.resources.get(k, 0.0) >= add.get(k, 0.0) + v
-                for k, v in b.items()
-            )
-
-        def commit(n: NodeInfo, b: dict):
-            add = planned[n.node_id]
-            for k, v in b.items():
-                add[k] = add.get(k, 0.0) + v
-
-        assignment: list[tuple[str, int]] = []
-        if strategy in ("PACK", "STRICT_PACK"):
-            order = sorted(alive, key=lambda n: -sum(n.resources.values()))
-            for i, b in enumerate(bundles):
-                for n in (order if strategy == "PACK" else order[:1]):
-                    if fits(n, b):
-                        commit(n, b)
-                        assignment.append((n.node_id, i))
-                        break
-                else:
-                    return None
-            if strategy == "STRICT_PACK" and len({a[0] for a in assignment}) > 1:
-                return None
-        elif strategy in ("SPREAD", "STRICT_SPREAD"):
-            used: set[str] = set()
-            for i, b in enumerate(bundles):
-                ranked = sorted(
-                    alive,
-                    key=lambda n: (n.node_id in used, -sum(n.resources.values())),
-                )
-                placed = False
-                for n in ranked:
-                    if strategy == "STRICT_SPREAD" and n.node_id in used:
-                        continue
-                    if fits(n, b):
-                        commit(n, b)
-                        used.add(n.node_id)
-                        assignment.append((n.node_id, i))
-                        placed = True
-                        break
-                if not placed:
-                    return None
-        else:
-            return None
-        return assignment
+        """Choose a node per bundle against total capacities: the
+        degenerate every-bundle-lost case of the reschedule
+        coordinator's partial assign — ONE bin-packing implementation
+        for both the initial reserve and the migration."""
+        return self._pg_assign_partial(
+            bundles, strategy, [], list(range(len(bundles))))
 
     def _reserve_pg(self, pg_id: str):
         # Reservation retries while the PG is PENDING: a prepare that
@@ -2352,6 +2352,7 @@ class HeadServer:
             if assignment is None:
                 with self._lock:
                     pg["state"] = "INFEASIBLE"
+                    self._pg_event(pg)
                 return
             # Phase 1: prepare every bundle on its node (blocking until
             # the node can reserve it); phase 2: commit. Rollback and
@@ -2364,12 +2365,19 @@ class HeadServer:
                 if node is None or not node.alive:
                     ok = False
                     break
+                # Appended BEFORE the call: a prepare that LANDED
+                # agent-side but whose reply was lost (severed channel,
+                # timeout) must still be rolled back, or the carve-out
+                # leaks when the retry round picks a different node.
+                # return_bundle on a node the prepare never reached is
+                # an idempotent no-op.
+                prepared.append((node_id, bundle_index))
                 try:
+                    failpoints.hit("head.pg.prepare")
                     node.client.call(
                         "prepare_bundle", pg_id, bundle_index,
                         bundles[bundle_index], timeout=120.0,
                     )
-                    prepared.append((node_id, bundle_index))
                 except Exception:
                     ok = False
                     break
@@ -2388,6 +2396,7 @@ class HeadServer:
             with self._lock:
                 node = self._nodes.get(node_id)
             try:
+                failpoints.hit("head.pg.commit")
                 node.client.call("commit_bundle", pg_id, bundle_index)
             except Exception:
                 pass
@@ -2400,6 +2409,7 @@ class HeadServer:
             else:
                 pg["placement"] = assignment
                 pg["state"] = "CREATED"
+                self._pg_event(pg)
         if rollback:
             for node_id, bundle_index in assignment:
                 with self._lock:
@@ -2417,7 +2427,12 @@ class HeadServer:
                 return False
             prev, pg["state"] = pg["state"], "REMOVED"
             placement = list(pg["placement"])
-        if prev == "CREATED":
+            self._pg_event(pg)
+        if prev in ("CREATED", "RESCHEDULING"):
+            # RESCHEDULING placements may include dead nodes (nothing to
+            # return there) and draining nodes (return, so the drain can
+            # finish); a reschedule coordinator racing this sees REMOVED
+            # under the lock and rolls back its own prepared bundles.
             for node_id, bundle_index in placement:
                 with self._lock:
                     node = self._nodes.get(node_id)
@@ -2428,16 +2443,37 @@ class HeadServer:
                         pass
         return True
 
+    def _pg_table_entry(self, pg: dict) -> dict:
+        """Caller holds self._lock. Public table view of one PG: the
+        coordinator's private keys are stripped, and the bundle->node
+        map plus per-bundle liveness ride along so gang holders (elastic
+        trainers, `ray-tpu status`, the dashboard) can see exactly which
+        bundles survived a node loss."""
+        e = {k: v for k, v in pg.items() if not k.startswith("_")}
+        e["placement"] = list(pg["placement"])
+        e["bundle_nodes"] = {bi: nid for nid, bi in pg["placement"]}
+        e["live_bundles"] = sorted(
+            bi for nid, bi in pg["placement"]
+            if self._nodes.get(nid) is not None
+            and self._nodes[nid].schedulable
+        )
+        e.setdefault("reschedules", 0)
+        return e
+
     def rpc_placement_group_table(self, pg_id=None):
         with self._lock:
             if pg_id is not None:
                 pg = self._pgs.get(pg_id)
-                return dict(pg, placement=list(pg["placement"])) if pg else None
-            return {k: dict(v, placement=list(v["placement"]))
+                return self._pg_table_entry(pg) if pg else None
+            return {k: self._pg_table_entry(v)
                     for k, v in self._pgs.items()}
 
     def rpc_pg_node_for_bundle(self, pg_id, bundle_index, timeout=30.0):
-        """Blocking: node that holds the given bundle (or any, if -1)."""
+        """Blocking: node that holds the given bundle (or any, if -1).
+        A RESCHEDULING group parks the caller — its bundles are being
+        migrated to healthy nodes, and the resolution that eventually
+        returns points at the bundle's NEW home (tasks pinned to a
+        migrated bundle re-resolve instead of erroring)."""
         deadline = time.monotonic() + timeout
         while True:
             with self._lock:
@@ -2448,6 +2484,8 @@ class HeadServer:
                     raise ValueError(f"placement group {pg_id} is infeasible")
                 if pg["state"] == "REMOVED":
                     raise ValueError(f"placement group {pg_id} was removed")
+                if pg["state"] == "DEAD":  # legacy persisted state
+                    raise ValueError(f"placement group {pg_id} is dead")
                 if pg["state"] == "CREATED":
                     for node_id, bi in pg["placement"]:
                         if bundle_index < 0 or bi == bundle_index:
@@ -2457,9 +2495,340 @@ class HeadServer:
                     raise ValueError(
                         f"bundle {bundle_index} of {pg_id} has no live node"
                     )
+                if pg["state"] == "RESCHEDULING":
+                    # Only the LOST bundles park. A surviving bundle
+                    # resolves immediately — an elastic gang running at
+                    # shrunk world size places its workers on the live
+                    # bundles while the coordinator migrates the rest.
+                    for node_id, bi in pg["placement"]:
+                        if bundle_index < 0 or bi == bundle_index:
+                            node = self._nodes.get(node_id)
+                            if node is not None and node.schedulable:
+                                return node_id, node.address
             if time.monotonic() > deadline:
                 raise TimeoutError(f"placement group {pg_id} not ready")
             time.sleep(0.02)
+
+    # -- placement-group rescheduling (reservation outlives its nodes) -----
+    #
+    # Podracer-style preemptible fleets lose nodes as the NORMAL case:
+    # a gang reservation must migrate, not die, when a bundle's node
+    # drains or crashes. The state machine is
+    #
+    #     CREATED --(bundle node dead/draining)--> RESCHEDULING
+    #     RESCHEDULING --(2PC re-reserve on healthy nodes)--> CREATED
+    #     RESCHEDULING --(remove_placement_group)--> REMOVED
+    #
+    # driven by one coordinator thread per group (restarted by the
+    # monitor loop if it ever dies — including across a head restart
+    # that reloads a RESCHEDULING group from the snapshot). Lock
+    # discipline: every node RPC runs OUTSIDE the shard locks.
+
+    @staticmethod
+    def _pg_reschedule_cause(cause: str) -> str:
+        """Metric cause class for a reschedule trigger: planned drains
+        (including a drained node whose heartbeat-death won the race)
+        vs a crash-detected node death."""
+        if "drain" in cause:
+            return "drain"
+        return "node_death"
+
+    def _pg_event(self, pg: dict, cause: str | None = None) -> None:
+        """Caller holds self._lock. Publish the group's latest lifecycle
+        state on the PLACEMENT_GROUPS channel (the NODES/ACTORS
+        state-update shape: full latest state per key, coalesced for
+        slow subscribers) so gang holders learn their bundles moved
+        without polling the table."""
+        msg = {
+            "placement_group_id": pg["placement_group_id"],
+            "state": pg["state"],
+            "placement": list(pg["placement"]),
+            "reschedules": pg.get("reschedules", 0),
+        }
+        if cause:
+            msg["cause"] = cause
+        self.pubsub.publish(
+            "PLACEMENT_GROUPS", pg["placement_group_id"], msg)
+
+    def _pg_mark_rescheduling_locked(self, pg: dict, cause: str) -> None:
+        """Caller holds self._lock. Move the group to RESCHEDULING and
+        ensure exactly one coordinator drives it: a second node loss
+        mid-reschedule only refreshes the cause — the running
+        coordinator re-derives the lost bundle set every round."""
+        pg["state"] = "RESCHEDULING"
+        pg["reschedule_cause"] = cause
+        self._pg_event(pg, cause)
+        if pg.get("_resched_active"):
+            return
+        pg["_resched_active"] = True
+        threading.Thread(
+            target=self._reschedule_pg,
+            args=(pg["placement_group_id"], cause), daemon=True,
+        ).start()
+
+    def _pg_assign_partial(self, bundles, strategy, keep,
+                           lost) -> Optional[list]:
+        """Choose a node for each LOST bundle against node totals,
+        honoring the strategy alongside the surviving placement:
+        surviving bundles' demand counts into the plan (no
+        double-booking their nodes), SPREAD ranks surviving nodes last,
+        STRICT_SPREAD excludes them, STRICT_PACK targets the surviving
+        node (or one fresh node for a full loss)."""
+        with self._lock:
+            alive = [n for n in self._nodes.values() if n.schedulable]
+        if not alive:
+            return None
+        planned: dict[str, dict[str, float]] = {
+            n.node_id: {} for n in alive}
+        keep_nodes: set[str] = set()
+        for nid, bi in keep:
+            keep_nodes.add(nid)
+            add = planned.get(nid)
+            if add is not None:
+                for k, v in bundles[bi].items():
+                    add[k] = add.get(k, 0.0) + v
+
+        def fits(n: NodeInfo, b: dict) -> bool:
+            add = planned[n.node_id]
+            return all(
+                n.resources.get(k, 0.0) >= add.get(k, 0.0) + v
+                for k, v in b.items()
+            )
+
+        def commit(n: NodeInfo, b: dict):
+            add = planned[n.node_id]
+            for k, v in b.items():
+                add[k] = add.get(k, 0.0) + v
+
+        assignment: list[tuple[str, int]] = []
+        if strategy in ("PACK", "STRICT_PACK"):
+            order = sorted(alive, key=lambda n: -sum(n.resources.values()))
+            if strategy == "STRICT_PACK":
+                # Everything on ONE node: the survivors' node if any
+                # bundle survived, else the single best fresh node.
+                if keep_nodes:
+                    order = [n for n in order if n.node_id in keep_nodes]
+                order = order[:1]
+            for bi in lost:
+                b = bundles[bi]
+                for n in order:
+                    if fits(n, b):
+                        commit(n, b)
+                        assignment.append((n.node_id, bi))
+                        break
+                else:
+                    return None
+        elif strategy in ("SPREAD", "STRICT_SPREAD"):
+            used = set(keep_nodes)
+            for bi in lost:
+                b = bundles[bi]
+                ranked = sorted(
+                    alive,
+                    key=lambda n: (n.node_id in used,
+                                   -sum(n.resources.values())),
+                )
+                placed = False
+                for n in ranked:
+                    if strategy == "STRICT_SPREAD" and n.node_id in used:
+                        continue
+                    if fits(n, b):
+                        commit(n, b)
+                        used.add(n.node_id)
+                        assignment.append((n.node_id, bi))
+                        placed = True
+                        break
+                if not placed:
+                    return None
+        else:
+            return None
+        return assignment
+
+    def _pg_rollback(self, pg_id: str, prepared: list) -> None:
+        """Return every bundle a failed 2PC round prepared — per node,
+        best-effort (a dead node's reservation died with it) — so a
+        partial prepare can never leak a per-node reservation."""
+        for node_id, bi in prepared:
+            with self._lock:
+                node = self._nodes.get(node_id)
+            if node is not None and node.alive:
+                try:
+                    node.client.call("return_bundle", pg_id, bi,
+                                     timeout=30.0)
+                except Exception:
+                    pass
+
+    def _pg_commit_assignment(self, pg_id: str, assignment: list) -> bool:
+        """Phase 2 on the replacement nodes. ``commit_bundle`` is
+        idempotent agent-side and ``prepare_bundle`` replays are
+        absorbed there too, so a commit whose reply was severed
+        mid-channel retries safely — exactly-once reservation. Returns
+        False when a target died mid-commit (caller re-derives)."""
+        for node_id, bi in assignment:
+            for attempt in range(3):
+                with self._lock:
+                    node = self._nodes.get(node_id)
+                    node_alive = node is not None and node.alive
+                if not node_alive:
+                    return False
+                try:
+                    failpoints.hit("head.pg.commit")
+                    node.client.call("commit_bundle", pg_id, bi,
+                                     timeout=30.0)
+                    break
+                except Exception:
+                    if attempt == 2:
+                        return False
+                    time.sleep(0.1)
+        return True
+
+    def _reschedule_pg(self, pg_id: str, cause: str) -> None:
+        """One group's reschedule lifecycle: re-run the reserve 2PC for
+        its lost bundles on healthy nodes — prepare every replacement
+        (rollback on partial failure), commit, install the new
+        placement — re-queuing behind capacity with the round-6 backoff
+        discipline (the gang was feasible once; it waits for a
+        replacement node rather than dying). Old reservations on
+        still-alive DRAINING nodes are returned only AFTER their
+        replacement committed, so the gang always holds a reservation
+        somewhere. No node RPC ever runs under a shard lock."""
+        t0 = time.monotonic()
+        try:
+            failpoints.hit("head.pg.before_reschedule")
+        except failpoints.FailpointError:
+            # Injected coordinator crash: DIE (the finally below clears
+            # _resched_active) and let the monitor loop restart a fresh
+            # coordinator — swallowing the raise would make the
+            # injection a no-op and the recovery path untestable.
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+                if pg is not None:
+                    pg["_resched_active"] = False
+            return
+        backoff = config.submit_retry_base_s
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    pg = self._pgs.get(pg_id)
+                    if pg is None or pg["state"] != "RESCHEDULING":
+                        return  # removed / settled while retrying
+                    bundles, strategy = pg["bundles"], pg["strategy"]
+                    keep: list[tuple] = []
+                    lost: list[int] = []
+                    vacate: list[tuple] = []
+                    for nid, bi in pg["placement"]:
+                        n = self._nodes.get(nid)
+                        if n is not None and n.schedulable:
+                            keep.append((nid, bi))
+                        else:
+                            lost.append(bi)
+                            if n is not None and n.alive:
+                                # DRAINING: reservation still held there;
+                                # return it after the replacement lands.
+                                vacate.append((n, bi))
+                if not lost:
+                    # Every bundle is back on a schedulable node (e.g. a
+                    # transient drain view): settle without a 2PC round.
+                    if self._pg_install(pg_id, keep, [], [], t0, cause):
+                        return
+                    continue
+                assignment = self._pg_assign_partial(
+                    bundles, strategy, keep, lost)
+                if assignment is None:
+                    time.sleep(backoff)
+                    backoff = min(config.submit_retry_max_s,
+                                  backoff * 2.0)
+                    continue
+                prepared: list[tuple] = []
+                ok = True
+                for node_id, bi in assignment:
+                    with self._lock:
+                        node = self._nodes.get(node_id)
+                        node_ok = node is not None and node.schedulable
+                    if not node_ok:
+                        ok = False
+                        break
+                    # Appended BEFORE the call (see _reserve_pg): a
+                    # prepare that landed but lost its reply must roll
+                    # back too, or the reservation leaks when the next
+                    # round assigns a different node.
+                    prepared.append((node_id, bi))
+                    try:
+                        failpoints.hit("head.pg.prepare")
+                        node.client.call(
+                            "prepare_bundle", pg_id, bi, bundles[bi],
+                            timeout=120.0)
+                    except Exception:
+                        ok = False
+                        break
+                if ok:
+                    ok = self._pg_commit_assignment(pg_id, assignment)
+                if not ok:
+                    self._pg_rollback(pg_id, prepared)
+                    time.sleep(backoff)
+                    backoff = min(config.submit_retry_max_s,
+                                  backoff * 2.0)
+                    continue
+                if self._pg_install(
+                        pg_id, keep, assignment, vacate, t0, cause):
+                    return
+                # A keep-node died mid-2PC: the committed replacements
+                # are already installed in the placement; loop to
+                # re-derive and re-reserve only the newly lost bundles.
+        finally:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+                if pg is not None:
+                    pg["_resched_active"] = False
+
+    def _pg_install(self, pg_id: str, keep: list, assignment: list,
+                    vacate: list, t0: float, cause: str) -> bool:
+        """Install keep+assignment as the group's placement. Returns
+        True when the reschedule is DONE (group CREATED again, or
+        removed meanwhile — prepared bundles rolled back); False when a
+        surviving node died mid-2PC and the coordinator must re-derive
+        (the commit landed: the placement keeps it either way)."""
+        placement = sorted(keep + assignment, key=lambda p: p[1])
+        removed = False
+        done = False
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None or pg["state"] != "RESCHEDULING":
+                removed = True
+            else:
+                pg["placement"] = placement
+                still_lost = [
+                    nid for nid, _bi in placement
+                    if not (self._nodes.get(nid) is not None
+                            and self._nodes[nid].schedulable)
+                ]
+                if not still_lost:
+                    pg["state"] = "CREATED"
+                    pg["reschedules"] = pg.get("reschedules", 0) + 1
+                    pg.pop("reschedule_cause", None)
+                    self._pg_event(pg, cause)
+                    done = True
+        if removed:
+            self._pg_rollback(pg_id, assignment)
+            return True
+        # Vacate the old reservations on draining nodes now that their
+        # replacements are committed (kills bundle tasks still there;
+        # owners recover them with the drain retry exemption).
+        for node, bi in vacate:
+            try:
+                node.client.call("return_bundle", pg_id, bi, timeout=30.0)
+            except Exception:
+                pass
+        if done:
+            from ray_tpu.util import metrics as _metrics
+
+            try:
+                _metrics.PG_RESCHEDULES_TOTAL.inc(
+                    tags={"cause": self._pg_reschedule_cause(cause)})
+                _metrics.PG_RESCHEDULE_SECONDS.observe(
+                    time.monotonic() - t0)
+            except Exception:
+                pass
+        return done
 
     # -- lifecycle --------------------------------------------------------
 

@@ -1551,19 +1551,47 @@ class NodeAgent:
     # -- placement group bundles (2PC participant) ------------------------
 
     def rpc_prepare_bundle(self, pg_id, bundle_index, bundle):
+        with self._lock:
+            if (pg_id, bundle_index) in self._bundles:
+                # Idempotent replay: the head's prepare landed but its
+                # reply was lost (severed channel / reconnect retry).
+                # Acquiring again would double-reserve the node for one
+                # logical bundle — exactly-once reservation means the
+                # retry is an ack, not a second carve-out.
+                return True
         if not self.pool.feasible(bundle):
             raise ValueError(f"bundle {bundle} infeasible on node {self.node_id}")
         if not self.pool.acquire(bundle, timeout=60.0):
             raise TimeoutError(f"bundle {bundle} not reservable on {self.node_id}")
         with self._lock:
+            if (pg_id, bundle_index) in self._bundles:
+                # Lost the race against a concurrent replay that
+                # acquired first: give this acquisition back.
+                self.pool.release(bundle)
+                return True
             self._bundles[(pg_id, bundle_index)] = ResourcePool(bundle)
             self._bundle_state[(pg_id, bundle_index)] = "PREPARED"
         return True
 
     def rpc_commit_bundle(self, pg_id, bundle_index):
         with self._lock:
-            self._bundle_state[(pg_id, bundle_index)] = "COMMITTED"
+            # Idempotent: committing an already-committed (or unknown —
+            # returned while the commit retried) bundle changes nothing.
+            if (pg_id, bundle_index) in self._bundles:
+                self._bundle_state[(pg_id, bundle_index)] = "COMMITTED"
         return True
+
+    def rpc_bundle_table(self):
+        """This node's live placement-group reservations:
+        ``{"<pg_id>:<bundle_index>": state}`` (PREPARED | COMMITTED).
+        The chaos soak's leak invariant joins this against the head's
+        PG table — a reservation here that no live group's placement
+        explains is a leaked carve-out."""
+        with self._lock:
+            return {
+                f"{pg_id}:{bi}": state
+                for (pg_id, bi), state in self._bundle_state.items()
+            }
 
     def rpc_return_bundle(self, pg_id, bundle_index):
         with self._lock:
@@ -1573,10 +1601,17 @@ class NodeAgent:
             # in its bundles (gcs_placement_group_manager removal path).
             # Without this, returning the reservation below would
             # oversubscribe the node for as long as a straggler runs.
+            # Scoped to THIS bundle: returning one bundle (a reschedule
+            # rollback or a single migrated bundle's vacate) must not
+            # kill a SIBLING bundle's healthy workers on the same node
+            # — only any-bundle tasks (bundle_index < 0, whose pool we
+            # never recorded) die with whichever bundle goes first.
             victims = [
                 w for w in self._workers.values()
                 if w.current_task is not None
                 and w.current_task["spec"].get("pg_id") == pg_id
+                and w.current_task["spec"].get(
+                    "bundle_index", -1) in (-1, bundle_index)
                 and w.proc.poll() is None
             ]
         for w in victims:

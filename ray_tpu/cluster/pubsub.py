@@ -51,13 +51,15 @@ from ray_tpu.util.metrics import (
     PUBSUB_DROPPED as _PUBSUB_DROPPED,
 )
 
-CHANNELS = ("LOGS", "ACTORS", "NODES", "ERRORS")
+CHANNELS = ("LOGS", "ACTORS", "NODES", "ERRORS", "PLACEMENT_GROUPS")
 
 # State-update channels: each message is the entity's complete latest
 # state keyed by entity id, so replacing a buffered message with a newer
 # one loses nothing a subscriber could act on. Event/stream channels
-# (LOGS, ERRORS) are deliberately absent.
-COALESCE_CHANNELS = frozenset(("ACTORS", "NODES"))
+# (LOGS, ERRORS) are deliberately absent. PLACEMENT_GROUPS carries each
+# group's full latest lifecycle state (CREATED/RESCHEDULING/...) keyed
+# by pg id — the feed gang holders watch to learn their bundles moved.
+COALESCE_CHANNELS = frozenset(("ACTORS", "NODES", "PLACEMENT_GROUPS"))
 
 
 class _Subscriber:

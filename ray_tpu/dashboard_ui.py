@@ -451,12 +451,18 @@ const RENDER = {
       pgs = Object.entries(pgs).map(([id, info]) =>
         ({pg_id: id, ...info}));
     $("view").replaceChildren(table(
-      ["pg_id", "name", "state", "strategy", "bundles"],
+      ["pg_id", "name", "state", "strategy", "bundles", "live",
+       "reschedules"],
       pgs, (r, c) => {
         if (c === "state") return stateCell(r.state);
         const td = el("td", c === "pg_id" ? "mono" : "");
         if (c === "bundles")
           td.textContent = JSON.stringify(r.bundles || []);
+        else if (c === "live")
+          td.textContent = r.bundles
+            ? `${(r.live_bundles || []).length}/${r.bundles.length}` : "";
+        else if (c === "reschedules")
+          td.textContent = r.reschedules ?? 0;
         else td.textContent = c === "pg_id"
           ? short(r.pg_id || r.id || "") : (r[c] ?? "");
         return td;

@@ -26,7 +26,7 @@ FILENAME = "BENCH_TPU_SESSIONS.jsonl"
 KNOWN_BENCHES = frozenset({
     "task_overhead", "memory_pressure", "chaos_soak", "scalebench",
     "drain_recovery_ms", "serve_latency", "input_pipeline", "goodput",
-    "analyze",
+    "analyze", "gang_recovery",
 })
 
 
@@ -419,6 +419,16 @@ def check_line(obj: object, *, allow_header: bool = False) -> list[str]:
             if not isinstance(obj.get("ok"), bool):
                 errs.append("analyze line missing boolean 'ok' gate "
                             "verdict")
+        elif obj["bench"] == "gang_recovery":
+            # The MTTR line IS the number: a gang-recovery claim with
+            # no reschedule latency is unreviewable.
+            if not _is_num(obj.get("pg_reschedule_ms")):
+                errs.append("gang_recovery line missing numeric "
+                            "pg_reschedule_ms")
+            if not isinstance(obj.get("trigger"), str) \
+                    or not obj.get("trigger"):
+                errs.append("gang_recovery line missing 'trigger' "
+                            "(drain | node_death)")
         elif obj["bench"] == "serve_latency":
             # A serve latency line must carry both views AND the
             # agreement verdict — a client-only (or server-only) number
@@ -506,6 +516,32 @@ def main(argv: list[str] | None = None) -> int:
         n_lines = sum(1 for _ in f)
     print(f"bench_log check: OK ({n_lines} line(s) in {path})")
     return 0
+
+
+def record_gang_recovery(pg_reschedule_ms: float, *,
+                         trigger: str = "drain",
+                         bundles: int = 0, bundles_lost: int = 0,
+                         device: str = "", path: str | None = None,
+                         **extra) -> dict:
+    """Gang-recovery MTTR evidence (``scripts/drain_bench.py`` gang
+    probe): wall milliseconds from a gang bundle losing its node (drain
+    initiated / node killed) to the placement group's reservation being
+    whole again on healthy nodes — the reschedule coordinator's
+    end-to-end latency, the number the elastic-fleet goodput envelope
+    stands on. Committed to the evidence trail only on a real
+    (accelerator) cluster; returns the entry (with ``committed_to``)
+    either way."""
+    entry = {
+        "bench": "gang_recovery",
+        "device": device,
+        "trigger": str(trigger),
+        "pg_reschedule_ms": round(float(pg_reschedule_ms), 1),
+        "bundles": int(bundles),
+        "bundles_lost": int(bundles_lost),
+    }
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
 
 
 def record_drain_recovery(proactive_drain_ms: float,

@@ -76,6 +76,8 @@ class _Soak:
         self.serve_shed = 0
         self.train_reports = 0
         self.train_goodput: "dict | None" = None
+        self.gang_goodput: "dict | None" = None
+        self.gang_reschedules = 0
         self._stop = threading.Event()
         # The graceful-drain victim: the fault injector must not kill or
         # partition the node the drain (and its retry-exemption probe)
@@ -366,6 +368,92 @@ class _Soak:
             self.violations.append(
                 f"train probe downtime with empty cause: {by_cause!r}")
 
+    def _gang_probe(self) -> None:
+        """Standing PG-migration invariant: an elastic gang trial
+        (num_workers=2, min_workers=1, max_failures=0) holding a
+        placement group through the whole seeded kill/drain schedule
+        must COMPLETE — its reservation migrates (RESCHEDULING ->
+        CREATED on healthy nodes) instead of dying, every lost second
+        lands in the ledger under a preemption/drain/reschedule cause,
+        and the failure budget stays untouched (completing with
+        max_failures=0 proves it)."""
+        from ray_tpu import train
+        from ray_tpu.train import session
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        steps = max(6, int(self.duration_s / 0.6))
+
+        def train_fn(config):
+            start = 0
+            ckpt = session.get_checkpoint()
+            if ckpt is not None:
+                start = ckpt.to_dict().get("step", -1) + 1
+            for i in range(start, config["steps"]):
+                time.sleep(0.4)
+                session.report(
+                    {"step": i},
+                    checkpoint=Checkpoint.from_dict({"step": i}))
+
+        trainer = train.DataParallelTrainer(
+            train_fn,
+            train_loop_config={"steps": steps},
+            scaling_config=train.ScalingConfig(
+                num_workers=2, min_workers=1,
+                placement_strategy="SPREAD",
+                resources_per_worker={"CPU": 1}),
+            run_config=train.RunConfig(
+                failure_config=train.FailureConfig(max_failures=0)),
+        )
+        try:
+            result = trainer.fit()
+        except Exception as e:  # noqa: BLE001
+            if not self._stop.is_set():
+                self.violations.append(f"gang probe crashed: {e!r}")
+            return
+        if result.error is not None:
+            self.violations.append(
+                f"gang probe burned its failure budget "
+                f"(max_failures=0): {result.error!r}")
+            return
+        if not result.metrics or result.metrics.get("step") != steps - 1:
+            self.violations.append(
+                f"gang probe lost steps: last metrics "
+                f"{result.metrics!r}")
+        from ray_tpu.util.goodput import attribution_ok
+
+        gp = result.goodput or {}
+        self.gang_goodput = gp
+        planned, sums = attribution_ok(gp)
+        if not sums:
+            self.violations.append(
+                f"gang probe downtime not fully attributed: {gp!r}")
+        if not planned:
+            self.violations.append(
+                f"gang probe downtime with unplanned cause(s) "
+                f"(every second must be preemption/drain/reschedule): "
+                f"{gp.get('by_cause')!r}")
+        final_pg = trainer.final_pg_state or {}
+        self.gang_reschedules = final_pg.get("reschedules", 0)
+        if final_pg.get("state") != "CREATED":
+            self.violations.append(
+                f"gang probe PG did not end ALIVE: "
+                f"{final_pg.get('state')!r}")
+        else:
+            import ray_tpu
+
+            try:
+                alive = {n["NodeID"] for n in ray_tpu.nodes()
+                         if n["Alive"]}
+                stale = [nid for nid, _bi in
+                         final_pg.get("placement", [])
+                         if nid not in alive]
+                if stale:
+                    self.violations.append(
+                        f"gang probe PG placed on dead node(s) "
+                        f"{stale!r} at completion")
+            except Exception:
+                pass
+
     def _drain_once(self, cluster) -> None:
         """One graceful drain mid-soak with a budget-exemption probe: a
         max_retries=0 task pinned to the drained node must complete."""
@@ -452,6 +540,53 @@ class _Soak:
                         f"{rep.get('node_id')!r}")
         except Exception as e:
             self.violations.append(f"directory/store check: {e!r}")
+        # No leaked per-node bundle reservations: every reservation an
+        # agent still holds must be explained by a live group's
+        # placement on that node (a failed/rolled-back 2PC round or a
+        # kill mid-2PC must never strand a carve-out). Settle-retried:
+        # an in-flight reschedule's PREPARED bundles (or a post-remove
+        # rollback still in the coordinator's hands) are a transient,
+        # self-correcting state, not a leak — only a PERSISTENT orphan
+        # is a violation.
+        def _bundle_leaks() -> list:
+            pgs = cluster.head.rpc_placement_group_table() or {}
+            expected: set = set()
+            pending_pgs = set()
+            for pg_id, pg in pgs.items():
+                if pg.get("state") in ("CREATED", "RESCHEDULING"):
+                    for nid, bi in pg.get("placement", []):
+                        expected.add((nid, f"{pg_id}:{bi}"))
+                elif pg.get("state") == "PENDING":
+                    # A queued group's reserve 2PC may legitimately
+                    # hold PREPARED bundles with placement still [] —
+                    # its prepares can block in pool.acquire for up to
+                    # 60s, past the settle window below.
+                    pending_pgs.add(pg_id)
+            leaks = []
+            for node in list(cluster.nodes):
+                try:
+                    held = node.rpc_bundle_table()
+                except Exception:
+                    continue  # node stopping: nothing held
+                for key in held:
+                    if key.rsplit(":", 1)[0] in pending_pgs:
+                        continue
+                    if (node.node_id, key) not in expected:
+                        leaks.append(
+                            f"leaked bundle reservation {key} on node "
+                            f"{node.node_id[-12:]} (no live placement "
+                            f"group explains it)")
+            return leaks
+
+        try:
+            leak_deadline = time.monotonic() + 30.0
+            leaks = _bundle_leaks()
+            while leaks and time.monotonic() < leak_deadline:
+                time.sleep(1.0)
+                leaks = _bundle_leaks()
+            self.violations.extend(leaks)
+        except Exception as e:
+            self.violations.append(f"bundle-leak check: {e!r}")
 
     # -- driver ------------------------------------------------------------
 
@@ -506,6 +641,9 @@ class _Soak:
             train_probe = threading.Thread(
                 target=self._train_probe, args=(deadline,), daemon=True)
             train_probe.start()
+            gang_probe = threading.Thread(
+                target=self._gang_probe, daemon=True)
+            gang_probe.start()
             if serve_handle is not None:
                 threading.Thread(
                     target=self._serve_probe_loop,
@@ -523,6 +661,14 @@ class _Soak:
                 self.violations.append(
                     "train probe wedged past deadline (neither "
                     "reporting nor restarting)")
+            # The gang trial rides the same kill/drain schedule and may
+            # spend windows SHRUNK waiting for bundle reschedules: give
+            # it the train probe's settle budget too.
+            gang_probe.join(timeout=self.duration_s + 240.0)
+            if gang_probe.is_alive():
+                self.violations.append(
+                    "gang probe wedged past deadline (gang neither "
+                    "completing, shrinking, nor regrowing)")
             # Fault quota: a soak that recovered slowly (MTTR probes
             # stretch the schedule on a loaded box) keeps injecting —
             # bounded — until at least 4 DISTINCT fault classes landed
@@ -573,6 +719,8 @@ class _Soak:
             serve_shed=self.serve_shed,
             train_reports=self.train_reports,
             train_goodput=self.train_goodput,
+            gang_goodput=self.gang_goodput,
+            gang_reschedules=self.gang_reschedules,
         )
         ray_tpu.shutdown()
         cluster.shutdown()

@@ -61,6 +61,26 @@ def cmd_status(args):
         print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
     from ray_tpu import state
 
+    pgs = state.placement_groups() or {}
+    active = {pid: pg for pid, pg in pgs.items()
+              if pg.get("state") not in ("REMOVED",)}
+    if active:
+        by_state: dict = {}
+        for pg in active.values():
+            by_state[pg["state"]] = by_state.get(pg["state"], 0) + 1
+        states = ", ".join(f"{n} {s}" for s, n in sorted(by_state.items()))
+        print(f"placement groups: {states}")
+        for pid, pg in sorted(active.items()):
+            n_live = len(pg.get("live_bundles", ()))
+            n_all = len(pg.get("bundles", ()))
+            extra = ""
+            if pg.get("reschedules"):
+                extra += f", {pg['reschedules']} reschedule(s)"
+            if pg["state"] == "RESCHEDULING" and pg.get("reschedule_cause"):
+                extra += f" ({pg['reschedule_cause']})"
+            print(f"  {pid[-12:]:<14} {pg['state']:<12} "
+                  f"bundles {n_live}/{n_all} live{extra}")
+
     snaps = [s for s in state.device_stats() if s.get("available")]
     if snaps:
         # One line per jax-loaded worker process: platform, device

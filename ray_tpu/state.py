@@ -28,6 +28,20 @@ def nodes() -> List[dict]:
     return []
 
 
+def placement_groups(pg_id: Optional[str] = None):
+    """Placement-group table with lifecycle state. Each record carries
+    ``state`` (``PENDING`` -> ``CREATED``, ``RESCHEDULING`` while the
+    head migrates bundles off a dead/draining node, ``INFEASIBLE`` /
+    ``REMOVED``), the ``bundle_nodes`` bundle->node map,
+    ``live_bundles`` (indices whose node is alive and schedulable —
+    what an elastic gang can run on right now), and ``reschedules``
+    (completed bundle migrations). Pass ``pg_id`` for one record."""
+    backend = _worker.backend()
+    if not hasattr(backend, "placement_group_table"):
+        return None if pg_id is not None else {}
+    return backend.placement_group_table(pg_id)
+
+
 def list_tasks(limit: int = 1000) -> List[dict]:
     backend = _worker.backend()
     if hasattr(backend, "list_tasks"):

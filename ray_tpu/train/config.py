@@ -22,6 +22,26 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
     topology: Optional[str] = None  # e.g. "v4-64": 8 hosts x 8 chips
+    # Elastic world size (Podracer-style preemptible fleets): when set,
+    # the trainer keeps ONE placement group across attempts and rides
+    # the head's bundle rescheduling — on bundle loss it re-forms the
+    # collective at the surviving world size (>= min_workers) from the
+    # latest checkpoint, and regrows to num_workers when the group
+    # reports restored capacity. None = fixed gang (an attempt always
+    # waits for all num_workers bundles).
+    min_workers: Optional[int] = None
+
+    def __post_init__(self):
+        if self.min_workers is not None and not (
+                1 <= self.min_workers <= self.num_workers):
+            # Fail at construction: a floor above num_workers can never
+            # be met (the gang has only num_workers bundles) and would
+            # otherwise surface as an opaque 300s wait-for-live-bundles
+            # timeout per attempt.
+            raise ValueError(
+                f"min_workers must be in [1, num_workers]; got "
+                f"min_workers={self.min_workers} with "
+                f"num_workers={self.num_workers}")
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

@@ -79,6 +79,15 @@ class TrainingWorkerPreempted(ActorError):
     task retry-budget preemption exemption)."""
 
 
+class TrainingGroupResized(ActorError):
+    """An elastic gang's placement group reports restored capacity
+    (the head finished rescheduling lost bundles onto healthy nodes)
+    while the current attempt runs at a SHRUNK world size: restart from
+    the latest checkpoint at the larger size. A planned regrow, not a
+    failure — exempt from ``FailureConfig.max_failures``; its downtime
+    is attributed to the ``reschedule`` cause."""
+
+
 class _TrainWorker:
     """Actor hosting one training worker (rank)."""
 
@@ -160,23 +169,40 @@ class _TrainWorker:
 
 class WorkerGroup:
     """N worker actors inside one placement group
-    (``train/_internal/worker_group.py:92``)."""
+    (``train/_internal/worker_group.py:92``).
 
-    def __init__(self, scaling: ScalingConfig):
+    Default (fixed gang): owns a fresh group sized for the full
+    ``scaling.num_workers``. Elastic: the trainer passes the ONE
+    long-lived group it holds across attempts plus the bundle indices
+    that currently have a live node — this attempt runs at that
+    (possibly shrunk) world size while the head's reschedule
+    coordinator migrates the lost bundles in the background."""
+
+    def __init__(self, scaling: ScalingConfig,
+                 num_workers: Optional[int] = None,
+                 pg=None, bundle_indices: Optional[List[int]] = None):
         self.scaling = scaling
-        bundles = scaling.as_placement_group_bundles()
-        self.pg = placement_group(bundles, strategy=scaling.placement_strategy)
-        ray_tpu.get(self.pg.ready(), timeout=120)
+        self.owns_pg = pg is None
+        self.num_workers = num_workers or scaling.num_workers
+        if pg is None:
+            bundles = scaling.as_placement_group_bundles()
+            pg = placement_group(
+                bundles, strategy=scaling.placement_strategy)
+            ray_tpu.get(pg.ready(), timeout=120)
+        self.pg = pg
+        if bundle_indices is None:
+            bundle_indices = list(range(self.num_workers))
+        self.bundle_indices = list(bundle_indices)[: self.num_workers]
         worker_cls = ray_tpu.remote(_TrainWorker)
         self.workers = [
             worker_cls.options(
                 num_cpus=0,
                 scheduling_strategy=PlacementGroupSchedulingStrategy(
                     placement_group=self.pg,
-                    placement_group_bundle_index=i,
+                    placement_group_bundle_index=self.bundle_indices[i],
                 ),
             ).remote(i)
-            for i in range(scaling.num_workers)
+            for i in range(self.num_workers)
         ]
 
     def run_all(self, train_fn, config, session_kwargs_per_worker) -> list:
@@ -191,10 +217,11 @@ class WorkerGroup:
                 ray_tpu.kill(w)
             except Exception:
                 pass
-        try:
-            remove_placement_group(self.pg)
-        except Exception:
-            pass
+        if self.owns_pg:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
 
 
 class _CheckpointManager:
@@ -258,10 +285,19 @@ class DataParallelTrainer:
     def _run_attempt(
         self, ckpt_mgr: _CheckpointManager, metrics_history: List[dict],
         ledger: Optional["_GoodputLedger"] = None,
+        pg=None,
     ) -> Optional[dict]:
         """Run the worker group to completion; returns last metrics.
-        Raises on worker failure (caller handles elasticity)."""
+        Raises on worker failure (caller handles elasticity). With
+        ``pg`` (the elastic path's long-lived group) the attempt runs
+        on the bundles that currently have a live node — shrunk world
+        size while the head migrates the rest — and a regrow watcher
+        interrupts it when the group's capacity is whole again."""
         n = self.scaling.num_workers
+        bundle_indices: Optional[List[int]] = None
+        if pg is not None:
+            bundle_indices = self._wait_live_bundles(pg)[:n]
+            n = len(bundle_indices)
         drain_stop = threading.Event()
         drained_nodes: set = set()
         # Subscribe to drain events BEFORE placing anything: a preemption
@@ -273,8 +309,21 @@ class DataParallelTrainer:
             target=self._watch_drains,
             args=(drained_nodes, drain_stop), daemon=True,
         ).start()
-        group = WorkerGroup(self.scaling)
-        queue = Queue()
+        regrow_evt: Optional[threading.Event] = None
+        if pg is not None and n < self.scaling.num_workers:
+            regrow_evt = threading.Event()
+            threading.Thread(
+                target=self._watch_regrow,
+                args=(pg, n, regrow_evt, drain_stop), daemon=True,
+            ).start()
+        group = WorkerGroup(self.scaling, num_workers=n, pg=pg,
+                            bundle_indices=bundle_indices)
+        # Pinned to the driver's node: a results queue riding a node a
+        # preemption takes would read as a budget-consuming trial
+        # failure (see queue.driver_node_options).
+        from ray_tpu.util.queue import driver_node_options
+
+        queue = Queue(actor_options=driver_node_options())
         try:
             shards = {
                 name: _shard_dataset(ds, n) for name, ds in self.datasets.items()
@@ -300,7 +349,7 @@ class DataParallelTrainer:
             return self._consume_results(
                 queue, run_refs, n, ckpt_mgr, metrics_history,
                 drained_nodes=drained_nodes, group_nodes=set(node_ids),
-                ledger=ledger,
+                ledger=ledger, regrow_evt=regrow_evt,
             )
         finally:
             drain_stop.set()
@@ -366,6 +415,63 @@ class DataParallelTrainer:
             except Exception:
                 pass
 
+    @staticmethod
+    def _pg_table(pg) -> dict:
+        from ray_tpu.util.placement_group import placement_group_table
+
+        return placement_group_table(pg) or {}
+
+    def _live_bundles(self, pg) -> List[int]:
+        """Bundle indices whose node is alive and schedulable right now
+        (the head's table carries them; a backend without per-bundle
+        liveness — the local backend — reports all bundles once the
+        group is CREATED)."""
+        table = self._pg_table(pg)
+        live = table.get("live_bundles")
+        if live is None:
+            if table.get("state") == "CREATED":
+                return list(range(len(table.get("bundles") or
+                                      [None] * self.scaling.num_workers)))
+            return []
+        return list(live)
+
+    def _wait_live_bundles(self, pg, timeout: float = 300.0) -> List[int]:
+        """Block until at least ``min_workers`` bundles have live nodes
+        (the elastic floor): a gang that lost everything waits for the
+        head's reschedule coordinator to land replacements rather than
+        burning an attempt on an unplaceable world."""
+        floor = max(1, self.scaling.min_workers or self.scaling.num_workers)
+        deadline = time.monotonic() + timeout
+        while True:
+            live = self._live_bundles(pg)
+            if len(live) >= floor:
+                return sorted(live)
+            state = self._pg_table(pg).get("state")
+            if state in ("REMOVED", "INFEASIBLE"):
+                raise RuntimeError(
+                    f"elastic gang placement group is {state}")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"elastic gang never reached min_workers={floor} "
+                    f"live bundles within {timeout}s (live={live})")
+            time.sleep(0.25)
+
+    def _watch_regrow(self, pg, current_n: int,
+                      regrow_evt: threading.Event,
+                      stop_evt: threading.Event) -> None:
+        """Poll the group's table while an attempt runs SHRUNK: the
+        moment more bundles are live than the attempt is using (the
+        head finished rescheduling onto a replacement node), signal the
+        consume loop to restart at the larger world size."""
+        while not stop_evt.is_set():
+            try:
+                if len(self._live_bundles(pg)) > current_n:
+                    regrow_evt.set()
+                    return
+            except Exception:
+                return  # backend shutting down
+            stop_evt.wait(0.5)
+
     def _on_group_start(self, group, node_ranks, local_ranks) -> None:
         """Framework-backend hook run before the training loops start
         (``Backend.on_start`` analog). Default: nothing."""
@@ -375,6 +481,7 @@ class DataParallelTrainer:
         drained_nodes: Optional[set] = None,
         group_nodes: Optional[set] = None,
         ledger: Optional["_GoodputLedger"] = None,
+        regrow_evt: Optional[threading.Event] = None,
     ) -> Optional[dict]:
         """TrainingIterator: drain worker reports; rank-0 metrics win
         (``train/trainer.py:155 _fetch_next_result``)."""
@@ -390,6 +497,13 @@ class DataParallelTrainer:
                 raise TrainingWorkerPreempted(
                     "a training worker's node is draining; restarting "
                     "the group from the latest checkpoint")
+            if regrow_evt is not None and regrow_evt.is_set():
+                # Capacity restored while running shrunk: re-form the
+                # collective at the larger world size from the latest
+                # checkpoint (planned, budget-exempt).
+                raise TrainingGroupResized(
+                    "gang capacity restored; regrowing the group from "
+                    "the latest checkpoint")
             # Fail fast if a worker actor died (its queue would stay silent).
             ready, _ = ray_tpu.wait(run_refs, num_returns=n, timeout=0.0)
             for r in ready:
@@ -423,42 +537,116 @@ class DataParallelTrainer:
         max_failures = self.run_config.failure_config.max_failures
         ledger = _GoodputLedger()
         attempt = 0
-        while True:
-            try:
-                last_metrics = self._run_attempt(
-                    ckpt_mgr, metrics_history, ledger)
-                return Result(
-                    metrics=last_metrics,
-                    checkpoint=ckpt_mgr.best,
-                    metrics_history=metrics_history,
-                    goodput=ledger.summary(),
-                )
-            except TrainingWorkerPreempted as e:
-                # Preemption exemption: a planned node departure restarts
-                # the group (from the latest checkpoint) WITHOUT
-                # consuming the failure budget.
-                ledger.mark_down(_goodput.downtime_cause(e))
-                time.sleep(0.2)
-            except (ActorError, TaskError) as e:
-                if _lost_to_drain(e):
-                    # A group actor (worker or results queue) died WITH a
-                    # draining/preempted node before the drain watcher
-                    # could classify it: same exemption, same restart.
-                    ledger.mark_down(_goodput.downtime_cause(e))
-                    time.sleep(0.2)
-                    continue
-                ledger.mark_down("failure")
-                attempt += 1
-                if max_failures >= 0 and attempt > max_failures:
+        elastic = self.scaling.min_workers is not None
+        pg = None
+        # Terminal snapshot of the elastic gang's PG table (state /
+        # placement / reschedule count), captured before the group is
+        # released — the chaos harness's "PG ends ALIVE" invariant
+        # reads it off the finished trainer.
+        self.final_pg_state: Optional[dict] = None
+        if elastic:
+            # ONE long-lived reservation for the whole fit(): bundle
+            # loss moves it to RESCHEDULING (the head migrates bundles
+            # to healthy nodes) instead of killing it — attempts shrink
+            # to the live bundles and regrow when capacity returns.
+            bundles = self.scaling.as_placement_group_bundles()
+            pg = placement_group(
+                bundles, strategy=self.scaling.placement_strategy)
+            ray_tpu.get(pg.ready(), timeout=120)
+        try:
+            while True:
+                resched_before = (
+                    self._pg_table(pg).get("reschedules", 0)
+                    if pg is not None else 0)
+                try:
+                    last_metrics = self._run_attempt(
+                        ckpt_mgr, metrics_history, ledger, pg=pg)
                     return Result(
-                        metrics=metrics_history[-1] if metrics_history else None,
+                        metrics=last_metrics,
                         checkpoint=ckpt_mgr.best,
-                        error=e,
                         metrics_history=metrics_history,
                         goodput=ledger.summary(),
                     )
-                # Elastic restart: new group resumes from latest checkpoint.
-                time.sleep(0.2)
+                except TrainingWorkerPreempted as e:
+                    # Preemption exemption: a planned node departure
+                    # restarts the group (from the latest checkpoint)
+                    # WITHOUT consuming the failure budget.
+                    ledger.mark_down(_goodput.downtime_cause(e))
+                    time.sleep(0.2)
+                except TrainingGroupResized:
+                    # Planned regrow to restored capacity: exempt, and
+                    # the restart cost is the reschedule's to carry.
+                    ledger.mark_down("reschedule")
+                    time.sleep(0.2)
+                except (ActorError, TaskError) as e:
+                    if _lost_to_drain(e):
+                        # A group actor (worker or results queue) died
+                        # WITH a draining/preempted node before the
+                        # drain watcher could classify it: same
+                        # exemption, same restart.
+                        ledger.mark_down(_goodput.downtime_cause(e))
+                        time.sleep(0.2)
+                        continue
+                    if elastic and self._gang_migrating(pg, resched_before):
+                        # A gang bundle's node died outright (hard spot
+                        # preemption, no notice): the reservation is
+                        # RESCHEDULING, not dead — on a preemptible
+                        # fleet this is the normal case, not a failure.
+                        # Restart shrunk from the latest checkpoint,
+                        # budget intact.
+                        ledger.mark_down("preemption")
+                        time.sleep(0.2)
+                        continue
+                    ledger.mark_down("failure")
+                    attempt += 1
+                    if max_failures >= 0 and attempt > max_failures:
+                        return Result(
+                            metrics=metrics_history[-1]
+                            if metrics_history else None,
+                            checkpoint=ckpt_mgr.best,
+                            error=e,
+                            metrics_history=metrics_history,
+                            goodput=ledger.summary(),
+                        )
+                    # Elastic restart: new group resumes from latest
+                    # checkpoint.
+                    time.sleep(0.2)
+        finally:
+            if pg is not None:
+                try:
+                    table = self._pg_table(pg)
+                    if table.get("state") == "RESCHEDULING":
+                        # The trial finished at shrunk world size while
+                        # the head was still migrating the lost
+                        # bundles: let the reservation settle (bounded)
+                        # so the terminal snapshot — the "gang ended
+                        # ALIVE on healthy nodes" evidence — reflects
+                        # the migration's outcome, not its midpoint.
+                        settle = time.monotonic() + 20.0
+                        while time.monotonic() < settle and \
+                                table.get("state") == "RESCHEDULING":
+                            time.sleep(0.25)
+                            table = self._pg_table(pg)
+                    self.final_pg_state = table
+                except Exception:
+                    pass
+                try:
+                    remove_placement_group(pg)
+                except Exception:
+                    pass
+
+    def _gang_migrating(self, pg, resched_before: int) -> bool:
+        """Is this attempt's loss a gang-bundle node loss the head is
+        already migrating (PG RESCHEDULING now, or a reschedule
+        completed since the attempt started)?"""
+        if pg is None:
+            return False
+        try:
+            table = self._pg_table(pg)
+        except Exception:
+            return False
+        return (table.get("state") == "RESCHEDULING"
+                or table.get("reschedules", 0) > resched_before)
 
 
 class JaxTrainer(DataParallelTrainer):

@@ -146,7 +146,13 @@ class TrialRunner:
         self.sync = sync
         self.experiment_meta: dict = {}  # metric/mode etc., persisted too
         self._persisted_sig = None
-        self.queue = Queue()
+        # Pinned to the driver's node: the shared results queue riding a
+        # node a drain/preemption takes would masquerade as a
+        # drain-caused failure of EVERY trial wired to it — retried
+        # exempt, forever (see queue.driver_node_options).
+        from ray_tpu.util.queue import driver_node_options
+
+        self.queue = Queue(actor_options=driver_node_options())
         self._actor_cls = ray_tpu.remote(_TrialActor)
 
     # -- experiment persistence -------------------------------------------
@@ -311,7 +317,7 @@ class TrialRunner:
         trial.status = RUNNING
         return True
 
-    def _stop_actor(self, trial: Trial):
+    def _stop_actor(self, trial: Trial, keep_pg: bool = False):
         if trial.actor is not None:
             try:
                 ray_tpu.kill(trial.actor)
@@ -319,9 +325,13 @@ class TrialRunner:
                 pass
         trial.actor = None
         trial.run_ref = None
-        if trial.pg is not None:
+        if trial.pg is not None and not keep_pg:
             # Release the gang reservation so the next pending trial's
-            # placement group can commit.
+            # placement group can commit. Drain/preemption-exempt
+            # restarts KEEP it: the head is migrating its bundles
+            # (RESCHEDULING -> CREATED on healthy nodes), and the
+            # retried trial re-enters the same reservation instead of
+            # re-queuing a fresh gang behind everyone else.
             from ray_tpu.util.placement_group import remove_placement_group
 
             try:
@@ -468,13 +478,23 @@ class TrialRunner:
             except (ActorError, TaskError) as e:
                 from ray_tpu.util import goodput as _goodput
 
-                trial.mark_down(_goodput.downtime_cause(e))
-                trial.num_failures += 1
-                if trial.num_failures <= self.max_failures:
+                cause = _goodput.downtime_cause(e)
+                trial.mark_down(cause)
+                # Retry-budget exemption, extended from actors to gangs
+                # (the PR-2 discipline): a trial lost to a planned
+                # drain / preemption restarts WITHOUT consuming
+                # max_failures, and a gang trial keeps its placement
+                # group — the head is rescheduling its bundles onto
+                # healthy nodes, so the retry waits for the SAME
+                # reservation to come back instead of burning it.
+                exempt = cause == "preemption" or cause.startswith("drain")
+                if not exempt:
+                    trial.num_failures += 1
+                if exempt or trial.num_failures <= self.max_failures:
                     # Retry from the last checkpoint; back to PENDING so
                     # the event loop restarts it (a gang trial may need
                     # to wait for its new PG without blocking the loop).
-                    self._stop_actor(trial)
+                    self._stop_actor(trial, keep_pg=exempt)
                     trial.status = PENDING
                     continue
                 trial.status = ERROR

@@ -66,6 +66,12 @@ SITES = frozenset({
     "head.drain.before_migrate",
     "head.restart_actor.tick",
     "head.snapshot.before_persist",
+    # placement-group 2PC + reschedule coordinator (mid-2PC crashes,
+    # severed prepare/commit replies, coordinator death are all
+    # injectable)
+    "head.pg.before_reschedule",
+    "head.pg.prepare",
+    "head.pg.commit",
     # node agent
     "agent.lease.push",
     "agent.dispatch.before_push",

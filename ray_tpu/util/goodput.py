@@ -180,6 +180,23 @@ def downtime_cause(exc: BaseException) -> str:
     return "failure"
 
 
+def attribution_ok(goodput: dict) -> Tuple[bool, bool]:
+    """The ledger-contract check every preemption harness shares:
+    ``(planned, sums)`` — *planned* is True when every ``by_cause`` key
+    is a planned cause (``preemption`` / ``reschedule`` /
+    ``drain:<reason>``/``drain``), *sums* when the causes sum exactly
+    (1e-6) to ``downtime_s``. One implementation so the chaos soak and
+    the gang bench can never disagree about what "fully attributed"
+    means."""
+    by_cause = goodput.get("by_cause") or {}
+    planned = all(
+        c in ("preemption", "reschedule") or c.startswith("drain")
+        for c in by_cause)
+    sums = abs(sum(by_cause.values())
+               - (goodput.get("downtime_s") or 0.0)) < 1e-6
+    return planned, sums
+
+
 class GoodputLedger:
     """Attributes every non-productive second of a trial's wall time to
     a cause (the PR-2/PR-5 plumbing: drain reason, preemption, plain

@@ -272,6 +272,23 @@ NODE_DRAIN_ACTORS_MIGRATED = Counter(
     "Actors proactively reconstructed off draining nodes",
     tag_keys=("reason",),
 )
+# -- placement-group rescheduling (head-side; the gang-migration half of
+# the drain/preemption plane: one increment per completed bundle
+# migration, and the wall time from losing a bundle's node to the
+# reservation being whole again on healthy nodes).
+PG_RESCHEDULES_TOTAL = Counter(
+    "ray_tpu_pg_reschedules_total",
+    "Completed placement-group reschedules, by trigger cause "
+    "(drain = planned departure, node_death = crash-detected loss)",
+    tag_keys=("cause",),
+)
+PG_RESCHEDULE_SECONDS = Histogram(
+    "ray_tpu_pg_reschedule_seconds",
+    "Wall time from a gang bundle losing its node to the group's "
+    "reservation being CREATED again on healthy nodes",
+    boundaries=[0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                120.0],
+)
 
 # -- head control plane (head-side; the contention instrumentation the
 # 100k-task/1k-actor envelope reads: per-method handler latency on the

@@ -21,6 +21,27 @@ class Full(Exception):
     pass
 
 
+def driver_node_options() -> Optional[dict]:
+    """``actor_options`` pinning a queue's actor to the DRIVER's node.
+
+    The default zero-demand round-robin can land a results queue on any
+    node — including one a drain/preemption is about to take — and a
+    dead queue masquerades as a failure of every consumer wired to it
+    (a trial that keeps "failing" with a drain-shaped cause retries
+    exempt forever). The driver's node is the one node the consumer
+    already cannot outlive; None on the local backend (placement is
+    moot there)."""
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    node_id = getattr(worker_mod.backend(), "node_id", None)
+    if node_id is None:
+        return None
+    return {"scheduling_strategy": NodeAffinitySchedulingStrategy(node_id)}
+
+
 class _QueueActor:
     def __init__(self, maxsize: int):
         self.maxsize = maxsize
