@@ -343,6 +343,135 @@ def gpt2_loss(params: Params, batch: dict[str, jax.Array], cfg: GPT2Config) -> j
     return jnp.mean(lse - picked)
 
 
+# -- autoregressive decoding (serving path) --------------------------------
+#
+# The serving engine (``ray_tpu/serve/llm_engine.py``) owns ONE jitted
+# decode step over a fixed ``[max_batch, ...]`` state and admits requests
+# between steps, so these functions are shape-stable by construction:
+#
+# * ``gpt2_init_cache``   — slot-indexed ring KV-cache in device memory,
+#   ``[n_layer, slots, cache_len, n_head, head_dim]`` in the activation
+#   dtype (bf16 by default — no fp32 cache copy ever materializes);
+# * ``gpt2_prefill``      — the second jitted shape: a fixed
+#   ``[rows, prompt_len]`` chunked-prefill lane writing each prompt's
+#   K/V into its slot's cache rows and sampling the FIRST token from the
+#   last real position's logits;
+# * ``gpt2_decode_step``  — one token for every slot: write this token's
+#   K/V at the slot's ring cursor (``lax.dynamic_update_slice`` vmapped
+#   over slots), attend over the valid cache window, next-token logits.
+#
+# Ring semantics: the write cursor is ``pos % cache_len`` and the
+# attention mask covers ``min(pos + 1, cache_len)`` entries — a
+# generation longer than the cache degrades to sliding-window attention
+# instead of erroring. Positions (wpe rows) use the absolute position,
+# clamped to ``seq_len``.
+
+
+def gpt2_init_cache(cfg: GPT2Config, slots: int, cache_len: int) -> Params:
+    """Ring KV-cache for ``slots`` concurrent sequences (bf16 by default:
+    the cache rides ``cfg.dtype``, never fp32)."""
+    shape = (cfg.n_layer, slots, cache_len, cfg.n_head, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def gpt2_decode_step(params: Params, cache: Params, tokens: jax.Array,
+                     pos: jax.Array, cfg: GPT2Config
+                     ) -> tuple[jax.Array, Params]:
+    """One decode iteration for every slot.
+
+    tokens [S] int32 (the slot's current token), pos [S] int32 (its
+    absolute position). Writes each token's K/V at the slot's ring
+    cursor, attends over the valid window, and returns
+    (logits [S, V] fp32, new cache). Free slots simply compute garbage
+    into their own cache rows — the fixed shape is the point."""
+    s = tokens.shape[0]
+    d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
+    cache_len = cache["k"].shape[2]
+    dt = cfg.dtype
+    cursor = jnp.mod(pos, cache_len)
+    valid = jnp.minimum(pos + 1, cache_len)
+    wpe_pos = jnp.clip(pos, 0, cfg.seq_len - 1)
+    x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[wpe_pos]
+
+    from ray_tpu.ops.attention import (cache_write_token,
+                                       cached_decode_attention)
+
+    def block(x, layer):
+        p, k_cache, v_cache = layer
+        y = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+        qkv = y @ p["attn_qkv_w"].astype(dt) + p["attn_qkv_b"].astype(dt)
+        q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+        k_cache = cache_write_token(
+            k_cache, k_new.reshape(s, 1, h, hd), cursor)
+        v_cache = cache_write_token(
+            v_cache, v_new.reshape(s, 1, h, hd), cursor)
+        attn = cached_decode_attention(
+            q.reshape(s, h, hd), k_cache, v_cache, valid, dt)
+        x = x + attn.reshape(s, d) @ p["attn_out_w"].astype(dt) \
+            + p["attn_out_b"].astype(dt)
+        y = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+        y = y @ p["mlp_in_w"].astype(dt) + p["mlp_in_b"].astype(dt)
+        y = jax.nn.gelu(y, approximate=True)
+        x = x + y @ p["mlp_out_w"].astype(dt) + p["mlp_out_b"].astype(dt)
+        return x, (k_cache, v_cache)
+
+    x, (k_all, v_all) = jax.lax.scan(
+        block, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    logits = jnp.einsum(
+        "sd,vd->sv", x, params["wte"].astype(dt),
+        preferred_element_type=jnp.float32)
+    return logits, {"k": k_all, "v": v_all}
+
+
+def gpt2_prefill(params: Params, cache: Params, tokens: jax.Array,
+                 slots: jax.Array, lengths: jax.Array, cfg: GPT2Config
+                 ) -> tuple[jax.Array, Params]:
+    """Chunked-prefill lane: the engine's SECOND (and only other) jitted
+    shape.
+
+    tokens [R, P] int32 zero-padded prompts, slots [R] int32 (each row's
+    target cache slot; point unused rows at a scratch slot), lengths [R]
+    int32. Runs the full causal forward over the padded window, writes
+    rows ``[0, P)`` of each target slot's K/V cache, and returns
+    (logits [R, V] fp32 at each prompt's last real token, new cache).
+    Rows past a prompt's length hold pad garbage; the decode mask never
+    reads them — the slot's own later writes overwrite them in order."""
+    r, p_len = tokens.shape
+    d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
+    dt = cfg.dtype
+    x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[:p_len]
+    from ray_tpu.ops.attention import cache_write_prompt
+
+    def block(x, layer):
+        p, k_cache, v_cache = layer
+        y = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+        qkv = y @ p["attn_qkv_w"].astype(dt) + p["attn_qkv_b"].astype(dt)
+        q, k_, v_ = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(r, p_len, h, hd)
+        k_ = k_.reshape(r, p_len, h, hd)
+        v_ = v_.reshape(r, p_len, h, hd)
+        attn = causal_attention(q, k_, v_, use_flash=False)
+        k_cache = cache_write_prompt(k_cache, k_, slots)
+        v_cache = cache_write_prompt(v_cache, v_, slots)
+        x = x + attn.reshape(r, p_len, d) @ p["attn_out_w"].astype(dt) \
+            + p["attn_out_b"].astype(dt)
+        y = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+        y = y @ p["mlp_in_w"].astype(dt) + p["mlp_in_b"].astype(dt)
+        y = jax.nn.gelu(y, approximate=True)
+        x = x + y @ p["mlp_out_w"].astype(dt) + p["mlp_out_b"].astype(dt)
+        return x, (k_cache, v_cache)
+
+    x, (k_all, v_all) = jax.lax.scan(
+        block, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    last = x[jnp.arange(r), jnp.clip(lengths - 1, 0, p_len - 1)]  # [R, D]
+    logits = jnp.einsum(
+        "rd,vd->rv", last, params["wte"].astype(dt),
+        preferred_element_type=jnp.float32)
+    return logits, {"k": k_all, "v": v_all}
+
+
 def gpt2_flops_per_token(cfg: GPT2Config, seq_len: int | None = None) -> float:
     """Training FLOPs/token: 6*N for matmuls + attention score/value FLOPs.
 

@@ -266,6 +266,130 @@ def llama_loss(params: Params, batch: dict[str, jax.Array],
     return jnp.mean(lse - picked)
 
 
+# -- autoregressive decoding (serving path) --------------------------------
+#
+# Same contract as the GPT-2 decode API (``models/gpt2.py``): one jitted
+# decode step over a fixed slot batch + one jitted chunked-prefill lane,
+# with a slot-indexed ring KV-cache. The cache rides the GQA layout —
+# only ``n_kv_head`` heads are cached (``[n_layer, slots, cache_len,
+# n_kv_head, head_dim]`` in the activation dtype, bf16 by default), and
+# query-head groups re-read the shared KV at attention time, so the GQA
+# bandwidth saving carries straight into serving HBM footprint.
+
+
+def llama_init_cache(cfg: LlamaConfig, slots: int, cache_len: int) -> Params:
+    shape = (cfg.n_layer, slots, cache_len, cfg.n_kv_head, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _rope_at(x: jax.Array, pos: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding for ONE token per slot at an absolute position:
+    x [S, H, D], pos [S] int32."""
+    s, h, d = x.shape
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = pos.astype(jnp.float32)[:, None] * freqs[None, :]  # [S, half]
+    cos = jnp.cos(angles)[:, None, :]  # [S, 1, half]
+    sin = jnp.sin(angles)[:, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def llama_decode_step(params: Params, cache: Params, tokens: jax.Array,
+                      pos: jax.Array, cfg: LlamaConfig
+                      ) -> tuple[jax.Array, Params]:
+    """One decode iteration for every slot: tokens [S] int32, pos [S]
+    int32 -> (logits [S, V] fp32, new cache). See gpt2_decode_step for
+    the ring-cursor/mask contract."""
+    s = tokens.shape[0]
+    nh, nkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    cache_len = cache["k"].shape[2]
+    dt = cfg.dtype
+    cursor = jnp.mod(pos, cache_len)
+    valid = jnp.minimum(pos + 1, cache_len)
+    x = params["embed"].astype(dt)[tokens]  # [S, D]
+    from ray_tpu.ops.attention import (cache_write_token,
+                                       cached_decode_attention)
+
+    def block(x, layer):
+        p, k_cache, v_cache = layer
+        y = _rms_norm(x, p["attn_norm"])
+        q = _rope_at((y @ p["wq"].astype(dt)).reshape(s, nh, hd),
+                     pos, cfg.rope_theta)
+        k_new = _rope_at((y @ p["wk"].astype(dt)).reshape(s, nkv, hd),
+                         pos, cfg.rope_theta)
+        v_new = (y @ p["wv"].astype(dt)).reshape(s, nkv, hd)
+        k_cache = cache_write_token(k_cache, k_new[:, None], cursor)
+        v_cache = cache_write_token(v_cache, v_new[:, None], cursor)
+        # GQA: expand the cached KV heads to the query heads at read
+        # time (the cache itself stays n_kv_head wide).
+        rep = nh // nkv
+        attn = cached_decode_attention(
+            q, jnp.repeat(k_cache, rep, axis=2),
+            jnp.repeat(v_cache, rep, axis=2), valid, dt)
+        x = x + attn.reshape(s, nh * hd) @ p["wo"].astype(dt)
+        y = _rms_norm(x, p["mlp_norm"])
+        gate = y @ p["w_gate"].astype(dt)
+        up = y @ p["w_up"].astype(dt)
+        x = x + (jax.nn.silu(gate) * up) @ p["w_down"].astype(dt)
+        return x, (k_cache, v_cache)
+
+    x, (k_all, v_all) = jax.lax.scan(
+        block, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum(
+        "sd,dv->sv", x, params["lm_head"].astype(dt),
+        preferred_element_type=jnp.float32)
+    return logits, {"k": k_all, "v": v_all}
+
+
+def llama_prefill(params: Params, cache: Params, tokens: jax.Array,
+                  slots: jax.Array, lengths: jax.Array, cfg: LlamaConfig
+                  ) -> tuple[jax.Array, Params]:
+    """Chunked-prefill lane (fixed [R, P] shape): full causal forward
+    over the padded prompts, K/V written into each row's target slot,
+    logits at each prompt's last real token. Same pad-garbage contract
+    as gpt2_prefill."""
+    r, p_len = tokens.shape
+    nh, nkv, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]
+    from ray_tpu.ops.attention import cache_write_prompt
+
+    def block(x, layer):
+        p, k_cache, v_cache = layer
+        y = _rms_norm(x, p["attn_norm"])
+        q = _rope((y @ p["wq"].astype(dt)).reshape(r, p_len, nh, hd),
+                  cfg.rope_theta)
+        k_ = _rope((y @ p["wk"].astype(dt)).reshape(r, p_len, nkv, hd),
+                   cfg.rope_theta)
+        v_ = (y @ p["wv"].astype(dt)).reshape(r, p_len, nkv, hd)
+        k_cache = cache_write_prompt(k_cache, k_, slots)
+        v_cache = cache_write_prompt(v_cache, v_, slots)
+        rep = nh // nkv
+        attn = causal_attention(
+            q, jnp.repeat(k_, rep, axis=2), jnp.repeat(v_, rep, axis=2),
+            use_flash=False)
+        x = x + attn.reshape(r, p_len, nh * hd) @ p["wo"].astype(dt)
+        y = _rms_norm(x, p["mlp_norm"])
+        gate = y @ p["w_gate"].astype(dt)
+        up = y @ p["w_up"].astype(dt)
+        x = x + (jax.nn.silu(gate) * up) @ p["w_down"].astype(dt)
+        return x, (k_cache, v_cache)
+
+    x, (k_all, v_all) = jax.lax.scan(
+        block, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["final_norm"])
+    last = x[jnp.arange(r), jnp.clip(lengths - 1, 0, p_len - 1)]
+    logits = jnp.einsum(
+        "rd,dv->rv", last, params["lm_head"].astype(dt),
+        preferred_element_type=jnp.float32)
+    return logits, {"k": k_all, "v": v_all}
+
+
 def llama_flops_per_token(cfg: LlamaConfig,
                           seq_len: int | None = None) -> float:
     """6*N matmul FLOPs + causal attention score/value FLOPs."""

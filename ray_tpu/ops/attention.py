@@ -71,3 +71,55 @@ def causal_attention(
                 # The caller asked for flash by name; do not silently degrade.
                 raise
     return xla_causal_attention(q, k, v, softmax_scale=softmax_scale)
+
+
+# -- KV-cache writes (serving decode path) ----------------------------------
+#
+# Shared by the GPT-2 and Llama decode APIs (``models/gpt2.py`` /
+# ``models/llama.py``): the head-count axis differs (full vs GQA
+# ``n_kv_head``) but the cursor-write contract is identical, so it lives
+# here once.
+
+
+def cache_write_token(cache: jax.Array, rows: jax.Array,
+                      cursor: jax.Array) -> jax.Array:
+    """Per-slot ring-cursor write of ONE token's K or V rows.
+
+    cache [S, L, H, hd], rows [S, 1, H, hd], cursor [S] int32 — each
+    slot's row lands at its own cursor (vmapped dynamic_update_slice)."""
+    return jax.vmap(
+        lambda c, r, i: jax.lax.dynamic_update_slice(
+            c, r.astype(c.dtype), (i, 0, 0))
+    )(cache, rows, cursor)
+
+
+def cache_write_prompt(cache: jax.Array, rows: jax.Array,
+                       slots: jax.Array) -> jax.Array:
+    """Prefill-lane write: row block ``rows[i]`` ([P, H, hd]) lands at
+    rows ``[0, P)`` of cache slot ``slots[i]``. Sequential over the
+    (small, static) prefill-row axis — each write must see the prior
+    ones, and distinct slots make the order immaterial."""
+    def body(i, c):
+        return jax.lax.dynamic_update_slice(
+            c, rows[i][None].astype(c.dtype), (slots[i], 0, 0, 0))
+    return jax.lax.fori_loop(0, rows.shape[0], body, cache)
+
+
+def cached_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                            valid: jax.Array, out_dtype) -> jax.Array:
+    """One query token per slot over the slot's ring-cache window.
+
+    q [S, H, hd]; k/v [S, L, H, hd] (GQA callers expand KV heads to the
+    query heads first); valid [S] = live cache entries (the ring mask).
+    fp32 scores/softmax, output cast to the activation dtype — shared
+    by both model families' decode steps so the masking/scaling
+    contract lives here once."""
+    hd = q.shape[-1]
+    scores = jnp.einsum(
+        "shd,slhd->shl", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / (hd ** 0.5)
+    mask = jnp.arange(k.shape[1])[None, :] < valid[:, None]  # [S, L]
+    weights = jax.nn.softmax(
+        jnp.where(mask[:, None, :], scores, -1e30), axis=-1)
+    out = jnp.einsum("shl,slhd->shd", weights, v.astype(jnp.float32))
+    return out.astype(out_dtype)

@@ -26,7 +26,7 @@ FILENAME = "BENCH_TPU_SESSIONS.jsonl"
 KNOWN_BENCHES = frozenset({
     "task_overhead", "memory_pressure", "chaos_soak", "scalebench",
     "drain_recovery_ms", "serve_latency", "input_pipeline", "goodput",
-    "analyze", "gang_recovery",
+    "analyze", "gang_recovery", "llm_serving",
 })
 
 
@@ -198,6 +198,31 @@ def record_serve_latency(*, client: dict, server: dict, agreement: dict,
         "mode": mode,
         "connections": int(connections),
         "n_requests": int(n_requests),
+        "client": dict(client),
+        "server": dict(server),
+        "agreement": dict(agreement),
+    }
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
+def record_llm_serving(*, client: dict, server: dict, agreement: dict,
+                       streams: int, tokens_s: float, device: str = "",
+                       path: str | None = None, **extra) -> dict:
+    """Continuous-batching LLM serving evidence (``serve_bench --llm``):
+    client-measured TTFT p50/p99 + aggregate tokens/s over N concurrent
+    token streams, the engine-side metric view of the same streams, and
+    the agreement verdict (count-exact TTFT/token totals, quantile
+    agreement, the single-compiled-shape assertion) — a one-sided
+    throughput claim is exactly what this bench exists to prevent.
+    Committed to the evidence trail only on an accelerator; returns the
+    entry (with ``committed_to``) either way."""
+    entry: dict = {
+        "bench": "llm_serving",
+        "device": device,
+        "streams": int(streams),
+        "tokens_s": float(tokens_s),
         "client": dict(client),
         "server": dict(server),
         "agreement": dict(agreement),
@@ -429,6 +454,25 @@ def check_line(obj: object, *, allow_header: bool = False) -> list[str]:
                     or not obj.get("trigger"):
                 errs.append("gang_recovery line missing 'trigger' "
                             "(drain | node_death)")
+        elif obj["bench"] == "llm_serving":
+            # The headline IS ttft + throughput, cross-checked: a line
+            # without both views and the verdict is an unverified
+            # serving claim.
+            client = obj.get("client")
+            if not (isinstance(client, dict)
+                    and _is_num(client.get("ttft_p50_ms"))
+                    and _is_num(client.get("ttft_p99_ms"))):
+                errs.append("llm_serving line missing numeric "
+                            "client.ttft_p50_ms/ttft_p99_ms")
+            if not _is_num(obj.get("tokens_s")):
+                errs.append("llm_serving line missing numeric tokens_s")
+            if not isinstance(obj.get("server"), dict):
+                errs.append("llm_serving line missing server dict")
+            agreement = obj.get("agreement")
+            if not (isinstance(agreement, dict)
+                    and isinstance(agreement.get("ok"), bool)):
+                errs.append("llm_serving line missing boolean "
+                            "agreement.ok")
         elif obj["bench"] == "serve_latency":
             # A serve latency line must carry both views AND the
             # agreement verdict — a client-only (or server-only) number

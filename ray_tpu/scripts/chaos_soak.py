@@ -74,6 +74,9 @@ class _Soak:
         self.puts_ok = 0
         self.serve_ok = 0
         self.serve_shed = 0
+        self.llm_ok = 0
+        self.llm_shed = 0
+        self.llm_failed_fast = 0
         self.train_reports = 0
         self.train_goodput: "dict | None" = None
         self.gang_goodput: "dict | None" = None
@@ -189,11 +192,26 @@ class _Soak:
                         {"head.snapshot.before_persist": "raise"},
                         {"client.flush_refs.before": "delay:0.02"},
                         {"agent.heartbeat": "delay:0.2"},
+                        # LLM engine scheduler faults: a delayed decode
+                        # step and a flaky admission — the engine must
+                        # requeue/recover and every probe stream still
+                        # finish, shed typed, or fail fast.
+                        {"serve.llm.before_step": "delay:0.08"},
+                        {"serve.llm.before_admit": "raise,p=0.5"},
                     ])
-                    failpoints.set_failpoints(arm)
+                    # Engine replicas are worker processes: serve.llm
+                    # sites need the cluster-wide control-plane fanout;
+                    # the head/agent/driver sites arm locally (the
+                    # in-process cluster shares this failpoint table).
+                    if any(s.startswith("serve.llm.") for s in arm):
+                        from ray_tpu import state
+
+                        setter = state.set_failpoints
+                    else:
+                        setter = failpoints.set_failpoints
+                    setter(arm)
                     time.sleep(self.rng.uniform(1.0, 3.0))
-                    failpoints.set_failpoints(
-                        {site: None for site in arm})
+                    setter({site: None for site in arm})
             except Exception as e:
                 self.violations.append(f"injecting {fault}: {e!r}")
                 continue
@@ -310,6 +328,58 @@ class _Soak:
                 else:
                     self.serve_shed += 1
             time.sleep(0.5)
+
+    def _llm_probe_setup(self):
+        """Deploy the standing streaming-LLM probe (a small always-on
+        continuous-batching engine) and prove one full stream BEFORE any
+        fault is injected."""
+        from ray_tpu import serve
+        from ray_tpu.serve.llm_engine import LLMEngine
+
+        eng = serve.deployment(
+            name="soak_llm", num_replicas=1,
+            max_concurrent_queries=16)(LLMEngine)
+        handle = serve.run(eng.bind(
+            model="gpt2", max_batch=2, cache_len=32, max_prompt_len=8,
+            max_new_tokens=4))
+        toks = [t for ch in handle.stream([3, 1, 4], 4) for t in ch]
+        if len(toks) != 4:
+            raise RuntimeError(
+                f"llm probe warm-up stream incomplete: {toks!r}")
+        self.llm_ok += 1
+        return handle
+
+    def _llm_probe_loop(self, handle, deadline: float) -> None:
+        """Standing mid-stream invariant under faults: every probe
+        stream must FINISH (all tokens, in order), shed TYPED, or fail
+        fast — a stream that hangs past 40s through a partition/kill
+        lost tokens the decode plane never accounted for, which is the
+        one behavior the never-hang contract cannot absorb."""
+        from ray_tpu.serve._observability import RequestShedError
+
+        while time.monotonic() < deadline and not self._stop.is_set():
+            t0 = time.monotonic()
+            try:
+                toks = [t for ch in handle.options(
+                    deadline_s=20.0).stream([7, 2, 9], 4) for t in ch]
+                if len(toks) == 4:
+                    self.llm_ok += 1
+                else:
+                    self.violations.append(
+                        f"llm probe stream incomplete: {toks!r}")
+            except RequestShedError:
+                self.llm_shed += 1
+            except Exception:  # noqa: BLE001 — classified by duration
+                took = time.monotonic() - t0
+                if self._stop.is_set():
+                    return
+                if took > 40.0:
+                    self.violations.append(
+                        f"llm probe stream HUNG {took:.1f}s (neither "
+                        f"finished, shed, nor failed fast)")
+                else:
+                    self.llm_failed_fast += 1
+            time.sleep(0.8)
 
     def _train_probe(self, deadline: float) -> None:
         """Standing train invariant under faults: a small checkpointing
@@ -628,6 +698,11 @@ class _Soak:
             serve_handle = self._serve_probe_setup()
         except Exception as e:  # noqa: BLE001
             self.violations.append(f"serve probe deploy failed: {e!r}")
+        llm_handle = None
+        try:
+            llm_handle = self._llm_probe_setup()
+        except Exception as e:  # noqa: BLE001
+            self.violations.append(f"llm probe deploy failed: {e!r}")
         injector = threading.Thread(
             target=self._fault_loop, args=(cluster,), daemon=True)
         injector.start()
@@ -648,6 +723,10 @@ class _Soak:
                 threading.Thread(
                     target=self._serve_probe_loop,
                     args=(serve_handle, deadline), daemon=True).start()
+            if llm_handle is not None:
+                threading.Thread(
+                    target=self._llm_probe_loop,
+                    args=(llm_handle, deadline), daemon=True).start()
             time.sleep(min(self.duration_s / 3.0, 10.0))
             self._drain_once(cluster)
             workload.join(timeout=self.duration_s + 180.0)
@@ -698,6 +777,9 @@ class _Soak:
         if serve_handle is not None and self.serve_ok < 1:
             self.violations.append(
                 "serve probe never completed a request")
+        if llm_handle is not None and self.llm_ok < 1:
+            self.violations.append(
+                "llm probe never completed a stream")
         try:
             from ray_tpu import serve
 
@@ -717,6 +799,9 @@ class _Soak:
             script="chaos_soak",
             serve_ok=self.serve_ok,
             serve_shed=self.serve_shed,
+            llm_ok=self.llm_ok,
+            llm_shed=self.llm_shed,
+            llm_failed_fast=self.llm_failed_fast,
             train_reports=self.train_reports,
             train_goodput=self.train_goodput,
             gang_goodput=self.gang_goodput,

@@ -274,6 +274,14 @@ def cmd_serve(args):
                 print(f"    {phase:<12} p50 {ph.get('p50_ms', '—')} ms  "
                       f"mean {ph.get('mean_ms', '—')} ms  "
                       f"n={ph.get('count', 0)}")
+        decode = d.get("decode") or {}
+        if decode:
+            print(f"    decode       streams {decode.get('streams', 0)}  "
+                  f"ttft p50 {decode.get('ttft_p50_ms', '—')} ms  "
+                  f"p99 {decode.get('ttft_p99_ms', '—')} ms  "
+                  f"tokens {decode.get('tokens', 0)}  "
+                  f"steps {decode.get('steps', 0)}  "
+                  f"occ {decode.get('mean_occupancy', '—')}")
     if stats.get("reconcile_s") is not None:
         print(f"controller reconcile: {stats['reconcile_s'] * 1e3:.1f} ms")
 

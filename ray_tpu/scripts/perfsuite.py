@@ -178,6 +178,16 @@ def main() -> None:
                     help="add the serve request-path point "
                          "(concurrent-stream harness + client/server "
                          "latency cross-check)")
+    ap.add_argument("--llm", action="store_true",
+                    help="add the continuous-batching LLM serving "
+                         "point (concurrent token streams + TTFT "
+                         "cross-check + single-compiled-shape "
+                         "assertion; machine-independent step/churn/"
+                         "shed counts)")
+    ap.add_argument("--llm-streams", type=int, default=400,
+                    help="stream count for the --llm stage (the full "
+                         "10k envelope runs via serve_bench --llm "
+                         "directly)")
     ap.add_argument("--input-pipeline", action="store_true",
                     dest="input_pipeline",
                     help="add the training-goodput point "
@@ -215,6 +225,11 @@ def main() -> None:
     if args.serve:
         steps.append([sys.executable, "-m",
                       "ray_tpu.scripts.serve_bench", "--out", args.out])
+    if args.llm:
+        steps.append([sys.executable, "-m",
+                      "ray_tpu.scripts.serve_bench", "--llm",
+                      "--streams", str(args.llm_streams),
+                      "--out", args.out])
     if args.input_pipeline:
         steps.append([sys.executable, "-m",
                       "ray_tpu.scripts.input_bench", "--out", args.out])

@@ -22,11 +22,22 @@ from ray_tpu.serve._observability import RequestShedError
 from ray_tpu.serve._private import (
     CONTROLLER_NAME,
     DEADLINE_HEADER,
+    STREAM_HEADER,
     DeploymentHandle,
     HTTPProxy,
     batch,
     get_or_create_controller,
 )
+
+
+def __getattr__(name: str):
+    # The LLM engine pulls in jax; resolve it lazily so importing serve
+    # on a jax-less control-plane process stays cheap.
+    if name == "LLMEngine":
+        from ray_tpu.serve.llm_engine import LLMEngine
+
+        return LLMEngine
+    raise AttributeError(name)
 
 
 @dataclass
@@ -279,6 +290,8 @@ __all__ = [
     "stats",
     "RequestShedError",
     "DEADLINE_HEADER",
+    "STREAM_HEADER",
+    "LLMEngine",
     "start_http_proxy",
     "start_http_proxies",
     "proxy_ports",

@@ -165,6 +165,26 @@ def record_reconcile(seconds: float) -> None:
     _emit({"k": "rec", "s": float(seconds)})
 
 
+def record_decode_step(deployment: str, seconds: float, occupancy: int,
+                       tokens: int) -> None:
+    """One LLM-engine decode iteration: step wall time, active slots,
+    tokens produced — a single event so a step is never half-recorded."""
+    _emit({"k": "dstep", "d": deployment, "s": float(seconds),
+           "o": int(occupancy), "n": int(tokens)})
+
+
+def record_ttft(deployment: str, seconds: float) -> None:
+    """Time to first token for one admitted stream."""
+    _emit({"k": "ttft", "d": deployment, "s": float(seconds)})
+
+
+def record_decode_tokens(deployment: str, tokens: int) -> None:
+    """Tokens produced outside a decode step (the prefill lane samples
+    each admitted stream's FIRST token from the prefill logits)."""
+    if tokens > 0:
+        _emit({"k": "dtok", "d": deployment, "n": int(tokens)})
+
+
 def set_replica_ongoing(deployment: str, replica: str, ongoing: int) -> None:
     _emit({"k": "g", "d": deployment, "r": replica, "n": int(ongoing)})
 
@@ -226,6 +246,26 @@ def apply_events(events: List[dict], node_id: str,
                     tags={"node_id": node_id, "deployment": dep,
                           "worker": worker})
                 gauge_keys.append(("queued", dep, worker))
+            elif kind == "dstep":
+                _metrics.SERVE_DECODE_STEP_SECONDS.observe(
+                    float(ev.get("s", 0.0)),
+                    tags={"node_id": node_id, "deployment": dep})
+                _metrics.SERVE_DECODE_BATCH_OCCUPANCY.observe(
+                    float(ev.get("o", 0)),
+                    tags={"node_id": node_id, "deployment": dep})
+                n_tok = float(ev.get("n", 0))
+                if n_tok > 0:
+                    _metrics.SERVE_DECODE_TOKENS_TOTAL.inc(
+                        n_tok, tags={"node_id": node_id,
+                                     "deployment": dep})
+            elif kind == "ttft":
+                _metrics.SERVE_DECODE_TTFT_SECONDS.observe(
+                    float(ev.get("s", 0.0)),
+                    tags={"node_id": node_id, "deployment": dep})
+            elif kind == "dtok":
+                _metrics.SERVE_DECODE_TOKENS_TOTAL.inc(
+                    float(ev.get("n", 0)),
+                    tags={"node_id": node_id, "deployment": dep})
             elif kind == "drop":
                 _metrics.SERVE_EVENTS_DROPPED.inc(
                     float(ev.get("n", 0)), tags={"node_id": node_id})
@@ -441,6 +481,43 @@ def deployment_stats(parsed: dict, deployment: str) -> dict:
                          "deployment", deployment=deployment)
     if queued:
         out["queued"] = int(sum(queued.values()))
+    decode = decode_stats(parsed, deployment)
+    if decode:
+        out["decode"] = decode
+    return out
+
+
+def decode_stats(parsed: dict, deployment: str) -> dict:
+    """LLM decode-engine rollup for one deployment (empty dict when the
+    deployment runs no engine): TTFT quantiles, aggregate tokens,
+    step/occupancy view — surfaced in ``serve.stats()``, the CLI and
+    the dashboard alongside the request-phase plane."""
+    out: dict = {}
+    ttft = histogram_dist(parsed, "ray_tpu_serve_decode_ttft_seconds",
+                          deployment=deployment)
+    if ttft:
+        out["streams"] = int(ttft["count"])
+        p50 = quantile_from_buckets(ttft, 0.50)
+        p99 = quantile_from_buckets(ttft, 0.99)
+        out["ttft_p50_ms"] = round(p50 * 1e3, 3) if p50 is not None \
+            else None
+        out["ttft_p99_ms"] = round(p99 * 1e3, 3) if p99 is not None \
+            else None
+    steps = histogram_dist(parsed, "ray_tpu_serve_decode_step_seconds",
+                           deployment=deployment)
+    if steps:
+        out["steps"] = int(steps["count"])
+        out["step_mean_ms"] = round(
+            steps["sum"] / steps["count"] * 1e3, 3)
+    occ = histogram_dist(parsed,
+                         "ray_tpu_serve_decode_batch_occupancy",
+                         deployment=deployment)
+    if occ:
+        out["mean_occupancy"] = round(occ["sum"] / occ["count"], 3)
+    tokens = sum_counter(parsed, "ray_tpu_serve_decode_tokens_total",
+                         "deployment", deployment=deployment)
+    if tokens:
+        out["tokens"] = int(sum(tokens.values()))
     return out
 
 

@@ -55,11 +55,22 @@ _WEDGED_PROBE_FAILURES = 5
 class Replica:
     """Actor wrapping one copy of the user's deployment callable."""
 
-    def __init__(self, cls_or_fn, init_args, init_kwargs):
+    def __init__(self, cls_or_fn, init_args, init_kwargs,
+                 deployment_name: Optional[str] = None):
         if isinstance(cls_or_fn, type):
             self.callable = cls_or_fn(*init_args, **init_kwargs)
         else:
             self.callable = cls_or_fn
+        if deployment_name and hasattr(self.callable,
+                                       "set_deployment_name"):
+            # Callables that self-report metrics (the LLM engine's
+            # decode families) need THIS deployment's name as their
+            # label, or the stats join misses them under any name the
+            # user didn't also pass into the bind args.
+            try:
+                self.callable.set_deployment_name(deployment_name)
+            except Exception:
+                pass
         self.num_ongoing = 0
         self._lock = threading.Lock()
         # Stable per-replica metrics label: pid is unique per node and
@@ -247,7 +258,7 @@ class ServeController:
         # starved behind a fully saturated request queue.
         opts["max_concurrency"] = max(2, max_q) + 1
         return replica_cls.options(**opts).remote(
-            cls_or_fn, init_args, init_kwargs
+            cls_or_fn, init_args, init_kwargs, app["name"]
         )
 
     @staticmethod
@@ -714,6 +725,8 @@ def reset_routers() -> None:
         for r in _routers.values():
             r._stopped = True
         _routers.clear()
+    with _stream_tables_lock:
+        _stream_tables.clear()
 
 
 def routed_call(deployment_name: str, method: str, args: tuple, kwargs: dict,
@@ -854,6 +867,163 @@ def _finish_routed(deployment_name: str, resp, t0: float, route_s: float):
     return result
 
 
+# -- token streaming (LLM engine protocol) ----------------------------------
+
+# Streaming replica table: deployment -> (fetched_at, [replica actor
+# ids]). stream_call runs OUTSIDE the router (it must work from the
+# ray:// proxy process, whose global backend is not the cluster's), so
+# it resolves replicas straight off the controller with a short TTL
+# cache — one controller round trip per deployment per TTL, not per
+# stream.
+_STREAM_TABLE_TTL_S = 2.0
+_stream_tables: Dict[str, tuple] = {}
+_stream_tables_lock = threading.Lock()
+
+# Long-poll budget per llm_next call; the outer RPC timeout adds slack
+# so a partitioned replica fails the stream FAST (typed, bounded by
+# _STREAM_POLL_S + _STREAM_RPC_SLACK_S), never hangs it.
+_STREAM_POLL_S = 1.0
+_STREAM_RPC_SLACK_S = 25.0
+
+
+def _stream_replicas(backend, deployment: str,
+                     refresh: bool = False) -> List[str]:
+    now = time.monotonic()
+    with _stream_tables_lock:
+        ent = _stream_tables.get(deployment)
+        if ent and not refresh and now - ent[0] < _STREAM_TABLE_TTL_S:
+            return ent[1]
+    controller_id = backend.get_named_actor(CONTROLLER_NAME)
+    with tracing.suppressed():
+        [ref] = backend.submit_actor_task(
+            controller_id, "get_routing_table", (), {})
+        _, table = backend.get([ref], timeout=30.0)[0]
+    entry = table.get(deployment)
+    if entry is None:
+        raise ValueError(f"no deployment named {deployment!r}")
+    replicas = [r._actor_id for r in entry["replicas"]]
+    if not replicas:
+        raise RuntimeError(f"deployment {deployment!r} has no replicas")
+    with _stream_tables_lock:
+        _stream_tables[deployment] = (now, replicas)
+    return replicas
+
+
+def _stream_rpc(backend, actor_id: str, method: str, args: tuple,
+                kwargs: dict, meta: Optional[dict], timeout: float):
+    [ref] = backend.submit_actor_task(
+        actor_id, "handle_request", (method, args, kwargs, meta), {})
+    return backend.get([ref], timeout=timeout)[0]
+
+
+# Sentinel frame the ray:// proxy interleaves on idle poll rounds so a
+# deep-queued stream (TTFT = minutes) keeps its client socket alive;
+# ClientBackend.serve_stream filters it out.
+STREAM_KEEPALIVE = {"__stream_keepalive__": True}
+
+
+def stream_call(deployment_name: str, args: tuple, kwargs: dict,
+                request_meta: Optional[dict] = None, backend=None,
+                poll_s: float = _STREAM_POLL_S,
+                keepalive_every: Optional[float] = None):
+    """Route one STREAMING request: generator of token chunks.
+
+    The replica's callable must speak the LLM engine protocol
+    (``llm_submit`` -> stream id, ``llm_next`` -> chunk drain; see
+    ``serve/llm_engine.py``). The stream pins to ONE replica for its
+    whole life — the KV-cache slot lives there. Submit retries across
+    replicas on a dead pick; a replica dying MID-stream fails the
+    stream fast (the slot died with the worker), and a deadline that
+    expires mid-decode surfaces as a typed :class:`RequestShedError`
+    (reason=decode) shed by the engine at a step boundary.
+
+    ``backend`` defaults to this process's backend; the ``ray://``
+    proxy passes its own ClusterBackend explicitly (its process-global
+    backend belongs to the CLIENT side)."""
+    if backend is None:
+        from ray_tpu._private import worker as _worker
+
+        backend = _worker.backend()
+    meta = dict(request_meta or {})
+    meta["deployment"] = deployment_name
+    deadline_ts = meta.get("deadline_ts")
+    if deadline_ts is not None:
+        # The engine owns mid-stream deadline semantics (shed at a step
+        # boundary, slot freed); the submit's request meta keeps the
+        # deadline too so an already-dead arrival sheds at the replica.
+        kwargs = {**kwargs, "deadline_ts": deadline_ts}
+    from ray_tpu.core.object_ref import ActorError, GetTimeoutError
+
+    last_err: Optional[BaseException] = None
+    resp = None
+    aid = None
+    for attempt in range(3):
+        try:
+            replicas = _stream_replicas(
+                backend, deployment_name, refresh=attempt > 0)
+            aid = replicas[random.randrange(len(replicas))]
+            resp = _stream_rpc(backend, aid, "llm_submit", args, kwargs,
+                               meta, timeout=60.0)
+            break
+        except (ValueError, RequestShedError):
+            raise
+        except GetTimeoutError:
+            # The submit may have EXECUTED on a wedged replica — the
+            # task layer's dup suppression covers retried pushes of the
+            # same spec, but a fresh submit here would be a second
+            # admission (orphaned stream holding a decode slot). Fail
+            # the stream instead of guessing.
+            raise
+        except (ActorError, RuntimeError) as e:
+            # Dead replica pick / empty table mid-replacement: the old
+            # incarnation's engine state died with the worker, so a
+            # resubmit cannot double-admit. Anything else propagates.
+            last_err = e
+            time.sleep(0.2 * (attempt + 1))
+    else:
+        raise last_err
+    if isinstance(resp, dict) and resp.get("__serve_envelope__"):
+        shed = resp.get("shed")
+        if shed:
+            raise RequestShedError(
+                f"stream to {deployment_name!r} shed at admission",
+                reason=shed)
+        rid = resp.get("result")
+    else:
+        rid = resp
+    last_yield = time.monotonic()
+    while True:
+        # Polls go meta-less (the legacy bare-result path): a long-poll
+        # is transport, not a request — it must not enter the request
+        # histograms or be shed by the replica's arrival check.
+        r = _stream_rpc(backend, aid, "llm_next", (rid,),
+                        {"timeout_s": poll_s}, None,
+                        timeout=poll_s + _STREAM_RPC_SLACK_S)
+        chunks = r.get("chunks") or ()
+        for chunk in chunks:
+            yield chunk
+        if chunks:
+            last_yield = time.monotonic()
+        elif keepalive_every is not None \
+                and time.monotonic() - last_yield >= keepalive_every:
+            # Deep-queued stream: nothing to say yet, but the consumer's
+            # transport (the ray:// proxy RPC) needs frames to not time
+            # out while the request waits for a slot.
+            yield STREAM_KEEPALIVE
+            last_yield = time.monotonic()
+        if r.get("done"):
+            shed = r.get("shed")
+            if shed:
+                raise RequestShedError(
+                    f"stream to {deployment_name!r} shed mid-decode",
+                    reason=shed)
+            err = r.get("error")
+            if err:
+                raise RuntimeError(
+                    f"stream to {deployment_name!r} failed: {err}")
+            return
+
+
 class DeploymentHandle:
     """Python-level handle: ``handle.remote(...)`` / ``handle.method.remote``
     (reference ``serve/handle.py``). Requests go through a routing proxy
@@ -903,6 +1073,22 @@ class DeploymentHandle:
         return call.remote(self.deployment_name, self.method_name, args,
                            kwargs, self._request_meta())
 
+    def stream(self, *args, **kwargs):
+        """Token-streaming call path (LLM engine protocol): a generator
+        of per-step token chunks. ``handle.options(deadline_s=...)``
+        applies — the engine sheds the stream typed (reason=decode) at
+        the next step boundary once the budget dies. Over a ``ray://``
+        connection the chunks are forwarded by the client proxy's
+        server-streaming RPC."""
+        from ray_tpu._private import worker as _worker
+
+        backend = _worker.backend()
+        if hasattr(backend, "serve_stream"):  # ray:// client backend
+            return backend.serve_stream(
+                self.deployment_name, args, kwargs, self._request_meta())
+        return stream_call(self.deployment_name, args, kwargs,
+                           self._request_meta())
+
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
@@ -924,6 +1110,10 @@ _REASONS = {200: "OK", 404: "Not Found", 500: "Internal Server Error",
 # proxy converts it to the absolute deadline that rides the request
 # context through router and batch queue.
 DEADLINE_HEADER = "x-serve-deadline-ms"
+# Opt into the token-streaming lane (LLM engine protocol): the response
+# becomes chunked-transfer ndjson — one {"tokens": [...]} line per
+# engine chunk, then a {"done": true, ...} terminator.
+STREAM_HEADER = "x-serve-stream"
 
 
 def make_asgi_app():
@@ -1036,6 +1226,75 @@ def make_asgi_app():
         try:
             payload = _json.loads(body) if body else None
             loop = asyncio.get_running_loop()
+            if headers.get(STREAM_HEADER):
+                # Token-streaming lane: ndjson chunks over chunked
+                # transfer encoding. The blocking stream generator runs
+                # on a pool thread feeding an asyncio queue; the FIRST
+                # event decides the status line, so a stream shed at
+                # admission still answers a clean 503 instead of a 200
+                # that dies mid-body.
+                q: asyncio.Queue = asyncio.Queue()
+
+                def pump():
+                    try:
+                        for chunk in stream_call(
+                                name, (payload,), {}, meta or None):
+                            loop.call_soon_threadsafe(
+                                q.put_nowait, ("chunk", chunk))
+                        loop.call_soon_threadsafe(
+                            q.put_nowait, ("end", None))
+                    except RequestShedError as e:
+                        loop.call_soon_threadsafe(
+                            q.put_nowait,
+                            ("shed", getattr(e, "reason", "deadline")))
+                    except BaseException as e:  # noqa: BLE001
+                        loop.call_soon_threadsafe(
+                            q.put_nowait, ("error", repr(e)))
+
+                # Dedicated thread per stream, NOT the shared executor:
+                # a pump blocks for the stream's whole life (minutes in
+                # a deep admission queue), and 32 concurrent streams on
+                # the 32-worker pool would wedge every non-streaming
+                # request behind them.
+                threading.Thread(target=pump, daemon=True).start()
+                kind, val = await q.get()
+                if kind == "shed":
+                    status = "ERROR: RequestShedError"
+                    await reply(503, {"error": "stream shed",
+                                      "shed": val})
+                    return
+                if kind == "error":
+                    status = "ERROR: stream"
+                    await reply(500, {"error": val})
+                    return
+                await send({
+                    "type": "http.response.start",
+                    "status": 200,
+                    "headers": [
+                        (b"content-type", b"application/x-ndjson"),
+                        (b"transfer-encoding", b"chunked")],
+                })
+                while True:
+                    if kind == "chunk":
+                        await send({
+                            "type": "http.response.body",
+                            "body": _json.dumps(
+                                {"tokens": val}).encode() + b"\n",
+                            "more_body": True})
+                    else:
+                        tail: dict = {"done": True}
+                        if kind == "shed":
+                            tail["shed"] = val
+                            status = "ERROR: RequestShedError"
+                        elif kind == "error":
+                            tail["error"] = val
+                            status = "ERROR: stream"
+                        await send({
+                            "type": "http.response.body",
+                            "body": _json.dumps(tail).encode() + b"\n",
+                            "more_body": False})
+                        return
+                    kind, val = await q.get()
             result = await loop.run_in_executor(
                 pool, routed_call, name, "__call__", (payload,), {},
                 meta or None)
@@ -1119,6 +1378,8 @@ class HTTPProxy:
                     return {"type": "http.request", "body": body,
                             "more_body": False}
 
+                chunked = {"on": False}
+
                 async def send(event):
                     if event["type"] == "http.response.start":
                         status = event["status"]
@@ -1126,10 +1387,25 @@ class HTTPProxy:
                             f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}"
                             "\r\n".encode())
                         for k, v in event.get("headers", []):
+                            if (k.lower() == b"transfer-encoding"
+                                    and v.lower() == b"chunked"):
+                                chunked["on"] = True
                             writer.write(k + b": " + v + b"\r\n")
                         writer.write(b"\r\n")
                     elif event["type"] == "http.response.body":
-                        writer.write(event.get("body", b""))
+                        body_bytes = event.get("body", b"")
+                        if chunked["on"]:
+                            # Chunked transfer framing: each body event
+                            # ships as its own chunk so the client sees
+                            # tokens as the engine produces them.
+                            if body_bytes:
+                                writer.write(
+                                    f"{len(body_bytes):x}\r\n".encode()
+                                    + body_bytes + b"\r\n")
+                            if not event.get("more_body"):
+                                writer.write(b"0\r\n\r\n")
+                        else:
+                            writer.write(body_bytes)
                         await writer.drain()
 
                 await self._app(scope, receive, send)

@@ -1,0 +1,648 @@
+"""Continuous-batching LLM decode engine (the millions-of-users datapath).
+
+The single-tenant ``generate()``-per-request serving shape recompiles or
+runs a private decode loop per caller; on TPU the idiomatic XLA answer
+is the opposite: ONE compiled decode step over a fixed ``[max_batch]``
+state, with requests admitted into (and evicted from) the running batch
+**between** steps — iteration-level scheduling. This module is that
+engine, mounted as an ordinary Serve deployment callable:
+
+* **Two compiled shapes, ever.** A fixed ``[max_batch]`` decode step
+  and a fixed ``[prefill_rows, max_prompt_len]`` chunked-prefill lane
+  (``models/gpt2.py`` / ``models/llama.py`` decode APIs). Per-engine
+  compile counters (trace-time side effects, the ``fused_norm`` test
+  idiom) prove no per-request recompile ever happens —
+  ``serve_bench --llm`` asserts ``compiles == {decode: 1, prefill: 1}``
+  after 10k streams.
+* **Slot-indexed ring KV-cache in device memory.** Per-slot write
+  cursors via ``lax.dynamic_update_slice``; the cache rides the model's
+  activation dtype (bf16 — no fp32 copy) and, for Llama, the GQA
+  ``n_kv_head`` layout. A finished/shed request's slot is recycled at
+  the next step boundary; generations longer than the cache degrade to
+  sliding-window attention instead of erroring.
+* **Deadline semantics ride the PR-8 shed plumbing.** A request whose
+  absolute deadline dies — queued or mid-decode — frees its slot at the
+  next step boundary as a TYPED shed (``RequestShedError``,
+  ``reason="decode"``, counted in ``ray_tpu_serve_shed_total``), never
+  a hang; admission prefers requests by deadline slack.
+* **Token streaming.** Every request is a stream of per-step token
+  chunks drained by ``llm_next``/``llm_poll`` long-polls — the
+  transport ``serve._private.stream_call`` (handle ``.stream()``, HTTP
+  chunked transfer, the ``ray://`` proxy's server-streaming RPC) builds
+  on.
+
+Failpoints ``serve.llm.before_admit`` / ``serve.llm.before_step`` let
+chaos crash, delay or hang the scheduler mid-iteration; the loop
+requeues interrupted admissions (bounded retries) and fails active
+streams fast after repeated step errors — fail fast, never hang.
+
+Metric families (two-sided through ``serve/_observability``):
+``ray_tpu_serve_decode_{step_seconds,batch_occupancy,ttft_seconds,
+tokens_total}``.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import threading
+import time
+import warnings
+from typing import Dict, List, Optional
+
+from ray_tpu.serve import _observability as _obs
+from ray_tpu.serve._observability import RequestShedError
+from ray_tpu.util import failpoints
+
+# How many consecutive decode-step failures fail the active streams
+# (each failure already surfaced; three in a row means the step itself
+# is broken, and holding streams open past that would be a hang).
+_MAX_STEP_ERRORS = 3
+# Abandoned-stream reap: a DONE stream nobody polls for this long is
+# dropped (the bench's fire-and-forget shed probes must not accumulate).
+_STREAM_TTL_S = 120.0
+
+
+class _Stream:
+    """One request's token stream: per-step chunks pending delivery plus
+    the terminal state. ``event`` is set whenever there is something new
+    to deliver (chunks or the terminal transition)."""
+
+    __slots__ = ("pending", "done", "shed", "error", "delivered",
+                 "last_poll", "event", "n_tokens")
+
+    def __init__(self):
+        self.pending: List[List[int]] = []
+        self.done = False
+        self.shed: Optional[str] = None
+        self.error: Optional[str] = None
+        self.delivered = False
+        self.last_poll = time.monotonic()
+        self.event = threading.Event()
+        self.n_tokens = 0
+
+
+class _Request:
+    __slots__ = ("rid", "prompt", "max_new", "deadline_ts", "submitted",
+                 "remaining", "retries", "stream", "seq")
+
+    def __init__(self, rid: str, prompt: List[int], max_new: int,
+                 deadline_ts: Optional[float], seq: int):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.deadline_ts = deadline_ts
+        self.submitted = time.time()
+        self.remaining = max_new
+        self.retries = 0
+        self.stream = _Stream()
+        self.seq = seq  # FIFO tiebreak for slack ordering
+
+
+def _model_bundle(model: str, config, preset: str):
+    """(config, init, init_cache, prefill, decode_step) for a model
+    family — resolved lazily so importing this module never pulls jax."""
+    if model == "gpt2":
+        from ray_tpu.models import gpt2 as m
+
+        cfg = config or (m.GPT2Config.tiny() if preset == "tiny"
+                         else m.GPT2Config.small())
+        return (cfg, m.gpt2_init, m.gpt2_init_cache, m.gpt2_prefill,
+                m.gpt2_decode_step)
+    if model == "llama":
+        from ray_tpu.models import llama as m
+
+        cfg = config or (m.LlamaConfig.tiny() if preset == "tiny"
+                         else m.LlamaConfig.small())
+        return (cfg, m.llama_init, m.llama_init_cache, m.llama_prefill,
+                m.llama_decode_step)
+    raise ValueError(f"unknown model family {model!r} (want gpt2|llama)")
+
+
+class LLMEngine:
+    """The deployment callable: one decode engine per replica.
+
+    Deploy it like any Serve class::
+
+        eng = serve.deployment(name="llm", max_concurrent_queries=64)(
+            LLMEngine)
+        handle = serve.run(eng.bind(model="gpt2", max_batch=32))
+        for chunk in handle.stream([1, 2, 3], max_new_tokens=16):
+            ...
+
+    ``__call__``/``generate`` are the blocking request/response lane;
+    ``llm_submit``/``llm_next``/``llm_poll`` are the streaming protocol
+    ``stream_call`` drives.
+    """
+
+    def __init__(self, model: str = "gpt2", config=None,
+                 preset: str = "tiny", seed: int = 0,
+                 max_batch: int = 8, cache_len: int = 64,
+                 max_prompt_len: int = 16, prefill_rows: int = 4,
+                 max_new_tokens: int = 16, max_new_cap: int = 512,
+                 max_queue: int = 8192, eos_token: Optional[int] = None,
+                 step_throttle_s: float = 0.0,
+                 deployment: Optional[str] = None):
+        import jax
+        import numpy as np
+
+        if max_prompt_len > cache_len:
+            raise ValueError(
+                f"max_prompt_len={max_prompt_len} must fit the cache "
+                f"(cache_len={cache_len})")
+        self._np = np
+        self._jnp = jax.numpy
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.cache_len = int(cache_len)
+        self.max_prompt_len = int(max_prompt_len)
+        self.prefill_rows = max(1, min(int(prefill_rows), self.max_batch))
+        self.max_new_tokens = int(max_new_tokens)
+        self.max_new_cap = int(max_new_cap)
+        self.max_queue = int(max_queue)
+        self.eos_token = eos_token
+        self.step_throttle_s = float(step_throttle_s)
+        # Metrics label. None = adopt the Serve deployment's name (the
+        # Replica calls set_deployment_name at construction); an
+        # explicit bind arg wins over the adoption.
+        self._dep = deployment or "llm"
+        self._dep_explicit = deployment is not None
+
+        cfg, init, init_cache, prefill, decode = _model_bundle(
+            model, config, preset)
+        if model == "gpt2" and self.max_prompt_len > cfg.seq_len:
+            # gpt2's learned position table bounds the prefill window;
+            # fail at bind time, not per-request inside the jit.
+            raise ValueError(
+                f"max_prompt_len={self.max_prompt_len} exceeds the "
+                f"model's position window (seq_len={cfg.seq_len})")
+        self._cfg = cfg
+        self.params = init(jax.random.PRNGKey(seed), cfg)
+        # One scratch slot past max_batch: inactive prefill rows write
+        # their pad garbage there, keeping the prefill shape fixed.
+        self._cache = init_cache(cfg, self.max_batch + 1, self.cache_len)
+        self._compiles = {"decode": 0, "prefill": 0}
+
+        def step_fn(params, cache, tokens, pos):
+            self._compiles["decode"] += 1  # trace-time: fires per compile
+            logits, cache = decode(params, cache, tokens, pos, cfg)
+            return (self._jnp.argmax(logits, axis=-1).astype(
+                self._jnp.int32), cache)
+
+        def prefill_fn(params, cache, tokens, slots, lengths):
+            self._compiles["prefill"] += 1
+            logits, cache = prefill(params, cache, tokens, slots,
+                                    lengths, cfg)
+            return (self._jnp.argmax(logits, axis=-1).astype(
+                self._jnp.int32), cache)
+
+        # Donate the cache: the engine holds the ONLY reference and the
+        # step replaces it, so XLA can update in place (2x HBM saved on
+        # the big buffer). CPU test runs warn that donation was unused.
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        self._step_fn = jax.jit(step_fn, donate_argnums=(1,))
+        self._prefill_fn = jax.jit(prefill_fn, donate_argnums=(1,))
+
+        self._tokens = np.zeros(self.max_batch + 1, np.int32)
+        self._pos = np.zeros(self.max_batch + 1, np.int32)
+        self._slot_req: List[Optional[_Request]] = [None] * self.max_batch
+        # Admission queue: a HEAP keyed (deadline slack, seq) — the 10k
+        # flagship load would pay an O(n log n) re-sort per scheduler
+        # iteration under the engine lock with a sorted list. Expiry and
+        # cancellation are lazy (checked at pop); _n_queued is the live
+        # count (heap entries may be dead).
+        self._queue: List[tuple] = []
+        self._n_queued = 0
+        self._streams: Dict[str, _Stream] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._seq = 0
+        self._step_errors_row = 0
+        self._last_reap = time.monotonic()
+        self.stats_counters = {
+            "steps": 0, "admitted": 0, "completed": 0, "shed": 0,
+            "errors": 0, "tokens_out": 0, "queue_peak": 0,
+            "occupancy_sum": 0, "ring_wraps": 0,
+        }
+        threading.Thread(target=self._loop, daemon=True,
+                         name="llm-engine-loop").start()
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _loop(self):
+        while not self._stop:
+            did = False
+            try:
+                did = self._admit_once() or did
+            except BaseException:
+                # _admit_once handles its own requeue; anything that
+                # still escapes must not kill the scheduler.
+                pass
+            try:
+                did = self._step_once() or did
+            except BaseException:
+                pass
+            if time.monotonic() - self._last_reap > 5.0:
+                self._reap_streams()
+            if not did:
+                self._wake.wait(0.02)
+                self._wake.clear()
+
+    def _push_queued_locked(self, req: _Request):
+        """Heap key = (deadline, seq): admission prefers deadline slack
+        — tightest budget first, FIFO among the unbounded. seq is
+        unique, so _Request itself is never compared."""
+        dl = req.deadline_ts if req.deadline_ts is not None \
+            else float("inf")
+        heapq.heappush(self._queue, (dl, req.seq, req))
+        self._n_queued += 1
+
+    def _shed_expired_locked(self, now: float):
+        """Typed-shed the expired HEAD of the queue (caller holds the
+        lock). The heap is deadline-ordered, so expired entries are a
+        prefix — this is O(expired), not O(queue), and it runs every
+        iteration so a dead budget sheds at the next step boundary even
+        when no slot ever frees (a saturated engine must not hold a
+        dead request's poller hostage)."""
+        while self._queue:
+            dl, _, req = self._queue[0]
+            if req.stream.done:
+                heapq.heappop(self._queue)  # cancelled: drop lazily
+                continue
+            if dl == float("inf") or now <= dl:
+                break
+            heapq.heappop(self._queue)
+            self._n_queued -= 1
+            self._finish_locked(req, shed="decode")
+
+    def _admit_once(self) -> bool:
+        with self._lock:
+            now = time.time()
+            self._shed_expired_locked(now)
+            free = [i for i in range(self.max_batch)
+                    if self._slot_req[i] is None]
+            if not free or not self._n_queued:
+                return False
+            take = min(len(free), self.prefill_rows)
+            batch: List[_Request] = []
+            while self._queue and len(batch) < take:
+                _, _, req = heapq.heappop(self._queue)
+                if req.stream.done:
+                    continue  # cancelled in queue: already accounted
+                self._n_queued -= 1
+                if req.deadline_ts is not None \
+                        and now > req.deadline_ts:
+                    # The budget died waiting for a slot: typed shed,
+                    # reason=decode (the engine owns the budget once
+                    # the router handed the request over).
+                    self._finish_locked(req, shed="decode")
+                    continue
+                batch.append(req)
+            if not batch:
+                # Expired/cancelled entries were drained — progress.
+                return True
+            slots = free[:len(batch)]
+        try:
+            failpoints.hit("serve.llm.before_admit")
+            self._prefill_batch(batch, slots)
+        except BaseException as e:  # noqa: BLE001 — requeue, bounded
+            with self._lock:
+                for req in batch:
+                    req.retries += 1
+                    if req.retries > 3:
+                        self._finish_locked(req, error=repr(e))
+                    else:
+                        self._push_queued_locked(req)
+        return True
+
+    def _prefill_batch(self, batch: List[_Request], slots: List[int]):
+        np = self._np
+        rows = self.prefill_rows
+        p_len = self.max_prompt_len
+        toks = np.zeros((rows, p_len), np.int32)
+        slot_idx = np.full(rows, self.max_batch, np.int32)  # scratch row
+        lengths = np.ones(rows, np.int32)
+        for i, req in enumerate(batch):
+            prompt = req.prompt[-p_len:]  # truncate to the lane window
+            toks[i, :len(prompt)] = prompt
+            slot_idx[i] = slots[i]
+            lengths[i] = len(prompt)
+        first, self._cache = self._prefill_fn(
+            self.params, self._cache, self._jnp.asarray(toks),
+            self._jnp.asarray(slot_idx), self._jnp.asarray(lengths))
+        first = np.asarray(first)
+        now = time.time()
+        _obs.record_decode_tokens(self._dep, len(batch))
+        with self._lock:
+            for i, req in enumerate(batch):
+                slot = slots[i]
+                tok = int(first[i])
+                self._tokens[slot] = tok
+                self._pos[slot] = int(lengths[i])
+                self._slot_req[slot] = req
+                req.remaining = req.max_new - 1
+                self.stats_counters["admitted"] += 1
+                self.stats_counters["tokens_out"] += 1
+                req.stream.n_tokens += 1
+                req.stream.pending.append([tok])
+                req.stream.event.set()
+                # TTFT: submit -> first token available for delivery.
+                _obs.record_ttft(self._dep, max(0.0, now - req.submitted))
+                if req.remaining <= 0 or tok == self.eos_token:
+                    self._finish_locked(req, done=True, slot=slot)
+
+    def _step_once(self) -> bool:
+        np = self._np
+        with self._lock:
+            now = time.time()
+            # Deadline eviction happens at the step boundary: the slot
+            # frees NOW, before compute, and the shed is typed.
+            for slot in range(self.max_batch):
+                req = self._slot_req[slot]
+                if req is not None and req.deadline_ts is not None \
+                        and now > req.deadline_ts:
+                    self._finish_locked(req, shed="decode", slot=slot)
+            active = [i for i in range(self.max_batch)
+                      if self._slot_req[i] is not None]
+            if not active:
+                return False
+        t0 = time.perf_counter()
+        try:
+            # The failpoint lives INSIDE the error-counted region: a
+            # raise-armed before_step must trip the 3-strike fail-fast
+            # (streams error out), not silently skip every step while
+            # the site stays armed — that would be the hang the
+            # never-hang contract forbids.
+            failpoints.hit("serve.llm.before_step")
+            nxt, self._cache = self._step_fn(
+                self.params, self._cache, self._jnp.asarray(self._tokens),
+                self._jnp.asarray(self._pos))
+            nxt = np.asarray(nxt)  # blocks until the step lands
+        except BaseException:
+            self._step_errors_row += 1
+            self.stats_counters["errors"] += 1
+            if self._step_errors_row >= _MAX_STEP_ERRORS:
+                with self._lock:
+                    for slot in range(self.max_batch):
+                        req = self._slot_req[slot]
+                        if req is not None:
+                            self._finish_locked(
+                                req, error="decode step failing "
+                                "repeatedly", slot=slot)
+                self._step_errors_row = 0
+            raise
+        self._step_errors_row = 0
+        step_s = time.perf_counter() - t0
+        with self._lock:
+            produced = 0
+            for slot in active:
+                req = self._slot_req[slot]
+                if req is None:
+                    continue  # cancelled while the step was in flight
+                tok = int(nxt[slot])
+                self._tokens[slot] = tok
+                self._pos[slot] += 1
+                if int(self._pos[slot]) % self.cache_len == 0:
+                    self.stats_counters["ring_wraps"] += 1
+                req.remaining -= 1
+                produced += 1
+                req.stream.n_tokens += 1
+                req.stream.pending.append([tok])
+                req.stream.event.set()
+                if req.remaining <= 0 or tok == self.eos_token:
+                    self._finish_locked(req, done=True, slot=slot)
+            self.stats_counters["steps"] += 1
+            self.stats_counters["tokens_out"] += produced
+            self.stats_counters["occupancy_sum"] += len(active)
+        _obs.record_decode_step(self._dep, step_s, len(active), produced)
+        if self.step_throttle_s:
+            time.sleep(self.step_throttle_s)
+        return True
+
+    def _finish_locked(self, req: _Request, done: bool = False,
+                       shed: Optional[str] = None,
+                       error: Optional[str] = None,
+                       slot: Optional[int] = None):
+        """Terminal transition (caller holds the lock): free the slot,
+        mark the stream, wake pollers, count the outcome."""
+        if slot is not None and self._slot_req[slot] is req:
+            self._slot_req[slot] = None
+        st = req.stream
+        if st.done:
+            return
+        st.done = True
+        st.shed = shed
+        st.error = error
+        st.event.set()
+        if shed is not None:
+            self.stats_counters["shed"] += 1
+            _obs.record_shed(self._dep, shed)
+        elif error is not None:
+            self.stats_counters["errors"] += 1
+        else:
+            self.stats_counters["completed"] += 1
+
+    def _reap_streams(self):
+        self._last_reap = time.monotonic()
+        cutoff = time.monotonic() - _STREAM_TTL_S
+        with self._lock:
+            # Fully-delivered streams leave the table at delivery
+            # (_drain_locked); only DONE streams nobody polls linger.
+            for rid in [r for r, s in self._streams.items()
+                        if s.done and s.last_poll < cutoff]:
+                del self._streams[rid]
+
+    # -- request surface (called through Replica.handle_request) ----------
+
+    def _normalize(self, prompt, max_new_tokens):
+        if isinstance(prompt, dict):
+            max_new_tokens = prompt.get("max_tokens", max_new_tokens)
+            prompt = prompt.get("tokens")
+        from ray_tpu.core.object_ref import ObjectRef
+
+        if isinstance(prompt, ObjectRef):
+            # The shm handoff lane: the proxy put the prompt payload in
+            # the object store and handed us the ref — the fetch is a
+            # same-node shared-memory read, not a copy over the wire.
+            import ray_tpu
+
+            prompt = ray_tpu.get(prompt, timeout=30.0)
+        if not prompt or not all(isinstance(t, int) for t in prompt):
+            raise ValueError("prompt must be a non-empty list of token "
+                             "ids (or {'tokens': [...]})")
+        if max_new_tokens is None:
+            max_new_tokens = self.max_new_tokens
+        return list(prompt), max(1, min(int(max_new_tokens),
+                                        self.max_new_cap))
+
+    def llm_submit(self, prompt, max_new_tokens=None,
+                   deadline_ts: Optional[float] = None) -> str:
+        """Admit a request into the engine queue; returns the stream id.
+        A full queue sheds typed (reason=decode) instead of erroring —
+        admission under a full BATCH merely queues."""
+        prompt, max_new = self._normalize(prompt, max_new_tokens)
+        with self._lock:
+            if self._n_queued >= self.max_queue:
+                _obs.record_shed(self._dep, "decode")
+                self.stats_counters["shed"] += 1
+                raise RequestShedError(
+                    f"llm engine queue full ({self.max_queue})",
+                    reason="decode")
+            self._seq += 1
+            rid = f"llm-{os.getpid():x}-{self._seq:x}"
+            req = _Request(rid, prompt, max_new, deadline_ts, self._seq)
+            self._push_queued_locked(req)
+            self.stats_counters["queue_peak"] = max(
+                self.stats_counters["queue_peak"], self._n_queued)
+            self._streams[rid] = req.stream
+        self._wake.set()
+        return rid
+
+    def llm_submit_many(self, requests: List[dict]) -> List[str]:
+        """Batched submit (the 10k-stream bench lane): each entry is
+        {"tokens": [...], "max_tokens": n, "deadline_ts": ts|None}."""
+        return [self.llm_submit(r.get("tokens"), r.get("max_tokens"),
+                                r.get("deadline_ts")) for r in requests]
+
+    def _drain_locked(self, rid: str, st: _Stream) -> dict:
+        chunks, st.pending = st.pending, []
+        st.last_poll = time.monotonic()
+        resp = {"chunks": chunks, "done": st.done, "shed": st.shed,
+                "error": st.error}
+        if st.done and not st.pending:
+            st.delivered = True
+            self._streams.pop(rid, None)
+        return resp
+
+    def llm_next(self, rid: str, timeout_s: float = 2.0) -> dict:
+        """Long-poll one stream: blocks until >=1 chunk (or the terminal
+        transition) is available, up to ``timeout_s``."""
+        with self._lock:
+            st = self._streams.get(rid)
+        if st is None:
+            return {"chunks": [], "done": True, "shed": None,
+                    "error": f"unknown stream {rid!r}"}
+        st.event.wait(max(0.0, float(timeout_s)))
+        with self._lock:
+            resp = self._drain_locked(rid, st)
+            if not st.done:
+                st.event.clear()
+        return resp
+
+    def llm_poll(self, rids: List[str]) -> Dict[str, dict]:
+        """Non-blocking batched drain (the bench's collector lane)."""
+        out = {}
+        with self._lock:
+            for rid in rids:
+                st = self._streams.get(rid)
+                if st is None:
+                    out[rid] = {"chunks": [], "done": True, "shed": None,
+                                "error": f"unknown stream {rid!r}"}
+                else:
+                    out[rid] = self._drain_locked(rid, st)
+        return out
+
+    def llm_cancel(self, rid: str) -> bool:
+        """Cancel a stream: a queued request leaves the queue, an
+        active one frees its slot at the cancel (the in-flight step's
+        token for it is discarded). The stream terminates with a
+        'cancelled' error; returns whether the request was still live.
+        A request mid-admission (its prefill in flight) is in neither
+        table and returns False — it completes normally and is reaped;
+        the window is one prefill call."""
+        with self._lock:
+            for slot in range(self.max_batch):
+                req = self._slot_req[slot]
+                if req is not None and req.rid == rid:
+                    self._finish_locked(req, error="cancelled",
+                                        slot=slot)
+                    return True
+            for _, _, req in self._queue:
+                if req.rid == rid and not req.stream.done:
+                    # The heap entry stays and is dropped lazily at
+                    # pop; the live count updates now.
+                    self._n_queued -= 1
+                    self._finish_locked(req, error="cancelled")
+                    return True
+        return False
+
+    def generate(self, prompt, max_new_tokens=None,
+                 deadline_ts: Optional[float] = None,
+                 timeout_s: Optional[float] = None) -> List[int]:
+        """Blocking request/response lane: submit, drain own stream,
+        return the generated tokens. Sheds raise typed. On timeout the
+        orphaned request is CANCELLED (slot freed, queue entry
+        dropped) — an abandoned caller must not leave the engine
+        decoding tokens nobody reads."""
+        rid = self.llm_submit(prompt, max_new_tokens, deadline_ts)
+        if timeout_s is None:
+            # A caller-supplied deadline bounds the wait (+grace for
+            # the final drain); without one, a generous static cap.
+            timeout_s = 300.0 if deadline_ts is None else max(
+                5.0, deadline_ts - time.time() + 30.0)
+        out: List[int] = []
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            resp = self.llm_next(rid, timeout_s=2.0)
+            for chunk in resp["chunks"]:
+                out.extend(chunk)
+            if resp["done"]:
+                if resp["shed"]:
+                    raise RequestShedError(
+                        f"llm request shed: {resp['shed']}",
+                        reason=resp["shed"])
+                if resp["error"]:
+                    raise RuntimeError(resp["error"])
+                return out
+        self.llm_cancel(rid)
+        raise TimeoutError(
+            f"llm generate did not finish within {timeout_s:.0f}s "
+            f"(request cancelled)")
+
+    def __call__(self, payload) -> dict:
+        """HTTP/graph lane: {"tokens": [...], "max_tokens": n} ->
+        {"tokens": [generated...]}. The serve request context's
+        deadline (handle.options(deadline_s=...) / the deadline header)
+        carries into the engine, so the blocking lane gets the same
+        mid-decode shed semantics as the streaming lane."""
+        ctx = _obs.current_request() or {}
+        return {"tokens": self.generate(
+            payload, deadline_ts=ctx.get("deadline_ts"))}
+
+    def llm_stats(self) -> dict:
+        with self._lock:
+            active = sum(1 for r in self._slot_req if r is not None)
+            queued = self._n_queued
+            c = dict(self.stats_counters)
+        steps = c["steps"]
+        return {
+            "model": self.model,
+            "max_batch": self.max_batch,
+            "cache_len": self.cache_len,
+            "max_prompt_len": self.max_prompt_len,
+            "prefill_rows": self.prefill_rows,
+            "active": active,
+            "queued": queued,
+            "compiles": dict(self._compiles),
+            "mean_occupancy": round(c["occupancy_sum"] / steps, 3)
+            if steps else 0.0,
+            **c,
+        }
+
+    def set_deployment_name(self, name: str) -> None:
+        """Called by the Replica wrapper at construction so the decode
+        metric families carry the ACTUAL deployment name — without it,
+        an engine deployed under any name but the bind-arg default
+        would be invisible to the stats join."""
+        if not self._dep_explicit and name:
+            self._dep = name
+
+    def check_health(self) -> str:
+        return "ok"
+
+    def shutdown_engine(self) -> bool:
+        self._stop = True
+        self._wake.set()
+        return True

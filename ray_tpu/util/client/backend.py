@@ -182,6 +182,32 @@ class ClientBackend:
     def kv_keys(self, prefix=""):
         return self._call("client_kv", "keys", prefix)
 
+    # -- serve streaming ---------------------------------------------------
+
+    def serve_stream(self, deployment: str, args: tuple, kwargs: dict,
+                     meta=None):
+        """Token-streaming serve call: the proxy runs the routed stream
+        server-side (shm prompt handoff included) and forwards each
+        chunk over a dedicated server-streaming RPC connection, so many
+        concurrent client streams multiplex cleanly. Server-side typed
+        sheds (RequestShedError) re-raise here."""
+        blob = ser.dumps((tuple(args), dict(kwargs or {}), meta))
+
+        def gen():
+            # The per-frame timeout only needs to outlive the proxy's
+            # keepalive cadence (20s), not the stream's total life — a
+            # deep-queued stream stays quiet for minutes while the
+            # proxy's keepalive frames keep the socket warm.
+            for item in self.rpc.call_stream(
+                    "client_serve_stream", self.session_id, deployment,
+                    blob, timeout=90.0):
+                if isinstance(item, dict) \
+                        and item.get("__stream_keepalive__"):
+                    continue
+                yield item
+
+        return gen()
+
     # -- lifecycle ---------------------------------------------------------
 
     def shutdown(self) -> None:

@@ -213,3 +213,41 @@ class ClientProxyServer:
 
     def rpc_client_kv(self, sid: str, op: str, *args):
         return getattr(self.backend, "kv_" + op)(*args)
+
+    # Prompt payloads at or above this many tokens ride the shared-memory
+    # object store instead of the actor-call frame: the proxy puts the
+    # list once and hands the replica an ObjectRef — a same-node shm
+    # read (zero-copy mmap), not a second serialize/copy over RPC.
+    PROMPT_SHM_MIN_TOKENS = 512
+
+    def rpc_client_serve_stream(self, sid: str, deployment: str,
+                                blob: bytes):
+        """Server-streaming serve call (``handle.stream()`` over
+        ``ray://``): a generator handler — the RPC layer ships one frame
+        per yielded token chunk, so N concurrent clients each hold their
+        own streaming connection while the proxy multiplexes onto the
+        ONE driver-style backend. Typed errors (RequestShedError from a
+        deadline dying mid-decode) propagate to the client as the
+        stream's terminal exception."""
+        from ray_tpu.serve import _private as sp
+
+        args, kwargs, meta = ser.loads(blob)
+        self._session(sid)
+        prompt_ref = None
+        if (args and isinstance(args[0], (list, tuple))
+                and len(args[0]) >= self.PROMPT_SHM_MIN_TOKENS):
+            prompt_ref = self.backend.put(list(args[0]))
+            args = (prompt_ref,) + tuple(args[1:])
+
+        def gen():
+            # The closure keeps prompt_ref pinned until the engine has
+            # fetched it (the submit round-trip completes before the
+            # first yield arrives back). keepalive frames flow while
+            # the stream sits in a deep admission queue (TTFT can be
+            # minutes there) so the client's socket never starves.
+            _pin = prompt_ref  # noqa: F841
+            yield from sp.stream_call(
+                deployment, tuple(args), dict(kwargs or {}), meta,
+                backend=self.backend, keepalive_every=20.0)
+
+        return gen()

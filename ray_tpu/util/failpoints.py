@@ -86,6 +86,11 @@ SITES = frozenset({
     # worker
     "worker.execute.before",
     "worker.execute.after",
+    # serve LLM engine (iteration-level scheduler: chaos can crash,
+    # delay or hang admission/decode mid-iteration; the loop requeues
+    # interrupted admissions and fails streams fast, never hangs)
+    "serve.llm.before_admit",
+    "serve.llm.before_step",
 })
 
 # site -> _Failpoint. `hit()` gates on plain truthiness of this dict:

@@ -409,6 +409,45 @@ SERVE_EVENTS_DROPPED = Counter(
     tag_keys=("node_id",),
 )
 
+# -- continuous-batching LLM decode engine (serve/llm_engine.py): one
+# compiled decode step over a fixed slot batch, requests admitted
+# between steps. Recorded through the same two-sided serve recorder
+# (engine replicas are workers; events replay into the agent registry
+# and federate on /metrics/cluster). Read batch occupancy BEFORE
+# blaming step latency: a slow tokens/s with full occupancy is a
+# kernel problem, with empty occupancy an admission problem.
+SERVE_DECODE_STEP_SECONDS = Histogram(
+    "ray_tpu_serve_decode_step_seconds",
+    "Wall time of one compiled decode iteration of the LLM engine "
+    "(device step + host sampling sync)",
+    boundaries=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0],
+    tag_keys=("node_id", "deployment"),
+)
+SERVE_DECODE_BATCH_OCCUPANCY = Histogram(
+    "ray_tpu_serve_decode_batch_occupancy",
+    "Active slots per decode iteration (the continuous-batching "
+    "utilization signal: 0-occupancy steps never run; a full batch at "
+    "max_batch means admission is the bottleneck)",
+    boundaries=[1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0],
+    tag_keys=("node_id", "deployment"),
+)
+SERVE_DECODE_TTFT_SECONDS = Histogram(
+    "ray_tpu_serve_decode_ttft_seconds",
+    "Time to first token per admitted stream (submit -> first token "
+    "available for delivery, engine-side). Extends past the request "
+    "boundaries: under deep admission queues (10k streams on 64 "
+    "slots) TTFT IS the queue, minutes not millis",
+    boundaries=SERVE_LATENCY_BOUNDARIES + [120.0, 300.0, 600.0],
+    tag_keys=("node_id", "deployment"),
+)
+SERVE_DECODE_TOKENS_TOTAL = Counter(
+    "ray_tpu_serve_decode_tokens_total",
+    "Tokens produced by the LLM decode engine (prefill first tokens + "
+    "decode-step tokens, all streams)",
+    tag_keys=("node_id", "deployment"),
+)
+
 # -- training goodput plane (input-pipeline + per-step train telemetry:
 # dataset stages, consumer-loop stall accounting, session-driven step
 # phases, the per-rank straggler gauge, and the trainer's downtime

@@ -1,0 +1,560 @@
+"""Continuous-batching LLM serving (PR 13): decode parity vs the naive
+per-request loop, slot recycle/eviction, deadline-shed-mid-decode,
+admission under a full batch, token streaming through handle + HTTP +
+the ``ray://`` proxy, TTFT histogram exactness, and the
+single-compiled-shape (no per-request recompiles) assertion.
+
+Test order matters (``-p no:randomly`` keeps definition order): the
+cluster/ray:// test tears down the module's local runtime, so it runs
+last.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.models import gpt2, llama
+from ray_tpu.scripts import bench_log
+from ray_tpu.serve import _observability as obs
+from ray_tpu.serve._observability import RequestShedError
+from ray_tpu.serve.llm_engine import LLMEngine
+from ray_tpu.util import failpoints, metrics
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    try:
+        if ray_tpu.is_initialized():
+            serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _clean_between_tests():
+    yield
+    failpoints.reset()
+    try:
+        if ray_tpu.is_initialized():
+            serve.shutdown()
+    except Exception:
+        pass
+
+
+GPT2_FP32 = dataclasses.replace(gpt2.GPT2Config.tiny(), dtype=jnp.float32)
+LLAMA_FP32 = dataclasses.replace(llama.LlamaConfig.tiny(),
+                                 dtype=jnp.float32)
+PROMPT = [5, 9, 2, 17, 3]
+
+
+def _naive_generate(forward, params, prompt, n, cfg):
+    """The single-tenant reference loop: full-context forward + argmax
+    per token — the thing the engine must match token-for-token."""
+    toks = list(prompt)
+    for _ in range(n):
+        logits = forward(params, jnp.asarray([toks], jnp.int32), cfg)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def _engine(**kw):
+    kw.setdefault("model", "gpt2")
+    kw.setdefault("config", GPT2_FP32)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("cache_len", 32)
+    kw.setdefault("max_prompt_len", 8)
+    kw.setdefault("max_new_tokens", 6)
+    return LLMEngine(**kw)
+
+
+def _snapshot():
+    return obs.parse_prometheus(metrics.prometheus_text())
+
+
+# -- decode parity vs the naive per-request loop ----------------------------
+
+
+def test_decode_parity_gpt2_vs_naive():
+    """prefill + cached decode steps == full-context forward, token for
+    token (fp32: identical math modulo reduction order)."""
+    params = gpt2.gpt2_init(jax.random.PRNGKey(0), GPT2_FP32)
+    want = _naive_generate(gpt2.gpt2_forward, params, PROMPT, 6,
+                           GPT2_FP32)
+    cache = gpt2.gpt2_init_cache(GPT2_FP32, 4, 32)
+    toks = np.zeros((2, 8), np.int32)
+    toks[0, :len(PROMPT)] = PROMPT
+    logits, cache = gpt2.gpt2_prefill(
+        params, cache, jnp.asarray(toks), jnp.asarray([2, 3], jnp.int32),
+        jnp.asarray([len(PROMPT), 1], jnp.int32), GPT2_FP32)
+    got = [int(jnp.argmax(logits[0]))]
+    cur = np.zeros(4, np.int32)
+    pos = np.zeros(4, np.int32)
+    cur[2], pos[2] = got[0], len(PROMPT)
+    for _ in range(5):
+        lg, cache = gpt2.gpt2_decode_step(
+            params, cache, jnp.asarray(cur), jnp.asarray(pos), GPT2_FP32)
+        nxt = int(jnp.argmax(lg[2]))
+        got.append(nxt)
+        cur[2], pos[2] = nxt, pos[2] + 1
+    assert got == want
+
+
+def test_decode_parity_llama_vs_naive():
+    """Same parity for the GQA/RoPE/SwiGLU family — the cache stores
+    only n_kv_head heads and the decode path must still match."""
+    params = llama.llama_init(jax.random.PRNGKey(1), LLAMA_FP32)
+    want = _naive_generate(llama.llama_forward, params, PROMPT, 6,
+                           LLAMA_FP32)
+    cache = llama.llama_init_cache(LLAMA_FP32, 4, 32)
+    assert cache["k"].shape[3] == LLAMA_FP32.n_kv_head  # GQA layout
+    assert cache["k"].dtype == LLAMA_FP32.dtype  # rides activation dtype
+    toks = np.zeros((1, 8), np.int32)
+    toks[0, :len(PROMPT)] = PROMPT
+    logits, cache = llama.llama_prefill(
+        params, cache, jnp.asarray(toks), jnp.asarray([0], jnp.int32),
+        jnp.asarray([len(PROMPT)], jnp.int32), LLAMA_FP32)
+    got = [int(jnp.argmax(logits[0]))]
+    cur = np.zeros(4, np.int32)
+    pos = np.zeros(4, np.int32)
+    cur[0], pos[0] = got[0], len(PROMPT)
+    for _ in range(5):
+        lg, cache = llama.llama_decode_step(
+            params, cache, jnp.asarray(cur), jnp.asarray(pos),
+            LLAMA_FP32)
+        nxt = int(jnp.argmax(lg[0]))
+        got.append(nxt)
+        cur[0], pos[0] = nxt, pos[0] + 1
+    assert got == want
+
+
+def test_engine_generate_matches_naive_both_models():
+    """The whole engine (admission -> prefill lane -> batched decode)
+    reproduces the naive loop for BOTH model families."""
+    for model, mod, cfg, fwd, init in (
+            ("gpt2", gpt2, GPT2_FP32, gpt2.gpt2_forward, gpt2.gpt2_init),
+            ("llama", llama, LLAMA_FP32, llama.llama_forward,
+             llama.llama_init)):
+        eng = _engine(model=model, config=cfg)
+        try:
+            want = _naive_generate(fwd, eng.params, PROMPT, 6, cfg)
+            assert eng.generate(PROMPT, 6) == want, model
+        finally:
+            eng.shutdown_engine()
+
+
+# -- scheduler: slots, admission, deadlines ---------------------------------
+
+
+def test_slot_recycle_and_admission_queue():
+    """More concurrent requests than slots: the overflow QUEUES (never
+    errors), slots recycle as streams finish, and every request gets
+    its full generation."""
+    eng = _engine(max_batch=2, prefill_rows=2)
+    try:
+        results: dict = {}
+        errors: list = []
+
+        def one(i):
+            try:
+                results[i] = eng.generate([i + 1, 7, 11], 5)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert len(results) == 8
+        assert all(len(v) == 5 for v in results.values())
+        st = eng.llm_stats()
+        assert st["admitted"] == 8          # every request held a slot
+        assert st["admitted"] > eng.max_batch  # ... by recycling
+        assert st["active"] == 0 and st["queued"] == 0
+        assert st["completed"] == 8
+    finally:
+        eng.shutdown_engine()
+
+
+def test_deadline_shed_mid_decode_frees_slot():
+    """A deadline dying mid-decode sheds TYPED (reason=decode) at the
+    next step boundary, frees the slot, and the engine keeps serving."""
+    before = _snapshot()
+    eng = _engine(max_batch=2, max_new_tokens=500, max_new_cap=1000,
+                  step_throttle_s=0.02)
+    try:
+        rid = eng.llm_submit(PROMPT, 500,
+                             deadline_ts=time.time() + 0.3)
+        got_tokens = 0
+        deadline = time.monotonic() + 30.0
+        shed = None
+        while time.monotonic() < deadline:
+            resp = eng.llm_next(rid, timeout_s=1.0)
+            got_tokens += sum(len(c) for c in resp["chunks"])
+            if resp["done"]:
+                shed = resp["shed"]
+                break
+        assert shed == "decode"
+        assert 0 < got_tokens < 500  # decoded some, then evicted
+        st = eng.llm_stats()
+        assert st["active"] == 0  # slot freed at the step boundary
+        assert st["shed"] == 1
+        # The slot is reusable: a fresh request completes.
+        assert len(eng.generate(PROMPT, 4)) == 4
+        delta = obs.diff_parsed(before, _snapshot())
+        sheds = obs.sum_counter(delta, "ray_tpu_serve_shed_total",
+                                "reason", deployment="llm")
+        assert sheds.get("decode") == 1
+    finally:
+        eng.shutdown_engine()
+
+
+def test_queued_deadline_shed_and_slack_admission():
+    """A request whose budget dies IN the queue sheds typed without
+    ever taking a slot; admission prefers tighter deadlines."""
+    eng = _engine(max_batch=1, prefill_rows=1, max_new_tokens=50,
+                  max_new_cap=100, step_throttle_s=0.01)
+    try:
+        # Occupy the only slot.
+        busy = eng.llm_submit(PROMPT, 50)
+        time.sleep(0.1)
+        dead = eng.llm_submit(PROMPT, 4,
+                              deadline_ts=time.time() + 0.05)
+        time.sleep(0.3)  # budget dies while queued behind `busy`
+        resp = eng.llm_next(dead, timeout_s=5.0)
+        assert resp["done"] and resp["shed"] == "decode"
+        # Drain the busy stream so teardown is clean.
+        while not eng.llm_next(busy, timeout_s=2.0)["done"]:
+            pass
+    finally:
+        eng.shutdown_engine()
+
+
+def test_admission_full_queue_sheds_typed():
+    eng = _engine(max_batch=1, max_queue=2, max_new_tokens=50,
+                  max_new_cap=100, step_throttle_s=0.01)
+    try:
+        eng.llm_submit(PROMPT, 50)
+        time.sleep(0.2)  # first request admitted to the slot
+        eng.llm_submit(PROMPT, 50)
+        eng.llm_submit(PROMPT, 50)
+        with pytest.raises(RequestShedError) as ei:
+            eng.llm_submit(PROMPT, 4)
+        assert ei.value.reason == "decode"
+    finally:
+        eng.shutdown_engine()
+
+
+def test_cancel_frees_slot_and_queue():
+    """llm_cancel drops a queued request and evicts an active one (the
+    abandoned-caller path generate() uses on timeout): slot freed,
+    stream terminates with a 'cancelled' error, engine keeps serving."""
+    eng = _engine(max_batch=1, prefill_rows=1, max_new_tokens=100,
+                  max_new_cap=200, step_throttle_s=0.01)
+    try:
+        active = eng.llm_submit(PROMPT, 100)
+        deadline = time.monotonic() + 30.0
+        while eng.llm_stats()["active"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)  # first prefill compiles; wait for the slot
+        assert eng.llm_stats()["active"] == 1
+        queued = eng.llm_submit(PROMPT, 4)
+        assert eng.llm_cancel(queued)
+        assert eng.llm_cancel(active)
+        assert not eng.llm_cancel(active)  # already gone
+        resp = eng.llm_next(active, timeout_s=2.0)
+        assert resp["done"] and resp["error"] == "cancelled"
+        assert len(eng.generate(PROMPT, 3)) == 3  # slot reusable
+    finally:
+        eng.shutdown_engine()
+
+
+def test_ring_cache_wrap():
+    """Generation past cache_len wraps the ring cursor (sliding-window
+    attention) instead of erroring."""
+    eng = _engine(max_batch=2, cache_len=8, max_prompt_len=8,
+                  max_new_tokens=20, max_new_cap=64)
+    try:
+        out = eng.generate([1, 2, 3], 20)
+        assert len(out) == 20
+        assert eng.llm_stats()["ring_wraps"] > 0
+    finally:
+        eng.shutdown_engine()
+
+
+def test_compile_counters_single_shape():
+    """Assorted prompt lengths and generation lengths all ride the SAME
+    two compiled shapes — the no-per-request-recompile claim, asserted
+    via trace-time counters."""
+    eng = _engine(max_batch=4)
+    try:
+        for prompt, n in (([1], 1), ([1, 2, 3], 4), (list(range(1, 9)),
+                                                     6), ([9, 9], 2)):
+            assert len(eng.generate(prompt, n)) == n
+        assert eng.llm_stats()["compiles"] == {"decode": 1, "prefill": 1}
+    finally:
+        eng.shutdown_engine()
+
+
+def test_ttft_histogram_exact_counts():
+    """Every admitted stream observes EXACTLY one TTFT sample, and the
+    token counter matches the delivered tokens exactly."""
+    before = _snapshot()
+    eng = _engine(deployment="ttft_test")
+    try:
+        total = 0
+        for i in range(5):
+            total += len(eng.generate([i + 1, 3, 5], 4))
+        delta = obs.diff_parsed(before, _snapshot())
+        ttft = obs.histogram_dist(
+            delta, "ray_tpu_serve_decode_ttft_seconds",
+            deployment="ttft_test")
+        assert ttft and int(ttft["count"]) == 5
+        toks = obs.sum_counter(
+            delta, "ray_tpu_serve_decode_tokens_total", "deployment",
+            deployment="ttft_test")
+        assert int(sum(toks.values())) == total == 20
+        occ = obs.histogram_dist(
+            delta, "ray_tpu_serve_decode_batch_occupancy",
+            deployment="ttft_test")
+        steps = obs.histogram_dist(
+            delta, "ray_tpu_serve_decode_step_seconds",
+            deployment="ttft_test")
+        assert occ and steps and occ["count"] == steps["count"]
+    finally:
+        eng.shutdown_engine()
+
+
+def test_failpoint_step_raise_fails_streams_fast():
+    """A persistently raise-armed before_step trips the 3-strike
+    fail-fast: active streams ERROR out quickly instead of waiting out
+    the armed site — fail fast, never hang."""
+    eng = _engine(max_new_tokens=50, max_new_cap=100,
+                  step_throttle_s=0.01)
+    try:
+        rid = eng.llm_submit(PROMPT, 50)
+        deadline = time.monotonic() + 30.0
+        while eng.llm_stats()["active"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        failpoints.arm("serve.llm.before_step", "raise")
+        resp = {}
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            resp = eng.llm_next(rid, timeout_s=1.0)
+            if resp["done"]:
+                break
+        assert resp.get("done"), "stream hung behind an armed failpoint"
+        assert resp["error"], resp
+        failpoints.reset()
+        assert len(eng.generate(PROMPT, 3)) == 3  # engine recovered
+    finally:
+        failpoints.reset()
+        eng.shutdown_engine()
+
+
+def test_failpoint_admission_raise_recovers():
+    """An armed serve.llm.before_admit raise interrupts the admission
+    batch; the engine requeues and the stream still completes once the
+    site disarms (raise,once) — crash the scheduler mid-iteration,
+    never lose the request."""
+    assert "serve.llm.before_admit" in failpoints.SITES
+    assert "serve.llm.before_step" in failpoints.SITES
+    eng = _engine()
+    try:
+        failpoints.arm("serve.llm.before_admit", "raise,once")
+        assert len(eng.generate(PROMPT, 4)) == 4
+        st = eng.llm_stats()
+        assert st["completed"] == 1
+    finally:
+        failpoints.reset()
+        eng.shutdown_engine()
+
+
+# -- streaming transports ---------------------------------------------------
+
+
+def _deploy_engine(**kw):
+    kw.setdefault("model", "gpt2")
+    kw.setdefault("config", GPT2_FP32)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("cache_len", 32)
+    kw.setdefault("max_prompt_len", 8)
+    kw.setdefault("max_new_tokens", 6)
+    # No explicit deployment= label: the engine must ADOPT the serve
+    # deployment's name via Replica's set_deployment_name hook.
+    eng = serve.deployment(name="llm", max_concurrent_queries=32,
+                           route_prefix="/llm")(LLMEngine)
+    return serve.run(eng.bind(**kw))
+
+
+def test_streaming_handle_and_http_local():
+    """Order + completeness through the real transports: handle.stream
+    chunks and chunked-HTTP ndjson both concatenate to exactly the
+    blocking lane's tokens, and serve.stats() grows a decode section."""
+    handle = _deploy_engine()
+    want = ray_tpu.get(
+        handle.remote({"tokens": PROMPT, "max_tokens": 5}), timeout=120)
+    assert len(want["tokens"]) == 5
+
+    chunks = list(handle.stream(PROMPT, 5))
+    assert [t for ch in chunks for t in ch] == want["tokens"]
+    assert all(len(ch) >= 1 for ch in chunks)  # per-step chunking
+
+    port = serve.start_http_proxy()
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        body = json.dumps({"tokens": PROMPT, "max_tokens": 5}).encode()
+        conn.request("POST", "/llm", body=body,
+                     headers={"Content-Type": "application/json",
+                              serve.STREAM_HEADER: "1"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Transfer-Encoding") == "chunked"
+        lines = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            lines.append(json.loads(line))
+        toks = [t for ln in lines if "tokens" in ln
+                for t in ln["tokens"]]
+        assert toks == want["tokens"]
+        assert lines[-1].get("done") is True
+        # Keep-alive survives a chunked response: a plain request on
+        # the same connection still answers.
+        conn.request("POST", "/llm", body=body,
+                     headers={"Content-Type": "application/json"})
+        r2 = conn.getresponse()
+        assert r2.status == 200
+        assert json.loads(r2.read())["tokens"] == want["tokens"]
+    finally:
+        conn.close()
+
+    stats = serve.stats()
+    decode = stats["deployments"]["llm"].get("decode")
+    assert decode and decode["streams"] >= 2
+    assert decode.get("tokens", 0) >= 15
+
+
+def test_stream_deadline_shed_typed_through_handle():
+    handle = _deploy_engine()
+    with pytest.raises(RequestShedError):
+        list(handle.options(deadline_s=0.0).stream(PROMPT, 4))
+
+
+def test_blocking_lane_deadline_shed_mid_decode():
+    """The BLOCKING lane (handle.remote -> __call__) inherits the serve
+    request context's deadline: a budget dying mid-decode sheds typed
+    and frees the slot, same as the streaming lane."""
+    handle = _deploy_engine(max_new_tokens=500, max_new_cap=1000,
+                            step_throttle_s=0.02)
+    t0 = time.monotonic()
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(handle.options(deadline_s=0.6).remote(
+            {"tokens": PROMPT, "max_tokens": 500}), timeout=120)
+    assert "shed" in repr(ei.value).lower(), repr(ei.value)
+    assert time.monotonic() - t0 < 60.0  # shed, not a 500-token wait
+
+
+def test_llm_serving_evidence_lint():
+    """record_llm_serving emits the shape bench_log --check demands; a
+    TTFT-less or verdict-less line fails the lint."""
+    assert "llm_serving" in bench_log.KNOWN_BENCHES
+    entry = bench_log.record_llm_serving(
+        client={"ttft_p50_ms": 12.5, "ttft_p99_ms": 80.1},
+        server={"ttft_count": 100, "tokens": 800},
+        agreement={"ok": True}, streams=100, tokens_s=5000.0,
+        device="tpu", path="")
+    entry.pop("committed_to")
+    entry["ts"] = 123.0  # stamped by record() at append time
+    assert bench_log.check_line(entry) == []
+    bad = dict(entry)
+    bad["client"] = {}
+    assert any("ttft_p50_ms" in e for e in bench_log.check_line(bad))
+    bad2 = dict(entry)
+    bad2.pop("agreement")
+    assert any("agreement.ok" in e for e in bench_log.check_line(bad2))
+    bad3 = dict(entry)
+    bad3.pop("tokens_s")
+    assert any("tokens_s" in e for e in bench_log.check_line(bad3))
+
+
+# -- cluster backend + ray:// proxy (runs LAST: tears down the module
+# runtime) ------------------------------------------------------------------
+
+
+def test_cluster_stream_and_ray_client_proxy():
+    """Streaming order/completeness on the CLUSTER backend (replica in a
+    worker process, events federate over the worker plane), then the
+    same stream forwarded chunk-by-chunk through the ``ray://`` client
+    proxy — including the zero-copy shm handoff lane for big prompts."""
+    from ray_tpu.cluster import Cluster
+    from ray_tpu.util.client import ClientProxyServer
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes()
+    ray_tpu.init(cluster.address)
+    proxy = None
+    try:
+        handle = _deploy_engine()
+        want = ray_tpu.get(
+            handle.remote({"tokens": PROMPT, "max_tokens": 5}),
+            timeout=300)
+        chunks = list(handle.stream(PROMPT, 5))
+        assert [t for ch in chunks for t in ch] == want["tokens"]
+
+        # TTFT federates from the replica worker to the cluster scrape.
+        deadline = time.monotonic() + 30.0
+        decode = {}
+        while time.monotonic() < deadline:
+            parsed = obs.parse_prometheus(obs.metrics_text())
+            decode = obs.decode_stats(parsed, "llm")
+            if decode.get("streams", 0) >= 2:
+                break
+            time.sleep(0.5)
+        assert decode.get("streams", 0) >= 2, decode
+
+        proxy = ClientProxyServer(cluster.address)
+        ray_tpu.shutdown()
+        ray_tpu.init(address=f"ray://{proxy.address}")
+        h2 = serve.get_deployment_handle("llm")
+        toks2 = [t for ch in h2.stream(PROMPT, 5) for t in ch]
+        assert toks2 == want["tokens"]
+        # Big prompt rides the shm store proxy->replica (the handoff
+        # threshold), and the stream still completes in order.
+        big = PROMPT + [1] * 600
+        toks3 = [t for ch in h2.stream(big, 4) for t in ch]
+        assert len(toks3) == 4
+        # Typed shed crosses the RPC stream boundary.
+        with pytest.raises(RequestShedError):
+            list(h2.options(deadline_s=0.0).stream(PROMPT, 4))
+    finally:
+        try:
+            ray_tpu.shutdown()
+            ray_tpu.init(cluster.address)
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+        if proxy is not None:
+            proxy.shutdown()
+        cluster.shutdown()
