@@ -221,6 +221,9 @@ class ClusterBackend:
         # affinity fallback); refreshed at most ~1/s so a mass-recovery
         # storm costs one `nodes` RPC per second, not one per spec.
         self._nodes_cache: tuple = (-1e9, None)
+        # Per-oid throttle for restore-from-spill-URI attempts (bounded;
+        # see _try_restore_spilled).
+        self._restore_attempts: dict[str, float] = {}
         # Owner-distributed object directory (reference ownership model:
         # reference_count.h:61 holds per-object state on the OWNING worker,
         # ownership_based_object_directory.h resolves locations from
@@ -820,9 +823,36 @@ class ClusterBackend:
             self._nodes_cache = (now, nodes)
         return nodes
 
+    def _try_restore_spilled(self, oid: str) -> bool:
+        """Remote-spill recovery: if the head holds a spill-URI record
+        for this object, have it restored onto a live node instead of
+        recomputing (or losing) it. Throttled per oid so the location
+        poll can call this every round without hammering the head.
+        On success the restored location is recorded into the local
+        owner table so the next poll round resolves without an RPC."""
+        now = time.monotonic()
+        last = self._restore_attempts.get(oid, 0.0)
+        if now - last < 2.0:
+            return False
+        if len(self._restore_attempts) > 4096:
+            self._restore_attempts.clear()
+        self._restore_attempts[oid] = now
+        try:
+            loc = self.head.call("restore_spilled", oid, timeout=45.0)
+        except (ConnectionLost, OSError):
+            return False
+        if not loc:
+            return False
+        node_id, address, store_path = loc
+        self._owner_record(oid, node_id, address, store_path)
+        return True
+
     def _maybe_recover(self, oid: str) -> bool:
         """Lineage reconstruction: resubmit the creating task if its node
-        died before the object appeared. Returns True if resubmitted."""
+        died before the object appeared — unless a REMOTE-SPILLED copy
+        of it survives, in which case the head restores it from the
+        spill URI and no recomputation happens. Returns True if
+        recovery was initiated either way."""
         spec = self._lineage.get(oid)
         if spec is None:
             # Streaming indices > 0 are synthesized by the generator and
@@ -846,6 +876,11 @@ class ClusterBackend:
         info = nodes.get(assigned, {})
         if info.get("Alive"):
             return False  # still computing (a DRAINING node finishes work)
+        # The creating node is dead — but if the object was spilled to a
+        # remote target, restore beats recompute (cheaper, and works for
+        # results whose inputs are gone too).
+        if self._try_restore_spilled(oid):
+            return True
         # Preemption exemption: a task lost to a drained/preempted node
         # re-executes WITHOUT consuming retries_left — planned node
         # departure is the platform's fault, not the task's.

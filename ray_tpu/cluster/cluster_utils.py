@@ -31,6 +31,12 @@ class Cluster:
         from ray_tpu.cluster.rpc import ensure_cluster_token
 
         ensure_cluster_token()
+        # Reclaim dead runs' leaked shm segments before this cluster
+        # allocates its own (a SIGKILLed soak can leave 100+ GB in
+        # /dev/shm and OOM every later run on the box).
+        from ray_tpu.util.shm_sweep import sweep_stale_shm
+
+        sweep_stale_shm()
         if initialize_head:
             self.head = HeadServer(persist_path=persist_path)
             if head_node_args is not None:

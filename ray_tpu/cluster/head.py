@@ -400,6 +400,23 @@ class HeadServer:
         self._released_streams: dict[str, int] = {}  # guarded-by: _obj_lock
         self._free_queue: list[tuple] = []  # guarded-by: _obj_lock
         self._free_cv = threading.Condition(self._obj_lock)
+        # Remote-spill records: oid -> spill URI. Written when an agent
+        # spills to a REMOTE target (rpc_add_spilled); read by the
+        # restore plane — a dead node's spilled objects are re-fetched
+        # from the URI onto a live node (rpc_restore_spilled / the
+        # wait-location hook) instead of being recomputed or lost.
+        self._spilled: dict[str, str] = {}  # guarded-by: _obj_lock
+        # Restore work queue + in-flight dedup (one restore RPC per oid
+        # at a time; waiters block on _objects_cv until the restored
+        # location lands through rpc_add_location).
+        self._restore_queue: list[str] = []  # guarded-by: _obj_lock
+        self._restore_inflight: set[str] = set()  # guarded-by: _obj_lock
+        # oid -> last FAILED attempt time: wait-location wakes fire
+        # every ~1s per waiter, and without a backoff an unreachable
+        # spill target turns into a restore-RPC storm that head-of-line
+        # blocks the single restore thread. guarded-by: _obj_lock
+        self._restore_backoff: dict[str, float] = {}
+        self._restore_cv = threading.Condition(self._obj_lock)
         # Leak sweeper state: oid -> flag record (state.memory_leaks()).
         # Initialized BEFORE the RPC server: _maybe_free clears flags.
         self._leaks: dict[str, dict] = {}  # guarded-by: _obj_lock
@@ -460,6 +477,7 @@ class HeadServer:
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
         self._monitor.start()
         threading.Thread(target=self._free_loop, daemon=True).start()
+        threading.Thread(target=self._restore_loop, daemon=True).start()
         if config.leak_sweep_interval_s > 0:
             threading.Thread(
                 target=self._leak_sweep_loop, daemon=True).start()
@@ -1124,6 +1142,7 @@ class HeadServer:
                 del self._freed[k]
         entry = self._objects.pop(oid, None)
         self._leaks.pop(oid, None)  # freed: by definition not leaked
+        queued_live = False
         if entry is not None:
             created = (entry.get("attr") or {}).get("created_at")
             if created:
@@ -1140,6 +1159,17 @@ class HeadServer:
                 node = self._nodes.get(nid)
                 if node is not None and node.alive:
                     self._free_queue.append((node, oid))
+                    queued_live = True
+        # Remote-spilled copy with no live holder (the spiller died):
+        # any live node can delete it from the shared target — without
+        # this the URI leaks one file per freed object.
+        uri = self._spilled.pop(oid, None)
+        if uri is not None and not queued_live:
+            anynode = next(
+                (n for n in self._nodes.values() if n.alive), None)
+            if anynode is not None:
+                self._free_queue.append((anynode, oid, uri))
+        if entry is not None or uri is not None:
             self._free_cv.notify_all()
         # Cascade: the container no longer holds its nested refs.
         for inner in self._contained.pop(oid, []):
@@ -1155,9 +1185,15 @@ class HeadServer:
                 while not self._free_queue and not self._stop.is_set():
                     self._free_cv.wait(0.5)
                 batch, self._free_queue = self._free_queue[:], []
-            for node, oid in batch:
+            for item in batch:
                 try:
-                    node.client.call("free_object", oid, timeout=5.0)
+                    if len(item) == 3:  # (node, oid, uri): URI-only copy
+                        node, oid, uri = item
+                        node.client.call("delete_spilled", oid, uri,
+                                         timeout=5.0)
+                    else:
+                        node, oid = item
+                        node.client.call("free_object", oid, timeout=5.0)
                 except Exception:
                     pass
 
@@ -1201,12 +1237,25 @@ class HeadServer:
             with self._obj_lock:
                 self._refs.pop(oid, None)
                 self._freed[oid] = True
+                uri = self._spilled.pop(oid, None)
                 entry = self._objects.pop(oid, None)
+                queued_live = False
                 if entry is not None:
                     for nid in entry["nodes"]:
                         node = self._nodes.get(nid)
                         if node is not None and node.alive:
                             self._free_queue.append((node, oid))
+                            queued_live = True
+                # Same dead-spiller fanout as _maybe_free: a released
+                # stream object whose URI copy has no live holder must
+                # still be deleted from the shared target.
+                if uri is not None and not queued_live:
+                    anynode = next(
+                        (n for n in self._nodes.values() if n.alive),
+                        None)
+                    if anynode is not None:
+                        self._free_queue.append((anynode, oid, uri))
+                if entry is not None or uri is not None:
                     self._free_cv.notify_all()
         return len(doomed)
 
@@ -1305,6 +1354,122 @@ class HeadServer:
                 if node_id in e["nodes"]
             ]
 
+    # -- remote spill records + restore-from-URI --------------------------
+
+    def rpc_add_spilled(self, oids, uri):
+        """An agent moved these objects to a REMOTE spill target: record
+        them so the copies survive the spiller's death — the restore
+        plane re-fetches a dead node's spilled objects from the URI
+        instead of recomputing them (external_storage.py + lineage
+        recovery composed)."""
+        with self._obj_lock:
+            for oid in oids:
+                if oid in self._freed or self._stream_released(oid):
+                    continue  # freed while spilling: don't resurrect
+                self._spilled[oid] = uri
+        return True
+
+    def rpc_spilled_objects(self):
+        """{oid: uri} snapshot of the remote-spill records (tests,
+        ``ray-tpu memory`` surfaces)."""
+        with self._obj_lock:
+            return dict(self._spilled)
+
+    def _queue_restore_locked(self, oid: str) -> None:
+        """Caller holds ``_obj_lock``: queue a restore for an oid whose
+        only surviving copy is on the remote spill target (idempotent
+        per in-flight restore, backed off per failed attempt so an
+        unreachable target doesn't become an RPC storm)."""
+        if oid in self._restore_inflight:
+            return
+        if time.monotonic() - self._restore_backoff.get(oid, 0.0) < 5.0:
+            return  # recent failed attempt: let the waiter's own
+            # deadline (or recomputation fallback) decide, retry later
+        self._restore_inflight.add(oid)
+        self._restore_queue.append(oid)
+        self._restore_cv.notify_all()
+
+    def _restore_loop(self):
+        """Fan restore-from-URI RPCs out to live agents OUTSIDE the
+        object lock (the free-loop shape). Waiters observe the restored
+        location through the normal add_location -> _objects_cv path."""
+        while not self._stop.is_set():
+            with self._restore_cv:
+                while not self._restore_queue and not self._stop.is_set():
+                    self._restore_cv.wait(0.5)
+                batch, self._restore_queue = self._restore_queue[:], []
+            for oid in batch:
+                self._restore_one(oid)
+
+    def _restore_one(self, oid: str) -> bool:
+        """One restore attempt: pick a live agent, have it fetch the
+        object from the spill URI into its store, register the new
+        location. Clears the in-flight mark either way (a failed
+        attempt re-queues on the next wait-location pass). NodeInfo
+        reads are lock-free per the shard-order comment in __init__."""
+        with self._obj_lock:
+            uri = self._spilled.get(oid)
+            entry = self._objects.get(oid)
+            owner = (entry or {}).get("owner", "")
+            has_live = bool(entry and any(
+                self._nodes.get(nid) and self._nodes[nid].alive
+                for nid in entry["nodes"]))
+        restored_on = None
+        if uri is not None and not has_live:
+            for cand in list(self._nodes.values()):
+                if not cand.alive:
+                    continue
+                try:
+                    ok = bool(cand.client.call(
+                        "restore_from_uri", oid, uri, owner,
+                        timeout=30.0))
+                except Exception:
+                    ok = False
+                if ok:
+                    restored_on = cand
+                    break
+        if restored_on is not None:
+            self.rpc_add_location(oid, restored_on.node_id)
+        with self._obj_lock:
+            self._restore_inflight.discard(oid)
+            if restored_on is not None or has_live:
+                self._restore_backoff.pop(oid, None)
+            else:
+                if len(self._restore_backoff) > 4096:
+                    self._restore_backoff.clear()
+                self._restore_backoff[oid] = time.monotonic()
+            self._objects_cv.notify_all()
+        return restored_on is not None or has_live
+
+    def rpc_restore_spilled(self, oid, timeout=30.0):
+        """Synchronous restore entry point for lineage recovery
+        (client ``_maybe_recover``): if the object has a remote-spill
+        record, make sure a live copy exists — restoring from the URI
+        if needed — and return its ``(node_id, address, store_path)``
+        location (None = not spilled / restore failed: fall back to
+        recomputation). Concurrent callers dedup on the in-flight mark
+        and wait for the winner's location to land."""
+        deadline = time.monotonic() + (timeout or 30.0)
+        with self._obj_lock:
+            if oid not in self._spilled:
+                return None
+            claimed = oid not in self._restore_inflight
+            if claimed:
+                self._restore_inflight.add(oid)
+        if claimed:
+            self._restore_one(oid)
+        with self._obj_lock:
+            while True:
+                entry = self._objects.get(oid)
+                for nid in (entry or {}).get("nodes", ()):
+                    node = self._nodes.get(nid)
+                    if node is not None and node.alive:
+                        return (nid, node.address, node.store_path)
+                if claimed or time.monotonic() >= deadline:
+                    return None  # our own attempt failed: report now
+                self._objects_cv.wait(
+                    min(1.0, max(0.05, deadline - time.monotonic())))
+
     def rpc_remove_location(self, oid, node_id):
         with self._obj_lock:
             entry = self._objects.get(oid)
@@ -1337,6 +1502,12 @@ class HeadServer:
                             ],
                             "error": entry["error"],
                         }
+                # No live copy — but a remote-spill record means the
+                # bytes still exist on the spill target: kick off a
+                # restore (dead-node recovery) and keep waiting; the
+                # restored location lands through add_location.
+                if oid in self._spilled:
+                    self._queue_restore_locked(oid)
                 remaining = (
                     None if deadline is None else deadline - time.monotonic()
                 )
@@ -1368,6 +1539,12 @@ class HeadServer:
                                       "error": entry["error"]}
                 if found:
                     return found
+                # Unresolvable oids whose bytes survive on the remote
+                # spill target: trigger restores while we wait (the
+                # dead-node recovery path; see rpc_wait_location).
+                for oid in oids:
+                    if oid in self._spilled:
+                        self._queue_restore_locked(oid)
                 remaining = (
                     None if deadline is None
                     else deadline - time.monotonic()
@@ -2855,6 +3032,7 @@ class HeadServer:
         self._stop.set()
         with self._free_cv:
             self._free_cv.notify_all()
+            self._restore_cv.notify_all()
         if self._metrics_shutdown is not None:
             try:
                 self._metrics_shutdown()

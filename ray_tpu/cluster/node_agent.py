@@ -85,11 +85,27 @@ class NodeAgent:
         session = session or f"s{os.getpid()}"
         self.store_path = f"/dev/shm/ray_tpu_{session}_{self.node_id[-8:]}"
         self.store = ShmStore(self.store_path, store_capacity, create=True)
-        # Spill directory (external_storage.py:72 analog): cold primary
+        # Spill target (external_storage.py:72 analog): cold primary
         # copies move here under memory pressure; restored on demand.
+        # Default: a per-session local dir (dies with the node). With
+        # config.spill_uri set, spills go to the shared remote backend
+        # and the head records them so a DEAD node's spilled objects
+        # restore from the URI instead of recomputing (spill_storage.py).
+        from ray_tpu.cluster import spill_storage
+
         self.spill_dir = f"/tmp/ray_tpu_spill_{session}_{self.node_id[-8:]}"
-        os.makedirs(self.spill_dir, exist_ok=True)
+        spill_uri = config.spill_uri
+        if spill_uri:
+            # A typo'd URI must fail agent boot, not the first
+            # memory-pressure spill.
+            self.spill_backend = spill_storage.backend_for(spill_uri)
+        else:
+            self.spill_backend = spill_storage.local_backend(self.spill_dir)
         self._spill_lock = threading.Lock()
+        # Foreign-URI restore backends (rpc_restore_from_uri for objects
+        # another node spilled under a different/older spill_uri),
+        # bounded small — a cluster normally has ONE spill target.
+        self._restore_backends: dict[str, object] = {}
         self._deferred_deletes: set[str] = set()
 
         self._lock = threading.RLock()
@@ -202,6 +218,7 @@ class NodeAgent:
         self._evictions_exported = 0
         self._store_gauges_exported = False
         self._spill_denied = 0
+        self._spill_restores = 0
         # Resource-view gossip (reference: ray_syncer.h:88 — nodes share
         # resource views so scheduling needn't centralize). Membership
         # (who exists / who died) still comes from the head, the GCS's
@@ -2140,8 +2157,75 @@ class NodeAgent:
 
     # -- object serving ---------------------------------------------------
 
-    def _spill_path(self, oid: str) -> str:
-        return os.path.join(self.spill_dir, oid)
+    def _restore_backend_for(self, uri: str):
+        """The spill backend behind ``uri`` — the node's own backend
+        when it matches (the common case: one cluster-wide spill_uri),
+        else a cached foreign-URI backend (restore of objects spilled
+        under an older config)."""
+        if uri == getattr(self.spill_backend, "uri", None):
+            return self.spill_backend
+        with self._lock:
+            be = self._restore_backends.get(uri)
+            if be is None:
+                from ray_tpu.cluster import spill_storage
+
+                if len(self._restore_backends) > 8:
+                    self._restore_backends.clear()
+                be = self._restore_backends[uri] = \
+                    spill_storage.backend_for(uri)
+        return be
+
+    def _count_restore(self) -> None:
+        from ray_tpu.util import metrics as _metrics
+
+        self._spill_restores += 1
+        try:
+            _metrics.SPILL_RESTORES_TOTAL.inc(
+                tags={"node_id": self.node_id})
+        except Exception:
+            pass
+
+    def rpc_restore_from_uri(self, oid, uri, owner=None):
+        """Restore one spilled object from a (remote) spill target into
+        THIS node's store — the recovery half of remote spill: the head
+        routes a dead node's spilled objects here instead of letting
+        lineage recompute them. Idempotent: an already-present object
+        returns True without touching the target. ``owner`` (the owning
+        client's directory address, when the head knows it) gets the
+        new location pushed directly so self-owned gets unblock without
+        a head sweep. Returns whether the object is now in this store."""
+        if self.store.contains(oid):
+            return True
+        try:
+            failpoints.hit("agent.restore.before_fetch")
+            backend = self._restore_backend_for(uri)
+        except Exception:
+            return False
+        got = backend.read(oid)
+        if got is None:
+            return False
+        meta, data = got
+        for attempt in range(4):
+            try:
+                # Not pinned (same contract as local spill restores):
+                # the URI copy stays the durable one until the object is
+                # freed, so a re-eviction only costs a re-fetch.
+                self.store.put(oid, data, meta)
+                break
+            except Exception:
+                # Store full: make room the same way a put does, then
+                # retry; a restore that cannot fit gives up (the caller
+                # falls back to lineage recomputation).
+                if attempt == 3 or self.rpc_spill(
+                        len(data) + config.spill_headroom_bytes) <= 0:
+                    return False
+        self._count_restore()
+        if owner:
+            try:
+                self._owner_notify(owner, oid)
+            except Exception:
+                pass  # owner gone/partitioned: the head sweep resolves
+        return True
 
     def rpc_fetch_object(self, oid):
         """Serve an object's (meta, data) to a peer in ONE frame — the
@@ -2164,20 +2248,22 @@ class NodeAgent:
         return restored
 
     def _restore_from_spill(self, oid):
-        path = self._spill_path(oid)
         try:
-            with open(path, "rb") as f:
-                meta_len = int.from_bytes(f.read(8), "little")
-                meta = f.read(meta_len)
-                data = f.read()
-        except OSError:
+            failpoints.hit("agent.restore.before_fetch")
+        except failpoints.FailpointError:
+            return None  # chaos: restore fails, caller degrades
+        got = self.spill_backend.read(oid)
+        if got is None:
             return None
+        meta, data = got
         try:
             # Restored copies are NOT pinned: they may be re-evicted (the
-            # spill file remains the durable copy until the object is freed).
+            # spill target remains the durable copy until the object is
+            # freed).
             self.store.put(oid, data, meta)
         except Exception:
             pass
+        self._count_restore()
         return meta, data
 
     def rpc_fetch_object_info(self, oid, inline_max: int = 0):
@@ -2235,14 +2321,7 @@ class NodeAgent:
                 return bytes(data[offset:offset + length])
             finally:
                 self.store.release(oid)
-        path = self._spill_path(oid)
-        try:
-            with open(path, "rb") as f:
-                meta_len = int.from_bytes(f.read(8), "little")
-                f.seek(8 + meta_len + offset)
-                return f.read(length)
-        except OSError:
-            return None
+        return self.spill_backend.read_range(oid, offset, length)
 
     def rpc_spill(self, bytes_needed: int):
         """Move cold, unreferenced primary copies to disk until
@@ -2257,6 +2336,8 @@ class NodeAgent:
             oids = self.head.call("objects_on_node", self.node_id)
         except Exception:
             oids = []
+        spilled_remote: list[str] = []
+        spilled_bytes = 0
         with self._spill_lock:
             cands = []
             for oid in oids:
@@ -2278,25 +2359,23 @@ class NodeAgent:
                 if got is None:
                     continue
                 data, meta = got
-                path = self._spill_path(oid)
-                tmp = path + ".tmp"
                 try:
-                    with open(tmp, "wb") as f:
-                        f.write(len(meta).to_bytes(8, "little"))
-                        f.write(meta)
-                        f.write(bytes(data))
-                    os.replace(tmp, path)
-                except OSError:
+                    failpoints.hit("agent.spill.before_write")
+                    written = self.spill_backend.write(
+                        oid, bytes(meta), bytes(data))
+                except Exception:
+                    # Chaos raise or target I/O error: this object stays
+                    # resident; pressure continues, never corrupts.
                     self.store.release(oid)
                     continue
                 self.store.release(oid)
                 if self.store.evict(oid):  # despite pin: bytes now on disk
                     freed += size
+                    spilled_bytes += written
+                    if self.spill_backend.remote:
+                        spilled_remote.append(oid)
                 else:
-                    try:
-                        os.unlink(path)
-                    except OSError:
-                        pass
+                    self.spill_backend.delete(oid)
             if freed < bytes_needed:
                 # Pressure signal: the store could not make the room a
                 # put asked for (everything left is referenced/pinned) —
@@ -2309,13 +2388,33 @@ class NodeAgent:
                         tags={"node_id": self.node_id})
                 except Exception:
                     pass
-            return freed
+        if spilled_bytes:
+            from ray_tpu.util import metrics as _metrics
+
+            try:
+                _metrics.SPILL_BYTES_TOTAL.inc(
+                    spilled_bytes, tags={"node_id": self.node_id})
+            except Exception:
+                pass
+        if spilled_remote:
+            # Remote target: record the spilled copies with the head so
+            # a DEAD node's objects restore from the URI instead of
+            # recomputing. OUTSIDE the spill lock (a slow/partitioned
+            # head must not wedge other spilling threads) and
+            # best-effort — an unrecorded spill only degrades recovery
+            # back to lineage recomputation.
+            try:
+                self.head.call("add_spilled", spilled_remote,
+                               self.spill_backend.uri, timeout=10.0)
+            except Exception:
+                pass
+        return freed
 
     def rpc_free_object(self, oid):
         """Head says nothing references this object anymore: drop the shm
-        copy and any spill file (free-on-zero broadcast target). The spill
-        lock orders this against an in-progress spill pass, so a spill
-        can't recreate the file after we unlink it."""
+        copy and any spilled copy (free-on-zero broadcast target). The
+        spill lock orders this against an in-progress spill pass, so a
+        spill can't recreate the target copy after we delete it."""
         with self._spill_lock:
             self.store.pin(oid, False)
             if not self.store.delete(oid) and self.store.contains(oid):
@@ -2323,37 +2422,41 @@ class NodeAgent:
                 # loop retries until readers release.
                 with self._lock:
                     self._deferred_deletes.add(oid)
-            try:
-                os.unlink(self._spill_path(oid))
-            except OSError:
-                pass
+            self.spill_backend.delete(oid)
         return True
 
     def rpc_delete_object(self, oid):
         self.store.delete(oid)
-        try:
-            os.unlink(self._spill_path(oid))
-        except OSError:
-            pass
+        self.spill_backend.delete(oid)
         try:
             self.head.call("remove_location", oid, self.node_id)
         except Exception:
             pass
         return True
 
+    def rpc_delete_spilled(self, oid, uri):
+        """Drop one object from a spill target this node can reach (the
+        head's free fanout for a DEAD node's remote-spilled copy — the
+        spiller is gone, so any live node does the delete)."""
+        try:
+            return self._restore_backend_for(uri).delete(oid)
+        except Exception:
+            return False
+
     def rpc_store_stats(self):
         stats = self.store.stats()
         try:
-            spill_files = os.listdir(self.spill_dir)
-            stats["spilled_objects"] = len(spill_files)
-            stats["spilled_bytes"] = sum(
-                os.path.getsize(os.path.join(self.spill_dir, f))
-                for f in spill_files
-            )
+            # With a shared remote spill target every node reports the
+            # TARGET's totals (the pool is cluster-wide by design);
+            # node-local spill dirs keep the per-node meaning.
+            sp = self.spill_backend.stats()
+            stats["spilled_objects"] = sp["objects"]
+            stats["spilled_bytes"] = sp["bytes"]
         except OSError:
             stats["spilled_objects"] = 0
             stats["spilled_bytes"] = 0
         stats["spill_denied"] = self._spill_denied
+        stats["spill_restores"] = self._spill_restores
         return stats
 
     def _object_attr(self, oid: str) -> dict:
@@ -2766,6 +2869,8 @@ class NodeAgent:
                     self._store_gauges_exported = False
                 _metrics.OBJECT_STORE_EVICTIONS.remove(tags=tags)
                 _metrics.OBJECT_SPILL_DENIED.remove(tags=tags)
+                _metrics.SPILL_BYTES_TOTAL.remove(tags=tags)
+                _metrics.SPILL_RESTORES_TOTAL.remove(tags=tags)
                 _metrics.OOM_KILLS_TOTAL.remove(tags=tags)
                 # Serve + goodput gauge children die with the node too.
                 for wid in list(self._serve_gauges):
@@ -2809,6 +2914,11 @@ def main():
     args = parser.parse_args()
     import json
 
+    # Standalone agents sweep dead runs' leaked shm segments before
+    # allocating their own (same hygiene as cluster_utils.Cluster).
+    from ray_tpu.util.shm_sweep import sweep_stale_shm
+
+    sweep_stale_shm()
     agent = NodeAgent(
         args.head,
         num_cpus=args.num_cpus,

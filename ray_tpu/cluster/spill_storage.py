@@ -1,0 +1,190 @@
+"""Pluggable spill storage: where cold object bytes go under pressure.
+
+Reference parity: ``python/ray/_private/external_storage.py`` — the
+reference routes spilled objects through an ``ExternalStorage`` chosen
+by config (filesystem / smart_open URI); here the ``spill_uri`` config
+knob picks a registered backend by URI scheme.
+
+Two deployment shapes:
+
+* **node-local** (default, ``spill_uri=""``): each agent spills into its
+  per-session ``/tmp/ray_tpu_spill_*`` directory. Fast, zero setup — but
+  a dead node takes its spilled objects with it (recovery falls back to
+  lineage recomputation).
+* **remote** (``spill_uri="file:///shared/dir"`` or any registered
+  scheme): every agent spills into one shared target keyed by object id.
+  The head records each spilled object, and when a node dies its spilled
+  objects are *restored from the URI onto a live node* by lineage
+  recovery instead of being recomputed or lost
+  (``node_agent.rpc_restore_from_uri`` / ``head.rpc_restore_spilled``).
+
+The on-target layout is one file per object id:
+``8-byte little-endian meta length + meta + data`` — identical to the
+historic local spill-file format, so the chunked fetch fallback can
+range-read the data section without loading the object.
+
+Register new schemes (s3/gcs/...) with :func:`register_scheme`; the
+factory receives the full URI and returns a :class:`SpillBackend`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+
+class SpillBackend:
+    """One spill target. ``remote`` declares whether the target survives
+    the writing node's death (drives head spill-record reporting and the
+    restore-from-URI recovery path)."""
+
+    remote = False
+    uri = ""
+
+    def write(self, oid: str, meta: bytes, data: bytes) -> int:
+        """Persist one object; returns total bytes written. Must be
+        atomic per object (a reader never sees a torn file)."""
+        raise NotImplementedError
+
+    def read(self, oid: str) -> Optional[Tuple[bytes, bytes]]:
+        """(meta, data) or None when the target has no such object."""
+        raise NotImplementedError
+
+    def read_range(self, oid: str, offset: int,
+                   length: int) -> Optional[bytes]:
+        """One bounded slice of the DATA section (chunked fetch
+        fallback), or None when absent."""
+        raise NotImplementedError
+
+    def delete(self, oid: str) -> bool:
+        """Drop the object from the target (free-on-zero broadcast);
+        returns whether it existed."""
+        raise NotImplementedError
+
+    def stats(self) -> dict:
+        """{"objects": n, "bytes": n} currently on the target."""
+        raise NotImplementedError
+
+
+class FileSpillBackend(SpillBackend):
+    """Filesystem spill target (``file://`` scheme and the node-local
+    default). A shared filesystem (NFS, gcsfuse) mounted at the same
+    path on every node makes this a remote backend."""
+
+    def __init__(self, root: str, *, remote: bool = False, uri: str = ""):
+        self.root = root
+        self.remote = remote
+        self.uri = uri or f"file://{root}"
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, oid: str) -> str:
+        return os.path.join(self.root, oid)
+
+    def write(self, oid: str, meta: bytes, data: bytes) -> int:
+        path = self._path(oid)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(len(meta).to_bytes(8, "little"))
+            f.write(meta)
+            f.write(data)
+        os.replace(tmp, path)
+        return 8 + len(meta) + len(data)
+
+    def read(self, oid: str) -> Optional[Tuple[bytes, bytes]]:
+        try:
+            with open(self._path(oid), "rb") as f:
+                meta_len = int.from_bytes(f.read(8), "little")
+                meta = f.read(meta_len)
+                data = f.read()
+        except OSError:
+            return None
+        return meta, data
+
+    def read_range(self, oid: str, offset: int,
+                   length: int) -> Optional[bytes]:
+        try:
+            with open(self._path(oid), "rb") as f:
+                meta_len = int.from_bytes(f.read(8), "little")
+                f.seek(8 + meta_len + offset)
+                return f.read(length)
+        except OSError:
+            return None
+
+    def delete(self, oid: str) -> bool:
+        try:
+            os.unlink(self._path(oid))
+            return True
+        except OSError:
+            return False
+
+    def stats(self) -> dict:
+        objects = 0
+        nbytes = 0
+        try:
+            for name in os.listdir(self.root):
+                if ".tmp." in name:
+                    continue  # in-flight writes aren't spilled objects
+                try:
+                    nbytes += os.path.getsize(
+                        os.path.join(self.root, name))
+                    objects += 1
+                except OSError:
+                    continue  # deleted under us
+        except OSError:
+            pass
+        return {"objects": objects, "bytes": nbytes}
+
+
+def _file_factory(uri: str) -> SpillBackend:
+    path = uri[len("file://"):]
+    if not path.startswith("/"):
+        raise ValueError(
+            f"spill_uri {uri!r}: file:// target must be an absolute "
+            f"path (file:///shared/dir)")
+    return FileSpillBackend(path, remote=True, uri=uri)
+
+
+# scheme -> factory(uri) -> SpillBackend. file:// ships; object stores
+# register here (the smart_open dispatch of the reference collapsed to
+# an explicit table).
+_SCHEMES: Dict[str, Callable[[str], SpillBackend]] = {
+    "file": _file_factory,
+}
+_schemes_lock = threading.Lock()
+
+
+def register_scheme(scheme: str,
+                    factory: Callable[[str], SpillBackend]) -> None:
+    """Plug a spill backend for ``<scheme>://`` URIs (s3, gcs, ...)."""
+    with _schemes_lock:
+        _SCHEMES[scheme] = factory
+
+
+def registered_schemes() -> list:
+    with _schemes_lock:
+        return sorted(_SCHEMES)
+
+
+def backend_for(uri: str) -> SpillBackend:
+    """The backend behind a spill URI. Raises ``ValueError`` on an
+    unknown scheme so a typo'd ``spill_uri`` fails at agent boot, not at
+    the first spill under memory pressure."""
+    scheme, sep, _rest = uri.partition("://")
+    if not sep or not scheme:
+        raise ValueError(
+            f"spill_uri {uri!r} is not a <scheme>://... URI; known "
+            f"schemes: {registered_schemes()}")
+    with _schemes_lock:
+        factory = _SCHEMES.get(scheme)
+    if factory is None:
+        raise ValueError(
+            f"spill_uri scheme {scheme!r} has no registered backend; "
+            f"known: {registered_schemes()} "
+            f"(spill_storage.register_scheme to add one)")
+    return factory(uri)
+
+
+def local_backend(spill_dir: str) -> FileSpillBackend:
+    """The per-node session spill dir as a (non-remote) backend."""
+    return FileSpillBackend(spill_dir, remote=False)

@@ -98,7 +98,13 @@ class WorkerHandler:
         worker_mod._backend = self.backend  # nested API calls inside tasks
         self._hooks = (
             lambda: self.agent.call("task_blocked", self.worker_id),
-            lambda: self.agent.call("task_unblocked", self.worker_id),
+            # Unblock re-acquires the CPU slot and the agent-side
+            # acquire may legitimately wait up to its 300s budget when
+            # the node is saturated (many tasks cycling few slots under
+            # memory pressure) — the RPC timeout must outlast it or the
+            # worker kills a healthy task with ConnectionLost.
+            lambda: self.agent.call("task_unblocked", self.worker_id,
+                                    timeout=330.0),
         )
         self._q: queue.Queue = queue.Queue()
         # Named concurrency groups: each gets its own queue + executor

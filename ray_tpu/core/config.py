@@ -88,6 +88,20 @@ _DEFS: dict[str, tuple[type, Any]] = {
     # pulls admit by priority get > wait > args (pull_manager.h analog).
     "pull_max_inflight_bytes": (int, 256 << 20),
     "spill_headroom_bytes": (int, 64 << 10),
+    # Remote spill target (external_storage.py analog): a URI whose
+    # scheme picks a registered spill backend (cluster/spill_storage.py;
+    # "file:///shared/dir" ships). "" keeps the per-node session spill
+    # dir — node-local, so a dead node takes its spilled objects with
+    # it. With a remote URI the head records every spilled object and
+    # lineage recovery RESTORES it from the target onto a live node
+    # instead of recomputing (or losing) it.
+    "spill_uri": (str, ""),
+    # -- data plane --------------------------------------------------------
+    # Dynamic block splitting: read/map tasks split output blocks bigger
+    # than this into store-friendly pieces (each its own object) so one
+    # skewed multi-GiB block cannot OOM the store. 0 disables splitting
+    # (legacy single-object stage outputs).
+    "target_block_size_bytes": (int, 128 << 20),
     # -- memory protection -------------------------------------------------
     "memory_usage_threshold": (float, 0.95),
     "memory_limit_bytes": (int, 0),  # 0 = no aggregate-RSS limit

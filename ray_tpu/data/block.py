@@ -64,6 +64,27 @@ def slice_block(block: Block, start: int, end: int) -> Block:
     return block[start:end]
 
 
+def split_block(block: Block, target_bytes: int) -> List[Block]:
+    """Dynamic block splitting: row-range slices of a block whose
+    estimated size exceeds ``target_bytes``, each at most ~target-sized.
+    Slices are views (arrow ``slice`` / numpy basic indexing), so the
+    split itself copies nothing — the pieces only become independent
+    bytes when they are serialized into the store as separate objects.
+    A block at or under target (or with a single row) passes through
+    unsplit."""
+    n = num_rows(block)
+    total = size_bytes(block)
+    if target_bytes <= 0 or n <= 1 or total <= target_bytes:
+        return [block]
+    parts = min(n, -(-total // target_bytes))  # ceil division
+    cuts = [round(i * n / parts) for i in range(parts + 1)]
+    return [
+        slice_block(block, cuts[i], cuts[i + 1])
+        for i in range(parts)
+        if cuts[i + 1] > cuts[i]
+    ]
+
+
 def concat_blocks(blocks: List[Block]) -> Block:
     blocks = [b for b in blocks if num_rows(b) > 0]
     if not blocks:

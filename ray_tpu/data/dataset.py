@@ -54,6 +54,39 @@ def _remote_apply_meta(fns, blk):
     return [blk, meta]
 
 
+def _remote_apply_split(name, fns, blk, target):
+    """Streaming task: run the fused chain, then DYNAMIC BLOCK
+    SPLITTING — an output bigger than ``target`` bytes yields as N
+    store-friendly blocks (each its own object, stored as produced) so
+    one skewed multi-GiB block never lands in the store whole. The
+    LAST yielded item is the stage meta dict (the driver pops it off;
+    the per-task stats never ride a data block)."""
+    t0 = time.perf_counter()
+    for fn in fns:
+        blk = fn(blk)
+    parts = B.split_block(blk, target)
+    meta = {
+        "duration_s": time.perf_counter() - t0,
+        "rows": B.num_rows(blk),
+        "bytes": B.size_bytes(blk),
+        "splits": len(parts) - 1,
+        # Per-part counts, in yield order: the driver caches them so
+        # count()/split(equal=True) never re-derive rows with a
+        # task-per-block fan-out.
+        "part_rows": [B.num_rows(p) for p in parts],
+    }
+    if len(parts) > 1:
+        gp = _goodput()
+        if gp is not None:
+            try:
+                gp.record_block_split(name, len(parts) - 1)
+            except Exception:
+                pass
+    for p in parts:
+        yield p
+    yield meta
+
+
 class _Stage:
     """One-to-one stage: fuseable block -> block function."""
 
@@ -80,13 +113,17 @@ class StageStats:
 
     __slots__ = ("name", "wall_s", "n_blocks", "block_seconds",
                  "block_rows", "block_bytes", "rows_total",
-                 "bytes_total", "sampled")
+                 "bytes_total", "sampled", "extra")
 
     def __init__(self, name: str, wall_s: float, n_blocks: int,
-                 blocks: Optional[list] = None, max_samples: int = 256):
+                 blocks: Optional[list] = None, max_samples: int = 256,
+                 extra: Optional[dict] = None):
         self.name = name
         self.wall_s = float(wall_s)
         self.n_blocks = int(n_blocks)
+        # Stage-shape facts that aren't per-block samples: dynamic
+        # split count, autoscaling-pool peak size / scale events.
+        self.extra: dict = dict(extra or {})
         self.block_seconds: List[float] = []
         self.block_rows: List[int] = []
         self.block_bytes: List[int] = []
@@ -132,6 +169,8 @@ class StageStats:
             "bytes_per_s": round(self.bytes_per_s, 1),
             "sampled": self.sampled,
         }
+        if self.extra:
+            out.update(self.extra)
         for key, vals in (("block_seconds", self.block_seconds),
                           ("block_rows", self.block_rows),
                           ("block_bytes", self.block_bytes)):
@@ -157,6 +196,9 @@ class StageStats:
                 f"    per-block: min {d['min'] * 1e3:.2f} / p50 "
                 f"{d['p50'] * 1e3:.2f} / max {d['max'] * 1e3:.2f} ms"
                 f"{clipped}")
+        if self.extra:
+            lines.append("    " + ", ".join(
+                f"{k}={v}" for k, v in sorted(self.extra.items())))
         return lines
 
 
@@ -228,10 +270,10 @@ class DatasetStats:
     def child(self, *extra_parents: "DatasetStats") -> "DatasetStats":
         return DatasetStats(parents=[self, *extra_parents])
 
-    def record(self, name, seconds, n_blocks, blocks=None):
+    def record(self, name, seconds, n_blocks, blocks=None, extra=None):
         self.stages.append(StageStats(
             name, seconds, n_blocks, blocks,
-            max_samples=self.MAX_BLOCK_SAMPLES))
+            max_samples=self.MAX_BLOCK_SAMPLES, extra=extra))
         if len(self.stages) > self.MAX_STAGES:
             del self.stages[0]
             self.dropped_stages += 1
@@ -304,11 +346,20 @@ class DatasetStats:
 
 class Dataset:
     def __init__(self, blocks: List, stages: Optional[List[_Stage]] = None,
-                 stats: Optional[DatasetStats] = None):
+                 stats: Optional[DatasetStats] = None,
+                 block_rows: Optional[List[int]] = None):
         self._blocks = blocks  # list[ObjectRef]
         self._stages: List[_Stage] = list(stages or [])
         self._stats = stats or DatasetStats()
         self._computed: Optional[List] = None if self._stages else blocks
+        # Per-block row counts when the producing stage reported them
+        # (task metas / pool probes): count() and split(equal=True)
+        # read this instead of fanning out one num_rows task per block
+        # — with dynamic splitting multiplying block counts, that
+        # fan-out is a worker-pool storm on a saturated node.
+        self._block_rows = block_rows if (
+            block_rows is not None and len(block_rows) == len(blocks)
+        ) else None
 
     # -- plan execution (lazy, with stage fusion) -------------------------
 
@@ -317,30 +368,68 @@ class Dataset:
             return self._computed
         fns = [s.fn for s in self._stages]
         name = "+".join(s.name for s in self._stages)
+        from ray_tpu.core.config import config as _config
         from ray_tpu.util import tracing
 
+        target = _config.target_block_size_bytes
         start = time.perf_counter()
-        apply_task = ray_tpu.remote(_remote_apply_meta).options(
-            num_returns=2)
         with tracing.span(f"data:{name}",
                           {"blocks": len(self._blocks)}, cat="data"):
-            pairs = [apply_task.remote(fns, b) for b in self._blocks]
-            out = [p[0] for p in pairs]
-            ray_tpu.wait(out, num_returns=len(out), timeout=None)
+            if target > 0:
+                out, meta_refs = self._execute_split(name, fns, target)
+            else:
+                apply_task = ray_tpu.remote(_remote_apply_meta).options(
+                    num_returns=2)
+                pairs = [apply_task.remote(fns, b) for b in self._blocks]
+                out = [p[0] for p in pairs]
+                meta_refs = [p[1] for p in pairs]
+                ray_tpu.wait(out, num_returns=len(out), timeout=None)
             wall = time.perf_counter() - start
-        # Per-block (duration, rows, bytes) metas are tiny side returns;
+        # Per-task (duration, rows, bytes) metas are tiny side returns;
         # best-effort — a stats fetch failure must not fail the plan.
         blocks_meta = None
+        extra = None
+        block_rows = None
         try:
-            metas = ray_tpu.get([p[1] for p in pairs])
+            metas = ray_tpu.get(meta_refs)
             blocks_meta = [(m["duration_s"], m["rows"], m["bytes"])
                            for m in metas]
+            splits = sum(m.get("splits", 0) for m in metas)
+            if splits:
+                extra = {"splits": splits}
+            block_rows = [
+                r for m in metas
+                for r in m.get("part_rows", [m["rows"]])
+            ]
         except Exception:
             pass
-        self._stats.record(name, wall, len(out), blocks=blocks_meta)
+        self._stats.record(name, wall, len(out), blocks=blocks_meta,
+                           extra=extra)
         self._computed = out
         self._blocks, self._stages = out, []
+        self._block_rows = block_rows if (
+            block_rows is not None and len(block_rows) == len(out)
+        ) else None
         return out
+
+    def _execute_split(self, name: str, fns: list, target: int):
+        """Run the fused chain as STREAMING tasks so oversized outputs
+        split into N independent store objects as they are produced (the
+        reference's dynamic block splitting rides its streaming
+        generators the same way). Returns ``(block_refs, meta_refs)``;
+        a mid-stream task error raises here — the same user call
+        (count/take/iter) that would have surfaced it at fetch time."""
+        split_task = ray_tpu.remote(_remote_apply_split).options(
+            num_returns="streaming")
+        gens = [split_task.remote(name, fns, b, target)
+                for b in self._blocks]
+        out: List = []
+        meta_refs: List = []
+        for gen in gens:
+            refs = list(gen)  # blocks in production order, meta last
+            meta_refs.append(refs[-1])
+            out.extend(refs[:-1])
+        return out, meta_refs
 
     def _with_stage(self, name: str, fn: Callable) -> "Dataset":
         return Dataset(self._blocks, self._stages + [_Stage(name, fn)],
@@ -408,36 +497,71 @@ class Dataset:
         return self._with_stage("map_batches", do)
 
     def _map_with_actor_pool(self, do: Callable, compute) -> "Dataset":
-        """ActorPoolStrategy: blocks stream through a pool of worker actors
-        (``_internal/compute.py:173``)."""
-        from ray_tpu.util.actor_pool import ActorPool
+        """ActorPoolStrategy: blocks stream through an AUTOSCALING pool
+        of worker actors (``_internal/compute.py:173``) — the pool grows
+        on queue depth up to ``max_size`` and shrinks back to
+        ``min_size`` on idle. Results stay in the object store as the
+        actors' return refs (pre-round-14 this path round-tripped every
+        block through driver memory: get + re-put, an extra two copies
+        of the whole dataset exactly where memory pressure lives)."""
+        from ray_tpu.util.actor_pool import AutoscalingActorPool
 
         blocks = self._execute()
 
         class _BlockWorker:
             def apply(self, fns, blk):
-                return _remote_apply(fns, blk)
+                out = _remote_apply(fns, blk)
+                # (rows, bytes) rides as a tiny second return, computed
+                # where the block is local — the stats probe costs zero
+                # extra tasks (a per-block probe fan-out is a worker-
+                # pool storm once splitting multiplies block counts).
+                return [out, (B.num_rows(out), B.size_bytes(out))]
 
         worker_cls = ray_tpu.remote(_BlockWorker)
-        n = min(compute.max_size, max(compute.min_size, len(blocks)))
-        pool = ActorPool([worker_cls.remote() for _ in _py_range(n)])
+        pool = AutoscalingActorPool(
+            worker_cls.remote,
+            min_size=min(compute.min_size, max(1, len(blocks))),
+            max_size=compute.max_size,
+            scale_up_queue_depth=compute.scale_up_queue_depth,
+            name="map_batches(actors)")
         start = time.perf_counter()
-        out_vals = list(
-            pool.map(lambda a, blk: a.apply.remote([do], blk), blocks)
-        )
-        out = [ray_tpu.put(v) for v in out_vals]
+        meta_by_ref: dict = {}
+
+        def _submit(a, b):
+            blk_ref, meta_ref = a.apply.options(
+                num_returns=2).remote([do], b)
+            meta_by_ref[blk_ref.id] = meta_ref
+            return blk_ref
+
+        for blk in blocks:
+            pool.submit(_submit, blk)
+        out = []
+        while pool.has_next():
+            out.append(pool.get_next_ref())
+        wall = time.perf_counter() - start
+        peak = pool.peak_size
+        scale_ups = sum(1 for d, _s in pool.scale_events if d == "up")
+        scale_downs = len(pool.scale_events) - scale_ups
+        pool.shutdown()
         stats = self._stats.child()
         # Per-block durations are unknown on the pool path (the pool
-        # interleaves blocks across actors); record sizes only — a
-        # fabricated 0.0s sample would poison the task-measured
-        # block-duration distribution.
-        stats.record("map_batches(actors)",
-                     time.perf_counter() - start, len(out),
-                     blocks=[(None, B.num_rows(v), B.size_bytes(v))
-                             for v in out_vals])
-        for w in list(pool._idle):
-            ray_tpu.kill(w)
-        return Dataset(out, [], stats)
+        # interleaves blocks across actors); sizes rode along as the
+        # apply calls' second returns so the blocks themselves never
+        # leave the store. A meta failure must not fail the map.
+        blocks_meta = None
+        block_rows = None
+        try:
+            sizes = ray_tpu.get([meta_by_ref[r.id] for r in out])
+            blocks_meta = [(None, rows, nbytes) for rows, nbytes in sizes]
+            block_rows = [rows for rows, _ in sizes]
+        except Exception:
+            pass
+        stats.record("map_batches(actors)", wall, len(out),
+                     blocks=blocks_meta,
+                     extra={"pool_peak": peak,
+                            "pool_scale_ups": scale_ups,
+                            "pool_scale_downs": scale_downs})
+        return Dataset(out, [], stats, block_rows=block_rows)
 
     def limit(self, n: int) -> "Dataset":
         blocks = self._execute()
@@ -616,16 +740,22 @@ class Dataset:
         blocks = self._execute()
         if not equal:
             return [
-                Dataset(blocks[i::n], [], self._stats.child())
+                Dataset(blocks[i::n], [], self._stats.child(),
+                        block_rows=None if self._block_rows is None
+                        else self._block_rows[i::n])
                 for i in _py_range(n)
             ]
-        counts = ray_tpu.get(
-            [ray_tpu.remote(B.num_rows).remote(b) for b in blocks]
-        )
+        if self._block_rows is not None:
+            counts = list(self._block_rows)
+        else:
+            counts = ray_tpu.get(
+                [ray_tpu.remote(B.num_rows).remote(b) for b in blocks]
+            )
         total = sum(counts)
         per = total // n
         slice_task = ray_tpu.remote(B.slice_block)
         shards: List[List] = [[] for _ in _py_range(n)]
+        shard_rows: List[List[int]] = [[] for _ in _py_range(n)]
         shard_idx, filled = 0, 0
         for ref, cnt in zip(blocks, counts):
             offset = 0
@@ -636,18 +766,23 @@ class Dataset:
                     shards[shard_idx].append(
                         slice_task.remote(ref, offset, offset + take)
                     )
+                    shard_rows[shard_idx].append(take)
                 offset += take
                 filled += take
                 if filled >= per:
                     shard_idx += 1
                     filled = 0
-        return [Dataset(s, [], self._stats.child()) for s in shards]
+        return [Dataset(s, [], self._stats.child(), block_rows=rows)
+                for s, rows in zip(shards, shard_rows)]
 
     # -- consumption ------------------------------------------------------
 
     def count(self) -> int:
+        self._execute()
+        if self._block_rows is not None:
+            return sum(self._block_rows)
         counts = ray_tpu.get(
-            [ray_tpu.remote(B.num_rows).remote(b) for b in self._execute()]
+            [ray_tpu.remote(B.num_rows).remote(b) for b in self._blocks]
         )
         return sum(counts)
 
@@ -983,12 +1118,17 @@ class GroupedData:
 
 
 class ActorPoolStrategy:
-    """Compute strategy: run map stages on a pool of long-lived actors
-    (``_internal/compute.py:173``)."""
+    """Compute strategy: run map stages on an AUTOSCALING pool of
+    long-lived actors (``_internal/compute.py:173``): the pool starts
+    at ``min_size``, adds an actor whenever a block queues behind
+    ``scale_up_queue_depth`` pending blocks with no idle actor (up to
+    ``max_size``), and retires surplus actors on idle."""
 
-    def __init__(self, min_size: int = 1, max_size: int = 4):
+    def __init__(self, min_size: int = 1, max_size: int = 4, *,
+                 scale_up_queue_depth: int = 2):
         self.min_size = min_size
         self.max_size = max_size
+        self.scale_up_queue_depth = scale_up_queue_depth
 
 
 # -- read API (``python/ray/data/read_api.py``) ----------------------------
@@ -1048,6 +1188,17 @@ def from_pandas(df, *, parallelism: int = 8) -> Dataset:
     )
 
 
+def _read_dataset(name: str, load_fn: Callable, specs: list) -> Dataset:
+    """Lazy read: each shard spec (path / byte range / row groups)
+    becomes a tiny spec-block and the actual I/O is a fused STAGE — so
+    reads execute lazily (a windowed pipeline reads one window at a
+    time), downstream maps fuse into the read task, and an oversized
+    read output dynamically splits exactly like any map output
+    (``target_block_size_bytes``)."""
+    return Dataset([ray_tpu.put(spec) for spec in specs],
+                   [_Stage(name, load_fn)])
+
+
 def _expand_paths(paths) -> list:
     import glob
     import os
@@ -1096,17 +1247,15 @@ def read_parquet(paths, *, parallelism: int = 8,
     travels zero-copy through the object store."""
     files = _expand_paths(paths)
 
-    def load(path, row_groups):
+    def load(spec):
         import pyarrow.parquet as pq
 
+        path, row_groups = spec
         return pq.ParquetFile(path).read_row_groups(
             row_groups, columns=columns)
 
-    load_task = ray_tpu.remote(load)
-    return Dataset([
-        load_task.remote(path, rgs)
-        for path, rgs in _rg_splits(files, parallelism)
-    ])
+    return _read_dataset("read_parquet", load,
+                         _rg_splits(files, parallelism))
 
 
 def _byte_ranges(files: list, parallelism: int) -> list:
@@ -1169,14 +1318,14 @@ def read_csv(paths, *, parallelism: int = 8,
             df = pd.read_csv(path)
             return {k: df[k].to_numpy() for k in df.columns}
 
-        load_whole = ray_tpu.remote(load_file)
-        return Dataset([load_whole.remote(p) for p in files])
+        return _read_dataset("read_csv", load_file, list(files))
 
-    def load(path, start, end, header):
+    def load(spec):
         import io
 
         import pandas as pd
 
+        path, start, end, header = spec
         body = _read_lines_range(path, start, end)
         if start == 0 and body:
             body = body[1:]  # drop the header line from the data
@@ -1192,36 +1341,35 @@ def read_csv(paths, *, parallelism: int = 8,
 
             return next(_csv.reader([f.readline()]))
 
-    load_task = ray_tpu.remote(load)
-    refs = []
     headers = {p: header_of(p) for p in files}
-    for path, start, end in _byte_ranges(files, parallelism):
-        refs.append(load_task.remote(path, start, end, headers[path]))
-    return Dataset(refs)
+    return _read_dataset("read_csv", load, [
+        (path, start, end, headers[path])
+        for path, start, end in _byte_ranges(files, parallelism)
+    ])
 
 
 def read_json(paths, *, parallelism: int = 8) -> Dataset:
     files = _expand_paths(paths)
 
-    def load(path, start, end):
+    def load(spec):
         import json
 
+        path, start, end = spec
         return [json.loads(ln) for ln in _read_lines_range(path, start, end)
                 if ln.strip()]
 
-    load_task = ray_tpu.remote(load)
-    return Dataset([
-        load_task.remote(p, s, e) for p, s, e in _byte_ranges(files, parallelism)
-    ])
+    return _read_dataset("read_json", load,
+                         _byte_ranges(files, parallelism))
 
 
 def read_text(paths, *, parallelism: int = 8) -> Dataset:
     files = _expand_paths(paths)
 
-    load_task = ray_tpu.remote(_read_lines_range)
-    return Dataset([
-        load_task.remote(p, s, e) for p, s, e in _byte_ranges(files, parallelism)
-    ])
+    def load(spec):
+        return _read_lines_range(*spec)
+
+    return _read_dataset("read_text", load,
+                         _byte_ranges(files, parallelism))
 
 
 def read_binary_files(paths, *, parallelism: int = 8) -> Dataset:
@@ -1231,5 +1379,4 @@ def read_binary_files(paths, *, parallelism: int = 8) -> Dataset:
         with open(path, "rb") as f:
             return [f.read()]
 
-    load_task = ray_tpu.remote(load)
-    return Dataset([load_task.remote(p) for p in files])
+    return _read_dataset("read_binary_files", load, list(files))

@@ -26,7 +26,7 @@ FILENAME = "BENCH_TPU_SESSIONS.jsonl"
 KNOWN_BENCHES = frozenset({
     "task_overhead", "memory_pressure", "chaos_soak", "scalebench",
     "drain_recovery_ms", "serve_latency", "input_pipeline", "goodput",
-    "analyze", "gang_recovery", "llm_serving",
+    "analyze", "gang_recovery", "llm_serving", "streaming_dataflow",
 })
 
 
@@ -256,6 +256,35 @@ def record_input_pipeline(*, client: dict, server: dict,
     return entry
 
 
+def record_streaming_dataflow(*, client: dict, server: dict,
+                              agreement: dict, rows_s: float,
+                              spill: dict, pool: dict,
+                              device: str = "", path: str | None = None,
+                              **extra) -> dict:
+    """Streaming-dataflow evidence (``scripts/dataflow_bench.py``): a
+    generation->training pipeline driven past store capacity — the
+    client-measured consumer stall fraction, the metrics-derived view
+    of the same loop, the agreement verdict, the throughput headline
+    (rows/s through the consumer), the spill/restore counts that prove
+    the store actually churned, and the actor-pool scale events. A
+    stall claim without the spill counts is just a small-data run.
+    Committed to the evidence trail only on an accelerator; returns the
+    entry (with ``committed_to``) either way."""
+    entry: dict = {
+        "bench": "streaming_dataflow",
+        "device": device,
+        "rows_s": float(rows_s),
+        "client": dict(client),
+        "server": dict(server),
+        "agreement": dict(agreement),
+        "spill": dict(spill),
+        "pool": dict(pool),
+    }
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
 def record_goodput(*, trial: str, goodput_pct: float, wall_s: float,
                    downtime_s: float, by_cause: dict,
                    device: str = "", path: str | None = None,
@@ -423,6 +452,37 @@ def check_line(obj: object, *, allow_header: bool = False) -> list[str]:
                     and isinstance(agreement.get("ok"), bool)):
                 errs.append("input_pipeline line missing boolean "
                             "agreement.ok")
+        elif obj["bench"] == "streaming_dataflow":
+            # The claim is "stall stayed bounded WHILE the store
+            # churned": both stall views, the agreement verdict, a
+            # numeric throughput, and the spill/restore counts that
+            # prove churn are all load-bearing — drop any one and the
+            # line is an unverified (or unloaded) claim.
+            if not any(_is_num(obj.get(k))
+                       for k in ("rows_s", "tokens_s")):
+                errs.append("streaming_dataflow line missing numeric "
+                            "rows_s/tokens_s throughput")
+            client = obj.get("client")
+            server = obj.get("server")
+            if not (isinstance(client, dict)
+                    and _is_num(client.get("stall_fraction"))):
+                errs.append("streaming_dataflow line missing numeric "
+                            "client.stall_fraction")
+            if not (isinstance(server, dict)
+                    and _is_num(server.get("stall_fraction"))):
+                errs.append("streaming_dataflow line missing numeric "
+                            "server.stall_fraction")
+            agreement = obj.get("agreement")
+            if not (isinstance(agreement, dict)
+                    and isinstance(agreement.get("ok"), bool)):
+                errs.append("streaming_dataflow line missing boolean "
+                            "agreement.ok")
+            spill = obj.get("spill")
+            if not (isinstance(spill, dict)
+                    and _is_num(spill.get("spilled_objects"))
+                    and _is_num(spill.get("restores"))):
+                errs.append("streaming_dataflow line missing numeric "
+                            "spill.spilled_objects/restores counts")
         elif obj["bench"] == "goodput":
             if not _is_num(obj.get("goodput_pct")):
                 errs.append("goodput line missing numeric goodput_pct")

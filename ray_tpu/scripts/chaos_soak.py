@@ -81,7 +81,17 @@ class _Soak:
         self.train_goodput: "dict | None" = None
         self.gang_goodput: "dict | None" = None
         self.gang_reschedules = 0
+        self.dataflow_ok = 0
+        self.dataflow_failed = 0
+        self.dataflow_spilled = 0
+        self.dataflow_restores = 0
         self._stop = threading.Event()
+        # The streaming-dataflow probe's small-store node: exempt from
+        # kill/drain (its custom resource exists nowhere else, so losing
+        # it would just park every later probe round — the harness
+        # starving itself, not a system fault); partitions/delays still
+        # hit it.
+        self._dataflow_node = None
         # The graceful-drain victim: the fault injector must not kill or
         # partition the node the drain (and its retry-exemption probe)
         # is pinned to — that would be the harness racing itself, not a
@@ -161,6 +171,8 @@ class _Soak:
             fault = self.rng.choice(classes)
             if fault == "kill" and killed:
                 fault = "partition"
+            if fault == "kill" and victim is self._dataflow_node:
+                fault = "partition"  # see _dataflow_node comment
             t0 = time.monotonic()
             try:
                 if fault == "partition":
@@ -532,7 +544,8 @@ class _Soak:
             NodeAffinitySchedulingStrategy,
         )
 
-        victims = cluster.nodes[1:]
+        victims = [n for n in cluster.nodes[1:]
+                   if n is not self._dataflow_node]
         if not victims:
             return
         victim = self.rng.choice(victims)
@@ -563,6 +576,79 @@ class _Soak:
                 f"retry-budget exemption violated (max_retries=0 task "
                 f"lost to a drain did not complete): {e!r}")
         self.faults["drain"] = self.faults.get("drain", 0) + 1
+
+    # -- streaming-dataflow probe ------------------------------------------
+
+    def _dataflow_probe_setup(self, cluster):
+        """Add the probe's dedicated SMALL-store node (12 MiB): every
+        probe round pushes ~2x its capacity through it, so dynamic
+        splitting + spill-to-URI + restore run continuously while the
+        fault schedule rages. The whole soak cluster spills to the
+        shared URI (config set before cluster boot)."""
+        node = cluster.add_node(num_cpus=2, store_capacity=12 << 20,
+                                resources={"dataflow_probe": 8})
+        cluster.wait_for_nodes()
+        self._dataflow_node = node
+        return node
+
+    def _dataflow_probe_loop(self, deadline: float) -> None:
+        """Standing invariant: every round of the generation->map->
+        consume pipeline under memory pressure either completes or
+        fails typed within the round budget — a hang is a violation.
+        At least one round must complete over the soak."""
+        import numpy as np
+
+        import ray_tpu
+        from ray_tpu import data
+
+        @ray_tpu.remote(resources={"dataflow_probe": 1}, max_retries=3)
+        def gen(seed):
+            rng = np.random.default_rng(seed)
+            # ~1 MiB per block, 16 blocks/round = ~16 MiB through a
+            # 12 MiB store (plus the map stage's output copy).
+            return {"tokens": rng.random((4096, 64), dtype=np.float32)}
+
+        rounds = 0
+        while time.monotonic() < deadline and not self._stop.is_set():
+            t0 = time.monotonic()
+            try:
+                refs = [gen.remote(rounds * 100 + i) for i in range(16)]
+                done, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                       timeout=60.0)
+                if len(done) < len(refs):
+                    raise RuntimeError(
+                        f"generation incomplete ({len(done)}/16)")
+                ds = data.Dataset(list(refs)).map_batches(
+                    lambda b: {"tokens": b["tokens"] * 2.0})
+                n = 0
+                for _batch in ds.iter_batches(batch_size=1024):
+                    n += 1
+                if n <= 0:
+                    raise RuntimeError("pipeline yielded no batches")
+                self.dataflow_ok += 1
+            except Exception:
+                # Typed failure under chaos is allowed (a partitioned
+                # probe node parks generation); hanging is not.
+                self.dataflow_failed += 1
+            if self._stop.is_set():
+                return  # settling cluster: not a verdict
+            took = time.monotonic() - t0
+            if took > 150.0:
+                self.violations.append(
+                    f"dataflow probe round HUNG {took:.1f}s (neither "
+                    f"completing nor failing fast)")
+                return
+            # Peak spilled-object count on the shared target (frees
+            # drain the target between rounds, so sample at the round
+            # boundary where pressure is highest).
+            try:
+                st = self._dataflow_node.rpc_store_stats()
+                self.dataflow_spilled = max(
+                    self.dataflow_spilled,
+                    int(st.get("spilled_objects", 0)))
+            except Exception:
+                pass
+            rounds += 1
 
     # -- invariants --------------------------------------------------------
 
@@ -672,6 +758,16 @@ class _Soak:
         prev_env_seed = os.environ.get("RAY_TPU_CHAOS_SEED")
         os.environ["RAY_TPU_CHAOS_SEED"] = str(self.seed)
         config.override("chaos_seed", self.seed)
+        # The streaming-dataflow probe's relief valve: the whole soak
+        # cluster spills to one shared URI (so a killed node's spilled
+        # objects restore instead of recomputing), and a small split
+        # target keeps the probe's ~1 MiB blocks splitting for real.
+        import shutil
+        import tempfile
+
+        spill_dir = tempfile.mkdtemp(prefix="ray_tpu_soak_spill_")
+        config.override("spill_uri", f"file://{spill_dir}")
+        config.override("target_block_size_bytes", 256 << 10)
         try:
             return self._run_seeded(ray_tpu, Cluster, bench_log)
         finally:
@@ -680,6 +776,9 @@ class _Soak:
             else:
                 os.environ["RAY_TPU_CHAOS_SEED"] = prev_env_seed
             config.reset("chaos_seed")
+            config.reset("spill_uri")
+            config.reset("target_block_size_bytes")
+            shutil.rmtree(spill_dir, ignore_errors=True)
 
     def _run_seeded(self, ray_tpu, Cluster, bench_log) -> dict:
         ray_tpu.shutdown()
@@ -703,6 +802,13 @@ class _Soak:
             llm_handle = self._llm_probe_setup()
         except Exception as e:  # noqa: BLE001
             self.violations.append(f"llm probe deploy failed: {e!r}")
+        dataflow_ready = False
+        try:
+            self._dataflow_probe_setup(cluster)
+            dataflow_ready = True
+        except Exception as e:  # noqa: BLE001
+            self.violations.append(
+                f"dataflow probe setup failed: {e!r}")
         injector = threading.Thread(
             target=self._fault_loop, args=(cluster,), daemon=True)
         injector.start()
@@ -727,6 +833,10 @@ class _Soak:
                 threading.Thread(
                     target=self._llm_probe_loop,
                     args=(llm_handle, deadline), daemon=True).start()
+            if dataflow_ready:
+                threading.Thread(
+                    target=self._dataflow_probe_loop,
+                    args=(deadline,), daemon=True).start()
             time.sleep(min(self.duration_s / 3.0, 10.0))
             self._drain_once(cluster)
             workload.join(timeout=self.duration_s + 180.0)
@@ -780,6 +890,19 @@ class _Soak:
         if llm_handle is not None and self.llm_ok < 1:
             self.violations.append(
                 "llm probe never completed a stream")
+        if dataflow_ready:
+            if self.dataflow_ok < 1:
+                self.violations.append(
+                    "dataflow probe never completed a round")
+            # Restores are cumulative per agent and can land on any
+            # live node (the head picks the restore target): sum the
+            # survivors for the evidence line.
+            for node in list(cluster.nodes):
+                try:
+                    self.dataflow_restores += int(
+                        node.rpc_store_stats().get("spill_restores", 0))
+                except Exception:
+                    continue
         try:
             from ray_tpu import serve
 
@@ -806,6 +929,10 @@ class _Soak:
             train_goodput=self.train_goodput,
             gang_goodput=self.gang_goodput,
             gang_reschedules=self.gang_reschedules,
+            dataflow_ok=self.dataflow_ok,
+            dataflow_failed=self.dataflow_failed,
+            dataflow_spilled=self.dataflow_spilled,
+            dataflow_restores=self.dataflow_restores,
         )
         ray_tpu.shutdown()
         cluster.shutdown()

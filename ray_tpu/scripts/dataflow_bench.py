@@ -1,0 +1,370 @@
+"""Streaming-dataflow harness: generation -> training at device speed
+while the object store churns past capacity.
+
+The MindSpeed-RL-shaped scenario (PAPERS.md): generation actors stream
+rollout blocks into the object store, a map stage on an AUTOSCALING
+actor pool post-processes them, and the consumer side — a driver-side
+``iter_device_batches`` loop plus a ``DataParallelTrainer`` mesh —
+drains the result, all against a store deliberately smaller than the
+dataset so dynamic block splitting + spill-to-URI + restore are doing
+real work the whole time. The Podracer framing applies: keeping the
+accelerators fed is the only metric, so the headline is the consumer
+STALL FRACTION — and per the serve_bench/input_bench discipline it is
+measured twice:
+
+* client-side: wall time starved inside ``next()`` vs total loop wall,
+  measured outside the dataset code;
+* metrics-side: the ``ray_tpu_data_iter_seconds`` wait/user histograms.
+
+The two must agree (tolerance 0.10, exact batch counts) AND stay under
+0.10 while the spill counters prove the store actually churned —
+disagreement or an unchurned store exits non-zero. Machine-independent
+shape results (counts, agreement booleans, spill/restore/split/pool
+counts) merge into MICROBENCH.json under ``streaming_dataflow``
+(perfsuite ``--dataflow`` stage); ``bench_log.record_streaming_dataflow``
+commits the evidence line on-chip.
+
+Run: python -m ray_tpu.scripts.dataflow_bench [--out MICROBENCH.json]
+     [--store-mb 24] [--gen-actors 4] [--rounds 64] [--block-kb 512]
+     [--target-kb 256] [--steps 4] [--workers 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+
+def _device_kind() -> str:
+    from ray_tpu.scripts.bench_log import device_kind
+
+    return device_kind()
+
+
+def _obs():
+    from ray_tpu.serve import _observability as serve_obs
+    from ray_tpu.train import _observability as train_obs
+
+    return serve_obs, train_obs
+
+
+def _poll_until(fn, deadline_s: float = 20.0, interval: float = 0.25):
+    deadline = time.monotonic() + deadline_s
+    val = fn()
+    while not val and time.monotonic() < deadline:
+        time.sleep(interval)
+        val = fn()
+    return val
+
+
+class _GenActor:
+    """One generation actor: produces fixed-size float32 rollout blocks
+    (the LLM-generation stand-in — the data plane under test does not
+    care what computed the tokens)."""
+
+    def __init__(self, block_kb: int):
+        self.rows = max(1, (block_kb << 10) // (64 * 4))
+
+    def generate(self, seed: int):
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        return {"tokens": rng.random((self.rows, 64), dtype=np.float32)}
+
+
+def run(store_mb: int = 24, gen_actors: int = 4, rounds: int = 64,
+        block_kb: int = 512, target_kb: int = 256, steps: int = 4,
+        workers: int = 2, batch_size: int = 256,
+        consume_ms: float = 8.0) -> dict:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data, train
+    from ray_tpu.cluster.cluster_utils import Cluster
+    from ray_tpu.core.config import config
+    from ray_tpu.train import _observability as tob
+    from ray_tpu.train import session
+    from ray_tpu.util import goodput
+
+    serve_obs, _ = _obs()
+
+    spill_dir = tempfile.mkdtemp(prefix="ray_tpu_dataflow_spill_")
+    config.override("spill_uri", f"file://{spill_dir}")
+    config.override("target_block_size_bytes", target_kb << 10)
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    node = cluster.add_node(num_cpus=8, store_capacity=store_mb << 20)
+    cluster.wait_for_nodes()
+    ray_tpu.init(cluster.address)
+    try:
+        # Warm jax before any timed loop: platform init is startup
+        # cost, not input-pipeline stall.
+        import jax
+
+        jax.device_put(np.zeros(1)).block_until_ready()
+
+        before = serve_obs.parse_prometheus(tob.scrape_text())
+
+        # -- generation: actors stream rollout blocks into the store --
+        actor_cls = ray_tpu.remote(_GenActor)
+        actors = [actor_cls.remote(block_kb) for _ in range(gen_actors)]
+        refs = []
+        for r in range(rounds):
+            refs.append(actors[r % gen_actors].generate.remote(r))
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=None)
+        ds = data.Dataset(list(refs))
+        dataset_bytes = rounds * (block_kb << 10)
+
+        # -- task-path map: dynamic splitting does its work here ------
+        # (generation blocks are 2x target size; the fused task stage
+        # splits each output into store-friendly pieces).
+        normalized = ds.map_batches(
+            lambda b: {"tokens": b["tokens"] - 0.5})
+
+        # -- map stage on the autoscaling pool ------------------------
+        processed = normalized.map_batches(
+            lambda b: {"tokens": b["tokens"] * 0.5},
+            compute=data.ActorPoolStrategy(
+                min_size=1, max_size=4, scale_up_queue_depth=2))
+        pool_stage = next(
+            (s for s in processed.stats().lineage()
+             if s.name == "map_batches(actors)"), None)
+        pool = dict(pool_stage.extra) if pool_stage is not None else {}
+
+        # Background churn: generation keeps streaming while the
+        # consumer drains — the store stays past capacity the whole
+        # loop (held refs; the relief valve is spill, not eviction).
+        churn_refs: list = []
+        churn_stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not churn_stop.is_set():
+                if len(churn_refs) > rounds:
+                    churn_stop.wait(0.05)  # plateau: hold ~rounds extra
+                    continue
+                churn_refs.append(
+                    actors[i % gen_actors].generate.remote(10_000 + i))
+                i += 1
+
+        churn_thread = threading.Thread(target=churn, daemon=True)
+        churn_thread.start()
+
+        # -- the consumer loop: device batches at train speed ---------
+        waits: list = []
+        rows_consumed = 0
+        n_batches = 0
+        t0 = time.perf_counter()
+        it = iter(processed.iter_device_batches(
+            batch_size=batch_size, drop_last=True))
+        while True:
+            t_req = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            waits.append(time.perf_counter() - t_req)
+            n_batches += 1
+            rows_consumed += int(batch["tokens"].shape[0])
+            time.sleep(consume_ms / 1e3)  # the "train step"
+        loop_wall = time.perf_counter() - t0
+        churn_stop.set()
+        churn_thread.join(timeout=30.0)
+        client_wait = sum(waits)
+        client_stall = client_wait / loop_wall if loop_wall > 0 else 0.0
+        rows_s = rows_consumed / loop_wall if loop_wall > 0 else 0.0
+
+        # -- metrics-side view of the same loop -----------------------
+        expected = n_batches
+
+        def settled():
+            parsed = serve_obs.parse_prometheus(tob.scrape_text())
+            delta = serve_obs.diff_parsed(before, parsed)
+            d = serve_obs.histogram_dist(
+                delta, "ray_tpu_data_iter_seconds", phase="user")
+            return delta if d and d["count"] >= expected else None
+
+        delta = _poll_until(settled) or serve_obs.diff_parsed(
+            before, serve_obs.parse_prometheus(tob.scrape_text()))
+        wait_d = serve_obs.histogram_dist(
+            delta, "ray_tpu_data_iter_seconds", phase="wait")
+        user_d = serve_obs.histogram_dist(
+            delta, "ray_tpu_data_iter_seconds", phase="user")
+        xfer_d = serve_obs.histogram_dist(
+            delta, "ray_tpu_data_iter_seconds", phase="transfer")
+        server_stall = goodput.stall_fraction_from(delta)
+        splits_metric = sum(serve_obs.sum_counter(
+            delta, "ray_tpu_block_splits_total", "stage").values())
+
+        # -- the trainer mesh drains a shard under the same pressure --
+        def train_fn(cfg):
+            shard = session.get_dataset_shard("train")
+            it = iter(shard.iter_batches(batch_size=cfg["batch_size"])) \
+                if shard is not None else None
+            for i in range(cfg["steps"]):
+                if it is not None:
+                    try:
+                        next(it)
+                    except StopIteration:
+                        it = None
+                time.sleep(cfg["consume_ms"] / 1e3)
+                session.report({"step": i})
+
+        trainer = train.DataParallelTrainer(
+            train_fn,
+            train_loop_config={"steps": steps,
+                               "batch_size": batch_size,
+                               "consume_ms": consume_ms},
+            scaling_config=train.ScalingConfig(num_workers=workers),
+            datasets={"train": processed},
+        )
+        result = trainer.fit()
+        trainer_ok = result.error is None
+
+        # -- spill/restore/split proof --------------------------------
+        store_stats = node.rpc_store_stats()
+        spill = {
+            "spilled_objects": int(store_stats.get("spilled_objects", 0)),
+            "spilled_bytes": int(store_stats.get("spilled_bytes", 0)),
+            "restores": int(store_stats.get("spill_restores", 0)),
+            "spill_denied": int(store_stats.get("spill_denied", 0)),
+        }
+        head_spill_records = len(cluster.head.rpc_spilled_objects())
+
+        counts = {
+            "wait": int(wait_d["count"]) if wait_d else 0,
+            "user": int(user_d["count"]) if user_d else 0,
+            "transfer": int(xfer_d["count"]) if xfer_d else 0,
+        }
+        agreement = {
+            "wait_count_exact": counts["wait"] == expected,
+            "user_count_exact": counts["user"] == expected,
+            "transfer_counted": counts["transfer"] >= expected,
+            "stall_within_tol": (
+                server_stall is not None
+                and abs(client_stall - server_stall) <= 0.10),
+            "server_not_exceeding": (
+                wait_d is not None
+                and wait_d["sum"] <= client_wait * 1.1 + 0.05),
+            # The acceptance claim itself: stall stays bounded while
+            # the store churns past capacity.
+            "stall_bounded": (
+                client_stall < 0.10
+                and server_stall is not None and server_stall < 0.10),
+            # Held bytes = generation + normalized + pool output copies
+            # (plus the churn plateau): the store was provably
+            # oversubscribed AND the relief valve actually fired.
+            "store_churned": spill["spilled_objects"] > 0
+            and 2 * dataset_bytes > (store_mb << 20),
+            "restores_counted": spill["restores"] > 0,
+            "blocks_split": splits_metric > 0,
+            "pool_scaled": pool.get("pool_peak", 0) > 1
+            and pool.get("pool_scale_downs", 0) > 0,
+            "trainer_completed": trainer_ok,
+        }
+        agreement["ok"] = all(agreement.values())
+
+        return {
+            "backend": "cluster",
+            "store_capacity_bytes": store_mb << 20,
+            "dataset_bytes": dataset_bytes,
+            "gen_actors": gen_actors,
+            "rounds": rounds,
+            "n_batches": expected,
+            "batch_size": batch_size,
+            "target_block_size_bytes": target_kb << 10,
+            "splits": int(splits_metric),
+            "pool": pool,
+            "spill": spill,
+            "head_spill_records": head_spill_records,
+            "client": {
+                "stall_fraction": round(client_stall, 4),
+                "wait_s": round(client_wait, 4),
+                "loop_wall_s": round(loop_wall, 4),
+                "rows_s": round(rows_s, 1),
+            },
+            "server": {
+                "stall_fraction": round(server_stall, 4)
+                if server_stall is not None else None,
+                "wait_s": round(wait_d["sum"], 4) if wait_d else None,
+                "counts": counts,
+            },
+            "trainer": {
+                "workers": workers,
+                "steps": steps,
+                "ok": trainer_ok,
+                "reports": len(result.metrics_history),
+                "error": None if trainer_ok else repr(result.error),
+            },
+            "agreement": agreement,
+        }
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        config.reset("spill_uri")
+        config.reset("target_block_size_bytes")
+        import shutil
+
+        shutil.rmtree(spill_dir, ignore_errors=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="Streaming-dataflow harness: generation->training "
+                    "past store capacity with client/metrics stall "
+                    "cross-check")
+    ap.add_argument("--out", default=None,
+                    help="merge the streaming_dataflow section into "
+                         "this MICROBENCH-style artifact")
+    ap.add_argument("--store-mb", type=int, default=24)
+    ap.add_argument("--gen-actors", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=64)
+    ap.add_argument("--block-kb", type=int, default=512)
+    ap.add_argument("--target-kb", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=256)
+    args = ap.parse_args()
+
+    res = run(store_mb=args.store_mb, gen_actors=args.gen_actors,
+              rounds=args.rounds, block_kb=args.block_kb,
+              target_kb=args.target_kb, steps=args.steps,
+              workers=args.workers, batch_size=args.batch_size)
+
+    from ray_tpu.scripts import bench_log
+
+    entry = bench_log.record_streaming_dataflow(
+        client=res["client"], server=res["server"],
+        agreement=res["agreement"], rows_s=res["client"]["rows_s"],
+        spill=res["spill"], pool=res["pool"],
+        device=_device_kind(), script="dataflow_bench")
+    res["evidence"] = {"committed_to": entry.get("committed_to")}
+
+    if args.out:
+        # Merge-preserve: every perfsuite stage owns one section.
+        payload = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                try:
+                    payload = json.load(f)
+                except ValueError:
+                    payload = {}
+        payload["streaming_dataflow"] = res
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(res, indent=1, default=str))
+    if not res["agreement"]["ok"]:
+        print("dataflow_bench: FAILED — see 'agreement' (either the "
+              "stall metrics disagree/are unbounded, or the store "
+              "never actually churned)", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

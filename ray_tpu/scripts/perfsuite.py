@@ -193,6 +193,11 @@ def main() -> None:
                     help="add the training-goodput point "
                          "(dataset->iterator->train-step harness + "
                          "client/server stall-fraction cross-check)")
+    ap.add_argument("--dataflow", action="store_true",
+                    help="add the streaming-dataflow point "
+                         "(generation->training pipeline past store "
+                         "capacity: split/spill/restore/pool counts + "
+                         "client/metrics stall cross-check)")
     args = ap.parse_args()
 
     # Each stage runs in its own subprocess: benchmark isolation (no
@@ -233,6 +238,9 @@ def main() -> None:
     if args.input_pipeline:
         steps.append([sys.executable, "-m",
                       "ray_tpu.scripts.input_bench", "--out", args.out])
+    if args.dataflow:
+        steps.append([sys.executable, "-m",
+                      "ray_tpu.scripts.dataflow_bench", "--out", args.out])
     for argv in steps:
         print(f"perfsuite: {' '.join(argv[2:])}", file=sys.stderr,
               flush=True)

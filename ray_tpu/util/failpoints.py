@@ -78,6 +78,11 @@ SITES = frozenset({
     "agent.worker_events.upload",
     "agent.fetch.chunk",
     "agent.heartbeat",
+    # spill plane (round 14): a raise-armed before_write skips that
+    # object's spill (pressure stays), before_fetch fails the restore
+    # (recovery falls back to recompute) — both degrade, never corrupt.
+    "agent.spill.before_write",
+    "agent.restore.before_fetch",
     # driver/client
     "client.dispatch.before_push",
     "client.recover.before_resubmit",
@@ -91,6 +96,10 @@ SITES = frozenset({
     # interrupted admissions and fails streams fast, never hangs)
     "serve.llm.before_admit",
     "serve.llm.before_step",
+    # autoscaling dataset actor pool: a raise-armed site skips that
+    # scale decision (the pool keeps its current size and the map
+    # completes); delay models slow actor boot.
+    "data.pool.before_scale",
 })
 
 # site -> _Failpoint. `hit()` gates on plain truthiness of this dict:

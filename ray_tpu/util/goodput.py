@@ -123,6 +123,23 @@ def record_stage(stage: str, wall_s: float,
     _emit(ev)
 
 
+def record_block_split(stage: str, n_splits: int) -> None:
+    """A stage task split one oversized output block into extra
+    store-friendly blocks (``n_splits`` = extra blocks beyond the
+    first). Runs inside worker tasks, so the observation rides the
+    worker-events replay on the cluster backend."""
+    if n_splits > 0:
+        _emit({"k": "split", "s": str(stage), "n": int(n_splits)})
+
+
+def record_pool_size(pool: str, size: int, queue_depth: int) -> None:
+    """An autoscaling dataset actor pool changed size (or reports its
+    terminal size): the pool-size / queue-depth gauges, sampled at
+    scale decisions."""
+    _emit({"k": "pool", "s": str(pool), "n": int(size),
+           "q": int(queue_depth)})
+
+
 def record_iter_batch(wait_s: Optional[float] = None,
                       user_s: Optional[float] = None,
                       transfer_s: Optional[float] = None,
@@ -352,6 +369,19 @@ def apply_events(events: List[dict], node_id: str,
                     tags={"node_id": node_id,
                           "trial": ev.get("t", "train"),
                           "cause": ev.get("c", "failure")})
+            elif kind == "split":
+                _metrics.DATA_BLOCK_SPLITS.inc(
+                    float(ev.get("n", 0)),
+                    tags={"node_id": node_id, "stage": ev.get("s", "")})
+            elif kind == "pool":
+                pool = ev.get("s", "")
+                _metrics.DATA_POOL_SIZE.set(
+                    float(ev.get("n", 0)),
+                    tags={"node_id": node_id, "pool": pool})
+                _metrics.DATA_POOL_QUEUE_DEPTH.set(
+                    float(ev.get("q", 0)),
+                    tags={"node_id": node_id, "pool": pool})
+                gauge_keys.append(("pool", pool))
             elif kind == "drop":
                 _metrics.TRAIN_EVENTS_DROPPED.inc(
                     float(ev.get("n", 0)), tags={"node_id": node_id})
@@ -369,6 +399,11 @@ def retract_gauges(keys, node_id: str) -> None:
             if key[0] == "rank":
                 _metrics.TRAIN_RANK_STEP_SECONDS.remove(tags={
                     "node_id": node_id, "trial": key[1], "rank": key[2]})
+            elif key[0] == "pool":
+                _metrics.DATA_POOL_SIZE.remove(tags={
+                    "node_id": node_id, "pool": key[1]})
+                _metrics.DATA_POOL_QUEUE_DEPTH.remove(tags={
+                    "node_id": node_id, "pool": key[1]})
         except Exception:
             pass
 

@@ -538,6 +538,32 @@ TRAIN_EVENTS_DROPPED = Counter(
     tag_keys=("node_id",),
 )
 
+# -- streaming dataflow (round 14: memory-safe data plane). Block
+# splits and pool scaling record two-sided through util/goodput.py
+# (tasks/drivers emit events, agents replay them into the federated
+# registry); spill traffic records agent-side directly — the agent IS
+# the scraped registry for its node.
+DATA_BLOCK_SPLITS = Counter(
+    "ray_tpu_block_splits_total",
+    "Extra output blocks produced by dynamic block splitting (a stage "
+    "whose output exceeded target_block_size_bytes; N splits = N "
+    "store-friendly objects instead of one oversized block)",
+    tag_keys=("node_id", "stage"),
+)
+DATA_POOL_SIZE = Gauge(
+    "ray_tpu_data_pool_size",
+    "Live actors in an autoscaling dataset actor pool "
+    "(ActorPoolStrategy(min, max): grows on queue depth, shrinks on "
+    "idle)",
+    tag_keys=("node_id", "pool"),
+)
+DATA_POOL_QUEUE_DEPTH = Gauge(
+    "ray_tpu_data_pool_queue_depth",
+    "Blocks queued behind an autoscaling dataset actor pool (the "
+    "scale-up pressure signal, sampled at scale decisions)",
+    tag_keys=("node_id", "pool"),
+)
+
 # -- RPC plane (client-side; one increment per reconnect attempt a
 # retry-windowed call makes after losing its connection — a reconnect
 # storm against one peer is visible on the federated scrape).
@@ -576,6 +602,23 @@ OBJECT_SPILL_DENIED = Counter(
     "Spill requests that could not free the requested bytes "
     "(everything left referenced or pinned — a put is about to fail)",
     tag_keys=("node_id",),
+)
+SPILL_BYTES_TOTAL = Counter(
+    "ray_tpu_spill_bytes_total",
+    "Bytes written to the node's spill target (local session dir or "
+    "the configured spill_uri backend) under memory pressure",
+    tag_keys=("node_id",),
+)
+SPILL_RESTORES_TOTAL = Counter(
+    "ray_tpu_spill_restores_total",
+    "Spilled objects restored into a node's store (local spill-file "
+    "reads plus restore-from-URI recoveries of a dead node's objects)",
+    tag_keys=("node_id",),
+)
+SHM_SWEPT_BYTES = Counter(
+    "ray_tpu_shm_swept_bytes_total",
+    "Bytes of stale /dev/shm/ray_tpu_* segments (owner process dead — "
+    "a SIGKILLed run's leak) removed by the startup sweeper",
 )
 OBJECT_AGE_SECONDS = Histogram(
     "ray_tpu_object_age_seconds",

@@ -36,6 +36,18 @@ def pytest_configure(config):
         "slow: long-running chaos/bench workouts, deselected by the "
         "tier-1 run's -m 'not slow'",
     )
+    # Reclaim /dev/shm segments leaked by SIGKILLed earlier runs (their
+    # owner pids are dead): 121 GB of leaked segments after one
+    # interrupted soak made later tier-1 runs OOM spuriously.
+    try:
+        from ray_tpu.util.shm_sweep import sweep_stale_shm
+
+        swept, nbytes = sweep_stale_shm()
+        if swept:
+            print(f"[conftest] swept {swept} stale /dev/shm segment(s), "
+                  f"{nbytes / 1e9:.2f} GB")
+    except Exception:
+        pass
 
 
 @pytest.fixture(scope="session")

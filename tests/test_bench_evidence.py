@@ -35,6 +35,12 @@ def test_check_accepts_real_writer_shapes(tmp_path):
          "seed": 7, "duration_s": 30.0, "faults": {}, "violations": []},
         {"ts": 4.0, "bench": "drain_recovery_ms", "device": "TPU v5 lite",
          "proactive_drain_ms": 100.0, "crash_detection_ms": 210.0},
+        {"ts": 5.0, "bench": "streaming_dataflow", "device": "TPU v5 lite",
+         "rows_s": 84000.0, "client": {"stall_fraction": 0.03},
+         "server": {"stall_fraction": 0.04},
+         "agreement": {"ok": True},
+         "spill": {"spilled_objects": 50, "restores": 55},
+         "pool": {"pool_peak": 4}},
     ]
     dest.write_text("".join(json.dumps(ln) + "\n" for ln in lines))
     assert bench_log.check_file(str(dest)) == []
@@ -63,6 +69,22 @@ def test_check_flags_malformed_lines(tmp_path):
                for p in problems)
     assert any(p.startswith("line 6") and "only valid on line 1" in p
                for p in problems)
+
+
+def test_check_flags_gutted_streaming_dataflow_line(tmp_path):
+    """A streaming_dataflow line without both stall views, the agreement
+    verdict, and the spill/restore churn proof is an unverified claim —
+    every missing piece is flagged."""
+    dest = tmp_path / "trail.jsonl"
+    dest.write_text(json.dumps({
+        "ts": 1.0, "bench": "streaming_dataflow",
+        "device": "TPU v5 lite"}) + "\n")
+    problems = "\n".join(bench_log.check_file(str(dest)))
+    assert "rows_s/tokens_s" in problems
+    assert "client.stall_fraction" in problems
+    assert "server.stall_fraction" in problems
+    assert "agreement.ok" in problems
+    assert "spill.spilled_objects/restores" in problems
 
 
 def test_check_cli_exit_codes(tmp_path):
