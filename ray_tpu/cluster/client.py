@@ -30,6 +30,7 @@ from ray_tpu.core.object_ref import (
 from ray_tpu.core.config import config
 from ray_tpu.core.resources import demand_of
 from ray_tpu.util import failpoints
+from ray_tpu.util import metrics as _metrics
 
 
 # Poll-again sentinel: a fetch hit only stale/dead locations; the oid
@@ -379,6 +380,7 @@ class ClusterBackend:
                 # (chaos failpoints, a head mid-restart edge): a dead
                 # flusher silently stops all ref/location reporting for
                 # the rest of the process's life.
+                _metrics.count_loop_restart("client.ref_flush")
                 continue
 
     def flush_refs(self) -> None:
@@ -1499,6 +1501,7 @@ class ClusterBackend:
                 # earlier specs in the batch may already be RUNNING on a
                 # node, and writing a TaskError over their oids would race
                 # their real results.
+                _metrics.count_loop_restart("client.submit")
                 for spec in batch:
                     if spec.get("_handled"):
                         continue
@@ -1506,7 +1509,11 @@ class ClusterBackend:
                         self._fail_spec(spec, TaskError(
                             spec.get("fname", "task"),
                             f"submission failed: {e!r}", repr(e)))
-                    except BaseException:
+                    # Per-spec error-write guard inside the already-
+                    # counted batch handler: ticking here too would
+                    # inflate the series by the batch width on one
+                    # transient outage.
+                    except BaseException:  # analyze: ignore[DL002]
                         pass
             finally:
                 with self._submit_cv:
@@ -2620,6 +2627,7 @@ class ClusterBackend:
                 got = self.head.call(
                     "pubsub_poll", sub_id, 10.0, timeout=15.0)
             except Exception:
+                _metrics.count_loop_restart("client.log_poll")
                 subscribed = False
                 time.sleep(0.5)
                 continue
@@ -2639,6 +2647,7 @@ class ClusterBackend:
                 # sys.stdout may be swapped/closed under us (pytest
                 # capture) — drop this batch but NEVER kill the poller;
                 # stdout usually comes back.
+                _metrics.count_loop_restart("client.log_poll")
                 continue
 
     def cluster_resources(self) -> dict:
@@ -2653,6 +2662,10 @@ class ClusterBackend:
     def shutdown(self) -> None:
         """Disconnect this client (the cluster keeps running; use
         Cluster.shutdown / shutdown_cluster to tear it down)."""
+        # This process's daemon loops die with it: retract their
+        # restart series so the scrape doesn't carry dead children.
+        _metrics.retract_loop_series(
+            ["client.ref_flush", "client.submit", "client.log_poll"])
         # Drain the submit queue first: tasks handed to submit_task before
         # shutdown must reach a node (or fail into their refs) — then the
         # closed flag stops the submitter thread. "_dispatching" covers

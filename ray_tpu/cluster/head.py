@@ -616,7 +616,7 @@ class HeadServer:
         })
         return {"head_time": time.time()}
 
-    def rpc_heartbeat(self, node_id, available):
+    def rpc_heartbeat(self, node_id, available):  # idempotent (full-state)
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
@@ -816,7 +816,7 @@ class HeadServer:
             ).start()
         return moved
 
-    def rpc_nodes(self):
+    def rpc_nodes(self):  # idempotent (read-only)
         with self._lock:
             return [
                 {
@@ -1007,13 +1007,13 @@ class HeadServer:
 
     # -- pubsub -----------------------------------------------------------
 
-    def rpc_pubsub_subscribe(self, sub_id, channel, keys=None):
+    def rpc_pubsub_subscribe(self, sub_id, channel, keys=None):  # idempotent
         return self.pubsub.subscribe(sub_id, channel, keys)
 
     def rpc_pubsub_unsubscribe(self, sub_id, channel=None):
         return self.pubsub.unsubscribe(sub_id, channel)
 
-    def rpc_pubsub_poll(self, sub_id, timeout=10.0, max_msgs=1000):
+    def rpc_pubsub_poll(self, sub_id, timeout=10.0, max_msgs=1000):  # idempotent
         # Long-poll: safe to block — the RPC server is thread-per-
         # connection and subscribers poll from a dedicated thread (whose
         # pooled connection is its own).
@@ -1195,7 +1195,11 @@ class HeadServer:
                         node, oid = item
                         node.client.call("free_object", oid, timeout=5.0)
                 except Exception:
-                    pass
+                    # Per-item fan-out guard: a dead node's delete is
+                    # moot, but the loop itself must survive and say so.
+                    from ray_tpu.util import metrics as _metrics
+
+                    _metrics.count_loop_restart("head.free")
 
     def rpc_ref_client_dead(self, client_id):
         """A client process died: drop every hold it registered."""
@@ -2553,7 +2557,9 @@ class HeadServer:
                     failpoints.hit("head.pg.prepare")
                     node.client.call(
                         "prepare_bundle", pg_id, bundle_index,
-                        bundles[bundle_index], timeout=120.0,
+                        bundles[bundle_index],
+                        # timeout-budget: outlasts config.bundle_reserve_timeout_s
+                        timeout=config.bundle_reserve_timeout_s * 2,
                     )
                 except Exception:
                     ok = False
@@ -2567,7 +2573,9 @@ class HeadServer:
                     try:
                         node.client.call("return_bundle", pg_id, bundle_index)
                     except Exception:
-                        pass
+                        from ray_tpu.util import metrics as _metrics
+
+                        _metrics.count_loop_restart("head.reserve_pg")
             time.sleep(0.25)
         for node_id, bundle_index in assignment:
             with self._lock:
@@ -2933,7 +2941,8 @@ class HeadServer:
                         failpoints.hit("head.pg.prepare")
                         node.client.call(
                             "prepare_bundle", pg_id, bi, bundles[bi],
-                            timeout=120.0)
+                            # timeout-budget: outlasts config.bundle_reserve_timeout_s
+                            timeout=config.bundle_reserve_timeout_s * 2)
                     except Exception:
                         ok = False
                         break
@@ -3033,6 +3042,10 @@ class HeadServer:
         with self._free_cv:
             self._free_cv.notify_all()
             self._restore_cv.notify_all()
+        from ray_tpu.util import metrics as _metrics
+
+        # Dead head = dead loops: their restart series leave the scrape.
+        _metrics.retract_loop_series(["head.free", "head.reserve_pg"])
         if self._metrics_shutdown is not None:
             try:
                 self._metrics_shutdown()

@@ -197,6 +197,12 @@ class NodeAgent:
         # Training goodput gauge children (the per-rank straggler
         # gauge), same retraction lifecycle as the serve gauges.
         self._train_gauges: dict[str, set] = {}
+        # Last-applied worker-events batch seq per (worker_id, pid):
+        # the flusher resends a batch whose ack was severed under its
+        # original seq, and this table absorbs the replay (bounded,
+        # insertion-ordered — the rpc_worker_events idempotence).
+        self._event_seqs: "collections.OrderedDict[tuple, int]" = (
+            collections.OrderedDict())
         # Remote profiler captures (state.capture_profile): manifest by
         # capture id; trace files live under log_dir and stream back
         # through read_capture_file (the log-read plane's chunked shape).
@@ -611,9 +617,16 @@ class NodeAgent:
 
     # -- task dispatch ----------------------------------------------------
 
-    def rpc_submit_task(self, spec: dict):
+    def rpc_submit_task(self, spec: dict):  # idempotent
         """Enqueue a task; the dispatcher leases a worker when resources
-        allow. Returns immediately (results flow through the store)."""
+        allow. Returns immediately (results flow through the store).
+
+        Idempotent under the task model's own contract: a replayed
+        plain-task enqueue re-executes a task lineage recovery is
+        allowed to re-run anyway (results land by oid, last-write-
+        wins), and a replayed ACTOR push dedups at the actor's single
+        worker (``_is_duplicate_push`` — exactly-once per
+        incarnation)."""
         self._requeue(spec)
         return True
 
@@ -748,17 +761,26 @@ class NodeAgent:
         except Exception:
             pass
 
-    def rpc_worker_events(self, worker_id, pid, task_events, log_lines,
-                          spans=None, device=None, serve=None,
-                          train=None):
+    def rpc_worker_events(self, worker_id, pid, task_events,  # idempotent
+                          log_lines, spans=None, device=None, serve=None,
+                          train=None, seq=None):
         """Batched observability report from a worker: authoritative task
         records (with timings/outcome + per-phase wall-ns), captured
         stdout/stderr lines, finished tracing spans (forwarded to the
         head's span store), an optional device-telemetry snapshot,
         serve request-path observations, and training goodput
         observations (both replayed into THIS registry — the one the
-        federated scrape sees; worker registries are never scraped)."""
+        federated scrape sees; worker registries are never scraped).
+
+        Idempotent per (worker, pid, seq): the flusher resends a batch
+        whose reply was lost under its original sequence number, and
+        the replay is absorbed here — without the dedup, a severed ack
+        double-counted every serve/goodput observation in the batch
+        (the exact-count planes' cross-check benches are built to
+        catch precisely that)."""
         failpoints.hit("agent.worker_events.upload")
+        if self._is_duplicate_event_batch(worker_id, pid, seq):
+            return True
         if serve:
             try:
                 from ray_tpu.serve import _observability as _serve_obs
@@ -835,6 +857,24 @@ class NodeAgent:
             except Exception:
                 pass
         return True
+
+    def _is_duplicate_event_batch(self, worker_id, pid, seq) -> bool:
+        """Record-and-test a worker event batch's sequence number (the
+        replay-absorb half of rpc_worker_events' idempotence). Keyed by
+        (worker_id, pid) so a restarted worker's fresh numbering never
+        collides with its previous incarnation's."""
+        if seq is None:
+            return False  # legacy/probe caller: no dedup contract
+        key = (worker_id, pid)
+        with self._lock:
+            last = self._event_seqs.get(key)
+            if last is not None and seq <= last:
+                return True
+            self._event_seqs[key] = seq
+            self._event_seqs.move_to_end(key)
+            while len(self._event_seqs) > 4096:
+                self._event_seqs.popitem(last=False)
+        return False
 
     def rpc_list_task_records(self, limit: int = 1000):
         with self._lock:
@@ -1056,12 +1096,22 @@ class NodeAgent:
             current["released"] = True
             current["pool"].release(current["demand"])
 
-    def rpc_task_done(self, worker_id):
-        """Worker finished its current task; release + return to pool."""
+    def rpc_task_done(self, worker_id):  # idempotent
+        """Worker finished its current task; release + return to pool.
+
+        Replay-absorbing: a worker whose task-done ACK was severed
+        retries, and the second delivery must be a no-op — without the
+        guard the replay appended the worker to the idle pool TWICE,
+        and the dispatcher could lease one process for two concurrent
+        tasks. The claim is taken ATOMICALLY under the lock (a pure
+        current_task check would race a concurrent replay still
+        between the check and the idle-pool append)."""
         with self._lock:
             w = self._workers.get(worker_id)
-        if w is None:
-            return False
+            current = w.current_task if w is not None else None
+            if w is None or current is None or current.get("_done"):
+                return False  # unknown worker, or a replayed done
+            current["_done"] = True  # first delivery owns the return
         self._release_current(w)
         self._return_worker(w)
         return True
@@ -1083,7 +1133,8 @@ class NodeAgent:
             return False
         current = w.current_task
         if current["released"]:
-            current["pool"].acquire(current["demand"], timeout=300.0)
+            current["pool"].acquire(current["demand"],
+                                    timeout=config.cpu_reacquire_budget_s)
             current["released"] = False
         return True
 
@@ -1151,7 +1202,7 @@ class NodeAgent:
         c.call("owner_add_location", oid, self.node_id, self.address,
                self.store_path, True, 0, timeout=10.0)
 
-    def rpc_cancel_task(self, task_id: str, force: bool = False):
+    def rpc_cancel_task(self, task_id: str, force: bool = False):  # idempotent
         """CancelTask analog (``core_worker.proto`` CancelTask → raylet).
         Queued: dropped here, TaskCancelledError stored. Running:
         force kills the worker process (its lease/pins are reclaimed by
@@ -1485,7 +1536,7 @@ class NodeAgent:
             self._drain_reason = reason
         return True
 
-    def rpc_drain_status(self):
+    def rpc_drain_status(self):  # idempotent
         """Quiescence probe for the drain coordinator: queued tasks plus
         busy non-actor workers (actor processes hold their creation spec
         as current_task for life, so they never count as 'running')."""
@@ -1567,7 +1618,7 @@ class NodeAgent:
 
     # -- placement group bundles (2PC participant) ------------------------
 
-    def rpc_prepare_bundle(self, pg_id, bundle_index, bundle):
+    def rpc_prepare_bundle(self, pg_id, bundle_index, bundle):  # idempotent
         with self._lock:
             if (pg_id, bundle_index) in self._bundles:
                 # Idempotent replay: the head's prepare landed but its
@@ -1578,7 +1629,8 @@ class NodeAgent:
                 return True
         if not self.pool.feasible(bundle):
             raise ValueError(f"bundle {bundle} infeasible on node {self.node_id}")
-        if not self.pool.acquire(bundle, timeout=60.0):
+        if not self.pool.acquire(
+                bundle, timeout=config.bundle_reserve_timeout_s):
             raise TimeoutError(f"bundle {bundle} not reservable on {self.node_id}")
         with self._lock:
             if (pg_id, bundle_index) in self._bundles:
@@ -1590,7 +1642,7 @@ class NodeAgent:
             self._bundle_state[(pg_id, bundle_index)] = "PREPARED"
         return True
 
-    def rpc_commit_bundle(self, pg_id, bundle_index):
+    def rpc_commit_bundle(self, pg_id, bundle_index):  # idempotent
         with self._lock:
             # Idempotent: committing an already-committed (or unknown —
             # returned while the commit retried) bundle changes nothing.
@@ -1610,7 +1662,7 @@ class NodeAgent:
                 for (pg_id, bi), state in self._bundle_state.items()
             }
 
-    def rpc_return_bundle(self, pg_id, bundle_index):
+    def rpc_return_bundle(self, pg_id, bundle_index):  # idempotent
         with self._lock:
             pool = self._bundles.pop((pg_id, bundle_index), None)
             self._bundle_state.pop((pg_id, bundle_index), None)
@@ -2323,7 +2375,7 @@ class NodeAgent:
                 self.store.release(oid)
         return self.spill_backend.read_range(oid, offset, length)
 
-    def rpc_spill(self, bytes_needed: int):
+    def rpc_spill(self, bytes_needed: int):  # idempotent (level-triggered)
         """Move cold, unreferenced primary copies to disk until
         ``bytes_needed`` arena bytes are freed. Returns bytes freed
         (local_object_manager.h:110,122 / SpillObjects analog)."""
@@ -2382,7 +2434,10 @@ class NodeAgent:
                 # the put will raise StoreFullError after its retries.
                 from ray_tpu.util import metrics as _metrics
 
-                self._spill_denied += 1
+                # A replayed spill request re-counting a denial skews a
+                # stats counter, never execution state — the handler
+                # stays level-triggered.  # analyze: ignore[RT002]
+                self._spill_denied += 1  # analyze: ignore[RT002]
                 try:
                     _metrics.OBJECT_SPILL_DENIED.inc(
                         tags={"node_id": self.node_id})
@@ -2410,7 +2465,7 @@ class NodeAgent:
                 pass
         return freed
 
-    def rpc_free_object(self, oid):
+    def rpc_free_object(self, oid):  # idempotent
         """Head says nothing references this object anymore: drop the shm
         copy and any spilled copy (free-on-zero broadcast target). The
         spill lock orders this against an in-progress spill pass, so a
@@ -2434,7 +2489,7 @@ class NodeAgent:
             pass
         return True
 
-    def rpc_delete_spilled(self, oid, uri):
+    def rpc_delete_spilled(self, oid, uri):  # idempotent
         """Drop one object from a spill target this node can reach (the
         head's free fanout for a DEAD node's remote-spilled copy — the
         spiller is gone, so any live node does the delete)."""
@@ -2583,7 +2638,7 @@ class NodeAgent:
                         cur.get("version", 0):
                     self._cluster_view[nid] = entry
 
-    def rpc_gossip(self, their_view: dict) -> dict:
+    def rpc_gossip(self, their_view: dict) -> dict:  # idempotent
         """Push-pull anti-entropy exchange: merge the caller's view,
         return ours (ray_syncer.h bidirectional sync analog)."""
         self._merge_view(their_view)
@@ -2773,7 +2828,7 @@ class NodeAgent:
             return [w.client for w in self._workers.values()
                     if w.client is not None and w.proc.poll() is None]
 
-    def rpc_worker_addresses(self):
+    def rpc_worker_addresses(self):  # idempotent (read-only)
         """Live workers' RPC server addresses. Partition group
         resolution folds these into a node's address set: traffic
         addressed DIRECTLY to a worker (cross-node actor pushes, owner

@@ -27,6 +27,7 @@ import traceback
 from ray_tpu.cluster.rpc import RpcClient, RpcServer
 from ray_tpu.core import attribution
 from ray_tpu.util import failpoints
+from ray_tpu.util import metrics as _wp_metrics
 from ray_tpu.core import serialization as ser
 from ray_tpu.core.cancellation import CancelRegistry
 from ray_tpu.core.object_ref import (
@@ -96,15 +97,21 @@ class WorkerHandler:
         from ray_tpu._private import worker as worker_mod
 
         worker_mod._backend = self.backend  # nested API calls inside tasks
+        from ray_tpu.core.config import config
+
         self._hooks = (
             lambda: self.agent.call("task_blocked", self.worker_id),
             # Unblock re-acquires the CPU slot and the agent-side
-            # acquire may legitimately wait up to its 300s budget when
-            # the node is saturated (many tasks cycling few slots under
-            # memory pressure) — the RPC timeout must outlast it or the
-            # worker kills a healthy task with ConnectionLost.
-            lambda: self.agent.call("task_unblocked", self.worker_id,
-                                    timeout=330.0),
+            # acquire may legitimately wait up to its full re-acquire
+            # budget when the node is saturated (many tasks cycling few
+            # slots under memory pressure) — the RPC timeout must
+            # outlast it or the worker kills a healthy task with
+            # ConnectionLost. Derived from the budget knob so the two
+            # can't drift; the analyzer checks the declared relation.
+            lambda: self.agent.call(
+                "task_unblocked", self.worker_id,
+                # timeout-budget: outlasts config.cpu_reacquire_budget_s
+                timeout=config.cpu_reacquire_budget_s + 30.0),
         )
         self._q: queue.Queue = queue.Queue()
         # Named concurrency groups: each gets its own queue + executor
@@ -186,7 +193,10 @@ class WorkerHandler:
             self._task_events.append(rec)
 
     def _event_flush_loop(self):
+        import collections
+
         from ray_tpu.util import device_telemetry, tracing
+        from ray_tpu.util import metrics as _metrics
 
         pid = os.getpid()
         last_dev_ship = 0.0
@@ -199,6 +209,15 @@ class WorkerHandler:
         # failed agent calls over ~3s mean the agent is gone.
         consecutive_fail = 0
         idle_rounds = 0
+        # Failed uploads are RESENT as-is under their ORIGINAL sequence
+        # number, and the agent's rpc_worker_events dedups on (worker,
+        # pid, seq): a reply lost after the agent applied the batch
+        # (maybe_executed) makes the resend an ack instead of a
+        # double-count — the serve/goodput planes promise exact counts,
+        # and the old requeue-into-the-buffer path re-shipped the same
+        # observations under what was effectively a new identity.
+        unacked: "collections.deque" = collections.deque()
+        ship_seq = 0
         while True:
             time.sleep(0.25)
             # Attach jax compile-counter listeners the moment a task's
@@ -207,7 +226,7 @@ class WorkerHandler:
             try:
                 device_telemetry.ensure_listeners()
             except Exception:
-                pass
+                _metrics.count_loop_restart("worker.event_flush")
             with self._ev_lock:
                 # Drain in place: the tee streams hold a reference to
                 # THESE list objects — rebinding would orphan them.
@@ -227,6 +246,7 @@ class WorkerHandler:
                     serve_events = so.drain_events()
                 except Exception:
                     serve_events = []
+                    _metrics.count_loop_restart("worker.event_flush")
             # Training goodput observations (dataset stage/iterator
             # samples, step phases, downtime) ride the same batch; the
             # module is only consulted if something in this process
@@ -238,8 +258,10 @@ class WorkerHandler:
                     train_events = go.drain_events()
                 except Exception:
                     train_events = []
+                    _metrics.count_loop_restart("worker.event_flush")
             if not lines and not events and not spans \
-                    and not serve_events and not train_events:
+                    and not serve_events and not train_events \
+                    and not unacked:
                 idle_rounds += 1
                 # Probe liveness every ~2s when idle; every round while
                 # failures are accumulating (fast exit once the agent
@@ -258,31 +280,51 @@ class WorkerHandler:
                     last_dev_ship = now
                 except Exception:
                     device = None
-            try:
-                self.agent.call(
-                    "worker_events", self.worker_id, pid, events, lines,
-                    spans, device, serve_events or None,
-                    train_events or None)
-                consecutive_fail = 0
-            except Exception:
-                if serve_events:
-                    # The serve plane promises exact counts: requeue a
-                    # failed upload's observations (bounded; overflow
-                    # counts into the drop counter) so a transient
-                    # worker->agent blip doesn't silently lose them.
-                    try:
-                        so.requeue_events(serve_events)
-                    except Exception:
-                        pass
-                if train_events:
-                    # Same exact-count promise on the goodput plane.
-                    try:
-                        go.requeue_events(train_events)
-                    except Exception:
-                        pass
-                consecutive_fail += 1
-                if consecutive_fail >= 12:
-                    os._exit(1)  # agent is gone: die with the node
+                    _metrics.count_loop_restart("worker.event_flush")
+            if lines or events or spans or serve_events or train_events \
+                    or device is not None or not unacked:
+                # New content — or an empty liveness probe when nothing
+                # is pending resend (the resend IS the probe otherwise).
+                ship_seq += 1
+                unacked.append((ship_seq, events, lines, spans, device,
+                                serve_events or None,
+                                train_events or None))
+            while len(unacked) > 8:
+                # Bounded resend queue: give the oldest batch's
+                # exact-count planes back to their buffers (they count
+                # their own overflow drops). Re-shipping under a new
+                # seq can double-apply only if one of its 8+ failed
+                # sends secretly landed — the narrow corner the bound
+                # trades for bounded memory.
+                _, _, _, _, _, drop_serve, drop_train = unacked.popleft()
+                # Independent requeues: a failing serve requeue must
+                # not also cost the batch's goodput observations.
+                try:
+                    if drop_serve and so is not None:
+                        so.requeue_events(drop_serve)
+                except Exception:
+                    _metrics.count_loop_restart("worker.event_flush")
+                try:
+                    if drop_train and go is not None:
+                        go.requeue_events(drop_train)
+                except Exception:
+                    _metrics.count_loop_restart("worker.event_flush")
+            while unacked:
+                (seq, b_events, b_lines, b_spans, b_device, b_serve,
+                 b_train) = unacked[0]
+                try:
+                    self.agent.call(
+                        "worker_events", self.worker_id, pid, b_events,
+                        b_lines, b_spans, b_device, b_serve, b_train,
+                        seq=seq)
+                    unacked.popleft()
+                    consecutive_fail = 0
+                except Exception:
+                    _metrics.count_loop_restart("worker.event_flush")
+                    consecutive_fail += 1
+                    if consecutive_fail >= 12:
+                        os._exit(1)  # agent is gone: die with the node
+                    break  # keep the batch; resend same seq next round
 
     # -- rpc surface (called by agent and by remote callers) ---------------
 
@@ -300,7 +342,7 @@ class WorkerHandler:
                 self._seen_pushes.popitem(last=False)
         return False
 
-    def rpc_push_task(self, spec: dict):
+    def rpc_push_task(self, spec: dict):  # idempotent
         if self._is_duplicate_push(spec):
             # Refused (False): the agent releases this dispatch's lease;
             # the first delivery owns the task's fate.
@@ -318,7 +360,7 @@ class WorkerHandler:
         self._q.put(("actor_ctor", spec))
         return True
 
-    def rpc_push_actor_task(self, spec: dict):
+    def rpc_push_actor_task(self, spec: dict):  # idempotent
         if self._is_duplicate_push(spec):
             # The caller's retry after a lost reply (sever-after-send):
             # the first delivery is (or was) executing — exactly-once
@@ -468,6 +510,7 @@ class WorkerHandler:
                 elif kind == "actor_task":
                     self._run_actor_task(spec)
             except Exception:
+                _wp_metrics.count_loop_restart("worker.exec")
                 traceback.print_exc()
 
     def _resolve_function(self, spec):
@@ -821,6 +864,7 @@ class WorkerHandler:
             try:
                 fn(fut)
             except Exception:
+                _wp_metrics.count_loop_restart("worker.async_done")
                 traceback.print_exc()
 
     def _run_actor_task(self, spec):

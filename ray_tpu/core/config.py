@@ -141,6 +141,15 @@ _DEFS: dict[str, tuple[type, Any]] = {
     # Finished-task records each node agent retains (ring; evictions
     # count into ray_tpu_task_records_evicted_total).
     "task_record_retention": (int, 10_000),
+    # Nested-timeout budgets (the analyzer's timeout-budget annotations
+    # relate inner RPC timeouts to these — edit one side and `ray-tpu
+    # analyze` fails instead of a healthy task dying):
+    # how long an agent's task_unblocked handler may block re-acquiring
+    # the CPU slot on a saturated node...
+    "cpu_reacquire_budget_s": (float, 300.0),
+    # ...and how long a 2PC prepare may block carving out a PG bundle's
+    # reservation on a busy node.
+    "bundle_reserve_timeout_s": (float, 60.0),
     # -- node drain / preemption -------------------------------------------
     # Default deadline a graceful drain gives in-flight tasks before the
     # node is force-removed (DrainRaylet deadline analog).

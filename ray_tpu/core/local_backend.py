@@ -69,6 +69,9 @@ class _DaemonPool:
             try:
                 fn(*args)
             except BaseException:  # noqa: BLE001 — pool must survive anything
+                from ray_tpu.util import metrics as _metrics
+
+                _metrics.count_loop_restart("local.daemon_pool")
                 traceback.print_exc()
 
 
@@ -1149,6 +1152,9 @@ class LocalBackend:
                     # A cancel injection delivered after the item's own
                     # handlers (e.g. inside a finally) must not kill this
                     # actor's executor thread.
+                    from ray_tpu.util import metrics as _metrics
+
+                    _metrics.count_loop_restart("local.actor_exec")
                     traceback.print_exc()
 
         for i in range(max_concurrency):

@@ -367,13 +367,14 @@ def gpt2_loss(params: Params, batch: dict[str, jax.Array], cfg: GPT2Config) -> j
 # clamped to ``seq_len``.
 
 
-def gpt2_init_cache(cfg: GPT2Config, slots: int, cache_len: int) -> Params:
+def gpt2_init_cache(cfg: GPT2Config, slots: int, cache_len: int) -> Params:  # decode-path
     """Ring KV-cache for ``slots`` concurrent sequences (bf16 by default:
     the cache rides ``cfg.dtype``, never fp32)."""
     shape = (cfg.n_layer, slots, cache_len, cfg.n_head, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
+# jax-hot-path: traced into the engine's single compiled decode step
 def gpt2_decode_step(params: Params, cache: Params, tokens: jax.Array,
                      pos: jax.Array, cfg: GPT2Config
                      ) -> tuple[jax.Array, Params]:
@@ -424,6 +425,7 @@ def gpt2_decode_step(params: Params, cache: Params, tokens: jax.Array,
     return logits, {"k": k_all, "v": v_all}
 
 
+# jax-hot-path: traced into the engine's single compiled prefill lane
 def gpt2_prefill(params: Params, cache: Params, tokens: jax.Array,
                  slots: jax.Array, lengths: jax.Array, cfg: GPT2Config
                  ) -> tuple[jax.Array, Params]:

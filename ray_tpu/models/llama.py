@@ -277,7 +277,7 @@ def llama_loss(params: Params, batch: dict[str, jax.Array],
 # bandwidth saving carries straight into serving HBM footprint.
 
 
-def llama_init_cache(cfg: LlamaConfig, slots: int, cache_len: int) -> Params:
+def llama_init_cache(cfg: LlamaConfig, slots: int, cache_len: int) -> Params:  # decode-path
     shape = (cfg.n_layer, slots, cache_len, cfg.n_kv_head, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
@@ -298,6 +298,7 @@ def _rope_at(x: jax.Array, pos: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+# jax-hot-path: traced into the engine's single compiled decode step
 def llama_decode_step(params: Params, cache: Params, tokens: jax.Array,
                       pos: jax.Array, cfg: LlamaConfig
                       ) -> tuple[jax.Array, Params]:
@@ -346,6 +347,7 @@ def llama_decode_step(params: Params, cache: Params, tokens: jax.Array,
     return logits, {"k": k_all, "v": v_all}
 
 
+# jax-hot-path: traced into the engine's single compiled prefill lane
 def llama_prefill(params: Params, cache: Params, tokens: jax.Array,
                   slots: jax.Array, lengths: jax.Array, cfg: LlamaConfig
                   ) -> tuple[jax.Array, Params]:

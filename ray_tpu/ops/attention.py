@@ -81,6 +81,7 @@ def causal_attention(
 # here once.
 
 
+# decode-path  # jax-hot-path: the KV cache stays in the activation dtype
 def cache_write_token(cache: jax.Array, rows: jax.Array,
                       cursor: jax.Array) -> jax.Array:
     """Per-slot ring-cursor write of ONE token's K or V rows.
@@ -93,6 +94,7 @@ def cache_write_token(cache: jax.Array, rows: jax.Array,
     )(cache, rows, cursor)
 
 
+# decode-path  # jax-hot-path: the KV cache stays in the activation dtype
 def cache_write_prompt(cache: jax.Array, rows: jax.Array,
                        slots: jax.Array) -> jax.Array:
     """Prefill-lane write: row block ``rows[i]`` ([P, H, hd]) lands at

@@ -8,7 +8,8 @@ a chaos soak.
 
 Usage:
     python -m ray_tpu.scripts.analyze [paths...]
-        [--rule lock-order|blocking|finalizer|async-lock|contracts]...
+        [--rule lock-order|blocking|finalizer|async-lock|contracts
+               |retry|daemon-loop|timeout-order|jax-hotpath|lifecycle]...
         [--no-baseline] [--baseline-file F] [--json]
         [--diff REV]           # only findings on lines changed since REV
         [--write-baseline]     # re-emit the baseline from current findings
@@ -67,6 +68,7 @@ def _merge_out(result: dict, out_path: str) -> None:
     artifact["analyze"] = {
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "files_scanned": result["n_files"],
+        "passes": sorted(analyze.PASSES),
         "rule_counts": result["rule_counts"],
         "new_rule_counts": result["new_rule_counts"],
         "baselined": len(result["allowed"]),

@@ -311,6 +311,7 @@ def record_goodput(*, trial: str, goodput_pct: float, wall_s: float,
 
 def record_analyze(*, rule_counts: dict, new: int, baselined: int,
                    ok: bool, stale_baseline: int = 0,
+                   passes: list | None = None,
                    device: str = "", path: str | None = None,
                    **extra) -> dict:
     """Static-analysis gate evidence (``scripts/analyze.py --out``, the
@@ -321,6 +322,16 @@ def record_analyze(*, rule_counts: dict, new: int, baselined: int,
     this line is the timestamped trail). Committed to the evidence
     trail only on an accelerator; returns the entry (with
     ``committed_to``) either way."""
+    if passes is None:
+        # Default to the live registry: the evidence line must say
+        # WHICH pass families were active — "analyze ran" from a build
+        # where half the passes didn't load is a weaker claim.
+        try:
+            from ray_tpu.util import analyze as _analyze
+
+            passes = sorted(_analyze.PASSES)
+        except Exception:
+            passes = []
     entry: dict = {
         "bench": "analyze",
         "device": device,
@@ -328,6 +339,7 @@ def record_analyze(*, rule_counts: dict, new: int, baselined: int,
         "new": int(new),
         "baselined": int(baselined),
         "stale_baseline": int(stale_baseline),
+        "passes": list(passes),
         "ok": bool(ok),
     }
     entry.update(extra)
@@ -504,6 +516,17 @@ def check_line(obj: object, *, allow_header: bool = False) -> list[str]:
             if not isinstance(obj.get("ok"), bool):
                 errs.append("analyze line missing boolean 'ok' gate "
                             "verdict")
+            required = {"lock-order", "blocking", "finalizer",
+                        "async-lock", "contracts", "retry",
+                        "daemon-loop", "timeout-order", "jax-hotpath",
+                        "lifecycle"}
+            passes = obj.get("passes")
+            if not isinstance(passes, list) \
+                    or not required <= set(passes):
+                missing = sorted(required - set(passes or ()))
+                errs.append(f"analyze line missing active pass "
+                            f"families {missing} — the gate claim must "
+                            f"name every family that ran")
         elif obj["bench"] == "gang_recovery":
             # The MTTR line IS the number: a gang-recovery claim with
             # no reschedule latency is unreviewable.

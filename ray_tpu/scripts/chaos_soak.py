@@ -225,6 +225,9 @@ class _Soak:
                     time.sleep(self.rng.uniform(1.0, 3.0))
                     setter({site: None for site in arm})
             except Exception as e:
+                from ray_tpu.util import metrics as _metrics
+
+                _metrics.count_loop_restart("soak.fault")
                 self.violations.append(f"injecting {fault}: {e!r}")
                 continue
             self.faults[fault] = self.faults.get(fault, 0) + 1

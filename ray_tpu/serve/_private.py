@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional
 import ray_tpu
 from ray_tpu.serve import _observability as _obs
 from ray_tpu.serve._observability import RequestShedError
+from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import tracing
 
 CONTROLLER_NAME = "ray_tpu.serve.controller"
@@ -296,15 +297,16 @@ class ServeController:
                 try:
                     self._reconcile_once()
                 except Exception:
-                    pass  # next tick retries; the loop must never die
+                    # next tick retries; the loop must never die
+                    _metrics.count_loop_restart("serve.reconcile")
                 try:
                     self._reconcile_proxies()
                 except Exception:
-                    pass
+                    _metrics.count_loop_restart("serve.reconcile")
             try:
                 _obs.record_reconcile(time.monotonic() - t0)
             except Exception:
-                pass
+                _metrics.count_loop_restart("serve.reconcile")
 
     def _reconcile_once(self):
         with self._lock:
@@ -578,6 +580,7 @@ class _TableListener:
             except Exception:
                 if self.stopped:
                     return
+                _metrics.count_loop_restart("serve.table_listener")
                 time.sleep(0.5)  # controller restarting; retry
 
 
@@ -1505,6 +1508,7 @@ class _BatchQueue:
                     box[0] = r
                     event.set()
             except BaseException as e:  # noqa: BLE001 — fan the error out
+                _metrics.count_loop_restart("serve.batch_queue")
                 for _, event, box, _, _ in run:
                     box[1] = e
                     event.set()

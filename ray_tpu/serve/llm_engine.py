@@ -53,6 +53,7 @@ from typing import Dict, List, Optional
 from ray_tpu.serve import _observability as _obs
 from ray_tpu.serve._observability import RequestShedError
 from ray_tpu.util import failpoints
+from ray_tpu.util import metrics as _metrics
 
 # How many consecutive decode-step failures fail the active streams
 # (each failure already surfaced; three in a row means the step itself
@@ -231,19 +232,23 @@ class LLMEngine:
 
     # -- scheduler loop ----------------------------------------------------
 
-    def _loop(self):
+    def _loop(self):  # jax-hot-path
         while not self._stop:
             did = False
             try:
                 did = self._admit_once() or did
             except BaseException:
                 # _admit_once handles its own requeue; anything that
-                # still escapes must not kill the scheduler.
-                pass
+                # still escapes must not kill the scheduler — but a
+                # scheduler stuck in a crash-restart cycle must be
+                # visible on the scrape, not just a silent hot core.
+                _metrics.count_loop_restart("llm.engine")
             try:
                 did = self._step_once() or did
             except BaseException:
-                pass
+                # Step errors are already counted (3-strike fail-fast
+                # in _step_once); this tick records the loop survival.
+                _metrics.count_loop_restart("llm.engine")
             if time.monotonic() - self._last_reap > 5.0:
                 self._reap_streams()
             if not did:
@@ -303,7 +308,7 @@ class LLMEngine:
             if not batch:
                 # Expired/cancelled entries were drained — progress.
                 return True
-            slots = free[:len(batch)]
+            slots = free[:len(batch)]  # slot-guard: _push_queued_locked,_finish_locked
         try:
             failpoints.hit("serve.llm.before_admit")
             self._prefill_batch(batch, slots)
@@ -317,7 +322,7 @@ class LLMEngine:
                         self._push_queued_locked(req)
         return True
 
-    def _prefill_batch(self, batch: List[_Request], slots: List[int]):
+    def _prefill_batch(self, batch: List[_Request], slots: List[int]):  # jax-hot-path
         np = self._np
         rows = self.prefill_rows
         p_len = self.max_prompt_len
@@ -332,7 +337,9 @@ class LLMEngine:
         first, self._cache = self._prefill_fn(
             self.params, self._cache, self._jnp.asarray(toks),
             self._jnp.asarray(slot_idx), self._jnp.asarray(lengths))
-        first = np.asarray(first)
+        # The one intentional sync per prefill: first tokens must reach
+        # the streams now.  # analyze: ignore[JX002]
+        first = np.asarray(first)  # analyze: ignore[JX002]
         now = time.time()
         _obs.record_decode_tokens(self._dep, len(batch))
         with self._lock:
@@ -353,7 +360,7 @@ class LLMEngine:
                 if req.remaining <= 0 or tok == self.eos_token:
                     self._finish_locked(req, done=True, slot=slot)
 
-    def _step_once(self) -> bool:
+    def _step_once(self) -> bool:  # jax-hot-path
         np = self._np
         with self._lock:
             now = time.time()
@@ -379,7 +386,9 @@ class LLMEngine:
             nxt, self._cache = self._step_fn(
                 self.params, self._cache, self._jnp.asarray(self._tokens),
                 self._jnp.asarray(self._pos))
-            nxt = np.asarray(nxt)  # blocks until the step lands
+            # The one intentional sync per decode step (tokens fan out
+            # to streams from host memory).  # analyze: ignore[JX002]
+            nxt = np.asarray(nxt)  # analyze: ignore[JX002]
         except BaseException:
             self._step_errors_row += 1
             self.stats_counters["errors"] += 1
@@ -645,4 +654,5 @@ class LLMEngine:
     def shutdown_engine(self) -> bool:
         self._stop = True
         self._wake.set()
+        _metrics.retract_loop_series(["llm.engine"])
         return True

@@ -26,6 +26,27 @@ Passes (rule-id prefix):
   ``failpoints.SITES``; every metric family emitted with exactly its
   declared tag keys and declared in the (grafana-feeding) registry;
   two-sided recorders observing locally AND buffering for replay.
+* ``retry`` (RT) — retried RPC call sites must target handlers
+  declared ``# idempotent`` (which must visibly absorb replays) or
+  consult ``maybe_executed``; bounded resubmits must narrow what they
+  retry (the PR-13 blind-resubmit / severed-2PC-commit class).
+* ``daemon-loop`` (DL) — forever-loops doing RPC/IO must survive
+  exceptions, and every survival handler must count into
+  ``ray_tpu_loop_restarts_total{loop}`` (a crash-restart cycle must
+  be visible on the scrape).
+* ``timeout-order`` (TO) — ``# timeout-budget: outlasts <ref>``
+  relations checked against config defaults: an inner RPC timeout can
+  never undercut the outer budget it serves (the PR-14
+  task-unblocked-kills-healthy-task shape).
+* ``jax-hotpath`` (JX) — unmarked-static jit scalars, host syncs and
+  sleepless poll spins in ``# jax-hot-path`` regions, fp32 upcasts in
+  ``# decode-path`` (activation-dtype) regions — the per-request
+  recompile / GIL-starvation throughput class PR 13's compile
+  counters guard at runtime.
+* ``lifecycle`` (LC) — per-entity gauge families must appear in a
+  retraction sweep; ship-buffer drains must requeue on upload
+  failure; ``# slot-guard`` declared acquire/release pairs must keep
+  their failure-edge release.
 
 Heuristic and precise-by-allowlist rather than sound-and-noisy: the
 committed ``ANALYZE_BASELINE.json`` allowlists justified findings so
@@ -53,6 +74,11 @@ from ray_tpu.util.analyze.core import (  # noqa: F401
 from ray_tpu.util.analyze import (  # noqa: F401,E402
     blocking,
     contracts,
+    daemon_loops,
     finalizers,
+    jax_hotpath,
+    lifecycle,
     lock_order,
+    retry,
+    timeouts,
 )

@@ -34,7 +34,12 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Tuple
 
-from ray_tpu.util.analyze.core import Finding, ParsedModule, analysis_pass
+from ray_tpu.util.analyze.core import (
+    Finding,
+    ParsedModule,
+    analysis_pass,
+    cross_pass,
+)
 
 _EMIT_METHODS = frozenset({"inc", "dec", "set", "observe", "remove"})
 _METRIC_ALIASES = frozenset({"metrics", "_metrics"})
@@ -142,6 +147,7 @@ def _hit_site_literals(tree: ast.Module) -> List[str]:
     return out
 
 
+@cross_pass("contracts")
 def stale_site_findings(modules) -> List[Finding]:
     """**CD002** — the reverse of CD001, checkable only with the whole
     tree in view (so it runs from ``analyze.run()`` on full scans, not

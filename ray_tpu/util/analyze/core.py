@@ -135,12 +135,28 @@ class FindingSink:
 # rule-family name -> pass callable
 PASSES: "Dict[str, Callable[[ParsedModule], Iterable[Finding]]]" = {}
 
+# rule-family name -> cross-module checker run only on FULL scans (the
+# whole tree must be in view: stale failpoint sites, gauge families
+# emitted in one module and retracted in another). Keyed by the same
+# family name as the per-module pass so --rule selection covers both.
+CROSS_PASSES: "Dict[str, Callable[[Sequence[ParsedModule]], Iterable[Finding]]]" = {}
+
 
 def analysis_pass(name: str):
     """Register a pass under a ``--rule`` family name."""
 
     def deco(fn):
         PASSES[name] = fn
+        return fn
+
+    return deco
+
+
+def cross_pass(name: str):
+    """Register a full-scan cross-module checker for a rule family."""
+
+    def deco(fn):
+        CROSS_PASSES[name] = fn
         return fn
 
     return deco
@@ -316,6 +332,15 @@ def rule_counts(findings: Sequence[Finding]) -> Dict[str, int]:
     return dict(sorted(counts.items()))
 
 
+def _cross_ignored(modules: Sequence[ParsedModule], f: Finding) -> bool:
+    """Honor line pragmas for cross-module findings too: the module the
+    finding anchors to is in view on a full scan by construction."""
+    for m in modules:
+        if m.relpath == f.path:
+            return m.ignored(f.rule, f.line)
+    return False
+
+
 def run(paths: Optional[Sequence[str]] = None,
         rules: Optional[Sequence[str]] = None,
         use_baseline: bool = True,
@@ -333,13 +358,14 @@ def run(paths: Optional[Sequence[str]] = None,
     modules = [m for m in (parse_file(p, root) for p in paths)
                if m is not None]
     findings = run_modules(modules, rules)
-    if full_scan and (not rules or "contracts" in rules):
-        # Cross-module check: needs the whole tree in view, so it only
-        # runs on full scans (a path-restricted run would report every
-        # site it didn't happen to look at as stale).
-        from ray_tpu.util.analyze.contracts import stale_site_findings
-
-        findings.extend(stale_site_findings(modules))
+    if full_scan:
+        # Cross-module checks: they need the whole tree in view, so
+        # they only run on full scans (a path-restricted run would
+        # report every site it didn't happen to look at as stale).
+        for name, fn in CROSS_PASSES.items():
+            if not rules or name in rules:
+                findings.extend(f for f in fn(modules)
+                                if not _cross_ignored(modules, f))
         findings.sort(key=lambda f: (f.path, f.line, f.rule, f.detail))
     if diff_rev:
         findings = filter_to_diff(findings, changed_lines(diff_rev, root))

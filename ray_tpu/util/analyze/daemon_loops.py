@@ -1,0 +1,248 @@
+"""Pass 7 — daemon-loop survivability (DL): one exception, one loop.
+
+Every plane of this system hangs off a handful of forever-loops —
+reaper, heartbeat, flusher, drain coordinator, leak sweeper, the LLM
+engine scheduler. PR 5 found (at soak time) that a partitioned head
+killed the agent's reap loop through one uncaught ``_store_task_error``;
+the PR-13 engine loop survives only because review added the blanket
+try/except by hand. This pass makes both halves of that discipline
+static:
+
+* **DL001** — a daemon loop body performs RPC/IO (``.call`` /
+  ``.call_stream``, sqlite ``commit``) outside any ``try`` *inside the
+  loop* whose handler survives the failure (catches a connection-ish
+  or broad exception without re-raising/breaking). One transient
+  network error permanently kills the thread — heartbeats stop, the
+  store never flushes again, and nothing restarts it.
+* **DL002** — a broad except handler inside a daemon loop swallows
+  without COUNTING: the loop survives, invisibly. Every survival
+  handler must tick ``ray_tpu_loop_restarts_total{loop}`` (the
+  ``metrics.count_loop_restart(<loop>)`` helper) so a loop stuck in a
+  crash-restart cycle shows on the federated scrape instead of
+  burning a core silently.
+
+A *daemon loop* is a ``while True`` / ``while not <stop-flag>`` loop
+inside a function that is (a) a ``threading.Thread`` target somewhere
+in the module, or (b) named like one (``*_loop`` / ``*_main`` or a
+``loop``/``flusher``/``monitor``/``sweeper``/``watcher``/``reaper``/
+``coordinator`` name). Bounded retry loops (``for``), and loops in
+ordinary request handlers, are out of scope — RT owns retries.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from ray_tpu.util.analyze.core import (
+    FindingSink,
+    ParsedModule,
+    analysis_pass,
+)
+from ray_tpu.util.analyze.resolver import (
+    _expr_calls,
+    callee_name,
+    receiver_of,
+)
+
+_LOOPY_NAME_PARTS = ("loop", "flusher", "monitor", "sweeper", "watcher",
+                     "reaper", "coordinator")
+_SURVIVAL_EXCEPTS = frozenset({
+    "", "Exception", "BaseException", "ConnectionLost", "OSError",
+    "IOError", "RpcError", "ConnectionError", "TimeoutError",
+})
+_BROAD_EXCEPTS = frozenset({"", "Exception", "BaseException"})
+
+
+def _thread_targets(tree: ast.Module) -> Set[str]:
+    """Leaf names handed to ``Thread(target=...)`` anywhere in the
+    module (``self._run`` -> ``_run``; bare closures by name too)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and callee_name(node) == "Thread"):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Attribute):
+                out.add(v.attr)
+            elif isinstance(v, ast.Name):
+                out.add(v.id)
+    return out
+
+
+def _is_daemon_fn(name: str, targets: Set[str]) -> bool:
+    if name in targets:
+        return True
+    low = name.lower()
+    if low.endswith(("_loop", "_main")):
+        return True
+    return any(part in low for part in _LOOPY_NAME_PARTS)
+
+
+def _is_forever_loop(node: ast.While) -> bool:
+    """``while True`` or ``while not <stop flag>`` — the daemon shape
+    (a ``while work:`` drain loop terminates on its own)."""
+    test = node.test
+    if isinstance(test, ast.Constant) and test.value is True:
+        return True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = test.operand
+        leaf = ""
+        if isinstance(inner, ast.Attribute):
+            leaf = inner.attr
+        elif isinstance(inner, ast.Name):
+            leaf = inner.id
+        elif isinstance(inner, ast.Call):
+            leaf = callee_name(inner)
+            recv = receiver_of(inner)
+            if leaf in ("is_set", "get") and isinstance(
+                    recv, ast.Attribute):
+                leaf = recv.attr
+        return "stop" in leaf.lower() or "shutdown" in leaf.lower() \
+            or "closed" in leaf.lower()
+    return False
+
+
+def _handler_types(handler: ast.ExceptHandler) -> Set[str]:
+    t = handler.type
+    if t is None:
+        return {""}
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out: Set[str] = set()
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.add(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.add(e.attr)
+    return out
+
+
+def _handler_guards(handler: ast.ExceptHandler) -> bool:
+    """For DL001 the handler protects the THREAD as long as it doesn't
+    unconditionally re-raise: break/return are controlled exits (the
+    loop ends on purpose), not a crash nothing restarts."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Raise):
+            return False
+    return True
+
+
+def _handler_reenters(handler: ast.ExceptHandler) -> bool:
+    """For DL002 the handler must RE-ENTER the iteration (swallow and
+    keep looping) for the restart counter to be owed: a handler that
+    exits the loop (raise/return/break on its only path) isn't a
+    survival point."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Raise, ast.Return, ast.Break)):
+            return False
+    return True
+
+
+def _handler_counts_restart(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Attribute) and \
+                "LOOP_RESTARTS" in node.attr:
+            return True
+        if isinstance(node, ast.Name) and "LOOP_RESTARTS" in node.id:
+            return True
+        if isinstance(node, ast.Call) and \
+                "loop_restart" in callee_name(node):
+            return True
+    return False
+
+
+def _is_io_call(node: ast.Call) -> bool:
+    name = callee_name(node)
+    if name in ("call", "call_stream"):
+        return receiver_of(node) is not None
+    if name == "commit":
+        return receiver_of(node) is not None
+    return False
+
+
+class _LoopScanner:
+    """Walk one daemon loop body tracking the guarding tries."""
+
+    def __init__(self, sink: FindingSink, scope: str,
+                 loop_name: str):
+        self.sink = sink
+        self.scope = scope
+        self.loop_name = loop_name
+
+    def scan(self, loop: ast.While) -> None:
+        self._walk(loop.body, guarded=False)
+
+    def _walk(self, stmts, guarded: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # deferred execution
+            if isinstance(stmt, ast.Try):
+                surviving = [
+                    h for h in stmt.handlers
+                    if _handler_types(h) & _SURVIVAL_EXCEPTS
+                    and _handler_guards(h)]
+                self._walk(stmt.body, guarded or bool(surviving))
+                for h in stmt.handlers:
+                    if _handler_types(h) & _BROAD_EXCEPTS \
+                            and _handler_reenters(h) \
+                            and not _handler_counts_restart(h):
+                        self.sink.emit(
+                            "DL002", h.lineno, self.scope,
+                            f"swallow:{h.lineno}",
+                            f"daemon loop {self.loop_name} survives an "
+                            f"exception here without counting it: a "
+                            f"crash-restart cycle in this loop is "
+                            f"invisible on the scrape (it just burns "
+                            f"a core)",
+                            "tick metrics.count_loop_restart("
+                            f"'{self.loop_name}') in the handler (the "
+                            "ray_tpu_loop_restarts_total family)")
+                    self._walk(h.body, guarded)
+                self._walk(stmt.orelse, guarded or bool(surviving))
+                self._walk(stmt.finalbody, guarded)
+                continue
+            # IO in this statement's own expressions (nested statements
+            # recurse below with their own guard state).
+            if not guarded:
+                for node in _expr_calls(stmt):
+                    if isinstance(node, ast.Call) and _is_io_call(node):
+                        self.sink.emit(
+                            "DL001", node.lineno, self.scope,
+                            f"io:{node.lineno}",
+                            f"RPC/IO in daemon loop {self.loop_name} "
+                            f"outside any surviving try/except: one "
+                            f"transient failure (a partitioned peer, a "
+                            f"reconnect blip) permanently kills this "
+                            f"thread and nothing restarts it",
+                            "wrap the loop body in try/except, count "
+                            "the failure via metrics.count_loop_"
+                            "restart(...), and continue")
+            if isinstance(stmt, (ast.While, ast.For)):
+                self._walk(stmt.body, guarded)
+                self._walk(stmt.orelse, guarded)
+            elif isinstance(stmt, ast.If):
+                self._walk(stmt.body, guarded)
+                self._walk(stmt.orelse, guarded)
+            elif isinstance(stmt, (ast.With,)):
+                self._walk(stmt.body, guarded)
+
+
+@analysis_pass("daemon-loop")
+def daemon_loop_pass(mod: ParsedModule) -> List:
+    sink = FindingSink(mod.relpath)
+    targets = _thread_targets(mod.tree)
+    model = mod.model()
+    for cm, fn, scope in model.functions():
+        if isinstance(fn, ast.AsyncFunctionDef):
+            continue  # asyncio loops have their own supervision story
+        if not _is_daemon_fn(fn.name, targets):
+            continue
+        loop_name = scope
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.While) and _is_forever_loop(stmt):
+                _LoopScanner(sink, scope, loop_name).scan(stmt)
+    return sink.findings

@@ -1,0 +1,293 @@
+"""Pass 9 — JAX hot-path lint (JX): the recompile/host-sync/GIL class.
+
+PR 13's engine carries trace-time compile counters precisely because
+the per-request-recompile bug is trivially easy to reintroduce and
+invisible until the bench runs: one stray Python-scalar jit argument,
+one host sync inside the step loop, one sleepless poll spin — each a
+throughput bug per the TPU-concurrency-limits framing (a GIL-starved
+engine loop measured 3x tokens/s). The declared regions make the
+discipline static:
+
+* ``# jax-hot-path`` on (or directly above) a ``def`` marks an
+  engine/decode-step region: code executed once per decode iteration
+  or traced into the jitted step.
+* ``# decode-path`` marks a function declared to stay in the model's
+  activation dtype (the KV-cache contract: bf16, no fp32 copy ever
+  materializes).
+
+Rules:
+
+* **JX001** — a callable jitted WITHOUT ``static_argnums``/
+  ``static_argnames`` is invoked with a Python int/bool literal
+  argument: every distinct value shape-specializes or retraces (and a
+  value meant to select branches/shapes silently recompiles per
+  request — the compile-counter claim breaks).
+* **JX002** — a host sync inside a ``# jax-hot-path`` region:
+  ``np.asarray`` / ``np.array`` / ``jax.device_get`` /
+  ``.block_until_ready()`` / ``.item()``. Each one stalls the Python
+  thread on the device stream mid-iteration; syncs belong at the
+  step boundary, once (mark the single intentional one with
+  ``# analyze: ignore[JX002]``).
+* **JX003** — a sleepless poll spin: a ``while`` loop that calls a
+  ``*poll*`` API with no ``time.sleep`` / ``.wait(...)`` / blocking
+  long-poll (timeout kwarg) anywhere in its body. A tight poll loop
+  on the GIL starves the engine thread (the measured 3x tokens/s
+  collector bug).
+* **JX004** — an fp32 upcast inside a ``# decode-path`` region:
+  ``float32`` mentioned in a region declared activation-dtype means a
+  2x HBM copy of cache-sized state (deliberate fp32 reductions live
+  OUTSIDE the declared region, or carry an ignore pragma with the
+  reason).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ray_tpu.util.analyze.core import (
+    FindingSink,
+    ParsedModule,
+    analysis_pass,
+)
+from ray_tpu.util.analyze.resolver import callee_name, receiver_of
+
+_HOT_MARK = "# jax-hot-path"
+_DECODE_MARK = "# decode-path"
+
+_HOST_SYNC_ATTRS = frozenset({"block_until_ready", "item"})
+_NP_ALIASES = frozenset({"np", "numpy", "onp"})
+
+
+def _marked(mod: ParsedModule, fn: ast.AST, mark: str) -> bool:
+    for ln in (fn.lineno, fn.lineno - 1):
+        if mark in mod.line_text(ln):
+            return True
+    # Decorated defs: the marker may sit above the decorator stack.
+    deco = getattr(fn, "decorator_list", None)
+    if deco:
+        top = min(d.lineno for d in deco)
+        if mark in mod.line_text(top - 1):
+            return True
+    return False
+
+
+def _jit_call(value: ast.expr) -> Optional[ast.Call]:
+    """The ``jax.jit(...)`` / ``jit(...)`` call in an assignment value
+    (None when it isn't one)."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn = value.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "jit":
+        return value
+    if isinstance(fn, ast.Name) and fn.id == "jit":
+        return value
+    return None
+
+
+def _jit_has_static(call: ast.Call) -> bool:
+    return any(kw.arg in ("static_argnums", "static_argnames")
+               for kw in call.keywords)
+
+
+def _scalar_literal_args(call: ast.Call) -> List[int]:
+    """Line numbers of Python int/bool literal args (positional or
+    keyword) — the unmarked-static recompile shape."""
+    out = []
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(a, ast.Constant) and isinstance(
+                a.value, (int, bool)) and not isinstance(a.value, float):
+            out.append(call.lineno)
+            break
+    return out
+
+
+def _nonzero_timeout_kwarg(call: ast.Call) -> bool:
+    """A timeout-ish kwarg that isn't literally zero (``wait(timeout=0)``
+    is exactly the non-blocking poll the spin rule exists to catch)."""
+    for kw in call.keywords:
+        if not (kw.arg and "timeout" in kw.arg):
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and v.value in (0, 0.0):
+            continue
+        return True
+    return False
+
+
+def _blocking_poll_exempt(call: ast.Call) -> bool:
+    """A poll call that itself blocks (carries a non-zero timeout-ish
+    kwarg) is a long-poll, not a spin."""
+    return _nonzero_timeout_kwarg(call)
+
+
+def _fn_has_block(fn: ast.AST) -> bool:
+    """The function's own body blocks somewhere: a sleep, a wait, or
+    any call with a timeout-ish kwarg (a queue.get(timeout=...) drain,
+    an owner long-poll). One level of this keeps the spin rule honest
+    about loops whose blocking lives in a helper."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = callee_name(node)
+        if name in ("sleep", "wait"):
+            return True
+        if _nonzero_timeout_kwarg(node):
+            return True
+    return False
+
+
+@analysis_pass("jax-hotpath")
+def jax_hotpath_pass(mod: ParsedModule) -> List:
+    sink = FindingSink(mod.relpath)
+    model = mod.model()
+
+    # -- JX001: jit-without-static + scalar-literal invocation ----------
+    # Collect jitted names (module/class/local assignments alike; keyed
+    # by leaf attr or bare name) that lack static arg declarations.
+    unstatic: Set[str] = set()
+    statics: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        jc = _jit_call(node.value)
+        if jc is None:
+            continue
+        for tgt in node.targets:
+            leaf = None
+            if isinstance(tgt, ast.Name):
+                leaf = tgt.id
+            elif isinstance(tgt, ast.Attribute):
+                leaf = tgt.attr
+            if leaf is None:
+                continue
+            (statics if _jit_has_static(jc) else unstatic).add(leaf)
+    unstatic -= statics  # a rebound name with statics gets the benefit
+    if unstatic:
+        parents: dict = {}
+        for node in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = None
+            if isinstance(node.func, ast.Name):
+                leaf = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                leaf = node.func.attr
+            if leaf not in unstatic:
+                continue
+            if _scalar_literal_args(node):
+                scope_node = node
+                path: List[str] = []
+                cur = parents.get(scope_node)
+                while cur is not None and not isinstance(
+                        cur, ast.Module):
+                    if isinstance(cur, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.ClassDef)):
+                        path.append(cur.name)
+                    cur = parents.get(cur)
+                scope = ".".join(reversed(path)) or "<module>"
+                sink.emit(
+                    "JX001", node.lineno, scope, leaf,
+                    f"jitted callable {leaf} (no static_argnums/"
+                    f"static_argnames on its jax.jit) is invoked with "
+                    f"a Python scalar literal: every distinct value "
+                    f"retraces/specializes — per-request recompile "
+                    f"risk (the compile-counter claim breaks)",
+                    "declare the scalar static in the jit (or pass a "
+                    "jnp array if it's genuinely data)")
+
+    # -- JX002/JX003/JX004: declared-region rules -----------------------
+    for cm, fn, scope in model.functions():
+        hot = _marked(mod, fn, _HOT_MARK)
+        decode = _marked(mod, fn, _DECODE_MARK)
+        if hot:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = callee_name(node)
+                recv = receiver_of(node)
+                is_sync = False
+                what = name
+                if name in _HOST_SYNC_ATTRS and recv is not None:
+                    is_sync = True
+                    what = f".{name}()"
+                elif name in ("asarray", "array") and isinstance(
+                        recv, ast.Name) and recv.id in _NP_ALIASES:
+                    is_sync = True
+                    what = f"{recv.id}.{name}"
+                elif name == "device_get":
+                    is_sync = True
+                    what = "jax.device_get"
+                if is_sync:
+                    sink.emit(
+                        "JX002", node.lineno, scope,
+                        f"{what}:{node.lineno}",
+                        f"host sync ({what}) inside the `# jax-hot-"
+                        f"path` region {scope}: the Python thread "
+                        f"stalls on the device stream mid-iteration — "
+                        f"a throughput bug before a correctness one",
+                        "hoist the sync to the step boundary (one sync "
+                        "per iteration, marked `# analyze: "
+                        "ignore[JX002]` with the reason)")
+        if decode:
+            for i in range(fn.lineno,
+                           getattr(fn, "end_lineno", fn.lineno) + 1):
+                text = mod.line_text(i)
+                if "float32" in text and _DECODE_MARK not in text:
+                    sink.emit(
+                        "JX004", i, scope, f"float32:{i}",
+                        f"fp32 upcast inside `# decode-path` region "
+                        f"{scope}: the region is declared to stay in "
+                        f"the activation dtype (the KV-cache contract "
+                        f"— no fp32 copy of cache-sized state)",
+                        "keep decode state in cfg.dtype; a deliberate "
+                        "fp32 reduction belongs outside the declared "
+                        "region or carries `# analyze: ignore[JX004]` "
+                        "with the reason")
+
+    # -- JX003: sleepless poll spins (any function) ---------------------
+    for cm, fn, scope in model.functions():
+        for loop in ast.walk(fn):
+            if not isinstance(loop, ast.While):
+                continue
+            poll_call = None
+            has_block = False
+            for node in ast.walk(loop):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                name = callee_name(node)
+                recv = receiver_of(node)
+                callee_fn = None
+                if cm is not None and isinstance(recv, ast.Name) \
+                        and recv.id == "self":
+                    callee_fn = cm.methods.get(name)
+                if name == "sleep" or name == "wait":
+                    has_block = True
+                elif callee_fn is not None and _fn_has_block(callee_fn):
+                    has_block = True  # helper blocks one level down
+                elif "poll" in name.lower():
+                    if _blocking_poll_exempt(node):
+                        has_block = True
+                    elif poll_call is None:
+                        poll_call = node
+                elif _nonzero_timeout_kwarg(node):
+                    has_block = True  # a long-poll bounds the spin
+            if poll_call is not None and not has_block:
+                sink.emit(
+                    "JX003", poll_call.lineno, scope,
+                    f"poll:{poll_call.lineno}",
+                    f"sleepless poll spin in {scope}: the loop polls "
+                    f"({callee_name(poll_call)}) with no sleep/wait/"
+                    f"long-poll anywhere in its body — on the GIL this "
+                    f"starves the engine thread (the measured 3x "
+                    f"tokens/s collector bug)",
+                    "add an inter-round time.sleep (50ms drains 10k "
+                    "streams fine) or use the blocking long-poll form")
+    return sink.findings

@@ -1,0 +1,218 @@
+"""Pass 10 — lifecycle discipline (LC): what's acquired must be freed.
+
+Three leak classes the PR-11..14 review rounds caught by hand, each
+with a structural signature:
+
+* **LC001** (cross-module, full scans) — a *per-entity* gauge family
+  (tag keys beyond ``node_id``: worker, rank, trial, pool, deployment,
+  device, ...) that some module emits (``set``/``inc``/``dec``) but NO
+  module ever retracts (``.remove(``). Dead workers/replicas/ranks
+  then stay on the federated scrape forever — the exact drift the
+  agent's retraction sweeps exist to prevent. Node-level gauges are
+  exempt (their series die with the node's registry).
+* **LC002** — a ship-buffer drain whose upload can fail must requeue:
+  a function that calls ``drain_events()`` and then performs an RPC
+  must reference ``requeue_events`` in an exception path. The
+  serve/goodput planes promise exact counts — a chaos-severed channel
+  silently dropping a drained batch breaks the cross-check benches.
+* **LC003** — a declared acquire/release pair: a line annotated
+  ``# slot-guard: <releaser>[,<releaser2>]`` (the engine's decode-slot
+  admission, a pool carve-out) requires a ``try`` in the same function
+  whose except/finally calls one of the named releasers. If review
+  removes the requeue/release edge, the declaration fails loud instead
+  of the slot leaking on the failure path.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set
+
+from ray_tpu.util.analyze.core import (
+    Finding,
+    FindingSink,
+    ParsedModule,
+    analysis_pass,
+    cross_pass,
+)
+from ray_tpu.util.analyze.resolver import callee_name, receiver_of
+
+_SLOT_GUARD_RE = re.compile(r"#\s*slot-guard:\s*([\w, ]+)")
+_EMIT_METHODS = frozenset({"set", "inc", "dec"})
+_NODE_LEVEL_TAGS = frozenset({"node_id"})
+
+
+def _gauge_families() -> Dict[str, tuple]:
+    """{family attr name: tag_keys} for registry Gauges with per-entity
+    tag dimensions (beyond node_id)."""
+    from ray_tpu.util import metrics as m
+
+    out = {}
+    for name, inst in vars(m).items():
+        if isinstance(inst, m.Gauge):
+            extra = set(inst.tag_keys) - _NODE_LEVEL_TAGS
+            if extra:
+                out[name] = tuple(inst.tag_keys)
+    return out
+
+
+def _family_method_refs(tree: ast.Module, families: Set[str],
+                        methods: frozenset) -> Dict[str, int]:
+    """{family: first line} where ``<alias>.FAMILY.<method>(...)`` or
+    ``FAMILY.<method>(...)`` appears."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in methods):
+            continue
+        base = node.func.value
+        fam = None
+        if isinstance(base, ast.Attribute) and base.attr.isupper():
+            fam = base.attr
+        elif isinstance(base, ast.Name) and base.id.isupper():
+            fam = base.id
+        if fam in families and fam not in out:
+            out[fam] = node.lineno
+    return out
+
+
+@cross_pass("lifecycle")
+def unretracted_gauge_findings(
+        modules: Sequence[ParsedModule]) -> List[Finding]:
+    """**LC001** — whole-tree join: per-entity gauge families emitted
+    somewhere must be retracted somewhere."""
+    families = _gauge_families()
+    fam_names = set(families)
+    emits: Dict[str, tuple] = {}   # family -> (relpath, line)
+    removes: Set[str] = set()
+    for mod in modules:
+        if mod.relpath.endswith("util/metrics.py"):
+            continue  # the registry itself (helpers touch every family)
+        for fam, line in _family_method_refs(
+                mod.tree, fam_names, _EMIT_METHODS).items():
+            emits.setdefault(fam, (mod.relpath, line))
+        for fam in _family_method_refs(
+                mod.tree, fam_names, frozenset({"remove"})):
+            removes.add(fam)
+    findings: List[Finding] = []
+    for fam in sorted(set(emits) - removes):
+        relpath, line = emits[fam]
+        tags = families[fam]
+        findings.append(Finding(
+            "LC001", relpath, line, "<module>", fam,
+            f"per-entity gauge family {fam} (tags {list(tags)}) is "
+            f"emitted here but no scanned module ever retracts it "
+            f"(.remove(...)): dead entities stay on the federated "
+            f"scrape forever",
+            "add the family to a retraction sweep (the agent's "
+            "worker-death / stop path) keyed by the entity tags"))
+    return findings
+
+
+def _fn_calls_named(fn: ast.AST, name: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and callee_name(node) == name:
+            return True
+    return False
+
+
+def _rpc_in(fn: ast.AST) -> Optional[int]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and callee_name(node) in ("call", "call_stream") \
+                and receiver_of(node) is not None:
+            return node.lineno
+    return None
+
+
+def _references(fn: ast.AST, name: str) -> bool:
+    """The function references ``name`` anywhere — the requeue may live
+    in an except handler (the classic shape) or on a bounded-resend
+    overflow path; total absence is the bug."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and callee_name(node) == name:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == name:
+            return True
+    return False
+
+
+@analysis_pass("lifecycle")
+def lifecycle_pass(mod: ParsedModule) -> List[Finding]:
+    sink = FindingSink(mod.relpath)
+    if "util/analyze/" in mod.relpath:
+        # The analyzer documents its own annotation grammar — those
+        # docstring examples are not declarations.
+        return sink.findings
+    model = mod.model()
+
+    # -- LC002: drain -> upload must requeue on failure -----------------
+    for cm, fn, scope in model.functions():
+        if not _fn_calls_named(fn, "drain_events"):
+            continue
+        rpc_line = _rpc_in(fn)
+        if rpc_line is None:
+            continue  # local consumption (tests, readers): no upload
+        if not _references(fn, "requeue_events"):
+            sink.emit(
+                "LC002", rpc_line, scope, "requeue_events",
+                f"{scope} drains a ship buffer and uploads it over RPC "
+                f"but never requeues on failure: a severed channel "
+                f"silently loses observations the plane promises to "
+                f"count exactly",
+                "requeue_events(<drained>) on the upload's failure "
+                "path (front of the buffer; overflow counts into the "
+                "drop counter) — or keep the batch and resend it under "
+                "its original dedup seq")
+
+    # -- LC003: declared slot-guard pairs -------------------------------
+    guards = {}  # line -> [releaser names]
+    for i, text in enumerate(mod.lines, 1):
+        m = _SLOT_GUARD_RE.search(text)
+        if m:
+            guards[i] = [s.strip() for s in m.group(1).split(",")
+                         if s.strip()]
+    if guards:
+        for cm, fn, scope in model.functions():
+            start = fn.lineno
+            end = getattr(fn, "end_lineno", fn.lineno)
+            mine = {ln: names for ln, names in guards.items()
+                    if start <= ln <= end}
+            if not mine:
+                continue
+            for ln, names in sorted(mine.items()):
+                guards.pop(ln, None)
+                ok = any(_handlers_or_finally_call(fn, name)
+                         for name in names)
+                if not ok:
+                    sink.emit(
+                        "LC003", ln, scope, ",".join(names),
+                        f"slot-guard declares that {' / '.join(names)} "
+                        f"releases this acquisition on failure, but no "
+                        f"try except/finally in {scope} calls it: the "
+                        f"slot leaks on the failure edge",
+                        "wrap the post-acquire region in try/except "
+                        "(or finally) that calls the declared releaser")
+        for ln, names in sorted(guards.items()):
+            sink.emit(
+                "LC003", ln, "<module>", ",".join(names),
+                "slot-guard annotation outside any function: the "
+                "declared release pair guards nothing",
+                "move the annotation onto the acquiring line inside "
+                "the function")
+    return sink.findings
+
+
+def _handlers_or_finally_call(fn: ast.AST, name: str) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Try):
+            continue
+        for body in [h.body for h in node.handlers] + [node.finalbody]:
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) \
+                            and callee_name(sub) == name:
+                        return True
+    return False

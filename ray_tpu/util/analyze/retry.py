@@ -1,0 +1,388 @@
+"""Pass 6 — retry/idempotence contracts (RT): the PR-11..13 class.
+
+Every subsystem shipped since the PG 2PC has needed a review round to
+catch the same bug: an RPC retried on connection loss whose handler
+was never built to absorb a replay. A severed reply is AMBIGUOUS — the
+peer may have executed the request (``maybe_executed=True`` on the
+``ConnectionLost``), so a blind resubmit forks the effect: a bundle
+reserved twice, a stream admitted twice holding two decode slots, a
+metrics batch double-counted. The declared contract this pass checks:
+
+* **RT001** — a *retried* RPC call site (``.call("<method>", ...)``
+  re-executed by a retry loop whose exception handler swallows the
+  failure) must either target a handler declared ``# idempotent`` on
+  its ``def rpc_<method>`` line, or the retry construct must consult
+  ``maybe_executed`` to separate ambiguous losses from safe ones.
+  Fan-out loops (the call references the loop variable — a different
+  target per iteration) are not retries and are exempt.
+* **RT002** — a handler declared ``# idempotent`` must actually show a
+  replay-absorb pattern: a membership test (``key in table`` early-ack
+  — the 2PC prepare shape), keyed last-write-wins stores, or
+  dedup helpers. A declared-idempotent handler that appends/increments
+  without any keying executes twice on replay — the declaration lies.
+* **RT003** — a resubmit-style retry loop (``for attempt in
+  range(n)`` around ``call_stream`` / a ``*submit*`` call) must narrow
+  the exceptions it retries: catching ``Exception`` retries
+  ``GetTimeoutError`` too, and a timed-out submit MAY have executed on
+  a wedged replica (the exact PR-13 blind-resubmit bug — a second
+  admission orphans a slot-holding stream). Handlers that re-``raise``
+  or ``break`` are not retries.
+
+The idempotent-handler table is built from ``# idempotent`` markers on
+``def rpc_*`` lines across the repo tree (cached) plus the module under
+analysis (so fixtures are self-contained), the same
+declared-intent-then-checked workflow as ``# guarded-by``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.util.analyze.core import (
+    FindingSink,
+    ParsedModule,
+    analysis_pass,
+)
+from ray_tpu.util.analyze.resolver import callee_name, receiver_of
+
+_IDEMPOTENT_DEF_RE = re.compile(
+    r"def\s+(rpc_)?(\w+)\s*\(.*#\s*idempotent\b")
+
+# Mutators that ABSORB a replay by construction (keyed overwrite /
+# explicit dedup) vs ones that compound per delivery.
+_ABSORB_CALLS = frozenset({"setdefault", "discard"})
+_COMPOUND_CALLS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "inc",
+    "push", "heappush", "put", "put_nowait",
+})
+
+_BROAD_EXCEPTS = frozenset({"Exception", "BaseException"})
+_RETRYABLE_EXCEPTS = _BROAD_EXCEPTS | frozenset({
+    "ConnectionLost", "OSError", "IOError", "RpcError", "RuntimeError",
+    "TimeoutError", "GetTimeoutError", "ActorError", "ConnectionError",
+})
+
+_repo_idempotent_cache: Optional[frozenset] = None
+
+
+_DEF_NAME_RE = re.compile(r"^\s*def\s+(rpc_)?(\w+)\s*\(")
+
+
+def _declared_idempotent(lines: List[str]) -> Set[str]:
+    """Handler METHOD names (``rpc_`` prefix stripped — the wire name a
+    ``.call()`` uses) declared ``# idempotent`` in this source: the
+    marker sits on the def line itself, or on its own line directly
+    above the def (both forms are honored by RT001 and RT002 alike)."""
+    out: Set[str] = set()
+    for i, text in enumerate(lines):
+        m = _IDEMPOTENT_DEF_RE.search(text)
+        if m:
+            out.add(m.group(2))
+            continue
+        if text.strip().startswith("# idempotent") \
+                and i + 1 < len(lines):
+            d = _DEF_NAME_RE.match(lines[i + 1])
+            if d:
+                out.add(d.group(2))
+    return out
+
+
+def repo_idempotent_table() -> frozenset:
+    """``# idempotent``-declared handler names across the package tree
+    (cached: the table changes only when source changes, and the
+    analyzer process is one run)."""
+    global _repo_idempotent_cache
+    if _repo_idempotent_cache is None:
+        from ray_tpu.util.analyze.core import default_paths
+
+        out: Set[str] = set()
+        for path in default_paths():
+            try:
+                with open(path, encoding="utf-8") as f:
+                    out |= _declared_idempotent(f.read().splitlines())
+            except OSError:
+                continue
+        _repo_idempotent_cache = frozenset(out)
+    return _repo_idempotent_cache
+
+
+def _rpc_method_literal(call: ast.Call) -> Optional[str]:
+    """The method-name literal of an ``x.call("m", ...)`` /
+    ``x.call_stream("m", ...)`` RPC (None = not that shape)."""
+    if callee_name(call) not in ("call", "call_stream"):
+        return None
+    if receiver_of(call) is None:
+        return None
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _loop_targets(loop: ast.AST) -> Set[str]:
+    if isinstance(loop, ast.For):
+        return _names_in(loop.target)
+    return set()
+
+
+def _always_exits(stmts: List[ast.stmt], break_exits: bool) -> bool:
+    """Every control path through these statements leaves the loop
+    under evaluation (raise / return — and ``break`` only when the
+    loop it breaks IS that loop). A conditional exit still falls
+    through on the other branch — that path retries."""
+    for stmt in stmts:
+        if isinstance(stmt, (ast.Raise, ast.Return)):
+            return True
+        if isinstance(stmt, ast.Break) and break_exits:
+            return True
+        if isinstance(stmt, ast.If):
+            if stmt.orelse and _always_exits(stmt.body, break_exits) \
+                    and _always_exits(stmt.orelse, break_exits):
+                return True
+        if isinstance(stmt, ast.Try):
+            if _always_exits(stmt.finalbody, break_exits):
+                return True
+    return False
+
+
+def _handler_retries(handler: ast.ExceptHandler,
+                     break_exits: bool = True) -> bool:
+    """A handler RETRIES the loop under evaluation when at least one
+    control path through it re-enters the iteration: ``continue`` or
+    plain fall-through. ``if attempt == 2: return False`` exits only
+    the LAST attempt — the earlier ones retry, which is what matters
+    for a blind-resubmit check. A ``break`` inside a nested fan-out
+    loop doesn't exit an OUTER retry loop (``break_exits=False``):
+    the 2PC prepare round aborts its fan-out, rolls back and re-runs
+    — every prepared node sees a replay."""
+    return not _always_exits(handler.body, break_exits)
+
+
+def _handler_types(handler: ast.ExceptHandler) -> Set[str]:
+    """Exception class names a handler catches ('' = bare except)."""
+    t = handler.type
+    if t is None:
+        return {""}
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out: Set[str] = set()
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.add(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.add(e.attr)
+    return out
+
+
+def _is_bounded_retry_loop(loop: ast.AST) -> bool:
+    """``for <v> in range(...)`` — the bounded-resubmit idiom."""
+    return (isinstance(loop, ast.For)
+            and isinstance(loop.iter, ast.Call)
+            and callee_name(loop.iter) == "range")
+
+
+def _mentions_maybe_executed(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr == "maybe_executed":
+            return True
+        if isinstance(n, ast.Constant) and n.value == "maybe_executed":
+            return True  # getattr(e, "maybe_executed", False)
+    return False
+
+
+def _scope_of(fn_stack: List[str]) -> str:
+    return ".".join(fn_stack) or "<module>"
+
+
+class _RetryWalker(ast.NodeVisitor):
+    """Find (loop, try, handler, rpc-call) retry constructs: an RPC
+    call is *retried* by loop L when some enclosing ``try`` INSIDE L
+    catches its failure with a handler that re-enters the iteration.
+    A try outside the loop (or a handler that raises/returns/breaks)
+    lets the failure escape — no retry, no finding."""
+
+    def __init__(self, mod: ParsedModule, sink: FindingSink,
+                 idempotent: frozenset):
+        self.mod = mod
+        self.sink = sink
+        self.idempotent = idempotent
+        self.scope_stack: List[str] = []
+        # (loop node, loop target names)
+        self.loop_stack: List[Tuple[ast.AST, Set[str]]] = []
+        # (loop depth at try entry, retrying handlers)
+        self.try_stack: List[Tuple[int, List[ast.ExceptHandler]]] = []
+
+    # -- scope bookkeeping -------------------------------------------------
+
+    def _walk_scoped(self, node, name: str):
+        self.scope_stack.append(name)
+        saved = (self.loop_stack, self.try_stack)
+        self.loop_stack, self.try_stack = [], []
+        self.generic_visit(node)
+        self.loop_stack, self.try_stack = saved
+        self.scope_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._walk_scoped(node, node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._walk_scoped(node, node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+    # -- retry-construct detection ----------------------------------------
+
+    def _enter_loop(self, node):
+        self.loop_stack.append((node, _loop_targets(node)))
+        self.generic_visit(node)
+        self.loop_stack.pop()
+
+    visit_For = _enter_loop
+    visit_While = _enter_loop
+
+    def visit_Try(self, node: ast.Try):
+        self.try_stack.append((len(self.loop_stack),
+                               list(node.handlers)))
+        for stmt in node.body:
+            self.visit(stmt)
+        self.try_stack.pop()
+        # Handler / else / finally bodies are not guarded by this try.
+        for h in node.handlers:
+            for stmt in h.body:
+                self.visit(stmt)
+        for stmt in node.orelse + node.finalbody:
+            self.visit(stmt)
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        method = _rpc_method_literal(node)
+        if method is None or not self.loop_stack:
+            return
+        scope = _scope_of(self.scope_stack)
+        # Innermost loop OUT: the first loop that retries this call
+        # (via a try inside it) and isn't a fan-out over it decides.
+        for depth in range(len(self.loop_stack), 0, -1):
+            loop, targets = self.loop_stack[depth - 1]
+            retrying = [
+                h for d, hs in self.try_stack if d >= depth
+                for h in hs
+                if _handler_retries(h, break_exits=(d == depth))
+                and _handler_types(h) & (_RETRYABLE_EXCEPTS | {""})]
+            if not retrying:
+                continue  # failures escape this loop — check outer
+            # Fan-out exemption: the call varies with the loop variable
+            # (a different peer per iteration) — nothing is re-sent.
+            if targets and (_names_in(node) & targets):
+                continue
+            guarded = _mentions_maybe_executed(loop)
+            if method not in self.idempotent and not guarded:
+                self.sink.emit(
+                    "RT001", node.lineno, scope, method,
+                    f"RPC {method!r} is retried by this loop (a "
+                    f"swallowing except handler re-enters the "
+                    f"iteration) but the handler is not declared "
+                    f"`# idempotent` and the retry never consults "
+                    f"maybe_executed: a lost REPLY resubmits a request "
+                    f"the peer may already have executed",
+                    "declare the handler idempotent (and make it "
+                    "absorb replays), or branch on maybe_executed "
+                    "before resubmitting")
+            # RT003: resubmit-style bounded retries must narrow what
+            # they retry — a broad catch retries timeouts, and a
+            # timed-out submit may have executed.
+            if _is_bounded_retry_loop(loop) and (
+                    callee_name(node) == "call_stream"
+                    or "submit" in method):
+                broad = [h for h in retrying
+                         if _handler_types(h) & (_BROAD_EXCEPTS
+                                                 | {""})]
+                if broad and not guarded:
+                    self.sink.emit(
+                        "RT003", node.lineno, scope, method,
+                        f"bounded resubmit of {method!r} retries on a "
+                        f"broad exception catch: a timeout/wedged-peer "
+                        f"failure MAY have executed the submit, and "
+                        f"the blind resubmit double-admits (the PR-13 "
+                        f"orphaned-decode-slot shape)",
+                        "narrow the retried exceptions to dead-peer "
+                        "cases (ActorError / empty-table) and re-raise "
+                        "ambiguous ones (GetTimeoutError)")
+            break
+
+
+def _absorbs_replay(fn: ast.AST) -> bool:
+    """A replay-absorb pattern is visible: membership test, keyed
+    overwrite, or dedup helper."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+            return True
+        if isinstance(node, ast.Call) and \
+                callee_name(node) in _ABSORB_CALLS:
+            return True
+        if isinstance(node, ast.Call) and "duplicate" in \
+                callee_name(node).lower():
+            return True
+    return False
+
+
+def _compounds_state(fn: ast.AST) -> Optional[int]:
+    """Line of the first mutation that COMPOUNDS per delivery (append /
+    +=-style), or None. Keyed subscript stores are last-write-wins and
+    don't count."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            continue
+        if isinstance(node, ast.AugAssign):
+            return node.lineno
+        if isinstance(node, ast.Call) and \
+                callee_name(node) in _COMPOUND_CALLS:
+            return node.lineno
+    return None
+
+
+@analysis_pass("retry")
+def retry_pass(mod: ParsedModule) -> List:
+    sink = FindingSink(mod.relpath)
+    local = _declared_idempotent(mod.lines)
+    # Skip the repo sweep for out-of-tree fixtures rooted elsewhere —
+    # relpath escaping the package means a test tmpdir.
+    table = frozenset(local) | (
+        repo_idempotent_table()
+        if not mod.relpath.startswith("..") else frozenset())
+    _RetryWalker(mod, sink, table).visit(mod.tree)
+
+    # RT002 — declared-idempotent handlers must absorb replays.
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        text = mod.line_text(node.lineno)
+        # The marker may sit on the def line or the line above it.
+        above = mod.line_text(node.lineno - 1).strip()
+        marked = "# idempotent" in text or above.startswith(
+            "# idempotent")
+        if not marked:
+            continue
+        compound_line = _compounds_state(node)
+        if compound_line is not None and not _absorbs_replay(node):
+            sink.emit(
+                "RT002", compound_line, node.name, node.name,
+                f"handler {node.name} is declared `# idempotent` but "
+                f"compounds state per delivery (append/+= at line "
+                f"{compound_line}) with no visible replay-absorb "
+                f"pattern (membership early-ack, keyed overwrite, "
+                f"dedup helper): a replayed request executes twice",
+                "absorb replays (check a key before acting, or key the "
+                "write) — or drop the declaration and guard callers "
+                "with maybe_executed")
+    return sink.findings

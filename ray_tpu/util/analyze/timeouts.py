@@ -1,0 +1,215 @@
+"""Pass 8 — timeout-budget ordering (TO): nested budgets, checked.
+
+The PR-14 bug shape: the worker's ``task_unblocked`` RPC timeout (60s)
+sat INSIDE the agent's 300s CPU re-acquire budget — on a saturated node
+the agent was still legitimately waiting when the worker declared the
+call dead and killed a healthy task. Nested timeouts form a contract
+(the outer budget only works if every inner timeout outlasts it), but
+the two constants usually live in different files and nothing relates
+them — until one is edited.
+
+The annotation makes the relation machine-checked::
+
+    self.agent.call("task_unblocked", wid,
+                    # timeout-budget: outlasts config.cpu_reacquire_budget_s
+                    timeout=config.cpu_reacquire_budget_s + 30.0)
+
+* **TO001** — the declared relation fails on defaults: the annotated
+  call's ``timeout=`` value does not STRICTLY exceed the referenced
+  budget (resolved against ``ray_tpu.core.config`` defaults, module
+  constants and literal arithmetic). Equality counts as a violation —
+  an inner timeout that expires exactly at the budget races it.
+* **TO002** — the annotation can't be checked: no ``timeout``-like
+  kwarg on the annotated call, an unknown ``config.<knob>``, or a
+  value the resolver can't fold (dynamic expression). Declared intent
+  that can't be verified is drift, same contract as GB002.
+
+Resolvable value forms: numeric literals, ``config.<knob>`` (the
+registry default), module-level ``CONST = <number>`` assignments,
+``+ - * /`` arithmetic and ``max()``/``min()`` over resolvables.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from ray_tpu.util.analyze.core import (
+    FindingSink,
+    ParsedModule,
+    analysis_pass,
+)
+
+_BUDGET_RE = re.compile(r"#\s*timeout-budget:\s*outlasts\s+(\S+)")
+_TIMEOUT_KWARGS = ("timeout", "timeout_s", "deadline_s")
+
+
+def _module_consts(tree: ast.Module) -> dict:
+    out = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            out[stmt.targets[0].id] = stmt.value
+    return out
+
+
+def _config_default(knob: str) -> Optional[float]:
+    from ray_tpu.core.config import _DEFS
+
+    entry = _DEFS.get(knob)
+    if entry is None:
+        return None
+    typ, default = entry
+    if typ in (int, float):
+        return float(default)
+    return None
+
+
+def resolve_value(expr: ast.expr, consts: dict,
+                  depth: int = 0) -> Optional[float]:
+    """Fold a timeout expression to a float using literals, module
+    constants and config defaults; None = not statically resolvable."""
+    if depth > 6:
+        return None
+    if isinstance(expr, ast.Constant) and isinstance(
+            expr.value, (int, float)) and not isinstance(
+            expr.value, bool):
+        return float(expr.value)
+    if isinstance(expr, ast.Name):
+        bound = consts.get(expr.id)
+        if bound is not None:
+            return resolve_value(bound, consts, depth + 1)
+        return None
+    if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name) and expr.value.id == "config":
+        return _config_default(expr.attr)
+    if isinstance(expr, ast.BinOp):
+        left = resolve_value(expr.left, consts, depth + 1)
+        right = resolve_value(expr.right, consts, depth + 1)
+        if left is None or right is None:
+            return None
+        if isinstance(expr.op, ast.Add):
+            return left + right
+        if isinstance(expr.op, ast.Sub):
+            return left - right
+        if isinstance(expr.op, ast.Mult):
+            return left * right
+        if isinstance(expr.op, ast.Div) and right != 0:
+            return left / right
+        return None
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+            and expr.func.id in ("max", "min") and expr.args:
+        vals = [resolve_value(a, consts, depth + 1) for a in expr.args]
+        if any(v is None for v in vals):
+            return None
+        return max(vals) if expr.func.id == "max" else min(vals)
+    return None
+
+
+def _parse_budget_ref(ref: str, consts: dict) -> Optional[float]:
+    """Resolve the annotation's referenced budget: a number,
+    ``config.<knob>``, or a module constant name."""
+    try:
+        return float(ref)
+    except ValueError:
+        pass
+    if ref.startswith("config."):
+        return _config_default(ref.split(".", 1)[1])
+    bound = consts.get(ref)
+    if bound is not None:
+        return resolve_value(bound, consts)
+    return None
+
+
+def _call_timeout_expr(call: ast.Call) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg in _TIMEOUT_KWARGS:
+            return kw.value
+    return None
+
+
+def _scope_of(node: ast.AST, parents: dict) -> str:
+    path: List[str] = []
+    cur = node
+    while cur is not None and not isinstance(cur, ast.Module):
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            path.append(cur.name)
+        cur = parents.get(cur)
+    return ".".join(reversed(path)) or "<module>"
+
+
+@analysis_pass("timeout-order")
+def timeout_order_pass(mod: ParsedModule) -> List:
+    sink = FindingSink(mod.relpath)
+    if "util/analyze/" in mod.relpath:
+        # The analyzer documents its own annotation grammar — those
+        # docstring examples are not declarations (same exemption the
+        # contracts pass gives failpoints.py's docstring).
+        return sink.findings
+    annotations = {}  # line -> budget ref string
+    for i, text in enumerate(mod.lines, 1):
+        m = _BUDGET_RE.search(text)
+        if m:
+            annotations[i] = m.group(1)
+    if not annotations:
+        return sink.findings
+
+    consts = _module_consts(mod.tree)
+    parents: dict = {}
+    for node in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    matched: set = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        end = getattr(node, "end_lineno", node.lineno)
+        lines_hit = [ln for ln in annotations
+                     if node.lineno <= ln <= end and ln not in matched]
+        if not lines_hit:
+            continue
+        timeout_expr = _call_timeout_expr(node)
+        if timeout_expr is None:
+            continue  # an enclosing call may still carry the kwarg
+        scope = _scope_of(node, parents)
+        for ln in lines_hit:
+            matched.add(ln)
+            ref = annotations[ln]
+            outer = _parse_budget_ref(ref, consts)
+            inner = resolve_value(timeout_expr, consts)
+            if outer is None or inner is None:
+                which = f"budget ref {ref!r}" if outer is None \
+                    else "timeout value"
+                sink.emit(
+                    "TO002", ln, scope, ref,
+                    f"# timeout-budget annotation can't be checked: "
+                    f"the {which} doesn't resolve statically (config "
+                    f"defaults, module constants and literal "
+                    f"arithmetic are the supported forms)",
+                    "reference a config.<knob> / module constant / "
+                    "number, and keep the timeout= expression foldable")
+            elif inner <= outer:
+                sink.emit(
+                    "TO001", ln, scope, f"{ref}:{inner:g}",
+                    f"inner timeout {inner:g}s does not outlast the "
+                    f"declared outer budget {ref} = {outer:g}s: the "
+                    f"caller declares the wait dead while the budget "
+                    f"it serves is still legitimately running (the "
+                    f"task_unblocked-kills-healthy-task shape)",
+                    f"raise the timeout above {outer:g}s (derive it "
+                    f"from the budget constant so they can't drift "
+                    f"apart again)")
+
+    for ln, ref in sorted(annotations.items()):
+        if ln not in matched:
+            sink.emit(
+                "TO002", ln, "<module>", ref,
+                "# timeout-budget annotation is not attached to any "
+                "call with a timeout= / timeout_s= / deadline_s= "
+                "kwarg: the declared relation guards nothing",
+                "put the annotation on the line range of the call "
+                "whose timeout serves the budget")
+    return sink.findings

@@ -573,6 +573,40 @@ RPC_RECONNECTS_TOTAL = Counter(
     tag_keys=("peer",),
 )
 
+# -- daemon-loop survivability (every forever-loop's survival handler
+# ticks this when it swallows an exception and re-enters the iteration;
+# the DL002 static rule enforces the discipline. A loop stuck in a
+# crash-restart cycle shows as a climbing series instead of silently
+# burning a core; components retract their loop children on stop so a
+# dead node's loops leave the federated scrape).
+LOOP_RESTARTS_TOTAL = Counter(
+    "ray_tpu_loop_restarts_total",
+    "Exceptions a daemon loop survived (swallowed and re-entered the "
+    "iteration), by loop name",
+    tag_keys=("loop",),
+)
+
+
+def count_loop_restart(loop: str) -> None:
+    """One survived daemon-loop exception. Never raises: the survival
+    handler calling this is the last line of defense for its loop, and
+    a metrics failure must not become the exception that kills it."""
+    try:
+        LOOP_RESTARTS_TOTAL.inc(tags={"loop": loop})
+    except Exception:
+        pass
+
+
+def retract_loop_series(loops: Sequence[str]) -> None:
+    """Drop the loop-restart children a stopping component owns (agent
+    stop, engine shutdown) so dead nodes' loops vanish from the
+    federated scrape. Never raises (stop paths call it)."""
+    for loop in loops:
+        try:
+            LOOP_RESTARTS_TOTAL.remove(tags={"loop": loop})
+        except Exception:
+            pass
+
 # -- object store / memory observability (agent-side per-node occupancy
 # sampled from the shm store's native stats; the head observes object
 # lifetimes into the age histogram as the ref-counter frees them, and

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Pre-push wrapper around `ray-tpu analyze --diff`: fail the push when
+# the outgoing commits introduce NEW analyzer findings (lock-order,
+# blocking-under-lock, finalizer, async-lock, contract drift, retry/
+# idempotence, daemon-loop, timeout-ordering, JAX hot-path, lifecycle).
+#
+# Install:
+#   ln -s ../../scripts/analyze_hook.sh .git/hooks/pre-push
+# or run ad hoc before pushing:
+#   scripts/analyze_hook.sh [upstream-rev]
+#
+# The diff base defaults to @{upstream} (falling back to origin/main,
+# then HEAD~1) so the gate sees exactly the lines this push adds —
+# pre-existing findings stay the full repo-wide run's business
+# (tests/test_static_analysis.py keeps that clean in tier-1).
+set -u
+
+repo_root="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$repo_root" || exit 2
+
+base="${1:-}"
+if [ -z "$base" ]; then
+    if git rev-parse --verify -q '@{upstream}' >/dev/null 2>&1; then
+        base='@{upstream}'
+    elif git rev-parse --verify -q origin/main >/dev/null 2>&1; then
+        base=origin/main
+    else
+        base=HEAD~1
+    fi
+fi
+
+echo "analyze_hook: checking lines changed since ${base}" >&2
+python -m ray_tpu.scripts.analyze --diff "$base"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "analyze_hook: push blocked — fix the findings above (or" >&2
+    echo "justify them in ANALYZE_BASELINE.json / an inline pragma" >&2
+    echo "with a reason; head.py lock-order and blocking findings" >&2
+    echo "must be fixed, never baselined)." >&2
+fi
+exit "$rc"

@@ -444,6 +444,498 @@ def test_blocking_in_nested_closure(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# The round-15 pass families: RT / DL / TO / JX / LC seeded regressions.
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_pr13_blind_resubmit_rt(tmp_path):
+    """The EXACT PR-13 shape: a bounded submit retry catching broadly —
+    a timed-out submit MAY have executed on a wedged replica, so the
+    blind resubmit double-admits (RT001 + RT003)."""
+    findings = _scan(tmp_path, """\
+        import time
+
+
+        def stream_call(backend, args):
+            for attempt in range(3):
+                try:
+                    return backend.call("llm_submit", args, timeout=60.0)
+                except Exception:
+                    time.sleep(0.2 * (attempt + 1))
+        """)
+    assert any(f.rule == "RT001" and f.detail == "llm_submit"
+               for f in findings), _rules(findings)
+    assert any(f.rule == "RT003" for f in findings), _rules(findings)
+    # Narrowed guard + maybe_executed branch: clean.
+    clean = _scan(tmp_path, """\
+        import time
+
+
+        def stream_call(backend, args):
+            for attempt in range(3):
+                try:
+                    return backend.call("llm_submit", args, timeout=60.0)
+                except Exception as e:
+                    if getattr(e, "maybe_executed", False):
+                        raise
+                    time.sleep(0.2 * (attempt + 1))
+        """, name="ok.py")
+    assert not [f for f in clean if f.rule.startswith("RT")]
+
+
+def test_rt_idempotent_declaration_and_fanout_exemption(tmp_path):
+    """A same-module `# idempotent` handler satisfies RT001; a fan-out
+    loop (call references the loop variable) is never a retry."""
+    findings = _scan(tmp_path, """\
+        class Head:
+            def commit_all(self, nodes, pg_id):
+                for bi in range(3):
+                    for attempt in range(3):
+                        try:
+                            self.node.call("commit_bundle", pg_id, bi)
+                            break
+                        except Exception:
+                            if attempt == 2:
+                                return False
+                    # fall through: next attempt replays the commit
+
+            def fanout(self, nodes):
+                for n in nodes:
+                    try:
+                        n.client.call("free_object", "oid")
+                    except Exception:
+                        continue
+
+
+        class Agent:
+            def rpc_commit_bundle(self, pg_id, bi):  # idempotent
+                if (pg_id, bi) in self._bundles:
+                    self._state[(pg_id, bi)] = "COMMITTED"
+                return True
+        """)
+    rt = [f for f in findings if f.rule == "RT001"]
+    # commit_bundle is declared idempotent in-module; the fan-out loop
+    # references its loop variable. 'bi' in commit_all's outer loop IS
+    # referenced by the call -> fan-out there too; the `for attempt`
+    # loop is the retry but the handler is declared. Nothing fires.
+    assert not rt, [(f.detail, f.scope) for f in rt]
+
+
+def test_rt002_declared_idempotent_must_absorb(tmp_path):
+    findings = _scan(tmp_path, """\
+        class Agent:
+            def rpc_track(self, item):  # idempotent
+                self._log.append(item)
+                return True
+        """)
+    assert any(f.rule == "RT002" for f in findings), _rules(findings)
+    # The above-the-def marker form is honored by BOTH halves: RT002
+    # scrutiny AND the RT001 idempotent table (a declaration must never
+    # be half-honored).
+    from ray_tpu.util.analyze.retry import _declared_idempotent
+
+    src = textwrap.dedent("""\
+        class Agent:
+            # idempotent
+            def rpc_above(self, key):
+                if key in self._seen:
+                    return True
+                self._seen[key] = True
+                return True
+        """)
+    assert "above" in _declared_idempotent(src.splitlines())
+    above = _scan(tmp_path, """\
+        class Agent:
+            # idempotent
+            def rpc_above(self, key):
+                self._log.append(key)
+                return True
+        """, name="above.py")
+    assert any(f.rule == "RT002" for f in above)
+    clean = _scan(tmp_path, """\
+        class Agent:
+            def rpc_track(self, key, item):  # idempotent
+                if key in self._seen:
+                    return True
+                self._log.append(item)
+                return True
+        """, name="ok.py")
+    assert not [f for f in clean if f.rule == "RT002"]
+
+
+def test_seeded_bare_reaper_loop_dl(tmp_path):
+    """A bare daemon loop doing RPC: one exception kills the thread
+    (DL001); a swallowing survival handler must count (DL002)."""
+    findings = _scan(tmp_path, """\
+        import time
+
+
+        class Agent:
+            def _reap_loop(self):
+                while True:
+                    time.sleep(1.0)
+                    self.head.call("report_corpses", self.node_id)
+        """)
+    assert any(f.rule == "DL001" for f in findings), _rules(findings)
+    swallowing = _scan(tmp_path, """\
+        import time
+
+
+        class Agent:
+            def _reap_loop(self):
+                while True:
+                    time.sleep(1.0)
+                    try:
+                        self.head.call("report_corpses", self.node_id)
+                    except Exception:
+                        pass
+        """, name="swallow.py")
+    assert any(f.rule == "DL002" for f in swallowing)
+    assert not [f for f in swallowing if f.rule == "DL001"]
+    counted = _scan(tmp_path, """\
+        import time
+
+        from ray_tpu.util import metrics
+
+
+        class Agent:
+            def _reap_loop(self):
+                while True:
+                    time.sleep(1.0)
+                    try:
+                        self.head.call("report_corpses", self.node_id)
+                    except Exception:
+                        metrics.count_loop_restart("agent.reap")
+        """, name="counted.py")
+    assert not [f for f in counted if f.rule.startswith("DL")]
+
+
+def test_seeded_timeout_inversion_to(tmp_path):
+    """The PR-14 pair: a 60s RPC timeout declared to outlast a 300s
+    budget fails TO001; deriving it from the budget passes."""
+    findings = _scan(tmp_path, """\
+        REACQUIRE_BUDGET_S = 300.0
+
+
+        def hook(agent, wid):
+            agent.call("task_unblocked", wid,
+                       # timeout-budget: outlasts REACQUIRE_BUDGET_S
+                       timeout=60.0)
+        """)
+    to = [f for f in findings if f.rule == "TO001"]
+    assert len(to) == 1 and "60" in to[0].detail
+    clean = _scan(tmp_path, """\
+        REACQUIRE_BUDGET_S = 300.0
+
+
+        def hook(agent, wid):
+            agent.call("task_unblocked", wid,
+                       # timeout-budget: outlasts REACQUIRE_BUDGET_S
+                       timeout=REACQUIRE_BUDGET_S + 30.0)
+        """, name="ok.py")
+    assert not [f for f in clean if f.rule.startswith("TO")]
+    # config.<knob> budgets resolve against the live registry defaults.
+    cfgcase = _scan(tmp_path, """\
+        def hook(agent, wid):
+            agent.call("task_unblocked", wid,
+                       # timeout-budget: outlasts config.cpu_reacquire_budget_s
+                       timeout=60.0)
+        """, name="cfg.py")
+    assert any(f.rule == "TO001" for f in cfgcase)
+    # Unresolvable budget ref / detached annotation -> TO002 drift.
+    drift = _scan(tmp_path, """\
+        def hook(agent, wid):
+            agent.call("task_unblocked", wid,
+                       # timeout-budget: outlasts config.no_such_knob
+                       timeout=60.0)
+
+
+        # timeout-budget: outlasts 10.0
+        x = 1
+        """, name="drift.py")
+    assert len([f for f in drift if f.rule == "TO002"]) == 2
+
+
+def test_seeded_unmarked_static_jit_scalar_jx(tmp_path):
+    findings = _scan(tmp_path, """\
+        import jax
+
+
+        def build(fn, x):
+            step = jax.jit(fn)
+            return step(x, 5)
+        """)
+    jx = [f for f in findings if f.rule == "JX001"]
+    assert len(jx) == 1 and jx[0].detail == "step"
+    clean = _scan(tmp_path, """\
+        import jax
+
+
+        def build(fn, x):
+            step = jax.jit(fn, static_argnums=(1,))
+            return step(x, 5)
+        """, name="ok.py")
+    assert not [f for f in clean if f.rule == "JX001"]
+
+
+def test_jx_host_sync_and_decode_dtype_regions(tmp_path):
+    findings = _scan(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+
+        def step_once(engine):  # jax-hot-path
+            out = engine.step()
+            host = np.asarray(out)
+            out.block_until_ready()
+            return host
+
+
+        def init_cache(cfg, slots):  # decode-path
+            return jnp.zeros((slots, 64), jnp.float32)
+
+
+        def unmarked(engine):
+            return np.asarray(engine.step())
+        """)
+    jx2 = [f for f in findings if f.rule == "JX002"]
+    assert len(jx2) == 2, [(f.detail) for f in jx2]
+    assert all(f.scope == "step_once" for f in jx2)  # unmarked exempt
+    jx4 = [f for f in findings if f.rule == "JX004"]
+    assert len(jx4) == 1 and jx4[0].scope == "init_cache"
+
+
+def test_jx_sleepless_poll_spin(tmp_path):
+    findings = _scan(tmp_path, """\
+        def collect(handle, rids):
+            out = {}
+            while rids:
+                got = handle.llm_poll(rids)
+                out.update(got)
+            return out
+        """)
+    assert any(f.rule == "JX003" for f in findings), _rules(findings)
+    clean = _scan(tmp_path, """\
+        import time
+
+
+        def collect(handle, rids):
+            out = {}
+            while rids:
+                got = handle.llm_poll(rids)
+                out.update(got)
+                time.sleep(0.05)
+            return out
+        """, name="ok.py")
+    assert not [f for f in clean if f.rule == "JX003"]
+    # Blocking lives one level down in a self-helper: exempt.
+    helper = _scan(tmp_path, """\
+        class Runner:
+            def _drain(self):
+                return self.queue.get(timeout=0.2)
+
+            def run(self):
+                while True:
+                    self._drain()
+                    self._poll_completions()
+
+            def _poll_completions(self):
+                pass
+        """, name="helper.py")
+    assert not [f for f in helper if f.rule == "JX003"]
+
+
+def test_seeded_unretracted_gauge_lc001(tmp_path):
+    """A per-entity gauge family emitted with no retraction anywhere in
+    the scanned tree — the dead-replica-forever drift."""
+    from ray_tpu.util.analyze import lifecycle
+
+    p = tmp_path / "emit.py"
+    p.write_text(textwrap.dedent("""\
+        from ray_tpu.util import metrics as _metrics
+
+
+        def record(trial, rank, sec):
+            _metrics.TRAIN_RANK_STEP_SECONDS.set(
+                sec, tags={"node_id": "n", "trial": trial,
+                           "rank": str(rank)})
+        """))
+    mod = acore.parse_file(str(p), root=str(tmp_path))
+    findings = lifecycle.unretracted_gauge_findings([mod])
+    assert any(f.rule == "LC001"
+               and f.detail == "TRAIN_RANK_STEP_SECONDS"
+               for f in findings), [f.detail for f in findings]
+    # A retraction sweep anywhere in view clears it.
+    q = tmp_path / "retract.py"
+    q.write_text(textwrap.dedent("""\
+        from ray_tpu.util import metrics as _metrics
+
+
+        def retract(trial, rank):
+            _metrics.TRAIN_RANK_STEP_SECONDS.remove(
+                tags={"node_id": "n", "trial": trial,
+                      "rank": str(rank)})
+        """))
+    mod2 = acore.parse_file(str(q), root=str(tmp_path))
+    findings2 = lifecycle.unretracted_gauge_findings([mod, mod2])
+    assert not [f for f in findings2
+                if f.detail == "TRAIN_RANK_STEP_SECONDS"]
+
+
+def test_lc002_drain_without_requeue(tmp_path):
+    findings = _scan(tmp_path, """\
+        def flush_loop(agent, obs):
+            while True:
+                events = obs.drain_events()
+                try:
+                    agent.call("worker_events", events)
+                except Exception:
+                    pass
+        """)
+    assert any(f.rule == "LC002" for f in findings), _rules(findings)
+    clean = _scan(tmp_path, """\
+        def flush_loop(agent, obs):
+            while True:
+                events = obs.drain_events()
+                try:
+                    agent.call("worker_events", events)
+                except Exception:
+                    obs.requeue_events(events)
+        """, name="ok.py")
+    assert not [f for f in clean if f.rule == "LC002"]
+
+
+def test_lc003_slot_guard_release_edge(tmp_path):
+    findings = _scan(tmp_path, """\
+        class Engine:
+            def admit(self, batch, free):
+                slots = free[:len(batch)]  # slot-guard: _requeue
+                self._prefill(batch, slots)
+        """)
+    lc3 = [f for f in findings if f.rule == "LC003"]
+    assert len(lc3) == 1 and lc3[0].detail == "_requeue"
+    clean = _scan(tmp_path, """\
+        class Engine:
+            def admit(self, batch, free):
+                slots = free[:len(batch)]  # slot-guard: _requeue
+                try:
+                    self._prefill(batch, slots)
+                except Exception:
+                    self._requeue(batch)
+        """, name="ok.py")
+    assert not [f for f in clean if f.rule == "LC003"]
+
+
+def test_new_rule_pragma_baseline_and_diff_workflows(tmp_path):
+    """The pragma/baseline/diff machinery covers the new families the
+    same way it covers PR-10's."""
+    src = """\
+        import time
+
+
+        def resubmit(backend, args):
+            for attempt in range(3):
+                try:
+                    return backend.call("llm_submit", args)
+                except Exception:
+                    time.sleep(0.1)
+        """
+    # Inline ignore silences exactly the pragma'd rule.
+    pragma = textwrap.dedent(src).replace(
+        'backend.call("llm_submit", args)',
+        'backend.call("llm_submit", args)  '
+        '# analyze: ignore[RT001,RT003]')
+    p = tmp_path / "m.py"
+    p.write_text(pragma)
+    res = analyze.run(paths=[str(p)], use_baseline=False,
+                      root=str(tmp_path))
+    assert not [f for f in res["new"] if f.rule.startswith("RT")]
+    # Baseline allowlists the stable key.
+    p.write_text(textwrap.dedent(src))
+    res = analyze.run(paths=[str(p)], use_baseline=False,
+                      root=str(tmp_path))
+    keys = {f.key for f in res["new"]}
+    assert keys, "expected RT findings"
+    bl = tmp_path / "ANALYZE_BASELINE.json"
+    bl.write_text(json.dumps(
+        {"entries": {k: "justified in test" for k in keys}}))
+    res2 = analyze.run(paths=[str(p)], baseline_file=str(bl),
+                       root=str(tmp_path))
+    assert res2["ok"] and len(res2["allowed"]) == len(keys)
+    # Diff mode: only the lines a PR touched fire.
+    subprocess.run(["git", "init", "-q", str(tmp_path)], check=True)
+    clean_seed = tmp_path / "seed.py"
+    clean_seed.write_text("x = 1\n")
+    subprocess.run(["git", "add", "seed.py"], cwd=str(tmp_path),
+                   check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "commit", "-qm", "seed"], cwd=str(tmp_path),
+                   check=True)
+    res3 = analyze.run(paths=[str(clean_seed), str(p)],
+                       use_baseline=False, diff_rev="HEAD",
+                       root=str(tmp_path))
+    assert {f.rule for f in res3["new"]} >= {"RT001"}  # untracked = new
+
+
+def test_live_contract_annotations_repo_wide():
+    """The real declarations this round added are live: the idempotent
+    table covers the 2PC + client-id-keyed handlers, and the five new
+    pass families are registered."""
+    from ray_tpu.util.analyze import retry as retry_pass_mod
+
+    table = retry_pass_mod.repo_idempotent_table()
+    assert {"prepare_bundle", "commit_bundle", "return_bundle",
+            "worker_events", "task_done", "heartbeat", "gossip",
+            "spill", "free_object", "cancel_task"} <= set(table), table
+    assert {"retry", "daemon-loop", "timeout-order", "jax-hotpath",
+            "lifecycle"} <= set(analyze.PASSES)
+    # The timeout-budget relations hold on config defaults by
+    # construction (derived expressions) — and the knobs exist.
+    from ray_tpu.core.config import config
+
+    assert config.cpu_reacquire_budget_s > 0
+    assert config.bundle_reserve_timeout_s > 0
+
+
+def test_loop_restart_counter_mechanics():
+    """count_loop_restart ticks the registry family; retract_loop_series
+    drops the child (the retracted-on-stop contract)."""
+    from ray_tpu.util import metrics as m
+
+    m.count_loop_restart("test.loop.abc")
+    text = "\n".join(m.LOOP_RESTARTS_TOTAL.expose())
+    assert 'loop="test.loop.abc"' in text
+    m.retract_loop_series(["test.loop.abc"])
+    text = "\n".join(m.LOOP_RESTARTS_TOTAL.expose())
+    assert 'loop="test.loop.abc"' not in text
+
+
+def test_worker_events_seq_dedup_absorbs_replay():
+    """The rpc_worker_events idempotence contract: a resent batch under
+    its original seq is absorbed; later seqs apply; a fresh pid (new
+    incarnation) starts its own numbering."""
+    import collections
+    import threading
+
+    from ray_tpu.cluster.node_agent import NodeAgent
+
+    class Stub:
+        _lock = threading.Lock()
+        _event_seqs: "collections.OrderedDict" = collections.OrderedDict()
+
+    stub = Stub()
+    dup = NodeAgent._is_duplicate_event_batch
+    assert dup(stub, "w1", 100, 1) is False
+    assert dup(stub, "w1", 100, 1) is True      # replay absorbed
+    assert dup(stub, "w1", 100, 2) is False     # progress applies
+    assert dup(stub, "w1", 100, 1) is True      # stale replay absorbed
+    assert dup(stub, "w1", 101, 1) is False     # new incarnation
+    assert dup(stub, "w2", 100, None) is False  # probe: no contract
+    assert dup(stub, "w2", 100, None) is False
+
+
+# ---------------------------------------------------------------------------
 # Baseline / ignore / diff workflows.
 # ---------------------------------------------------------------------------
 
