@@ -2612,6 +2612,28 @@ class ClusterBackend:
         targets_path}, or None when disabled."""
         return self.head.call("metrics_endpoint")
 
+    # -- signal plane (head metrics history + SLOs) ------------------------
+
+    def query_metrics(self, spec: dict) -> dict:
+        """Windowed query against the head's history ring — zero sleeps
+        anywhere in the path (pure ring read on the head)."""
+        return self.head.call("query_metrics", spec, timeout=15.0)
+
+    def slo_status(self) -> dict:
+        return self.head.call("slo_status", timeout=15.0)
+
+    def register_slo(self, name: str, expr: str) -> dict:
+        """Register a declarative SLO, e.g.
+        ``ttft_p50{deployment="d"} < 2s over 60s``."""
+        return self.head.call("register_slo", name, expr, timeout=15.0)
+
+    def remove_slo(self, name: str) -> dict:
+        return self.head.call("remove_slo", name, timeout=15.0)
+
+    def signal_top(self, window_s: float = 60.0) -> dict:
+        """The ``ray-tpu top`` cluster rollup, all from history."""
+        return self.head.call("signal_top", window_s, timeout=15.0)
+
     def _log_poll_loop(self, subscribed: bool = False) -> None:
         """Driver-side log streaming over the pubsub LOGS channel
         (long-poll push, ``src/ray/pubsub`` analog — replaces the old

@@ -170,6 +170,33 @@ class MetricsAccessor(_Accessor):
         return self._rpc.call("device_stats", fresh, timeout=20.0)
 
 
+class SignalsAccessor(_Accessor):
+    """The head's signal plane: windowed queries over the metrics
+    history ring and the declarative SLO registry. Every call is a pure
+    ring read on the head — zero sleeps anywhere in the path."""
+
+    def query(self, spec: dict) -> dict:
+        """One windowed query: ``{"op": "rate"|"delta"|"gauge_avg"|
+        "gauge_max"|"gauge_last"|"trend"|"quantile"|"series_delta",
+        "name": family, "window_s": s, "q"?, "match"?, "group_by"?}``."""
+        return self._rpc.call("query_metrics", spec, timeout=15.0)
+
+    def slo_status(self) -> dict:
+        return self._rpc.call("slo_status", timeout=15.0)
+
+    def register_slo(self, name: str, expr: str) -> dict:
+        """e.g. ``signals.register_slo("serve-ttft",
+        'ttft_p50{deployment="d"} < 2s over 60s')``."""
+        return self._rpc.call("register_slo", name, expr, timeout=15.0)
+
+    def remove_slo(self, name: str) -> dict:
+        return self._rpc.call("remove_slo", name, timeout=15.0)
+
+    def top(self, window_s: float = 60.0) -> dict:
+        """The ``ray-tpu top`` rollup (nodes/serve/train/slos)."""
+        return self._rpc.call("signal_top", window_s, timeout=15.0)
+
+
 class ChaosAccessor(_Accessor):
     """Cluster-wide deterministic fault injection: failpoints (named
     sites, armed head -> agents -> workers) and network chaos on the RPC
@@ -220,6 +247,7 @@ class GcsClient:
         self.pubsub = PubsubAccessor(self._rpc)
         self.tasks = TaskInfoAccessor(self._rpc)
         self.metrics = MetricsAccessor(self._rpc)
+        self.signals = SignalsAccessor(self._rpc)
         self.chaos = ChaosAccessor(self._rpc)
 
     def ping(self) -> bool:

@@ -483,6 +483,25 @@ class HeadServer:
                 target=self._leak_sweep_loop, daemon=True).start()
         if self._store is not None:
             threading.Thread(target=self._snapshot_loop, daemon=True).start()
+        # Signal plane: the head's own metrics history (scrape loop
+        # feeds the federated exposition into a bounded ring; SLO loop
+        # evaluates burn-rate state over it). 0 interval disables and
+        # every history-backed surface degrades to its single-scrape
+        # behaviour.
+        self._signals = None
+        if config.signal_scrape_interval_s > 0:
+            from ray_tpu.cluster.signals import SignalPlane
+
+            self._signals = SignalPlane(
+                history_s=config.signal_history_s,
+                max_series=config.signal_max_series,
+                scrape_interval_s=config.signal_scrape_interval_s,
+                burn_evals=config.slo_burn_evals)
+            threading.Thread(
+                target=self._signal_scrape_loop, daemon=True).start()
+            if config.slo_eval_interval_s > 0:
+                threading.Thread(
+                    target=self._slo_eval_loop, daemon=True).start()
 
     # -- persistence ------------------------------------------------------
 
@@ -926,6 +945,14 @@ class HeadServer:
             self.pubsub.publish("NODES", node_id, {
                 "node_id": node_id, "state": "DEAD", "cause": cause,
             })
+            if self._signals is not None:
+                # Age the corpse's series out of the history ring on
+                # the death edge — windowed averages must not blend a
+                # dead node's last samples into live capacity signals.
+                try:
+                    self._signals.age_out_node(node_id)
+                except Exception:
+                    pass
             # Actors on the node die with it; restartable ones reconstruct
             # elsewhere (GcsActorManager::OnNodeDead -> ReconstructActor).
             for info in list(self._actors.values()):
@@ -2249,6 +2276,87 @@ class HeadServer:
             "targets_path": "/metrics/targets",
         }
 
+    # -- signal plane (metrics history ring + SLO evaluation) --------------
+
+    def _signal_scrape_loop(self):
+        """Self-scrape the federated exposition into the history ring.
+        The fanout inside cluster_metrics_text already tolerates dead
+        agents (their chunk is skipped), so one bad node degrades the
+        snapshot, never the loop."""
+        interval = max(0.1, config.signal_scrape_interval_s)
+        while not self._stop.wait(interval):
+            try:
+                self._scrape_signals_once()
+            except Exception:
+                from ray_tpu.util import metrics as _metrics
+
+                _metrics.count_loop_restart("head.signal_scrape")
+                continue
+
+    def _scrape_signals_once(self):
+        from ray_tpu.util import metrics as _metrics
+
+        t0 = time.perf_counter()
+        text = self.cluster_metrics_text()
+        n_series = self._signals.ingest_text(time.time(), text)
+        _metrics.HEAD_SIGNAL_SCRAPE_SECONDS.observe(
+            time.perf_counter() - t0)
+        _metrics.HEAD_SIGNAL_SERIES.set(float(n_series))
+
+    def _slo_eval_loop(self):
+        interval = max(0.1, config.slo_eval_interval_s)
+        while not self._stop.wait(interval):
+            try:
+                self._eval_slos_once()
+            except Exception:
+                from ray_tpu.util import metrics as _metrics
+
+                _metrics.count_loop_restart("head.slo_eval")
+                continue
+
+    def _eval_slos_once(self):
+        events = self._signals.evaluate_slos(time.time())
+        for ev in events:
+            # Same plane drain/OOM events ride: channel/key/payload.
+            self.pubsub.publish("SLO", ev["slo"], ev)
+
+    def rpc_query_metrics(self, spec: dict):
+        """Windowed query against the head's history ring (see
+        signals.SignalPlane.query for the spec shape). Answers
+        {"ok": False, "error": "..."} when the ring is disabled so
+        callers can fall back without a try/except."""
+        if self._signals is None:
+            return {"ok": False, "error": "signal plane disabled"}
+        return self._signals.query(spec)
+
+    def rpc_slo_status(self):
+        if self._signals is None:
+            return {"ok": False, "error": "signal plane disabled"}
+        return {"ok": True, **self._signals.slo_status()}
+
+    def rpc_register_slo(self, name: str, expr: str):
+        if self._signals is None:
+            return {"ok": False, "error": "signal plane disabled"}
+        try:
+            return {"ok": True, "slo": self._signals.register_slo(
+                name, expr)}
+        except ValueError as e:
+            return {"ok": False, "error": str(e)}
+
+    def rpc_remove_slo(self, name: str):
+        if self._signals is None:
+            return {"ok": False, "error": "signal plane disabled"}
+        return {"ok": True,
+                "removed": self._signals.remove_slo(name)}
+
+    def rpc_signal_top(self, window_s: float = 60.0):
+        """The `ray-tpu top` rollup — every number a ring query, zero
+        sleeps in this path by construction."""
+        if self._signals is None:
+            return {"ok": False, "error": "signal plane disabled"}
+        return {"ok": True,
+                **self._signals.top_summary(float(window_s))}
+
     # -- chaos / fault-injection control plane -----------------------------
     # The head is the arming point for cluster-wide deterministic fault
     # injection: failpoint specs and network-chaos rules fan out to every
@@ -3045,7 +3153,9 @@ class HeadServer:
         from ray_tpu.util import metrics as _metrics
 
         # Dead head = dead loops: their restart series leave the scrape.
-        _metrics.retract_loop_series(["head.free", "head.reserve_pg"])
+        _metrics.retract_loop_series(["head.free", "head.reserve_pg",
+                                      "head.signal_scrape",
+                                      "head.slo_eval"])
         if self._metrics_shutdown is not None:
             try:
                 self._metrics_shutdown()

@@ -1975,14 +1975,28 @@ class NodeAgent:
         """This agent process's full registry in Prometheus exposition
         format — the per-node input to the head's /metrics/cluster
         federation. Store occupancy is refreshed per scrape (it is one
-        cheap native call; worker /proc sampling stays on the loop)."""
+        cheap native call; worker /proc sampling stays on the loop).
+
+        Scrape-cost self-accounting: the render-time gauge is set to
+        the PREVIOUS scrape's cost before rendering, so the cost of
+        serving metrics is itself visible in the body — one scrape
+        behind by construction (this scrape's cost can't be known
+        until after the text is built)."""
+        import time as _time
+
         from ray_tpu.util import metrics as _metrics
 
         try:
             self._export_store_gauges()
+            _metrics.AGENT_METRICS_RENDER_SECONDS.set(
+                getattr(self, "_last_metrics_render_s", 0.0),
+                tags={"node_id": self.node_id})
         except Exception:
             pass
-        return _metrics.prometheus_text()
+        t0 = _time.perf_counter()
+        body = _metrics.prometheus_text()
+        self._last_metrics_render_s = _time.perf_counter() - t0
+        return body
 
     def rpc_has_worker(self, worker_id):
         """Routing probe for the head: does this node know the worker?"""

@@ -51,7 +51,8 @@ from ray_tpu.util.metrics import (
     PUBSUB_DROPPED as _PUBSUB_DROPPED,
 )
 
-CHANNELS = ("LOGS", "ACTORS", "NODES", "ERRORS", "PLACEMENT_GROUPS")
+CHANNELS = ("LOGS", "ACTORS", "NODES", "ERRORS", "PLACEMENT_GROUPS",
+            "SLO")
 
 # State-update channels: each message is the entity's complete latest
 # state keyed by entity id, so replacing a buffered message with a newer
@@ -59,6 +60,9 @@ CHANNELS = ("LOGS", "ACTORS", "NODES", "ERRORS", "PLACEMENT_GROUPS")
 # (LOGS, ERRORS) are deliberately absent. PLACEMENT_GROUPS carries each
 # group's full latest lifecycle state (CREATED/RESCHEDULING/...) keyed
 # by pg id — the feed gang holders watch to learn their bundles moved.
+# SLO is an edge-event channel: a burning event and the recovery that
+# follows it share the slo-name key, so coalescing would swallow one
+# edge — both must deliver.
 COALESCE_CHANNELS = frozenset(("ACTORS", "NODES", "PLACEMENT_GROUPS"))
 
 

@@ -169,6 +169,26 @@ _DEFS: dict[str, tuple[type, Any]] = {
     # victim choice) so any chaos run replays from one env var. 0 =
     # unseeded (OS entropy).
     "chaos_seed": (int, 0),
+    # -- signal plane (head metrics history + SLO evaluation) --------------
+    # The head self-scrapes its own federated /metrics/cluster body into
+    # a bounded in-memory time-series ring every interval; 0 disables
+    # the scrape loop (and every history-backed surface falls back to
+    # its single-scrape behaviour).
+    "signal_scrape_interval_s": (float, 2.0),
+    # Per-series retention window: samples older than this age out of
+    # the ring (bounded-retention discipline — head RSS must not grow
+    # with uptime).
+    "signal_history_s": (float, 600.0),
+    # Hard cap on distinct series the ring retains; past it the
+    # least-recently-updated series is evicted (and counted into
+    # ray_tpu_head_signal_evictions_total).
+    "signal_max_series": (int, 50_000),
+    # SLO evaluator cadence (burn-rate state machine over the ring);
+    # 0 disables the loop. Defaults to the scrape cadence.
+    "slo_eval_interval_s": (float, 2.0),
+    # Consecutive breaching evaluations before an SLO transitions to
+    # burning (hysteresis: one scrape gap or blip must not flap it).
+    "slo_burn_evals": (int, 3),
     # -- pubsub ------------------------------------------------------------
     "pubsub_max_buffer": (int, 10_000),
     "pubsub_subscriber_ttl_s": (float, 120.0),

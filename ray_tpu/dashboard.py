@@ -282,12 +282,32 @@ class Dashboard:
             from ray_tpu import serve
 
             self._ensure_client()
-            # Always a single scrape: the dashboard serves requests
-            # serially through ONE handler thread, so a windowed QPS
-            # sample (which sleeps) would stall every other pane. The
-            # SPA shows cumulative counts; use `ray-tpu serve stats`
-            # for a measured QPS.
-            return ok_json(serve.stats(window_s=0.0))
+            # ?window= answers from the head's metrics history ring —
+            # no stall by construction (allow_sleep=False forbids the
+            # legacy double-scrape, which would block this single
+            # handler thread and stall every other pane). No window,
+            # or no ring (signal plane disabled): single scrape,
+            # cumulative counts only.
+            window = float(qs.get("window", 0.0) or 0.0)
+            return ok_json(serve.stats(
+                window_s=window, allow_sleep=False))
+        if route == "/api/signals":
+            # Signals pane: SLO burn-rate table + the `top` rollup from
+            # the head's history ring; ?op=...&name=... runs one ad-hoc
+            # windowed query. Pure ring reads — zero sleeps.
+            window = float(qs.get("window", 60.0) or 60.0)
+            if qs.get("op"):
+                spec = {"op": qs["op"], "name": qs.get("name", ""),
+                        "window_s": window}
+                if qs.get("q"):
+                    spec["q"] = float(qs["q"])
+                if qs.get("group_by"):
+                    spec["group_by"] = qs["group_by"]
+                return ok_json(self.head.call("query_metrics", spec))
+            return ok_json({
+                "slo": self.head.call("slo_status"),
+                "top": self.head.call("signal_top", window),
+            })
         if route == "/api/serve/applications":
             # Read-only: a cluster that never used serve must stay
             # untouched — probe the controller through the head's named
@@ -468,7 +488,7 @@ class Dashboard:
                "/api/device_stats", "/api/cluster_metrics",
                "/api/placement_groups", "/api/pubsub_stats",
                "/api/serve_stats", "/api/data_stats",
-               "/api/train_stats"]
+               "/api/train_stats", "/api/signals"]
         links = "".join(f'<li><a href="{r}">{r}</a></li>' for r in api)
         return (
             "<!doctype html><title>ray_tpu dashboard</title>"

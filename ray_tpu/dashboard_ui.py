@@ -72,7 +72,7 @@ INDEX_HTML = r"""<!doctype html>
 "use strict";
 const TABS = ["cluster", "nodes", "workers", "devices", "actors", "tasks",
               "objects", "memory", "placement_groups", "jobs", "serve",
-              "train", "logs"];
+              "train", "signals", "logs"];
 let active = location.hash.slice(1) || "cluster";
 let logCursor = 0;
 const logBuf = [];
@@ -484,8 +484,12 @@ const RENDER = {
     // Serve pane (memory-pane shape): SLO tiles + per-deployment
     // latency/shed table from the request-path plane, then the raw
     // application listing.
+    // ?window= answers QPS from the head's metrics history ring —
+    // no stall by construction (the route forbids the legacy
+    // sleeping double-scrape); without a ring the field is simply
+    // absent and the column shows "—".
     const [s, d] = await Promise.all(
-      [api("/api/serve_stats"), api("/api/serve/applications")]);
+      [api("/api/serve_stats?window=30"), api("/api/serve/applications")]);
     const deps = Object.entries(s.deployments || {})
       .map(([name, info]) => ({name, ...info}));
     const totals = deps.reduce((acc, r) => {
@@ -506,16 +510,14 @@ const RENDER = {
     ]);
     const wrap = el("div");
     wrap.appendChild(el("h3", "", "per-deployment SLO"));
-    // No qps column: the API route is single-scrape by design (a
-    // windowed sample would stall the single-threaded dashboard);
-    // counts are cumulative — `ray-tpu serve stats` measures QPS.
     wrap.appendChild(table(
-      ["deployment", "replicas", "p50 ms", "p99 ms", "ok",
+      ["deployment", "replicas", "qps", "p50 ms", "p99 ms", "ok",
        "errors", "shed", "ongoing", "queued", "phases"],
       deps, (r, c) => {
         const req = r.requests || {};
         if (c === "deployment") return el("td", "", r.name);
         if (c === "replicas") return el("td", "", r.replicas ?? "?");
+        if (c === "qps") return el("td", "", r.qps ?? "—");
         if (c === "p50 ms") return el("td", "", r.p50_ms ?? "—");
         if (c === "p99 ms") return el("td",
           (r.p99_ms || 0) > 1000 ? "warn" : "", r.p99_ms ?? "—");
@@ -548,6 +550,87 @@ const RENDER = {
         td.textContent = JSON.stringify(r.info);
         return td;
       }));
+    $("view").replaceChildren(wrap);
+  },
+  async signals() {
+    // Signal-plane pane: SLO burn-rate table + the `top` rollup, all
+    // windowed queries over the head's metrics history ring (the API
+    // route performs zero sleeps — pure ring reads).
+    const d = await api("/api/signals?window=60");
+    const slo = d.slo || {}, top = d.top || {};
+    if (slo.ok === false) {
+      setTiles([["signal plane", slo.error || "disabled", "warn"]]);
+      $("view").replaceChildren(
+        el("p", "dim", "enable with RAY_TPU_SIGNAL_SCRAPE_INTERVAL_S"));
+      return;
+    }
+    const slos = Object.entries(slo.slos || {})
+      .map(([name, s]) => ({name, ...s}));
+    const burning = slos.filter(s => s.state === "burning").length;
+    const warning = slos.filter(s => s.state === "warning").length;
+    const evict = Object.values(top.evictions || {})
+      .reduce((a, b) => a + b, 0);
+    setTiles([
+      ["series", top.series ?? slo.series ?? "?"],
+      ["evictions", evict, evict > 0 ? "warn" : ""],
+      ["SLOs", slos.length],
+      ["burning", burning, burning > 0 ? "bad" : "ok"],
+      ["warning", warning, warning > 0 ? "warn" : ""],
+    ]);
+    const wrap = el("div");
+    wrap.appendChild(el("h3", "", "SLO burn rate"));
+    wrap.appendChild(table(
+      ["name", "state", "value", "threshold", "window s", "breaches",
+       "expr"],
+      slos, (r, c) => {
+        if (c === "name") return el("td", "", r.name);
+        if (c === "state") return el("td",
+          r.state === "burning" ? "bad"
+            : r.state === "warning" ? "warn" : "ok", r.state);
+        if (c === "value") return el("td", "mono",
+          r.value != null ? Number(r.value).toPrecision(4) : "—");
+        if (c === "threshold") return el("td", "mono",
+          `${r.op} ${r.threshold}`);
+        if (c === "window s") return el("td", "", r.window_s);
+        if (c === "breaches") return el("td", "", r.breach_streak);
+        return el("td", "mono", r.expr);
+      }));
+    const nodes = Object.entries(top.nodes || {})
+      .map(([id, n]) => ({id, ...n}));
+    wrap.appendChild(el("h3", "", "nodes (windowed)"));
+    wrap.appendChild(table(
+      ["node", "cpu %", "rss MB", "store", "workers"],
+      nodes, (r, c) => {
+        if (c === "node") return el("td", "mono", short(r.id));
+        if (c === "cpu %") return el("td", "", r.cpu_percent ?? "—");
+        if (c === "rss MB") return el("td", "",
+          r.rss_bytes != null ? (r.rss_bytes / 1e6).toFixed(1) : "—");
+        if (c === "store") return el("td",
+          (r.store_occupancy || 0) > 0.8 ? "warn" : "",
+          r.store_occupancy != null
+            ? (r.store_occupancy * 100).toFixed(1) + "%" : "—");
+        return el("td", "", r.workers ?? "—");
+      }));
+    const deps = Object.entries(top.serve || {})
+      .map(([name, s]) => ({name, ...s}));
+    if (deps.length) {
+      wrap.appendChild(el("h3", "", "serve (windowed)"));
+      wrap.appendChild(table(
+        ["deployment", "qps", "shed", "ttft p50 ms", "itl p50 ms",
+         "latency p50 ms"],
+        deps, (r, c) => {
+          const ms = (v) => v != null ? (v * 1e3).toFixed(1) : "—";
+          if (c === "deployment") return el("td", "", r.name);
+          if (c === "qps") return el("td", "", r.qps ?? "—");
+          if (c === "shed") return el("td",
+            (r.shed_ratio || 0) > 0 ? "warn" : "",
+            r.shed_ratio != null
+              ? (r.shed_ratio * 100).toFixed(2) + "%" : "—");
+          if (c === "ttft p50 ms") return el("td", "", ms(r.ttft_p50_s));
+          if (c === "itl p50 ms") return el("td", "", ms(r.itl_p50_s));
+          return el("td", "", ms(r.latency_p50_s));
+        }));
+    }
     $("view").replaceChildren(wrap);
   },
   async train() {

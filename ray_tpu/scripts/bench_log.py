@@ -27,6 +27,7 @@ KNOWN_BENCHES = frozenset({
     "task_overhead", "memory_pressure", "chaos_soak", "scalebench",
     "drain_recovery_ms", "serve_latency", "input_pipeline", "goodput",
     "analyze", "gang_recovery", "llm_serving", "streaming_dataflow",
+    "signal_plane",
 })
 
 
@@ -280,6 +281,38 @@ def record_streaming_dataflow(*, client: dict, server: dict,
         "spill": dict(spill),
         "pool": dict(pool),
     }
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
+def record_signal_plane(*, agreement: dict, query_p50_ms: float,
+                        series: int, ring: dict | None = None,
+                        slo: dict | None = None,
+                        device: str = "", path: str | None = None,
+                        **extra) -> dict:
+    """Signal-plane evidence (``scripts/signal_bench.py``): the
+    windowed-query-vs-client agreement verdict (history-derived QPS and
+    TTFT p50 must match client-side measurement within bucket
+    resolution — a query engine that disagrees with the traffic it
+    summarizes is worse than none), the query path's p50 latency (the
+    zero-sleeps claim, measured), the ring's series count, the
+    bounded-memory section (64-node-shaped scrape: growth + eviction
+    counts), and the seeded SLO burn section (exactly one burning and
+    one recovery event). Committed to the evidence trail only on an
+    accelerator; returns the entry (with ``committed_to``) either
+    way."""
+    entry: dict = {
+        "bench": "signal_plane",
+        "device": device,
+        "agreement": dict(agreement),
+        "query_p50_ms": float(query_p50_ms),
+        "series": int(series),
+    }
+    if ring is not None:
+        entry["ring"] = dict(ring)
+    if slo is not None:
+        entry["slo"] = dict(slo)
     entry.update(extra)
     entry["committed_to"] = record_if_on_chip(dict(entry), path)
     return entry
@@ -556,6 +589,22 @@ def check_line(obj: object, *, allow_header: bool = False) -> list[str]:
                     and isinstance(agreement.get("ok"), bool)):
                 errs.append("llm_serving line missing boolean "
                             "agreement.ok")
+        elif obj["bench"] == "signal_plane":
+            # The line's claim is "the history ring answers truthfully
+            # and cheaply": the windowed-vs-client agreement verdict,
+            # the measured query latency (zero-sleeps, proven not
+            # asserted), and the series count are all load-bearing.
+            agreement = obj.get("agreement")
+            if not (isinstance(agreement, dict)
+                    and isinstance(agreement.get("ok"), bool)):
+                errs.append("signal_plane line missing boolean "
+                            "agreement.ok")
+            if not _is_num(obj.get("query_p50_ms")):
+                errs.append("signal_plane line missing numeric "
+                            "query_p50_ms")
+            if not _is_num(obj.get("series")):
+                errs.append("signal_plane line missing numeric "
+                            "series count")
         elif obj["bench"] == "serve_latency":
             # A serve latency line must carry both views AND the
             # agreement verdict — a client-only (or server-only) number

@@ -85,6 +85,10 @@ class _Soak:
         self.dataflow_failed = 0
         self.dataflow_spilled = 0
         self.dataflow_restores = 0
+        self.signal_queries_ok = 0
+        self.signal_queries_failed = 0
+        self.signal_slo_transitions = 0
+        self.signal_missed_evals = 0
         self._stop = threading.Event()
         # The streaming-dataflow probe's small-store node: exempt from
         # kill/drain (its custom resource exists nowhere else, so losing
@@ -653,6 +657,62 @@ class _Soak:
                 pass
             rounds += 1
 
+    def _signal_probe_setup(self) -> bool:
+        """Register a sentinel SLO that can never legitimately burn:
+        any burning/recovery transition over the soak is the evaluator
+        flapping on scrape gaps, not a real breach."""
+        from ray_tpu import state
+
+        st = state.slo_status()
+        if not st.get("ok", False):
+            return False  # signal plane disabled: nothing to probe
+        reg = state.register_slo("soak-sentinel",
+                                 "qps < 1000000 over 10s")
+        if not reg.get("ok"):
+            return False
+        # Prove one query round trip BEFORE faults start (the serve
+        # probe's discipline): under the fault schedule a saturated box
+        # can starve every later round, and "never completed a query"
+        # must mean the plane broke, not that the probe never got a
+        # healthy turn.
+        if state.query_metrics({"op": "gauge_last",
+                                "name": "ray_tpu_node_worker_count",
+                                "window_s": 60.0}).get("ok"):
+            self.signal_queries_ok += 1
+        return True
+
+    def _signal_probe_loop(self, deadline: float) -> None:
+        """Standing invariant: the head's history ring keeps answering
+        windowed queries while agents are partitioned/killed — the ring
+        is head-local state, so a partition starves it of NEW samples
+        but must never make a query stall or error. A stalled query is
+        a violation; per-round results are counted for the evidence
+        line."""
+        from ray_tpu import state
+
+        while time.monotonic() < deadline and not self._stop.is_set():
+            t0 = time.monotonic()
+            try:
+                res = state.query_metrics({
+                    "op": "gauge_last",
+                    "name": "ray_tpu_node_worker_count",
+                    "window_s": 60.0})
+                if res.get("ok"):
+                    self.signal_queries_ok += 1
+                else:
+                    self.signal_queries_failed += 1
+            except Exception:
+                self.signal_queries_failed += 1
+            if self._stop.is_set():
+                return  # settling cluster: not a verdict
+            took = time.monotonic() - t0
+            if took > 30.0:
+                self.violations.append(
+                    f"signal query STALLED {took:.1f}s under faults "
+                    f"(the ring must answer from head-local history)")
+                return
+            time.sleep(0.5)
+
     # -- invariants --------------------------------------------------------
 
     def _check_invariants(self, cluster) -> None:
@@ -812,6 +872,11 @@ class _Soak:
         except Exception as e:  # noqa: BLE001
             self.violations.append(
                 f"dataflow probe setup failed: {e!r}")
+        signal_ready = False
+        try:
+            signal_ready = self._signal_probe_setup()
+        except Exception as e:  # noqa: BLE001
+            self.violations.append(f"signal probe setup failed: {e!r}")
         injector = threading.Thread(
             target=self._fault_loop, args=(cluster,), daemon=True)
         injector.start()
@@ -839,6 +904,10 @@ class _Soak:
             if dataflow_ready:
                 threading.Thread(
                     target=self._dataflow_probe_loop,
+                    args=(deadline,), daemon=True).start()
+            if signal_ready:
+                threading.Thread(
+                    target=self._signal_probe_loop,
                     args=(deadline,), daemon=True).start()
             time.sleep(min(self.duration_s / 3.0, 10.0))
             self._drain_once(cluster)
@@ -906,6 +975,33 @@ class _Soak:
                         node.rpc_store_stats().get("spill_restores", 0))
                 except Exception:
                     continue
+        if signal_ready:
+            from ray_tpu import state
+
+            if self.signal_queries_ok < 1:
+                self.violations.append(
+                    "signal probe never completed a query")
+            try:
+                sent = (state.slo_status().get("slos") or {}).get(
+                    "soak-sentinel") or {}
+                # missed_evals counts held evaluations (scrape gaps
+                # under partition) — evidence, not a fault. Any
+                # transition on a can't-burn sentinel IS the evaluator
+                # flapping on those gaps.
+                self.signal_slo_transitions = int(
+                    sent.get("transitions", 0))
+                self.signal_missed_evals = int(
+                    sent.get("missed_evals", 0))
+                if self.signal_slo_transitions:
+                    self.violations.append(
+                        f"sentinel SLO flapped "
+                        f"{self.signal_slo_transitions}x on scrape "
+                        f"gaps (evaluator must hold state when the "
+                        f"window has no samples)")
+                state.remove_slo("soak-sentinel")
+            except Exception as e:  # noqa: BLE001
+                self.violations.append(
+                    f"signal probe teardown: {e!r}")
         try:
             from ray_tpu import serve
 
@@ -936,6 +1032,10 @@ class _Soak:
             dataflow_failed=self.dataflow_failed,
             dataflow_spilled=self.dataflow_spilled,
             dataflow_restores=self.dataflow_restores,
+            signal_queries_ok=self.signal_queries_ok,
+            signal_queries_failed=self.signal_queries_failed,
+            signal_slo_transitions=self.signal_slo_transitions,
+            signal_missed_evals=self.signal_missed_evals,
         )
         ray_tpu.shutdown()
         cluster.shutdown()

@@ -286,6 +286,130 @@ def cmd_serve(args):
         print(f"controller reconcile: {stats['reconcile_s'] * 1e3:.1f} ms")
 
 
+def _print_top(top, window):
+    slos = top.get("slos") or {}
+    burning = sum(1 for s in slos.values() if s["state"] == "burning")
+    print(f"window {window:g}s · {top.get('series', 0)} series"
+          + (f" · {burning} SLO(s) BURNING" if burning else ""))
+    nodes = top.get("nodes") or {}
+    if nodes:
+        hdr = (f"{'node':<16} {'cpu%':>6} {'rss MB':>8} {'store%':>7} "
+               f"{'workers':>7}")
+        print(hdr)
+        print("-" * len(hdr))
+        for nid, n in sorted(nodes.items()):
+            occ = n.get("store_occupancy")
+            print(f"{nid[-14:]:<16} {n.get('cpu_percent', 0):>6} "
+                  f"{n.get('rss_bytes', 0) / 1e6:>8.1f} "
+                  f"{(f'{occ:.1%}' if occ is not None else '—'):>7} "
+                  f"{n.get('workers', 0):>7}")
+    serve = top.get("serve") or {}
+    if serve:
+        hdr = (f"{'deployment':<24} {'qps':>7} {'shed%':>6} "
+               f"{'ttft p50':>9} {'itl p50':>9} {'lat p50':>9}")
+        print(hdr)
+        print("-" * len(hdr))
+        for dep, d in sorted(serve.items()):
+            def ms(key):
+                v = d.get(key)
+                return f"{v * 1e3:.1f}ms" if v is not None else "—"
+            shed = d.get("shed_ratio")
+            print(f"{dep:<24} {d.get('qps', 0):>7} "
+                  f"{(f'{shed:.1%}' if shed is not None else '—'):>6} "
+                  f"{ms('ttft_p50_s'):>9} {ms('itl_p50_s'):>9} "
+                  f"{ms('latency_p50_s'):>9}")
+    train = top.get("train") or {}
+    for trial, t in sorted(train.items()):
+        gp = t.get("goodput_pct")
+        print(f"trial {trial}: {t.get('reports_per_s', 0)} reports/s"
+              + (f", goodput {gp}%" if gp is not None else ""))
+    for name, s in sorted(slos.items()):
+        v = s.get("value")
+        print(f"slo {name:<20} {s['state']:<8} "
+              f"{v if v is not None else '—'} "
+              f"{s['op']} {s['threshold']}  ({s['expr']})")
+
+
+def cmd_top(args):
+    """Live cluster view from the head's metrics history ring — every
+    number a windowed ring query, zero sleeps in the request path (the
+    --watch cadence is the terminal's, not the data path's)."""
+    _connect(args)
+    from ray_tpu import state
+
+    def once():
+        top = state.signal_top(args.window)
+        if not top.get("ok"):
+            raise SystemExit(f"signal plane unavailable: "
+                             f"{top.get('error')}")
+        if args.json:
+            print(json.dumps(top, indent=2, default=str))
+        else:
+            _print_top(top, args.window)
+
+    if not args.watch:
+        once()
+        return
+    import time as _time
+
+    try:
+        while True:
+            print("\x1b[2J\x1b[H", end="")
+            once()
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_slo(args):
+    """SLO registry: ``ray-tpu slo`` prints the burn-rate table;
+    ``register <name> <expr>`` / ``remove <name>`` manage objectives
+    (grammar: ``ttft_p50{deployment="d"} < 2s over 60s``,
+    ``shed_ratio < 1% over 300s``, ``rate(family) < N over Ws``)."""
+    _connect(args)
+    from ray_tpu import state
+
+    if args.op == "register":
+        if not args.name or not args.expr:
+            raise SystemExit("usage: ray-tpu slo register <name> <expr>")
+        res = state.register_slo(args.name, " ".join(args.expr))
+        if not res.get("ok"):
+            raise SystemExit(f"register failed: {res.get('error')}")
+        print(json.dumps(res["slo"], indent=2, default=str))
+        return
+    if args.op == "remove":
+        if not args.name:
+            raise SystemExit("usage: ray-tpu slo remove <name>")
+        res = state.remove_slo(args.name)
+        if not res.get("ok"):
+            raise SystemExit(f"remove failed: {res.get('error')}")
+        print("removed" if res.get("removed") else "not registered")
+        return
+    status = state.slo_status()
+    if not status.get("ok"):
+        raise SystemExit(f"signal plane unavailable: "
+                         f"{status.get('error')}")
+    if args.json:
+        print(json.dumps(status, indent=2, default=str))
+        return
+    slos = status.get("slos") or {}
+    if not slos:
+        print("no SLOs registered "
+              "(ray-tpu slo register <name> '<expr>')")
+        return
+    hdr = (f"{'name':<20} {'state':<8} {'value':>10} {'threshold':>10} "
+           f"{'window':>7} {'breaches':>8}")
+    print(hdr)
+    print("-" * len(hdr))
+    for name, s in sorted(slos.items()):
+        v = s.get("value")
+        print(f"{name:<20} {s['state']:<8} "
+              f"{(round(v, 5) if v is not None else '—'):>10} "
+              f"{s['op']}{s['threshold']:>9} "
+              f"{s['window_s']:>6g}s {s['breach_streak']:>8}")
+        print(f"    {s['expr']}")
+
+
 def cmd_data(args):
     """Input-pipeline observability: ``ray-tpu data stats`` prints the
     per-stage execution rollup and the consumer-loop stall fraction —
@@ -769,6 +893,31 @@ def main(argv=None):
                    help="also print the per-phase latency breakdown")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "top",
+        help="live cluster view from the head's metrics history "
+             "(nodes, serve, train, SLOs — zero sleeps in the path)")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="query window seconds")
+    p.add_argument("--watch", action="store_true",
+                   help="refresh continuously until ^C")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--watch refresh cadence seconds")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser(
+        "slo",
+        help="SLO registry: burn-rate table / register / remove")
+    p.add_argument("op", nargs="?", default="status",
+                   choices=["status", "register", "remove"])
+    p.add_argument("name", nargs="?", default=None)
+    p.add_argument("expr", nargs="*",
+                   help="SLO expression, e.g. "
+                        "ttft_p50{deployment=\"d\"} < 2s over 60s")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_slo)
 
     p = sub.add_parser(
         "data",

@@ -193,6 +193,11 @@ def main() -> None:
                     help="add the training-goodput point "
                          "(dataset->iterator->train-step harness + "
                          "client/server stall-fraction cross-check)")
+    ap.add_argument("--signals", action="store_true",
+                    help="add the signal-plane point (windowed-query "
+                         "agreement vs client ledger + bounded-ring "
+                         "memory proof + seeded SLO burn with exactly "
+                         "one burning and one recovery pubsub event)")
     ap.add_argument("--dataflow", action="store_true",
                     help="add the streaming-dataflow point "
                          "(generation->training pipeline past store "
@@ -241,6 +246,9 @@ def main() -> None:
     if args.dataflow:
         steps.append([sys.executable, "-m",
                       "ray_tpu.scripts.dataflow_bench", "--out", args.out])
+    if args.signals:
+        steps.append([sys.executable, "-m",
+                      "ray_tpu.scripts.signal_bench", "--out", args.out])
     for argv in steps:
         print(f"perfsuite: {' '.join(argv[2:])}", file=sys.stderr,
               flush=True)

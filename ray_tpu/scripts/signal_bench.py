@@ -1,0 +1,387 @@
+"""Signal-plane harness: history-vs-client agreement, bounded ring
+memory, and a seeded SLO burn — the three claims the signal plane
+stands on, each measured, none asserted.
+
+Sections (all in one run, merged into MICROBENCH.json under
+``signal_plane`` with ``--out``):
+
+* **agreement** — drive seeded serve-shaped traffic through the real
+  recorder -> head-scrape -> ring path, then ask the windowed query
+  engine for the same numbers the client ledger knows: the counter
+  delta must be count-exact, the windowed TTFT p50 must match the
+  client-side percentile within the histogram's bucket resolution at
+  that value, and the windowed QPS must match the paced rate. The
+  query path's p50 latency is measured and must be far below the query
+  window — a sleeping implementation (the old double-scrape) cannot
+  pass this.
+* **ring** — a 64-node-shaped synthetic scrape ingested far past the
+  retention window and over the series cap: traced memory must plateau
+  after warmup (bounded, not merely slow-growing) and every eviction
+  must be counted by reason (series_cap / dead_node / stale) — never a
+  silent cap.
+* **slo** — a seeded TTFT-SLO burn: fast traffic (ok) -> slow traffic
+  (burning) -> fast traffic (recovered), with the pubsub SLO channel
+  subscribed the whole time. Exactly one burning event and one
+  recovery event must arrive, and `ray-tpu slo` must show the same
+  story.
+
+Run: python -m ray_tpu.scripts.signal_bench [--out MICROBENCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import random
+import sys
+import time
+import tracemalloc
+
+SCRAPE_S = 0.05
+EVAL_S = 0.05
+BURN_EVALS = 3
+DEP = "bench"
+
+
+def _percentile(values, q):
+    s = sorted(values)
+    if not s:
+        return None
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+def _drive(obs, duration_s: float, rate_hz: float, ttft_values,
+           ledger=None):
+    """Paced serve-shaped traffic through the real recorder (the
+    producer may sleep — the zero-sleeps claim is about the QUERY
+    path)."""
+    interval = 1.0 / rate_hz
+    end = time.time() + duration_s
+    i = 0
+    while time.time() < end:
+        val = ttft_values[i % len(ttft_values)]
+        obs.record_status(DEP, "ok")
+        obs.record_ttft(DEP, val)
+        if ledger is not None:
+            ledger.append(val)
+        i += 1
+        time.sleep(interval)
+    return i
+
+
+def _section_agreement(state, serve, obs):
+    """Windowed queries vs the client ledger, on the live scrape path."""
+    rng = random.Random(20260807)
+    # Warm the counter series into the ring at a known value BEFORE the
+    # timed run: windowed deltas subtract the first in-window sample,
+    # so the ring must have seen the series at its starting value for
+    # the delta to be count-exact.
+    obs.record_status(DEP, "ok")
+    obs.record_ttft(DEP, 0.02)
+    time.sleep(SCRAPE_S * 6)
+
+    ttft_pool = [rng.uniform(0.01, 0.2) for _ in range(64)]
+    ledger: list = []
+    rate_hz = 200.0
+    t0 = time.time()
+    n_sent = _drive(obs, 2.0, rate_hz, ttft_pool, ledger)
+    elapsed_client = time.time() - t0
+    # Mid-steady-state QPS check happens below with a window inside the
+    # run; first let the tail land in the ring.
+    time.sleep(SCRAPE_S * 6)
+
+    # Count-exact delta: the window's FIRST ring sample is the warmed
+    # counter at 1, so last - first is exactly the timed requests.
+    big = state.query_metrics({
+        "op": "delta", "name": "ray_tpu_serve_requests_total",
+        "window_s": 300.0, "match": {"deployment": DEP}})
+    ring_count = big.get("value") or 0
+
+    # Windowed QPS: a window matching the run length, anchored at the
+    # ring's latest ingest (a short idle tail and an equally short
+    # clipped head make this approximate, hence the tolerance).
+    qps_res = state.query_metrics({
+        "op": "rate", "name": "ray_tpu_serve_requests_total",
+        "window_s": elapsed_client, "match": {"deployment": DEP}})
+    ring_qps = qps_res.get("value") or 0.0
+    client_qps = n_sent / elapsed_client
+
+    # Windowed TTFT p50 from bucket deltas vs the ledger percentile.
+    q_res = state.query_metrics({
+        "op": "quantile", "name": "ray_tpu_serve_decode_ttft_seconds",
+        "q": 0.5, "window_s": 300.0, "match": {"deployment": DEP}})
+    ring_p50 = q_res.get("value")
+    resolution = q_res.get("resolution_s") or 0.0
+    client_p50 = _percentile(ledger, 0.5)
+
+    # serve.stats history path (satellite: no sleeps by construction).
+    t_stats = time.time()
+    stats = serve.stats(window_s=5.0, allow_sleep=False)
+    stats_wall = time.time() - t_stats
+    stats_qps = (stats.get("deployments", {}).get(DEP) or {}).get("qps")
+
+    # Query-path latency: measured, not asserted. A sleep-based
+    # implementation takes >= the window (5000ms here); the ring
+    # answers from memory.
+    lat_ms = []
+    for _ in range(40):
+        q0 = time.perf_counter()
+        state.query_metrics({
+            "op": "quantile",
+            "name": "ray_tpu_serve_decode_ttft_seconds",
+            "q": 0.5, "window_s": 60.0, "match": {"deployment": DEP}})
+        lat_ms.append((time.perf_counter() - q0) * 1e3)
+    query_p50_ms = round(_percentile(lat_ms, 0.5), 3)
+
+    count_exact = int(ring_count) == n_sent
+    ttft_ok = (ring_p50 is not None and client_p50 is not None
+               and abs(ring_p50 - client_p50) <= resolution + 1e-9)
+    qps_ok = client_qps > 0 and \
+        abs(ring_qps - client_qps) / client_qps < 0.25
+    no_sleep = query_p50_ms < 100.0 and stats_wall < 1.0
+    return {
+        "n_sent": n_sent,
+        "ring_count": int(ring_count),
+        "count_exact": count_exact,
+        "client_qps": round(client_qps, 2),
+        "ring_qps": round(ring_qps, 2),
+        "serve_stats_qps": stats_qps,
+        "serve_stats_wall_ms": round(stats_wall * 1e3, 1),
+        "client_ttft_p50_s": round(client_p50, 5),
+        "ring_ttft_p50_s": round(ring_p50, 5)
+        if ring_p50 is not None else None,
+        "bucket_resolution_s": round(resolution, 5),
+        "query_p50_ms": query_p50_ms,
+        "query_p99_ms": round(_percentile(lat_ms, 0.99), 3),
+        "ok": bool(count_exact and ttft_ok and qps_ok and no_sleep),
+        "checks": {"count_exact": count_exact, "ttft_p50": ttft_ok,
+                   "qps": qps_ok, "no_sleep": no_sleep},
+    }
+
+
+def _section_ring():
+    """64-node-shaped synthetic scrape: bounded memory + counted
+    evictions, in-process against a standalone ring."""
+    from ray_tpu.cluster.signals import MetricsRing
+
+    nodes, per_node, max_series = 64, 80, 4000
+    ring = MetricsRing(history_s=10.0, max_series=max_series,
+                       scrape_interval_s=0.5)
+
+    def exposition(snap: int) -> str:
+        lines = []
+        for n in range(nodes):
+            for s in range(per_node):
+                # 5% of series churn their label value each snapshot
+                # (restarting workers) — the stale-eviction source.
+                gen = snap if s % 20 == 0 else 0
+                lines.append(
+                    f'ray_tpu_worker_cpu_percent{{node_id="n{n:02d}",'
+                    f'worker_id="w{s}g{gen}"}} {float(snap + s)}')
+        return "\n".join(lines)
+
+    tracemalloc.start()
+    ts = 1_000_000.0
+    warm_bytes = 0
+    for snap in range(120):
+        ts += 0.5
+        ring.ingest_text(ts, exposition(snap))
+        if snap == 40:
+            warm_bytes = tracemalloc.get_traced_memory()[0]
+    end_bytes = tracemalloc.get_traced_memory()[0]
+    tracemalloc.stop()
+    dead_dropped = ring.age_out_node("n00")
+    bounded = (ring.series_count() <= max_series
+               and end_bytes < warm_bytes * 1.5)
+    # Stale aging is proven at unit level (tests/test_signal_plane.py):
+    # under cap pressure the churned series are LRU-evicted as
+    # series_cap before they can turn stale, so it isn't required here.
+    return {
+        "nodes": nodes,
+        "series_offered": nodes * per_node,
+        "max_series": max_series,
+        "series_final": ring.series_count(),
+        "warm_bytes": warm_bytes,
+        "end_bytes": end_bytes,
+        "growth_ratio": round(end_bytes / max(1, warm_bytes), 3),
+        "evictions": dict(ring.evictions),
+        "dead_node_series_dropped": dead_dropped,
+        "ok": bool(bounded and ring.evictions["series_cap"] > 0
+                   and dead_dropped > 0),
+    }
+
+
+def _section_slo(state, obs, cluster_address: str):
+    """Seeded TTFT-SLO burn: ok -> burning -> ok with the pubsub SLO
+    channel subscribed on both edges."""
+    from ray_tpu.cluster.gcs_client import GcsClient
+
+    gcs = GcsClient(cluster_address)
+    gcs.pubsub.subscribe("signal_bench", "SLO")
+    reg = state.register_slo(
+        "bench-ttft", f'ttft_p50{{deployment="{DEP}"}} < 50ms over 2s')
+    if not reg.get("ok"):
+        return {"ok": False, "error": reg.get("error")}
+
+    events: list = []
+
+    def drain(deadline_s: float, until_state=None):
+        end = time.time() + deadline_s
+        while time.time() < end:
+            res = gcs.pubsub.poll("signal_bench", timeout=0.5)
+            for msg in (res[0] if res else []):  # poll -> (msgs, dropped)
+                ev = msg.get("data") or {}
+                if ev.get("slo") == "bench-ttft":
+                    events.append(ev)
+            if until_state and any(
+                    e["state"] == until_state for e in events):
+                return True
+            time.sleep(EVAL_S)
+        return False
+
+    # Phase 1: fast traffic — the SLO must settle at ok, no events.
+    _drive(obs, 1.0, 200.0, [0.005])
+    drain(0.5)
+    # Phase 2: slow traffic — windowed p50 climbs over threshold,
+    # hysteresis counts BURN_EVALS breaches, ONE burning event fires.
+    _drive(obs, 2.5, 100.0, [0.5])
+    burned = drain(10.0, until_state="burning")
+    # Phase 3: fast traffic flushes the slow samples out of the 2s
+    # window; BURN_EVALS clean evals recover it — ONE recovery event.
+    recover_end = time.time() + 20.0
+    recovered = False
+    while time.time() < recover_end and not recovered:
+        _drive(obs, 0.5, 400.0, [0.005])
+        recovered = drain(0.5, until_state="ok")
+    status = state.slo_status()
+    slo_now = (status.get("slos") or {}).get("bench-ttft") or {}
+
+    # `ray-tpu slo` must tell the same story (in-process CLI call —
+    # same head, same ring).
+    from ray_tpu.scripts import cli
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli.main(["--address", cluster_address, "slo", "--json"])
+    cli_view = json.loads(buf.getvalue())
+    cli_state = ((cli_view.get("slos") or {})
+                 .get("bench-ttft") or {}).get("state")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli.main(["--address", cluster_address, "top", "--json"])
+    top_view = json.loads(buf.getvalue())
+
+    burning_events = [e for e in events if e["state"] == "burning"]
+    recovery_events = [e for e in events if e["state"] == "ok"]
+    return {
+        "burned": burned,
+        "recovered": recovered,
+        "burning_events": len(burning_events),
+        "recovery_events": len(recovery_events),
+        "final_state": slo_now.get("state"),
+        "cli_state": cli_state,
+        "cli_top_series": top_view.get("series"),
+        "transitions": slo_now.get("transitions"),
+        "events": events,
+        "ok": bool(len(burning_events) == 1
+                   and len(recovery_events) == 1
+                   and slo_now.get("state") == "ok"
+                   and cli_state == "ok"),
+    }
+
+
+def run() -> dict:
+    from ray_tpu.core.config import config
+
+    config.override("signal_scrape_interval_s", SCRAPE_S)
+    config.override("slo_eval_interval_s", EVAL_S)
+    config.override("slo_burn_evals", BURN_EVALS)
+    config.override("signal_history_s", 600.0)
+
+    import ray_tpu
+    from ray_tpu import serve, state
+    from ray_tpu.cluster.cluster_utils import Cluster
+    from ray_tpu.serve import _observability as obs
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(cluster.address)
+    try:
+        agreement = _section_agreement(state, serve, obs)
+        ring = _section_ring()
+        slo = _section_slo(state, obs, cluster.address)
+        status = state.slo_status()
+        return {
+            "scrape_interval_s": SCRAPE_S,
+            "agreement": agreement,
+            "ring": ring,
+            "slo": slo,
+            "head_series": status.get("series"),
+            "head_evictions": status.get("evictions"),
+            "ok": bool(agreement["ok"] and ring["ok"] and slo["ok"]),
+        }
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        for knob in ("signal_scrape_interval_s", "slo_eval_interval_s",
+                     "slo_burn_evals", "signal_history_s"):
+            config.reset(knob)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="Signal-plane harness: windowed-query agreement, "
+                    "bounded ring memory, seeded SLO burn")
+    ap.add_argument("--out", default=None,
+                    help="merge the signal_plane section into this "
+                         "MICROBENCH-style artifact")
+    args = ap.parse_args()
+
+    res = run()
+
+    from ray_tpu.scripts import bench_log
+
+    entry = bench_log.record_signal_plane(
+        agreement={"ok": res["agreement"]["ok"],
+                   **res["agreement"]["checks"]},
+        query_p50_ms=res["agreement"]["query_p50_ms"],
+        series=res["head_series"] or 0,
+        ring={k: res["ring"][k] for k in
+              ("series_final", "growth_ratio", "evictions", "ok")},
+        slo={k: res["slo"][k] for k in
+             ("burning_events", "recovery_events", "final_state", "ok")
+             if k in res["slo"]},
+        device=bench_log.device_kind(), script="signal_bench")
+    res["evidence"] = {"committed_to": entry.get("committed_to")}
+
+    if args.out:
+        # Merge-preserve: every perfsuite stage owns one section.
+        payload = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                try:
+                    payload = json.load(f)
+                except ValueError:
+                    payload = {}
+        payload["signal_plane"] = res
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(res, indent=1, default=str))
+    if not res["ok"]:
+        print("signal_bench: FAILED — see 'agreement'/'ring'/'slo' "
+              "(either the windowed queries disagree with the client "
+              "ledger, the ring memory is unbounded, or the seeded SLO "
+              "burn did not fire exactly one burning + one recovery "
+              "event)", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

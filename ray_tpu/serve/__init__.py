@@ -197,14 +197,18 @@ def status() -> Dict[str, dict]:
     return ray_tpu.get(controller.status.remote(), timeout=30)
 
 
-def stats(window_s: float = 0.0) -> Dict[str, dict]:
+def stats(window_s: float = 0.0,
+          allow_sleep: bool = True) -> Dict[str, dict]:
     """Per-deployment serving stats from the SLO latency plane:
     replica counts, p50/p99/mean request latency, per-phase breakdown
     (route / queue_wait / batch_wait / execute / serialize), status and
     shed counts, live ongoing/queued gauges. ``window_s > 0`` adds a
-    measured QPS over that window. Surfaced as ``ray-tpu serve stats``
+    measured QPS over that window — answered from the head's metrics
+    history ring when one is reachable; ``allow_sleep=False`` forbids
+    the off-cluster double-scrape fallback (request paths like the
+    dashboard must never stall). Surfaced as ``ray-tpu serve stats``
     and the dashboard's ``/api/serve_stats``."""
-    return _observability.stats(window_s)
+    return _observability.stats(window_s, allow_sleep=allow_sleep)
 
 
 _proxy_handle = None

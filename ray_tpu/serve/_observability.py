@@ -32,7 +32,6 @@ from __future__ import annotations
 import collections
 import contextvars
 import os
-import re
 import threading
 import time
 from contextlib import contextmanager
@@ -178,6 +177,18 @@ def record_ttft(deployment: str, seconds: float) -> None:
     _emit({"k": "ttft", "d": deployment, "s": float(seconds)})
 
 
+def record_decode_itl(deployment: str, seconds: float,
+                      tokens: int) -> None:
+    """Inter-token latency (TPOT) for one decode step: every token the
+    step produced arrived ``seconds`` after its stream's previous one
+    (slots advance in lockstep), so one event carries the shared gap
+    and the token count — replayed as ``tokens`` histogram
+    observations."""
+    if tokens > 0 and seconds >= 0:
+        _emit({"k": "itl", "d": deployment, "s": float(seconds),
+               "n": int(tokens)})
+
+
 def record_decode_tokens(deployment: str, tokens: int) -> None:
     """Tokens produced outside a decode step (the prefill lane samples
     each admitted stream's FIRST token from the prefill logits)."""
@@ -262,6 +273,16 @@ def apply_events(events: List[dict], node_id: str,
                 _metrics.SERVE_DECODE_TTFT_SECONDS.observe(
                     float(ev.get("s", 0.0)),
                     tags={"node_id": node_id, "deployment": dep})
+            elif kind == "itl":
+                # One observation per token the step produced (the gap
+                # is shared across the batch's streams); bounded far
+                # above any real slot count so a corrupt event can't
+                # spin the replay.
+                gap = float(ev.get("s", 0.0))
+                for _ in range(min(int(ev.get("n", 0)), 4096)):
+                    _metrics.SERVE_DECODE_ITL_SECONDS.observe(
+                        gap, tags={"node_id": node_id,
+                                   "deployment": dep})
             elif kind == "dtok":
                 _metrics.SERVE_DECODE_TOKENS_TOTAL.inc(
                     float(ev.get("n", 0)),
@@ -295,129 +316,21 @@ def retract_gauges(keys, node_id: str) -> None:
 
 
 # -- reading the plane back (serve.stats / serve_bench cross-check) --------
+# The parser lives in util/metrics.py since the signal plane made it
+# cluster infrastructure (the head's history ring ingests the same
+# exposition this module reads back); re-exported here so every
+# existing caller — goodput.py, the benches, the tests — keeps one
+# import path and one definition.
 
-_SAMPLE_RE = re.compile(
-    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([^\s]+)$")
-_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
-
-
-def parse_prometheus(text: str) -> Dict[str, Dict[tuple, float]]:
-    """Exposition text -> {metric_name: {sorted (label, value) tuple:
-    sample value}} (comments skipped; NaN-free by construction here)."""
-    out: Dict[str, Dict[tuple, float]] = {}
-    for line in (text or "").splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        m = _SAMPLE_RE.match(line)
-        if not m:
-            continue
-        name, labels_raw, value = m.groups()
-        try:
-            val = float(value)
-        except ValueError:
-            continue
-        labels = tuple(sorted(_LABEL_RE.findall(labels_raw or "")))
-        out.setdefault(name, {})[labels] = val
-    return out
-
-
-def _labels_get(labels: tuple, key: str) -> Optional[str]:
-    for k, v in labels:
-        if k == key:
-            return v
-    return None
-
-
-def sum_counter(parsed: dict, name: str, group_label: str,
-                **match: str) -> Dict[str, float]:
-    """Sum a family's samples across node_id (and any other untagged
-    label), grouped by one label, filtered by exact label matches."""
-    out: Dict[str, float] = {}
-    for labels, val in (parsed.get(name) or {}).items():
-        if any(_labels_get(labels, k) != v for k, v in match.items()):
-            continue
-        key = _labels_get(labels, group_label) or ""
-        out[key] = out.get(key, 0.0) + val
-    return out
-
-
-def histogram_dist(parsed: dict, name: str, **match: str) -> Optional[dict]:
-    """One histogram's cumulative buckets/sum/count, summed across
-    node_id, filtered by exact label matches (e.g. deployment=...,
-    phase=...). Returns {"buckets": [(le, cum)], "sum": s, "count": n}
-    or None when no sample matched."""
-    buckets: Dict[float, float] = {}
-    total = 0.0
-    count = 0.0
-    seen = False
-    for labels, val in (parsed.get(name + "_bucket") or {}).items():
-        if any(_labels_get(labels, k) != v for k, v in match.items()):
-            continue
-        le_raw = _labels_get(labels, "le")
-        le = float("inf") if le_raw == "+Inf" else float(le_raw)
-        buckets[le] = buckets.get(le, 0.0) + val
-        seen = True
-    for labels, val in (parsed.get(name + "_sum") or {}).items():
-        if not any(_labels_get(labels, k) != v for k, v in match.items()):
-            total += val
-    for labels, val in (parsed.get(name + "_count") or {}).items():
-        if not any(_labels_get(labels, k) != v for k, v in match.items()):
-            count += val
-    if not seen or count <= 0:
-        return None
-    return {"buckets": sorted(buckets.items()), "sum": total,
-            "count": count}
-
-
-def quantile_from_buckets(dist: Optional[dict], q: float) -> Optional[float]:
-    """Prometheus-style histogram_quantile: linear interpolation inside
-    the bucket containing the q-th sample (the +Inf bucket clamps to the
-    last finite bound — same convention as PromQL)."""
-    if not dist:
-        return None
-    buckets = dist["buckets"]
-    total = dist["count"]
-    rank = q * total
-    prev_le, prev_cum = 0.0, 0.0
-    last_finite = 0.0
-    for le, cum in buckets:
-        if le != float("inf"):
-            last_finite = le
-        if cum >= rank and cum > prev_cum:
-            if le == float("inf"):
-                return last_finite
-            frac = (rank - prev_cum) / (cum - prev_cum)
-            return prev_le + (le - prev_le) * frac
-        prev_le, prev_cum = (0.0 if le == float("inf") else le), cum
-    return last_finite
-
-
-def bucket_width_at(dist: Optional[dict], value: float) -> float:
-    """Width of the histogram bucket a value falls in — the resolution
-    floor for any client/server latency agreement check."""
-    if not dist:
-        return float("inf")
-    prev = 0.0
-    for le, _ in dist["buckets"]:
-        if le == float("inf"):
-            break
-        if value <= le:
-            return le - prev
-        prev = le
-    return float("inf")
-
-
-def diff_parsed(before: dict, after: dict) -> dict:
-    """Per-series ``after - before`` (counters/histogram buckets): lets
-    a bench isolate ITS requests from whatever the shared registry
-    already accumulated."""
-    out: Dict[str, Dict[tuple, float]] = {}
-    for name, series in after.items():
-        base = before.get(name) or {}
-        out[name] = {labels: val - base.get(labels, 0.0)
-                     for labels, val in series.items()}
-    return out
+from ray_tpu.util.metrics import (  # noqa: E402,F401
+    _labels_get,
+    bucket_width_at,
+    diff_parsed,
+    histogram_dist,
+    parse_prometheus,
+    quantile_from_buckets,
+    sum_counter,
+)
 
 
 def metrics_text() -> str:
@@ -503,6 +416,12 @@ def decode_stats(parsed: dict, deployment: str) -> dict:
             else None
         out["ttft_p99_ms"] = round(p99 * 1e3, 3) if p99 is not None \
             else None
+    itl = histogram_dist(parsed, "ray_tpu_serve_decode_itl_seconds",
+                         deployment=deployment)
+    if itl:
+        p50 = quantile_from_buckets(itl, 0.50)
+        out["itl_p50_ms"] = round(p50 * 1e3, 3) if p50 is not None \
+            else None
     steps = histogram_dist(parsed, "ray_tpu_serve_decode_step_seconds",
                            deployment=deployment)
     if steps:
@@ -521,13 +440,48 @@ def decode_stats(parsed: dict, deployment: str) -> dict:
     return out
 
 
-def stats(window_s: float = 0.0) -> dict:
+def _history_deltas(window_s: float):
+    """Windowed per-series deltas of the request counter from the
+    head's signal-plane history ring — zero sleeps; returns
+    ``(deltas, actual_window_s)`` or ``(None, 0.0)`` when no ring is
+    reachable (local backend, signal plane disabled, or the ring
+    hasn't two samples yet)."""
+    from ray_tpu._private import worker as _worker
+
+    try:
+        backend = _worker.backend()
+    except Exception:
+        return None, 0.0
+    if backend is None or not hasattr(backend, "query_metrics"):
+        return None, 0.0
+    try:
+        res = backend.query_metrics(
+            {"op": "series_delta",
+             "name": "ray_tpu_serve_requests_total",
+             "window_s": float(window_s)})
+    except Exception:
+        return None, 0.0
+    if not isinstance(res, dict) or not res.get("ok"):
+        return None, 0.0
+    actual = float(res.get("window_s") or 0.0)
+    if actual <= 0:
+        return None, 0.0
+    series = {tuple(tuple(kv) for kv in labels): float(v)
+              for labels, v in (res.get("series") or [])}
+    return {"ray_tpu_serve_requests_total": series}, actual
+
+
+def stats(window_s: float = 0.0, allow_sleep: bool = True) -> dict:
     """Per-deployment serving stats (``serve.stats()`` / ``ray-tpu serve
     stats`` / dashboard ``/api/serve_stats``): replica counts from the
     controller's routing table joined with p50/p99/mean, status counts,
     shed counts and live gauges from the metrics plane. With
-    ``window_s > 0`` a second scrape after the window adds ``qps`` and
-    ``window_count`` deltas."""
+    ``window_s > 0`` the head's signal-plane history ring answers the
+    windowed ``qps`` / ``window_count`` deltas with ZERO sleeps; only
+    off-cluster (local backend, ring disabled) does the old
+    sleep-between-two-scrapes fallback run — and callers in a request
+    path (the single-threaded dashboard) pass ``allow_sleep=False`` to
+    skip the window instead of stalling."""
     import ray_tpu
     from ray_tpu.serve import _private as sp
 
@@ -544,11 +498,15 @@ def stats(window_s: float = 0.0) -> dict:
     text0 = metrics_text()
     parsed = parse_prometheus(text0)
     deltas: Optional[dict] = None
+    window_used = 0.0
     if window_s and window_s > 0:
-        time.sleep(window_s)
-        parsed_after = parse_prometheus(metrics_text())
-        deltas = diff_parsed(parsed, parsed_after)
-        parsed = parsed_after
+        deltas, window_used = _history_deltas(window_s)
+        if deltas is None and allow_sleep:
+            time.sleep(window_s)
+            parsed_after = parse_prometheus(metrics_text())
+            deltas = diff_parsed(parsed, parsed_after)
+            parsed = parsed_after
+            window_used = float(window_s)
     deployments = {}
     names = set(table) | set(
         sum_counter(parsed, "ray_tpu_serve_requests_total", "deployment"))
@@ -559,11 +517,11 @@ def stats(window_s: float = 0.0) -> dict:
             entry["max_concurrent_queries"] = \
                 table[name]["max_concurrent_queries"]
             entry["route_prefix"] = table[name]["route_prefix"]
-        if deltas is not None:
+        if deltas is not None and window_used > 0:
             done = sum(sum_counter(
                 deltas, "ray_tpu_serve_requests_total", "deployment",
                 deployment=name).values())
-            entry["qps"] = round(done / window_s, 2)
+            entry["qps"] = round(done / window_used, 2)
             entry["window_count"] = int(done)
         deployments[name] = entry
     out = {"deployments": deployments}

@@ -221,6 +221,12 @@ class LLMEngine:
         self._stop = False
         self._seq = 0
         self._step_errors_row = 0
+        # Last wall-clock instant a token batch reached the streams —
+        # the previous edge of the inter-token-latency (TPOT) gap.
+        # None until the first prefill delivers (the first decode step
+        # after a gap measures from the last delivery, so ITL includes
+        # scheduling stalls between steps, not just compute).
+        self._last_tokens_at: Optional[float] = None
         self._last_reap = time.monotonic()
         self.stats_counters = {
             "steps": 0, "admitted": 0, "completed": 0, "shed": 0,
@@ -359,6 +365,7 @@ class LLMEngine:
                 _obs.record_ttft(self._dep, max(0.0, now - req.submitted))
                 if req.remaining <= 0 or tok == self.eos_token:
                     self._finish_locked(req, done=True, slot=slot)
+            self._last_tokens_at = now
 
     def _step_once(self) -> bool:  # jax-hot-path
         np = self._np
@@ -425,7 +432,16 @@ class LLMEngine:
             self.stats_counters["steps"] += 1
             self.stats_counters["tokens_out"] += produced
             self.stats_counters["occupancy_sum"] += len(active)
+            # ITL (TPOT): delivery-to-delivery gap. All slots advance
+            # in lockstep, so every token this step produced arrived
+            # the same gap after its stream's previous one — one event
+            # carries the shared gap plus the token count.
+            done_at = time.time()
+            itl = step_s if self._last_tokens_at is None \
+                else max(0.0, done_at - self._last_tokens_at)
+            self._last_tokens_at = done_at
         _obs.record_decode_step(self._dep, step_s, len(active), produced)
+        _obs.record_decode_itl(self._dep, itl, produced)
         if self.step_throttle_s:
             time.sleep(self.step_throttle_s)
         return True

@@ -241,6 +241,57 @@ def train_stats() -> dict:
     return goodput.train_stats()
 
 
+def query_metrics(spec: dict) -> dict:
+    """Windowed query against the head's metrics history ring (the
+    signal plane): ``{"op": "rate"|"delta"|"gauge_avg"|"gauge_max"|
+    "gauge_last"|"trend"|"quantile"|"series_delta", "name": family,
+    "window_s": s, "q"?, "match"?, "group_by"?}``. Answers
+    ``{"ok": False, "error": ...}`` off-cluster or with the plane
+    disabled — never raises for a cold ring."""
+    backend = _worker.backend()
+    if hasattr(backend, "query_metrics"):
+        return backend.query_metrics(spec)
+    return {"ok": False, "error": "no cluster backend"}
+
+
+def slo_status() -> dict:
+    """Every registered SLO's burn-rate state (ok/warning/burning),
+    last evaluated value, threshold, and streaks — plus the ring's
+    series count and eviction ledger."""
+    backend = _worker.backend()
+    if hasattr(backend, "slo_status"):
+        return backend.slo_status()
+    return {"ok": False, "error": "no cluster backend"}
+
+
+def register_slo(name: str, expr: str) -> dict:
+    """Register a declarative SLO evaluated by the head's burn-rate
+    loop, e.g. ``ttft_p50{deployment="d"} < 2s over 60s`` or
+    ``shed_ratio < 1% over 300s``. Transitions to/from burning publish
+    events on the pubsub ``SLO`` channel."""
+    backend = _worker.backend()
+    if hasattr(backend, "register_slo"):
+        return backend.register_slo(name, expr)
+    return {"ok": False, "error": "no cluster backend"}
+
+
+def remove_slo(name: str) -> dict:
+    backend = _worker.backend()
+    if hasattr(backend, "remove_slo"):
+        return backend.remove_slo(name)
+    return {"ok": False, "error": "no cluster backend"}
+
+
+def signal_top(window_s: float = 60.0) -> dict:
+    """The ``ray-tpu top`` rollup: per-node CPU/RSS/store occupancy,
+    per-deployment QPS/TTFT/shed, per-trial goodput — every number a
+    history-ring query, zero sleeps in the path."""
+    backend = _worker.backend()
+    if hasattr(backend, "signal_top"):
+        return backend.signal_top(window_s)
+    return {"ok": False, "error": "no cluster backend"}
+
+
 def set_failpoints(specs: dict, include_workers: bool = True) -> dict:
     """Arm/disarm deterministic failpoints cluster-wide: ``{site: spec}``
     where spec is ``action[:arg][,selector...]`` (see

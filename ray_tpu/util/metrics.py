@@ -7,6 +7,7 @@ agent pipeline collapses to an in-process registry with a text endpoint.
 
 from __future__ import annotations
 
+import re as _re
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -447,6 +448,66 @@ SERVE_DECODE_TOKENS_TOTAL = Counter(
     "decode-step tokens, all streams)",
     tag_keys=("node_id", "deployment"),
 )
+SERVE_DECODE_ITL_SECONDS = Histogram(
+    "ray_tpu_serve_decode_itl_seconds",
+    "Inter-token latency (TPOT) per decode-step token: wall time from "
+    "a stream's previous token to this one, engine-side (the decode "
+    "half of the TTFT/TPOT SLO pair — a full batch with climbing ITL "
+    "is a step-latency problem, not an admission problem)",
+    boundaries=[0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5],
+    tag_keys=("node_id", "deployment"),
+)
+
+# -- signal plane (head metrics history ring + SLO burn-rate layer,
+# cluster/signals.py): the head self-scrapes its own federated
+# /metrics/cluster into a bounded time-series ring and answers windowed
+# queries from history — these families are the plane's SELF-overhead
+# accounting (the TPU-concurrency-limits lesson: host-side sensing is a
+# first-order cost, so the sensor charges itself on the same scrape it
+# feeds) plus the SLO layer's exported burn state.
+HEAD_SIGNAL_SCRAPE_SECONDS = Histogram(
+    "ray_tpu_head_signal_scrape_seconds",
+    "Wall time of one head signal-plane self-scrape (federated "
+    "cluster_metrics_text render + parse + ring ingest)",
+    boundaries=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5],
+)
+HEAD_SIGNAL_SERIES = Gauge(
+    "ray_tpu_head_signal_series",
+    "Distinct time series retained in the head's signal-plane history "
+    "ring (bounded by signal_max_series)",
+)
+HEAD_SIGNAL_EVICTIONS_TOTAL = Counter(
+    "ray_tpu_head_signal_evictions_total",
+    "Series evicted from the head's signal-plane ring, by reason "
+    "(series_cap = ring full at signal_max_series, dead_node = node "
+    "died, stale = series stopped reporting for a full history window)",
+    tag_keys=("reason",),
+)
+AGENT_METRICS_RENDER_SECONDS = Gauge(
+    "ray_tpu_agent_metrics_render_seconds",
+    "Wall seconds the node agent spent rendering its previous "
+    "metrics_text response (the per-node sensing cost every federated "
+    "scrape fan-out pays; one scrape behind by construction — the "
+    "cost isn't known until the body is rendered)",
+    tag_keys=("node_id",),
+)
+SLO_STATE = Gauge(
+    "ray_tpu_slo_state",
+    "Burn-rate state of a registered SLO (0=ok 1=warning 2=burning)",
+    tag_keys=("slo",),
+)
+SLO_VALUE = Gauge(
+    "ray_tpu_slo_value",
+    "Most recent windowed value of a registered SLO's signal",
+    tag_keys=("slo",),
+)
+SLO_THRESHOLD = Gauge(
+    "ray_tpu_slo_threshold",
+    "Configured threshold of a registered SLO",
+    tag_keys=("slo",),
+)
 
 # -- training goodput plane (input-pipeline + per-step train telemetry:
 # dataset stages, consumer-loop stall accounting, session-driven step
@@ -732,6 +793,137 @@ def merge_prometheus(chunks: Sequence[str]) -> str:
                 seen_series.add(series)
             out.append(line)
     return "\n".join(out) + "\n"
+
+
+# -- reading an exposition back (one parser for serve.stats, the bench
+# cross-checks AND the head's signal-plane history ring — the same
+# definition everywhere, so a windowed query and a client-side
+# measurement can never disagree about what the text says). Moved here
+# from serve/_observability.py (which re-exports) when the signal plane
+# made the parser cluster infrastructure rather than a serve detail.
+
+_SAMPLE_RE = _re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([^\s]+)$")
+_LABEL_RE = _re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def parse_prometheus(text: str) -> Dict[str, Dict[tuple, float]]:
+    """Exposition text -> {metric_name: {sorted (label, value) tuple:
+    sample value}} (comments skipped; NaN-free by construction here)."""
+    out: Dict[str, Dict[tuple, float]] = {}
+    for line in (text or "").splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labels_raw, value = m.groups()
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        labels = tuple(sorted(_LABEL_RE.findall(labels_raw or "")))
+        out.setdefault(name, {})[labels] = val
+    return out
+
+
+def _labels_get(labels: tuple, key: str) -> Optional[str]:
+    for k, v in labels:
+        if k == key:
+            return v
+    return None
+
+
+def sum_counter(parsed: dict, name: str, group_label: str,
+                **match: str) -> Dict[str, float]:
+    """Sum a family's samples across node_id (and any other untagged
+    label), grouped by one label, filtered by exact label matches."""
+    out: Dict[str, float] = {}
+    for labels, val in (parsed.get(name) or {}).items():
+        if any(_labels_get(labels, k) != v for k, v in match.items()):
+            continue
+        key = _labels_get(labels, group_label) or ""
+        out[key] = out.get(key, 0.0) + val
+    return out
+
+
+def histogram_dist(parsed: dict, name: str, **match: str) -> Optional[dict]:
+    """One histogram's cumulative buckets/sum/count, summed across
+    node_id, filtered by exact label matches (e.g. deployment=...,
+    phase=...). Returns {"buckets": [(le, cum)], "sum": s, "count": n}
+    or None when no sample matched."""
+    buckets: Dict[float, float] = {}
+    total = 0.0
+    count = 0.0
+    seen = False
+    for labels, val in (parsed.get(name + "_bucket") or {}).items():
+        if any(_labels_get(labels, k) != v for k, v in match.items()):
+            continue
+        le_raw = _labels_get(labels, "le")
+        le = float("inf") if le_raw == "+Inf" else float(le_raw)
+        buckets[le] = buckets.get(le, 0.0) + val
+        seen = True
+    for labels, val in (parsed.get(name + "_sum") or {}).items():
+        if not any(_labels_get(labels, k) != v for k, v in match.items()):
+            total += val
+    for labels, val in (parsed.get(name + "_count") or {}).items():
+        if not any(_labels_get(labels, k) != v for k, v in match.items()):
+            count += val
+    if not seen or count <= 0:
+        return None
+    return {"buckets": sorted(buckets.items()), "sum": total,
+            "count": count}
+
+
+def quantile_from_buckets(dist: Optional[dict], q: float) -> Optional[float]:
+    """Prometheus-style histogram_quantile: linear interpolation inside
+    the bucket containing the q-th sample (the +Inf bucket clamps to the
+    last finite bound — same convention as PromQL)."""
+    if not dist:
+        return None
+    buckets = dist["buckets"]
+    total = dist["count"]
+    rank = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    last_finite = 0.0
+    for le, cum in buckets:
+        if le != float("inf"):
+            last_finite = le
+        if cum >= rank and cum > prev_cum:
+            if le == float("inf"):
+                return last_finite
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = (0.0 if le == float("inf") else le), cum
+    return last_finite
+
+
+def bucket_width_at(dist: Optional[dict], value: float) -> float:
+    """Width of the histogram bucket a value falls in — the resolution
+    floor for any client/server latency agreement check."""
+    if not dist:
+        return float("inf")
+    prev = 0.0
+    for le, _ in dist["buckets"]:
+        if le == float("inf"):
+            break
+        if value <= le:
+            return le - prev
+        prev = le
+    return float("inf")
+
+
+def diff_parsed(before: dict, after: dict) -> dict:
+    """Per-series ``after - before`` (counters/histogram buckets): lets
+    a bench isolate ITS requests from whatever the shared registry
+    already accumulated."""
+    out: Dict[str, Dict[tuple, float]] = {}
+    for name, series in after.items():
+        base = before.get(name) or {}
+        out[name] = {labels: val - base.get(labels, 0.0)
+                     for labels, val in series.items()}
+    return out
 
 
 def file_sd_targets(address: str, labels: Optional[Dict[str, str]] = None,
