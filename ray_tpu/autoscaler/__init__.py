@@ -4,10 +4,35 @@ Reference parity: ``python/ray/autoscaler`` (SURVEY.md §2.2) —
 ``StandardAutoscaler.update`` reconciles resource demand against running
 nodes (``_private/autoscaler.py:167``), a ``ResourceDemandScheduler``
 bin-packs pending demands over node types
-(``_private/resource_demand_scheduler.py:103``), and ``NodeProvider``
+(``_private/resource_demand_scheduler.py:103,171``), and ``NodeProvider``
 plugins do the actual provisioning (local/fake providers for tests,
 ``fake_multi_node/node_provider.py``). The TPU deployment target is pods:
 a node type maps to a TPU host shape (e.g. ``{"CPU": 8, "TPU": 4}``).
+
+Round 17 — the execution half is robustness-first (Podracer runs fleets
+on preemptible pods; preemption and boot failure are the NORMAL case):
+
+* **Bin-packing over real pending demand.** The head's
+  ``demand_snapshot`` merges queued task demands, pending (RESTARTING)
+  actors and the unplaced bundles of PENDING/RESCHEDULING placement
+  groups; the packer sizes a heterogeneous node-type catalog against
+  it. STRICT_SPREAD bundles need N distinct nodes, not N bundles-worth
+  of one node; a ``spot: false`` gang only counts against on-demand
+  types.
+* **Quarantine/backoff boot loop.** Every launch runs under a
+  wall-clock timeout; a failed type waits out a jittered exponential
+  backoff, and N consecutive failures bench the type for a cooldown —
+  demand falls through to the next feasible type, and a flapping
+  provider can never hot-loop ``create_node``.
+* **Zero-goodput-loss scale-down.** Idle nodes (occupancy-coldest
+  first, ranked by windowed signal-ring queries) drain through the
+  head's ``ALIVE -> DRAINING -> DEAD`` protocol; the provider
+  terminate only fires once the head reports the node dead, and the
+  head gets a ``terminate_ack`` so the ledger closes.
+* **SLO-burn scale-up.** The reconcile loop subscribes to the head's
+  SLO pubsub channel; a burning SLO (``ttft_p50``,
+  ``queue_depth_trend``, ...) adds one node-shape of demand ahead of
+  the pending-work signal.
 """
 
 from __future__ import annotations
@@ -17,6 +42,7 @@ import time
 from typing import Dict, List, Optional
 
 from ray_tpu.cluster.rpc import RpcClient
+from ray_tpu.util import failpoints
 
 
 class NodeProvider:
@@ -44,6 +70,8 @@ class LocalNodeProvider(NodeProvider):
         agent = self.cluster.add_node(
             num_cpus=node_config.get("num_cpus"),
             resources=node_config.get("resources"),
+            labels={"node_type": node_type,
+                    "spot": bool(node_config.get("spot", False))},
         )
         self._agents[agent.node_id] = agent
         return agent.node_id
@@ -60,6 +88,18 @@ class LocalNodeProvider(NodeProvider):
         ]
 
 
+class _TypeState:
+    """Per-node-type boot-loop state: consecutive failures, the backoff
+    gate, and the quarantine bench."""
+
+    __slots__ = ("failures", "next_attempt", "quarantined_until")
+
+    def __init__(self):
+        self.failures = 0
+        self.next_attempt = 0.0       # monotonic; 0 = launch freely
+        self.quarantined_until = 0.0  # monotonic; 0 = not benched
+
+
 class StandardAutoscaler:
     """One reconcile step per ``update()``; ``start()`` loops it."""
 
@@ -73,6 +113,11 @@ class StandardAutoscaler:
         idle_timeout_s: float = 60.0,
         launch_cooldown_s: float = 2.0,
         drain_deadline_s: float | None = None,
+        launch_timeout_s: float | None = None,
+        backoff_base_s: float | None = None,
+        backoff_max_s: float | None = None,
+        quarantine_failures: int | None = None,
+        quarantine_cooldown_s: float | None = None,
     ):
         from ray_tpu.core.config import config
 
@@ -85,64 +130,402 @@ class StandardAutoscaler:
         self.drain_deadline_s = (
             config.drain_deadline_s if drain_deadline_s is None
             else drain_deadline_s)
+        self.launch_timeout_s = (
+            config.autoscaler_launch_timeout_s if launch_timeout_s is None
+            else launch_timeout_s)
+        self.backoff_base_s = (
+            config.autoscaler_launch_backoff_base_s if backoff_base_s is None
+            else backoff_base_s)
+        self.backoff_max_s = (
+            config.autoscaler_launch_backoff_max_s if backoff_max_s is None
+            else backoff_max_s)
+        self.quarantine_failures = (
+            config.autoscaler_quarantine_failures
+            if quarantine_failures is None else quarantine_failures)
+        self.quarantine_cooldown_s = (
+            config.autoscaler_quarantine_cooldown_s
+            if quarantine_cooldown_s is None else quarantine_cooldown_s)
         # Nodes whose scale-down drain was initiated; terminated once
         # the head reports them DEAD (possibly on a later pass).
-        self._draining: set = set()
+        # Insertion-ordered (dict-as-set): drains started first are
+        # reaped (and ledger-acked) first.
+        self._draining: Dict[str, None] = {}
         self._idle_since: Dict[str, float] = {}
         self._last_launch = 0.0
         self._stop = threading.Event()
         self.launched: List[str] = []
+        # Boot-loop state per type + which type each provider node is.
+        self._type_state: Dict[str, _TypeState] = {}
+        self._node_type_of: Dict[str, str] = {}
+        # SLO-burn subscription state: active burns + boosts not yet
+        # absorbed by a launch.
+        self._slo_sub_id = f"autoscaler-{id(self):x}"
+        self._slo_subscribed = False
+        self._slo_burn: Dict[str, float] = {}
+        self._boosts: List[str] = []
+
+    # -- node-type catalog -------------------------------------------------
+
+    def _shape(self, type_name: str) -> Dict[str, float]:
+        cfg = self.node_types[type_name]
+        total = {"CPU": float(cfg.get("num_cpus", 0) or 0)}
+        total.update(cfg.get("resources") or {})
+        return total
+
+    def _is_spot(self, type_name: str) -> bool:
+        return bool(self.node_types[type_name].get("spot", False))
+
+    def _type_cap(self, type_name: str) -> Optional[int]:
+        cap = self.node_types[type_name].get("max_workers")
+        return None if cap is None else int(cap)
+
+    def _state_of(self, type_name: str) -> _TypeState:
+        st = self._type_state.get(type_name)
+        if st is None:
+            st = self._type_state[type_name] = _TypeState()
+        return st
+
+    def _quarantined(self, type_name: str, now: float) -> bool:
+        return now < self._state_of(type_name).quarantined_until
+
+    # -- demand normalization ---------------------------------------------
+
+    @staticmethod
+    def _entry(resources: dict, kind: str, *, group: str | None = None,
+               strict_spread: bool = False, spot_ok: bool = True) -> dict:
+        return {"resources": dict(resources), "kind": kind, "group": group,
+                "strict_spread": strict_spread, "spot_ok": spot_ok}
+
+    def _normalize(self, demands) -> List[dict]:
+        """Accepts the rich ``demand_snapshot`` dict, a legacy flat list
+        of resource dicts, or an already-normalized entry list."""
+        if isinstance(demands, dict):
+            entries = [self._entry(d, "task")
+                       for d in demands.get("tasks") or [] if d]
+            entries += [self._entry(d, "actor")
+                        for d in demands.get("actors") or [] if d]
+            for pg in demands.get("pg_bundles") or []:
+                strict = pg.get("strategy") == "STRICT_SPREAD"
+                spot_ok = bool(pg.get("spot", True))
+                for b in pg.get("bundles") or []:
+                    entries.append(self._entry(
+                        b, "pg_bundle", group=pg.get("pg_id"),
+                        strict_spread=strict, spot_ok=spot_ok))
+            return entries
+        out = []
+        for d in demands or []:
+            if isinstance(d, dict) and "resources" in d and "kind" in d:
+                out.append(d)
+            elif d:
+                out.append(self._entry(d, "task"))
+        return out
 
     # -- demand -> nodes (ResourceDemandScheduler.get_nodes_to_launch) ----
 
-    def _nodes_to_launch(self, demands: List[dict], n_current: int) -> List[str]:
+    def _nodes_to_launch(self, demands, n_current: int,
+                         per_type_current: Optional[Dict[str, int]] = None,
+                         now: Optional[float] = None,
+                         existing_rooms: Optional[List[dict]] = None,
+                         ) -> List[str]:
+        now = time.monotonic() if now is None else now
+        entries = self._normalize(demands)
         budget = self.max_workers - n_current
-        if budget <= 0 or not demands:
+        if budget <= 0 or not entries:
             return []
-        # First-fit-decreasing bin-pack of demands onto new node headrooms.
+        per_type_current = dict(per_type_current or {})
+        # First-fit-decreasing bin-pack of demands onto headrooms:
+        # EXISTING nodes' available capacity first (reference
+        # ResourceDemandScheduler — a demand miss the client just
+        # hasn't retried onto freshly launched capacity yet must not
+        # trigger a second launch), then new nodes. Strict-spread gang
+        # bundles go first (they constrain node COUNT, not just
+        # capacity).
         launches: List[str] = []
-        headrooms: List[dict] = []
-        for demand in sorted(demands, key=lambda d: -sum(d.values())):
+        headrooms: List[dict] = [dict(r) for r in existing_rooms or []]
+
+        def feasible_in(room: dict, e: dict) -> bool:
+            if e["strict_spread"] and e["group"] in room["groups"]:
+                return False  # distinct node per STRICT_SPREAD bundle
+            if not e["spot_ok"] and room.get("spot"):
+                return False  # gang-critical bundle: on-demand only
+            res = e["resources"]
+            return all(room["resources"].get(k, 0.0) >= v
+                       for k, v in res.items())
+
+        def debit(room: dict, e: dict) -> None:
+            for k, v in e["resources"].items():
+                room["resources"][k] = room["resources"].get(k, 0.0) - v
+            if e["group"] is not None:
+                room["groups"].add(e["group"])
+
+        ordered = sorted(entries, key=lambda e: (
+            0 if e["strict_spread"] else 1,
+            -sum(e["resources"].values())))
+        for e in ordered:
             placed = False
             for room in headrooms:
-                if all(room.get(k, 0.0) >= v for k, v in demand.items()):
-                    for k, v in demand.items():
-                        room[k] = room.get(k, 0.0) - v
+                if feasible_in(room, e):
+                    debit(room, e)
                     placed = True
                     break
             if placed:
                 continue
             if len(launches) >= budget:
                 continue
-            for type_name, config in self.node_types.items():
-                total = {"CPU": float(config.get("num_cpus", 0) or 0)}
-                total.update(config.get("resources") or {})
-                if all(total.get(k, 0.0) >= v for k, v in demand.items()):
+            for type_name in self.node_types:
+                if self._quarantined(type_name, now):
+                    continue  # benched: demand falls through
+                if not e["spot_ok"] and self._is_spot(type_name):
+                    continue
+                cap = self._type_cap(type_name)
+                if cap is not None:
+                    planned = per_type_current.get(type_name, 0) \
+                        + sum(1 for t in launches if t == type_name)
+                    if planned >= cap:
+                        continue
+                total = self._shape(type_name)
+                if all(total.get(k, 0.0) >= v
+                       for k, v in e["resources"].items()):
                     launches.append(type_name)
-                    room = dict(total)
-                    for k, v in demand.items():
-                        room[k] = room.get(k, 0.0) - v
+                    room = {"resources": dict(total), "type": type_name,
+                            "spot": self._is_spot(type_name),
+                            "groups": set()}
+                    debit(room, e)
                     headrooms.append(room)
                     break
         return launches
 
+    # -- launch pipeline (timeout / backoff / quarantine) ------------------
+
+    def _timed_create(self, type_name: str, cfg: dict):
+        """create_node bounded by the launch timeout: the provider call
+        runs in a worker thread so a wedged cloud CLI fails the LAUNCH,
+        not the reconcile loop (a late success is adopted through
+        non_terminated_nodes on a later pass)."""
+        result: dict = {}
+
+        def _do():
+            try:
+                result["node_id"] = self.provider.create_node(
+                    type_name, cfg)
+            except Exception as e:
+                result["error"] = e
+
+        t0 = time.perf_counter()
+        worker = threading.Thread(target=_do, daemon=True)
+        worker.start()
+        worker.join(self.launch_timeout_s)
+        if worker.is_alive():
+            raise TimeoutError(
+                f"create_node({type_name!r}) exceeded "
+                f"{self.launch_timeout_s}s")
+        if "error" in result:
+            raise result["error"]
+        return result["node_id"], time.perf_counter() - t0
+
+    def _on_launch_failure(self, type_name: str, now: float) -> None:
+        from ray_tpu.util import metrics
+
+        st = self._state_of(type_name)
+        st.failures += 1
+        metrics.AUTOSCALER_LAUNCH_FAILURES_TOTAL.inc(
+            tags={"node_type": type_name})
+        if st.failures >= self.quarantine_failures:
+            # Benched: no attempts for the cooldown; the first attempt
+            # after it is a single probe (failures stay high, so one
+            # more failure re-benches immediately).
+            st.quarantined_until = now + self.quarantine_cooldown_s
+            st.next_attempt = st.quarantined_until
+            metrics.AUTOSCALER_QUARANTINES_TOTAL.inc(
+                tags={"node_type": type_name})
+            return
+        # Jittered exponential backoff, capped: jitter only shrinks
+        # (0.5x-1x) so the cap is a true bound on the schedule.
+        backoff = min(self.backoff_max_s,
+                      self.backoff_base_s * (2 ** (st.failures - 1)))
+        rng = failpoints.seeded_rng(
+            f"autoscaler:{type_name}:{st.failures}")
+        st.next_attempt = now + backoff * (0.5 + 0.5 * rng.random())
+
+    # -- SLO-burn scale-up -------------------------------------------------
+
+    def _poll_slo_events(self) -> None:
+        """Drain the head's SLO channel; a burning transition queues one
+        node-shape of boost demand, recovery clears the burn."""
+        if not self._slo_subscribed:
+            self.head.call("pubsub_subscribe", self._slo_sub_id, "SLO",
+                           timeout=5.0)
+            self._slo_subscribed = True
+        polled = self.head.call("pubsub_poll", self._slo_sub_id, 0.0,
+                                200, timeout=10.0)
+        if polled is None:  # head restarted: pubsub state is gone
+            self._slo_subscribed = False
+            return
+        msgs, _dropped = polled
+        for m in msgs:
+            ev = m.get("message") or {}
+            slo = ev.get("slo") or m.get("key")
+            if not slo:
+                continue
+            if ev.get("state") == "burning":
+                if slo not in self._slo_burn:
+                    self._slo_burn[slo] = time.monotonic()
+                    self._boosts.append(slo)
+            else:
+                self._slo_burn.pop(slo, None)
+                if slo in self._boosts:
+                    self._boosts.remove(slo)
+
+    def _boost_entries(self, now: float) -> List[dict]:
+        """One smallest-feasible-node-shape demand per unabsorbed burn:
+        capacity ahead of the pending-work signal."""
+        entries = []
+        shapes = sorted(
+            (t for t in self.node_types if not self._quarantined(t, now)),
+            key=lambda t: sum(self._shape(t).values()))
+        if not shapes:
+            return entries
+        shape = self._shape(shapes[0])
+        for _slo in self._boosts:
+            entries.append(self._entry(shape, "slo_burn"))
+        return entries
+
+    # -- occupancy (signal-plane scale-down ranking) -----------------------
+
+    def _occupancy(self, node_ids: List[str]) -> Dict[str, float]:
+        """Windowed per-node CPU occupancy from the head's signal ring;
+        empty when the ring is disabled (callers fall back to insertion
+        order)."""
+        try:
+            res = self.head.call("query_metrics", {
+                "op": "gauge_avg", "name": "ray_tpu_worker_cpu_percent",
+                "window_s": max(30.0, self.idle_timeout_s),
+                "group_by": "node_id",
+            }, timeout=5.0)
+        except Exception:
+            return {}
+        if not isinstance(res, dict) or not res.get("ok"):
+            return {}
+        value = res.get("value")
+        if not isinstance(value, dict):
+            return {}
+        return {nid: float(v) for nid, v in value.items()
+                if nid in node_ids}
+
+    # -- reconcile ---------------------------------------------------------
+
     def update(self) -> dict:
-        """One reconcile round: scale up for pending demand, scale down
-        idle provider nodes past the timeout."""
-        demands = self.head.call("pending_demands", 10.0)
-        nodes = self.head.call("nodes")
-        alive = [n for n in nodes if n["Alive"]]
-        report = {"launched": [], "terminated": []}
+        """One reconcile round: bin-pack pending demand into launches,
+        scale down idle provider nodes past the timeout (drain first,
+        terminate after the head reports them dead)."""
+        failpoints.hit("autoscaler.tick")
+        from ray_tpu.util import metrics
 
         now = time.monotonic()
-        if demands and now - self._last_launch >= self.launch_cooldown_s:
-            mine = set(self.provider.non_terminated_nodes())
-            for type_name in self._nodes_to_launch(demands, len(mine)):
-                config = self.node_types[type_name]
-                node_id = self.provider.create_node(type_name, config)
+        try:
+            demands = self.head.call("demand_snapshot", 10.0)
+        except Exception:
+            # Older head: flat infeasible-task list only.
+            demands = {"tasks": self.head.call("pending_demands", 10.0)}
+        try:
+            self._poll_slo_events()
+        except Exception:
+            self._slo_subscribed = False  # resubscribe next pass
+        entries = self._normalize(demands) + self._boost_entries(now)
+        counts: Dict[str, int] = {}
+        for e in entries:
+            counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+        for kind in ("task", "actor", "pg_bundle", "slo_burn"):
+            metrics.AUTOSCALER_PENDING_DEMAND.set(
+                float(counts.get(kind, 0)), tags={"kind": kind})
+        nodes = self.head.call("nodes")
+        alive = [n for n in nodes if n["Alive"]]
+        report = {"launched": [], "terminated": [], "launch_failures": []}
+
+        mine = set(self.provider.non_terminated_nodes())
+        # Externally-dead tracked nodes: a spot preemption notice or an
+        # operator drain lands as a head-side death the provider never
+        # initiated — and a completed drain even shuts the agent down,
+        # dropping it from the provider view before this pass runs.
+        # Either way, reclaim the slot and close the goodput ledger
+        # with the attributed cause: "preemption" for a preempted spot
+        # node, "drain:<reason>" for an external drain,
+        # "failure:<cause>" for an on-demand crash.
+        table = {n["NodeID"]: n for n in nodes}
+        for node_id in list(self._node_type_of):
+            if node_id in self._draining:
+                continue  # autoscaler-initiated: _reap_drained owns it
+            info = table.get(node_id)
+            dead = info is not None and not info["Alive"]
+            if not dead:
+                if node_id not in mine and info is None:
+                    # Gone from the provider without ever registering
+                    # (boot death): just untrack.
+                    self._node_type_of.pop(node_id, None)
+                continue
+            cause = info.get("DeathCause") or ""
+            if cause.startswith("drained: "):
+                reason = cause[len("drained: "):]
+                ack = ("preemption" if reason == "preemption"
+                       else f"drain:{reason}")
+            elif bool((info.get("Labels") or {}).get("spot")):
+                ack = "preemption"  # spot died without notice
+            else:
+                ack = f"failure:{cause or 'unknown'}"
+            if self._terminate(node_id, report, ack_cause=ack):
+                mine.discard(node_id)
+                self._idle_since.pop(node_id, None)
+        per_type: Dict[str, int] = {}
+        for nid in mine:
+            t = self._node_type_of.get(nid)
+            if t is not None:
+                per_type[t] = per_type.get(t, 0) + 1
+
+        # Live headroom: pending demand packs into ALIVE schedulable
+        # nodes' available capacity before any launch is planned.
+        # Existing rooms start with empty strict-spread group sets (the
+        # autoscaler doesn't see which nodes hold a gang's PLACED
+        # bundles — worst case it under-plans one node and the next
+        # pass corrects), and carry the agent's spot label so
+        # ``spot: false`` demand never counts preemptible headroom.
+        existing_rooms = []
+        for n in alive:
+            if n.get("State", "ALIVE") != "ALIVE":
+                continue
+            labels = n.get("Labels") or {}
+            existing_rooms.append({
+                "resources": dict(n["Available"]),
+                "type": labels.get("node_type") or "",
+                "spot": bool(labels.get("spot")),
+                "groups": set(),
+            })
+
+        if entries and now - self._last_launch >= self.launch_cooldown_s:
+            for type_name in self._nodes_to_launch(
+                    entries, len(mine), per_type, now, existing_rooms):
+                st = self._state_of(type_name)
+                if now < st.next_attempt:
+                    continue  # backoff gate: this type waits its turn
+                cfg = self.node_types[type_name]
+                try:
+                    failpoints.hit("autoscaler.before_create")
+                    node_id, dt = self._timed_create(type_name, cfg)
+                except Exception:
+                    self._on_launch_failure(type_name, time.monotonic())
+                    report["launch_failures"].append(type_name)
+                    continue
+                st.failures = 0
+                st.next_attempt = 0.0
+                self._node_type_of[node_id] = type_name
                 self.launched.append(node_id)
                 report["launched"].append(node_id)
-                self._last_launch = now
+                self._last_launch = time.monotonic()
+                metrics.AUTOSCALER_LAUNCHES_TOTAL.inc(
+                    tags={"node_type": type_name})
+                metrics.AUTOSCALER_LAUNCH_SECONDS.observe(
+                    dt, tags={"node_type": type_name})
+            if report["launched"]:
+                self._boosts.clear()  # burn demand absorbed
 
         # Scale down: provider-owned nodes fully idle past the timeout
         # are DRAINED before the provider terminate hook — a task that
@@ -154,7 +537,20 @@ class StandardAutoscaler:
         # pass; termination lands once the head reports the node DEAD.
         self._reap_drained({n["NodeID"]: n for n in nodes}, report)
         by_id = {n["NodeID"]: n for n in alive}
-        started: list = []
+
+        def fits_pending(info: dict) -> bool:
+            # Scale-down must not race scale-up: a node that could
+            # serve a pending demand entry is about to be used (the
+            # client's retry just hasn't landed yet) — draining it now
+            # would shoot the very capacity this pass exists to
+            # provide, then relaunch it.
+            avail = info["Available"]
+            return any(
+                all(avail.get(k, 0.0) >= v
+                    for k, v in e["resources"].items())
+                for e in entries)
+
+        candidates: List[str] = []
         for node_id in list(self.provider.non_terminated_nodes()):
             if node_id in self._draining:
                 continue  # drain in flight; _reap_drained settles it
@@ -162,23 +558,32 @@ class StandardAutoscaler:
             if info is None or info.get("State", "ALIVE") != "ALIVE":
                 continue
             idle = info["Available"] == info["Resources"]
-            if not idle:
+            if not idle or (entries and fits_pending(info)):
                 self._idle_since.pop(node_id, None)
                 continue
             since = self._idle_since.setdefault(node_id, now)
             if now - since >= self.idle_timeout_s:
-                try:
-                    self.head.call(
-                        "drain_node", node_id, "autoscaler_idle",
-                        self.drain_deadline_s, False, timeout=15.0)
-                    self._draining.add(node_id)
-                    started.append(node_id)
-                except Exception:
-                    # Head hiccup: terminate ungracefully (old behavior)
-                    # rather than leak the provider node.
-                    self.provider.terminate_node(node_id)
-                    report["terminated"].append(node_id)
-                self._idle_since.pop(node_id, None)
+                candidates.append(node_id)
+        started: list = []
+        if candidates:
+            # Signal-plane ranking: drain the occupancy-coldest node
+            # first — "fully idle right now" can still differ in recent
+            # load, and the colder node's caches/objects are staler.
+            occ = self._occupancy(candidates)
+            candidates.sort(key=lambda nid: occ.get(nid, 0.0))
+        for node_id in candidates:
+            try:
+                self.head.call(
+                    "drain_node", node_id, "autoscaler_idle",
+                    self.drain_deadline_s, False, timeout=15.0)
+                self._draining[node_id] = None
+                started.append(node_id)
+            except Exception:
+                # Head hiccup: terminate ungracefully (old behavior)
+                # rather than leak the provider node.
+                self._terminate(node_id, report)
+            self._idle_since.pop(node_id, None)
+        self._report_state(now, per_type)
         if started:
             # Bounded settle: an idle node drains in well under a
             # second, so give this pass a brief window to finish the
@@ -194,25 +599,88 @@ class StandardAutoscaler:
                 started = [n for n in started if n in self._draining]
         return report
 
+    def _terminate(self, node_id: str, report: dict,
+                   ack_cause: str | None = None) -> bool:
+        """Provider terminate behind the failpoint + churn metric; a
+        failure leaves the node for a later pass instead of leaking the
+        drain state."""
+        from ray_tpu.util import metrics
+
+        try:
+            failpoints.hit("autoscaler.before_terminate")
+            self.provider.terminate_node(node_id)
+        except Exception:
+            return False
+        report["terminated"].append(node_id)
+        node_type = self._node_type_of.pop(node_id, None) or "unknown"
+        metrics.AUTOSCALER_SCALE_DOWNS_TOTAL.inc(
+            tags={"node_type": node_type})
+        if ack_cause is not None:
+            try:
+                self.head.call("terminate_ack", node_id, ack_cause,
+                               timeout=5.0)
+            except Exception:
+                pass  # ledger ack is best-effort; state is settled
+        return True
+
     def _reap_drained(self, node_table: dict, report: dict) -> None:
         """Terminate provider nodes whose scale-down drain completed."""
         for node_id in list(self._draining):
             info = node_table.get(node_id)
             if info is not None and info["Alive"]:
                 continue  # still draining
-            self._draining.discard(node_id)
-            self.provider.terminate_node(node_id)
-            report["terminated"].append(node_id)
+            if self._terminate(node_id, report,
+                               ack_cause="drain:autoscaler_idle"):
+                self._draining.pop(node_id, None)
+
+    def _report_state(self, now: float,
+                      per_type: Dict[str, int]) -> None:
+        """Push per-type quarantine/backoff state to the head (full-state
+        replace) so `ray-tpu status` and the dashboard can show it."""
+        types = {}
+        for t in self.node_types:
+            st = self._state_of(t)
+            types[t] = {
+                "spot": self._is_spot(t),
+                "nodes": per_type.get(t, 0),
+                "failures": st.failures,
+                "quarantined": now < st.quarantined_until,
+                "quarantine_remaining_s": round(
+                    max(0.0, st.quarantined_until - now), 3),
+                "backoff_remaining_s": round(
+                    max(0.0, st.next_attempt - now), 3),
+            }
+        try:
+            self.head.call("autoscaler_report", {
+                "types": types,
+                "max_workers": self.max_workers,
+                "draining": sorted(self._draining),
+                "slo_burns": sorted(self._slo_burn),
+            }, timeout=5.0)
+        except Exception:
+            pass  # status surface only; next tick replaces it anyway
 
     def start(self, interval_s: float = 1.0) -> None:
         def loop():
+            from ray_tpu.util import metrics
+
             while not self._stop.wait(interval_s):
                 try:
                     self.update()
                 except Exception:
+                    metrics.count_loop_restart("autoscaler.reconcile")
                     continue
 
         threading.Thread(target=loop, daemon=True).start()
 
     def stop(self) -> None:
         self._stop.set()
+        from ray_tpu.util import metrics
+
+        # Retract this fleet's per-kind demand series (and the loop
+        # restart counter) from the registry: a torn-down autoscaler
+        # must not linger on the federated scrape.
+        for kind in ("task", "actor", "pg_bundle", "slo_burn"):
+            metrics.AUTOSCALER_PENDING_DEMAND.remove(
+                tags={"kind": kind})
+        metrics.retract_loop_series(["autoscaler.reconcile"])

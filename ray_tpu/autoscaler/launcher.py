@@ -116,10 +116,12 @@ def create_or_update_cluster(
     autoscaler = None
     if start_autoscaler:
         def shape(tcfg):
-            # Everything but the scaling bounds flows to the provider
-            # (cloud providers read extra keys, e.g. accelerator_type).
+            # Everything but min_workers flows through: cloud providers
+            # read extra keys (accelerator_type, spot), and the
+            # autoscaler reads max_workers as the per-type cap and spot
+            # as the preemptible marker for its bin-packer.
             return {k: v for k, v in tcfg.items()
-                    if k not in ("min_workers", "max_workers")}
+                    if k not in ("min_workers",)}
 
         node_types = {
             name: shape(tcfg)

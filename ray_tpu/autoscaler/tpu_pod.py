@@ -64,10 +64,14 @@ DEFAULT_COMMANDS = {
 
 
 class CommandRunner:
-    """Executes provisioning commands (reference command_runner.py)."""
+    """Executes provisioning commands (reference command_runner.py).
+    ``timeout`` bounds one command's wall clock — a wedged cloud CLI
+    fails the launch (feeding the autoscaler's backoff/quarantine
+    schedule) instead of hanging the reconcile pass."""
 
-    def run(self, argv: List[str]) -> str:
-        return subprocess.check_output(argv, text=True)
+    def run(self, argv: List[str],
+            timeout: Optional[float] = None) -> str:
+        return subprocess.check_output(argv, text=True, timeout=timeout)
 
 
 class DryRunCommandRunner(CommandRunner):
@@ -76,7 +80,8 @@ class DryRunCommandRunner(CommandRunner):
     def __init__(self):
         self.commands: List[List[str]] = []
 
-    def run(self, argv: List[str]) -> str:
+    def run(self, argv: List[str],
+            timeout: Optional[float] = None) -> str:
         self.commands.append(list(argv))
         return ""
 
@@ -117,8 +122,11 @@ class TPUPodNodeProvider(NodeProvider):
         accel = node_config.get(
             "accelerator_type",
             self.config.get("accelerator_type", "v5litepod-4"))
-        self.runner.run(self._argv("create", name=name,
-                                   accelerator_type=accel))
+        from ray_tpu.core.config import config as _config
+
+        self.runner.run(
+            self._argv("create", name=name, accelerator_type=accel),
+            timeout=_config.autoscaler_launch_timeout_s or None)
         agent = None
         if self.dry_run and self.cluster is not None:
             # Simulate the pod host joining the cluster with the declared
@@ -127,6 +135,8 @@ class TPUPodNodeProvider(NodeProvider):
             agent = self.cluster.add_node(
                 num_cpus=node_config.get("num_cpus"),
                 resources=node_config.get("resources"),
+                labels={"node_type": node_type,
+                        "spot": bool(node_config.get("spot", False))},
             )
         self._pods[name] = agent
         # In dry-run the provider's node id must match the joined node's

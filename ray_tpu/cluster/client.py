@@ -1754,6 +1754,24 @@ class ClusterBackend:
                     s["assigned_node"] = None
                     self._queue_retry(s)
 
+    def _node_confirmed_dead(self, node_id: str) -> bool:
+        """Whether the HEAD declares this node dead (or gone entirely).
+        A maybe-executed push to a confirmed-dead peer cannot fork
+        execution — the process is gone and its store with it — so the
+        spec is safe to resubmit. This is the zero-goodput-loss path
+        for planned scale-down and spot preemption: the drain marks the
+        node DEAD before the provider terminate, so a spillback racing
+        the termination (gossip views stay fresh for seconds) falls
+        back to head scheduling instead of failing the task."""
+        try:
+            nodes = self.head.call("nodes", timeout=5.0)
+        except (ConnectionLost, OSError):
+            return False  # can't confirm: stay conservative
+        for n in nodes:
+            if n["NodeID"] == node_id:
+                return not n["Alive"]
+        return True  # deregistered entirely
+
     def _spill_to_peers(self, specs: list) -> list:
         """Try to place locally-rejected leasable specs on peers chosen
         from the local agent's gossiped cluster view (no head RPC).
@@ -1804,7 +1822,8 @@ class ClusterBackend:
                 rej = set(self._node_client(address).call(
                     "submit_tasks_leased", group))
             except (ConnectionLost, OSError, RuntimeError) as e:
-                if getattr(e, "maybe_executed", False):
+                if getattr(e, "maybe_executed", False) \
+                        and not self._node_confirmed_dead(nid):
                     # The push died mid-call: the peer may have enqueued
                     # the batch; resubmitting could fork execution.
                     for s in group:
@@ -2394,14 +2413,15 @@ class ClusterBackend:
 
     # -- placement groups --------------------------------------------------
 
-    def create_placement_group(self, bundles, strategy, name="", lifetime=None):
+    def create_placement_group(self, bundles, strategy, name="",
+                               lifetime=None, spot=True):
         # Client-generated id makes the call idempotent under the head
         # client's reconnect-window retry (a replayed create after a head
         # restart must not reserve a second PG's resources).
         pg_id = ids.new_placement_group_id()
         return self.head.call(
             "create_placement_group", bundles, strategy, name, lifetime,
-            pg_id,
+            pg_id, spot,
         )
 
     def remove_placement_group(self, pg_id: str) -> None:
@@ -2633,6 +2653,12 @@ class ClusterBackend:
     def signal_top(self, window_s: float = 60.0) -> dict:
         """The ``ray-tpu top`` cluster rollup, all from history."""
         return self.head.call("signal_top", window_s, timeout=15.0)
+
+    def autoscaler_status(self) -> dict:
+        """The fleet autoscaler's last state report (per-type node
+        counts, quarantine/backoff benches, draining nodes, active SLO
+        burns); ``{}`` before the first reconcile pass."""
+        return self.head.call("autoscaler_status", timeout=15.0)
 
     def _log_poll_loop(self, subscribed: bool = False) -> None:
         """Driver-side log streaming over the pubsub LOGS channel

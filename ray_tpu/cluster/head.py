@@ -303,12 +303,17 @@ class _ShardLock:
 
 
 class NodeInfo:
-    def __init__(self, node_id, address, resources, store_path):
+    def __init__(self, node_id, address, resources, store_path,
+                 labels=None):
         self.node_id = node_id
         self.address = address
         self.resources = dict(resources)  # total
         self.available = dict(resources)  # latest reported view
         self.store_path = store_path
+        # Provisioning metadata (node_type, spot, ...) the agent carried
+        # at registration; the autoscaler's spot-aware bin-packing and
+        # the status surfaces read it from the node table.
+        self.labels = dict(labels or {})
         self.last_heartbeat = time.monotonic()
         self.alive = True
         # Lifecycle: ALIVE -> (DRAINING ->) DEAD. A DRAINING node keeps
@@ -430,6 +435,13 @@ class HeadServer:
             collections.OrderedDict()
         )
         self._demand_miss_seq = 0
+        # Latest autoscaler self-report (per-type quarantine/backoff
+        # state): full-state replace each reconcile tick, read by
+        # `ray-tpu status` and the dashboard.
+        self._autoscaler_report: dict = {}  # guarded-by: _lock
+        # node_id -> terminate-ack record (the autoscaler's confirmation
+        # that a drained node's provider resources were released).
+        self._terminate_acks: dict[str, dict] = {}  # guarded-by: _lock
         # Worker stdout/stderr ring buffer for driver log streaming
         # (log_monitor.py -> GCS pubsub -> driver analog; drivers poll
         # rpc_drain_logs with their last-seen seq).
@@ -534,7 +546,8 @@ class HeadServer:
         with self._lock:
             for node_id, rec in nodes.items():
                 info = NodeInfo(node_id, rec["address"], rec["resources"],
-                                rec["store_path"])
+                                rec["store_path"],
+                                labels=rec.get("labels"))
                 self._nodes[node_id] = info
             self._actors.update(snap.get("actors", {}))
             for actor_id, rec in self._actors.items():
@@ -619,19 +632,21 @@ class HeadServer:
                     avail[k] = avail.get(k, 0.0) + v
         self._res_total, self._res_avail = total, avail
 
-    def rpc_register_node(self, node_id, address, resources, store_path):
+    def rpc_register_node(self, node_id, address, resources, store_path,
+                          labels=None):
         with self._lock:
-            info = NodeInfo(node_id, address, resources, store_path)
+            info = NodeInfo(node_id, address, resources, store_path,
+                            labels=labels)
             info.client.chaos_src = self.address
             self._nodes[node_id] = info
             self._rebuild_res_caches()
         self._persist("node", node_id, {
             "address": address, "resources": dict(resources),
-            "store_path": store_path,
+            "store_path": store_path, "labels": dict(labels or {}),
         })
         self.pubsub.publish("NODES", node_id, {
             "node_id": node_id, "state": "ALIVE", "address": address,
-            "resources": dict(resources),
+            "resources": dict(resources), "labels": dict(labels or {}),
         })
         return {"head_time": time.time()}
 
@@ -848,6 +863,7 @@ class HeadServer:
                     "Resources": dict(n.resources),
                     "Available": dict(n.available),
                     "StorePath": n.store_path,
+                    "Labels": dict(n.labels),
                 }
                 for n in self._nodes.values()
             ]
@@ -2522,6 +2538,12 @@ class HeadServer:
             for n in alive
             if all(n.resources.get(k, 0.0) >= v for k, v in demand.items())
         ]
+        if feasible and task_id is not None:
+            # A satisfied retry retires its recorded miss immediately —
+            # the autoscaler must size against live demand, not demand
+            # that capacity already absorbed (stale misses otherwise
+            # linger a full window and over-provision the next pass).
+            self._demand_misses.pop(task_id, None)
         if not feasible:
             # One live entry per pending task: retries refresh the
             # timestamp (and slot order) instead of inflating apparent
@@ -2592,10 +2614,86 @@ class HeadServer:
             return [dict(m["demand"])
                     for m in self._demand_misses.values()]
 
+    def rpc_demand_snapshot(self, window_s: float = 30.0):  # idempotent (read-only)
+        """Everything the autoscaler's bin-packer sizes against, in one
+        consistent read (resource_demand_scheduler.py:103 input shape):
+        queued task demands no node could fit, pending (RESTARTING)
+        actors whose restart is still hunting for placement, and the
+        unplaced bundles of PENDING/RESCHEDULING placement groups —
+        with their strategy (STRICT_SPREAD bundles need N distinct
+        nodes, not N bundles-worth of one node) and spot constraint."""
+        cutoff = time.monotonic() - window_s
+        with self._lock:
+            for key in [k for k, m in self._demand_misses.items()
+                        if m["ts"] < cutoff]:
+                del self._demand_misses[key]
+            tasks = [dict(m["demand"])
+                     for m in self._demand_misses.values()]
+            actors = []
+            for aid, info in self._actors.items():
+                if info.get("state") != "RESTARTING":
+                    continue
+                rec = self._actor_specs.get(aid)
+                if rec is None:
+                    continue
+                actors.append(dict(rec["spec"].get("demand") or {}))
+            pg_bundles = []
+            for pg in self._pgs.values():
+                if pg["state"] not in ("PENDING", "RESCHEDULING"):
+                    continue
+                live = {
+                    bi for nid, bi in pg["placement"]
+                    if self._nodes.get(nid) is not None
+                    and self._nodes[nid].schedulable
+                }
+                lost = [i for i in range(len(pg["bundles"]))
+                        if i not in live]
+                if not lost:
+                    continue
+                pg_bundles.append({
+                    "pg_id": pg["placement_group_id"],
+                    "strategy": pg["strategy"],
+                    "bundles": [dict(pg["bundles"][i]) for i in lost],
+                    "spot": bool(pg.get("spot", True)),
+                })
+        return {"tasks": tasks, "actors": actors,
+                "pg_bundles": pg_bundles}
+
+    def rpc_terminate_ack(self, node_id, cause: str = ""):  # idempotent (keyed last-write-wins)
+        """The autoscaler's confirmation that a node's provider
+        resources were released after its drain completed. Keyed
+        last-write-wins per node so a replay through a severed reply
+        records once; a node still alive is NOT acked (the autoscaler
+        must drain first — this is the zero-goodput-loss contract)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None and node.alive:
+                return {"ok": False, "state": node.state,
+                        "error": "node still alive; drain before terminate"}
+            self._terminate_acks[node_id] = {
+                "cause": cause, "ts": time.time(),
+            }
+            while len(self._terminate_acks) > 1000:
+                self._terminate_acks.pop(next(iter(self._terminate_acks)))
+        return {"ok": True, "node_id": node_id}
+
+    def rpc_autoscaler_report(self, report: dict):  # idempotent (full-state replace)
+        """Autoscaler self-report: per-node-type quarantine/backoff/
+        launch state, replaced wholesale each reconcile tick (replays
+        converge on the same state)."""
+        with self._lock:
+            self._autoscaler_report = dict(report or {})
+            self._autoscaler_report["ts"] = time.time()
+        return {"ok": True}
+
+    def rpc_autoscaler_status(self):  # idempotent (read-only)
+        with self._lock:
+            return dict(self._autoscaler_report)
+
     # -- placement groups (2-phase commit) --------------------------------
 
     def rpc_create_placement_group(self, bundles, strategy, name="",
-                                   lifetime=None, pg_id=None):
+                                   lifetime=None, pg_id=None, spot=True):
         if pg_id is None:  # legacy caller: server-generated id
             pg_id = ids.new_placement_group_id()
         with self._lock:
@@ -2611,6 +2709,10 @@ class HeadServer:
                 "state": "PENDING",
                 "placement": [],  # [(node_id, bundle_index)]
                 "reschedules": 0,
+                # spot=False marks the gang preemption-critical: the
+                # autoscaler's bin-packer only sizes on-demand node
+                # types for its unplaced bundles.
+                "spot": bool(spot),
             }
         threading.Thread(
             target=self._reserve_pg, args=(pg_id,), daemon=True

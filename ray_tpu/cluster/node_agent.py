@@ -71,8 +71,15 @@ class NodeAgent:
         session: str | None = None,
         memory_usage_threshold: float | None = None,
         memory_limit_bytes: int | None = None,
+        labels: dict | None = None,
     ):
         self.node_id = ids.new_node_id()
+        # Provisioning metadata (node_type, spot, ...) carried to the
+        # head at registration; the autoscaler and status surfaces read
+        # it from the node table. A spot node's preemption still arrives
+        # through the preemption watcher / SIGTERM — labels only say
+        # WHICH nodes can vanish that way.
+        self.labels = dict(labels or {})
         self.head_address = head_address
         # Reconnect window so a restarting head (GCS FT) doesn't fail
         # in-flight add_location/register calls from this agent.
@@ -257,7 +264,7 @@ class NodeAgent:
         self.head.chaos_src = self.address
         self.head.call(
             "register_node", self.node_id, self.address,
-            self.total_resources, self.store_path,
+            self.total_resources, self.store_path, self.labels,
         )
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         threading.Thread(target=self._dispatch_loop, daemon=True).start()

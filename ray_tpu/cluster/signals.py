@@ -762,11 +762,35 @@ class SignalPlane:
             if down:
                 entry["downtime_s"] = round(down, 1)
             train[trial] = entry
+        # Fleet churn: the autoscaler's counter families (windowed
+        # deltas per node type) + the live pending-demand gauge — empty
+        # until an autoscaler's registry lands in the ring.
+        fleet_types: Dict[str, dict] = {}
+        for key, fam in (
+                ("launches", "ray_tpu_autoscaler_launches_total"),
+                ("launch_failures",
+                 "ray_tpu_autoscaler_launch_failures_total"),
+                ("quarantines", "ray_tpu_autoscaler_quarantines_total"),
+                ("scale_downs", "ray_tpu_autoscaler_scale_downs_total")):
+            delta, _ = self.ring.counter_delta(
+                fam, window_s, group_by="node_type")
+            for t, v in (delta or {}).items():
+                if not t or not v:
+                    continue
+                fleet_types.setdefault(t, {})[key] = int(v)
+        pending = ring.gauge_over_window(
+            "ray_tpu_autoscaler_pending_demand", window_s, "last",
+            group_by="kind") or {}
         return {
             "window_s": window_s,
             "nodes": nodes,
             "serve": serve,
             "train": train,
+            "fleet": {
+                "types": fleet_types,
+                "pending_demand": {k: int(v) for k, v in pending.items()
+                                   if k and v},
+            },
             "slos": self.slo_status()["slos"],
             "series": ring.series_count(),
             "evictions": dict(ring.evictions),

@@ -163,6 +163,20 @@ _DEFS: dict[str, tuple[type, Any]] = {
     # Cloud hook: metadata endpoint polled for a termination notice
     # (GCE: .../computeMetadata/v1/instance/preempted returns "TRUE").
     "preemption_metadata_url": (str, ""),
+    # -- autoscaler execution half (boot-loop robustness) -------------------
+    # Wall-clock budget for one provider create_node call; past it the
+    # launch counts as failed (the provider call may still land — the
+    # reconcile loop adopts it via non_terminated_nodes on a later pass).
+    "autoscaler_launch_timeout_s": (float, 120.0),
+    # Jittered exponential backoff between launch attempts for a node
+    # type whose last create failed: base * 2^(failures-1), capped.
+    "autoscaler_launch_backoff_base_s": (float, 1.0),
+    "autoscaler_launch_backoff_max_s": (float, 30.0),
+    # Consecutive boot failures before a node type is quarantined
+    # (benched for the cooldown; demand falls through to the next
+    # feasible type) — a flapping provider can never hot-loop create.
+    "autoscaler_quarantine_failures": (int, 3),
+    "autoscaler_quarantine_cooldown_s": (float, 60.0),
     # -- chaos / fault injection -------------------------------------------
     # One seed for ALL chaos randomness (failpoint probability RNGs,
     # network-chaos delay/jitter draws, soak schedules, the chaos test's

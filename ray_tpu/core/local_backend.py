@@ -504,7 +504,8 @@ class LocalBackend:
                 pg.release_cv.wait(0.05)
 
     def create_placement_group(
-        self, bundles: list, strategy: str, name: str = "", lifetime=None
+        self, bundles: list, strategy: str, name: str = "", lifetime=None,
+        spot: bool = True,
     ) -> str:
         pg_id = ids.new_placement_group_id()
         pg = _PlacementGroupState(pg_id, bundles, strategy, name)

@@ -157,6 +157,11 @@ class Dashboard:
             return ok_json(self._cluster_status())
         if route == "/api/nodes":
             return ok_json({"nodes": self.head.call("nodes")})
+        if route == "/api/autoscaler":
+            # Last autoscaler state report (per-type fleet counts,
+            # quarantine/backoff, SLO burns); {} when none is attached.
+            return ok_json(
+                {"autoscaler": self.head.call("autoscaler_status")})
         if route == "/api/actors":
             return ok_json({"actors": self.head.call("list_actors")})
         if route == "/api/tasks":
@@ -481,7 +486,8 @@ class Dashboard:
             f"{_html.escape(json.dumps(v, default=str))}</code>"
             for k, v in s.items()
         )
-        api = ["/api/cluster_status", "/api/nodes", "/api/actors",
+        api = ["/api/cluster_status", "/api/nodes", "/api/autoscaler",
+               "/api/actors",
                "/api/tasks", "/api/objects", "/api/memory_summary",
                "/api/memory_leaks", "/api/logs",
                "/api/worker_logs", "/api/worker_stats",

@@ -147,12 +147,26 @@ const RENDER = {
     }));
   },
   async nodes() {
-    const d = await api("/api/nodes");
+    const [d, fleetD] = await Promise.all(
+      [api("/api/nodes"), api("/api/autoscaler")]);
+    const fleet = (fleetD || {}).autoscaler || {};
+    const quarantined = new Set(Object.entries(fleet.types || {})
+      .filter(([, t]) => t.quarantined).map(([name]) => name));
     $("view").replaceChildren(table(
-      ["NodeID", "Address", "State", "Cause", "Resources", "StorePath"],
+      ["NodeID", "Address", "State", "Type", "Cause", "Resources",
+       "StorePath"],
       d.nodes || [], (r, c) => {
         if (c === "State")
           return stateCell(r.State || (r.Alive ? "ALIVE" : "DEAD"));
+        if (c === "Type") {
+          // node_type/spot from the agent's labels; a quarantined type
+          // (autoscaler boot-loop bench) is flagged inline.
+          const labels = r.Labels || {};
+          let txt = labels.node_type || "";
+          if (labels.spot) txt += " (spot)";
+          if (quarantined.has(labels.node_type)) txt += " [quarantined]";
+          return el("td", "mono", txt);
+        }
         if (c === "Cause") {
           // DRAINING shows its reason; DEAD its cause (crash vs drain).
           const td = el("td", "mono");

@@ -27,7 +27,7 @@ KNOWN_BENCHES = frozenset({
     "task_overhead", "memory_pressure", "chaos_soak", "scalebench",
     "drain_recovery_ms", "serve_latency", "input_pipeline", "goodput",
     "analyze", "gang_recovery", "llm_serving", "streaming_dataflow",
-    "signal_plane",
+    "signal_plane", "fleet_scaling",
 })
 
 
@@ -318,6 +318,34 @@ def record_signal_plane(*, agreement: dict, query_p50_ms: float,
     return entry
 
 
+def record_fleet_scaling(*, scale_up_ms: dict, bin_pack_efficiency: float,
+                         scale_down: dict, waves: int, seed: int,
+                         device: str = "", path: str | None = None,
+                         **extra) -> dict:
+    """Fleet autoscaling evidence (``scalebench --demand-burst``): the
+    seeded arrival-wave envelope — scale-up latency p50/p99 (submit to
+    demand-served, capacity provisioned by the bin-packer), bin-pack
+    efficiency (requested / provisioned resources; launching a node per
+    demand would read as waste here), and the zero-goodput-loss
+    scale-down section (every terminated node drained first, every
+    removal cause-attributed ``drain:*`` — an unplanned termination is
+    exactly the goodput loss this bench exists to rule out). Committed
+    to the evidence trail only on an accelerator; returns the entry
+    (with ``committed_to``) either way."""
+    entry: dict = {
+        "bench": "fleet_scaling",
+        "device": device,
+        "waves": int(waves),
+        "seed": int(seed),
+        "scale_up_ms": dict(scale_up_ms),
+        "bin_pack_efficiency": float(bin_pack_efficiency),
+        "scale_down": dict(scale_down),
+    }
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
 def record_goodput(*, trial: str, goodput_pct: float, wall_s: float,
                    downtime_s: float, by_cause: dict,
                    device: str = "", path: str | None = None,
@@ -528,6 +556,26 @@ def check_line(obj: object, *, allow_header: bool = False) -> list[str]:
                     and _is_num(spill.get("restores"))):
                 errs.append("streaming_dataflow line missing numeric "
                             "spill.spilled_objects/restores counts")
+        elif obj["bench"] == "fleet_scaling":
+            # The claim is "the fleet sizes itself and shrinks without
+            # losing goodput": the latency percentiles, the packing
+            # efficiency, and the fully cause-attributed scale-down
+            # ledger are each load-bearing — a line without them is an
+            # unverified autoscaling claim.
+            su = obj.get("scale_up_ms")
+            if not (isinstance(su, dict) and _is_num(su.get("p50"))
+                    and _is_num(su.get("p99"))):
+                errs.append("fleet_scaling line missing numeric "
+                            "scale_up_ms.p50/p99")
+            if not _is_num(obj.get("bin_pack_efficiency")):
+                errs.append("fleet_scaling line missing numeric "
+                            "bin_pack_efficiency")
+            sd = obj.get("scale_down")
+            if not (isinstance(sd, dict) and _is_num(sd.get("nodes"))
+                    and isinstance(sd.get("causes"), dict)):
+                errs.append("fleet_scaling line missing scale_down "
+                            "dict with numeric 'nodes' + 'causes' "
+                            "attribution")
         elif obj["bench"] == "goodput":
             if not _is_num(obj.get("goodput_pct")):
                 errs.append("goodput line missing numeric goodput_pct")

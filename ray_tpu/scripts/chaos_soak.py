@@ -89,6 +89,17 @@ class _Soak:
         self.signal_queries_failed = 0
         self.signal_slo_transitions = 0
         self.signal_missed_evals = 0
+        self.autoscaler_rounds_ok = 0
+        self.autoscaler_rounds_failed = 0
+        self.autoscaler_launches = 0
+        self.autoscaler_launch_failures = 0
+        self.autoscaler_quarantines = 0
+        self.autoscaler_scale_downs = 0
+        self.autoscaler_preemptions = 0
+        self._autoscaler = None
+        self._as_provider = None
+        self._as_cluster = None
+        self._fleet_work = None
         self._stop = threading.Event()
         # The streaming-dataflow probe's small-store node: exempt from
         # kill/drain (its custom resource exists nowhere else, so losing
@@ -619,9 +630,14 @@ class _Soak:
         while time.monotonic() < deadline and not self._stop.is_set():
             t0 = time.monotonic()
             try:
+                # 90s: the box runs every standing probe (serve, llm,
+                # train, gang, signal, autoscaler fleet) concurrently —
+                # generation on the 2-CPU probe node is the round's
+                # long pole, and the budget must absorb co-probe load
+                # spikes while staying under the 150s hang threshold.
                 refs = [gen.remote(rounds * 100 + i) for i in range(16)]
                 done, _ = ray_tpu.wait(refs, num_returns=len(refs),
-                                       timeout=60.0)
+                                       timeout=90.0)
                 if len(done) < len(refs):
                     raise RuntimeError(
                         f"generation incomplete ({len(done)}/16)")
@@ -712,6 +728,206 @@ class _Soak:
                     f"(the ring must answer from head-local history)")
                 return
             time.sleep(0.5)
+
+    # -- autoscaler probe --------------------------------------------------
+
+    def _autoscaler_probe_setup(self, cluster) -> bool:
+        """Stand up a ``LocalNodeProvider`` fleet the fault schedule
+        rides: fleet demand uses a custom resource only autoscaler-
+        launched nodes carry, so every probe round exercises the full
+        scale-up path (bin-pack -> create_node -> boot -> schedule) and
+        the teardown exercises drain-before-terminate scale-down. A
+        provider terminate of a node the head still reports ALIVE is an
+        instant violation (goodput-loss scale-down). One clean round
+        runs here, BEFORE faults start; then ``create_node`` itself is
+        put on the seeded fault schedule so the backoff/quarantine boot
+        loop earns its keep."""
+        import ray_tpu
+        from ray_tpu.autoscaler import LocalNodeProvider, StandardAutoscaler
+
+        provider = LocalNodeProvider(cluster)
+        real_terminate = provider.terminate_node
+
+        def checked_terminate(node_id):
+            try:
+                alive = any(n["NodeID"] == node_id and n["Alive"]
+                            for n in cluster.head.rpc_nodes())
+            except Exception:
+                alive = False
+            if alive:
+                self.violations.append(
+                    f"autoscaler terminated {node_id[:12]} while the "
+                    f"head still reported it ALIVE (drain-before-"
+                    f"terminate violated)")
+            real_terminate(node_id)
+
+        provider.terminate_node = checked_terminate
+        self._as_provider = provider
+        self._as_cluster = cluster
+        self._autoscaler = StandardAutoscaler(
+            cluster.address, provider,
+            node_types={
+                # Catalog order is the packer's preference order: spot
+                # first (Podracer economics — preemptible is the normal
+                # case), on-demand as the quarantine fall-through.
+                "fleet_spot": {"num_cpus": 2,
+                               "resources": {"fleet": 2}, "spot": True},
+                "fleet_ondemand": {"num_cpus": 2,
+                                   "resources": {"fleet": 2}},
+            },
+            max_workers=3,
+            idle_timeout_s=1.5,
+            launch_cooldown_s=0.2,
+            backoff_base_s=0.2,
+            backoff_max_s=1.0,
+            quarantine_failures=3,
+            quarantine_cooldown_s=3.0,
+        )
+
+        @ray_tpu.remote(num_cpus=1, resources={"fleet": 1}, max_retries=5)
+        def fleet_work(i):
+            time.sleep(0.05)
+            return i
+
+        self._fleet_work = fleet_work
+        self._autoscaler_round(0, budget_s=30.0)
+        self.autoscaler_rounds_ok += 1
+        # From here on, launches fail on the seeded schedule: with two
+        # feasible types, backoff + quarantine fall-through must keep
+        # demand satisfiable anyway. (Settle's failpoints.reset()
+        # disarms this before the end-state round.)
+        from ray_tpu.util import failpoints
+
+        failpoints.set_failpoints(
+            {"autoscaler.before_create": "raise:chaos,p=0.25"})
+        return True
+
+    def _autoscaler_round(self, tag: int, budget_s: float,
+                          heed_stop: bool = True) -> None:
+        """One demand burst: submit fleet-only tasks (no standing node
+        carries the resource), pump the reconcile loop until all land.
+        Raises if the budget expires with demand unsatisfied.
+        ``heed_stop`` aborts at soak teardown (mid-soak rounds only —
+        the end-state round runs AFTER settle, with ``_stop`` set)."""
+        import ray_tpu
+
+        refs = [self._fleet_work.remote(tag * 10 + i) for i in range(4)]
+        pending = list(refs)
+        pump_deadline = time.monotonic() + budget_s
+        while pending and time.monotonic() < pump_deadline:
+            report = self._autoscaler.update()
+            self.autoscaler_launches += len(report["launched"])
+            self.autoscaler_launch_failures += len(
+                report["launch_failures"])
+            self.autoscaler_scale_downs += len(report["terminated"])
+            _, pending = ray_tpu.wait(
+                pending, num_returns=len(pending), timeout=1.0)
+            if pending and heed_stop and self._stop.is_set():
+                raise RuntimeError("soak stopping mid-round")
+        if pending:
+            raise RuntimeError(
+                f"fleet demand unsatisfied ({len(pending)}/4 pending "
+                f"after {budget_s:.0f}s)")
+        ray_tpu.get(refs, timeout=10.0)
+
+    def _autoscaler_preempt_drill(self) -> bool:
+        """Simulate a provider preemption notice on one live spot fleet
+        node: drain(reason="preemption"). The reconcile loop must
+        reclaim the slot and close the ledger with cause
+        ``preemption``."""
+        a = self._autoscaler
+        live = set(self._as_provider.non_terminated_nodes())
+        spots = [nid for nid, t in a._node_type_of.items()
+                 if t == "fleet_spot" and nid in live
+                 and nid not in a._draining]
+        if not spots:
+            return False
+        self._as_cluster.head.rpc_drain_node(
+            spots[0], "preemption", 10.0, wait=False)
+        self.autoscaler_preemptions += 1
+        return True
+
+    def _autoscaler_probe_loop(self, deadline: float) -> None:
+        """Standing invariant: a fleet-only demand burst is satisfied
+        through autoscaler scale-up within the round budget even while
+        faults land on the launched nodes and ``create_node`` itself
+        fails on the seeded schedule. A round may fail typed under
+        chaos; hanging is a violation. One round rides a simulated spot
+        preemption."""
+        preempted = False
+        tag = 1
+        while time.monotonic() < deadline and not self._stop.is_set():
+            t0 = time.monotonic()
+            try:
+                self._autoscaler_round(tag, budget_s=45.0)
+                self.autoscaler_rounds_ok += 1
+                if not preempted:
+                    preempted = self._autoscaler_preempt_drill()
+            except Exception:
+                if self._stop.is_set():
+                    return  # settling cluster: not a verdict
+                self.autoscaler_rounds_failed += 1
+            took = time.monotonic() - t0
+            if took > 120.0:
+                self.violations.append(
+                    f"autoscaler probe round HUNG {took:.1f}s (fleet "
+                    f"demand neither satisfied nor failing fast)")
+                return
+            tag += 1
+            # Gentle cadence: the soak box runs every other standing
+            # probe too, and this one spawns node agents.
+            time.sleep(1.0)
+
+    def _autoscaler_end_state(self, cluster) -> None:
+        """Post-storm verdicts: demand still satisfiable (no stuck
+        quarantine — the schedule is over and cooldowns expired), fleet
+        scales to zero with every termination drained first, and the
+        head's terminate ledger is fully cause-attributed."""
+        a = self._autoscaler
+        try:
+            try:
+                self._autoscaler_round(999, budget_s=30.0,
+                                       heed_stop=False)
+                self.autoscaler_rounds_ok += 1
+            except Exception as e:  # noqa: BLE001
+                self.violations.append(
+                    f"autoscaler demand unsatisfied after soak (stuck "
+                    f"quarantine/backoff?): {e!r}")
+            self.autoscaler_quarantines = sum(
+                1 for st in a._type_state.values()
+                if st.quarantined_until > 0)
+            # Zero-goodput-loss scale-down: idle the whole fleet out.
+            # The provider hook asserts drained-first on every
+            # terminate; the ledger check below does attribution.
+            a.idle_timeout_s = 0.0
+            sd_deadline = time.monotonic() + 30.0
+            while (self._as_provider.non_terminated_nodes()
+                   and time.monotonic() < sd_deadline):
+                report = a.update()
+                self.autoscaler_scale_downs += len(report["terminated"])
+                time.sleep(0.1)
+            if self._as_provider.non_terminated_nodes():
+                self.violations.append(
+                    "autoscaler fleet failed to scale to zero after "
+                    "the soak")
+            with cluster.head._lock:
+                acks = {nid: rec["cause"] for nid, rec
+                        in cluster.head._terminate_acks.items()}
+            fleet_acks = {nid: c for nid, c in acks.items()
+                          if nid in set(a.launched)}
+            bad = {nid[:12]: c for nid, c in fleet_acks.items()
+                   if not (c == "preemption" or c.startswith("drain:")
+                           or c.startswith("failure:"))}
+            if bad:
+                self.violations.append(
+                    f"unattributed fleet terminations in ledger: {bad}")
+            if (self.autoscaler_preemptions
+                    and "preemption" not in fleet_acks.values()):
+                self.violations.append(
+                    "spot preemption not attributed as 'preemption' "
+                    "in the terminate ledger")
+        finally:
+            a.stop()
 
     # -- invariants --------------------------------------------------------
 
@@ -877,6 +1093,12 @@ class _Soak:
             signal_ready = self._signal_probe_setup()
         except Exception as e:  # noqa: BLE001
             self.violations.append(f"signal probe setup failed: {e!r}")
+        autoscaler_ready = False
+        try:
+            autoscaler_ready = self._autoscaler_probe_setup(cluster)
+        except Exception as e:  # noqa: BLE001
+            self.violations.append(
+                f"autoscaler probe setup failed: {e!r}")
         injector = threading.Thread(
             target=self._fault_loop, args=(cluster,), daemon=True)
         injector.start()
@@ -908,6 +1130,10 @@ class _Soak:
             if signal_ready:
                 threading.Thread(
                     target=self._signal_probe_loop,
+                    args=(deadline,), daemon=True).start()
+            if autoscaler_ready:
+                threading.Thread(
+                    target=self._autoscaler_probe_loop,
                     args=(deadline,), daemon=True).start()
             time.sleep(min(self.duration_s / 3.0, 10.0))
             self._drain_once(cluster)
@@ -1002,6 +1228,12 @@ class _Soak:
             except Exception as e:  # noqa: BLE001
                 self.violations.append(
                     f"signal probe teardown: {e!r}")
+        if autoscaler_ready:
+            try:
+                self._autoscaler_end_state(cluster)
+            except Exception as e:  # noqa: BLE001
+                self.violations.append(
+                    f"autoscaler probe end-state: {e!r}")
         try:
             from ray_tpu import serve
 
@@ -1036,6 +1268,13 @@ class _Soak:
             signal_queries_failed=self.signal_queries_failed,
             signal_slo_transitions=self.signal_slo_transitions,
             signal_missed_evals=self.signal_missed_evals,
+            autoscaler_rounds_ok=self.autoscaler_rounds_ok,
+            autoscaler_rounds_failed=self.autoscaler_rounds_failed,
+            autoscaler_launches=self.autoscaler_launches,
+            autoscaler_launch_failures=self.autoscaler_launch_failures,
+            autoscaler_quarantines=self.autoscaler_quarantines,
+            autoscaler_scale_downs=self.autoscaler_scale_downs,
+            autoscaler_preemptions=self.autoscaler_preemptions,
         )
         ray_tpu.shutdown()
         cluster.shutdown()

@@ -55,11 +55,36 @@ def cmd_status(args):
         state = node_state(n)
         why = n.get("DrainReason") if state == "DRAINING" \
             else n.get("DeathCause")
-        print(f"  {n['NodeID'][-12:]:<14} {state:<9}"
+        labels = n.get("Labels") or {}
+        kind = labels.get("node_type") or "-"
+        if labels.get("spot"):
+            kind += " (spot)"
+        print(f"  {n['NodeID'][-12:]:<14} {state:<9} {kind:<16}"
               + (f" ({why})" if why else ""))
     for k in sorted(total):
         print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
     from ray_tpu import state
+
+    fleet = state.autoscaler_status() or {}
+    if fleet.get("types"):
+        print(f"autoscaler: max_workers {fleet.get('max_workers', '?')}"
+              + (f", draining {len(fleet['draining'])}"
+                 if fleet.get("draining") else "")
+              + (f", SLO burns: {', '.join(fleet['slo_burns'])}"
+                 if fleet.get("slo_burns") else ""))
+        for name, t in sorted(fleet["types"].items()):
+            flags = []
+            if t.get("spot"):
+                flags.append("spot")
+            if t.get("quarantined"):
+                flags.append(
+                    f"QUARANTINED {t['quarantine_remaining_s']:g}s")
+            elif t.get("backoff_remaining_s"):
+                flags.append(f"backoff {t['backoff_remaining_s']:g}s")
+            if t.get("failures"):
+                flags.append(f"{t['failures']} boot failure(s)")
+            suffix = f" [{', '.join(flags)}]" if flags else ""
+            print(f"  {name:<16} nodes {t.get('nodes', 0)}{suffix}")
 
     pgs = state.placement_groups() or {}
     active = {pid: pg for pid, pg in pgs.items()
@@ -318,6 +343,22 @@ def _print_top(top, window):
                   f"{(f'{shed:.1%}' if shed is not None else '—'):>6} "
                   f"{ms('ttft_p50_s'):>9} {ms('itl_p50_s'):>9} "
                   f"{ms('latency_p50_s'):>9}")
+    fleet = top.get("fleet") or {}
+    churn = fleet.get("types") or {}
+    if churn:
+        hdr = (f"{'node type':<16} {'launch':>7} {'fail':>6} "
+               f"{'bench':>6} {'down':>6}")
+        print(hdr)
+        print("-" * len(hdr))
+        for t, c in sorted(churn.items()):
+            print(f"{t:<16} {c.get('launches', 0):>7} "
+                  f"{c.get('launch_failures', 0):>6} "
+                  f"{c.get('quarantines', 0):>6} "
+                  f"{c.get('scale_downs', 0):>6}")
+    pending = fleet.get("pending_demand") or {}
+    if pending:
+        print("pending demand: " + ", ".join(
+            f"{k} {v}" for k, v in sorted(pending.items())))
     train = top.get("train") or {}
     for trial, t in sorted(train.items()):
         gp = t.get("goodput_pct")

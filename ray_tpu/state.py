@@ -292,6 +292,17 @@ def signal_top(window_s: float = 60.0) -> dict:
     return {"ok": False, "error": "no cluster backend"}
 
 
+def autoscaler_status() -> dict:
+    """The fleet autoscaler's last state report: per-node-type counts
+    and spot markers, quarantine/backoff benches, nodes draining for
+    scale-down, and active SLO burns. ``{}`` until the autoscaler's
+    first reconcile pass (or on the local backend)."""
+    backend = _worker.backend()
+    if hasattr(backend, "autoscaler_status"):
+        return backend.autoscaler_status()
+    return {}
+
+
 def set_failpoints(specs: dict, include_workers: bool = True) -> dict:
     """Arm/disarm deterministic failpoints cluster-wide: ``{site: spec}``
     where spec is ``action[:arg][,selector...]`` (see

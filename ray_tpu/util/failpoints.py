@@ -100,6 +100,16 @@ SITES = frozenset({
     # scale decision (the pool keeps its current size and the map
     # completes); delay models slow actor boot.
     "data.pool.before_scale",
+    # fleet autoscaler execution half (round 17): tick fires once per
+    # reconcile pass (delay/hang models a wedged control loop — the
+    # loop must keep its cadence, not pile up), before_create injects
+    # boot failures (driving the backoff/quarantine schedule), and
+    # before_terminate interposes on scale-down AFTER the drain
+    # completed — a raise leaves the node for the next pass to reap,
+    # never un-drains it.
+    "autoscaler.tick",
+    "autoscaler.before_create",
+    "autoscaler.before_terminate",
 })
 
 # site -> _Failpoint. `hit()` gates on plain truthiness of this dict:

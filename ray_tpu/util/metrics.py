@@ -291,6 +291,47 @@ PG_RESCHEDULE_SECONDS = Histogram(
                 120.0],
 )
 
+# -- fleet autoscaler (execution half, round 17): per-node-type launch /
+# failure / quarantine / scale-down counters plus the pending-demand
+# gauge the bin-packer planned against — `ray-tpu top` reads these from
+# the signal ring as fleet churn. The pending-demand gauge is per-kind
+# (task/actor/pg_bundle/slo_burn) and retracted on autoscaler stop so a
+# torn-down fleet doesn't linger on the federated scrape.
+AUTOSCALER_LAUNCHES_TOTAL = Counter(
+    "ray_tpu_autoscaler_launches_total",
+    "Provider nodes successfully launched, by node type",
+    tag_keys=("node_type",),
+)
+AUTOSCALER_LAUNCH_FAILURES_TOTAL = Counter(
+    "ray_tpu_autoscaler_launch_failures_total",
+    "Provider create_node failures/timeouts, by node type",
+    tag_keys=("node_type",),
+)
+AUTOSCALER_QUARANTINES_TOTAL = Counter(
+    "ray_tpu_autoscaler_quarantines_total",
+    "Node types benched after consecutive boot failures",
+    tag_keys=("node_type",),
+)
+AUTOSCALER_SCALE_DOWNS_TOTAL = Counter(
+    "ray_tpu_autoscaler_scale_downs_total",
+    "Provider nodes terminated by scale-down (drained first unless the "
+    "head was unreachable), by node type",
+    tag_keys=("node_type",),
+)
+AUTOSCALER_LAUNCH_SECONDS = Histogram(
+    "ray_tpu_autoscaler_launch_seconds",
+    "Wall time of one successful provider create_node call",
+    boundaries=[0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 120.0,
+                300.0],
+    tag_keys=("node_type",),
+)
+AUTOSCALER_PENDING_DEMAND = Gauge(
+    "ray_tpu_autoscaler_pending_demand",
+    "Pending demand entries the bin-packer planned against, by kind "
+    "(task, actor, pg_bundle, slo_burn)",
+    tag_keys=("kind",),
+)
+
 # -- head control plane (head-side; the contention instrumentation the
 # 100k-task/1k-actor envelope reads: per-method handler latency on the
 # head's RPC server, time spent WAITING on each head lock shard — an

@@ -63,6 +63,7 @@ def placement_group(
     strategy: str = "PACK",
     name: str = "",
     lifetime: Optional[str] = None,
+    spot: bool = True,
 ) -> PlacementGroup:
     if strategy not in VALID_STRATEGIES:
         raise ValueError(f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}")
@@ -74,7 +75,7 @@ def placement_group(
         if any(v < 0 for v in b.values()):
             raise ValueError(f"bundle resources must be >= 0: {b!r}")
     pg_id = _worker.backend().create_placement_group(
-        [dict(b) for b in bundles], strategy, name, lifetime
+        [dict(b) for b in bundles], strategy, name, lifetime, spot=spot
     )
     return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy, name)
 

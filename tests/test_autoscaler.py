@@ -1,6 +1,12 @@
 """Autoscaler tests: demand-driven scale-up on a live simulated cluster and
 pure-unit reconciler behavior (reference: ``test_autoscaler.py``,
-``test_autoscaler_fake_multinode.py``)."""
+``test_autoscaler_fake_multinode.py``).
+
+Round 17 adds the execution half: heterogeneous bin-packing (STRICT_SPREAD
+needs N distinct nodes; ``spot: false`` gangs only count on-demand types),
+the launch-failure -> backoff -> quarantine -> fall-through boot loop,
+SLO-burn-triggered scale-up, occupancy-coldest idle scale-down, and the
+drain-before-terminate ordering guarantee."""
 
 import sys
 import time
@@ -11,8 +17,25 @@ import pytest
 import ray_tpu
 from ray_tpu.autoscaler import LocalNodeProvider, NodeProvider, StandardAutoscaler
 from ray_tpu.cluster import Cluster
+from ray_tpu.util import failpoints
 
 cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def wait_for(cond, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {msg}")
 
 
 class MockProvider(NodeProvider):
@@ -33,13 +56,81 @@ class MockProvider(NodeProvider):
         return list(self.nodes)
 
 
+class FakeHead:
+    """Stand-in for the head RPC client in pure-unit reconciler tests:
+    canned demand snapshot / node table / pubsub batches / occupancy, and
+    records of drains, terminate acks, and status reports."""
+
+    def __init__(self):
+        self.snapshot = {"tasks": [], "actors": [], "pg_bundles": []}
+        self.nodes = {}  # node_id -> node-table dict
+        self.poll_batches = []  # list of message lists, popped per poll
+        self.occupancy = {}  # node_id -> cpu percent
+        self.drained = []
+        self.acks = []
+        self.reports = []
+
+    def call(self, method, *args, **kwargs):
+        if method == "demand_snapshot":
+            return self.snapshot
+        if method == "nodes":
+            return [dict(n) for n in self.nodes.values()]
+        if method == "pubsub_subscribe":
+            return args[0]
+        if method == "pubsub_poll":
+            if self.poll_batches:
+                return (self.poll_batches.pop(0), 0)
+            return ([], 0)
+        if method == "query_metrics":
+            return {"ok": True, "op": "gauge_avg",
+                    "value": dict(self.occupancy)}
+        if method == "drain_node":
+            node_id, reason = args[0], args[1]
+            self.drained.append(node_id)
+            n = self.nodes.get(node_id)
+            if n is not None:  # instant drain: node goes DEAD
+                n["Alive"] = False
+                n["State"] = "DEAD"
+                n["DeathCause"] = f"drained: {reason}"
+            return {"ok": True}
+        if method == "autoscaler_report":
+            self.reports.append(args[0])
+            return True
+        if method == "terminate_ack":
+            self.acks.append((args[0], args[1]))
+            return {"ok": True, "node_id": args[0]}
+        raise AssertionError(f"unexpected head call {method!r}")
+
+
+def _node(node_id, cpus, *, alive=True, state="ALIVE", used=0.0):
+    return {
+        "NodeID": node_id,
+        "Alive": alive,
+        "State": state,
+        "Resources": {"CPU": float(cpus)},
+        "Available": {"CPU": float(cpus) - used},
+        "Labels": {},
+    }
+
+
+def mk(provider, node_types, **kw):
+    """Real constructor (RpcClient is lazy — no dial until .call), head
+    swapped for a FakeHead."""
+    kw.setdefault("max_workers", 8)
+    kw.setdefault("idle_timeout_s", 9999.0)
+    kw.setdefault("launch_cooldown_s", 0.0)
+    a = StandardAutoscaler("127.0.0.1:1", provider,
+                           node_types=node_types, **kw)
+    fh = FakeHead()
+    a.head = fh
+    return a, fh
+
+
 def test_nodes_to_launch_bin_packing():
-    autoscaler = StandardAutoscaler.__new__(StandardAutoscaler)
-    autoscaler.max_workers = 8
-    autoscaler.node_types = {
+    autoscaler, _ = mk(MockProvider(), {
         "small": {"num_cpus": 2},
         "tpu_host": {"num_cpus": 8, "resources": {"TPU": 4}},
-    }
+    })
     # The TPU demand forces a tpu_host; the 1-CPU demands then pack into
     # its remaining headroom -> a single launch covers everything.
     launches = autoscaler._nodes_to_launch(
@@ -57,6 +148,147 @@ def test_nodes_to_launch_bin_packing():
         [{"CPU": 2}, {"TPU": 4}], n_current=1
     )
     assert launches == []
+
+
+def test_strict_spread_bundles_need_distinct_nodes():
+    autoscaler, _ = mk(MockProvider(), {"big": {"num_cpus": 8}})
+    spread = {"tasks": [], "actors": [], "pg_bundles": [{
+        "pg_id": "pg-1", "strategy": "STRICT_SPREAD",
+        "bundles": [{"CPU": 1}, {"CPU": 1}, {"CPU": 1}], "spot": True,
+    }]}
+    # 3 bundles-worth of CPU fits one node, but STRICT_SPREAD constrains
+    # node COUNT: three distinct hosts.
+    assert autoscaler._nodes_to_launch(spread, n_current=0) == [
+        "big", "big", "big"]
+    packed = {"tasks": [], "actors": [], "pg_bundles": [{
+        "pg_id": "pg-2", "strategy": "PACK",
+        "bundles": [{"CPU": 1}, {"CPU": 1}, {"CPU": 1}], "spot": True,
+    }]}
+    assert autoscaler._nodes_to_launch(packed, n_current=0) == ["big"]
+
+
+def test_spot_false_gang_only_sizes_on_demand_types():
+    # Spot type is cheaper (listed first) but a spot:false gang must
+    # land on the on-demand type; plain task demand takes the spot type.
+    autoscaler, _ = mk(MockProvider(), {
+        "cheap_spot": {"num_cpus": 4, "spot": True},
+        "ondemand": {"num_cpus": 4},
+    })
+    gang = {"tasks": [], "actors": [], "pg_bundles": [{
+        "pg_id": "pg-crit", "strategy": "PACK",
+        "bundles": [{"CPU": 2}], "spot": False,
+    }]}
+    assert autoscaler._nodes_to_launch(gang, n_current=0) == ["ondemand"]
+    tasks = {"tasks": [{"CPU": 2}], "actors": [], "pg_bundles": []}
+    assert autoscaler._nodes_to_launch(tasks, n_current=0) == ["cheap_spot"]
+
+
+def test_launch_failure_backoff_quarantine_fallthrough():
+    class FlakyProvider(MockProvider):
+        def __init__(self):
+            super().__init__()
+            self.attempts = {}
+
+        def create_node(self, node_type, node_config):
+            self.attempts[node_type] = self.attempts.get(node_type, 0) + 1
+            if node_type == "flaky":
+                raise RuntimeError("boot failed")
+            return super().create_node(node_type, node_config)
+
+    provider = FlakyProvider()
+    autoscaler, fh = mk(provider, {
+        "flaky": {"num_cpus": 4},
+        "fallback": {"num_cpus": 4},
+    }, backoff_base_s=0.01, backoff_max_s=0.05,
+        quarantine_failures=3, quarantine_cooldown_s=60.0)
+    fh.snapshot = {"tasks": [{"CPU": 2}], "actors": [], "pg_bundles": []}
+    for _ in range(80):
+        autoscaler.update()
+        if provider.attempts.get("fallback"):
+            break
+        time.sleep(0.02)
+    # Exactly quarantine_failures create attempts on the flaky type
+    # (backoff gates retries; quarantine then benches it for 60s), after
+    # which demand falls through to the next feasible type.
+    assert provider.attempts["flaky"] == 3
+    assert provider.attempts["fallback"] == 1
+    assert autoscaler._quarantined("flaky", time.monotonic())
+    assert list(provider.nodes.values()) == ["fallback"]
+    # The head-facing status report shows the bench.
+    types = fh.reports[-1]["types"]
+    assert types["flaky"]["quarantined"] is True
+    assert types["flaky"]["quarantine_remaining_s"] > 0
+
+
+def test_slo_burn_event_triggers_scale_up():
+    provider = MockProvider()
+    autoscaler, fh = mk(provider, {"small": {"num_cpus": 2}})
+    fh.poll_batches = [[{"channel": "SLO", "key": "ttft_p50",
+                         "message": {"slo": "ttft_p50",
+                                     "state": "burning"}}]]
+    report = autoscaler.update()  # burn transition -> one boost launch
+    assert len(report["launched"]) == 1
+    assert provider.nodes  # capacity added ahead of pending work
+    # Still burning but already boosted: no launch storm.
+    report = autoscaler.update()
+    assert report["launched"] == []
+    # Recovery clears the burn state.
+    fh.poll_batches = [[{"channel": "SLO", "key": "ttft_p50",
+                         "message": {"slo": "ttft_p50", "state": "ok"}}]]
+    autoscaler.update()
+    assert autoscaler._slo_burn == {}
+
+
+def test_idle_scale_down_picks_occupancy_coldest_first():
+    provider = MockProvider()
+    autoscaler, fh = mk(provider, {"small": {"num_cpus": 2}},
+                        idle_timeout_s=0.0)
+    hot = provider.create_node("small", {"num_cpus": 2})
+    cold = provider.create_node("small", {"num_cpus": 2})
+    fh.nodes = {hot: _node(hot, 2), cold: _node(cold, 2)}
+    fh.occupancy = {hot: 85.0, cold: 1.0}
+    report = autoscaler.update()
+    # Both are idle NOW, but the windowed signal ring says `cold` had
+    # less recent load: it drains first.
+    assert fh.drained == [cold, hot]
+    # FakeHead drains instantly, so the settle pass terminates both —
+    # and only AFTER the drain, with the ledger acked as planned.
+    assert sorted(report["terminated"]) == sorted([hot, cold])
+    assert fh.acks == [(cold, "drain:autoscaler_idle"),
+                       (hot, "drain:autoscaler_idle")]
+    assert provider.non_terminated_nodes() == []
+
+
+def test_externally_dead_nodes_reclaimed_with_attributed_cause():
+    """A spot preemption or operator drain lands as a head-side death
+    the provider never hears about: the next reconcile pass terminates
+    the stale provider slot and closes the goodput ledger with the
+    attributed cause (preemption / drain:<reason> / failure:<cause>)."""
+    provider = MockProvider()
+    autoscaler, fh = mk(provider, {
+        "spot_small": {"num_cpus": 2, "spot": True},
+        "small": {"num_cpus": 2},
+    })
+    preempted = provider.create_node("spot_small", {"num_cpus": 2})
+    drained = provider.create_node("small", {"num_cpus": 2})
+    crashed = provider.create_node("small", {"num_cpus": 2})
+    autoscaler._node_type_of.update({preempted: "spot_small",
+                                     drained: "small", crashed: "small"})
+    n1 = _node(preempted, 2, alive=False, state="DEAD")
+    n1["DeathCause"] = "drained: preemption"
+    n2 = _node(drained, 2, alive=False, state="DEAD")
+    n2["DeathCause"] = "drained: maintenance"
+    n3 = _node(crashed, 2, alive=False, state="DEAD")
+    n3["DeathCause"] = "heartbeat timeout"
+    fh.nodes = {preempted: n1, drained: n2, crashed: n3}
+    report = autoscaler.update()
+    assert sorted(report["terminated"]) == sorted(
+        [preempted, drained, crashed])
+    assert provider.non_terminated_nodes() == []
+    causes = dict(fh.acks)
+    assert causes[preempted] == "preemption"
+    assert causes[drained] == "drain:maintenance"
+    assert causes[crashed] == "failure:heartbeat timeout"
 
 
 def test_scale_up_makes_pending_task_runnable():
@@ -88,7 +320,11 @@ def test_scale_up_makes_pending_task_runnable():
         cluster.shutdown()
 
 
-def test_scale_down_idle_nodes():
+def test_scale_down_drains_before_terminate():
+    """Idle scale-down is drain-first even under a terminate failpoint:
+    the provider hook only ever fires on a node the head already reports
+    DEAD with a ``drained:`` cause, and a failed terminate retries on a
+    later pass instead of leaking the node."""
     ray_tpu.shutdown()
     cluster = Cluster()
     cluster.add_node(num_cpus=1)
@@ -103,23 +339,138 @@ def test_scale_down_idle_nodes():
         idle_timeout_s=0.5,
         launch_cooldown_s=0.0,
     )
+    observed = []
+    real_terminate = provider.terminate_node
+
+    def spy(node_id):
+        info = {n["NodeID"]: n
+                for n in cluster.head.rpc_nodes()}.get(node_id)
+        observed.append((info["Alive"], info["DeathCause"]))
+        real_terminate(node_id)
+
+    provider.terminate_node = spy
+    # First terminate attempt dies before the provider hook.
+    failpoints.set_failpoints(
+        {"autoscaler.before_terminate": "raise:chaos,once"})
     try:
         node_id = provider.create_node("big", {"num_cpus": 2})
         cluster.wait_for_nodes()
-        assert provider.non_terminated_nodes() == [node_id]
         autoscaler.update()  # first observation starts the idle clock
         time.sleep(0.8)  # exceed idle timeout
-        report = autoscaler.update()
-        assert node_id in report["terminated"]
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline:
-            alive = [n for n in ray_tpu.nodes() if n["Alive"]]
-            if len(alive) == 1:
+        terminated = []
+        for _ in range(100):
+            terminated += autoscaler.update()["terminated"]
+            if node_id in terminated:
                 break
-            time.sleep(0.1)
+            time.sleep(0.05)
+        assert node_id in terminated  # retried past the chaos raise
+        # The provider hook only ever saw a drained-dead node.
+        assert observed and all(
+            alive is False and cause.startswith("drained:")
+            for alive, cause in observed)
         assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == 1
+        # The ledger got the planned-removal attribution.
+        assert cluster.head.rpc_terminate_ack(node_id, "x")["ok"]
     finally:
         autoscaler.stop()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_spot_preemption_reschedules_actor_without_budget_burn():
+    """A spot node's preemption notice drains it; the restartable actor
+    on it migrates budget-free (planned removal is not a crash)."""
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    provider = LocalNodeProvider(cluster)
+    try:
+        spot_id = provider.create_node(
+            "spot_tpu", {"num_cpus": 2, "spot": True})
+        cluster.wait_for_nodes()
+        labels = {n["NodeID"]: n["Labels"] for n in ray_tpu.nodes()}
+        assert labels[spot_id] == {"node_type": "spot_tpu", "spot": True}
+
+        @ray_tpu.remote(num_cpus=2, max_restarts=1)
+        class Worker:
+            def ping(self):
+                return "ok"
+
+        actor = Worker.remote()  # only fits the 2-CPU spot node
+        assert ray_tpu.get(actor.ping.remote(), timeout=30) == "ok"
+        cluster.add_node(num_cpus=2)  # on-demand fallback capacity
+        cluster.wait_for_nodes()
+        # Preemption signal -> drain plane (what the provider's
+        # preemption watcher feeds).
+        cluster.head.rpc_drain_node(spot_id, "preemption", 15.0,
+                                    wait=True)
+        assert ray_tpu.get(actor.ping.remote(), timeout=60) == "ok"
+        # Budget-free migration: max_restarts untouched.
+        rec = cluster.head._actor_specs[actor._actor_id]
+        assert rec["restarts_left"] == 1
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_demand_snapshot_and_terminate_ack():
+    """The head's demand snapshot carries queued-task shapes and the
+    unplaced bundles of pending PGs (with their spot marker); the
+    terminate ack refuses live nodes and absorbs duplicates."""
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(num_cpus=4)
+        def too_big():
+            return 1
+
+        too_big.remote()  # infeasible on a 2-CPU fleet -> demand miss
+        wait_for(
+            lambda: any(d.get("CPU") == 4.0 for d in
+                        cluster.head.rpc_demand_snapshot(30.0)["tasks"]),
+            timeout=10, msg="queued task demand in snapshot")
+
+        @ray_tpu.remote(num_cpus=2)
+        class Hog:
+            def ping(self):
+                return "ok"
+
+        hog = Hog.remote()  # holds the node's CPUs
+        assert ray_tpu.get(hog.ping.remote(), timeout=30) == "ok"
+        from ray_tpu.util.placement_group import placement_group
+
+        pg = placement_group([{"CPU": 2}], strategy="PACK", spot=False)
+
+        def pg_demand():
+            snap = cluster.head.rpc_demand_snapshot(30.0)
+            return [p for p in snap["pg_bundles"] if p["pg_id"] == pg.id]
+
+        wait_for(lambda: bool(pg_demand()), timeout=10,
+                 msg="pending PG bundles in snapshot")
+        entry = pg_demand()[0]
+        assert entry["strategy"] == "PACK"
+        assert entry["spot"] is False
+        assert entry["bundles"] == [{"CPU": 2}]
+
+        # Ack protocol: refuse while the node is alive ...
+        node_id = [n["NodeID"] for n in ray_tpu.nodes() if n["Alive"]][0]
+        res = cluster.head.rpc_terminate_ack(node_id, "drain:test")
+        assert res["ok"] is False
+        # ... accept after a drain, idempotently on replay.
+        agent = cluster.add_node(num_cpus=1)
+        cluster.wait_for_nodes()
+        cluster.head.rpc_drain_node(agent.node_id, "scale_down", 10.0,
+                                    wait=True)
+        assert cluster.head.rpc_terminate_ack(
+            agent.node_id, "drain:scale_down")["ok"] is True
+        assert cluster.head.rpc_terminate_ack(
+            agent.node_id, "drain:scale_down")["ok"] is True
+    finally:
         ray_tpu.shutdown()
         cluster.shutdown()
 
