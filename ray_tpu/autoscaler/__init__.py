@@ -663,10 +663,16 @@ class StandardAutoscaler:
     def start(self, interval_s: float = 1.0) -> None:
         def loop():
             from ray_tpu.util import metrics
+            from ray_tpu.util import tracing
 
             while not self._stop.wait(interval_s):
                 try:
-                    self.update()
+                    # Suppressed: the reconcile pass fans out head/agent
+                    # RPCs every second — cadence traffic that would
+                    # swamp the span buffer with traces nobody asked
+                    # for (same rule as the serve controller's loop).
+                    with tracing.suppressed():
+                        self.update()
                 except Exception:
                     metrics.count_loop_restart("autoscaler.reconcile")
                     continue

@@ -286,8 +286,50 @@ class ClusterBackend:
                 target=self._log_poll_loop, args=(subscribed,),
                 daemon=True,
             ).start()
+        if process_kind != "w":
+            # Driver/proxy-side spans (submit:, serve.http, serve.route,
+            # serve.stream) have no workerproc event flusher to carry
+            # them — without this daemon the head's flight recorder
+            # assembles traces missing their roots. Workers skip it:
+            # their spans ride the agent event batch, node-attributed.
+            threading.Thread(target=self._span_flush_loop,
+                             daemon=True).start()
 
     # -- plumbing ----------------------------------------------------------
+
+    def _span_flush_loop(self):
+        from ray_tpu.util import metrics as _metrics
+
+        while not self._closed:
+            time.sleep(0.5)
+            try:
+                self._flush_spans()
+            except Exception:
+                _metrics.count_loop_restart("client.span_flush")
+
+    def _flush_spans(self):
+        """Ship this process's finished spans (and its span-buffer
+        truncation count) to the head's flight recorder."""
+        from ray_tpu.util import tracing
+
+        # A closed client must not keep draining the process-global
+        # span buffer: the next backend (or a local collect()) owns it.
+        if self._closed or not tracing.is_enabled():
+            return
+        spans = tracing.drain()
+        dropped = tracing.drain_dropped()
+        if not spans and not dropped:
+            return
+        with tracing.suppressed():
+            try:
+                self.head.call(
+                    "report_spans", spans, "driver:" + self.client_id,
+                    dropped=dropped, timeout=10.0)
+            except Exception:
+                # The batch is gone (drain pops); count it as dropped
+                # rather than silently losing spans AND their counter.
+                tracing.requeue_dropped(dropped + len(spans))
+                raise
 
     def _node_client(self, address: str) -> RpcClient:
         with self._lock:
@@ -2622,6 +2664,34 @@ class ClusterBackend:
         """Finished tracing spans from the head's span store (fed by the
         workers' batched event reports)."""
         return self.head.call("list_spans", trace_id, limit, timeout=15.0)
+
+    # -- trace flight recorder (head-assembled; cluster/traces.py) ---------
+
+    def _flush_spans_quiet(self):
+        """Best-effort pre-query flush so a trace queried right after
+        its request finished isn't missing this process's spans."""
+        try:
+            self._flush_spans()
+        except Exception:
+            pass
+
+    def get_trace(self, trace_id: str):
+        self._flush_spans_quiet()
+        return self.head.call("get_trace", trace_id, timeout=15.0)
+
+    def list_traces(self, limit: int = 50) -> list:
+        self._flush_spans_quiet()
+        return self.head.call("list_traces", limit, timeout=15.0)
+
+    def trace_stats(self) -> dict:
+        self._flush_spans_quiet()
+        return self.head.call("trace_stats", timeout=15.0)
+
+    def ttft_decomposition(self, window_s: float | None = None,
+                           deployment: str | None = None) -> dict:
+        self._flush_spans_quiet()
+        return self.head.call("ttft_decomposition", window_s, deployment,
+                              timeout=15.0)
 
     def cluster_metrics_text(self) -> str:
         """The head's federated /metrics/cluster body."""

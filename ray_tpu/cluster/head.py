@@ -376,6 +376,10 @@ class HeadServer:
         self._spans: "collections.deque" = collections.deque(  # guarded-by: _event_lock
             maxlen=max(16, config.head_span_retention))
         self._spans_dropped = 0
+        # worker/driver-side span truncation shipped with event batches
+        # (tracing._record overflow in OTHER processes, re-attributed
+        # here so one query answers "is any process clipping spans").
+        self._worker_span_drops = 0  # guarded-by: _event_lock
         # object directory: oid -> {"nodes": set, "error": bool}
         self._objects: dict[str, dict] = {}  # guarded-by: _obj_lock
         self._objects_cv = threading.Condition(self._obj_lock)
@@ -514,6 +518,20 @@ class HeadServer:
             if config.slo_eval_interval_s > 0:
                 threading.Thread(
                     target=self._slo_eval_loop, daemon=True).start()
+        # Trace assembly (cluster/traces.py): spans arriving via
+        # rpc_report_spans stitch into whole cross-node traces with
+        # tail sampling; the SLO plane reads exemplar trace_ids from it
+        # so a burning latency objective names concrete traces.
+        from ray_tpu.cluster.traces import TraceStore
+
+        self._traces = TraceStore(
+            max_traces=config.head_trace_retention,
+            sample_rate=config.trace_sample_rate,
+            slow_threshold_s=config.trace_slow_threshold_s,
+            max_spans_per_trace=config.trace_max_spans,
+            quiet_s=config.trace_quiet_s)
+        if self._signals is not None:
+            self._signals.set_exemplar_source(self._traces.exemplars)
 
     # -- persistence ------------------------------------------------------
 
@@ -1084,7 +1102,21 @@ class HeadServer:
 
     # -- tracing span store (util/tracing.py; OTel-shaped) ----------------
 
-    def rpc_report_spans(self, spans):
+    def rpc_report_spans(self, spans, node_id=None, dropped=0):
+        if dropped:
+            # Worker/driver-side truncation (tracing._record overflowed
+            # its bounded buffer) shipped with the batch: fold into the
+            # head-scraped counter so `ray-tpu top` sees every drop no
+            # matter whose process clipped.
+            with self._event_lock:
+                self._worker_span_drops += int(dropped)
+            try:
+                from ray_tpu.util import metrics as _metrics
+
+                _metrics.TRACING_DROPPED_SPANS.inc(
+                    int(dropped), tags={"node_id": node_id or "unknown"})
+            except Exception:
+                pass
         with self._event_lock:
             overflow = max(
                 0, len(self._spans) + len(spans) - self._spans.maxlen)
@@ -1097,6 +1129,11 @@ class HeadServer:
                     _metrics.HEAD_SPANS_DROPPED.inc(overflow)
                 except Exception:
                     pass
+        # Assembly path: the same batch stitches into whole traces
+        # (node-attributed so clock-offset alignment knows whose clock
+        # stamped each span). Outside _event_lock — the store has its
+        # own lock and never calls back into head state.
+        self._traces.add_spans(spans, node_id=node_id)
         return True
 
     def rpc_list_spans(self, trace_id=None, limit: int = 10_000):
@@ -1104,6 +1141,48 @@ class HeadServer:
             out = [s for s in self._spans
                    if trace_id is None or s["trace_id"] == trace_id]
             return out[-limit:]
+
+    # -- trace assembly (cluster/traces.py flight recorder) ----------------
+
+    def _drain_own_spans(self) -> None:
+        """The head's own spans (rpc: handler spans opened when a
+        traced client call carries a traceparent) have no event flusher
+        — fold them into the ring + store on the query path."""
+        from ray_tpu.util import tracing as _tracing
+
+        if not _tracing.is_enabled():
+            return
+        spans = _tracing.drain()
+        if spans:
+            self.rpc_report_spans(spans)
+
+    def rpc_get_trace(self, trace_id: str):
+        self._drain_own_spans()
+        return self._traces.get(trace_id)
+
+    def rpc_list_traces(self, limit: int = 50):
+        self._drain_own_spans()
+        return self._traces.list(limit)
+
+    def rpc_trace_stats(self):
+        return self._traces.stats()
+
+    def rpc_ttft_decomposition(self, window_s=None, deployment=None):
+        self._drain_own_spans()
+        return self._traces.ttft_decomposition(window_s, deployment)
+
+    def rpc_clock_probe(self, t0: float):
+        """NTP-style exchange for per-node clock-offset estimation: the
+        agent sends its clock's ``t0``, we answer (receive time, reply
+        time) on ours; the agent computes the offset from the round
+        trip and reports it via rpc_report_clock."""
+        t1 = time.time()
+        return (t1, time.time())
+
+    def rpc_report_clock(self, node_id: str, offset_s: float,
+                         rtt_s: float):
+        self._traces.clock.observe(node_id, offset_s, rtt_s)
+        return True
 
     # -- distributed ref-counting -----------------------------------------
 
@@ -2311,10 +2390,15 @@ class HeadServer:
 
     def _scrape_signals_once(self):
         from ray_tpu.util import metrics as _metrics
+        from ray_tpu.util import tracing as _tracing
 
-        t0 = time.perf_counter()
-        text = self.cluster_metrics_text()
-        n_series = self._signals.ingest_text(time.time(), text)
+        # Suppressed: the self-scrape fans an RPC to every agent on a
+        # 2s cadence forever — with tracing enabled those control-plane
+        # spans would drown the request traces the recorder exists for.
+        with _tracing.suppressed():
+            t0 = time.perf_counter()
+            text = self.cluster_metrics_text()
+            n_series = self._signals.ingest_text(time.time(), text)
         _metrics.HEAD_SIGNAL_SCRAPE_SECONDS.observe(
             time.perf_counter() - t0)
         _metrics.HEAD_SIGNAL_SERIES.set(float(n_series))
@@ -2370,8 +2454,16 @@ class HeadServer:
         sleeps in this path by construction."""
         if self._signals is None:
             return {"ok": False, "error": "signal plane disabled"}
-        return {"ok": True,
-                **self._signals.top_summary(float(window_s))}
+        out = {"ok": True,
+               **self._signals.top_summary(float(window_s))}
+        # Flight-recorder rollup: assembled/kept/dropped trace counts
+        # and span-truncation drops, so `ray-tpu top` shows whether the
+        # trace plane is whole (no-silent-caps surfaced, not buried).
+        out["traces"] = self._traces.stats()
+        with self._event_lock:
+            out["traces"]["head_spans_dropped"] = self._spans_dropped
+            out["traces"]["worker_spans_dropped"] = self._worker_span_drops
+        return out
 
     # -- chaos / fault-injection control plane -----------------------------
     # The head is the arming point for cluster-wide deterministic fault

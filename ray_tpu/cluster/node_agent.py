@@ -770,7 +770,7 @@ class NodeAgent:
 
     def rpc_worker_events(self, worker_id, pid, task_events,  # idempotent
                           log_lines, spans=None, device=None, serve=None,
-                          train=None, seq=None):
+                          train=None, seq=None, dropped=None):
         """Batched observability report from a worker: authoritative task
         records (with timings/outcome + per-phase wall-ns), captured
         stdout/stderr lines, finished tracing spans (forwarded to the
@@ -845,9 +845,15 @@ class NodeAgent:
                     "worker_logs", self.node_id, pid, log_lines)
             except Exception:
                 pass  # head restarting/unreachable: logs are best-effort
-        if spans:
+        if spans or dropped:
+            # Node-attributed so the head's trace assembly can apply
+            # this node's clock offset to the batch; the truncation
+            # count rides along (worker registries are never scraped,
+            # so a clipped span buffer is only visible via this path).
             try:
-                self.head.call("report_spans", spans)
+                self.head.call(
+                    "report_spans", spans or [], self.node_id,
+                    dropped=dropped or 0)
             except Exception:
                 pass
         failed = [r for r in task_events if r.get("state") == "FAILED"]
@@ -2747,6 +2753,7 @@ class NodeAgent:
                     continue  # peer down: membership refresh cleans up
 
     def _heartbeat_loop(self):
+        beats = 0
         while not self._shutdown.wait(config.heartbeat_interval_s):
             try:
                 failpoints.hit("agent.heartbeat")
@@ -2759,8 +2766,33 @@ class NodeAgent:
                     # stop serving) instead of running on as a zombie node.
                     self.stop()
                     return
+                beats += 1
+                if beats % max(1, config.clock_probe_every_beats) == 0:
+                    self._probe_clock()
             except Exception:
                 continue
+
+    def _probe_clock(self):
+        """NTP-style offset estimate against the head's clock, riding
+        the heartbeat cadence: offset = ((t1-t0)+(t2-t3))/2 with rtt as
+        the quality weight. The head's trace assembly shifts this node's
+        span timestamps by the min-RTT-filtered median, so cross-node
+        critical paths don't invert at machine clock skew. Suppressed:
+        the probe must never generate spans of its own (it would recurse
+        into the very plane it calibrates)."""
+        from ray_tpu.util import tracing as _tracing
+
+        try:
+            with _tracing.suppressed():
+                t0 = time.time()
+                t1, t2 = self.head.call("clock_probe", t0, timeout=5.0)
+                t3 = time.time()
+                offset = ((t1 - t0) + (t2 - t3)) / 2.0
+                rtt = (t3 - t0) - (t2 - t1)
+                self.head.call("report_clock", self.node_id, offset,
+                               rtt, timeout=5.0)
+        except Exception:
+            pass  # best-effort: next beat re-probes
 
     # -- chaos / fault-injection control plane -----------------------------
 

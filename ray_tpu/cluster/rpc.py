@@ -10,7 +10,10 @@ style) are therefore fine.
 
 Wire format (round 5, replaces pickle-on-the-wire): 4-byte big-endian
 length || msgpack frame (``wire.WireCodec``). Requests are
-``{"m": method, "a": args, "k": kwargs[, "st": true]}``; responses
+``{"m": method, "a": args, "k": kwargs[, "st": true][, "tp": traceparent]}``
+(``tp`` is a W3C traceparent carried only when the calling thread has an
+active trace — the server parents an ``rpc:<method>`` span under it, so
+one trace id follows a request across every RPC hop); responses
 ``{"ok": true, "v": value}`` / ``{"ok": false, "e": exc, "tb": str}``;
 streaming responses are ``{"ok": true, "stream": true}`` followed by one
 ``{"s": item}`` frame per yielded item and ``{"end": true}``. Hot-path
@@ -42,7 +45,10 @@ import time
 import traceback
 from typing import Any, Callable, Iterator
 
+from contextlib import nullcontext
+
 from ray_tpu.cluster.wire import WireCodec, WireError
+from ray_tpu.util import tracing as _tracing
 
 _LEN = struct.Struct(">I")
 
@@ -56,6 +62,16 @@ def get_cluster_token() -> bytes:
     from ray_tpu.core.config import config
 
     return config.cluster_token.encode()
+
+
+def _outbound_traceparent() -> str | None:
+    """The W3C traceparent an outbound request should carry: set only
+    when this thread is inside an active span (suppressed control-plane
+    cadence traffic, and everything while tracing is off, rides bare —
+    the envelope cost is zero unless a request is actually traced)."""
+    if not _tracing.is_enabled() or _tracing.is_suppressed():
+        return None
+    return _tracing.format_traceparent(_tracing.current_context())
 
 
 def ensure_cluster_token() -> str:
@@ -487,16 +503,29 @@ class RpcServer:
                 t0 = time.perf_counter()
                 try:
                     fn = getattr(self._handler, "rpc_" + req["m"])
-                    value = fn(*req.get("a", ()), **req.get("k", {}))
-                    if req.get("st"):
-                        self._stream_response(conn, codec, value)
-                        self._record_stat(
-                            req["m"], time.perf_counter() - t0)
-                        continue
-                    if hasattr(value, "__next__"):
-                        # Streaming handler invoked without st: drain so
-                        # the reply is still one frame.
-                        value = list(value)
+                    # Trace propagation: a request carrying a W3C
+                    # traceparent parents an rpc:<method> span on this
+                    # side of the hop (only when this process traces —
+                    # the sampling decision belongs to the server, and
+                    # spans opened by the handler nest under it via the
+                    # thread-local current span).
+                    parent = _tracing.parse_traceparent(req.get("tp")) \
+                        if req.get("tp") and _tracing.is_enabled() \
+                        else None
+                    span_cm = _tracing.span(
+                        "rpc:" + req["m"], parent=parent, cat="rpc") \
+                        if parent is not None else nullcontext()
+                    with span_cm:
+                        value = fn(*req.get("a", ()), **req.get("k", {}))
+                        if req.get("st"):
+                            self._stream_response(conn, codec, value)
+                            self._record_stat(
+                                req["m"], time.perf_counter() - t0)
+                            continue
+                        if hasattr(value, "__next__"):
+                            # Streaming handler invoked without st:
+                            # drain so the reply is still one frame.
+                            value = list(value)
                     self._record_stat(req["m"], time.perf_counter() - t0)
                     try:
                         _send_msg(conn, {"ok": True, "v": value}, codec)
@@ -770,6 +799,9 @@ class RpcClient:
             # args as a list: skips one EXT_TUPLE nesting per message on
             # the hottest path (the server *-unpacks either shape).
             req = {"m": method, "a": list(args), "k": kwargs}
+            tp = _outbound_traceparent()
+            if tp:
+                req["tp"] = tp
             _send_msg(conn, req, codec)
             sent = True
             if chaos_sever:
@@ -833,12 +865,18 @@ class RpcClient:
         if timeout is not None:
             conn.settimeout(timeout)
 
+        stream_req = {"m": method, "a": list(args), "k": kwargs,
+                      "st": True}
+        # Capture the traceparent HERE, not inside gen(): the stream is
+        # consumed lazily, possibly on another thread with no trace
+        # context.
+        tp = _outbound_traceparent()
+        if tp:
+            stream_req["tp"] = tp
+
         def gen():
             try:
-                _send_msg(
-                    conn,
-                    {"m": method, "a": list(args), "k": kwargs, "st": True},
-                    codec)
+                _send_msg(conn, stream_req, codec)
                 first = _recv_msg(conn, codec)
                 if not first.get("stream"):
                     if first.get("ok"):

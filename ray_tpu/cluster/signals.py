@@ -480,6 +480,31 @@ class SignalPlane:
         self.burn_evals = max(1, int(burn_evals))
         self._slo_lock = threading.Lock()
         self._slos: Dict[str, _Slo] = {}
+        # Metrics<->trace exemplars: the trace store's lookup hook
+        # (deployment, min_duration_s, limit) -> [{"trace_id", ...}].
+        # Optional — a plane without a trace store answers without
+        # exemplars, it never fails an SLO surface over them.
+        self._exemplar_source = None
+
+    def set_exemplar_source(self, source) -> None:
+        self._exemplar_source = source
+
+    def _exemplars_for(self, slo: _Slo, limit: int = 3) -> List[dict]:
+        """Sampled trace_ids for the traffic this SLO watches: latency-
+        quantile SLOs ask for traces at/over the threshold (the ones IN
+        the breaching histogram buckets), everything else takes the
+        slowest recent traces for the deployment."""
+        if self._exemplar_source is None:
+            return []
+        kind = slo.spec["signal"][0]
+        match = {**slo.spec["signal"][3], **slo.spec["match"]}
+        min_s = slo.spec["threshold"] if kind == "quantile" else 0.0
+        try:
+            return list(self._exemplar_source(
+                deployment=match.get("deployment"),
+                min_duration_s=min_s, limit=limit) or [])
+        except Exception:
+            return []
 
     # -- ingest (head scrape loop) ----------------------------------------
 
@@ -579,9 +604,15 @@ class SignalPlane:
 
     def slo_status(self) -> dict:
         with self._slo_lock:
-            slos = {name: slo.status()
+            slos = {name: (slo.status(), slo)
                     for name, slo in self._slos.items()}
-        return {"slos": slos, "burn_evals": self.burn_evals,
+        out = {}
+        for name, (status, slo) in slos.items():
+            if status["state"] in ("burning", "warning"):
+                status["exemplar_trace_ids"] = [
+                    e["trace_id"] for e in self._exemplars_for(slo)]
+            out[name] = status
+        return {"slos": out, "burn_evals": self.burn_evals,
                 "series": self.ring.series_count(),
                 "evictions": dict(self.ring.evictions)}
 
@@ -660,7 +691,7 @@ class SignalPlane:
                 slo.transitions += 1
             if (prev != "burning" and slo.state == "burning") or \
                     (prev == "burning" and slo.state == "ok"):
-                events.append({
+                ev = {
                     "slo": slo.name,
                     "expr": slo.spec["expr"],
                     "state": slo.state,
@@ -669,7 +700,14 @@ class SignalPlane:
                     "threshold": slo.spec["threshold"],
                     "window_s": slo.spec["window_s"],
                     "ts": now,
-                })
+                }
+                if slo.state == "burning":
+                    # A burn event names concrete traces: the operator
+                    # goes straight from "it's burning" to `ray-tpu
+                    # trace <id>` without hunting for a repro.
+                    ev["exemplar_trace_ids"] = [
+                        e["trace_id"] for e in self._exemplars_for(slo)]
+                events.append(ev)
             try:
                 _metrics.SLO_STATE.set(_STATE_CODE[slo.state],
                                        tags={"slo": slo.name})

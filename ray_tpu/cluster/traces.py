@@ -1,0 +1,552 @@
+"""Cross-node trace assembly: the head-side flight recorder.
+
+Spans reach the head one process at a time (worker event batches →
+``rpc_report_spans``); this module stitches them back into the request
+they came from. Three jobs, all bounded:
+
+* **Assembly** — group incoming spans by ``trace_id`` into pending
+  traces; a trace finalizes once its span stream goes quiet. Cross-node
+  timestamps are aligned with the per-node clock offset the agents
+  estimate from RPC request/response timestamps (NTP-style probe:
+  ``offset = ((t1 - t0) + (t2 - t3)) / 2``) and report on their
+  heartbeat cadence.
+* **Tail sampling** — the keep/drop decision happens at finalize time,
+  when the whole trace is known: every errored span and every trace
+  slower than ``trace_slow_threshold_s`` is kept, the rest are
+  deterministically sampled at ``trace_sample_rate``. Kept traces live
+  in a bounded ring; every drop is counted by cause (never a silent
+  cap). Phase decompositions are recorded for EVERY finalized trace
+  before the sampling decision, so windowed aggregates are unbiased.
+* **Analysis** — critical-path extraction (the blocking chain: at each
+  instant, the deepest active span owns the wall time) and TTFT
+  decomposition (the root→first-token interval partitioned into named
+  phases — queue / prefill / route / ... — summing exactly to the
+  interval, so "which phase IS the TTFT" is arithmetic, not a vibe).
+
+The store is head-state but deliberately backend-agnostic: the local
+backend instantiates its own ``TraceStore`` over ``tracing.collect()``
+so ``state.get_trace`` / ``state.ttft_decomposition`` answer the same
+shape on both backends.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# -- phase naming ----------------------------------------------------------
+#
+# Span-name prefix -> the named phase wall time is attributed to.
+# Longest prefix wins; anything unmapped is "other" (which is still
+# attributed — the decomposition must partition the interval, not
+# cherry-pick the phases it has names for).
+_PHASE_PREFIXES: List[Tuple[str, str]] = [
+    ("serve.http", "ingress"),
+    ("serve.route", "route"),
+    ("serve.replica", "handle"),
+    ("serve.stream", "stream"),
+    ("llm.queue", "queue"),
+    ("llm.prefill", "prefill"),
+    ("llm.decode", "decode"),
+    ("llm.step", "decode"),
+    ("submit:", "submit"),
+    ("run:", "execute"),
+    ("rpc:", "rpc"),
+]
+
+
+def phase_of(name: str) -> str:
+    best = "other"
+    best_len = 0
+    for prefix, phase in _PHASE_PREFIXES:
+        if name.startswith(prefix) and len(prefix) > best_len:
+            best, best_len = phase, len(prefix)
+    return best
+
+
+# -- clock alignment -------------------------------------------------------
+
+
+class ClockSync:
+    """Per-node clock offset, fed by NTP-style RPC timestamp exchanges.
+
+    The agent samples ``t0`` (its send time), the head answers with
+    ``(t1, t2)`` (receive / reply time), the agent samples ``t3`` on
+    return and reports ``offset = ((t1 - t0) + (t2 - t3)) / 2`` — the
+    estimate of (head clock - node clock). Samples ride a min-RTT
+    filter: a probe that sat in a TCP queue has a symmetric-delay
+    assumption violated, so only the crispest recent exchanges vote.
+    """
+
+    _WINDOW = 16
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # node_id -> deque[(rtt_s, offset_s)]  guarded-by: _lock
+        self._samples: Dict[str, collections.deque] = {}
+
+    def observe(self, node_id: str, offset_s: float, rtt_s: float) -> None:
+        with self._lock:
+            ring = self._samples.setdefault(
+                node_id, collections.deque(maxlen=self._WINDOW))
+            ring.append((max(0.0, float(rtt_s)), float(offset_s)))
+
+    def offset_s(self, node_id: Optional[str]) -> float:
+        """Best current (head - node) clock offset; 0.0 when unknown
+        (the head's own spans, or a node that never probed)."""
+        if not node_id:
+            return 0.0
+        with self._lock:
+            ring = self._samples.get(node_id)
+            if not ring:
+                return 0.0
+            # Median offset of the lowest-RTT half: robust to one
+            # queued probe without trusting any single exchange.
+            best = sorted(ring)[: max(1, len(ring) // 2)]
+            offs = sorted(o for _, o in best)
+            return offs[len(offs) // 2]
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            nodes = list(self._samples)
+        out = {}
+        for n in nodes:
+            with self._lock:
+                ring = list(self._samples.get(n) or ())
+            if ring:
+                out[n] = {
+                    "offset_s": self.offset_s(n),
+                    "rtt_s": min(r for r, _ in ring),
+                    "samples": len(ring),
+                }
+        return out
+
+
+def drop_node(sync: ClockSync, node_id: str) -> None:
+    """Forget a dead node's clock samples (retraction discipline)."""
+    with sync._lock:
+        sync._samples.pop(node_id, None)
+
+
+# -- assembly + analysis (pure functions over span lists) ------------------
+
+
+def _dur_ns(s: dict) -> int:
+    end = s.get("end_ns") or s.get("start_ns") or 0
+    return max(0, end - (s.get("start_ns") or 0))
+
+
+def find_root(spans: List[dict]) -> Optional[dict]:
+    """The trace's root: a span whose parent is absent from the batch
+    (the driver-side request span), earliest start wins ties."""
+    if not spans:
+        return None
+    ids = {s["span_id"] for s in spans}
+    roots = [s for s in spans
+             if not s.get("parent_id") or s["parent_id"] not in ids]
+    return min(roots or spans, key=lambda s: s.get("start_ns") or 0)
+
+
+def _children_map(spans: List[dict]) -> Dict[Optional[str], List[dict]]:
+    by_parent: Dict[Optional[str], List[dict]] = {}
+    for s in spans:
+        by_parent.setdefault(s.get("parent_id"), []).append(s)
+    for kids in by_parent.values():
+        kids.sort(key=lambda s: s.get("start_ns") or 0)
+    return by_parent
+
+
+def critical_path(spans: List[dict]) -> List[dict]:
+    """The blocking chain: partition the root's wall-clock interval so
+    that at every instant the deepest active span owns the time.
+    Returns ordered segments ``{name, phase, span_id, t0_ns, t1_ns,
+    self_s}`` summing exactly to the root's duration."""
+    root = find_root(spans)
+    if root is None:
+        return []
+    by_parent = _children_map(spans)
+    end_ns = root.get("end_ns") or max(
+        (s.get("end_ns") or s.get("start_ns") or 0) for s in spans)
+    segments: List[dict] = []
+
+    def emit(span: dict, t0: int, t1: int) -> None:
+        if t1 > t0:
+            segments.append({
+                "name": span["name"], "phase": phase_of(span["name"]),
+                "span_id": span["span_id"], "t0_ns": t0, "t1_ns": t1,
+                "self_s": (t1 - t0) / 1e9,
+            })
+
+    def walk(span: dict, lo: int, hi: int) -> None:
+        cursor = lo
+        for child in by_parent.get(span["span_id"], ()):
+            c0 = max(cursor, min(hi, child.get("start_ns") or cursor))
+            c1 = max(c0, min(hi, child.get("end_ns")
+                             or child.get("start_ns") or c0))
+            if c1 <= cursor:
+                continue
+            emit(span, cursor, c0)       # gap before the child: ours
+            walk(child, c0, c1)
+            cursor = c1
+        emit(span, cursor, hi)
+
+    walk(root, root.get("start_ns") or 0, end_ns)
+    return segments
+
+
+def ttft_point_ns(spans: List[dict]) -> Optional[int]:
+    """When the request's first token existed: the end of the last
+    prefill-phase span (continuous batching produces the first token at
+    prefill exit). None for traces with no prefill span."""
+    pts = [s.get("end_ns") for s in spans
+           if phase_of(s["name"]) == "prefill" and s.get("end_ns")]
+    return max(pts) if pts else None
+
+
+def decompose(spans: List[dict],
+              until_ns: Optional[int] = None) -> Optional[dict]:
+    """Per-phase wall-time attribution of ``[root start, until_ns]``
+    (default: the TTFT point, falling back to root end). The phases
+    partition the interval, so ``sum(phases.values()) == total_s``
+    by construction — the decomposition can't quietly lose time."""
+    root = find_root(spans)
+    if root is None or root.get("start_ns") is None:
+        return None
+    if until_ns is None:
+        until_ns = ttft_point_ns(spans) or root.get("end_ns")
+    if not until_ns or until_ns <= root["start_ns"]:
+        return None
+    phases: Dict[str, float] = {}
+    for seg in critical_path(spans):
+        t0 = seg["t0_ns"]
+        t1 = min(seg["t1_ns"], until_ns)
+        if t1 <= t0 or t0 >= until_ns:
+            continue
+        phases[seg["phase"]] = phases.get(seg["phase"], 0.0) \
+            + (t1 - t0) / 1e9
+    if not phases:
+        return None
+    total = (until_ns - root["start_ns"]) / 1e9
+    dominant = max(phases.items(), key=lambda kv: kv[1])[0]
+    return {"total_s": total, "phases": phases, "dominant": dominant,
+            "root": root["name"]}
+
+
+def render_tree(spans: List[dict]) -> str:
+    """ASCII tree of an assembled trace (the ``ray-tpu trace`` view)."""
+    root = find_root(spans)
+    if root is None:
+        return "(empty trace)"
+    by_parent = _children_map(spans)
+    t0 = root.get("start_ns") or 0
+    lines: List[str] = []
+
+    def fmt(span: dict, depth: int) -> None:
+        off_ms = ((span.get("start_ns") or t0) - t0) / 1e6
+        dur_ms = _dur_ns(span) / 1e6
+        status = span.get("status") or "OK"
+        mark = "" if status == "OK" else f"  !! {status}"
+        where = span.get("node_id") or f"pid {span.get('pid', '?')}"
+        lines.append(
+            f"{'  ' * depth}{span['name']}  "
+            f"[+{off_ms:.1f}ms  {dur_ms:.1f}ms  {where}]{mark}")
+        for child in by_parent.get(span["span_id"], ()):
+            fmt(child, depth + 1)
+
+    fmt(root, 0)
+    orphans = [s for s in spans if s is not root
+               and s.get("parent_id") not in {x["span_id"] for x in spans}]
+    for o in orphans:
+        fmt(o, 0)
+    return "\n".join(lines)
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+# -- the bounded store -----------------------------------------------------
+
+
+class TraceStore:
+    """Bounded assembly store: pending traces accumulate spans, quiet
+    traces finalize through tail sampling into a kept ring. Every
+    bounded decision is counted (``dropped`` by cause)."""
+
+    def __init__(self, *, max_traces: int = 512,
+                 sample_rate: float = 0.05,
+                 slow_threshold_s: float = 1.0,
+                 max_spans_per_trace: int = 4096,
+                 quiet_s: float = 1.5,
+                 decomp_retention: int = 2048,
+                 exemplar_retention: int = 64):
+        self.clock = ClockSync()
+        self._lock = threading.Lock()
+        self._max_traces = max(1, int(max_traces))
+        self._sample_rate = min(1.0, max(0.0, float(sample_rate)))
+        self._slow_s = float(slow_threshold_s)
+        self._span_cap = max(16, int(max_spans_per_trace))
+        self._quiet_s = float(quiet_s)
+        # trace_id -> {"spans": [...], "last": mono_ts}  guarded-by: _lock
+        self._pending: Dict[str, dict] = {}
+        # trace_id -> finalized record (insertion-ordered ring)
+        self._kept: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        # Every finalized trace's decomposition (pre-sampling, so the
+        # windowed aggregates are unbiased): (wall_ts, dep, decomp).
+        self._decomps: collections.deque = collections.deque(
+            maxlen=max(16, int(decomp_retention)))
+        # deployment -> deque[(wall_ts, ttft_s, trace_id)] of KEPT
+        # traces only — an exemplar the CLI can't resolve is worse
+        # than none.
+        self._exemplars: Dict[str, collections.deque] = {}
+        self._exemplar_n = max(4, int(exemplar_retention))
+        self.assembled_total = 0
+        self.dropped: Dict[str, int] = {
+            "sampled": 0, "evicted": 0, "span_cap": 0}
+
+    # -- ingest ------------------------------------------------------------
+
+    def add_spans(self, spans: List[dict],
+                  node_id: Optional[str] = None) -> None:
+        now = time.monotonic()
+        clipped = 0
+        with self._lock:
+            for s in spans:
+                tid = s.get("trace_id")
+                if not tid:
+                    continue
+                if node_id and not s.get("node_id"):
+                    s["node_id"] = node_id
+                entry = self._pending.get(tid)
+                if entry is None:
+                    if tid in self._kept:
+                        # Straggler span for an already-kept trace:
+                        # merge it (idempotently) instead of opening a
+                        # second pending trace under the same id.
+                        rec = self._kept[tid]
+                        if len(rec["spans"]) < self._span_cap and \
+                                s["span_id"] not in rec["span_ids"]:
+                            self._merge_kept(rec, s)
+                        continue
+                    entry = self._pending[tid] = {"spans": [],
+                                                  "ids": set(),
+                                                  "last": now}
+                if s["span_id"] in entry["ids"]:
+                    continue  # idempotent: event batches can resend
+                if len(entry["spans"]) >= self._span_cap:
+                    clipped += 1
+                    continue
+                entry["ids"].add(s["span_id"])
+                entry["spans"].append(s)
+                entry["last"] = now
+        if clipped:
+            self._count_drop("span_cap", clipped)
+        self.finalize_quiet(now)
+
+    def _merge_kept(self, rec: dict, s: dict) -> None:
+        # guarded-by: _lock (callers hold it)
+        off = self.clock.offset_s(s.get("node_id"))
+        s = self._aligned(s, off)
+        rec["spans"].append(s)
+        rec["span_ids"].add(s["span_id"])
+
+    @staticmethod
+    def _aligned(s: dict, offset_s: float) -> dict:
+        if not offset_s:
+            return s
+        shift = int(offset_s * 1e9)
+        s = dict(s)
+        if s.get("start_ns"):
+            s["start_ns"] = s["start_ns"] + shift
+        if s.get("end_ns"):
+            s["end_ns"] = s["end_ns"] + shift
+        s["clock_offset_s"] = offset_s
+        return s
+
+    def _count_drop(self, cause: str, n: int = 1) -> None:
+        with self._lock:
+            self.dropped[cause] = self.dropped.get(cause, 0) + n
+        try:
+            from ray_tpu.util import metrics as _metrics
+
+            _metrics.HEAD_TRACES_DROPPED.inc(n, tags={"cause": cause})
+        except Exception:
+            pass
+
+    # -- finalize / tail-sample --------------------------------------------
+
+    def finalize_quiet(self, now: Optional[float] = None,
+                       force: bool = False) -> int:
+        """Move quiet pending traces through the tail-sampling decision.
+        ``force`` finalizes everything pending (benches, shutdown)."""
+        now = time.monotonic() if now is None else now
+        ripe: List[Tuple[str, dict]] = []
+        with self._lock:
+            for tid, entry in list(self._pending.items()):
+                if force or now - entry["last"] >= self._quiet_s:
+                    ripe.append((tid, self._pending.pop(tid)))
+        for tid, entry in ripe:
+            self._finalize_one(tid, entry["spans"])
+        return len(ripe)
+
+    def _keep_decision(self, tid: str, spans: List[dict],
+                       duration_s: float) -> Tuple[bool, str]:
+        if any((s.get("status") or "OK") != "OK" for s in spans):
+            return True, "error"
+        if duration_s >= self._slow_s:
+            return True, "slow"
+        # Deterministic head-of-id sampling: the same trace id makes
+        # the same decision on every node (and in tests).
+        try:
+            bucket = int(tid[:8], 16) % 10_000
+        except (ValueError, TypeError):
+            bucket = 0
+        if bucket < int(self._sample_rate * 10_000):
+            return True, "sampled_in"
+        return False, "sampled"
+
+    def _finalize_one(self, tid: str, spans: List[dict]) -> None:
+        # Clock-align BEFORE analysis: the critical path of a cross-
+        # node trace is garbage if node clocks disagree by more than a
+        # hop takes.
+        aligned = [self._aligned(s, self.clock.offset_s(s.get("node_id")))
+                   for s in spans]
+        root = find_root(aligned)
+        duration_s = _dur_ns(root) / 1e9 if root else 0.0
+        decomp = decompose(aligned)
+        dep = None
+        for s in aligned:
+            dep = (s.get("attributes") or {}).get("deployment") or dep
+        wall_ts = time.time()
+        self.assembled_total += 1
+        if decomp is not None:
+            with self._lock:
+                self._decomps.append((wall_ts, dep, decomp))
+        keep, why = self._keep_decision(tid, aligned, duration_s)
+        if not keep:
+            self._count_drop("sampled")
+            return
+        rec = {
+            "trace_id": tid,
+            "spans": aligned,
+            "span_ids": {s["span_id"] for s in aligned},
+            "root": root["name"] if root else None,
+            "duration_s": duration_s,
+            "ts": wall_ts,
+            "kept_because": why,
+            "deployment": dep,
+            "decomposition": decomp,
+            "errored": any((s.get("status") or "OK") != "OK"
+                           for s in aligned),
+        }
+        evicted = 0
+        with self._lock:
+            self._kept[tid] = rec
+            while len(self._kept) > self._max_traces:
+                self._kept.popitem(last=False)
+                evicted += 1
+            if decomp is not None and dep is not None:
+                ring = self._exemplars.setdefault(
+                    dep, collections.deque(maxlen=self._exemplar_n))
+                ring.append((wall_ts, decomp["total_s"], tid))
+        if evicted:
+            self._count_drop("evicted", evicted)
+
+    # -- queries -----------------------------------------------------------
+
+    def get(self, trace_id: str) -> Optional[dict]:
+        self.finalize_quiet()
+        with self._lock:
+            rec = self._kept.get(trace_id)
+            if rec is None:
+                return None
+            out = dict(rec)
+            out["spans"] = list(rec["spans"])
+            out.pop("span_ids", None)
+        out["critical_path"] = critical_path(out["spans"])
+        return out
+
+    def list(self, limit: int = 50) -> List[dict]:
+        self.finalize_quiet()
+        with self._lock:
+            recs = list(self._kept.values())[-max(1, int(limit)):]
+        return [{k: r[k] for k in
+                 ("trace_id", "root", "duration_s", "ts",
+                  "kept_because", "deployment", "errored")}
+                | {"spans": len(r["spans"]),
+                   "dominant": (r["decomposition"] or {}).get("dominant")}
+                for r in reversed(recs)]
+
+    def ttft_decomposition(self, window_s: Optional[float] = None,
+                           deployment: Optional[str] = None) -> dict:
+        """Windowed per-phase p50/p99 over every finalized trace (pre-
+        sampling, so percentiles are unbiased by the keep decision)."""
+        self.finalize_quiet()
+        cutoff = time.time() - window_s if window_s else None
+        with self._lock:
+            rows = [(ts, dep, d) for ts, dep, d in self._decomps
+                    if (cutoff is None or ts >= cutoff)
+                    and (deployment is None or dep == deployment)]
+        totals = sorted(d["total_s"] for _, _, d in rows)
+        phase_vals: Dict[str, List[float]] = {}
+        for _, _, d in rows:
+            for phase, sec in d["phases"].items():
+                phase_vals.setdefault(phase, []).append(sec)
+        phases = {}
+        for phase, vals in sorted(phase_vals.items()):
+            vals.sort()
+            phases[phase] = {
+                "p50_s": _percentile(vals, 0.5),
+                "p99_s": _percentile(vals, 0.99),
+                "mean_s": sum(vals) / len(vals),
+                "count": len(vals),
+            }
+        dominant = max(phases.items(),
+                       key=lambda kv: kv[1]["p50_s"] or 0.0)[0] \
+            if phases else None
+        return {
+            "traces": len(rows),
+            "ttft_p50_s": _percentile(totals, 0.5),
+            "ttft_p99_s": _percentile(totals, 0.99),
+            "phases": phases,
+            "dominant": dominant,
+            "phase_sum_p50_s": sum(
+                (p["p50_s"] or 0.0) for p in phases.values()),
+        }
+
+    def exemplars(self, deployment: Optional[str] = None,
+                  min_duration_s: float = 0.0,
+                  limit: int = 4) -> List[dict]:
+        """Recent kept-trace exemplars, slowest first — what the SLO
+        plane attaches to burn events and histogram buckets so a
+        burning latency objective names concrete traces."""
+        with self._lock:
+            rows: List[Tuple[float, float, str]] = []
+            for dep, ring in self._exemplars.items():
+                if deployment is not None and dep != deployment:
+                    continue
+                rows.extend(ring)
+        rows = [r for r in rows if r[1] >= min_duration_s]
+        rows.sort(key=lambda r: r[1], reverse=True)
+        return [{"trace_id": tid, "ttft_s": ttft, "ts": ts}
+                for ts, ttft, tid in rows[:max(1, int(limit))]]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "pending": len(self._pending),
+                "kept": len(self._kept),
+                "assembled_total": self.assembled_total,
+                "dropped": dict(self.dropped),
+                "max_traces": self._max_traces,
+                "sample_rate": self._sample_rate,
+                "slow_threshold_s": self._slow_s,
+                "clock": self.clock.snapshot(),
+            }

@@ -235,6 +235,11 @@ class WorkerHandler:
                 events = self._task_events[:]
                 del self._task_events[:]
             spans = tracing.drain() if tracing.is_enabled() else []
+            # Span-buffer truncation count rides the batch (no-silent-caps:
+            # a worker clipping spans must show up in the head's scrape,
+            # and worker registries are never scraped directly).
+            span_drops = tracing.drain_dropped() if tracing.is_enabled() \
+                else 0
             # Serve request-path observations (phase histograms, shed
             # counters, replica gauges) ride the same batch; the module
             # is only consulted if something in this process imported
@@ -259,7 +264,7 @@ class WorkerHandler:
                 except Exception:
                     train_events = []
                     _metrics.count_loop_restart("worker.event_flush")
-            if not lines and not events and not spans \
+            if not lines and not events and not spans and not span_drops \
                     and not serve_events and not train_events \
                     and not unacked:
                 idle_rounds += 1
@@ -281,14 +286,15 @@ class WorkerHandler:
                 except Exception:
                     device = None
                     _metrics.count_loop_restart("worker.event_flush")
-            if lines or events or spans or serve_events or train_events \
-                    or device is not None or not unacked:
+            if lines or events or spans or span_drops or serve_events \
+                    or train_events or device is not None or not unacked:
                 # New content — or an empty liveness probe when nothing
                 # is pending resend (the resend IS the probe otherwise).
                 ship_seq += 1
                 unacked.append((ship_seq, events, lines, spans, device,
                                 serve_events or None,
-                                train_events or None))
+                                train_events or None,
+                                span_drops or None))
             while len(unacked) > 8:
                 # Bounded resend queue: give the oldest batch's
                 # exact-count planes back to their buffers (they count
@@ -296,7 +302,16 @@ class WorkerHandler:
                 # seq can double-apply only if one of its 8+ failed
                 # sends secretly landed — the narrow corner the bound
                 # trades for bounded memory.
-                _, _, _, _, _, drop_serve, drop_train = unacked.popleft()
+                (_, _, _, _, _, drop_serve, drop_train,
+                 drop_spans) = unacked.popleft()
+                # The evicted batch's truncation count folds back into
+                # the buffer — losing the loss-counter is the one drop
+                # this plane can never absorb silently.
+                try:
+                    if drop_spans:
+                        tracing.requeue_dropped(drop_spans)
+                except Exception:
+                    _metrics.count_loop_restart("worker.event_flush")
                 # Independent requeues: a failing serve requeue must
                 # not also cost the batch's goodput observations.
                 try:
@@ -311,12 +326,12 @@ class WorkerHandler:
                     _metrics.count_loop_restart("worker.event_flush")
             while unacked:
                 (seq, b_events, b_lines, b_spans, b_device, b_serve,
-                 b_train) = unacked[0]
+                 b_train, b_drops) = unacked[0]
                 try:
                     self.agent.call(
                         "worker_events", self.worker_id, pid, b_events,
                         b_lines, b_spans, b_device, b_serve, b_train,
-                        seq=seq)
+                        seq=seq, dropped=b_drops)
                     unacked.popleft()
                     consecutive_fail = 0
                 except Exception:

@@ -45,6 +45,24 @@ _DEFS: dict[str, tuple[type, Any]] = {
     # the drop counter surfaces in rpc_pubsub_stats / metrics). Bounds
     # head RSS under a 100k-task burst's span upload.
     "head_span_retention": (int, 100_000),
+    # -- trace assembly (cluster/traces.py flight recorder) ----------------
+    # Assembled traces the head keeps after tail sampling (bounded ring;
+    # evictions counted in ray_tpu_head_traces_dropped_total).
+    "head_trace_retention": (int, 512),
+    # Tail-sampling keep probability for unremarkable traces. Errored
+    # traces and traces slower than trace_slow_threshold_s are ALWAYS
+    # kept — sampling only thins the healthy fast ones.
+    "trace_sample_rate": (float, 0.05),
+    "trace_slow_threshold_s": (float, 1.0),
+    # A pending trace finalizes (tail-sampling decision) once its span
+    # stream has been quiet this long; spans per trace are capped (the
+    # clip is counted, never silent).
+    "trace_quiet_s": (float, 1.5),
+    "trace_max_spans": (int, 4096),
+    # Agents probe the head's clock every Nth heartbeat (NTP-style
+    # request/response timestamps -> per-node offset for cross-node
+    # span alignment); 0 disables probing.
+    "clock_probe_every_beats": (int, 10),
     # -- worker pool -------------------------------------------------------
     "workers_per_cpu": (int, 4),
     "worker_start_timeout_s": (float, 60.0),

@@ -850,6 +850,42 @@ class LocalBackend:
 
         return _metrics.prometheus_text()
 
+    # -- trace flight recorder (cluster/traces.py over local spans) --------
+
+    def _trace_store(self):
+        """A fresh TraceStore over this process's finished spans:
+        single-process, so assembly is trivial (no clock alignment, no
+        quiet-window wait) and nothing is tail-sampled — the local
+        backend is the debugging backend, keep everything. Rebuilt per
+        query; the span buffer itself is the bounded state."""
+        from ray_tpu.cluster.traces import TraceStore
+        from ray_tpu.core.config import config
+        from ray_tpu.util import tracing
+
+        store = TraceStore(
+            max_traces=config.head_trace_retention,
+            sample_rate=1.0,
+            slow_threshold_s=config.trace_slow_threshold_s,
+            max_spans_per_trace=config.trace_max_spans,
+            quiet_s=0.0)
+        store.add_spans(tracing.collect())
+        store.finalize_quiet(force=True)
+        return store
+
+    def get_trace(self, trace_id: str):
+        return self._trace_store().get(trace_id)
+
+    def list_traces(self, limit: int = 50) -> list:
+        return self._trace_store().list(limit)
+
+    def trace_stats(self) -> dict:
+        return self._trace_store().stats()
+
+    def ttft_decomposition(self, window_s: float | None = None,
+                           deployment: str | None = None) -> dict:
+        return self._trace_store().ttft_decomposition(
+            window_s=window_s, deployment=deployment)
+
     # -- task plane -------------------------------------------------------
 
     def _pin_ref_args(self, args, kwargs) -> list[str]:
@@ -973,7 +1009,10 @@ class LocalBackend:
             self._store_error(oids, e)
             return refs
         pins = self._pin_ref_args(args, kwargs)
+        from contextlib import nullcontext
+
         from ray_tpu.core import attribution
+        from ray_tpu.util import tracing
 
         # Submit-time callsite: by store time the user frames are gone,
         # so the .remote() line is the return objects' creation site.
@@ -987,9 +1026,18 @@ class LocalBackend:
                     self._record_task_state(task_id, "CANCELLED")
                     self._store_error(oids, TaskCancelledError(fname))
                     return
+                # Execution span parents under the submit span's spec
+                # context — same parent/child shape as a cluster worker
+                # (tracing_helper parity), so the conformance tests see
+                # one trace tree regardless of backend.
+                run_cm = (tracing.span(f"run:{fname}",
+                                       {"task_id": task_id},
+                                       parent=trace_ctx)
+                          if trace_ctx and tracing.is_enabled()
+                          else nullcontext())
                 # Attribution context: the task's returns and any nested
                 # puts its user code makes attribute to this task name.
-                with attribution.task_context(fname, submit_site):
+                with attribution.task_context(fname, submit_site), run_cm:
                     run_attempts()
             finally:
                 try:
@@ -1077,7 +1125,16 @@ class LocalBackend:
                             )
                         return
 
-        self._pool.submit(run)
+        # Submission span: covers the enqueue only (dispatch is async);
+        # its context is the spec-carried trace_ctx the run span (and
+        # anything the task itself traces) parents under.
+        span_cm = (tracing.span(f"submit:{fname}", {"task_id": task_id})
+                   if tracing.is_enabled() else nullcontext())
+        with span_cm as s:
+            trace_ctx = ({"trace_id": s["trace_id"],
+                          "span_id": s["span_id"]}
+                         if s is not None else None)
+            self._pool.submit(run)
         return refs
 
     # -- actor plane ------------------------------------------------------

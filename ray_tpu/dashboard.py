@@ -313,6 +313,26 @@ class Dashboard:
                 "slo": self.head.call("slo_status"),
                 "top": self.head.call("signal_top", window),
             })
+        if route == "/api/traces":
+            # Traces pane: kept-trace summaries + store health, plus
+            # the windowed TTFT decomposition. Head-side ring reads.
+            window = float(qs.get("window", 0.0) or 0.0)
+            return ok_json({
+                "traces": self.head.call(
+                    "list_traces", int(qs.get("limit", 50) or 50)),
+                "stats": self.head.call("trace_stats"),
+                "ttft": self.head.call(
+                    "ttft_decomposition", window or None,
+                    qs.get("deployment") or None),
+            })
+        if route == "/api/trace":
+            tid = qs.get("id", "")
+            tr = self.head.call("get_trace", tid) if tid else None
+            if tr is None:
+                return (404, "application/json",
+                        json.dumps({"error": f"no trace {tid!r}"})
+                        .encode())
+            return ok_json(tr)
         if route == "/api/serve/applications":
             # Read-only: a cluster that never used serve must stay
             # untouched — probe the controller through the head's named
@@ -494,7 +514,7 @@ class Dashboard:
                "/api/device_stats", "/api/cluster_metrics",
                "/api/placement_groups", "/api/pubsub_stats",
                "/api/serve_stats", "/api/data_stats",
-               "/api/train_stats", "/api/signals"]
+               "/api/train_stats", "/api/signals", "/api/traces"]
         links = "".join(f'<li><a href="{r}">{r}</a></li>' for r in api)
         return (
             "<!doctype html><title>ray_tpu dashboard</title>"

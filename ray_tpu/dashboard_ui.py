@@ -72,7 +72,7 @@ INDEX_HTML = r"""<!doctype html>
 "use strict";
 const TABS = ["cluster", "nodes", "workers", "devices", "actors", "tasks",
               "objects", "memory", "placement_groups", "jobs", "serve",
-              "train", "signals", "logs"];
+              "train", "signals", "traces", "logs"];
 let active = location.hash.slice(1) || "cluster";
 let logCursor = 0;
 const logBuf = [];
@@ -645,6 +645,87 @@ const RENDER = {
           return el("td", "", ms(r.latency_p50_s));
         }));
     }
+    $("view").replaceChildren(wrap);
+  },
+  async traces() {
+    // Flight-recorder pane: store health tiles, the windowed TTFT
+    // decomposition, and kept-trace rows — click a trace id to render
+    // its assembled cross-process span tree inline.
+    const d = await api("/api/traces?window=300");
+    const st = d.stats || {}, ttft = d.ttft || {};
+    const drops = Object.values(st.dropped || {})
+      .reduce((a, b) => a + b, 0);
+    const ms = (v) => v != null ? (v * 1e3).toFixed(1) : "—";
+    setTiles([
+      ["kept", st.kept ?? 0],
+      ["assembled", st.assembled_total ?? 0],
+      ["pending", st.pending ?? 0],
+      ["dropped", drops, drops > 0 ? "warn" : ""],
+      ["ttft p50 ms", ms(ttft.ttft_p50_s)],
+      ["dominant", ttft.dominant || "—"],
+    ]);
+    const wrap = el("div");
+    const phases = Object.entries(ttft.phases || {})
+      .map(([name, p]) => ({name, ...p}))
+      .sort((a, b) => (b.p50_s || 0) - (a.p50_s || 0));
+    if (phases.length) {
+      wrap.appendChild(el("h3", "",
+        `ttft decomposition (${ttft.traces} traces, 5m window)`));
+      wrap.appendChild(table(
+        ["phase", "p50 ms", "p99 ms", "mean ms", "count"],
+        phases, (r, c) => {
+          if (c === "phase") return el("td", "", r.name);
+          if (c === "p50 ms") return el("td", "mono", ms(r.p50_s));
+          if (c === "p99 ms") return el("td", "mono", ms(r.p99_s));
+          if (c === "mean ms") return el("td", "mono", ms(r.mean_s));
+          return el("td", "", r.count);
+        }));
+    }
+    wrap.appendChild(el("h3", "", "kept traces"));
+    const pre = el("pre", "mono", "");
+    wrap.appendChild(table(
+      ["trace", "root", "dur ms", "spans", "kept", "dominant"],
+      d.traces || [], (r, c) => {
+        if (c === "trace") {
+          const td = el("td", "mono");
+          const a = el("a", "", r.trace_id.slice(0, 16) + "…");
+          a.href = "#traces";
+          a.onclick = async (ev) => {
+            ev.preventDefault();
+            const tr = await api("/api/trace?id=" + r.trace_id);
+            const spans = tr.spans || [];
+            const byId = {};
+            spans.forEach(s => { byId[s.span_id] = s; });
+            const depth = (s) => {
+              let n = 0, p = s.parent_id;
+              while (p && byId[p]) { n++; p = byId[p].parent_id; }
+              return n;
+            };
+            const t0 = Math.min(
+              ...spans.map(s => s.start_ns || Infinity));
+            pre.textContent = "trace " + tr.trace_id + "\n" +
+              spans.slice()
+                .sort((a2, b2) => (a2.start_ns || 0) - (b2.start_ns || 0))
+                .map(s => "  ".repeat(depth(s)) + s.name +
+                  "  [+" + (((s.start_ns || t0) - t0) / 1e6).toFixed(1)
+                  + "ms  " + (((s.end_ns || s.start_ns || 0)
+                  - (s.start_ns || 0)) / 1e6).toFixed(1) + "ms  "
+                  + (s.node_id || ("pid " + (s.pid ?? "?"))) + "]"
+                  + ((s.status || "OK") !== "OK"
+                    ? "  !! " + s.status : ""))
+                .join("\n");
+          };
+          td.appendChild(a);
+          return td;
+        }
+        if (c === "root") return el("td", "mono", r.root || "?");
+        if (c === "dur ms") return el("td",
+          r.errored ? "bad" : "", (r.duration_s * 1e3).toFixed(1));
+        if (c === "spans") return el("td", "", r.spans);
+        if (c === "kept") return el("td", "", r.kept_because);
+        return el("td", "", r.dominant || "—");
+      }));
+    wrap.appendChild(pre);
     $("view").replaceChildren(wrap);
   },
   async train() {

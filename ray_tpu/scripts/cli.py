@@ -369,6 +369,21 @@ def _print_top(top, window):
         print(f"slo {name:<20} {s['state']:<8} "
               f"{v if v is not None else '—'} "
               f"{s['op']} {s['threshold']}  ({s['expr']})")
+        ex = s.get("exemplar_trace_ids")
+        if ex:
+            print("    exemplars: " + " ".join(ex)
+                  + "  (ray-tpu trace <id>)")
+    traces = top.get("traces") or {}
+    if traces.get("assembled_total") or traces.get("pending"):
+        drops = traces.get("dropped") or {}
+        drop_s = ", ".join(f"{k} {v}" for k, v in sorted(drops.items())
+                           if v) or "none"
+        span_drops = (traces.get("head_spans_dropped", 0)
+                      + traces.get("worker_spans_dropped", 0))
+        print(f"traces: {traces.get('kept', 0)} kept / "
+              f"{traces.get('assembled_total', 0)} assembled "
+              f"({traces.get('pending', 0)} pending) · drops: {drop_s}"
+              + (f" · SPANS DROPPED: {span_drops}" if span_drops else ""))
 
 
 def cmd_top(args):
@@ -449,6 +464,112 @@ def cmd_slo(args):
               f"{s['op']}{s['threshold']:>9} "
               f"{s['window_s']:>6g}s {s['breach_streak']:>8}")
         print(f"    {s['expr']}")
+        ex = s.get("exemplar_trace_ids")
+        if ex:
+            print("    exemplars: " + " ".join(ex)
+                  + "  (ray-tpu trace <id>)")
+
+
+def _print_ttft_decomp(out):
+    n = out.get("traces", 0)
+    if not n:
+        print("no finalized traces in the window "
+              "(is tracing enabled? RAY_TPU_TRACING_ENABLED=1)")
+        return
+    p50 = out.get("ttft_p50_s")
+    p99 = out.get("ttft_p99_s")
+    print(f"{n} trace(s) · ttft p50 "
+          f"{p50 * 1e3:.1f}ms · p99 {p99 * 1e3:.1f}ms · dominant phase: "
+          f"{out.get('dominant')}")
+    hdr = f"{'phase':<12} {'p50':>10} {'p99':>10} {'mean':>10} {'n':>6}"
+    print(hdr)
+    print("-" * len(hdr))
+    for phase, p in sorted((out.get("phases") or {}).items(),
+                           key=lambda kv: -(kv[1].get("p50_s") or 0.0)):
+        def ms(v):
+            return f"{v * 1e3:.1f}ms" if v is not None else "—"
+        print(f"{phase:<12} {ms(p.get('p50_s')):>10} "
+              f"{ms(p.get('p99_s')):>10} {ms(p.get('mean_s')):>10} "
+              f"{p.get('count', 0):>6}")
+    ps = out.get("phase_sum_p50_s")
+    if p50 and ps is not None:
+        print(f"phase-sum p50 {ps * 1e3:.1f}ms "
+              f"({ps / p50:.1%} of ttft p50)")
+
+
+def cmd_trace(args):
+    """Flight-recorder queries. ``ray-tpu trace`` lists kept traces;
+    ``ray-tpu trace <id>`` renders the assembled cross-process span
+    tree (``--chrome out.json`` exports Perfetto-loadable events,
+    ``--path`` prints the critical-path segments); ``ray-tpu trace
+    --ttft`` prints the windowed per-phase TTFT decomposition."""
+    _connect(args)
+    from ray_tpu import state
+
+    if args.ttft:
+        out = state.ttft_decomposition(
+            window_s=args.window, deployment=args.deployment)
+        if args.json:
+            print(json.dumps(out, indent=2, default=str))
+        else:
+            _print_ttft_decomp(out)
+        return
+    if not args.trace_id:
+        rows = state.list_traces(args.limit)
+        if args.json:
+            print(json.dumps(rows, indent=2, default=str))
+            return
+        if not rows:
+            print("no traces kept (enable tracing and send traffic; "
+                  "only errored/slow/sampled traces are retained)")
+            return
+        hdr = (f"{'trace_id':<34} {'root':<28} {'dur':>9} "
+               f"{'spans':>5} {'kept':>10} {'dominant':>9}")
+        print(hdr)
+        print("-" * len(hdr))
+        for r in rows:
+            dur = r.get("duration_s") or 0.0
+            mark = "!" if r.get("errored") else " "
+            print(f"{r['trace_id']:<34} {(r.get('root') or '?')[:27]:<28} "
+                  f"{dur * 1e3:>8.1f}ms{mark}{r.get('spans', 0):>5} "
+                  f"{r.get('kept_because', ''):>10} "
+                  f"{r.get('dominant') or '—':>9}")
+        return
+    tr = state.get_trace(args.trace_id)
+    if tr is None:
+        raise SystemExit(
+            f"unknown trace {args.trace_id!r} — never reported, still "
+            f"inside the assembly quiet window, or tail-sampled out "
+            f"(kept: errored, >slow-threshold, or sampled-in)")
+    if args.chrome:
+        from ray_tpu.util import tracing
+
+        n = tracing.export_chrome_trace(args.chrome, tr["spans"])
+        print(f"wrote {n} span(s) to {args.chrome} "
+              f"(load in Perfetto / chrome://tracing)")
+        return
+    if args.json:
+        print(json.dumps(tr, indent=2, default=str))
+        return
+    from ray_tpu.cluster.traces import render_tree
+
+    print(f"trace {tr['trace_id']}  "
+          f"({tr['duration_s'] * 1e3:.1f}ms, kept: {tr['kept_because']}"
+          + (f", deployment {tr['deployment']}" if tr.get("deployment")
+             else "") + ")")
+    print(render_tree(tr["spans"]))
+    d = tr.get("decomposition")
+    if d:
+        parts = ", ".join(f"{k} {v * 1e3:.1f}ms"
+                          for k, v in sorted(d["phases"].items(),
+                                             key=lambda kv: -kv[1]))
+        print(f"ttft {d['total_s'] * 1e3:.1f}ms = {parts} "
+              f"(dominant: {d['dominant']})")
+    if args.path:
+        print("critical path:")
+        for seg in tr.get("critical_path") or ():
+            print(f"  {seg['self_s'] * 1e3:>8.1f}ms  {seg['phase']:<10} "
+                  f"{seg['name']}")
 
 
 def cmd_data(args):
@@ -959,6 +1080,26 @@ def main(argv=None):
                         "ttft_p50{deployment=\"d\"} < 2s over 60s")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_slo)
+
+    p = sub.add_parser(
+        "trace",
+        help="flight recorder: list kept traces, render one "
+             "cross-process tree, or the windowed TTFT decomposition")
+    p.add_argument("trace_id", nargs="?", default=None)
+    p.add_argument("--ttft", action="store_true",
+                   help="windowed per-phase TTFT decomposition")
+    p.add_argument("--window", type=float, default=None,
+                   help="--ttft window seconds (default: all retained)")
+    p.add_argument("--deployment", default=None,
+                   help="--ttft filter by deployment")
+    p.add_argument("--limit", type=int, default=30,
+                   help="list mode: max traces shown")
+    p.add_argument("--chrome", metavar="PATH", default=None,
+                   help="export the trace as Chrome/Perfetto events")
+    p.add_argument("--path", action="store_true",
+                   help="print the critical-path segments")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser(
         "data",

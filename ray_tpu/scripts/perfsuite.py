@@ -198,6 +198,11 @@ def main() -> None:
                          "agreement vs client ledger + bounded-ring "
                          "memory proof + seeded SLO burn with exactly "
                          "one burning and one recovery pubsub event)")
+    ap.add_argument("--traces", action="store_true",
+                    help="add the trace-plane point (TTFT "
+                         "decomposition vs the client stopwatch, "
+                         "bounded assembly store, tracing hot-path "
+                         "overhead ratios)")
     ap.add_argument("--dataflow", action="store_true",
                     help="add the streaming-dataflow point "
                          "(generation->training pipeline past store "
@@ -249,6 +254,9 @@ def main() -> None:
     if args.signals:
         steps.append([sys.executable, "-m",
                       "ray_tpu.scripts.signal_bench", "--out", args.out])
+    if args.traces:
+        steps.append([sys.executable, "-m",
+                      "ray_tpu.scripts.trace_bench", "--out", args.out])
     for argv in steps:
         print(f"perfsuite: {' '.join(argv[2:])}", file=sys.stderr,
               flush=True)

@@ -1,0 +1,358 @@
+"""Trace-plane harness: TTFT decomposition truth, bounded assembly
+memory, and the tracing hot-path overhead — the three claims the
+flight recorder stands on, each measured, none asserted.
+
+Sections (all in one run, merged into MICROBENCH.json under
+``trace_plane`` with ``--out``):
+
+* **decomposition** — a traced LLM serve slice (real deployment, real
+  ``handle.stream`` transport, queue contention by construction): the
+  flight recorder's windowed TTFT p50 must match the client-measured
+  first-chunk p50 within 5%, the per-phase p50s must sum to the
+  recorder's TTFT p50 within 5% (the partition claim, aggregated), and
+  the decomposition must NAME the dominant phase. A decomposition that
+  disagrees with the stopwatch is worse than none.
+* **store** — synthetic trace churn far past every bound: traced
+  memory must plateau after warmup and every bounded decision must be
+  counted by cause (sampled / evicted / span_cap) — never a silent
+  cap.
+* **overhead** — engine tok/s three ways: tracing disabled, tracing
+  enabled but the request NOT carrying a context (the guard idiom:
+  sampling is the caller's decision, an untraced request must ride the
+  span-free hot path), and fully traced. The untraced ratio is the
+  regression gate; the traced ratio is reported.
+
+Run: python -m ray_tpu.scripts.trace_bench [--out MICROBENCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import tracemalloc
+
+STREAMS = 24
+MAX_NEW = 4
+DEP = "llm"
+
+
+def _percentile(values, q):
+    s = sorted(values)
+    if not s:
+        return None
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+def _engine_kwargs():
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+
+    return dict(model="gpt2",
+                config=dataclasses.replace(gpt2.GPT2Config.tiny(),
+                                           dtype=jnp.float32),
+                max_batch=2, prefill_rows=2, cache_len=64,
+                max_prompt_len=8, max_new_tokens=MAX_NEW)
+
+
+def _section_decomposition(state, serve):
+    """Traced serve slice: recorder TTFT vs the client stopwatch."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+    from ray_tpu.util import tracing
+
+    dep = serve.deployment(name=DEP, max_concurrent_queries=64,
+                           route_prefix="/llm")(LLMEngine)
+    handle = serve.run(dep.bind(**_engine_kwargs()))
+    # Untraced warmup: compile the prefill/decode kernels outside the
+    # measured (and traced) window.
+    import ray_tpu
+
+    ray_tpu.get(handle.remote({"tokens": [5, 9, 2], "max_tokens": 2}),
+                timeout=300)
+
+    tracing.enable()
+    tracing.drain()
+    ttfts: list = []
+    errors: list = []
+    lock = threading.Lock()
+
+    def one(i):
+        prompt = [5 + (i % 7), 9, 2]
+        try:
+            with tracing.span("request", {"i": i}):
+                t0 = time.perf_counter()
+                first = None
+                # Drain the whole stream (the slot must recycle); the
+                # stopwatch stops at the FIRST chunk.
+                for _chunk in handle.stream(prompt, MAX_NEW):
+                    if first is None:
+                        first = time.perf_counter() - t0
+            if first is not None:
+                with lock:
+                    ttfts.append(first)
+        except Exception as e:  # noqa: BLE001 — bench records, not raises
+            with lock:
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(STREAMS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    d = state.ttft_decomposition()
+    client_p50 = _percentile(ttfts, 0.5)
+    ring_p50 = d.get("ttft_p50_s")
+    phase_sum = d.get("phase_sum_p50_s") or 0.0
+    agree_client = (client_p50 and ring_p50
+                    and abs(ring_p50 - client_p50) / client_p50 <= 0.05)
+    agree_partition = (ring_p50
+                       and abs(phase_sum - ring_p50) / ring_p50 <= 0.05)
+    # An exemplar must resolve end to end: list -> get -> critical path
+    # partitioning the root interval exactly.
+    traces = state.list_traces(limit=5)
+    resolved = None
+    if traces:
+        tr = state.get_trace(traces[0]["trace_id"])
+        if tr is not None:
+            path_s = sum(seg["self_s"] for seg in tr["critical_path"])
+            resolved = {
+                "trace_id": tr["trace_id"],
+                "spans": len(tr["spans"]),
+                "critical_path_s": round(path_s, 6),
+                "duration_s": round(tr["duration_s"], 6),
+                "partition_exact": abs(path_s - tr["duration_s"]) < 1e-6,
+            }
+    ok = bool(agree_client and agree_partition and d.get("dominant")
+              and not errors and resolved
+              and resolved["partition_exact"])
+    return {
+        "streams": STREAMS,
+        "errors": errors[:3],
+        "client_ttft_p50_s": round(client_p50, 5) if client_p50 else None,
+        "recorder_ttft_p50_s": round(ring_p50, 5) if ring_p50 else None,
+        "phase_sum_p50_s": round(phase_sum, 5),
+        "phases": {k: round(v["p50_s"], 5)
+                   for k, v in (d.get("phases") or {}).items()},
+        "dominant": d.get("dominant"),
+        "traces": d.get("traces"),
+        "exemplar": resolved,
+        "ok": ok,
+        "checks": {"client_agreement": bool(agree_client),
+                   "partition": bool(agree_partition),
+                   "dominant_named": bool(d.get("dominant"))},
+    }
+
+
+def _section_store():
+    """Synthetic churn through the bounded assembly store."""
+    from ray_tpu.cluster.traces import TraceStore
+
+    max_traces, n_traces = 256, 4000
+    store = TraceStore(max_traces=max_traces, sample_rate=0.2,
+                       slow_threshold_s=9999.0, quiet_s=0.0,
+                       max_spans_per_trace=64)
+
+    def tid(i: int) -> str:
+        # Knuth-hash the index into the first 8 hex chars so the
+        # deterministic sampler sees a spread of buckets.
+        return f"{(i * 2654435761) % (1 << 32):08x}" + "d" * 24
+
+    def spans(i: int):
+        t = tid(i)
+        base = i * 1_000_000
+        return [
+            {"trace_id": t, "span_id": f"r{i}", "parent_id": None,
+             "name": "serve.stream:bench", "start_ns": base,
+             "end_ns": base + 50_000_000, "status": "OK",
+             "attributes": {"deployment": "bench"}, "pid": 1},
+            {"trace_id": t, "span_id": f"p{i}", "parent_id": f"r{i}",
+             "name": "llm.prefill:bench", "start_ns": base + 5_000_000,
+             "end_ns": base + 30_000_000, "status": "OK",
+             "attributes": {}, "pid": 1},
+            {"trace_id": t, "span_id": f"d{i}", "parent_id": f"r{i}",
+             "name": "llm.decode:bench", "start_ns": base + 30_000_000,
+             "end_ns": base + 50_000_000, "status": "OK",
+             "attributes": {}, "pid": 1},
+        ]
+
+    tracemalloc.start()
+    warm_bytes = 0
+    for i in range(n_traces):
+        store.add_spans(spans(i))
+        store.finalize_quiet(force=True)
+        if i == n_traces // 3:
+            warm_bytes = tracemalloc.get_traced_memory()[0]
+    # One pathological trace over the span cap: clipped AND counted.
+    fat = [dict(s, span_id=f"fat{j}") for j in range(100)
+           for s in [spans(n_traces)[0]]]
+    store.add_spans(fat)
+    store.finalize_quiet(force=True)
+    end_bytes = tracemalloc.get_traced_memory()[0]
+    tracemalloc.stop()
+
+    st = store.stats()
+    dropped = st["dropped"]
+    bounded = (st["kept"] <= max_traces
+               and end_bytes < max(warm_bytes, 1) * 1.5)
+    accounted = (dropped.get("sampled", 0) > 0
+                 and dropped.get("evicted", 0) > 0
+                 and dropped.get("span_cap", 0) > 0)
+    return {
+        "traces_offered": n_traces + 1,
+        "max_traces": max_traces,
+        "kept": st["kept"],
+        "assembled_total": st["assembled_total"],
+        "warm_bytes": warm_bytes,
+        "end_bytes": end_bytes,
+        "growth_ratio": round(end_bytes / max(1, warm_bytes), 3),
+        "dropped": dict(dropped),
+        "ok": bool(bounded and accounted),
+    }
+
+
+def _section_overhead():
+    """Engine tok/s: tracing disabled vs enabled-untraced vs traced."""
+    from ray_tpu.serve import _observability as obs
+    from ray_tpu.serve.llm_engine import LLMEngine
+    from ray_tpu.util import tracing
+
+    tracing.disable()
+    kw = _engine_kwargs()
+    kw.update(max_new_tokens=16, cache_len=64, deployment="bench")
+    eng = LLMEngine(**kw)
+    prompt = [5, 9, 2]
+
+    def tok_s(n: int, scope_ctx=None) -> float:
+        toks = 0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            if scope_ctx is not None:
+                with tracing.span("request") as root:
+                    ctx = {"trace_id": root["trace_id"],
+                           "span_id": root["span_id"]}
+                    with obs.request_scope("bench", None, trace_ctx=ctx):
+                        toks += len(eng.generate(prompt, 16))
+            else:
+                toks += len(eng.generate(prompt, 16))
+        return toks / (time.perf_counter() - t0)
+
+    try:
+        tok_s(3)  # compile + warm
+        off = tok_s(10)
+        tracing.enable()
+        tracing.drain()
+        untraced = tok_s(10)          # enabled, no carried context
+        traced = tok_s(10, scope_ctx=True)  # worst case: every request
+        spans_recorded = len(tracing.collect(clear=True))
+    finally:
+        tracing.disable()
+        tracing.drain()
+        eng.shutdown_engine()
+
+    untraced_ratio = untraced / off if off else 0.0
+    traced_ratio = traced / off if off else 0.0
+    return {
+        "tok_s_off": round(off, 1),
+        "tok_s_enabled_untraced": round(untraced, 1),
+        "tok_s_traced": round(traced, 1),
+        "untraced_ratio": round(untraced_ratio, 3),
+        "traced_ratio": round(traced_ratio, 3),
+        "spans_recorded": spans_recorded,
+        # Within noise: an untraced request on a tracing-enabled
+        # process must not pay for the flight recorder.
+        "ok": bool(untraced_ratio >= 0.85 and spans_recorded > 0),
+    }
+
+
+def run() -> dict:
+    import ray_tpu
+    from ray_tpu import serve, state
+    from ray_tpu.util import tracing
+
+    # Overhead first: its baseline needs tracing untouched.
+    overhead = _section_overhead()
+    store = _section_store()
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    try:
+        decomposition = _section_decomposition(state, serve)
+    finally:
+        tracing.disable()
+        tracing.drain()
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+    return {
+        "decomposition": decomposition,
+        "store": store,
+        "overhead": overhead,
+        "ok": bool(decomposition["ok"] and store["ok"]
+                   and overhead["ok"]),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="Trace-plane harness: TTFT decomposition vs the "
+                    "client stopwatch, bounded assembly store, tracing "
+                    "hot-path overhead")
+    ap.add_argument("--out", default=None,
+                    help="merge the trace_plane section into this "
+                         "MICROBENCH-style artifact")
+    args = ap.parse_args()
+
+    res = run()
+
+    from ray_tpu.scripts import bench_log
+
+    entry = bench_log.record_trace_plane(
+        decomposition={"ok": res["decomposition"]["ok"],
+                       **res["decomposition"]["checks"],
+                       "dominant": res["decomposition"]["dominant"]},
+        ttft_p50_ms=round(
+            (res["decomposition"]["recorder_ttft_p50_s"] or 0.0) * 1e3,
+            3),
+        overhead={k: res["overhead"][k] for k in
+                  ("untraced_ratio", "traced_ratio", "ok")},
+        store={k: res["store"][k] for k in
+               ("kept", "growth_ratio", "dropped", "ok")},
+        device=bench_log.device_kind(), script="trace_bench")
+    res["evidence"] = {"committed_to": entry.get("committed_to")}
+
+    if args.out:
+        # Merge-preserve: every perfsuite stage owns one section.
+        payload = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                try:
+                    payload = json.load(f)
+                except ValueError:
+                    payload = {}
+        payload["trace_plane"] = res
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(res, indent=1, default=str))
+    if not res["ok"]:
+        print("trace_bench: FAILED — see 'decomposition'/'store'/"
+              "'overhead' (either the recorder's TTFT disagrees with "
+              "the client stopwatch, the assembly store is unbounded "
+              "or drops silently, or untraced requests pay a tracing "
+              "tax)", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

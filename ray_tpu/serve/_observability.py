@@ -61,13 +61,18 @@ _request_ctx: contextvars.ContextVar = contextvars.ContextVar(
 
 
 @contextmanager
-def request_scope(deployment: str, deadline_ts: Optional[float]):
+def request_scope(deployment: str, deadline_ts: Optional[float],
+                  trace_ctx: Optional[dict] = None):
     """Active while the replica runs the user callable, so nested
-    machinery (the @serve.batch queue) can read the deployment name and
-    the absolute deadline without threading arguments through user
-    code."""
+    machinery (the @serve.batch queue, the LLM engine's admission path)
+    can read the deployment name, the absolute deadline, and the
+    caller's span context without threading arguments through user
+    code. ``trace_ctx`` is what the engine parents its queue/prefill/
+    decode spans under — the engine loop runs on its OWN thread, so the
+    thread-local current-span stack cannot carry it there."""
     token = _request_ctx.set({"deployment": deployment,
-                              "deadline_ts": deadline_ts})
+                              "deadline_ts": deadline_ts,
+                              "trace_ctx": trace_ctx})
     try:
         yield
     finally:

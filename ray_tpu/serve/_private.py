@@ -134,7 +134,15 @@ class Replica:
             _obs.set_replica_ongoing(dep, self._replica_tag,
                                      self.num_ongoing)
         try:
-            with span_cm, _obs.request_scope(dep, deadline_ts):
+            # The scope carries the CALLER's span context (the stream/
+            # route span that covers the whole request), not the replica
+            # span just opened: the engine's queue/prefill/decode spans
+            # outlive this handler call by the stream's whole life, and
+            # critical-path extraction clips children to their parent's
+            # interval — parenting them under a span that ends at
+            # llm_submit-return would zero them out.
+            with span_cm, _obs.request_scope(dep, deadline_ts,
+                                             trace_ctx=trace_ctx):
                 t_exec = time.time()
                 try:
                     result = self._target(method)(*args, **kwargs)
@@ -940,9 +948,57 @@ def stream_call(deployment_name: str, args: tuple, kwargs: dict,
     expires mid-decode surfaces as a typed :class:`RequestShedError`
     (reason=decode) shed by the engine at a step boundary.
 
+    When the caller traces (``trace_ctx`` in the request meta), the
+    whole stream is one ``serve.stream`` span: downstream hops — the
+    replica's llm_submit span, the engine's queue/prefill/decode spans
+    — re-parent under it, and the first real token stamps the
+    client-observed TTFT on its attributes.
+
     ``backend`` defaults to this process's backend; the ``ray://``
     proxy passes its own ClusterBackend explicitly (its process-global
     backend belongs to the CLIENT side)."""
+    meta = dict(request_meta or {})
+    trace_parent = meta.get("trace_ctx")
+    if trace_parent:
+        tracing.enable()  # the caller traces: continue here
+    if not (trace_parent and tracing.is_enabled()):
+        yield from _stream_call_impl(deployment_name, args, kwargs, meta,
+                                     backend, poll_s, keepalive_every)
+        return
+    # Manual span (start_span/finish_span): the generator frame
+    # interleaves with the consumer's code on one thread, so a
+    # context-manager span's thread-local restore order would corrupt
+    # across yields (same rule as the asgi proxy's await points).
+    span = tracing.start_span(
+        f"serve.stream:{deployment_name}",
+        {"deployment": deployment_name}, parent=trace_parent, cat="serve")
+    if span is not None:
+        meta["trace_ctx"] = {"trace_id": span["trace_id"],
+                             "span_id": span["span_id"]}
+    status = "OK"
+    t0 = time.monotonic()
+    first = True
+    try:
+        for chunk in _stream_call_impl(deployment_name, args, kwargs,
+                                       meta, backend, poll_s,
+                                       keepalive_every):
+            if first and span is not None and not (
+                    isinstance(chunk, dict)
+                    and chunk.get("__stream_keepalive__")):
+                span["attributes"]["ttft_s"] = round(
+                    time.monotonic() - t0, 6)
+                first = False
+            yield chunk
+    except BaseException as e:
+        status = f"ERROR: {type(e).__name__}"
+        raise
+    finally:
+        tracing.finish_span(span, status)
+
+
+def _stream_call_impl(deployment_name: str, args: tuple, kwargs: dict,
+                      request_meta: Optional[dict], backend,
+                      poll_s: float, keepalive_every: Optional[float]):
     if backend is None:
         from ray_tpu._private import worker as _worker
 

@@ -54,6 +54,7 @@ from ray_tpu.serve import _observability as _obs
 from ray_tpu.serve._observability import RequestShedError
 from ray_tpu.util import failpoints
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing
 
 # How many consecutive decode-step failures fail the active streams
 # (each failure already surfaced; three in a row means the step itself
@@ -85,10 +86,12 @@ class _Stream:
 
 class _Request:
     __slots__ = ("rid", "prompt", "max_new", "deadline_ts", "submitted",
-                 "remaining", "retries", "stream", "seq")
+                 "remaining", "retries", "stream", "seq", "trace_ctx",
+                 "span")
 
     def __init__(self, rid: str, prompt: List[int], max_new: int,
-                 deadline_ts: Optional[float], seq: int):
+                 deadline_ts: Optional[float], seq: int,
+                 trace_ctx: Optional[dict] = None):
         self.rid = rid
         self.prompt = prompt
         self.max_new = max_new
@@ -98,6 +101,16 @@ class _Request:
         self.retries = 0
         self.stream = _Stream()
         self.seq = seq  # FIFO tiebreak for slack ordering
+        # Flight-recorder state (None when the caller doesn't trace):
+        # the caller's span context, and the request's OPEN phase span
+        # (llm.queue -> llm.prefill -> llm.decode, exactly one open at
+        # a time). Manual spans (tracing.start_span): the engine loop
+        # runs on its own thread, and a request's lifecycle crosses
+        # submit-thread -> loop-thread, so thread-local context
+        # managers cannot carry them. Mutated only under the engine
+        # lock; every terminal path closes via _finish_locked.
+        self.trace_ctx = trace_ctx
+        self.span: Optional[dict] = None
 
 
 def _model_bundle(model: str, config, preset: str):
@@ -315,6 +328,10 @@ class LLMEngine:
                 # Expired/cancelled entries were drained — progress.
                 return True
             slots = free[:len(batch)]  # slot-guard: _push_queued_locked,_finish_locked
+            for req in batch:
+                # Admission: queue phase ends, prefill phase starts
+                # (the span covers the prefill compute below).
+                self._phase_span_locked(req, "llm.prefill")
         try:
             failpoints.hit("serve.llm.before_admit")
             self._prefill_batch(batch, slots)
@@ -325,6 +342,12 @@ class LLMEngine:
                     if req.retries > 3:
                         self._finish_locked(req, error=repr(e))
                     else:
+                        # Back to the queue: the failed prefill span
+                        # closes errored and a fresh queue span opens —
+                        # an open span must never re-enter the heap.
+                        self._phase_span_locked(
+                            req, "llm.queue",
+                            status="ERROR: prefill_retry")
                         self._push_queued_locked(req)
         return True
 
@@ -363,6 +386,10 @@ class LLMEngine:
                 req.stream.event.set()
                 # TTFT: submit -> first token available for delivery.
                 _obs.record_ttft(self._dep, max(0.0, now - req.submitted))
+                # First token exists: prefill phase ends HERE (the TTFT
+                # decomposition keys on the prefill span's end), decode
+                # phase runs until the terminal transition.
+                self._phase_span_locked(req, "llm.decode")
                 if req.remaining <= 0 or tok == self.eos_token:
                     self._finish_locked(req, done=True, slot=slot)
             self._last_tokens_at = now
@@ -382,6 +409,22 @@ class LLMEngine:
                       if self._slot_req[i] is not None]
             if not active:
                 return False
+            # Per-decode-step span: ONE per engine step (not one per
+            # traced request per step — that would square the span
+            # volume), parented under the oldest traced request's
+            # decode span so it lands inside a real trace.
+            step_parent = None
+            for slot in active:
+                req = self._slot_req[slot]
+                if req is not None and req.span is not None and (
+                        step_parent is None
+                        or req.submitted < step_parent[0]):
+                    step_parent = (req.submitted, req.span)
+        step_span = tracing.start_span(
+            "llm.step", {"occupancy": len(active)},
+            parent={"trace_id": step_parent[1]["trace_id"],
+                    "span_id": step_parent[1]["span_id"]},
+            cat="llm") if step_parent is not None else None
         t0 = time.perf_counter()
         try:
             # The failpoint lives INSIDE the error-counted region: a
@@ -397,6 +440,7 @@ class LLMEngine:
             # to streams from host memory).  # analyze: ignore[JX002]
             nxt = np.asarray(nxt)  # analyze: ignore[JX002]
         except BaseException:
+            tracing.finish_span(step_span, "ERROR: step")
             self._step_errors_row += 1
             self.stats_counters["errors"] += 1
             if self._step_errors_row >= _MAX_STEP_ERRORS:
@@ -442,9 +486,26 @@ class LLMEngine:
             self._last_tokens_at = done_at
         _obs.record_decode_step(self._dep, step_s, len(active), produced)
         _obs.record_decode_itl(self._dep, itl, produced)
+        if step_span is not None:
+            step_span["attributes"]["tokens"] = produced
+            tracing.finish_span(step_span)
         if self.step_throttle_s:
             time.sleep(self.step_throttle_s)
         return True
+
+    def _phase_span_locked(self, req: _Request, name: Optional[str],
+                           status: str = "OK") -> None:
+        """Close the request's open phase span and (when ``name``) open
+        the next one — at most one open span per request, every
+        transition closes before it opens (caller holds the lock).
+        No-op end to end for untraced requests."""
+        if req.span is not None:
+            tracing.finish_span(req.span, status)
+            req.span = None
+        if name is not None and req.trace_ctx and tracing.is_enabled():
+            req.span = tracing.start_span(
+                name, {"rid": req.rid, "deployment": self._dep},
+                parent=req.trace_ctx, cat="llm")
 
     def _finish_locked(self, req: _Request, done: bool = False,
                        shed: Optional[str] = None,
@@ -468,6 +529,13 @@ class LLMEngine:
             self.stats_counters["errors"] += 1
         else:
             self.stats_counters["completed"] += 1
+        # Every terminal path funnels here, so this is THE place the
+        # request's open phase span closes — queued (shed/cancel),
+        # decoding (done/shed/error), step-failure fan-out alike.
+        self._phase_span_locked(
+            req, None,
+            status="OK" if done and not shed and not error
+            else f"ERROR: {shed or error or 'aborted'}")
 
     def _reap_streams(self):
         self._last_reap = time.monotonic()
@@ -508,6 +576,12 @@ class LLMEngine:
         A full queue sheds typed (reason=decode) instead of erroring —
         admission under a full BATCH merely queues."""
         prompt, max_new = self._normalize(prompt, max_new_tokens)
+        # The caller's span context rides the serve request scope (set
+        # by Replica.handle_request); read on THIS thread, before the
+        # request crosses to the engine loop's.
+        trace_ctx = (_obs.current_request() or {}).get("trace_ctx")
+        if trace_ctx:
+            tracing.enable()  # the caller traces: continue here
         with self._lock:
             if self._n_queued >= self.max_queue:
                 _obs.record_shed(self._dep, "decode")
@@ -517,8 +591,10 @@ class LLMEngine:
                     reason="decode")
             self._seq += 1
             rid = f"llm-{os.getpid():x}-{self._seq:x}"
-            req = _Request(rid, prompt, max_new, deadline_ts, self._seq)
+            req = _Request(rid, prompt, max_new, deadline_ts, self._seq,
+                           trace_ctx=trace_ctx)
             self._push_queued_locked(req)
+            self._phase_span_locked(req, "llm.queue")
             self.stats_counters["queue_peak"] = max(
                 self.stats_counters["queue_peak"], self._n_queued)
             self._streams[rid] = req.stream

@@ -292,6 +292,50 @@ def signal_top(window_s: float = 60.0) -> dict:
     return {"ok": False, "error": "no cluster backend"}
 
 
+def get_trace(trace_id: str) -> Optional[dict]:
+    """One assembled trace from the flight recorder: clock-aligned
+    spans, critical-path segments, and the TTFT decomposition. ``None``
+    when the id is unknown (never reported, still assembling inside the
+    quiet window, or tail-sampled out — only errored/slow/sampled-in
+    traces are kept)."""
+    backend = _worker.backend()
+    if hasattr(backend, "get_trace"):
+        return backend.get_trace(trace_id)
+    return None
+
+
+def list_traces(limit: int = 50) -> List[dict]:
+    """Kept-trace summaries, newest first: ``{trace_id, root,
+    duration_s, ts, kept_because, deployment, errored, spans,
+    dominant}``."""
+    backend = _worker.backend()
+    if hasattr(backend, "list_traces"):
+        return backend.list_traces(limit)
+    return []
+
+
+def trace_stats() -> dict:
+    """Flight-recorder health: pending/kept counts, drop ledger by
+    cause (sampled/evicted/span_cap), and per-node clock offsets."""
+    backend = _worker.backend()
+    if hasattr(backend, "trace_stats"):
+        return backend.trace_stats()
+    return {}
+
+
+def ttft_decomposition(window_s: Optional[float] = None,
+                       deployment: Optional[str] = None) -> dict:
+    """Windowed per-phase TTFT decomposition (p50/p99/mean by named
+    phase — queue/prefill/route/...) over every finalized trace,
+    computed BEFORE tail sampling so the percentiles are unbiased.
+    ``phase_sum_p50_s`` vs ``ttft_p50_s`` is the partition check."""
+    backend = _worker.backend()
+    if hasattr(backend, "ttft_decomposition"):
+        return backend.ttft_decomposition(window_s=window_s,
+                                          deployment=deployment)
+    return {"traces": 0, "phases": {}}
+
+
 def autoscaler_status() -> dict:
     """The fleet autoscaler's last state report: per-node-type counts
     and spot markers, quarantine/backoff benches, nodes draining for

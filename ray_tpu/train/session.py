@@ -122,8 +122,10 @@ class _Session:
             {"trial": self.trial, "rank": self.world_rank,
              "iteration": self.iteration},
             cat="train") if checkpoint is not None else None
-        self.results_queue.put(payload)
-        _tracing.finish_span(ckpt_span)
+        try:
+            self.results_queue.put(payload)
+        finally:
+            _tracing.finish_span(ckpt_span)
         # The synchronous hand-off (checkpoint serialization rides the
         # queue put when one is attached).
         hand_off = max(0.0, time.perf_counter() - now)

@@ -47,6 +47,11 @@ Passes (rule-id prefix):
   retraction sweep; ship-buffer drains must requeue on upload
   failure; ``# slot-guard`` declared acquire/release pairs must keep
   their failure-edge release.
+* ``trace-propagation`` (TP) — manual flight-recorder spans
+  (``tracing.start_span``) must be closable: never-finished local
+  spans, finishes that aren't exception-safe (no ``finally`` and no
+  except/normal pair), and created-and-discarded span handles — the
+  leak shapes that stall trace assembly's quiet window.
 
 Heuristic and precise-by-allowlist rather than sound-and-noisy: the
 committed ``ANALYZE_BASELINE.json`` allowlists justified findings so
@@ -81,4 +86,5 @@ from ray_tpu.util.analyze import (  # noqa: F401,E402
     lock_order,
     retry,
     timeouts,
+    trace_propagation,
 )

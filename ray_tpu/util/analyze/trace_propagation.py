@@ -1,0 +1,273 @@
+"""Pass 11 — trace-propagation span hygiene (TP): every span closes.
+
+PR 18 threads request traces across the serve router, the LLM engine
+loop and both backends with *manual* spans (``tracing.start_span`` /
+``finish_span``) wherever a context manager can't express the lifetime
+— generator frames that suspend across yields, engine-lock phase
+transitions, spans handed between threads. Manual spans trade the
+``with`` block's guaranteed close for three new leak shapes, which this
+pass makes static:
+
+* **TP001** — ``start_span`` bound to a local name that is *never*
+  passed to ``finish_span`` and never escapes the function (not
+  returned, yielded, stored on an object, or handed to another call).
+  The span can literally never be closed: it stays open forever and
+  the trace it belongs to never finalizes (the assembler waits out its
+  quiet window on every request).
+* **TP002** — a locally-opened span whose every ``finish_span`` sits in
+  straight-line flow: one exception between open and close leaks the
+  span *and* loses the error status the trace store keys tail-sampling
+  on. Exception-safe means a finish in a ``finally``, or the manual
+  equivalent (a finish in an ``except`` handler paired with one in
+  normal flow — the engine-loop idiom, where the error path must stamp
+  ``ERROR:`` before re-raising).
+* **TP003** — ``tracing.span(...)`` / ``tracing.start_span(...)`` as a
+  bare expression statement: the span is created and the handle
+  immediately discarded, so it is unclosable from birth. ``span()``
+  must be entered (``with``) and ``start_span``'s return value kept.
+
+Spans stored on objects (``self._step_span``, ``req.span``) hand their
+lifetime to another scope this per-function pass can't see — those
+sites are exempt here; the runtime ``dropped_spans`` counter and the
+trace store's quiet-window eviction stats cover that residue.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ray_tpu.util.analyze.core import (
+    FindingSink,
+    ParsedModule,
+    analysis_pass,
+)
+from ray_tpu.util.analyze.resolver import callee_name, receiver_of
+
+# The tracing module rides in under either name (serve imports it as
+# `tracing`, train as `_tracing`).
+_TRACING_ALIASES = frozenset({"tracing", "_tracing"})
+
+# tracing.py itself opens and closes spans internally (the span()
+# context manager is built from start/finish); it is the implementation
+# of the contract, not a client of it.
+_SELF_MODULES = ("util/tracing.py",)
+
+
+def _is_tracing_call(call: ast.Call, names: Set[str]) -> bool:
+    """``tracing.<name>(...)`` / ``_tracing.<name>(...)``."""
+    if callee_name(call) not in names:
+        return False
+    recv = receiver_of(call)
+    return isinstance(recv, ast.Name) and recv.id in _TRACING_ALIASES
+
+
+def _find_start_span(expr: ast.expr) -> Optional[ast.Call]:
+    """The ``start_span`` call inside an assignment's value, seeing
+    through the guard idiom ``x = tracing.start_span(...) if carried
+    else None`` (and nothing deeper — a span built inside a
+    comprehension or lambda has its own frame)."""
+    candidates = [expr]
+    if isinstance(expr, ast.IfExp):
+        candidates = [expr.body, expr.orelse]
+    for c in candidates:
+        if isinstance(c, ast.Call) and _is_tracing_call(c, {"start_span"}):
+            return c
+    return None
+
+
+class _SpanInfo:
+    __slots__ = ("name", "line", "finishes", "escaped")
+
+    def __init__(self, name: str, line: int):
+        self.name = name
+        self.line = line
+        # Flow contexts each finish_span(<name>) was seen in:
+        # "finally" / "except" / "normal".
+        self.finishes: Set[str] = set()
+        self.escaped = False
+
+
+class _FnScanner:
+    """One function body walk tracking finally/except flow context.
+
+    Nested function/class definitions are skipped — ``all_functions``
+    hands each of those to the pass as its own scope, and a span
+    captured by a closure counts as escaped anyway.
+    """
+
+    def __init__(self, sink: FindingSink, scope: str):
+        self.sink = sink
+        self.scope = scope
+        self.spans: Dict[str, _SpanInfo] = {}
+
+    # -- driver -------------------------------------------------------
+
+    def scan(self, fn: ast.AST) -> None:
+        self._walk(fn.body, ctx="normal")
+        for info in self.spans.values():
+            self._judge(info)
+
+    def _judge(self, info: _SpanInfo) -> None:
+        if info.escaped:
+            return  # lifetime handed elsewhere; out of per-fn scope
+        if not info.finishes:
+            self.sink.emit(
+                "TP001", info.line, self.scope,
+                f"never_finished:{info.name}",
+                f"span '{info.name}' is opened with start_span but "
+                f"never passed to finish_span and never leaves this "
+                f"function: it can never be closed, so its trace "
+                f"never finalizes",
+                "finish_span it (in a finally), or use the "
+                "tracing.span(...) context manager")
+            return
+        if "finally" in info.finishes:
+            return
+        if "except" in info.finishes and "normal" in info.finishes:
+            # The manual pair: error path stamps ERROR and re-raises,
+            # success path closes OK.
+            return
+        self.sink.emit(
+            "TP002", info.line, self.scope,
+            f"unsafe_finish:{info.name}",
+            f"span '{info.name}' is only finished in straight-line "
+            f"flow: an exception between start_span and finish_span "
+            f"leaks the span and drops the ERROR status tail-sampling "
+            f"keys on",
+            "move the finish into a finally, or pair an "
+            "except-handler finish (ERROR status) with the "
+            "normal-flow one")
+
+    # -- statement walk -----------------------------------------------
+
+    def _walk(self, stmts, ctx: str) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                # Own scope; a span reaching in there is a capture.
+                self._mark_escapes_in(stmt, skip_call=None)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._walk(stmt.body, ctx)
+                for h in stmt.handlers:
+                    self._walk(h.body, "except")
+                self._walk(stmt.orelse, ctx)
+                self._walk(stmt.finalbody, "finally")
+                continue
+            self._scan_stmt(stmt, ctx)
+            if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                self._walk(stmt.body, ctx)
+                self._walk(stmt.orelse, ctx)
+            elif isinstance(stmt, ast.If):
+                self._walk(stmt.body, ctx)
+                self._walk(stmt.orelse, ctx)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._walk(stmt.body, ctx)
+
+    def _scan_stmt(self, stmt: ast.stmt, ctx: str) -> None:
+        # TP003: span created and handle discarded on the spot.
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if _is_tracing_call(call, {"span", "start_span"}):
+                kind = callee_name(call)
+                self.sink.emit(
+                    "TP003", call.lineno, self.scope,
+                    f"discarded:{call.lineno}",
+                    f"tracing.{kind}(...) as a bare statement discards "
+                    f"the span handle: the span is unclosable from "
+                    f"birth",
+                    "enter span() with `with`, or keep start_span's "
+                    "return value and finish_span it")
+                return
+        # New tracked span: `name = tracing.start_span(...)`.
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt = stmt.targets[0]
+            call = _find_start_span(stmt.value)
+            if call is not None and isinstance(tgt, ast.Name):
+                # Rebinding reuses the slot: the open/finish pattern is
+                # judged over the whole function (the reopen idiom
+                # finishes the old one through the same name).
+                if tgt.id not in self.spans:
+                    self.spans[tgt.id] = _SpanInfo(tgt.id, call.lineno)
+                return
+            if call is not None:
+                return  # attribute/subscript target: escaped by design
+        # finish_span(<name>) / escapes, in this statement's OWN
+        # expressions. Compound statements contribute only their
+        # headers — _walk recurses into their bodies carrying the
+        # correct flow context (a finish inside a nested finally must
+        # not also register as "normal" at the enclosing level).
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._mark_uses(stmt.test, ctx)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._mark_uses(stmt.iter, ctx)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._mark_uses(item.context_expr, ctx)
+        else:
+            self._mark_uses(stmt, ctx)
+
+    # -- name uses ----------------------------------------------------
+
+    def _mark_uses(self, stmt: ast.stmt, ctx: str) -> None:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                self._mark_escapes_in(node, skip_call=None)
+                continue
+            if isinstance(node, ast.Call) and \
+                    _is_tracing_call(node, {"finish_span"}):
+                if node.args and isinstance(node.args[0], ast.Name):
+                    info = self.spans.get(node.args[0].id)
+                    if info is not None:
+                        info.finishes.add(ctx)
+                # Other finish args in the same call escape normally.
+                for extra in node.args[1:]:
+                    self._escape_expr(extra)
+                continue
+            self._escape_node(node)
+
+    def _escape_node(self, node: ast.AST) -> None:
+        """Conservative escape: the span name used anywhere that could
+        hand its lifetime elsewhere — call argument, return/yield,
+        store into an attribute/subscript/container."""
+        if isinstance(node, ast.Call):
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                self._escape_expr(a)
+        elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            if node.value is not None:
+                self._escape_expr(node.value)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    self._escape_expr(node.value)
+        elif isinstance(node, (ast.List, ast.Tuple, ast.Set, ast.Dict)):
+            self._escape_expr(node)
+
+    def _escape_expr(self, expr: ast.expr) -> None:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name):
+                info = self.spans.get(n.id)
+                if info is not None:
+                    info.escaped = True
+
+    def _mark_escapes_in(self, node: ast.AST, skip_call) -> None:
+        """A nested def/lambda capturing a span name owns it now."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                info = self.spans.get(n.id)
+                if info is not None:
+                    info.escaped = True
+
+
+@analysis_pass("trace-propagation")
+def trace_propagation_pass(mod: ParsedModule) -> List:
+    if mod.relpath.replace("\\", "/").endswith(_SELF_MODULES):
+        return []
+    sink = FindingSink(mod.relpath)
+    model = mod.model()
+    for cm, fn, scope in model.functions():
+        scanner = _FnScanner(sink, scope)
+        scanner.scan(fn)
+    return sink.findings

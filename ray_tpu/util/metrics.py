@@ -372,6 +372,20 @@ HEAD_SPANS_DROPPED = Counter(
     "ray_tpu_head_spans_dropped_total",
     "Tracing spans dropped by the head's bounded span ring",
 )
+TRACING_DROPPED_SPANS = Counter(
+    "ray_tpu_tracing_dropped_spans_total",
+    "Finished spans a process dropped to its in-memory ring cap before "
+    "they could be drained (worker-side drops are re-attributed to "
+    "their node by the agent when the event batch ships the count)",
+    tag_keys=("node_id",),
+)
+HEAD_TRACES_DROPPED = Counter(
+    "ray_tpu_head_traces_dropped_total",
+    "Assembled traces evicted from the head's bounded trace store, "
+    "by cause (sampled = tail-sampling declined, evicted = retention "
+    "cap, span_cap = per-trace span limit clipped spans)",
+    tag_keys=("cause",),
+)
 TASK_RECORDS_EVICTED = Counter(
     "ray_tpu_task_records_evicted_total",
     "Finished task records evicted from a node agent's bounded ring",

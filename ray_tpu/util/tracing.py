@@ -36,6 +36,7 @@ _enabled = os.environ.get("RAY_TPU_TRACING_ENABLED", "").lower() in (
     "1", "true", "yes", "on")
 _finished: List[dict] = []
 _MAX_SPANS = 100_000
+_dropped = 0  # guarded-by: _lock — spans lost to the _MAX_SPANS cap
 _current = threading.local()  # .span = active span dict
 
 
@@ -58,10 +59,51 @@ def _new_id(nbytes: int) -> str:
 
 
 def _record(span: dict) -> None:
+    global _dropped
+    overflow = 0
     with _lock:
         _finished.append(span)
         if len(_finished) > _MAX_SPANS:
-            del _finished[: len(_finished) - _MAX_SPANS]
+            overflow = len(_finished) - _MAX_SPANS
+            del _finished[:overflow]
+            _dropped += overflow
+    if overflow:
+        # No silent caps: the truncation that used to vanish here is a
+        # counter on the scrape (and rides the worker-events batch to
+        # the head, node-attributed, via drain_dropped).
+        try:
+            from ray_tpu.util import metrics as _metrics
+
+            _metrics.TRACING_DROPPED_SPANS.inc(overflow, tags={
+                "node_id": os.environ.get("RAY_TPU_NODE_ID", "local")})
+        except Exception:
+            pass
+
+
+def dropped_spans() -> int:
+    """Spans this process dropped to the ``_MAX_SPANS`` ring cap."""
+    with _lock:
+        return _dropped
+
+
+def drain_dropped() -> int:
+    """Pop the drop count accumulated since the last drain (the worker
+    event flusher ships this alongside the span batch so the head's
+    scrape sees worker-side truncation, not just its own ring's)."""
+    global _dropped
+    with _lock:
+        n = _dropped
+        _dropped = 0
+    return n
+
+
+def requeue_dropped(n: int) -> None:
+    """Give a drained drop count back (a shipped batch that was itself
+    evicted from the resend queue must not silently lose its count)."""
+    global _dropped
+    if n:
+        with _lock:
+            _dropped += n
 
 
 def current_span() -> Optional[dict]:

@@ -1,0 +1,547 @@
+"""Request-path flight recorder: assembly, analysis, exemplars.
+
+The PR-18 trace plane in four layers, each pinned here: the pure
+analysis functions (critical path / TTFT decomposition partition the
+root interval *exactly* — no quietly lost time), the bounded
+``TraceStore`` (tail sampling keeps errored/slow/sampled-in, every
+drop counted by cause, stragglers merge idempotently), clock alignment
+(NTP-style per-node offsets, min-RTT filtered), the metrics↔trace
+exemplar hook (a burning SLO names concrete, *resolvable* trace ids),
+and the end-to-end conformance runs over both backends plus the LLM
+engine's phase spans.
+"""
+
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.cluster import Cluster
+from ray_tpu.cluster.signals import SignalPlane
+from ray_tpu.cluster.traces import (
+    ClockSync,
+    TraceStore,
+    critical_path,
+    decompose,
+    drop_node,
+    find_root,
+    phase_of,
+    render_tree,
+    ttft_point_ns,
+)
+from ray_tpu.util import tracing
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+MS = 1_000_000  # ns
+
+
+def _sp(tid, sid, parent, name, t0_ms, t1_ms, node_id=None,
+        status="OK", attrs=None):
+    return {"trace_id": tid, "span_id": sid, "parent_id": parent,
+            "name": name, "start_ns": int(t0_ms * MS),
+            "end_ns": int(t1_ms * MS), "status": status,
+            "attributes": attrs or {}, "pid": 1, "node_id": node_id}
+
+
+def _store(**kw):
+    kw.setdefault("sample_rate", 1.0)
+    kw.setdefault("slow_threshold_s", 9999.0)
+    kw.setdefault("quiet_s", 0.0)
+    return TraceStore(**kw)
+
+
+def _finalize(store):
+    store.finalize_quiet(force=True)
+
+
+# -- clock sync ------------------------------------------------------------
+
+
+def test_clock_sync_min_rtt_median_and_drop():
+    cs = ClockSync()
+    assert cs.offset_s("n1") == 0.0          # never probed
+    assert cs.offset_s(None) == 0.0          # head's own spans
+    # Queued probes (big RTT) carry garbage offsets; the crisp half
+    # must outvote them.
+    for rtt, off in [(0.5, 9.0), (0.4, 7.0), (0.001, 0.10),
+                     (0.002, 0.12), (0.003, 0.11)]:
+        cs.observe("n1", off, rtt)
+    assert 0.09 <= cs.offset_s("n1") <= 0.13
+    snap = cs.snapshot()
+    assert snap["n1"]["samples"] == 5
+    assert snap["n1"]["rtt_s"] == pytest.approx(0.001)
+    drop_node(cs, "n1")
+    assert cs.offset_s("n1") == 0.0 and "n1" not in cs.snapshot()
+
+
+# -- critical path / decomposition (pure) ----------------------------------
+
+
+def test_critical_path_partitions_root_interval_exactly():
+    """Deepest-active-span ownership: the segments tile [root start,
+    root end] with no gaps and no overlap — including the gap BETWEEN
+    children (owned by the parent) and a child overrunning its parent
+    (clipped, so a buggy child timestamp can't inflate the total)."""
+    spans = [
+        _sp("t", "r", None, "serve.stream:chat", 0, 100),
+        _sp("t", "a", "r", "llm.queue:x", 10, 40),
+        _sp("t", "g", "a", "rpc:admit", 20, 30),
+        _sp("t", "b", "r", "llm.decode:x", 60, 130),  # overruns root
+    ]
+    segs = critical_path(spans)
+    assert sum(s["self_s"] for s in segs) == pytest.approx(0.100)
+    assert segs[0]["t0_ns"] == 0
+    assert segs[-1]["t1_ns"] == 100 * MS
+    for prev, cur in zip(segs, segs[1:]):
+        assert prev["t1_ns"] == cur["t0_ns"]  # contiguous tiling
+    own = [(s["name"].split(":")[0], s["self_s"]) for s in segs]
+    assert own == [("serve.stream", pytest.approx(0.010)),
+                   ("llm.queue", pytest.approx(0.010)),
+                   ("rpc", pytest.approx(0.010)),
+                   ("llm.queue", pytest.approx(0.010)),
+                   ("serve.stream", pytest.approx(0.020)),
+                   ("llm.decode", pytest.approx(0.040))]
+
+
+def test_decompose_sums_to_total_and_names_dominant():
+    spans = [
+        _sp("t", "r", None, "serve.stream:chat", 0, 100),
+        _sp("t", "q", "r", "llm.queue:chat", 5, 30),
+        _sp("t", "p", "r", "llm.prefill:chat", 30, 80),
+        _sp("t", "d", "r", "llm.decode:chat", 80, 100),
+    ]
+    assert ttft_point_ns(spans) == 80 * MS
+    d = decompose(spans)
+    # Interval is [root start, TTFT point]: decode is not TTFT.
+    assert d["total_s"] == pytest.approx(0.080)
+    assert sum(d["phases"].values()) == pytest.approx(d["total_s"])
+    assert d["phases"]["prefill"] == pytest.approx(0.050)
+    assert d["phases"]["queue"] == pytest.approx(0.025)
+    assert d["phases"]["stream"] == pytest.approx(0.005)
+    assert "decode" not in d["phases"]
+    assert d["dominant"] == "prefill"
+    # No prefill span -> whole-root decomposition, still a partition.
+    no_prefill = [s for s in spans if s["span_id"] != "p"]
+    d2 = decompose(no_prefill)
+    assert d2["total_s"] == pytest.approx(0.100)
+    assert sum(d2["phases"].values()) == pytest.approx(0.100)
+
+
+def test_phase_of_longest_prefix_and_find_root():
+    assert phase_of("llm.decode:x") == "decode"
+    assert phase_of("llm.step") == "decode"
+    assert phase_of("serve.stream:chat") == "stream"
+    assert phase_of("mystery") == "other"
+    spans = [_sp("t", "b", "a", "child", 10, 20),
+             _sp("t", "a", "gone", "root-ish", 0, 30)]
+    # Parent absent from the batch => root; earliest start wins.
+    assert find_root(spans)["span_id"] == "a"
+    assert "root-ish" in render_tree(spans).splitlines()[0]
+
+
+# -- tail sampling + bounded store -----------------------------------------
+
+
+def test_tail_sampling_keeps_error_slow_sampled_in():
+    st = _store(sample_rate=0.0, slow_threshold_s=0.05)
+    st.add_spans([_sp("e" * 32, "s1", None, "req", 0, 10,
+                      status="ERROR: Boom")])
+    st.add_spans([_sp("f" * 32, "s2", None, "req", 0, 100)])   # slow
+    st.add_spans([_sp("a" * 32, "s3", None, "req", 0, 10)])    # fast OK
+    _finalize(st)
+    kept = {r["trace_id"]: r["kept_because"] for r in st.list()}
+    assert kept["e" * 32] == "error"
+    assert kept["f" * 32] == "slow"
+    assert ("a" * 32) not in kept
+    assert st.dropped["sampled"] == 1
+    # Decompositions are recorded for EVERY finalized trace, sampled
+    # out or not — the windowed percentiles must be unbiased.
+    assert st.ttft_decomposition()["traces"] == 3
+    assert st.get("a" * 32) is None
+    assert st.get("e" * 32)["errored"] is True
+
+
+def test_tail_sampling_deterministic_by_trace_id():
+    st = _store(sample_rate=0.5, slow_threshold_s=9999.0)
+    lo = "00000000" + "a" * 24   # bucket 0      -> sampled_in
+    hi = "ffffffff" + "a" * 24   # bucket 7295   -> sampled out
+    st.add_spans([_sp(lo, "s1", None, "req", 0, 10)])
+    st.add_spans([_sp(hi, "s2", None, "req", 0, 10)])
+    _finalize(st)
+    assert st.get(lo)["kept_because"] == "sampled_in"
+    assert st.get(hi) is None and st.dropped["sampled"] == 1
+
+
+def test_store_eviction_and_span_cap_counted():
+    st = _store(max_traces=2)
+    for i in range(4):
+        st.add_spans([_sp(("%032x" % i), f"s{i}", None, "req", 0, 10)])
+        _finalize(st)
+    assert st.stats()["kept"] == 2
+    assert st.dropped["evicted"] == 2
+    # Span cap clips (floor is 16) and counts — never a silent cap.
+    st2 = _store(max_spans_per_trace=16)
+    tid = "b" * 32
+    st2.add_spans([_sp(tid, f"x{i}", None if i == 0 else "x0",
+                       "req" if i == 0 else f"c{i}", 0, 10)
+                   for i in range(20)])
+    assert st2.dropped["span_cap"] == 4
+    _finalize(st2)
+    assert len(st2.get(tid)["spans"]) == 16
+
+
+def test_straggler_merge_and_idempotent_resend():
+    st = _store()
+    tid = "c" * 32
+    st.add_spans([_sp(tid, "r", None, "req", 0, 50)])
+    st.add_spans([_sp(tid, "r", None, "req", 0, 50)])  # resent batch
+    _finalize(st)
+    assert len(st.get(tid)["spans"]) == 1
+    # A span arriving AFTER finalize merges into the kept record
+    # instead of opening a ghost pending trace under the same id.
+    st.add_spans([_sp(tid, "k", "r", "run:late", 10, 20)])
+    st.add_spans([_sp(tid, "k", "r", "run:late", 10, 20)])  # dup
+    got = st.get(tid)
+    assert {s["span_id"] for s in got["spans"]} == {"r", "k"}
+    assert st.stats()["pending"] == 0
+
+
+def test_clock_alignment_shifts_cross_node_spans():
+    st = _store()
+    # Node n1's clock runs 5s behind the head: offset (head-node) = +5.
+    for _ in range(4):
+        st.clock.observe("n1", 5.0, 0.001)
+    tid = "d" * 32
+    st.add_spans([_sp(tid, "r", None, "req", 0, 100)])
+    st.add_spans([_sp(tid, "w", "r", "run:f", 10, 90)], node_id="n1")
+    _finalize(st)
+    got = st.get(tid)
+    w = [s for s in got["spans"] if s["span_id"] == "w"][0]
+    assert w["start_ns"] == 10 * MS + int(5.0 * 1e9)
+    assert w["clock_offset_s"] == pytest.approx(5.0)
+    assert w["node_id"] == "n1"
+    r = [s for s in got["spans"] if s["span_id"] == "r"][0]
+    assert r["start_ns"] == 0  # head-side span untouched
+
+
+# -- metrics <-> trace exemplars -------------------------------------------
+
+
+def _hist(name, labels, by_le):
+    out = {name + "_bucket": {}, name + "_count": {}, name + "_sum": {}}
+    running = total = 0.0
+    for le, n in sorted(by_le.items()):
+        running += n
+        total += n * (le if le != float("inf") else 0.0)
+        le_s = "+Inf" if le == float("inf") else repr(le)
+        out[name + "_bucket"][labels + (("le", le_s),)] = running
+    out[name + "_count"][labels] = running
+    out[name + "_sum"][labels] = total
+    return out
+
+
+def test_burning_slo_attaches_resolvable_exemplars():
+    """The acceptance shape: a deliberately-burned TTFT SLO carries
+    exemplar trace ids, and every one of them resolves in the trace
+    store to a full trace (not a dangling pointer)."""
+    store = _store(slow_threshold_s=0.05)
+    for i in range(3):
+        tid = "%032x" % (0xE0 + i)
+        store.add_spans([
+            _sp(tid, "r", None, "serve.stream:d", 0, 200,
+                attrs={"deployment": "d"}),
+            _sp(tid, "p", "r", "llm.prefill:d", 20, 180 - 10 * i,
+                attrs={"deployment": "d"})])
+    _finalize(store)
+    plane = SignalPlane(history_s=600.0, burn_evals=2)
+    plane.set_exemplar_source(store.exemplars)
+    plane.register_slo("ttft", 'ttft_p50{deployment="d"} < 0.1s over 5s')
+    name = "ray_tpu_serve_decode_ttft_seconds"
+    lbl = (("deployment", "d"), ("node_id", "n1"))
+    les = {0.05: 0.0, 0.5: 0.0, float("inf"): 0.0}
+    t = 0.0
+    for _ in range(6):  # slow traffic only -> breach
+        les[0.5] += 50.0
+        plane.ring.ingest(t, _hist(name, lbl, les))
+        t += 1.0
+    plane.evaluate_slos(t - 1)
+    events = plane.evaluate_slos(t - 0.5)
+    assert [e["state"] for e in events] == ["burning"]
+    st = plane.slo_status()["slos"]["ttft"]
+    assert st["state"] == "burning"
+    ids = st["exemplar_trace_ids"]
+    assert ids, "burning SLO carried no exemplars"
+    for tid in ids:
+        tr = store.get(tid)
+        assert tr is not None, f"exemplar {tid} does not resolve"
+        assert tr["decomposition"]["total_s"] >= 0.1  # >= SLO threshold
+    # Slowest-TTFT-first: the worst trace leads.
+    ttfts = [store.get(t)["decomposition"]["total_s"] for t in ids]
+    assert ttfts == sorted(ttfts, reverse=True)
+    # Exemplars only come from KEPT traces (resolvable by contract).
+    ex = store.exemplars(deployment="d", min_duration_s=0.0, limit=10)
+    assert all(store.get(e["trace_id"]) for e in ex)
+    assert [e["ttft_s"] for e in ex] == \
+        sorted((e["ttft_s"] for e in ex), reverse=True)
+
+
+# -- conformance: local backend --------------------------------------------
+
+
+def test_local_backend_trace_query_roundtrip():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    tracing.enable()
+    try:
+        tracing.drain()
+
+        @ray_tpu.remote
+        def traced_work(x):
+            time.sleep(0.01)
+            return x + 1
+
+        with tracing.span("request") as root:
+            assert ray_tpu.get(traced_work.remote(1), timeout=30) == 2
+        tid = root["trace_id"]
+        tr = state.get_trace(tid)
+        assert tr is not None
+        names = {s["name"].split(":")[0] for s in tr["spans"]}
+        assert {"request", "submit", "run"} <= names
+        # The critical path partitions the root interval exactly (the
+        # async run: span is clipped to its short submit parent — by
+        # design, so a child timestamp can't inflate the total).
+        assert sum(s["self_s"] for s in tr["critical_path"]) == \
+            pytest.approx(tr["duration_s"], rel=1e-6)
+        assert any(t["trace_id"] == tid for t in state.list_traces())
+        stats = state.trace_stats()
+        assert stats["kept"] >= 1
+        d = state.ttft_decomposition()
+        assert d["traces"] >= 1 and d["phases"]
+        assert sum(p["p50_s"] for p in d["phases"].values()) == \
+            pytest.approx(d["phase_sum_p50_s"])
+    finally:
+        tracing.disable()
+        tracing.drain()
+        ray_tpu.shutdown()
+
+
+# -- conformance: LLM engine phase spans -----------------------------------
+
+
+def test_llm_engine_phase_spans_parent_under_caller():
+    """llm.queue -> llm.prefill -> llm.decode, all parented under the
+    CALLER's long-lived span (so critical-path clipping sees them), and
+    llm.step spans carry token counts."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.serve import _observability as obs
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    eng = LLMEngine(model="gpt2",
+                    config=dataclasses.replace(gpt2.GPT2Config.tiny(),
+                                               dtype=jnp.float32),
+                    max_batch=2, cache_len=32, max_prompt_len=8,
+                    max_new_tokens=4, deployment="llm")
+    tracing.enable()
+    try:
+        tracing.drain()
+        with tracing.span("serve.stream:llm") as caller:
+            ctx = {"trace_id": caller["trace_id"],
+                   "span_id": caller["span_id"]}
+            with obs.request_scope("llm", None, trace_ctx=ctx):
+                out = eng.generate([5, 9, 2], 4)
+        assert len(out) == 4
+        spans = tracing.collect(clear=True)
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"].split(":")[0], []).append(s)
+        for name in ("llm.queue", "llm.prefill", "llm.decode"):
+            assert name in by_name, f"missing {name} span"
+            s = by_name[name][0]
+            assert s["trace_id"] == caller["trace_id"]
+            # Parented under the CALLER span, not some engine-side
+            # short-lived span: the decomposition clips children to
+            # their parent's interval.
+            assert s["parent_id"] == caller["span_id"]
+            assert s["status"] == "OK"
+            assert s["attributes"]["deployment"] == "llm"
+        steps = by_name.get("llm.step", [])
+        assert steps, "no llm.step spans"
+        assert all(s["trace_id"] == caller["trace_id"] for s in steps)
+        # Prefill yields the first token; decode steps own the rest.
+        assert sum(s["attributes"].get("tokens", 0) for s in steps) >= 3
+        decode = by_name["llm.decode"][0]
+        assert all(s["parent_id"] == decode["span_id"] for s in steps)
+        # Untraced requests stay span-free: sampling is the caller's
+        # decision, the engine only follows a carried context.
+        tracing.drain()
+        eng.generate([5, 9, 2], 2)
+        assert not [s for s in tracing.collect(clear=True)
+                    if s["name"].startswith("llm.")]
+    finally:
+        tracing.disable()
+        tracing.drain()
+        eng.shutdown_engine()
+
+
+# -- conformance: cluster backend ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_cluster_trace_assembles_cross_process(cluster):
+    """Driver submit span + worker run span assemble at the head into
+    one tree ``state.get_trace`` resolves; kept via the slow path (the
+    default tail sampler keeps any trace over the slow threshold)."""
+    tracing.enable()
+    try:
+        tracing.drain()
+
+        @ray_tpu.remote
+        def slow_work():
+            time.sleep(1.2)  # > trace_slow_threshold_s -> kept
+            return "done"
+
+        with tracing.span("request") as root:
+            assert ray_tpu.get(slow_work.remote(), timeout=60) == "done"
+        tid = root["trace_id"]
+
+        deadline = time.monotonic() + 30
+        tr = None
+        while time.monotonic() < deadline:
+            tr = state.get_trace(tid)
+            if tr is not None and any(
+                    s["name"].startswith("run:")
+                    for s in tr["spans"]):
+                break
+            tr = None
+            time.sleep(0.3)
+        assert tr is not None, "trace never assembled at the head"
+        assert tr["kept_because"] in ("slow", "sampled_in")
+        by_id = {s["span_id"]: s for s in tr["spans"]}
+        submit = next(s for s in tr["spans"]
+                      if s["name"].startswith("submit:"))
+        run = next(s for s in tr["spans"]
+                   if s["name"].startswith("run:"))
+        assert run["parent_id"] == submit["span_id"]
+        assert submit["parent_id"] in by_id  # under the request root
+        assert run["pid"] != submit["pid"]   # crossed a process
+        assert run.get("node_id")            # node-attributed
+        assert sum(seg["self_s"] for seg in tr["critical_path"]) == \
+            pytest.approx(tr["duration_s"], rel=1e-6)
+        assert any(t["trace_id"] == tid for t in state.list_traces())
+    finally:
+        tracing.disable()
+        tracing.drain()
+
+
+# -- analyze: trace-propagation rules --------------------------------------
+
+
+def _scan(tmp_path, source):
+    from ray_tpu.util import analyze
+
+    p = tmp_path / "fixture.py"
+    p.write_text(source)
+    return analyze.run_paths([str(p)], rules=["trace-propagation"],
+                             root=str(tmp_path))
+
+
+def test_analyze_tp_rules_fire_and_accept(tmp_path):
+    findings = _scan(tmp_path, """\
+from ray_tpu.util import tracing
+
+def leaks():
+    sp = tracing.start_span("a")
+    work()
+
+def unsafe():
+    sp = tracing.start_span("b")
+    work()
+    tracing.finish_span(sp)
+
+def discarded():
+    tracing.start_span("c")
+""")
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["TP001", "TP002", "TP003"]
+    clean = _scan(tmp_path, """\
+from ray_tpu.util import tracing as _tracing
+
+def ok_finally():
+    sp = _tracing.start_span("a")
+    try:
+        work()
+    finally:
+        _tracing.finish_span(sp)
+
+def ok_pair(flag):
+    sp = _tracing.start_span("b") if flag else None
+    try:
+        work()
+    except Exception:
+        _tracing.finish_span(sp, "ERROR: x")
+        raise
+    _tracing.finish_span(sp)
+
+def ok_escapes(self):
+    self._sp = _tracing.start_span("c")
+    sp2 = _tracing.start_span("d")
+    return sp2
+
+def ok_with():
+    with _tracing.span("e"):
+        work()
+""")
+    assert clean == [], [f.format() for f in clean]
+
+
+def test_analyze_tp002_nested_finally_context(tmp_path):
+    """A finish inside a try/finally nested under an if must register
+    as exception-safe — flow context follows the NESTED statement, not
+    the enclosing one."""
+    clean = _scan(tmp_path, """\
+from ray_tpu.util import tracing
+
+def ok_nested(flag):
+    sp = tracing.start_span("a")
+    if flag:
+        try:
+            work()
+        finally:
+            tracing.finish_span(sp)
+    else:
+        try:
+            other()
+        finally:
+            tracing.finish_span(sp)
+""")
+    assert clean == [], [f.format() for f in clean]
+    findings = _scan(tmp_path, """\
+from ray_tpu.util import tracing
+
+def bad_branches(flag):
+    sp = tracing.start_span("a")
+    if flag:
+        tracing.finish_span(sp)
+    else:
+        tracing.finish_span(sp, "ERROR: x")
+""")
+    assert [f.rule for f in findings] == ["TP002"]
