@@ -81,10 +81,20 @@ _NAMED_SIGNALS: Dict[str, tuple] = {
                     None, {}),
     "queue_depth_trend": ("trend", "ray_tpu_serve_router_queue_depth",
                           None, {}),
+    # Step anatomy plane (round 19). mfu averages across rank series
+    # (summing ranks would report a 2-rank gang at 40% as 80%);
+    # step_p99 is the classic per-report step residual; sync_ratio is
+    # the sync phase's share of the per-rank anatomy gauges — the
+    # "gang is waiting, not computing" burn signal.
+    "mfu": ("gauge_mean", "ray_tpu_mfu_percent", None, {}),
+    "step_p99": ("quantile", "ray_tpu_train_step_phase_seconds",
+                 0.99, {"phase": "step"}),
+    "sync_ratio": ("gauge_ratio", "ray_tpu_step_phase_seconds",
+                   {"phase": "sync"}, {}),
 }
 
 _GENERIC_OPS = ("rate", "delta", "gauge_avg", "gauge_max", "gauge_last",
-                "trend", "p50", "p90", "p95", "p99")
+                "gauge_mean", "trend", "p50", "p90", "p95", "p99")
 
 _SLO_RE = re.compile(
     r"^\s*(?P<sig>[a-zA-Z_][a-zA-Z0-9_]*)"
@@ -117,10 +127,6 @@ def parse_slo(expr: str) -> dict:
              _LABEL_PAIR_RE.findall(m.group("labels") or "")}
     threshold = float(m.group("val"))
     unit = m.group("unit")
-    if unit == "ms":
-        threshold /= 1e3
-    elif unit == "%":
-        threshold /= 100.0
     window_s = float(m.group("win") or 60.0)
     if arg is not None:
         if sig not in _GENERIC_OPS:
@@ -137,6 +143,14 @@ def parse_slo(expr: str) -> dict:
                 f"unknown named signal {sig!r} "
                 f"(have {sorted(_NAMED_SIGNALS)})")
         signal = named
+    # Unit scaling AFTER signal resolution: a family measured in
+    # percent (``..._percent``) takes `< 40%` literally as 40, not
+    # 0.4 — `mfu{trial="x"} < 40% over 120s` must mean what it says.
+    if unit == "ms":
+        threshold /= 1e3
+    elif unit == "%":
+        if not str(signal[1]).endswith("_percent"):
+            threshold /= 100.0
     return {
         "expr": expr.strip(),
         "signal": signal,
@@ -395,6 +409,26 @@ class MetricsRing:
             return out
         return out.get("")
 
+    def gauge_mean_over_window(self, name: str, window_s: float,
+                               match: Optional[dict] = None,
+                               group_by: Optional[str] = None):
+        """Mean ACROSS matched series of each series' window average.
+        ``gauge_over_window`` sums series (per-node CPU semantics);
+        utilization families like MFU need the mean — summing would
+        report a 2-rank gang at 40% each as 80%."""
+        _, start = self._anchor(window_s)
+        per_group: Dict[str, List[float]] = {}
+        for labels, samples in self._matched(name, start, match):
+            key = (_labels_get(labels, group_by) or "") if group_by \
+                else ""
+            vals = [v for _, v in samples]
+            per_group.setdefault(key, []).append(
+                sum(vals) / len(vals))
+        out = {k: sum(v) / len(v) for k, v in per_group.items()}
+        if group_by:
+            return out
+        return out.get("")
+
     def trend(self, name: str, window_s: float,
               match: Optional[dict] = None) -> Optional[float]:
         """Per-second growth of a gauge over the window: (second-half
@@ -548,6 +582,12 @@ class SignalPlane:
                 return {"ok": True, "op": op, "name": name,
                         "value": value,
                         "window_s": self.ring.window_span(window_s)}
+            if op == "gauge_mean":
+                value = self.ring.gauge_mean_over_window(
+                    name, window_s, match, group_by)
+                return {"ok": True, "op": op, "name": name,
+                        "value": value,
+                        "window_s": self.ring.window_span(window_s)}
             if op == "trend":
                 value = self.ring.trend(name, window_s, match)
                 return {"ok": True, "op": op, "name": name,
@@ -632,8 +672,22 @@ class SignalPlane:
         if kind in ("gauge_avg", "gauge_max", "gauge_last"):
             return self.ring.gauge_over_window(
                 a, window_s, kind[len("gauge_"):], match)
+        if kind == "gauge_mean":
+            return self.ring.gauge_mean_over_window(a, window_s, match)
         if kind == "trend":
             return self.ring.trend(a, window_s, match)
+        if kind == "gauge_ratio":
+            # sync_ratio shape: one phase's share of a gauge family —
+            # numerator extra labels ride in `b` (a dict), denominator
+            # is the same family with them stripped (all phases, all
+            # ranks summed per snapshot), so the value is the gang-wide
+            # share of step wall spent in that phase.
+            num = self.ring.gauge_over_window(
+                a, window_s, "avg", {**match, **b})
+            den = self.ring.gauge_over_window(a, window_s, "avg", match)
+            if num is None or den is None or den <= 0:
+                return None
+            return num / den
         if kind == "ratio":
             # shed_ratio shape: numerator family / denominator family,
             # the shared match filtering both (deployment=...).
@@ -800,6 +854,35 @@ class SignalPlane:
             if down:
                 entry["downtime_s"] = round(down, 1)
             train[trial] = entry
+        # Step anatomy: windowed MFU per trial plus the straggler
+        # verdict from the per-rank phase gauges (the same attributor
+        # train_stats uses, so top and stats can never disagree).
+        from ray_tpu.util.goodput import (
+            ANATOMY_PHASES,
+            straggler_attribution,
+        )
+
+        mfu_by_trial = ring.gauge_mean_over_window(
+            "ray_tpu_mfu_percent", window_s, group_by="trial") or {}
+        anat_trials = set(ring.gauge_over_window(
+            "ray_tpu_step_phase_seconds", window_s, "last",
+            group_by="trial") or {})
+        for trial in sorted(
+                (set(mfu_by_trial) | anat_trials) - {""}):
+            entry = train.setdefault(trial, {})
+            if mfu_by_trial.get(trial) is not None:
+                entry["mfu_pct"] = round(mfu_by_trial[trial], 2)
+            rank_phases: Dict[str, Dict[str, float]] = {}
+            for phase in ANATOMY_PHASES:
+                per_rank = ring.gauge_over_window(
+                    "ray_tpu_step_phase_seconds", window_s, "last",
+                    {"trial": trial, "phase": phase},
+                    group_by="rank") or {}
+                for rank, val in per_rank.items():
+                    rank_phases.setdefault(rank, {})[phase] = val
+            verdict = straggler_attribution(rank_phases)
+            if verdict:
+                entry["straggler"] = verdict
         # Fleet churn: the autoscaler's counter families (windowed
         # deltas per node type) + the live pending-demand gauge — empty
         # until an autoscaler's registry lands in the ring.

@@ -775,6 +775,39 @@ const RENDER = {
           .map(([p, v]) => `${p}:${v.p50_ms}ms`).join(" ");
         return td;
       }));
+    const anatRows = trials.flatMap(r => {
+      const anat = r.anatomy || {};
+      return Object.entries(anat.ranks || {}).map(([rank, phases]) => ({
+        trial: r.name, rank, phases,
+        mfu: (anat.mfu_pct || {})[rank],
+        straggler: anat.straggler,
+      }));
+    });
+    if (anatRows.length) {
+      wrap.appendChild(el("h3", "", "step anatomy (per rank)"));
+      wrap.appendChild(table(
+        ["trial", "rank", "mfu %", "data_wait", "host", "compute",
+         "sync", "verdict"],
+        anatRows, (r, c) => {
+          if (c === "trial") return el("td", "", r.trial);
+          if (c === "rank") return el("td", "", r.rank);
+          if (c === "mfu %") return el("td",
+            r.mfu != null && r.mfu < 40 ? "warn" : "",
+            r.mfu != null ? r.mfu.toFixed(1) : "—");
+          if (["data_wait", "host", "compute", "sync"].includes(c)) {
+            const v = (r.phases || {})[c];
+            return el("td", "mono",
+              v != null ? (v * 1e3).toFixed(1) + "ms" : "—");
+          }
+          const s = r.straggler;
+          if (!s || String(s.rank) !== String(r.rank)
+              || s.cause === "balanced")
+            return el("td", "", "—");
+          return el("td", "warn",
+            s.cause + " +" + ((s.excess_s || 0) * 1e3).toFixed(1)
+            + "ms");
+        }));
+    }
     const stages = Object.entries(d.stages || {})
       .map(([name, info]) => ({name, ...info}));
     wrap.appendChild(el("h3", "", "input-pipeline stages"));

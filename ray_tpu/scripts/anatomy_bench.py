@@ -1,0 +1,400 @@
+"""Step-anatomy harness: the proof behind the MFU/straggler plane
+(round 19; commits its section into MICROBENCH.json as
+``step_anatomy`` with ``--out``).
+
+Four claims, each measured, none asserted:
+
+* **cost_model** — the XLA cost-model FLOPs (``util/xla_cost`` on the
+  compiled train step's HLO) agree with the analytic
+  ``*_flops_per_token`` estimate on BOTH model families (GPT-2 and
+  Llama), so the exported MFU denominator is not a typo'd formula;
+* **partition** — the session's anatomy phases (data_wait / host /
+  compute / sync) sum to the step wall EXACTLY, report by report,
+  proven from the emitted goodput events — a decomposition that does
+  not partition is a narrative, not an accounting;
+* **straggler** — a seeded slow rank in a 2-worker gang is named by
+  :func:`ray_tpu.util.goodput.straggler_attribution` with the seeded
+  cause (compute-bound for a slow step body, input-bound for seeded
+  data wait), and the trial's per-rank gauges are retracted when the
+  session stops;
+* **sentinel** — ``bench_log --regress`` exits 0 when the fresh
+  artifact matches the committed one and 1 when a seeded slowdown
+  (halved MFU, doubled step wall, flipped verdict) is injected.
+
+Run: python -m ray_tpu.scripts.anatomy_bench [--out MICROBENCH.json]
+
+The harness is TPU-ready: every number is stamped with the live device
+kind, and the evidence line enters BENCH_TPU_SESSIONS.jsonl only when
+run on a real accelerator.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import tempfile
+import time
+
+TRIAL = "anatomy_bench"
+
+
+def _timed_loop(step_fn, state, batch, steps: int) -> tuple:  # step-timed
+    """Timed train-step loop -> (state, dt seconds). The device sync
+    (``float`` of the loss) sits between the timer reads, so the wall
+    covers real compute, not async dispatch."""
+    t0 = time.perf_counter()
+    metrics = None
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return state, dt
+
+
+def _cost_model_one(name: str, cfg, init, loss, shardings_fn,
+                    flops_per_token, *, batch: int = 4,
+                    steps: int = 8, warmup: int = 2) -> dict:
+    """HLO-vs-analytic FLOPs agreement + measured MFU for one model
+    family's compiled train step."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+    from ray_tpu.train.train_step import make_init_fn, make_train_step
+    from ray_tpu.util import xla_cost
+
+    mesh = build_mesh(MeshConfig(fsdp=-1))
+    shardings = shardings_fn(cfg, mesh)
+    init_fn = make_init_fn(lambda r: init(r, cfg), shardings, mesh)
+    state = init_fn(jax.random.key(0))
+    step_fn = make_train_step(
+        lambda p, b: loss(p, b, cfg), shardings, mesh)
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, cfg.seq_len + 1), 0, cfg.vocab_size,
+        jnp.int32)
+    batch_data = {"tokens": tokens}
+
+    cost = xla_cost.step_cost(step_fn, state, batch_data)
+    analytic = float(flops_per_token(cfg)) * batch * cfg.seq_len
+    out: dict = {"model": name, "batch": batch,
+                 "seq_len": cfg.seq_len,
+                 "analytic_flops": analytic,
+                 "available": bool(cost.get("available"))}
+    if not cost.get("available"):
+        out["reason"] = cost.get("reason", "")
+        out["ok"] = False
+        return out
+
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch_data)
+    float(metrics["loss"])
+    state, dt = _timed_loop(step_fn, state, batch_data, steps)
+    step_s = dt / steps
+
+    mfu = xla_cost.mfu_percent(cost["flops"], step_s,
+                               device_kind=cost.get("device_kind"))
+    ratio = cost["flops"] / max(analytic, 1.0)
+    out.update({
+        "hlo_flops": cost["flops"],
+        "flops_ratio": round(ratio, 3),
+        "intensity_flops_per_byte": round(
+            cost.get("intensity_flops_per_byte") or 0.0, 2),
+        "roofline": cost.get("roofline"),
+        "step_ms": round(step_s * 1000, 3),
+        "mfu": round(mfu, 4),
+        # Generous band by design: the analytic 6N formula ignores
+        # softmax/norm/optimizer FLOPs and the HLO counts every one of
+        # them — agreement here means "same order, same model", which
+        # is exactly what a fat-fingered denominator would break.
+        "ok": 0.25 <= ratio <= 4.0,
+    })
+    return out
+
+
+def _cost_model_section() -> dict:
+    try:
+        import jax  # noqa: F401
+    except Exception as e:
+        return {"skipped": f"jax unavailable: {e!r}", "ok": False}
+    from ray_tpu.models.gpt2 import (
+        GPT2Config,
+        gpt2_flops_per_token,
+        gpt2_init,
+        gpt2_loss,
+        gpt2_shardings,
+    )
+    from ray_tpu.models.llama import (
+        LlamaConfig,
+        llama_flops_per_token,
+        llama_init,
+        llama_loss,
+        llama_shardings,
+    )
+
+    gpt2 = _cost_model_one(
+        "gpt2",
+        GPT2Config(vocab_size=256, n_layer=2, n_head=4, d_model=128,
+                   seq_len=64, remat=False),
+        gpt2_init, gpt2_loss, gpt2_shardings, gpt2_flops_per_token)
+    llama = _cost_model_one(
+        "llama",
+        LlamaConfig(vocab_size=256, n_layer=2, n_head=4, n_kv_head=2,
+                    d_model=128, seq_len=64, remat=False),
+        llama_init, llama_loss, llama_shardings, llama_flops_per_token)
+    ratios = [m["flops_ratio"] for m in (gpt2, llama)
+              if "flops_ratio" in m]
+    return {
+        "gpt2": gpt2,
+        "llama": llama,
+        # Headline for the regression gate: the worst family's ratio.
+        "flops_ratio": round(max(ratios), 3) if ratios else None,
+        "ok": bool(gpt2.get("ok")) and bool(llama.get("ok")),
+    }
+
+
+def _partition_section(steps: int = 4) -> dict:
+    """Exact-partition proof on a live in-process session: every
+    report's emitted anatomy phases must sum to that report's step wall
+    (data_wait + step from the classic accounting) to float precision."""
+    from ray_tpu.train import session
+    from ray_tpu.train import _observability as tob
+
+    tob.drain_events()  # isolate: only this section's events below
+    session.init_session(
+        world_rank=0, world_size=1, local_rank=0, node_rank=0,
+        results_queue=queue.Queue(), checkpoint=None,
+        dataset_shards=None, trial_info={"trial_id": TRIAL})
+    try:
+        session.set_step_cost(1e6)  # exercise the MFU export path
+        for _ in range(steps):
+            session.add_data_wait(0.002)
+            time.sleep(0.002)
+            session.timed_step(time.sleep, 0.004)
+            session.report({})
+    finally:
+        session.shutdown_session()
+    events = tob.drain_events()
+    walls = [ev["p"].get("data_wait", 0.0) + ev["p"]["step"]
+             for ev in events if ev.get("k") == "step"
+             and ev.get("t") == TRIAL]
+    anat = [sum(ev["p"].values()) for ev in events
+            if ev.get("k") == "anat" and ev.get("t") == TRIAL]
+    mfu_exported = any(ev.get("m") is not None for ev in events
+                       if ev.get("k") == "anat")
+    errs = [abs(a - w) for a, w in zip(anat, walls)]
+    phases = next((dict(ev["p"]) for ev in reversed(events)
+                   if ev.get("k") == "anat"), {})
+    try:
+        tob.retract_trial(TRIAL)
+    except Exception:
+        pass
+    return {
+        "steps": steps,
+        "reports": len(walls),
+        "anatomy_reports": len(anat),
+        "max_partition_err_s": max(errs) if errs else None,
+        "mfu_exported": mfu_exported,
+        "last_phases": {k: round(v, 6) for k, v in phases.items()},
+        "ok": (len(anat) == steps and len(walls) == steps
+               and mfu_exported
+               and all(e < 1e-9 for e in errs)),
+    }
+
+
+def _run_gang(seed: str, steps: int = 3) -> dict | None:
+    """2-worker local gang with rank 1 seeded slow — ``seed`` picks the
+    slow phase ('compute': a slow step body; 'input': seeded data
+    wait). Returns the straggler verdict from the emitted events."""
+    from ray_tpu import train
+    from ray_tpu.train import session
+    from ray_tpu.train import _observability as tob
+
+    def train_fn(config):
+        rank = session.get_world_rank()
+        for _ in range(config["steps"]):
+            if config["seed"] == "input" and rank == 1:
+                time.sleep(0.05)
+                session.add_data_wait(0.05)
+            slow = 0.05 if (config["seed"] == "compute"
+                            and rank == 1) else 0.0
+            session.timed_step(time.sleep, 0.01 + slow)
+            session.report({})
+
+    tob.drain_events()
+    trainer = train.DataParallelTrainer(
+        train_fn,
+        train_loop_config={"steps": steps, "seed": seed},
+        scaling_config=train.ScalingConfig(num_workers=2),
+    )
+    result = trainer.fit()
+    if result.error is not None:
+        return {"error": repr(result.error)}
+    rank_phases: dict = {}
+    for ev in tob.drain_events():
+        if ev.get("k") != "anat":
+            continue
+        acc = rank_phases.setdefault(ev["r"], {})
+        for p, s in ev["p"].items():
+            acc[p] = acc.get(p, 0.0) + s
+    verdict = tob.straggler_attribution(rank_phases)
+    return {"rank_phases": {
+        str(r): {p: round(s, 4) for p, s in ph.items()}
+        for r, ph in rank_phases.items()},
+        "verdict": verdict}
+
+
+def _straggler_section() -> dict:
+    from ray_tpu.serve import _observability as obs
+    from ray_tpu.util import metrics
+
+    compute = _run_gang("compute")
+    inp = _run_gang("input")
+
+    def check(res, cause):
+        v = (res or {}).get("verdict") or {}
+        return {**(res or {}),
+                "ok": v.get("rank") == 1 and v.get("cause") == cause}
+
+    compute = check(compute, "compute-bound")
+    inp = check(inp, "input-bound")
+
+    # Session-stop discipline (LC001): fit()'s finally retracts the
+    # trial's per-rank gauges — nothing may survive on the scrape.
+    parsed = obs.parse_prometheus(metrics.prometheus_text())
+    leftover = [dict(lb) for fam in ("ray_tpu_step_phase_seconds",
+                                     "ray_tpu_mfu_percent")
+                for lb in (parsed.get(fam) or {})
+                if dict(lb).get("trial") == "train"]
+    return {
+        "compute_seeded": compute,
+        "input_seeded": inp,
+        "retraction": {"leftover_series": len(leftover),
+                       "ok": not leftover},
+        "ok": (compute["ok"] and inp["ok"] and not leftover),
+    }
+
+
+def _sentinel_section() -> dict:
+    """The regression sentinel trips on a seeded slowdown and stays
+    quiet on identity — proven through the real CLI entrypoint (exit
+    codes), not just the library call."""
+    from ray_tpu.scripts import bench_log
+
+    base = {"step_anatomy": {
+        "mfu": 42.0, "step_wall_s": 0.5,
+        "phases": {"data_wait": 0.1, "host": 0.05,
+                   "compute": 0.3, "sync": 0.05},
+        "cost_model": {"flops_ratio": 1.2, "ok": True},
+        "agreement": {"ok": True},
+    }}
+    seeded = json.loads(json.dumps(base))
+    seeded["step_anatomy"]["mfu"] = 21.0           # halved
+    seeded["step_anatomy"]["step_wall_s"] = 1.0    # doubled
+    seeded["step_anatomy"]["cost_model"]["ok"] = False
+
+    identity_problems = bench_log.regress_check(
+        json.loads(json.dumps(base)), base)
+    seeded_problems = bench_log.regress_check(seeded, base)
+
+    with tempfile.TemporaryDirectory() as td:
+        bp = os.path.join(td, "base.json")
+        fp = os.path.join(td, "fresh.json")
+        sp = os.path.join(td, "seeded.json")
+        for path, obj in ((bp, base), (fp, base), (sp, seeded)):
+            with open(path, "w") as f:
+                json.dump(obj, f)
+        rc_identity = bench_log.main(
+            ["--regress", fp, "--against", bp])
+        rc_seeded = bench_log.main(
+            ["--regress", sp, "--against", bp])
+    return {
+        "identity_problems": len(identity_problems),
+        "seeded_problems": seeded_problems,
+        "identity_rc": rc_identity,
+        "seeded_rc": rc_seeded,
+        "ok": (not identity_problems and len(seeded_problems) >= 3
+               and rc_identity == 0 and rc_seeded == 1),
+    }
+
+
+def run() -> dict:
+    import ray_tpu
+    from ray_tpu.scripts import bench_log
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    try:
+        cost_model = _cost_model_section()
+        partition = _partition_section()
+        straggler = _straggler_section()
+        sentinel = _sentinel_section()
+    finally:
+        ray_tpu.shutdown()
+
+    phases = partition.get("last_phases") or {}
+    gpt2 = cost_model.get("gpt2") or {}
+    res = {
+        "device": bench_log.device_kind() or "cpu",
+        # Headline numbers (the regression gates key on these): the
+        # GPT-2 family's measured MFU and the live partition's phases.
+        "mfu": gpt2.get("mfu", 0.0),
+        "phases": phases,
+        "step_wall_s": round(sum(phases.values()), 6),
+        "cost_model": cost_model,
+        "partition": partition,
+        "straggler": straggler,
+        "sentinel": sentinel,
+        "agreement": {"ok": bool(cost_model.get("ok"))
+                      and bool(partition.get("ok"))},
+        "ok": all(bool(s.get("ok")) for s in
+                  (cost_model, partition, straggler, sentinel)),
+    }
+
+    entry = bench_log.record_step_anatomy(
+        mfu=res["mfu"], phases=res["phases"],
+        step_wall_s=res["step_wall_s"], agreement=res["agreement"],
+        straggler=(straggler.get("compute_seeded") or {}).get("verdict"),
+        device=res["device"], script="anatomy_bench")
+    res["evidence"] = {"committed_to": entry.get("committed_to")}
+    return res
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="Step-anatomy harness: cost-model agreement, exact "
+                    "phase partition, seeded-straggler attribution, "
+                    "regression-sentinel trip")
+    ap.add_argument("--out", default=None,
+                    help="merge the step_anatomy section into this "
+                         "MICROBENCH-style artifact")
+    args = ap.parse_args()
+
+    res = run()
+
+    if args.out:
+        # Merge-preserve: every perfsuite stage owns one section.
+        payload = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                try:
+                    payload = json.load(f)
+                except ValueError:
+                    payload = {}
+        payload["step_anatomy"] = res
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(res, indent=1, default=str))
+    if not res["ok"]:
+        print("anatomy_bench: FAILED — see 'cost_model'/'partition'/"
+              "'straggler'/'sentinel' (either the HLO and analytic "
+              "FLOPs disagree, the phases do not partition the step "
+              "wall, the seeded straggler was not attributed, or the "
+              "sentinel did not trip)")
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

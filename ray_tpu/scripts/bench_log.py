@@ -27,7 +27,7 @@ KNOWN_BENCHES = frozenset({
     "task_overhead", "memory_pressure", "chaos_soak", "scalebench",
     "drain_recovery_ms", "serve_latency", "input_pipeline", "goodput",
     "analyze", "gang_recovery", "llm_serving", "streaming_dataflow",
-    "signal_plane", "fleet_scaling", "trace_plane",
+    "signal_plane", "fleet_scaling", "trace_plane", "step_anatomy",
 })
 
 
@@ -700,6 +700,36 @@ def check_line(obj: object, *, allow_header: bool = False) -> list[str]:
                     and _is_num(overhead.get("untraced_ratio"))):
                 errs.append("trace_plane line missing numeric "
                             "overhead.untraced_ratio")
+        elif obj["bench"] == "step_anatomy":
+            # The line's claim is "we know where the step wall went and
+            # how close to peak the chip ran": the MFU number, the
+            # phase partition (which must actually SUM to the step
+            # wall — a decomposition that doesn't partition is a
+            # narrative, not an accounting), and the cost-model-vs-
+            # measured agreement verdict are all load-bearing.
+            if not _is_num(obj.get("mfu")):
+                errs.append("step_anatomy line missing numeric mfu")
+            wall = obj.get("step_wall_s")
+            phases = obj.get("phases")
+            if not _is_num(wall):
+                errs.append("step_anatomy line missing numeric "
+                            "step_wall_s")
+            if not (isinstance(phases, dict) and phases
+                    and all(_is_num(v) for v in phases.values())):
+                errs.append("step_anatomy line missing numeric "
+                            "phases dict")
+            elif _is_num(wall):
+                total = sum(phases.values())
+                if abs(total - wall) > max(1e-6, 0.01 * wall):
+                    errs.append(
+                        f"step_anatomy phases sum to {total:.6f}s but "
+                        f"step_wall_s is {wall:.6f}s — the phases must "
+                        f"partition the step wall exactly")
+            agreement = obj.get("agreement")
+            if not (isinstance(agreement, dict)
+                    and isinstance(agreement.get("ok"), bool)):
+                errs.append("step_anatomy line missing boolean "
+                            "agreement.ok")
         elif obj["bench"] == "serve_latency":
             # A serve latency line must carry both views AND the
             # agreement verdict — a client-only (or server-only) number
@@ -766,11 +796,54 @@ def main(argv: list[str] | None = None) -> int:
                     help="validate every line of the evidence file "
                          "against the expected schema; exit 1 on any "
                          "malformed line")
+    ap.add_argument("--regress", metavar="FRESH", default=None,
+                    help="perf-regression sentinel: diff a fresh "
+                         "perfsuite artifact (MICROBENCH-shaped JSON) "
+                         "against the committed MICROBENCH.json; exit "
+                         "1 on any gated metric moving past tolerance "
+                         "or any committed-true 'ok' verdict going "
+                         "false")
+    ap.add_argument("--against", metavar="COMMITTED", default=None,
+                    help="baseline artifact for --regress (default: "
+                         "HEAD's MICROBENCH.json via git, falling back "
+                         "to the working-tree file)")
     ap.add_argument("path", nargs="?", default=None,
                     help=f"evidence file (default: committed {FILENAME})")
     args = ap.parse_args(argv)
+    if args.regress:
+        try:
+            with open(args.regress) as f:
+                fresh = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench_log regress: cannot read fresh artifact "
+                  f"{args.regress}: {e}")
+            return 1
+        if args.against:
+            try:
+                with open(args.against) as f:
+                    committed = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"bench_log regress: cannot read baseline "
+                      f"{args.against}: {e}")
+                return 1
+        else:
+            committed = _committed_microbench()
+            if committed is None:
+                print("bench_log regress: no committed MICROBENCH.json "
+                      "to diff against — nothing to gate")
+                return 0
+        problems = regress_check(fresh, committed)
+        if problems:
+            for p in problems:
+                print(f"bench_log regress: {p}")
+            print(f"bench_log regress: FAIL ({len(problems)} "
+                  f"regression(s) vs committed artifact)")
+            return 1
+        print("bench_log regress: OK (no gated metric regressed, no "
+              "committed verdict went false)")
+        return 0
     if not args.check:
-        ap.error("nothing to do (pass --check)")
+        ap.error("nothing to do (pass --check or --regress)")
     path = args.path or default_path()
     try:
         problems = check_file(path)
@@ -838,6 +911,140 @@ def record_drain_recovery(proactive_drain_ms: float,
     entry.update(extra)
     entry["committed_to"] = record_if_on_chip(dict(entry), path)
     return entry
+
+
+def record_step_anatomy(*, mfu: float, phases: dict, step_wall_s: float,
+                        agreement: dict, straggler: dict | None = None,
+                        device: str = "", path: str | None = None,
+                        **extra) -> dict:
+    """Step-anatomy evidence (``scripts/anatomy_bench.py``): the
+    cost-model MFU, the exact phase partition of one step's wall
+    (data_wait / host / compute / sync must sum to ``step_wall_s``),
+    the cost-model-vs-measured agreement verdict, and — when a seeded
+    straggler ran — the attribution verdict. Committed to the evidence
+    trail only on a real accelerator; returns the entry (with
+    ``committed_to``) either way."""
+    entry: dict = {
+        "bench": "step_anatomy",
+        "device": device,
+        "mfu": round(float(mfu), 2),
+        "step_wall_s": float(step_wall_s),
+        "phases": {k: float(v) for k, v in dict(phases).items()},
+        "agreement": dict(agreement),
+    }
+    if straggler:
+        entry["straggler"] = dict(straggler)
+    entry.update(extra)
+    entry["committed_to"] = record_if_on_chip(dict(entry), path)
+    return entry
+
+
+# --------------------------------------------------------------------------
+# Perf-regression sentinel (round 19): diff a fresh perfsuite artifact
+# against the committed MICROBENCH.json. A perf number nobody compares
+# is a perf number that silently rots — this is the comparison, run as
+# the last perfsuite stage and as
+# ``python -m ray_tpu.scripts.bench_log --regress FRESH [--against OLD]``.
+# --------------------------------------------------------------------------
+
+# Numeric gates: dotted section path -> (direction, relative tolerance).
+# direction "higher" = the committed value is a floor (fresh may not
+# drop more than tol below it); "lower" = a ceiling (fresh may not rise
+# more than tol above it). Tolerances are deliberately loose — the
+# sentinel exists to catch the 2x cliff nobody noticed, not to flake on
+# scheduler jitter.
+REGRESS_GATES: dict[str, tuple[str, float]] = {
+    "step_anatomy.mfu": ("higher", 0.25),
+    "step_anatomy.step_wall_s": ("lower", 0.25),
+    "step_anatomy.cost_model.flops_ratio": ("lower", 0.25),
+    "goodput.goodput_pct": ("higher", 0.15),
+    "serve_latency.client.p99_ms": ("lower", 0.50),
+    "signal_plane.query_p50_ms": ("lower", 0.50),
+    "trace_plane.ttft_p50_ms": ("lower", 0.50),
+}
+
+
+def _dig(obj, dotted: str):
+    for part in dotted.split("."):
+        if not isinstance(obj, dict) or part not in obj:
+            return None
+        obj = obj[part]
+    return obj
+
+
+def _ok_paths(obj, prefix: str = "") -> dict[str, bool]:
+    """Every boolean-valued 'ok' key in a nested artifact, by dotted
+    path — the generic invariant: a check that passed in the committed
+    artifact must not start failing in a fresh run."""
+    out: dict[str, bool] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            p = f"{prefix}.{k}" if prefix else str(k)
+            if k == "ok" and isinstance(v, bool):
+                out[p] = v
+            else:
+                out.update(_ok_paths(v, p))
+    return out
+
+
+def regress_check(fresh: dict, committed: dict) -> list[str]:
+    """Regressions in a fresh perfsuite artifact relative to the
+    committed one ([] = clean). Two rules: (1) numeric gates — a
+    REGRESS_GATES metric present in BOTH artifacts must not move in the
+    bad direction by more than its relative tolerance; (2) verdict
+    preservation — any boolean 'ok' that is true in the committed
+    artifact and present in the fresh one must still be true. Sections
+    or metrics absent from either side are skipped (a fresh artifact
+    that only ran one stage gates only that stage)."""
+    problems: list[str] = []
+    for dotted, (direction, tol) in REGRESS_GATES.items():
+        old = _dig(committed, dotted)
+        new = _dig(fresh, dotted)
+        if not (_is_num(old) and _is_num(new)) or old == 0:
+            continue
+        if direction == "higher":
+            floor = old * (1.0 - tol)
+            if new < floor:
+                problems.append(
+                    f"{dotted}: {new:.4g} fell below committed "
+                    f"{old:.4g} by more than {tol:.0%} "
+                    f"(floor {floor:.4g})")
+        else:
+            ceil = old * (1.0 + tol)
+            if new > ceil:
+                problems.append(
+                    f"{dotted}: {new:.4g} rose above committed "
+                    f"{old:.4g} by more than {tol:.0%} "
+                    f"(ceiling {ceil:.4g})")
+    fresh_oks = _ok_paths(fresh)
+    for path, was_ok in _ok_paths(committed).items():
+        if was_ok and fresh_oks.get(path) is False:
+            problems.append(
+                f"{path}: was true in the committed artifact, false "
+                f"in the fresh run")
+    return problems
+
+
+def _committed_microbench() -> dict | None:
+    """The committed MICROBENCH.json — preferring HEAD's copy via git
+    (so a fresh-run-overwritten working file still diffs against what
+    was actually committed), falling back to the working tree."""
+    import subprocess
+
+    root = os.path.dirname(default_path())
+    try:
+        out = subprocess.run(
+            ["git", "show", "HEAD:MICROBENCH.json"], cwd=root,
+            capture_output=True, text=True, timeout=30)
+        if out.returncode == 0 and out.stdout.strip():
+            return json.loads(out.stdout)
+    except Exception:
+        pass
+    try:
+        with open(os.path.join(root, "MICROBENCH.json")) as f:
+            return json.load(f)
+    except Exception:
+        return None
 
 
 if __name__ == "__main__":

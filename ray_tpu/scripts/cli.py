@@ -362,8 +362,16 @@ def _print_top(top, window):
     train = top.get("train") or {}
     for trial, t in sorted(train.items()):
         gp = t.get("goodput_pct")
+        mfu = t.get("mfu_pct")
+        strag = t.get("straggler")
+        strag_s = ""
+        if strag and strag.get("cause") != "balanced":
+            strag_s = (f", straggler r{strag.get('rank')} "
+                       f"{strag.get('cause')}")
         print(f"trial {trial}: {t.get('reports_per_s', 0)} reports/s"
-              + (f", goodput {gp}%" if gp is not None else ""))
+              + (f", goodput {gp}%" if gp is not None else "")
+              + (f", mfu {mfu:.1f}%" if mfu is not None else "")
+              + strag_s)
     for name, s in sorted(slos.items()):
         v = s.get("value")
         print(f"slo {name:<20} {s['state']:<8} "
@@ -649,6 +657,25 @@ def cmd_train(args):
             line = "  ".join(f"r{r}={s * 1e3:.1f}ms"
                              for r, s in ranks.items())
             print(f"    rank step: {line}")
+        anat = t.get("anatomy") or {}
+        mfu = anat.get("mfu_pct") or {}
+        if mfu:
+            line = "  ".join(f"r{r}={v:.1f}%"
+                             for r, v in sorted(mfu.items()))
+            print(f"    mfu: {line}")
+        for rank, phases in sorted((anat.get("ranks") or {}).items()):
+            line = "  ".join(f"{p}={s * 1e3:.1f}ms"
+                             for p, s in phases.items())
+            print(f"    anatomy r{rank}: {line}")
+        strag = anat.get("straggler")
+        if strag:
+            if strag.get("cause") == "balanced":
+                print("    straggler: none (balanced gang)")
+            else:
+                print(f"    straggler: rank {strag.get('rank')} "
+                      f"{strag.get('cause')} "
+                      f"(+{strag.get('excess_s', 0) * 1e3:.1f}ms over "
+                      f"median, phase {strag.get('phase')})")
         for cause, s in (t.get("downtime_s") or {}).items():
             print(f"    downtime [{cause}]: {s:.2f} s")
 

@@ -38,7 +38,7 @@ def peak_flops_per_chip(device_kind: str) -> float:
 
 
 def measure_gpt2(cfg, batch: int, *, steps: int = 20, warmup: int = 3,
-                 mesh=None) -> dict:
+                 mesh=None) -> dict:  # step-timed
     """Timed GPT-2 train-step loop -> measurement dict.
 
     Builds the sharded state on ``mesh`` (default: fsdp over all local

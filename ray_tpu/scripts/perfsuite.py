@@ -203,6 +203,13 @@ def main() -> None:
                          "decomposition vs the client stopwatch, "
                          "bounded assembly store, tracing hot-path "
                          "overhead ratios)")
+    ap.add_argument("--anatomy", action="store_true",
+                    help="add the step-anatomy point (cost-model-vs-"
+                         "analytic FLOPs agreement on two model "
+                         "families, exact phase partition, seeded-"
+                         "straggler attribution) and run the perf-"
+                         "regression sentinel against the committed "
+                         "artifact as the final stage")
     ap.add_argument("--dataflow", action="store_true",
                     help="add the streaming-dataflow point "
                          "(generation->training pipeline past store "
@@ -257,6 +264,14 @@ def main() -> None:
     if args.traces:
         steps.append([sys.executable, "-m",
                       "ray_tpu.scripts.trace_bench", "--out", args.out])
+    if args.anatomy:
+        steps.append([sys.executable, "-m",
+                      "ray_tpu.scripts.anatomy_bench", "--out", args.out])
+        # Sentinel last: diff the fresh artifact (every section above
+        # has landed in --out by now) against the committed
+        # MICROBENCH.json; a regression fails the suite.
+        steps.append([sys.executable, "-m",
+                      "ray_tpu.scripts.bench_log", "--regress", args.out])
     for argv in steps:
         print(f"perfsuite: {' '.join(argv[2:])}", file=sys.stderr,
               flush=True)

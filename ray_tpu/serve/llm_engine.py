@@ -53,6 +53,7 @@ from typing import Dict, List, Optional
 from ray_tpu.serve import _observability as _obs
 from ray_tpu.serve._observability import RequestShedError
 from ray_tpu.util import failpoints
+from ray_tpu.util import goodput as _goodput
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import tracing
 
@@ -217,6 +218,16 @@ class LLMEngine:
             "ignore", message="Some donated buffers were not usable")
         self._step_fn = jax.jit(step_fn, donate_argnums=(1,))
         self._prefill_fn = jax.jit(prefill_fn, donate_argnums=(1,))
+        # Step-anatomy cost model (round 19): a counter-free twin of
+        # the decode step for xla_cost lowering — lowering _step_fn
+        # itself would re-run its traced body and bump the
+        # compile-counter invariant serve_bench asserts on. Lazy and
+        # opt-in via step_cost(): the extra XLA compile is not free.
+        self._cost_fn = jax.jit(
+            lambda params, cache, tokens, pos: decode(
+                params, cache, tokens, pos, cfg))
+        self._step_cost: Optional[dict] = None
+        self._step_cost_flops = 0.0
 
         self._tokens = np.zeros(self.max_batch + 1, np.int32)
         self._pos = np.zeros(self.max_batch + 1, np.int32)
@@ -394,7 +405,25 @@ class LLMEngine:
                     self._finish_locked(req, done=True, slot=slot)
             self._last_tokens_at = now
 
-    def _step_once(self) -> bool:  # jax-hot-path
+    def step_cost(self) -> dict:
+        """Cost-account the compiled decode step (util/xla_cost):
+        FLOPs / bytes / roofline from the HLO, computed once and
+        cached. Opt-in — the lowering pays one extra XLA compile, so
+        the decode loop never does this on its own; once called, every
+        subsequent step's anatomy event carries MFU."""
+        if self._step_cost is None:
+            from ray_tpu.util import xla_cost as _xla_cost
+
+            cost = _xla_cost.step_cost(
+                self._cost_fn, self.params, self._cache,
+                self._jnp.asarray(self._tokens),
+                self._jnp.asarray(self._pos))
+            self._step_cost = cost
+            if cost.get("available"):
+                self._step_cost_flops = float(cost.get("flops", 0.0))
+        return self._step_cost
+
+    def _step_once(self) -> bool:  # jax-hot-path  # step-timed
         np = self._np
         with self._lock:
             now = time.time()
@@ -436,6 +465,8 @@ class LLMEngine:
             nxt, self._cache = self._step_fn(
                 self.params, self._cache, self._jnp.asarray(self._tokens),
                 self._jnp.asarray(self._pos))
+            # Anatomy host phase ends when the async dispatch returns.
+            t_dispatch = time.perf_counter()
             # The one intentional sync per decode step (tokens fan out
             # to streams from host memory).  # analyze: ignore[JX002]
             nxt = np.asarray(nxt)  # analyze: ignore[JX002]
@@ -486,6 +517,26 @@ class LLMEngine:
             self._last_tokens_at = done_at
         _obs.record_decode_step(self._dep, step_s, len(active), produced)
         _obs.record_decode_itl(self._dep, itl, produced)
+        # Step anatomy: host = dispatch wall, compute = the sync wall
+        # after it (the np.asarray above IS the device wait); a
+        # single-replica engine has no gang barrier, so sync is 0 and
+        # host + compute partition step_s exactly. MFU rides along
+        # once step_cost() has attached the HLO cost model.
+        host_s = max(0.0, t_dispatch - t0)
+        mfu = None
+        if self._step_cost_flops > 0 and step_s > host_s:
+            from ray_tpu.util import xla_cost as _xla_cost
+
+            mfu = _xla_cost.mfu_percent(
+                self._step_cost_flops, step_s - host_s)
+        try:
+            _goodput.record_anatomy(
+                f"serve:{self._dep}", 0,
+                {"data_wait": 0.0, "host": host_s,
+                 "compute": max(0.0, step_s - host_s), "sync": 0.0},
+                mfu=mfu)
+        except Exception:
+            pass
         if step_span is not None:
             step_span["attributes"]["tokens"] = produced
             tracing.finish_span(step_span)
@@ -747,4 +798,10 @@ class LLMEngine:
         self._stop = True
         self._wake.set()
         _metrics.retract_loop_series(["llm.engine"])
+        # The engine's per-step anatomy gauges (MFU / phase seconds)
+        # must not outlive it on the scrape (LC001 discipline).
+        try:
+            _goodput.retract_trial(f"serve:{self._dep}")
+        except Exception:
+            pass
         return True

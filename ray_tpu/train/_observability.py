@@ -9,19 +9,23 @@ module's docstring for the recording contract.
 """
 
 from ray_tpu.util.goodput import (  # noqa: F401
+    ANATOMY_PHASES,
     ITER_PHASES,
     STEP_PHASES,
     apply_events,
     data_stats,
     downtime_cause,
     drain_events,
+    record_anatomy,
     record_downtime,
     record_iter_batch,
     record_stage,
     record_step,
     requeue_events,
     retract_gauges,
+    retract_trial,
     scrape_text,
     stall_fraction_from,
+    straggler_attribution,
     train_stats,
 )

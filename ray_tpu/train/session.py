@@ -16,10 +16,20 @@ spent materializing the session's start checkpoint, measured where
 ``ray_tpu.util.goodput`` (local registry + worker-events replay), the
 per-rank step time feeds the straggler gauge, and when tracing is
 enabled each step is a ``cat="train"`` span in ``state.timeline()``.
+
+Step anatomy (round 19): a train_fn that runs its step through
+:func:`timed_step` (or accrues via :func:`add_step_anatomy`) gets each
+report's step wall partitioned exactly into ``data_wait`` / ``host``
+(dispatch until device launch) / ``compute`` (synced device wall) /
+``sync`` (the residual: this rank's wait for the slowest rank), shipped
+as per-rank ``ray_tpu_step_phase_seconds`` gauges; attach the compiled
+HLO's cost via :func:`set_step_cost` and ``ray_tpu_mfu_percent`` is
+exported too.
 """
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Any, Optional
@@ -27,6 +37,7 @@ from typing import Any, Optional
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.util import goodput as _goodput
 from ray_tpu.util import tracing as _tracing
+from ray_tpu.util import xla_cost as _xla_cost
 
 _local = threading.local()
 
@@ -87,6 +98,18 @@ class _Session:
         self._phase_t0 = time.perf_counter()
         self._data_wait_s = 0.0
         self._restore_s = 0.0
+        # Step-anatomy accruals (only populated by the instrumented
+        # step path — timed_step / add_step_anatomy; a plain train_fn
+        # keeps the classic data_wait/step residual accounting).
+        self._host_s = 0.0
+        self._compute_s = 0.0
+        self._anat_steps = 0
+        self._anat_recorded = False
+        # Cost model attached via set_step_cost: per-step FLOPs for
+        # this rank's shard, from the compiled HLO (util/xla_cost).
+        self._step_flops = 0.0
+        self._cost_kind: Optional[str] = None
+        self._cost_devs = 1
         self._step_span = None
         self._open_step_span()
 
@@ -142,6 +165,34 @@ class _Session:
             _goodput.record_step(self.trial, self.world_rank, phases)
         except Exception:
             pass
+        if self._anat_recorded:
+            # Anatomy partition of the step wall (interval minus the
+            # checkpoint-restore traffic): data_wait + host + compute
+            # + sync == wall exactly — sync is the residual, i.e. the
+            # wall time not attributable to this rank's own input/
+            # dispatch/device work: its wait for the slowest rank.
+            wall = max(0.0, interval - restore)
+            host = min(self._host_s, max(0.0, wall - data_wait))
+            compute = min(self._compute_s,
+                          max(0.0, wall - data_wait - host))
+            sync = max(0.0, wall - data_wait - host - compute)
+            mfu = None
+            if self._step_flops > 0 and compute > 0:
+                mfu = _xla_cost.mfu_percent(
+                    self._step_flops * max(1, self._anat_steps),
+                    compute, device_kind=self._cost_kind,
+                    n_devices=self._cost_devs)
+            try:
+                _goodput.record_anatomy(
+                    self.trial, self.world_rank,
+                    {"data_wait": data_wait, "host": host,
+                     "compute": compute, "sync": sync}, mfu=mfu)
+            except Exception:
+                pass
+        self._host_s = 0.0
+        self._compute_s = 0.0
+        self._anat_steps = 0
+        self._anat_recorded = False
         _tracing.finish_span(self._step_span)
         self._open_step_span()
         self._phase_t0 = time.perf_counter()
@@ -208,3 +259,67 @@ def add_data_wait(seconds: float) -> None:
     s = getattr(_local, "session", None)
     if s is not None and seconds > 0:
         s._data_wait_s += seconds
+
+
+def _block_sync(out: Any) -> Any:
+    """Force device completion of a step's outputs: the anatomy compute
+    phase must end at a real sync, never at async dispatch. Degrades to
+    a no-op off-jax (plain objects are already 'ready')."""
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            jax.block_until_ready(out)
+        except Exception:
+            pass
+    return out
+
+
+def add_step_anatomy(host_s: float, compute_s: float) -> None:
+    """Accrue one instrumented step's host (dispatch until device
+    launch) and compute (synced device wall) seconds to the active
+    session's current report interval. ``report()`` then partitions
+    the step wall into data_wait / host / compute / sync — sync is the
+    residual, this rank's wait for the slowest rank. A no-op outside a
+    train session."""
+    s = getattr(_local, "session", None)
+    if s is None:
+        return
+    s._host_s += max(0.0, float(host_s))
+    s._compute_s += max(0.0, float(compute_s))
+    s._anat_steps += 1
+    s._anat_recorded = True
+
+
+def timed_step(step_fn, *args: Any, **kwargs: Any):  # step-timed
+    """Run one training-step call with anatomy timing: host = wall
+    until the (async) dispatch returns, compute = wall until a real
+    device sync completes. Returns the step's outputs (synced)."""
+    t0 = time.perf_counter()
+    out = step_fn(*args, **kwargs)
+    host = time.perf_counter() - t0
+    _block_sync(out)
+    compute = time.perf_counter() - t0 - host
+    add_step_anatomy(host, compute)
+    return out
+
+
+def set_step_cost(cost, device_kind: Optional[str] = None,
+                  n_devices: int = 1) -> None:
+    """Attach the per-step cost model for this rank's shard so
+    ``report()`` can export MFU: ``cost`` is either FLOPs per step (a
+    number) or the dict returned by ``xla_cost.step_cost`` on the
+    compiled step function. A no-op outside a train session or when
+    the cost dict is an off-jax stub."""
+    s = getattr(_local, "session", None)
+    if s is None:
+        return
+    if isinstance(cost, dict):
+        if not cost.get("available"):
+            return
+        if device_kind is None:
+            device_kind = cost.get("device_kind")
+        cost = cost.get("flops", 0.0)
+    s._step_flops = max(0.0, float(cost or 0.0))
+    s._cost_kind = device_kind
+    s._cost_devs = max(1, int(n_devices))

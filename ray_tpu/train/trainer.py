@@ -612,6 +612,14 @@ class DataParallelTrainer:
                     # checkpoint.
                     time.sleep(0.2)
         finally:
+            # Session stop: the trial's per-rank gauge series (step
+            # time, MFU, anatomy phases) must not outlive the trial on
+            # the scrape (LC001 discipline — the local backend's worker
+            # threads never die to trigger the agent's sweep).
+            try:
+                _goodput.retract_trial(ledger.trial)
+            except Exception:
+                pass
             if pg is not None:
                 try:
                     table = self._pg_table(pg)

@@ -47,6 +47,12 @@ Passes (rule-id prefix):
   retraction sweep; ship-buffer drains must requeue on upload
   failure; ``# slot-guard`` declared acquire/release pairs must keep
   their failure-edge release.
+* ``timing`` (TH) — step-timing honesty: a ``# step-timed`` region's
+  timer reads must bracket a real host sync (``block_until_ready`` /
+  ``.item()`` / ``np.asarray`` / ``float()`` of a device scalar) — an
+  unsynced wall around async dispatch times the launch, not the
+  device, and the MFU/anatomy plane built on it would be fiction; a
+  marked region that times nothing is a stale annotation.
 * ``trace-propagation`` (TP) — manual flight-recorder spans
   (``tracing.start_span``) must be closable: never-finished local
   spans, finishes that aren't exception-safe (no ``finally`` and no
@@ -86,5 +92,6 @@ from ray_tpu.util.analyze import (  # noqa: F401,E402
     lock_order,
     retry,
     timeouts,
+    timing,
     trace_propagation,
 )

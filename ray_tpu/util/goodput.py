@@ -48,6 +48,16 @@ from ray_tpu.util import metrics as _metrics
 # after data waits / checkpoint traffic are subtracted out.
 STEP_PHASES = ("data_wait", "step", "report", "checkpoint_save",
                "checkpoint_restore")
+# Phases of one *instrumented* step's anatomy decomposition (the
+# round-19 step anatomy plane): data_wait = input starvation, host =
+# dispatch until device launch, compute = synced device wall, sync =
+# barrier skew (this rank's wait for the slowest rank — the session
+# computes it as the residual, so the four phases partition the
+# instrumented step wall exactly).
+ANATOMY_PHASES = ("data_wait", "host", "compute", "sync")
+# The slowest rank's excess classified by the phase that carries it.
+ANATOMY_CAUSES = {"data_wait": "input-bound", "host": "compute-bound",
+                  "compute": "compute-bound", "sync": "sync-bound"}
 # Phases of one consumer-loop batch (the data iterator histogram's
 # phase tag values): wait = consumer starved for the next batch,
 # user = consumer's own time between batches, transfer = host->device
@@ -171,6 +181,23 @@ def record_step(trial: str, rank: int, phases: Dict[str, float]) -> None:
     _emit({"k": "step", "t": str(trial), "r": int(rank), "p": phases})
 
 
+def record_anatomy(trial: str, rank: int, phases: Dict[str, float],
+                   mfu: Optional[float] = None) -> None:
+    """One instrumented step's anatomy decomposition for one rank
+    (``data_wait`` / ``host`` / ``compute`` / ``sync`` — the session
+    computes ``sync`` as the residual, so the phases partition the
+    instrumented step wall exactly). ``mfu`` is the cost-model MFU
+    percent when a step cost is attached. Per-rank gauges, retracted
+    on worker death and session stop."""
+    phases = {p: max(0.0, float(s)) for p, s in phases.items()
+              if p in ANATOMY_PHASES}
+    ev: dict = {"k": "anat", "t": str(trial), "r": int(rank),
+                "p": phases}
+    if mfu is not None:
+        ev["m"] = float(mfu)
+    _emit(ev)
+
+
 def record_downtime(trial: str, cause: str, seconds: float) -> None:
     """Non-productive trial wall time attributed to a cause (the
     trainer's downtime ledger: restart/drain/preemption)."""
@@ -195,6 +222,53 @@ def downtime_cause(exc: BaseException) -> str:
     if "Preempted" in type(exc).__name__:
         return "preemption"
     return "failure"
+
+
+def straggler_attribution(rank_phases: Dict[str, Dict[str, float]],
+                          min_excess_frac: float = 0.05
+                          ) -> Optional[dict]:
+    """Head-side straggler attributor: name the slowest rank of a gang
+    and classify its excess into input-bound / compute-bound /
+    sync-bound.
+
+    ``rank_phases`` maps rank -> anatomy phase seconds. The slowest
+    rank is the one with the most *own work* (everything but ``sync``
+    — in lockstep every rank's wall is identical, the barrier wait is
+    what differs, so ranking by wall would name nobody). Its excess
+    over the median of the other ranks is attributed to the phase with
+    the largest delta vs that median. Below ``min_excess_frac`` of the
+    baseline the gang is ``balanced`` — no rank gets accused of noise.
+
+    One implementation shared by ``train_stats``, ``ray-tpu top`` and
+    the anatomy bench, so they can never disagree about who the
+    straggler is."""
+    if not rank_phases or len(rank_phases) < 2:
+        return None
+
+    def own(p: Dict[str, float]) -> float:
+        return sum(v for k, v in p.items() if k != "sync")
+
+    totals = {r: own(p) for r, p in rank_phases.items()}
+    slowest = max(totals, key=lambda r: totals[r])
+    rest = sorted(t for r, t in totals.items() if r != slowest)
+    baseline = rest[len(rest) // 2]
+    excess = totals[slowest] - baseline
+    out = {"rank": slowest, "own_s": round(totals[slowest], 6),
+           "baseline_s": round(baseline, 6),
+           "excess_s": round(max(0.0, excess), 6)}
+    if baseline > 0 and excess < min_excess_frac * baseline:
+        out["cause"] = "balanced"
+        return out
+    deltas = {}
+    for phase in ANATOMY_PHASES:
+        others = sorted(rank_phases[r].get(phase, 0.0)
+                        for r in rank_phases if r != slowest)
+        med = others[len(others) // 2] if others else 0.0
+        deltas[phase] = rank_phases[slowest].get(phase, 0.0) - med
+    worst_phase = max(deltas, key=lambda p: deltas[p])
+    out["phase"] = worst_phase
+    out["cause"] = ANATOMY_CAUSES[worst_phase]
+    return out
 
 
 def attribution_ok(goodput: dict) -> Tuple[bool, bool]:
@@ -363,6 +437,21 @@ def apply_events(events: List[dict], node_id: str,
                         tags={"node_id": node_id, "trial": trial,
                               "rank": rank})
                     gauge_keys.append(("rank", trial, rank))
+            elif kind == "anat":
+                trial = ev.get("t", "train")
+                rank = str(ev.get("r", 0))
+                for phase, sec in (ev.get("p") or {}).items():
+                    if phase in ANATOMY_PHASES:
+                        _metrics.TRAIN_STEP_ANATOMY_SECONDS.set(
+                            float(sec),
+                            tags={"node_id": node_id, "trial": trial,
+                                  "phase": phase, "rank": rank})
+                if ev.get("m") is not None:
+                    _metrics.TRAIN_MFU_PERCENT.set(
+                        float(ev["m"]),
+                        tags={"node_id": node_id, "trial": trial,
+                              "rank": rank})
+                gauge_keys.append(("anat", trial, rank))
             elif kind == "down":
                 _metrics.TRAIN_DOWNTIME_SECONDS.inc(
                     float(ev.get("s", 0.0)),
@@ -399,6 +488,30 @@ def retract_gauges(keys, node_id: str) -> None:
             if key[0] == "rank":
                 _metrics.TRAIN_RANK_STEP_SECONDS.remove(tags={
                     "node_id": node_id, "trial": key[1], "rank": key[2]})
+            elif key[0] == "anat":
+                for phase in ANATOMY_PHASES:
+                    try:
+                        _metrics.TRAIN_STEP_ANATOMY_SECONDS.remove(
+                            tags={"node_id": node_id, "trial": key[1],
+                                  "phase": phase, "rank": key[2]})
+                    except Exception:
+                        pass
+                _metrics.TRAIN_MFU_PERCENT.remove(tags={
+                    "node_id": node_id, "trial": key[1], "rank": key[2]})
+            elif key[0] == "trial":
+                # Session-stop sweep: drop EVERY per-rank child of the
+                # trial from this process's registry (the local backend
+                # runs workers as threads — nothing dies to trigger the
+                # agent's worker-death retraction).
+                for fam in (_metrics.TRAIN_RANK_STEP_SECONDS,
+                            _metrics.TRAIN_MFU_PERCENT,
+                            _metrics.TRAIN_STEP_ANATOMY_SECONDS):
+                    for ld in fam.series():
+                        if ld.get("trial") == key[1]:
+                            try:
+                                fam.remove(tags=ld)
+                            except Exception:
+                                pass
             elif key[0] == "pool":
                 _metrics.DATA_POOL_SIZE.remove(tags={
                     "node_id": node_id, "pool": key[1]})
@@ -406,6 +519,14 @@ def retract_gauges(keys, node_id: str) -> None:
                     "node_id": node_id, "pool": key[1]})
         except Exception:
             pass
+
+
+def retract_trial(trial: str, node_id: str = _LOCAL_NODE) -> None:
+    """Session stop: retract the trial's per-rank gauge series (step
+    time, MFU, anatomy phases) from this process's registry. The
+    trainer calls this when a trial finishes; on the cluster backend
+    the agent's worker-death sweep covers its copies."""
+    retract_gauges([("trial", str(trial))], node_id)
 
 
 # -- reading the plane back (state.train_stats / data_stats / bench) -------
@@ -540,6 +661,10 @@ def train_stats(parsed: Optional[dict] = None) -> dict:
                                 "trial"))
     names |= set(obs.sum_counter(
         parsed, "ray_tpu_train_downtime_seconds_total", "trial"))
+    # Anatomy-only producers (the LLM engine's step loop reports no
+    # session metrics) still get a per-trial entry.
+    names |= {dict(lb).get("trial", "") for lb in
+              (parsed.get("ray_tpu_step_phase_seconds") or {})}
     for trial in sorted(n for n in names if n):
         entry: dict = {}
         reports = obs.sum_counter(parsed, "ray_tpu_train_reports_total",
@@ -569,6 +694,31 @@ def train_stats(parsed: Optional[dict] = None) -> dict:
             if fastest > 0:
                 entry["rank_skew"] = round(max(ranks.values()) / fastest,
                                            3)
+        anat_ranks: Dict[str, Dict[str, float]] = {}
+        for labels, val in (parsed.get(
+                "ray_tpu_step_phase_seconds") or {}).items():
+            ld = dict(labels)
+            if ld.get("trial") == trial:
+                anat_ranks.setdefault(ld.get("rank", "?"), {})[
+                    ld.get("phase", "?")] = round(val, 6)
+        mfu: Dict[str, float] = {}
+        for labels, val in (parsed.get(
+                "ray_tpu_mfu_percent") or {}).items():
+            ld = dict(labels)
+            if ld.get("trial") == trial:
+                mfu[ld.get("rank", "?")] = round(val, 3)
+        if anat_ranks or mfu:
+            anatomy: dict = {}
+            if anat_ranks:
+                anatomy["ranks"] = {
+                    r: dict(sorted(anat_ranks[r].items()))
+                    for r in sorted(anat_ranks)}
+                verdict = straggler_attribution(anat_ranks)
+                if verdict:
+                    anatomy["straggler"] = verdict
+            if mfu:
+                anatomy["mfu_pct"] = dict(sorted(mfu.items()))
+            entry["anatomy"] = anatomy
         downtime = obs.sum_counter(
             parsed, "ray_tpu_train_downtime_seconds_total", "cause",
             trial=trial)

@@ -58,6 +58,19 @@ class Metric:
                     removed = True
         return removed
 
+    def series(self) -> List[Dict[str, str]]:
+        """Tag dicts of every live child. Lifecycle sweeps (e.g. a
+        trial stopping) enumerate these to retract an entity's series
+        without knowing every key the entity ever emitted."""
+        keys: List[Tuple] = []
+        with self._lock:
+            for table in ("_values", "_counts", "_sums", "_totals"):
+                d = getattr(self, table, None)
+                if d is not None:
+                    keys.extend(d.keys())
+        return [dict(zip(self.tag_keys, k))
+                for k in dict.fromkeys(keys)]
+
     def _fmt_tags(self, key: Tuple) -> str:
         if not self.tag_keys:
             return ""
@@ -652,6 +665,27 @@ TRAIN_EVENTS_DROPPED = Counter(
     "Goodput observations discarded by a worker's bounded ship buffer "
     "before the event flusher drained them (no silent caps)",
     tag_keys=("node_id",),
+)
+
+# -- step anatomy plane (round 19: MFU accounting + per-rank phase
+# decomposition). Both are per-entity gauges: retracted on worker
+# death and session stop via goodput.retract_gauges / retract_trial.
+TRAIN_MFU_PERCENT = Gauge(
+    "ray_tpu_mfu_percent",
+    "Model-FLOPs utilization per rank: XLA cost-model FLOPs per step "
+    "(util/xla_cost, from the compiled HLO — not a hand formula) over "
+    "measured device-compute seconds, against the measure.py per-chip "
+    "peak; retracted on worker death and session stop",
+    tag_keys=("node_id", "trial", "rank"),
+)
+TRAIN_STEP_ANATOMY_SECONDS = Gauge(
+    "ray_tpu_step_phase_seconds",
+    "Most recent step-anatomy decomposition per rank: data_wait / host "
+    "(dispatch until device launch) / compute (synced device wall) / "
+    "sync (barrier skew: this rank's wait for the slowest rank); the "
+    "four phases partition the instrumented step wall exactly; "
+    "retracted on worker death and session stop",
+    tag_keys=("node_id", "trial", "phase", "rank"),
 )
 
 # -- streaming dataflow (round 14: memory-safe data plane). Block

@@ -260,13 +260,16 @@ def test_session_step_phases_exact_counts():
         delta, "ray_tpu_train_reports_total", "trial",
         trial="train").values())
     assert int(reports) == 6
-    # Straggler gauge: one child per rank.
+    # Straggler gauge: per-rank children live only while the trial
+    # runs — fit() retracts them at session stop (round-19 LC001
+    # discipline; the cluster backend's agent sweep covers worker
+    # death), so a finished trial leaves no stale rank series.
     parsed = _snapshot()
     ranks = {dict(labels).get("rank")
              for labels in (parsed.get(
                  "ray_tpu_train_rank_step_seconds") or {})
              if dict(labels).get("trial") == "train"}
-    assert {"0", "1"} <= ranks
+    assert ranks == set()
 
     # Goodput: clean run => no downtime, 100%.
     assert result.goodput is not None
@@ -278,7 +281,9 @@ def test_session_step_phases_exact_counts():
     entry = ts["trials"]["train"]
     assert entry["reports"] >= 6
     assert "step" in entry["phases"]
-    assert "rank_step_s" in entry
+    # rank_step_s is derived from the per-rank gauges retracted above,
+    # so a finished trial no longer carries it.
+    assert "rank_step_s" not in entry
 
 
 def test_checkpoint_restore_phase_observed():
